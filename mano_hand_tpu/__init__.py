@@ -1,0 +1,18 @@
+"""mano_hand_tpu — a TPU-native (JAX/XLA) framework for the MANO hand model.
+
+Built from scratch with the capability surface of reyuwei/MANO-Hand
+(reference mounted at /root/reference), re-designed TPU-first: a pure,
+jitted, vmapped, differentiable forward core; a float64 NumPy oracle; an
+asset pipeline; gradient-based pose/shape fitting; and mesh-sharded
+multi-chip execution via jax.sharding.
+"""
+
+from mano_hand_tpu import constants
+from mano_hand_tpu.assets import (
+    ManoParams,
+    load_model,
+    synthetic_pair,
+    synthetic_params,
+)
+
+__version__ = "0.1.0"

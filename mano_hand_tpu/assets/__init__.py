@@ -1,0 +1,23 @@
+from mano_hand_tpu.assets.schema import ManoParams, validate
+from mano_hand_tpu.assets.synthetic import synthetic_pair, synthetic_params
+from mano_hand_tpu.assets.loader import (
+    load_dumped_pickle,
+    load_model,
+    load_npz,
+    load_official_pickle,
+    save_dumped_pickle,
+    save_npz,
+)
+
+__all__ = [
+    "ManoParams",
+    "validate",
+    "synthetic_params",
+    "synthetic_pair",
+    "load_model",
+    "load_npz",
+    "load_dumped_pickle",
+    "load_official_pickle",
+    "save_npz",
+    "save_dumped_pickle",
+]

@@ -1,0 +1,154 @@
+"""Asset I/O: official MANO pickles, reference-style dumped pickles, and the
+canonical ``.npz`` form.
+
+Covers both layers of the reference's asset pipeline:
+  * C8 "asset converter" (/root/reference/dump_model.py:4-21): official
+    chumpy-era pickle -> plain arrays (sparse J_regressor densified,
+    root parent sentinel),
+  * C1 "param loader" (/root/reference/mano_np.py:17-33): reads the dumped
+    nine-key pickle.
+
+We add a canonical ``.npz`` form (no pickle at runtime) and keep pickle paths
+for interop with assets produced by the reference's own dump_model.py.
+"""
+
+from __future__ import annotations
+
+import pickle
+from pathlib import Path
+from typing import Union
+
+import numpy as np
+
+from mano_hand_tpu import constants as C
+from mano_hand_tpu.assets.schema import ARRAY_FIELDS, ManoParams, validate
+
+PathLike = Union[str, Path]
+
+_PICKLE_KEYS = {
+    "pose_pca_basis": "pca_basis",
+    "pose_pca_mean": "pca_mean",
+    "J_regressor": "j_regressor",
+    "skinning_weights": "lbs_weights",
+    "mesh_pose_basis": "pose_basis",
+    "mesh_shape_basis": "shape_basis",
+    "mesh_template": "v_template",
+    "faces": "faces",
+}
+
+
+def _dense(a) -> np.ndarray:
+    """Materialize chumpy arrays / scipy sparse matrices as dense ndarrays."""
+    if hasattr(a, "toarray"):  # scipy sparse
+        return np.asarray(a.toarray())
+    if hasattr(a, "r"):  # chumpy Ch object
+        return np.asarray(a.r)
+    return np.asarray(a)
+
+
+def _parents_from(raw) -> tuple:
+    parents = list(raw)
+    parents[0] = -1  # reference stores None (dump_model.py:18); we use -1
+    return tuple(int(p) for p in parents)
+
+
+def _infer_side(path: PathLike, explicit: str | None) -> str:
+    if explicit is not None:
+        return explicit
+    name = Path(path).name.lower()
+    return C.LEFT if "left" in name else C.RIGHT
+
+
+def load_dumped_pickle(path: PathLike, side: str | None = None) -> ManoParams:
+    """Load an asset in the reference's dumped-pickle format (nine keys).
+
+    Keys may be str or bytes: the reference reads its own dumps with
+    ``encoding='bytes'`` (/root/reference/mano_np.py:18), so py2-era dumps
+    with bytes keys are legitimate inputs.
+    """
+    with open(path, "rb") as f:
+        raw = pickle.load(f, encoding="bytes")
+    raw = {k.decode() if isinstance(k, bytes) else k: v for k, v in raw.items()}
+    kwargs = {ours: _dense(raw[theirs]) for theirs, ours in _PICKLE_KEYS.items()}
+    kwargs["faces"] = kwargs["faces"].astype(np.int32)
+    return validate(
+        ManoParams(
+            parents=_parents_from(raw["parents"]),
+            side=_infer_side(path, side),
+            **kwargs,
+        )
+    )
+
+
+def load_official_pickle(path: PathLike, side: str | None = None) -> ManoParams:
+    """Load an official MANO_{LEFT,RIGHT}.pkl directly (chumpy-era pickle).
+
+    Folds in the conversion the reference performs offline
+    (/root/reference/dump_model.py:8-18): densify the sparse J_regressor,
+    take row 0 of kintree_table as the parent array, and strip chumpy
+    wrappers. Requires ``encoding='latin1'`` for the py2-era pickle.
+    """
+    with open(path, "rb") as f:
+        raw = pickle.load(f, encoding="latin1")
+    return validate(
+        ManoParams(
+            v_template=_dense(raw["v_template"]).astype(np.float64),
+            shape_basis=_dense(raw["shapedirs"]).astype(np.float64),
+            pose_basis=_dense(raw["posedirs"]).astype(np.float64),
+            j_regressor=_dense(raw["J_regressor"]).astype(np.float64),
+            lbs_weights=_dense(raw["weights"]).astype(np.float64),
+            pca_basis=_dense(raw["hands_components"]).astype(np.float64),
+            pca_mean=_dense(raw["hands_mean"]).astype(np.float64),
+            faces=_dense(raw["f"]).astype(np.int32),
+            parents=_parents_from(_dense(raw["kintree_table"])[0]),
+            side=_infer_side(path, side),
+        )
+    )
+
+
+def save_npz(params: ManoParams, path: PathLike) -> None:
+    """Canonical on-disk form: a flat .npz, no pickle objects."""
+    arrays = {f: np.asarray(getattr(params, f)) for f in ARRAY_FIELDS}
+    np.savez(
+        path,
+        parents=np.asarray(params.parents, dtype=np.int32),
+        side=np.asarray(params.side),
+        **arrays,
+    )
+
+
+def load_npz(path: PathLike, side: str | None = None) -> ManoParams:
+    with np.load(path) as z:
+        arrays = {f: z[f] for f in ARRAY_FIELDS}
+        arrays["faces"] = arrays["faces"].astype(np.int32)
+        return validate(
+            ManoParams(
+                parents=tuple(int(p) for p in z["parents"]),
+                side=side if side is not None else str(z["side"]),
+                **arrays,
+            )
+        )
+
+
+def save_dumped_pickle(params: ManoParams, path: PathLike) -> None:
+    """Write the reference's dumped-pickle format for interop (C8 parity):
+    the same nine keys /root/reference/mano_np.py:20-33 reads, including the
+    ``parents[0] = None`` sentinel."""
+    out = {theirs: np.asarray(getattr(params, ours))
+           for theirs, ours in _PICKLE_KEYS.items()}
+    parents = [None] + [int(p) for p in params.parents[1:]]
+    out["parents"] = parents
+    with open(path, "wb") as f:
+        pickle.dump(out, f)
+
+
+def load_model(path: PathLike, side: str | None = None) -> ManoParams:
+    """Load an asset of any supported format, sniffed by extension/content."""
+    p = Path(path)
+    if p.suffix == ".npz":
+        return load_npz(p, side=side)
+    # Both pickle flavors end in .pkl; sniff by content.
+    try:
+        return load_dumped_pickle(p, side=side)
+    except (KeyError, UnicodeDecodeError):
+        return load_official_pickle(p, side=side)

@@ -1,0 +1,137 @@
+"""The MANO parameter PyTree — the data contract between the asset layer and
+the compute core.
+
+Mirrors the nine-key pickle schema that is the reference's de-facto API
+(/root/reference/dump_model.py:8-18 -> /root/reference/mano_np.py:20-33), but
+as an immutable, jit/vmap/grad-friendly PyTree with static metadata
+(kinematic tree, handedness) carried out-of-band so XLA sees only dense
+arrays with static shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import numpy as np
+
+from mano_hand_tpu import constants as C
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ManoParams:
+    """Frozen MANO model parameters.
+
+    Array fields are PyTree leaves (np.ndarray or jax.Array); ``parents`` and
+    ``side`` are static aux data so the FK unroll and caching stay static
+    under ``jax.jit``.
+
+    Shapes (V=778 verts, J=16 joints, S=10 shape dims, P=135 pose-basis dims):
+      v_template     [V, 3]    rest-pose template mesh
+      shape_basis    [V, 3, S] shape blendshapes ("shapedirs")
+      pose_basis     [V, 3, P] pose-corrective blendshapes ("posedirs")
+      j_regressor    [J, V]    joint regressor (dense)
+      lbs_weights    [V, J]    linear-blend-skinning weights
+      pca_basis      [45, 45]  finger-pose PCA basis, rows = components
+      pca_mean       [45]      mean finger pose (flattened 15x3 axis-angle)
+      faces          [F, 3]    triangle indices, 0-based int32
+    """
+
+    v_template: Any
+    shape_basis: Any
+    pose_basis: Any
+    j_regressor: Any
+    lbs_weights: Any
+    pca_basis: Any
+    pca_mean: Any
+    faces: Any
+    parents: Tuple[int, ...] = dataclasses.field(
+        default=C.MANO_PARENTS, metadata={"static": True}
+    )
+    side: str = dataclasses.field(default=C.RIGHT, metadata={"static": True})
+
+    # -- convenience views ---------------------------------------------------
+    @property
+    def n_verts(self) -> int:
+        return self.v_template.shape[0]
+
+    @property
+    def n_joints(self) -> int:
+        return self.j_regressor.shape[0]
+
+    @property
+    def n_shape(self) -> int:
+        return self.shape_basis.shape[-1]
+
+    def astype(self, dtype) -> "ManoParams":
+        """Cast all float leaves to ``dtype`` (faces stay integer)."""
+        def cast(name, x):
+            if name == "faces":
+                return x
+            return x.astype(dtype)
+        return dataclasses.replace(
+            self, **{f: cast(f, getattr(self, f)) for f in ARRAY_FIELDS}
+        )
+
+    def device_put(self, sharding=None) -> "ManoParams":
+        put = (lambda x: jax.device_put(x, sharding)) if sharding else jax.device_put
+        return dataclasses.replace(
+            self, **{f: put(getattr(self, f)) for f in ARRAY_FIELDS}
+        )
+
+
+ARRAY_FIELDS = (
+    "v_template",
+    "shape_basis",
+    "pose_basis",
+    "j_regressor",
+    "lbs_weights",
+    "pca_basis",
+    "pca_mean",
+    "faces",
+)
+
+
+def validate(p: ManoParams) -> ManoParams:
+    """Shape/consistency check of the asset contract; returns ``p``.
+
+    Raises ValueError with a precise message on any mismatch, so a bad asset
+    fails at load time rather than as an XLA shape error deep in a trace.
+    """
+    v, j = p.v_template.shape[0], p.j_regressor.shape[0]
+    s = p.shape_basis.shape[-1]
+    expect = {
+        "v_template": (v, 3),
+        "shape_basis": (v, 3, s),
+        "pose_basis": (v, 3, (j - 1) * 9),
+        "j_regressor": (j, v),
+        "lbs_weights": (v, j),
+        "pca_basis": ((j - 1) * 3, (j - 1) * 3),
+        "pca_mean": ((j - 1) * 3,),
+    }
+    for name, shape in expect.items():
+        got = tuple(getattr(p, name).shape)
+        if got != shape:
+            raise ValueError(f"{name}: expected shape {shape}, got {got}")
+    if p.faces.ndim != 2 or p.faces.shape[1] != 3:
+        raise ValueError(f"faces: expected [F, 3], got {tuple(p.faces.shape)}")
+    if len(p.parents) != j:
+        raise ValueError(f"parents: expected length {j}, got {len(p.parents)}")
+    if p.parents[0] != -1:
+        raise ValueError("parents[0] must be -1 (root)")
+    for i, par in enumerate(p.parents[1:], start=1):
+        if not (0 <= par < i):
+            raise ValueError(
+                f"parents must be topologically ordered; parents[{i}]={par}"
+            )
+    faces = np.asarray(p.faces)
+    if faces.size and (faces.min() < 0 or faces.max() >= v):
+        raise ValueError(
+            f"faces indices must be in [0, {v}); got range "
+            f"[{faces.min()}, {faces.max()}]"
+        )
+    if p.side not in (C.LEFT, C.RIGHT):
+        raise ValueError(f"side must be 'left' or 'right', got {p.side!r}")
+    return p

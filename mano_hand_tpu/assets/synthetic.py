@@ -1,0 +1,92 @@
+"""Deterministic synthetic MANO-shaped assets for tests and benchmarks.
+
+The official MANO pickles are license-gated and absent from both the
+reference repo (gitignored, /root/reference/.gitignore:1-5) and this one, so
+every test/bench runs on a generated asset with the exact schema of
+/root/reference/dump_model.py:8-18. The generator is seeded and pure NumPy,
+so golden digests are stable across machines.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mano_hand_tpu import constants as C
+from mano_hand_tpu.assets.schema import ManoParams, validate
+
+
+def synthetic_params(
+    seed: int = 0,
+    side: str = C.RIGHT,
+    n_verts: int = C.N_VERTS,
+    n_joints: int = C.N_JOINTS,
+    n_shape: int = C.N_SHAPE,
+    n_faces: int = C.N_FACES,
+    dtype=np.float64,
+) -> ManoParams:
+    """Build a random but structurally valid MANO-like asset.
+
+    Properties the real asset has and tests rely on:
+      * j_regressor rows are non-negative and sum to 1 (joints are convex
+        combinations of vertices),
+      * lbs_weights rows are non-negative and sum to 1, concentrated on a
+        few joints,
+      * pca_basis is orthonormal (rows = components),
+      * parents is the true MANO kinematic tree when n_joints == 16.
+    """
+    rng = np.random.default_rng(seed)
+    n_pose_aa = (n_joints - 1) * 3
+    n_pose_basis = (n_joints - 1) * 9
+
+    # A blobby hand-scale (~10 cm) point cloud as the template.
+    v_template = rng.normal(scale=0.04, size=(n_verts, 3))
+    v_template[:, 1] += np.linspace(0.0, 0.1, n_verts)  # stretch along +y
+
+    shape_basis = rng.normal(scale=5e-3, size=(n_verts, 3, n_shape))
+    pose_basis = rng.normal(scale=5e-4, size=(n_verts, 3, n_pose_basis))
+
+    # Joint regressor: each joint draws from a random vertex neighborhood.
+    j_regressor = rng.random((n_joints, n_verts)) ** 8  # sparse-ish
+    j_regressor /= j_regressor.sum(axis=1, keepdims=True)
+
+    # Skinning weights: concentrate each vertex on ~2 joints.
+    lbs_weights = rng.random((n_verts, n_joints)) ** 6
+    lbs_weights /= lbs_weights.sum(axis=1, keepdims=True)
+
+    # Orthonormal PCA basis via QR; small mean pose.
+    q, _ = np.linalg.qr(rng.normal(size=(n_pose_aa, n_pose_aa)))
+    pca_basis = q
+    pca_mean = rng.normal(scale=0.05, size=(n_pose_aa,))
+
+    # Random valid triangles (distinct vertex ids per face).
+    faces = np.stack(
+        [rng.choice(n_verts, size=3, replace=False) for _ in range(n_faces)]
+    ).astype(np.int32)
+
+    if n_joints == C.N_JOINTS:
+        parents = C.MANO_PARENTS
+    else:
+        parents = (-1,) + tuple(rng.integers(0, i) for i in range(1, n_joints))
+
+    return validate(
+        ManoParams(
+            v_template=v_template.astype(dtype),
+            shape_basis=shape_basis.astype(dtype),
+            pose_basis=pose_basis.astype(dtype),
+            j_regressor=j_regressor.astype(dtype),
+            lbs_weights=lbs_weights.astype(dtype),
+            pca_basis=pca_basis.astype(dtype),
+            pca_mean=pca_mean.astype(dtype),
+            faces=faces,
+            parents=parents,
+            side=side,
+        )
+    )
+
+
+def synthetic_pair(seed: int = 0, dtype=np.float64):
+    """A (left, right) pair of synthetic hands, distinct but seeded."""
+    return (
+        synthetic_params(seed=seed + 1, side=C.LEFT, dtype=dtype),
+        synthetic_params(seed=seed, side=C.RIGHT, dtype=dtype),
+    )

@@ -1,0 +1,25 @@
+"""Model-family constants for the MANO hand.
+
+These pin the shape contract described in the reference's asset schema
+(/root/reference/dump_model.py:8-18 written, /root/reference/mano_np.py:20-36
+read): 16 joints, 778 vertices, 1538 faces, 10 shape coefficients, 45
+axis-angle pose dims (15 articulated joints x 3), and 135 pose-corrective
+blendshape dims (15 x 9 rotation-matrix deltas).
+"""
+
+N_VERTS = 778
+N_JOINTS = 16
+N_FACES = 1538
+N_SHAPE = 10
+N_POSE_JOINTS = N_JOINTS - 1          # articulated joints (wrist excluded)
+N_POSE_AXISANGLE = N_POSE_JOINTS * 3  # 45: flattened finger axis-angles
+N_POSE_BASIS = N_POSE_JOINTS * 9      # 135: (R - I) rotation-matrix deltas
+
+# The MANO kinematic tree (root = wrist), topologically ordered so every
+# parent index precedes its children. Root's parent is -1 (the reference
+# stores None at /root/reference/dump_model.py:18 and never dereferences it,
+# /root/reference/mano_np.py:98).
+MANO_PARENTS = (-1, 0, 1, 2, 0, 4, 5, 0, 7, 8, 0, 10, 11, 0, 13, 14)
+
+LEFT = "left"
+RIGHT = "right"

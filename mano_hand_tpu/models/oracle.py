@@ -1,0 +1,148 @@
+"""Float64 NumPy oracle: the numerical ground truth for every JAX-path test.
+
+A faithful, functional re-expression of the reference forward pass
+(/root/reference/mano_np.py:79-148), preserving its quirks exactly:
+
+  * theta is clamped to float64 eps before normalizing (mano_np.py:132);
+  * the pose corrective uses (R[1:] - I).ravel() in row-major order, i.e. the
+    global-rotation joint is excluded (mano_np.py:87-91);
+  * "rest_verts" is the pose-and-shape-corrected mesh BEFORE skinning
+    (mano_np.py:93), not the template;
+  * the PCA decode is coeffs @ basis[:n] + mean, then the global-rot row is
+    prepended (mano_np.py:67-72).
+
+Unlike the reference's stateful class, this module is pure functions over a
+ManoParams PyTree, mirroring the JAX core's API one-to-one so the two paths
+can be diffed stage by stage.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from mano_hand_tpu.assets.schema import ManoParams
+
+
+class ManoOutputs(NamedTuple):
+    """Everything the reference exposes after an update (mano_np.py:41-44)."""
+
+    verts: np.ndarray        # [V, 3] posed, skinned mesh
+    joints: np.ndarray       # [J, 3] rest-pose joint locations (self.J)
+    rest_verts: np.ndarray   # [V, 3] blendshaped mesh before skinning
+    rot_mats: np.ndarray     # [J, 3, 3] per-joint rotations (self.R)
+    posed_joints: np.ndarray  # [J, 3] world joint locations after FK (extra)
+
+
+def rodrigues(axis_angle: np.ndarray) -> np.ndarray:
+    """Axis-angle [..., 3] -> rotation matrices [..., 3, 3] (float64).
+
+    Same formula as the reference (mano_np.py:130-147): R = cos(t) I +
+    (1 - cos(t)) rr^T + sin(t) K(r_hat), with t clamped to f64 eps.
+    """
+    aa = np.asarray(axis_angle, dtype=np.float64)
+    theta = np.sqrt((aa * aa).sum(axis=-1, keepdims=True))
+    theta = np.maximum(theta, np.finfo(np.float64).eps)
+    axis = aa / theta
+    x, y, z = axis[..., 0], axis[..., 1], axis[..., 2]
+    zero = np.zeros_like(x)
+    K = np.stack(
+        [zero, -z, y, z, zero, -x, -y, x, zero], axis=-1
+    ).reshape(*axis.shape[:-1], 3, 3)
+    outer = axis[..., :, None] * axis[..., None, :]
+    c = np.cos(theta)[..., None]
+    s = np.sin(theta)[..., None]
+    eye = np.broadcast_to(np.eye(3), outer.shape)
+    return c * eye + (1.0 - c) * outer + s * K
+
+
+def decode_pca_pose(
+    params: ManoParams,
+    pca_coeffs: np.ndarray,
+    global_rot: np.ndarray | None = None,
+) -> np.ndarray:
+    """PCA coefficients [n<=45] (+ optional global rot [3]) -> pose [16, 3].
+
+    Semantics of mano_np.py:66-72: truncated basis rows, add mean, reshape
+    to [15, 3], prepend the global-rotation row (zeros if not given).
+    """
+    pca_coeffs = np.asarray(pca_coeffs, dtype=np.float64)
+    n = pca_coeffs.shape[-1]
+    flat = pca_coeffs @ np.asarray(params.pca_basis)[:n] + np.asarray(params.pca_mean)
+    fingers = flat.reshape(15, 3)
+    root = (
+        np.zeros((1, 3))
+        if global_rot is None
+        else np.asarray(global_rot, dtype=np.float64).reshape(1, 3)
+    )
+    return np.concatenate([root, fingers], axis=0)
+
+
+def forward(
+    params: ManoParams,
+    pose: np.ndarray | None = None,
+    shape: np.ndarray | None = None,
+) -> ManoOutputs:
+    """Full MANO forward pass: blendshapes -> joints -> FK -> LBS.
+
+    pose: [16, 3] axis-angle per joint (row 0 = global rotation).
+    shape: [10] shape coefficients.
+    """
+    n_joints = params.j_regressor.shape[0]
+    pose = (
+        np.zeros((n_joints, 3)) if pose is None
+        else np.asarray(pose, dtype=np.float64).reshape(n_joints, 3)
+    )
+    shape = (
+        np.zeros(params.shape_basis.shape[-1]) if shape is None
+        else np.asarray(shape, dtype=np.float64)
+    )
+    template = np.asarray(params.v_template, dtype=np.float64)
+    shape_basis = np.asarray(params.shape_basis, dtype=np.float64)
+    pose_basis = np.asarray(params.pose_basis, dtype=np.float64)
+    j_reg = np.asarray(params.j_regressor, dtype=np.float64)
+    weights = np.asarray(params.lbs_weights, dtype=np.float64)
+
+    # 1. Shape blendshape (mano_np.py:81) and joint regression (mano_np.py:83).
+    v_shaped = template + shape_basis @ shape
+    joints = j_reg @ v_shaped
+
+    # 2. Per-joint rotations and pose corrective (mano_np.py:84-91). The
+    #    corrective is driven by (R - I) of the 15 articulated joints only.
+    rot_mats = rodrigues(pose)
+    pose_feat = (rot_mats[1:] - np.eye(3)).ravel()
+    v_posed = v_shaped + pose_basis @ pose_feat
+    rest_verts = v_posed  # reference naming (mano_np.py:93)
+
+    # 3. Forward kinematics along the parent chain (mano_np.py:96-104),
+    #    expressed as (rotation, translation) pairs instead of 4x4 stacking.
+    world_rot = np.empty((n_joints, 3, 3))
+    world_t = np.empty((n_joints, 3))
+    world_rot[0] = rot_mats[0]
+    world_t[0] = joints[0]
+    for i in range(1, n_joints):
+        p = params.parents[i]
+        local_t = joints[i] - joints[p]
+        world_rot[i] = world_rot[p] @ rot_mats[i]
+        world_t[i] = world_rot[p] @ local_t + world_t[p]
+    posed_joints = world_t.copy()
+
+    # 4. Inverse-bind (mano_np.py:106-110): subtract each joint's rest
+    #    position as carried through its world transform, so skinning maps
+    #    rest-pose verts directly to posed verts.
+    skin_t = world_t - np.einsum("jab,jb->ja", world_rot, joints)
+
+    # 5. LBS (mano_np.py:112-115), fused: blend rotations and translations
+    #    per vertex, never materializing [V, 4, 4].
+    blend_rot = np.einsum("vj,jab->vab", weights, world_rot)
+    blend_t = weights @ skin_t
+    verts = np.einsum("vab,vb->va", blend_rot, v_posed) + blend_t
+
+    return ManoOutputs(
+        verts=verts,
+        joints=joints,
+        rest_verts=rest_verts,
+        rot_mats=rot_mats,
+        posed_joints=posed_joints,
+    )

@@ -1,0 +1,37 @@
+"""Test harness config: run JAX on a virtual 8-device CPU mesh.
+
+Must set env vars before the first ``import jax`` anywhere in the test
+process so sharding/pjit paths are exercised without TPU hardware
+(SURVEY.md §4.5: "multi-node without a cluster").
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Make the repo root importable regardless of pytest invocation directory.
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import pytest  # noqa: E402
+
+from mano_hand_tpu.assets import synthetic_pair, synthetic_params  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def params():
+    """Session-wide synthetic right-hand asset (float64)."""
+    return synthetic_params(seed=0)
+
+
+@pytest.fixture(scope="session")
+def params_pair():
+    """(left, right) synthetic asset pair."""
+    return synthetic_pair(seed=0)

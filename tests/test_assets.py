@@ -1,0 +1,118 @@
+"""Asset layer: schema validation, synthetic generator, loader round-trips."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from mano_hand_tpu import constants as C
+from mano_hand_tpu.assets import (
+    ManoParams,
+    load_dumped_pickle,
+    load_model,
+    load_npz,
+    save_dumped_pickle,
+    save_npz,
+    synthetic_params,
+    validate,
+)
+
+
+def test_synthetic_shapes(params):
+    assert params.v_template.shape == (C.N_VERTS, 3)
+    assert params.shape_basis.shape == (C.N_VERTS, 3, C.N_SHAPE)
+    assert params.pose_basis.shape == (C.N_VERTS, 3, C.N_POSE_BASIS)
+    assert params.j_regressor.shape == (C.N_JOINTS, C.N_VERTS)
+    assert params.lbs_weights.shape == (C.N_VERTS, C.N_JOINTS)
+    assert params.pca_basis.shape == (45, 45)
+    assert params.pca_mean.shape == (45,)
+    assert params.faces.shape == (C.N_FACES, 3)
+    assert params.parents == C.MANO_PARENTS
+
+
+def test_synthetic_stochastic_structure(params):
+    # Convex-combination structure of regressor and skinning weights.
+    np.testing.assert_allclose(params.j_regressor.sum(axis=1), 1.0, atol=1e-12)
+    np.testing.assert_allclose(params.lbs_weights.sum(axis=1), 1.0, atol=1e-12)
+    assert (params.j_regressor >= 0).all()
+    assert (params.lbs_weights >= 0).all()
+    # PCA basis orthonormal.
+    np.testing.assert_allclose(
+        params.pca_basis @ params.pca_basis.T, np.eye(45), atol=1e-10
+    )
+
+
+def test_synthetic_deterministic():
+    a = synthetic_params(seed=7)
+    b = synthetic_params(seed=7)
+    np.testing.assert_array_equal(a.v_template, b.v_template)
+    np.testing.assert_array_equal(a.faces, b.faces)
+
+
+def test_validate_rejects_bad_parents(params):
+    bad = dataclasses.replace(params, parents=(0,) + params.parents[1:])
+    with pytest.raises(ValueError, match="parents"):
+        validate(bad)
+
+
+def test_validate_rejects_bad_shape(params):
+    bad = dataclasses.replace(params, pca_mean=params.pca_mean[:-1])
+    with pytest.raises(ValueError, match="pca_mean"):
+        validate(bad)
+
+
+def test_npz_roundtrip(params, tmp_path):
+    path = tmp_path / "hand.npz"
+    save_npz(params, path)
+    back = load_npz(path)
+    np.testing.assert_array_equal(back.v_template, params.v_template)
+    np.testing.assert_array_equal(back.faces, params.faces)
+    assert back.parents == params.parents
+    assert back.side == params.side
+
+
+def test_dumped_pickle_roundtrip(params, tmp_path):
+    """Interop with the reference's dumped format, incl. parents[0]=None."""
+    path = tmp_path / "dump_mano_right.pkl"
+    save_dumped_pickle(params, path)
+
+    import pickle
+    with open(path, "rb") as f:
+        raw = pickle.load(f)
+    assert raw["parents"][0] is None  # reference sentinel preserved
+    assert set(raw) == {
+        "pose_pca_basis", "pose_pca_mean", "J_regressor", "skinning_weights",
+        "mesh_pose_basis", "mesh_shape_basis", "mesh_template", "faces",
+        "parents",
+    }
+
+    back = load_dumped_pickle(path)
+    np.testing.assert_array_equal(back.v_template, params.v_template)
+    assert back.parents == params.parents
+    assert back.side == C.RIGHT  # inferred from filename
+
+
+def test_load_model_sniffs_format(params, tmp_path):
+    npz = tmp_path / "hand.npz"
+    pkl = tmp_path / "dump_mano_left.pkl"
+    save_npz(params, npz)
+    save_dumped_pickle(params, pkl)
+    assert isinstance(load_model(npz), ManoParams)
+    assert load_model(pkl).side == C.LEFT
+
+
+def test_pytree_registration(params):
+    """ManoParams must be a PyTree with static parents/side."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    assert len(leaves) == 8
+    rebuilt = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert rebuilt.parents == params.parents
+    assert rebuilt.side == params.side
+
+
+def test_astype(params):
+    p32 = params.astype(np.float32)
+    assert p32.v_template.dtype == np.float32
+    assert p32.faces.dtype == np.int32  # ints untouched
