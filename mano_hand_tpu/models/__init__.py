@@ -1,0 +1,23 @@
+from mano_hand_tpu.models.core import (
+    ManoOutput,
+    decode_pca,
+    forward,
+    forward_batched,
+    forward_chunked,
+    forward_pca,
+    jit_forward,
+    jit_forward_batched,
+)
+from mano_hand_tpu.models import oracle
+
+__all__ = [
+    "ManoOutput",
+    "decode_pca",
+    "forward",
+    "forward_batched",
+    "forward_chunked",
+    "forward_pca",
+    "jit_forward",
+    "jit_forward_batched",
+    "oracle",
+]

@@ -1,0 +1,169 @@
+"""The pure JAX MANO forward core.
+
+One pure function over a frozen ``ManoParams`` PyTree — jittable, vmappable,
+and differentiable end-to-end (SURVEY.md §7 design stance). The math is the
+reference pipeline (/root/reference/mano_np.py:79-115) re-composed from the
+TPU-first ops in ``mano_hand_tpu.ops``:
+
+    shape_blend -> regress_joints -> rotation_matrix -> pose_blend
+    -> forward_kinematics (level-parallel) -> skin (fused LBS)
+
+Batching is by ``jax.vmap`` over the pose/shape arguments (params are closed
+over and replicated); huge batches go through ``forward_chunked`` to bound
+the [B, V, 3, 3] blend-rotation intermediate.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from mano_hand_tpu.assets.schema import ManoParams
+from mano_hand_tpu import ops
+from mano_hand_tpu.ops.common import DEFAULT_PRECISION
+
+
+class ManoOutput(NamedTuple):
+    """Forward-pass outputs; mirrors the reference's exposed state
+    (verts/J/R/rest_verts at /root/reference/mano_np.py:41-44) plus posed
+    joint locations."""
+
+    verts: jnp.ndarray         # [..., V, 3] skinned mesh
+    joints: jnp.ndarray        # [..., J, 3] rest-pose joints
+    rest_verts: jnp.ndarray    # [..., V, 3] blendshaped mesh pre-skinning
+    rot_mats: jnp.ndarray      # [..., J, 3, 3] per-joint rotations
+    posed_joints: jnp.ndarray  # [..., J, 3] world joints after FK
+
+
+def decode_pca(
+    params: ManoParams,
+    pca_coeffs: jnp.ndarray,
+    global_rot: Optional[jnp.ndarray] = None,
+    precision=DEFAULT_PRECISION,
+) -> jnp.ndarray:
+    """PCA pose coefficients [n<=45] -> full pose [16, 3].
+
+    Reference semantics (/root/reference/mano_np.py:66-72): truncated basis
+    rows, add the mean pose, prepend the global-rotation row. The number of
+    coefficients is a static property of the input shape.
+    """
+    n = pca_coeffs.shape[-1]
+    flat = (
+        jnp.einsum("...n,nf->...f", pca_coeffs, params.pca_basis[:n],
+                   precision=precision)
+        + params.pca_mean
+    )
+    fingers = flat.reshape(*pca_coeffs.shape[:-1], 15, 3)
+    root_shape = (*pca_coeffs.shape[:-1], 1, 3)
+    if global_rot is None:
+        root = jnp.zeros(root_shape, dtype=fingers.dtype)
+    else:
+        root = jnp.asarray(global_rot, dtype=fingers.dtype)
+        if root.ndim <= 1:
+            # A single [3] rotation broadcasts across any coefficient batch.
+            root = jnp.broadcast_to(root.reshape(3), root_shape)
+        else:
+            root = root.reshape(root_shape)
+    return jnp.concatenate([root, fingers], axis=-2)
+
+
+def forward(
+    params: ManoParams,
+    pose: Optional[jnp.ndarray] = None,   # [J, 3] axis-angle, row 0 global
+    shape: Optional[jnp.ndarray] = None,  # [S]
+    precision=DEFAULT_PRECISION,
+) -> ManoOutput:
+    """Single-hand forward pass. Batch with jax.vmap over (pose, shape)."""
+    n_joints = params.j_regressor.shape[0]
+    dtype = params.v_template.dtype
+    if pose is None:
+        pose = jnp.zeros((n_joints, 3), dtype=dtype)
+    if shape is None:
+        shape = jnp.zeros((params.shape_basis.shape[-1],), dtype=dtype)
+    pose = pose.reshape(n_joints, 3).astype(dtype)
+    shape = shape.astype(dtype)
+
+    v_shaped = ops.shape_blend(
+        params.v_template, params.shape_basis, shape, precision
+    )
+    joints = ops.regress_joints(params.j_regressor, v_shaped, precision)
+    rot_mats = ops.rotation_matrix(pose)
+    v_posed = ops.pose_blend(v_shaped, params.pose_basis, rot_mats, precision)
+    world_rot, world_t = ops.forward_kinematics(
+        params.parents, rot_mats, joints, precision
+    )
+    skin_rot, skin_t = ops.skinning_transforms(
+        world_rot, world_t, joints, precision
+    )
+    verts = ops.skin(params.lbs_weights, skin_rot, skin_t, v_posed, precision)
+    return ManoOutput(
+        verts=verts,
+        joints=joints,
+        rest_verts=v_posed,
+        rot_mats=rot_mats,
+        posed_joints=world_t,
+    )
+
+
+def forward_pca(
+    params: ManoParams,
+    pca_coeffs: jnp.ndarray,
+    global_rot: Optional[jnp.ndarray] = None,
+    shape: Optional[jnp.ndarray] = None,
+    precision=DEFAULT_PRECISION,
+) -> ManoOutput:
+    """Forward pass from PCA pose coefficients (reference's default input)."""
+    pose = decode_pca(params, pca_coeffs, global_rot, precision)
+    return forward(params, pose, shape, precision)
+
+
+def forward_batched(
+    params: ManoParams,
+    pose: jnp.ndarray,   # [B, J, 3] or [B, J*3]
+    shape: jnp.ndarray,  # [B, S]
+    precision=DEFAULT_PRECISION,
+) -> ManoOutput:
+    """vmap over the batch axis; params replicated (closed over)."""
+    return jax.vmap(
+        lambda p, s: forward(params, p, s, precision)
+    )(pose, shape)
+
+
+def forward_chunked(
+    params: ManoParams,
+    pose: jnp.ndarray,
+    shape: jnp.ndarray,
+    chunk_size: int = 8192,
+    precision=DEFAULT_PRECISION,
+) -> jnp.ndarray:
+    """Memory-bounded huge-batch vertices via lax.map over chunks.
+
+    Keeps the per-chunk [chunk, V, 3, 3] LBS intermediate under ~2 GB while
+    the MXU stays saturated; returns verts only ([B, V, 3]).
+    B must be divisible by chunk_size (pad at the call site if not).
+    """
+    b = pose.shape[0]
+    if b % chunk_size:
+        raise ValueError(f"batch {b} not divisible by chunk_size {chunk_size}")
+    pose_c = pose.reshape(b // chunk_size, chunk_size, *pose.shape[1:])
+    shape_c = shape.reshape(b // chunk_size, chunk_size, *shape.shape[1:])
+    verts = jax.lax.map(
+        lambda ps: forward_batched(params, ps[0], ps[1], precision).verts,
+        (pose_c, shape_c),
+    )
+    return verts.reshape(b, *verts.shape[2:])
+
+
+@functools.partial(jax.jit, static_argnames=("precision",))
+def jit_forward(params, pose, shape, precision=DEFAULT_PRECISION):
+    """Convenience jitted single-hand forward."""
+    return forward(params, pose, shape, precision)
+
+
+@functools.partial(jax.jit, static_argnames=("precision",))
+def jit_forward_batched(params, pose, shape, precision=DEFAULT_PRECISION):
+    """Convenience jitted batched forward."""
+    return forward_batched(params, pose, shape, precision)

@@ -1,0 +1,200 @@
+"""Stateful MANOModel wrapper: the reference's ergonomics over the pure core.
+
+Preserves the reference API and its quirks (/root/reference/mano_np.py:48-77):
+
+  * ``set_params(pose_abs | pose_pca, shape, global_rot)`` mutates state and
+    returns a copy of the vertices;
+  * ``global_rot`` is honored **only** in the PCA branch (mano_np.py:70-72),
+    and persists across calls (``self.rot`` is stateful);
+  * a freshly constructed model already holds the rest-pose mesh
+    (``update()`` runs in ``__init__``, mano_np.py:46);
+  * exposed attributes: ``verts``, ``rest_verts``, ``J``, ``R``, ``faces``.
+
+The backend flag (``np`` | ``jax``) selects the float64 oracle or the jitted
+TPU core per call — the contract named in BASELINE.json's north star. The
+mutable state lives out here; the jitted core stays pure.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from mano_hand_tpu.assets.loader import load_model
+from mano_hand_tpu.assets.schema import ManoParams
+from mano_hand_tpu.io.obj import export_obj_pair
+from mano_hand_tpu.models import core, oracle
+
+BACKENDS = ("np", "jax")
+
+
+class MANOModel:
+    """Drop-in replacement for the reference's MANOModel class."""
+
+    def __init__(
+        self,
+        model: Union[str, Path, ManoParams],
+        backend: str = "jax",
+        dtype=jnp.float32,
+    ):
+        if isinstance(model, (str, Path)):
+            model = load_model(model)
+        self._params_np = model  # float64 master copy (oracle path)
+        self._dtype = np.dtype(dtype)
+        self._params_jax_cache = None  # built lazily: the np backend must
+        # work without touching any JAX device (e.g. accelerator offline)
+        self.backend = self._check_backend(backend)
+
+        self.n_joints = model.n_joints
+        self.n_shape_params = model.n_shape
+        self.faces = np.asarray(model.faces)
+        self.side = model.side
+
+        # Reference state layout (mano_np.py:38-44).
+        self.pose = np.zeros((self.n_joints, 3))
+        self.shape = np.zeros(self.n_shape_params)
+        self.rot = np.zeros((1, 3))
+        self.verts = None
+        self.rest_verts = None
+        self.J = None
+        self.R = None
+
+        self.update()
+
+    @staticmethod
+    def _check_backend(backend: str) -> str:
+        if backend not in BACKENDS:
+            raise ValueError(f"backend must be one of {BACKENDS}, got {backend!r}")
+        return backend
+
+    @property
+    def params(self) -> ManoParams:
+        """The float64 parameter PyTree (asset master copy)."""
+        return self._params_np
+
+    @property
+    def _params_jax(self) -> ManoParams:
+        if self._params_jax_cache is None:
+            self._params_jax_cache = (
+                self._params_np.astype(self._dtype).device_put()
+            )
+        return self._params_jax_cache
+
+    # ------------------------------------------------------------- reference API
+    def set_params(
+        self,
+        pose_abs=None,
+        pose_pca=None,
+        shape=None,
+        global_rot=None,
+    ) -> np.ndarray:
+        """Reference semantics (mano_np.py:48-77), including the quirk that
+        global_rot only takes effect through the PCA branch and persists."""
+        if pose_abs is not None:
+            self.pose = np.asarray(pose_abs, dtype=np.float64)
+        if pose_pca is not None:
+            if global_rot is not None:
+                self.rot = np.asarray(global_rot, dtype=np.float64).reshape(1, 3)
+            fingers = oracle.decode_pca_pose(self._params_np, pose_pca)[1:]
+            self.pose = np.concatenate([self.rot, fingers], axis=0)
+        if shape is not None:
+            self.shape = np.asarray(shape, dtype=np.float64)
+        self.update()
+        return self.verts.copy()
+
+    def update(self) -> None:
+        """Recompute verts/J/R/rest_verts from current state via the
+        selected backend."""
+        out = self._evaluate(self.pose, self.shape, self.backend)
+        self.verts = np.asarray(out.verts, dtype=np.float64)
+        self.rest_verts = np.asarray(out.rest_verts, dtype=np.float64)
+        self.J = np.asarray(out.joints, dtype=np.float64)
+        self.R = np.asarray(out.rot_mats, dtype=np.float64)
+        self.posed_J = np.asarray(out.posed_joints, dtype=np.float64)
+
+    def export_obj(self, path: Union[str, Path]) -> None:
+        """Write posed + rest-pose OBJ pair (mano_np.py:181-201 parity)."""
+        export_obj_pair(self.verts, self.rest_verts, self.faces, path)
+
+    # ----------------------------------------------------------- functional API
+    def __call__(
+        self,
+        pose: Optional[np.ndarray] = None,
+        shape: Optional[np.ndarray] = None,
+        pose_pca: Optional[np.ndarray] = None,
+        global_rot: Optional[np.ndarray] = None,
+        backend: Optional[str] = None,
+    ) -> np.ndarray:
+        """Stateless evaluation: verts for the given pose/shape.
+
+        The ``backend`` flag selects ``np`` (float64 oracle) or ``jax``
+        (jitted TPU core) per call, per BASELINE.json's north star. Accepts
+        either absolute pose [.., 16, 3] or PCA coefficients [.., n<=45];
+        leading batch dimensions are dispatched to the vmapped core (np
+        backend is unbatched, like the reference).
+        """
+        backend = self._check_backend(backend or self.backend)
+        if (pose is None) == (pose_pca is None):
+            if pose is None:
+                pose = np.zeros((self.n_joints, 3))
+            else:
+                raise ValueError("pass exactly one of pose / pose_pca")
+        if global_rot is not None and pose_pca is None:
+            # Absolute pose already carries the root rotation in row 0;
+            # silently ignoring global_rot here would return an un-rotated
+            # mesh (the reference's set_params quirk is preserved only in
+            # set_params, not in this functional API).
+            raise ValueError(
+                "global_rot is only meaningful with pose_pca; with an "
+                "absolute pose, put the root rotation in pose[..., 0, :]"
+            )
+        if pose_pca is not None and backend == "np" and np.ndim(pose_pca) > 1:
+            raise ValueError(
+                "np backend is unbatched (like the reference); "
+                "use backend='jax' for batched evaluation"
+            )
+        if pose_pca is not None:
+            if backend == "np":
+                pose = oracle.decode_pca_pose(
+                    self._params_np, pose_pca, global_rot
+                )
+            else:
+                pose = core.decode_pca(
+                    self._params_jax,
+                    jnp.asarray(pose_pca, self._params_jax.v_template.dtype),
+                    None if global_rot is None
+                    else jnp.asarray(global_rot,
+                                     self._params_jax.v_template.dtype),
+                )
+        pose = np.asarray(pose) if backend == "np" else pose
+        if shape is None:
+            shape = np.zeros(
+                (*np.shape(pose)[:-2], self.n_shape_params)
+            )
+        return np.asarray(self._evaluate(pose, shape, backend).verts)
+
+    def _evaluate(self, pose, shape, backend: str):
+        if backend == "np":
+            if np.ndim(pose) > 2:
+                raise ValueError(
+                    "np backend is unbatched (like the reference); "
+                    "use backend='jax' for batched evaluation"
+                )
+            return oracle.forward(self._params_np, pose=pose, shape=shape)
+        dtype = self._params_jax.v_template.dtype
+        pose_j = jnp.asarray(pose, dtype)
+        shape_j = jnp.asarray(shape, dtype)
+        if pose_j.ndim > 2:
+            lead = pose_j.shape[:-2]
+            out = core.jit_forward_batched(
+                self._params_jax,
+                pose_j.reshape(-1, self.n_joints, 3),
+                shape_j.reshape(-1, self.n_shape_params),
+            )
+            return core.ManoOutput(
+                *(x.reshape(*lead, *x.shape[1:]) for x in out)
+            )
+        return core.jit_forward(self._params_jax, pose_j, shape_j)

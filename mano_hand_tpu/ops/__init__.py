@@ -1,0 +1,16 @@
+from mano_hand_tpu.ops.rodrigues import rotation_matrix, skew
+from mano_hand_tpu.ops.fk import forward_kinematics, skinning_transforms, tree_levels
+from mano_hand_tpu.ops.blend import pose_blend, regress_joints, shape_blend
+from mano_hand_tpu.ops.lbs import skin
+
+__all__ = [
+    "rotation_matrix",
+    "skew",
+    "forward_kinematics",
+    "skinning_transforms",
+    "tree_levels",
+    "shape_blend",
+    "pose_blend",
+    "regress_joints",
+    "skin",
+]

@@ -1,0 +1,49 @@
+"""Blendshape application and joint regression.
+
+These are the MXU-bound contractions of the forward pass
+(/root/reference/mano_np.py:81-91). All einsums take an explicit
+``precision`` so callers can force float32 accumulation on TPU (bf16-default
+matmuls would blow the <1e-4 vertex-error budget; SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from mano_hand_tpu.ops.common import DEFAULT_PRECISION
+
+
+def shape_blend(
+    v_template: jnp.ndarray,   # [V, 3]
+    shape_basis: jnp.ndarray,  # [V, 3, S]
+    beta: jnp.ndarray,         # [S]
+    precision=DEFAULT_PRECISION,
+) -> jnp.ndarray:
+    """Template + shape blendshape offsets (mano_np.py:81)."""
+    return v_template + jnp.einsum(
+        "vcs,s->vc", shape_basis, beta, precision=precision
+    )
+
+
+def pose_blend(
+    v_shaped: jnp.ndarray,    # [V, 3]
+    pose_basis: jnp.ndarray,  # [V, 3, P]
+    rot_mats: jnp.ndarray,    # [J, 3, 3] incl. root
+    precision=DEFAULT_PRECISION,
+) -> jnp.ndarray:
+    """Pose-corrective offsets driven by (R - I) of the articulated joints;
+    the root/global rotation is excluded (mano_np.py:87-91)."""
+    eye = jnp.eye(3, dtype=rot_mats.dtype)
+    pose_feat = (rot_mats[1:] - eye).reshape(-1)
+    return v_shaped + jnp.einsum(
+        "vcp,p->vc", pose_basis, pose_feat, precision=precision
+    )
+
+
+def regress_joints(
+    j_regressor: jnp.ndarray,  # [J, V]
+    v_shaped: jnp.ndarray,     # [V, 3]
+    precision=DEFAULT_PRECISION,
+) -> jnp.ndarray:
+    """Joint locations as convex combinations of vertices (mano_np.py:83)."""
+    return jnp.einsum("jv,vc->jc", j_regressor, v_shaped, precision=precision)

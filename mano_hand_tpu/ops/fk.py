@@ -1,0 +1,91 @@
+"""Forward kinematics over the static MANO kinematic tree.
+
+The reference walks the 15 articulated joints sequentially with 4x4
+homogeneous matrices (/root/reference/mano_np.py:96-110). On TPU we instead:
+
+  * carry (rotation, translation) pairs — no 4x4 padding, fewer FLOPs,
+    no wasted lanes on constant rows;
+  * compose **level-parallel**: the MANO tree has depth 4 (wrist -> MCP ->
+    PIP -> DIP across 5 fingers), so all joints at a depth compose against
+    their parents in one batched [5,3,3] matmul — 3 batched steps instead
+    of 15 sequential ones, shrinking the XLA dependency chain;
+  * levels and gather indices are static Python, derived from the
+    ``parents`` tuple at trace time, so jit sees fixed shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from mano_hand_tpu.ops.common import DEFAULT_PRECISION
+
+
+@functools.lru_cache(maxsize=None)
+def tree_levels(parents: Tuple[int, ...]) -> Tuple[Tuple[int, ...], ...]:
+    """Group joint indices by depth (root excluded). Static, cached."""
+    depth = [0] * len(parents)
+    for i, p in enumerate(parents):
+        if p >= 0:
+            depth[i] = depth[p] + 1
+    levels = []
+    for d in range(1, max(depth) + 1):
+        levels.append(tuple(i for i, dd in enumerate(depth) if dd == d))
+    return tuple(levels)
+
+
+def forward_kinematics(
+    parents: Tuple[int, ...],
+    rot_local: jnp.ndarray,   # [J, 3, 3] per-joint local rotations
+    joints: jnp.ndarray,      # [J, 3] rest-pose joint positions
+    precision=DEFAULT_PRECISION,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Compose the kinematic chain; returns (world_rot [J,3,3], world_t [J,3]).
+
+    world_t are the posed joint positions; world_rot the accumulated
+    orientations — together the reference's G matrices
+    (/root/reference/mano_np.py:96-104) without the homogeneous row.
+
+    Every contraction takes an explicit ``precision``: default-precision f32
+    matmuls cost ~1e-2 absolute error (bf16 passes), far over the 1e-4
+    vertex budget.
+    """
+    parents_arr = np.asarray(parents)
+    world_rot = jnp.zeros_like(rot_local).at[0].set(rot_local[0])
+    world_t = jnp.zeros_like(joints).at[0].set(joints[0])
+    for level in tree_levels(parents):
+        idx = np.asarray(level)
+        par = parents_arr[idx]
+        parent_rot = world_rot[par]                       # [k, 3, 3]
+        local_t = joints[idx] - joints[par]               # [k, 3]
+        world_rot = world_rot.at[idx].set(
+            jnp.einsum("kab,kbc->kac", parent_rot, rot_local[idx],
+                       precision=precision)
+        )
+        world_t = world_t.at[idx].set(
+            jnp.einsum("kab,kb->ka", parent_rot, local_t,
+                       precision=precision)
+            + world_t[par]
+        )
+    return world_rot, world_t
+
+
+def skinning_transforms(
+    world_rot: jnp.ndarray,  # [J, 3, 3]
+    world_t: jnp.ndarray,    # [J, 3]
+    joints: jnp.ndarray,     # [J, 3] rest-pose joints
+    precision=DEFAULT_PRECISION,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Inverse-bind: map rest-pose space to posed space per joint.
+
+    Equivalent to the reference's G - pack(G @ [J;0]) step
+    (/root/reference/mano_np.py:106-110): rotation unchanged, translation
+    becomes world_t - world_rot @ J_rest.
+    """
+    skin_t = world_t - jnp.einsum(
+        "jab,jb->ja", world_rot, joints, precision=precision
+    )
+    return world_rot, skin_t

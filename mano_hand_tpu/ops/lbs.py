@@ -1,0 +1,37 @@
+"""Fused linear blend skinning.
+
+The reference materializes a per-vertex [778, 4, 4] transform tensor
+(/root/reference/mano_np.py:112-115); batched naively that is
+[B, 778, 4, 4] — ~4.4 GB at B=65536 — and is pure HBM traffic. We blend
+(rotation, translation) pairs instead and contract straight to vertices:
+
+    verts[v] = (sum_j w[v,j] R_j) @ v_posed[v] + sum_j w[v,j] t_j
+
+which XLA fuses into two MXU contractions ([V,J]x[J,9] and [V,J]x[J,3]) plus
+an elementwise combine, never touching 4x4 homogeneous padding.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from mano_hand_tpu.ops.common import DEFAULT_PRECISION
+
+
+def skin(
+    weights: jnp.ndarray,    # [V, J] LBS weights
+    world_rot: jnp.ndarray,  # [J, 3, 3] skinning rotations
+    skin_t: jnp.ndarray,     # [J, 3] skinning translations (inverse-bound)
+    v_posed: jnp.ndarray,    # [V, 3] blendshaped rest-pose verts
+    precision=DEFAULT_PRECISION,
+) -> jnp.ndarray:
+    """Pose the mesh: [V, 3] skinned vertices."""
+    rot_flat = world_rot.reshape(world_rot.shape[0], 9)        # [J, 9]
+    blend_rot = jnp.einsum(
+        "vj,jr->vr", weights, rot_flat, precision=precision
+    ).reshape(-1, 3, 3)                                        # [V, 3, 3]
+    blend_t = jnp.einsum("vj,jc->vc", weights, skin_t, precision=precision)
+    return (
+        jnp.einsum("vab,vb->va", blend_rot, v_posed, precision=precision)
+        + blend_t
+    )

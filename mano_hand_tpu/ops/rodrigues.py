@@ -1,0 +1,56 @@
+"""Autodiff-safe Rodrigues rotations (axis-angle -> SO(3)).
+
+The reference clamps theta to float64 eps before dividing
+(/root/reference/mano_np.py:130-133), which is value-safe but leaves
+``d‖r‖/dr`` NaN at r = 0 under autodiff — fatal for pose fitting that
+initializes at the zero pose. We instead use the unnormalized form
+
+    R = I + a(theta) * K + b(theta) * K @ K,   K = skew(r)
+
+with a = sin(theta)/theta and b = (1 - cos(theta))/theta^2 computed through
+Taylor guards, so R and all its derivatives are finite and smooth at
+theta = 0. For theta > sqrt(eps) this is algebraically identical to the
+reference formula cos*I + (1-cos)*rr^T + sin*K(r_hat).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Below this theta^2, the Taylor series is more accurate than the closed
+# form in f32 *and* keeps gradients finite.
+_SMALL = 1e-8
+
+
+def skew(r: jnp.ndarray) -> jnp.ndarray:
+    """[..., 3] -> [..., 3, 3] cross-product (skew-symmetric) matrices."""
+    x, y, z = r[..., 0], r[..., 1], r[..., 2]
+    zero = jnp.zeros_like(x)
+    return jnp.stack(
+        [zero, -z, y, z, zero, -x, -y, x, zero], axis=-1
+    ).reshape(*r.shape[:-1], 3, 3)
+
+
+def rotation_matrix(axis_angle: jnp.ndarray) -> jnp.ndarray:
+    """Axis-angle [..., 3] -> rotation matrices [..., 3, 3].
+
+    Fully differentiable everywhere, including the zero vector.
+    """
+    theta2 = jnp.sum(axis_angle * axis_angle, axis=-1)[..., None, None]
+    small = theta2 < _SMALL
+    # Guard the sqrt so its gradient never sees 0.
+    theta = jnp.sqrt(jnp.where(small, 1.0, theta2))
+    a = jnp.where(small, 1.0 - theta2 / 6.0 + theta2 * theta2 / 120.0,
+                  jnp.sin(theta) / theta)
+    # Denominator uses the guarded theta so the unselected branch stays
+    # finite — the double-where rule: NaN in a dead branch still poisons
+    # gradients through jnp.where.
+    b = jnp.where(small, 0.5 - theta2 / 24.0 + theta2 * theta2 / 720.0,
+                  (1.0 - jnp.cos(theta)) / (theta * theta))
+    K = skew(axis_angle)
+    # K @ K == r r^T - |r|^2 I exactly; the outer-product form stays on the
+    # VPU in full precision (a 3x3 matmul would ride the MXU's bf16 default
+    # on TPU and cost ~1e-2 absolute error in the rotation entries).
+    outer = axis_angle[..., :, None] * axis_angle[..., None, :]
+    eye = jnp.broadcast_to(jnp.eye(3, dtype=axis_angle.dtype), K.shape)
+    return (1.0 - b * theta2) * eye + a * K + b * outer

@@ -8,7 +8,9 @@ process so sharding/pjit paths are exercised without TPU hardware
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the shell env points at a TPU (JAX_PLATFORMS=axon):
+# the suite exercises numerics + sharding on a deterministic virtual mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -19,6 +21,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
+
+import jax  # noqa: E402
+
+# A site hook on this image (an accelerator-tunnel plugin) re-sets
+# jax_platforms to "<plugin>,cpu" at interpreter startup, overriding the env
+# var; when the tunnel is unavailable any backend init then hangs. Re-assert
+# cpu through the config API, which wins over the startup hook.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

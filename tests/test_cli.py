@@ -1,0 +1,56 @@
+"""CLI subcommands, driven through main() (the module surface)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from mano_hand_tpu import cli
+from mano_hand_tpu.assets import load_model, save_npz, synthetic_params
+
+
+def test_demo_writes_obj_pair(tmp_path, capsys):
+    out = tmp_path / "hand.obj"
+    assert cli.main(["demo", "--backend", "np", "--out", str(out)]) == 0
+    assert out.exists()
+    assert (tmp_path / "hand_restpose.obj").exists()
+    assert "wrote" in capsys.readouterr().out
+
+
+def test_demo_backends_agree(tmp_path):
+    a = tmp_path / "a.obj"
+    b = tmp_path / "b.obj"
+    cli.main(["demo", "--backend", "np", "--out", str(a)])
+    cli.main(["demo", "--backend", "jax", "--out", str(b)])
+    va = np.array([l.split()[1:] for l in a.read_text().splitlines()
+                   if l.startswith("v ")], dtype=float)
+    vb = np.array([l.split()[1:] for l in b.read_text().splitlines()
+                   if l.startswith("v ")], dtype=float)
+    assert np.abs(va - vb).max() < 1e-4
+
+
+def test_convert_roundtrip(tmp_path, params):
+    src = tmp_path / "hand.npz"
+    save_npz(params, src)
+    dst = tmp_path / "hand.pkl"
+    assert cli.main(["convert", str(src), str(dst)]) == 0
+    back = load_model(dst)
+    np.testing.assert_array_equal(back.v_template, params.v_template)
+    bad = cli.main(["convert", str(src), str(tmp_path / "hand.xyz")])
+    assert bad == 2
+
+
+def test_animate(tmp_path):
+    poses = np.random.default_rng(0).normal(scale=0.3, size=(4, 15, 3))
+    npy = tmp_path / "poses.npy"
+    np.save(npy, poses)
+    outdir = tmp_path / "frames"
+    assert cli.main(["animate", str(npy), "--out", str(outdir)]) == 0
+    assert len(list(outdir.glob("frame_*.obj"))) == 4
+
+
+def test_info(capsys):
+    assert cli.main(["info"]) == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["n_verts"] == 778
+    assert info["parents"][0] == -1

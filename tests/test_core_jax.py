@@ -1,0 +1,150 @@
+"""JAX core vs the float64 NumPy oracle: the central parity suite.
+
+Error budget: BASELINE.json demands max per-vertex error < 1e-4 vs the
+oracle; the JAX path runs in float32 with Precision.HIGHEST.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mano_hand_tpu.models import core, oracle
+from mano_hand_tpu.ops import rodrigues as rod
+
+TOL = 1e-4
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+def rand_inputs(seed, batch=None):
+    rng = np.random.default_rng(seed)
+    shape_dims = (batch,) if batch else ()
+    pose = rng.normal(scale=0.6, size=(*shape_dims, 16, 3))
+    beta = rng.normal(size=(*shape_dims, 10))
+    return pose, beta
+
+
+# ---------------------------------------------------------------- rodrigues
+def test_rodrigues_matches_oracle():
+    rng = np.random.default_rng(0)
+    aa = rng.normal(size=(64, 3))
+    got = rod.rotation_matrix(jnp.asarray(aa, dtype=jnp.float32))
+    want = oracle.rodrigues(aa)
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-6)
+
+
+def test_rodrigues_zero_and_tiny():
+    for aa in [np.zeros(3), np.full(3, 1e-10), np.full(3, 1e-5)]:
+        got = np.asarray(rod.rotation_matrix(jnp.asarray(aa, jnp.float32)))
+        np.testing.assert_allclose(got, oracle.rodrigues(aa), atol=1e-6)
+
+
+def test_rodrigues_grad_finite_at_zero():
+    """The reference's eps-clamp leaves NaN grads at r=0; ours must not."""
+    g = jax.grad(lambda r: rod.rotation_matrix(r).sum())(jnp.zeros(3))
+    assert np.isfinite(np.asarray(g)).all()
+    # And near-zero, grads should match finite differences of the oracle.
+    r0 = np.full(3, 1e-4)
+    g = jax.jacobian(rod.rotation_matrix)(jnp.asarray(r0, jnp.float32))
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_rodrigues_grad_matches_fd():
+    rng = np.random.default_rng(3)
+    r0 = rng.normal(size=3)
+    jac = np.asarray(jax.jacobian(rod.rotation_matrix)(jnp.asarray(r0, jnp.float32)))
+    eps = 1e-5
+    for k in range(3):
+        d = np.zeros(3)
+        d[k] = eps
+        fd = (oracle.rodrigues(r0 + d) - oracle.rodrigues(r0 - d)) / (2 * eps)
+        np.testing.assert_allclose(jac[..., k], fd, atol=1e-3)
+
+
+# ------------------------------------------------------------------ forward
+def test_zero_pose_parity(params, params32):
+    out = core.forward(params32)
+    want = oracle.forward(params)
+    np.testing.assert_allclose(np.asarray(out.verts), want.verts, atol=TOL)
+    np.testing.assert_allclose(np.asarray(out.joints), want.joints, atol=TOL)
+
+
+def test_random_pose_parity(params, params32):
+    for seed in range(5):
+        pose, beta = rand_inputs(seed)
+        out = core.forward(params32, jnp.asarray(pose), jnp.asarray(beta))
+        want = oracle.forward(params, pose=pose, shape=beta)
+        np.testing.assert_allclose(np.asarray(out.verts), want.verts, atol=TOL)
+        np.testing.assert_allclose(
+            np.asarray(out.rest_verts), want.rest_verts, atol=TOL
+        )
+        np.testing.assert_allclose(
+            np.asarray(out.posed_joints), want.posed_joints, atol=TOL
+        )
+
+
+def test_pca_branch_parity(params, params32):
+    rng = np.random.default_rng(7)
+    coeffs = rng.normal(size=9)
+    grot = np.array([1.0, 0.0, 0.0])
+    beta = rng.normal(size=10)
+    out = core.forward_pca(
+        params32, jnp.asarray(coeffs, jnp.float32),
+        jnp.asarray(grot, jnp.float32), jnp.asarray(beta, jnp.float32)
+    )
+    pose = oracle.decode_pca_pose(params, coeffs, global_rot=grot)
+    want = oracle.forward(params, pose=pose, shape=beta)
+    np.testing.assert_allclose(np.asarray(out.verts), want.verts, atol=TOL)
+
+
+def test_jit_and_vmap_parity(params, params32):
+    pose, beta = rand_inputs(11, batch=8)
+    out = core.jit_forward_batched(
+        params32, jnp.asarray(pose, jnp.float32), jnp.asarray(beta, jnp.float32)
+    )
+    assert out.verts.shape == (8, 778, 3)
+    for i in range(8):
+        want = oracle.forward(params, pose=pose[i], shape=beta[i])
+        np.testing.assert_allclose(np.asarray(out.verts[i]), want.verts, atol=TOL)
+
+
+def test_chunked_matches_batched(params32):
+    pose, beta = rand_inputs(13, batch=32)
+    pose = jnp.asarray(pose, jnp.float32)
+    beta = jnp.asarray(beta, jnp.float32)
+    full = core.forward_batched(params32, pose, beta).verts
+    chunked = core.forward_chunked(params32, pose, beta, chunk_size=8)
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(full), atol=1e-6)
+    with pytest.raises(ValueError, match="divisible"):
+        core.forward_chunked(params32, pose, beta, chunk_size=5)
+
+
+def test_forward_grad_finite_at_zero_pose(params32):
+    """Pose fitting initializes at theta=0: the whole graph must have
+    finite gradients there (SURVEY.md §7 'hard parts')."""
+    def loss(pose, beta):
+        return (core.forward(params32, pose, beta).verts ** 2).sum()
+
+    g_pose, g_beta = jax.grad(loss, argnums=(0, 1))(
+        jnp.zeros((16, 3)), jnp.zeros(10)
+    )
+    assert np.isfinite(np.asarray(g_pose)).all()
+    assert np.isfinite(np.asarray(g_beta)).all()
+
+
+def test_fk_levels_cover_tree(params):
+    from mano_hand_tpu.ops.fk import tree_levels
+    levels = tree_levels(params.parents)
+    flat = [i for lvl in levels for i in lvl]
+    assert sorted(flat) == list(range(1, 16))
+    assert len(levels) == 3  # MCP, PIP, DIP rings of 5 fingers each
+    assert all(len(lvl) == 5 for lvl in levels)
+
+
+def test_dtype_follows_params(params32):
+    out = core.forward(params32)
+    assert out.verts.dtype == jnp.float32
