@@ -1,0 +1,181 @@
+"""Benchmark harness: MANO forward throughput on the attached accelerator.
+
+Prints exactly ONE JSON line on stdout:
+    {"metric": ..., "value": N, "unit": "evals/s", "vs_baseline": N, ...}
+Everything else goes to stderr.
+
+Baseline: the reference publishes no numbers (BASELINE.md); the target is
+the north-star >= 50,000 forward evals/sec on one v5e chip with max vertex
+error < 1e-4 vs the float64 NumPy oracle (/root/repo/BASELINE.json).
+
+Covers the BASELINE.json config suite:
+  1. single zero-pose eval (vs oracle)        — accuracy anchor
+  2. batch=1024 random pose+shape             — throughput
+  3. batch=65536, left+right interleaved      — throughput (chunked)
+  4. pose-fitting batch=256, 100 Adam steps   — fitting throughput
+  5. 120-frame x 2-hand temporal sequence     — latency
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_EVALS_PER_SEC = 50_000.0
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def timeit(fn, iters: int = 10, warmup: int = 2):
+    """Median wall time of fn() (which must block until ready)."""
+    for _ in range(warmup):
+        fn()
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--big-batch", type=int, default=65536)
+    ap.add_argument("--chunk", type=int, default=8192)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--fit-steps", type=int, default=100)
+    ap.add_argument("--skip-fit", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.assets import synthetic_pair
+    from mano_hand_tpu.fitting import fit
+    from mano_hand_tpu.models import core, oracle
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform}:{dev.device_kind}")
+
+    left64, right64 = synthetic_pair(seed=0)
+    right = right64.astype(np.float32).device_put()
+    left = left64.astype(np.float32).device_put()
+    rng = np.random.default_rng(0)
+
+    results = {}
+
+    # -- config 1: single zero-pose eval, accuracy vs oracle ----------------
+    out1 = core.jit_forward(
+        right, jnp.zeros((16, 3), jnp.float32), jnp.zeros(10, jnp.float32)
+    )
+    want = oracle.forward(right64)
+    err0 = float(np.abs(np.asarray(out1.verts) - want.verts).max())
+    results["config1_zero_pose_max_err"] = err0
+    log(f"config1 zero-pose max err vs oracle: {err0:.3e}")
+
+    # accuracy at random poses (8 samples)
+    poses = rng.normal(scale=0.6, size=(8, 16, 3)).astype(np.float32)
+    betas = rng.normal(size=(8, 10)).astype(np.float32)
+    outs = core.jit_forward_batched(right, jnp.asarray(poses), jnp.asarray(betas))
+    max_err = 0.0
+    for i in range(8):
+        w = oracle.forward(right64, pose=poses[i], shape=betas[i]).verts
+        max_err = max(max_err, float(np.abs(np.asarray(outs.verts[i]) - w).max()))
+    results["max_err_vs_numpy"] = max_err
+    log(f"random-pose max err vs oracle: {max_err:.3e}")
+
+    # -- config 2: batch=1024 ----------------------------------------------
+    b2 = 1024
+    pose2 = jnp.asarray(rng.normal(scale=0.6, size=(b2, 16, 3)), jnp.float32)
+    beta2 = jnp.asarray(rng.normal(size=(b2, 10)), jnp.float32)
+    fwd2 = jax.jit(lambda p, s: core.forward_batched(right, p, s).verts)
+    t2 = timeit(lambda: jax.block_until_ready(fwd2(pose2, beta2)), args.iters)
+    results["config2_b1024_evals_per_sec"] = b2 / t2
+    log(f"config2 batch=1024: {b2 / t2:,.0f} evals/s ({t2 * 1e3:.2f} ms)")
+
+    # -- config 3: batch=65536, left+right interleaved (chunked) ------------
+    b3 = args.big_batch - (args.big_batch % 2)
+    half = b3 // 2
+    chunk = args.chunk
+    while half % chunk:  # clamp to a divisor so odd CLI args can't crash
+        chunk -= 1
+    pose3 = jnp.asarray(rng.normal(scale=0.6, size=(b3, 16, 3)), jnp.float32)
+    beta3 = jnp.asarray(rng.normal(size=(b3, 10)), jnp.float32)
+
+    def interleaved(p, s):
+        # alternate hands by halves of each chunk: two param sets, one graph
+        vl = core.forward_chunked(left, p[:half], s[:half], chunk)
+        vr = core.forward_chunked(right, p[half:], s[half:], chunk)
+        return vl, vr
+
+    fwd3 = jax.jit(interleaved)
+    t3 = timeit(lambda: jax.block_until_ready(fwd3(pose3, beta3)), args.iters)
+    results["config3_b65536_evals_per_sec"] = b3 / t3
+    log(f"config3 batch={b3} L+R: {b3 / t3:,.0f} evals/s ({t3 * 1e3:.1f} ms)")
+
+    # -- config 4: pose fitting batch=256 -----------------------------------
+    if not args.skip_fit:
+        b4 = 256
+        pose4 = rng.normal(scale=0.3, size=(b4, 16, 3)).astype(np.float32)
+        beta4 = rng.normal(scale=0.5, size=(b4, 10)).astype(np.float32)
+        targets = core.jit_forward_batched(
+            right, jnp.asarray(pose4), jnp.asarray(beta4)
+        ).verts
+
+        def run_fit():
+            res = fit(right, targets, n_steps=args.fit_steps, lr=0.05)
+            jax.block_until_ready(res.pose)
+            return res
+
+        t4 = timeit(run_fit, iters=max(2, args.iters // 3), warmup=1)
+        fit_evals = b4 * args.fit_steps  # fwd+bwd per step
+        results["config4_fit_steps_per_sec"] = args.fit_steps / t4
+        results["config4_fit_evals_per_sec"] = fit_evals / t4
+        log(f"config4 fit b=256 x {args.fit_steps} steps: {t4 * 1e3:.1f} ms "
+            f"({fit_evals / t4:,.0f} fwd+bwd evals/s)")
+
+    # -- config 5: 120-frame two-hand temporal sequence ---------------------
+    t_frames, hands = 120, 2
+    pose5 = jnp.asarray(
+        rng.normal(scale=0.4, size=(t_frames * hands, 16, 3)), jnp.float32
+    )
+    beta5 = jnp.zeros((t_frames * hands, 10), jnp.float32)
+
+    def seq(p, s):
+        vl = core.forward_batched(left, p[:t_frames], s[:t_frames]).verts
+        vr = core.forward_batched(right, p[t_frames:], s[t_frames:]).verts
+        return vl, vr
+
+    fwd5 = jax.jit(seq)
+    t5 = timeit(lambda: jax.block_until_ready(fwd5(pose5, beta5)), args.iters)
+    results["config5_seq240_ms"] = t5 * 1e3
+    log(f"config5 120f x 2 hands: {t5 * 1e3:.2f} ms "
+        f"({t_frames * hands / t5:,.0f} evals/s)")
+
+    # -- headline ------------------------------------------------------------
+    headline = max(
+        results["config2_b1024_evals_per_sec"],
+        results["config3_b65536_evals_per_sec"],
+    )
+    line = {
+        "metric": "mano_forward_evals_per_sec",
+        "value": round(headline, 1),
+        "unit": "evals/s",
+        "vs_baseline": round(headline / BASELINE_EVALS_PER_SEC, 3),
+        "max_err_vs_numpy": max_err,
+        "device": f"{dev.platform}:{dev.device_kind}",
+        "detail": {k: (float(f"{v:.5g}") if isinstance(v, float) else v)
+                   for k, v in results.items()},
+    }
+    print(json.dumps(line), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

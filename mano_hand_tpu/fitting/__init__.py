@@ -1,0 +1,17 @@
+from mano_hand_tpu.fitting.objectives import (
+    joint_l2,
+    l2_prior,
+    max_vertex_error,
+    vertex_l2,
+)
+from mano_hand_tpu.fitting.solvers import FitResult, fit, fit_with_optimizer
+
+__all__ = [
+    "FitResult",
+    "fit",
+    "fit_with_optimizer",
+    "vertex_l2",
+    "joint_l2",
+    "l2_prior",
+    "max_vertex_error",
+]

@@ -1,0 +1,32 @@
+"""Fitting objectives and priors.
+
+The reference has no fitting capability at all; BASELINE.json's north star
+adds it ("the JAX path is fully differentiable so pose/shape can be
+recovered by gradient descent on TPU"). Objectives are pure functions of
+(predicted, target) plus optional parameter priors, composable into one
+scalar loss for optax.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def vertex_l2(pred_verts: jnp.ndarray, target_verts: jnp.ndarray) -> jnp.ndarray:
+    """Mean squared vertex distance (the data term)."""
+    return jnp.mean(jnp.sum((pred_verts - target_verts) ** 2, axis=-1))
+
+
+def joint_l2(pred_joints: jnp.ndarray, target_joints: jnp.ndarray) -> jnp.ndarray:
+    """Mean squared joint distance (sparser, better conditioned early)."""
+    return jnp.mean(jnp.sum((pred_joints - target_joints) ** 2, axis=-1))
+
+
+def max_vertex_error(pred_verts: jnp.ndarray, target_verts: jnp.ndarray) -> jnp.ndarray:
+    """Max per-vertex Euclidean error — the BASELINE.json accuracy metric."""
+    return jnp.max(jnp.linalg.norm(pred_verts - target_verts, axis=-1))
+
+
+def l2_prior(x: jnp.ndarray) -> jnp.ndarray:
+    """Quadratic prior toward zero (pose/shape regularizer)."""
+    return jnp.mean(x ** 2)

@@ -1,0 +1,156 @@
+"""Gradient-based pose/shape recovery (inverse MANO).
+
+TPU-first structure: one jitted ``lax.scan`` over optimizer steps, ``vmap``
+over a batch of independent fitting problems — B x n_steps forward+backward
+passes compile to a single XLA program with zero host round-trips. The
+optimizer is any optax GradientTransformation (Adam by default).
+
+Pose can be parameterized as full axis-angle ([16, 3], well-suited to
+tracking) or PCA coefficients + global rotation (the reference's native
+parameterization, better conditioned for sparse data).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from mano_hand_tpu.assets.schema import ManoParams
+from mano_hand_tpu.fitting import objectives
+from mano_hand_tpu.models import core
+
+
+class FitResult(NamedTuple):
+    pose: jnp.ndarray          # [..., 16, 3] recovered axis-angle pose
+    shape: jnp.ndarray         # [..., S] recovered shape coefficients
+    final_loss: jnp.ndarray    # [...] last-step data loss
+    loss_history: jnp.ndarray  # [..., n_steps] data-loss curve
+    pca: Optional[jnp.ndarray] = None  # [..., n_pca] when pose_space="pca"
+
+
+def _fit_single(
+    params: ManoParams,
+    target_verts: jnp.ndarray,  # [V, 3]
+    *,
+    n_steps: int,
+    optimizer: optax.GradientTransformation,
+    pose_space: str,
+    n_pca: int,
+    pose_prior_weight: float,
+    shape_prior_weight: float,
+) -> FitResult:
+    dtype = params.v_template.dtype
+    n_joints = params.j_regressor.shape[0]
+    n_shape = params.shape_basis.shape[-1]
+
+    if pose_space == "aa":
+        theta0 = {"pose": jnp.zeros((n_joints, 3), dtype)}
+    elif pose_space == "pca":
+        theta0 = {
+            "pca": jnp.zeros((n_pca,), dtype),
+            "global_rot": jnp.zeros((3,), dtype),
+        }
+    else:
+        raise ValueError(f"pose_space must be 'aa' or 'pca', got {pose_space!r}")
+    theta0["shape"] = jnp.zeros((n_shape,), dtype)
+
+    def decode(p):
+        if pose_space == "aa":
+            return p["pose"]
+        return core.decode_pca(params, p["pca"], p["global_rot"])
+
+    def loss_fn(p):
+        out = core.forward(params, decode(p), p["shape"])
+        data = objectives.vertex_l2(out.verts, target_verts)
+        reg = (
+            pose_prior_weight
+            * objectives.l2_prior(p["pca"] if pose_space == "pca" else p["pose"])
+            + shape_prior_weight * objectives.l2_prior(p["shape"])
+        )
+        return data + reg, data
+
+    opt_state0 = optimizer.init(theta0)
+
+    def step(carry, _):
+        p, opt_state = carry
+        (_, data), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        updates, opt_state = optimizer.update(grads, opt_state, p)
+        p = optax.apply_updates(p, updates)
+        return (p, opt_state), data
+
+    (p_final, _), history = jax.lax.scan(
+        step, (theta0, opt_state0), None, length=n_steps
+    )
+    # history[k] is the loss *before* update k; evaluate the returned
+    # parameters once more so final_loss describes them, not the state one
+    # step behind.
+    _, final_loss = loss_fn(p_final)
+    return FitResult(
+        pose=decode(p_final),
+        shape=p_final["shape"],
+        final_loss=final_loss,
+        loss_history=history,
+        pca=p_final.get("pca"),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_steps", "lr", "pose_space", "n_pca",
+        "pose_prior_weight", "shape_prior_weight",
+    ),
+)
+def fit(
+    params: ManoParams,
+    target_verts: jnp.ndarray,  # [V, 3] or [B, V, 3]
+    n_steps: int = 200,
+    lr: float = 0.05,
+    pose_space: str = "aa",
+    n_pca: int = 45,
+    pose_prior_weight: float = 0.0,
+    shape_prior_weight: float = 0.0,
+) -> FitResult:
+    """Recover pose/shape for one target mesh or a batch of them.
+
+    Batched targets fit as independent problems in parallel (vmap); this is
+    BASELINE.json config 4 at batch=256. For a custom optimizer use
+    ``fit_with_optimizer`` (not jitted at this level so the transformation
+    can be any optax object).
+    """
+    return fit_with_optimizer(
+        params, target_verts, optax.adam(lr),
+        n_steps=n_steps, pose_space=pose_space, n_pca=n_pca,
+        pose_prior_weight=pose_prior_weight,
+        shape_prior_weight=shape_prior_weight,
+    )
+
+
+def fit_with_optimizer(
+    params: ManoParams,
+    target_verts: jnp.ndarray,
+    optimizer: optax.GradientTransformation,
+    n_steps: int = 200,
+    pose_space: str = "aa",
+    n_pca: int = 45,
+    pose_prior_weight: float = 0.0,
+    shape_prior_weight: float = 0.0,
+) -> FitResult:
+    single = functools.partial(
+        _fit_single,
+        params,
+        n_steps=n_steps,
+        optimizer=optimizer,
+        pose_space=pose_space,
+        n_pca=n_pca,
+        pose_prior_weight=pose_prior_weight,
+        shape_prior_weight=shape_prior_weight,
+    )
+    target_verts = jnp.asarray(target_verts, params.v_template.dtype)
+    if target_verts.ndim == 2:
+        return single(target_verts)
+    return jax.vmap(single)(target_verts)

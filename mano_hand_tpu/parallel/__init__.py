@@ -1,0 +1,33 @@
+from mano_hand_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    make_mesh,
+    replicated,
+)
+from mano_hand_tpu.parallel.sharding import (
+    PARAM_SPECS,
+    ShardedParams,
+    gspmd_forward,
+    pad_verts,
+    shard_map_forward,
+    shard_params,
+)
+from mano_hand_tpu.parallel.fit import FitState, init_state, make_fit_step
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "make_mesh",
+    "batch_sharding",
+    "replicated",
+    "PARAM_SPECS",
+    "ShardedParams",
+    "shard_params",
+    "pad_verts",
+    "gspmd_forward",
+    "shard_map_forward",
+    "FitState",
+    "init_state",
+    "make_fit_step",
+]

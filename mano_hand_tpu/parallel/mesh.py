@@ -1,0 +1,55 @@
+"""Device-mesh construction for multi-chip execution.
+
+The reference is single-process CPU with no parallel machinery at all
+(SURVEY.md §2.2); here batch ("data") and vertex ("model") axes map onto a
+2-D ``jax.sharding.Mesh`` so collectives ride ICI. On one chip the mesh is
+trivial and everything compiles to the single-device program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    data: int = -1,
+    model: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ('data', 'model') mesh.
+
+    ``data=-1`` absorbs all remaining devices. ICI-friendly layout comes
+    from mesh_utils when the sizes allow; otherwise a plain reshape.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if data == -1:
+        if n % model:
+            raise ValueError(f"{n} devices not divisible by model={model}")
+        data = n // model
+    if data * model != n:
+        raise ValueError(
+            f"mesh {data}x{model} needs {data * model} devices, have {n}"
+        )
+    try:
+        dev_array = mesh_utils.create_device_mesh((data, model), devices=devices)
+    except Exception:
+        dev_array = np.asarray(devices).reshape(data, model)
+    return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis batch sharding over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
