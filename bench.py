@@ -100,9 +100,9 @@ def main() -> int:
     log(f"config2 batch=1024: {b2 / t2:,.0f} evals/s ({t2 * 1e3:.2f} ms)")
 
     # -- config 3: batch=65536, left+right interleaved (chunked) ------------
-    b3 = args.big_batch - (args.big_batch % 2)
+    b3 = max(2, args.big_batch - (args.big_batch % 2))
     half = b3 // 2
-    chunk = args.chunk
+    chunk = max(1, min(args.chunk, half))
     while half % chunk:  # clamp to a divisor so odd CLI args can't crash
         chunk -= 1
     pose3 = jnp.asarray(rng.normal(scale=0.6, size=(b3, 16, 3)), jnp.float32)

@@ -8,6 +8,12 @@ from mano_hand_tpu.assets.loader import (
     save_dumped_pickle,
     save_npz,
 )
+from mano_hand_tpu.assets.scans import (
+    extract_scan_poses,
+    mirror_pose,
+    mirror_verts,
+    save_scan_poses,
+)
 
 __all__ = [
     "ManoParams",
@@ -20,4 +26,8 @@ __all__ = [
     "load_official_pickle",
     "save_npz",
     "save_dumped_pickle",
+    "extract_scan_poses",
+    "save_scan_poses",
+    "mirror_pose",
+    "mirror_verts",
 ]

@@ -1,0 +1,68 @@
+"""Scan-pose extraction from official MANO pickles (C9 parity).
+
+Reproduces the reference's dump_scans (/root/reference/dump_model.py:24-43):
+decode the per-scan PCA coefficients shipped inside the official pickles
+(``hands_coeffs @ hands_components + hands_mean``), mirror the right-hand
+poses into the left-hand frame by flipping the y/z axis-angle components
+(dump_model.py:38), concatenate, and save as ``axangles.npy`` for the
+animation path.
+"""
+
+from __future__ import annotations
+
+import pickle
+from pathlib import Path
+from typing import Union
+
+import numpy as np
+
+from mano_hand_tpu.assets.loader import _dense
+
+PathLike = Union[str, Path]
+
+# Axis-angle mirror between left/right hands (dump_model.py:38): negate the
+# y and z components of every rotation vector.
+MIRROR_AA = np.array([1.0, -1.0, -1.0])
+
+
+def mirror_pose(pose: np.ndarray) -> np.ndarray:
+    """Mirror axis-angle pose(s) [..., 3] between left and right hands."""
+    return np.asarray(pose) * MIRROR_AA
+
+
+def mirror_verts(verts: np.ndarray) -> np.ndarray:
+    """Mirror vertices [..., 3] across the x=0 plane (left<->right
+    template relation)."""
+    return np.asarray(verts) * np.array([-1.0, 1.0, 1.0])
+
+
+def decode_scan_poses(official_pkl: PathLike) -> np.ndarray:
+    """Scan poses [N, 15, 3] stored in one official MANO pickle."""
+    with open(official_pkl, "rb") as f:
+        raw = pickle.load(f, encoding="latin1")
+    coeffs = _dense(raw["hands_coeffs"])
+    basis = _dense(raw["hands_components"])
+    mean = _dense(raw["hands_mean"])
+    flat = coeffs @ basis + mean
+    return flat.reshape(-1, 15, 3)
+
+
+def extract_scan_poses(
+    left_pkl: PathLike, right_pkl: PathLike
+) -> np.ndarray:
+    """All scan poses in the left-hand frame: left as-is, right mirrored.
+
+    Matches dump_scans' concatenation order (left block then right block,
+    dump_model.py:40)."""
+    left = decode_scan_poses(left_pkl)
+    right = mirror_pose(decode_scan_poses(right_pkl))
+    return np.concatenate([left, right], axis=0)
+
+
+def save_scan_poses(
+    left_pkl: PathLike, right_pkl: PathLike, out_path: PathLike = "axangles.npy"
+) -> Path:
+    """dump_scans parity: write the pooled pose bank as .npy."""
+    out_path = Path(out_path)
+    np.save(out_path, extract_scan_poses(left_pkl, right_pkl))
+    return out_path

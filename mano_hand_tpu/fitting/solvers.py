@@ -66,6 +66,7 @@ def _fit_single(
     def loss_fn(p):
         out = core.forward(params, decode(p), p["shape"])
         data = objectives.vertex_l2(out.verts, target_verts)
+        # Prior weights may be traced scalars (see fit): plain multiplies.
         reg = (
             pose_prior_weight
             * objectives.l2_prior(p["pca"] if pose_space == "pca" else p["pose"])
@@ -100,10 +101,7 @@ def _fit_single(
 
 @functools.partial(
     jax.jit,
-    static_argnames=(
-        "n_steps", "lr", "pose_space", "n_pca",
-        "pose_prior_weight", "shape_prior_weight",
-    ),
+    static_argnames=("n_steps", "pose_space", "n_pca"),
 )
 def fit(
     params: ManoParams,
@@ -118,9 +116,10 @@ def fit(
     """Recover pose/shape for one target mesh or a batch of them.
 
     Batched targets fit as independent problems in parallel (vmap); this is
-    BASELINE.json config 4 at batch=256. For a custom optimizer use
-    ``fit_with_optimizer`` (not jitted at this level so the transformation
-    can be any optax object).
+    BASELINE.json config 4 at batch=256. ``lr`` and the prior weights are
+    traced operands, so a hyperparameter sweep reuses one compiled program.
+    For a custom optimizer use ``fit_with_optimizer`` (not jitted at this
+    level so the transformation can be any optax object).
     """
     return fit_with_optimizer(
         params, target_verts, optax.adam(lr),

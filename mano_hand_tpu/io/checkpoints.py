@@ -1,0 +1,49 @@
+"""Checkpointing for fitted parameters and pose banks.
+
+The reference's only persistence is the asset pickle and OBJ export
+(SURVEY.md §5 "checkpoint/resume"); the fitting subsystem adds recovered
+(theta, beta) that are worth saving/restoring. Format: flat ``.npz`` —
+host-portable, no pickle execution on load.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Mapping, Union
+
+import numpy as np
+
+PathLike = Union[str, Path]
+
+
+def save_fit_result(result, path: PathLike) -> Path:
+    """Persist a fitting.FitResult (or any object with pose/shape/...)."""
+    path = Path(path)
+    arrays = {
+        "pose": np.asarray(result.pose),
+        "shape": np.asarray(result.shape),
+        "final_loss": np.asarray(result.final_loss),
+        "loss_history": np.asarray(result.loss_history),
+    }
+    if getattr(result, "pca", None) is not None:
+        arrays["pca"] = np.asarray(result.pca)
+    np.savez(path, **arrays)
+    return path
+
+
+def load_fit_result(path: PathLike) -> dict:
+    """Load a saved fit as a dict of numpy arrays."""
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}
+
+
+def save_arrays(path: PathLike, **arrays: Mapping[str, np.ndarray]) -> Path:
+    """Generic named-array checkpoint (pose banks, targets, ...)."""
+    path = Path(path)
+    np.savez(path, **{k: np.asarray(v) for k, v in arrays.items()})
+    return path
+
+
+def load_arrays(path: PathLike) -> dict:
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}

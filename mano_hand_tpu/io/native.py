@@ -1,0 +1,114 @@
+"""ctypes binding for the native OBJ serializer (native/objio.cpp).
+
+Builds the shared library on demand with g++ (no pybind11 on this image;
+the C ABI + ctypes keeps the binding dependency-free). Every entry point
+degrades gracefully to the pure-Python writer when no compiler is
+available, so the native layer is an accelerator, never a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "build" / "libmanoio.so"
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def build(force: bool = False) -> bool:
+    """Compile the native library. Returns True on success."""
+    if _LIB_PATH.exists() and not force:
+        return True
+    try:
+        subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR)],
+            check=True, capture_output=True, timeout=120,
+        )
+        return _LIB_PATH.exists()
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def load(build_if_needed: bool = True) -> Optional[ctypes.CDLL]:
+    """Load (optionally building) the native library; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    if not build_if_needed and not _LIB_PATH.exists():
+        return None
+    if _tried:
+        return None
+    _tried = True
+    if not build():
+        return None
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+    except OSError:
+        return None
+    lib.mano_write_obj.restype = ctypes.c_int
+    lib.mano_write_obj.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+    ]
+    lib.mano_write_obj_sequence.restype = ctypes.c_int
+    lib.mano_write_obj_sequence.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+    ]
+    _lib = lib
+    return _lib
+
+
+def available(build_if_needed: bool = True) -> bool:
+    return load(build_if_needed) is not None
+
+
+def _as_c(verts, faces):
+    verts = np.ascontiguousarray(verts, dtype=np.float64).reshape(-1, 3)
+    faces = np.ascontiguousarray(faces, dtype=np.int32).reshape(-1, 3)
+    return (
+        verts,
+        faces,
+        verts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        faces.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+
+
+def write_obj(verts, faces, path) -> None:
+    """Native single-mesh OBJ write; raises RuntimeError on failure."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native objio unavailable (no compiler?)")
+    verts, faces, vp, fp = _as_c(verts, faces)
+    rc = lib.mano_write_obj(
+        str(path).encode(), vp, verts.shape[0], fp, faces.shape[0]
+    )
+    if rc != 0:
+        raise RuntimeError(f"mano_write_obj failed with code {rc} for {path}")
+
+
+def write_obj_sequence(verts_seq, faces, directory, stem="frame") -> int:
+    """Native animation dump; returns the number of frames written."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native objio unavailable (no compiler?)")
+    verts_seq = np.ascontiguousarray(verts_seq, dtype=np.float64)
+    t, v = verts_seq.shape[0], verts_seq.shape[1]
+    faces = np.ascontiguousarray(faces, dtype=np.int32).reshape(-1, 3)
+    Path(directory).mkdir(parents=True, exist_ok=True)
+    rc = lib.mano_write_obj_sequence(
+        str(directory).encode(), stem.encode(),
+        verts_seq.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), t, v,
+        faces.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), faces.shape[0],
+    )
+    if rc < 0:
+        raise RuntimeError(f"mano_write_obj_sequence failed with code {rc}")
+    return rc

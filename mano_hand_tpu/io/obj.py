@@ -27,8 +27,26 @@ def format_obj(verts: np.ndarray, faces: np.ndarray) -> str:
     return v_lines + "\n" + f_lines + "\n"
 
 
-def export_obj(verts: np.ndarray, faces: np.ndarray, path: PathLike) -> None:
-    """Write a single mesh as OBJ."""
+def export_obj(
+    verts: np.ndarray, faces: np.ndarray, path: PathLike,
+    use_native: bool | None = None,
+) -> None:
+    """Write a single mesh as OBJ.
+
+    Uses the C++ serializer (io/native.py) when it is already built —
+    output is byte-identical, so the switch is transparent. A single-mesh
+    write never triggers a compile (a subprocess `make` would dwarf the
+    millisecond write); ``use_native=True`` forces (and builds) the native
+    path, ``False`` forces Python.
+    """
+    if use_native is not False:
+        from mano_hand_tpu.io import native
+
+        if native.available(build_if_needed=bool(use_native)):
+            native.write_obj(verts, faces, path)
+            return
+        if use_native:
+            raise RuntimeError("native objio requested but unavailable")
     with open(path, "w") as fp:
         fp.write(format_obj(verts, faces))
 
@@ -64,15 +82,29 @@ def export_obj_sequence(
     faces: np.ndarray,
     directory: PathLike,
     stem: str = "frame",
+    use_native: bool | None = None,
 ) -> list[Path]:
     """Dump an animation as frame_%05d.obj files (the batch analogue of the
     reference's per-frame viewer loop, /root/reference/data_explore.py:12-15).
+
+    The native sequence writer formats all frames in C++ (one call, no
+    per-frame Python overhead); a sequence dump is the case where the
+    one-off build pays for itself, so this path builds on demand.
     """
     directory = Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
-    paths = []
-    for t, verts in enumerate(np.asarray(verts_seq)):
-        p = directory / f"{stem}_{t:05d}.obj"
-        export_obj(verts, faces, p)
-        paths.append(p)
+    verts_seq = np.asarray(verts_seq)
+    paths = [
+        directory / f"{stem}_{t:05d}.obj" for t in range(verts_seq.shape[0])
+    ]
+    if use_native is not False:
+        from mano_hand_tpu.io import native
+
+        if native.available():
+            native.write_obj_sequence(verts_seq, faces, directory, stem)
+            return paths
+        if use_native:
+            raise RuntimeError("native objio requested but unavailable")
+    for p, verts in zip(paths, verts_seq):
+        export_obj(verts, faces, p, use_native=False)
     return paths

@@ -1,0 +1,83 @@
+"""Temporal sequence evaluation: frames x hands in one XLA program.
+
+The reference animates by looping ``set_params`` per frame in Python and
+rendering each mesh (/root/reference/data_explore.py:12-15). Here a whole
+two-hand motion clip is one vmapped forward over the (frame, hand) axes
+(BASELINE.json config 5), with an optional pose resampler for retiming
+clips.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mano_hand_tpu.assets.schema import ManoParams
+from mano_hand_tpu.models import core
+
+
+def evaluate_sequence(
+    params: ManoParams,
+    poses: jnp.ndarray,                 # [T, 16, 3]
+    shapes: Optional[jnp.ndarray] = None,  # [T, S] or [S] (broadcast)
+) -> jnp.ndarray:
+    """Verts [T, V, 3] for a single-hand motion clip (jitted, one program)."""
+    poses = jnp.asarray(poses)
+    t = poses.shape[0]
+    dtype = params.v_template.dtype
+    if shapes is None:
+        shapes = jnp.zeros((t, params.shape_basis.shape[-1]), dtype)
+    else:
+        shapes = jnp.broadcast_to(
+            jnp.asarray(shapes, dtype),
+            (t, params.shape_basis.shape[-1]),
+        )
+    return core.jit_forward_batched(params, poses, shapes).verts
+
+
+def evaluate_two_hand_sequence(
+    left: ManoParams,
+    right: ManoParams,
+    poses: jnp.ndarray,                 # [T, 2, 16, 3] (hand axis: L, R)
+    shapes: Optional[jnp.ndarray] = None,  # [T, 2, S] optional
+) -> jnp.ndarray:
+    """Verts [T, 2, V, 3] for a two-hand clip — vmap over (frame, hand).
+
+    The hand axis maps to two parameter PyTrees (left/right are separate
+    assets, /root/reference/dump_model.py:48-49), so each hand evaluates
+    under its own params inside one compiled program.
+    """
+    poses = jnp.asarray(poses)
+    t = poses.shape[0]
+    if shapes is None:
+        s_dim = left.shape_basis.shape[-1]
+        shapes = jnp.zeros((t, 2, s_dim), left.v_template.dtype)
+
+    @jax.jit
+    def run(p, s):
+        vl = core.forward_batched(left, p[:, 0], s[:, 0]).verts
+        vr = core.forward_batched(right, p[:, 1], s[:, 1]).verts
+        return jnp.stack([vl, vr], axis=1)
+
+    return run(poses, jnp.asarray(shapes))
+
+
+def resample_poses(poses: np.ndarray, n_frames: int) -> np.ndarray:
+    """Linearly retime an axis-angle pose track [T, ...] to n_frames.
+
+    Linear interpolation of axis-angle vectors is exact for fixed axes and a
+    good small-angle approximation otherwise — sufficient for retiming
+    scan-pose banks; use a quaternion path if long-arc accuracy matters.
+    """
+    poses = np.asarray(poses)
+    t = poses.shape[0]
+    if t == n_frames:
+        return poses.copy()
+    src = np.linspace(0.0, t - 1.0, n_frames)
+    lo = np.floor(src).astype(int)
+    hi = np.minimum(lo + 1, t - 1)
+    w = (src - lo).reshape((-1,) + (1,) * (poses.ndim - 1))
+    return (1.0 - w) * poses[lo] + w * poses[hi]

@@ -132,6 +132,50 @@ def forward_batched(
     )(pose, shape)
 
 
+def forward_batched_pallas(
+    params: ManoParams,
+    pose: jnp.ndarray,   # [B, J, 3]
+    shape: jnp.ndarray,  # [B, S]
+    precision=DEFAULT_PRECISION,
+    block_b: int = 32,
+    block_v: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Batched forward with the Pallas fused-LBS kernel; returns verts only.
+
+    The pre-skinning stages (blendshapes, Rodrigues, FK) are the vmapped
+    XLA path; skinning runs in one Pallas kernel that keeps the per-vertex
+    blended rotations in VMEM (see ops/pallas_lbs.py). Forward-only — use
+    ``forward_batched`` for gradients.
+    """
+    from mano_hand_tpu.ops import pallas_lbs
+
+    def pre(p, s):
+        v_shaped = ops.shape_blend(
+            params.v_template, params.shape_basis, s, precision
+        )
+        joints = ops.regress_joints(params.j_regressor, v_shaped, precision)
+        rot_mats = ops.rotation_matrix(p)
+        v_posed = ops.pose_blend(
+            v_shaped, params.pose_basis, rot_mats, precision
+        )
+        world_rot, world_t = ops.forward_kinematics(
+            params.parents, rot_mats, joints, precision
+        )
+        skin_rot, skin_t = ops.skinning_transforms(
+            world_rot, world_t, joints, precision
+        )
+        return skin_rot, skin_t, v_posed
+
+    dtype = params.v_template.dtype
+    pose = pose.reshape(pose.shape[0], -1, 3).astype(dtype)
+    skin_rot, skin_t, v_posed = jax.vmap(pre)(pose, shape.astype(dtype))
+    return pallas_lbs.skin_batched(
+        params.lbs_weights, skin_rot, skin_t, v_posed,
+        block_b=block_b, block_v=block_v, interpret=interpret,
+    )
+
+
 def forward_chunked(
     params: ManoParams,
     pose: jnp.ndarray,

@@ -1,0 +1,111 @@
+"""Pallas TPU kernel: fused linear blend skinning.
+
+One kernel computes, per (batch-tile, vertex-tile):
+
+    M      = R_flat @ W^T        [9, TV]  (MXU, contraction over J=16)
+    T_blend= T^T    @ W^T        [3, TV]
+    out[a] = sum_c M[3a+c] * vp[c] + T_blend[a]          (VPU)
+
+so the blended per-vertex rotations never round-trip through HBM — the XLA
+einsum path (ops/lbs.py) materializes the [B, V, 9] blend tensor (~229 MB at
+B=8192), this kernel keeps it in VMEM tiles.
+
+Layout is lane-friendly: vertices ride the 128-wide lane dimension, the tiny
+3/9/16-sized axes sit on sublanes. Inputs are transposed at the JAX level
+(XLA fuses the transposes into the surrounding pads/copies).
+
+Forward-only: the fitting path keeps the differentiable einsum LBS; this
+kernel targets inference/bench throughput. Numerics: f32 accumulate via
+preferred_element_type (matches Precision.HIGHEST on the einsum path).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _skin_kernel(wt_ref, rt_ref, tt_ref, vpt_ref, out_ref):
+    """Blocks: wt [J, TV], rt [TB, 9, J], tt [TB, 3, J], vpt [TB, 3, TV],
+    out [TB, 3, TV]."""
+    tb = rt_ref.shape[0]
+    j = wt_ref.shape[0]
+    wt = wt_ref[:]                                        # [J, TV]
+    m = jnp.dot(
+        rt_ref[:].reshape(tb * 9, j), wt,
+        preferred_element_type=jnp.float32,
+    ).reshape(tb, 9, -1)                                  # [TB, 9, TV]
+    t_blend = jnp.dot(
+        tt_ref[:].reshape(tb * 3, j), wt,
+        preferred_element_type=jnp.float32,
+    ).reshape(tb, 3, -1)                                  # [TB, 3, TV]
+    vp = vpt_ref[:]                                       # [TB, 3, TV]
+    for a in range(3):
+        acc = t_blend[:, a, :]
+        for c in range(3):
+            acc = acc + m[:, 3 * a + c, :] * vp[:, c, :]
+        out_ref[:, a, :] = acc
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_b", "block_v", "interpret")
+)
+def skin_batched(
+    weights: jnp.ndarray,    # [V, J] LBS weights
+    world_rot: jnp.ndarray,  # [B, J, 3, 3] skinning rotations
+    skin_t: jnp.ndarray,     # [B, J, 3] skinning translations
+    v_posed: jnp.ndarray,    # [B, V, 3] blendshaped rest-pose verts
+    block_b: int = 32,
+    block_v: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Batched fused LBS: [B, V, 3] skinned vertices.
+
+    Semantics identical to vmap(ops.lbs.skin) over the batch axis.
+    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU tests).
+    """
+    b, v, j = v_posed.shape[0], weights.shape[0], weights.shape[1]
+    f32 = jnp.float32
+    bp, vp_ = _cdiv(b, block_b) * block_b, _cdiv(v, block_v) * block_v
+
+    wt = jnp.pad(weights.astype(f32).T, [(0, 0), (0, vp_ - v)])     # [J, Vp]
+    rt = jnp.pad(
+        world_rot.astype(f32).reshape(b, j, 9).transpose(0, 2, 1),
+        [(0, bp - b), (0, 0), (0, 0)],
+    )                                                               # [Bp,9,J]
+    tt = jnp.pad(
+        skin_t.astype(f32).transpose(0, 2, 1), [(0, bp - b), (0, 0), (0, 0)]
+    )                                                               # [Bp,3,J]
+    vpt = jnp.pad(
+        v_posed.astype(f32).transpose(0, 2, 1),
+        [(0, bp - b), (0, 0), (0, vp_ - v)],
+    )                                                               # [Bp,3,Vp]
+
+    grid = (bp // block_b, vp_ // block_v)
+    out = pl.pallas_call(
+        _skin_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((j, block_v), lambda i, k: (0, k),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_b, 9, j), lambda i, k: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_b, 3, j), lambda i, k: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_b, 3, block_v), lambda i, k: (i, 0, k),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_b, 3, block_v), lambda i, k: (i, 0, k),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bp, 3, vp_), f32),
+        interpret=interpret,
+    )(wt, rt, tt, vpt)
+    return out[:b].transpose(0, 2, 1)[:, :v]

@@ -1,0 +1,4 @@
+from mano_hand_tpu.utils.config import ManoConfig
+from mano_hand_tpu.utils.profiling import Timer, time_jax_fn, xla_trace
+
+__all__ = ["ManoConfig", "Timer", "time_jax_fn", "xla_trace"]

@@ -1,0 +1,81 @@
+"""Runtime configuration.
+
+The reference hardcodes every constant (asset paths at dump_model.py:48-49,
+demo params at mano_np.py:209-216, n_joints/n_shape at mano_np.py:35-36);
+SURVEY.md §5 calls for a small config object instead. One dataclass, JSON
+round-trippable, that can build the model objects it describes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Optional, Union
+
+PathLike = Union[str, Path]
+
+
+@dataclasses.dataclass
+class ManoConfig:
+    asset: str = "synthetic"        # path to .npz/.pkl, or "synthetic"
+    side: Optional[str] = None      # left | right | None (infer)
+    backend: str = "jax"            # np | jax
+    dtype: str = "float32"          # compute dtype for the jax path
+    precision: str = "highest"      # highest | default (contraction passes)
+    mesh_data: int = 1              # data-parallel mesh extent
+    mesh_model: int = 1             # tensor-parallel mesh extent
+    chunk_size: int = 8192          # huge-batch chunking
+    seed: int = 0                   # synthetic-asset seed
+
+    # ----------------------------------------------------------- build
+    def load_params(self):
+        import numpy as np
+
+        from mano_hand_tpu.assets import load_model, synthetic_params
+
+        if self.asset == "synthetic":
+            params = synthetic_params(
+                seed=self.seed, side=self.side or "right"
+            )
+        else:
+            params = load_model(self.asset, side=self.side)
+        if self.backend == "jax":
+            return params.astype(np.dtype(self.dtype))
+        return params
+
+    def build_model(self):
+        from mano_hand_tpu.models.layer import MANOModel
+
+        return MANOModel(self.load_params(), backend=self.backend)
+
+    def build_mesh(self):
+        from mano_hand_tpu.parallel import make_mesh
+
+        return make_mesh(data=self.mesh_data, model=self.mesh_model)
+
+    def jax_precision(self):
+        import jax
+
+        return {
+            "highest": jax.lax.Precision.HIGHEST,
+            "default": jax.lax.Precision.DEFAULT,
+        }[self.precision]
+
+    # ------------------------------------------------------------ json
+    def to_json(self, path: Optional[PathLike] = None) -> str:
+        text = json.dumps(dataclasses.asdict(self), indent=2)
+        if path is not None:
+            Path(path).write_text(text)
+        return text
+
+    @classmethod
+    def from_json(cls, source: Union[str, PathLike]) -> "ManoConfig":
+        p = Path(str(source))
+        text = p.read_text() if p.exists() else str(source)
+        data = json.loads(text)
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(data) - known
+        if unknown:
+            raise ValueError(f"unknown config keys: {sorted(unknown)}")
+        return cls(**data)

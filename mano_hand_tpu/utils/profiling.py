@@ -1,0 +1,75 @@
+"""Profiling and timing helpers (SURVEY.md §5 "tracing/profiling").
+
+The reference has no instrumentation at all; these wrap the two tools that
+matter on TPU: wall-timing with ``block_until_ready`` (async dispatch makes
+naive timing meaningless) and the XLA profiler trace for xprof/tensorboard.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+
+class Timer:
+    """Accumulating wall-clock timer.
+
+    >>> t = Timer()
+    >>> with t:
+    ...     work()
+    >>> t.total, t.count, t.mean
+    """
+
+    def __init__(self) -> None:
+        self.total = 0.0
+        self.count = 0
+        self._t0: Optional[float] = None
+
+    def __enter__(self) -> "Timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.total += time.perf_counter() - self._t0
+        self.count += 1
+        self._t0 = None
+
+    @property
+    def mean(self) -> float:
+        return self.total / max(self.count, 1)
+
+
+def time_jax_fn(
+    fn: Callable, *args, iters: int = 10, warmup: int = 2
+) -> dict:
+    """Time a JAX callable correctly: device-blocking, median over iters.
+
+    Returns {"median_s", "min_s", "mean_s", "iters"}.
+    """
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        samples.append(time.perf_counter() - t0)
+    return {
+        "median_s": float(np.median(samples)),
+        "min_s": float(np.min(samples)),
+        "mean_s": float(np.mean(samples)),
+        "iters": iters,
+    }
+
+
+@contextlib.contextmanager
+def xla_trace(log_dir: str):
+    """Capture an XLA profiler trace viewable in xprof/tensorboard."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

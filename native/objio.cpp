@@ -1,0 +1,74 @@
+// Native OBJ serializer for mano_hand_tpu.
+//
+// The OBJ text format ("v %f %f %f" / "f %d %d %d", 1-indexed faces —
+// matching /root/reference/mano_np.py:190-194) is trivially CPU-bound in
+// Python at animation scale (hundreds of 778-vertex frames). This writer
+// formats into a growable buffer with snprintf (same printf semantics as
+// Python's '%' operator, so output is byte-identical) and writes once.
+//
+// C ABI, loaded via ctypes (no pybind11 in this image). Thread-safe: no
+// globals; each call owns its buffer.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+// Format one mesh as OBJ text into an internal buffer and write it to
+// `path`. Returns 0 on success, negative errno-style codes on failure.
+int mano_write_obj(const char* path,
+                   const double* verts, int64_t n_verts,
+                   const int32_t* faces, int64_t n_faces) {
+  if (!path || (n_verts > 0 && !verts) || (n_faces > 0 && !faces)) {
+    return -1;
+  }
+  std::string buf;
+  buf.reserve(static_cast<size_t>(n_verts) * 40 +
+              static_cast<size_t>(n_faces) * 24);
+  char line[128];
+  for (int64_t i = 0; i < n_verts; ++i) {
+    int n = snprintf(line, sizeof line, "v %f %f %f\n",
+                     verts[3 * i], verts[3 * i + 1], verts[3 * i + 2]);
+    if (n < 0) return -2;
+    buf.append(line, static_cast<size_t>(n));
+  }
+  for (int64_t i = 0; i < n_faces; ++i) {
+    int n = snprintf(line, sizeof line, "f %d %d %d\n",
+                     faces[3 * i] + 1, faces[3 * i + 1] + 1,
+                     faces[3 * i + 2] + 1);
+    if (n < 0) return -2;
+    buf.append(line, static_cast<size_t>(n));
+  }
+  FILE* fp = fopen(path, "w");
+  if (!fp) return -3;
+  size_t written = fwrite(buf.data(), 1, buf.size(), fp);
+  int rc = (written == buf.size()) ? 0 : -4;
+  if (fclose(fp) != 0) rc = rc ? rc : -5;
+  return rc;
+}
+
+// Batch variant: write an animation sequence frame_%05d.obj under `dir`.
+// verts is [T, V, 3] contiguous. Returns number of frames written, or a
+// negative error code.
+int mano_write_obj_sequence(const char* dir, const char* stem,
+                            const double* verts, int64_t t_frames,
+                            int64_t n_verts,
+                            const int32_t* faces, int64_t n_faces) {
+  if (!dir || !stem) return -1;
+  char path[4096];
+  for (int64_t t = 0; t < t_frames; ++t) {
+    int n = snprintf(path, sizeof path, "%s/%s_%05lld.obj", dir, stem,
+                     static_cast<long long>(t));
+    if (n < 0 || n >= static_cast<int>(sizeof path)) return -2;
+    int rc = mano_write_obj(path, verts + t * n_verts * 3, n_verts,
+                            faces, n_faces);
+    if (rc != 0) return rc;
+  }
+  return static_cast<int>(t_frames);
+}
+
+}  // extern "C"

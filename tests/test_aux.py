@@ -1,0 +1,167 @@
+"""Auxiliary subsystems: scan extraction, mirroring, anim, checkpoints,
+config, profiling."""
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mano_hand_tpu.assets import scans, synthetic_params
+from mano_hand_tpu.io import checkpoints
+from mano_hand_tpu.models import anim, core, oracle
+from mano_hand_tpu.utils import ManoConfig, Timer, time_jax_fn
+
+
+# ------------------------------------------------------------ scans (C9)
+def fake_official_pkl(path, seed, n_scans=7):
+    """Official-pickle shaped file with just the pose-bank keys."""
+    rng = np.random.default_rng(seed)
+    data = {
+        "hands_components": rng.normal(size=(45, 45)),
+        "hands_mean": rng.normal(scale=0.05, size=45),
+        "hands_coeffs": rng.normal(size=(n_scans, 45)),
+    }
+    with open(path, "wb") as f:
+        pickle.dump(data, f)
+    return data
+
+
+def test_extract_scan_poses(tmp_path):
+    dl = fake_official_pkl(tmp_path / "MANO_LEFT.pkl", seed=0)
+    dr = fake_official_pkl(tmp_path / "MANO_RIGHT.pkl", seed=1, n_scans=5)
+    poses = scans.extract_scan_poses(
+        tmp_path / "MANO_LEFT.pkl", tmp_path / "MANO_RIGHT.pkl"
+    )
+    assert poses.shape == (12, 15, 3)
+    # left block decodes as-is
+    want_l = (dl["hands_coeffs"] @ dl["hands_components"] + dl["hands_mean"])
+    np.testing.assert_allclose(poses[:7], want_l.reshape(-1, 15, 3))
+    # right block is mirrored by [1,-1,-1]
+    want_r = (dr["hands_coeffs"] @ dr["hands_components"] + dr["hands_mean"])
+    np.testing.assert_allclose(
+        poses[7:], want_r.reshape(-1, 15, 3) * [1, -1, -1]
+    )
+    out = scans.save_scan_poses(
+        tmp_path / "MANO_LEFT.pkl", tmp_path / "MANO_RIGHT.pkl",
+        tmp_path / "axangles.npy",
+    )
+    np.testing.assert_array_equal(np.load(out), poses)
+
+
+def test_mirror_involution():
+    rng = np.random.default_rng(2)
+    pose = rng.normal(size=(4, 16, 3))
+    np.testing.assert_allclose(scans.mirror_pose(scans.mirror_pose(pose)), pose)
+    verts = rng.normal(size=(10, 3))
+    np.testing.assert_allclose(scans.mirror_verts(scans.mirror_verts(verts)), verts)
+
+
+def test_mirrored_hands_produce_mirrored_meshes(params_pair):
+    """Build a geometrically mirrored 'left' asset from the right one; a
+    mirrored pose must then produce the mirrored mesh (the relation behind
+    dump_model.py:38)."""
+    import dataclasses
+
+    _, right = params_pair
+    s = np.array([-1.0, 1.0, 1.0])
+    # Mirrored rotations are conjugations R' = M R M, so the 135 pose
+    # features (R-I)[a,b] pick up sign s[a]*s[b] in addition to the
+    # coordinate sign s[c] on the basis output axis.
+    feat_sign = np.tile((s[:, None] * s[None, :]).reshape(9), 15)  # [135]
+    left = dataclasses.replace(
+        right,
+        v_template=scans.mirror_verts(right.v_template),
+        shape_basis=right.shape_basis * s[None, :, None],
+        pose_basis=right.pose_basis * s[None, :, None] * feat_sign[None, None, :],
+        side="left",
+    )
+    rng = np.random.default_rng(3)
+    pose = rng.normal(scale=0.4, size=(16, 3))
+    beta = rng.normal(size=10)
+    v_r = oracle.forward(right, pose=pose, shape=beta).verts
+    v_l = oracle.forward(left, pose=scans.mirror_pose(pose), shape=beta).verts
+    np.testing.assert_allclose(v_l, scans.mirror_verts(v_r), atol=1e-10)
+
+
+# ------------------------------------------------------------------ anim
+def test_evaluate_sequence(params):
+    p32 = params.astype(np.float32)
+    rng = np.random.default_rng(4)
+    poses = rng.normal(scale=0.4, size=(6, 16, 3)).astype(np.float32)
+    verts = anim.evaluate_sequence(p32, jnp.asarray(poses))
+    assert verts.shape == (6, 778, 3)
+    want = core.forward(p32, jnp.asarray(poses[2]),
+                        jnp.zeros(10, jnp.float32)).verts
+    np.testing.assert_allclose(np.asarray(verts[2]), np.asarray(want),
+                               atol=1e-6)
+
+
+def test_two_hand_sequence(params_pair):
+    left, right = (p.astype(np.float32) for p in params_pair)
+    rng = np.random.default_rng(5)
+    poses = rng.normal(scale=0.4, size=(4, 2, 16, 3)).astype(np.float32)
+    verts = anim.evaluate_two_hand_sequence(left, right, jnp.asarray(poses))
+    assert verts.shape == (4, 2, 778, 3)
+    want = core.forward(right, jnp.asarray(poses[1, 1]),
+                        jnp.zeros(10, jnp.float32)).verts
+    np.testing.assert_allclose(np.asarray(verts[1, 1]), np.asarray(want),
+                               atol=1e-6)
+
+
+def test_resample_poses():
+    poses = np.stack([np.full((15, 3), t, dtype=float) for t in range(5)])
+    up = anim.resample_poses(poses, 9)
+    assert up.shape == (9, 15, 3)
+    np.testing.assert_allclose(up[0], poses[0])
+    np.testing.assert_allclose(up[-1], poses[-1])
+    np.testing.assert_allclose(up[4], np.full((15, 3), 2.0))  # midpoint
+
+
+# ----------------------------------------------------------- checkpoints
+def test_fit_checkpoint_roundtrip(params, tmp_path):
+    from mano_hand_tpu.fitting import fit
+
+    p32 = params.astype(np.float32)
+    target = core.forward(p32).verts
+    res = fit(p32, target, n_steps=5)
+    path = checkpoints.save_fit_result(res, tmp_path / "fit.npz")
+    back = checkpoints.load_fit_result(path)
+    np.testing.assert_allclose(back["pose"], np.asarray(res.pose))
+    np.testing.assert_allclose(back["loss_history"],
+                               np.asarray(res.loss_history))
+
+
+# ---------------------------------------------------------------- config
+def test_config_roundtrip(tmp_path):
+    cfg = ManoConfig(asset="synthetic", mesh_data=4, mesh_model=2)
+    path = tmp_path / "cfg.json"
+    cfg.to_json(path)
+    back = ManoConfig.from_json(path)
+    assert back == cfg
+    with pytest.raises(ValueError, match="unknown config keys"):
+        ManoConfig.from_json('{"bogus": 1}')
+
+
+def test_config_builds(tmp_path):
+    cfg = ManoConfig(backend="np")
+    model = cfg.build_model()
+    assert model.verts.shape == (778, 3)
+    params = ManoConfig(backend="jax").load_params()
+    assert params.v_template.dtype == np.float32
+
+
+# ------------------------------------------------------------- profiling
+def test_timer_and_time_jax_fn(params):
+    t = Timer()
+    with t:
+        pass
+    assert t.count == 1 and t.total >= 0
+    p32 = params.astype(np.float32)
+    stats = time_jax_fn(
+        lambda: core.jit_forward(
+            p32, jnp.zeros((16, 3), jnp.float32), jnp.zeros(10, jnp.float32)
+        ),
+        iters=3, warmup=1,
+    )
+    assert stats["min_s"] <= stats["median_s"] <= stats["mean_s"] * 3
