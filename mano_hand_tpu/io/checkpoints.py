@@ -16,9 +16,18 @@ import numpy as np
 PathLike = Union[str, Path]
 
 
+def _npz_path(path: PathLike) -> Path:
+    # np.savez appends ".npz" to suffix-less paths; normalize up front so the
+    # returned path is always the file that exists on disk.
+    path = Path(path)
+    return path if path.suffix == ".npz" else path.with_suffix(
+        path.suffix + ".npz"
+    )
+
+
 def save_fit_result(result, path: PathLike) -> Path:
     """Persist a fitting.FitResult (or any object with pose/shape/...)."""
-    path = Path(path)
+    path = _npz_path(path)
     arrays = {
         "pose": np.asarray(result.pose),
         "shape": np.asarray(result.shape),
@@ -33,13 +42,12 @@ def save_fit_result(result, path: PathLike) -> Path:
 
 def load_fit_result(path: PathLike) -> dict:
     """Load a saved fit as a dict of numpy arrays."""
-    with np.load(path) as z:
-        return {k: z[k] for k in z.files}
+    return load_arrays(path)
 
 
 def save_arrays(path: PathLike, **arrays: Mapping[str, np.ndarray]) -> Path:
     """Generic named-array checkpoint (pose banks, targets, ...)."""
-    path = Path(path)
+    path = _npz_path(path)
     np.savez(path, **{k: np.asarray(v) for k, v in arrays.items()})
     return path
 

@@ -42,7 +42,9 @@ def load(build_if_needed: bool = True) -> Optional[ctypes.CDLL]:
         return _lib
     if not build_if_needed and not _LIB_PATH.exists():
         return None
-    if _tried:
+    # The failed-build latch only suppresses rebuild *attempts*; if the
+    # library has appeared since (manual make, build(force=True)), load it.
+    if _tried and not _LIB_PATH.exists():
         return None
     _tried = True
     if not build():

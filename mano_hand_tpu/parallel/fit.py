@@ -60,23 +60,26 @@ def make_fit_step(
     n_verts = n_verts or true_v
     data = NamedSharding(mesh, P(DATA_AXIS))
 
-    def loss_fn(fit_params, targets):
+    def loss_fn(prm, fit_params, targets):
         out = core.forward_batched(
-            params, fit_params["pose"], fit_params["shape"]
+            prm, fit_params["pose"], fit_params["shape"]
         )
         return objectives.vertex_l2(out.verts[:, :n_verts], targets)
 
     @functools.partial(
         jax.jit,
-        in_shardings=(None, data),
+        in_shardings=(None, None, data),
         out_shardings=(None, None),
-        donate_argnums=(0,),
+        donate_argnums=(1,),
     )
-    def step(state: FitState, targets):
+    def step(prm, state: FitState, targets):
         fit_params = {"pose": state.pose, "shape": state.shape}
-        loss, grads = jax.value_and_grad(loss_fn)(fit_params, targets)
+        loss, grads = jax.value_and_grad(loss_fn, argnums=1)(
+            prm, fit_params, targets
+        )
         updates, opt_state = optimizer.update(grads, state.opt_state, fit_params)
         fit_params = optax.apply_updates(fit_params, updates)
         return FitState(fit_params["pose"], fit_params["shape"], opt_state), loss
 
-    return step
+    # Params ride as a jit argument, not a captured constant (axon dispatch).
+    return lambda state, targets: step(params, state, targets)

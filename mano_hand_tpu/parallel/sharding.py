@@ -138,16 +138,20 @@ def gspmd_forward(params, mesh: Mesh, n_verts: int | None = None):
     @functools.partial(
         jax.jit,
         in_shardings=(
+            None,  # params: keep their committed (vertex-sharded) placement
             NamedSharding(mesh, P(DATA_AXIS)),
             NamedSharding(mesh, P(DATA_AXIS)),
         ),
         out_shardings=NamedSharding(mesh, out_spec),
     )
-    def fwd(pose, shape):
-        verts = core.forward_batched(params, pose, shape).verts
+    def fwd(prm, pose, shape):
+        verts = core.forward_batched(prm, pose, shape).verts
         return verts[:, :n_verts]
 
-    return fwd
+    # Bind params outside the trace: passing them as a jit argument (instead
+    # of capturing device arrays as constants) keeps dispatch fast on the
+    # axon TPU tunnel.
+    return lambda pose, shape: fwd(params, pose, shape)
 
 
 def shard_map_forward(params, mesh: Mesh, n_verts: int | None = None):
@@ -199,7 +203,7 @@ def shard_map_forward(params, mesh: Mesh, n_verts: int | None = None):
     )
 
     @jax.jit
-    def fwd(pose, shape):
-        return shard_fn(params, pose, shape)[:, :n_verts]
+    def fwd(prm, pose, shape):
+        return shard_fn(prm, pose, shape)[:, :n_verts]
 
-    return fwd
+    return lambda pose, shape: fwd(params, pose, shape)

@@ -46,9 +46,16 @@ class Timer:
 def time_jax_fn(
     fn: Callable, *args, iters: int = 10, warmup: int = 2
 ) -> dict:
-    """Time a JAX callable correctly: device-blocking, median over iters.
+    """Time a JAX callable: block_until_ready per call, median over iters.
 
     Returns {"median_s", "min_s", "mean_s", "iters"}.
+
+    Caveat: on remote-tunneled devices (e.g. the axon TPU platform)
+    ``block_until_ready`` can return at enqueue rather than completion, and
+    the first device->host readback adds a fixed per-dispatch sync cost.
+    There, use bench.py's ``slope_time`` pattern instead: loop the workload
+    inside one jitted program and difference two loop counts so fixed
+    overheads cancel. This helper is accurate on directly-attached devices.
     """
     for _ in range(warmup):
         jax.block_until_ready(fn(*args))

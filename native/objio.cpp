@@ -29,18 +29,20 @@ int mano_write_obj(const char* path,
   std::string buf;
   buf.reserve(static_cast<size_t>(n_verts) * 40 +
               static_cast<size_t>(n_faces) * 24);
-  char line[128];
+  // %f of a double can exceed 300 chars (e.g. 1e308), so the line buffer
+  // must fit three of them; truncation (n >= sizeof line) is still checked.
+  char line[1024];
   for (int64_t i = 0; i < n_verts; ++i) {
     int n = snprintf(line, sizeof line, "v %f %f %f\n",
                      verts[3 * i], verts[3 * i + 1], verts[3 * i + 2]);
-    if (n < 0) return -2;
+    if (n < 0 || n >= static_cast<int>(sizeof line)) return -2;
     buf.append(line, static_cast<size_t>(n));
   }
   for (int64_t i = 0; i < n_faces; ++i) {
     int n = snprintf(line, sizeof line, "f %d %d %d\n",
                      faces[3 * i] + 1, faces[3 * i + 1] + 1,
                      faces[3 * i + 2] + 1);
-    if (n < 0) return -2;
+    if (n < 0 || n >= static_cast<int>(sizeof line)) return -2;
     buf.append(line, static_cast<size_t>(n));
   }
   FILE* fp = fopen(path, "w");
