@@ -234,7 +234,16 @@ def main() -> int:
         "detail": {k: (float(f"{v:.5g}") if isinstance(v, float) else v)
                    for k, v in results.items()},
     }
-    print(json.dumps(line), flush=True)
+
+    def _finite(x):
+        # NaN/inf (noisy slope sentinel) would make the line invalid JSON.
+        if isinstance(x, float) and not np.isfinite(x):
+            return None
+        if isinstance(x, dict):
+            return {k: _finite(v) for k, v in x.items()}
+        return x
+
+    print(json.dumps(_finite(line)), flush=True)
     return 0
 
 

@@ -10,6 +10,9 @@ Subcommands cover the reference's executable entry points (SURVEY.md §3):
   animate  — batch-evaluate a pose sequence ([T,16,3] .npy) and dump OBJ
              frames: the offline analogue of the reference's GL viewer loop
              (/root/reference/data_explore.py:8-18)
+  render   — rasterize a pose (or pose sequence) to PNG frames / an
+             animated GIF with the built-in JAX renderer, replacing the
+             reference's external OpenGL viewer dependency
   info     — print an asset's schema summary
 
 Run as ``python -m mano_hand_tpu.cli <subcommand>``.
@@ -81,6 +84,22 @@ def cmd_convert(args) -> int:
     return 0
 
 
+def _load_pose_sequence(path: str | None, params) -> np.ndarray:
+    """Pose bank -> [T, n_joints, 3]. Accepts [T,16,3], [T,15,3] (zero
+    global-rot row prepended, data_explore.py:13 behavior), or a single
+    [16,3]/[15,3] pose; None gives one rest-pose frame."""
+    if path is None:
+        return np.zeros((1, params.n_joints, 3))
+    poses = np.load(path)
+    if poses.ndim == 2:
+        poses = poses[None]
+    if poses.shape[-2] == params.n_joints - 1:
+        poses = np.concatenate(
+            [np.zeros((*poses.shape[:-2], 1, 3)), poses], axis=-2
+        )
+    return poses
+
+
 def cmd_animate(args) -> int:
     import jax.numpy as jnp
 
@@ -88,12 +107,7 @@ def cmd_animate(args) -> int:
     from mano_hand_tpu.models import core
 
     params = _load_params(args.asset, args.side).astype(np.float32)
-    poses = np.load(args.poses)  # [T, 16, 3] or [T, 15, 3] (no global rot)
-    if poses.shape[-2] == params.n_joints - 1:
-        # data_explore.py:13 behavior: prepend a zero global-rot row.
-        poses = np.concatenate(
-            [np.zeros((*poses.shape[:-2], 1, 3)), poses], axis=-2
-        )
+    poses = _load_pose_sequence(args.poses, params)
     shapes = np.zeros((poses.shape[0], params.n_shape))
     out = core.jit_forward_batched(
         params, jnp.asarray(poses, jnp.float32), jnp.asarray(shapes, jnp.float32)
@@ -102,6 +116,35 @@ def cmd_animate(args) -> int:
         np.asarray(out.verts), np.asarray(params.faces), args.out
     )
     print(f"wrote {len(paths)} frames to {args.out}/")
+    return 0
+
+
+def cmd_render(args) -> int:
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+    from mano_hand_tpu import viz
+
+    params = _load_params(args.asset, args.side).astype(np.float32)
+    poses = _load_pose_sequence(args.poses, params)
+    shapes = np.zeros((poses.shape[0], params.n_shape))
+    out = core.jit_forward_batched(
+        params, jnp.asarray(poses, jnp.float32),
+        jnp.asarray(shapes, jnp.float32),
+    )
+    frames = viz.render_sequence(
+        np.asarray(out.verts), np.asarray(params.faces),
+        height=args.size, width=args.size,
+    )
+    dst = Path(args.out)
+    if dst.suffix == ".gif":
+        viz.write_gif(frames, dst, fps=args.fps)
+        print(f"wrote {dst} ({len(frames)} frames)")
+    else:
+        dst.mkdir(parents=True, exist_ok=True)
+        for t, frame in enumerate(frames):
+            viz.write_png(frame, dst / f"frame_{t:05d}.png")
+        print(f"wrote {len(frames)} PNGs to {dst}/")
     return 0
 
 
@@ -144,6 +187,17 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("--side", default=None, choices=[None, "left", "right"])
     a.add_argument("--out", default="frames")
     a.set_defaults(fn=cmd_animate)
+
+    r = sub.add_parser("render", help="rasterize poses to PNG/GIF")
+    r.add_argument("--poses", default=None,
+                   help=".npy of [T,16,3]/[T,15,3]/[16,3]; default rest pose")
+    r.add_argument("--asset", default="synthetic")
+    r.add_argument("--side", default=None, choices=[None, "left", "right"])
+    r.add_argument("--out", default="render",
+                   help="output dir for PNGs, or a .gif path")
+    r.add_argument("--size", type=int, default=256)
+    r.add_argument("--fps", type=int, default=20)
+    r.set_defaults(fn=cmd_render)
 
     i = sub.add_parser("info", help="print asset summary")
     i.add_argument("--asset", default="synthetic")

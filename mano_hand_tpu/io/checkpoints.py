@@ -53,5 +53,10 @@ def save_arrays(path: PathLike, **arrays: Mapping[str, np.ndarray]) -> Path:
 
 
 def load_arrays(path: PathLike) -> dict:
+    # Mirror the save-side .npz normalization so save_*(x, "ckpt") /
+    # load_*("ckpt") round-trips; a literal existing path still wins.
+    path = Path(path)
+    if not path.exists():
+        path = _npz_path(path)
     with np.load(path) as z:
         return {k: z[k] for k in z.files}

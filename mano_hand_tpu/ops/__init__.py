@@ -2,8 +2,16 @@ from mano_hand_tpu.ops.rodrigues import rotation_matrix, skew
 from mano_hand_tpu.ops.fk import forward_kinematics, skinning_transforms, tree_levels
 from mano_hand_tpu.ops.blend import pose_blend, regress_joints, shape_blend
 from mano_hand_tpu.ops.lbs import skin
+from mano_hand_tpu.ops.normals import (
+    batched_vertex_normals,
+    face_normals,
+    vertex_normals,
+)
 
 __all__ = [
+    "face_normals",
+    "vertex_normals",
+    "batched_vertex_normals",
     "rotation_matrix",
     "skew",
     "forward_kinematics",

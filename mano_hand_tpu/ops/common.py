@@ -9,3 +9,8 @@ absolute error against the <1e-4 vertex budget.
 import jax
 
 DEFAULT_PRECISION = jax.lax.Precision.HIGHEST
+
+# Division guard for normalizations (normals, axis vectors). Safe for both
+# f32 and f64 inputs: comfortably above denormals, far below any real
+# geometric magnitude in meters.
+EPS = 1e-12

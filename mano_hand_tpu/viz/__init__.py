@@ -1,0 +1,24 @@
+"""TPU-native visualization: a pure-JAX mesh rasterizer.
+
+The reference's visualization (C11, /root/reference/data_explore.py:1-18)
+depends on an external OpenGL viewer (vctoolkit + transforms3d) to render
+scan-pose animations to AVI. This subsystem replaces that with a
+dependency-free, jittable software renderer: camera transforms, a z-buffer
+triangle rasterizer with Lambert shading, and a pure-Python PNG/GIF writer
+— so `cli render` produces shaded hand images and animations on any host,
+and whole animation clips render as one batched XLA program on TPU.
+"""
+
+from mano_hand_tpu.viz.camera import Camera, look_at, view_rotation
+from mano_hand_tpu.viz.render import render_mesh, render_sequence
+from mano_hand_tpu.viz.png import write_png, write_gif
+
+__all__ = [
+    "Camera",
+    "look_at",
+    "view_rotation",
+    "render_mesh",
+    "render_sequence",
+    "write_png",
+    "write_gif",
+]

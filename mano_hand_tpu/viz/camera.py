@@ -1,0 +1,76 @@
+"""Camera model for the software rasterizer.
+
+The reference's demo applies a hand-built view rotation before rendering
+(/root/reference/data_explore.py:10,15 — a transforms3d axis-angle matrix).
+``view_rotation`` reproduces that role natively (via the same safe
+Rodrigues kernel the model uses); ``look_at`` + ``Camera`` give a proper
+pinhole projection for stills and turntables.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from mano_hand_tpu.ops.common import EPS
+from mano_hand_tpu.ops.rodrigues import rotation_matrix
+
+
+class Camera(NamedTuple):
+    """Pinhole camera: world -> view rotation R, translation t, focal.
+
+    ``project(v) = focal * (R @ v + t).xy / (R @ v + t).z`` in NDC units;
+    z after transform must be positive (camera looks down +z).
+    """
+
+    rot: jnp.ndarray     # [3, 3]
+    trans: jnp.ndarray   # [3]
+    focal: float = 1.0
+
+    def transform(self, verts: jnp.ndarray) -> jnp.ndarray:
+        """World verts [..., 3] -> view space [..., 3]."""
+        return verts @ self.rot.T + self.trans
+
+    def project(self, verts: jnp.ndarray) -> jnp.ndarray:
+        """World verts [..., 3] -> (x_ndc, y_ndc, depth) [..., 3]."""
+        v = self.transform(verts)
+        z = jnp.maximum(v[..., 2:3], EPS)
+        xy = self.focal * v[..., :2] / z
+        return jnp.concatenate([xy, v[..., 2:3]], axis=-1)
+
+
+def view_rotation(axis_angle: Sequence[float]) -> jnp.ndarray:
+    """Axis-angle view matrix, the rasterizer-side analogue of the demo's
+    transforms3d usage. Accepts a length-3 vector; angle = norm."""
+    aa = jnp.asarray(axis_angle, jnp.float32).reshape(3)
+    return rotation_matrix(aa.reshape(1, 3))[0]
+
+
+def look_at(
+    eye: Sequence[float],
+    target: Sequence[float] = (0.0, 0.0, 0.0),
+    up: Sequence[float] = (0.0, 1.0, 0.0),
+    focal: float = 1.2,
+) -> Camera:
+    """Camera at ``eye`` looking at ``target`` (numpy-side construction)."""
+    eye = np.asarray(eye, np.float64)
+    fwd = np.asarray(target, np.float64) - eye
+    fwd = fwd / max(np.linalg.norm(fwd), EPS)
+    right = np.cross(np.asarray(up, np.float64), fwd)
+    right = right / max(np.linalg.norm(right), EPS)
+    cam_up = np.cross(fwd, right)  # right-handed: right x up = fwd
+    rot = np.stack([right, cam_up, fwd])        # rows = camera axes, y = up
+    trans = -rot @ eye
+    return Camera(
+        rot=jnp.asarray(rot, jnp.float32),
+        trans=jnp.asarray(trans, jnp.float32),
+        focal=float(focal),
+    )
+
+
+def default_hand_camera(scale: float = 0.25) -> Camera:
+    """A framing that keeps a MANO hand (~0.2 m span near the origin) in
+    view: straight-on, slightly pulled back along -z."""
+    return look_at(eye=(0.0, 0.0, -3.0 * scale), focal=2.2)
