@@ -5,11 +5,14 @@ from mano_hand_tpu.fitting.objectives import (
     vertex_l2,
 )
 from mano_hand_tpu.fitting.solvers import FitResult, fit, fit_with_optimizer
+from mano_hand_tpu.fitting.lm import LMResult, fit_lm
 
 __all__ = [
     "FitResult",
     "fit",
     "fit_with_optimizer",
+    "LMResult",
+    "fit_lm",
     "vertex_l2",
     "joint_l2",
     "l2_prior",

@@ -1,0 +1,143 @@
+"""Levenberg-Marquardt pose/shape fitting (second-order inverse MANO).
+
+The reference has no fitting at all; BASELINE.json config 4 mandates
+gradient-based recovery, and first-order Adam (solvers.py) covers it. This
+module adds the solver of choice for small-parameter mesh fitting:
+damped Gauss-Newton over the ~58-dim (pose, shape) space.
+
+TPU-first shape of the problem: the residual Jacobian [V*3, P] comes from
+``jax.jacfwd`` (P forward-mode columns batched by XLA into one program),
+the normal matrix JtJ is a [P, P] MXU matmul, and the solve is a tiny
+Cholesky — all inside one ``lax.scan`` step with branch-free accept/reject
+damping (``jnp.where``, no host control flow). A batch of independent
+problems vmaps over the scan.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from mano_hand_tpu.assets.schema import ManoParams
+from mano_hand_tpu.models import core
+
+
+class LMResult(NamedTuple):
+    pose: jnp.ndarray          # [..., 16, 3] recovered axis-angle pose
+    shape: jnp.ndarray         # [..., S] recovered shape coefficients
+    final_loss: jnp.ndarray    # [...] final mean-squared vertex residual
+    loss_history: jnp.ndarray  # [..., n_steps]
+    damping_history: jnp.ndarray  # [..., n_steps] lambda per step
+
+
+def _fit_single(
+    params: ManoParams,
+    target_verts: jnp.ndarray,  # [V, 3]
+    *,
+    n_steps: int,
+    init_damping: float,
+    damping_up: float,
+    damping_down: float,
+    shape_weight: float,
+) -> LMResult:
+    dtype = params.v_template.dtype
+    n_joints = params.j_regressor.shape[0]
+    n_shape = params.shape_basis.shape[-1]
+
+    theta0 = {
+        "pose": jnp.zeros((n_joints, 3), dtype),
+        "shape": jnp.zeros((n_shape,), dtype),
+    }
+    flat0, unravel = ravel_pytree(theta0)
+    n_params = flat0.shape[0]
+    target = target_verts.reshape(-1)
+
+    def residual(flat):
+        p = unravel(flat)
+        out = core.forward(params, p["pose"], p["shape"])
+        res = out.verts.reshape(-1) - target
+        # Tikhonov rows keep beta near 0 when vertices underdetermine it.
+        # Always present (zero rows when the traced weight is 0, which is
+        # mathematically a no-op on JtJ/Jtr) so the residual shape — and
+        # therefore the jit cache key — is weight-independent.
+        return jnp.concatenate([res, shape_weight * p["shape"]])
+
+    def loss_of(flat):
+        r = residual(flat)
+        return (r * r).mean()
+
+    def step(carry, _):
+        flat, damping = carry
+        r = residual(flat)
+        jac = jax.jacfwd(residual)(flat)               # [R, P]
+        jtj = jnp.einsum(
+            "rp,rq->pq", jac, jac, precision=core.DEFAULT_PRECISION
+        )                                              # [P, P] (MXU)
+        jtr = jnp.einsum(
+            "rp,r->p", jac, r, precision=core.DEFAULT_PRECISION
+        )
+        a = jtj + damping * jnp.diag(jnp.diag(jtj)) \
+            + 1e-9 * jnp.eye(n_params, dtype=dtype)
+        delta = jax.scipy.linalg.cho_solve(
+            jax.scipy.linalg.cho_factor(a), jtr
+        )
+        candidate = flat - delta
+        old = (r * r).mean()
+        new = loss_of(candidate)
+        accept = new < old
+        flat = jnp.where(accept, candidate, flat)
+        damping = jnp.where(
+            accept, damping * damping_down, damping * damping_up
+        )
+        damping = jnp.clip(damping, 1e-10, 1e8)
+        return (flat, damping), (jnp.where(accept, new, old), damping)
+
+    (flat_fin, _), (history, dhist) = jax.lax.scan(
+        step, (flat0, jnp.asarray(init_damping, dtype)), None, length=n_steps
+    )
+    p_fin = unravel(flat_fin)
+    return LMResult(
+        pose=p_fin["pose"],
+        shape=p_fin["shape"],
+        final_loss=loss_of(flat_fin),
+        loss_history=history,
+        damping_history=dhist,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_steps",),
+)
+def fit_lm(
+    params: ManoParams,
+    target_verts: jnp.ndarray,  # [V, 3] or [B, V, 3]
+    n_steps: int = 30,
+    init_damping: float = 1e-3,
+    damping_up: float = 10.0,
+    damping_down: float = 0.3,
+    shape_weight: float = 0.0,
+) -> LMResult:
+    """Recover (pose, shape) by damped Gauss-Newton; batch via vmap.
+
+    Converges to numerical floor in tens of steps where Adam needs
+    hundreds — the preferred solver when targets are clean meshes. For
+    robust/prior-weighted energies use solvers.fit (first-order).
+    """
+    single = functools.partial(
+        _fit_single,
+        params,
+        n_steps=n_steps,
+        init_damping=init_damping,
+        damping_up=damping_up,
+        damping_down=damping_down,
+        shape_weight=shape_weight,
+    )
+    target_verts = jnp.asarray(target_verts, params.v_template.dtype)
+    if target_verts.ndim == 2:
+        return single(target_verts)
+    return jax.vmap(single)(target_verts)

@@ -4,7 +4,9 @@ from mano_hand_tpu.models.core import (
     forward,
     forward_batched,
     forward_chunked,
+    forward_fused,
     forward_pca,
+    fused_blend_bases,
     jit_forward,
     jit_forward_batched,
 )
@@ -16,7 +18,9 @@ __all__ = [
     "forward",
     "forward_batched",
     "forward_chunked",
+    "forward_fused",
     "forward_pca",
+    "fused_blend_bases",
     "jit_forward",
     "jit_forward_batched",
     "oracle",

@@ -73,7 +73,7 @@ def resample_poses(poses: np.ndarray, n_frames: int) -> np.ndarray:
 
     Linear interpolation of axis-angle vectors is exact for fixed axes and a
     good small-angle approximation otherwise — sufficient for retiming
-    scan-pose banks; use a quaternion path if long-arc accuracy matters.
+    scan-pose banks; ``resample_poses_slerp`` is the long-arc-exact path.
     """
     poses = np.asarray(poses)
     t = poses.shape[0]
@@ -84,3 +84,62 @@ def resample_poses(poses: np.ndarray, n_frames: int) -> np.ndarray:
     hi = np.minimum(lo + 1, t - 1)
     w = (src - lo).reshape((-1,) + (1,) * (poses.ndim - 1))
     return (1.0 - w) * poses[lo] + w * poses[hi]
+
+
+def _aa_to_quat(aa: np.ndarray) -> np.ndarray:
+    """Axis-angle [..., 3] -> unit quaternion [..., 4] (w, xyz)."""
+    angle = np.linalg.norm(aa, axis=-1, keepdims=True)
+    half = 0.5 * angle
+    # sin(x)/x via sinc (numpy sinc is sin(pi x)/(pi x)): exact limit at 0.
+    k = 0.5 * np.sinc(half / np.pi)
+    return np.concatenate([np.cos(half), aa * k], axis=-1)
+
+
+def _quat_to_aa(q: np.ndarray) -> np.ndarray:
+    """Unit quaternion [..., 4] -> axis-angle [..., 3], angle in [0, pi]."""
+    q = q * np.sign(np.where(q[..., :1] == 0, 1.0, q[..., :1]))  # w >= 0
+    w = np.clip(q[..., :1], -1.0, 1.0)
+    vec = q[..., 1:]
+    norm = np.linalg.norm(vec, axis=-1, keepdims=True)
+    angle = 2.0 * np.arctan2(norm, w)
+    scale = np.where(norm > 1e-12, angle / np.maximum(norm, 1e-12), 2.0)
+    # Near identity, q_vec ~= aa/2, so aa ~= 2*vec: the 2.0 fallback above.
+    return vec * scale
+
+
+def resample_poses_slerp(poses: np.ndarray, n_frames: int) -> np.ndarray:
+    """Retime an axis-angle track [T, J, 3] via per-joint quaternion slerp.
+
+    Exact on SO(3) geodesics for any arc length — the upgrade over
+    ``resample_poses`` when scan keyframes are sparse or rotations large.
+
+    Output is in CANONICAL axis-angle form: angle in [0, pi]. Inputs with
+    |aa| > pi denote the same rotation as their conjugate (2*pi - theta,
+    negated axis) and come back in that canonical form, so the track is
+    representation-preserving only for |aa| <= pi; rotations themselves
+    (and thus forward() output) are always preserved. Post-processing that
+    differentiates the raw axis-angle values (e.g. finite-difference
+    velocities) should canonicalize its input first.
+    """
+    poses = np.asarray(poses, np.float64)
+    t = poses.shape[0]
+    if t == n_frames:
+        return poses.copy()
+    q = _aa_to_quat(poses)                          # [T, J, 4]
+    src = np.linspace(0.0, t - 1.0, n_frames)
+    lo = np.floor(src).astype(int)
+    hi = np.minimum(lo + 1, t - 1)
+    w = (src - lo).reshape(-1, 1, 1)
+    qa, qb = q[lo], q[hi]                           # [N, J, 4]
+    # Shortest path: flip hemisphere where the pair straddles it.
+    dot = (qa * qb).sum(-1, keepdims=True)
+    qb = np.where(dot < 0, -qb, qb)
+    dot = np.clip(np.abs(dot), -1.0, 1.0)
+    theta = np.arccos(dot)
+    sin_theta = np.sin(theta)
+    small = sin_theta < 1e-6
+    wa = np.where(small, 1.0 - w, np.sin((1.0 - w) * theta) / np.where(small, 1.0, sin_theta))
+    wb = np.where(small, w, np.sin(w * theta) / np.where(small, 1.0, sin_theta))
+    out = wa * qa + wb * qb
+    out = out / np.linalg.norm(out, axis=-1, keepdims=True)
+    return _quat_to_aa(out)

@@ -108,6 +108,88 @@ def forward(
     )
 
 
+def fused_blend_bases(params: ManoParams, precision=DEFAULT_PRECISION):
+    """Per-asset derived tensors for the fused forward path.
+
+    Returns (vertex_basis [V*3, S+P], joint_template [J, 3],
+    joint_shape_basis [J, 3, S]). Exploits linearity: since joints are an
+    affine map of the shaped template (mano_np.py:81-83), Jreg can be
+    precomposed with the shape basis, and the shape + pose-corrective
+    blendshapes concatenate into ONE [V*3, S+P] matrix — a single
+    MXU-shaped matmul per eval instead of two skinny contractions. All
+    three are batch-invariant, so XLA hoists them out of vmapped programs.
+    """
+    v, _, s = params.shape_basis.shape
+    pdim = params.pose_basis.shape[-1]
+    vertex_basis = jnp.concatenate(
+        [
+            params.shape_basis.reshape(v * 3, s),
+            params.pose_basis.reshape(v * 3, pdim),
+        ],
+        axis=1,
+    )
+    joint_template = jnp.einsum(
+        "jv,vc->jc", params.j_regressor, params.v_template,
+        precision=precision,
+    )
+    joint_shape_basis = jnp.einsum(
+        "jv,vcs->jcs", params.j_regressor, params.shape_basis,
+        precision=precision,
+    )
+    return vertex_basis, joint_template, joint_shape_basis
+
+
+def forward_fused(
+    params: ManoParams,
+    pose: Optional[jnp.ndarray] = None,
+    shape: Optional[jnp.ndarray] = None,
+    precision=DEFAULT_PRECISION,
+) -> ManoOutput:
+    """Forward pass with fused blendshape/joint contractions.
+
+    Numerically equivalent to ``forward`` (exact in real arithmetic; within
+    f32 rounding in practice) with better MXU utilization: one
+    [S+P]-coefficient matmul drives all vertex displacement, and joint
+    regression shrinks to a [J,3,S]·[S] contraction.
+    """
+    n_joints = params.j_regressor.shape[0]
+    dtype = params.v_template.dtype
+    if pose is None:
+        pose = jnp.zeros((n_joints, 3), dtype=dtype)
+    if shape is None:
+        shape = jnp.zeros((params.shape_basis.shape[-1],), dtype=dtype)
+    pose = pose.reshape(n_joints, 3).astype(dtype)
+    shape = shape.astype(dtype)
+
+    vertex_basis, joint_template, joint_shape_basis = fused_blend_bases(
+        params, precision
+    )
+    rot_mats = ops.rotation_matrix(pose)
+    eye = jnp.eye(3, dtype=rot_mats.dtype)
+    coeff = jnp.concatenate([shape, (rot_mats[1:] - eye).reshape(-1)])
+    v_posed = (
+        params.v_template.reshape(-1)
+        + jnp.einsum("rk,k->r", vertex_basis, coeff, precision=precision)
+    ).reshape(-1, 3)
+    joints = joint_template + jnp.einsum(
+        "jcs,s->jc", joint_shape_basis, shape, precision=precision
+    )
+    world_rot, world_t = ops.forward_kinematics(
+        params.parents, rot_mats, joints, precision
+    )
+    skin_rot, skin_t = ops.skinning_transforms(
+        world_rot, world_t, joints, precision
+    )
+    verts = ops.skin(params.lbs_weights, skin_rot, skin_t, v_posed, precision)
+    return ManoOutput(
+        verts=verts,
+        joints=joints,
+        rest_verts=v_posed,
+        rot_mats=rot_mats,
+        posed_joints=world_t,
+    )
+
+
 def forward_pca(
     params: ManoParams,
     pca_coeffs: jnp.ndarray,
@@ -125,10 +207,17 @@ def forward_batched(
     pose: jnp.ndarray,   # [B, J, 3] or [B, J*3]
     shape: jnp.ndarray,  # [B, S]
     precision=DEFAULT_PRECISION,
+    fused: bool = True,
 ) -> ManoOutput:
-    """vmap over the batch axis; params replicated (closed over)."""
+    """vmap over the batch axis; params replicated (closed over).
+
+    Uses the fused-basis path by default (one [B, S+P] x [S+P, V*3] MXU
+    matmul across the batch); ``fused=False`` selects the
+    reference-structured staging for debugging/parity work.
+    """
+    fwd = forward_fused if fused else forward
     return jax.vmap(
-        lambda p, s: forward(params, p, s, precision)
+        lambda p, s: fwd(params, p, s, precision)
     )(pose, shape)
 
 

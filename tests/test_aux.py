@@ -165,3 +165,70 @@ def test_timer_and_time_jax_fn(params):
         iters=3, warmup=1,
     )
     assert stats["min_s"] <= stats["median_s"] <= stats["mean_s"] * 3
+
+
+# ------------------------------------------------------------- slerp resample
+def _rot_log(r):
+    """Rotation matrix -> axis-angle via the log map (test-side check)."""
+    angle = np.arccos(np.clip((np.trace(r) - 1.0) / 2.0, -1.0, 1.0))
+    if angle < 1e-12:
+        return np.zeros(3)
+    skew = (r - r.T) / (2.0 * np.sin(angle))
+    return angle * np.array([skew[2, 1], skew[0, 2], skew[1, 0]])
+
+
+def test_slerp_quat_roundtrip():
+    rng = np.random.default_rng(0)
+    aa = rng.normal(size=(50, 3))
+    aa = aa / np.linalg.norm(aa, axis=-1, keepdims=True) \
+        * rng.uniform(0, np.pi - 1e-3, size=(50, 1))
+    back = anim._quat_to_aa(anim._aa_to_quat(aa))
+    np.testing.assert_allclose(back, aa, atol=1e-10)
+
+
+def test_slerp_follows_geodesic():
+    from mano_hand_tpu.ops import rotation_matrix
+
+    # Two-keyframe track with a large-arc axis change; sample 5 frames.
+    aa0 = np.array([np.pi / 2, 0.0, 0.0])
+    aa1 = np.array([0.0, np.pi / 2, 0.0])
+    track = np.stack([aa0, aa1])[:, None, :]        # [T=2, J=1, 3]
+    out = anim.resample_poses_slerp(track, 5)[:, 0]  # [5, 3]
+    np.testing.assert_allclose(out[0], aa0, atol=1e-9)
+    np.testing.assert_allclose(out[-1], aa1, atol=1e-9)
+
+    def rot(aa):
+        return np.asarray(
+            rotation_matrix(jnp.asarray(aa, jnp.float32).reshape(1, 3))[0]
+        )
+
+    r0, r1 = rot(aa0), rot(aa1)
+    full = _rot_log(r0.T @ r1)
+    theta = np.linalg.norm(full)
+    axis = full / theta
+    for i, t in enumerate(np.linspace(0, 1, 5)):
+        rel = _rot_log(r0.T @ rot(out[i]))
+        # Constant relative axis, angle growing linearly: the geodesic.
+        np.testing.assert_allclose(rel, t * theta * axis, atol=1e-6)
+
+
+def test_slerp_matches_linear_for_small_angles():
+    rng = np.random.default_rng(1)
+    track = rng.normal(scale=0.05, size=(4, 16, 3))
+    lin = anim.resample_poses(track, 9)
+    slp = anim.resample_poses_slerp(track, 9)
+    assert np.abs(lin - slp).max() < 1e-3
+
+
+def test_slerp_canonicalizes_large_angles():
+    from mano_hand_tpu.ops import rotation_matrix
+
+    # |aa| > pi comes back as the canonical conjugate representation, but
+    # the ROTATION at the keyframe is preserved exactly.
+    aa = np.array([3.5, 0.0, 0.0])
+    track = np.stack([aa, np.zeros(3)])[:, None, :]
+    out = anim.resample_poses_slerp(track, 3)[:, 0]
+    assert np.linalg.norm(out[0]) <= np.pi + 1e-9  # canonical range
+    r_in = np.asarray(rotation_matrix(jnp.asarray(aa, jnp.float32).reshape(1, 3))[0])
+    r_out = np.asarray(rotation_matrix(jnp.asarray(out[0], jnp.float32).reshape(1, 3))[0])
+    np.testing.assert_allclose(r_in, r_out, atol=1e-6)

@@ -1,0 +1,58 @@
+"""Fused-basis forward path parity (models/core.py forward_fused)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mano_hand_tpu.models import core, oracle
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+def test_fused_matches_staged_and_oracle(params, params32):
+    rng = np.random.default_rng(5)
+    for i in range(4):
+        pose = rng.normal(scale=0.6, size=(16, 3)).astype(np.float32)
+        beta = rng.normal(size=10).astype(np.float32)
+        staged = core.forward(params32, jnp.asarray(pose), jnp.asarray(beta))
+        fused = core.forward_fused(
+            params32, jnp.asarray(pose), jnp.asarray(beta)
+        )
+        want = oracle.forward(params, pose=pose, shape=beta)
+        assert np.abs(np.asarray(fused.verts) - np.asarray(staged.verts)).max() < 1e-6
+        assert np.abs(np.asarray(fused.verts) - want.verts).max() < 1e-6
+        assert np.abs(np.asarray(fused.joints) - want.joints).max() < 1e-6
+        assert np.abs(np.asarray(fused.rest_verts) - want.rest_verts).max() < 1e-6
+
+
+def test_fused_default_args_give_rest_pose(params32):
+    fused = core.forward_fused(params32)
+    staged = core.forward(params32)
+    assert np.abs(np.asarray(fused.verts) - np.asarray(staged.verts)).max() < 1e-6
+
+
+def test_fused_gradients_match_staged(params32):
+    rng = np.random.default_rng(6)
+    pose = jnp.asarray(rng.normal(scale=0.3, size=(16, 3)), jnp.float32)
+    beta = jnp.asarray(rng.normal(size=10), jnp.float32)
+
+    def loss(fwd, q, b):
+        return fwd(params32, q, b).verts.sum()
+
+    g1 = jax.grad(loss, argnums=(1, 2))(core.forward, pose, beta)
+    g2 = jax.grad(loss, argnums=(1, 2))(core.forward_fused, pose, beta)
+    for a, b in zip(g1, g2):
+        assert np.abs(np.asarray(a) - np.asarray(b)).max() < 1e-4
+
+
+def test_forward_batched_fused_flag_parity(params32):
+    rng = np.random.default_rng(7)
+    pose = jnp.asarray(rng.normal(scale=0.4, size=(6, 16, 3)), jnp.float32)
+    beta = jnp.asarray(rng.normal(size=(6, 10)), jnp.float32)
+    on = core.forward_batched(params32, pose, beta, fused=True)
+    off = core.forward_batched(params32, pose, beta, fused=False)
+    assert np.abs(np.asarray(on.verts) - np.asarray(off.verts)).max() < 1e-6
