@@ -1,0 +1,21 @@
+"""Interop bridges to neighboring ecosystems.
+
+The reference is pure NumPy, but most downstream MANO users come from
+torch-based stacks (manopth/smplx); ``interop.torch_bridge`` gives them a
+zero-copy-where-possible on-ramp. ``interop.flax_bridge`` embeds the
+forward core in flax networks as a Module.
+"""
+
+from mano_hand_tpu.interop.torch_bridge import (
+    forward_from_torch,
+    params_from_torch,
+    to_torch,
+)
+from mano_hand_tpu.interop.flax_bridge import ManoLayer
+
+__all__ = [
+    "forward_from_torch",
+    "params_from_torch",
+    "to_torch",
+    "ManoLayer",
+]

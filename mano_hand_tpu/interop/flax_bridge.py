@@ -1,0 +1,69 @@
+"""Flax bridge: the MANO forward as a ``flax.linen`` Module.
+
+Embeds the hand model inside flax networks (e.g. an image encoder
+regressing (pose, shape) with a differentiable mesh head). The asset
+params ride as module constants — not trainable variables — so
+``Module.init`` carries no 10 MB of "weights"; optionally the shape
+coefficients can be learned as a variable (calibration use case).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mano_hand_tpu.assets.schema import ManoParams
+from mano_hand_tpu.models import core
+
+
+class ManoLayer(nn.Module):
+    """Differentiable MANO mesh head.
+
+    Attributes:
+      params: the (float32) ManoParams asset, a module constant.
+      use_pca: if True, ``__call__`` takes PCA coefficients [B, n<=45]
+        (+ optional global_rot [B, 3]); else absolute pose [B, 16, 3].
+      learn_shape: if True, beta is a trainable variable of the module
+        (shared across the batch — per-subject calibration); else it is an
+        input.
+
+    Returns verts [B, V, 3]; the full ManoOutput is available via
+    ``forward_full``.
+    """
+
+    params: ManoParams
+    use_pca: bool = False
+    learn_shape: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        pose: jnp.ndarray,
+        shape: Optional[jnp.ndarray] = None,
+        global_rot: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        return self.forward_full(pose, shape, global_rot).verts
+
+    @nn.compact
+    def forward_full(
+        self,
+        pose: jnp.ndarray,
+        shape: Optional[jnp.ndarray] = None,
+        global_rot: Optional[jnp.ndarray] = None,
+    ):
+        n_shape = self.params.shape_basis.shape[-1]
+        batch = pose.shape[0]
+        if self.learn_shape:
+            beta = self.param(
+                "beta", nn.initializers.zeros, (n_shape,), jnp.float32
+            )
+            shape = jnp.broadcast_to(beta, (batch, n_shape))
+        elif shape is None:
+            shape = jnp.zeros((batch, n_shape), jnp.float32)
+        if self.use_pca:
+            full_pose = core.decode_pca(self.params, pose, global_rot)
+        else:
+            full_pose = pose
+        return core.forward_batched(self.params, full_pose, shape)

@@ -1,0 +1,169 @@
+"""PyTorch bridge: run the TPU forward from/to torch tensors.
+
+For users migrating from torch MANO stacks (manopth, smplx): keep their
+torch data pipeline, swap the model evaluation. Conversion goes through
+NumPy (zero-copy for CPU torch tensors via ``.numpy()`` /
+``torch.from_numpy``); gradients do NOT flow across the bridge — use the
+JAX core end-to-end (fitting/) when optimizing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+
+from mano_hand_tpu.assets.schema import ManoParams
+from mano_hand_tpu.models import core
+
+
+def _torch():
+    try:
+        import torch
+    except ImportError as e:  # pragma: no cover - torch is in this image
+        raise ImportError("interop.torch_bridge requires torch") from e
+    return torch
+
+
+def _to_np(x) -> np.ndarray:
+    torch = _torch()
+    if isinstance(x, torch.Tensor):
+        return x.detach().cpu().numpy()
+    if hasattr(x, "toarray"):  # scipy sparse (official-pickle J_regressor)
+        return np.asarray(x.toarray())
+    return np.asarray(x)
+
+
+def to_torch(tree: Any):
+    """jax/numpy array, ManoOutput, or any NamedTuple/dataclass -> torch.
+
+    Leaves become CPU torch tensors (sharing memory when the source is a
+    NumPy-backed array).
+    """
+    torch = _torch()
+    if hasattr(tree, "_asdict"):  # NamedTuple (e.g. ManoOutput)
+        return type(tree)(*(to_torch(v) for v in tree))
+    if dataclasses.is_dataclass(tree):
+        return {
+            f.name: to_torch(getattr(tree, f.name))
+            for f in dataclasses.fields(tree)
+        }
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(to_torch(v) for v in tree)
+    if isinstance(tree, dict):
+        return {k: to_torch(v) for k, v in tree.items()}
+    if isinstance(tree, (str, type(None), int, float)):
+        return tree
+    arr = np.ascontiguousarray(np.asarray(tree))
+    if not arr.flags.writeable:
+        # jax.Array views are read-only; torch.from_numpy would warn about
+        # (and allow) writes into them. Copy for a clean owning tensor.
+        arr = arr.copy()
+    return torch.from_numpy(arr)
+
+
+def params_from_torch(
+    tensors: dict,
+    side: str = "right",
+    dtype=np.float32,
+) -> ManoParams:
+    """Build ManoParams from a dict of torch tensors / arrays.
+
+    Accepts this package's key names (schema.py) and the common torch-stack
+    aliases (smplx/manopth naming): v_template, shapedirs->shape_basis,
+    posedirs->pose_basis ([V,3,135] or transposed [135, V*3]),
+    J_regressor->j_regressor, lbs_weights/weights, faces, parents
+    (kintree_table's parent row also accepted), hands_components/
+    hands_mean -> pca basis/mean.
+    """
+    t = {k: _to_np(v) for k, v in tensors.items()}
+
+    def pick(*names):
+        for n in names:
+            if n in t:
+                return t[n]
+        return None
+
+    v_template = pick("v_template", "mesh_template")
+    if v_template is None:
+        raise ValueError("params dict needs v_template")
+    n_verts = v_template.shape[0]
+
+    pose_basis = pick("pose_basis", "posedirs", "mesh_pose_basis")
+    if pose_basis is not None and pose_basis.ndim == 2:
+        # torch-stack layout: [P, V*3] (flattened, transposed)
+        pose_basis = pose_basis.T.reshape(n_verts, 3, -1)
+
+    parents = pick("parents")
+    if parents is None and "kintree_table" in t:
+        parents = t["kintree_table"][0]
+    # Root encodings seen in the wild: None, -1, or uint32(-1); schema wants
+    # -1 and a hashable tuple (parents are static aux data under jit).
+    parents = tuple(
+        -1 if (p is None or int(p) < 0 or int(p) >= 2**31 - 1) else int(p)
+        for p in np.asarray(parents, dtype=object).reshape(-1)
+    )
+
+    j_regressor = pick("j_regressor", "J_regressor")
+
+    shape_basis = pick("shape_basis", "shapedirs", "mesh_shape_basis")
+    # PCA space covers the articulated joints' axis-angles: 3*(J-1) dims.
+    n_pca = 3 * (j_regressor.shape[0] - 1)
+    pca_basis = pick("pca_basis", "hands_components", "pose_pca_basis")
+    if pca_basis is None:
+        pca_basis = np.eye(n_pca)
+    pca_mean = pick("pca_mean", "hands_mean", "pose_pca_mean")
+    if pca_mean is None:
+        pca_mean = np.zeros(pca_basis.shape[1])
+
+    return ManoParams(
+        v_template=np.asarray(v_template, dtype),
+        shape_basis=np.asarray(shape_basis, dtype),
+        pose_basis=np.asarray(pose_basis, dtype),
+        j_regressor=np.asarray(j_regressor, dtype),
+        lbs_weights=np.asarray(pick("lbs_weights", "weights",
+                                    "skinning_weights"), dtype),
+        pca_basis=np.asarray(pca_basis, dtype),
+        pca_mean=np.asarray(pca_mean, dtype),
+        faces=np.asarray(pick("faces", "f"), np.int32),
+        parents=parents,
+        side=side,
+    )
+
+
+def forward_from_torch(
+    params: ManoParams,
+    pose,                      # torch [B?, 16, 3] or [B?, 48]
+    shape: Optional[Any] = None,  # torch [B?, S]
+):
+    """Evaluate the JAX core on torch inputs; outputs as torch tensors.
+
+    Unbatched or batched; ManoOutput fields come back as CPU torch tensors.
+    """
+    import jax.numpy as jnp
+
+    pose_np = _to_np(pose).astype(np.float32)
+    batched = pose_np.ndim == 3 or (
+        pose_np.ndim == 2 and pose_np.shape[-1] != 3
+    )
+    n_joints = params.j_regressor.shape[0]
+    n_shape = params.shape_basis.shape[-1]
+    if shape is None:
+        shape_np = np.zeros(
+            (pose_np.shape[0], n_shape) if batched else (n_shape,),
+            np.float32,
+        )
+    else:
+        shape_np = _to_np(shape).astype(np.float32)
+    if batched:
+        pose_np = pose_np.reshape(pose_np.shape[0], n_joints, 3)
+        out = core.jit_forward_batched(
+            params, jnp.asarray(pose_np), jnp.asarray(shape_np)
+        )
+    else:
+        out = core.jit_forward(
+            params, jnp.asarray(pose_np.reshape(n_joints, 3)),
+            jnp.asarray(shape_np),
+        )
+    return to_torch(out)

@@ -124,7 +124,9 @@ def resample_poses_slerp(poses: np.ndarray, n_frames: int) -> np.ndarray:
     poses = np.asarray(poses, np.float64)
     t = poses.shape[0]
     if t == n_frames:
-        return poses.copy()
+        # Still canonicalize (quat round-trip) so the output representation
+        # is n_frames-independent.
+        return _quat_to_aa(_aa_to_quat(poses))
     q = _aa_to_quat(poses)                          # [T, J, 4]
     src = np.linspace(0.0, t - 1.0, n_frames)
     lo = np.floor(src).astype(int)

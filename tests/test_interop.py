@@ -1,0 +1,167 @@
+"""torch and flax interop bridges (mano_hand_tpu/interop/)."""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from mano_hand_tpu.assets.schema import ARRAY_FIELDS, validate
+from mano_hand_tpu.interop import (
+    ManoLayer, forward_from_torch, params_from_torch, to_torch,
+)
+from mano_hand_tpu.models import core
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+def test_to_torch_output(params32):
+    out = core.jit_forward(params32, jnp.zeros((16, 3)), jnp.zeros(10))
+    t = to_torch(out)
+    assert isinstance(t.verts, torch.Tensor)
+    assert t.verts.shape == (778, 3)
+    np.testing.assert_allclose(t.verts.numpy(), np.asarray(out.verts))
+
+
+def test_params_from_torch_native_names(params32):
+    tensors = {
+        f: torch.from_numpy(np.asarray(getattr(params32, f)))
+        for f in ARRAY_FIELDS
+    }
+    tensors["parents"] = np.asarray(params32.parents)
+    rebuilt = validate(params_from_torch(tensors, side=params32.side))
+    for f in ARRAY_FIELDS:
+        np.testing.assert_allclose(
+            np.asarray(getattr(rebuilt, f)),
+            np.asarray(getattr(params32, f)),
+        )
+    assert rebuilt.parents == params32.parents
+
+
+def test_params_from_torch_smplx_names(params32):
+    v = params32.n_verts
+    # torch-stack conventions: posedirs [P, V*3], kintree_table, uint32 root.
+    kintree = np.asarray(params32.parents, np.int64)
+    kintree[0] = np.iinfo(np.uint32).max
+    tensors = {
+        "v_template": torch.from_numpy(np.asarray(params32.v_template)),
+        "shapedirs": torch.from_numpy(np.asarray(params32.shape_basis)),
+        "posedirs": torch.from_numpy(
+            np.asarray(params32.pose_basis).reshape(v * 3, -1).T.copy()
+        ),
+        "J_regressor": torch.from_numpy(np.asarray(params32.j_regressor)),
+        "weights": torch.from_numpy(np.asarray(params32.lbs_weights)),
+        "hands_components": torch.from_numpy(np.asarray(params32.pca_basis)),
+        "hands_mean": torch.from_numpy(np.asarray(params32.pca_mean)),
+        "f": np.asarray(params32.faces),
+        "kintree_table": np.stack([kintree, np.arange(16)]),
+    }
+    rebuilt = validate(params_from_torch(tensors))
+    np.testing.assert_allclose(
+        np.asarray(rebuilt.pose_basis), np.asarray(params32.pose_basis)
+    )
+    assert rebuilt.parents[0] == -1
+
+
+def test_forward_from_torch_matches_core(params32):
+    rng = np.random.default_rng(0)
+    pose = rng.normal(scale=0.4, size=(3, 16, 3)).astype(np.float32)
+    beta = rng.normal(size=(3, 10)).astype(np.float32)
+    out = forward_from_torch(
+        params32, torch.from_numpy(pose), torch.from_numpy(beta)
+    )
+    want = core.jit_forward_batched(
+        params32, jnp.asarray(pose), jnp.asarray(beta)
+    )
+    assert isinstance(out.verts, torch.Tensor)
+    np.testing.assert_allclose(
+        out.verts.numpy(), np.asarray(want.verts), atol=1e-6
+    )
+    # Unbatched and flattened-pose forms work too.
+    single = forward_from_torch(params32, torch.from_numpy(pose[0]))
+    assert single.verts.shape == (778, 3)
+    flat = forward_from_torch(
+        params32, torch.from_numpy(pose.reshape(3, 48)),
+        torch.from_numpy(beta),
+    )
+    np.testing.assert_allclose(
+        flat.verts.numpy(), out.verts.numpy(), atol=1e-6
+    )
+
+
+def test_flax_layer_forward_and_grads(params32):
+    layer = ManoLayer(params=params32)
+    rng = np.random.default_rng(1)
+    pose = jnp.asarray(rng.normal(scale=0.3, size=(2, 16, 3)), jnp.float32)
+    variables = layer.init(jax.random.key(0), pose)
+    verts = layer.apply(variables, pose)
+    want = core.forward_batched(params32, pose, jnp.zeros((2, 10)))
+    np.testing.assert_allclose(
+        np.asarray(verts), np.asarray(want.verts), atol=1e-6
+    )
+    # Gradients flow through to the pose input (mesh-head use case).
+    g = jax.grad(lambda p: layer.apply(variables, p).sum())(pose)
+    assert np.isfinite(np.asarray(g)).all() and np.abs(np.asarray(g)).max() > 0
+
+
+def test_flax_layer_learned_shape(params32):
+    layer = ManoLayer(params=params32, learn_shape=True)
+    pose = jnp.zeros((2, 16, 3))
+    variables = layer.init(jax.random.key(0), pose)
+    assert variables["params"]["beta"].shape == (10,)
+
+    # The learned beta is trainable: its gradient against a shaped target
+    # is non-zero.
+    target = core.forward_batched(
+        params32, pose, jnp.ones((2, 10)) * 0.5
+    ).verts
+
+    def loss(v):
+        return ((layer.apply(v, pose) - target) ** 2).mean()
+
+    g = jax.grad(loss)(variables)
+    assert np.abs(np.asarray(g["params"]["beta"])).max() > 0
+
+
+def test_flax_layer_pca_input(params32):
+    layer = ManoLayer(params=params32, use_pca=True)
+    rng = np.random.default_rng(2)
+    pca = jnp.asarray(rng.normal(size=(2, 9)), jnp.float32)
+    rot = jnp.asarray(rng.normal(size=(2, 3)), jnp.float32)
+    variables = layer.init(jax.random.key(0), pca, None, rot)
+    verts = layer.apply(variables, pca, None, rot)
+    full = core.decode_pca(params32, pca, rot)
+    want = core.forward_batched(params32, full, jnp.zeros((2, 10)))
+    np.testing.assert_allclose(
+        np.asarray(verts), np.asarray(want.verts), atol=1e-6
+    )
+
+
+def test_params_from_torch_sparse_jregressor(params32):
+    scipy_sparse = pytest.importorskip("scipy.sparse")
+    tensors = {
+        f: np.asarray(getattr(params32, f)) for f in ARRAY_FIELDS
+    }
+    tensors["j_regressor"] = scipy_sparse.csc_matrix(tensors["j_regressor"])
+    tensors["parents"] = np.asarray(params32.parents)
+    rebuilt = validate(params_from_torch(tensors, side=params32.side))
+    np.testing.assert_allclose(
+        np.asarray(rebuilt.j_regressor), np.asarray(params32.j_regressor)
+    )
+
+
+def test_params_from_torch_missing_pca_defaults(params32):
+    tensors = {
+        f: np.asarray(getattr(params32, f)) for f in ARRAY_FIELDS
+        if f not in ("pca_basis", "pca_mean")
+    }
+    tensors["parents"] = np.asarray(params32.parents)
+    rebuilt = validate(params_from_torch(tensors, side=params32.side))
+    assert rebuilt.pca_basis.shape == (45, 45)
+    np.testing.assert_allclose(rebuilt.pca_basis, np.eye(45))
