@@ -4,6 +4,9 @@ The reference is pure NumPy, but most downstream MANO users come from
 torch-based stacks (manopth/smplx); ``interop.torch_bridge`` gives them a
 zero-copy-where-possible on-ramp. ``interop.flax_bridge`` embeds the
 forward core in flax networks as a Module.
+
+Bridges import lazily so a torch-only environment never needs flax and
+vice versa.
 """
 
 from mano_hand_tpu.interop.torch_bridge import (
@@ -11,7 +14,6 @@ from mano_hand_tpu.interop.torch_bridge import (
     params_from_torch,
     to_torch,
 )
-from mano_hand_tpu.interop.flax_bridge import ManoLayer
 
 __all__ = [
     "forward_from_torch",
@@ -19,3 +21,11 @@ __all__ = [
     "to_torch",
     "ManoLayer",
 ]
+
+
+def __getattr__(name):
+    if name == "ManoLayer":
+        from mano_hand_tpu.interop.flax_bridge import ManoLayer
+
+        return ManoLayer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -85,9 +85,27 @@ def params_from_torch(
                 return t[n]
         return None
 
+    required = {
+        "v_template": ("v_template", "mesh_template"),
+        "shape_basis": ("shape_basis", "shapedirs", "mesh_shape_basis"),
+        "pose_basis": ("pose_basis", "posedirs", "mesh_pose_basis"),
+        "j_regressor": ("j_regressor", "J_regressor"),
+        "lbs_weights": ("lbs_weights", "weights", "skinning_weights"),
+        "faces": ("faces", "f"),
+        "parents": ("parents", "kintree_table"),
+    }
+    missing = [
+        canonical for canonical, aliases in required.items()
+        if pick(*aliases) is None
+    ]
+    if missing:
+        raise ValueError(
+            f"params dict is missing required keys: {missing} "
+            f"(accepted aliases: "
+            f"{ {k: v for k, v in required.items() if k in missing} })"
+        )
+
     v_template = pick("v_template", "mesh_template")
-    if v_template is None:
-        raise ValueError("params dict needs v_template")
     n_verts = v_template.shape[0]
 
     pose_basis = pick("pose_basis", "posedirs", "mesh_pose_basis")
@@ -117,7 +135,9 @@ def params_from_torch(
     if pca_mean is None:
         pca_mean = np.zeros(pca_basis.shape[1])
 
-    return ManoParams(
+    from mano_hand_tpu.assets.schema import validate
+
+    return validate(ManoParams(
         v_template=np.asarray(v_template, dtype),
         shape_basis=np.asarray(shape_basis, dtype),
         pose_basis=np.asarray(pose_basis, dtype),
@@ -129,7 +149,7 @@ def params_from_torch(
         faces=np.asarray(pick("faces", "f"), np.int32),
         parents=parents,
         side=side,
-    )
+    ))
 
 
 def forward_from_torch(

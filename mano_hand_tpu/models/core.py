@@ -234,8 +234,9 @@ def forward_batched_pallas(
 
     The pre-skinning stages (blendshapes, Rodrigues, FK) are the vmapped
     XLA path; skinning runs in one Pallas kernel that keeps the per-vertex
-    blended rotations in VMEM (see ops/pallas_lbs.py). Forward-only — use
-    ``forward_batched`` for gradients.
+    blended rotations in VMEM (see ops/pallas_lbs.py). Differentiable:
+    skinning carries a custom VJP whose vertex cotangent reuses the same
+    kernel, so jax.grad works end-to-end through this path.
     """
     from mano_hand_tpu.ops import pallas_lbs
 
@@ -259,9 +260,10 @@ def forward_batched_pallas(
     dtype = params.v_template.dtype
     pose = pose.reshape(pose.shape[0], -1, 3).astype(dtype)
     skin_rot, skin_t, v_posed = jax.vmap(pre)(pose, shape.astype(dtype))
-    return pallas_lbs.skin_batched(
+    # Positional call: custom_vjp functions reject keyword arguments.
+    return pallas_lbs.skin_batched_ad(
         params.lbs_weights, skin_rot, skin_t, v_posed,
-        block_b=block_b, block_v=block_v, interpret=interpret,
+        block_b, block_v, interpret,
     )
 
 

@@ -14,9 +14,13 @@ Layout is lane-friendly: vertices ride the 128-wide lane dimension, the tiny
 3/9/16-sized axes sit on sublanes. Inputs are transposed at the JAX level
 (XLA fuses the transposes into the surrounding pads/copies).
 
-Forward-only: the fitting path keeps the differentiable einsum LBS; this
-kernel targets inference/bench throughput. Numerics: f32 accumulate via
-preferred_element_type (matches Precision.HIGHEST on the einsum path).
+``skin_batched`` is the raw forward kernel; ``skin_batched_ad`` wraps it in
+a custom VJP so the Pallas path composes with jax.grad. The backward pass
+reuses the SAME kernel for the vertex cotangent (LBS is linear in v_posed
+with blended matrix M, so dL/dvp = M^T g — i.e. the forward kernel with
+transposed rotations and zero translations), and small einsums for the
+per-joint cotangents. Numerics: f32 accumulate via preferred_element_type
+(matches Precision.HIGHEST on the einsum path).
 """
 
 from __future__ import annotations
@@ -109,3 +113,60 @@ def skin_batched(
         interpret=interpret,
     )(wt, rt, tt, vpt)
     return out[:b].transpose(0, 2, 1)[:, :v]
+
+
+# ---------------------------------------------------------------- custom VJP
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def skin_batched_ad(
+    weights, world_rot, skin_t, v_posed,
+    block_b: int = 32, block_v: int = 128, interpret: bool = False,
+):
+    """Differentiable fused LBS: Pallas forward, composed VJP backward."""
+    return skin_batched(
+        weights, world_rot, skin_t, v_posed,
+        block_b=block_b, block_v=block_v, interpret=interpret,
+    )
+
+
+def _skin_fwd(weights, world_rot, skin_t, v_posed,
+              block_b, block_v, interpret):
+    out = skin_batched(
+        weights, world_rot, skin_t, v_posed,
+        block_b=block_b, block_v=block_v, interpret=interpret,
+    )
+    return out, (weights, world_rot, skin_t, v_posed)
+
+
+def _skin_bwd(block_b, block_v, interpret, residuals, g):
+    weights, world_rot, skin_t, v_posed = residuals
+    g = g.astype(jnp.float32)
+    hi = jax.lax.Precision.HIGHEST
+    # dL/dvp[b,v,c] = sum_j w[v,j] sum_a R[b,j,a,c] g[b,v,a]: the forward
+    # kernel applied to g with R transposed and t = 0.
+    grad_vp = skin_batched(
+        weights, world_rot.transpose(0, 1, 3, 2),
+        jnp.zeros_like(skin_t), g,
+        block_b=block_b, block_v=block_v, interpret=interpret,
+    )
+    # The largest backward intermediate is outer [B, V, 3, 3] (9BV floats,
+    # shared by grad_rot and grad_w) — the same bound as the einsum path's
+    # autodiff, with no [B, V, J, *] tensor anywhere. Fitting-scale batches
+    # are the intended consumers of this gradient.
+    outer = g[..., :, None] * v_posed[..., None, :]        # [B, V, 3, 3]
+    grad_rot = jnp.einsum("vj,bvac->bjac", weights, outer, precision=hi)
+    grad_t = jnp.einsum("vj,bva->bja", weights, g, precision=hi)
+    # dL/dw[v,j] = sum_{b,a,c} outer[b,v,a,c] R[b,j,a,c]
+    #           + sum_{b,a} g[b,v,a] t[b,j,a]
+    grad_w = (
+        jnp.einsum("bvac,bjac->vj", outer, world_rot, precision=hi)
+        + jnp.einsum("bva,bja->vj", g, skin_t, precision=hi)
+    )
+    return (
+        grad_w.astype(weights.dtype),
+        grad_rot.astype(world_rot.dtype),
+        grad_t.astype(skin_t.dtype),
+        grad_vp.astype(v_posed.dtype),
+    )
+
+
+skin_batched_ad.defvjp(_skin_fwd, _skin_bwd)

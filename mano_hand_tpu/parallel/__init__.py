@@ -14,8 +14,10 @@ from mano_hand_tpu.parallel.sharding import (
     shard_params,
 )
 from mano_hand_tpu.parallel.fit import FitState, init_state, make_fit_step
+from mano_hand_tpu.parallel import multihost
 
 __all__ = [
+    "multihost",
     "DATA_AXIS",
     "MODEL_AXIS",
     "make_mesh",

@@ -1,0 +1,123 @@
+"""Multi-host (multi-process) execution helpers.
+
+The reference has no distributed machinery at all (SURVEY.md §2.2); the
+TPU-native equivalent of a NCCL/MPI backend is JAX's built-in runtime:
+``jax.distributed`` bootstraps the process group over DCN, meshes span all
+hosts' devices, and XLA inserts the collectives (the forward's only one is
+the joint-regression psum, which rides ICI within a slice).
+
+Everything here degrades to a no-op single-process setup in CI — the same
+code path runs on one host with a virtual device count and on a v5e pod
+slice, which is what makes it testable without a cluster.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mano_hand_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Bootstrap the JAX process group; True if multi-process.
+
+    On TPU pods all arguments come from the environment and may be omitted
+    (`jax.distributed.initialize()`); pass them explicitly for CPU/GPU
+    clusters. Safe to call in single-process runs: does nothing when no
+    coordinator is configured and none is discoverable.
+    """
+    already = getattr(initialize, "_done", False)
+    if already:
+        return jax.process_count() > 1
+    # Do NOT touch jax.process_count()/jax.devices() before deciding:
+    # querying them initializes the backend, after which distributed init
+    # is impossible ("must be called before any JAX computation").
+    if coordinator_address is None and num_processes is None:
+        try:
+            # Pod environments self-describe (TPU metadata, SLURM, etc.);
+            # jax raises when no cluster environment is discoverable.
+            jax.distributed.initialize()
+        except (ValueError, RuntimeError):
+            initialize._done = True  # single host (CI, laptop)
+            return False
+    else:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    initialize._done = True
+    return jax.process_count() > 1
+
+
+def global_mesh(
+    data: Optional[int] = None,
+    model: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """A ('data', 'model') mesh over every device of every process.
+
+    Defaults to all-data-parallel over the global device count. The
+    'model' (tensor-parallel) axis should stay within a host/ICI domain on
+    real pods — keep ``model`` a divisor of the per-host device count so
+    the vertex-sharded all-reduce never crosses DCN.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if data is None:
+        if n % model:
+            raise ValueError(f"model={model} must divide device count {n}")
+        data = n // model
+    if data * model != n:
+        raise ValueError(f"mesh {data}x{model} != {n} devices")
+    arr = np.asarray(devices).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def process_local_slice(global_batch: int, mesh: Mesh) -> slice:
+    """The [start, stop) rows of a global batch this process should load.
+
+    Row-major over the 'data' axis: each process feeds its own addressable
+    shard — the host-side analogue of a distributed sampler.
+    """
+    n_proc = jax.process_count()
+    n_data = mesh.shape[DATA_AXIS]
+    if global_batch % n_data:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by the mesh's "
+            f"data axis ({n_data})"
+        )
+    if global_batch % n_proc:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by "
+            f"{n_proc} processes"
+        )
+    per = global_batch // n_proc
+    pid = jax.process_index()
+    return slice(pid * per, (pid + 1) * per)
+
+
+def global_batch_array(local_rows: np.ndarray, mesh: Mesh) -> jax.Array:
+    """Assemble a data-sharded global array from per-process local rows.
+
+    ``local_rows`` is this process's slice (see ``process_local_slice``);
+    the result is a global jax.Array sharded over 'data', usable directly
+    by the sharded forward/fit programs. Single-process: equivalent to
+    ``jax.device_put`` with the batch sharding.
+    """
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    global_shape = (
+        local_rows.shape[0] * jax.process_count(),
+        *local_rows.shape[1:],
+    )
+    return jax.make_array_from_process_local_data(
+        sharding, np.ascontiguousarray(local_rows), global_shape
+    )

@@ -165,3 +165,9 @@ def test_params_from_torch_missing_pca_defaults(params32):
     rebuilt = validate(params_from_torch(tensors, side=params32.side))
     assert rebuilt.pca_basis.shape == (45, 45)
     np.testing.assert_allclose(rebuilt.pca_basis, np.eye(45))
+
+
+def test_params_from_torch_missing_required_keys(params32):
+    tensors = {"v_template": np.asarray(params32.v_template)}
+    with pytest.raises(ValueError, match="missing required keys"):
+        params_from_torch(tensors)

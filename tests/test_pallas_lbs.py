@@ -52,3 +52,38 @@ def test_forward_batched_pallas_parity(params32):
     got = core.forward_batched_pallas(params32, pose, beta, interpret=True)
     want = core.forward_batched(params32, pose, beta).verts
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_skin_batched_ad_gradient_parity():
+    weights, rot, t, vp = rand_skin_inputs(seed=11, b=3)
+
+    def loss_pallas(w_, r_, t_, v_):
+        return (pallas_lbs.skin_batched_ad(w_, r_, t_, v_, 32, 128, True) ** 2).sum()
+
+    def loss_einsum(w_, r_, t_, v_):
+        return (
+            jax.vmap(lambda r, tt, v: lbs.skin(w_, r, tt, v))(r_, t_, v_) ** 2
+        ).sum()
+
+    args = tuple(jnp.asarray(x) for x in (weights, rot, t, vp))
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2, 3))(*args)
+    ge = jax.grad(loss_einsum, argnums=(0, 1, 2, 3))(*args)
+    for a, b in zip(gp, ge):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_forward_batched_pallas_is_differentiable(params32):
+    rng = np.random.default_rng(12)
+    pose = jnp.asarray(rng.normal(scale=0.3, size=(3, 16, 3)), jnp.float32)
+    beta = jnp.asarray(rng.normal(size=(3, 10)), jnp.float32)
+    g_pallas = jax.grad(
+        lambda q: core.forward_batched_pallas(
+            params32, q, beta, interpret=True
+        ).sum()
+    )(pose)
+    g_einsum = jax.grad(
+        lambda q: core.forward_batched(params32, q, beta).verts.sum()
+    )(pose)
+    np.testing.assert_allclose(
+        np.asarray(g_pallas), np.asarray(g_einsum), atol=1e-4
+    )
