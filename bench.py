@@ -162,6 +162,24 @@ def main() -> int:
     results["config3_b65536_evals_per_sec"] = b3 / t3
     log(f"config3 batch={b3} L+R: {b3 / t3:,.0f} evals/s ({t3 * 1e3:.1f} ms)")
 
+    # -- config 3b: same workload through the Pallas fused-skinning kernel --
+    def interleaved_pallas(prm_pair, p, s):
+        pl_, pr_ = prm_pair
+        vl = core.forward_batched_pallas(pl_, p[:half], s[:half])
+        vr = core.forward_batched_pallas(pr_, p[half:], s[half:])
+        return vl.sum() + vr.sum()
+
+    try:
+        fwd3p = loop_scalar(interleaved_pallas)
+        t3p = slope_time(
+            lambda m: looped(fwd3p, m, (left, right), pose3, beta3),
+            1, 3, iters=max(3, args.iters // 3),
+        )
+        results["config3_pallas_evals_per_sec"] = b3 / t3p
+        log(f"config3 pallas: {b3 / t3p:,.0f} evals/s ({t3p * 1e3:.1f} ms)")
+    except Exception as e:  # no TPU (CPU run) or kernel regression
+        log(f"config3 pallas path skipped: {type(e).__name__}: {e}")
+
     # -- config 4: pose fitting batch=256 -----------------------------------
     if not args.skip_fit:
         b4 = 256

@@ -13,6 +13,8 @@ Subcommands cover the reference's executable entry points (SURVEY.md §3):
   render   — rasterize a pose (or pose sequence) to PNG frames / an
              animated GIF with the built-in JAX renderer, replacing the
              reference's external OpenGL viewer dependency
+  fit      — recover pose/shape from target vertices (.npy) by Adam or
+             Levenberg-Marquardt; writes a .npz checkpoint
   info     — print an asset's schema summary
 
 Run as ``python -m mano_hand_tpu.cli <subcommand>``.
@@ -148,6 +150,45 @@ def cmd_render(args) -> int:
     return 0
 
 
+def cmd_fit(args) -> int:
+    import jax
+
+    from mano_hand_tpu import fitting
+    from mano_hand_tpu.io.checkpoints import save_fit_result
+
+    params = _load_params(args.asset, args.side).astype(np.float32)
+    targets = np.load(args.targets)  # [V, 3] or [B, V, 3]
+    if targets.ndim not in (2, 3) or targets.shape[-2:] != (
+        params.n_verts, 3
+    ):
+        print(
+            f"targets must be [{params.n_verts}, 3] or "
+            f"[B, {params.n_verts}, 3], got {targets.shape}",
+            file=sys.stderr,
+        )
+        return 2
+    steps = (
+        args.steps if args.steps is not None
+        else (25 if args.solver == "lm" else 200)
+    )
+    if args.solver == "lm":
+        if args.lr is not None:
+            print("note: --lr only applies to --solver adam; ignored",
+                  file=sys.stderr)
+        res = fitting.fit_lm(params, targets, n_steps=steps)
+    else:
+        res = fitting.fit(
+            params, targets, n_steps=steps,
+            lr=0.05 if args.lr is None else args.lr,
+        )
+    jax.block_until_ready(res.pose)
+    path = save_fit_result(res, args.out)
+    final = float(np.max(np.asarray(res.final_loss)))
+    print(f"fit ({args.solver}, {steps} steps) -> {path} "
+          f"(worst final loss {final:.3e})")
+    return 0
+
+
 def cmd_info(args) -> int:
     params = _load_params(args.asset, args.side)
     info = {
@@ -198,6 +239,18 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--size", type=int, default=256)
     r.add_argument("--fps", type=int, default=20)
     r.set_defaults(fn=cmd_render)
+
+    f = sub.add_parser("fit", help="recover pose/shape from target verts")
+    f.add_argument("targets", help=".npy of [V,3] or [B,V,3] target verts")
+    f.add_argument("--asset", default="synthetic")
+    f.add_argument("--side", default=None, choices=[None, "left", "right"])
+    f.add_argument("--solver", default="lm", choices=["lm", "adam"])
+    f.add_argument("--steps", type=int, default=None,
+                   help="default: 25 (lm) / 200 (adam)")
+    f.add_argument("--lr", type=float, default=None,
+                   help="adam learning rate (default 0.05; adam only)")
+    f.add_argument("--out", default="fit.npz")
+    f.set_defaults(fn=cmd_fit)
 
     i = sub.add_parser("info", help="print asset summary")
     i.add_argument("--asset", default="synthetic")

@@ -54,3 +54,34 @@ def test_info(capsys):
     info = json.loads(capsys.readouterr().out)
     assert info["n_verts"] == 778
     assert info["parents"][0] == -1
+
+
+def test_fit_subcommand(tmp_path, capsys):
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+
+    p32 = synthetic_params(seed=0).astype(np.float32)
+    rng = np.random.default_rng(0)
+    pose = rng.normal(scale=0.25, size=(2, 16, 3)).astype(np.float32)
+    targets = np.asarray(core.jit_forward_batched(
+        p32, jnp.asarray(pose), jnp.zeros((2, 10), jnp.float32)
+    ).verts)
+    np.save(tmp_path / "targets.npy", targets)
+    out = tmp_path / "fit.npz"
+    rc = cli.main([
+        "fit", str(tmp_path / "targets.npy"),
+        "--solver", "lm", "--steps", "15", "--out", str(out),
+    ])
+    assert rc == 0
+    assert "fit (lm, 15 steps)" in capsys.readouterr().out
+    ckpt = np.load(out)
+    assert ckpt["pose"].shape == (2, 16, 3)
+    np.testing.assert_allclose(ckpt["pose"], pose, atol=1e-3)
+
+
+def test_fit_subcommand_rejects_bad_targets(tmp_path, capsys):
+    np.save(tmp_path / "bad.npy", np.zeros((5, 3)))
+    rc = cli.main(["fit", str(tmp_path / "bad.npy")])
+    assert rc == 2
+    assert "targets must be" in capsys.readouterr().err

@@ -1,0 +1,64 @@
+"""Golden regression: the demo outputs are pinned across rounds.
+
+The reference's only verification artifact is its deterministic demo
+export (SURVEY.md §3.4: fixed inputs -> hand.obj). Here the same role is
+played by a checked-in fixture of the demo vertices on the synthetic
+asset: any unintended numerical change to the oracle, the JAX core, the
+PCA decode, or the synthetic asset generator trips this test.
+
+Regenerate (only for INTENTIONAL numerics changes, with a changelog note):
+    python -c "see tests/test_golden.py docstring" — run the snippet in
+    generate_fixture() below.
+"""
+
+from pathlib import Path
+
+import numpy as np
+import jax.numpy as jnp
+
+from mano_hand_tpu import cli
+from mano_hand_tpu.models import core
+from mano_hand_tpu.models.layer import MANOModel
+
+FIXTURE = Path(__file__).parent / "fixtures" / "golden_demo.npz"
+
+
+def generate_fixture(params):  # pragma: no cover - regeneration helper
+    model = MANOModel(params, backend="np")
+    model.set_params(
+        pose_pca=cli.DEMO_POSE_PCA, shape=cli.DEMO_SHAPE,
+        global_rot=cli.DEMO_GLOBAL_ROT,
+    )
+    np.savez_compressed(
+        FIXTURE, verts=model.verts, rest_verts=model.rest_verts,
+        joints=model.J,
+    )
+
+
+def test_demo_matches_golden_np_backend(params):
+    golden = np.load(FIXTURE)
+    model = MANOModel(params, backend="np")
+    model.set_params(
+        pose_pca=cli.DEMO_POSE_PCA, shape=cli.DEMO_SHAPE,
+        global_rot=cli.DEMO_GLOBAL_ROT,
+    )
+    # f64 end-to-end; tolerance covers BLAS summation-order differences.
+    np.testing.assert_allclose(model.verts, golden["verts"], atol=1e-12)
+    np.testing.assert_allclose(
+        model.rest_verts, golden["rest_verts"], atol=1e-12
+    )
+    np.testing.assert_allclose(model.J, golden["joints"], atol=1e-12)
+
+
+def test_demo_matches_golden_jax_backend(params):
+    golden = np.load(FIXTURE)
+    p32 = params.astype(np.float32)
+    pose = core.decode_pca(
+        p32,
+        jnp.asarray(cli.DEMO_POSE_PCA, jnp.float32),
+        jnp.asarray(cli.DEMO_GLOBAL_ROT, jnp.float32),
+    )
+    out = core.jit_forward(
+        p32, pose, jnp.asarray(cli.DEMO_SHAPE, jnp.float32)
+    )
+    assert np.abs(np.asarray(out.verts) - golden["verts"]).max() < 1e-4
