@@ -5,14 +5,18 @@ from mano_hand_tpu.models.core import (
     forward_batched,
     forward_chunked,
     forward_fused,
+    forward_hands,
     forward_pca,
     fused_blend_bases,
     jit_forward,
     jit_forward_batched,
+    stack_params,
 )
 from mano_hand_tpu.models import oracle
 
 __all__ = [
+    "forward_hands",
+    "stack_params",
     "ManoOutput",
     "decode_pca",
     "forward",

@@ -56,16 +56,20 @@ def evaluate_two_hand_sequence(
         s_dim = left.shape_basis.shape[-1]
         shapes = jnp.zeros((t, 2, s_dim), left.v_template.dtype)
 
-    return _run_two_hand(left, right, poses, jnp.asarray(shapes))
+    stacked = core.stack_params(left, right)
+    return _run_two_hand(stacked, poses, jnp.asarray(shapes))
 
 
 @jax.jit
-def _run_two_hand(left, right, p, s):
+def _run_two_hand(stacked, p, s):
     # Params are jit arguments on purpose: a device array captured as a jit
-    # constant degrades every later dispatch on the axon TPU tunnel to ~70 ms.
-    vl = core.forward_batched(left, p[:, 0], s[:, 0]).verts
-    vr = core.forward_batched(right, p[:, 1], s[:, 1]).verts
-    return jnp.stack([vl, vr], axis=1)
+    # constant degrades every later dispatch on the axon TPU tunnel to
+    # ~70 ms. The hand axis vmaps over the stacked param PyTree, so both
+    # hands run as one hand-batched program.
+    out = core.forward_hands(
+        stacked, p.transpose(1, 0, 2, 3), s.transpose(1, 0, 2)
+    )
+    return out.verts.transpose(1, 0, 2, 3)
 
 
 def resample_poses(poses: np.ndarray, n_frames: int) -> np.ndarray:

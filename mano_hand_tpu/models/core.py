@@ -267,6 +267,41 @@ def forward_batched_pallas(
     )
 
 
+def stack_params(left: ManoParams, right: ManoParams) -> ManoParams:
+    """Stack a (left, right) asset pair into one PyTree with [2, ...] leaves.
+
+    The reference ships hands as two separate asset files
+    (/root/reference/dump_model.py:48-49) and evaluates them in separate
+    calls; stacking lets ``forward_hands`` vmap over the hand axis so a
+    two-hand workload is ONE XLA program with hand-batched matmuls.
+    ``side`` becomes "stacked" (do not pass to schema.validate); parents
+    must match (they always do for MANO).
+    """
+    import dataclasses
+
+    if tuple(left.parents) != tuple(right.parents):
+        raise ValueError("cannot stack params with different kinematic trees")
+    right_aligned = dataclasses.replace(right, side=left.side)
+    stacked = jax.tree_util.tree_map(
+        lambda a, b: jnp.stack([jnp.asarray(a), jnp.asarray(b)]),
+        left, right_aligned,
+    )
+    return dataclasses.replace(stacked, side="stacked")
+
+
+def forward_hands(
+    stacked: ManoParams,     # stack_params output, [H, ...] leaves
+    pose: jnp.ndarray,       # [H, B, J, 3]
+    shape: jnp.ndarray,      # [H, B, S]
+    precision=DEFAULT_PRECISION,
+) -> ManoOutput:
+    """Multi-hand batched forward: vmap over the hand axis of params AND
+    inputs — one program, hand-major outputs [H, B, ...]."""
+    return jax.vmap(
+        lambda prm, p, s: forward_batched(prm, p, s, precision)
+    )(stacked, pose, shape)
+
+
 def forward_chunked(
     params: ManoParams,
     pose: jnp.ndarray,

@@ -56,3 +56,31 @@ def test_forward_batched_fused_flag_parity(params32):
     on = core.forward_batched(params32, pose, beta, fused=True)
     off = core.forward_batched(params32, pose, beta, fused=False)
     assert np.abs(np.asarray(on.verts) - np.asarray(off.verts)).max() < 1e-6
+
+
+def test_stack_params_and_forward_hands(params_pair):
+    left, right = (p.astype(np.float32) for p in params_pair)
+    stacked = core.stack_params(left, right)
+    assert stacked.v_template.shape == (2, 778, 3)
+    assert stacked.side == "stacked"
+    rng = np.random.default_rng(8)
+    pose = jnp.asarray(rng.normal(scale=0.4, size=(2, 5, 16, 3)), jnp.float32)
+    beta = jnp.asarray(rng.normal(size=(2, 5, 10)), jnp.float32)
+    out = core.forward_hands(stacked, pose, beta)
+    assert out.verts.shape == (2, 5, 778, 3)
+    for h, prm in enumerate((left, right)):
+        want = core.forward_batched(prm, pose[h], beta[h]).verts
+        np.testing.assert_array_equal(
+            np.asarray(out.verts[h]), np.asarray(want)
+        )
+
+
+def test_stack_params_rejects_mismatched_trees(params_pair):
+    import dataclasses
+
+    left, right = (p.astype(np.float32) for p in params_pair)
+    bad = dataclasses.replace(right, parents=(-1,) + (0,) * 15)
+    if tuple(bad.parents) == tuple(left.parents):
+        pytest.skip("synthetic parents happen to match the degenerate tree")
+    with pytest.raises(ValueError, match="kinematic trees"):
+        core.stack_params(left, bad)
