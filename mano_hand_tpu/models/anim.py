@@ -56,8 +56,28 @@ def evaluate_two_hand_sequence(
         s_dim = left.shape_basis.shape[-1]
         shapes = jnp.zeros((t, 2, s_dim), left.v_template.dtype)
 
-    stacked = core.stack_params(left, right)
+    stacked = _stacked_cached(left, right)
     return _run_two_hand(stacked, poses, jnp.asarray(shapes))
+
+
+# stack_params re-stacks (and re-uploads) the full left+right parameter set
+# (~10 MB of leaves) — costly per frame-batch on the axon TPU tunnel. Cache
+# by identity of the (left, right) pair: params PyTrees are frozen
+# dataclasses reused across calls, so identity is the natural key.
+_STACK_CACHE: dict = {}
+
+
+def _stacked_cached(left: ManoParams, right: ManoParams) -> ManoParams:
+    key = (id(left), id(right))
+    hit = _STACK_CACHE.get(key)
+    # Keep the originals alive in the entry so ids can't be recycled.
+    if hit is not None and hit[0] is left and hit[1] is right:
+        return hit[2]
+    stacked = core.stack_params(left, right)
+    if len(_STACK_CACHE) >= 8:   # bound: a handful of asset pairs at most
+        _STACK_CACHE.clear()
+    _STACK_CACHE[key] = (left, right, stacked)
+    return stacked
 
 
 @jax.jit

@@ -312,19 +312,28 @@ def forward_chunked(
     """Memory-bounded huge-batch vertices via lax.map over chunks.
 
     Keeps the per-chunk [chunk, V, 3, 3] LBS intermediate under ~2 GB while
-    the MXU stays saturated; returns verts only ([B, V, 3]).
-    B must be divisible by chunk_size (pad at the call site if not).
+    the MXU stays saturated; returns verts only ([B, V, 3]). Any batch size
+    works: a trailing partial chunk is zero-padded internally (static pad,
+    jit-safe) and the padding sliced off the output.
     """
     b = pose.shape[0]
-    if b % chunk_size:
-        raise ValueError(f"batch {b} not divisible by chunk_size {chunk_size}")
-    pose_c = pose.reshape(b // chunk_size, chunk_size, *pose.shape[1:])
-    shape_c = shape.reshape(b // chunk_size, chunk_size, *shape.shape[1:])
+    chunk_size = max(1, min(chunk_size, b))  # max(1,..) keeps B=0 legal
+    pad = (-b) % chunk_size
+    if pad:
+        pose = jnp.concatenate(
+            [pose, jnp.zeros((pad, *pose.shape[1:]), pose.dtype)]
+        )
+        shape = jnp.concatenate(
+            [shape, jnp.zeros((pad, *shape.shape[1:]), shape.dtype)]
+        )
+    n_chunks = (b + pad) // chunk_size
+    pose_c = pose.reshape(n_chunks, chunk_size, *pose.shape[1:])
+    shape_c = shape.reshape(n_chunks, chunk_size, *shape.shape[1:])
     verts = jax.lax.map(
         lambda ps: forward_batched(params, ps[0], ps[1], precision).verts,
         (pose_c, shape_c),
     )
-    return verts.reshape(b, *verts.shape[2:])
+    return verts.reshape(n_chunks * chunk_size, *verts.shape[2:])[:b]
 
 
 @functools.partial(jax.jit, static_argnames=("precision",))

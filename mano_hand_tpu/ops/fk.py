@@ -49,10 +49,11 @@ def forward_kinematics(
     orientations — together the reference's G matrices
     (/root/reference/mano_np.py:96-104) without the homogeneous row.
 
-    Every contraction takes an explicit ``precision``: default-precision f32
-    matmuls cost ~1e-2 absolute error (bf16 passes), far over the 1e-4
-    vertex budget.
+    ``precision`` is accepted for signature symmetry with the other ops but
+    unused: the 3x3 composes below are broadcast-multiply-sums (full f32
+    mul+add, equivalent to Precision.HIGHEST), not dot_generals.
     """
+    del precision
     parents_arr = np.asarray(parents)
     world_rot = jnp.zeros_like(rot_local).at[0].set(rot_local[0])
     world_t = jnp.zeros_like(joints).at[0].set(joints[0])
@@ -61,13 +62,18 @@ def forward_kinematics(
         par = parents_arr[idx]
         parent_rot = world_rot[par]                       # [k, 3, 3]
         local_t = joints[idx] - joints[par]               # [k, 3]
+        # 3x3 composes as broadcast-multiply-sum, NOT einsum/dot_general:
+        # at this size the MXU buys nothing, f32 mul+add matches
+        # Precision.HIGHEST, and a dot_general here (3 batch dims once
+        # callers nest vmap over hand and batch axes) trips an XLA
+        # simplifier bug that mangles batch-dim order and fails the hlo
+        # verifier (f32[5,2,4,3,3] vs f32[4,5,2,3,3]).
         world_rot = world_rot.at[idx].set(
-            jnp.einsum("kab,kbc->kac", parent_rot, rot_local[idx],
-                       precision=precision)
+            (parent_rot[..., :, :, None]
+             * rot_local[idx][..., None, :, :]).sum(axis=-2)
         )
         world_t = world_t.at[idx].set(
-            jnp.einsum("kab,kb->ka", parent_rot, local_t,
-                       precision=precision)
+            (parent_rot * local_t[..., None, :]).sum(axis=-1)
             + world_t[par]
         )
     return world_rot, world_t

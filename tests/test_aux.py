@@ -3,6 +3,7 @@ config, profiling."""
 
 import pickle
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -232,3 +233,49 @@ def test_slerp_canonicalizes_large_angles():
     r_in = np.asarray(rotation_matrix(jnp.asarray(aa, jnp.float32).reshape(1, 3))[0])
     r_out = np.asarray(rotation_matrix(jnp.asarray(out[0], jnp.float32).reshape(1, 3))[0])
     np.testing.assert_allclose(r_in, r_out, atol=1e-6)
+
+
+def test_lm_checkpoint_keeps_damping_history(params, tmp_path):
+    """Solver-specific NamedTuple extras must survive save/load generically
+    (LMResult.damping_history was silently dropped before)."""
+    from mano_hand_tpu.fitting import fit_lm
+
+    p32 = params.astype(np.float32)
+    target = core.forward(p32).verts
+    res = fit_lm(p32, target, n_steps=3)
+    back = checkpoints.load_fit_result(
+        checkpoints.save_fit_result(res, tmp_path / "lm.npz")
+    )
+    assert "damping_history" in back
+    np.testing.assert_allclose(back["damping_history"],
+                               np.asarray(res.damping_history))
+
+
+def test_two_hand_layout_convention(params_pair):
+    """CANONICAL layouts: the anim API is frame-major [T, 2(hands), ...]
+    (matching the reference's per-frame loop, data_explore.py:12-15); the
+    core forward_hands API is hand-major [H, B, ...] (the vmap axis order
+    over stacked params). They are exact transposes of each other."""
+    left, right = (p.astype(np.float32) for p in params_pair)
+    rng = np.random.default_rng(11)
+    poses = rng.normal(scale=0.4, size=(3, 2, 16, 3)).astype(np.float32)
+    shapes = rng.normal(scale=0.5, size=(3, 2, 10)).astype(np.float32)
+
+    frame_major = anim.evaluate_two_hand_sequence(
+        left, right, jnp.asarray(poses), jnp.asarray(shapes)
+    )
+
+    stacked = core.stack_params(left, right)
+    hand_major = jax.jit(core.forward_hands)(
+        stacked,
+        jnp.asarray(poses.transpose(1, 0, 2, 3)),
+        jnp.asarray(shapes.transpose(1, 0, 2)),
+    ).verts
+
+    assert frame_major.shape == (3, 2, 778, 3)
+    assert hand_major.shape == (2, 3, 778, 3)
+    np.testing.assert_allclose(
+        np.asarray(frame_major),
+        np.asarray(hand_major).transpose(1, 0, 2, 3),
+        atol=1e-6,
+    )
