@@ -1,0 +1,13 @@
+# Pre-commit gate: `make check` MUST pass (full suite incl. the golden demo
+# fixture on the virtual 8-device CPU mesh) before any snapshot commit.
+.PHONY: check test bench-cpu
+
+check: test
+
+test:
+	python -m pytest tests/ -q
+
+# Correctness-only bench pass on CPU (small sizes); real numbers need the TPU.
+bench-cpu:
+	python bench.py --platform cpu --big-batch 2048 --chunk 512 --iters 4 \
+	  --fit-steps 20 --pallas-sweep off --init-retries 2

@@ -206,6 +206,12 @@ def cmd_info(args) -> int:
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="mano_hand_tpu", description=__doc__)
+    p.add_argument(
+        "--platform", default="",
+        help="force a JAX platform (e.g. 'cpu'). Needed when the default "
+             "accelerator tunnel is down: a site hook overrides "
+             "JAX_PLATFORMS, so only the config API reliably selects cpu.",
+    )
     sub = p.add_subparsers(dest="cmd", required=True)
 
     d = sub.add_parser("demo", help="export the reference demo mesh")
@@ -261,6 +267,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     return args.fn(args)
 
 
