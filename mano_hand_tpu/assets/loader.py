@@ -37,11 +37,71 @@ _PICKLE_KEYS = {
 }
 
 
+class _StubPickled:
+    """Stand-in for classes whose module is unimportable at unpickle time.
+
+    The official MANO pickle holds ``chumpy.Ch`` wrappers
+    (/root/reference/dump_model.py:6-10 runs in a chumpy-era env); chumpy is
+    dead upstream and absent from modern images, so unpickling it must not
+    require the real class. The stub absorbs any construction protocol
+    pickle uses (``__setstate__`` dict, ``_reconstructor`` args) and exposes
+    the wrapped ndarray the way ``_dense`` probes for it.
+    """
+
+    def __init__(self, *args, **kwargs):
+        self._args = args
+        self.__dict__.update(kwargs)
+
+    def __setstate__(self, state):
+        if isinstance(state, dict):
+            self.__dict__.update(state)
+        else:
+            self._state = state
+
+    def _arrays(self):
+        return [v for v in self.__dict__.values()
+                if isinstance(v, np.ndarray)]
+
+    @property
+    def r(self):
+        # chumpy.Ch stores its value in attribute ``x``; fall back to the
+        # largest ndarray in the state for chumpy subclasses that rename it.
+        x = self.__dict__.get("x")
+        if isinstance(x, np.ndarray):
+            return x
+        arrays = self._arrays()
+        if not arrays:
+            raise ValueError(
+                f"stubbed pickle object has no array payload: "
+                f"{sorted(self.__dict__)}"
+            )
+        return max(arrays, key=lambda a: a.size)
+
+
+class _TolerantUnpickler(pickle.Unpickler):
+    """Unpickler that substitutes ``_StubPickled`` for missing classes.
+
+    Only loads what it can resolve for real and stubs the rest — asset
+    pickles are still untrusted input, so this never fabricates imports,
+    it only *narrows* what a normal ``pickle.load`` would execute.
+    """
+
+    def find_class(self, module, name):
+        try:
+            return super().find_class(module, name)
+        except (ImportError, AttributeError):
+            return _StubPickled
+
+
+def _tolerant_load(f, encoding: str):
+    return _TolerantUnpickler(f, encoding=encoding).load()
+
+
 def _dense(a) -> np.ndarray:
     """Materialize chumpy arrays / scipy sparse matrices as dense ndarrays."""
     if hasattr(a, "toarray"):  # scipy sparse
         return np.asarray(a.toarray())
-    if hasattr(a, "r"):  # chumpy Ch object
+    if hasattr(a, "r"):  # chumpy Ch object (or its _StubPickled stand-in)
         return np.asarray(a.r)
     return np.asarray(a)
 
@@ -67,7 +127,7 @@ def load_dumped_pickle(path: PathLike, side: str | None = None) -> ManoParams:
     with bytes keys are legitimate inputs.
     """
     with open(path, "rb") as f:
-        raw = pickle.load(f, encoding="bytes")
+        raw = _tolerant_load(f, encoding="bytes")
     raw = {k.decode() if isinstance(k, bytes) else k: v for k, v in raw.items()}
     kwargs = {ours: _dense(raw[theirs]) for theirs, ours in _PICKLE_KEYS.items()}
     kwargs["faces"] = kwargs["faces"].astype(np.int32)
@@ -87,9 +147,12 @@ def load_official_pickle(path: PathLike, side: str | None = None) -> ManoParams:
     (/root/reference/dump_model.py:8-18): densify the sparse J_regressor,
     take row 0 of kintree_table as the parent array, and strip chumpy
     wrappers. Requires ``encoding='latin1'`` for the py2-era pickle.
+
+    Works WITHOUT chumpy installed: unresolvable classes unpickle as
+    ``_StubPickled``, whose ``.r`` hands ``_dense`` the wrapped array.
     """
     with open(path, "rb") as f:
-        raw = pickle.load(f, encoding="latin1")
+        raw = _tolerant_load(f, encoding="latin1")
     return validate(
         ManoParams(
             v_template=_dense(raw["v_template"]).astype(np.float64),

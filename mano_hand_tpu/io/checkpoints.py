@@ -26,16 +26,21 @@ def _npz_path(path: PathLike) -> Path:
 
 
 def save_fit_result(result, path: PathLike) -> Path:
-    """Persist a fitting.FitResult (or any object with pose/shape/...)."""
+    """Persist a fitting result NamedTuple (FitResult, LMResult, ...).
+
+    Every non-None field is saved generically via ``_asdict``, so
+    solver-specific extras (e.g. LMResult.damping_history) survive the
+    round-trip instead of being silently dropped.
+    """
     path = _npz_path(path)
-    arrays = {
-        "pose": np.asarray(result.pose),
-        "shape": np.asarray(result.shape),
-        "final_loss": np.asarray(result.final_loss),
-        "loss_history": np.asarray(result.loss_history),
-    }
-    if getattr(result, "pca", None) is not None:
-        arrays["pca"] = np.asarray(result.pca)
+    if hasattr(result, "_asdict"):
+        fields = result._asdict()
+    else:
+        fields = {k: getattr(result, k)
+                  for k in ("pose", "shape", "final_loss", "loss_history",
+                            "pca")
+                  if hasattr(result, k)}
+    arrays = {k: np.asarray(v) for k, v in fields.items() if v is not None}
     np.savez(path, **arrays)
     return path
 

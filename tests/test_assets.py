@@ -116,3 +116,68 @@ def test_astype(params):
     p32 = params.astype(np.float32)
     assert p32.v_template.dtype == np.float32
     assert p32.faces.dtype == np.int32  # ints untouched
+
+
+def test_official_pickle_without_chumpy(params, tmp_path):
+    """Official MANO pickles hold chumpy.Ch wrappers; chumpy is dead and not
+    installed. The tolerant unpickler must stub those classes and still
+    surface the wrapped arrays (/root/reference/dump_model.py:4-21 is the
+    chumpy-era conversion this loader folds in)."""
+    import pickle
+    import sys
+    import types
+
+    import scipy.sparse as sp
+
+    from mano_hand_tpu.assets import load_official_pickle
+
+    # Forge a chumpy-like module so pickling records class "chumpy.Ch";
+    # it is removed before loading, and the real chumpy is not installed,
+    # so unpickling MUST go through the stub path.
+    assert "chumpy" not in sys.modules or not getattr(
+        sys.modules["chumpy"], "__file__", None
+    )
+    fake = types.ModuleType("chumpy")
+
+    class Ch:
+        def __init__(self, x):
+            self.x = np.asarray(x)
+            self.dterms = ("x",)  # extra non-array state, like real chumpy
+
+    Ch.__module__ = "chumpy"
+    Ch.__qualname__ = "Ch"
+    fake.Ch = Ch
+    sys.modules["chumpy"] = fake
+    try:
+        raw = {
+            "v_template": Ch(params.v_template),
+            "shapedirs": Ch(params.shape_basis),
+            "posedirs": np.asarray(params.pose_basis),
+            "J_regressor": sp.csc_matrix(np.asarray(params.j_regressor)),
+            "weights": Ch(params.lbs_weights),
+            "hands_components": np.asarray(params.pca_basis),
+            "hands_mean": np.asarray(params.pca_mean),
+            "f": np.asarray(params.faces, np.uint32),
+            "kintree_table": np.stack([
+                np.asarray([4294967295] + list(params.parents[1:]),
+                           np.uint32),
+                np.arange(16, dtype=np.uint32),
+            ]),
+        }
+        path = tmp_path / "MANO_RIGHT.pkl"
+        with open(path, "wb") as f:
+            pickle.dump(raw, f, protocol=2)
+    finally:
+        del sys.modules["chumpy"]
+
+    loaded = load_official_pickle(path)
+    np.testing.assert_array_equal(loaded.v_template, params.v_template)
+    np.testing.assert_array_equal(loaded.j_regressor, params.j_regressor)
+    np.testing.assert_array_equal(loaded.lbs_weights, params.lbs_weights)
+    assert loaded.parents == params.parents
+    assert loaded.parents[0] == -1
+    assert loaded.side == C.RIGHT
+
+    # load_model sniffing must also land on the official branch.
+    from mano_hand_tpu.assets import load_model as _lm
+    assert _lm(path).side == C.RIGHT
