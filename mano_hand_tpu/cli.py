@@ -139,7 +139,12 @@ def cmd_render(args) -> int:
         height=args.size, width=args.size,
     )
     dst = Path(args.out)
-    if dst.suffix == ".gif":
+    if dst.suffix == ".avi":
+        # The reference's animation demo output format
+        # (/root/reference/data_explore.py:17).
+        viz.write_avi(frames, dst, fps=args.fps)
+        print(f"wrote {dst} ({len(frames)} frames)")
+    elif dst.suffix == ".gif":
         viz.write_gif(frames, dst, fps=args.fps)
         print(f"wrote {dst} ({len(frames)} frames)")
     else:

@@ -4,14 +4,16 @@ The reference's visualization (C11, /root/reference/data_explore.py:1-18)
 depends on an external OpenGL viewer (vctoolkit + transforms3d) to render
 scan-pose animations to AVI. This subsystem replaces that with a
 dependency-free, jittable software renderer: camera transforms, a z-buffer
-triangle rasterizer with Lambert shading, and a pure-Python PNG/GIF writer
-— so `cli render` produces shaded hand images and animations on any host,
-and whole animation clips render as one batched XLA program on TPU.
+triangle rasterizer with Lambert shading, and pure-Python PNG/GIF/AVI
+writers — so `cli render` produces shaded hand images, animations, and
+actual video files on any host, and whole animation clips render as one
+batched XLA program on TPU.
 """
 
 from mano_hand_tpu.viz.camera import Camera, look_at, view_rotation
 from mano_hand_tpu.viz.render import render_mesh, render_sequence
 from mano_hand_tpu.viz.png import write_png, write_gif
+from mano_hand_tpu.viz.avi import write_avi, read_avi_info
 
 __all__ = [
     "Camera",
@@ -21,4 +23,6 @@ __all__ = [
     "render_sequence",
     "write_png",
     "write_gif",
+    "write_avi",
+    "read_avi_info",
 ]

@@ -191,3 +191,37 @@ def test_cli_render_png_dir(tmp_path):
     pngs = sorted(out.glob("*.png"))
     assert len(pngs) == 1
     assert pngs[0].read_bytes()[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+# ------------------------------------------------------------------- avi
+def test_avi_roundtrip(tmp_path):
+    """write_avi produces a parseable RIFF/AVI whose first frame round-trips
+    pixel-exactly (uncompressed DIB: flip + channel swap are involutions)."""
+    from mano_hand_tpu.viz import read_avi_info, write_avi
+
+    rng = np.random.default_rng(0)
+    frames = rng.integers(0, 256, size=(5, 31, 33, 3), dtype=np.uint8)
+    path = write_avi(frames, tmp_path / "clip.avi", fps=24)
+    info = read_avi_info(path)
+    assert (info["width"], info["height"]) == (33, 31)  # odd dims: stride pad
+    assert info["n_frames"] == 5
+    assert info["fps"] == 24
+    assert info["streams"] == 1
+    assert info["has_index"]
+    assert info["bits"] == 24 and info["compression"] == 0  # BI_RGB DIB
+    assert info["first_chunk_tag"] == "00db"
+    np.testing.assert_array_equal(info["first_frame"], frames[0])
+
+
+def test_avi_float_frames_and_validation(tmp_path):
+    from mano_hand_tpu.viz import read_avi_info, write_avi
+
+    frames = np.linspace(0.0, 1.0, 2 * 8 * 8 * 3).reshape(2, 8, 8, 3)
+    info = read_avi_info(write_avi(frames, tmp_path / "f.avi"))
+    assert info["n_frames"] == 2
+    assert info["first_frame"].max() <= 255
+
+    with pytest.raises(ValueError, match="zero frames"):
+        write_avi(np.zeros((0, 4, 4, 3), np.uint8), tmp_path / "z.avi")
+    with pytest.raises(ValueError, match="expected"):
+        write_avi(np.zeros((4, 4, 3), np.uint8), tmp_path / "b.avi")
