@@ -425,6 +425,22 @@ def run_benchmarks(args, device_str: str) -> dict:
         log(f"config5 120f x 2 hands: {t5 * 1e3:.2f} ms "
             f"({t_frames * hands / t5:,.0f} evals/s)")
 
+        # Variant: both hands as ONE hand-batched program (vmap over the
+        # stacked param PyTree) — hand-major [2, T, ...] inputs.
+        stacked = core.stack_params(left, right)
+        pose5h = pose5.reshape(hands, t_frames, 16, 3)
+        beta5h = beta5.reshape(hands, t_frames, 10)
+
+        def seq_stacked(prm, p, s):
+            return core.forward_hands(prm, p, s).verts.sum()
+
+        fwd5s = loop_scalar(seq_stacked)
+        t5s = slope_time(
+            lambda m: looped(fwd5s, m, stacked, pose5h, beta5h),
+            1, 9, iters=max(1, args.iters // 2))
+        results["config5_stacked_ms"] = t5s * 1e3
+        log(f"config5 stacked forward_hands: {t5s * 1e3:.2f} ms")
+
     section("config5", config5)
 
     # -- optional: sharded forward over an explicit mesh --------------------

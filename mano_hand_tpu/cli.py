@@ -13,8 +13,9 @@ Subcommands cover the reference's executable entry points (SURVEY.md §3):
   render   — rasterize a pose (or pose sequence) to PNG frames / an
              animated GIF with the built-in JAX renderer, replacing the
              reference's external OpenGL viewer dependency
-  fit      — recover pose/shape from target vertices (.npy) by Adam or
-             Levenberg-Marquardt; writes a .npz checkpoint
+  fit      — recover pose/shape from target vertices or sparse 3D joint
+             keypoints (.npy) by Adam or Levenberg-Marquardt; writes a
+             .npz checkpoint
   info     — print an asset's schema summary
 
 Run as ``python -m mano_hand_tpu.cli <subcommand>``.
@@ -162,16 +163,20 @@ def cmd_fit(args) -> int:
     from mano_hand_tpu.io.checkpoints import save_fit_result
 
     params = _load_params(args.asset, args.side).astype(np.float32)
-    targets = np.load(args.targets)  # [V, 3] or [B, V, 3]
-    if targets.ndim not in (2, 3) or targets.shape[-2:] != (
-        params.n_verts, 3
-    ):
+    targets = np.load(args.targets)  # [V|J, 3] or [B, V|J, 3]
+    n_rows = (
+        params.n_joints if args.data_term == "joints" else params.n_verts
+    )
+    if targets.ndim not in (2, 3) or targets.shape[-2:] != (n_rows, 3):
         print(
-            f"targets must be [{params.n_verts}, 3] or "
-            f"[B, {params.n_verts}, 3], got {targets.shape}",
+            f"targets must be [{n_rows}, 3] or "
+            f"[B, {n_rows}, 3] for --data-term {args.data_term}, "
+            f"got {targets.shape}",
             file=sys.stderr,
         )
         return 2
+    if args.solver is None:
+        args.solver = "adam" if args.data_term == "joints" else "lm"
     steps = (
         args.steps if args.steps is not None
         else (25 if args.solver == "lm" else 200)
@@ -180,11 +185,23 @@ def cmd_fit(args) -> int:
         if args.lr is not None:
             print("note: --lr only applies to --solver adam; ignored",
                   file=sys.stderr)
+        if args.data_term != "verts":
+            print("--data-term joints requires --solver adam",
+                  file=sys.stderr)
+            return 2
         res = fitting.fit_lm(params, targets, n_steps=steps)
     else:
+        # Shape is weakly observable from 16 joints; regularize it
+        # (unless the user set an explicit weight).
+        shape_prior = (
+            args.shape_prior if args.shape_prior is not None
+            else (1e-3 if args.data_term == "joints" else 0.0)
+        )
         res = fitting.fit(
             params, targets, n_steps=steps,
             lr=0.05 if args.lr is None else args.lr,
+            data_term=args.data_term,
+            shape_prior_weight=shape_prior,
         )
     jax.block_until_ready(res.pose)
     path = save_fit_result(res, args.out)
@@ -251,11 +268,27 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--fps", type=int, default=20)
     r.set_defaults(fn=cmd_render)
 
-    f = sub.add_parser("fit", help="recover pose/shape from target verts")
-    f.add_argument("targets", help=".npy of [V,3] or [B,V,3] target verts")
+    f = sub.add_parser(
+        "fit",
+        help="recover pose/shape from target verts or 3D joint keypoints",
+    )
+    f.add_argument("targets",
+                   help=".npy of [V,3]/[B,V,3] verts (or [16,3]/[B,16,3] "
+                        "joints with --data-term joints)")
+    f.add_argument("--data-term", default="verts",
+                   choices=["verts", "joints"],
+                   help="fit to a full target mesh or to sparse 3D "
+                        "keypoints (detector/mocap output)")
+    f.add_argument("--shape-prior", type=float, default=None,
+                   help="L2 prior weight on shape coefficients; default 0 "
+                        "for verts, 1e-3 for joints (16 keypoints observe "
+                        "shape only weakly)")
     f.add_argument("--asset", default="synthetic")
     f.add_argument("--side", default=None, choices=[None, "left", "right"])
-    f.add_argument("--solver", default="lm", choices=["lm", "adam"])
+    f.add_argument("--solver", default=None, choices=["lm", "adam"],
+                   help="default: lm for --data-term verts, adam for "
+                        "joints (lm's Gauss-Newton system is built on the "
+                        "vertex residual)")
     f.add_argument("--steps", type=int, default=None,
                    help="default: 25 (lm) / 200 (adam)")
     f.add_argument("--lr", type=float, default=None,

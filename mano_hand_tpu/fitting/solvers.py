@@ -34,7 +34,7 @@ class FitResult(NamedTuple):
 
 def _fit_single(
     params: ManoParams,
-    target_verts: jnp.ndarray,  # [V, 3]
+    target: jnp.ndarray,  # [V, 3] (data_term="verts") or [J, 3] ("joints")
     *,
     n_steps: int,
     optimizer: optax.GradientTransformation,
@@ -42,7 +42,12 @@ def _fit_single(
     n_pca: int,
     pose_prior_weight: float,
     shape_prior_weight: float,
+    data_term: str = "verts",
 ) -> FitResult:
+    if data_term not in ("verts", "joints"):
+        raise ValueError(
+            f"data_term must be 'verts' or 'joints', got {data_term!r}"
+        )
     dtype = params.v_template.dtype
     n_joints = params.j_regressor.shape[0]
     n_shape = params.shape_basis.shape[-1]
@@ -65,7 +70,13 @@ def _fit_single(
 
     def loss_fn(p):
         out = core.forward(params, decode(p), p["shape"])
-        data = objectives.vertex_l2(out.verts, target_verts)
+        if data_term == "verts":
+            data = objectives.vertex_l2(out.verts, target)
+        else:
+            # Sparse-keypoint fitting: 16 posed joints (detector/mocap
+            # output) instead of a full target mesh. Shape is weakly
+            # observable from joints alone - pair with shape_prior_weight.
+            data = objectives.joint_l2(out.posed_joints, target)
         # Prior weights may be traced scalars (see fit): plain multiplies.
         reg = (
             pose_prior_weight
@@ -101,17 +112,18 @@ def _fit_single(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_steps", "pose_space", "n_pca"),
+    static_argnames=("n_steps", "pose_space", "n_pca", "data_term"),
 )
 def fit(
     params: ManoParams,
-    target_verts: jnp.ndarray,  # [V, 3] or [B, V, 3]
+    target_verts: jnp.ndarray,  # [V, 3] or [B, V, 3] ([J, 3] for joints)
     n_steps: int = 200,
     lr: float = 0.05,
     pose_space: str = "aa",
     n_pca: int = 45,
     pose_prior_weight: float = 0.0,
     shape_prior_weight: float = 0.0,
+    data_term: str = "verts",
 ) -> FitResult:
     """Recover pose/shape for one target mesh or a batch of them.
 
@@ -126,6 +138,7 @@ def fit(
         n_steps=n_steps, pose_space=pose_space, n_pca=n_pca,
         pose_prior_weight=pose_prior_weight,
         shape_prior_weight=shape_prior_weight,
+        data_term=data_term,
     )
 
 
@@ -138,6 +151,7 @@ def fit_with_optimizer(
     n_pca: int = 45,
     pose_prior_weight: float = 0.0,
     shape_prior_weight: float = 0.0,
+    data_term: str = "verts",
 ) -> FitResult:
     single = functools.partial(
         _fit_single,
@@ -148,6 +162,7 @@ def fit_with_optimizer(
         n_pca=n_pca,
         pose_prior_weight=pose_prior_weight,
         shape_prior_weight=shape_prior_weight,
+        data_term=data_term,
     )
     target_verts = jnp.asarray(target_verts, params.v_template.dtype)
     if target_verts.ndim == 2:

@@ -84,3 +84,40 @@ def test_first_step_grads_finite_from_zero(params32):
     res = fit(params32, target, n_steps=2, lr=0.05)
     assert np.isfinite(np.asarray(res.loss_history)).all()
     assert np.isfinite(np.asarray(res.pose)).all()
+
+
+def test_fit_to_joints(params32):
+    """Sparse-keypoint fitting: recover pose from 16 posed joints only
+    (detector/mocap-style input), shape regularized toward zero."""
+    rng = np.random.default_rng(3)
+    pose = rng.normal(scale=0.3, size=(16, 3)).astype(np.float32)
+    target_joints = core.forward(params32, jnp.asarray(pose)).posed_joints
+
+    res = fit(params32, target_joints, n_steps=300, lr=0.05,
+              data_term="joints", shape_prior_weight=1e-3)
+    assert res.pose.shape == (16, 3)
+    out = core.forward(params32, res.pose, res.shape)
+    err = float(np.max(np.linalg.norm(
+        np.asarray(out.posed_joints) - np.asarray(target_joints), axis=-1
+    )))
+    assert float(res.loss_history[0]) > 100 * float(res.final_loss)
+    assert err < 5e-3  # every joint within 5 mm
+
+
+def test_fit_to_joints_batched(params32):
+    rng = np.random.default_rng(4)
+    pose = rng.normal(scale=0.3, size=(3, 16, 3)).astype(np.float32)
+    targets = core.forward_batched(
+        params32, jnp.asarray(pose),
+        jnp.zeros((3, 10), jnp.float32),
+    ).posed_joints
+    res = fit(params32, targets, n_steps=150, lr=0.05, data_term="joints",
+              shape_prior_weight=1e-3)
+    assert res.pose.shape == (3, 16, 3)
+    assert np.all(np.asarray(res.final_loss) < np.asarray(res.loss_history[:, 0]))
+
+
+def test_fit_rejects_bad_data_term(params32):
+    target = core.forward(params32).verts
+    with pytest.raises(ValueError, match="data_term"):
+        fit(params32, target, n_steps=2, data_term="nope")
