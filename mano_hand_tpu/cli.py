@@ -185,6 +185,9 @@ def cmd_fit(args) -> int:
         if args.lr is not None:
             print("note: --lr only applies to --solver adam; ignored",
                   file=sys.stderr)
+        if args.shape_prior is not None:
+            print("note: --shape-prior only applies to --solver adam; "
+                  "ignored", file=sys.stderr)
         if args.data_term != "verts":
             print("--data-term joints requires --solver adam",
                   file=sys.stderr)

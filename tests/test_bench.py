@@ -1,0 +1,75 @@
+"""The benchmark harness itself is load-bearing (the driver parses its one
+stdout JSON line), so its contract is tested: valid JSON on success AND on
+every failure mode. Round 1 shipped an untested harness that died with a
+traceback at backend init and captured nothing — never again."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+
+ROOT = Path(__file__).parent.parent
+
+
+def _run_bench(*extra, timeout=420):
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "bench.py"), *extra],
+        capture_output=True, text=True, timeout=timeout, cwd=ROOT,
+    )
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line, got {lines!r}"
+    return proc.returncode, json.loads(lines[0])
+
+
+def test_flops_model_matches_hand_count():
+    sys.path.insert(0, str(ROOT))
+    import bench
+
+    fpe = bench.flops_per_eval()
+    # ~1 MFLOP per eval (VERDICT round-1 estimate); dominated by the fused
+    # [V*3, S+P] vertex matmul = 2*2334*145.
+    assert 0.9e6 < fpe < 1.1e6
+    assert fpe > 2 * 2334 * 145  # at least the vertex blend
+
+
+def test_parse_mesh():
+    sys.path.insert(0, str(ROOT))
+    import bench
+
+    assert bench.parse_mesh("data=8") == {"data": 8}
+    assert bench.parse_mesh("data=4,model=2") == {"data": 4, "model": 2}
+
+
+def test_bench_error_path_emits_valid_json():
+    """A platform that can never come up must yield one valid error line,
+    not a traceback (the round-1 failure mode)."""
+    rc, line = _run_bench(
+        "--platform", "nosuchbackend", "--init-retries", "1",
+        "--init-timeout", "30", timeout=120,
+    )
+    assert rc == 1
+    assert line["metric"] == "mano_forward_evals_per_sec"
+    assert line["value"] is None
+    assert "error" in line and "bring-up" in line["error"]
+
+
+def test_bench_cpu_tiny_run_end_to_end():
+    """Full harness on CPU with minimal sizes: rc=0, all headline fields."""
+    rc, line = _run_bench(
+        "--platform", "cpu", "--big-batch", "256", "--chunk", "128",
+        "--iters", "2", "--skip-fit", "--pallas-sweep", "off",
+        "--init-retries", "2", "--init-timeout", "60",
+    )
+    assert rc == 0, line
+    assert line["value"] is not None and line["value"] > 0
+    assert line["unit"] == "evals/s"
+    assert line["vs_baseline"] > 0
+    assert line["max_err_vs_numpy"] < 1e-4  # the BASELINE accuracy gate
+    d = line["detail"]
+    for key in ("config2_b1024_evals_per_sec", "config3_b65536_evals_per_sec",
+                "config5_seq240_ms", "flops_per_eval", "achieved_gflops",
+                "config1_zero_pose_max_err"):
+        assert key in d, f"missing {key}: {sorted(d)}"
+    assert "config_errors" not in line, line.get("config_errors")
