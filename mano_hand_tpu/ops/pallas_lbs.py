@@ -1,18 +1,20 @@
 """Pallas TPU kernel: fused linear blend skinning.
 
-One kernel computes, per (batch-tile, vertex-tile):
+One kernel computes, per (batch-tile, vertex-tile), for a in 0..2:
 
-    M      = R_flat @ W^T        [9, TV]  (MXU, contraction over J=16)
-    T_blend= T^T    @ W^T        [3, TV]
-    out[a] = sum_c M[3a+c] * vp[c] + T_blend[a]          (VPU)
+    M_ac   = r_ac @ W^T          [TB, TV]  (MXU, contraction over J=16)
+    out_a  = t_a @ W^T + sum_c M_ac * v_c  (VPU FMAs)
 
 so the blended per-vertex rotations never round-trip through HBM — the XLA
 einsum path (ops/lbs.py) materializes the [B, V, 9] blend tensor (~229 MB at
 B=8192), this kernel keeps it in VMEM tiles.
 
 Layout is lane-friendly: vertices ride the 128-wide lane dimension, the tiny
-3/9/16-sized axes sit on sublanes. Inputs are transposed at the JAX level
-(XLA fuses the transposes into the surrounding pads/copies).
+3/9/16-sized axes either sit on sublanes or are split into separate 2-D
+operands at the JAX level (nine rotation-component slabs, three translation
+slabs, three coordinate planes) so every ref the kernel touches is plain
+2-D — the shapes Mosaic lowers most reliably, with no in-kernel reshapes.
+XLA fuses the slab slicing into the surrounding pads/copies.
 
 ``skin_batched`` is the raw forward kernel; ``skin_batched_ad`` wraps it in
 a custom VJP so the Pallas path composes with jax.grad. The backward pass
@@ -37,26 +39,28 @@ def _cdiv(a: int, b: int) -> int:
     return -(-a // b)
 
 
-def _skin_kernel(wt_ref, rt_ref, tt_ref, vpt_ref, out_ref):
-    """Blocks: wt [J, TV], rt [TB, 9, J], tt [TB, 3, J], vpt [TB, 3, TV],
-    out [TB, 3, TV]."""
-    tb = rt_ref.shape[0]
-    j = wt_ref.shape[0]
+def _skin_kernel(wt_ref, *refs):
+    """All-2-D blocks (the shapes Mosaic lowers most reliably — no in-kernel
+    reshapes or >2-D relayouts): wt [J, TV]; nine rotation-component slabs
+    r_ac [TB, J]; three translation slabs t_a [TB, J]; three rest-coordinate
+    planes v_c [TB, TV]; three output planes o_a [TB, TV].
+
+        M_ac    = r_ac @ W^T   [TB, TV]   (MXU, contraction over J)
+        o_a     = t_a @ W^T + sum_c M_ac * v_c          (VPU FMAs)
+    """
+    r = refs[0:9]
+    t = refs[9:12]
+    v = refs[12:15]
+    o = refs[15:18]
     wt = wt_ref[:]                                        # [J, TV]
-    m = jnp.dot(
-        rt_ref[:].reshape(tb * 9, j), wt,
-        preferred_element_type=jnp.float32,
-    ).reshape(tb, 9, -1)                                  # [TB, 9, TV]
-    t_blend = jnp.dot(
-        tt_ref[:].reshape(tb * 3, j), wt,
-        preferred_element_type=jnp.float32,
-    ).reshape(tb, 3, -1)                                  # [TB, 3, TV]
-    vp = vpt_ref[:]                                       # [TB, 3, TV]
     for a in range(3):
-        acc = t_blend[:, a, :]
+        acc = jnp.dot(t[a][:], wt, preferred_element_type=jnp.float32)
         for c in range(3):
-            acc = acc + m[:, 3 * a + c, :] * vp[:, c, :]
-        out_ref[:, a, :] = acc
+            m_ac = jnp.dot(
+                r[3 * a + c][:], wt, preferred_element_type=jnp.float32
+            )
+            acc = acc + m_ac * v[c][:]
+        o[a][:] = acc
 
 
 @functools.partial(
@@ -80,39 +84,38 @@ def skin_batched(
     f32 = jnp.float32
     bp, vp_ = _cdiv(b, block_b) * block_b, _cdiv(v, block_v) * block_v
 
+    def padb(x):  # pad the batch axis of a [B, ...] array
+        return jnp.pad(x, [(0, bp - b)] + [(0, 0)] * (x.ndim - 1))
+
     wt = jnp.pad(weights.astype(f32).T, [(0, 0), (0, vp_ - v)])     # [J, Vp]
-    rt = jnp.pad(
-        world_rot.astype(f32).reshape(b, j, 9).transpose(0, 2, 1),
-        [(0, bp - b), (0, 0), (0, 0)],
-    )                                                               # [Bp,9,J]
-    tt = jnp.pad(
-        skin_t.astype(f32).transpose(0, 2, 1), [(0, bp - b), (0, 0), (0, 0)]
-    )                                                               # [Bp,3,J]
-    vpt = jnp.pad(
-        v_posed.astype(f32).transpose(0, 2, 1),
-        [(0, bp - b), (0, 0), (0, vp_ - v)],
-    )                                                               # [Bp,3,Vp]
+    rot = padb(world_rot.astype(f32))                               # [Bp,J,3,3]
+    st = padb(skin_t.astype(f32))                                   # [Bp,J,3]
+    r_slabs = [rot[:, :, a, c] for a in range(3) for c in range(3)]  # 9x[Bp,J]
+    t_slabs = [st[:, :, a] for a in range(3)]                        # 3x[Bp,J]
+    vp_pad = jnp.pad(
+        v_posed.astype(f32), [(0, bp - b), (0, vp_ - v), (0, 0)]
+    )
+    v_slabs = [vp_pad[:, :, c] for c in range(3)]                   # 3x[Bp,Vp]
 
     grid = (bp // block_b, vp_ // block_v)
-    out = pl.pallas_call(
+    spec_bj = pl.BlockSpec((block_b, j), lambda i, k: (i, 0),
+                           memory_space=pltpu.VMEM)
+    spec_bv = pl.BlockSpec((block_b, block_v), lambda i, k: (i, k),
+                           memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
         _skin_kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((j, block_v), lambda i, k: (0, k),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_b, 9, j), lambda i, k: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_b, 3, j), lambda i, k: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((block_b, 3, block_v), lambda i, k: (i, 0, k),
-                         memory_space=pltpu.VMEM),
+            *([spec_bj] * 12),
+            *([spec_bv] * 3),
         ],
-        out_specs=pl.BlockSpec((block_b, 3, block_v), lambda i, k: (i, 0, k),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((bp, 3, vp_), f32),
+        out_specs=[spec_bv] * 3,
+        out_shape=[jax.ShapeDtypeStruct((bp, vp_), f32)] * 3,
         interpret=interpret,
-    )(wt, rt, tt, vpt)
-    return out[:b].transpose(0, 2, 1)[:, :v]
+    )(wt, *r_slabs, *t_slabs, *v_slabs)
+    return jnp.stack(outs, axis=-1)[:b, :v, :]
 
 
 # ---------------------------------------------------------------- custom VJP
