@@ -6,6 +6,11 @@ from mano_hand_tpu.io.obj import (
     restpose_path,
 )
 
+# Checkpoint backends: io.checkpoints (flat npz, canonical) and
+# io.orbax_ckpt (Orbax PyTree checkpoints: sharded/async, optional) are
+# imported as submodules on demand; neither is re-exported here to keep
+# package import light.
+
 __all__ = [
     "export_obj",
     "export_obj_pair",

@@ -25,14 +25,10 @@ def _npz_path(path: PathLike) -> Path:
     )
 
 
-def save_fit_result(result, path: PathLike) -> Path:
-    """Persist a fitting result NamedTuple (FitResult, LMResult, ...).
-
-    Every non-None field is saved generically via ``_asdict``, so
-    solver-specific extras (e.g. LMResult.damping_history) survive the
-    round-trip instead of being silently dropped.
-    """
-    path = _npz_path(path)
+def result_fields(result) -> dict:
+    """Fitting-result NamedTuple (FitResult, LMResult, ...) -> dict of its
+    non-None fields. The single field-extraction policy shared by the npz
+    and Orbax checkpoint backends."""
     if hasattr(result, "_asdict"):
         fields = result._asdict()
     else:
@@ -40,7 +36,18 @@ def save_fit_result(result, path: PathLike) -> Path:
                   for k in ("pose", "shape", "final_loss", "loss_history",
                             "pca")
                   if hasattr(result, k)}
-    arrays = {k: np.asarray(v) for k, v in fields.items() if v is not None}
+    return {k: v for k, v in fields.items() if v is not None}
+
+
+def save_fit_result(result, path: PathLike) -> Path:
+    """Persist a fitting result NamedTuple (FitResult, LMResult, ...).
+
+    Every non-None field is saved generically, so solver-specific extras
+    (e.g. LMResult.damping_history) survive the round-trip instead of
+    being silently dropped.
+    """
+    path = _npz_path(path)
+    arrays = {k: np.asarray(v) for k, v in result_fields(result).items()}
     np.savez(path, **arrays)
     return path
 

@@ -1,0 +1,116 @@
+"""Optional Orbax-backed checkpointing for fit results and pose banks.
+
+The flat ``.npz`` format (io/checkpoints.py) is the canonical, dependency-
+light path. This module layers the JAX-ecosystem-native alternative on top:
+Orbax writes sharded arrays without device->host gathering first, supports
+async saves that overlap training steps, and restores directly onto a
+``jax.sharding.Mesh`` — the right checkpoint story once fitting runs
+multi-chip (SURVEY.md §5 "checkpoint/resume": the reference has only the
+asset pickle, /root/reference/dump_model.py:20-21).
+
+Import is deferred and failure-tolerant: everything raises a clear error at
+call time when orbax is absent, so the core package never depends on it.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Optional, Union
+
+import numpy as np
+
+PathLike = Union[str, Path]
+
+
+def available() -> bool:
+    try:
+        import orbax.checkpoint  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def _ocp():
+    try:
+        import orbax.checkpoint as ocp
+
+        return ocp
+    except ImportError as e:  # pragma: no cover - orbax is in this image
+        raise ImportError(
+            "orbax-checkpoint is not installed; use "
+            "mano_hand_tpu.io.checkpoints (npz) instead"
+        ) from e
+
+
+def _as_tree(result) -> dict:
+    """A fit result (NamedTuple) or plain mapping -> a PyTree of arrays.
+
+    Shares the field-extraction policy with the npz backend
+    (io.checkpoints.result_fields) so the two never drift.
+    """
+    from mano_hand_tpu.io.checkpoints import result_fields
+
+    if isinstance(result, dict):
+        return {k: v for k, v in result.items() if v is not None}
+    return result_fields(result)
+
+
+_ASYNC_CKPTR = None  # one long-lived AsyncCheckpointer; created on demand
+
+
+def _async_ckptr():
+    global _ASYNC_CKPTR
+    if _ASYNC_CKPTR is None:
+        ocp = _ocp()
+        _ASYNC_CKPTR = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    return _ASYNC_CKPTR
+
+
+def save(result, path: PathLike, *, async_save: bool = False) -> Path:
+    """Persist a fit result / array dict as an Orbax PyTree checkpoint.
+
+    ``async_save=True`` returns after scheduling the write on ONE reused
+    background checkpointer; a subsequent ``save`` first joins the
+    in-flight write (Orbax serializes saves on the same checkpointer), and
+    ``wait()`` joins explicitly — use async to overlap checkpointing with
+    the next fitting batch, and call ``wait()`` before process exit.
+    """
+    ocp = _ocp()
+    path = Path(path).absolute()
+    if async_save:
+        ckptr = _async_ckptr()
+        ckptr.save(path, _as_tree(result), force=True)
+    else:
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(path, _as_tree(result), force=True)
+        ckptr.wait_until_finished()
+    return path
+
+
+def wait() -> None:
+    """Join all outstanding async saves."""
+    if _ASYNC_CKPTR is not None:
+        _ASYNC_CKPTR.wait_until_finished()
+
+
+def load(path: PathLike, target: Optional[Any] = None) -> dict:
+    """Restore a checkpoint as a dict of numpy arrays.
+
+    ``target`` (a PyTree of like-shaped arrays, e.g. jax.ShapeDtypeStruct
+    or device arrays with shardings) restores directly into that structure/
+    placement; without it, arrays come back host-resident.
+    """
+    ocp = _ocp()
+    path = Path(path).absolute()
+    ckptr = ocp.StandardCheckpointer()
+    if target is not None:
+        return ckptr.restore(path, target)
+    restored = ckptr.restore(path)
+
+    def to_np(x):
+        if isinstance(x, dict):
+            return {k: to_np(v) for k, v in x.items()}
+        return np.asarray(x)
+
+    return {k: to_np(v) for k, v in restored.items()}
