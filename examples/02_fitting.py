@@ -1,0 +1,71 @@
+"""Inverse MANO: recover pose/shape from a target mesh or 3D keypoints.
+
+The reference has no fitting at all; here it is a compiled optimization
+loop (optax Adam in lax.scan, or damped Gauss-Newton) — zero host
+round-trips per step, vmapped over a batch of independent problems.
+
+    python examples/02_fitting.py [--platform cpu]
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default="")
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.assets import synthetic_params
+    from mano_hand_tpu.fitting import fit, fit_lm, max_vertex_error
+    from mano_hand_tpu.io.checkpoints import save_fit_result
+    from mano_hand_tpu.models import core
+
+    params = synthetic_params(seed=0).astype(np.float32)
+    rng = np.random.default_rng(1)
+
+    # Ground truth to recover: a batch of random poses/shapes.
+    true_pose = rng.normal(scale=0.3, size=(args.batch, 16, 3)).astype("f")
+    true_shape = rng.normal(scale=0.5, size=(args.batch, 10)).astype("f")
+    target = core.jit_forward_batched(
+        params, jnp.asarray(true_pose), jnp.asarray(true_shape)
+    )
+
+    # 1. Dense: fit to the full 778-vertex mesh with Levenberg-Marquardt.
+    res = fit_lm(params, target.verts, n_steps=20)
+    out = core.forward_batched(params, res.pose, res.shape)
+    err = float(np.max(np.asarray(
+        jax.vmap(max_vertex_error)(out.verts, target.verts)
+    )))
+    print(f"LM mesh fit: worst max-vertex error {err:.2e} over "
+          f"{args.batch} problems")
+
+    # 2. Sparse: fit to 16 posed joints only (detector/mocap input).
+    res_j = fit(params, target.posed_joints, n_steps=300, lr=0.05,
+                data_term="joints", shape_prior_weight=1e-3)
+    out_j = core.forward_batched(params, res_j.pose, res_j.shape)
+    jerr = float(np.max(np.linalg.norm(
+        np.asarray(out_j.posed_joints) - np.asarray(target.posed_joints),
+        axis=-1,
+    )))
+    print(f"Adam joints fit: worst joint error {jerr:.2e}")
+
+    path = save_fit_result(res, "fit_result")
+    print(f"checkpointed LM fit -> {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

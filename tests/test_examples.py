@@ -1,0 +1,34 @@
+"""Examples are part of the public surface — run each end-to-end (tiny
+sizes, CPU) so they cannot rot."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).parent.parent
+EXAMPLES = sorted((ROOT / "examples").glob("*.py"))
+
+
+def _run(script, *extra, tmp_path):
+    proc = subprocess.run(
+        [sys.executable, str(script), "--platform", "cpu", *extra],
+        capture_output=True, text=True, timeout=360, cwd=tmp_path,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc.stdout
+
+
+def test_examples_exist():
+    assert len(EXAMPLES) >= 3
+
+
+@pytest.mark.parametrize("script", EXAMPLES, ids=lambda p: p.stem)
+def test_example_runs(script, tmp_path):
+    extra = {
+        "02_fitting": ["--batch", "2"],
+        "03_two_hands_video": ["--frames", "4", "--size", "48"],
+    }.get(script.stem, [])
+    out = _run(script, *extra, tmp_path=tmp_path)
+    assert "wrote" in out or "fit" in out
