@@ -250,6 +250,22 @@ def run_benchmarks(args, device_str: str) -> dict:
 
         return jax.jit(run, static_argnums=3)
 
+    # -- config 1 latency: single-eval device time --------------------------
+    def config1_latency():
+        pose1 = jnp.asarray(rng.normal(scale=0.5, size=(16, 3)), jnp.float32)
+        beta1 = jnp.asarray(rng.normal(size=10), jnp.float32)
+        fwd1 = loop_scalar(
+            lambda prm, p, s: core.forward(prm, p, s).verts.sum()
+        )
+        # Single evals are dispatch-dominated through the tunnel; the slope
+        # over in-program repeats isolates pure device time per eval.
+        t1 = slope_time(lambda m: looped(fwd1, m, right, pose1, beta1),
+                        8, 64, iters=max(1, args.iters // 2))
+        results["config1_single_eval_us"] = t1 * 1e6
+        log(f"config1 single eval: {t1 * 1e6:.1f} us device time")
+
+    section("config1_latency", config1_latency)
+
     # -- config 2: batch=1024 ----------------------------------------------
     b2 = 1024
     pose2 = jnp.asarray(rng.normal(scale=0.6, size=(b2, 16, 3)), jnp.float32)
