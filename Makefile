@@ -11,3 +11,12 @@ test:
 bench-cpu:
 	python bench.py --platform cpu --big-batch 2048 --chunk 512 --iters 4 \
 	  --fit-steps 20 --pallas-sweep off --init-retries 2
+
+# Unattended TPU bench: keep retrying through tunnel outages until one run
+# completes (each attempt already probes with minutes-scale backoff).
+bench-tpu-wait:
+	until python bench.py --pallas-sweep full --init-retries 60 \
+	  --init-timeout 120 --iters 10 > bench_tpu_r02.out \
+	  2>> bench_tpu_r02.log; do \
+	  echo "bench attempt failed; re-trying in 300s" >&2; sleep 300; done; \
+	cat bench_tpu_r02.out

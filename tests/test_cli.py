@@ -85,3 +85,53 @@ def test_fit_subcommand_rejects_bad_targets(tmp_path, capsys):
     rc = cli.main(["fit", str(tmp_path / "bad.npy")])
     assert rc == 2
     assert "targets must be" in capsys.readouterr().err
+
+
+def test_convert_official_pickle_to_npz(tmp_path, params):
+    """The dump_model workflow end-to-end through the CLI: a chumpy-era
+    official pickle (forged with stubbed classes, chumpy NOT installed)
+    converts straight to canonical .npz."""
+    import pickle
+    import sys as _sys
+    import types
+
+    import scipy.sparse as sp
+
+    fake = types.ModuleType("chumpy")
+
+    class Ch:
+        def __init__(self, x):
+            self.x = np.asarray(x)
+
+    Ch.__module__ = "chumpy"
+    Ch.__qualname__ = "Ch"
+    fake.Ch = Ch
+    _sys.modules["chumpy"] = fake
+    try:
+        raw = {
+            "v_template": Ch(params.v_template),
+            "shapedirs": Ch(params.shape_basis),
+            "posedirs": np.asarray(params.pose_basis),
+            "J_regressor": sp.csc_matrix(np.asarray(params.j_regressor)),
+            "weights": Ch(params.lbs_weights),
+            "hands_components": np.asarray(params.pca_basis),
+            "hands_mean": np.asarray(params.pca_mean),
+            "f": np.asarray(params.faces, np.uint32),
+            "kintree_table": np.stack([
+                np.asarray([4294967295] + list(params.parents[1:]),
+                           np.uint32),
+                np.arange(16, dtype=np.uint32),
+            ]),
+        }
+        src = tmp_path / "MANO_LEFT.pkl"
+        with open(src, "wb") as f:
+            pickle.dump(raw, f, protocol=2)
+    finally:
+        del _sys.modules["chumpy"]
+
+    dst = tmp_path / "mano_left.npz"
+    assert cli.main(["convert", str(src), str(dst)]) == 0
+    back = load_model(dst)
+    np.testing.assert_array_equal(back.v_template, params.v_template)
+    assert back.parents[0] == -1
+    assert back.side == "left"
