@@ -1,6 +1,6 @@
 # Pre-commit gate: `make check` MUST pass (full suite incl. the golden demo
 # fixture on the virtual 8-device CPU mesh) before any snapshot commit.
-.PHONY: check test bench-cpu
+.PHONY: check test bench-cpu bench-tpu-wait
 
 check: test
 
@@ -14,9 +14,11 @@ bench-cpu:
 
 # Unattended TPU bench: keep retrying through tunnel outages until one run
 # completes (each attempt already probes with minutes-scale backoff).
+# Override the artifact basename with OUT=..., e.g. `make bench-tpu-wait
+# OUT=bench_tpu_r03`.
+OUT ?= bench_tpu
 bench-tpu-wait:
 	until python bench.py --pallas-sweep full --init-retries 60 \
-	  --init-timeout 120 --iters 10 > bench_tpu_r02.out \
-	  2>> bench_tpu_r02.log; do \
+	  --init-timeout 120 --iters 10 > $(OUT).out 2>> $(OUT).log; do \
 	  echo "bench attempt failed; re-trying in 300s" >&2; sleep 300; done; \
-	cat bench_tpu_r02.out
+	cat $(OUT).out

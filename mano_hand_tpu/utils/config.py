@@ -22,7 +22,9 @@ class ManoConfig:
     side: Optional[str] = None      # left | right | None (infer)
     backend: str = "jax"            # np | jax
     dtype: str = "float32"          # compute dtype for the jax path
-    precision: str = "highest"      # highest | default (contraction passes)
+    precision: str = "high"         # high | highest | default — bf16 passes
+                                    # per f32 matmul (3/6/1); "high" is the
+                                    # library default (ops/common.py)
     mesh_data: int = 1              # data-parallel mesh extent
     mesh_model: int = 1             # tensor-parallel mesh extent
     chunk_size: int = 8192          # huge-batch chunking
@@ -58,6 +60,7 @@ class ManoConfig:
         import jax
 
         return {
+            "high": jax.lax.Precision.HIGH,
             "highest": jax.lax.Precision.HIGHEST,
             "default": jax.lax.Precision.DEFAULT,
         }[self.precision]

@@ -1,7 +1,9 @@
 """JAX core vs the float64 NumPy oracle: the central parity suite.
 
 Error budget: BASELINE.json demands max per-vertex error < 1e-4 vs the
-oracle; the JAX path runs in float32 with Precision.HIGHEST.
+oracle; the JAX path runs in float32 with Precision.HIGH by default (3
+bf16 passes per matmul on the MXU — measured 3.8e-6 on a v5e chip; on the
+CPU backend these tests use, HIGH and HIGHEST are identical f32 math).
 """
 
 import jax
