@@ -1,5 +1,6 @@
 from mano_hand_tpu.fitting.objectives import (
     joint_l2,
+    keypoint2d_l2,
     l2_prior,
     max_vertex_error,
     vertex_l2,
@@ -15,6 +16,7 @@ __all__ = [
     "fit_lm",
     "vertex_l2",
     "joint_l2",
+    "keypoint2d_l2",
     "l2_prior",
     "max_vertex_error",
 ]

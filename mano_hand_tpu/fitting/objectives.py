@@ -27,6 +27,28 @@ def max_vertex_error(pred_verts: jnp.ndarray, target_verts: jnp.ndarray) -> jnp.
     return jnp.max(jnp.linalg.norm(pred_verts - target_verts, axis=-1))
 
 
+def keypoint2d_l2(
+    pred_xy: jnp.ndarray,      # [..., J, 2] projected keypoints
+    target_xy: jnp.ndarray,    # [..., J, 2] observed keypoints
+    conf: jnp.ndarray = None,  # [..., J] optional per-keypoint confidence
+) -> jnp.ndarray:
+    """(Confidence-weighted) mean squared 2D reprojection error.
+
+    The data term for fitting to detector output: 3D joints projected
+    through a pinhole ``viz.camera.Camera`` against observed 2D keypoints.
+    ``conf`` downweights occluded/unreliable detections; weights are
+    normalized so the loss scale is independent of how many keypoints are
+    trusted. Reduction is over the keypoint axis only — batched inputs get
+    one loss per problem in both the weighted and unweighted branches.
+    """
+    err = jnp.sum((pred_xy - target_xy) ** 2, axis=-1)
+    if conf is None:
+        return jnp.mean(err, axis=-1)
+    return jnp.sum(conf * err, axis=-1) / jnp.maximum(
+        jnp.sum(conf, axis=-1), 1e-12
+    )
+
+
 def l2_prior(x: jnp.ndarray) -> jnp.ndarray:
     """Quadratic prior toward zero (pose/shape regularizer)."""
     return jnp.mean(x ** 2)

@@ -30,11 +30,13 @@ class FitResult(NamedTuple):
     final_loss: jnp.ndarray    # [...] last-step data loss
     loss_history: jnp.ndarray  # [..., n_steps] data-loss curve
     pca: Optional[jnp.ndarray] = None  # [..., n_pca] when pose_space="pca"
+    trans: Optional[jnp.ndarray] = None  # [..., 3] when fit_trans=True
 
 
 def _fit_single(
     params: ManoParams,
-    target: jnp.ndarray,  # [V, 3] (data_term="verts") or [J, 3] ("joints")
+    target: jnp.ndarray,  # [V, 3] | [J, 3] | [J, 2] (see data_term)
+    conf: Optional[jnp.ndarray] = None,  # [J] keypoint confidences
     *,
     n_steps: int,
     optimizer: optax.GradientTransformation,
@@ -43,11 +45,16 @@ def _fit_single(
     pose_prior_weight: float,
     shape_prior_weight: float,
     data_term: str = "verts",
+    camera=None,
+    fit_trans: bool = False,
 ) -> FitResult:
-    if data_term not in ("verts", "joints"):
+    if data_term not in ("verts", "joints", "keypoints2d"):
         raise ValueError(
-            f"data_term must be 'verts' or 'joints', got {data_term!r}"
+            "data_term must be 'verts', 'joints' or 'keypoints2d', "
+            f"got {data_term!r}"
         )
+    if data_term == "keypoints2d" and camera is None:
+        raise ValueError("data_term='keypoints2d' needs a viz.camera.Camera")
     dtype = params.v_template.dtype
     n_joints = params.j_regressor.shape[0]
     n_shape = params.shape_basis.shape[-1]
@@ -62,6 +69,11 @@ def _fit_single(
     else:
         raise ValueError(f"pose_space must be 'aa' or 'pca', got {pose_space!r}")
     theta0["shape"] = jnp.zeros((n_shape,), dtype)
+    if fit_trans:
+        # Global translation DOF: the model itself has none (the reference
+        # keeps hands at the origin), but image-space fitting needs the
+        # hand placed in the camera frustum.
+        theta0["trans"] = jnp.zeros((3,), dtype)
 
     def decode(p):
         if pose_space == "aa":
@@ -70,13 +82,21 @@ def _fit_single(
 
     def loss_fn(p):
         out = core.forward(params, decode(p), p["shape"])
+        offset = p["trans"] if fit_trans else 0.0
         if data_term == "verts":
-            data = objectives.vertex_l2(out.verts, target)
-        else:
+            data = objectives.vertex_l2(out.verts + offset, target)
+        elif data_term == "joints":
             # Sparse-keypoint fitting: 16 posed joints (detector/mocap
             # output) instead of a full target mesh. Shape is weakly
             # observable from joints alone - pair with shape_prior_weight.
-            data = objectives.joint_l2(out.posed_joints, target)
+            data = objectives.joint_l2(out.posed_joints + offset, target)
+        else:
+            # 2D keypoints: posed joints through the pinhole projection.
+            # Depth is only observable through perspective scaling, so use
+            # priors (and fit_trans=True) — the problem is ill-posed
+            # without them.
+            xy = camera.project(out.posed_joints + offset)[..., :2]
+            data = objectives.keypoint2d_l2(xy, target, conf)
         # Prior weights may be traced scalars (see fit): plain multiplies.
         reg = (
             pose_prior_weight
@@ -107,16 +127,19 @@ def _fit_single(
         final_loss=final_loss,
         loss_history=history,
         pca=p_final.get("pca"),
+        trans=p_final.get("trans"),
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_steps", "pose_space", "n_pca", "data_term"),
+    static_argnames=("n_steps", "pose_space", "n_pca", "data_term",
+                     "fit_trans"),
 )
 def fit(
     params: ManoParams,
-    target_verts: jnp.ndarray,  # [V, 3] or [B, V, 3] ([J, 3] for joints)
+    target_verts: jnp.ndarray,  # [V, 3] or [B, V, 3] ([J, 3] joints;
+                                # [J, 2] keypoints2d)
     n_steps: int = 200,
     lr: float = 0.05,
     pose_space: str = "aa",
@@ -124,21 +147,30 @@ def fit(
     pose_prior_weight: float = 0.0,
     shape_prior_weight: float = 0.0,
     data_term: str = "verts",
+    camera=None,
+    target_conf: Optional[jnp.ndarray] = None,  # [J] or [B, J]
+    fit_trans: bool = False,
 ) -> FitResult:
     """Recover pose/shape for one target mesh or a batch of them.
 
     Batched targets fit as independent problems in parallel (vmap); this is
     BASELINE.json config 4 at batch=256. ``lr`` and the prior weights are
     traced operands, so a hyperparameter sweep reuses one compiled program.
-    For a custom optimizer use ``fit_with_optimizer`` (not jitted at this
-    level so the transformation can be any optax object).
+    ``data_term='keypoints2d'`` fits 2D detector output: posed joints are
+    projected through ``camera`` (a ``viz.camera.Camera``) and compared in
+    image space, optionally confidence-weighted; pair with
+    ``fit_trans=True`` (adds a global translation DOF) and nonzero priors
+    — depth is only observable through perspective scaling. For a custom
+    optimizer use ``fit_with_optimizer`` (not jitted at this level so the
+    transformation can be any optax object).
     """
     return fit_with_optimizer(
         params, target_verts, optax.adam(lr),
         n_steps=n_steps, pose_space=pose_space, n_pca=n_pca,
         pose_prior_weight=pose_prior_weight,
         shape_prior_weight=shape_prior_weight,
-        data_term=data_term,
+        data_term=data_term, camera=camera, target_conf=target_conf,
+        fit_trans=fit_trans,
     )
 
 
@@ -152,6 +184,9 @@ def fit_with_optimizer(
     pose_prior_weight: float = 0.0,
     shape_prior_weight: float = 0.0,
     data_term: str = "verts",
+    camera=None,
+    target_conf: Optional[jnp.ndarray] = None,
+    fit_trans: bool = False,
 ) -> FitResult:
     single = functools.partial(
         _fit_single,
@@ -163,8 +198,24 @@ def fit_with_optimizer(
         pose_prior_weight=pose_prior_weight,
         shape_prior_weight=shape_prior_weight,
         data_term=data_term,
+        camera=camera,
+        fit_trans=fit_trans,
     )
+    if data_term != "keypoints2d" and (camera is not None
+                                       or target_conf is not None):
+        # These operands only enter the keypoints2d loss; accepting them
+        # elsewhere would silently fit unweighted/unprojected data.
+        raise ValueError(
+            "camera/target_conf only apply to data_term='keypoints2d', "
+            f"got data_term={data_term!r}"
+        )
     target_verts = jnp.asarray(target_verts, params.v_template.dtype)
+    if target_conf is not None:
+        target_conf = jnp.asarray(target_conf, params.v_template.dtype)
     if target_verts.ndim == 2:
-        return single(target_verts)
-    return jax.vmap(single)(target_verts)
+        return single(target_verts, target_conf)
+    # Batched problems: map conf per-problem when it is [B, J]; a shared
+    # [J] conf (or None) broadcasts via in_axes=None.
+    conf_axis = 0 if (target_conf is not None
+                      and target_conf.ndim == 2) else None
+    return jax.vmap(single, in_axes=(0, conf_axis))(target_verts, target_conf)

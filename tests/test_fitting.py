@@ -121,3 +121,126 @@ def test_fit_rejects_bad_data_term(params32):
     target = core.forward(params32).verts
     with pytest.raises(ValueError, match="data_term"):
         fit(params32, target, n_steps=2, data_term="nope")
+
+
+def _project_joints(params32, camera, pose, trans):
+    out = core.forward(params32, jnp.asarray(pose))
+    pj = out.posed_joints + jnp.asarray(trans, jnp.float32)
+    return camera.project(pj)[..., :2]
+
+
+def test_fit_to_2d_keypoints(params32):
+    """Image-space fitting: recover pose + global translation from 16
+    projected keypoints through a pinhole camera (detector-style input)."""
+    from mano_hand_tpu.viz.camera import default_hand_camera
+
+    camera = default_hand_camera()
+    rng = np.random.default_rng(5)
+    pose = rng.normal(scale=0.25, size=(16, 3)).astype(np.float32)
+    trans = np.array([0.03, -0.02, 0.05], np.float32)
+    target_xy = _project_joints(params32, camera, pose, trans)
+
+    res = fit(params32, target_xy, n_steps=400, lr=0.02,
+              data_term="keypoints2d", camera=camera, fit_trans=True,
+              pose_space="pca", n_pca=15,
+              pose_prior_weight=1e-4, shape_prior_weight=1e-3)
+    assert res.trans is not None and res.trans.shape == (3,)
+    # Reprojection of the recovered configuration must land on the targets.
+    out = core.forward(params32, res.pose, res.shape)
+    xy = camera.project(out.posed_joints + res.trans)[..., :2]
+    reproj = float(np.max(np.linalg.norm(np.asarray(xy) - target_xy, axis=-1)))
+    assert float(res.loss_history[0]) > 100 * float(res.final_loss)
+    assert reproj < 5e-3  # NDC units; image is ~2 units across
+
+
+def test_fit_to_2d_keypoints_confidence_masks_outliers(params32):
+    """A zero-confidence keypoint may be arbitrarily corrupted without
+    degrading the fit of the trusted ones."""
+    from mano_hand_tpu.viz.camera import default_hand_camera
+
+    camera = default_hand_camera()
+    rng = np.random.default_rng(6)
+    pose = rng.normal(scale=0.2, size=(16, 3)).astype(np.float32)
+    target_xy = np.asarray(
+        _project_joints(params32, camera, pose, np.zeros(3))
+    ).copy()
+    target_xy[7] += 10.0                    # wildly wrong detection
+    conf = np.ones(16, np.float32)
+    conf[7] = 0.0
+
+    res = fit(params32, target_xy, n_steps=300, lr=0.02,
+              data_term="keypoints2d", camera=camera, target_conf=conf,
+              pose_space="pca", n_pca=15,
+              pose_prior_weight=1e-4, shape_prior_weight=1e-3)
+    out = core.forward(params32, res.pose, res.shape)
+    xy = np.asarray(camera.project(out.posed_joints)[..., :2])
+    good = np.linalg.norm(xy - target_xy, axis=-1)[conf > 0]
+    assert good.max() < 5e-3
+
+
+def test_fit_to_2d_keypoints_batched(params32):
+    from mano_hand_tpu.viz.camera import default_hand_camera
+
+    camera = default_hand_camera()
+    rng = np.random.default_rng(7)
+    poses = rng.normal(scale=0.2, size=(3, 16, 3)).astype(np.float32)
+    targets = np.stack([
+        np.asarray(_project_joints(params32, camera, p, np.zeros(3)))
+        for p in poses
+    ])
+    res = fit(params32, targets, n_steps=200, lr=0.02,
+              data_term="keypoints2d", camera=camera, fit_trans=True,
+              pose_space="pca", n_pca=15,
+              pose_prior_weight=1e-4, shape_prior_weight=1e-3)
+    assert res.pose.shape == (3, 16, 3)
+    assert res.trans.shape == (3, 3)
+    assert np.all(np.asarray(res.final_loss) < np.asarray(res.loss_history[:, 0]))
+
+
+def test_fit_keypoints2d_requires_camera(params32):
+    with pytest.raises(ValueError, match="camera"):
+        fit(params32, np.zeros((16, 2), np.float32), n_steps=2,
+            data_term="keypoints2d")
+
+
+def test_fit_to_2d_keypoints_batched_shared_conf(params32):
+    """A shared [J] confidence broadcasts across a [B, J, 2] target batch."""
+    from mano_hand_tpu.viz.camera import default_hand_camera
+
+    camera = default_hand_camera()
+    rng = np.random.default_rng(8)
+    poses = rng.normal(scale=0.2, size=(3, 16, 3)).astype(np.float32)
+    targets = np.stack([
+        np.asarray(_project_joints(params32, camera, p, np.zeros(3)))
+        for p in poses
+    ])
+    res = fit(params32, targets, n_steps=50, lr=0.02,
+              data_term="keypoints2d", camera=camera,
+              target_conf=np.ones(16, np.float32),
+              pose_space="pca", n_pca=15,
+              pose_prior_weight=1e-4, shape_prior_weight=1e-3)
+    assert res.pose.shape == (3, 16, 3)
+    assert np.all(np.asarray(res.final_loss) < np.asarray(res.loss_history[:, 0]))
+
+
+def test_keypoint2d_l2_reduction_shapes():
+    """Per-problem reduction is over the keypoint axis only, with or
+    without confidences."""
+    from mano_hand_tpu.fitting import keypoint2d_l2
+
+    p = jnp.zeros((4, 16, 2))
+    t = jnp.ones((4, 16, 2))
+    assert keypoint2d_l2(p, t).shape == (4,)
+    assert keypoint2d_l2(p, t, jnp.ones((4, 16))).shape == (4,)
+    np.testing.assert_allclose(
+        np.asarray(keypoint2d_l2(p, t)),
+        np.asarray(keypoint2d_l2(p, t, jnp.ones((4, 16)))),
+        rtol=1e-6,
+    )
+
+
+def test_conf_camera_rejected_for_3d_terms(params32):
+    target = core.forward(params32).verts
+    with pytest.raises(ValueError, match="keypoints2d"):
+        fit(params32, target, n_steps=2, data_term="verts",
+            target_conf=np.ones(16, np.float32))
