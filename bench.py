@@ -344,6 +344,29 @@ def run_benchmarks(args, device_str: str) -> dict:
     section("config2_precision", config2_precision)
     section("config2_precision_highest", config2_precision_highest)
 
+    # -- compiled cost analysis: XLA's own FLOP/byte count for config2 ------
+    # Cross-checks the hand FLOP model (flops_per_eval) against what the
+    # compiler actually scheduled; compile-only, nothing executes.
+    def cost_analysis():
+        fwd = jax.jit(lambda prm, p, s: core.forward_batched(prm, p, s).verts)
+        ca = fwd.lower(right, pose2, beta2).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if not ca:
+            log("cost_analysis empty on this backend")
+            return
+        flops = float(ca.get("flops", 0.0))
+        byts = float(ca.get("bytes accessed", 0.0))
+        if flops:
+            results["xla_flops_per_eval"] = flops / b2
+        if byts:
+            results["xla_hbm_bytes_per_eval"] = byts / b2
+        log(f"XLA cost analysis (batch {b2}): {flops / b2:,.0f} FLOP/eval, "
+            f"{byts / b2:,.0f} bytes/eval "
+            f"(hand model: {flops_per_eval():,.0f} FLOP/eval)")
+
+    section("cost_analysis", cost_analysis)
+
     # -- config 3: batch=65536, left+right interleaved (chunked) ------------
     b3 = max(2, args.big_batch - (args.big_batch % 2))
     half = b3 // 2
@@ -381,27 +404,30 @@ def run_benchmarks(args, device_str: str) -> dict:
         }[args.pallas_sweep]
         if not sweep:
             return
+        def time_pallas(launch_b, block_b, block_v):
+            """Evals/s of the two-hand pallas path at one launch size."""
+            def interleaved_pallas(prm_pair, p, s):
+                pl_, pr_ = prm_pair
+                vl = core.forward_batched_pallas(
+                    pl_, p[:half][:launch_b], s[:half][:launch_b],
+                    block_b=block_b, block_v=block_v)
+                vr = core.forward_batched_pallas(
+                    pr_, p[half:][:launch_b], s[half:][:launch_b],
+                    block_b=block_b, block_v=block_v)
+                return vl.sum() + vr.sum()
+
+            fwd3p = loop_scalar(interleaved_pallas)
+            t3p = slope_time(
+                lambda m: looped(fwd3p, m, (left, right), pose3, beta3),
+                1, 5, iters=max(3, args.iters // 3),
+            )
+            return 2 * launch_b / t3p
+
         b3b = min(half, 8192)  # one un-chunked pallas launch per hand
         best = None
         for block_b, block_v in sweep:
-            def interleaved_pallas(prm_pair, p, s,
-                                   bb=block_b, bv=block_v):
-                pl_, pr_ = prm_pair
-                vl = core.forward_batched_pallas(
-                    pl_, p[:half][:b3b], s[:half][:b3b],
-                    block_b=bb, block_v=bv)
-                vr = core.forward_batched_pallas(
-                    pr_, p[half:][:b3b], s[half:][:b3b],
-                    block_b=bb, block_v=bv)
-                return vl.sum() + vr.sum()
-
             try:
-                fwd3p = loop_scalar(interleaved_pallas)
-                t3p = slope_time(
-                    lambda m: looped(fwd3p, m, (left, right), pose3, beta3),
-                    1, 5, iters=max(3, args.iters // 3),
-                )
-                rate = 2 * b3b / t3p
+                rate = time_pallas(b3b, block_b, block_v)
                 log(f"config3b pallas block_b={block_b} block_v={block_v}: "
                     f"{rate:,.0f} evals/s")
                 if np.isfinite(rate) and (best is None or rate > best[0]):
@@ -411,10 +437,29 @@ def run_benchmarks(args, device_str: str) -> dict:
                     f"{type(e).__name__}: {str(e)[:200]}")
         if best is None:
             raise RuntimeError("no pallas block config succeeded")
+
+        # Launch-size sweep at the winning block: bigger launches amortize
+        # grid setup and keep the MXU busier, until the [B, J, 3, 3]
+        # pre-skinning intermediates start paying HBM round-trips.
+        best_launch = b3b
+        for launch_b in (16384, 32768):
+            if launch_b > half or launch_b == b3b:
+                continue
+            try:
+                rate = time_pallas(launch_b, best[1], best[2])
+                log(f"config3b pallas launch={launch_b}: {rate:,.0f} evals/s")
+                if np.isfinite(rate) and rate > best[0]:
+                    best = (rate, best[1], best[2])
+                    best_launch = launch_b
+            except Exception as e:
+                log(f"config3b launch {launch_b} failed: "
+                    f"{type(e).__name__}: {str(e)[:200]}")
+
         results["config3_pallas_evals_per_sec"] = best[0]
         results["pallas_best_block"] = f"b={best[1]},v={best[2]}"
+        results["pallas_best_launch"] = best_launch
         log(f"config3b best: {best[0]:,.0f} evals/s at block_b={best[1]} "
-            f"block_v={best[2]}")
+            f"block_v={best[2]} launch={best_launch}")
 
         # Accuracy probe through the COMPILED kernel at the winning block:
         # the headline path's numerics must be measured on-chip, not assumed

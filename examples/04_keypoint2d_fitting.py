@@ -1,0 +1,77 @@
+"""Image-space inverse MANO: fit pose + global translation to 2D keypoints.
+
+Detector-style input — 16 joints observed only as 2D image points through a
+pinhole camera — fitted by projecting the model's posed joints through the
+same differentiable camera and descending the confidence-weighted
+reprojection error. One compiled program; depth enters only through
+perspective scaling, so priors and the translation DOF do the work the
+missing third coordinate can't.
+
+    python examples/04_keypoint2d_fitting.py [--platform cpu]
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default="")
+    ap.add_argument("--steps", type=int, default=400)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.assets import synthetic_params
+    from mano_hand_tpu.fitting import fit
+    from mano_hand_tpu.models import core
+    from mano_hand_tpu.viz.camera import default_hand_camera
+
+    params = synthetic_params(seed=0).astype(np.float32)
+    camera = default_hand_camera()
+    rng = np.random.default_rng(2)
+
+    # Ground truth: a posed hand, translated off the origin.
+    true_pose = rng.normal(scale=0.25, size=(16, 3)).astype("f")
+    true_trans = np.array([0.03, -0.02, 0.05], "f")
+    gt = core.forward(params, jnp.asarray(true_pose))
+    keypoints_2d = camera.project(gt.posed_joints + true_trans)[..., :2]
+
+    # Simulated detector confidences: one joint "occluded" (zero weight),
+    # its observation corrupted — the fit must ignore it.
+    conf = np.ones(16, "f")
+    conf[9] = 0.0
+    observed = np.asarray(keypoints_2d).copy()
+    observed[9] += 5.0
+
+    res = fit(
+        params, observed, n_steps=args.steps, lr=0.02,
+        data_term="keypoints2d", camera=camera, target_conf=conf,
+        fit_trans=True, pose_space="pca", n_pca=15,
+        pose_prior_weight=1e-4, shape_prior_weight=1e-3,
+    )
+
+    out = core.forward(params, res.pose, res.shape)
+    reproj = np.asarray(
+        camera.project(out.posed_joints + res.trans)[..., :2]
+    )
+    err = np.linalg.norm(reproj - np.asarray(keypoints_2d), axis=-1)
+    print(f"2D keypoint fit: {args.steps} steps, "
+          f"trusted-joint reprojection max err {err[conf > 0].max():.2e} NDC, "
+          f"occluded joint err {err[9]:.2e} (excluded from the loss)")
+    print(f"recovered translation {np.asarray(res.trans).round(4).tolist()} "
+          f"vs true {true_trans.tolist()}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -163,20 +163,23 @@ def cmd_fit(args) -> int:
     from mano_hand_tpu.io.checkpoints import save_fit_result
 
     params = _load_params(args.asset, args.side).astype(np.float32)
-    targets = np.load(args.targets)  # [V|J, 3] or [B, V|J, 3]
-    n_rows = (
-        params.n_joints if args.data_term == "joints" else params.n_verts
-    )
-    if targets.ndim not in (2, 3) or targets.shape[-2:] != (n_rows, 3):
+    targets = np.load(args.targets)  # [V|J, 3|2] or [B, V|J, 3|2]
+    if args.data_term == "keypoints2d":
+        want = (params.n_joints, 2)
+    elif args.data_term == "joints":
+        want = (params.n_joints, 3)
+    else:
+        want = (params.n_verts, 3)
+    if targets.ndim not in (2, 3) or targets.shape[-2:] != want:
         print(
-            f"targets must be [{n_rows}, 3] or "
-            f"[B, {n_rows}, 3] for --data-term {args.data_term}, "
+            f"targets must be [{want[0]}, {want[1]}] or "
+            f"[B, {want[0]}, {want[1]}] for --data-term {args.data_term}, "
             f"got {targets.shape}",
             file=sys.stderr,
         )
         return 2
     if args.solver is None:
-        args.solver = "adam" if args.data_term == "joints" else "lm"
+        args.solver = "lm" if args.data_term == "verts" else "adam"
     steps = (
         args.steps if args.steps is not None
         else (25 if args.solver == "lm" else 200)
@@ -189,7 +192,7 @@ def cmd_fit(args) -> int:
             print("note: --shape-prior only applies to --solver adam; "
                   "ignored", file=sys.stderr)
         if args.data_term != "verts":
-            print("--data-term joints requires --solver adam",
+            print(f"--data-term {args.data_term} requires --solver adam",
                   file=sys.stderr)
             return 2
         res = fitting.fit_lm(params, targets, n_steps=steps)
@@ -198,13 +201,54 @@ def cmd_fit(args) -> int:
         # (unless the user set an explicit weight).
         shape_prior = (
             args.shape_prior if args.shape_prior is not None
-            else (1e-3 if args.data_term == "joints" else 0.0)
+            else (0.0 if args.data_term == "verts" else 1e-3)
         )
+        kp2d = {}
+        default_lr = 0.05
+        if args.data_term == "keypoints2d":
+            from mano_hand_tpu.viz.camera import look_at
+
+            try:
+                eye = [float(x) for x in args.camera_eye.split(",")]
+                if len(eye) != 3:
+                    raise ValueError(f"need 3 components, got {len(eye)}")
+            except ValueError as e:
+                print(f"--camera-eye must be 'x,y,z': {e}", file=sys.stderr)
+                return 2
+            conf = None
+            if args.conf:
+                conf = np.load(args.conf).astype(np.float32)
+                want_conf = targets.shape[:-1]
+                if conf.shape not in (want_conf, want_conf[-1:]):
+                    print(f"--conf must be {list(want_conf)} (or "
+                          f"[{want_conf[-1]}] shared) to match targets "
+                          f"{targets.shape}, got {conf.shape}",
+                          file=sys.stderr)
+                    return 2
+            # 2D data is depth-blind: fit a global translation, use the
+            # better-conditioned PCA pose space, a mild pose prior, and a
+            # gentler step (the defaults the library-level tests validate).
+            default_lr = 0.02
+            kp2d = dict(
+                camera=look_at(eye=eye, focal=args.focal),
+                target_conf=conf,
+                fit_trans=True,
+                pose_space="pca",
+                n_pca=15,
+                pose_prior_weight=1e-4,
+            )
+        elif args.conf is not None:
+            # Mirror the library-level guard (solvers reject conf/camera
+            # outside keypoints2d) instead of silently dropping the file.
+            print("--conf only applies to --data-term keypoints2d",
+                  file=sys.stderr)
+            return 2
         res = fitting.fit(
             params, targets, n_steps=steps,
-            lr=0.05 if args.lr is None else args.lr,
+            lr=default_lr if args.lr is None else args.lr,
             data_term=args.data_term,
             shape_prior_weight=shape_prior,
+            **kp2d,
         )
     jax.block_until_ready(res.pose)
     path = save_fit_result(res, args.out)
@@ -273,29 +317,41 @@ def build_parser() -> argparse.ArgumentParser:
 
     f = sub.add_parser(
         "fit",
-        help="recover pose/shape from target verts or 3D joint keypoints",
+        help="recover pose/shape from target verts, 3D joints, or 2D "
+             "keypoints",
     )
     f.add_argument("targets",
-                   help=".npy of [V,3]/[B,V,3] verts (or [16,3]/[B,16,3] "
-                        "joints with --data-term joints)")
+                   help=".npy of [V,3]/[B,V,3] verts; [16,3]/[B,16,3] "
+                        "joints with --data-term joints; [16,2]/[B,16,2] "
+                        "image points with --data-term keypoints2d")
     f.add_argument("--data-term", default="verts",
-                   choices=["verts", "joints"],
-                   help="fit to a full target mesh or to sparse 3D "
-                        "keypoints (detector/mocap output)")
+                   choices=["verts", "joints", "keypoints2d"],
+                   help="fit to a full target mesh, sparse 3D keypoints "
+                        "(detector/mocap output), or 2D keypoints "
+                        "projected through a pinhole camera")
+    f.add_argument("--conf", default=None,
+                   help=".npy of [16]/[B,16] keypoint confidences "
+                        "(keypoints2d only)")
+    f.add_argument("--camera-eye", default="0,0,-0.75",
+                   help="camera position 'x,y,z' looking at the origin "
+                        "(keypoints2d only)")
+    f.add_argument("--focal", type=float, default=2.2,
+                   help="pinhole focal in NDC units (keypoints2d only)")
     f.add_argument("--shape-prior", type=float, default=None,
                    help="L2 prior weight on shape coefficients; default 0 "
-                        "for verts, 1e-3 for joints (16 keypoints observe "
-                        "shape only weakly)")
+                        "for verts, 1e-3 for joints/keypoints2d (16 "
+                        "keypoints observe shape only weakly)")
     f.add_argument("--asset", default="synthetic")
     f.add_argument("--side", default=None, choices=[None, "left", "right"])
     f.add_argument("--solver", default=None, choices=["lm", "adam"],
                    help="default: lm for --data-term verts, adam for "
-                        "joints (lm's Gauss-Newton system is built on the "
-                        "vertex residual)")
+                        "joints/keypoints2d (lm's Gauss-Newton system is "
+                        "built on the vertex residual)")
     f.add_argument("--steps", type=int, default=None,
                    help="default: 25 (lm) / 200 (adam)")
     f.add_argument("--lr", type=float, default=None,
-                   help="adam learning rate (default 0.05; adam only)")
+                   help="adam learning rate (default 0.05; 0.02 for "
+                        "keypoints2d; adam only)")
     f.add_argument("--out", default="fit.npz")
     f.set_defaults(fn=cmd_fit)
 

@@ -135,3 +135,62 @@ def test_convert_official_pickle_to_npz(tmp_path, params):
     np.testing.assert_array_equal(back.v_template, params.v_template)
     assert back.parents[0] == -1
     assert back.side == "left"
+
+
+def test_fit_subcommand_keypoints2d(tmp_path, capsys):
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+    from mano_hand_tpu.viz.camera import look_at
+
+    p32 = synthetic_params(seed=0).astype(np.float32)
+    rng = np.random.default_rng(1)
+    pose = rng.normal(scale=0.2, size=(16, 3)).astype(np.float32)
+    cam = look_at(eye=(0.0, 0.0, -0.75), focal=2.2)  # the CLI default
+    gt = core.forward(p32, jnp.asarray(pose))
+    xy = np.asarray(cam.project(gt.posed_joints)[..., :2])
+    conf = np.ones(16, np.float32)
+    np.save(tmp_path / "kp.npy", xy)
+    np.save(tmp_path / "conf.npy", conf)
+    out = tmp_path / "fit2d.npz"
+    rc = cli.main([
+        "fit", str(tmp_path / "kp.npy"), "--data-term", "keypoints2d",
+        "--conf", str(tmp_path / "conf.npy"), "--steps", "150",
+        "--out", str(out),
+    ])
+    assert rc == 0
+    ckpt = np.load(out)
+    assert "trans" in ckpt and ckpt["trans"].shape == (3,)
+
+
+def test_fit_subcommand_keypoints2d_rejects_lm(tmp_path, capsys):
+    np.save(tmp_path / "kp.npy", np.zeros((16, 2), np.float32))
+    rc = cli.main([
+        "fit", str(tmp_path / "kp.npy"), "--data-term", "keypoints2d",
+        "--solver", "lm",
+    ])
+    assert rc == 2
+
+
+def test_fit_subcommand_rejects_misused_or_bad_kp2d_flags(tmp_path, capsys):
+    np.save(tmp_path / "j.npy", np.zeros((16, 3), np.float32))
+    np.save(tmp_path / "conf.npy", np.ones(16, np.float32))
+    # conf with a 3D data term is an error, not silently dropped
+    rc = cli.main(["fit", str(tmp_path / "j.npy"), "--data-term", "joints",
+                   "--conf", str(tmp_path / "conf.npy"), "--steps", "2"])
+    assert rc == 2
+    assert "keypoints2d" in capsys.readouterr().err
+    # malformed camera spec exits cleanly
+    np.save(tmp_path / "kp.npy", np.zeros((16, 2), np.float32))
+    rc = cli.main(["fit", str(tmp_path / "kp.npy"),
+                   "--data-term", "keypoints2d", "--camera-eye", "0,0",
+                   "--steps", "2"])
+    assert rc == 2
+    assert "camera-eye" in capsys.readouterr().err
+    # wrong-shape conf exits cleanly
+    np.save(tmp_path / "badconf.npy", np.ones((3, 16), np.float32))
+    rc = cli.main(["fit", str(tmp_path / "kp.npy"),
+                   "--data-term", "keypoints2d",
+                   "--conf", str(tmp_path / "badconf.npy"), "--steps", "2"])
+    assert rc == 2
+    assert "conf" in capsys.readouterr().err

@@ -29,6 +29,7 @@ def test_example_runs(script, tmp_path):
     extra = {
         "02_fitting": ["--batch", "2"],
         "03_two_hands_video": ["--frames", "4", "--size", "48"],
+        "04_keypoint2d_fitting": ["--steps", "150"],
     }.get(script.stem, [])
     out = _run(script, *extra, tmp_path=tmp_path)
     assert "wrote" in out or "fit" in out
