@@ -184,6 +184,12 @@ def cmd_fit(args) -> int:
         args.steps if args.steps is not None
         else (25 if args.solver == "lm" else 200)
     )
+    if args.conf is not None and args.data_term != "keypoints2d":
+        # Mirror the library-level guard (solvers reject conf/camera
+        # outside keypoints2d) instead of silently dropping the file.
+        print("--conf only applies to --data-term keypoints2d",
+              file=sys.stderr)
+        return 2
     if args.solver == "lm":
         if args.lr is not None:
             print("note: --lr only applies to --solver adam; ignored",
@@ -237,12 +243,6 @@ def cmd_fit(args) -> int:
                 n_pca=15,
                 pose_prior_weight=1e-4,
             )
-        elif args.conf is not None:
-            # Mirror the library-level guard (solvers reject conf/camera
-            # outside keypoints2d) instead of silently dropping the file.
-            print("--conf only applies to --data-term keypoints2d",
-                  file=sys.stderr)
-            return 2
         res = fitting.fit(
             params, targets, n_steps=steps,
             lr=default_lr if args.lr is None else args.lr,

@@ -5,12 +5,20 @@ from mano_hand_tpu.fitting.objectives import (
     max_vertex_error,
     vertex_l2,
 )
-from mano_hand_tpu.fitting.solvers import FitResult, fit, fit_with_optimizer
+from mano_hand_tpu.fitting.solvers import (
+    FitResult,
+    SequenceFitResult,
+    fit,
+    fit_sequence,
+    fit_with_optimizer,
+)
 from mano_hand_tpu.fitting.lm import LMResult, fit_lm
 
 __all__ = [
     "FitResult",
+    "SequenceFitResult",
     "fit",
+    "fit_sequence",
     "fit_with_optimizer",
     "LMResult",
     "fit_lm",

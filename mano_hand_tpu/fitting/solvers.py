@@ -33,6 +33,70 @@ class FitResult(NamedTuple):
     trans: Optional[jnp.ndarray] = None  # [..., 3] when fit_trans=True
 
 
+def _check_data_term(data_term: str, camera, conf) -> None:
+    """One validation policy for every solver entry point."""
+    if data_term not in ("verts", "joints", "keypoints2d"):
+        raise ValueError(
+            "data_term must be 'verts', 'joints' or 'keypoints2d', "
+            f"got {data_term!r}"
+        )
+    if data_term == "keypoints2d":
+        if camera is None:
+            raise ValueError(
+                "data_term='keypoints2d' needs a viz.camera.Camera"
+            )
+    elif camera is not None or conf is not None:
+        # Accepting these would silently fit unweighted/unprojected data.
+        raise ValueError(
+            "camera/target_conf only apply to data_term='keypoints2d', "
+            f"got data_term={data_term!r}"
+        )
+
+
+def _data_loss(out, offset, target, data_term: str, camera, conf):
+    """The one data-term dispatch shared by every Adam solver.
+
+    - ``verts``: full-mesh L2.
+    - ``joints``: sparse 3D keypoints (detector/mocap output); shape is
+      weakly observable from 16 joints — pair with shape_prior_weight.
+    - ``keypoints2d``: posed joints through the pinhole projection.
+      Depth is only observable through perspective scaling, so use the
+      priors (and fit_trans=True) — ill-posed without them.
+
+    Returns a scalar: single problems reduce naturally; clip-shaped
+    inputs ([T, ...]) mean over frames.
+    """
+    if data_term == "verts":
+        return objectives.vertex_l2(out.verts + offset, target)
+    if data_term == "joints":
+        return objectives.joint_l2(out.posed_joints + offset, target)
+    xy = camera.project(out.posed_joints + offset)[..., :2]
+    return jnp.mean(objectives.keypoint2d_l2(xy, target, conf))
+
+
+def _run_adam(loss_fn, theta0, optimizer, n_steps: int):
+    """The shared jitted optimization loop: lax.scan over Adam steps.
+
+    ``loss_fn(p) -> (total, data)``; the history records the data loss
+    *before* each update, and the returned parameters are re-evaluated
+    once so final_loss describes them, not the state one step behind.
+    """
+    opt_state0 = optimizer.init(theta0)
+
+    def step(carry, _):
+        p, opt_state = carry
+        (_, data), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        updates, opt_state = optimizer.update(grads, opt_state, p)
+        p = optax.apply_updates(p, updates)
+        return (p, opt_state), data
+
+    (p_final, _), history = jax.lax.scan(
+        step, (theta0, opt_state0), None, length=n_steps
+    )
+    _, final_loss = loss_fn(p_final)
+    return p_final, final_loss, history
+
+
 def _fit_single(
     params: ManoParams,
     target: jnp.ndarray,  # [V, 3] | [J, 3] | [J, 2] (see data_term)
@@ -48,13 +112,7 @@ def _fit_single(
     camera=None,
     fit_trans: bool = False,
 ) -> FitResult:
-    if data_term not in ("verts", "joints", "keypoints2d"):
-        raise ValueError(
-            "data_term must be 'verts', 'joints' or 'keypoints2d', "
-            f"got {data_term!r}"
-        )
-    if data_term == "keypoints2d" and camera is None:
-        raise ValueError("data_term='keypoints2d' needs a viz.camera.Camera")
+    _check_data_term(data_term, camera, conf)
     dtype = params.v_template.dtype
     n_joints = params.j_regressor.shape[0]
     n_shape = params.shape_basis.shape[-1]
@@ -83,20 +141,7 @@ def _fit_single(
     def loss_fn(p):
         out = core.forward(params, decode(p), p["shape"])
         offset = p["trans"] if fit_trans else 0.0
-        if data_term == "verts":
-            data = objectives.vertex_l2(out.verts + offset, target)
-        elif data_term == "joints":
-            # Sparse-keypoint fitting: 16 posed joints (detector/mocap
-            # output) instead of a full target mesh. Shape is weakly
-            # observable from joints alone - pair with shape_prior_weight.
-            data = objectives.joint_l2(out.posed_joints + offset, target)
-        else:
-            # 2D keypoints: posed joints through the pinhole projection.
-            # Depth is only observable through perspective scaling, so use
-            # priors (and fit_trans=True) — the problem is ill-posed
-            # without them.
-            xy = camera.project(out.posed_joints + offset)[..., :2]
-            data = objectives.keypoint2d_l2(xy, target, conf)
+        data = _data_loss(out, offset, target, data_term, camera, conf)
         # Prior weights may be traced scalars (see fit): plain multiplies.
         reg = (
             pose_prior_weight
@@ -105,22 +150,9 @@ def _fit_single(
         )
         return data + reg, data
 
-    opt_state0 = optimizer.init(theta0)
-
-    def step(carry, _):
-        p, opt_state = carry
-        (_, data), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
-        updates, opt_state = optimizer.update(grads, opt_state, p)
-        p = optax.apply_updates(p, updates)
-        return (p, opt_state), data
-
-    (p_final, _), history = jax.lax.scan(
-        step, (theta0, opt_state0), None, length=n_steps
+    p_final, final_loss, history = _run_adam(
+        loss_fn, theta0, optimizer, n_steps
     )
-    # history[k] is the loss *before* update k; evaluate the returned
-    # parameters once more so final_loss describes them, not the state one
-    # step behind.
-    _, final_loss = loss_fn(p_final)
     return FitResult(
         pose=decode(p_final),
         shape=p_final["shape"],
@@ -201,14 +233,7 @@ def fit_with_optimizer(
         camera=camera,
         fit_trans=fit_trans,
     )
-    if data_term != "keypoints2d" and (camera is not None
-                                       or target_conf is not None):
-        # These operands only enter the keypoints2d loss; accepting them
-        # elsewhere would silently fit unweighted/unprojected data.
-        raise ValueError(
-            "camera/target_conf only apply to data_term='keypoints2d', "
-            f"got data_term={data_term!r}"
-        )
+    _check_data_term(data_term, camera, target_conf)
     target_verts = jnp.asarray(target_verts, params.v_template.dtype)
     if target_conf is not None:
         target_conf = jnp.asarray(target_conf, params.v_template.dtype)
@@ -219,3 +244,108 @@ def fit_with_optimizer(
     conf_axis = 0 if (target_conf is not None
                       and target_conf.ndim == 2) else None
     return jax.vmap(single, in_axes=(0, conf_axis))(target_verts, target_conf)
+
+
+# ------------------------------------------------------------- sequences
+class SequenceFitResult(NamedTuple):
+    pose: jnp.ndarray          # [T, 16, 3] per-frame axis-angle pose
+    shape: jnp.ndarray         # [S] ONE shape for the whole clip
+    final_loss: jnp.ndarray    # [] mean per-frame data loss at the end
+    loss_history: jnp.ndarray  # [n_steps] data-loss curve
+    trans: Optional[jnp.ndarray] = None  # [T, 3] when fit_trans=True
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_steps", "data_term", "fit_trans"),
+)
+def fit_sequence(
+    params: ManoParams,
+    targets: jnp.ndarray,  # [T, V, 3] | [T, J, 3] | [T, J, 2]
+    n_steps: int = 300,
+    lr: float = 0.03,
+    data_term: str = "verts",
+    camera=None,
+    target_conf: Optional[jnp.ndarray] = None,  # [T, J] or [J]
+    fit_trans: bool = False,
+    smooth_pose_weight: float = 1.0,
+    smooth_trans_weight: float = 1.0,
+    pose_prior_weight: float = 0.0,
+    shape_prior_weight: float = 1e-3,
+) -> SequenceFitResult:
+    """Track a whole motion clip as ONE optimization problem.
+
+    Unlike vmapping ``fit`` over frames, the clip shares a single shape
+    (one hand, one identity — the per-frame shape ambiguity collapses)
+    and couples consecutive frames with squared-velocity smoothness
+    priors on pose (and translation), so frames with occluded or
+    corrupted observations borrow information from their neighbors.
+    The reference's closest analogue is the serial per-frame animation
+    loop (/root/reference/data_explore.py:12-15); here all T frames'
+    forwards are one batched program inside one jitted Adam loop.
+
+    Pose is parameterized as per-frame axis-angle ([T, 16, 3]) — the
+    natural space for velocity coupling; the smoothness weights scale
+    mean squared frame-to-frame differences.
+    """
+    _check_data_term(data_term, camera, target_conf)
+    dtype = params.v_template.dtype
+    targets = jnp.asarray(targets, dtype)
+    if targets.ndim != 3:
+        # A [V, 3]/[J, 3] single frame would otherwise be read as V or J
+        # one-point frames via broadcasting and fit garbage silently.
+        raise ValueError(
+            "fit_sequence targets must be [T, rows, coords]; for a single "
+            f"frame use fit(). Got shape {targets.shape}"
+        )
+    t_frames = targets.shape[0]
+    n_joints = params.j_regressor.shape[0]
+    n_shape = params.shape_basis.shape[-1]
+    if target_conf is not None:
+        target_conf = jnp.broadcast_to(
+            jnp.asarray(target_conf, dtype), (t_frames, n_joints)
+        )
+
+    theta0 = {
+        "pose": jnp.zeros((t_frames, n_joints, 3), dtype),
+        "shape": jnp.zeros((n_shape,), dtype),
+    }
+    if fit_trans:
+        theta0["trans"] = jnp.zeros((t_frames, 3), dtype)
+
+    def loss_fn(p):
+        shapes = jnp.broadcast_to(p["shape"], (t_frames, n_shape))
+        out = core.forward_batched(params, p["pose"], shapes)
+        offset = (
+            p["trans"][:, None, :] if fit_trans
+            else jnp.zeros((), dtype)
+        )
+        data = _data_loss(out, offset, targets, data_term, camera,
+                          target_conf)
+        # t_frames is static: skip velocity terms for single-frame clips
+        # (mean over an empty array is NaN and would poison every grad).
+        if t_frames > 1:
+            vel = p["pose"][1:] - p["pose"][:-1]
+            reg = smooth_pose_weight * jnp.mean(vel ** 2)
+            if fit_trans:
+                tvel = p["trans"][1:] - p["trans"][:-1]
+                reg = reg + smooth_trans_weight * jnp.mean(tvel ** 2)
+        else:
+            reg = jnp.zeros((), dtype)
+        reg = (
+            reg
+            + pose_prior_weight * objectives.l2_prior(p["pose"])
+            + shape_prior_weight * objectives.l2_prior(p["shape"])
+        )
+        return data + reg, data
+
+    p_final, final_loss, history = _run_adam(
+        loss_fn, theta0, optax.adam(lr), n_steps
+    )
+    return SequenceFitResult(
+        pose=p_final["pose"],
+        shape=p_final["shape"],
+        final_loss=final_loss,
+        loss_history=history,
+        trans=p_final.get("trans"),
+    )

@@ -244,3 +244,129 @@ def test_conf_camera_rejected_for_3d_terms(params32):
     with pytest.raises(ValueError, match="keypoints2d"):
         fit(params32, target, n_steps=2, data_term="verts",
             target_conf=np.ones(16, np.float32))
+
+
+def _smooth_track(rng, t_frames, scale=0.3):
+    """A smooth pose track: slerp-free linear blend of two random poses."""
+    a = rng.normal(scale=scale, size=(16, 3)).astype(np.float32)
+    b = rng.normal(scale=scale, size=(16, 3)).astype(np.float32)
+    w = np.linspace(0.0, 1.0, t_frames, dtype=np.float32)[:, None, None]
+    return (1.0 - w) * a + w * b
+
+
+def test_fit_sequence_recovers_smooth_track(params32):
+    from mano_hand_tpu.fitting import fit_sequence
+
+    rng = np.random.default_rng(10)
+    t_frames = 6
+    poses = _smooth_track(rng, t_frames)
+    shape = rng.normal(scale=0.5, size=10).astype(np.float32)
+    targets = core.forward_batched(
+        params32, jnp.asarray(poses),
+        jnp.broadcast_to(jnp.asarray(shape), (t_frames, 10)),
+    ).verts
+
+    res = fit_sequence(params32, targets, n_steps=600, lr=0.05,
+                       smooth_pose_weight=1e-3, shape_prior_weight=0.0)
+    assert res.pose.shape == (t_frames, 16, 3)
+    assert res.shape.shape == (10,)  # ONE shape for the clip
+    out = core.forward_batched(
+        params32, res.pose,
+        jnp.broadcast_to(res.shape, (t_frames, 10)),
+    )
+    err = float(np.max(np.linalg.norm(
+        np.asarray(out.verts) - np.asarray(targets), axis=-1
+    )))
+    assert float(res.loss_history[0]) > 100 * float(res.final_loss)
+    assert err < 5e-3
+
+
+def test_fit_sequence_keypoints2d_smoothness_bridges_occlusion(params32):
+    """A joint occluded for some frames is constrained by its neighbors:
+    the temporally-coupled fit keeps its reprojection close even where
+    the observation is corrupted and zero-confidence."""
+    from mano_hand_tpu.fitting import fit_sequence
+    from mano_hand_tpu.viz.camera import default_hand_camera
+
+    camera = default_hand_camera()
+    rng = np.random.default_rng(11)
+    t_frames = 6
+    poses = _smooth_track(rng, t_frames, scale=0.2)
+    out_gt = core.forward_batched(
+        params32, jnp.asarray(poses), jnp.zeros((t_frames, 10), jnp.float32)
+    )
+    clean_xy = np.asarray(camera.project(out_gt.posed_joints)[..., :2])
+
+    observed = clean_xy.copy()
+    conf = np.ones((t_frames, 16), np.float32)
+    occluded = [2, 3]
+    observed[occluded, 7] += 3.0       # corrupted detection, joint 7
+    conf[occluded, 7] = 0.0
+
+    res = fit_sequence(params32, observed, n_steps=400, lr=0.02,
+                       data_term="keypoints2d", camera=camera,
+                       target_conf=conf, fit_trans=True,
+                       smooth_pose_weight=1e-2, smooth_trans_weight=1e-2,
+                       pose_prior_weight=1e-4)
+    out = core.forward_batched(
+        params32, res.pose,
+        jnp.broadcast_to(res.shape, (t_frames, 10)),
+    )
+    xy = np.asarray(
+        camera.project(out.posed_joints + res.trans[:, None, :])[..., :2]
+    )
+    err = np.linalg.norm(xy - clean_xy, axis=-1)   # vs CLEAN ground truth
+    assert err[conf > 0].max() < 6e-3
+    # The occluded joint lands near its true location, not the corrupted
+    # observation 3 NDC units away.
+    assert err[occluded, 7].max() < 3e-2
+
+
+def test_fit_sequence_validations(params32):
+    from mano_hand_tpu.fitting import fit_sequence
+
+    target = jnp.zeros((4, 16, 2), jnp.float32)
+    with pytest.raises(ValueError, match="camera"):
+        fit_sequence(params32, target, n_steps=2, data_term="keypoints2d")
+    with pytest.raises(ValueError, match="target_conf"):
+        fit_sequence(params32, jnp.zeros((4, 16, 3), jnp.float32),
+                     n_steps=2, data_term="joints",
+                     target_conf=jnp.ones((4, 16), jnp.float32))
+
+
+def test_fit_sequence_single_frame_no_nan(params32):
+    """A one-frame clip must not NaN out on the empty velocity term."""
+    from mano_hand_tpu.fitting import fit_sequence
+
+    target = core.forward(params32).verts[None]    # [1, V, 3]
+    res = fit_sequence(params32, target, n_steps=20, lr=0.05)
+    assert np.isfinite(np.asarray(res.pose)).all()
+    assert np.isfinite(float(res.final_loss))
+
+
+def test_fit_sequence_rejects_camera_for_3d_terms(params32):
+    from mano_hand_tpu.fitting import fit_sequence
+    from mano_hand_tpu.viz.camera import default_hand_camera
+
+    with pytest.raises(ValueError, match="keypoints2d"):
+        fit_sequence(params32, jnp.zeros((4, 16, 3), jnp.float32),
+                     n_steps=2, data_term="joints",
+                     camera=default_hand_camera())
+
+
+def test_fit_sequence_rejects_single_frame_shape(params32):
+    from mano_hand_tpu.fitting import fit_sequence
+
+    with pytest.raises(ValueError, match="fit_sequence targets"):
+        fit_sequence(params32, jnp.zeros((778, 3), jnp.float32), n_steps=2)
+
+
+def test_cli_conf_rejected_on_lm_path(tmp_path, capsys):
+    from mano_hand_tpu import cli
+
+    np.save(tmp_path / "v.npy", np.zeros((778, 3), np.float32))
+    np.save(tmp_path / "conf.npy", np.ones(16, np.float32))
+    rc = cli.main(["fit", str(tmp_path / "v.npy"), "--solver", "lm",
+                   "--conf", str(tmp_path / "conf.npy"), "--steps", "2"])
+    assert rc == 2
+    assert "keypoints2d" in capsys.readouterr().err
