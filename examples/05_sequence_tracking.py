@@ -1,0 +1,85 @@
+"""Temporal tracking: fit a whole motion clip as one optimization problem.
+
+Noisy per-frame 2D detections (with an occlusion) go in; a smooth,
+temporally-coherent pose track with one shared shape comes out. The
+squared-velocity smoothness priors let occluded frames borrow from their
+neighbors, and the whole clip — every frame's forward and backward pass,
+every Adam step — is one compiled XLA program.
+
+    python examples/05_sequence_tracking.py [--platform cpu]
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default="")
+    ap.add_argument("--frames", type=int, default=12)
+    ap.add_argument("--steps", type=int, default=400)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.assets import synthetic_params
+    from mano_hand_tpu.fitting import fit_sequence
+    from mano_hand_tpu.models import core
+    from mano_hand_tpu.viz.camera import default_hand_camera
+
+    params = synthetic_params(seed=0).astype(np.float32)
+    camera = default_hand_camera()
+    rng = np.random.default_rng(3)
+    t = args.frames
+
+    # Ground truth: a smooth pose track between two keyframes.
+    a = rng.normal(scale=0.25, size=(16, 3)).astype("f")
+    b = rng.normal(scale=0.25, size=(16, 3)).astype("f")
+    w = np.linspace(0, 1, t, dtype=np.float32)[:, None, None]
+    true_poses = (1 - w) * a + w * b
+    gt = core.forward_batched(
+        params, jnp.asarray(true_poses), jnp.zeros((t, 10), jnp.float32)
+    )
+    clean_xy = np.asarray(camera.project(gt.posed_joints)[..., :2])
+
+    # Simulated detections: pixel noise everywhere, one joint occluded
+    # (zero confidence, corrupted observation) for the middle third.
+    observed = clean_xy + rng.normal(scale=2e-3, size=clean_xy.shape).astype("f")
+    conf = np.ones((t, 16), "f")
+    occ = slice(t // 3, 2 * t // 3)
+    observed[occ, 7] += 3.0
+    conf[occ, 7] = 0.0
+
+    res = fit_sequence(
+        params, observed, n_steps=args.steps, lr=0.02,
+        data_term="keypoints2d", camera=camera, target_conf=conf,
+        fit_trans=True, smooth_pose_weight=1e-2, smooth_trans_weight=1e-2,
+        pose_prior_weight=1e-4,
+    )
+
+    out = core.forward_batched(
+        params, res.pose, jnp.broadcast_to(res.shape, (t, 10))
+    )
+    track = np.asarray(
+        camera.project(out.posed_joints + res.trans[:, None, :])[..., :2]
+    )
+    err = np.linalg.norm(track - clean_xy, axis=-1)
+    print(f"tracked {t} frames x {args.steps} steps: "
+          f"mean reprojection err {err.mean():.2e} NDC "
+          f"(observation noise 2e-3)")
+    print(f"occluded joint, occluded frames: {err[occ, 7].max():.2e} "
+          "(bridged by temporal smoothness, not observed)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
