@@ -374,25 +374,35 @@ def run_benchmarks(args, device_str: str) -> dict:
     pose3 = jnp.asarray(rng.normal(scale=0.6, size=(b3, 16, 3)), jnp.float32)
     beta3 = jnp.asarray(rng.normal(size=(b3, 10)), jnp.float32)
 
-    def config3():
+    def chunked_interleaved(**chunk_kw):
+        """Full-batch two-hand workload, halves on separate param sets."""
         def interleaved(prm_pair, p, s):
-            # alternate hands by halves: two param sets, one graph
             pl, pr = prm_pair
-            vl = core.forward_chunked(pl, p[:half], s[:half], chunk)
-            vr = core.forward_chunked(pr, p[half:], s[half:], chunk)
+            vl = core.forward_chunked(pl, p[:half], s[:half], chunk,
+                                      **chunk_kw)
+            vr = core.forward_chunked(pr, p[half:], s[half:], chunk,
+                                      **chunk_kw)
             return vl.sum() + vr.sum()
 
-        fwd3 = loop_scalar(interleaved)
+        return interleaved
+
+    def time_chunked(**chunk_kw):
+        fwd3 = loop_scalar(chunked_interleaved(**chunk_kw))
         t3 = slope_time(lambda m: looped(fwd3, m, (left, right), pose3, beta3),
                         1, 3, iters=max(3, args.iters // 3))
-        results["config3_b65536_evals_per_sec"] = b3 / t3
-        log(f"config3 batch={b3} L+R: {b3 / t3:,.0f} evals/s "
+        return b3 / t3, t3
+
+    def config3():
+        rate, t3 = time_chunked()
+        results["config3_b65536_evals_per_sec"] = rate
+        log(f"config3 batch={b3} L+R: {rate:,.0f} evals/s "
             f"({t3 * 1e3:.1f} ms)")
 
     section("config3", config3)
 
     # -- config 3b: Pallas fused-skinning kernel, block-size sweep ----------
     verts_pallas = None  # [8, V, 3] accuracy probe through the COMPILED kernel
+    pallas_best = {}     # sweep winner, consumed by config3p below
 
     def config3b():
         nonlocal verts_pallas
@@ -458,6 +468,7 @@ def run_benchmarks(args, device_str: str) -> dict:
         results["config3_pallas_evals_per_sec"] = best[0]
         results["pallas_best_block"] = f"b={best[1]},v={best[2]}"
         results["pallas_best_launch"] = best_launch
+        pallas_best["block"] = (best[1], best[2])
         log(f"config3b best: {best[0]:,.0f} evals/s at block_b={best[1]} "
             f"block_v={best[2]} launch={best_launch}")
 
@@ -484,6 +495,20 @@ def run_benchmarks(args, device_str: str) -> dict:
         log("config3b pallas VJP compiled + executed")
 
     section("config3b", config3b)
+
+    # -- config 3p: full batch again, pallas-skinned chunks at the winning
+    # block (runs after the sweep so it measures the per-chip best, not a
+    # stale default).
+    def config3_pallas_chunked():
+        if args.pallas_sweep == "off":
+            return
+        bb, bv = pallas_best.get("block", (32, 896))
+        rate, t3p = time_chunked(use_pallas=True, block_b=bb, block_v=bv)
+        results["config3_pallas_chunked_evals_per_sec"] = rate
+        log(f"config3p batch={b3} L+R pallas chunks (b={bb},v={bv}): "
+            f"{rate:,.0f} evals/s ({t3p * 1e3:.1f} ms)")
+
+    section("config3_pallas_chunked", config3_pallas_chunked)
 
     # -- config 4: pose fitting batch=256 -----------------------------------
     def config4():
@@ -661,6 +686,7 @@ def run_benchmarks(args, device_str: str) -> dict:
     # -- headline + roofline -------------------------------------------------
     candidates = [results.get("config2_b1024_evals_per_sec"),
                   results.get("config3_b65536_evals_per_sec"),
+                  results.get("config3_pallas_chunked_evals_per_sec"),
                   results.get("config3_pallas_evals_per_sec")]
     candidates = [c for c in candidates if c is not None and np.isfinite(c)]
     if not candidates:

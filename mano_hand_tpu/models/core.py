@@ -227,7 +227,7 @@ def forward_batched_pallas(
     shape: jnp.ndarray,  # [B, S]
     precision=DEFAULT_PRECISION,
     block_b: int = 32,
-    block_v: int = 128,
+    block_v: int = 896,  # bench sweep winner (docs/benchmarking.md)
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Batched forward with the Pallas fused-LBS kernel; returns verts only.
@@ -308,13 +308,20 @@ def forward_chunked(
     shape: jnp.ndarray,
     chunk_size: int = 8192,
     precision=DEFAULT_PRECISION,
+    use_pallas: bool = False,
+    block_b: int = 32,
+    block_v: int = 896,
+    interpret: bool = False,
 ) -> jnp.ndarray:
     """Memory-bounded huge-batch vertices via lax.map over chunks.
 
     Keeps the per-chunk [chunk, V, 3, 3] LBS intermediate under ~2 GB while
     the MXU stays saturated; returns verts only ([B, V, 3]). Any batch size
     works: a trailing partial chunk is zero-padded internally (static pad,
-    jit-safe) and the padding sliced off the output.
+    jit-safe) and the padding sliced off the output. ``use_pallas`` routes
+    each chunk's skinning through the fused Pallas kernel (the fastest
+    measured path at launch-scale batches — docs/benchmarking.md); block
+    defaults are the bench sweep's winners.
     """
     b = pose.shape[0]
     chunk_size = max(1, min(chunk_size, b))  # max(1,..) keeps B=0 legal
@@ -329,10 +336,16 @@ def forward_chunked(
     n_chunks = (b + pad) // chunk_size
     pose_c = pose.reshape(n_chunks, chunk_size, *pose.shape[1:])
     shape_c = shape.reshape(n_chunks, chunk_size, *shape.shape[1:])
-    verts = jax.lax.map(
-        lambda ps: forward_batched(params, ps[0], ps[1], precision).verts,
-        (pose_c, shape_c),
-    )
+    if use_pallas:
+        chunk_fn = lambda ps: forward_batched_pallas(  # noqa: E731
+            params, ps[0], ps[1], precision,
+            block_b=block_b, block_v=block_v, interpret=interpret,
+        )
+    else:
+        chunk_fn = lambda ps: forward_batched(  # noqa: E731
+            params, ps[0], ps[1], precision
+        ).verts
+    verts = jax.lax.map(chunk_fn, (pose_c, shape_c))
     return verts.reshape(n_chunks * chunk_size, *verts.shape[2:])[:b]
 
 
