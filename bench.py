@@ -408,7 +408,7 @@ def run_benchmarks(args, device_str: str) -> dict:
         nonlocal verts_pallas
         sweep = {
             "off": [],
-            "quick": [(32, 896)],
+            "quick": [core.PALLAS_BEST_BLOCK],
             "full": [(8, 128), (32, 128), (128, 128), (32, 256), (32, 896),
                      (128, 256), (64, 896), (128, 896), (16, 896), (64, 512)],
         }[args.pallas_sweep]
@@ -502,7 +502,7 @@ def run_benchmarks(args, device_str: str) -> dict:
     def config3_pallas_chunked():
         if args.pallas_sweep == "off":
             return
-        bb, bv = pallas_best.get("block", (32, 896))
+        bb, bv = pallas_best.get("block", core.PALLAS_BEST_BLOCK)
         rate, t3p = time_chunked(use_pallas=True, block_b=bb, block_v=bv)
         results["config3_pallas_chunked_evals_per_sec"] = rate
         log(f"config3p batch={b3} L+R pallas chunks (b={bb},v={bv}): "

@@ -1,4 +1,5 @@
 from mano_hand_tpu.fitting.objectives import (
+    huber,
     joint_l2,
     keypoint2d_l2,
     l2_prior,
@@ -25,6 +26,7 @@ __all__ = [
     "vertex_l2",
     "joint_l2",
     "keypoint2d_l2",
+    "huber",
     "l2_prior",
     "max_vertex_error",
 ]

@@ -12,14 +12,24 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def vertex_l2(pred_verts: jnp.ndarray, target_verts: jnp.ndarray) -> jnp.ndarray:
-    """Mean squared vertex distance (the data term)."""
-    return jnp.mean(jnp.sum((pred_verts - target_verts) ** 2, axis=-1))
+def vertex_l2(pred_verts: jnp.ndarray, target_verts: jnp.ndarray,
+              penalty=None) -> jnp.ndarray:
+    """Mean per-vertex penalty (the data term).
+
+    ``penalty`` maps per-point squared distances elementwise (e.g.
+    ``huber``); None means plain squared distance. The solvers route
+    every data term through these helpers, so a change here IS a change
+    to what fit/fit_sequence optimize.
+    """
+    sq = jnp.sum((pred_verts - target_verts) ** 2, axis=-1)
+    return jnp.mean(sq if penalty is None else penalty(sq))
 
 
-def joint_l2(pred_joints: jnp.ndarray, target_joints: jnp.ndarray) -> jnp.ndarray:
-    """Mean squared joint distance (sparser, better conditioned early)."""
-    return jnp.mean(jnp.sum((pred_joints - target_joints) ** 2, axis=-1))
+def joint_l2(pred_joints: jnp.ndarray, target_joints: jnp.ndarray,
+             penalty=None) -> jnp.ndarray:
+    """Mean per-joint penalty (sparser, better conditioned early)."""
+    sq = jnp.sum((pred_joints - target_joints) ** 2, axis=-1)
+    return jnp.mean(sq if penalty is None else penalty(sq))
 
 
 def max_vertex_error(pred_verts: jnp.ndarray, target_verts: jnp.ndarray) -> jnp.ndarray:
@@ -31,6 +41,7 @@ def keypoint2d_l2(
     pred_xy: jnp.ndarray,      # [..., J, 2] projected keypoints
     target_xy: jnp.ndarray,    # [..., J, 2] observed keypoints
     conf: jnp.ndarray = None,  # [..., J] optional per-keypoint confidence
+    penalty=None,              # elementwise map of squared distances
 ) -> jnp.ndarray:
     """(Confidence-weighted) mean squared 2D reprojection error.
 
@@ -42,11 +53,28 @@ def keypoint2d_l2(
     one loss per problem in both the weighted and unweighted branches.
     """
     err = jnp.sum((pred_xy - target_xy) ** 2, axis=-1)
+    if penalty is not None:
+        err = penalty(err)
     if conf is None:
         return jnp.mean(err, axis=-1)
     return jnp.sum(conf * err, axis=-1) / jnp.maximum(
         jnp.sum(conf, axis=-1), 1e-12
     )
+
+
+def huber(sq_dist: jnp.ndarray, delta: float) -> jnp.ndarray:
+    """Huber penalty on per-point SQUARED distances.
+
+    Quadratic (= sq_dist) within ``delta`` of zero, linear in distance
+    beyond — outliers contribute bounded gradients instead of dragging
+    the fit. Formulated on squared distances so the inlier branch never
+    takes a sqrt (grad-safe at exact zero); the outlier branch's sqrt
+    argument is clamped from below by delta^2, away from zero.
+    """
+    d2 = delta * delta
+    inlier = sq_dist <= d2
+    safe = jnp.sqrt(jnp.maximum(sq_dist, d2))
+    return jnp.where(inlier, sq_dist, 2.0 * delta * safe - d2)
 
 
 def l2_prior(x: jnp.ndarray) -> jnp.ndarray:

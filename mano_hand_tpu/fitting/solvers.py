@@ -53,7 +53,8 @@ def _check_data_term(data_term: str, camera, conf) -> None:
         )
 
 
-def _data_loss(out, offset, target, data_term: str, camera, conf):
+def _data_loss(out, offset, target, data_term: str, camera, conf,
+               robust: str = "none", robust_scale: float = 0.01):
     """The one data-term dispatch shared by every Adam solver.
 
     - ``verts``: full-mesh L2.
@@ -63,15 +64,31 @@ def _data_loss(out, offset, target, data_term: str, camera, conf):
       Depth is only observable through perspective scaling, so use the
       priors (and fit_trans=True) — ill-posed without them.
 
-    Returns a scalar: single problems reduce naturally; clip-shaped
-    inputs ([T, ...]) mean over frames.
+    ``robust="huber"`` replaces the per-point squared distance with a
+    Huber penalty at scale ``robust_scale`` (same units as the data:
+    meters for 3D terms, NDC for 2D) — un-flagged outliers contribute
+    bounded gradients. Returns a scalar: single problems reduce
+    naturally; clip-shaped inputs ([T, ...]) mean over frames.
     """
+    if robust not in ("none", "huber"):
+        raise ValueError(f"robust must be 'none' or 'huber', got {robust!r}")
+    if (robust == "huber" and isinstance(robust_scale, (int, float))
+            and robust_scale <= 0):
+        # A zero scale makes the whole data term identically zero (the
+        # fit would silently return the initialization); negative rewards
+        # outliers. robust_scale is static in the jitted entry points, so
+        # it is always concrete there.
+        raise ValueError(f"robust_scale must be > 0, got {robust_scale}")
+    penalty = (
+        (lambda sq: objectives.huber(sq, robust_scale))
+        if robust == "huber" else None
+    )
     if data_term == "verts":
-        return objectives.vertex_l2(out.verts + offset, target)
+        return objectives.vertex_l2(out.verts + offset, target, penalty)
     if data_term == "joints":
-        return objectives.joint_l2(out.posed_joints + offset, target)
+        return objectives.joint_l2(out.posed_joints + offset, target, penalty)
     xy = camera.project(out.posed_joints + offset)[..., :2]
-    return jnp.mean(objectives.keypoint2d_l2(xy, target, conf))
+    return jnp.mean(objectives.keypoint2d_l2(xy, target, conf, penalty))
 
 
 def _run_adam(loss_fn, theta0, optimizer, n_steps: int):
@@ -111,6 +128,8 @@ def _fit_single(
     data_term: str = "verts",
     camera=None,
     fit_trans: bool = False,
+    robust: str = "none",
+    robust_scale: float = 0.01,
 ) -> FitResult:
     _check_data_term(data_term, camera, conf)
     dtype = params.v_template.dtype
@@ -141,7 +160,8 @@ def _fit_single(
     def loss_fn(p):
         out = core.forward(params, decode(p), p["shape"])
         offset = p["trans"] if fit_trans else 0.0
-        data = _data_loss(out, offset, target, data_term, camera, conf)
+        data = _data_loss(out, offset, target, data_term, camera, conf,
+                          robust, robust_scale)
         # Prior weights may be traced scalars (see fit): plain multiplies.
         reg = (
             pose_prior_weight
@@ -166,7 +186,7 @@ def _fit_single(
 @functools.partial(
     jax.jit,
     static_argnames=("n_steps", "pose_space", "n_pca", "data_term",
-                     "fit_trans"),
+                     "fit_trans", "robust", "robust_scale"),
 )
 def fit(
     params: ManoParams,
@@ -182,6 +202,8 @@ def fit(
     camera=None,
     target_conf: Optional[jnp.ndarray] = None,  # [J] or [B, J]
     fit_trans: bool = False,
+    robust: str = "none",
+    robust_scale: float = 0.01,
 ) -> FitResult:
     """Recover pose/shape for one target mesh or a batch of them.
 
@@ -202,7 +224,7 @@ def fit(
         pose_prior_weight=pose_prior_weight,
         shape_prior_weight=shape_prior_weight,
         data_term=data_term, camera=camera, target_conf=target_conf,
-        fit_trans=fit_trans,
+        fit_trans=fit_trans, robust=robust, robust_scale=robust_scale,
     )
 
 
@@ -219,6 +241,8 @@ def fit_with_optimizer(
     camera=None,
     target_conf: Optional[jnp.ndarray] = None,
     fit_trans: bool = False,
+    robust: str = "none",
+    robust_scale: float = 0.01,
 ) -> FitResult:
     single = functools.partial(
         _fit_single,
@@ -232,6 +256,8 @@ def fit_with_optimizer(
         data_term=data_term,
         camera=camera,
         fit_trans=fit_trans,
+        robust=robust,
+        robust_scale=robust_scale,
     )
     _check_data_term(data_term, camera, target_conf)
     target_verts = jnp.asarray(target_verts, params.v_template.dtype)
@@ -257,7 +283,8 @@ class SequenceFitResult(NamedTuple):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_steps", "data_term", "fit_trans"),
+    static_argnames=("n_steps", "data_term", "fit_trans", "robust",
+                     "robust_scale"),
 )
 def fit_sequence(
     params: ManoParams,
@@ -268,6 +295,8 @@ def fit_sequence(
     camera=None,
     target_conf: Optional[jnp.ndarray] = None,  # [T, J] or [J]
     fit_trans: bool = False,
+    robust: str = "none",
+    robust_scale: float = 0.01,
     smooth_pose_weight: float = 1e-3,
     smooth_trans_weight: float = 1e-3,
     pose_prior_weight: float = 0.0,
@@ -324,7 +353,7 @@ def fit_sequence(
             else jnp.zeros((), dtype)
         )
         data = _data_loss(out, offset, targets, data_term, camera,
-                          target_conf)
+                          target_conf, robust, robust_scale)
         # t_frames is static: skip velocity terms for single-frame clips
         # (mean over an empty array is NaN and would poison every grad).
         if t_frames > 1:

@@ -221,13 +221,20 @@ def forward_batched(
     )(pose, shape)
 
 
+# The bench block-size sweep's winning tile for the fused skinning kernel
+# on TPU v5e (docs/benchmarking.md). THE one definition — the kernel entry
+# points below and bench.py's quick sweep/fallback all read it, so a new
+# sweep winner is a one-line change.
+PALLAS_BEST_BLOCK = (32, 896)
+
+
 def forward_batched_pallas(
     params: ManoParams,
     pose: jnp.ndarray,   # [B, J, 3]
     shape: jnp.ndarray,  # [B, S]
     precision=DEFAULT_PRECISION,
-    block_b: int = 32,
-    block_v: int = 896,  # bench sweep winner (docs/benchmarking.md)
+    block_b: int = PALLAS_BEST_BLOCK[0],
+    block_v: int = PALLAS_BEST_BLOCK[1],
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Batched forward with the Pallas fused-LBS kernel; returns verts only.
@@ -309,8 +316,8 @@ def forward_chunked(
     chunk_size: int = 8192,
     precision=DEFAULT_PRECISION,
     use_pallas: bool = False,
-    block_b: int = 32,
-    block_v: int = 896,
+    block_b: int = PALLAS_BEST_BLOCK[0],
+    block_v: int = PALLAS_BEST_BLOCK[1],
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Memory-bounded huge-batch vertices via lax.map over chunks.

@@ -370,3 +370,70 @@ def test_cli_conf_rejected_on_lm_path(tmp_path, capsys):
                    "--conf", str(tmp_path / "conf.npy"), "--steps", "2"])
     assert rc == 2
     assert "keypoints2d" in capsys.readouterr().err
+
+
+def test_huber_values_and_grads():
+    from mano_hand_tpu.fitting.objectives import huber
+
+    delta = 0.1
+    # Inlier branch: identity on squared distance.
+    np.testing.assert_allclose(float(huber(jnp.asarray(0.002), delta)), 0.002,
+                               rtol=1e-6)
+    # Continuity at the threshold r = delta.
+    np.testing.assert_allclose(float(huber(jnp.asarray(delta ** 2), delta)),
+                               delta ** 2, rtol=1e-6)
+    # Outlier branch: 2*delta*r - delta^2.
+    r = 0.5
+    np.testing.assert_allclose(float(huber(jnp.asarray(r ** 2), delta)),
+                               2 * delta * r - delta ** 2, rtol=1e-6)
+    import jax
+
+    # Gradient finite (and zero) at exactly zero residual.
+    g = jax.grad(lambda s: huber(s, delta))(jnp.asarray(0.0))
+    assert np.isfinite(float(g))
+    # Outlier gradient wrt squared distance shrinks as the residual grows:
+    # bounded pull instead of L2's constant 1.
+    g_out = jax.grad(lambda s: huber(s, delta))(jnp.asarray(r ** 2))
+    assert float(g_out) < 1.0
+
+
+def test_huber_fit_resists_unflagged_outlier(params32):
+    """One corrupted joint WITHOUT a confidence flag: the Huber fit keeps
+    the clean joints accurate; the L2 fit gets dragged."""
+    rng = np.random.default_rng(13)
+    pose = rng.normal(scale=0.25, size=(16, 3)).astype(np.float32)
+    clean = np.asarray(
+        core.forward(params32, jnp.asarray(pose)).posed_joints
+    ).copy()
+    corrupted = clean.copy()
+    corrupted[11] += np.array([0.5, -0.5, 0.5], np.float32)  # huge outlier
+
+    common = dict(n_steps=300, lr=0.05, data_term="joints",
+                  shape_prior_weight=1e-3)
+    res_l2 = fit(params32, corrupted, **common)
+    res_hub = fit(params32, corrupted, robust="huber", robust_scale=0.01,
+                  **common)
+    mask = np.ones(16, bool)
+    mask[11] = False
+
+    def clean_err(res):
+        out = core.forward(params32, res.pose, res.shape)
+        return np.linalg.norm(
+            np.asarray(out.posed_joints) - clean, axis=-1
+        )[mask].max()
+
+    e_l2, e_hub = clean_err(res_l2), clean_err(res_hub)
+    assert e_hub < 5e-3          # huber: clean joints still accurate
+    assert e_hub < 0.5 * e_l2    # and well clear of plain L2
+
+
+def test_huber_rejects_bad_kind(params32):
+    target = core.forward(params32).verts
+    with pytest.raises(ValueError, match="robust"):
+        fit(params32, target, n_steps=2, robust="tukey")
+
+
+def test_huber_rejects_nonpositive_scale(params32):
+    target = core.forward(params32).verts
+    with pytest.raises(ValueError, match="robust_scale"):
+        fit(params32, target, n_steps=2, robust="huber", robust_scale=0.0)
