@@ -72,12 +72,13 @@ def _data_loss(out, offset, target, data_term: str, camera, conf,
     """
     if robust not in ("none", "huber"):
         raise ValueError(f"robust must be 'none' or 'huber', got {robust!r}")
-    if (robust == "huber" and isinstance(robust_scale, (int, float))
-            and robust_scale <= 0):
+    if (robust == "huber" and not isinstance(robust_scale, jax.core.Tracer)
+            and float(robust_scale) <= 0):
         # A zero scale makes the whole data term identically zero (the
         # fit would silently return the initialization); negative rewards
         # outliers. robust_scale is static in the jitted entry points, so
-        # it is always concrete there.
+        # it is always concrete there (incl. numpy scalars — hence
+        # float(), not an isinstance whitelist).
         raise ValueError(f"robust_scale must be > 0, got {robust_scale}")
     penalty = (
         (lambda sq: objectives.huber(sq, robust_scale))
@@ -130,6 +131,7 @@ def _fit_single(
     fit_trans: bool = False,
     robust: str = "none",
     robust_scale: float = 0.01,
+    init: Optional[dict] = None,
 ) -> FitResult:
     _check_data_term(data_term, camera, conf)
     dtype = params.v_template.dtype
@@ -151,6 +153,29 @@ def _fit_single(
         # keeps hands at the origin), but image-space fitting needs the
         # hand placed in the camera frustum.
         theta0["trans"] = jnp.zeros((3,), dtype)
+
+    if init:
+        # Warm start: seed any subset of the parameters (previous frame's
+        # solution, a detector initializer, a coarse fit). Keys must match
+        # the active parameterization.
+        unknown = set(init) - set(theta0)
+        if unknown:
+            raise ValueError(
+                f"init keys {sorted(unknown)} not in this parameterization "
+                f"{sorted(theta0)} (pose_space={pose_space!r}, "
+                f"fit_trans={fit_trans})"
+            )
+        for k, v in init.items():
+            v = jnp.asarray(v, dtype)
+            if v.shape != theta0[k].shape:
+                # No silent reshape: a transposed or re-flattened seed has
+                # the right element count but scrambled joints, and would
+                # quietly degrade to worse-than-cold convergence.
+                raise ValueError(
+                    f"init[{k!r}] shape {v.shape} != expected "
+                    f"{theta0[k].shape}"
+                )
+            theta0[k] = v
 
     def decode(p):
         if pose_space == "aa":
@@ -204,6 +229,7 @@ def fit(
     fit_trans: bool = False,
     robust: str = "none",
     robust_scale: float = 0.01,
+    init: Optional[dict] = None,
 ) -> FitResult:
     """Recover pose/shape for one target mesh or a batch of them.
 
@@ -225,6 +251,7 @@ def fit(
         shape_prior_weight=shape_prior_weight,
         data_term=data_term, camera=camera, target_conf=target_conf,
         fit_trans=fit_trans, robust=robust, robust_scale=robust_scale,
+        init=init,
     )
 
 
@@ -243,6 +270,7 @@ def fit_with_optimizer(
     fit_trans: bool = False,
     robust: str = "none",
     robust_scale: float = 0.01,
+    init: Optional[dict] = None,
 ) -> FitResult:
     single = functools.partial(
         _fit_single,
@@ -264,12 +292,16 @@ def fit_with_optimizer(
     if target_conf is not None:
         target_conf = jnp.asarray(target_conf, params.v_template.dtype)
     if target_verts.ndim == 2:
-        return single(target_verts, target_conf)
+        return single(target_verts, target_conf, init=init)
     # Batched problems: map conf per-problem when it is [B, J]; a shared
-    # [J] conf (or None) broadcasts via in_axes=None.
+    # [J] conf (or None) broadcasts via in_axes=None. A warm-start init
+    # must carry the batch on every leaf (one seed per problem).
     conf_axis = 0 if (target_conf is not None
                       and target_conf.ndim == 2) else None
-    return jax.vmap(single, in_axes=(0, conf_axis))(target_verts, target_conf)
+    return jax.vmap(
+        lambda t, c, i: single(t, c, init=i),
+        in_axes=(0, conf_axis, 0 if init else None),
+    )(target_verts, target_conf, init)
 
 
 # ------------------------------------------------------------- sequences

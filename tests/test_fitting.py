@@ -437,3 +437,71 @@ def test_huber_rejects_nonpositive_scale(params32):
     target = core.forward(params32).verts
     with pytest.raises(ValueError, match="robust_scale"):
         fit(params32, target, n_steps=2, robust="huber", robust_scale=0.0)
+
+
+def test_fit_warm_start_beats_cold(params32):
+    """Seeding near the solution makes a short fit converge far better
+    than the same budget from zero — the streaming/refinement workflow."""
+    rng = np.random.default_rng(14)
+    pose = rng.normal(scale=0.3, size=(16, 3)).astype(np.float32)
+    target = core.forward(params32, jnp.asarray(pose)).verts
+    near = pose + rng.normal(scale=0.02, size=pose.shape).astype(np.float32)
+
+    cold = fit(params32, target, n_steps=30, lr=0.05)
+    warm = fit(params32, target, n_steps=30, lr=0.05,
+               init={"pose": near})
+    assert float(warm.final_loss) < 0.5 * float(cold.final_loss)
+
+
+def test_fit_warm_start_streaming_track(params32):
+    """Online tracking: each frame warm-started from the previous frame's
+    solution needs only a handful of steps to stay locked on."""
+    rng = np.random.default_rng(15)
+    t_frames = 5
+    a = rng.normal(scale=0.3, size=(16, 3)).astype(np.float32)
+    b = rng.normal(scale=0.3, size=(16, 3)).astype(np.float32)
+    w = np.linspace(0, 1, t_frames, dtype=np.float32)[:, None, None]
+    poses = (1 - w) * a + w * b
+    targets = core.forward_batched(
+        params32, jnp.asarray(poses), jnp.zeros((t_frames, 10), jnp.float32)
+    ).verts
+
+    init = None
+    errs = []
+    for t in range(t_frames):
+        steps = 300 if t == 0 else 60   # bootstrap, then track cheaply
+        res = fit(params32, targets[t], n_steps=steps, lr=0.05, init=init)
+        init = {"pose": res.pose, "shape": res.shape}
+        out = core.forward(params32, res.pose, res.shape)
+        errs.append(float(jnp.max(jnp.linalg.norm(
+            out.verts - targets[t], axis=-1
+        ))))
+    assert max(errs) < 5e-3  # stays locked on with 40 steps/frame
+
+
+def test_fit_warm_start_batched_and_bad_key(params32):
+    rng = np.random.default_rng(16)
+    poses = rng.normal(scale=0.25, size=(3, 16, 3)).astype(np.float32)
+    targets = core.forward_batched(
+        params32, jnp.asarray(poses), jnp.zeros((3, 10), jnp.float32)
+    ).verts
+    res = fit(params32, targets, n_steps=30, lr=0.05,
+              init={"pose": poses})  # batched seed, one per problem
+    assert res.pose.shape == (3, 16, 3)
+    assert float(np.max(np.asarray(res.final_loss))) < 1e-5
+    with pytest.raises(ValueError, match="init keys"):
+        fit(params32, targets[0], n_steps=2, init={"quat": np.zeros(4)})
+
+
+def test_robust_scale_numpy_zero_rejected(params32):
+    target = core.forward(params32).verts
+    with pytest.raises(ValueError, match="robust_scale"):
+        fit(params32, target, n_steps=2, robust="huber",
+            robust_scale=np.float32(0.0))
+
+
+def test_warm_start_wrong_shape_rejected(params32):
+    target = core.forward(params32).verts
+    with pytest.raises(ValueError, match="init\\['pose'\\] shape"):
+        fit(params32, target, n_steps=2,
+            init={"pose": np.zeros((3, 16), np.float32)})
