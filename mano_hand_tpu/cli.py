@@ -194,14 +194,23 @@ def cmd_fit(args) -> int:
         if args.lr is not None:
             print("note: --lr only applies to --solver adam; ignored",
                   file=sys.stderr)
-        if args.shape_prior is not None:
-            print("note: --shape-prior only applies to --solver adam; "
-                  "ignored", file=sys.stderr)
-        if args.data_term != "verts":
-            print(f"--data-term {args.data_term} requires --solver adam",
+        if args.data_term == "keypoints2d":
+            print("--data-term keypoints2d requires --solver adam",
                   file=sys.stderr)
             return 2
-        res = fitting.fit_lm(params, targets, n_steps=steps)
+        lm_kw = {}
+        if args.data_term == "joints":
+            # LM's Tikhonov rows stand in for the Adam path's shape prior
+            # (16 joints underdetermine shape).
+            lm_kw = dict(
+                data_term="joints",
+                shape_weight=(0.1 if args.shape_prior is None
+                              else args.shape_prior),
+            )
+        elif args.shape_prior is not None:
+            print("note: --shape-prior only applies to --solver adam or "
+                  "--data-term joints; ignored", file=sys.stderr)
+        res = fitting.fit_lm(params, targets, n_steps=steps, **lm_kw)
     else:
         # Shape is weakly observable from 16 joints; regularize it
         # (unless the user set an explicit weight).
@@ -338,15 +347,18 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--focal", type=float, default=2.2,
                    help="pinhole focal in NDC units (keypoints2d only)")
     f.add_argument("--shape-prior", type=float, default=None,
-                   help="L2 prior weight on shape coefficients; default 0 "
-                        "for verts, 1e-3 for joints/keypoints2d (16 "
-                        "keypoints observe shape only weakly)")
+                   help="shape regularizer. adam: L2 prior weight (default "
+                        "0 for verts, 1e-3 for joints/keypoints2d). lm "
+                        "with joints: Tikhonov residual-ROW weight, which "
+                        "enters the least-squares loss SQUARED (default "
+                        "0.1) — not numerically comparable to the adam "
+                        "weight")
     f.add_argument("--asset", default="synthetic")
     f.add_argument("--side", default=None, choices=[None, "left", "right"])
     f.add_argument("--solver", default=None, choices=["lm", "adam"],
                    help="default: lm for --data-term verts, adam for "
-                        "joints/keypoints2d (lm's Gauss-Newton system is "
-                        "built on the vertex residual)")
+                        "joints/keypoints2d; lm also supports joints "
+                        "(keypoints2d is adam-only)")
     f.add_argument("--steps", type=int, default=None,
                    help="default: 25 (lm) / 200 (adam)")
     f.add_argument("--lr", type=float, default=None,

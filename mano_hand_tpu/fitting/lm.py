@@ -29,20 +29,24 @@ from mano_hand_tpu.models import core
 class LMResult(NamedTuple):
     pose: jnp.ndarray          # [..., 16, 3] recovered axis-angle pose
     shape: jnp.ndarray         # [..., S] recovered shape coefficients
-    final_loss: jnp.ndarray    # [...] final mean-squared vertex residual
+    final_loss: jnp.ndarray    # [...] final mean-squared residual over ALL
+    #   rows: vertex or joint rows per data_term, plus the Tikhonov shape
+    #   rows — not directly comparable across data terms or to the Adam
+    #   path's data loss.
     loss_history: jnp.ndarray  # [..., n_steps]
     damping_history: jnp.ndarray  # [..., n_steps] lambda per step
 
 
 def _fit_single(
     params: ManoParams,
-    target_verts: jnp.ndarray,  # [V, 3]
+    target_verts: jnp.ndarray,  # [V, 3] or [J, 3] (data_term)
     *,
     n_steps: int,
     init_damping: float,
     damping_up: float,
     damping_down: float,
     shape_weight: float,
+    data_term: str = "verts",
 ) -> LMResult:
     dtype = params.v_template.dtype
     n_joints = params.j_regressor.shape[0]
@@ -59,7 +63,8 @@ def _fit_single(
     def residual(flat):
         p = unravel(flat)
         out = core.forward(params, p["pose"], p["shape"])
-        res = out.verts.reshape(-1) - target
+        pred = out.verts if data_term == "verts" else out.posed_joints
+        res = pred.reshape(-1) - target
         # Tikhonov rows keep beta near 0 when vertices underdetermine it.
         # Always present (zero rows when the traced weight is 0, which is
         # mathematically a no-op on JtJ/Jtr) so the residual shape — and
@@ -111,23 +116,31 @@ def _fit_single(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_steps",),
+    static_argnames=("n_steps", "data_term"),
 )
 def fit_lm(
     params: ManoParams,
-    target_verts: jnp.ndarray,  # [V, 3] or [B, V, 3]
+    target_verts: jnp.ndarray,  # [V, 3] or [B, V, 3] ([J, 3] for joints)
     n_steps: int = 30,
     init_damping: float = 1e-3,
     damping_up: float = 10.0,
     damping_down: float = 0.3,
     shape_weight: float = 0.0,
+    data_term: str = "verts",
 ) -> LMResult:
     """Recover (pose, shape) by damped Gauss-Newton; batch via vmap.
 
     Converges to numerical floor in tens of steps where Adam needs
-    hundreds — the preferred solver when targets are clean meshes. For
-    robust/prior-weighted energies use solvers.fit (first-order).
+    hundreds — the preferred solver when targets are clean meshes.
+    ``data_term="joints"`` fits 16 posed joints instead (a [48+S]-row
+    residual — even cheaper per step); 16 joints underdetermine shape,
+    so pair it with a nonzero ``shape_weight``. For robust or
+    2D-projected energies use solvers.fit (first-order).
     """
+    if data_term not in ("verts", "joints"):
+        raise ValueError(
+            f"fit_lm data_term must be 'verts' or 'joints', got {data_term!r}"
+        )
     single = functools.partial(
         _fit_single,
         params,
@@ -136,6 +149,7 @@ def fit_lm(
         damping_up=damping_up,
         damping_down=damping_down,
         shape_weight=shape_weight,
+        data_term=data_term,
     )
     target_verts = jnp.asarray(target_verts, params.v_template.dtype)
     if target_verts.ndim == 2:
