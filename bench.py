@@ -199,7 +199,7 @@ def run_benchmarks(args, device_str: str) -> dict:
     import jax.numpy as jnp
 
     from mano_hand_tpu.assets import synthetic_pair
-    from mano_hand_tpu.fitting import fit
+    from mano_hand_tpu.fitting import fit, fit_lm
     from mano_hand_tpu.models import core, oracle
 
     dev = jax.devices()[0]
@@ -511,11 +511,14 @@ def run_benchmarks(args, device_str: str) -> dict:
     section("config3_pallas_chunked", config3_pallas_chunked)
 
     # -- config 4: pose fitting batch=256 -----------------------------------
+    b4 = 256
+    pose4 = rng.normal(scale=0.3, size=(b4, 16, 3)).astype(np.float32)
+    beta4 = rng.normal(scale=0.5, size=(b4, 10)).astype(np.float32)
+    fit_targets = None
+
     def config4():
-        b4 = 256
-        pose4 = rng.normal(scale=0.3, size=(b4, 16, 3)).astype(np.float32)
-        beta4 = rng.normal(scale=0.5, size=(b4, 10)).astype(np.float32)
-        targets = core.jit_forward_batched(
+        nonlocal fit_targets
+        fit_targets = core.jit_forward_batched(
             right, jnp.asarray(pose4), jnp.asarray(beta4)
         ).verts
 
@@ -523,7 +526,8 @@ def run_benchmarks(args, device_str: str) -> dict:
             # fit is jitted with static n_steps; the whole Adam loop is one
             # lax.scan program, so the steps-count slope cancels sync cost.
             return lambda: float(
-                fit(right, targets, n_steps=steps, lr=0.05).final_loss.sum()
+                fit(right, fit_targets, n_steps=steps,
+                    lr=0.05).final_loss.sum()
             )
 
         s1, s2 = args.fit_steps // 2, args.fit_steps + args.fit_steps // 2
@@ -535,8 +539,26 @@ def run_benchmarks(args, device_str: str) -> dict:
         log(f"config4 fit b=256 x {args.fit_steps} steps: {t4 * 1e3:.1f} ms "
             f"({fit_evals / t4:,.0f} fwd+bwd evals/s)")
 
+    def config4b_lm():
+        # Second-order solver throughput: each LM step builds a [R, 58]
+        # forward-mode Jacobian + normal equations + Cholesky per problem.
+        if fit_targets is None:
+            raise RuntimeError("config4 did not produce targets")
+
+        def run_lm(steps):
+            return lambda: float(
+                fit_lm(right, fit_targets,
+                       n_steps=steps).final_loss.sum()
+            )
+
+        t_step = slope_time(run_lm, 5, 15, iters=max(2, args.iters // 3))
+        results["config4_lm_steps_per_sec"] = 1.0 / t_step
+        log(f"config4b LM b={b4}: {1.0 / t_step:,.1f} steps/s "
+            f"({t_step * 1e3:.2f} ms/step)")
+
     if not args.skip_fit:
         section("config4", config4)
+        section("config4b_lm", config4b_lm)
 
     # -- config 5: 120-frame two-hand temporal sequence ---------------------
     def config5():

@@ -265,6 +265,9 @@ def forward_batched_pallas(
         return skin_rot, skin_t, v_posed
 
     dtype = params.v_template.dtype
+    if pose.shape[0] == 0:
+        # Static empty batch: the kernel's grid math divides by B.
+        return jnp.zeros((0, params.v_template.shape[0], 3), dtype)
     pose = pose.reshape(pose.shape[0], -1, 3).astype(dtype)
     skin_rot, skin_t, v_posed = jax.vmap(pre)(pose, shape.astype(dtype))
     # Positional call: custom_vjp functions reject keyword arguments.

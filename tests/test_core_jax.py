@@ -156,3 +156,20 @@ def test_fk_levels_cover_tree(params):
 def test_dtype_follows_params(params32):
     out = core.forward(params32)
     assert out.verts.dtype == jnp.float32
+
+
+def test_empty_and_singleton_batches(params):
+    """Every public batch path accepts B=0 and B=1 (pipeline edges: an
+    empty detector frame, a single sample) without special-casing at the
+    call site."""
+    p32 = params.astype(np.float32)
+    for b in (0, 1):
+        pose = jnp.zeros((b, 16, 3), jnp.float32)
+        beta = jnp.zeros((b, 10), jnp.float32)
+        assert core.forward_batched(p32, pose, beta).verts.shape == (b, 778, 3)
+        assert core.forward_chunked(p32, pose, beta, chunk_size=8).shape == (
+            b, 778, 3
+        )
+        assert core.forward_batched_pallas(
+            p32, pose, beta, block_b=8, block_v=128, interpret=True
+        ).shape == (b, 778, 3)
