@@ -400,6 +400,66 @@ def run_benchmarks(args, device_str: str) -> dict:
 
     section("config3", config3)
 
+    # -- configs 3b/3c share one sweep harness ------------------------------
+    def interleaved_rate(forward_fn, launch_b, iters):
+        """Evals/s of a two-hand `forward_fn(params, pose, shape)` path at
+        one launch size, slope-timed like every other config."""
+        def interleaved(prm_pair, p, s):
+            pl_, pr_ = prm_pair
+            vl = forward_fn(pl_, p[:half][:launch_b], s[:half][:launch_b])
+            vr = forward_fn(pr_, p[half:][:launch_b], s[half:][:launch_b])
+            return vl.sum() + vr.sum()
+
+        fwd = loop_scalar(interleaved)
+        t = slope_time(
+            lambda m: looped(fwd, m, (left, right), pose3, beta3),
+            1, 5, iters=iters,
+        )
+        return 2 * launch_b / t
+
+    def sweep_kernel(tag, make_fn, cfgs, base_launch):
+        """Block-config sweep at base_launch, then a launch-size sweep at the
+        winning config (bigger launches amortize grid setup and keep the MXU
+        busier, until pre-stage intermediates start paying HBM round-trips).
+        Returns (best_rate, best_cfg, best_launch)."""
+        iters = max(3, args.iters // 3)
+        best = None
+        for cfg in cfgs:
+            try:
+                rate = interleaved_rate(make_fn(*cfg), base_launch, iters)
+                log(f"{tag} {cfg}: {rate:,.0f} evals/s")
+                if np.isfinite(rate) and (best is None or rate > best[0]):
+                    best = (rate, cfg)
+            except Exception as e:  # per-config isolation
+                log(f"{tag} {cfg} failed: "
+                    f"{type(e).__name__}: {str(e)[:200]}")
+        if best is None:
+            raise RuntimeError(f"no {tag} block config succeeded")
+        best_launch = base_launch
+        for launch_b in (16384, 32768):
+            if launch_b > half or launch_b == base_launch:
+                continue
+            try:
+                rate = interleaved_rate(make_fn(*best[1]), launch_b, iters)
+                log(f"{tag} launch={launch_b}: {rate:,.0f} evals/s")
+                if np.isfinite(rate) and rate > best[0]:
+                    best = (rate, best[1])
+                    best_launch = launch_b
+            except Exception as e:
+                log(f"{tag} launch {launch_b} failed: "
+                    f"{type(e).__name__}: {str(e)[:200]}")
+        return best[0], best[1], best_launch
+
+    def prove_vjp(forward_fn):
+        """The kernel's fwd+bwd Mosaic lowering must EXECUTE on this backend
+        (round-1 gap: only ever ran interpreted); correctness is tested."""
+        import jax as _jax
+
+        gfn = _jax.jit(_jax.grad(
+            lambda p: forward_fn(right, p, beta2[:64]).sum()
+        ))
+        _jax.block_until_ready(gfn(pose2[:64]))
+
     # -- config 3b: Pallas fused-skinning kernel, block-size sweep ----------
     verts_pallas = None  # [8, V, 3] accuracy probe through the COMPILED kernel
     pallas_best = {}     # sweep winner, consumed by config3p below
@@ -414,63 +474,20 @@ def run_benchmarks(args, device_str: str) -> dict:
         }[args.pallas_sweep]
         if not sweep:
             return
-        def time_pallas(launch_b, block_b, block_v):
-            """Evals/s of the two-hand pallas path at one launch size."""
-            def interleaved_pallas(prm_pair, p, s):
-                pl_, pr_ = prm_pair
-                vl = core.forward_batched_pallas(
-                    pl_, p[:half][:launch_b], s[:half][:launch_b],
-                    block_b=block_b, block_v=block_v)
-                vr = core.forward_batched_pallas(
-                    pr_, p[half:][:launch_b], s[half:][:launch_b],
-                    block_b=block_b, block_v=block_v)
-                return vl.sum() + vr.sum()
 
-            fwd3p = loop_scalar(interleaved_pallas)
-            t3p = slope_time(
-                lambda m: looped(fwd3p, m, (left, right), pose3, beta3),
-                1, 5, iters=max(3, args.iters // 3),
-            )
-            return 2 * launch_b / t3p
+        def make_fn(block_b, block_v):
+            return lambda prm, p, s: core.forward_batched_pallas(
+                prm, p, s, block_b=block_b, block_v=block_v)
 
         b3b = min(half, 8192)  # one un-chunked pallas launch per hand
-        best = None
-        for block_b, block_v in sweep:
-            try:
-                rate = time_pallas(b3b, block_b, block_v)
-                log(f"config3b pallas block_b={block_b} block_v={block_v}: "
-                    f"{rate:,.0f} evals/s")
-                if np.isfinite(rate) and (best is None or rate > best[0]):
-                    best = (rate, block_b, block_v)
-            except Exception as e:  # per-block-config isolation
-                log(f"config3b block ({block_b},{block_v}) failed: "
-                    f"{type(e).__name__}: {str(e)[:200]}")
-        if best is None:
-            raise RuntimeError("no pallas block config succeeded")
-
-        # Launch-size sweep at the winning block: bigger launches amortize
-        # grid setup and keep the MXU busier, until the [B, J, 3, 3]
-        # pre-skinning intermediates start paying HBM round-trips.
-        best_launch = b3b
-        for launch_b in (16384, 32768):
-            if launch_b > half or launch_b == b3b:
-                continue
-            try:
-                rate = time_pallas(launch_b, best[1], best[2])
-                log(f"config3b pallas launch={launch_b}: {rate:,.0f} evals/s")
-                if np.isfinite(rate) and rate > best[0]:
-                    best = (rate, best[1], best[2])
-                    best_launch = launch_b
-            except Exception as e:
-                log(f"config3b launch {launch_b} failed: "
-                    f"{type(e).__name__}: {str(e)[:200]}")
-
-        results["config3_pallas_evals_per_sec"] = best[0]
-        results["pallas_best_block"] = f"b={best[1]},v={best[2]}"
+        rate, (bb, bv), best_launch = sweep_kernel(
+            "config3b pallas", make_fn, sweep, b3b)
+        results["config3_pallas_evals_per_sec"] = rate
+        results["pallas_best_block"] = f"b={bb},v={bv}"
         results["pallas_best_launch"] = best_launch
-        pallas_best["block"] = (best[1], best[2])
-        log(f"config3b best: {best[0]:,.0f} evals/s at block_b={best[1]} "
-            f"block_v={best[2]} launch={best_launch}")
+        pallas_best["block"] = (bb, bv)
+        log(f"config3b best: {rate:,.0f} evals/s at block_b={bb} "
+            f"block_v={bv} launch={best_launch}")
 
         # Accuracy probe through the COMPILED kernel at the winning block:
         # the headline path's numerics must be measured on-chip, not assumed
@@ -478,19 +495,9 @@ def run_benchmarks(args, device_str: str) -> dict:
         # section (D2H poisons axon dispatch).
         verts_pallas = core.forward_batched_pallas(
             right, jnp.asarray(poses), jnp.asarray(betas),
-            block_b=best[1], block_v=best[2],
+            block_b=bb, block_v=bv,
         )
-
-        # VJP through the kernel must COMPILE on this backend (round-1 gap:
-        # only ever ran interpreted). Correctness is covered by tests; here
-        # we just prove the Mosaic lowering of fwd+bwd executes.
-        import jax as _jax
-        gfn = _jax.jit(_jax.grad(
-            lambda p: core.forward_batched_pallas(
-                right, p, beta2[:64], block_b=best[1], block_v=best[2]
-            ).sum()
-        ))
-        _jax.block_until_ready(gfn(pose2[:64]))
+        prove_vjp(make_fn(bb, bv))
         results["pallas_vjp_compiles"] = True
         log("config3b pallas VJP compiled + executed")
 
@@ -509,6 +516,56 @@ def run_benchmarks(args, device_str: str) -> dict:
             f"{rate:,.0f} evals/s ({t3p * 1e3:.1f} ms)")
 
     section("config3_pallas_chunked", config3_pallas_chunked)
+
+    # -- config 3c: fully-fused Pallas forward (blend + skin in ONE kernel,
+    # ops/pallas_forward.py) — block_b x launch-size sweep, plus the full
+    # 65536 batch through pallas-fused chunks at the winner.
+    verts_fused = None   # accuracy probe through the COMPILED fused kernel
+    fused_best = {}
+
+    def config3c():
+        nonlocal verts_fused
+        if args.pallas_sweep == "off":
+            return
+
+        def make_fn(block_b):
+            return lambda prm, p, s: core.forward_batched_pallas_fused(
+                prm, p, s, block_b=block_b)
+
+        blocks = ([(core.FUSED_BEST_BLOCK_B,)]
+                  if args.pallas_sweep == "quick"
+                  else [(32,), (64,), (128,), (256,)])
+        rate, (bb,), best_launch = sweep_kernel(
+            "config3c fused", make_fn, blocks, min(half, 8192))
+        results["config3_fused_evals_per_sec"] = rate
+        results["fused_best_block_b"] = bb
+        results["fused_best_launch"] = best_launch
+        fused_best["block_b"] = bb
+        log(f"config3c best: {rate:,.0f} evals/s at block_b={bb} "
+            f"launch={best_launch}")
+
+        # On-chip accuracy probe (readback deferred to the accuracy section)
+        # + VJP execute proof for the hybrid backward.
+        verts_fused = core.forward_batched_pallas_fused(
+            right, jnp.asarray(poses), jnp.asarray(betas), block_b=bb
+        )
+        prove_vjp(make_fn(bb))
+        results["fused_vjp_compiles"] = True
+        log("config3c fused VJP compiled + executed")
+
+    section("config3c", config3c)
+
+    def config3_fused_chunked():
+        if args.pallas_sweep == "off" or "block_b" not in fused_best:
+            return
+        rate, t3f = time_chunked(use_pallas_fused=True,
+                                 block_b=fused_best["block_b"])
+        results["config3_fused_chunked_evals_per_sec"] = rate
+        log(f"config3f batch={b3} L+R fused chunks "
+            f"(block_b={fused_best['block_b']}): {rate:,.0f} evals/s "
+            f"({t3f * 1e3:.1f} ms)")
+
+    section("config3_fused_chunked", config3_fused_chunked)
 
     # -- config 4: pose fitting batch=256 -----------------------------------
     b4 = 256
@@ -656,7 +713,7 @@ def run_benchmarks(args, device_str: str) -> dict:
         err0 = float(np.abs(np.asarray(out1.verts) - want.verts).max())
         results["config1_zero_pose_max_err"] = err0
         log(f"config1 zero-pose max err vs oracle: {err0:.3e}")
-        max_err = fast_err = highest_err = pallas_err = 0.0
+        max_err = fast_err = highest_err = pallas_err = fused_err = 0.0
         for i in range(8):
             w = oracle.forward(right64, pose=poses[i], shape=betas[i]).verts
             max_err = max(
@@ -674,6 +731,10 @@ def run_benchmarks(args, device_str: str) -> dict:
                 pallas_err = max(pallas_err, float(
                     np.abs(np.asarray(verts_pallas[i]) - w).max()
                 ))
+            if verts_fused is not None:
+                fused_err = max(fused_err, float(
+                    np.abs(np.asarray(verts_fused[i]) - w).max()
+                ))
         results["max_err_vs_numpy"] = max_err
         log(f"random-pose max err vs oracle (model default precision): "
             f"{max_err:.3e}")
@@ -687,6 +748,10 @@ def run_benchmarks(args, device_str: str) -> dict:
         if verts_pallas is not None:
             results["pallas_max_err_vs_numpy"] = pallas_err
             log(f"compiled pallas path max err vs oracle: {pallas_err:.3e}")
+        if verts_fused is not None:
+            results["fused_max_err_vs_numpy"] = fused_err
+            log(f"compiled fused-forward path max err vs oracle: "
+                f"{fused_err:.3e}")
 
     section("accuracy", accuracy)
 
@@ -709,7 +774,9 @@ def run_benchmarks(args, device_str: str) -> dict:
     candidates = [results.get("config2_b1024_evals_per_sec"),
                   results.get("config3_b65536_evals_per_sec"),
                   results.get("config3_pallas_chunked_evals_per_sec"),
-                  results.get("config3_pallas_evals_per_sec")]
+                  results.get("config3_pallas_evals_per_sec"),
+                  results.get("config3_fused_evals_per_sec"),
+                  results.get("config3_fused_chunked_evals_per_sec")]
     candidates = [c for c in candidates if c is not None and np.isfinite(c)]
     if not candidates:
         raise RuntimeError(f"no throughput config completed: {errors}")

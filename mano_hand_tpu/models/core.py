@@ -227,6 +227,11 @@ def forward_batched(
 # sweep winner is a one-line change.
 PALLAS_BEST_BLOCK = (32, 896)
 
+# Batch tile for the fully-fused forward kernel (ops/pallas_forward.py),
+# which has no vertex-tile knob (the whole padded mesh rides the lanes).
+# Same contract as PALLAS_BEST_BLOCK: bench sweep winners land here.
+FUSED_BEST_BLOCK_B = 128
+
 
 def forward_batched_pallas(
     params: ManoParams,
@@ -273,7 +278,30 @@ def forward_batched_pallas(
     # Positional call: custom_vjp functions reject keyword arguments.
     return pallas_lbs.skin_batched_ad(
         params.lbs_weights, skin_rot, skin_t, v_posed,
-        block_b, block_v, interpret,
+        block_b, block_v, interpret, precision,
+    )
+
+
+def forward_batched_pallas_fused(
+    params: ManoParams,
+    pose: jnp.ndarray,   # [B, J, 3]
+    shape: jnp.ndarray,  # [B, S]
+    precision=DEFAULT_PRECISION,
+    block_b: int = FUSED_BEST_BLOCK_B,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Batched forward via the fully-fused Pallas kernel; returns verts only.
+
+    One kernel launch covers blendshapes AND skinning (ops/pallas_forward.py)
+    — the blended vertices never round-trip through HBM between the two,
+    unlike ``forward_batched_pallas`` where v_posed crosses a program
+    boundary. Differentiable (hybrid custom VJP).
+    """
+    from mano_hand_tpu.ops import pallas_forward
+
+    # Positional call: custom_vjp functions reject keyword arguments.
+    return pallas_forward.forward_verts_fused_ad(
+        params, pose, shape, precision, block_b, interpret
     )
 
 
@@ -319,9 +347,10 @@ def forward_chunked(
     chunk_size: int = 8192,
     precision=DEFAULT_PRECISION,
     use_pallas: bool = False,
-    block_b: int = PALLAS_BEST_BLOCK[0],
+    block_b: Optional[int] = None,
     block_v: int = PALLAS_BEST_BLOCK[1],
     interpret: bool = False,
+    use_pallas_fused: bool = False,
 ) -> jnp.ndarray:
     """Memory-bounded huge-batch vertices via lax.map over chunks.
 
@@ -329,9 +358,11 @@ def forward_chunked(
     the MXU stays saturated; returns verts only ([B, V, 3]). Any batch size
     works: a trailing partial chunk is zero-padded internally (static pad,
     jit-safe) and the padding sliced off the output. ``use_pallas`` routes
-    each chunk's skinning through the fused Pallas kernel (the fastest
-    measured path at launch-scale batches — docs/benchmarking.md); block
-    defaults are the bench sweep's winners.
+    each chunk's skinning through the fused Pallas skinning kernel;
+    ``use_pallas_fused`` routes the whole vertex path (blend + skin) through
+    the fully-fused kernel (ops/pallas_forward.py), where ``block_b`` is its
+    batch tile. Block defaults are the bench sweep's winners
+    (docs/benchmarking.md).
     """
     b = pose.shape[0]
     chunk_size = max(1, min(chunk_size, b))  # max(1,..) keeps B=0 legal
@@ -346,10 +377,18 @@ def forward_chunked(
     n_chunks = (b + pad) // chunk_size
     pose_c = pose.reshape(n_chunks, chunk_size, *pose.shape[1:])
     shape_c = shape.reshape(n_chunks, chunk_size, *shape.shape[1:])
-    if use_pallas:
+    if use_pallas_fused:
+        # Each kernel route defaults to ITS OWN swept tile, not the other's.
+        bb = FUSED_BEST_BLOCK_B if block_b is None else block_b
+        chunk_fn = lambda ps: forward_batched_pallas_fused(  # noqa: E731
+            params, ps[0], ps[1], precision,
+            block_b=min(bb, chunk_size), interpret=interpret,
+        )
+    elif use_pallas:
+        bb = PALLAS_BEST_BLOCK[0] if block_b is None else block_b
         chunk_fn = lambda ps: forward_batched_pallas(  # noqa: E731
             params, ps[0], ps[1], precision,
-            block_b=block_b, block_v=block_v, interpret=interpret,
+            block_b=bb, block_v=block_v, interpret=interpret,
         )
     else:
         chunk_fn = lambda ps: forward_batched(  # noqa: E731

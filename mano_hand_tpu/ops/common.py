@@ -13,9 +13,54 @@ tests are precision-invariant. Pass ``precision=jax.lax.Precision.HIGHEST``
 explicitly where the last two decimal digits matter more than speed.
 """
 
+import functools
+
 import jax
+import jax.numpy as jnp
 
 DEFAULT_PRECISION = jax.lax.Precision.HIGH
+
+# TPU register tiling (f32): kernels pad their lane axis to LANE and their
+# sublane/contraction axes to SUBLANE multiples.
+LANE = 128
+SUBLANE = 8
+
+
+def cdiv(a: int, b: int) -> int:
+    """Ceiling division (grid/pad arithmetic in the Pallas kernels)."""
+    return -(-a // b)
+
+
+def kernel_dot(a, b, precision=DEFAULT_PRECISION):
+    """Precision-faithful matmul for INSIDE Pallas kernels.
+
+    Mosaic ignores the surrounding jit's precision config and lowers a bare
+    ``jnp.dot`` to single-pass bf16 on the MXU (~2.4e-3 relative error —
+    measured on v5e; fails the 1e-4 vertex gate that interpret-mode tests
+    can't see). It honors ``Precision.HIGHEST`` (6-pass, 2e-7) but rejects
+    ``HIGH``, so HIGH is implemented here as the standard 3-pass bf16
+    decomposition a ≈ a_hi + a_lo: a_hi·b_hi + a_hi·b_lo + a_lo·b_hi
+    (5e-6 relative error measured on-chip — same policy XLA applies for
+    HIGH outside kernels). Accumulation is always f32.
+    """
+    # Canonicalize: JAX accepts strings ('high', 'highest') and None for
+    # precision everywhere else; an un-canonicalized string would fall
+    # through BOTH enum comparisons below and silently run single-pass
+    # bf16 — the exact failure this helper exists to prevent.
+    if precision is not None:
+        precision = jax.lax.Precision(precision)
+    if precision == jax.lax.Precision.HIGHEST:
+        return jnp.dot(a, b, precision=jax.lax.Precision.HIGHEST,
+                       preferred_element_type=jnp.float32)
+    if precision == jax.lax.Precision.HIGH:
+        f32 = jnp.float32
+        a_hi = a.astype(jnp.bfloat16)
+        a_lo = (a - a_hi.astype(f32)).astype(jnp.bfloat16)
+        b_hi = b.astype(jnp.bfloat16)
+        b_lo = (b - b_hi.astype(f32)).astype(jnp.bfloat16)
+        d = functools.partial(jnp.dot, preferred_element_type=f32)
+        return d(a_hi, b_hi) + d(a_hi, b_lo) + d(a_lo, b_hi)
+    return jnp.dot(a, b, preferred_element_type=jnp.float32)
 
 # Division guard for normalizations (normals, axis vectors). Safe for both
 # f32 and f64 inputs: comfortably above denormals, far below any real

@@ -1,0 +1,374 @@
+"""Pallas TPU kernel: fully-fused MANO vertex forward (blendshapes + LBS).
+
+The split pipeline (``models/core.py:forward_batched_pallas``) runs the
+vertex blendshape matmul in XLA, writes ``v_posed [B, V, 3]`` to HBM, and
+re-reads it inside the skinning kernel — ~19 KB of HBM round-trip per eval
+that exists only because the two stages live in different programs. This
+kernel fuses them: one Pallas program computes
+
+    v_posed = coeff_aug @ basis_aug          [TB, 3*VP]   (MXU)
+    M_ac    = r_ac @ W^T                     [TB, VP]     (MXU)
+    out_a   = t_a @ W^T + sum_c M_ac * v_c                (VPU FMAs)
+
+per batch tile, so blended vertices never leave VMEM between blending and
+skinning. Design points:
+
+* **Coordinate-major vertex layout.** The flat vertex axis is laid out as
+  three V-planes (``c * VP + v``, VP = V padded to the 128 lane width)
+  instead of interleaved ``v * 3 + c``; each coordinate plane is then an
+  aligned lane-slice of the matmul output — no strided access, no in-kernel
+  reshapes (the layouts Mosaic lowers most reliably).
+* **Template via augmentation.** The rest template is appended as one extra
+  basis row driven by a constant-1 coefficient column, so "template + blend
+  offsets" is a single MXU contraction with no broadcast-add operand.
+* **Basis resident in VMEM.** The grid iterates over batch tiles only; the
+  ``[K+1, 3*VP]`` basis and ``[J, VP]`` weight blocks have constant index
+  maps, so Pallas fetches them once per launch (~1.7 MB) and every batch
+  tile reuses them from VMEM.
+
+Per-eval HBM traffic drops to coeff + (R, t) slabs + output verts
+(~12 KB) vs ~30 KB for the split path; FLOPs are unchanged (the blend
+matmul pays ~15% lane padding at V=778 -> 896).
+
+Reference semantics being fused: blendshapes /root/reference/mano_np.py:81-91
+and skinning /root/reference/mano_np.py:112-115, with the [B, V, 4, 4]
+transform materialization of the latter eliminated (see ops/pallas_lbs.py).
+
+``forward_verts_fused`` is the raw forward; ``forward_verts_fused_ad``
+carries a custom VJP (backward reuses the skinning kernel for the vertex
+cotangent and one MXU matmul for the coefficient cotangent).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mano_hand_tpu.assets.schema import ManoParams
+from mano_hand_tpu import ops
+from mano_hand_tpu.ops import pallas_lbs
+from mano_hand_tpu.ops.common import (
+    DEFAULT_PRECISION, LANE, SUBLANE, cdiv as _cdiv, kernel_dot,
+)
+
+
+def fused_operands(params: ManoParams, precision=DEFAULT_PRECISION):
+    """Per-asset derived tensors for the fused kernel (batch-invariant).
+
+    Returns ``(basis_aug [Kp, 3*VP], wt [J, VP], joint_template [J, 3],
+    joint_shape_basis [J, 3, S])`` in float32. Kp = S + P + 1 rounded up to
+    the sublane height; the extra row is the rest template (augmentation
+    trick), extra padding rows are zero. Joint regression is precomposed
+    with the shape basis exactly as in ``core.fused_blend_bases``.
+    """
+    f32 = jnp.float32
+    v, _, s = params.shape_basis.shape
+    p = params.pose_basis.shape[-1]
+    k = s + p + 1
+    kp = _cdiv(k, SUBLANE) * SUBLANE
+    vp = _cdiv(v, LANE) * LANE
+    # Rows of the augmented basis, coordinate-major: [K, 3, V].
+    # (jnp coercion first: leaves can arrive as plain host arrays, e.g.
+    # inside custom_vjp backward passes.)
+    shape_basis = jnp.asarray(params.shape_basis, f32)
+    pose_basis = jnp.asarray(params.pose_basis, f32)
+    v_template = jnp.asarray(params.v_template, f32)
+    basis = jnp.concatenate(
+        [
+            shape_basis.transpose(2, 1, 0),                      # [S, 3, V]
+            pose_basis.transpose(2, 1, 0),                       # [P, 3, V]
+            v_template.T[None],                                  # [1, 3, V]
+        ],
+        axis=0,
+    )
+    basis_aug = jnp.pad(
+        basis, [(0, kp - k), (0, 0), (0, vp - v)]
+    ).reshape(kp, 3 * vp)
+    wt = jnp.pad(
+        jnp.asarray(params.lbs_weights, f32).T, [(0, 0), (0, vp - v)]
+    )                                                            # [J, VP]
+    j_regressor = jnp.asarray(params.j_regressor, f32)
+    joint_template = jnp.einsum(
+        "jv,vc->jc", j_regressor, v_template, precision=precision
+    )
+    joint_shape_basis = jnp.einsum(
+        "jv,vcs->jcs", j_regressor, shape_basis, precision=precision
+    )
+    return basis_aug, wt, joint_template, joint_shape_basis
+
+
+def _fused_kernel(vp: int, precision, basis_ref, wt_ref, coeff_ref, *refs):
+    """One batch tile: blend + skin without leaving VMEM.
+
+    Blocks: basis [Kp, 3*VP] and wt [J, VP] (constant index maps — resident
+    across the whole launch); coeff [TB, Kp]; nine rotation-component slabs
+    r_ac [TB, J]; three translation slabs t_a [TB, J]; three output
+    coordinate planes o_a [TB, VP]. Contractions go through
+    ops.common.kernel_dot so the model's precision policy holds inside the
+    kernel too (a bare dot is single-pass bf16 under Mosaic).
+    """
+    r = refs[0:9]
+    t = refs[9:12]
+    o = refs[12:15]
+    vp_flat = kernel_dot(coeff_ref[:], basis_ref[:], precision)  # [TB, 3*VP]
+    wt = wt_ref[:]                                               # [J, VP]
+    for a in range(3):
+        acc = kernel_dot(t[a][:], wt, precision)
+        for c in range(3):
+            m_ac = kernel_dot(r[3 * a + c][:], wt, precision)
+            acc = acc + m_ac * vp_flat[:, c * vp:(c + 1) * vp]
+        o[a][:] = acc
+
+
+def blend_skin_fused(
+    basis_aug: jnp.ndarray,  # [Kp, 3*VP] from fused_operands
+    wt: jnp.ndarray,         # [J, VP] transposed padded LBS weights
+    coeff: jnp.ndarray,      # [B, K] blend coefficients (no template column)
+    skin_rot: jnp.ndarray,   # [B, J, 3, 3] skinning rotations
+    skin_t: jnp.ndarray,     # [B, J, 3] skinning translations
+    n_verts: int,
+    block_b: int = 128,
+    interpret: bool = False,
+    precision=DEFAULT_PRECISION,
+) -> jnp.ndarray:
+    """Blend + skin in one kernel launch: [B, n_verts, 3] vertices."""
+    f32 = jnp.float32
+    b = coeff.shape[0]
+    j = wt.shape[0]
+    kp, lanes = basis_aug.shape
+    vp = lanes // 3
+    block_b = max(1, min(block_b, b))
+    bp = _cdiv(b, block_b) * block_b
+
+    def padb(x):
+        return jnp.pad(x, [(0, bp - b)] + [(0, 0)] * (x.ndim - 1))
+
+    k = coeff.shape[1]
+    # Constant-1 template column, then zero-pad the coefficient axis to Kp.
+    coeff_aug = jnp.pad(
+        jnp.concatenate(
+            [coeff.astype(f32), jnp.ones((b, 1), f32)], axis=1
+        ),
+        [(0, bp - b), (0, kp - (k + 1))],
+    )                                                   # [Bp, Kp]
+    rot = padb(skin_rot.astype(f32))
+    st = padb(skin_t.astype(f32))
+    r_slabs = [rot[:, :, a, c] for a in range(3) for c in range(3)]
+    t_slabs = [st[:, :, a] for a in range(3)]
+
+    grid = (bp // block_b,)
+    const_basis = pl.BlockSpec((kp, 3 * vp), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM)
+    const_wt = pl.BlockSpec((j, vp), lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+    spec_bk = pl.BlockSpec((block_b, kp), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+    spec_bj = pl.BlockSpec((block_b, j), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+    spec_bv = pl.BlockSpec((block_b, vp), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
+        functools.partial(_fused_kernel, vp, precision),
+        grid=grid,
+        in_specs=[const_basis, const_wt, spec_bk,
+                  *([spec_bj] * 12)],
+        out_specs=[spec_bv] * 3,
+        out_shape=[jax.ShapeDtypeStruct((bp, vp), f32)] * 3,
+        interpret=interpret,
+    )(basis_aug, wt, coeff_aug, *r_slabs, *t_slabs)
+    return jnp.stack(outs, axis=-1)[:b, :n_verts, :]
+
+
+def _pre_stage(params, operands, pose, shape, precision):
+    """Rodrigues + joint regression + FK (the tiny non-vertex math, XLA)."""
+    _, _, joint_template, joint_shape_basis = operands
+
+    def one(p, s):
+        rot_mats = ops.rotation_matrix(p)
+        joints = joint_template + jnp.einsum(
+            "jcs,s->jc", joint_shape_basis, s, precision=precision
+        )
+        world_rot, world_t = ops.forward_kinematics(
+            params.parents, rot_mats, joints, precision
+        )
+        skin_rot, skin_t = ops.skinning_transforms(
+            world_rot, world_t, joints, precision
+        )
+        eye = jnp.eye(3, dtype=rot_mats.dtype)
+        coeff = jnp.concatenate([s, (rot_mats[1:] - eye).reshape(-1)])
+        return coeff, skin_rot, skin_t
+
+    return jax.vmap(one)(pose, shape)
+
+
+def forward_verts_fused(
+    params: ManoParams,
+    pose: jnp.ndarray,   # [B, J, 3] axis-angle (row 0 global)
+    shape: jnp.ndarray,  # [B, S]
+    precision=DEFAULT_PRECISION,
+    block_b: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Batched vertices [B, V, 3] via the fully-fused kernel.
+
+    Semantics match ``core.forward_batched(...).verts`` (fused path); only
+    vertices are produced — the joint outputs stay on the XLA paths.
+    """
+    f32 = jnp.float32
+    n_verts = params.v_template.shape[0]
+    if pose.shape[0] == 0:
+        return jnp.zeros((0, n_verts, 3), f32)
+    pose = pose.reshape(pose.shape[0], -1, 3).astype(f32)
+    shape = shape.astype(f32)
+    operands = fused_operands(params, precision)
+    coeff, skin_rot, skin_t = _pre_stage(
+        params, operands, pose, shape, precision
+    )
+    return blend_skin_fused(
+        operands[0], operands[1], coeff, skin_rot, skin_t,
+        n_verts, block_b=block_b, interpret=interpret, precision=precision,
+    )
+
+
+# ---------------------------------------------------------------- custom VJP
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def forward_verts_fused_ad(
+    params, pose, shape,
+    precision=DEFAULT_PRECISION, block_b: int = 128, interpret: bool = False,
+):
+    """Differentiable fused forward: Pallas forward, hybrid backward.
+
+    The backward pass reuses the skinning kernel for the dominant vertex
+    cotangent (LBS is linear in the blended vertices: dL/dvp = M^T g), one
+    MXU matmul for the blend-coefficient cotangent, and JAX autodiff of the
+    tiny pre-stage (Rodrigues/FK) to carry those into (pose, shape) —
+    no [B, V, J, *] tensor anywhere.
+    """
+    return forward_verts_fused(
+        params, pose, shape, precision, block_b, interpret
+    )
+
+
+def _fwd(params, pose, shape, precision, block_b, interpret):
+    out = forward_verts_fused(
+        params, pose, shape, precision, block_b, interpret
+    )
+    return out, (params, pose, shape)
+
+
+def _bwd(precision, block_b, interpret, residuals, g):
+    params, pose, shape = residuals
+    f32 = jnp.float32
+    g = g.astype(f32)
+    hi = jax.lax.Precision.HIGHEST
+    pose32 = pose.reshape(pose.shape[0], -1, 3).astype(f32)
+    shape32 = shape.astype(f32)
+    operands = fused_operands(params, precision)
+    basis_aug, _, _, _ = operands
+
+    # Re-run the cheap pre-stage under VJP so its cotangents flow to
+    # (params, pose, shape); the expensive vertex stages never re-run in
+    # XLA. Differentiating through fused_operands here carries the
+    # joint-regression path's cotangent into j_regressor/shape_basis/
+    # v_template.
+    def pre_p(prm, p, s):
+        return _pre_stage(prm, fused_operands(prm, precision), p, s,
+                          precision)
+
+    (coeff, skin_rot, skin_t), pre_vjp = jax.vjp(
+        pre_p, params, pose32, shape32,
+    )
+
+    # Vertex cotangent dL/dv_posed via the skinning kernel with transposed
+    # rotations and zero translations (see ops/pallas_lbs.py:_skin_bwd).
+    grad_vp = pallas_lbs.skin_batched(
+        params.lbs_weights.astype(f32),
+        skin_rot.transpose(0, 1, 3, 2),
+        jnp.zeros_like(skin_t),
+        g,
+        block_b=min(block_b, 32), block_v=LANE, interpret=interpret,
+        precision=precision,
+    )                                                    # [B, V, 3]
+    # Blend matmul cotangent: vp_flat = coeff_aug @ basis_aug, so
+    # dL/dcoeff = dL/dvp_flat @ basis_aug^T (template column dropped).
+    b = g.shape[0]
+    v = g.shape[1]
+    vp = basis_aug.shape[1] // 3
+    gvp_cm = jnp.pad(
+        grad_vp.transpose(0, 2, 1), [(0, 0), (0, 0), (0, vp - v)]
+    ).reshape(b, 3 * vp)                                 # [B, 3*VP] c-major
+    grad_coeff_aug = jnp.einsum(
+        "bl,kl->bk", gvp_cm, basis_aug, precision=hi
+    )
+    k = coeff.shape[1]
+    grad_coeff = grad_coeff_aug[:, :k]
+
+    # Recompute v_posed (one matmul) for the rotation/translation cotangents.
+    coeff_aug = jnp.concatenate([coeff, jnp.ones((b, 1), f32)], axis=1)
+    kp = basis_aug.shape[0]
+    coeff_aug = jnp.pad(coeff_aug, [(0, 0), (0, kp - (k + 1))])
+    v_posed = (
+        jnp.einsum("bk,kl->bl", coeff_aug, basis_aug, precision=hi)
+        .reshape(b, 3, vp)[:, :, :v].transpose(0, 2, 1)  # [B, V, 3]
+    )
+    outer = g[..., :, None] * v_posed[..., None, :]      # [B, V, 3, 3]
+    w = jnp.asarray(params.lbs_weights, f32)
+    grad_rot = jnp.einsum("vj,bvac->bjac", w, outer, precision=hi)
+    grad_t = jnp.einsum("vj,bva->bja", w, g, precision=hi)
+
+    grad_params_pre, grad_pose, grad_shape = pre_vjp(
+        (grad_coeff, grad_rot, grad_t)
+    )
+
+    # Direct vertex-path parameter cotangents (the pre-stage vjp covers
+    # only the joint/FK dependence):
+    #   lbs_weights — same formula as pallas_lbs._skin_bwd;
+    #   basis_aug   — vp_flat = coeff_aug @ basis_aug, so
+    #                 dL/dbasis = coeff_aug^T @ dL/dvp_flat, unpacked back
+    #                 through the coordinate-major packing of
+    #                 fused_operands into (shape_basis, pose_basis,
+    #                 v_template) cotangents.
+    grad_w = (
+        jnp.einsum("bvac,bjac->vj", outer, skin_rot, precision=hi)
+        + jnp.einsum("bva,bja->vj", g, skin_t, precision=hi)
+    )
+    grad_basis = jnp.einsum(
+        "bk,bl->kl", coeff_aug, gvp_cm, precision=hi
+    ).reshape(kp, 3, vp)[:, :, :v]                       # [Kp, 3, V]
+    s_dim = params.shape_basis.shape[-1]
+    p_dim = params.pose_basis.shape[-1]
+    import dataclasses
+
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    grad_params_vert = dataclasses.replace(
+        zeros,
+        lbs_weights=grad_w.astype(zeros.lbs_weights.dtype),
+        shape_basis=grad_basis[:s_dim].transpose(2, 1, 0)
+        .astype(zeros.shape_basis.dtype),
+        pose_basis=grad_basis[s_dim:s_dim + p_dim].transpose(2, 1, 0)
+        .astype(zeros.pose_basis.dtype),
+        v_template=grad_basis[s_dim + p_dim].T
+        .astype(zeros.v_template.dtype),
+    )
+    def _combine(a, b):
+        # Integer leaves (faces) carry float0 cotangents from the vjp —
+        # pass those through untouched (the required tangent type).
+        if getattr(b, "dtype", None) == jax.dtypes.float0:
+            return b
+        return a + b.astype(a.dtype)
+
+    grad_params = jax.tree_util.tree_map(
+        _combine, grad_params_vert, grad_params_pre,
+    )
+    return (
+        grad_params,
+        grad_pose.reshape(pose.shape).astype(pose.dtype),
+        grad_shape.astype(shape.dtype),
+    )
+
+
+forward_verts_fused_ad.defvjp(_fwd, _bwd)

@@ -34,12 +34,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from mano_hand_tpu.ops.common import (
+    DEFAULT_PRECISION, cdiv as _cdiv, kernel_dot,
+)
 
-def _cdiv(a: int, b: int) -> int:
-    return -(-a // b)
 
-
-def _skin_kernel(wt_ref, *refs):
+def _skin_kernel(precision, wt_ref, *refs):
     """All-2-D blocks (the shapes Mosaic lowers most reliably — no in-kernel
     reshapes or >2-D relayouts): wt [J, TV]; nine rotation-component slabs
     r_ac [TB, J]; three translation slabs t_a [TB, J]; three rest-coordinate
@@ -54,17 +54,16 @@ def _skin_kernel(wt_ref, *refs):
     o = refs[15:18]
     wt = wt_ref[:]                                        # [J, TV]
     for a in range(3):
-        acc = jnp.dot(t[a][:], wt, preferred_element_type=jnp.float32)
+        acc = kernel_dot(t[a][:], wt, precision)
         for c in range(3):
-            m_ac = jnp.dot(
-                r[3 * a + c][:], wt, preferred_element_type=jnp.float32
-            )
+            m_ac = kernel_dot(r[3 * a + c][:], wt, precision)
             acc = acc + m_ac * v[c][:]
         o[a][:] = acc
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_b", "block_v", "interpret")
+    jax.jit,
+    static_argnames=("block_b", "block_v", "interpret", "precision"),
 )
 def skin_batched(
     weights: jnp.ndarray,    # [V, J] LBS weights
@@ -74,10 +73,13 @@ def skin_batched(
     block_b: int = 32,
     block_v: int = 128,
     interpret: bool = False,
+    precision=DEFAULT_PRECISION,
 ) -> jnp.ndarray:
     """Batched fused LBS: [B, V, 3] skinned vertices.
 
-    Semantics identical to vmap(ops.lbs.skin) over the batch axis.
+    Semantics identical to vmap(ops.lbs.skin) over the batch axis, INCLUDING
+    the contraction precision (see ops.common.kernel_dot — a bare in-kernel
+    dot would silently run single-pass bf16 and fail the 1e-4 gate).
     ``interpret=True`` runs the kernel in the Pallas interpreter (CPU tests).
     """
     b, v, j = v_posed.shape[0], weights.shape[0], weights.shape[1]
@@ -103,7 +105,7 @@ def skin_batched(
     spec_bv = pl.BlockSpec((block_b, block_v), lambda i, k: (i, k),
                            memory_space=pltpu.VMEM)
     outs = pl.pallas_call(
-        _skin_kernel,
+        functools.partial(_skin_kernel, precision),
         grid=grid,
         in_specs=[
             pl.BlockSpec((j, block_v), lambda i, k: (0, k),
@@ -119,28 +121,31 @@ def skin_batched(
 
 
 # ---------------------------------------------------------------- custom VJP
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def skin_batched_ad(
     weights, world_rot, skin_t, v_posed,
     block_b: int = 32, block_v: int = 128, interpret: bool = False,
+    precision=DEFAULT_PRECISION,
 ):
     """Differentiable fused LBS: Pallas forward, composed VJP backward."""
     return skin_batched(
         weights, world_rot, skin_t, v_posed,
         block_b=block_b, block_v=block_v, interpret=interpret,
+        precision=precision,
     )
 
 
 def _skin_fwd(weights, world_rot, skin_t, v_posed,
-              block_b, block_v, interpret):
+              block_b, block_v, interpret, precision):
     out = skin_batched(
         weights, world_rot, skin_t, v_posed,
         block_b=block_b, block_v=block_v, interpret=interpret,
+        precision=precision,
     )
     return out, (weights, world_rot, skin_t, v_posed)
 
 
-def _skin_bwd(block_b, block_v, interpret, residuals, g):
+def _skin_bwd(block_b, block_v, interpret, precision, residuals, g):
     weights, world_rot, skin_t, v_posed = residuals
     g = g.astype(jnp.float32)
     hi = jax.lax.Precision.HIGHEST
@@ -150,6 +155,7 @@ def _skin_bwd(block_b, block_v, interpret, residuals, g):
         weights, world_rot.transpose(0, 1, 3, 2),
         jnp.zeros_like(skin_t), g,
         block_b=block_b, block_v=block_v, interpret=interpret,
+        precision=precision,
     )
     # The largest backward intermediate is outer [B, V, 3, 3] (9BV floats,
     # shared by grad_rot and grad_w) — the same bound as the einsum path's

@@ -1,0 +1,176 @@
+"""Fully-fused Pallas forward (ops/pallas_forward.py) vs the XLA paths.
+
+All kernel launches run under ``interpret=True`` (Pallas CPU interpreter);
+the real-chip compile + timing happens in bench.py config 3c.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mano_hand_tpu.models import core
+from mano_hand_tpu.ops import pallas_forward
+
+TOL = 1e-4
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+def _rand(b, seed=0):
+    rng = np.random.default_rng(seed)
+    pose = rng.normal(scale=0.6, size=(b, 16, 3)).astype(np.float32)
+    beta = rng.normal(size=(b, 10)).astype(np.float32)
+    return jnp.asarray(pose), jnp.asarray(beta)
+
+
+def test_matches_forward_batched(params32):
+    pose, beta = _rand(6)
+    want = core.forward_batched(params32, pose, beta).verts
+    got = pallas_forward.forward_verts_fused(
+        params32, pose, beta, block_b=4, interpret=True
+    )
+    assert got.shape == want.shape
+    assert np.abs(np.asarray(got) - np.asarray(want)).max() < TOL
+
+
+def test_ragged_batch_and_flat_pose(params32):
+    # B=5 is not a multiple of block_b=4: the pad/slice path must be exact,
+    # and [B, 48] flat poses must behave like [B, 16, 3].
+    pose, beta = _rand(5, seed=1)
+    want = core.forward_batched(params32, pose, beta).verts
+    got = pallas_forward.forward_verts_fused(
+        params32, pose.reshape(5, 48), beta, block_b=4, interpret=True
+    )
+    assert np.abs(np.asarray(got) - np.asarray(want)).max() < TOL
+
+
+def test_empty_batch(params32):
+    pose, beta = _rand(0)
+    got = pallas_forward.forward_verts_fused(
+        params32, pose, beta, interpret=True
+    )
+    assert got.shape == (0, params32.v_template.shape[0], 3)
+
+
+def test_zero_pose_is_rest_mesh(params32):
+    # At theta=0 every rotation is I: the pose corrective vanishes and the
+    # kernel must reproduce the shaped rest mesh (mano_np.py:87-91 quirk).
+    beta = jnp.asarray(
+        np.random.default_rng(2).normal(size=(3, 10)), jnp.float32
+    )
+    pose = jnp.zeros((3, 16, 3), jnp.float32)
+    want = core.forward_batched(params32, pose, beta).verts
+    got = pallas_forward.forward_verts_fused(
+        params32, pose, beta, block_b=8, interpret=True
+    )
+    assert np.abs(np.asarray(got) - np.asarray(want)).max() < TOL
+
+
+def test_vjp_matches_xla_grad(params32):
+    pose, beta = _rand(4, seed=3)
+    targets = core.forward_batched(params32, pose, beta).verts
+
+    def loss_ref(p, s):
+        v = core.forward_batched(params32, p, s).verts
+        return ((v - targets) ** 2).sum()
+
+    def loss_fused(p, s):
+        v = pallas_forward.forward_verts_fused_ad(
+            params32, p, s, jax.lax.Precision.HIGHEST, 4, True
+        )
+        return ((v - targets) ** 2).sum()
+
+    p2, b2 = _rand(4, seed=4)
+    gp_ref, gs_ref = jax.grad(loss_ref, argnums=(0, 1))(p2, b2)
+    gp, gs = jax.grad(loss_fused, argnums=(0, 1))(p2, b2)
+    # Relative tolerance: gradients scale with vertex count.
+    def close(a, b):
+        a, b = np.asarray(a), np.asarray(b)
+        denom = max(1.0, np.abs(b).max())
+        return np.abs(a - b).max() / denom < 1e-4
+
+    assert close(gp, gp_ref)
+    assert close(gs, gs_ref)
+
+
+def test_param_grads_match_xla(params32):
+    # The hybrid VJP must produce REAL parameter cotangents (template,
+    # bases, weights, regressor), not zeros — checked against autodiff of
+    # the plain XLA path.
+    pose, beta = _rand(3, seed=7)
+    hi = jax.lax.Precision.HIGHEST
+
+    def loss_ref(prm):
+        return core.forward_batched(prm, pose, beta, precision=hi).verts.sum()
+
+    def loss_fused(prm):
+        return pallas_forward.forward_verts_fused_ad(
+            prm, pose, beta, hi, 2, True
+        ).sum()
+
+    # allow_int: the faces leaf is integer-valued and gets float0 tangents.
+    g_ref = jax.grad(loss_ref, allow_int=True)(params32)
+    g_fused = jax.grad(loss_fused, allow_int=True)(params32)
+    for name in ("v_template", "shape_basis", "pose_basis",
+                 "lbs_weights", "j_regressor"):
+        a = np.asarray(getattr(g_fused, name))
+        b = np.asarray(getattr(g_ref, name))
+        denom = max(1.0, np.abs(b).max())
+        assert np.abs(a - b).max() / denom < 1e-4, name
+        assert np.abs(b).max() > 0, f"{name}: reference grad trivially zero"
+
+
+def test_grad_finite_at_zero_pose(params32):
+    # theta=0 is the fitting init; the Taylor-guarded Rodrigues must keep
+    # the fused path's gradients finite there too.
+    pose = jnp.zeros((2, 16, 3), jnp.float32)
+    beta = jnp.zeros((2, 10), jnp.float32)
+
+    g = jax.grad(
+        lambda p: pallas_forward.forward_verts_fused_ad(
+            params32, p, beta, jax.lax.Precision.HIGHEST, 2, True
+        ).sum()
+    )(pose)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_chunked_fused_route(params32):
+    # forward_chunked(use_pallas_fused=True) must agree with the XLA path,
+    # including a ragged trailing chunk.
+    pose, beta = _rand(10, seed=6)
+    want = core.forward_batched(params32, pose, beta).verts
+    got = core.forward_chunked(
+        params32, pose, beta, chunk_size=4,
+        use_pallas_fused=True, block_b=4, interpret=True,
+    )
+    assert np.abs(np.asarray(got) - np.asarray(want)).max() < TOL
+
+
+def test_string_precision_canonicalized(params32):
+    # JAX accepts 'high' anywhere Precision.HIGH is legal; the kernels must
+    # canonicalize rather than silently fall through to single-pass bf16.
+    pose, beta = _rand(2, seed=9)
+    a = pallas_forward.forward_verts_fused(
+        params32, pose, beta, precision="high", block_b=2, interpret=True
+    )
+    b = pallas_forward.forward_verts_fused(
+        params32, pose, beta, precision=jax.lax.Precision.HIGH,
+        block_b=2, interpret=True,
+    )
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_jit_compiles(params32):
+    pose, beta = _rand(4, seed=5)
+    fn = jax.jit(
+        lambda p, s: pallas_forward.forward_verts_fused(
+            params32, p, s, block_b=4, interpret=True
+        )
+    )
+    want = core.forward_batched(params32, pose, beta).verts
+    got = jax.block_until_ready(fn(pose, beta))
+    assert np.abs(np.asarray(got) - np.asarray(want)).max() < TOL

@@ -56,9 +56,13 @@ def test_forward_batched_pallas_parity(params32):
 
 def test_skin_batched_ad_gradient_parity():
     weights, rot, t, vp = rand_skin_inputs(seed=11, b=3)
+    hi = jax.lax.Precision.HIGHEST
 
+    # HIGHEST: both sides are exact f32 on CPU — tight absolute parity.
     def loss_pallas(w_, r_, t_, v_):
-        return (pallas_lbs.skin_batched_ad(w_, r_, t_, v_, 32, 128, True) ** 2).sum()
+        return (
+            pallas_lbs.skin_batched_ad(w_, r_, t_, v_, 32, 128, True, hi) ** 2
+        ).sum()
 
     def loss_einsum(w_, r_, t_, v_):
         return (
@@ -70,6 +74,20 @@ def test_skin_batched_ad_gradient_parity():
     ge = jax.grad(loss_einsum, argnums=(0, 1, 2, 3))(*args)
     for a, b in zip(gp, ge):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+    # Default HIGH runs the kernel's 3-pass bf16 decomposition even in the
+    # interpreter — gradients must stay within bf16-compensated RELATIVE
+    # error of the exact ones (the same policy XLA applies outside kernels).
+    def loss_high(w_, r_, t_, v_):
+        return (
+            pallas_lbs.skin_batched_ad(w_, r_, t_, v_, 32, 128, True) ** 2
+        ).sum()
+
+    gh = jax.grad(loss_high, argnums=(0, 1, 2, 3))(*args)
+    for a, b in zip(gh, ge):
+        a, b = np.asarray(a), np.asarray(b)
+        rel = np.abs(a - b).max() / max(1e-6, np.abs(b).max())
+        assert rel < 1e-4, rel
 
 
 def test_forward_batched_pallas_is_differentiable(params32):
