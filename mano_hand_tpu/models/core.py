@@ -225,7 +225,7 @@ def forward_batched(
 # on TPU v5e (docs/benchmarking.md). THE one definition — the kernel entry
 # points below and bench.py's quick sweep/fallback all read it, so a new
 # sweep winner is a one-line change.
-PALLAS_BEST_BLOCK = (32, 896)
+PALLAS_BEST_BLOCK = (64, 896)
 
 # Batch tile for the fully-fused forward kernel (ops/pallas_forward.py),
 # which has no vertex-tile knob (the whole padded mesh rides the lanes).
@@ -388,7 +388,8 @@ def forward_chunked(
         bb = PALLAS_BEST_BLOCK[0] if block_b is None else block_b
         chunk_fn = lambda ps: forward_batched_pallas(  # noqa: E731
             params, ps[0], ps[1], precision,
-            block_b=bb, block_v=block_v, interpret=interpret,
+            block_b=min(bb, chunk_size), block_v=block_v,
+            interpret=interpret,
         )
     else:
         chunk_fn = lambda ps: forward_batched(  # noqa: E731

@@ -56,14 +56,11 @@ from mano_hand_tpu.ops.common import (
 )
 
 
-def fused_operands(params: ManoParams, precision=DEFAULT_PRECISION):
-    """Per-asset derived tensors for the fused kernel (batch-invariant).
+def vertex_operands(params: ManoParams):
+    """Kernel-side derived tensors: ``(basis_aug [Kp, 3*VP], wt [J, VP])``.
 
-    Returns ``(basis_aug [Kp, 3*VP], wt [J, VP], joint_template [J, 3],
-    joint_shape_basis [J, 3, S])`` in float32. Kp = S + P + 1 rounded up to
-    the sublane height; the extra row is the rest template (augmentation
-    trick), extra padding rows are zero. Joint regression is precomposed
-    with the shape basis exactly as in ``core.fused_blend_bases``.
+    Kp = S + P + 1 rounded up to the sublane height; the extra row is the
+    rest template (augmentation trick), extra padding rows are zero.
     """
     f32 = jnp.float32
     v, _, s = params.shape_basis.shape
@@ -91,14 +88,30 @@ def fused_operands(params: ManoParams, precision=DEFAULT_PRECISION):
     wt = jnp.pad(
         jnp.asarray(params.lbs_weights, f32).T, [(0, 0), (0, vp - v)]
     )                                                            # [J, VP]
+    return basis_aug, wt
+
+
+def joint_operands(params: ManoParams, precision=DEFAULT_PRECISION):
+    """Pre-stage derived tensors: ``(joint_template [J, 3],
+    joint_shape_basis [J, 3, S])`` — joint regression precomposed with the
+    shape basis exactly as in ``core.fused_blend_bases``."""
+    f32 = jnp.float32
     j_regressor = jnp.asarray(params.j_regressor, f32)
     joint_template = jnp.einsum(
-        "jv,vc->jc", j_regressor, v_template, precision=precision
+        "jv,vc->jc", j_regressor,
+        jnp.asarray(params.v_template, f32), precision=precision,
     )
     joint_shape_basis = jnp.einsum(
-        "jv,vcs->jcs", j_regressor, shape_basis, precision=precision
+        "jv,vcs->jcs", j_regressor,
+        jnp.asarray(params.shape_basis, f32), precision=precision,
     )
-    return basis_aug, wt, joint_template, joint_shape_basis
+    return joint_template, joint_shape_basis
+
+
+def fused_operands(params: ManoParams, precision=DEFAULT_PRECISION):
+    """All per-asset derived tensors for the fused path (batch-invariant):
+    ``(basis_aug, wt, joint_template, joint_shape_basis)`` in float32."""
+    return (*vertex_operands(params), *joint_operands(params, precision))
 
 
 def _fused_kernel(vp: int, precision, basis_ref, wt_ref, coeff_ref, *refs):
@@ -267,8 +280,9 @@ def _bwd(precision, block_b, interpret, residuals, g):
     hi = jax.lax.Precision.HIGHEST
     pose32 = pose.reshape(pose.shape[0], -1, 3).astype(f32)
     shape32 = shape.astype(f32)
-    operands = fused_operands(params, precision)
-    basis_aug, _, _, _ = operands
+    # Only the vertex-side tensors are needed here; pre_p derives its own
+    # joint operands under the vjp (so their cotangents flow to params).
+    basis_aug, _ = vertex_operands(params)
 
     # Re-run the cheap pre-stage under VJP so its cotangents flow to
     # (params, pose, shape); the expensive vertex stages never re-run in
