@@ -31,6 +31,24 @@ def cdiv(a: int, b: int) -> int:
     return -(-a // b)
 
 
+def split_hi_lo(x):
+    """f32 -> (hi, lo) bf16 pair with x ~= hi + lo.
+
+    THE one definition of the operand split used by the HIGH-precision
+    3-pass decomposition everywhere (kernel_dot below, and the Pallas
+    kernels that pre-split resident operands outside their grid loops)."""
+    hi = x.astype(jnp.bfloat16)
+    lo = (x - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
+
+
+def dot3(a_hi, a_lo, b_hi, b_lo):
+    """HIGH-precision product of pre-split operands: 3 bf16 MXU passes
+    (a_hi·b_hi + a_hi·b_lo + a_lo·b_hi), f32 accumulation."""
+    d = functools.partial(jnp.dot, preferred_element_type=jnp.float32)
+    return d(a_hi, b_hi) + d(a_hi, b_lo) + d(a_lo, b_hi)
+
+
 def kernel_dot(a, b, precision=DEFAULT_PRECISION):
     """Precision-faithful matmul for INSIDE Pallas kernels.
 
@@ -53,13 +71,7 @@ def kernel_dot(a, b, precision=DEFAULT_PRECISION):
         return jnp.dot(a, b, precision=jax.lax.Precision.HIGHEST,
                        preferred_element_type=jnp.float32)
     if precision == jax.lax.Precision.HIGH:
-        f32 = jnp.float32
-        a_hi = a.astype(jnp.bfloat16)
-        a_lo = (a - a_hi.astype(f32)).astype(jnp.bfloat16)
-        b_hi = b.astype(jnp.bfloat16)
-        b_lo = (b - b_hi.astype(f32)).astype(jnp.bfloat16)
-        d = functools.partial(jnp.dot, preferred_element_type=f32)
-        return d(a_hi, b_hi) + d(a_hi, b_lo) + d(a_lo, b_hi)
+        return dot3(*split_hi_lo(a), *split_hi_lo(b))
     return jnp.dot(a, b, preferred_element_type=jnp.float32)
 
 # Division guard for normalizations (normals, axis vectors). Safe for both

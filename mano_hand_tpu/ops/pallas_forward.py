@@ -52,7 +52,8 @@ from mano_hand_tpu.assets.schema import ManoParams
 from mano_hand_tpu import ops
 from mano_hand_tpu.ops import pallas_lbs
 from mano_hand_tpu.ops.common import (
-    DEFAULT_PRECISION, LANE, SUBLANE, cdiv as _cdiv, kernel_dot,
+    DEFAULT_PRECISION, LANE, SUBLANE, cdiv as _cdiv,
+    dot3 as _dot3, kernel_dot, split_hi_lo as _split_hi_lo,
 )
 
 
@@ -137,6 +138,34 @@ def _fused_kernel(vp: int, precision, basis_ref, wt_ref, coeff_ref, *refs):
         o[a][:] = acc
 
 
+def _fused_kernel_split(vp: int, basis_hi_ref, basis_lo_ref,
+                        wt_hi_ref, wt_lo_ref, coeff_ref, *refs):
+    """HIGH-precision variant with the big operands pre-split to bf16.
+
+    Splitting the [Kp, 3*VP] basis inside the kernel would redo ~400K VPU
+    cast/subtract ops on every grid step; pre-splitting at the JAX level
+    moves that work out of the loop entirely (it fuses into the one-time
+    operand prep) and halves the resident bytes per copy. Only the tiny
+    per-tile operands (coeff [TB, Kp], r/t slabs [TB, J]) split in-kernel.
+    Numerics are identical to kernel_dot's HIGH path: same a_hi*b_hi +
+    a_hi*b_lo + a_lo*b_hi decomposition, f32 accumulate.
+    """
+    r = refs[0:9]
+    t = refs[9:12]
+    o = refs[12:15]
+    c_hi, c_lo = _split_hi_lo(coeff_ref[:])
+    vp_flat = _dot3(c_hi, c_lo, basis_hi_ref[:], basis_lo_ref[:])
+    w_hi, w_lo = wt_hi_ref[:], wt_lo_ref[:]
+    for a in range(3):
+        t_hi, t_lo = _split_hi_lo(t[a][:])
+        acc = _dot3(t_hi, t_lo, w_hi, w_lo)
+        for c in range(3):
+            r_hi, r_lo = _split_hi_lo(r[3 * a + c][:])
+            m_ac = _dot3(r_hi, r_lo, w_hi, w_lo)
+            acc = acc + m_ac * vp_flat[:, c * vp:(c + 1) * vp]
+        o[a][:] = acc
+
+
 def blend_skin_fused(
     basis_aug: jnp.ndarray,  # [Kp, 3*VP] from fused_operands
     wt: jnp.ndarray,         # [J, VP] transposed padded LBS weights
@@ -184,15 +213,35 @@ def blend_skin_fused(
                            memory_space=pltpu.VMEM)
     spec_bv = pl.BlockSpec((block_b, vp), lambda i: (i, 0),
                            memory_space=pltpu.VMEM)
-    outs = pl.pallas_call(
-        functools.partial(_fused_kernel, vp, precision),
-        grid=grid,
-        in_specs=[const_basis, const_wt, spec_bk,
-                  *([spec_bj] * 12)],
-        out_specs=[spec_bv] * 3,
-        out_shape=[jax.ShapeDtypeStruct((bp, vp), f32)] * 3,
-        interpret=interpret,
-    )(basis_aug, wt, coeff_aug, *r_slabs, *t_slabs)
+
+    canon = (jax.lax.Precision(precision)
+             if precision is not None else precision)
+    if canon == jax.lax.Precision.HIGH:
+        # Pre-split the resident operands to bf16 hi/lo pairs at the JAX
+        # level (one-time prep, hoisted out of callers' loops) so the grid
+        # steps run pure bf16 MXU passes — see _fused_kernel_split.
+        basis_hi, basis_lo = _split_hi_lo(basis_aug)
+        wt_hi, wt_lo = _split_hi_lo(wt)
+        outs = pl.pallas_call(
+            functools.partial(_fused_kernel_split, vp),
+            grid=grid,
+            in_specs=[const_basis, const_basis, const_wt, const_wt,
+                      spec_bk, *([spec_bj] * 12)],
+            out_specs=[spec_bv] * 3,
+            out_shape=[jax.ShapeDtypeStruct((bp, vp), f32)] * 3,
+            interpret=interpret,
+        )(basis_hi, basis_lo, wt_hi, wt_lo, coeff_aug,
+          *r_slabs, *t_slabs)
+    else:
+        outs = pl.pallas_call(
+            functools.partial(_fused_kernel, vp, precision),
+            grid=grid,
+            in_specs=[const_basis, const_wt, spec_bk,
+                      *([spec_bj] * 12)],
+            out_specs=[spec_bv] * 3,
+            out_shape=[jax.ShapeDtypeStruct((bp, vp), f32)] * 3,
+            interpret=interpret,
+        )(basis_aug, wt, coeff_aug, *r_slabs, *t_slabs)
     return jnp.stack(outs, axis=-1)[:b, :n_verts, :]
 
 
