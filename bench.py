@@ -489,14 +489,16 @@ def run_benchmarks(args, device_str: str) -> dict:
         log(f"config3b best: {rate:,.0f} evals/s at block_b={bb} "
             f"block_v={bv} launch={best_launch}")
 
-        # Accuracy probe through the COMPILED kernel at the winning block:
-        # the headline path's numerics must be measured on-chip, not assumed
-        # from interpret-mode tests. Readback deferred to the accuracy
-        # section (D2H poisons axon dispatch).
-        verts_pallas = core.forward_batched_pallas(
-            right, jnp.asarray(poses), jnp.asarray(betas),
-            block_b=bb, block_v=bv,
-        )
+        # Accuracy probe through the COMPILED kernel at the winning block,
+        # under jit with params as traced args — the same compilation
+        # context as the timed path. (An eager probe once missed an
+        # XLA-level fold that zeroed the jitted path's bf16 residuals.)
+        # Readback deferred to the accuracy section (D2H poisons axon
+        # dispatch).
+        verts_pallas = jax.jit(
+            lambda prm, p, s: core.forward_batched_pallas(
+                prm, p, s, block_b=bb, block_v=bv)
+        )(right, jnp.asarray(poses), jnp.asarray(betas))
         prove_vjp(make_fn(bb, bv))
         results["pallas_vjp_compiles"] = True
         log("config3b pallas VJP compiled + executed")
@@ -544,11 +546,14 @@ def run_benchmarks(args, device_str: str) -> dict:
         log(f"config3c best: {rate:,.0f} evals/s at block_b={bb} "
             f"launch={best_launch}")
 
-        # On-chip accuracy probe (readback deferred to the accuracy section)
-        # + VJP execute proof for the hybrid backward.
-        verts_fused = core.forward_batched_pallas_fused(
-            right, jnp.asarray(poses), jnp.asarray(betas), block_b=bb
-        )
+        # On-chip accuracy probe in the SAME compilation context as the
+        # timed path (jit, params as traced args — see config3b note);
+        # readback deferred to the accuracy section. Plus a VJP execute
+        # proof for the hybrid backward.
+        verts_fused = jax.jit(
+            lambda prm, p, s: core.forward_batched_pallas_fused(
+                prm, p, s, block_b=bb)
+        )(right, jnp.asarray(poses), jnp.asarray(betas))
         prove_vjp(make_fn(bb))
         results["fused_vjp_compiles"] = True
         log("config3c fused VJP compiled + executed")

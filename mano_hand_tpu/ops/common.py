@@ -32,13 +32,36 @@ def cdiv(a: int, b: int) -> int:
 
 
 def split_hi_lo(x):
-    """f32 -> (hi, lo) bf16 pair with x ~= hi + lo.
+    """f32 -> (hi, lo) bf16 pair with x ~= hi + lo — INSIDE-KERNEL version.
 
-    THE one definition of the operand split used by the HIGH-precision
-    3-pass decomposition everywhere (kernel_dot below, and the Pallas
-    kernels that pre-split resident operands outside their grid loops)."""
+    The operand split of the HIGH-precision 3-pass decomposition. This
+    convert-based form is correct under Mosaic (measured 1.45e-6 vertex
+    error on-chip) but MUST NOT run at the XLA level: XLA:TPU folds the
+    bf16->f32 convert pair to identity, so ``x - f32(bf16(x))`` compiles
+    to literally zero (measured) and the decomposition silently collapses
+    to single-pass bf16. Use ``split_hi_lo_xla`` outside kernels."""
     hi = x.astype(jnp.bfloat16)
     lo = (x - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
+
+
+def split_hi_lo_xla(x):
+    """f32 -> (hi, lo) bf16 pair with x ~= hi + lo — XLA-LEVEL version.
+
+    Fold-proof form of ``split_hi_lo`` for code compiled by XLA (operand
+    pre-splitting outside Pallas kernels): the high half is extracted by
+    masking the low 16 mantissa bits (truncation — every such value is
+    exactly representable in bf16), so there is no convert round-trip for
+    the simplifier to elide and the residual subtraction stays exact f32
+    (Sterbenz). The truncated hi makes lo at most 2x the round-to-nearest
+    split's — immaterial, since lo is fully carried by the decomposition.
+    """
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    hi_f32 = jax.lax.bitcast_convert_type(
+        bits & jnp.uint32(0xFFFF0000), jnp.float32
+    )
+    hi = hi_f32.astype(jnp.bfloat16)       # exact: value is on the bf16 grid
+    lo = (x - hi_f32).astype(jnp.bfloat16)
     return hi, lo
 
 

@@ -54,6 +54,7 @@ from mano_hand_tpu.ops import pallas_lbs
 from mano_hand_tpu.ops.common import (
     DEFAULT_PRECISION, LANE, SUBLANE, cdiv as _cdiv,
     dot3 as _dot3, kernel_dot, split_hi_lo as _split_hi_lo,
+    split_hi_lo_xla,
 )
 
 
@@ -219,9 +220,11 @@ def blend_skin_fused(
     if canon == jax.lax.Precision.HIGH:
         # Pre-split the resident operands to bf16 hi/lo pairs at the JAX
         # level (one-time prep, hoisted out of callers' loops) so the grid
-        # steps run pure bf16 MXU passes — see _fused_kernel_split.
-        basis_hi, basis_lo = _split_hi_lo(basis_aug)
-        wt_hi, wt_lo = _split_hi_lo(wt)
+        # steps run pure bf16 MXU passes — see _fused_kernel_split. MUST be
+        # the fold-proof XLA-level split: the convert-based one compiles to
+        # lo == 0 under XLA:TPU (see ops.common).
+        basis_hi, basis_lo = split_hi_lo_xla(basis_aug)
+        wt_hi, wt_lo = split_hi_lo_xla(wt)
         outs = pl.pallas_call(
             functools.partial(_fused_kernel_split, vp),
             grid=grid,

@@ -10,6 +10,7 @@ from mano_hand_tpu.parallel.sharding import (
     ShardedParams,
     gspmd_forward,
     pad_verts,
+    pallas_forward_dp,
     shard_map_forward,
     shard_params,
 )
@@ -28,6 +29,7 @@ __all__ = [
     "shard_params",
     "pad_verts",
     "gspmd_forward",
+    "pallas_forward_dp",
     "shard_map_forward",
     "FitState",
     "init_state",

@@ -207,3 +207,53 @@ def shard_map_forward(params, mesh: Mesh, n_verts: int | None = None):
         return shard_fn(prm, pose, shape)[:, :n_verts]
 
     return lambda pose, shape: fwd(params, pose, shape)
+
+
+def pallas_forward_dp(
+    params: ManoParams,
+    mesh: Mesh,
+    block_b: int | None = None,
+    interpret: bool = False,
+):
+    """Data-parallel fused-kernel forward: each device runs the fully-fused
+    Pallas kernel (ops/pallas_forward.py) on its local batch shard.
+
+    Params are replicated (they are ~1.4 MB — far below the point where the
+    'model'-axis vertex sharding of ``shard_map_forward`` pays for itself on
+    the kernel path) and the per-shard program contains no collectives, so
+    scaling is embarrassingly parallel over the 'data' axis: this is the
+    multi-chip shape of the single-chip headline path. The data-axis size
+    must divide the global batch.
+
+    ``interpret=True`` runs the kernel in the Pallas interpreter — how the
+    virtual CPU meshes in CI exercise this composition.
+    """
+    from mano_hand_tpu.models import core as _core
+    from mano_hand_tpu.ops import pallas_forward
+
+    params, true_v = _unwrap(params)
+    bb = _core.FUSED_BEST_BLOCK_B if block_b is None else block_b
+
+    def per_shard(prm, pose, shape):
+        # Slice back to the asset's true vertex count: padded ShardedParams
+        # must never leak padding rows into outputs (module invariant).
+        return pallas_forward.forward_verts_fused(
+            prm, pose, shape, block_b=bb, interpret=interpret
+        )[:, :true_v]
+
+    shard_fn = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS),
+        # pallas_call's out_shape carries no varying-mesh-axes annotation,
+        # so shard_map's vma check rejects it; the manual out_specs above
+        # are the full truth for this collective-free program.
+        check_vma=False,
+    )
+
+    @jax.jit
+    def fwd(prm, pose, shape):
+        return shard_fn(prm, pose, shape)
+
+    return lambda pose, shape: fwd(params, pose, shape)
