@@ -221,9 +221,10 @@ def pallas_forward_dp(
     Params are replicated (they are ~1.4 MB — far below the point where the
     'model'-axis vertex sharding of ``shard_map_forward`` pays for itself on
     the kernel path) and the per-shard program contains no collectives, so
-    scaling is embarrassingly parallel over the 'data' axis: this is the
-    multi-chip shape of the single-chip headline path. The data-axis size
-    must divide the global batch.
+    scaling is embarrassingly parallel: the batch shards over BOTH mesh
+    axes (a model>1 axis would otherwise just replicate work), giving full
+    n-device parallelism on the single-chip headline path. The total
+    device count must divide the global batch.
 
     ``interpret=True`` runs the kernel in the Pallas interpreter — how the
     virtual CPU meshes in CI exercise this composition.
@@ -241,11 +242,12 @@ def pallas_forward_dp(
             prm, pose, shape, block_b=bb, interpret=interpret
         )[:, :true_v]
 
+    batch_spec = P((DATA_AXIS, MODEL_AXIS))
     shard_fn = jax.shard_map(
         per_shard,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=P(DATA_AXIS),
+        in_specs=(P(), batch_spec, batch_spec),
+        out_specs=batch_spec,
         # pallas_call's out_shape carries no varying-mesh-axes annotation,
         # so shard_map's vma check rejects it; the manual out_specs above
         # are the full truth for this collective-free program.

@@ -33,4 +33,4 @@ def test_example_runs(script, tmp_path):
         "05_sequence_tracking": ["--frames", "6", "--steps", "150"],
     }.get(script.stem, [])
     out = _run(script, *extra, tmp_path=tmp_path)
-    assert any(k in out for k in ("wrote", "fit", "tracked"))
+    assert any(k in out for k in ("wrote", "fit", "tracked", "fused kernel"))

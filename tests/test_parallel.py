@@ -134,10 +134,10 @@ def test_pallas_forward_dp_slices_padded_params(params32):
     rows through the kernel path."""
     mesh4 = parallel.make_mesh(data=2, model=4)
     sp = shd.shard_params(params32, mesh4)
-    pose, beta = rand_batch(4, 4)
+    pose, beta = rand_batch(4, 8)  # batch shards over all 8 devices
     fwd = shd.pallas_forward_dp(sp, mesh4, block_b=2, interpret=True)
     verts = fwd(pose, beta)
-    assert verts.shape == (4, 778, 3)
+    assert verts.shape == (8, 778, 3)
     want = core.forward_batched(params32, pose, beta).verts
     np.testing.assert_allclose(np.asarray(verts), np.asarray(want), atol=1e-4)
 
