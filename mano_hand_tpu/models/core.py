@@ -81,30 +81,9 @@ def forward(
     dtype = params.v_template.dtype
     if pose is None:
         pose = jnp.zeros((n_joints, 3), dtype=dtype)
-    if shape is None:
-        shape = jnp.zeros((params.shape_basis.shape[-1],), dtype=dtype)
     pose = pose.reshape(n_joints, 3).astype(dtype)
-    shape = shape.astype(dtype)
-
-    v_shaped = ops.shape_blend(
-        params.v_template, params.shape_basis, shape, precision
-    )
-    joints = ops.regress_joints(params.j_regressor, v_shaped, precision)
-    rot_mats = ops.rotation_matrix(pose)
-    v_posed = ops.pose_blend(v_shaped, params.pose_basis, rot_mats, precision)
-    world_rot, world_t = ops.forward_kinematics(
-        params.parents, rot_mats, joints, precision
-    )
-    skin_rot, skin_t = ops.skinning_transforms(
-        world_rot, world_t, joints, precision
-    )
-    verts = ops.skin(params.lbs_weights, skin_rot, skin_t, v_posed, precision)
-    return ManoOutput(
-        verts=verts,
-        joints=joints,
-        rest_verts=v_posed,
-        rot_mats=rot_mats,
-        posed_joints=world_t,
+    return forward_rotmats(
+        params, ops.rotation_matrix(pose), shape, precision
     )
 
 
@@ -156,15 +135,30 @@ def forward_fused(
     dtype = params.v_template.dtype
     if pose is None:
         pose = jnp.zeros((n_joints, 3), dtype=dtype)
+    pose = pose.reshape(n_joints, 3).astype(dtype)
+    return forward_fused_rotmats(
+        params, ops.rotation_matrix(pose), shape, precision
+    )
+
+
+def forward_fused_rotmats(
+    params: ManoParams,
+    rot_mats: jnp.ndarray,   # [J, 3, 3] per-joint rotations, row 0 global
+    shape: Optional[jnp.ndarray] = None,
+    precision=DEFAULT_PRECISION,
+) -> ManoOutput:
+    """Fused-basis forward from rotation MATRICES (``forward_fused`` minus
+    Rodrigues — see ``forward_rotmats`` for the input contract)."""
+    n_joints = params.j_regressor.shape[0]
+    dtype = params.v_template.dtype
     if shape is None:
         shape = jnp.zeros((params.shape_basis.shape[-1],), dtype=dtype)
-    pose = pose.reshape(n_joints, 3).astype(dtype)
+    rot_mats = rot_mats.reshape(n_joints, 3, 3).astype(dtype)
     shape = shape.astype(dtype)
 
     vertex_basis, joint_template, joint_shape_basis = fused_blend_bases(
         params, precision
     )
-    rot_mats = ops.rotation_matrix(pose)
     eye = jnp.eye(3, dtype=rot_mats.dtype)
     coeff = jnp.concatenate([shape, (rot_mats[1:] - eye).reshape(-1)])
     v_posed = (
@@ -188,6 +182,66 @@ def forward_fused(
         rot_mats=rot_mats,
         posed_joints=world_t,
     )
+
+
+def forward_rotmats(
+    params: ManoParams,
+    rot_mats: jnp.ndarray,   # [J, 3, 3] per-joint rotations, row 0 global
+    shape: Optional[jnp.ndarray] = None,  # [S]
+    precision=DEFAULT_PRECISION,
+) -> ManoOutput:
+    """Forward pass from per-joint rotation MATRICES, skipping Rodrigues.
+
+    The smplx-style ``pose2rot=False`` entry point: pipelines that optimize
+    in rotation space (the 6D representation via ``ops.matrix_from_6d``,
+    pose transfer from rotation-matrix sources) feed SO(3) elements
+    directly. Matrices are used as given — no orthonormalization is
+    applied, matching the reference's implicit contract that ``R`` drives
+    both the pose corrective (mano_np.py:87-91) and FK (mano_np.py:96-104).
+    Batch with ``jax.vmap`` over (rot_mats, shape).
+    """
+    n_joints = params.j_regressor.shape[0]
+    dtype = params.v_template.dtype
+    if shape is None:
+        shape = jnp.zeros((params.shape_basis.shape[-1],), dtype=dtype)
+    rot_mats = rot_mats.reshape(n_joints, 3, 3).astype(dtype)
+    shape = shape.astype(dtype)
+
+    v_shaped = ops.shape_blend(
+        params.v_template, params.shape_basis, shape, precision
+    )
+    joints = ops.regress_joints(params.j_regressor, v_shaped, precision)
+    v_posed = ops.pose_blend(v_shaped, params.pose_basis, rot_mats, precision)
+    world_rot, world_t = ops.forward_kinematics(
+        params.parents, rot_mats, joints, precision
+    )
+    skin_rot, skin_t = ops.skinning_transforms(
+        world_rot, world_t, joints, precision
+    )
+    verts = ops.skin(params.lbs_weights, skin_rot, skin_t, v_posed, precision)
+    return ManoOutput(
+        verts=verts,
+        joints=joints,
+        rest_verts=v_posed,
+        rot_mats=rot_mats,
+        posed_joints=world_t,
+    )
+
+
+def forward_batched_rotmats(
+    params: ManoParams,
+    rot_mats: jnp.ndarray,   # [B, J, 3, 3]
+    shape: jnp.ndarray,      # [B, S]
+    precision=DEFAULT_PRECISION,
+    fused: bool = True,
+) -> ManoOutput:
+    """vmap over the batch axis from rotation matrices; like
+    ``forward_batched``, the fused-basis path is the default (one
+    [B, S+P] x [S+P, V*3] MXU matmul drives the batch's blendshapes)."""
+    fwd = forward_fused_rotmats if fused else forward_rotmats
+    return jax.vmap(
+        lambda r, s: fwd(params, r, s, precision)
+    )(rot_mats, shape)
 
 
 def forward_pca(

@@ -1,4 +1,9 @@
-from mano_hand_tpu.ops.rodrigues import rotation_matrix, skew
+from mano_hand_tpu.ops.rodrigues import (
+    matrix_from_6d,
+    matrix_to_6d,
+    rotation_matrix,
+    skew,
+)
 from mano_hand_tpu.ops.fk import forward_kinematics, skinning_transforms, tree_levels
 from mano_hand_tpu.ops.blend import pose_blend, regress_joints, shape_blend
 from mano_hand_tpu.ops.lbs import skin
@@ -14,6 +19,8 @@ __all__ = [
     "batched_vertex_normals",
     "rotation_matrix",
     "skew",
+    "matrix_from_6d",
+    "matrix_to_6d",
     "forward_kinematics",
     "skinning_transforms",
     "tree_levels",

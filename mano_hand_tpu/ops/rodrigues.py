@@ -54,3 +54,32 @@ def rotation_matrix(axis_angle: jnp.ndarray) -> jnp.ndarray:
     outer = axis_angle[..., :, None] * axis_angle[..., None, :]
     eye = jnp.broadcast_to(jnp.eye(3, dtype=axis_angle.dtype), K.shape)
     return (1.0 - b * theta2) * eye + a * K + b * outer
+
+
+def matrix_from_6d(x: jnp.ndarray, eps: float = 1e-12) -> jnp.ndarray:
+    """6D rotation representation [..., 6] -> rotation matrices [..., 3, 3].
+
+    The continuous representation of Zhou et al., "On the Continuity of
+    Rotation Representations in Neural Networks" (CVPR 2019): the first two
+    columns of a rotation matrix, re-orthonormalized by Gram-Schmidt, the
+    third their cross product. Continuous and surjective onto SO(3) — the
+    standard parameterization for gradient-based rotation estimation (no
+    axis-angle 2*pi wrap, no quaternion double cover).
+    """
+    a1, a2 = x[..., 0:3], x[..., 3:6]
+    n1 = jnp.sqrt(jnp.sum(a1 * a1, axis=-1, keepdims=True) + eps)
+    b1 = a1 / n1
+    a2p = a2 - jnp.sum(b1 * a2, axis=-1, keepdims=True) * b1
+    n2 = jnp.sqrt(jnp.sum(a2p * a2p, axis=-1, keepdims=True) + eps)
+    b2 = a2p / n2
+    b3 = jnp.cross(b1, b2)
+    return jnp.stack([b1, b2, b3], axis=-1)  # columns
+
+
+def matrix_to_6d(rot: jnp.ndarray) -> jnp.ndarray:
+    """Rotation matrices [..., 3, 3] -> 6D representation [..., 6].
+
+    Inverse of ``matrix_from_6d`` on SO(3): the first two COLUMNS,
+    flattened. ``matrix_from_6d(matrix_to_6d(R)) == R`` for orthonormal R.
+    """
+    return jnp.concatenate([rot[..., :, 0], rot[..., :, 1]], axis=-1)
