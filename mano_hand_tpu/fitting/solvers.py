@@ -6,8 +6,10 @@ passes compile to a single XLA program with zero host round-trips. The
 optimizer is any optax GradientTransformation (Adam by default).
 
 Pose can be parameterized as full axis-angle ([16, 3], well-suited to
-tracking) or PCA coefficients + global rotation (the reference's native
-parameterization, better conditioned for sparse data).
+tracking), PCA coefficients + global rotation (the reference's native
+parameterization, better conditioned for sparse data), or the 6D
+continuous rotation representation (Zhou et al. — no 2*pi wrap in the
+landscape; results decode back to axis-angle via the SO(3) log map).
 """
 
 from __future__ import annotations
@@ -19,9 +21,15 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from mano_hand_tpu import ops
 from mano_hand_tpu.assets.schema import ManoParams
 from mano_hand_tpu.fitting import objectives
 from mano_hand_tpu.models import core
+
+# Identity rotation in the 6D representation (first two columns of I).
+# Plain tuple: materializing a device array at import time would initialize
+# the backend before the caller can pick a platform.
+_ID6D = (1.0, 0.0, 0.0, 0.0, 1.0, 0.0)
 
 
 class FitResult(NamedTuple):
@@ -145,8 +153,18 @@ def _fit_single(
             "pca": jnp.zeros((n_pca,), dtype),
             "global_rot": jnp.zeros((3,), dtype),
         }
+    elif pose_space == "6d":
+        # The continuous rotation representation (ops.matrix_from_6d):
+        # no 2*pi wrap in the optimization landscape. Init = identity.
+        theta0 = {
+            "rot6d": jnp.broadcast_to(
+                jnp.asarray(_ID6D, dtype), (n_joints, 6)
+            )
+        }
     else:
-        raise ValueError(f"pose_space must be 'aa' or 'pca', got {pose_space!r}")
+        raise ValueError(
+            f"pose_space must be 'aa', 'pca' or '6d', got {pose_space!r}"
+        )
     theta0["shape"] = jnp.zeros((n_shape,), dtype)
     if fit_trans:
         # Global translation DOF: the model itself has none (the reference
@@ -180,17 +198,36 @@ def _fit_single(
     def decode(p):
         if pose_space == "aa":
             return p["pose"]
+        if pose_space == "6d":
+            # Result convention is the reference's axis-angle; the log map
+            # is only evaluated on the final parameters, never in the loss.
+            return ops.axis_angle_from_matrix(ops.matrix_from_6d(p["rot6d"]))
         return core.decode_pca(params, p["pca"], p["global_rot"])
 
+    def model_out(p):
+        if pose_space == "6d":
+            return core.forward_rotmats(
+                params, ops.matrix_from_6d(p["rot6d"]), p["shape"]
+            )
+        return core.forward(params, decode(p), p["shape"])
+
+    def pose_reg(p):
+        if pose_space == "pca":
+            return objectives.l2_prior(p["pca"])
+        if pose_space == "6d":
+            # Deviation from the identity representation plays the role the
+            # zero-pose prior plays in axis-angle space.
+            return objectives.l2_prior(p["rot6d"] - jnp.asarray(_ID6D, dtype))
+        return objectives.l2_prior(p["pose"])
+
     def loss_fn(p):
-        out = core.forward(params, decode(p), p["shape"])
+        out = model_out(p)
         offset = p["trans"] if fit_trans else 0.0
         data = _data_loss(out, offset, target, data_term, camera, conf,
                           robust, robust_scale)
         # Prior weights may be traced scalars (see fit): plain multiplies.
         reg = (
-            pose_prior_weight
-            * objectives.l2_prior(p["pca"] if pose_space == "pca" else p["pose"])
+            pose_prior_weight * pose_reg(p)
             + shape_prior_weight * objectives.l2_prior(p["shape"])
         )
         return data + reg, data

@@ -1,4 +1,5 @@
 from mano_hand_tpu.ops.rodrigues import (
+    axis_angle_from_matrix,
     matrix_from_6d,
     matrix_to_6d,
     rotation_matrix,
@@ -19,6 +20,7 @@ __all__ = [
     "batched_vertex_normals",
     "rotation_matrix",
     "skew",
+    "axis_angle_from_matrix",
     "matrix_from_6d",
     "matrix_to_6d",
     "forward_kinematics",

@@ -83,3 +83,77 @@ def matrix_to_6d(rot: jnp.ndarray) -> jnp.ndarray:
     flattened. ``matrix_from_6d(matrix_to_6d(R)) == R`` for orthonormal R.
     """
     return jnp.concatenate([rot[..., :, 0], rot[..., :, 1]], axis=-1)
+
+
+def axis_angle_from_matrix(rot: jnp.ndarray) -> jnp.ndarray:
+    """SO(3) log map: rotation matrices [..., 3, 3] -> axis-angle [..., 3].
+
+    Inverse of ``rotation_matrix`` up to the usual angle wrap: output angle
+    lies in [0, pi]. Three guarded regimes (all jnp.where-safe for tracing):
+
+      * small angle  — vec/2 with a Taylor correction (vec = 2 sin(t) axis),
+      * generic      — theta * vec / (2 sin(theta)),
+      * near pi      — sin(theta) -> 0 kills vec, so the axis is recovered
+        from the symmetric part: (R + I)/2 == axis axis^T at theta == pi;
+        magnitudes from the diagonal, signs from the row of the largest
+        diagonal entry (whose own sign is fixed positive — the axis at pi
+        is only defined up to global sign anyway).
+
+    Intended for decoding results (e.g. 6D-space fits back to the
+    reference's axis-angle convention); like every log map it is not
+    differentiable AT theta == pi (the rotation itself is — the chart is).
+    """
+    vec = jnp.stack(
+        [
+            rot[..., 2, 1] - rot[..., 1, 2],
+            rot[..., 0, 2] - rot[..., 2, 0],
+            rot[..., 1, 0] - rot[..., 0, 1],
+        ],
+        axis=-1,
+    )                                            # 2 sin(theta) * axis
+    trace = rot[..., 0, 0] + rot[..., 1, 1] + rot[..., 2, 2]
+    cos_t = jnp.clip((trace - 1.0) * 0.5, -1.0, 1.0)[..., None]
+    theta = jnp.arccos(cos_t)
+    sin_t = jnp.sqrt(jnp.clip(1.0 - cos_t * cos_t, 0.0, 1.0))
+
+    small = theta < 1e-3
+    near_pi = theta > jnp.pi - 1e-3
+    generic = ~(small | near_pi)
+    # Guarded denominator: dead branches must stay finite (double-where).
+    safe_sin = jnp.where(generic, sin_t, 1.0)
+    aa_generic = vec * (theta / (2.0 * safe_sin))
+    t2 = theta * theta
+    aa_small = vec * 0.5 * (1.0 + t2 / 6.0 + 7.0 * t2 * t2 / 360.0)
+
+    # Near pi: (R + I)/2 ~= axis axis^T. Take magnitudes from the diagonal;
+    # align signs with the row of the largest diagonal entry.
+    sym = 0.5 * (rot + jnp.swapaxes(rot, -1, -2))
+    m = 0.5 * (sym + jnp.eye(3, dtype=rot.dtype))
+    diag = jnp.clip(
+        jnp.stack([m[..., 0, 0], m[..., 1, 1], m[..., 2, 2]], axis=-1),
+        0.0, 1.0,
+    )
+    k = jnp.argmax(diag, axis=-1)
+    row = jnp.take_along_axis(
+        m, k[..., None, None] ,
+        axis=-2,
+    )[..., 0, :]                                  # [..., 3] = a_k * axis
+    axis_pi = row / jnp.sqrt(
+        jnp.clip(
+            jnp.take_along_axis(diag, k[..., None], axis=-1), 1e-12, 1.0
+        )
+    )
+    norm = jnp.sqrt(
+        jnp.clip(jnp.sum(axis_pi * axis_pi, axis=-1, keepdims=True),
+                 1e-12, None)
+    )
+    # For theta strictly below pi, vec = 2 sin(theta) axis still carries the
+    # true sign — align with it so the chart is continuous up to pi (the
+    # largest-diagonal convention alone would flip the axis for rotations
+    # whose dominant axis component is negative). Only AT pi (vec == 0)
+    # does the global-sign ambiguity remain, and there any sign is correct.
+    align = jnp.sum(axis_pi * vec, axis=-1, keepdims=True)
+    sign = jnp.where(jnp.abs(align) > 1e-12, jnp.sign(align), 1.0)
+    aa_pi = axis_pi * sign / norm * theta
+
+    return jnp.where(small, aa_small, jnp.where(near_pi, aa_pi, aa_generic))

@@ -62,6 +62,34 @@ def test_6d_roundtrip_and_orthonormality():
     assert np.abs(det - 1.0).max() < 1e-5
 
 
+def test_log_map_roundtrip_all_regimes():
+    # axis-angle -> matrix -> axis-angle across tiny, generic, and near-pi
+    # angles; at pi the axis sign is ambiguous so compare ROTATIONS there.
+    rng = np.random.default_rng(4)
+    mags = np.concatenate([
+        np.full(20, 1e-6), rng.uniform(0.01, 3.0, 100),
+        np.full(20, np.pi - 1e-5), np.full(8, np.pi),
+    ])
+    axes = rng.normal(size=(len(mags), 3))
+    axes /= np.linalg.norm(axes, axis=-1, keepdims=True)
+    aa = jnp.asarray((axes * mags[:, None]).astype(np.float32))
+    rot = ops.rotation_matrix(aa)
+    aa2 = ops.axis_angle_from_matrix(rot)
+    rot2 = ops.rotation_matrix(aa2)
+    # f32 arccos conditioning near pi bounds the matrix roundtrip at ~5e-4.
+    assert np.abs(np.asarray(rot2) - np.asarray(rot)).max() < 5e-3
+    mask = mags < 3.0
+    assert np.abs(np.asarray(aa2)[mask] - np.asarray(aa)[mask]).max() < 1e-4
+    # Just below pi the AXIS-ANGLE VECTOR itself must come back (the sign
+    # stays recoverable from the skew part until exactly pi) — a flipped
+    # axis here would be a ~2*pi discontinuity for warm-start consumers.
+    near = (mags > 3.0) & (mags < np.pi)
+    denom = np.abs(np.asarray(aa)[near]).max()
+    assert np.abs(np.asarray(aa2)[near] - np.asarray(aa)[near]).max() < (
+        2e-3 * denom
+    )
+
+
 def test_6d_gradients_finite():
     x = jnp.zeros((2, 16, 6), jnp.float32).at[..., 0].set(1.0).at[..., 4].set(1.0)
     g = jax.grad(lambda q: ops.matrix_from_6d(q).sum())(x)
