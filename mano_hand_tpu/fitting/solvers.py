@@ -32,6 +32,53 @@ from mano_hand_tpu.models import core
 _ID6D = (1.0, 0.0, 0.0, 0.0, 1.0, 0.0)
 
 
+# --- pose-space machinery shared by _fit_single and fit_sequence ---------
+# One definition each of: parameter init, decode-to-rotation input, prior
+# deviation, and final decode-to-axis-angle, keyed on pose_space. `prefix`
+# prepends leading dims (() for one problem, (T,) for a clip).
+
+def _pose_init(pose_space, prefix, n_joints, n_pca, dtype, allowed):
+    if pose_space not in allowed:
+        raise ValueError(
+            f"pose_space must be one of {sorted(allowed)}, "
+            f"got {pose_space!r}"
+        )
+    if pose_space == "aa":
+        return {"pose": jnp.zeros((*prefix, n_joints, 3), dtype)}
+    if pose_space == "pca":
+        return {
+            "pca": jnp.zeros((*prefix, n_pca), dtype),
+            "global_rot": jnp.zeros((*prefix, 3), dtype),
+        }
+    # "6d": the continuous rotation representation (ops.matrix_from_6d) —
+    # no 2*pi wrap in the optimization landscape. Init = identity.
+    return {
+        "rot6d": jnp.broadcast_to(
+            jnp.asarray(_ID6D, dtype), (*prefix, n_joints, 6)
+        )
+    }
+
+
+def _pose_deviation(pose_space, p, dtype):
+    """What the pose prior penalizes: distance from the rest pose in the
+    active parameterization (identity representation for 6d)."""
+    if pose_space == "pca":
+        return p["pca"]
+    if pose_space == "6d":
+        return p["rot6d"] - jnp.asarray(_ID6D, dtype)
+    return p["pose"]
+
+
+def _pose_to_aa(pose_space, params, p):
+    """Final parameters -> the reference's axis-angle convention. The 6d
+    log map is only evaluated on results, never inside the loss."""
+    if pose_space == "aa":
+        return p["pose"]
+    if pose_space == "6d":
+        return ops.axis_angle_from_matrix(ops.matrix_from_6d(p["rot6d"]))
+    return core.decode_pca(params, p["pca"], p["global_rot"])
+
+
 class FitResult(NamedTuple):
     pose: jnp.ndarray          # [..., 16, 3] recovered axis-angle pose
     shape: jnp.ndarray         # [..., S] recovered shape coefficients
@@ -146,25 +193,8 @@ def _fit_single(
     n_joints = params.j_regressor.shape[0]
     n_shape = params.shape_basis.shape[-1]
 
-    if pose_space == "aa":
-        theta0 = {"pose": jnp.zeros((n_joints, 3), dtype)}
-    elif pose_space == "pca":
-        theta0 = {
-            "pca": jnp.zeros((n_pca,), dtype),
-            "global_rot": jnp.zeros((3,), dtype),
-        }
-    elif pose_space == "6d":
-        # The continuous rotation representation (ops.matrix_from_6d):
-        # no 2*pi wrap in the optimization landscape. Init = identity.
-        theta0 = {
-            "rot6d": jnp.broadcast_to(
-                jnp.asarray(_ID6D, dtype), (n_joints, 6)
-            )
-        }
-    else:
-        raise ValueError(
-            f"pose_space must be 'aa', 'pca' or '6d', got {pose_space!r}"
-        )
+    theta0 = _pose_init(pose_space, (), n_joints, n_pca, dtype,
+                        allowed={"aa", "pca", "6d"})
     theta0["shape"] = jnp.zeros((n_shape,), dtype)
     if fit_trans:
         # Global translation DOF: the model itself has none (the reference
@@ -195,30 +225,13 @@ def _fit_single(
                 )
             theta0[k] = v
 
-    def decode(p):
-        if pose_space == "aa":
-            return p["pose"]
-        if pose_space == "6d":
-            # Result convention is the reference's axis-angle; the log map
-            # is only evaluated on the final parameters, never in the loss.
-            return ops.axis_angle_from_matrix(ops.matrix_from_6d(p["rot6d"]))
-        return core.decode_pca(params, p["pca"], p["global_rot"])
-
     def model_out(p):
         if pose_space == "6d":
             return core.forward_rotmats(
                 params, ops.matrix_from_6d(p["rot6d"]), p["shape"]
             )
-        return core.forward(params, decode(p), p["shape"])
-
-    def pose_reg(p):
-        if pose_space == "pca":
-            return objectives.l2_prior(p["pca"])
-        if pose_space == "6d":
-            # Deviation from the identity representation plays the role the
-            # zero-pose prior plays in axis-angle space.
-            return objectives.l2_prior(p["rot6d"] - jnp.asarray(_ID6D, dtype))
-        return objectives.l2_prior(p["pose"])
+        return core.forward(params, _pose_to_aa(pose_space, params, p),
+                            p["shape"])
 
     def loss_fn(p):
         out = model_out(p)
@@ -227,7 +240,8 @@ def _fit_single(
                           robust, robust_scale)
         # Prior weights may be traced scalars (see fit): plain multiplies.
         reg = (
-            pose_prior_weight * pose_reg(p)
+            pose_prior_weight
+            * objectives.l2_prior(_pose_deviation(pose_space, p, dtype))
             + shape_prior_weight * objectives.l2_prior(p["shape"])
         )
         return data + reg, data
@@ -236,7 +250,7 @@ def _fit_single(
         loss_fn, theta0, optimizer, n_steps
     )
     return FitResult(
-        pose=decode(p_final),
+        pose=_pose_to_aa(pose_space, params, p_final),
         shape=p_final["shape"],
         final_loss=final_loss,
         loss_history=history,
@@ -353,7 +367,7 @@ class SequenceFitResult(NamedTuple):
 @functools.partial(
     jax.jit,
     static_argnames=("n_steps", "data_term", "fit_trans", "robust",
-                     "robust_scale"),
+                     "robust_scale", "pose_space"),
 )
 def fit_sequence(
     params: ManoParams,
@@ -370,6 +384,7 @@ def fit_sequence(
     smooth_trans_weight: float = 1e-3,
     pose_prior_weight: float = 0.0,
     shape_prior_weight: float = 1e-3,
+    pose_space: str = "aa",
 ) -> SequenceFitResult:
     """Track a whole motion clip as ONE optimization problem.
 
@@ -382,12 +397,16 @@ def fit_sequence(
     loop (/root/reference/data_explore.py:12-15); here all T frames'
     forwards are one batched program inside one jitted Adam loop.
 
-    Pose is parameterized as per-frame axis-angle ([T, 16, 3]) — the
-    natural space for velocity coupling; the smoothness weights scale
-    mean squared frame-to-frame differences. The 1e-3 defaults keep the
-    data term dominant on clean dense targets; raise toward ~1e-2 for
-    noisy sparse observations (the regime the occlusion-bridging tests
-    validate), lower toward 0 for fast motion sampled coarsely.
+    Pose is parameterized per frame as axis-angle ([T, 16, 3], the
+    default) or the 6D continuous representation
+    (``pose_space="6d"``) — in 6D the velocity coupling is wrap-free
+    (axis-angle jumps by 2*pi at the chart boundary read as huge fake
+    velocities on long clips with large rotations), and results decode
+    back to axis-angle. The smoothness weights scale mean squared
+    frame-to-frame differences. The 1e-3 defaults keep the data term
+    dominant on clean dense targets; raise toward ~1e-2 for noisy sparse
+    observations (the regime the occlusion-bridging tests validate),
+    lower toward 0 for fast motion sampled coarsely.
     """
     _check_data_term(data_term, camera, target_conf)
     dtype = params.v_template.dtype
@@ -407,16 +426,22 @@ def fit_sequence(
             jnp.asarray(target_conf, dtype), (t_frames, n_joints)
         )
 
-    theta0 = {
-        "pose": jnp.zeros((t_frames, n_joints, 3), dtype),
-        "shape": jnp.zeros((n_shape,), dtype),
-    }
+    theta0 = _pose_init(pose_space, (t_frames,), n_joints, n_pca=0,
+                        dtype=dtype, allowed={"aa", "6d"})
+    theta0["shape"] = jnp.zeros((n_shape,), dtype)
     if fit_trans:
         theta0["trans"] = jnp.zeros((t_frames, 3), dtype)
 
+    pose_key = "pose" if pose_space == "aa" else "rot6d"
+
     def loss_fn(p):
         shapes = jnp.broadcast_to(p["shape"], (t_frames, n_shape))
-        out = core.forward_batched(params, p["pose"], shapes)
+        if pose_space == "6d":
+            out = core.forward_batched_rotmats(
+                params, ops.matrix_from_6d(p["rot6d"]), shapes
+            )
+        else:
+            out = core.forward_batched(params, p["pose"], shapes)
         offset = (
             p["trans"][:, None, :] if fit_trans
             else jnp.zeros((), dtype)
@@ -425,8 +450,10 @@ def fit_sequence(
                           target_conf, robust, robust_scale)
         # t_frames is static: skip velocity terms for single-frame clips
         # (mean over an empty array is NaN and would poison every grad).
+        # Velocity couples whichever representation is being optimized —
+        # in 6D it is wrap-free by construction.
         if t_frames > 1:
-            vel = p["pose"][1:] - p["pose"][:-1]
+            vel = p[pose_key][1:] - p[pose_key][:-1]
             reg = smooth_pose_weight * jnp.mean(vel ** 2)
             if fit_trans:
                 tvel = p["trans"][1:] - p["trans"][:-1]
@@ -435,7 +462,8 @@ def fit_sequence(
             reg = jnp.zeros((), dtype)
         reg = (
             reg
-            + pose_prior_weight * objectives.l2_prior(p["pose"])
+            + pose_prior_weight
+            * objectives.l2_prior(_pose_deviation(pose_space, p, dtype))
             + shape_prior_weight * objectives.l2_prior(p["shape"])
         )
         return data + reg, data
@@ -444,7 +472,7 @@ def fit_sequence(
         loss_fn, theta0, optax.adam(lr), n_steps
     )
     return SequenceFitResult(
-        pose=p_final["pose"],
+        pose=_pose_to_aa(pose_space, params, p_final),
         shape=p_final["shape"],
         final_loss=final_loss,
         loss_history=history,
