@@ -179,7 +179,12 @@ def cmd_fit(args) -> int:
         )
         return 2
     if args.solver is None:
-        args.solver = "lm" if args.data_term == "verts" else "adam"
+        # An explicit pose space implies the Adam solver (LM is
+        # axis-angle-only); otherwise dense-verts targets default to LM.
+        if args.pose_space is not None:
+            args.solver = "adam"
+        else:
+            args.solver = "lm" if args.data_term == "verts" else "adam"
     steps = (
         args.steps if args.steps is not None
         else (25 if args.solver == "lm" else 200)
@@ -210,6 +215,13 @@ def cmd_fit(args) -> int:
         elif args.shape_prior is not None:
             print("note: --shape-prior only applies to --solver adam or "
                   "--data-term joints; ignored", file=sys.stderr)
+        if args.pose_space is not None:
+            # Only reachable with an EXPLICIT --solver lm (an unset solver
+            # resolves to adam when --pose-space is given): a contradiction,
+            # not a preference — refuse rather than silently drop it.
+            print("--pose-space requires --solver adam (LM is "
+                  "axis-angle-only)", file=sys.stderr)
+            return 2
         res = fitting.fit_lm(params, targets, n_steps=steps, **lm_kw)
     else:
         # Shape is weakly observable from 16 joints; regularize it
@@ -248,15 +260,18 @@ def cmd_fit(args) -> int:
                 camera=look_at(eye=eye, focal=args.focal),
                 target_conf=conf,
                 fit_trans=True,
-                pose_space="pca",
                 n_pca=15,
                 pose_prior_weight=1e-4,
             )
+        # One decision point for the effective pose space: the user's
+        # explicit choice, else pca for depth-blind 2D data, else aa.
+        pose_space = args.pose_space or ("pca" if kp2d else "aa")
         res = fitting.fit(
             params, targets, n_steps=steps,
             lr=default_lr if args.lr is None else args.lr,
             data_term=args.data_term,
             shape_prior_weight=shape_prior,
+            pose_space=pose_space,
             **kp2d,
         )
     jax.block_until_ready(res.pose)
@@ -333,6 +348,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help=".npy of [V,3]/[B,V,3] verts; [16,3]/[B,16,3] "
                         "joints with --data-term joints; [16,2]/[B,16,2] "
                         "image points with --data-term keypoints2d")
+    f.add_argument("--pose-space", default=None,
+                   choices=["aa", "pca", "6d"],
+                   help="pose parameterization for the Adam solver: "
+                        "axis-angle (default), PCA coefficients, or the "
+                        "6D continuous rotation representation "
+                        "(wrap-free; results decode back to axis-angle). "
+                        "keypoints2d defaults to pca when unset")
     f.add_argument("--data-term", default="verts",
                    choices=["verts", "joints", "keypoints2d"],
                    help="fit to a full target mesh, sparse 3D keypoints "

@@ -80,6 +80,43 @@ def test_fit_subcommand(tmp_path, capsys):
     np.testing.assert_allclose(ckpt["pose"], pose, atol=1e-3)
 
 
+def test_fit_subcommand_pose_space_6d(tmp_path, capsys):
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+
+    p32 = synthetic_params(seed=0).astype(np.float32)
+    rng = np.random.default_rng(1)
+    pose = rng.normal(scale=0.25, size=(16, 3)).astype(np.float32)
+    targets = np.asarray(core.jit_forward(
+        p32, jnp.asarray(pose), jnp.zeros(10, jnp.float32)
+    ).verts)
+    np.save(tmp_path / "t.npy", targets)
+    out = tmp_path / "fit6d.npz"
+    rc = cli.main([
+        "fit", str(tmp_path / "t.npy"),
+        "--pose-space", "6d", "--steps", "300", "--out", str(out),
+    ])
+    assert rc == 0
+    # An explicit pose space must resolve the default solver to Adam (the
+    # verts default of LM is axis-angle-only and would drop the flag).
+    assert "fit (adam, 300 steps)" in capsys.readouterr().out
+    ckpt = np.load(out)
+    assert ckpt["pose"].shape == (16, 3)  # decoded back to axis-angle
+    got = np.asarray(core.jit_forward(
+        p32, jnp.asarray(ckpt["pose"]), jnp.asarray(ckpt["shape"])
+    ).verts)
+    assert np.abs(got - targets).max() < 5e-3
+
+    # Explicit LM + a pose space is a contradiction, not a preference.
+    rc = cli.main([
+        "fit", str(tmp_path / "t.npy"),
+        "--solver", "lm", "--pose-space", "6d", "--out", str(out),
+    ])
+    assert rc == 2
+    assert "requires --solver adam" in capsys.readouterr().err
+
+
 def test_fit_subcommand_rejects_bad_targets(tmp_path, capsys):
     np.save(tmp_path / "bad.npy", np.zeros((5, 3)))
     rc = cli.main(["fit", str(tmp_path / "bad.npy")])
