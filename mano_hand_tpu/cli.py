@@ -179,9 +179,10 @@ def cmd_fit(args) -> int:
         )
         return 2
     if args.solver is None:
-        # An explicit pose space implies the Adam solver (LM is
-        # axis-angle-only); otherwise dense-verts targets default to LM.
-        if args.pose_space is not None:
+        # A pose space LM cannot represent (pca/6d) implies the Adam
+        # solver; 'aa' IS LM's parameterization so it leaves the default
+        # (LM for dense-verts targets) untouched.
+        if args.pose_space in ("pca", "6d"):
             args.solver = "adam"
         else:
             args.solver = "lm" if args.data_term == "verts" else "adam"
@@ -215,12 +216,13 @@ def cmd_fit(args) -> int:
         elif args.shape_prior is not None:
             print("note: --shape-prior only applies to --solver adam or "
                   "--data-term joints; ignored", file=sys.stderr)
-        if args.pose_space is not None:
+        if args.pose_space in ("pca", "6d"):
             # Only reachable with an EXPLICIT --solver lm (an unset solver
-            # resolves to adam when --pose-space is given): a contradiction,
-            # not a preference — refuse rather than silently drop it.
-            print("--pose-space requires --solver adam (LM is "
-                  "axis-angle-only)", file=sys.stderr)
+            # resolves to adam for these spaces): a contradiction, not a
+            # preference — refuse rather than silently drop it. 'aa' is
+            # exactly LM's parameterization and passes through.
+            print(f"--pose-space {args.pose_space} requires --solver adam "
+                  "(LM optimizes axis-angle)", file=sys.stderr)
             return 2
         res = fitting.fit_lm(params, targets, n_steps=steps, **lm_kw)
     else:

@@ -154,21 +154,37 @@ def params_from_torch(
 
 def forward_from_torch(
     params: ManoParams,
-    pose,                      # torch [B?, 16, 3] or [B?, 48]
+    pose,                      # torch [B?, 16, 3] / [B?, 48]; with
+                               # pose2rot=False: [B?, 16, 3, 3] matrices
     shape: Optional[Any] = None,  # torch [B?, S]
+    pose2rot: bool = True,
 ):
     """Evaluate the JAX core on torch inputs; outputs as torch tensors.
 
     Unbatched or batched; ManoOutput fields come back as CPU torch tensors.
+    ``pose2rot=False`` takes per-joint rotation MATRICES instead of
+    axis-angle — the smplx keyword and contract (rotation-space pipelines
+    skip Rodrigues).
     """
     import jax.numpy as jnp
 
     pose_np = _to_np(pose).astype(np.float32)
-    batched = pose_np.ndim == 3 or (
-        pose_np.ndim == 2 and pose_np.shape[-1] != 3
-    )
     n_joints = params.j_regressor.shape[0]
     n_shape = params.shape_basis.shape[-1]
+    # Select representation-specific pieces ONCE; both paths use the jitted
+    # wrappers (per-frame torch pipelines would otherwise re-trace the
+    # whole graph eagerly on every call).
+    if pose2rot:
+        batched = pose_np.ndim == 3 or (
+            pose_np.ndim == 2 and pose_np.shape[-1] != 3
+        )
+        row_shape = (n_joints, 3)
+        fwd = core.jit_forward_batched if batched else core.jit_forward
+    else:
+        batched = pose_np.ndim == 4
+        row_shape = (n_joints, 3, 3)
+        fwd = (core.jit_forward_batched_rotmats if batched
+               else core.jit_forward_rotmats)
     if shape is None:
         shape_np = np.zeros(
             (pose_np.shape[0], n_shape) if batched else (n_shape,),
@@ -176,14 +192,7 @@ def forward_from_torch(
         )
     else:
         shape_np = _to_np(shape).astype(np.float32)
-    if batched:
-        pose_np = pose_np.reshape(pose_np.shape[0], n_joints, 3)
-        out = core.jit_forward_batched(
-            params, jnp.asarray(pose_np), jnp.asarray(shape_np)
-        )
-    else:
-        out = core.jit_forward(
-            params, jnp.asarray(pose_np.reshape(n_joints, 3)),
-            jnp.asarray(shape_np),
-        )
+    lead = (pose_np.shape[0],) if batched else ()
+    pose_j = jnp.asarray(pose_np.reshape(*lead, *row_shape))
+    out = fwd(params, pose_j, jnp.asarray(shape_np))
     return to_torch(out)

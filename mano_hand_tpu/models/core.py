@@ -463,3 +463,17 @@ def jit_forward(params, pose, shape, precision=DEFAULT_PRECISION):
 def jit_forward_batched(params, pose, shape, precision=DEFAULT_PRECISION):
     """Convenience jitted batched forward."""
     return forward_batched(params, pose, shape, precision)
+
+
+@functools.partial(jax.jit, static_argnames=("precision",))
+def jit_forward_rotmats(params, rot_mats, shape,
+                        precision=DEFAULT_PRECISION):
+    """Convenience jitted single-hand rotation-matrix forward."""
+    return forward_rotmats(params, rot_mats, shape, precision)
+
+
+@functools.partial(jax.jit, static_argnames=("precision",))
+def jit_forward_batched_rotmats(params, rot_mats, shape,
+                                precision=DEFAULT_PRECISION):
+    """Convenience jitted batched rotation-matrix forward."""
+    return forward_batched_rotmats(params, rot_mats, shape, precision)

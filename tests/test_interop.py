@@ -95,6 +95,33 @@ def test_forward_from_torch_matches_core(params32):
     )
 
 
+def test_forward_from_torch_pose2rot_false(params32):
+    """smplx's pose2rot=False contract: rotation-matrix input."""
+    from mano_hand_tpu import ops
+
+    rng = np.random.default_rng(5)
+    pose = rng.normal(scale=0.4, size=(3, 16, 3)).astype(np.float32)
+    beta = rng.normal(size=(3, 10)).astype(np.float32)
+    # np.array (copy): jax buffers are non-writable and torch.from_numpy
+    # warns on them.
+    rots = np.array(jax.vmap(ops.rotation_matrix)(jnp.asarray(pose)))
+    out = forward_from_torch(
+        params32, torch.from_numpy(rots), torch.from_numpy(beta),
+        pose2rot=False,
+    )
+    want = core.jit_forward_batched(
+        params32, jnp.asarray(pose), jnp.asarray(beta)
+    )
+    np.testing.assert_allclose(
+        out.verts.numpy(), np.asarray(want.verts), atol=1e-5
+    )
+    # Unbatched matrices too.
+    single = forward_from_torch(
+        params32, torch.from_numpy(rots[0]), pose2rot=False
+    )
+    assert single.verts.shape == (778, 3)
+
+
 def test_flax_layer_forward_and_grads(params32):
     layer = ManoLayer(params=params32)
     rng = np.random.default_rng(1)
