@@ -178,11 +178,12 @@ def cmd_fit(args) -> int:
             file=sys.stderr,
         )
         return 2
+    # Anything that is not LM's own parameterization (axis-angle) needs the
+    # Adam solver — ONE definition, shared with the explicit-LM guard below,
+    # so a future pose space fails safe instead of silently routing to LM.
+    needs_adam = args.pose_space not in (None, "aa")
     if args.solver is None:
-        # A pose space LM cannot represent (pca/6d) implies the Adam
-        # solver; 'aa' IS LM's parameterization so it leaves the default
-        # (LM for dense-verts targets) untouched.
-        if args.pose_space in ("pca", "6d"):
+        if needs_adam:
             args.solver = "adam"
         else:
             args.solver = "lm" if args.data_term == "verts" else "adam"
@@ -216,7 +217,7 @@ def cmd_fit(args) -> int:
         elif args.shape_prior is not None:
             print("note: --shape-prior only applies to --solver adam or "
                   "--data-term joints; ignored", file=sys.stderr)
-        if args.pose_space in ("pca", "6d"):
+        if needs_adam:
             # Only reachable with an EXPLICIT --solver lm (an unset solver
             # resolves to adam for these spaces): a contradiction, not a
             # preference — refuse rather than silently drop it. 'aa' is
@@ -352,10 +353,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "image points with --data-term keypoints2d")
     f.add_argument("--pose-space", default=None,
                    choices=["aa", "pca", "6d"],
-                   help="pose parameterization for the Adam solver: "
-                        "axis-angle (default), PCA coefficients, or the "
-                        "6D continuous rotation representation "
-                        "(wrap-free; results decode back to axis-angle). "
+                   help="pose parameterization: axis-angle (both solvers' "
+                        "native space — leaves the solver default alone), "
+                        "PCA coefficients, or the 6D continuous rotation "
+                        "representation (wrap-free; results decode back "
+                        "to axis-angle). pca/6d imply the Adam solver; "
                         "keypoints2d defaults to pca when unset")
     f.add_argument("--data-term", default="verts",
                    choices=["verts", "joints", "keypoints2d"],

@@ -14,8 +14,11 @@ from typing import Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
+from mano_hand_tpu import ops
 from mano_hand_tpu.assets.schema import ManoParams
 from mano_hand_tpu.models import core
+
+POSE_FORMATS = ("aa", "pca", "6d", "rotmat")
 
 
 class ManoLayer(nn.Module):
@@ -23,8 +26,13 @@ class ManoLayer(nn.Module):
 
     Attributes:
       params: the (float32) ManoParams asset, a module constant.
-      use_pca: if True, ``__call__`` takes PCA coefficients [B, n<=45]
-        (+ optional global_rot [B, 3]); else absolute pose [B, 16, 3].
+      pose_format: what ``__call__``'s pose argument means —
+        ``"aa"`` axis-angle [B, 16, 3] (default); ``"pca"`` PCA
+        coefficients [B, n<=45] (+ optional global_rot [B, 3]); ``"6d"``
+        the continuous rotation representation [B, 16, 6] (the standard
+        regression target for neural pose estimators — continuous, no
+        wrap); ``"rotmat"`` rotation matrices [B, 16, 3, 3].
+      use_pca: legacy alias for ``pose_format="pca"``.
       learn_shape: if True, beta is a trainable variable of the module
         (shared across the batch — per-subject calibration); else it is an
         input.
@@ -34,6 +42,7 @@ class ManoLayer(nn.Module):
     """
 
     params: ManoParams
+    pose_format: str = "aa"
     use_pca: bool = False
     learn_shape: bool = False
 
@@ -53,6 +62,20 @@ class ManoLayer(nn.Module):
         shape: Optional[jnp.ndarray] = None,
         global_rot: Optional[jnp.ndarray] = None,
     ):
+        if self.use_pca and self.pose_format not in ("aa", "pca"):
+            # Contradictory config: silently letting use_pca win would send
+            # a 6d/rotmat-shaped input into the PCA decode and fail deep in
+            # the core with an opaque reshape error.
+            raise ValueError(
+                f"use_pca=True conflicts with pose_format="
+                f"{self.pose_format!r}; drop use_pca (legacy alias for "
+                f"pose_format='pca')"
+            )
+        fmt = "pca" if self.use_pca else self.pose_format
+        if fmt not in POSE_FORMATS:
+            raise ValueError(
+                f"pose_format must be one of {POSE_FORMATS}, got {fmt!r}"
+            )
         n_shape = self.params.shape_basis.shape[-1]
         batch = pose.shape[0]
         if self.learn_shape:
@@ -62,7 +85,13 @@ class ManoLayer(nn.Module):
             shape = jnp.broadcast_to(beta, (batch, n_shape))
         elif shape is None:
             shape = jnp.zeros((batch, n_shape), jnp.float32)
-        if self.use_pca:
+        if fmt == "6d":
+            return core.forward_batched_rotmats(
+                self.params, ops.matrix_from_6d(pose), shape
+            )
+        if fmt == "rotmat":
+            return core.forward_batched_rotmats(self.params, pose, shape)
+        if fmt == "pca":
             full_pose = core.decode_pca(self.params, pose, global_rot)
         else:
             full_pose = pose
