@@ -168,12 +168,21 @@ def cmd_fit(args) -> int:
         want = (params.n_joints, 2)
     elif args.data_term == "joints":
         want = (params.n_joints, 3)
+    elif args.data_term == "points":
+        want = (None, 3)  # any number of scan points, 3D
     else:
         want = (params.n_verts, 3)
-    if targets.ndim not in (2, 3) or targets.shape[-2:] != want:
+    rows_ok = (
+        targets.ndim >= 2
+        and (targets.shape[-2] == want[0] if want[0] is not None
+             else targets.shape[-2] > 0)  # empty scan would fit to NaN
+    )
+    if (targets.ndim not in (2, 3) or targets.shape[-1] != want[1]
+            or not rows_ok):
+        rows = "N" if want[0] is None else str(want[0])
         print(
-            f"targets must be [{want[0]}, {want[1]}] or "
-            f"[B, {want[0]}, {want[1]}] for --data-term {args.data_term}, "
+            f"targets must be [{rows}, {want[1]}] or "
+            f"[B, {rows}, {want[1]}] for --data-term {args.data_term}, "
             f"got {targets.shape}",
             file=sys.stderr,
         )
@@ -201,8 +210,8 @@ def cmd_fit(args) -> int:
         if args.lr is not None:
             print("note: --lr only applies to --solver adam; ignored",
                   file=sys.stderr)
-        if args.data_term == "keypoints2d":
-            print("--data-term keypoints2d requires --solver adam",
+        if args.data_term in ("keypoints2d", "points"):
+            print(f"--data-term {args.data_term} requires --solver adam",
                   file=sys.stderr)
             return 2
         lm_kw = {}
@@ -350,7 +359,9 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("targets",
                    help=".npy of [V,3]/[B,V,3] verts; [16,3]/[B,16,3] "
                         "joints with --data-term joints; [16,2]/[B,16,2] "
-                        "image points with --data-term keypoints2d")
+                        "image points with --data-term keypoints2d; "
+                        "[N,3]/[B,N,3] scan points with --data-term "
+                        "points")
     f.add_argument("--pose-space", default=None,
                    choices=["aa", "pca", "6d"],
                    help="pose parameterization: axis-angle (both solvers' "
@@ -360,10 +371,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "to axis-angle). pca/6d imply the Adam solver; "
                         "keypoints2d defaults to pca when unset")
     f.add_argument("--data-term", default="verts",
-                   choices=["verts", "joints", "keypoints2d"],
+                   choices=["verts", "joints", "keypoints2d", "points"],
                    help="fit to a full target mesh, sparse 3D keypoints "
-                        "(detector/mocap output), or 2D keypoints "
-                        "projected through a pinhole camera")
+                        "(detector/mocap output), 2D keypoints projected "
+                        "through a pinhole camera, or a correspondence-"
+                        "free point cloud (one-sided chamfer — partial "
+                        "depth-sensor scans)")
     f.add_argument("--conf", default=None,
                    help=".npy of [16]/[B,16] keypoint confidences "
                         "(keypoints2d only)")

@@ -11,6 +11,33 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from mano_hand_tpu.ops.common import DEFAULT_PRECISION
+
+
+def point_cloud_l2(pred_verts: jnp.ndarray,    # [..., V, 3]
+                   target_points: jnp.ndarray,  # [..., N, 3]
+                   penalty=None) -> jnp.ndarray:
+    """One-sided chamfer: each observed point to its nearest mesh vertex.
+
+    The correspondence-free registration objective (depth-sensor scans,
+    partial point clouds): every observed point must lie on the mesh;
+    mesh regions with no observations are unpenalized — exactly right for
+    partial views, where the two-sided term would drag unobserved surface
+    toward the data. The min is the standard ICP subgradient (flows to
+    the closest vertex); N is static per compile. The pairwise [N, V]
+    distance matrix is one MXU matmul plus broadcasts (~2.3 MFLOP per
+    thousand points), trivially batch/frame-parallel.
+    """
+    d2 = (
+        jnp.sum(target_points ** 2, axis=-1)[..., :, None]
+        - 2.0 * jnp.einsum("...nc,...vc->...nv", target_points, pred_verts,
+                           precision=DEFAULT_PRECISION)
+        + jnp.sum(pred_verts ** 2, axis=-1)[..., None, :]
+    )
+    # Expansion can go slightly negative in fp; huber takes sqrt of this.
+    sq = jnp.maximum(jnp.min(d2, axis=-1), 0.0)
+    return jnp.mean(sq if penalty is None else penalty(sq))
+
 
 def vertex_l2(pred_verts: jnp.ndarray, target_verts: jnp.ndarray,
               penalty=None) -> jnp.ndarray:

@@ -90,10 +90,10 @@ class FitResult(NamedTuple):
 
 def _check_data_term(data_term: str, camera, conf) -> None:
     """One validation policy for every solver entry point."""
-    if data_term not in ("verts", "joints", "keypoints2d"):
+    if data_term not in ("verts", "joints", "keypoints2d", "points"):
         raise ValueError(
-            "data_term must be 'verts', 'joints' or 'keypoints2d', "
-            f"got {data_term!r}"
+            "data_term must be 'verts', 'joints', 'keypoints2d' or "
+            f"'points', got {data_term!r}"
         )
     if data_term == "keypoints2d":
         if camera is None:
@@ -112,12 +112,17 @@ def _data_loss(out, offset, target, data_term: str, camera, conf,
                robust: str = "none", robust_scale: float = 0.01):
     """The one data-term dispatch shared by every Adam solver.
 
-    - ``verts``: full-mesh L2.
+    - ``verts``: full-mesh L2 (known correspondence).
     - ``joints``: sparse 3D keypoints (detector/mocap output); shape is
       weakly observable from 16 joints — pair with shape_prior_weight.
     - ``keypoints2d``: posed joints through the pinhole projection.
       Depth is only observable through perspective scaling, so use the
       priors (and fit_trans=True) — ill-posed without them.
+    - ``points``: correspondence-FREE registration to an unstructured
+      point cloud [N, 3] (depth-sensor scan): one-sided chamfer, each
+      observed point to its nearest mesh vertex. Partial views are fine;
+      pair with the priors (unobserved regions are unconstrained) and
+      ``fit_trans=True`` when the scan is not origin-aligned.
 
     ``robust="huber"`` replaces the per-point squared distance with a
     Huber penalty at scale ``robust_scale`` (same units as the data:
@@ -141,6 +146,8 @@ def _data_loss(out, offset, target, data_term: str, camera, conf,
     )
     if data_term == "verts":
         return objectives.vertex_l2(out.verts + offset, target, penalty)
+    if data_term == "points":
+        return objectives.point_cloud_l2(out.verts + offset, target, penalty)
     if data_term == "joints":
         return objectives.joint_l2(out.posed_joints + offset, target, penalty)
     xy = camera.project(out.posed_joints + offset)[..., :2]
@@ -172,7 +179,7 @@ def _run_adam(loss_fn, theta0, optimizer, n_steps: int):
 
 def _fit_single(
     params: ManoParams,
-    target: jnp.ndarray,  # [V, 3] | [J, 3] | [J, 2] (see data_term)
+    target: jnp.ndarray,  # [V, 3] | [J, 3] | [J, 2] | [N, 3] (see data_term)
     conf: Optional[jnp.ndarray] = None,  # [J] keypoint confidences
     *,
     n_steps: int,
@@ -267,7 +274,7 @@ def _fit_single(
 def fit(
     params: ManoParams,
     target_verts: jnp.ndarray,  # [V, 3] or [B, V, 3] ([J, 3] joints;
-                                # [J, 2] keypoints2d)
+                                # [J, 2] keypoints2d; [N, 3] points)
     n_steps: int = 200,
     lr: float = 0.05,
     pose_space: str = "aa",
@@ -340,6 +347,10 @@ def fit_with_optimizer(
     )
     _check_data_term(data_term, camera, target_conf)
     target_verts = jnp.asarray(target_verts, params.v_template.dtype)
+    if data_term == "points" and target_verts.shape[-2] == 0:
+        # A zero-point cloud (empty depth-scan foreground) would mean() over
+        # an empty axis -> NaN in every parameter, silently.
+        raise ValueError("points target cloud is empty ([..., 0, 3])")
     if target_conf is not None:
         target_conf = jnp.asarray(target_conf, params.v_template.dtype)
     if target_verts.ndim == 2:
@@ -371,7 +382,7 @@ class SequenceFitResult(NamedTuple):
 )
 def fit_sequence(
     params: ManoParams,
-    targets: jnp.ndarray,  # [T, V, 3] | [T, J, 3] | [T, J, 2]
+    targets: jnp.ndarray,  # [T, V, 3] | [T, J, 3] | [T, J, 2] | [T, N, 3]
     n_steps: int = 300,
     lr: float = 0.03,
     data_term: str = "verts",
@@ -418,6 +429,8 @@ def fit_sequence(
             "fit_sequence targets must be [T, rows, coords]; for a single "
             f"frame use fit(). Got shape {targets.shape}"
         )
+    if data_term == "points" and targets.shape[-2] == 0:
+        raise ValueError("points target cloud is empty ([T, 0, 3])")
     t_frames = targets.shape[0]
     n_joints = params.j_regressor.shape[0]
     n_shape = params.shape_basis.shape[-1]

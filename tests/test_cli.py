@@ -117,6 +117,37 @@ def test_fit_subcommand_pose_space_6d(tmp_path, capsys):
     assert "requires --solver adam" in capsys.readouterr().err
 
 
+def test_fit_subcommand_points(tmp_path, capsys):
+    """Correspondence-free scan registration through the CLI (mechanics:
+    any-N validation, Adam routing, checkpoint written)."""
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+
+    p32 = synthetic_params(seed=0).astype(np.float32)
+    verts = np.asarray(core.jit_forward(
+        p32, jnp.zeros((16, 3), jnp.float32), jnp.zeros(10, jnp.float32)
+    ).verts)
+    cloud = verts[np.random.default_rng(2).permutation(778)[:123]]
+    np.save(tmp_path / "cloud.npy", cloud)
+    out = tmp_path / "reg.npz"
+    rc = cli.main([
+        "fit", str(tmp_path / "cloud.npy"),
+        "--data-term", "points", "--steps", "40", "--out", str(out),
+    ])
+    assert rc == 0
+    assert "fit (adam, 40 steps)" in capsys.readouterr().out
+    assert np.load(out)["pose"].shape == (16, 3)
+
+    # Explicit LM cannot do chamfer.
+    rc = cli.main([
+        "fit", str(tmp_path / "cloud.npy"),
+        "--data-term", "points", "--solver", "lm",
+    ])
+    assert rc == 2
+    assert "requires --solver adam" in capsys.readouterr().err
+
+
 def test_fit_subcommand_rejects_bad_targets(tmp_path, capsys):
     np.save(tmp_path / "bad.npy", np.zeros((5, 3)))
     rc = cli.main(["fit", str(tmp_path / "bad.npy")])
