@@ -160,7 +160,7 @@ def cmd_fit(args) -> int:
     import jax
 
     from mano_hand_tpu import fitting
-    from mano_hand_tpu.io.checkpoints import save_fit_result
+    from mano_hand_tpu.io.checkpoints import load_arrays, save_fit_result
 
     params = _load_params(args.asset, args.side).astype(np.float32)
     targets = np.load(args.targets)  # [V|J, 3|2] or [B, V|J, 3|2]
@@ -213,6 +213,11 @@ def cmd_fit(args) -> int:
         if args.data_term in ("keypoints2d", "points"):
             print(f"--data-term {args.data_term} requires --solver adam",
                   file=sys.stderr)
+            return 2
+        if args.init is not None or args.robust != "none":
+            # These change the result materially — refuse rather than note:
+            # LM has no warm start and no robustifier.
+            print("--init/--robust require --solver adam", file=sys.stderr)
             return 2
         lm_kw = {}
         if args.data_term == "joints":
@@ -278,12 +283,30 @@ def cmd_fit(args) -> int:
         # One decision point for the effective pose space: the user's
         # explicit choice, else pca for depth-blind 2D data, else aa.
         pose_space = args.pose_space or ("pca" if kp2d else "aa")
+        init = None
+        if args.init:
+            if pose_space != "aa":
+                # fit() warm-starts in the ACTIVE parameterization, and
+                # checkpoints store axis-angle pose.
+                print("--init requires the axis-angle pose space "
+                      f"(active: {pose_space})", file=sys.stderr)
+                return 2
+            ck = load_arrays(args.init)
+            missing = {"pose", "shape"} - set(ck)
+            if missing:
+                print(f"--init checkpoint lacks {sorted(missing)} "
+                      f"(has {sorted(ck)})", file=sys.stderr)
+                return 2
+            # Leaf shapes (incl. batch agreement) are validated by fit().
+            init = {"pose": ck["pose"], "shape": ck["shape"]}
         res = fitting.fit(
             params, targets, n_steps=steps,
             lr=default_lr if args.lr is None else args.lr,
             data_term=args.data_term,
             shape_prior_weight=shape_prior,
             pose_space=pose_space,
+            robust=args.robust, robust_scale=args.robust_scale,
+            init=init,
             **kp2d,
         )
     jax.block_until_ready(res.pose)
@@ -377,6 +400,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "through a pinhole camera, or a correspondence-"
                         "free point cloud (one-sided chamfer — partial "
                         "depth-sensor scans)")
+    f.add_argument("--init", default=None,
+                   help="warm-start from a previous fit checkpoint (.npz "
+                        "with pose/shape, e.g. a coarse --data-term joints "
+                        "fit before --data-term points refinement: chamfer "
+                        "plateaus from a cold start). Adam only")
+    f.add_argument("--robust", default="none", choices=["none", "huber"],
+                   help="Huber-robust data term (bounded pull from "
+                        "outlier points). Adam only")
+    f.add_argument("--robust-scale", type=float, default=0.01,
+                   help="Huber scale in data units (meters for 3D terms)")
     f.add_argument("--conf", default=None,
                    help=".npy of [16]/[B,16] keypoint confidences "
                         "(keypoints2d only)")

@@ -118,34 +118,61 @@ def test_fit_subcommand_pose_space_6d(tmp_path, capsys):
 
 
 def test_fit_subcommand_points(tmp_path, capsys):
-    """Correspondence-free scan registration through the CLI (mechanics:
-    any-N validation, Adam routing, checkpoint written)."""
+    """Scan registration through the CLI: the full two-stage workflow
+    (coarse joints fit -> chamfer refinement warm-started via --init,
+    huber-robust), plus validation mechanics."""
     import jax.numpy as jnp
 
     from mano_hand_tpu.models import core
 
     p32 = synthetic_params(seed=0).astype(np.float32)
-    verts = np.asarray(core.jit_forward(
-        p32, jnp.zeros((16, 3), jnp.float32), jnp.zeros(10, jnp.float32)
-    ).verts)
-    cloud = verts[np.random.default_rng(2).permutation(778)[:123]]
+    rng = np.random.default_rng(2)
+    pose = rng.normal(scale=0.25, size=(16, 3)).astype(np.float32)
+    out_true = core.jit_forward(
+        p32, jnp.asarray(pose), jnp.zeros(10, jnp.float32)
+    )
+    np.save(tmp_path / "joints.npy", np.asarray(out_true.posed_joints))
+    cloud = np.asarray(out_true.verts)[rng.permutation(778)[:200]]
     np.save(tmp_path / "cloud.npy", cloud)
+
+    coarse = tmp_path / "coarse.npz"
+    rc = cli.main([
+        "fit", str(tmp_path / "joints.npy"), "--data-term", "joints",
+        "--solver", "adam", "--steps", "150", "--out", str(coarse),
+    ])
+    assert rc == 0
     out = tmp_path / "reg.npz"
     rc = cli.main([
         "fit", str(tmp_path / "cloud.npy"),
-        "--data-term", "points", "--steps", "40", "--out", str(out),
+        "--data-term", "points", "--steps", "100", "--lr", "0.01",
+        "--robust", "huber", "--init", str(coarse), "--out", str(out),
     ])
     assert rc == 0
-    assert "fit (adam, 40 steps)" in capsys.readouterr().out
+    assert "fit (adam, 100 steps)" in capsys.readouterr().out
     assert np.load(out)["pose"].shape == (16, 3)
 
-    # Explicit LM cannot do chamfer.
+    # Explicit LM cannot do chamfer, warm starts, or robustifiers.
     rc = cli.main([
         "fit", str(tmp_path / "cloud.npy"),
         "--data-term", "points", "--solver", "lm",
     ])
     assert rc == 2
     assert "requires --solver adam" in capsys.readouterr().err
+    rc = cli.main([
+        "fit", str(tmp_path / "joints.npy"), "--data-term", "joints",
+        "--solver", "lm", "--init", str(coarse),
+    ])
+    assert rc == 2
+    assert "--init/--robust" in capsys.readouterr().err
+
+    # An --init checkpoint missing required keys is a clear error.
+    np.savez(tmp_path / "bad.npz", pose=np.zeros((16, 3)))
+    rc = cli.main([
+        "fit", str(tmp_path / "cloud.npy"), "--data-term", "points",
+        "--init", str(tmp_path / "bad.npz"),
+    ])
+    assert rc == 2
+    assert "lacks" in capsys.readouterr().err
 
 
 def test_fit_subcommand_rejects_bad_targets(tmp_path, capsys):
