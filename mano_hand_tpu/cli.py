@@ -156,11 +156,27 @@ def cmd_render(args) -> int:
     return 0
 
 
+def _load_init(path):
+    """Warm-start checkpoint -> ({'pose', 'shape'}, None) or (None, error).
+
+    One loader for both solvers; leaf shapes (incl. batch agreement) are
+    validated by the library entry points.
+    """
+    from mano_hand_tpu.io.checkpoints import load_arrays
+
+    ck = load_arrays(path)
+    missing = {"pose", "shape"} - set(ck)
+    if missing:
+        return None, (f"--init checkpoint lacks {sorted(missing)} "
+                      f"(has {sorted(ck)})")
+    return {"pose": ck["pose"], "shape": ck["shape"]}, None
+
+
 def cmd_fit(args) -> int:
     import jax
 
     from mano_hand_tpu import fitting
-    from mano_hand_tpu.io.checkpoints import load_arrays, save_fit_result
+    from mano_hand_tpu.io.checkpoints import save_fit_result
 
     params = _load_params(args.asset, args.side).astype(np.float32)
     targets = np.load(args.targets)  # [V|J, 3|2] or [B, V|J, 3|2]
@@ -210,27 +226,33 @@ def cmd_fit(args) -> int:
         if args.lr is not None:
             print("note: --lr only applies to --solver adam; ignored",
                   file=sys.stderr)
-        if args.data_term in ("keypoints2d", "points"):
-            print(f"--data-term {args.data_term} requires --solver adam",
+        if args.data_term == "keypoints2d":
+            print("--data-term keypoints2d requires --solver adam",
                   file=sys.stderr)
             return 2
-        if args.init is not None or args.robust != "none":
-            # These change the result materially — refuse rather than note:
-            # LM has no warm start and no robustifier.
-            print("--init/--robust require --solver adam", file=sys.stderr)
+        if args.robust != "none":
+            # Materially changes the result — refuse rather than note:
+            # the GN residual has no robustifier.
+            print("--robust requires --solver adam", file=sys.stderr)
             return 2
         lm_kw = {}
-        if args.data_term == "joints":
+        if args.data_term in ("joints", "points"):
             # LM's Tikhonov rows stand in for the Adam path's shape prior
-            # (16 joints underdetermine shape).
+            # (16 joints — or a partial scan — underdetermine shape).
             lm_kw = dict(
-                data_term="joints",
+                data_term=args.data_term,
                 shape_weight=(0.1 if args.shape_prior is None
                               else args.shape_prior),
             )
         elif args.shape_prior is not None:
             print("note: --shape-prior only applies to --solver adam or "
-                  "--data-term joints; ignored", file=sys.stderr)
+                  "--data-term joints/points; ignored", file=sys.stderr)
+        if args.init:
+            init, err = _load_init(args.init)
+            if err:
+                print(err, file=sys.stderr)
+                return 2
+            lm_kw["init"] = init
         if needs_adam:
             # Only reachable with an EXPLICIT --solver lm (an unset solver
             # resolves to adam for these spaces): a contradiction, not a
@@ -291,14 +313,10 @@ def cmd_fit(args) -> int:
                 print("--init requires the axis-angle pose space "
                       f"(active: {pose_space})", file=sys.stderr)
                 return 2
-            ck = load_arrays(args.init)
-            missing = {"pose", "shape"} - set(ck)
-            if missing:
-                print(f"--init checkpoint lacks {sorted(missing)} "
-                      f"(has {sorted(ck)})", file=sys.stderr)
+            init, err = _load_init(args.init)
+            if err:
+                print(err, file=sys.stderr)
                 return 2
-            # Leaf shapes (incl. batch agreement) are validated by fit().
-            init = {"pose": ck["pose"], "shape": ck["shape"]}
         res = fitting.fit(
             params, targets, n_steps=steps,
             lr=default_lr if args.lr is None else args.lr,
@@ -403,8 +421,9 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--init", default=None,
                    help="warm-start from a previous fit checkpoint (.npz "
                         "with pose/shape, e.g. a coarse --data-term joints "
-                        "fit before --data-term points refinement: chamfer "
-                        "plateaus from a cold start). Adam only")
+                        "fit before --data-term points refinement: "
+                        "chamfer/ICP plateau from a cold start). Works "
+                        "with both solvers (Adam needs --pose-space aa)")
     f.add_argument("--robust", default="none", choices=["none", "huber"],
                    help="Huber-robust data term (bounded pull from "
                         "outlier points). Adam only")

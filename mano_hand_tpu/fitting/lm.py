@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax.flatten_util import ravel_pytree
 
 from mano_hand_tpu.assets.schema import ManoParams
+from mano_hand_tpu.fitting import objectives
 from mano_hand_tpu.models import core
 
 
@@ -39,7 +40,7 @@ class LMResult(NamedTuple):
 
 def _fit_single(
     params: ManoParams,
-    target_verts: jnp.ndarray,  # [V, 3] or [J, 3] (data_term)
+    target_verts: jnp.ndarray,  # [V, 3] | [J, 3] | [N, 3] (data_term)
     *,
     n_steps: int,
     init_damping: float,
@@ -47,6 +48,7 @@ def _fit_single(
     damping_down: float,
     shape_weight: float,
     data_term: str = "verts",
+    init: Optional[dict] = None,
 ) -> LMResult:
     dtype = params.v_template.dtype
     n_joints = params.j_regressor.shape[0]
@@ -56,14 +58,36 @@ def _fit_single(
         "pose": jnp.zeros((n_joints, 3), dtype),
         "shape": jnp.zeros((n_shape,), dtype),
     }
+    if init:
+        # Warm start (same contract as solvers.fit): ICP in particular
+        # needs one — nearest-neighbor assignments from the rest pose
+        # lock in a local basin.
+        unknown = set(init) - set(theta0)
+        if unknown:
+            raise ValueError(
+                f"init keys {sorted(unknown)} not in {sorted(theta0)}"
+            )
+        for k, v in init.items():
+            v = jnp.asarray(v, dtype)
+            if v.shape != theta0[k].shape:
+                raise ValueError(
+                    f"init[{k!r}] shape {v.shape} != {theta0[k].shape}"
+                )
+            theta0[k] = v
     flat0, unravel = ravel_pytree(theta0)
     n_params = flat0.shape[0]
     target = target_verts.reshape(-1)
 
-    def residual(flat):
+    def residual(flat, corr=None):
         p = unravel(flat)
         out = core.forward(params, p["pose"], p["shape"])
-        pred = out.verts if data_term == "verts" else out.posed_joints
+        if data_term == "points":
+            # Point-to-point ICP residual under the step's FROZEN
+            # correspondence assignment (GN never differentiates the
+            # argmin, matching classic ICP).
+            pred = out.verts[corr]
+        else:
+            pred = out.verts if data_term == "verts" else out.posed_joints
         res = pred.reshape(-1) - target
         # Tikhonov rows keep beta near 0 when vertices underdetermine it.
         # Always present (zero rows when the traced weight is 0, which is
@@ -71,14 +95,26 @@ def _fit_single(
         # therefore the jit cache key — is weight-independent.
         return jnp.concatenate([res, shape_weight * p["shape"]])
 
+    def assignment(flat):
+        p = unravel(flat)
+        verts = core.forward(params, p["pose"], p["shape"]).verts
+        return objectives.nearest_vertex_idx(
+            verts, target_verts.reshape(-1, 3)
+        )
+
     def loss_of(flat):
-        r = residual(flat)
+        # Fresh assignment when scoring (ICP's true objective is the
+        # chamfer, not the residual under a stale correspondence).
+        corr = assignment(flat) if data_term == "points" else None
+        r = residual(flat, corr)
         return (r * r).mean()
 
     def step(carry, _):
         flat, damping = carry
-        r = residual(flat)
-        jac = jax.jacfwd(residual)(flat)               # [R, P]
+        corr = assignment(flat) if data_term == "points" else None
+        res_fn = lambda f: residual(f, corr)  # noqa: E731
+        r = res_fn(flat)
+        jac = jax.jacfwd(res_fn)(flat)                 # [R, P]
         jtj = jnp.einsum(
             "rp,rq->pq", jac, jac, precision=core.DEFAULT_PRECISION
         )                                              # [P, P] (MXU)
@@ -120,13 +156,15 @@ def _fit_single(
 )
 def fit_lm(
     params: ManoParams,
-    target_verts: jnp.ndarray,  # [V, 3] or [B, V, 3] ([J, 3] for joints)
+    target_verts: jnp.ndarray,  # [V, 3] or [B, V, 3] ([J, 3] joints;
+                                # [N, 3] points)
     n_steps: int = 30,
     init_damping: float = 1e-3,
     damping_up: float = 10.0,
     damping_down: float = 0.3,
     shape_weight: float = 0.0,
     data_term: str = "verts",
+    init: Optional[dict] = None,
 ) -> LMResult:
     """Recover (pose, shape) by damped Gauss-Newton; batch via vmap.
 
@@ -134,13 +172,21 @@ def fit_lm(
     hundreds — the preferred solver when targets are clean meshes.
     ``data_term="joints"`` fits 16 posed joints instead (a [48+S]-row
     residual — even cheaper per step); 16 joints underdetermine shape,
-    so pair it with a nonzero ``shape_weight``. For robust or
-    2D-projected energies use solvers.fit (first-order).
+    so pair it with a nonzero ``shape_weight``. ``data_term="points"``
+    is true point-to-point ICP: per step, nearest-vertex correspondences
+    are re-assigned and a GN solve runs on the frozen assignment —
+    registration to an unstructured [N, 3] scan in ~10 steps; warm-start
+    via ``init`` (assignments from the rest pose lock in a local basin).
+    For robust or 2D-projected energies use solvers.fit (first-order).
     """
-    if data_term not in ("verts", "joints"):
+    if data_term not in ("verts", "joints", "points"):
         raise ValueError(
-            f"fit_lm data_term must be 'verts' or 'joints', got {data_term!r}"
+            "fit_lm data_term must be 'verts', 'joints' or 'points', "
+            f"got {data_term!r}"
         )
+    target_verts = jnp.asarray(target_verts, params.v_template.dtype)
+    if data_term == "points" and target_verts.shape[-2] == 0:
+        raise ValueError("points target cloud is empty ([..., 0, 3])")
     single = functools.partial(
         _fit_single,
         params,
@@ -151,7 +197,11 @@ def fit_lm(
         shape_weight=shape_weight,
         data_term=data_term,
     )
-    target_verts = jnp.asarray(target_verts, params.v_template.dtype)
     if target_verts.ndim == 2:
-        return single(target_verts)
-    return jax.vmap(single)(target_verts)
+        return single(target_verts, init=init)
+    if init is None:
+        return jax.vmap(lambda t: single(t, init=None))(target_verts)
+    # Batched warm start: one seed per problem on every init leaf.
+    init = {k: jnp.asarray(v, params.v_template.dtype)
+            for k, v in init.items()}
+    return jax.vmap(lambda t, i: single(t, init=i))(target_verts, init)

@@ -14,24 +14,38 @@ import jax.numpy as jnp
 from mano_hand_tpu.ops.common import DEFAULT_PRECISION
 
 
-def nearest_vertex_sq_dist(pred_verts: jnp.ndarray,    # [..., V, 3]
-                           target_points: jnp.ndarray,  # [..., N, 3]
-                           ) -> jnp.ndarray:
-    """Per-point squared distance to the nearest mesh vertex: [..., N].
-
-    THE one implementation of the cancellation-prone pairwise expansion
-    (|t|^2 - 2 t.v + |v|^2, clamped at 0 for fp) — the objective below,
-    tests, and examples all measure scan-to-surface distance through it.
-    The [N, V] matrix is one MXU matmul plus broadcasts (~2.3 MFLOP per
-    thousand points), trivially batch/frame-parallel.
-    """
-    d2 = (
+def _pairwise_sq_dist(pred_verts: jnp.ndarray,    # [..., V, 3]
+                      target_points: jnp.ndarray,  # [..., N, 3]
+                      ) -> jnp.ndarray:
+    """[..., N, V] squared distances — THE one implementation of the
+    cancellation-prone pairwise expansion (|t|^2 - 2 t.v + |v|^2); the
+    chamfer objective, ICP assignment, tests, and examples all ride it.
+    One MXU matmul plus broadcasts (~2.3 MFLOP per thousand points),
+    trivially batch/frame-parallel."""
+    return (
         jnp.sum(target_points ** 2, axis=-1)[..., :, None]
         - 2.0 * jnp.einsum("...nc,...vc->...nv", target_points, pred_verts,
                            precision=DEFAULT_PRECISION)
         + jnp.sum(pred_verts ** 2, axis=-1)[..., None, :]
     )
-    return jnp.maximum(jnp.min(d2, axis=-1), 0.0)
+
+
+def nearest_vertex_sq_dist(pred_verts: jnp.ndarray,    # [..., V, 3]
+                           target_points: jnp.ndarray,  # [..., N, 3]
+                           ) -> jnp.ndarray:
+    """Per-point squared distance to the nearest mesh vertex: [..., N],
+    clamped at 0 (the expansion can go slightly negative in fp)."""
+    return jnp.maximum(
+        jnp.min(_pairwise_sq_dist(pred_verts, target_points), axis=-1), 0.0
+    )
+
+
+def nearest_vertex_idx(pred_verts: jnp.ndarray,    # [..., V, 3]
+                       target_points: jnp.ndarray,  # [..., N, 3]
+                       ) -> jnp.ndarray:
+    """Index of the nearest mesh vertex per point: [..., N] int32 — the
+    ICP correspondence assignment."""
+    return jnp.argmin(_pairwise_sq_dist(pred_verts, target_points), axis=-1)
 
 
 def point_cloud_l2(pred_verts: jnp.ndarray,    # [..., V, 3]

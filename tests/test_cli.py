@@ -151,19 +151,24 @@ def test_fit_subcommand_points(tmp_path, capsys):
     assert "fit (adam, 100 steps)" in capsys.readouterr().out
     assert np.load(out)["pose"].shape == (16, 3)
 
-    # Explicit LM cannot do chamfer, warm starts, or robustifiers.
+    # Second-order ICP through the CLI: LM + points + warm start.
+    icp_out = tmp_path / "icp.npz"
     rc = cli.main([
         "fit", str(tmp_path / "cloud.npy"),
-        "--data-term", "points", "--solver", "lm",
+        "--data-term", "points", "--solver", "lm", "--steps", "10",
+        "--init", str(coarse), "--out", str(icp_out),
     ])
-    assert rc == 2
-    assert "requires --solver adam" in capsys.readouterr().err
+    assert rc == 0
+    assert "fit (lm, 10 steps)" in capsys.readouterr().out
+    assert np.load(icp_out)["pose"].shape == (16, 3)
+
+    # The GN residual has no robustifier.
     rc = cli.main([
         "fit", str(tmp_path / "joints.npy"), "--data-term", "joints",
-        "--solver", "lm", "--init", str(coarse),
+        "--solver", "lm", "--robust", "huber",
     ])
     assert rc == 2
-    assert "--init/--robust" in capsys.readouterr().err
+    assert "--robust requires --solver adam" in capsys.readouterr().err
 
     # An --init checkpoint missing required keys is a clear error.
     np.savez(tmp_path / "bad.npz", pose=np.zeros((16, 3)))

@@ -120,3 +120,56 @@ def test_cli_lm_joints(tmp_path, capsys, params32):
     assert rc == 0
     ck = np.load(out)
     assert "damping_history" in ck  # LM extras survive the checkpoint
+
+
+def test_lm_icp_points_registration(params32):
+    """True ICP: per-step nearest-vertex reassignment + GN solve.
+    Two-stage: coarse joints LM, then ICP refinement on a shuffled
+    partial cloud — converging in ~12 second-order steps."""
+    from mano_hand_tpu.fitting import objectives
+
+    rng = np.random.default_rng(9)
+    pose = rng.normal(scale=0.3, size=(16, 3)).astype(np.float32)
+    out_true = core.jit_forward(
+        params32, jnp.asarray(pose), jnp.zeros(10, jnp.float32)
+    )
+    cloud = jnp.asarray(
+        np.asarray(out_true.verts)[rng.permutation(778)[:350]]
+    )
+
+    coarse = fit_lm(params32, out_true.posed_joints, n_steps=20,
+                    data_term="joints", shape_weight=0.1)
+    res = fit_lm(params32, cloud, n_steps=12, data_term="points",
+                 shape_weight=0.1,
+                 init={"pose": coarse.pose, "shape": coarse.shape})
+    verts = core.jit_forward(params32, res.pose, res.shape).verts
+    nn = np.sqrt(np.asarray(objectives.nearest_vertex_sq_dist(verts, cloud)))
+    assert float(nn.max()) < 2e-3  # worst scan point within 2 mm
+    # ICP must IMPROVE on the coarse stage, not just match it.
+    verts_c = core.jit_forward(params32, coarse.pose, coarse.shape).verts
+    nn_c = np.asarray(objectives.nearest_vertex_sq_dist(verts_c, cloud))
+    assert float(np.mean(nn ** 2)) < 0.5 * float(np.mean(nn_c))
+
+
+def test_lm_icp_batched_with_init(params32):
+    rng = np.random.default_rng(10)
+    pose = rng.normal(scale=0.2, size=(2, 16, 3)).astype(np.float32)
+    verts = np.asarray(core.jit_forward_batched(
+        params32, jnp.asarray(pose), jnp.zeros((2, 10), jnp.float32)
+    ).verts)
+    idx = rng.permutation(778)[:250]
+    clouds = jnp.asarray(verts[:, idx])
+    # Warm-start near the truth (per-problem seeds); ICP polishes.
+    res = fit_lm(params32, clouds, n_steps=10, data_term="points",
+                 shape_weight=0.1,
+                 init={"pose": pose * 0.9,
+                       "shape": np.zeros((2, 10), np.float32)})
+    assert res.pose.shape == (2, 16, 3)
+    assert np.isfinite(np.asarray(res.final_loss)).all()
+    assert np.asarray(res.final_loss).max() < 1e-6
+
+
+def test_lm_rejects_empty_cloud(params32):
+    with pytest.raises(ValueError, match="empty"):
+        fit_lm(params32, jnp.zeros((0, 3), jnp.float32), n_steps=1,
+               data_term="points")
