@@ -184,7 +184,7 @@ def cmd_fit(args) -> int:
         want = (params.n_joints, 2)
     elif args.data_term == "joints":
         want = (params.n_joints, 3)
-    elif args.data_term == "points":
+    elif args.data_term in ("points", "point_to_plane"):
         want = (None, 3)  # any number of scan points, 3D
     else:
         want = (params.n_verts, 3)
@@ -211,7 +211,8 @@ def cmd_fit(args) -> int:
         if needs_adam:
             args.solver = "adam"
         else:
-            args.solver = "lm" if args.data_term == "verts" else "adam"
+            args.solver = ("lm" if args.data_term
+                           in ("verts", "point_to_plane") else "adam")
     steps = (
         args.steps if args.steps is not None
         else (25 if args.solver == "lm" else 200)
@@ -236,7 +237,7 @@ def cmd_fit(args) -> int:
             print("--robust requires --solver adam", file=sys.stderr)
             return 2
         lm_kw = {}
-        if args.data_term in ("joints", "points"):
+        if args.data_term in ("joints", "points", "point_to_plane"):
             # LM's Tikhonov rows stand in for the Adam path's shape prior
             # (16 joints — or a partial scan — underdetermine shape).
             lm_kw = dict(
@@ -246,7 +247,8 @@ def cmd_fit(args) -> int:
             )
         elif args.shape_prior is not None:
             print("note: --shape-prior only applies to --solver adam or "
-                  "--data-term joints/points; ignored", file=sys.stderr)
+                  "--data-term joints/points/point_to_plane; ignored",
+                  file=sys.stderr)
         if args.init:
             init, err = _load_init(args.init)
             if err:
@@ -263,6 +265,21 @@ def cmd_fit(args) -> int:
             return 2
         res = fitting.fit_lm(params, targets, n_steps=steps, **lm_kw)
     else:
+        if args.data_term == "point_to_plane":
+            # The Adam path has no normal-distance residual; the GN
+            # solver owns this polish stage. Name the FULL conflict when
+            # a pose space forced the adam resolution — "use --solver lm"
+            # alone would send the user into the opposite error.
+            if needs_adam:
+                print("--data-term point_to_plane is LM-only and LM "
+                      "optimizes axis-angle: it cannot combine with "
+                      f"--pose-space {args.pose_space}; drop the pose "
+                      "space or use --data-term points",
+                      file=sys.stderr)
+            else:
+                print("--data-term point_to_plane requires --solver lm",
+                      file=sys.stderr)
+            return 2
         # Shape is weakly observable from 16 joints; regularize it
         # (unless the user set an explicit weight).
         shape_prior = (
@@ -402,7 +419,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "joints with --data-term joints; [16,2]/[B,16,2] "
                         "image points with --data-term keypoints2d; "
                         "[N,3]/[B,N,3] scan points with --data-term "
-                        "points")
+                        "points or point_to_plane")
     f.add_argument("--pose-space", default=None,
                    choices=["aa", "pca", "6d"],
                    help="pose parameterization: axis-angle (both solvers' "
@@ -412,12 +429,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "to axis-angle). pca/6d imply the Adam solver; "
                         "keypoints2d defaults to pca when unset")
     f.add_argument("--data-term", default="verts",
-                   choices=["verts", "joints", "keypoints2d", "points"],
+                   choices=["verts", "joints", "keypoints2d", "points",
+                            "point_to_plane"],
                    help="fit to a full target mesh, sparse 3D keypoints "
                         "(detector/mocap output), 2D keypoints projected "
                         "through a pinhole camera, or a correspondence-"
-                        "free point cloud (one-sided chamfer — partial "
-                        "depth-sensor scans)")
+                        "free point cloud (partial depth-sensor scans): "
+                        "'points' = chamfer/point-to-point ICP, "
+                        "'point_to_plane' = LM-only normal-distance "
+                        "polish after a points fit")
     f.add_argument("--init", default=None,
                    help="warm-start from a previous fit checkpoint (.npz "
                         "with pose/shape, e.g. a coarse --data-term joints "
@@ -447,9 +467,10 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--asset", default="synthetic")
     f.add_argument("--side", default=None, choices=[None, "left", "right"])
     f.add_argument("--solver", default=None, choices=["lm", "adam"],
-                   help="default: lm for --data-term verts, adam for "
-                        "joints/keypoints2d; lm also supports joints "
-                        "(keypoints2d is adam-only)")
+                   help="default: lm for --data-term verts/point_to_plane, "
+                        "adam for joints/keypoints2d/points; lm also "
+                        "supports joints and points (second-order ICP); "
+                        "keypoints2d is adam-only, point_to_plane lm-only")
     f.add_argument("--steps", type=int, default=None,
                    help="default: 25 (lm) / 200 (adam)")
     f.add_argument("--lr", type=float, default=None,

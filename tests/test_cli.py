@@ -162,6 +162,22 @@ def test_fit_subcommand_points(tmp_path, capsys):
     assert "fit (lm, 10 steps)" in capsys.readouterr().out
     assert np.load(icp_out)["pose"].shape == (16, 3)
 
+    # Point-to-plane polish through the CLI (LM-only, defaults to LM).
+    polish = tmp_path / "polish.npz"
+    rc = cli.main([
+        "fit", str(tmp_path / "cloud.npy"),
+        "--data-term", "point_to_plane", "--steps", "5",
+        "--init", str(icp_out), "--out", str(polish),
+    ])
+    assert rc == 0
+    assert "fit (lm, 5 steps)" in capsys.readouterr().out
+    rc = cli.main([
+        "fit", str(tmp_path / "cloud.npy"),
+        "--data-term", "point_to_plane", "--solver", "adam",
+    ])
+    assert rc == 2
+    assert "requires --solver lm" in capsys.readouterr().err
+
     # The GN residual has no robustifier.
     rc = cli.main([
         "fit", str(tmp_path / "joints.npy"), "--data-term", "joints",

@@ -173,3 +173,33 @@ def test_lm_rejects_empty_cloud(params32):
     with pytest.raises(ValueError, match="empty"):
         fit_lm(params32, jnp.zeros((0, 3), jnp.float32), n_steps=1,
                data_term="points")
+
+
+def test_lm_point_to_plane_registration(params32):
+    """Chen & Medioni point-to-plane ICP as the POLISH stage: applied
+    after point-to-point it must preserve (not degrade) the registration
+    floor. Plane residuals alone let the mesh slide tangentially — with
+    vertex-level correspondences they are a refinement, not a opener."""
+    from mano_hand_tpu.fitting import objectives
+
+    rng = np.random.default_rng(11)
+    pose = rng.normal(scale=0.3, size=(16, 3)).astype(np.float32)
+    out_true = core.jit_forward(
+        params32, jnp.asarray(pose), jnp.zeros(10, jnp.float32)
+    )
+    cloud = jnp.asarray(
+        np.asarray(out_true.verts)[rng.permutation(778)[:350]]
+    )
+    coarse = fit_lm(params32, out_true.posed_joints, n_steps=20,
+                    data_term="joints", shape_weight=0.1)
+    pp = fit_lm(params32, cloud, n_steps=12, data_term="points",
+                shape_weight=0.1,
+                init={"pose": coarse.pose, "shape": coarse.shape})
+
+    plane = fit_lm(params32, cloud, n_steps=6,
+                   data_term="point_to_plane", shape_weight=0.1,
+                   init={"pose": pp.pose, "shape": pp.shape})
+    verts = core.jit_forward(params32, plane.pose, plane.shape).verts
+    nn = np.sqrt(np.asarray(objectives.nearest_vertex_sq_dist(verts, cloud)))
+    assert float(nn.max()) < 2e-3
+    assert np.isfinite(np.asarray(plane.final_loss)).all()
