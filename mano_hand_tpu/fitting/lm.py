@@ -53,6 +53,7 @@ def _fit_single(
     shape_weight: float,
     data_term: str = "verts",
     init: Optional[dict] = None,
+    trim_fraction: float = 0.0,
 ) -> LMResult:
     dtype = params.v_template.dtype
     n_joints = params.j_regressor.shape[0]
@@ -88,20 +89,23 @@ def _fit_single(
         if data_term == "points":
             # Point-to-point ICP residual under the step's FROZEN
             # correspondence assignment (GN never differentiates the
-            # argmin, matching classic ICP).
-            pred = out.verts[corr]
-        elif data_term == "point_to_plane":
+            # argmin, matching classic ICP). Trim weights zero the rows
+            # of rejected points — residual shape stays static.
+            idx, w = corr
+            d = out.verts[idx] - target_verts.reshape(-1, 3)
+            res = (d * w[:, None]).reshape(-1)
+            return jnp.concatenate([res, shape_weight * p["shape"]])
+        if data_term == "point_to_plane":
             # Point-to-plane: signed distance along the step's FROZEN
             # surface normal — one row per point. Sliding tangentially
             # along the surface is free, which is why this converges in
             # fewer steps than point-to-point on smooth regions (the
             # classic Chen & Medioni refinement).
-            idx, normals = corr
+            idx, normals, w = corr
             d = out.verts[idx] - target_verts.reshape(-1, 3)
-            res = jnp.sum(d * normals, axis=-1)
+            res = jnp.sum(d * normals, axis=-1) * w
             return jnp.concatenate([res, shape_weight * p["shape"]])
-        else:
-            pred = out.verts if data_term == "verts" else out.posed_joints
+        pred = out.verts if data_term == "verts" else out.posed_joints
         res = pred.reshape(-1) - target
         # Tikhonov rows keep beta near 0 when vertices underdetermine it.
         # Always present (zero rows when the traced weight is 0, which is
@@ -112,15 +116,21 @@ def _fit_single(
     def assignment(flat):
         p = unravel(flat)
         verts = core.forward(params, p["pose"], p["shape"]).verts
-        idx = objectives.nearest_vertex_idx(
-            verts, target_verts.reshape(-1, 3)
-        )
+        points = target_verts.reshape(-1, 3)
+        idx = objectives.nearest_vertex_idx(verts, points)
+        # Trimmed ICP: reject the worst trim_fraction of points THIS step
+        # (sensor outliers, non-hand foreground) — the standard trimming
+        # since the GN residual has no robustifier. The quantile is over
+        # the frozen assignment's distances; trim_fraction=0 keeps all.
+        d2 = jnp.sum((verts[idx] - points) ** 2, axis=-1)
+        thresh = jnp.quantile(d2, 1.0 - trim_fraction)
+        w = (d2 <= thresh).astype(dtype)
         if data_term == "point_to_plane":
             # Normals of the CURRENT surface at the assigned vertices,
             # frozen with the assignment for this step.
             normals = ops.vertex_normals(verts, params.faces)[idx]
-            return idx, normals
-        return idx
+            return idx, normals, w
+        return idx, w
 
     def loss_of(flat):
         # Fresh assignment when scoring (ICP's true objective is the
@@ -172,7 +182,7 @@ def _fit_single(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_steps", "data_term"),
+    static_argnames=("n_steps", "data_term", "trim_fraction"),
 )
 def fit_lm(
     params: ManoParams,
@@ -185,6 +195,7 @@ def fit_lm(
     shape_weight: float = 0.0,
     data_term: str = "verts",
     init: Optional[dict] = None,
+    trim_fraction: float = 0.0,
 ) -> LMResult:
     """Recover (pose, shape) by damped Gauss-Newton; batch via vmap.
 
@@ -197,7 +208,11 @@ def fit_lm(
     are re-assigned and a GN solve runs on the frozen assignment —
     registration to an unstructured [N, 3] scan in ~10 steps; warm-start
     via ``init`` (assignments from the rest pose lock in a local basin).
-    ``data_term="point_to_plane"`` is the Chen & Medioni refinement:
+    ``trim_fraction`` (ICP terms only) rejects that fraction of the
+    worst-matching points EACH step (re-evaluated with the assignment) —
+    trimmed ICP, the standard outlier defense since the GN residual has
+    no robustifier. ``data_term="point_to_plane"`` is the Chen & Medioni
+    refinement:
     residuals are signed distances along the current surface normals
     (one row per point), letting points slide freely along the surface.
     Use it as the POLISH stage after a point-to-point fit — plane
@@ -215,6 +230,19 @@ def fit_lm(
     target_verts = jnp.asarray(target_verts, params.v_template.dtype)
     if data_term in _ICP_TERMS and target_verts.shape[-2] == 0:
         raise ValueError("points target cloud is empty ([..., 0, 3])")
+    # trim_fraction is static (a config knob), so these validate concretely.
+    # jnp.quantile would silently CLAMP an out-of-range fraction — e.g. 1.0
+    # keeps only the single nearest point and returns a garbage fit with a
+    # tiny loss.
+    if not 0.0 <= float(trim_fraction) < 1.0:
+        raise ValueError(
+            f"trim_fraction must be in [0, 1), got {trim_fraction}"
+        )
+    if trim_fraction and data_term not in _ICP_TERMS:
+        raise ValueError(
+            "trim_fraction only applies to the ICP data terms "
+            f"{_ICP_TERMS}, got data_term={data_term!r}"
+        )
     single = functools.partial(
         _fit_single,
         params,
@@ -224,6 +252,7 @@ def fit_lm(
         damping_down=damping_down,
         shape_weight=shape_weight,
         data_term=data_term,
+        trim_fraction=trim_fraction,
     )
     if target_verts.ndim == 2:
         return single(target_verts, init=init)

@@ -203,3 +203,48 @@ def test_lm_point_to_plane_registration(params32):
     nn = np.sqrt(np.asarray(objectives.nearest_vertex_sq_dist(verts, cloud)))
     assert float(nn.max()) < 2e-3
     assert np.isfinite(np.asarray(plane.final_loss)).all()
+
+
+def test_lm_trimmed_icp_rejects_outliers(params32):
+    """5% of the scan displaced 10 cm (non-hand foreground): untrimmed
+    ICP is dragged off; trim_fraction=0.1 registers tight."""
+    from mano_hand_tpu.fitting import objectives
+
+    rng = np.random.default_rng(12)
+    pose = rng.normal(scale=0.3, size=(16, 3)).astype(np.float32)
+    out_true = core.jit_forward(
+        params32, jnp.asarray(pose), jnp.zeros(10, jnp.float32)
+    )
+    clean = np.asarray(out_true.verts)[rng.permutation(778)[:350]]
+    cloud = clean.copy()
+    n_out = 18  # ~5%
+    cloud[:n_out] += rng.normal(scale=0.1, size=(n_out, 3))
+    cloud = jnp.asarray(cloud)
+    inliers = jnp.asarray(clean[n_out:])
+
+    coarse = fit_lm(params32, out_true.posed_joints, n_steps=20,
+                    data_term="joints", shape_weight=0.1)
+    init = {"pose": coarse.pose, "shape": coarse.shape}
+
+    def inlier_nn_max(res):
+        v = core.jit_forward(params32, res.pose, res.shape).verts
+        return float(np.sqrt(np.asarray(
+            objectives.nearest_vertex_sq_dist(v, inliers)
+        )).max())
+
+    plain = fit_lm(params32, cloud, n_steps=12, data_term="points",
+                   shape_weight=0.1, init=init)
+    trimmed = fit_lm(params32, cloud, n_steps=12, data_term="points",
+                     shape_weight=0.1, init=init, trim_fraction=0.1)
+    assert inlier_nn_max(trimmed) < 2e-3
+    assert inlier_nn_max(trimmed) < 0.5 * inlier_nn_max(plain)
+
+
+def test_lm_trim_fraction_validation(params32):
+    cloud = jnp.zeros((10, 3), jnp.float32)
+    with pytest.raises(ValueError, match="trim_fraction"):
+        fit_lm(params32, cloud, n_steps=1, data_term="points",
+               trim_fraction=1.0)
+    with pytest.raises(ValueError, match="trim_fraction"):
+        fit_lm(params32, core.forward(params32).verts, n_steps=1,
+               data_term="verts", trim_fraction=0.3)
