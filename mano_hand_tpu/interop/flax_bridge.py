@@ -18,7 +18,7 @@ from mano_hand_tpu import ops
 from mano_hand_tpu.assets.schema import ManoParams
 from mano_hand_tpu.models import core
 
-POSE_FORMATS = ("aa", "pca", "6d", "rotmat")
+POSE_FORMATS = ("aa", "pca", "6d", "rotmat", "quat")
 
 
 class ManoLayer(nn.Module):
@@ -31,7 +31,9 @@ class ManoLayer(nn.Module):
         coefficients [B, n<=45] (+ optional global_rot [B, 3]); ``"6d"``
         the continuous rotation representation [B, 16, 6] (the standard
         regression target for neural pose estimators — continuous, no
-        wrap); ``"rotmat"`` rotation matrices [B, 16, 3, 3].
+        wrap); ``"rotmat"`` rotation matrices [B, 16, 3, 3]; ``"quat"``
+        quaternions [B, 16, 4] (scalar-first w,x,y,z; normalized
+        internally — mocap interchange).
       use_pca: legacy alias for ``pose_format="pca"``.
       learn_shape: if True, beta is a trainable variable of the module
         (shared across the batch — per-subject calibration); else it is an
@@ -88,6 +90,10 @@ class ManoLayer(nn.Module):
         if fmt == "6d":
             return core.forward_batched_rotmats(
                 self.params, ops.matrix_from_6d(pose), shape
+            )
+        if fmt == "quat":
+            return core.forward_batched_rotmats(
+                self.params, ops.matrix_from_quaternion(pose), shape
             )
         if fmt == "rotmat":
             return core.forward_batched_rotmats(self.params, pose, shape)

@@ -1,6 +1,7 @@
 from mano_hand_tpu.ops.rodrigues import (
     axis_angle_from_matrix,
     matrix_from_6d,
+    matrix_from_quaternion,
     matrix_to_6d,
     rotation_matrix,
     skew,
@@ -22,6 +23,7 @@ __all__ = [
     "skew",
     "axis_angle_from_matrix",
     "matrix_from_6d",
+    "matrix_from_quaternion",
     "matrix_to_6d",
     "forward_kinematics",
     "skinning_transforms",

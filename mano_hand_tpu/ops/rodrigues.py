@@ -157,3 +157,23 @@ def axis_angle_from_matrix(rot: jnp.ndarray) -> jnp.ndarray:
     aa_pi = axis_pi * sign / norm * theta
 
     return jnp.where(small, aa_small, jnp.where(near_pi, aa_pi, aa_generic))
+
+
+def matrix_from_quaternion(q: jnp.ndarray, eps: float = 1e-12) -> jnp.ndarray:
+    """Quaternions [..., 4] (scalar-first: w, x, y, z) -> [..., 3, 3].
+
+    Inputs are normalized first (regressor outputs and interpolated mocap
+    quats are rarely exactly unit), so any nonzero 4-vector maps onto
+    SO(3); q and -q give the same rotation (double cover). Matches the
+    convention of ``anim``'s slerp helpers.
+    """
+    q = q / jnp.sqrt(jnp.sum(q * q, axis=-1, keepdims=True) + eps)
+    w, x, y, z = q[..., 0], q[..., 1], q[..., 2], q[..., 3]
+    return jnp.stack(
+        [
+            1 - 2 * (y * y + z * z), 2 * (x * y - w * z), 2 * (x * z + w * y),
+            2 * (x * y + w * z), 1 - 2 * (x * x + z * z), 2 * (y * z - w * x),
+            2 * (x * z - w * y), 2 * (y * z + w * x), 1 - 2 * (x * x + y * y),
+        ],
+        axis=-1,
+    ).reshape(*q.shape[:-1], 3, 3)

@@ -129,3 +129,38 @@ def test_fit_pose_in_6d_space(params32):
     for _ in range(400):
         x, state, val = step(x, state)
     assert float(val) < l0 * 1e-3, (float(val), l0)
+
+
+def test_quaternion_matches_rodrigues():
+    # aa -> quat (via the anim helpers' convention) -> matrix must equal
+    # aa -> matrix directly; scaling a quat must not change the rotation.
+    from mano_hand_tpu.models.anim import _aa_to_quat
+
+    rng = np.random.default_rng(15)
+    aa = rng.normal(scale=1.0, size=(32, 3))
+    q = jnp.asarray(_aa_to_quat(aa).astype(np.float32))
+    want = ops.rotation_matrix(jnp.asarray(aa, jnp.float32))
+    got = ops.matrix_from_quaternion(q)
+    assert np.abs(np.asarray(got) - np.asarray(want)).max() < 1e-5
+    got_scaled = ops.matrix_from_quaternion(q * 3.7)
+    assert np.abs(np.asarray(got_scaled) - np.asarray(want)).max() < 1e-5
+    # Double cover: -q is the same rotation.
+    got_neg = ops.matrix_from_quaternion(-q)
+    assert np.abs(np.asarray(got_neg) - np.asarray(want)).max() < 1e-5
+
+
+def test_flax_quat_format(params):
+    from mano_hand_tpu.interop import ManoLayer
+    from mano_hand_tpu.models.anim import _aa_to_quat
+    from mano_hand_tpu.models import core as _core
+
+    p32 = params.astype(np.float32)
+    rng = np.random.default_rng(16)
+    pose = rng.normal(scale=0.4, size=(2, 16, 3))
+    quats = jnp.asarray(_aa_to_quat(pose).astype(np.float32))
+    beta = jnp.asarray(rng.normal(size=(2, 10)), jnp.float32)
+    want = _core.forward_batched(
+        p32, jnp.asarray(pose, jnp.float32), beta
+    ).verts
+    got = ManoLayer(params=p32, pose_format="quat").apply({}, quats, beta)
+    assert np.abs(np.asarray(got) - np.asarray(want)).max() < 1e-4
