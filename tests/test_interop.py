@@ -199,7 +199,7 @@ def test_flax_layer_6d_and_rotmat_inputs(params32):
     assert float(np.abs(np.asarray(g)).max()) > 0
 
     with pytest.raises(ValueError, match="pose_format"):
-        ManoLayer(params=params32, pose_format="quat").apply({}, x6, beta)
+        ManoLayer(params=params32, pose_format="euler").apply({}, x6, beta)
 
 
 def test_params_from_torch_sparse_jregressor(params32):
