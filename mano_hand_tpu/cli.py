@@ -203,6 +203,15 @@ def cmd_fit(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if not 0.0 <= args.trim < 1.0:
+        print(f"--trim must be in [0, 1), got {args.trim}", file=sys.stderr)
+        return 2
+    if args.trim and args.data_term not in ("points", "point_to_plane"):
+        # Checked BEFORE any solver resolution: naming only the solver
+        # here would ping-pong the user into the opposite error.
+        print("--trim only applies to --data-term points/point_to_plane",
+              file=sys.stderr)
+        return 2
     # Anything that is not LM's own parameterization (axis-angle) needs the
     # Adam solver — ONE definition, shared with the explicit-LM guard below,
     # so a future pose space fails safe instead of silently routing to LM.
@@ -255,6 +264,8 @@ def cmd_fit(args) -> int:
                 print(err, file=sys.stderr)
                 return 2
             lm_kw["init"] = init
+        if args.trim:
+            lm_kw["trim_fraction"] = args.trim
         if needs_adam:
             # Only reachable with an EXPLICIT --solver lm (an unset solver
             # resolves to adam for these spaces): a contradiction, not a
@@ -265,6 +276,10 @@ def cmd_fit(args) -> int:
             return 2
         res = fitting.fit_lm(params, targets, n_steps=steps, **lm_kw)
     else:
+        if args.trim:
+            print("--trim requires --solver lm (the Adam chamfer path "
+                  "uses --robust huber instead)", file=sys.stderr)
+            return 2
         if args.data_term == "point_to_plane":
             # The Adam path has no normal-distance residual; the GN
             # solver owns this polish stage. Name the FULL conflict when
@@ -449,6 +464,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "outlier points). Adam only")
     f.add_argument("--robust-scale", type=float, default=0.01,
                    help="Huber scale in data units (meters for 3D terms)")
+    f.add_argument("--trim", type=float, default=0.0,
+                   help="trimmed-ICP fraction in [0, 1): reject this "
+                        "fraction of the worst-matching scan points each "
+                        "step (outlier defense; --solver lm with "
+                        "--data-term points/point_to_plane only)")
     f.add_argument("--conf", default=None,
                    help=".npy of [16]/[B,16] keypoint confidences "
                         "(keypoints2d only)")

@@ -178,6 +178,35 @@ def test_fit_subcommand_points(tmp_path, capsys):
     assert rc == 2
     assert "requires --solver lm" in capsys.readouterr().err
 
+    # Trimmed ICP through the CLI.
+    trim_out = tmp_path / "trim.npz"
+    rc = cli.main([
+        "fit", str(tmp_path / "cloud.npy"),
+        "--data-term", "points", "--solver", "lm", "--steps", "8",
+        "--trim", "0.1", "--init", str(coarse), "--out", str(trim_out),
+    ])
+    assert rc == 0
+    assert np.load(trim_out)["pose"].shape == (16, 3)
+    rc = cli.main([
+        "fit", str(tmp_path / "cloud.npy"),
+        "--data-term", "points", "--trim", "0.1",  # adam path
+    ])
+    assert rc == 2
+    assert "--trim requires --solver lm" in capsys.readouterr().err
+    rc = cli.main([
+        "fit", str(tmp_path / "joints.npy"), "--data-term", "joints",
+        "--solver", "lm", "--trim", "0.1",
+    ])
+    assert rc == 2
+    assert "--trim only applies" in capsys.readouterr().err
+    # Out-of-range fractions get the one-line usage error, not a traceback.
+    rc = cli.main([
+        "fit", str(tmp_path / "cloud.npy"),
+        "--data-term", "points", "--solver", "lm", "--trim", "1.0",
+    ])
+    assert rc == 2
+    assert "--trim must be in [0, 1)" in capsys.readouterr().err
+
     # The GN residual has no robustifier.
     rc = cli.main([
         "fit", str(tmp_path / "joints.npy"), "--data-term", "joints",
