@@ -18,7 +18,6 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from mano_hand_tpu.assets.schema import ManoParams
 from mano_hand_tpu.fitting import objectives
 from mano_hand_tpu.models import core
 from mano_hand_tpu.parallel.mesh import DATA_AXIS

@@ -14,7 +14,7 @@ exact at vertices and more than adequate for mesh inspection at MANO scale.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
