@@ -24,7 +24,7 @@ from jax.flatten_util import ravel_pytree
 
 from mano_hand_tpu import ops
 from mano_hand_tpu.assets.schema import ManoParams
-from mano_hand_tpu.fitting import objectives
+from mano_hand_tpu.fitting import objectives, solvers
 from mano_hand_tpu.models import core
 
 # Data terms with per-step ICP correspondence assignment.
@@ -261,4 +261,14 @@ def fit_lm(
     # Batched warm start: one seed per problem on every init leaf.
     init = {k: jnp.asarray(v, params.v_template.dtype)
             for k, v in init.items()}
+    solvers.validate_batched_init(
+        init, target_verts.shape[0],
+        # LM's theta0 is the "aa" parameterization with no n_pca/trans DOFs
+        # — same shape source as the Adam solvers, no hand-written mirror.
+        solvers._batched_init_shapes(
+            "aa", params.j_regressor.shape[0], 0,
+            params.shape_basis.shape[-1], fit_trans=False,
+        ),
+        target_verts.shape, "fit_lm",
+    )
     return jax.vmap(lambda t, i: single(t, init=i))(target_verts, init)

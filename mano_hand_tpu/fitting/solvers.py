@@ -37,26 +37,73 @@ _ID6D = (1.0, 0.0, 0.0, 0.0, 1.0, 0.0)
 # deviation, and final decode-to-axis-angle, keyed on pose_space. `prefix`
 # prepends leading dims (() for one problem, (T,) for a clip).
 
-def _pose_init(pose_space, prefix, n_joints, n_pca, dtype, allowed):
+def _pose_shapes(pose_space, n_joints, n_pca, allowed):
+    """Per-problem pose-parameter shapes — THE shape source of truth that
+    both ``_pose_init`` (array construction) and the batched warm-start
+    validation consume, so the two can't drift."""
     if pose_space not in allowed:
         raise ValueError(
             f"pose_space must be one of {sorted(allowed)}, "
             f"got {pose_space!r}"
         )
     if pose_space == "aa":
-        return {"pose": jnp.zeros((*prefix, n_joints, 3), dtype)}
+        return {"pose": (n_joints, 3)}
     if pose_space == "pca":
-        return {
-            "pca": jnp.zeros((*prefix, n_pca), dtype),
-            "global_rot": jnp.zeros((*prefix, 3), dtype),
-        }
+        return {"pca": (n_pca,), "global_rot": (3,)}
     # "6d": the continuous rotation representation (ops.matrix_from_6d) —
-    # no 2*pi wrap in the optimization landscape. Init = identity.
-    return {
-        "rot6d": jnp.broadcast_to(
-            jnp.asarray(_ID6D, dtype), (*prefix, n_joints, 6)
+    # no 2*pi wrap in the optimization landscape.
+    return {"rot6d": (n_joints, 6)}
+
+
+def _pose_init(pose_space, prefix, n_joints, n_pca, dtype, allowed):
+    shapes = _pose_shapes(pose_space, n_joints, n_pca, allowed)
+    if pose_space == "6d":
+        # Init = identity rotation, not zeros (a zero 6D vector is
+        # degenerate under Gram-Schmidt).
+        return {
+            "rot6d": jnp.broadcast_to(
+                jnp.asarray(_ID6D, dtype), (*prefix, *shapes["rot6d"])
+            )
+        }
+    return {k: jnp.zeros((*prefix, *s), dtype) for k, s in shapes.items()}
+
+
+def _batched_init_shapes(pose_space, n_joints, n_pca, n_shape, fit_trans,
+                         allowed=frozenset({"aa", "pca", "6d"})):
+    """Full per-problem parameter shapes for the active parameterization —
+    plain tuples (no array materialization; this runs on every batched
+    warm-started call). Pose shapes come from ``_pose_shapes``, the same
+    source ``_pose_init`` builds from."""
+    shapes = dict(_pose_shapes(pose_space, n_joints, n_pca, allowed))
+    shapes["shape"] = (n_shape,)
+    if fit_trans:
+        shapes["trans"] = (3,)
+    return shapes
+
+
+def validate_batched_init(init, b, expected, target_shape, fn_name):
+    """One up-front check for every batched warm-start path (Adam and LM).
+
+    Full-shape validation: a single-problem seed — even one whose own
+    leading dim coincidentally equals B — or a typo'd key must fail here
+    with a descriptive message, not as a raw vmap axis-size error deep in
+    the trace. ``expected`` maps key -> per-problem shape tuple.
+    """
+    unknown = set(init) - set(expected)
+    if unknown:
+        raise ValueError(
+            f"init keys {sorted(unknown)} not in this parameterization "
+            f"{sorted(expected)}"
         )
-    }
+    for k, v in init.items():
+        v = jnp.asarray(v)
+        want = (b, *expected[k])
+        if v.shape != want:
+            raise ValueError(
+                f"batched {fn_name} needs one seed per problem: "
+                f"init[{k!r}] has shape {v.shape}, expected {want} for "
+                f"target batch {target_shape}"
+            )
 
 
 def _pose_deviation(pose_space, p, dtype):
@@ -358,6 +405,15 @@ def fit_with_optimizer(
     # Batched problems: map conf per-problem when it is [B, J]; a shared
     # [J] conf (or None) broadcasts via in_axes=None. A warm-start init
     # must carry the batch on every leaf (one seed per problem).
+    if init:
+        validate_batched_init(
+            init, target_verts.shape[0],
+            _batched_init_shapes(
+                pose_space, params.j_regressor.shape[0], n_pca,
+                params.shape_basis.shape[-1], fit_trans,
+            ),
+            target_verts.shape, "fit",
+        )
     conf_axis = 0 if (target_conf is not None
                       and target_conf.ndim == 2) else None
     return jax.vmap(

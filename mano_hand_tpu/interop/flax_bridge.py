@@ -31,7 +31,10 @@ class ManoLayer(nn.Module):
         coefficients [B, n<=45] (+ optional global_rot [B, 3]); ``"6d"``
         the continuous rotation representation [B, 16, 6] (the standard
         regression target for neural pose estimators — continuous, no
-        wrap); ``"rotmat"`` rotation matrices [B, 16, 3, 3]; ``"quat"``
+        wrap; COLUMN convention — pytorch3d-trained regressors emit the
+        ROW convention and decode here to transposed rotations, see
+        ``ops.matrix_from_6d``); ``"rotmat"`` rotation matrices
+        [B, 16, 3, 3]; ``"quat"``
         quaternions [B, 16, 4] (scalar-first w,x,y,z; normalized
         internally — mocap interchange).
       use_pca: legacy alias for ``pose_format="pca"``.

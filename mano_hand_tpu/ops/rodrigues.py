@@ -65,6 +65,12 @@ def matrix_from_6d(x: jnp.ndarray, eps: float = 1e-12) -> jnp.ndarray:
     third their cross product. Continuous and surjective onto SO(3) — the
     standard parameterization for gradient-based rotation estimation (no
     axis-angle 2*pi wrap, no quaternion double cover).
+
+    CONVENTION: the 6 numbers are the first two COLUMNS of R (the paper's
+    formulation). pytorch3d's ``rotation_6d_to_matrix`` uses the first two
+    ROWS instead — a pytorch3d-trained regressor's 6D output decodes here
+    to R^T (the inverse rotation). Port such outputs with
+    ``matrix_to_6d(pytorch3d_matrix)`` or transpose before re-encoding.
     """
     a1, a2 = x[..., 0:3], x[..., 3:6]
     n1 = jnp.sqrt(jnp.sum(a1 * a1, axis=-1, keepdims=True) + eps)
@@ -81,6 +87,8 @@ def matrix_to_6d(rot: jnp.ndarray) -> jnp.ndarray:
 
     Inverse of ``matrix_from_6d`` on SO(3): the first two COLUMNS,
     flattened. ``matrix_from_6d(matrix_to_6d(R)) == R`` for orthonormal R.
+    (Column convention — differs from pytorch3d's row convention; see
+    ``matrix_from_6d``.)
     """
     return jnp.concatenate([rot[..., :, 0], rot[..., :, 1]], axis=-1)
 

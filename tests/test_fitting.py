@@ -643,3 +643,26 @@ def test_warm_start_wrong_shape_rejected(params32):
     with pytest.raises(ValueError, match="init\\['pose'\\] shape"):
         fit(params32, target, n_steps=2,
             init={"pose": np.zeros((3, 16), np.float32)})
+
+
+def test_batched_warm_start_unbatched_seed_rejected(params32):
+    # A single-problem seed against batched targets must raise the
+    # descriptive up-front error, not a raw vmap axis-size failure —
+    # including when the seed's own leading dim happens to equal B.
+    targets = jnp.zeros((3, 778, 3), jnp.float32)
+    with pytest.raises(ValueError, match="one seed per problem"):
+        fit(params32, targets, n_steps=2,
+            init={"pose": np.zeros((16, 3), np.float32)})
+    targets16 = jnp.zeros((16, 778, 3), jnp.float32)
+    with pytest.raises(ValueError, match="one seed per problem"):
+        fit(params32, targets16, n_steps=2,
+            init={"pose": np.zeros((16, 3), np.float32)})
+
+
+def test_batched_warm_start_unknown_key_rejected(params32):
+    # A typo'd key with an unbatched seed must hit the descriptive
+    # unknown-key error, not a vmap axis mismatch.
+    targets = jnp.zeros((3, 778, 3), jnp.float32)
+    with pytest.raises(ValueError, match="init keys"):
+        fit(params32, targets, n_steps=2,
+            init={"poze": np.zeros((16, 3), np.float32)})

@@ -105,6 +105,15 @@ def test_lm_rejects_bad_data_term(params32):
                data_term="keypoints2d")
 
 
+def test_lm_rejects_unbatched_init_for_batched_targets(params32):
+    # A single-problem seed against [B, V, 3] targets must fail with a
+    # descriptive error, not a raw vmap axis-size error.
+    targets = jnp.zeros((3, 778, 3), jnp.float32)
+    with pytest.raises(ValueError, match="one seed per problem"):
+        fit_lm(params32, targets, n_steps=2,
+               init={"pose": jnp.zeros((16, 3), jnp.float32)})
+
+
 def test_cli_lm_joints(tmp_path, capsys, params32):
     from mano_hand_tpu import cli
 
