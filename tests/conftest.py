@@ -16,6 +16,11 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# The persistent compilation cache below re-loads AOT results compiled on
+# this same machine; XLA's loader still error-logs a harmless mismatch on
+# the "prefer-no-scatter/gather" PSEUDO-features (not real ISA bits) for
+# every hit. Silence the C++ log noise — Python-level failures still raise.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
 # Make the repo root importable regardless of pytest invocation directory.
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -23,6 +28,21 @@ if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
 import jax  # noqa: E402
+
+# Persistent XLA compilation cache: the suite is compile-bound (hundreds of
+# distinct jitted programs, one CPU core on this box), and the programs are
+# deterministic run to run — so the gate pays full compilation only on a
+# cold cache. Repo-local dir (gitignored) so `git clean`/fresh clones start
+# cold; VERDICT r2 item 5 records cold vs warm wall times in the Makefile.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(_ROOT, ".jax_compile_cache"),
+)
+# Cache EVERYTHING: the suite's long tail is hundreds of sub-second
+# compiles (the default 1s threshold would skip them all and leave ~5 of
+# the 10 cold minutes on the table).
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 # A site hook on this image (an accelerator-tunnel plugin) re-sets
 # jax_platforms to "<plugin>,cpu" at interpreter startup, overriding the env
