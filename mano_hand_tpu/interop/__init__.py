@@ -10,13 +10,17 @@ vice versa.
 """
 
 from mano_hand_tpu.interop.torch_bridge import (
+    TorchManoLayer,
     forward_from_torch,
+    make_torch_layer,
     params_from_torch,
     to_torch,
 )
 
 __all__ = [
+    "TorchManoLayer",
     "forward_from_torch",
+    "make_torch_layer",
     "params_from_torch",
     "to_torch",
     "ManoLayer",

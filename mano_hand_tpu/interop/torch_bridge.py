@@ -1,10 +1,15 @@
 """PyTorch bridge: run the TPU forward from/to torch tensors.
 
 For users migrating from torch MANO stacks (manopth, smplx): keep their
-torch data pipeline, swap the model evaluation. Conversion goes through
-NumPy (zero-copy for CPU torch tensors via ``.numpy()`` /
-``torch.from_numpy``); gradients do NOT flow across the bridge — use the
-JAX core end-to-end (fitting/) when optimizing.
+torch data pipeline, swap the model evaluation. Two tiers:
+
+* ``forward_from_torch`` — inference: convert, evaluate, convert back.
+* ``TorchManoLayer`` / ``make_torch_layer`` — training: a
+  ``torch.autograd.Function`` wraps the JAX forward via ``jax.vjp``, so
+  pose/shape/trans gradients flow from a torch loss back into a torch
+  optimizer — a drop-in differentiable replacement for manopth/smplx
+  layers. Tensor hand-off is zero-copy where the runtimes allow it
+  (DLPack for CPU torch -> JAX; NumPy views for JAX -> torch).
 """
 
 from __future__ import annotations
@@ -150,6 +155,141 @@ def params_from_torch(
         parents=parents,
         side=side,
     ))
+
+
+def _torch_to_jax(t):
+    """Detached CPU torch tensor -> JAX array, zero-copy via DLPack when
+    the runtimes allow it (contiguous CPU tensors), NumPy otherwise."""
+    import jax.numpy as jnp
+
+    torch = _torch()
+    if isinstance(t, torch.Tensor):
+        t = t.detach()
+        if t.device.type == "cpu":
+            t = t.contiguous()
+            try:
+                return jnp.from_dlpack(t)
+            except Exception:
+                pass  # dtype/layout DLPack won't carry — NumPy fallback
+        return jnp.asarray(_to_np(t))
+    return jnp.asarray(np.asarray(t))
+
+
+def _jax_to_torch(x):
+    """JAX array -> owning CPU torch tensor (one device_get; the NumPy ->
+    torch step is a view, copied only when the buffer is read-only)."""
+    torch = _torch()
+    arr = np.asarray(x)
+    if not arr.flags.writeable:
+        arr = arr.copy()
+    return torch.from_numpy(arr)
+
+
+def make_torch_layer(params: ManoParams, pose2rot: bool = True):
+    """Differentiable torch -> JAX -> torch MANO layer (the training tier).
+
+    Returns ``layer(pose, shape=None, trans=None) -> (verts, joints)``
+    where all tensors are torch and **gradients flow**: the forward runs
+    the jitted JAX core, the backward runs one jitted ``jax.vjp`` pull
+    (forward recomputed inside the compiled program — cheaper than
+    holding JAX residuals hostage across the torch autograd boundary,
+    and both directions hit the jit cache after the first call).
+
+    Inputs may be unbatched ([16, 3] / [48]) or batched ([B, 16, 3] /
+    [B, 48]); with ``pose2rot=False`` pose is rotation matrices
+    ([B?, 16, 3, 3]), the smplx contract. ``trans`` is a global
+    translation added to verts and joints (the manopth/smplx layer DOF
+    the core model itself doesn't carry). Everything is float32.
+
+    The reference has no autodiff at all (/root/reference/mano_np.py);
+    this is parity with the torch MANO layers users migrate from.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    torch = _torch()
+    n_joints = params.j_regressor.shape[0]
+    n_shape = params.shape_basis.shape[-1]
+
+    def _core_fwd(pose, shape, trans):
+        if pose2rot:
+            out = core.forward_batched(params, pose, shape)
+        else:
+            out = core.forward_batched_rotmats(params, pose, shape)
+        return (out.verts + trans[:, None, :],
+                out.posed_joints + trans[:, None, :])
+
+    fwd_jit = jax.jit(_core_fwd)
+
+    def _core_bwd(pose, shape, trans, g_verts, g_joints):
+        _, vjp_fn = jax.vjp(_core_fwd, pose, shape, trans)
+        return vjp_fn((g_verts, g_joints))
+
+    bwd_jit = jax.jit(_core_bwd)
+
+    class _ManoFunction(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, pose_t, shape_t, trans_t):
+            ctx.save_for_backward(pose_t, shape_t, trans_t)
+            verts, joints = fwd_jit(
+                _torch_to_jax(pose_t), _torch_to_jax(shape_t),
+                _torch_to_jax(trans_t),
+            )
+            return _jax_to_torch(verts), _jax_to_torch(joints)
+
+        @staticmethod
+        def backward(ctx, g_verts, g_joints):
+            pose_t, shape_t, trans_t = ctx.saved_tensors
+            gp, gs, gt = bwd_jit(
+                _torch_to_jax(pose_t), _torch_to_jax(shape_t),
+                _torch_to_jax(trans_t),
+                _torch_to_jax(g_verts), _torch_to_jax(g_joints),
+            )
+            return (_jax_to_torch(gp), _jax_to_torch(gs),
+                    _jax_to_torch(gt))
+
+    row = (n_joints, 3, 3) if not pose2rot else (n_joints, 3)
+
+    def layer(pose, shape=None, trans=None):
+        pose = torch.as_tensor(pose).float()
+        if pose2rot:
+            batched = pose.dim() == 3 or (
+                pose.dim() == 2 and pose.shape[-1] != 3
+            )
+        else:
+            batched = pose.dim() == 4
+        lead = (pose.shape[0],) if batched else (1,)
+        # torch-side reshapes keep the autograd graph connected to the
+        # caller's tensors; the Function itself always sees batched input.
+        pose_b = pose.reshape(*lead, *row)
+        if shape is None:
+            shape_b = torch.zeros((*lead, n_shape))
+        else:
+            shape_b = torch.as_tensor(shape).float().reshape(*lead, n_shape)
+        if trans is None:
+            trans_b = torch.zeros((*lead, 3))
+        else:
+            trans_b = torch.as_tensor(trans).float().reshape(*lead, 3)
+        verts, joints = _ManoFunction.apply(pose_b, shape_b, trans_b)
+        if not batched:
+            return verts[0], joints[0]
+        return verts, joints
+
+    return layer
+
+
+def TorchManoLayer(params: ManoParams, pose2rot: bool = True):
+    """``torch.nn.Module`` wrapping ``make_torch_layer`` — registrable in
+    ``torch.nn.Sequential``/module trees like the manopth/smplx layers it
+    replaces. (A factory, not a class: torch imports stay lazy.)"""
+    torch = _torch()
+    layer_fn = make_torch_layer(params, pose2rot)
+
+    class _TorchManoModule(torch.nn.Module):
+        def forward(self, pose, shape=None, trans=None):
+            return layer_fn(pose, shape, trans)
+
+    return _TorchManoModule()
 
 
 def forward_from_torch(
