@@ -212,6 +212,11 @@ def cmd_fit(args) -> int:
         print("--trim only applies to --data-term points/point_to_plane",
               file=sys.stderr)
         return 2
+    if (args.robust_weights != "none"
+            and args.data_term not in ("points", "point_to_plane")):
+        print("--robust-weights only applies to --data-term "
+              "points/point_to_plane", file=sys.stderr)
+        return 2
     # Anything that is not LM's own parameterization (axis-angle) needs the
     # Adam solver — ONE definition, shared with the explicit-LM guard below,
     # so a future pose space fails safe instead of silently routing to LM.
@@ -230,6 +235,15 @@ def cmd_fit(args) -> int:
         # Mirror the library-level guard (solvers reject conf/camera
         # outside keypoints2d) instead of silently dropping the file.
         print("--conf only applies to --data-term keypoints2d",
+              file=sys.stderr)
+        return 2
+    if args.solver == "lm" and (args.pose_prior != "l2"
+                                or args.pose_prior_weight is not None):
+        # Either prior flag under LM is a contradiction, not a preference
+        # — silently dropping a requested regularization weight would
+        # return a different fit than the user asked for.
+        print("--pose-prior/--pose-prior-weight require --solver adam "
+              "(LM regularizes via its Tikhonov shape rows)",
               file=sys.stderr)
         return 2
     if args.solver == "lm":
@@ -266,6 +280,8 @@ def cmd_fit(args) -> int:
             lm_kw["init"] = init
         if args.trim:
             lm_kw["trim_fraction"] = args.trim
+        if args.robust_weights != "none":
+            lm_kw["robust_weights"] = args.robust_weights
         if needs_adam:
             # Only reachable with an EXPLICIT --solver lm (an unset solver
             # resolves to adam for these spaces): a contradiction, not a
@@ -279,6 +295,11 @@ def cmd_fit(args) -> int:
         if args.trim:
             print("--trim requires --solver lm (the Adam chamfer path "
                   "uses --robust huber instead)", file=sys.stderr)
+            return 2
+        if args.robust_weights != "none":
+            print("--robust-weights requires --solver lm (the Adam "
+                  "chamfer path uses --robust huber instead)",
+                  file=sys.stderr)
             return 2
         if args.data_term == "point_to_plane":
             # The Adam path has no normal-distance residual; the GN
@@ -332,11 +353,25 @@ def cmd_fit(args) -> int:
                 target_conf=conf,
                 fit_trans=True,
                 n_pca=15,
-                pose_prior_weight=1e-4,
             )
         # One decision point for the effective pose space: the user's
         # explicit choice, else pca for depth-blind 2D data, else aa.
         pose_space = args.pose_space or ("pca" if kp2d else "aa")
+        if args.pose_prior == "mahalanobis" and pose_space == "6d":
+            print("--pose-prior mahalanobis needs axis-angle statistics: "
+                  "use --pose-space aa or pca", file=sys.stderr)
+            return 2
+        # Default pose-prior weight: the 2D term is depth-blind and always
+        # needs one; elsewhere the data-driven prior defaults on gently
+        # when selected, and the isotropic prior stays off.
+        pose_prior_weight = args.pose_prior_weight
+        if pose_prior_weight is None:
+            if args.data_term == "keypoints2d":
+                pose_prior_weight = 1e-4
+            elif args.pose_prior == "mahalanobis":
+                pose_prior_weight = 1e-3
+            else:
+                pose_prior_weight = 0.0
         init = None
         if args.init:
             if pose_space != "aa":
@@ -355,6 +390,8 @@ def cmd_fit(args) -> int:
             data_term=args.data_term,
             shape_prior_weight=shape_prior,
             pose_space=pose_space,
+            pose_prior=args.pose_prior,
+            pose_prior_weight=pose_prior_weight,
             robust=args.robust, robust_scale=args.robust_scale,
             init=init,
             **kp2d,
@@ -464,6 +501,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "outlier points). Adam only")
     f.add_argument("--robust-scale", type=float, default=0.01,
                    help="Huber scale in data units (meters for 3D terms)")
+    f.add_argument("--robust-weights", default="none",
+                   choices=["none", "tukey", "geman"],
+                   help="soft IRLS reweighting of ICP points by their "
+                        "per-step distances (LM solver, points/"
+                        "point_to_plane) — the graded-noise counterpart "
+                        "of --trim's hard cut; they compose")
     f.add_argument("--trim", type=float, default=0.0,
                    help="trimmed-ICP fraction in [0, 1): reject this "
                         "fraction of the worst-matching scan points each "
@@ -477,6 +520,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "(keypoints2d only)")
     f.add_argument("--focal", type=float, default=2.2,
                    help="pinhole focal in NDC units (keypoints2d only)")
+    f.add_argument("--pose-prior", default="l2",
+                   choices=["l2", "mahalanobis"],
+                   help="pose regularizer: isotropic L2 toward zero, or "
+                        "the data-driven Mahalanobis energy toward the "
+                        "asset's mean pose in PCA-whitened space "
+                        "(adam solver, aa/pca pose spaces)")
+    f.add_argument("--pose-prior-weight", type=float, default=None,
+                   help="pose prior weight (default: 1e-4 for "
+                        "keypoints2d, 1e-3 for --pose-prior mahalanobis, "
+                        "else 0)")
     f.add_argument("--shape-prior", type=float, default=None,
                    help="shape regularizer. adam: L2 prior weight (default "
                         "0 for verts, 1e-3 for joints/keypoints2d). lm "

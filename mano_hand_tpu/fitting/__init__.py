@@ -3,7 +3,9 @@ from mano_hand_tpu.fitting.objectives import (
     joint_l2,
     keypoint2d_l2,
     l2_prior,
+    mahalanobis_pose_prior,
     max_vertex_error,
+    pose_component_variances,
     vertex_l2,
 )
 from mano_hand_tpu.fitting.solvers import (
@@ -14,6 +16,11 @@ from mano_hand_tpu.fitting.solvers import (
     fit_with_optimizer,
 )
 from mano_hand_tpu.fitting.lm import LMResult, fit_lm
+from mano_hand_tpu.fitting.tracking import (
+    TrackState,
+    make_tracker,
+    track_clip,
+)
 
 __all__ = [
     "FitResult",
@@ -23,10 +30,15 @@ __all__ = [
     "fit_with_optimizer",
     "LMResult",
     "fit_lm",
+    "TrackState",
+    "make_tracker",
+    "track_clip",
     "vertex_l2",
     "joint_l2",
     "keypoint2d_l2",
     "huber",
     "l2_prior",
+    "mahalanobis_pose_prior",
+    "pose_component_variances",
     "max_vertex_error",
 ]

@@ -132,3 +132,61 @@ def huber(sq_dist: jnp.ndarray, delta: float) -> jnp.ndarray:
 def l2_prior(x: jnp.ndarray) -> jnp.ndarray:
     """Quadratic prior toward zero (pose/shape regularizer)."""
     return jnp.mean(x ** 2)
+
+
+def mahalanobis_pose_prior(
+    params,
+    fingers_flat: jnp.ndarray,        # [..., 3*(J-1)] articulated axis-angle
+    component_vars: jnp.ndarray = None,  # [C] per-component variances
+    precision=DEFAULT_PRECISION,
+) -> jnp.ndarray:
+    """Data-driven pose prior: squared deviation from the anatomical mean
+    pose, measured in PCA-whitened component space.
+
+    The asset's ``pca_basis``/``pca_mean`` encode the pose distribution the
+    model was built from (the reference regularizes implicitly by
+    truncating to few PCA dims, /root/reference/mano_np.py:67-68); this
+    makes that knowledge an explicit Mahalanobis energy:
+
+        z = (theta_fingers - pca_mean) @ pinv(pca_basis);  mean(z^2 / var)
+
+    Unlike ``l2_prior`` it (a) pulls toward the MEAN pose, not the zero
+    pose (a flat, non-anatomical hand), and (b) with ``component_vars``
+    (estimated from real poses via ``pose_component_variances``) charges
+    deviation along rare directions more than along common ones. The
+    global rotation row is deliberately NOT part of the energy — where the
+    hand points is not anatomically constrained. Scalar output (mean over
+    all leading axes too, matching ``l2_prior``'s reduction contract).
+    """
+    basis = jnp.asarray(params.pca_basis, fingers_flat.dtype)
+    mean = jnp.asarray(params.pca_mean, fingers_flat.dtype)
+    # pinv is [45, C]-tiny, batch-invariant, and hoisted by XLA out of
+    # vmapped/scanned programs; for orthonormal bases it equals basis.T.
+    pinv = jnp.linalg.pinv(basis)
+    z = jnp.einsum("...f,fc->...c", fingers_flat - mean, pinv,
+                   precision=precision)
+    if component_vars is not None:
+        z = z / jnp.sqrt(jnp.asarray(component_vars, z.dtype))
+    return jnp.mean(z ** 2)
+
+
+def pose_component_variances(params, poses) -> jnp.ndarray:
+    """Per-component variances of a pose corpus in PCA component space.
+
+    ``poses`` is [N, 16, 3] full axis-angle (global row dropped),
+    [N, 15, 3] articulated, or [N, 45] flat — e.g. the scan poses the
+    official assets ship (``assets.scans.decode_scan_poses``). Feed the
+    result to ``mahalanobis_pose_prior`` / ``fit(pose_prior_vars=...)``.
+    A small floor keeps near-degenerate components from exploding the
+    whitened energy.
+    """
+    poses = jnp.asarray(poses)
+    n_pca = jnp.asarray(params.pca_mean).shape[-1]
+    if poses.ndim == 3 and poses.shape[-2] * 3 == n_pca + 3:
+        poses = poses[..., 1:, :]  # drop the global-rotation row
+    flat = poses.reshape(poses.shape[0], n_pca)
+    pinv = jnp.linalg.pinv(jnp.asarray(params.pca_basis, flat.dtype))
+    z = jnp.einsum("nf,fc->nc", flat - jnp.asarray(params.pca_mean,
+                                                   flat.dtype), pinv,
+                   precision=DEFAULT_PRECISION)
+    return jnp.maximum(jnp.var(z, axis=0), 1e-6)

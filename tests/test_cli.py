@@ -339,3 +339,50 @@ def test_fit_subcommand_rejects_misused_or_bad_kp2d_flags(tmp_path, capsys):
                    "--conf", str(tmp_path / "badconf.npy"), "--steps", "2"])
     assert rc == 2
     assert "conf" in capsys.readouterr().err
+
+
+def test_fit_subcommand_pose_prior(tmp_path, capsys):
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+
+    p32 = synthetic_params(seed=0).astype(np.float32)
+    rng = np.random.default_rng(5)
+    pose = rng.normal(scale=0.2, size=(16, 3)).astype(np.float32)
+    joints = np.asarray(core.jit_forward(
+        p32, jnp.asarray(pose), jnp.zeros(10, jnp.float32)
+    ).posed_joints)
+    np.save(tmp_path / "j.npy", joints)
+    out = tmp_path / "fit_prior.npz"
+    rc = cli.main([
+        "fit", str(tmp_path / "j.npy"), "--data-term", "joints",
+        "--pose-prior", "mahalanobis", "--steps", "60",
+        "--out", str(out),
+    ])
+    assert rc == 0
+    assert "fit (adam, 60 steps)" in capsys.readouterr().out
+    assert np.load(out)["pose"].shape == (16, 3)
+
+    # LM has no Adam-style pose prior: contradiction, exit 2.
+    rc = cli.main([
+        "fit", str(tmp_path / "j.npy"), "--data-term", "joints",
+        "--solver", "lm", "--pose-prior", "mahalanobis",
+    ])
+    assert rc == 2
+    assert "require --solver adam" in capsys.readouterr().err
+
+    # An explicit weight under LM is equally silently-droppable: refuse.
+    rc = cli.main([
+        "fit", str(tmp_path / "j.npy"), "--data-term", "joints",
+        "--solver", "lm", "--pose-prior-weight", "0.01",
+    ])
+    assert rc == 2
+    assert "require --solver adam" in capsys.readouterr().err
+
+    # mahalanobis + 6d: the prior needs axis-angle statistics.
+    rc = cli.main([
+        "fit", str(tmp_path / "j.npy"), "--data-term", "joints",
+        "--pose-space", "6d", "--pose-prior", "mahalanobis",
+    ])
+    assert rc == 2
+    assert "aa or pca" in capsys.readouterr().err

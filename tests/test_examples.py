@@ -31,6 +31,7 @@ def test_example_runs(script, tmp_path):
         "03_two_hands_video": ["--frames", "4", "--size", "48"],
         "04_keypoint2d_fitting": ["--steps", "150"],
         "05_sequence_tracking": ["--frames", "6", "--steps", "150"],
+        "08_streaming_tracking": ["--frames", "4", "--steps", "4"],
     }.get(script.stem, [])
     out = _run(script, *extra, tmp_path=tmp_path)
     assert any(k in out for k in ("wrote", "fit", "tracked", "fused kernel"))

