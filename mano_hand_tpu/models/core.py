@@ -286,6 +286,13 @@ PALLAS_BEST_BLOCK = (64, 896)
 # Same contract as PALLAS_BEST_BLOCK: bench sweep winners land here.
 FUSED_BEST_BLOCK_B = 128
 
+# Batch tile for the FULL-fusion kernel (Rodrigues + FK + blend + skin in
+# one launch, ops/pallas_forward.py:forward_verts_fused_full). The small
+# tile wins on v5e: measured 19.6M evals/s at 64 vs 11.8M at 128 at
+# launch 8192 (more grid steps, but each tile's nine [TB, J] skin dots
+# stay resident-friendly; 512 exceeds the 16M scoped-vmem limit).
+FUSED_FULL_BEST_BLOCK_B = 64
+
 
 def forward_batched_pallas(
     params: ManoParams,
@@ -359,6 +366,35 @@ def forward_batched_pallas_fused(
     )
 
 
+def forward_batched_pallas_fused_full(
+    params: ManoParams,
+    pose: jnp.ndarray,   # [B, J, 3]
+    shape: jnp.ndarray,  # [B, S]
+    precision=DEFAULT_PRECISION,
+    block_b: int = FUSED_FULL_BEST_BLOCK_B,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Batched forward with the WHOLE pipeline in one Pallas launch.
+
+    Rodrigues, shaped-joint regression, level-parallel FK, inverse-bind,
+    blendshapes and skinning all run per batch tile in VMEM
+    (ops/pallas_forward.py:forward_verts_fused_full) — no XLA pre-stage,
+    no r/t slab HBM round-trips. Inputs are just (pose, shape); returns
+    verts only. Differentiable via the shared hybrid VJP. Requires a
+    level-aligned kinematic tree (all MANO-family assets qualify).
+    """
+    from mano_hand_tpu.ops import pallas_forward
+
+    if pose.shape[0] == 0:
+        return jnp.zeros((0, params.v_template.shape[0], 3),
+                         params.v_template.dtype)
+    pose = pose.reshape(pose.shape[0], -1, 3)
+    # Positional call: custom_vjp functions reject keyword arguments.
+    return pallas_forward.forward_verts_fused_full_ad(
+        params, pose, shape, precision, block_b, interpret
+    )
+
+
 def stack_params(left: ManoParams, right: ManoParams) -> ManoParams:
     """Stack a (left, right) asset pair into one PyTree with [2, ...] leaves.
 
@@ -405,6 +441,7 @@ def forward_chunked(
     block_v: int = PALLAS_BEST_BLOCK[1],
     interpret: bool = False,
     use_pallas_fused: bool = False,
+    use_pallas_fused_full: bool = False,
 ) -> jnp.ndarray:
     """Memory-bounded huge-batch vertices via lax.map over chunks.
 
@@ -415,8 +452,9 @@ def forward_chunked(
     each chunk's skinning through the fused Pallas skinning kernel;
     ``use_pallas_fused`` routes the whole vertex path (blend + skin) through
     the fully-fused kernel (ops/pallas_forward.py), where ``block_b`` is its
-    batch tile. Block defaults are the bench sweep's winners
-    (docs/benchmarking.md).
+    batch tile; ``use_pallas_fused_full`` routes the ENTIRE forward
+    (Rodrigues + FK included) through the full-fusion kernel. Block
+    defaults are the bench sweep's winners (docs/benchmarking.md).
     """
     b = pose.shape[0]
     chunk_size = max(1, min(chunk_size, b))  # max(1,..) keeps B=0 legal
@@ -431,8 +469,14 @@ def forward_chunked(
     n_chunks = (b + pad) // chunk_size
     pose_c = pose.reshape(n_chunks, chunk_size, *pose.shape[1:])
     shape_c = shape.reshape(n_chunks, chunk_size, *shape.shape[1:])
-    if use_pallas_fused:
+    if use_pallas_fused_full:
         # Each kernel route defaults to ITS OWN swept tile, not the other's.
+        bb = FUSED_FULL_BEST_BLOCK_B if block_b is None else block_b
+        chunk_fn = lambda ps: forward_batched_pallas_fused_full(  # noqa: E731
+            params, ps[0], ps[1], precision,
+            block_b=min(bb, chunk_size), interpret=interpret,
+        )
+    elif use_pallas_fused:
         bb = FUSED_BEST_BLOCK_B if block_b is None else block_b
         chunk_fn = lambda ps: forward_batched_pallas_fused(  # noqa: E731
             params, ps[0], ps[1], precision,

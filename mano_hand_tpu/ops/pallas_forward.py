@@ -299,6 +299,383 @@ def forward_verts_fused(
     )
 
 
+# ------------------------------------------------- FULL fusion (pre-stage in)
+# The kernel above still receives its rotation/translation slabs from an
+# XLA pre-stage (Rodrigues + joint regression + FK), worth ~166us of the
+# ~770us per 8192-batch pass on v5e plus the r/t slab HBM round-trips
+# (docs/roadmap.md #1). The variant below moves the ENTIRE forward into
+# one kernel: inputs are just (pose, shape); Rodrigues, shaped-joint
+# regression, level-parallel FK, inverse-bind, blendshapes and skinning
+# all happen per batch tile without leaving VMEM.
+#
+# Layout key: joints ride the LANES in breadth-first level order
+# [root | level1 | level2 | level3] (fingers in a fixed order), so each
+# FK level composes against its parents as an ALIGNED elementwise
+# multiply of contiguous lane slices — no gathers, no scatters, just
+# slice + concat. The per-(a,b) rotation components live in nine separate
+# [TB, J] slabs (VPU-friendly); the blend coefficient vector is
+# concatenated in-register in (ab-major, level-ordered-joint) layout,
+# with the basis rows permuted to match at operand-prep time.
+# Reference semantics fused: /root/reference/mano_np.py:79-115 complete.
+
+
+@functools.lru_cache(maxsize=None)
+def level_layout(parents: tuple):
+    """Static layout for lane-ordered FK: ``(perm, levels)``.
+
+    ``perm`` lists original joint indices in [root, level1, level2, ...]
+    order; ``levels`` holds ``(start, size, parent_start, parent_size)``
+    lane ranges into the permuted order (parent_size == 1 broadcasts the
+    shared parent; == size pairs one-to-one). Raises for trees where a
+    level's parents are not exactly the previous level (or one shared
+    joint) in order — callers fall back to the XLA-pre-stage kernel.
+    """
+    from mano_hand_tpu.ops import fk
+
+    levels_orig = fk.tree_levels(tuple(parents))
+    perm = [0]
+    prev = [0]
+    prev_start = 0
+    out_levels = []
+    for lv in levels_orig:
+        order = sorted(lv, key=lambda j: (prev.index(parents[j]), j))
+        par_pos = [prev.index(parents[j]) for j in order]
+        start = len(perm)
+        if len(set(par_pos)) == 1:
+            pinfo = (prev_start + par_pos[0], 1)
+        elif par_pos == list(range(len(prev))) and len(order) == len(prev):
+            pinfo = (prev_start, len(prev))
+        else:
+            raise ValueError(
+                "kinematic tree is not level-aligned (parents of a level "
+                "must be one shared joint or exactly the previous level "
+                "in order); use the XLA-pre-stage fused kernel instead"
+            )
+        out_levels.append((start, len(order), *pinfo))
+        perm.extend(order)
+        prev = order
+        prev_start = start
+    return tuple(perm), tuple(out_levels)
+
+
+def fused_full_operands(params: ManoParams, precision=DEFAULT_PRECISION):
+    """Batch-invariant operands for the fully-fused kernel.
+
+    Returns ``(basis2 [Kp2, 3*VP], wt2 [J, VP], jb [3][Sp, J])`` where all
+    joint axes are in ``level_layout`` order and the basis rows follow the
+    in-kernel coefficient layout ``[shape(S) | template | zero-pad to Sp |
+    pose rows (ab-major, permuted joints) | pad]``. ``jb[a]`` maps the
+    augmented shape vector [beta | 1 | 0...] to joint coordinate ``a``
+    (template row included — the same augmentation trick as the vertex
+    basis).
+    """
+    f32 = jnp.float32
+    perm, _ = level_layout(tuple(params.parents))
+    perm = list(perm)
+    v, _, s = params.shape_basis.shape
+    j = params.j_regressor.shape[0]
+    p = params.pose_basis.shape[-1]
+    sp = _cdiv(s + 1, SUBLANE) * SUBLANE
+    k2 = sp + p
+    kp2 = _cdiv(k2, SUBLANE) * SUBLANE
+    vp = _cdiv(v, LANE) * LANE
+
+    shape_basis = jnp.asarray(params.shape_basis, f32)   # [V, 3, S]
+    pose_basis = jnp.asarray(params.pose_basis, f32)     # [V, 3, P]
+    v_template = jnp.asarray(params.v_template, f32)     # [V, 3]
+
+    # Rows [K2, 3, V] in coefficient order.
+    rows = [shape_basis.transpose(2, 1, 0)]              # [S, 3, V]
+    rows.append(v_template.T[None])                      # template at S
+    if sp - (s + 1):
+        rows.append(jnp.zeros((sp - (s + 1), 3, v), f32))
+    # Pose rows: ab-major, joints in perm order (root excluded). Original
+    # column for joint jj, entry (a, b) is (jj-1)*9 + 3a + b (the
+    # reference's joint-major row-major ravel, mano_np.py:87-91).
+    pb = pose_basis.transpose(2, 1, 0)                   # [P, 3, V]
+    order = [
+        (perm[pos] - 1) * 9 + 3 * a + b
+        for a in range(3) for b in range(3)
+        for pos in range(1, j)
+    ]
+    rows.append(pb[jnp.asarray(order, jnp.int32)])
+    basis = jnp.concatenate(rows, axis=0)                # [K2, 3, V]
+    basis2 = jnp.pad(
+        basis, [(0, kp2 - k2), (0, 0), (0, vp - v)]
+    ).reshape(kp2, 3 * vp)
+
+    wt2 = jnp.pad(
+        jnp.asarray(params.lbs_weights, f32).T[jnp.asarray(perm)],
+        [(0, 0), (0, vp - v)],
+    )                                                    # [J, VP]
+
+    joint_template, joint_shape_basis = joint_operands(params, precision)
+    jt = joint_template[jnp.asarray(perm)]               # [J, 3]
+    jsb = joint_shape_basis[jnp.asarray(perm)]           # [J, 3, S]
+    jb = []
+    for a in range(3):
+        rows_a = jnp.concatenate(
+            [jsb[:, a, :].T, jt[None, :, a],
+             jnp.zeros((sp - (s + 1), j), f32)], axis=0
+        )                                                # [Sp, J]
+        jb.append(rows_a)
+    return basis2, wt2, tuple(jb)
+
+
+def _rodrigues_slabs(x, y, z):
+    """Per-joint rotation components from axis-angle slabs [TB, J].
+
+    Same guarded math as ops.rodrigues.rotation_matrix (value-identical;
+    the hybrid VJP never differentiates through the kernel, so only value
+    continuity matters here): R = (1 - b t2) I + a K + b rr^T.
+    """
+    t2 = x * x + y * y + z * z
+    small = t2 < 1e-8
+    theta = jnp.sqrt(jnp.where(small, 1.0, t2))
+    a = jnp.where(small, 1.0 - t2 / 6.0 + t2 * t2 / 120.0,
+                  jnp.sin(theta) / theta)
+    b = jnp.where(small, 0.5 - t2 / 24.0 + t2 * t2 / 720.0,
+                  (1.0 - jnp.cos(theta)) / (theta * theta))
+    diag = 1.0 - b * t2
+    return (
+        diag + b * x * x, b * x * y - a * z, b * x * z + a * y,
+        b * x * y + a * z, diag + b * y * y, b * y * z - a * x,
+        b * x * z - a * y, b * y * z + a * x, diag + b * z * z,
+    )
+
+
+def _fk_slabs(r_local, jx, jy, jz, levels):
+    """Level-parallel FK on lane slabs; returns (world_rot 9-tuple,
+    skin_t 3-tuple), each [TB, J] in permuted joint order.
+
+    Each level's compose is elementwise on contiguous, parent-aligned
+    lane slices (see level_layout) — concat accumulates the result, no
+    scatters. Equivalent to ops.fk.forward_kinematics +
+    skinning_transforms (mano_np.py:96-110 semantics).
+    """
+    jroot = [jx[:, 0:1], jy[:, 0:1], jz[:, 0:1]]
+    jslab = (jx, jy, jz)
+    parts_r = [[r[:, 0:1]] for r in r_local]   # 9 lists of lane chunks
+    parts_t = [[jroot[0]], [jroot[1]], [jroot[2]]]
+    prev_r = [r[:, 0:1] for r in r_local]
+    prev_t = jroot
+    prev_j = jroot
+    prev_start = 0
+    for (st, sz, pst, psz) in levels:
+        # Parent slab: the (pst, psz) lane range RELATIVE to the previous
+        # level's slabs — width sz (one-to-one) or 1 (shared parent,
+        # broadcasts; the shared joint may sit anywhere in the previous
+        # level, hence the explicit offset rather than the whole slab).
+        rel = pst - prev_start
+        pr = [r[:, rel:rel + psz] for r in prev_r]
+        pt = [t[:, rel:rel + psz] for t in prev_t]
+        pj = [c[:, rel:rel + psz] for c in prev_j]
+        rl = [r[:, st:st + sz] for r in r_local]
+        jl = [jslab[c][:, st:st + sz] for c in range(3)]
+        loc = [jl[c] - pj[c] for c in range(3)]
+        new_r = [
+            pr[3 * a + 0] * rl[0 + b]
+            + pr[3 * a + 1] * rl[3 + b]
+            + pr[3 * a + 2] * rl[6 + b]
+            for a in range(3) for b in range(3)
+        ]
+        new_t = [
+            pr[3 * a + 0] * loc[0]
+            + pr[3 * a + 1] * loc[1]
+            + pr[3 * a + 2] * loc[2]
+            + pt[a]
+            for a in range(3)
+        ]
+        for i in range(9):
+            parts_r[i].append(new_r[i])
+        for a in range(3):
+            parts_t[a].append(new_t[a])
+        prev_r, prev_t, prev_j = new_r, new_t, jl
+        prev_start = st
+    world_r = tuple(jnp.concatenate(ps, axis=1) for ps in parts_r)
+    world_t = [jnp.concatenate(ps, axis=1) for ps in parts_t]
+    # Inverse bind: skin_t = world_t - world_rot @ j_rest (fk.py:82-97).
+    skin_t = tuple(
+        world_t[a]
+        - (world_r[3 * a + 0] * jx + world_r[3 * a + 1] * jy
+           + world_r[3 * a + 2] * jz)
+        for a in range(3)
+    )
+    return world_r, skin_t
+
+
+def _fused_full_kernel(vp, levels, precision, split, *refs):
+    """One batch tile of the COMPLETE forward: pose/shape slabs in,
+    vertex coordinate planes out. ``split`` selects the pre-split-bf16
+    HIGH path for the resident operands (see _fused_kernel_split)."""
+    if split:
+        (basis_hi, basis_lo, wt_hi, wt_lo, jbx, jby, jbz,
+         shape_ref, px, py, pz) = refs[:11]
+        out = refs[11:14]
+    else:
+        (basis_ref, wt_ref, jbx, jby, jbz,
+         shape_ref, px, py, pz) = refs[:9]
+        out = refs[9:12]
+
+    shape_aug = shape_ref[:]                              # [TB, Sp]
+    x, y, z = px[:], py[:], pz[:]                         # [TB, J]
+    r_local = _rodrigues_slabs(x, y, z)
+
+    # Shaped joints: [TB, Sp] x [Sp, J] per coordinate (tiny MXU dots).
+    jx = kernel_dot(shape_aug, jbx[:], precision)
+    jy = kernel_dot(shape_aug, jby[:], precision)
+    jz = kernel_dot(shape_aug, jbz[:], precision)
+
+    world_r, skin_t = _fk_slabs(r_local, jx, jy, jz, levels)
+
+    # Blend coefficients in-register: [shape_aug | (R_local - I) deltas
+    # ab-major over non-root joints | pad] matching fused_full_operands'
+    # basis row order.
+    deltas = [
+        r_local[3 * a + b][:, 1:] - (1.0 if a == b else 0.0)
+        for a in range(3) for b in range(3)
+    ]
+    coeff = jnp.concatenate([shape_aug, *deltas], axis=1)
+    kp2 = (basis_hi if split else basis_ref).shape[0]
+    pad = kp2 - coeff.shape[1]
+    if pad:
+        coeff = jnp.concatenate(
+            [coeff, jnp.zeros((coeff.shape[0], pad), coeff.dtype)], axis=1
+        )
+
+    if split:
+        c_hi, c_lo = _split_hi_lo(coeff)
+        vp_flat = _dot3(c_hi, c_lo, basis_hi[:], basis_lo[:])
+        w_hi, w_lo = wt_hi[:], wt_lo[:]
+        for a in range(3):
+            t_hi, t_lo = _split_hi_lo(skin_t[a])
+            acc = _dot3(t_hi, t_lo, w_hi, w_lo)
+            for c in range(3):
+                r_hi, r_lo = _split_hi_lo(world_r[3 * a + c])
+                m_ac = _dot3(r_hi, r_lo, w_hi, w_lo)
+                acc = acc + m_ac * vp_flat[:, c * vp:(c + 1) * vp]
+            out[a][:] = acc
+    else:
+        vp_flat = kernel_dot(coeff, basis_ref[:], precision)
+        wt = wt_ref[:]
+        for a in range(3):
+            acc = kernel_dot(skin_t[a], wt, precision)
+            for c in range(3):
+                m_ac = kernel_dot(world_r[3 * a + c], wt, precision)
+                acc = acc + m_ac * vp_flat[:, c * vp:(c + 1) * vp]
+            out[a][:] = acc
+
+
+def forward_verts_fused_full(
+    params: ManoParams,
+    pose: jnp.ndarray,   # [B, J, 3] axis-angle (row 0 global)
+    shape: jnp.ndarray,  # [B, S]
+    precision=DEFAULT_PRECISION,
+    block_b: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Batched vertices [B, V, 3] with the WHOLE forward in one kernel.
+
+    Per-eval HBM input traffic is pose (48 f32 = 192 B) + shape
+    (10 f32 = 40 B); the r/t slabs and blend coefficients of the split
+    pipeline never exist in HBM. Requires a level-aligned kinematic tree (all MANO-family
+    assets); ``level_layout`` raises otherwise.
+    """
+    f32 = jnp.float32
+    v = params.v_template.shape[0]
+    j = params.j_regressor.shape[0]
+    s = params.shape_basis.shape[-1]
+    if pose.shape[0] == 0:
+        return jnp.zeros((0, v, 3), f32)
+    perm, levels = level_layout(tuple(params.parents))
+    basis2, wt2, jb = fused_full_operands(params, precision)
+
+    b = pose.shape[0]
+    pose_p = pose.reshape(b, j, 3).astype(f32)[:, jnp.asarray(perm), :]
+    sp = jb[0].shape[0]
+    shape_aug = jnp.concatenate(
+        [shape.astype(f32), jnp.ones((b, 1), f32),
+         jnp.zeros((b, sp - s - 1), f32)], axis=1
+    )                                                    # [B, Sp]
+
+    block_b = max(1, min(block_b, b))
+    bp = _cdiv(b, block_b) * block_b
+
+    def padb(xarr):
+        return jnp.pad(xarr, [(0, bp - b)] + [(0, 0)] * (xarr.ndim - 1))
+
+    shape_aug = padb(shape_aug)
+    slabs = [padb(pose_p[:, :, c]) for c in range(3)]    # 3 x [Bp, J]
+
+    kp2, lanes = basis2.shape
+    vp = lanes // 3
+    grid = (bp // block_b,)
+    const_basis = pl.BlockSpec((kp2, 3 * vp), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM)
+    const_wt = pl.BlockSpec((j, vp), lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+    const_jb = pl.BlockSpec((sp, j), lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+    spec_bs = pl.BlockSpec((block_b, sp), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+    spec_bj = pl.BlockSpec((block_b, j), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+    spec_bv = pl.BlockSpec((block_b, vp), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+
+    canon = (jax.lax.Precision(precision)
+             if precision is not None else precision)
+    split = canon == jax.lax.Precision.HIGH
+    if split:
+        basis_hi, basis_lo = split_hi_lo_xla(basis2)
+        wt_hi, wt_lo = split_hi_lo_xla(wt2)
+        operands = (basis_hi, basis_lo, wt_hi, wt_lo, *jb,
+                    shape_aug, *slabs)
+        in_specs = [const_basis, const_basis, const_wt, const_wt,
+                    const_jb, const_jb, const_jb, spec_bs,
+                    *([spec_bj] * 3)]
+    else:
+        operands = (basis2, wt2, *jb, shape_aug, *slabs)
+        in_specs = [const_basis, const_wt,
+                    const_jb, const_jb, const_jb, spec_bs,
+                    *([spec_bj] * 3)]
+    outs = pl.pallas_call(
+        functools.partial(_fused_full_kernel, vp, levels,
+                          precision, split),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[spec_bv] * 3,
+        out_shape=[jax.ShapeDtypeStruct((bp, vp), f32)] * 3,
+        interpret=interpret,
+    )(*operands)
+    return jnp.stack(outs, axis=-1)[:b, :v, :]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def forward_verts_fused_full_ad(
+    params, pose, shape,
+    precision=DEFAULT_PRECISION, block_b: int = 128, interpret: bool = False,
+):
+    """Differentiable fully-fused forward — same hybrid VJP as
+    ``forward_verts_fused_ad`` (the backward recomputes the tiny
+    pre-stage in XLA regardless of how the forward was fused, so the
+    cotangent math is shared verbatim)."""
+    return forward_verts_fused_full(
+        params, pose, shape, precision, block_b, interpret
+    )
+
+
+def _fwd_full(params, pose, shape, precision, block_b, interpret):
+    out = forward_verts_fused_full(
+        params, pose, shape, precision, block_b, interpret
+    )
+    return out, (params, pose, shape)
+
+
+# (defvjp wiring for the full variant is at the bottom of the file, after
+# the shared _bwd is defined.)
+
+
 # ---------------------------------------------------------------- custom VJP
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def forward_verts_fused_ad(
@@ -438,3 +815,4 @@ def _bwd(precision, block_b, interpret, residuals, g):
 
 
 forward_verts_fused_ad.defvjp(_fwd, _bwd)
+forward_verts_fused_full_ad.defvjp(_fwd_full, _bwd)

@@ -213,6 +213,7 @@ def pallas_forward_dp(
     mesh: Mesh,
     block_b: int | None = None,
     interpret: bool = False,
+    full: bool = False,
 ):
     """Data-parallel fused-kernel forward: each device runs the fully-fused
     Pallas kernel (ops/pallas_forward.py) on its local batch shard.
@@ -226,18 +227,26 @@ def pallas_forward_dp(
     device count must divide the global batch.
 
     ``interpret=True`` runs the kernel in the Pallas interpreter — how the
-    virtual CPU meshes in CI exercise this composition.
+    virtual CPU meshes in CI exercise this composition. ``full=True``
+    selects the FULL-fusion kernel (Rodrigues + FK in-kernel,
+    ops/pallas_forward.py:forward_verts_fused_full) per shard.
     """
     from mano_hand_tpu.models import core as _core
     from mano_hand_tpu.ops import pallas_forward
 
     params, true_v = _unwrap(params)
-    bb = _core.FUSED_BEST_BLOCK_B if block_b is None else block_b
+    if block_b is None:
+        bb = (_core.FUSED_FULL_BEST_BLOCK_B if full
+              else _core.FUSED_BEST_BLOCK_B)
+    else:
+        bb = block_b
+    kernel = (pallas_forward.forward_verts_fused_full if full
+              else pallas_forward.forward_verts_fused)
 
     def per_shard(prm, pose, shape):
         # Slice back to the asset's true vertex count: padded ShardedParams
         # must never leak padding rows into outputs (module invariant).
-        return pallas_forward.forward_verts_fused(
+        return kernel(
             prm, pose, shape, block_b=bb, interpret=interpret
         )[:, :true_v]
 

@@ -207,3 +207,108 @@ def test_jit_compiles(params32):
     want = core.forward_batched(params32, pose, beta).verts
     got = jax.block_until_ready(fn(pose, beta))
     assert np.abs(np.asarray(got) - np.asarray(want)).max() < TOL
+
+
+# ------------------------------------------------- full-fusion kernel
+def test_full_fusion_matches_forward_batched(params32):
+    pose, beta = _rand(6, seed=3)
+    want = core.forward_batched(params32, pose, beta).verts
+    got = pallas_forward.forward_verts_fused_full(
+        params32, pose, beta, block_b=4, interpret=True
+    )
+    assert got.shape == want.shape
+    assert np.abs(np.asarray(got) - np.asarray(want)).max() < TOL
+
+
+def test_full_fusion_ragged_flat_empty(params32):
+    pose, beta = _rand(5, seed=4)
+    want = core.forward_batched(params32, pose, beta).verts
+    got = core.forward_batched_pallas_fused_full(
+        params32, pose.reshape(5, 48), beta, block_b=4, interpret=True
+    )
+    assert np.abs(np.asarray(got) - np.asarray(want)).max() < TOL
+    empty = core.forward_batched_pallas_fused_full(
+        params32, jnp.zeros((0, 16, 3)), jnp.zeros((0, 10)), interpret=True
+    )
+    assert empty.shape == (0, 778, 3)
+
+
+def test_full_fusion_zero_pose_taylor_guard(params32):
+    # theta = 0 exercises the in-kernel Taylor branch of Rodrigues.
+    beta = jnp.asarray(
+        np.random.default_rng(5).normal(size=(3, 10)).astype(np.float32)
+    )
+    want = core.forward_batched(params32, jnp.zeros((3, 16, 3)), beta).verts
+    got = pallas_forward.forward_verts_fused_full(
+        params32, jnp.zeros((3, 16, 3)), beta, block_b=4, interpret=True
+    )
+    assert np.abs(np.asarray(got) - np.asarray(want)).max() < TOL
+
+
+def test_full_fusion_vjp_matches_xla_grad(params32):
+    pose, beta = _rand(4, seed=6)
+    w = jnp.asarray(
+        np.random.default_rng(7).normal(size=(4, 778, 3)).astype(np.float32)
+    )
+
+    def loss_full(po, sh):
+        v = core.forward_batched_pallas_fused_full(
+            params32, po, sh, block_b=4, interpret=True
+        )
+        return jnp.sum(v * w)
+
+    def loss_ref(po, sh):
+        return jnp.sum(core.forward_batched(params32, po, sh).verts * w)
+
+    gp, gs = jax.grad(loss_full, argnums=(0, 1))(pose, beta)
+    rp, rs = jax.grad(loss_ref, argnums=(0, 1))(pose, beta)
+    assert np.abs(np.asarray(gp) - np.asarray(rp)).max() < 1e-3
+    assert np.abs(np.asarray(gs) - np.asarray(rs)).max() < 1e-3
+
+
+def test_full_fusion_chunked_route(params32):
+    pose, beta = _rand(10, seed=8)
+    want = core.forward_batched(params32, pose, beta).verts
+    got = core.forward_chunked(
+        params32, pose, beta, chunk_size=4, use_pallas_fused_full=True,
+        block_b=4, interpret=True,
+    )
+    assert np.abs(np.asarray(got) - np.asarray(want)).max() < TOL
+
+
+def test_level_layout_mano_and_rejection():
+    from mano_hand_tpu.constants import MANO_PARENTS
+
+    perm, levels = pallas_forward.level_layout(tuple(MANO_PARENTS))
+    assert perm[0] == 0 and sorted(perm) == list(range(16))
+    assert [lv[1] for lv in levels] == [5, 5, 5]
+    # L1 shares the root parent (broadcast); deeper levels pair 1:1.
+    assert levels[0][3] == 1 and levels[1][3] == 5
+    # Two level-2 parents but three level-2 joints (1 has two children,
+    # 2 has one): neither one-shared-parent nor one-to-one — rejected.
+    with pytest.raises(ValueError, match="level-aligned"):
+        pallas_forward.level_layout((-1, 0, 0, 1, 2, 1))
+
+
+def test_full_fusion_shared_parent_inside_wide_level():
+    """A level whose single shared parent sits INSIDE a multi-joint
+    previous level (here: joints 3,4 both children of joint 1, while
+    level 1 is {1, 2}) must compose against that parent's lane — not
+    pair elementwise with the whole previous level."""
+    import dataclasses
+
+    from mano_hand_tpu.assets import synthetic_params
+
+    base = synthetic_params(seed=11, n_verts=97, n_joints=5, n_shape=4,
+                            n_faces=60)
+    p32 = dataclasses.replace(base, parents=(-1, 0, 0, 1, 1)).astype(
+        np.float32
+    )
+    rng = np.random.default_rng(12)
+    pose = jnp.asarray(rng.normal(scale=0.5, size=(3, 5, 3)), jnp.float32)
+    beta = jnp.asarray(rng.normal(size=(3, 4)), jnp.float32)
+    want = core.forward_batched(p32, pose, beta).verts
+    got = pallas_forward.forward_verts_fused_full(
+        p32, pose, beta, block_b=2, interpret=True
+    )
+    assert np.abs(np.asarray(got) - np.asarray(want)).max() < TOL

@@ -129,6 +129,19 @@ def test_pallas_forward_dp_parity(params32, mesh):
     np.testing.assert_allclose(np.asarray(verts), np.asarray(want), atol=1e-4)
 
 
+def test_pallas_forward_dp_full_fusion_parity(params32, mesh):
+    """The FULL-fusion kernel (Rodrigues + FK in-kernel) also composes
+    under shard_map data parallelism."""
+    pose, beta = rand_batch(5, 8)
+    fwd = shd.pallas_forward_dp(params32, mesh, block_b=2, interpret=True,
+                                full=True)
+    verts = fwd(pose, beta)
+    assert verts.shape == (8, 778, 3)
+    want = core.forward_batched(params32, pose, beta).verts
+    np.testing.assert_allclose(np.asarray(verts), np.asarray(want),
+                               atol=1e-4)
+
+
 def test_pallas_forward_dp_slices_padded_params(params32):
     """Padded ShardedParams (model=4 pads V to 780) must not leak padding
     rows through the kernel path."""
