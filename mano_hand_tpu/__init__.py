@@ -21,6 +21,7 @@ from mano_hand_tpu.models import (
     forward_batched,
     forward_chunked,
     forward_pca,
+    keypoints,
 )
 from mano_hand_tpu.models.layer import MANOModel
 

@@ -23,3 +23,34 @@ MANO_PARENTS = (-1, 0, 1, 2, 0, 4, 5, 0, 7, 8, 0, 10, 11, 0, 13, 14)
 
 LEFT = "left"
 RIGHT = "right"
+
+# ---------------------------------------------------------------- keypoints
+# The MANO skeleton regresses 16 joints (no fingertips — the tips are mesh
+# surface, not skeleton). Hand-pose datasets and detectors (FreiHAND,
+# HO-3D, InterHand2.6M, OpenPose/MediaPipe) use a 21-keypoint set: the 16
+# joints plus one fingertip per finger, taken as fixed vertices of the
+# official 778-vertex mesh. The reference never needs this (it has no
+# fitting, /root/reference/mano_np.py), but any fitting framework does.
+#
+# Two vertex-id conventions circulate in the torch ecosystem; both are
+# provided so targets produced against either stack plug in directly.
+# Order within each tuple: (thumb, index, middle, ring, pinky).
+TIP_VERTEX_IDS = {
+    "smplx": (744, 320, 443, 554, 671),    # smplx VertexJointSelector
+    "manopth": (745, 317, 444, 556, 673),  # manopth ManoLayer tips
+}
+
+# MANO's 16 joints are ordered wrist, index(3), middle(3), pinky(3),
+# ring(3), thumb(3) — the kinematic-tree order of MANO_PARENTS above. With
+# the 5 tips appended (thumb..pinky, indices 16..20), this permutation
+# re-orders the 21 keypoints into the OpenPose/FreiHAND convention
+# (wrist, thumb CMC->tip, index MCP->tip, middle, ring, pinky):
+# openpose[i] = mano21[MANO21_TO_OPENPOSE[i]].
+MANO21_TO_OPENPOSE = (
+    0,
+    13, 14, 15, 16,   # thumb chain + tip
+    1, 2, 3, 17,      # index
+    4, 5, 6, 18,      # middle
+    10, 11, 12, 19,   # ring
+    7, 8, 9, 20,      # pinky
+)

@@ -56,6 +56,8 @@ def _fit_single(
     trim_fraction: float = 0.0,
     robust_weights: str = "none",
     robust_scale: Optional[float] = None,
+    tips=None,
+    keypoint_order: str = "mano",
 ) -> LMResult:
     dtype = params.v_template.dtype
     n_joints = params.j_regressor.shape[0]
@@ -111,7 +113,10 @@ def _fit_single(
             d = out.verts[idx] - target_verts.reshape(-1, 3)
             res = jnp.sum(d * normals, axis=-1) * w
             return jnp.concatenate([res, shape_weight * p["shape"]])
-        pred = out.verts if data_term == "verts" else out.posed_joints
+        pred = (
+            out.verts if data_term == "verts"
+            else core.keypoints(out, tips, keypoint_order)
+        )
         res = pred.reshape(-1) - target
         # Tikhonov rows keep beta near 0 when vertices underdetermine it.
         # Always present (zero rows when the traced weight is 0, which is
@@ -207,10 +212,12 @@ def _fit_single(
     )
 
 
+@solvers.normalize_tips_kwarg
 @functools.partial(
     jax.jit,
     static_argnames=("n_steps", "data_term", "trim_fraction",
-                     "robust_weights", "robust_scale"),
+                     "robust_weights", "robust_scale", "tip_vertex_ids",
+                     "keypoint_order"),
 )
 def fit_lm(
     params: ManoParams,
@@ -226,6 +233,8 @@ def fit_lm(
     trim_fraction: float = 0.0,
     robust_weights: str = "none",
     robust_scale: Optional[float] = None,
+    tip_vertex_ids=None,         # None | "smplx" | "manopth" | vertex ids
+    keypoint_order: str = "mano",  # "mano" | "openpose"
 ) -> LMResult:
     """Recover (pose, shape) by damped Gauss-Newton; batch via vmap.
 
@@ -233,7 +242,13 @@ def fit_lm(
     hundreds — the preferred solver when targets are clean meshes.
     ``data_term="joints"`` fits 16 posed joints instead (a [48+S]-row
     residual — even cheaper per step); 16 joints underdetermine shape,
-    so pair it with a nonzero ``shape_weight``. ``data_term="points"``
+    so pair it with a nonzero ``shape_weight``. ``tip_vertex_ids``
+    extends the joints term with fingertip vertex picks (the standard
+    21-keypoint set — ``"smplx"``/``"manopth"`` conventions or explicit
+    ids; ``keypoint_order="openpose"`` for OpenPose/FreiHAND-ordered
+    targets): tips observe the distal phalanx rotations that the 16
+    skeleton joints miss entirely, so 21-point LM recovers full finger
+    articulation where 16-point LM cannot. ``data_term="points"``
     is true point-to-point ICP: per step, nearest-vertex correspondences
     are re-assigned and a GN solve runs on the frozen assignment —
     registration to an unstructured [N, 3] scan in ~10 steps; warm-start
@@ -267,6 +282,12 @@ def fit_lm(
     target_verts = jnp.asarray(target_verts, params.v_template.dtype)
     if data_term in _ICP_TERMS and target_verts.shape[-2] == 0:
         raise ValueError("points target cloud is empty ([..., 0, 3])")
+    # "joints" is the only keypoint term here (2D/projective energies are
+    # the first-order solvers' job); verts/ICP terms reject tip specs.
+    tips, _ = solvers.check_keypoint_spec(
+        params, data_term, tip_vertex_ids, keypoint_order, target_verts,
+        "fit_lm",
+    )
     # trim_fraction is static (a config knob), so these validate concretely.
     # jnp.quantile would silently CLAMP an out-of-range fraction — e.g. 1.0
     # keeps only the single nearest point and returns a garbage fit with a
@@ -304,6 +325,8 @@ def fit_lm(
         trim_fraction=trim_fraction,
         robust_weights=robust_weights,
         robust_scale=robust_scale,
+        tips=tips,
+        keypoint_order=keypoint_order,
     )
     if target_verts.ndim == 2:
         return single(target_verts, init=init)

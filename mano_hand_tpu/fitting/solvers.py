@@ -196,8 +196,75 @@ def _check_data_term(data_term: str, camera, conf) -> None:
         )
 
 
+# Data terms whose rows are skeleton keypoints (the terms the fingertip
+# extension applies to — 'verts'/'points' address mesh vertices directly).
+KEYPOINT_TERMS = ("joints", "keypoints2d")
+
+
+def normalize_tips_kwarg(fn):
+    """Resolve ``tip_vertex_ids`` to a hashable tuple BEFORE the jit boundary.
+
+    The jitted solvers declare ``tip_vertex_ids`` static; without this a
+    documented-as-valid list/array spec would die at the jit boundary as
+    'unhashable type' instead of reaching ``resolve_tip_ids``'s
+    normalization and named errors. Applies only to keyword passing —
+    which is how every internal call site and example passes it.
+    """
+    @functools.wraps(fn)
+    def wrapper(params, *args, tip_vertex_ids=None, **kw):
+        tip_vertex_ids = core.resolve_tip_ids(
+            tip_vertex_ids, params.v_template.shape[0]
+        )
+        return fn(params, *args, tip_vertex_ids=tip_vertex_ids, **kw)
+
+    return wrapper
+
+
+def check_keypoint_spec(params, data_term, tip_vertex_ids, keypoint_order,
+                        target, fn_name):
+    """Shared tip/order validation + target row check for every solver.
+
+    Returns ``(tips, n_kp)``: the resolved tip tuple (or None) and the
+    keypoint count the spec yields — THE one definition of that count, so
+    the conf-length checks can't drift from the target-row check. Target
+    row counts are static shapes, so a 21-row target with no tip spec (or
+    vice versa) fails HERE with the fix spelled out instead of as a
+    broadcast error mid-trace.
+    """
+    if keypoint_order not in ("mano", "openpose"):
+        raise ValueError(
+            f"keypoint_order must be 'mano' or 'openpose', "
+            f"got {keypoint_order!r}"
+        )
+    if data_term not in KEYPOINT_TERMS:
+        if tip_vertex_ids is not None or keypoint_order != "mano":
+            raise ValueError(
+                "tip_vertex_ids/keypoint_order only apply to the keypoint "
+                f"data terms {KEYPOINT_TERMS}, got data_term={data_term!r}"
+            )
+        return None, params.j_regressor.shape[0]
+    tips = core.resolve_tip_ids(tip_vertex_ids, params.v_template.shape[0])
+    n_kp = params.j_regressor.shape[0] + (len(tips) if tips else 0)
+    if keypoint_order == "openpose" and n_kp != 21:
+        raise ValueError(
+            "keypoint_order='openpose' is the 21-keypoint convention "
+            f"(16 joints + 5 tips); this spec yields {n_kp} keypoints"
+        )
+    if target.shape[-2] != n_kp:
+        n_joints = params.j_regressor.shape[0]
+        raise ValueError(
+            f"{fn_name}: target has {target.shape[-2]} keypoint rows but "
+            f"the model produces {n_kp} ({n_joints} joints"
+            f"{f' + {len(tips)} tips' if tips else ''}); pass "
+            "tip_vertex_ids='smplx'|'manopth' (or explicit vertex ids) "
+            "for 21-keypoint targets"
+        )
+    return tips, n_kp
+
+
 def _data_loss(out, offset, target, data_term: str, camera, conf,
-               robust: str = "none", robust_scale: float = 0.01):
+               robust: str = "none", robust_scale: float = 0.01,
+               tips=None, keypoint_order: str = "mano"):
     """The one data-term dispatch shared by every Adam solver.
 
     - ``verts``: full-mesh L2 (known correspondence).
@@ -236,9 +303,13 @@ def _data_loss(out, offset, target, data_term: str, camera, conf,
         return objectives.vertex_l2(out.verts + offset, target, penalty)
     if data_term == "points":
         return objectives.point_cloud_l2(out.verts + offset, target, penalty)
+    # Keypoint terms: the 16 skeleton joints, optionally extended with
+    # fingertip vertex picks (tips resolved/validated by
+    # check_keypoint_spec) and re-ordered to the target's convention.
+    kp = core.keypoints(out, tips, keypoint_order)
     if data_term == "joints":
-        return objectives.joint_l2(out.posed_joints + offset, target, penalty)
-    xy = camera.project(out.posed_joints + offset)[..., :2]
+        return objectives.joint_l2(kp + offset, target, penalty)
+    xy = camera.project(kp + offset)[..., :2]
     return jnp.mean(objectives.keypoint2d_l2(xy, target, conf, penalty))
 
 
@@ -284,6 +355,8 @@ def _fit_single(
     init: Optional[dict] = None,
     pose_prior: str = "l2",
     pose_prior_vars: Optional[jnp.ndarray] = None,
+    tips=None,
+    keypoint_order: str = "mano",
 ) -> FitResult:
     _check_data_term(data_term, camera, conf)
     _check_pose_prior(pose_prior, pose_space)
@@ -335,7 +408,7 @@ def _fit_single(
         out = model_out(p)
         offset = p["trans"] if fit_trans else 0.0
         data = _data_loss(out, offset, target, data_term, camera, conf,
-                          robust, robust_scale)
+                          robust, robust_scale, tips, keypoint_order)
         # Prior weights may be traced scalars (see fit): plain multiplies.
         reg = (
             _pose_reg(pose_space, pose_prior, pose_prior_vars, params, p,
@@ -357,10 +430,12 @@ def _fit_single(
     )
 
 
+@normalize_tips_kwarg
 @functools.partial(
     jax.jit,
     static_argnames=("n_steps", "pose_space", "n_pca", "data_term",
-                     "fit_trans", "robust", "robust_scale", "pose_prior"),
+                     "fit_trans", "robust", "robust_scale", "pose_prior",
+                     "tip_vertex_ids", "keypoint_order"),
 )
 def fit(
     params: ManoParams,
@@ -381,6 +456,8 @@ def fit(
     init: Optional[dict] = None,
     pose_prior: str = "l2",
     pose_prior_vars: Optional[jnp.ndarray] = None,  # [C] component vars
+    tip_vertex_ids=None,         # None | "smplx" | "manopth" | vertex ids
+    keypoint_order: str = "mano",  # "mano" | "openpose" (21-kp targets)
 ) -> FitResult:
     """Recover pose/shape for one target mesh or a batch of them.
 
@@ -402,6 +479,14 @@ def fit(
     ``objectives.pose_component_variances`` over scan poses). The priors
     carry ill-posed fits — sparse joints, 2D keypoints, partial clouds —
     toward anatomically plausible poses instead of the flat zero pose.
+
+    ``tip_vertex_ids`` extends the keypoint data terms with fingertip
+    vertex picks — the 21-keypoint convention every major hand dataset
+    and detector uses (MANO's skeleton has no tips). Pass ``"smplx"`` or
+    ``"manopth"`` for the two circulating vertex-id conventions on the
+    official mesh, or explicit vertex ids; ``keypoint_order="openpose"``
+    matches OpenPose/FreiHAND-ordered targets. Fingertips pin the distal
+    phalanx orientations that 16 joints leave entirely unobserved.
     """
     return fit_with_optimizer(
         params, target_verts, optax.adam(lr),
@@ -411,6 +496,7 @@ def fit(
         data_term=data_term, camera=camera, target_conf=target_conf,
         fit_trans=fit_trans, robust=robust, robust_scale=robust_scale,
         init=init, pose_prior=pose_prior, pose_prior_vars=pose_prior_vars,
+        tip_vertex_ids=tip_vertex_ids, keypoint_order=keypoint_order,
     )
 
 
@@ -432,7 +518,15 @@ def fit_with_optimizer(
     init: Optional[dict] = None,
     pose_prior: str = "l2",
     pose_prior_vars: Optional[jnp.ndarray] = None,
+    tip_vertex_ids=None,
+    keypoint_order: str = "mano",
 ) -> FitResult:
+    _check_data_term(data_term, camera, target_conf)
+    target_verts = jnp.asarray(target_verts, params.v_template.dtype)
+    tips, n_kp = check_keypoint_spec(
+        params, data_term, tip_vertex_ids, keypoint_order, target_verts,
+        "fit",
+    )
     single = functools.partial(
         _fit_single,
         params,
@@ -449,15 +543,22 @@ def fit_with_optimizer(
         robust_scale=robust_scale,
         pose_prior=pose_prior,
         pose_prior_vars=pose_prior_vars,
+        tips=tips,
+        keypoint_order=keypoint_order,
     )
-    _check_data_term(data_term, camera, target_conf)
-    target_verts = jnp.asarray(target_verts, params.v_template.dtype)
     if data_term == "points" and target_verts.shape[-2] == 0:
         # A zero-point cloud (empty depth-scan foreground) would mean() over
         # an empty axis -> NaN in every parameter, silently.
         raise ValueError("points target cloud is empty ([..., 0, 3])")
     if target_conf is not None:
         target_conf = jnp.asarray(target_conf, params.v_template.dtype)
+        if target_conf.shape[-1] != n_kp:
+            # e.g. a stale 16-entry confidence vector with a 21-keypoint
+            # fit — fail here, not as a broadcast error mid-trace.
+            raise ValueError(
+                f"target_conf has {target_conf.shape[-1]} entries but this "
+                f"keypoint spec yields {n_kp} keypoints"
+            )
     if target_verts.ndim == 2:
         return single(target_verts, target_conf, init=init)
     # Batched problems: map conf per-problem when it is [B, J]; a shared
@@ -489,10 +590,12 @@ class SequenceFitResult(NamedTuple):
     trans: Optional[jnp.ndarray] = None  # [T, 3] when fit_trans=True
 
 
+@normalize_tips_kwarg
 @functools.partial(
     jax.jit,
     static_argnames=("n_steps", "data_term", "fit_trans", "robust",
-                     "robust_scale", "pose_space", "pose_prior"),
+                     "robust_scale", "pose_space", "pose_prior",
+                     "tip_vertex_ids", "keypoint_order"),
 )
 def fit_sequence(
     params: ManoParams,
@@ -512,6 +615,8 @@ def fit_sequence(
     pose_space: str = "aa",
     pose_prior: str = "l2",
     pose_prior_vars: Optional[jnp.ndarray] = None,
+    tip_vertex_ids=None,
+    keypoint_order: str = "mano",
 ) -> SequenceFitResult:
     """Track a whole motion clip as ONE optimization problem.
 
@@ -548,13 +653,21 @@ def fit_sequence(
         )
     if data_term == "points" and targets.shape[-2] == 0:
         raise ValueError("points target cloud is empty ([T, 0, 3])")
+    tips, n_kp = check_keypoint_spec(
+        params, data_term, tip_vertex_ids, keypoint_order, targets,
+        "fit_sequence",
+    )
     t_frames = targets.shape[0]
     n_joints = params.j_regressor.shape[0]
     n_shape = params.shape_basis.shape[-1]
     if target_conf is not None:
-        target_conf = jnp.broadcast_to(
-            jnp.asarray(target_conf, dtype), (t_frames, n_joints)
-        )
+        target_conf = jnp.asarray(target_conf, dtype)
+        if target_conf.shape[-1] != n_kp:
+            raise ValueError(
+                f"target_conf has {target_conf.shape[-1]} entries but this "
+                f"keypoint spec yields {n_kp} keypoints"
+            )
+        target_conf = jnp.broadcast_to(target_conf, (t_frames, n_kp))
 
     theta0 = _pose_init(pose_space, (t_frames,), n_joints, n_pca=0,
                         dtype=dtype, allowed={"aa", "6d"})
@@ -577,7 +690,8 @@ def fit_sequence(
             else jnp.zeros((), dtype)
         )
         data = _data_loss(out, offset, targets, data_term, camera,
-                          target_conf, robust, robust_scale)
+                          target_conf, robust, robust_scale, tips,
+                          keypoint_order)
         # t_frames is static: skip velocity terms for single-frame clips
         # (mean over an empty array is NaN and would poison every grad).
         # Velocity couples whichever representation is being optimized —

@@ -10,6 +10,7 @@ from mano_hand_tpu.models.core import (
     fused_blend_bases,
     jit_forward,
     jit_forward_batched,
+    keypoints,
     stack_params,
 )
 from mano_hand_tpu.models import oracle
@@ -27,5 +28,6 @@ __all__ = [
     "fused_blend_bases",
     "jit_forward",
     "jit_forward_batched",
+    "keypoints",
     "oracle",
 ]

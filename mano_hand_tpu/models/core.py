@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from mano_hand_tpu.assets.schema import ManoParams
-from mano_hand_tpu import ops
+from mano_hand_tpu import constants, ops
 from mano_hand_tpu.ops.common import DEFAULT_PRECISION
 
 
@@ -273,6 +273,71 @@ def forward_batched(
     return jax.vmap(
         lambda p, s: fwd(params, p, s, precision)
     )(pose, shape)
+
+
+# ------------------------------------------------------------- keypoints
+def resolve_tip_ids(tip_vertex_ids, n_verts: int):
+    """Normalize a fingertip-vertex spec to a tuple of valid vertex ids.
+
+    ``tip_vertex_ids`` is ``None`` (no tips — the bare 16 skeleton
+    joints), a convention name from ``constants.TIP_VERTEX_IDS``
+    (``"smplx"`` | ``"manopth"``, vertex ids on the official 778-vertex
+    mesh), or an explicit sequence of vertex indices (any length — e.g.
+    custom markers on a personalized mesh).
+    """
+    if tip_vertex_ids is None:
+        return None
+    if isinstance(tip_vertex_ids, str):
+        try:
+            tip_vertex_ids = constants.TIP_VERTEX_IDS[tip_vertex_ids]
+        except KeyError:
+            raise ValueError(
+                f"unknown tip convention {tip_vertex_ids!r}; known: "
+                f"{sorted(constants.TIP_VERTEX_IDS)} (or pass explicit "
+                "vertex ids)"
+            ) from None
+    ids = tuple(int(i) for i in tip_vertex_ids)
+    if not ids:
+        return None  # () means the same as None: the bare skeleton
+    bad = [i for i in ids if not 0 <= i < n_verts]
+    if bad:
+        raise ValueError(
+            f"tip vertex ids {bad} out of range for a {n_verts}-vertex mesh"
+        )
+    return ids
+
+
+def keypoints(
+    out: ManoOutput,
+    tip_vertex_ids=None,
+    order: str = "mano",
+) -> jnp.ndarray:
+    """Keypoints [..., 16(+T), 3]: posed joints + fingertip vertex picks.
+
+    MANO's skeleton has no fingertips (the reference exposes only the 16
+    FK joints, /root/reference/mano_np.py:83,96-104); datasets and
+    detectors use 21 keypoints with tips taken as mesh vertices. With the
+    standard 5 tips, ``order="openpose"`` re-orders into the
+    OpenPose/FreiHAND convention (``constants.MANO21_TO_OPENPOSE``);
+    ``order="mano"`` keeps [16 joints | tips as given]. Works on batched
+    outputs (leading axes broadcast).
+    """
+    if order not in ("mano", "openpose"):
+        raise ValueError(f"order must be 'mano' or 'openpose', got {order!r}")
+    tips = resolve_tip_ids(tip_vertex_ids, out.verts.shape[-2])
+    kp = out.posed_joints
+    if tips is not None:
+        kp = jnp.concatenate(
+            [kp, out.verts[..., jnp.array(tips), :]], axis=-2
+        )
+    if order == "openpose":
+        if kp.shape[-2] != len(constants.MANO21_TO_OPENPOSE):
+            raise ValueError(
+                "order='openpose' needs the 21-keypoint set (16 joints + "
+                f"5 tips), got {kp.shape[-2]} keypoints"
+            )
+        kp = kp[..., jnp.array(constants.MANO21_TO_OPENPOSE), :]
+    return kp
 
 
 # The bench block-size sweep's winning tile for the fused skinning kernel

@@ -1,0 +1,299 @@
+"""Extended (21-point) keypoints: fingertip vertex picks + dataset ordering.
+
+MANO's skeleton regresses 16 joints with no fingertips (the reference
+exposes only the FK joints, /root/reference/mano_np.py:83,96-104); hand
+datasets and detectors use 21 keypoints with tips taken as mesh vertices.
+These tests pin the selection/ordering math and — the load-bearing claim —
+that fingertips make the distal (leaf) joint rotations observable to the
+keypoint data terms, which 16 joints provably cannot see.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mano_hand_tpu import constants
+from mano_hand_tpu.fitting import fit, fit_lm, fit_sequence
+from mano_hand_tpu.models import core
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+# Joints whose rotation moves NO skeleton joint position: the chain leaves
+# (fingertips of the kinematic tree). FK translations only compose parent
+# rotations, so a leaf's own rotation reaches the mesh (via skinning and
+# the pose corrective) but never posed_joints.
+LEAF_JOINTS = [
+    j for j in range(constants.N_JOINTS)
+    if j not in constants.MANO_PARENTS
+]
+
+
+def _pose(seed, scale=0.3):
+    rng = np.random.default_rng(seed)
+    return rng.normal(scale=scale, size=(16, 3)).astype(np.float32)
+
+
+# ------------------------------------------------------------ selection
+def test_keypoints_shapes_and_tip_selection(params32):
+    out = core.forward(params32, jnp.asarray(_pose(0)), jnp.zeros((10,)))
+    kp16 = core.keypoints(out)
+    np.testing.assert_array_equal(np.asarray(kp16),
+                                  np.asarray(out.posed_joints))
+    for conv in ("smplx", "manopth"):
+        kp21 = core.keypoints(out, conv)
+        assert kp21.shape == (21, 3)
+        tips = constants.TIP_VERTEX_IDS[conv]
+        np.testing.assert_array_equal(
+            np.asarray(kp21)[16:], np.asarray(out.verts)[list(tips)]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(kp21)[:16], np.asarray(out.posed_joints)
+        )
+    # Explicit ids of any length work (custom marker sets).
+    kp18 = core.keypoints(out, (0, 5, 777))
+    assert kp18.shape == (19, 3)
+    np.testing.assert_array_equal(np.asarray(kp18)[16:],
+                                  np.asarray(out.verts)[[0, 5, 777]])
+
+
+def test_keypoints_batched(params32):
+    rng = np.random.default_rng(1)
+    pose = jnp.asarray(rng.normal(scale=0.3, size=(5, 16, 3)), jnp.float32)
+    beta = jnp.asarray(rng.normal(size=(5, 10)), jnp.float32)
+    outs = core.forward_batched(params32, pose, beta)
+    kp = core.keypoints(outs, "smplx", order="openpose")
+    assert kp.shape == (5, 21, 3)
+    # Per-element equals the single-call path (pure selection, no cross-
+    # batch coupling).
+    out0 = core.forward(params32, pose[0], beta[0])
+    np.testing.assert_allclose(
+        np.asarray(kp[0]),
+        np.asarray(core.keypoints(out0, "smplx", order="openpose")),
+        atol=1e-6,
+    )
+
+
+def test_openpose_permutation_is_consistent():
+    perm = np.array(constants.MANO21_TO_OPENPOSE)
+    assert sorted(perm.tolist()) == list(range(21))  # bijection
+    assert perm[0] == 0                              # wrist stays first
+    # Every finger chain is 3 MANO joints followed by its appended tip
+    # (tips live at indices 16..20 in thumb..pinky order).
+    chains = perm[1:].reshape(5, 4)
+    for chain in chains:
+        assert chain[3] >= 16                        # chain ends at a tip
+        assert (np.diff(chain[:3]) == 1).all()       # MANO chains are runs
+    # Thumb comes first in OpenPose order; MANO stores it last (13-15).
+    assert chains[0].tolist() == [13, 14, 15, 16]
+
+
+def test_keypoints_validations(params32):
+    out = core.forward(params32, jnp.zeros((16, 3)), jnp.zeros((10,)))
+    with pytest.raises(ValueError, match="unknown tip convention"):
+        core.keypoints(out, "nonsense")
+    with pytest.raises(ValueError, match="out of range"):
+        core.keypoints(out, (778,))
+    with pytest.raises(ValueError, match="21-keypoint"):
+        core.keypoints(out, None, order="openpose")
+    with pytest.raises(ValueError, match="order must be"):
+        core.keypoints(out, "smplx", order="freihand")
+
+
+# ---------------------------------------------------------- observability
+def test_leaf_rotations_invisible_to_16_joints_visible_to_21(params32):
+    """The reason tips exist: a leaf joint's rotation moves zero skeleton
+    joints (exact FK invariance), so the 16-point data term has
+    identically zero gradient there — while the 21-point term sees the
+    tip vertices move."""
+    target16 = core.forward(
+        params32, jnp.asarray(_pose(2)), jnp.zeros((10,))
+    ).posed_joints
+
+    def loss16(pose):
+        out = core.forward(params32, pose, jnp.zeros((10,)))
+        return jnp.sum((core.keypoints(out) - target16) ** 2)
+
+    def loss21(pose):
+        out = core.forward(params32, pose, jnp.zeros((10,)))
+        kp = core.keypoints(out, "smplx")
+        return jnp.sum(kp[16:] ** 2)  # any tip-dependent functional
+
+    g16 = np.asarray(jax.grad(loss16)(jnp.asarray(_pose(3))))
+    g21 = np.asarray(jax.grad(loss21)(jnp.asarray(_pose(3))))
+    for j in LEAF_JOINTS:
+        np.testing.assert_allclose(g16[j], 0.0, atol=1e-12)
+        assert np.abs(g21[j]).max() > 1e-6
+
+
+# ---------------------------------------------------------------- fitting
+def _target21(params32, seed, order="mano", batch=None):
+    dims = (batch,) if batch else ()
+    rng = np.random.default_rng(seed)
+    pose = rng.normal(scale=0.3, size=(*dims, 16, 3)).astype(np.float32)
+    beta = rng.normal(scale=0.5, size=(*dims, 10)).astype(np.float32)
+    fwd = core.forward_batched if batch else core.forward
+    out = fwd(params32, jnp.asarray(pose), jnp.asarray(beta))
+    return pose, beta, core.keypoints(out, "smplx", order=order)
+
+
+def test_fit_lm_21_keypoints(params32):
+    pose, beta, target = _target21(params32, seed=4, order="openpose")
+    res = fit_lm(params32, target, n_steps=60, data_term="joints",
+                 shape_weight=1e-3, tip_vertex_ids="smplx",
+                 keypoint_order="openpose")
+    out = core.forward(params32, res.pose, res.shape)
+    kp = core.keypoints(out, "smplx", order="openpose")
+    err = float(jnp.abs(kp - target).max())
+    # 63 data rows over 58 params: barely overdetermined, so the claim is
+    # "reproduces the observations", not exact pose recovery.
+    assert err < 2e-3
+
+
+def test_fit_adam_21_keypoints_batched(params32):
+    _, _, targets = _target21(params32, seed=5, batch=3)
+    res = fit(params32, targets, n_steps=300, lr=0.05, data_term="joints",
+              tip_vertex_ids="smplx", shape_prior_weight=1e-3)
+    assert res.pose.shape == (3, 16, 3)
+    outs = core.forward_batched(params32, res.pose, res.shape)
+    kp = core.keypoints(outs, "smplx")
+    err = float(jnp.abs(kp - targets).max())
+    assert err < 5e-3
+    assert float(jnp.mean(res.loss_history[:, 0])) > \
+        100 * float(jnp.mean(res.final_loss))
+
+
+def test_fit_2d_21_keypoints(params32):
+    from mano_hand_tpu.viz.camera import default_hand_camera
+
+    camera = default_hand_camera()
+    rng = np.random.default_rng(6)
+    pose = rng.normal(scale=0.25, size=(16, 3)).astype(np.float32)
+    out = core.forward(params32, jnp.asarray(pose), jnp.zeros((10,)))
+    kp = core.keypoints(out, "manopth", order="openpose")
+    target_xy = camera.project(kp)[..., :2]
+    # Per-point confidences now carry 21 entries.
+    conf = np.ones((21,), np.float32)
+
+    res = fit(params32, target_xy, n_steps=300, lr=0.02,
+              data_term="keypoints2d", camera=camera, target_conf=conf,
+              tip_vertex_ids="manopth", keypoint_order="openpose",
+              pose_prior_weight=1e-4, shape_prior_weight=1e-3)
+    out2 = core.forward(params32, res.pose, res.shape)
+    xy = camera.project(
+        core.keypoints(out2, "manopth", order="openpose")
+    )[..., :2]
+    reproj = float(np.max(np.linalg.norm(
+        np.asarray(xy) - np.asarray(target_xy), axis=-1
+    )))
+    assert reproj < 5e-3
+
+
+def test_fit_sequence_21_keypoints(params32):
+    t_frames = 4
+    rng = np.random.default_rng(7)
+    base = rng.normal(scale=0.2, size=(16, 3)).astype(np.float32)
+    drift = rng.normal(scale=0.02, size=(t_frames, 16, 3)).astype(np.float32)
+    poses = jnp.asarray(base + np.cumsum(drift, axis=0))
+    outs = core.forward_batched(
+        params32, poses, jnp.zeros((t_frames, 10), jnp.float32)
+    )
+    targets = core.keypoints(outs, "smplx")
+    res = fit_sequence(params32, targets, n_steps=250, lr=0.03,
+                       data_term="joints", tip_vertex_ids="smplx")
+    outs2 = core.forward_batched(
+        params32, res.pose,
+        jnp.broadcast_to(res.shape, (t_frames, 10))
+    )
+    kp = core.keypoints(outs2, "smplx")
+    err = float(jnp.abs(kp - targets).max())
+    assert err < 5e-3
+
+
+def test_solver_validations(params32):
+    _, _, target = _target21(params32, seed=8)
+    # 21-row target without a tip spec: named error, not a broadcast crash.
+    with pytest.raises(ValueError, match="tip_vertex_ids"):
+        fit_lm(params32, target, data_term="joints")
+    # Tip spec on a mesh data term is meaningless.
+    verts_target = core.forward(
+        params32, jnp.zeros((16, 3)), jnp.zeros((10,))
+    ).verts
+    with pytest.raises(ValueError, match="keypoint data terms"):
+        fit(params32, verts_target, data_term="verts",
+            tip_vertex_ids="smplx")
+    with pytest.raises(ValueError, match="keypoint data terms"):
+        fit_lm(params32, verts_target, data_term="verts",
+               tip_vertex_ids="smplx")
+    # openpose ordering without the 5 tips is not a convention.
+    target16 = core.forward(
+        params32, jnp.zeros((16, 3)), jnp.zeros((10,))
+    ).posed_joints
+    with pytest.raises(ValueError, match="21-keypoint"):
+        fit(params32, target16, data_term="joints",
+            keypoint_order="openpose")
+    with pytest.raises(ValueError, match="keypoint_order must be"):
+        fit(params32, target, data_term="joints", tip_vertex_ids="smplx",
+            keypoint_order="freihand")
+
+
+def test_tip_spec_accepts_lists_and_arrays(params32):
+    """The jitted solvers declare tip_vertex_ids static; the wrapper must
+    normalize unhashable sequences before the jit boundary."""
+    _, _, target = _target21(params32, seed=10)
+    ids = list(constants.TIP_VERTEX_IDS["smplx"])
+    res = fit_lm(params32, target, n_steps=5, data_term="joints",
+                 tip_vertex_ids=ids)
+    assert res.pose.shape == (16, 3)
+    res = fit(params32, target, n_steps=5, data_term="joints",
+              tip_vertex_ids=np.array(ids))
+    assert res.pose.shape == (16, 3)
+
+
+def test_empty_tip_tuple_means_no_tips(params32):
+    out = core.forward(params32, jnp.zeros((16, 3)), jnp.zeros((10,)))
+    np.testing.assert_array_equal(
+        np.asarray(core.keypoints(out, ())),
+        np.asarray(core.keypoints(out, None)),
+    )
+    target16 = out.posed_joints
+    res = fit(params32, target16, n_steps=5, data_term="joints",
+              tip_vertex_ids=())
+    assert res.pose.shape == (16, 3)
+
+
+def test_conf_length_checked_against_extended_keypoints(params32):
+    from mano_hand_tpu.viz.camera import default_hand_camera
+
+    camera = default_hand_camera()
+    out = core.forward(params32, jnp.zeros((16, 3)), jnp.zeros((10,)))
+    target_xy = camera.project(core.keypoints(out, "smplx"))[..., :2]
+    with pytest.raises(ValueError, match="target_conf has 16"):
+        fit(params32, target_xy, n_steps=5, data_term="keypoints2d",
+            camera=camera, tip_vertex_ids="smplx",
+            target_conf=np.ones((16,), np.float32))
+    # Same named error on the sequence path (not a raw broadcast crash).
+    with pytest.raises(ValueError, match="target_conf has 16"):
+        fit_sequence(params32, jnp.broadcast_to(target_xy, (3, 21, 2)),
+                     n_steps=5, data_term="keypoints2d", camera=camera,
+                     tip_vertex_ids="smplx",
+                     target_conf=np.ones((16,), np.float32))
+
+
+def test_tracker_passes_tips_through(params32):
+    """The streaming tracker forwards tip specs via **solver_kw."""
+    from mano_hand_tpu.fitting import make_tracker
+
+    _, _, target = _target21(params32, seed=9)
+    state, step = make_tracker(
+        params32, n_steps=15, solver="lm", data_term="joints",
+        shape_weight=1e-2, tip_vertex_ids="smplx",
+    )
+    state, res = step(state, target)
+    out = core.forward(params32, res.pose, res.shape)
+    kp = core.keypoints(out, "smplx")
+    assert float(jnp.abs(kp - target).max()) < 5e-3
