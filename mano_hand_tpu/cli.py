@@ -404,6 +404,28 @@ def cmd_fit(args) -> int:
     return 0
 
 
+def cmd_export_aot(args) -> int:
+    """Serialize the compiled forward as a self-contained serving artifact."""
+    from mano_hand_tpu.io.export_aot import save_forward
+
+    params = _load_params(args.asset, args.side)
+    params = params.astype(np.float32)
+    path = save_forward(
+        params, args.out,
+        batch=args.batch if args.batch else "b",
+        tip_vertex_ids=args.tips or None,
+        keypoint_order=args.keypoint_order,
+        platforms=tuple(args.platforms.split(",")) if args.platforms
+        else None,
+    )
+    import os
+
+    print(f"exported AOT forward -> {path} ({os.path.getsize(path)} bytes; "
+          "params baked in; consumer needs only jax + "
+          "mano_hand_tpu.io.export_aot.load_forward)")
+    return 0
+
+
 def cmd_info(args) -> int:
     params = _load_params(args.asset, args.side)
     info = {
@@ -551,6 +573,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "keypoints2d; adam only)")
     f.add_argument("--out", default="fit.npz")
     f.set_defaults(fn=cmd_fit)
+
+    e = sub.add_parser(
+        "export-aot",
+        help="serialize the compiled forward (jax.export) for serving",
+    )
+    e.add_argument("--asset", default="synthetic")
+    e.add_argument("--side", default=None, choices=[None, "left", "right"])
+    e.add_argument("--out", default="mano_fwd.jaxexp")
+    e.add_argument("--batch", type=int, default=0,
+                   help="pin the batch size; default 0 = symbolic (any B)")
+    e.add_argument("--tips", default="",
+                   help="fingertip convention for baked-in keypoints "
+                        "('smplx' | 'manopth'); default: 16 joints only")
+    e.add_argument("--keypoint-order", default="mano",
+                   choices=["mano", "openpose"])
+    e.add_argument("--platforms", default="",
+                   help="comma-separated lowering platforms; default cpu,tpu")
+    e.set_defaults(fn=cmd_export_aot)
 
     i = sub.add_parser("info", help="print asset summary")
     i.add_argument("--asset", default="synthetic")

@@ -552,7 +552,12 @@ def fit_with_optimizer(
         raise ValueError("points target cloud is empty ([..., 0, 3])")
     if target_conf is not None:
         target_conf = jnp.asarray(target_conf, params.v_template.dtype)
-        if target_conf.shape[-1] != n_kp:
+        # A scalar means "this confidence for every keypoint" — lift it to
+        # the per-point vector the loss expects; vectors must match the
+        # spec's keypoint count.
+        if target_conf.ndim == 0:
+            target_conf = jnp.broadcast_to(target_conf, (n_kp,))
+        elif target_conf.shape[-1] != n_kp:
             # e.g. a stale 16-entry confidence vector with a 21-keypoint
             # fit — fail here, not as a broadcast error mid-trace.
             raise ValueError(
@@ -662,7 +667,7 @@ def fit_sequence(
     n_shape = params.shape_basis.shape[-1]
     if target_conf is not None:
         target_conf = jnp.asarray(target_conf, dtype)
-        if target_conf.shape[-1] != n_kp:
+        if target_conf.ndim and target_conf.shape[-1] != n_kp:
             raise ValueError(
                 f"target_conf has {target_conf.shape[-1]} entries but this "
                 f"keypoint spec yields {n_kp} keypoints"

@@ -282,6 +282,15 @@ def test_conf_length_checked_against_extended_keypoints(params32):
                      n_steps=5, data_term="keypoints2d", camera=camera,
                      tip_vertex_ids="smplx",
                      target_conf=np.ones((16,), np.float32))
+    # A SCALAR conf broadcasts to every keypoint — pre-existing behavior
+    # the length check must not regress.
+    res = fit(params32, target_xy, n_steps=5, data_term="keypoints2d",
+              camera=camera, tip_vertex_ids="smplx", target_conf=1.0)
+    assert res.pose.shape == (16, 3)
+    res = fit_sequence(params32, jnp.broadcast_to(target_xy, (3, 21, 2)),
+                       n_steps=5, data_term="keypoints2d", camera=camera,
+                       tip_vertex_ids="smplx", target_conf=1.0)
+    assert res.pose.shape == (3, 16, 3)
 
 
 def test_tracker_passes_tips_through(params32):
