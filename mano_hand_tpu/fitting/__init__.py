@@ -1,5 +1,6 @@
 from mano_hand_tpu.fitting.objectives import (
     huber,
+    inter_penetration,
     joint_l2,
     keypoint2d_l2,
     l2_prior,
@@ -8,6 +9,7 @@ from mano_hand_tpu.fitting.objectives import (
     pose_component_variances,
     vertex_l2,
 )
+from mano_hand_tpu.fitting.hands import HandsFitResult, fit_hands
 from mano_hand_tpu.fitting.solvers import (
     FitResult,
     SequenceFitResult,
@@ -24,7 +26,10 @@ from mano_hand_tpu.fitting.tracking import (
 
 __all__ = [
     "FitResult",
+    "HandsFitResult",
     "SequenceFitResult",
+    "fit_hands",
+    "inter_penetration",
     "fit",
     "fit_sequence",
     "fit_with_optimizer",

@@ -129,6 +129,32 @@ def huber(sq_dist: jnp.ndarray, delta: float) -> jnp.ndarray:
     return jnp.where(inlier, sq_dist, 2.0 * delta * safe - d2)
 
 
+def inter_penetration(verts_a: jnp.ndarray,   # [..., V, 3]
+                      verts_b: jnp.ndarray,   # [..., W, 3]
+                      radius: float) -> jnp.ndarray:
+    """Soft inter-mesh repulsion: penalize vertex pairs closer than ``radius``.
+
+    Symmetric hinge on nearest-neighbor distances between two meshes —
+    zero once every vertex of each mesh is at least ``radius`` (meters)
+    from the other, quadratic inside. This is the standard contact/
+    penetration regularizer for interacting-hands fitting: noisy or
+    sparse observations routinely pull the two fitted hands through each
+    other; physically they can touch but not overlap. The hinge is on
+    DISTANCE (not squared distance) so the gradient does not vanish as
+    surfaces approach contact; the sqrt is clamped away from zero.
+    """
+    # One pairwise expansion serves both directions (min over each axis);
+    # the term runs every optimizer step, so don't pay the [V, W] matmul
+    # and its backward twice.
+    d2 = jnp.maximum(_pairwise_sq_dist(verts_a, verts_b), 0.0)  # [..., W, V]
+
+    def hinge(sq):
+        d = jnp.sqrt(jnp.maximum(sq, 1e-12))
+        return jnp.mean(jnp.maximum(radius - d, 0.0) ** 2)
+
+    return 0.5 * (hinge(jnp.min(d2, axis=-1)) + hinge(jnp.min(d2, axis=-2)))
+
+
 def l2_prior(x: jnp.ndarray) -> jnp.ndarray:
     """Quadratic prior toward zero (pose/shape regularizer)."""
     return jnp.mean(x ** 2)

@@ -212,8 +212,10 @@ def normalize_tips_kwarg(fn):
     """
     @functools.wraps(fn)
     def wrapper(params, *args, tip_vertex_ids=None, **kw):
+        # shape[-2] is the vertex axis for single ([V, 3]) AND stacked
+        # two-hand ([2, V, 3]) parameter trees.
         tip_vertex_ids = core.resolve_tip_ids(
-            tip_vertex_ids, params.v_template.shape[0]
+            tip_vertex_ids, params.v_template.shape[-2]
         )
         return fn(params, *args, tip_vertex_ids=tip_vertex_ids, **kw)
 
@@ -243,7 +245,7 @@ def check_keypoint_spec(params, data_term, tip_vertex_ids, keypoint_order,
                 f"data terms {KEYPOINT_TERMS}, got data_term={data_term!r}"
             )
         return None, params.j_regressor.shape[0]
-    tips = core.resolve_tip_ids(tip_vertex_ids, params.v_template.shape[0])
+    tips = core.resolve_tip_ids(tip_vertex_ids, params.v_template.shape[-2])
     n_kp = params.j_regressor.shape[0] + (len(tips) if tips else 0)
     if keypoint_order == "openpose" and n_kp != 21:
         raise ValueError(
@@ -260,6 +262,24 @@ def check_keypoint_spec(params, data_term, tip_vertex_ids, keypoint_order,
             "for 21-keypoint targets"
         )
     return tips, n_kp
+
+
+def normalize_conf(target_conf, n_kp: int, dtype):
+    """THE one conf policy: scalars lift to a per-keypoint vector; vectors
+    must match the keypoint spec's count (named error, not a broadcast
+    crash mid-trace). Returns the normalized array (or None)."""
+    if target_conf is None:
+        return None
+    target_conf = jnp.asarray(target_conf, dtype)
+    if target_conf.ndim == 0:
+        return jnp.broadcast_to(target_conf, (n_kp,))
+    if target_conf.shape[-1] != n_kp:
+        # e.g. a stale 16-entry confidence vector with a 21-keypoint fit.
+        raise ValueError(
+            f"target_conf has {target_conf.shape[-1]} entries but this "
+            f"keypoint spec yields {n_kp} keypoints"
+        )
+    return target_conf
 
 
 def _data_loss(out, offset, target, data_term: str, camera, conf,
@@ -550,20 +570,8 @@ def fit_with_optimizer(
         # A zero-point cloud (empty depth-scan foreground) would mean() over
         # an empty axis -> NaN in every parameter, silently.
         raise ValueError("points target cloud is empty ([..., 0, 3])")
-    if target_conf is not None:
-        target_conf = jnp.asarray(target_conf, params.v_template.dtype)
-        # A scalar means "this confidence for every keypoint" — lift it to
-        # the per-point vector the loss expects; vectors must match the
-        # spec's keypoint count.
-        if target_conf.ndim == 0:
-            target_conf = jnp.broadcast_to(target_conf, (n_kp,))
-        elif target_conf.shape[-1] != n_kp:
-            # e.g. a stale 16-entry confidence vector with a 21-keypoint
-            # fit — fail here, not as a broadcast error mid-trace.
-            raise ValueError(
-                f"target_conf has {target_conf.shape[-1]} entries but this "
-                f"keypoint spec yields {n_kp} keypoints"
-            )
+    target_conf = normalize_conf(target_conf, n_kp,
+                                 params.v_template.dtype)
     if target_verts.ndim == 2:
         return single(target_verts, target_conf, init=init)
     # Batched problems: map conf per-problem when it is [B, J]; a shared
@@ -665,13 +673,8 @@ def fit_sequence(
     t_frames = targets.shape[0]
     n_joints = params.j_regressor.shape[0]
     n_shape = params.shape_basis.shape[-1]
+    target_conf = normalize_conf(target_conf, n_kp, dtype)
     if target_conf is not None:
-        target_conf = jnp.asarray(target_conf, dtype)
-        if target_conf.ndim and target_conf.shape[-1] != n_kp:
-            raise ValueError(
-                f"target_conf has {target_conf.shape[-1]} entries but this "
-                f"keypoint spec yields {n_kp} keypoints"
-            )
         target_conf = jnp.broadcast_to(target_conf, (t_frames, n_kp))
 
     theta0 = _pose_init(pose_space, (t_frames,), n_joints, n_pca=0,

@@ -162,8 +162,12 @@ def load_forward(src) -> AotForward:
             "by save_forward/export_forward"
         )
     off = len(_MAGIC)
+    if len(data) < off + 4:
+        raise ValueError("truncated MANO AOT artifact (no header length)")
     (hlen,) = struct.unpack_from("<I", data, off)
     off += 4
+    if len(data) < off + hlen:
+        raise ValueError("truncated MANO AOT artifact (incomplete header)")
     meta = json.loads(data[off:off + hlen].decode())
     blob = data[off + hlen:]
     return AotForward(meta, jax_export.deserialize(bytearray(blob)))

@@ -83,11 +83,17 @@ def test_custom_marker_set_and_repr(params32):
     assert fwd(pose, shape)["keypoints"].shape == (2, 19, 3)
 
 
-def test_rejects_non_artifact(tmp_path):
+def test_rejects_non_artifact(tmp_path, params32):
     bad = tmp_path / "not_an_artifact.bin"
     bad.write_bytes(b"definitely not stablehlo")
     with pytest.raises(ValueError, match="bad magic"):
         load_forward(bad)
+    # Truncated artifacts stay on the ValueError contract too.
+    blob = export_forward(params32, platforms=("cpu",))
+    with pytest.raises(ValueError, match="truncated"):
+        load_forward(blob[:10])  # magic survives, header length gone
+    with pytest.raises(ValueError, match="truncated"):
+        load_forward(blob[:20])  # header cut mid-JSON
 
 
 def test_cli_export_aot(params32, tmp_path, capsys):
