@@ -1,0 +1,200 @@
+"""Analytic residual Jacobian for the LM solver.
+
+``jax.jacfwd`` of the full forward replays 58 tangent columns through the
+blend + skinning chain; XLA materializes [58, V, 3, 3]-scale tangent
+intermediates per problem and the LM step becomes bandwidth-bound on them
+— measured 7.5 ms of the 9.4 ms step at batch 256 on a v5e chip, and
+routing the replay through the fused-basis forward did not move it
+(`docs/roadmap.md` 1b).
+
+The structure the replay ignores: with pose/shape as the unknowns,
+skinned vertices are
+
+    verts_v = M_v @ v_posed_v + sum_j w_vj b_j
+    M_v     = sum_j w_vj A_j
+
+where (A_j, b_j) are the 16 skinning transforms — a function of theta
+with NO vertex dimension — and v_posed is LINEAR in theta's effects
+(shape basis columns; pose-corrective basis columns through R). So:
+
+  * differentiate ONLY the tiny joint chain with ``jacfwd`` (16 joints x
+    (9 + 3 + 3) outputs x 58 inputs — a few thousand numbers);
+  * assemble the [V, 3, 58] vertex Jacobian with three einsums whose
+    intermediates never exceed [V, 3, 58].
+
+Exact (no approximation): validated against ``jax.jacfwd`` of the full
+residual in tests/test_jacobian.py.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from mano_hand_tpu import ops
+from mano_hand_tpu.assets.schema import ManoParams
+from mano_hand_tpu.models import core
+from mano_hand_tpu.ops.common import DEFAULT_PRECISION
+
+
+class ForwardJacobian(NamedTuple):
+    """Forward values + exact Jacobians wrt the flat (pose, shape) vector."""
+
+    verts: jnp.ndarray         # [V, 3]
+    posed_joints: jnp.ndarray  # [J, 3]
+    verts_jac: jnp.ndarray     # [V, 3, P]
+    joints_jac: jnp.ndarray    # [J, 3, P]
+    shape_jac: jnp.ndarray     # [S, P] selector rows (Tikhonov block)
+
+
+def _small_chain(params: ManoParams, unravel, precision):
+    """The joint-dimension-only forward: the part worth differentiating
+    with AD (no vertex axis anywhere)."""
+    _, joint_template, joint_shape_basis = core.fused_blend_bases(
+        params, precision
+    )
+
+    def small(f):
+        th = unravel(f)
+        rot = ops.rotation_matrix(th["pose"])
+        jnt = joint_template + jnp.einsum(
+            "jcs,s->jc", joint_shape_basis, th["shape"], precision=precision
+        )
+        world_rot, world_t = ops.forward_kinematics(
+            params.parents, rot, jnt, precision
+        )
+        skin_rot, skin_t = ops.skinning_transforms(
+            world_rot, world_t, jnt, precision
+        )
+        return skin_rot, skin_t, world_t, rot, th["shape"]
+
+    return small
+
+
+def _values(params, skin_rot, skin_t, v_posed, precision):
+    """Skinned vertices + the per-vertex blended rotation M — THE value
+    expression shared by ``forward_values`` and ``forward_with_jacobian``
+    so both estimators are numerically identical (the LM accept test
+    compares losses across them)."""
+    w = params.lbs_weights
+    m_per_vertex = jnp.einsum("vj,jab->vab", w, skin_rot,
+                              precision=precision)
+    verts = (
+        jnp.einsum("vab,vb->va", m_per_vertex, v_posed, precision=precision)
+        + jnp.einsum("vj,ja->va", w, skin_t, precision=precision)
+    )
+    return m_per_vertex, verts
+
+
+def _v_posed(params, rot, shape, precision):
+    v_shaped = ops.shape_blend(
+        params.v_template, params.shape_basis, shape, precision
+    )
+    return ops.pose_blend(v_shaped, params.pose_basis, rot, precision)
+
+
+def forward_values(
+    params: ManoParams,
+    unravel,
+    flat: jnp.ndarray,
+    precision=DEFAULT_PRECISION,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(verts [V, 3], posed_joints [J, 3]) by exactly the same numeric
+    path as ``forward_with_jacobian`` — for scoring candidates in the
+    analytic LM loop without paying for the Jacobian."""
+    small = _small_chain(params, unravel, precision)
+    skin_rot, skin_t, world_t, rot, shape = small(flat)
+    v_posed = _v_posed(params, rot, shape, precision)
+    _, verts = _values(params, skin_rot, skin_t, v_posed, precision)
+    return verts, world_t
+
+
+def forward_with_jacobian(
+    params: ManoParams,
+    unravel,                 # ravel_pytree unravel for {"pose", "shape"}
+    flat: jnp.ndarray,       # [P] flattened (pose, shape)
+    precision=DEFAULT_PRECISION,
+) -> ForwardJacobian:
+    """One forward pass + its full analytic Jacobian.
+
+    ``unravel`` defines the column layout — the same ravel the solver
+    optimizes in, so no ordering assumptions are baked in here.
+    """
+    n_params = flat.shape[0]
+    small = _small_chain(params, unravel, precision)
+    vals = small(flat)
+    d_skin_rot, d_skin_t, d_world_t, d_rot, d_shape = jax.jacfwd(small)(flat)
+    skin_rot, skin_t, world_t, rot, shape = vals
+
+    # v_posed and its Jacobian: linear in beta (shape basis) and in
+    # vec(R[1:]) (pose-corrective basis); d_rot carries rot's dependence
+    # on the flat vector, so the pose AND any cross terms come along.
+    v_posed = _v_posed(params, rot, shape, precision)
+    n_pose_basis = params.pose_basis.shape[-1]
+    d_vec_rot = d_rot[1:].reshape(n_pose_basis, n_params)
+    dv = (
+        jnp.einsum("vcf,fp->vcp", params.pose_basis, d_vec_rot,
+                   precision=precision)
+        + jnp.einsum("vcs,sp->vcp", params.shape_basis, d_shape,
+                     precision=precision)
+    )
+
+    # verts_v = (sum_j w_vj A_j) v_v + sum_j w_vj b_j; product rule over
+    # the three theta-dependent factors. Intermediates stay [V, 3, P].
+    w = params.lbs_weights
+    m_per_vertex, verts = _values(params, skin_rot, skin_t, v_posed,
+                                  precision)
+    # The dA term MUST contract (j, b) together: the per-vertex outer
+    # product O[v, j, b] = w[v, j] * v[v, b] turns it into one
+    # [V, J*3] x [J*3, 3*P] matmul with no [V, 3, 3, P]-scale
+    # intermediate (a three-operand einsum left to XLA materialized one
+    # and ate the analytic path's advantage — measured).
+    n_joints = w.shape[1]
+    outer = (w[:, :, None] * v_posed[:, None, :]).reshape(
+        -1, n_joints * 3
+    )
+    da_flat = d_skin_rot.transpose(0, 2, 1, 3).reshape(n_joints * 3, -1)
+    # The M @ dv term is a [3, 3] x [3, P] contraction per vertex — as an
+    # einsum/dot it lowers to B*V microscopic gemms (measured ms-scale);
+    # unrolled over the K=3 axis it is three fused elementwise
+    # multiply-adds over the [V, 3, P] slab (VPU work, ~0.4 ms at b=256).
+    m_dot_dv = sum(
+        m_per_vertex[:, :, b, None] * dv[:, b, None, :] for b in range(3)
+    )
+    verts_jac = (
+        m_dot_dv
+        + jnp.matmul(outer, da_flat, precision=precision).reshape(
+            -1, 3, n_params
+        )
+        + jnp.einsum("vj,jap->vap", w, d_skin_t, precision=precision)
+    )
+    return ForwardJacobian(
+        verts=verts,
+        posed_joints=world_t,
+        verts_jac=verts_jac,
+        joints_jac=d_world_t,
+        shape_jac=d_shape,
+    )
+
+
+def keypoint_jacobian(
+    fj: ForwardJacobian,
+    tips,                       # resolved tuple or None
+    keypoint_order: str,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(keypoints [K, 3], jac [K, 3, P]) under the same selection/ordering
+    as ``core.keypoints`` — tip rows are vertex rows of the mesh Jacobian."""
+    kp = fj.posed_joints
+    jac = fj.joints_jac
+    if tips is not None:
+        idx = jnp.array(tips)
+        kp = jnp.concatenate([kp, fj.verts[idx]], axis=0)
+        jac = jnp.concatenate([jac, fj.verts_jac[idx]], axis=0)
+    if keypoint_order == "openpose":
+        from mano_hand_tpu import constants
+
+        perm = jnp.array(constants.MANO21_TO_OPENPOSE)
+        kp, jac = kp[perm], jac[perm]
+    return kp, jac

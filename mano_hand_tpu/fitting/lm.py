@@ -5,12 +5,14 @@ gradient-based recovery, and first-order Adam (solvers.py) covers it. This
 module adds the solver of choice for small-parameter mesh fitting:
 damped Gauss-Newton over the ~58-dim (pose, shape) space.
 
-TPU-first shape of the problem: the residual Jacobian [V*3, P] comes from
-``jax.jacfwd`` (P forward-mode columns batched by XLA into one program),
-the normal matrix JtJ is a [P, P] MXU matmul, and the solve is a tiny
-Cholesky — all inside one ``lax.scan`` step with branch-free accept/reject
-damping (``jnp.where``, no host control flow). A batch of independent
-problems vmaps over the scan.
+TPU-first shape of the problem: the residual Jacobian [V*3, P] is
+assembled ANALYTICALLY by default (AD differentiates only the 16-joint
+chain; the vertex Jacobian is bounded einsums — fitting/jacobian.py;
+``jacobian="ad"`` keeps the plain ``jax.jacfwd`` replay as a
+cross-check), the normal matrix JtJ is a [P, P] MXU matmul, and the
+solve is a tiny Cholesky — all inside one ``lax.scan`` step with
+branch-free accept/reject damping (``jnp.where``, no host control
+flow). A batch of independent problems vmaps over the scan.
 """
 
 from __future__ import annotations
@@ -24,6 +26,7 @@ from jax.flatten_util import ravel_pytree
 
 from mano_hand_tpu import ops
 from mano_hand_tpu.assets.schema import ManoParams
+from mano_hand_tpu.fitting import jacobian as jacobian_mod
 from mano_hand_tpu.fitting import objectives, solvers
 from mano_hand_tpu.models import core
 
@@ -58,6 +61,7 @@ def _fit_single(
     robust_scale: Optional[float] = None,
     tips=None,
     keypoint_order: str = "mano",
+    jacobian: str = "analytic",
 ) -> LMResult:
     dtype = params.v_template.dtype
     n_joints = params.j_regressor.shape[0]
@@ -87,46 +91,72 @@ def _fit_single(
     n_params = flat0.shape[0]
     target = target_verts.reshape(-1)
 
-    def residual(flat, corr=None):
+    def values_of(flat):
+        """(verts, posed_joints) by the active backend's estimator.
+
+        One estimator per run: the accept test compares losses of the
+        current iterate against a candidate, so both must come from the
+        SAME numeric path (the fused and staged forwards differ by
+        ~float32 rounding — enough to flip accepts at the floor).
+        """
+        if jacobian == "analytic":
+            return jacobian_mod.forward_values(params, unravel, flat)
         p = unravel(flat)
         # Fused-basis forward: under jacfwd the blend stage's 58 tangent
         # columns batch into ONE [P, S+P] x [S+P, V*3] MXU matmul instead
-        # of 58 replays of the staged skinny contractions (the r2 judge's
-        # "route LM through the fused forward" item).
+        # of 58 replays of the staged skinny contractions.
         out = core.forward_fused(params, p["pose"], p["shape"])
+        return out.verts, out.posed_joints
+
+    def rows_from(verts, posed_joints, p_shape, corr):
+        """THE per-data-term residual row construction — shared by the
+        AD path (under jacfwd), the analytic path, and scoring, so the
+        backends cannot drift apart."""
         if data_term == "points":
             # Point-to-point ICP residual under the step's FROZEN
             # correspondence assignment (GN never differentiates the
             # argmin, matching classic ICP). Trim weights zero the rows
             # of rejected points — residual shape stays static.
             idx, w = corr
-            d = out.verts[idx] - target_verts.reshape(-1, 3)
+            d = verts[idx] - target_verts.reshape(-1, 3)
             res = (d * w[:, None]).reshape(-1)
-            return jnp.concatenate([res, shape_weight * p["shape"]])
-        if data_term == "point_to_plane":
+        elif data_term == "point_to_plane":
             # Point-to-plane: signed distance along the step's FROZEN
             # surface normal — one row per point. Sliding tangentially
             # along the surface is free, which is why this converges in
             # fewer steps than point-to-point on smooth regions (the
             # classic Chen & Medioni refinement).
             idx, normals, w = corr
-            d = out.verts[idx] - target_verts.reshape(-1, 3)
+            d = verts[idx] - target_verts.reshape(-1, 3)
             res = jnp.sum(d * normals, axis=-1) * w
-            return jnp.concatenate([res, shape_weight * p["shape"]])
-        pred = (
-            out.verts if data_term == "verts"
-            else core.keypoints(out, tips, keypoint_order)
-        )
-        res = pred.reshape(-1) - target
+        else:
+            pred = (
+                verts if data_term == "verts"
+                else _select_keypoints(verts, posed_joints)
+            )
+            res = pred.reshape(-1) - target
         # Tikhonov rows keep beta near 0 when vertices underdetermine it.
         # Always present (zero rows when the traced weight is 0, which is
         # mathematically a no-op on JtJ/Jtr) so the residual shape — and
         # therefore the jit cache key — is weight-independent.
-        return jnp.concatenate([res, shape_weight * p["shape"]])
+        return jnp.concatenate([res, shape_weight * p_shape])
+
+    def _select_keypoints(verts, posed_joints):
+        kp = posed_joints
+        if tips is not None:
+            kp = jnp.concatenate([kp, verts[jnp.array(tips)]], axis=0)
+        if keypoint_order == "openpose":
+            from mano_hand_tpu import constants
+
+            kp = kp[jnp.array(constants.MANO21_TO_OPENPOSE)]
+        return kp
+
+    def residual(flat, corr=None):
+        verts, posed_joints = values_of(flat)
+        return rows_from(verts, posed_joints, unravel(flat)["shape"], corr)
 
     def assignment(flat):
-        p = unravel(flat)
-        verts = core.forward_fused(params, p["pose"], p["shape"]).verts
+        verts = values_of(flat)[0]
         points = target_verts.reshape(-1, 3)
         idx = objectives.nearest_vertex_idx(verts, points)
         # Trimmed ICP: reject the worst trim_fraction of points THIS step
@@ -164,6 +194,39 @@ def _fit_single(
             return idx, normals, w
         return idx, w
 
+    def analytic_res_jac(flat, corr):
+        """Residual + exact Jacobian without the 58-column forward replay.
+
+        ``jax.jacfwd`` of the full residual materializes [P, V, 3, 3]
+        tangent slabs and is bandwidth-bound (7.5 of the 9.4 ms step at
+        b=256 on-chip); here AD touches only the V-free joint chain and
+        the vertex Jacobian is three [V, 3, P]-bounded einsums
+        (fitting/jacobian.py). Rows match ``residual`` exactly.
+        """
+        fj = jacobian_mod.forward_with_jacobian(params, unravel, flat)
+        res = rows_from(fj.verts, fj.posed_joints, unravel(flat)["shape"],
+                        corr)
+        if data_term == "points":
+            idx, w = corr
+            jac = (fj.verts_jac[idx] * w[:, None, None]).reshape(
+                -1, n_params
+            )
+        elif data_term == "point_to_plane":
+            idx, normals, w = corr
+            jac = w[:, None] * jnp.einsum(
+                "nc,ncp->np", normals, fj.verts_jac[idx],
+                precision=core.DEFAULT_PRECISION,
+            )
+        elif data_term == "verts":
+            jac = fj.verts_jac.reshape(-1, n_params)
+        else:  # joints (optionally extended with fingertips)
+            _, kp_jac = jacobian_mod.keypoint_jacobian(
+                fj, tips, keypoint_order
+            )
+            jac = kp_jac.reshape(-1, n_params)
+        jac = jnp.concatenate([jac, shape_weight * fj.shape_jac])
+        return res, jac
+
     def loss_of(flat):
         # Fresh assignment when scoring (ICP's true objective is the
         # chamfer, not the residual under a stale correspondence).
@@ -174,9 +237,12 @@ def _fit_single(
     def step(carry, _):
         flat, damping = carry
         corr = (assignment(flat) if data_term in _ICP_TERMS else None)
-        res_fn = lambda f: residual(f, corr)  # noqa: E731
-        r = res_fn(flat)
-        jac = jax.jacfwd(res_fn)(flat)                 # [R, P]
+        if jacobian == "analytic":
+            r, jac = analytic_res_jac(flat, corr)
+        else:
+            res_fn = lambda f: residual(f, corr)  # noqa: E731
+            r = res_fn(flat)
+            jac = jax.jacfwd(res_fn)(flat)             # [R, P]
         jtj = jnp.einsum(
             "rp,rq->pq", jac, jac, precision=core.DEFAULT_PRECISION
         )                                              # [P, P] (MXU)
@@ -217,7 +283,7 @@ def _fit_single(
     jax.jit,
     static_argnames=("n_steps", "data_term", "trim_fraction",
                      "robust_weights", "robust_scale", "tip_vertex_ids",
-                     "keypoint_order"),
+                     "keypoint_order", "jacobian"),
 )
 def fit_lm(
     params: ManoParams,
@@ -235,6 +301,7 @@ def fit_lm(
     robust_scale: Optional[float] = None,
     tip_vertex_ids=None,         # None | "smplx" | "manopth" | vertex ids
     keypoint_order: str = "mano",  # "mano" | "openpose"
+    jacobian: str = "analytic",  # "analytic" | "ad"
 ) -> LMResult:
     """Recover (pose, shape) by damped Gauss-Newton; batch via vmap.
 
@@ -272,6 +339,14 @@ def fit_lm(
     the registration can drift (measured: 29 mm from a coarse start vs
     0.06 mm as polish). For robust or 2D-projected energies use
     solvers.fit (first-order).
+
+    ``jacobian="analytic"`` (default) assembles the residual Jacobian
+    exactly without replaying 58 forward-mode columns through the mesh
+    (fitting/jacobian.py): AD differentiates only the 16-joint chain and
+    the vertex Jacobian is three bounded einsums — measured 5.5 ms/step
+    vs 10.7 for ``"ad"`` at batch 256 on a v5e chip (93 -> 182 steps/s),
+    identical convergence (tests/test_jacobian.py). ``"ad"`` keeps the
+    plain ``jax.jacfwd`` path as the cross-check.
     """
     if data_term not in ("verts", "joints", "points",
                          "point_to_plane"):
@@ -313,6 +388,10 @@ def fit_lm(
         )
     if robust_scale is not None and float(robust_scale) <= 0:
         raise ValueError(f"robust_scale must be > 0, got {robust_scale}")
+    if jacobian not in ("analytic", "ad"):
+        raise ValueError(
+            f"jacobian must be 'analytic' or 'ad', got {jacobian!r}"
+        )
     single = functools.partial(
         _fit_single,
         params,
@@ -327,6 +406,7 @@ def fit_lm(
         robust_scale=robust_scale,
         tips=tips,
         keypoint_order=keypoint_order,
+        jacobian=jacobian,
     )
     if target_verts.ndim == 2:
         return single(target_verts, init=init)
