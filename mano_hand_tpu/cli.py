@@ -180,10 +180,37 @@ def cmd_fit(args) -> int:
 
     params = _load_params(args.asset, args.side).astype(np.float32)
     targets = np.load(args.targets)  # [V|J, 3|2] or [B, V|J, 3|2]
+    if args.data_term not in ("joints", "keypoints2d"):
+        # Name the real conflict for BOTH keypoint flags here — sending
+        # the user to --tips from the openpose check would ping-pong them
+        # straight into this error.
+        if args.tips:
+            print("--tips only applies to --data-term joints/keypoints2d",
+                  file=sys.stderr)
+            return 2
+        if args.keypoint_order != "mano":
+            print("--keypoint-order only applies to --data-term "
+                  "joints/keypoints2d", file=sys.stderr)
+            return 2
+    try:
+        from mano_hand_tpu.models.core import resolve_tip_ids
+
+        tips = resolve_tip_ids(args.tips or None, params.n_verts)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    n_kp = params.n_joints + (len(tips) if tips else 0)
+    if args.keypoint_order == "openpose" and n_kp != 21:
+        print("--keypoint-order openpose is the 21-point convention; "
+              "pass --tips smplx|manopth", file=sys.stderr)
+        return 2
+    kp_kw = {}
+    if args.data_term in ("joints", "keypoints2d"):
+        kp_kw = dict(tip_vertex_ids=tips, keypoint_order=args.keypoint_order)
     if args.data_term == "keypoints2d":
-        want = (params.n_joints, 2)
+        want = (n_kp, 2)
     elif args.data_term == "joints":
-        want = (params.n_joints, 3)
+        want = (n_kp, 3)
     elif args.data_term in ("points", "point_to_plane"):
         want = (None, 3)  # any number of scan points, 3D
     else:
@@ -290,7 +317,8 @@ def cmd_fit(args) -> int:
             print(f"--pose-space {args.pose_space} requires --solver adam "
                   "(LM optimizes axis-angle)", file=sys.stderr)
             return 2
-        res = fitting.fit_lm(params, targets, n_steps=steps, **lm_kw)
+        res = fitting.fit_lm(params, targets, n_steps=steps, **lm_kw,
+                             **kp_kw)
     else:
         if args.trim:
             print("--trim requires --solver lm (the Adam chamfer path "
@@ -395,6 +423,7 @@ def cmd_fit(args) -> int:
             robust=args.robust, robust_scale=args.robust_scale,
             init=init,
             **kp2d,
+            **kp_kw,
         )
     jax.block_until_ready(res.pose)
     path = save_fit_result(res, args.out)
@@ -523,6 +552,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "outlier points). Adam only")
     f.add_argument("--robust-scale", type=float, default=0.01,
                    help="Huber scale in data units (meters for 3D terms)")
+    f.add_argument("--tips", default="",
+                   help="extend joints/keypoints2d targets with fingertip "
+                        "vertex picks: 'smplx' | 'manopth' (the standard "
+                        "21-keypoint set); default: 16 joints only")
+    f.add_argument("--keypoint-order", default="mano",
+                   choices=["mano", "openpose"],
+                   help="row ordering of 21-keypoint targets "
+                        "(openpose = OpenPose/FreiHAND convention)")
     f.add_argument("--robust-weights", default="none",
                    choices=["none", "tukey", "geman"],
                    help="soft IRLS reweighting of ICP points by their "

@@ -185,16 +185,10 @@ def keypoint_jacobian(
     keypoint_order: str,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(keypoints [K, 3], jac [K, 3, P]) under the same selection/ordering
-    as ``core.keypoints`` — tip rows are vertex rows of the mesh Jacobian."""
-    kp = fj.posed_joints
-    jac = fj.joints_jac
-    if tips is not None:
-        idx = jnp.array(tips)
-        kp = jnp.concatenate([kp, fj.verts[idx]], axis=0)
-        jac = jnp.concatenate([jac, fj.verts_jac[idx]], axis=0)
-    if keypoint_order == "openpose":
-        from mano_hand_tpu import constants
-
-        perm = jnp.array(constants.MANO21_TO_OPENPOSE)
-        kp, jac = kp[perm], jac[perm]
+    as ``core.keypoints`` — tip rows are vertex rows of the mesh Jacobian,
+    selected by the SAME shared helper (axis=0: rows of [K, 3, P])."""
+    kp = core.select_keypoints(fj.verts, fj.posed_joints, tips,
+                               keypoint_order)
+    jac = core.select_keypoints(fj.verts_jac, fj.joints_jac, tips,
+                                keypoint_order, axis=0)
     return kp, jac

@@ -132,7 +132,8 @@ def _fit_single(
         else:
             pred = (
                 verts if data_term == "verts"
-                else _select_keypoints(verts, posed_joints)
+                else core.select_keypoints(verts, posed_joints, tips,
+                                           keypoint_order)
             )
             res = pred.reshape(-1) - target
         # Tikhonov rows keep beta near 0 when vertices underdetermine it.
@@ -140,16 +141,6 @@ def _fit_single(
         # mathematically a no-op on JtJ/Jtr) so the residual shape — and
         # therefore the jit cache key — is weight-independent.
         return jnp.concatenate([res, shape_weight * p_shape])
-
-    def _select_keypoints(verts, posed_joints):
-        kp = posed_joints
-        if tips is not None:
-            kp = jnp.concatenate([kp, verts[jnp.array(tips)]], axis=0)
-        if keypoint_order == "openpose":
-            from mano_hand_tpu import constants
-
-            kp = kp[jnp.array(constants.MANO21_TO_OPENPOSE)]
-        return kp
 
     def residual(flat, corr=None):
         verts, posed_joints = values_of(flat)

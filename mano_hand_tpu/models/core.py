@@ -307,6 +307,40 @@ def resolve_tip_ids(tip_vertex_ids, n_verts: int):
     return ids
 
 
+def select_keypoints(
+    verts: jnp.ndarray,
+    posed_joints: jnp.ndarray,
+    tips=None,                 # PRE-RESOLVED tuple (resolve_tip_ids) or None
+    order: str = "mano",
+    axis: int = -2,            # the keypoint/vertex axis of both inputs
+) -> jnp.ndarray:
+    """THE keypoint selection: concat tip rows, apply dataset ordering.
+
+    One implementation shared by ``keypoints`` (values), the LM row
+    builder, and the analytic Jacobian — which applies the SAME selection
+    to Jacobian rows via ``axis=0`` (rows of [K, 3, P] tensors select in
+    lockstep with the keypoints they differentiate).
+    """
+    if order not in ("mano", "openpose"):
+        raise ValueError(f"order must be 'mano' or 'openpose', got {order!r}")
+    kp = posed_joints
+    if tips is not None:
+        kp = jnp.concatenate(
+            [kp, jnp.take(verts, jnp.array(tips), axis=axis)], axis=axis
+        )
+    if order == "openpose":
+        n = kp.shape[axis]
+        if n != len(constants.MANO21_TO_OPENPOSE):
+            raise ValueError(
+                "order='openpose' needs the 21-keypoint set (16 joints + "
+                f"5 tips), got {n} keypoints"
+            )
+        kp = jnp.take(
+            kp, jnp.array(constants.MANO21_TO_OPENPOSE), axis=axis
+        )
+    return kp
+
+
 def keypoints(
     out: ManoOutput,
     tip_vertex_ids=None,
@@ -322,22 +356,8 @@ def keypoints(
     ``order="mano"`` keeps [16 joints | tips as given]. Works on batched
     outputs (leading axes broadcast).
     """
-    if order not in ("mano", "openpose"):
-        raise ValueError(f"order must be 'mano' or 'openpose', got {order!r}")
     tips = resolve_tip_ids(tip_vertex_ids, out.verts.shape[-2])
-    kp = out.posed_joints
-    if tips is not None:
-        kp = jnp.concatenate(
-            [kp, out.verts[..., jnp.array(tips), :]], axis=-2
-        )
-    if order == "openpose":
-        if kp.shape[-2] != len(constants.MANO21_TO_OPENPOSE):
-            raise ValueError(
-                "order='openpose' needs the 21-keypoint set (16 joints + "
-                f"5 tips), got {kp.shape[-2]} keypoints"
-            )
-        kp = kp[..., jnp.array(constants.MANO21_TO_OPENPOSE), :]
-    return kp
+    return select_keypoints(out.verts, out.posed_joints, tips, order)
 
 
 # The bench block-size sweep's winning tile for the fused skinning kernel

@@ -23,6 +23,7 @@ from typing import Optional, Union
 import jax.numpy as jnp
 import numpy as np
 
+from mano_hand_tpu import constants
 from mano_hand_tpu.assets.loader import load_model
 from mano_hand_tpu.assets.schema import ManoParams
 from mano_hand_tpu.io.obj import export_obj_pair
@@ -118,6 +119,35 @@ class MANOModel:
     def export_obj(self, path: Union[str, Path]) -> None:
         """Write posed + rest-pose OBJ pair (mano_np.py:181-201 parity)."""
         export_obj_pair(self.verts, self.rest_verts, self.faces, path)
+
+    def keypoints(self, tip_vertex_ids=None, order: str = "mano"):
+        """Current-state keypoints [16(+T), 3] (float64 numpy).
+
+        The dataset-facing joint set: the 16 posed skeleton joints,
+        optionally extended with fingertip vertex picks
+        (``"smplx"``/``"manopth"`` conventions or explicit vertex ids)
+        and re-ordered to the OpenPose/FreiHAND convention — see
+        ``models.core.keypoints``. The reference exposes only the bare
+        FK joints (/root/reference/mano_np.py:83).
+        """
+        # Deliberately pure-numpy (not core.select_keypoints): the np
+        # backend must work without initializing any JAX device.
+        tips = core.resolve_tip_ids(tip_vertex_ids, self.verts.shape[0])
+        kp = self.posed_J
+        if tips is not None:
+            kp = np.concatenate([kp, self.verts[list(tips)]], axis=0)
+        if order == "openpose":
+            if kp.shape[0] != len(constants.MANO21_TO_OPENPOSE):
+                raise ValueError(
+                    "order='openpose' needs the 21-keypoint set (16 "
+                    f"joints + 5 tips), got {kp.shape[0]} keypoints"
+                )
+            kp = kp[list(constants.MANO21_TO_OPENPOSE)]
+        elif order != "mano":
+            raise ValueError(
+                f"order must be 'mano' or 'openpose', got {order!r}"
+            )
+        return kp.copy()
 
     # ----------------------------------------------------------- functional API
     def __call__(

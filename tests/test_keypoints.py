@@ -293,6 +293,71 @@ def test_conf_length_checked_against_extended_keypoints(params32):
     assert res.pose.shape == (3, 16, 3)
 
 
+def test_layer_keypoints_accessor(params32):
+    from mano_hand_tpu.models.layer import MANOModel
+
+    model = MANOModel(params32.astype(np.float64), backend="np")
+    model.set_params(pose_abs=_pose(11, scale=0.2).astype(np.float64))
+    kp21 = model.keypoints("smplx", order="openpose")
+    assert kp21.shape == (21, 3) and kp21.dtype == np.float64
+    # Must equal the functional path on the same state.
+    out = core.forward(params32, jnp.asarray(model.pose, jnp.float32),
+                       jnp.asarray(model.shape, jnp.float32))
+    ref = core.keypoints(out, "smplx", order="openpose")
+    np.testing.assert_allclose(kp21, np.asarray(ref), atol=1e-5)
+    with pytest.raises(ValueError, match="21-keypoint"):
+        model.keypoints(None, order="openpose")
+
+
+def test_cli_fit_21_keypoints(tmp_path, capsys, params32):
+    from mano_hand_tpu.cli import main
+    from mano_hand_tpu.assets import save_npz
+
+    asset = tmp_path / "asset.npz"
+    save_npz(params32, asset)
+    pose = _pose(12, scale=0.25)
+    out = core.forward(params32, jnp.asarray(pose), jnp.zeros((10,)))
+    target = np.asarray(core.keypoints(out, "manopth", order="openpose"))
+    tpath = tmp_path / "kp21.npy"
+    np.save(tpath, target.astype(np.float32))
+
+    rc = main(["fit", str(tpath), "--asset", str(asset),
+               "--data-term", "joints", "--tips", "manopth",
+               "--keypoint-order", "openpose", "--solver", "lm",
+               "--steps", "25", "--out", str(tmp_path / "fit.npz")])
+    assert rc == 0
+    assert "fit (lm" in capsys.readouterr().out
+    import numpy as _np
+    saved = _np.load(tmp_path / "fit.npz")
+    o2 = core.forward(params32, jnp.asarray(saved["pose"], jnp.float32),
+                      jnp.asarray(saved["shape"], jnp.float32))
+    kp2 = core.keypoints(o2, "manopth", order="openpose")
+    assert float(jnp.abs(kp2 - target).max()) < 2e-3
+    # Guard rails.
+    rc = main(["fit", str(tpath), "--asset", str(asset),
+               "--data-term", "joints", "--keypoint-order", "openpose"])
+    assert rc == 2  # openpose without tips
+    rc = main(["fit", str(tpath), "--asset", str(asset),
+               "--data-term", "verts", "--tips", "smplx"])
+    assert rc == 2  # tips on a mesh term
+    rc = main(["fit", str(tpath), "--asset", str(asset),
+               "--data-term", "verts", "--keypoint-order", "openpose"])
+    assert rc == 2  # ordering on a mesh term (no --tips ping-pong)
+
+
+def test_keypoint_jacobian_guards_openpose_without_tips(params32):
+    from jax.flatten_util import ravel_pytree
+    from mano_hand_tpu.fitting import jacobian as jm
+
+    flat, unravel = ravel_pytree({
+        "pose": jnp.zeros((16, 3), jnp.float32),
+        "shape": jnp.zeros((10,), jnp.float32),
+    })
+    fj = jm.forward_with_jacobian(params32, unravel, flat)
+    with pytest.raises(ValueError, match="21-keypoint"):
+        jm.keypoint_jacobian(fj, None, "openpose")
+
+
 def test_tracker_passes_tips_through(params32):
     """The streaming tracker forwards tip specs via **solver_kw."""
     from mano_hand_tpu.fitting import make_tracker
