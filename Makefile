@@ -1,8 +1,9 @@
 # Pre-commit gate: `make check` MUST pass (full suite incl. the golden demo
 # fixture on the virtual 8-device CPU mesh) before any snapshot commit.
 #
-# Wall time on this box (1 CPU core): ~11 min with a COLD compilation
-# cache, ~3 min warm. The suite is compile-bound; tests/conftest.py keeps a
+# Wall time on this box (1 CPU core): ~13 min with a COLD compilation
+# cache, ~7 min warm (291 tests as of round 3 — the round-3 features
+# added ~60). The suite is compile-bound; tests/conftest.py keeps a
 # persistent XLA compilation cache in .jax_compile_cache/ (gitignored), so
 # every run after the first skips recompilation of unchanged programs.
 # TF_CPP_MIN_LOG_LEVEL=3 must be set OUTSIDE the process: a site hook loads

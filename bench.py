@@ -670,21 +670,27 @@ def run_benchmarks(args, device_str: str) -> dict:
             f"({fit_evals / t4:,.0f} fwd+bwd evals/s)")
 
     def config4b_lm():
-        # Second-order solver throughput: each LM step builds a [R, 58]
-        # forward-mode Jacobian + normal equations + Cholesky per problem.
+        # Second-order solver throughput: each LM step builds the [R, 58]
+        # residual Jacobian + normal equations + Cholesky per problem.
+        # Default backend is the analytic assembly (fitting/jacobian.py,
+        # measured 1.96x the jacfwd replay); record which one ran so the
+        # number is attributable.
         if fit_targets is None:
             raise RuntimeError("config4 did not produce targets")
+        lm_jacobian = "analytic"  # the one constant both the call and
+        #   the recorded field read — they cannot drift apart.
 
         def run_lm(steps):
             return lambda: float(
-                fit_lm(right, fit_targets,
-                       n_steps=steps).final_loss.sum()
+                fit_lm(right, fit_targets, n_steps=steps,
+                       jacobian=lm_jacobian).final_loss.sum()
             )
 
         t_step = slope_time(run_lm, 5, 15, iters=max(2, args.iters // 3))
         results["config4_lm_steps_per_sec"] = 1.0 / t_step
+        results["config4_lm_jacobian"] = lm_jacobian
         log(f"config4b LM b={b4}: {1.0 / t_step:,.1f} steps/s "
-            f"({t_step * 1e3:.2f} ms/step)")
+            f"({t_step * 1e3:.2f} ms/step, analytic Jacobian)")
 
     if not args.skip_fit:
         section("config4", config4)

@@ -1,0 +1,86 @@
+"""Joint two-hand fitting: one observation, two hands, no interpenetration.
+
+The reference treats hands as two unrelated model instances evaluated in
+separate calls (/root/reference/dump_model.py:48-49). Real two-hand data
+is one frame containing both — and fitting them independently lets noisy
+or sparse observations pull the meshes through each other. ``fit_hands``
+solves both hands as one jitted problem over stacked parameters, with an
+inter-penetration hinge that lets the fitted surfaces touch but not
+overlap.
+
+    python examples/10_two_hands_fitting.py [--platform cpu]
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default="",
+                    help="force a JAX platform, e.g. 'cpu'")
+    ap.add_argument("--steps", type=int, default=250)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.assets import synthetic_pair
+    from mano_hand_tpu.fitting import fit_hands, inter_penetration
+    from mano_hand_tpu.models import core
+
+    left, right = synthetic_pair(seed=0)
+    stacked = core.stack_params(
+        left.astype(np.float32), right.astype(np.float32)
+    )
+
+    # Ground truth: two hands ALMOST touching (4 mm apart) — then observe
+    # only their 21-keypoint skeletons, the typical detector output.
+    rng = np.random.default_rng(0)
+    pose = jnp.asarray(rng.normal(scale=0.2, size=(2, 16, 3)), jnp.float32)
+    shape = jnp.zeros((2, 10), jnp.float32)
+    out = jax.vmap(
+        lambda prm, p, s: core.forward(prm, p, s)
+    )(stacked, pose, shape)
+    trans = jnp.asarray([[0.0, 0, 0], [0.004, 0, 0]], jnp.float32)
+    targets = core.keypoints(out, "smplx") + trans[:, None, :]
+
+    def report(label, res):
+        o = jax.vmap(
+            lambda prm, p, s: core.forward(prm, p, s)
+        )(stacked, res.pose, res.shape)
+        verts = o.verts + res.trans[:, None, :]
+        kp = core.keypoints(o, "smplx") + res.trans[:, None, :]
+        pen = float(inter_penetration(verts[0], verts[1], radius=0.004))
+        fit_err = float(jnp.abs(kp - targets).max())
+        print(f"{label}: keypoint err {fit_err * 1e3:.2f} mm, "
+              f"penetration energy {pen:.3e}")
+        return pen
+
+    common = dict(n_steps=args.steps, lr=0.03, data_term="joints",
+                  fit_trans=True, tip_vertex_ids="smplx",
+                  shape_prior_weight=1e-3)
+    pen_off = report(
+        "without repulsion",
+        fit_hands(stacked, targets, repulsion_weight=0.0, **common),
+    )
+    pen_on = report(
+        "with repulsion   ",
+        fit_hands(stacked, targets, repulsion_weight=20.0,
+                  repulsion_radius=0.004, **common),
+    )
+    print(f"fit: repulsion cut penetration {pen_off / max(pen_on, 1e-12):.1f}x "
+          "while the keypoints still fit")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,7 @@ def test_example_runs(script, tmp_path):
         "04_keypoint2d_fitting": ["--steps", "150"],
         "05_sequence_tracking": ["--frames", "6", "--steps", "150"],
         "08_streaming_tracking": ["--frames", "4", "--steps", "4"],
+        "10_two_hands_fitting": ["--steps", "120"],
     }.get(script.stem, [])
     out = _run(script, *extra, tmp_path=tmp_path)
     assert any(k in out for k in ("wrote", "fit", "tracked", "fused kernel"))
