@@ -7,6 +7,8 @@ from mano_hand_tpu.fitting.objectives import (
     mahalanobis_pose_prior,
     max_vertex_error,
     pose_component_variances,
+    self_penetration,
+    self_penetration_mask,
     vertex_l2,
 )
 from mano_hand_tpu.fitting.hands import HandsFitResult, fit_hands
@@ -30,6 +32,8 @@ __all__ = [
     "SequenceFitResult",
     "fit_hands",
     "inter_penetration",
+    "self_penetration",
+    "self_penetration_mask",
     "fit",
     "fit_sequence",
     "fit_with_optimizer",

@@ -155,6 +155,61 @@ def inter_penetration(verts_a: jnp.ndarray,   # [..., V, 3]
     return 0.5 * (hinge(jnp.min(d2, axis=-1)) + hinge(jnp.min(d2, axis=-2)))
 
 
+def self_penetration_mask(params, radius: float = 0.004) -> jnp.ndarray:
+    """[V, V] bool mask of vertex pairs the self-penetration term may
+    penalize: pairs whose body parts are neither identical nor
+    kinematically adjacent, AND which are farther than ``radius`` apart
+    in the REST pose.
+
+    Segmenting by dominant skinning weight assigns each vertex to one of
+    the 16 parts; same-part and parent/child-part pairs are excluded
+    (surfaces that legitimately touch — the hinge would otherwise fire on
+    every knuckle crease at rest). The rest-pose distance filter removes
+    the remaining pairs that are already close in the neutral hand (e.g.
+    adjacent finger bases across different MCP chains): the term then
+    penalizes only configurations that move NON-neighboring surface
+    closer than the hand's neutral geometry allows — fingers passing
+    through each other, a thumb through the palm. Constant per asset:
+    compute once and reuse (a [V, V] bool is ~605 KB — one byte per
+    bool; the solvers' ``prepare_self_pen`` accepts a prebuilt mask via
+    ``_self_pen_mask``, which per-frame callers like the tracker use).
+    """
+    import numpy as np
+
+    w = np.asarray(params.lbs_weights)
+    parents = list(params.parents)
+    part = w.argmax(axis=1)                               # [V]
+    same = part[:, None] == part[None, :]
+    parent_of = np.array([p if p >= 0 else j
+                          for j, p in enumerate(parents)])
+    adjacent = (parent_of[part][:, None] == part[None, :]) | \
+               (parent_of[part][None, :] == part[:, None])
+    rest = np.asarray(params.v_template)
+    d2 = ((rest[:, None, :] - rest[None, :, :]) ** 2).sum(-1)
+    far_at_rest = d2 > radius * radius
+    return jnp.asarray(~(same | adjacent) & far_at_rest)
+
+
+def self_penetration(verts: jnp.ndarray,   # [..., V, 3]
+                     mask: jnp.ndarray,    # [V, V] from self_penetration_mask
+                     radius: float) -> jnp.ndarray:
+    """Soft SELF-collision repulsion for one hand (leading axes broadcast).
+
+    Hinge on distances between masked vertex pairs only — fingers may
+    touch (the mask excludes same/adjacent parts and rest-pose
+    neighbors) but not pass through each other, the failure mode of
+    sparse-observation fitting (16 or 21 keypoints say nothing about the
+    surface between them). Mean over each vertex's nearest masked
+    neighbor, matching ``inter_penetration``'s scale.
+    """
+    d2 = jnp.maximum(_pairwise_sq_dist(verts, verts), 0.0)    # [V, V]
+    # Unmasked pairs are pushed beyond the hinge instead of being
+    # dropped, so each row's min stays well-defined and differentiable.
+    d2 = jnp.where(mask, d2, (2.0 * radius) ** 2)
+    d = jnp.sqrt(jnp.maximum(jnp.min(d2, axis=-1), 1e-12))
+    return jnp.mean(jnp.maximum(radius - d, 0.0) ** 2)
+
+
 def l2_prior(x: jnp.ndarray) -> jnp.ndarray:
     """Quadratic prior toward zero (pose/shape regularizer)."""
     return jnp.mean(x ** 2)

@@ -222,6 +222,31 @@ def normalize_tips_kwarg(fn):
     return wrapper
 
 
+def prepare_self_pen(fn):
+    """Build the [V, V] self-penetration mask BEFORE the jit boundary.
+
+    The mask derives from concrete parameter arrays (numpy argmax over
+    skinning weights, rest-pose distances) — impossible inside jit where
+    params are tracers. ``self_penetration_weight`` is STATIC (a concrete
+    float; changing it recompiles): gating on it lets zero-weight fits
+    skip the [V, V] pairwise term and its backward entirely, which a
+    traced weight could not (the common case pays nothing).
+    """
+    @functools.wraps(fn)
+    def wrapper(params, *args, self_penetration_weight=0.0,
+                self_penetration_radius=0.004, _self_pen_mask=None, **kw):
+        if self_penetration_weight and _self_pen_mask is None:
+            _self_pen_mask = objectives.self_penetration_mask(
+                params, self_penetration_radius
+            )
+        return fn(params, *args,
+                  self_penetration_weight=self_penetration_weight,
+                  self_penetration_radius=self_penetration_radius,
+                  _self_pen_mask=_self_pen_mask, **kw)
+
+    return wrapper
+
+
 def check_keypoint_spec(params, data_term, tip_vertex_ids, keypoint_order,
                         target, fn_name):
     """Shared tip/order validation + target row check for every solver.
@@ -377,6 +402,9 @@ def _fit_single(
     pose_prior_vars: Optional[jnp.ndarray] = None,
     tips=None,
     keypoint_order: str = "mano",
+    self_penetration_weight: float = 0.0,
+    self_penetration_radius: float = 0.004,
+    self_pen_mask: Optional[jnp.ndarray] = None,
 ) -> FitResult:
     _check_data_term(data_term, camera, conf)
     _check_pose_prior(pose_prior, pose_space)
@@ -435,6 +463,13 @@ def _fit_single(
                       dtype, pose_prior_weight)
             + shape_prior_weight * objectives.l2_prior(p["shape"])
         )
+        if self_pen_mask is not None:
+            # Static gate (see prepare_self_pen): fingers must not pass
+            # through each other — the failure mode of sparse keypoint
+            # observations, which say nothing about the surface between.
+            reg = reg + self_penetration_weight * objectives.self_penetration(
+                out.verts, self_pen_mask, self_penetration_radius
+            )
         return data + reg, data
 
     p_final, final_loss, history = _run_adam(
@@ -451,11 +486,13 @@ def _fit_single(
 
 
 @normalize_tips_kwarg
+@prepare_self_pen
 @functools.partial(
     jax.jit,
     static_argnames=("n_steps", "pose_space", "n_pca", "data_term",
                      "fit_trans", "robust", "robust_scale", "pose_prior",
-                     "tip_vertex_ids", "keypoint_order"),
+                     "tip_vertex_ids", "keypoint_order",
+                     "self_penetration_weight", "self_penetration_radius"),
 )
 def fit(
     params: ManoParams,
@@ -478,6 +515,9 @@ def fit(
     pose_prior_vars: Optional[jnp.ndarray] = None,  # [C] component vars
     tip_vertex_ids=None,         # None | "smplx" | "manopth" | vertex ids
     keypoint_order: str = "mano",  # "mano" | "openpose" (21-kp targets)
+    self_penetration_weight: float = 0.0,   # STATIC: nonzero recompiles
+    self_penetration_radius: float = 0.004,
+    _self_pen_mask=None,         # built by prepare_self_pen; do not pass
 ) -> FitResult:
     """Recover pose/shape for one target mesh or a batch of them.
 
@@ -507,6 +547,14 @@ def fit(
     official mesh, or explicit vertex ids; ``keypoint_order="openpose"``
     matches OpenPose/FreiHAND-ordered targets. Fingertips pin the distal
     phalanx orientations that 16 joints leave entirely unobserved.
+
+    ``self_penetration_weight > 0`` (a STATIC float — changing it
+    recompiles; zero-weight fits skip the term entirely) adds
+    ``objectives.self_penetration``: a hinge that keeps non-adjacent
+    body parts — fingers, thumb vs palm — from passing through each
+    other, the classic failure of sparse keypoint observations. The
+    part-adjacency mask is built from the asset's skinning weights
+    before the jit boundary (``prepare_self_pen``).
     """
     return fit_with_optimizer(
         params, target_verts, optax.adam(lr),
@@ -517,9 +565,13 @@ def fit(
         fit_trans=fit_trans, robust=robust, robust_scale=robust_scale,
         init=init, pose_prior=pose_prior, pose_prior_vars=pose_prior_vars,
         tip_vertex_ids=tip_vertex_ids, keypoint_order=keypoint_order,
+        self_penetration_weight=self_penetration_weight,
+        self_penetration_radius=self_penetration_radius,
+        _self_pen_mask=_self_pen_mask,
     )
 
 
+@prepare_self_pen
 def fit_with_optimizer(
     params: ManoParams,
     target_verts: jnp.ndarray,
@@ -540,6 +592,9 @@ def fit_with_optimizer(
     pose_prior_vars: Optional[jnp.ndarray] = None,
     tip_vertex_ids=None,
     keypoint_order: str = "mano",
+    self_penetration_weight: float = 0.0,
+    self_penetration_radius: float = 0.004,
+    _self_pen_mask=None,
 ) -> FitResult:
     _check_data_term(data_term, camera, target_conf)
     target_verts = jnp.asarray(target_verts, params.v_template.dtype)
@@ -565,6 +620,9 @@ def fit_with_optimizer(
         pose_prior_vars=pose_prior_vars,
         tips=tips,
         keypoint_order=keypoint_order,
+        self_penetration_weight=self_penetration_weight,
+        self_penetration_radius=self_penetration_radius,
+        self_pen_mask=_self_pen_mask,
     )
     if data_term == "points" and target_verts.shape[-2] == 0:
         # A zero-point cloud (empty depth-scan foreground) would mean() over
@@ -604,11 +662,13 @@ class SequenceFitResult(NamedTuple):
 
 
 @normalize_tips_kwarg
+@prepare_self_pen
 @functools.partial(
     jax.jit,
     static_argnames=("n_steps", "data_term", "fit_trans", "robust",
                      "robust_scale", "pose_space", "pose_prior",
-                     "tip_vertex_ids", "keypoint_order"),
+                     "tip_vertex_ids", "keypoint_order",
+                     "self_penetration_weight", "self_penetration_radius"),
 )
 def fit_sequence(
     params: ManoParams,
@@ -630,6 +690,9 @@ def fit_sequence(
     pose_prior_vars: Optional[jnp.ndarray] = None,
     tip_vertex_ids=None,
     keypoint_order: str = "mano",
+    self_penetration_weight: float = 0.0,   # STATIC: nonzero recompiles
+    self_penetration_radius: float = 0.004,
+    _self_pen_mask=None,
 ) -> SequenceFitResult:
     """Track a whole motion clip as ONE optimization problem.
 
@@ -718,6 +781,12 @@ def fit_sequence(
                         dtype, pose_prior_weight)
             + shape_prior_weight * objectives.l2_prior(p["shape"])
         )
+        if _self_pen_mask is not None:
+            # self_penetration broadcasts over the frame axis; the final
+            # mean over [T, V] equals the mean of per-frame means.
+            reg = reg + self_penetration_weight * objectives.self_penetration(
+                out.verts, _self_pen_mask, self_penetration_radius
+            )
         return data + reg, data
 
     p_final, final_loss, history = _run_adam(

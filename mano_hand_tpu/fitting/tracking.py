@@ -70,6 +70,15 @@ def make_tracker(
     if solver == "lm" and fit_trans:
         raise ValueError("fit_trans requires solver='adam' (LM has no "
                          "translation DOF)")
+    if solver == "adam" and solver_kw.get("self_penetration_weight"):
+        # Build the [V, V] part-adjacency mask ONCE for the stream — the
+        # per-frame path must not redo the O(V^2) host build + transfer
+        # every frame (prepare_self_pen skips the rebuild when given).
+        from mano_hand_tpu.fitting import objectives
+
+        solver_kw.setdefault("_self_pen_mask", objectives.self_penetration_mask(
+            params, solver_kw.get("self_penetration_radius", 0.004)
+        ))
     dtype = params.v_template.dtype
     n_joints = params.j_regressor.shape[0]
     n_shape = params.shape_basis.shape[-1]
