@@ -22,6 +22,7 @@ from mano_hand_tpu.fitting.solvers import (
 from mano_hand_tpu.fitting.lm import LMResult, fit_lm
 from mano_hand_tpu.fitting.tracking import (
     TrackState,
+    make_hands_tracker,
     make_tracker,
     track_clip,
 )
@@ -40,6 +41,7 @@ __all__ = [
     "LMResult",
     "fit_lm",
     "TrackState",
+    "make_hands_tracker",
     "make_tracker",
     "track_clip",
     "vertex_l2",

@@ -157,37 +157,46 @@ def inter_penetration(verts_a: jnp.ndarray,   # [..., V, 3]
 
 def self_penetration_mask(params, radius: float = 0.004) -> jnp.ndarray:
     """[V, V] bool mask of vertex pairs the self-penetration term may
-    penalize: pairs whose body parts are neither identical nor
-    kinematically adjacent, AND which are farther than ``radius`` apart
-    in the REST pose.
+    penalize: pairs whose body parts lie on DIFFERENT kinematic chains
+    (neither is an ancestor of the other), AND which are farther than
+    ``radius`` apart in the REST pose.
 
     Segmenting by dominant skinning weight assigns each vertex to one of
-    the 16 parts; same-part and parent/child-part pairs are excluded
-    (surfaces that legitimately touch — the hinge would otherwise fire on
-    every knuckle crease at rest). The rest-pose distance filter removes
-    the remaining pairs that are already close in the neutral hand (e.g.
-    adjacent finger bases across different MCP chains): the term then
-    penalizes only configurations that move NON-neighboring surface
-    closer than the hand's neutral geometry allows — fingers passing
-    through each other, a thumb through the palm. Constant per asset:
-    compute once and reuse (a [V, V] bool is ~605 KB — one byte per
-    bool; the solvers' ``prepare_self_pen`` accepts a prebuilt mask via
-    ``_self_pen_mask``, which per-frame callers like the tracker use).
+    the 16 parts. The whole ancestor chain is excluded — not just
+    parent/child — because a curling finger legitimately brings its own
+    distal pad near its own proximal segment (DIP vs MCP parts are two
+    hops apart) and must not repel itself open. The rest-pose distance
+    filter removes cross-chain pairs already close in the neutral hand
+    (adjacent finger bases). What remains is cross-chain proximity —
+    fingers against each other, thumb against palm. Note the term is a
+    SOFT prior, like every repulsion regularizer: genuine cross-finger
+    contact pays a small hinge cost traded against the data weight; what
+    it prevents is the surface-through-surface solutions sparse
+    keypoints cannot rule out. Constant per asset: compute once and
+    reuse (a [V, V] bool is ~605 KB — one byte per bool; the solvers'
+    ``prepare_self_pen`` accepts a prebuilt mask via ``_self_pen_mask``,
+    which per-frame callers like the tracker use).
     """
     import numpy as np
 
     w = np.asarray(params.lbs_weights)
     parents = list(params.parents)
+    n_joints = w.shape[1]
     part = w.argmax(axis=1)                               # [V]
-    same = part[:, None] == part[None, :]
-    parent_of = np.array([p if p >= 0 else j
-                          for j, p in enumerate(parents)])
-    adjacent = (parent_of[part][:, None] == part[None, :]) | \
-               (parent_of[part][None, :] == part[:, None])
+    # ancestor[a, b] == True iff a is b or an ancestor of b.
+    ancestor = np.eye(n_joints, dtype=bool)
+    for j in range(n_joints):
+        k = parents[j]
+        while k is not None and k >= 0:
+            ancestor[k, j] = True
+            k = parents[k]
+    same_chain = ancestor | ancestor.T
     rest = np.asarray(params.v_template)
     d2 = ((rest[:, None, :] - rest[None, :, :]) ** 2).sum(-1)
     far_at_rest = d2 > radius * radius
-    return jnp.asarray(~(same | adjacent) & far_at_rest)
+    return jnp.asarray(
+        ~same_chain[part[:, None], part[None, :]] & far_at_rest
+    )
 
 
 def self_penetration(verts: jnp.ndarray,   # [..., V, 3]

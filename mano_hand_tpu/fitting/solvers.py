@@ -463,10 +463,12 @@ def _fit_single(
                       dtype, pose_prior_weight)
             + shape_prior_weight * objectives.l2_prior(p["shape"])
         )
-        if self_pen_mask is not None:
-            # Static gate (see prepare_self_pen): fingers must not pass
-            # through each other — the failure mode of sparse keypoint
-            # observations, which say nothing about the surface between.
+        if self_pen_mask is not None and self_penetration_weight:
+            # Static gate (see prepare_self_pen; the weight check keeps a
+            # prebuilt-mask-with-zero-weight call from tracing the dense
+            # term): fingers must not pass through each other — the
+            # failure mode of sparse keypoint observations, which say
+            # nothing about the surface between.
             reg = reg + self_penetration_weight * objectives.self_penetration(
                 out.verts, self_pen_mask, self_penetration_radius
             )
@@ -781,7 +783,7 @@ def fit_sequence(
                         dtype, pose_prior_weight)
             + shape_prior_weight * objectives.l2_prior(p["shape"])
         )
-        if _self_pen_mask is not None:
+        if _self_pen_mask is not None and self_penetration_weight:
             # self_penetration broadcasts over the frame axis; the final
             # mean over [T, V] equals the mean of per-frame means.
             reg = reg + self_penetration_weight * objectives.self_penetration(

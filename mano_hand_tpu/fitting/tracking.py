@@ -70,6 +70,11 @@ def make_tracker(
     if solver == "lm" and fit_trans:
         raise ValueError("fit_trans requires solver='adam' (LM has no "
                          "translation DOF)")
+    if solver == "lm" and solver_kw.get("self_penetration_weight"):
+        # Fail at build time like the fit_trans case above — not as a
+        # TypeError out of the first frame's solve.
+        raise ValueError("self_penetration_weight requires solver='adam' "
+                         "(LM's GN residual has no hinge term)")
     if solver == "adam" and solver_kw.get("self_penetration_weight"):
         # Build the [V, V] part-adjacency mask ONCE for the stream — the
         # per-frame path must not redo the O(V^2) host build + transfer
@@ -111,6 +116,59 @@ def make_tracker(
             pose=res.pose,
             shape=res.shape,
             trans=getattr(res, "trans", None),
+            frame=state.frame + 1,
+        )
+        return new_state, res
+
+    return state0, track_step
+
+
+def make_hands_tracker(
+    stacked: ManoParams,          # core.stack_params(left, right)
+    n_steps: int = 10,
+    data_term: str = "joints",
+    lr: float = 0.02,
+    fit_trans: bool = True,
+    shape_prior_weight: float = 1e-3,
+    camera=None,
+    **solver_kw,
+) -> Tuple[TrackState, Callable]:
+    """Streaming TWO-hand tracker over ``fit_hands`` (interacting hands).
+
+    Same contract as ``make_tracker`` but the state carries both hands
+    ([2, ...] leaves) and each frame solves them jointly — shared camera
+    for 2D terms, and the inter-penetration repulsion
+    (``repulsion_weight`` via ``**solver_kw``) keeps warm-started
+    surfaces from drifting through each other during close interaction,
+    which is exactly when per-hand trackers fail. ``fit_trans`` defaults
+    ON: real two-hand observations are never both origin-centered.
+    """
+    from mano_hand_tpu.fitting import hands as hands_mod
+
+    dtype = stacked.v_template.dtype
+    n_joints = stacked.j_regressor.shape[-2]
+    n_shape = stacked.shape_basis.shape[-1]
+    state0 = TrackState(
+        pose=jnp.zeros((2, n_joints, 3), dtype),
+        shape=jnp.zeros((2, n_shape), dtype),
+        trans=jnp.zeros((2, 3), dtype) if fit_trans else None,
+        frame=0,
+    )
+
+    def track_step(state: TrackState, target) -> Tuple[TrackState, object]:
+        target = jnp.asarray(target, dtype)
+        init = {"pose": state.pose, "shape": state.shape}
+        if fit_trans:
+            init["trans"] = state.trans
+        res = hands_mod.fit_hands(
+            stacked, target, n_steps=n_steps, lr=lr, data_term=data_term,
+            camera=camera, fit_trans=fit_trans,
+            shape_prior_weight=shape_prior_weight, init=init, **solver_kw,
+        )
+        new_state = TrackState(
+            pose=res.pose,
+            shape=res.shape,
+            trans=res.trans,
             frame=state.frame + 1,
         )
         return new_state, res

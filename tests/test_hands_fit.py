@@ -137,6 +137,34 @@ def test_repulsion_resolves_interpenetration(stacked):
     assert float(jnp.abs(kp - targets).max()) < 1e-2
 
 
+# --------------------------------------------------------------- tracking
+def test_hands_tracker_follows_smooth_motion(stacked):
+    """Streaming two-hand tracking: warm-started joint solves follow a
+    smooth clip with few steps per frame."""
+    from mano_hand_tpu.fitting import make_hands_tracker
+
+    rng = np.random.default_rng(5)
+    base = jnp.asarray(rng.normal(scale=0.2, size=(2, 16, 3)), jnp.float32)
+    drift = jnp.asarray(
+        rng.normal(scale=0.02, size=(4, 2, 16, 3)), jnp.float32
+    )
+    trans = jnp.asarray([[0.0, 0, 0], [0.08, 0, 0]], jnp.float32)
+
+    state, step = make_hands_tracker(
+        stacked, n_steps=150, data_term="joints", lr=0.05,
+        tip_vertex_ids="smplx",
+    )
+    for t in range(4):
+        pose_t = base + drift[: t + 1].sum(0)
+        out = _forward2(stacked, pose_t, jnp.zeros((2, 10), jnp.float32))
+        target = core.keypoints(out, "smplx") + trans[:, None, :]
+        state, res = step(state, target)
+    assert state.frame == 4
+    out = _forward2(stacked, res.pose, res.shape)
+    kp = core.keypoints(out, "smplx") + res.trans[:, None, :]
+    assert float(jnp.abs(kp - target).max()) < 5e-3
+
+
 # ---------------------------------------------------------------- errors
 def test_fit_hands_validations(stacked, params_pair):
     pose, shape, trans, targets = _two_hand_targets(stacked, seed=3)

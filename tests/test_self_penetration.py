@@ -36,16 +36,24 @@ def test_mask_structure(params32, mask):
     assert m.shape == (778, 778)
     np.testing.assert_array_equal(m, m.T)       # symmetric
     assert not m.diagonal().any()               # no self pairs
-    # No same-part or parent/child-part pair is maskable.
+    # No same-CHAIN pair is maskable: a curling finger brings its own
+    # distal pad near its own proximal segment (parts two hops apart on
+    # one chain) and must not repel itself open.
     part = np.asarray(params32.lbs_weights).argmax(axis=1)
     parents = list(params32.parents)
+
+    def chain(j):
+        out = {j}
+        while parents[j] is not None and parents[j] >= 0:
+            j = parents[j]
+            out.add(j)
+        return out
+
     hit = np.argwhere(m)
     pi, pj = part[hit[:, 0]], part[hit[:, 1]]
     assert (pi != pj).all()
-    for a, b in ((pi, pj), (pj, pi)):
-        parent_of_a = np.array([parents[x] if parents[x] >= 0 else x
-                                for x in a])
-        assert (parent_of_a != b).all()
+    for a, b in set(zip(pi.tolist(), pj.tolist())):
+        assert a not in chain(b) and b not in chain(a)
     # No rest-pose-close pair survives (the neutral hand must be free).
     rest = np.asarray(params32.v_template)
     d = np.linalg.norm(rest[hit[:, 0]] - rest[hit[:, 1]], axis=-1)
@@ -155,3 +163,17 @@ def test_zero_weight_pays_nothing(params32):
     assert captured["mask"] is None
     probe(params32, self_penetration_weight=1.0)
     assert captured["mask"] is not None
+    # A prebuilt mask with zero weight must also skip the dense term:
+    # the jitted loss gates on the weight, not on mask presence.
+    m = self_penetration_mask(params32, 0.004)
+    out = core.forward(params32, jnp.zeros((16, 3)), jnp.zeros((10,)))
+    res = fit(params32, out.verts, n_steps=3, _self_pen_mask=m)
+    assert np.isfinite(float(res.final_loss))
+
+
+def test_tracker_rejects_self_pen_under_lm(params32):
+    from mano_hand_tpu.fitting import make_tracker
+
+    with pytest.raises(ValueError, match="requires solver='adam'"):
+        make_tracker(params32, solver="lm", data_term="joints",
+                     self_penetration_weight=10.0)
