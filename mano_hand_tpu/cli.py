@@ -115,6 +115,18 @@ def cmd_animate(args) -> int:
     out = core.jit_forward_batched(
         params, jnp.asarray(poses, jnp.float32), jnp.asarray(shapes, jnp.float32)
     )
+    if str(args.out).endswith(".glb"):
+        # One self-contained viewer-ready file: the clip as a morph-target
+        # animation (drag into Blender / any glTF viewer and press play).
+        from mano_hand_tpu.io.gltf import export_glb
+
+        verts = np.asarray(out.verts)
+        path = export_glb(
+            verts[0], np.asarray(params.faces), args.out,
+            morph_frames=list(verts), fps=args.fps,
+        )
+        print(f"wrote {poses.shape[0]}-frame animated GLB to {path}")
+        return 0
     paths = export_obj_sequence(
         np.asarray(out.verts), np.asarray(params.faces), args.out
     )
@@ -498,7 +510,11 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument("poses", help=".npy of [T,16,3] or [T,15,3] axis-angles")
     a.add_argument("--asset", default="synthetic")
     a.add_argument("--side", default=None, choices=[None, "left", "right"])
-    a.add_argument("--out", default="frames")
+    a.add_argument("--out", default="frames",
+                   help="output dir for OBJ frames, or a .glb path for "
+                        "ONE viewer-ready animated file (morph targets)")
+    a.add_argument("--fps", type=float, default=30.0,
+                   help="playback rate for --out .glb")
     a.set_defaults(fn=cmd_animate)
 
     r = sub.add_parser("render", help="rasterize poses to PNG/GIF")

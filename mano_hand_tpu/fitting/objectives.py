@@ -162,14 +162,19 @@ def self_penetration_mask(params, radius: float = 0.004) -> jnp.ndarray:
     ``radius`` apart in the REST pose.
 
     Segmenting by dominant skinning weight assigns each vertex to one of
-    the 16 parts. The whole ancestor chain is excluded — not just
-    parent/child — because a curling finger legitimately brings its own
-    distal pad near its own proximal segment (DIP vs MCP parts are two
-    hops apart) and must not repel itself open. The rest-pose distance
-    filter removes cross-chain pairs already close in the neutral hand
-    (adjacent finger bases). What remains is cross-chain proximity —
-    fingers against each other, thumb against palm. Note the term is a
-    SOFT prior, like every repulsion regularizer: genuine cross-finger
+    the 16 parts. A finger's whole NON-ROOT ancestor chain is excluded —
+    not just parent/child — because a curling finger legitimately brings
+    its own distal pad near its own proximal segment (DIP vs MCP parts
+    are two hops apart) and must not repel itself open. The root is
+    special-cased: it is every joint's ancestor, so excluding ancestor
+    relations through it would silently free ALL palm pairs — exactly
+    the thumb-through-palm case the term exists for. Palm keeps only
+    direct parent/child adjacency (the knuckle-base regions that
+    genuinely overlap it). The rest-pose distance filter removes
+    remaining pairs already close in the neutral hand (adjacent finger
+    bases). What remains is cross-chain proximity — fingers against each
+    other, thumb and fingers against the palm. Note the term is a SOFT
+    prior, like every repulsion regularizer: genuine cross-finger
     contact pays a small hinge cost traded against the data weight; what
     it prevents is the surface-through-surface solutions sparse
     keypoints cannot rule out. Constant per asset: compute once and
@@ -183,19 +188,25 @@ def self_penetration_mask(params, radius: float = 0.004) -> jnp.ndarray:
     parents = list(params.parents)
     n_joints = w.shape[1]
     part = w.argmax(axis=1)                               # [V]
-    # ancestor[a, b] == True iff a is b or an ancestor of b.
-    ancestor = np.eye(n_joints, dtype=bool)
+    # excluded[a, b]: same part, direct parent/child, or same-chain via
+    # NON-root ancestors (the root is everyone's ancestor — routing the
+    # chain relation through it would exempt every palm pair).
+    excluded = np.eye(n_joints, dtype=bool)
     for j in range(n_joints):
-        k = parents[j]
-        while k is not None and k >= 0:
-            ancestor[k, j] = True
-            k = parents[k]
-    same_chain = ancestor | ancestor.T
+        p = parents[j]
+        if p is not None and p >= 0:
+            excluded[p, j] = excluded[j, p] = True        # direct
+            k = parents[p]
+            while k is not None and k >= 0 and parents[k] is not None \
+                    and parents[k] >= 0:
+                # k is a non-root strict ancestor of j.
+                excluded[k, j] = excluded[j, k] = True
+                k = parents[k]
     rest = np.asarray(params.v_template)
     d2 = ((rest[:, None, :] - rest[None, :, :]) ** 2).sum(-1)
     far_at_rest = d2 > radius * radius
     return jnp.asarray(
-        ~same_chain[part[:, None], part[None, :]] & far_at_rest
+        ~excluded[part[:, None], part[None, :]] & far_at_rest
     )
 
 

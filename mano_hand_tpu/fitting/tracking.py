@@ -143,8 +143,21 @@ def make_hands_tracker(
     which is exactly when per-hand trackers fail. ``fit_trans`` defaults
     ON: real two-hand observations are never both origin-centered.
     """
+    import inspect
+
     from mano_hand_tpu.fitting import hands as hands_mod
 
+    # Validate pass-through kwargs at BUILD time (same policy as
+    # make_tracker's explicit checks): an unsupported option must not
+    # surface as a TypeError out of the first live frame's solve.
+    allowed = set(inspect.signature(hands_mod.fit_hands).parameters)
+    unknown = set(solver_kw) - allowed
+    if unknown:
+        raise ValueError(
+            f"make_hands_tracker got options fit_hands does not take: "
+            f"{sorted(unknown)} (e.g. self_penetration_* and ICP options "
+            "are single-hand fit/fit_lm features)"
+        )
     dtype = stacked.v_template.dtype
     n_joints = stacked.j_regressor.shape[-2]
     n_shape = stacked.shape_basis.shape[-1]

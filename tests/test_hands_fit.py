@@ -165,6 +165,13 @@ def test_hands_tracker_follows_smooth_motion(stacked):
     assert float(jnp.abs(kp - target).max()) < 5e-3
 
 
+def test_hands_tracker_rejects_unknown_options(stacked):
+    from mano_hand_tpu.fitting import make_hands_tracker
+
+    with pytest.raises(ValueError, match="does not take"):
+        make_hands_tracker(stacked, self_penetration_weight=10.0)
+
+
 # ---------------------------------------------------------------- errors
 def test_fit_hands_validations(stacked, params_pair):
     pose, shape, trans, targets = _two_hand_targets(stacked, seed=3)

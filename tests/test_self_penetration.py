@@ -36,24 +36,32 @@ def test_mask_structure(params32, mask):
     assert m.shape == (778, 778)
     np.testing.assert_array_equal(m, m.T)       # symmetric
     assert not m.diagonal().any()               # no self pairs
-    # No same-CHAIN pair is maskable: a curling finger brings its own
-    # distal pad near its own proximal segment (parts two hops apart on
-    # one chain) and must not repel itself open.
+    # Exclusion rule: same part, direct parent/child, or same chain via
+    # NON-root ancestors (a curling finger must not repel itself open;
+    # the root is everyone's ancestor and must NOT free palm pairs).
     part = np.asarray(params32.lbs_weights).argmax(axis=1)
     parents = list(params32.parents)
+    root = parents.index(-1)
 
-    def chain(j):
-        out = {j}
-        while parents[j] is not None and parents[j] >= 0:
-            j = parents[j]
-            out.add(j)
+    def nonroot_ancestors(j):
+        out = set()
+        k = parents[j]
+        while k is not None and k >= 0:
+            if k != root:
+                out.add(k)
+            k = parents[k]
         return out
 
     hit = np.argwhere(m)
-    pi, pj = part[hit[:, 0]], part[hit[:, 1]]
-    assert (pi != pj).all()
-    for a, b in set(zip(pi.tolist(), pj.tolist())):
-        assert a not in chain(b) and b not in chain(a)
+    pairs = set(zip(part[hit[:, 0]].tolist(), part[hit[:, 1]].tolist()))
+    for a, b in pairs:
+        assert a != b
+        assert parents[b] != a and parents[a] != b       # not direct
+        assert a not in nonroot_ancestors(b)
+        assert b not in nonroot_ancestors(a)
+    # Regression guard: palm vs NON-child finger parts must stay
+    # penalizable — thumb-through-palm is the canonical case.
+    assert any(root in (a, b) for a, b in pairs)
     # No rest-pose-close pair survives (the neutral hand must be free).
     rest = np.asarray(params32.v_template)
     d = np.linalg.norm(rest[hit[:, 0]] - rest[hit[:, 1]], axis=-1)
