@@ -149,14 +149,20 @@ def make_hands_tracker(
 
     # Validate pass-through kwargs at BUILD time (same policy as
     # make_tracker's explicit checks): an unsupported option must not
-    # surface as a TypeError out of the first live frame's solve.
-    allowed = set(inspect.signature(hands_mod.fit_hands).parameters)
+    # surface as a TypeError out of the first live frame's solve. Names
+    # the wrapper itself supplies are just as invalid in solver_kw —
+    # they would collide as "multiple values for argument" at frame 1.
+    allowed = set(inspect.signature(hands_mod.fit_hands).parameters) - {
+        "stacked", "targets", "n_steps", "lr", "data_term", "camera",
+        "fit_trans", "shape_prior_weight", "init",
+    }
     unknown = set(solver_kw) - allowed
     if unknown:
         raise ValueError(
-            f"make_hands_tracker got options fit_hands does not take: "
-            f"{sorted(unknown)} (e.g. self_penetration_* and ICP options "
-            "are single-hand fit/fit_lm features)"
+            f"make_hands_tracker got options it cannot pass to fit_hands: "
+            f"{sorted(unknown)} (tracker-managed arguments like 'init' are "
+            "set per frame; self_penetration_*/ICP options are single-hand "
+            "fit/fit_lm features)"
         )
     dtype = stacked.v_template.dtype
     n_joints = stacked.j_regressor.shape[-2]

@@ -102,6 +102,11 @@ def export_glb(
     }
 
     if morph_frames is not None:
+        if not fps > 0:
+            # arange/fps would put inf/nan keyframe times into the JSON
+            # chunk (json.dumps emits bare Infinity — invalid glTF that
+            # strict viewers reject with an opaque parse error).
+            raise ValueError(f"fps must be > 0, got {fps}")
         frames = [np.asarray(f, np.float32) for f in morph_frames]
         if not frames:
             raise ValueError("morph_frames is empty")

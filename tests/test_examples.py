@@ -33,6 +33,8 @@ def test_example_runs(script, tmp_path):
         "05_sequence_tracking": ["--frames", "6", "--steps", "150"],
         "08_streaming_tracking": ["--frames", "4", "--steps", "4"],
         "10_two_hands_fitting": ["--steps", "120"],
+        "11_neural_pose_regression": ["--steps", "150", "--batch", "16"],
     }.get(script.stem, [])
     out = _run(script, *extra, tmp_path=tmp_path)
-    assert any(k in out for k in ("wrote", "fit", "tracked", "fused kernel"))
+    assert any(k in out for k in ("wrote", "fit", "tracked", "fused kernel",
+                                  "trained"))

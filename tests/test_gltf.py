@@ -94,6 +94,9 @@ def test_glb_validations(params32, tmp_path):
     with pytest.raises(ValueError, match="morph frame shape"):
         export_glb(verts, faces, tmp_path / "x.glb",
                    morph_frames=[verts[:100]])
+    with pytest.raises(ValueError, match="fps must be"):
+        export_glb(verts, faces, tmp_path / "x.glb",
+                   morph_frames=[verts], fps=0.0)
     bad = tmp_path / "bad.glb"
     bad.write_bytes(b"not a glb")
     with pytest.raises(ValueError, match="bad magic"):

@@ -168,8 +168,16 @@ def test_hands_tracker_follows_smooth_motion(stacked):
 def test_hands_tracker_rejects_unknown_options(stacked):
     from mano_hand_tpu.fitting import make_hands_tracker
 
-    with pytest.raises(ValueError, match="does not take"):
+    with pytest.raises(ValueError, match="cannot pass"):
         make_hands_tracker(stacked, self_penetration_weight=10.0)
+    # Tracker-managed arguments are rejected at build time too — they
+    # would collide with the per-frame warm start at frame 1 otherwise.
+    with pytest.raises(ValueError, match="cannot pass"):
+        make_hands_tracker(
+            stacked,
+            init={"pose": np.zeros((2, 16, 3), np.float32),
+                  "shape": np.zeros((2, 10), np.float32)},
+        )
 
 
 # ---------------------------------------------------------------- errors
