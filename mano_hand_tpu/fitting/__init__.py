@@ -11,7 +11,12 @@ from mano_hand_tpu.fitting.objectives import (
     self_penetration_mask,
     vertex_l2,
 )
-from mano_hand_tpu.fitting.hands import HandsFitResult, fit_hands
+from mano_hand_tpu.fitting.hands import (
+    HandsFitResult,
+    HandsSequenceFitResult,
+    fit_hands,
+    fit_hands_sequence,
+)
 from mano_hand_tpu.fitting.solvers import (
     FitResult,
     SequenceFitResult,
@@ -32,6 +37,8 @@ __all__ = [
     "HandsFitResult",
     "SequenceFitResult",
     "fit_hands",
+    "fit_hands_sequence",
+    "HandsSequenceFitResult",
     "inter_penetration",
     "self_penetration",
     "self_penetration_mask",

@@ -170,3 +170,138 @@ def fit_hands(
         loss_history=history,
         trans=p_final.get("trans"),
     )
+
+
+class HandsSequenceFitResult(NamedTuple):
+    pose: jnp.ndarray          # [T, 2, 16, 3] per-frame, per-hand
+    shape: jnp.ndarray         # [2, S] ONE shape per hand for the clip
+    final_loss: jnp.ndarray    # []
+    loss_history: jnp.ndarray  # [n_steps]
+    trans: Optional[jnp.ndarray] = None  # [T, 2, 3] when fit_trans=True
+
+
+@solvers.normalize_tips_kwarg
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_steps", "data_term", "fit_trans", "robust",
+                     "robust_scale", "tip_vertex_ids", "keypoint_order"),
+)
+def fit_hands_sequence(
+    stacked: ManoParams,        # core.stack_params(left, right)
+    targets: jnp.ndarray,       # [T, 2, rows, coords] frame-major
+    n_steps: int = 300,
+    lr: float = 0.03,
+    data_term: str = "verts",
+    camera=None,
+    target_conf: Optional[jnp.ndarray] = None,  # [K] or [2, K]
+    fit_trans: bool = False,
+    robust: str = "none",
+    robust_scale: float = 0.01,
+    smooth_pose_weight: float = 1e-3,
+    smooth_trans_weight: float = 1e-3,
+    pose_prior_weight: float = 0.0,
+    shape_prior_weight: float = 1e-3,
+    repulsion_weight: float = 0.0,
+    repulsion_radius: float = 0.004,
+    tip_vertex_ids=None,
+    keypoint_order: str = "mano",
+) -> HandsSequenceFitResult:
+    """Track a two-hand clip as ONE optimization problem.
+
+    The two-hand counterpart of ``fit_sequence`` (frame-major
+    ``[T, 2, rows, coords]`` targets, matching
+    ``anim.evaluate_two_hand_sequence``'s layout): each hand keeps ONE
+    shape across the clip, per-frame pose (and translation), with
+    squared-velocity smoothness coupling consecutive frames — occluded
+    frames borrow from their neighbors AND from the other hand's
+    repulsion constraint when ``repulsion_weight > 0`` (applied per
+    frame: interacting-hands clips are exactly where observations go
+    missing and surfaces drift through each other).
+    """
+    if stacked.side != "stacked":
+        raise ValueError(
+            "fit_hands_sequence takes core.stack_params(left, right) "
+            f"output; got side={stacked.side!r}. For one hand use "
+            "fit_sequence()."
+        )
+    solvers._check_data_term(data_term, camera, target_conf)
+    if data_term == "points":
+        raise ValueError(
+            "fit_hands_sequence supports verts/joints/keypoints2d"
+        )
+    dtype = stacked.v_template.dtype
+    targets = jnp.asarray(targets, dtype)
+    if targets.ndim != 4 or targets.shape[1] != 2:
+        raise ValueError(
+            "targets must be [T, 2, rows, coords] frame-major, got "
+            f"{targets.shape}; for one frame use fit_hands()"
+        )
+    one = jax.tree_util.tree_map(lambda x: x[0], stacked)
+    tips, n_kp = solvers.check_keypoint_spec(
+        one, data_term, tip_vertex_ids, keypoint_order, targets,
+        "fit_hands_sequence",
+    )
+    t_frames = targets.shape[0]
+    n_joints = one.j_regressor.shape[0]
+    n_shape = one.shape_basis.shape[-1]
+    target_conf = solvers.normalize_conf(target_conf, n_kp, dtype)
+    if target_conf is not None:
+        target_conf = jnp.broadcast_to(target_conf, (t_frames, 2, n_kp))
+
+    theta0 = {
+        "pose": jnp.zeros((t_frames, 2, n_joints, 3), dtype),
+        "shape": jnp.zeros((2, n_shape), dtype),
+    }
+    if fit_trans:
+        theta0["trans"] = jnp.zeros((t_frames, 2, 3), dtype)
+
+    def loss_fn(p):
+        # Hand-major forward ([2, T, ...]): vmap the batched per-hand
+        # forward over the hand axis of params AND variables, then view
+        # frame-major for the data term.
+        pose_hm = jnp.swapaxes(p["pose"], 0, 1)          # [2, T, 16, 3]
+        shapes_hm = jnp.broadcast_to(
+            p["shape"][:, None, :], (2, t_frames, n_shape)
+        )
+        out_hm = jax.vmap(
+            lambda prm, pp, ss: core.forward_batched(prm, pp, ss)
+        )(stacked, pose_hm, shapes_hm)
+        out = jax.tree_util.tree_map(
+            lambda x: jnp.swapaxes(x, 0, 1), out_hm     # [T, 2, ...]
+        )
+        offset = p["trans"][..., None, :] if fit_trans else 0.0
+        data = solvers._data_loss(
+            out, offset, targets, data_term, camera, target_conf,
+            robust, robust_scale, tips, keypoint_order,
+        )
+        if t_frames > 1:
+            vel = p["pose"][1:] - p["pose"][:-1]
+            reg = smooth_pose_weight * jnp.mean(vel ** 2)
+            if fit_trans:
+                tvel = p["trans"][1:] - p["trans"][:-1]
+                reg = reg + smooth_trans_weight * jnp.mean(tvel ** 2)
+        else:
+            reg = jnp.zeros((), dtype)
+        reg = (
+            reg
+            + pose_prior_weight * objectives.l2_prior(p["pose"][:, :, 1:])
+            + shape_prior_weight * objectives.l2_prior(p["shape"])
+        )
+        verts = out.verts + offset
+        # inter_penetration broadcasts over the frame axis: [T, V, 3]
+        # per hand -> mean over frames comes out of the hinge means.
+        reg = reg + repulsion_weight * objectives.inter_penetration(
+            verts[:, 0], verts[:, 1], repulsion_radius
+        )
+        return data + reg, data
+
+    p_final, final_loss, history = solvers._run_adam(
+        loss_fn, theta0, optax.adam(lr), n_steps
+    )
+    return HandsSequenceFitResult(
+        pose=p_final["pose"],
+        shape=p_final["shape"],
+        final_loss=final_loss,
+        loss_history=history,
+        trans=p_final.get("trans"),
+    )

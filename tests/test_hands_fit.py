@@ -137,6 +137,62 @@ def test_repulsion_resolves_interpenetration(stacked):
     assert float(jnp.abs(kp - targets).max()) < 1e-2
 
 
+# --------------------------------------------------------------- sequence
+def test_fit_hands_sequence_recovers_clip(stacked):
+    """Offline joint two-hand clip solve: one shape per hand, per-frame
+    pose/translation, smoothness; frame-major [T, 2, ...] targets."""
+    from mano_hand_tpu.fitting import fit_hands_sequence
+
+    rng = np.random.default_rng(6)
+    t_frames = 4
+    base = jnp.asarray(rng.normal(scale=0.2, size=(2, 16, 3)), jnp.float32)
+    drift = jnp.asarray(
+        np.cumsum(rng.normal(scale=0.02, size=(t_frames, 2, 16, 3)), axis=0),
+        jnp.float32,
+    )
+    poses = base + drift                               # [T, 2, 16, 3]
+    trans = jnp.asarray([[0.0, 0, 0], [0.09, 0, 0]], jnp.float32)
+    outs = jax.vmap(
+        lambda prm, pp, ss: core.forward_batched(prm, pp, ss)
+    )(stacked, jnp.swapaxes(poses, 0, 1),
+      jnp.zeros((2, t_frames, 10), jnp.float32))
+    targets = (
+        jnp.swapaxes(core.keypoints(outs, "smplx"), 0, 1)
+        + trans[None, :, None, :]
+    )                                                   # [T, 2, 21, 3]
+
+    res = fit_hands_sequence(
+        stacked, targets, n_steps=400, lr=0.04, data_term="joints",
+        fit_trans=True, tip_vertex_ids="smplx", repulsion_weight=1.0,
+    )
+    assert res.pose.shape == (t_frames, 2, 16, 3)
+    assert res.shape.shape == (2, 10)
+    assert res.trans.shape == (t_frames, 2, 3)
+    outs2 = jax.vmap(
+        lambda prm, pp, ss: core.forward_batched(prm, pp, ss)
+    )(stacked, jnp.swapaxes(res.pose, 0, 1),
+      jnp.broadcast_to(res.shape[:, None], (2, t_frames, 10)))
+    kp = (
+        jnp.swapaxes(core.keypoints(outs2, "smplx"), 0, 1)
+        + res.trans[..., None, :]
+    )
+    assert float(jnp.abs(kp - targets).max()) < 5e-3
+
+
+def test_fit_hands_sequence_validations(stacked, params_pair):
+    from mano_hand_tpu.fitting import fit_hands_sequence
+
+    left, _ = params_pair
+    t = jnp.zeros((3, 2, 16, 3), jnp.float32)
+    with pytest.raises(ValueError, match="stack_params"):
+        fit_hands_sequence(left.astype(np.float32), t, n_steps=2,
+                           data_term="joints")
+    with pytest.raises(ValueError, match="frame-major"):
+        fit_hands_sequence(stacked, t[0], n_steps=2, data_term="joints")
+    with pytest.raises(ValueError, match="verts/joints/keypoints2d"):
+        fit_hands_sequence(stacked, t, n_steps=2, data_term="points")
+
+
 # --------------------------------------------------------------- tracking
 def test_hands_tracker_follows_smooth_motion(stacked):
     """Streaming two-hand tracking: warm-started joint solves follow a
