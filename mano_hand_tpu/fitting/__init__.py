@@ -30,6 +30,7 @@ from mano_hand_tpu.fitting.tracking import (
     make_hands_tracker,
     make_tracker,
     track_clip,
+    track_hands_clip,
 )
 
 __all__ = [
@@ -51,6 +52,7 @@ __all__ = [
     "make_hands_tracker",
     "make_tracker",
     "track_clip",
+    "track_hands_clip",
     "vertex_l2",
     "joint_l2",
     "keypoint2d_l2",

@@ -210,9 +210,34 @@ def track_clip(
     """
     targets = jnp.asarray(targets)
     state, step = make_tracker(params, **tracker_kw)
+    return _run_clip(state, step, targets)
+
+
+def _run_clip(state, step, targets):
+    """The one frame-loop body shared by both clip conveniences."""
     poses, shapes = [], []
     for t in range(targets.shape[0]):
         state, _ = step(state, targets[t])
         poses.append(state.pose)
         shapes.append(state.shape)
     return jnp.stack(poses), jnp.stack(shapes), state
+
+
+def track_hands_clip(
+    stacked: ManoParams,
+    targets,                      # [T, 2, rows, coords] frame-major
+    **tracker_kw,
+):
+    """Two-hand ``track_clip``: causal streaming over a recorded clip.
+
+    Returns ``(poses [T, 2, J, 3], shapes [T, 2, S], final_state)`` —
+    the online counterpart of ``fit_hands_sequence`` (which solves the
+    clip jointly, acausally).
+    """
+    targets = jnp.asarray(targets)
+    if targets.ndim != 4 or targets.shape[1] != 2:
+        raise ValueError(
+            f"targets must be [T, 2, rows, coords], got {targets.shape}"
+        )
+    state, step = make_hands_tracker(stacked, **tracker_kw)
+    return _run_clip(state, step, targets)

@@ -221,6 +221,39 @@ def test_hands_tracker_follows_smooth_motion(stacked):
     assert float(jnp.abs(kp - target).max()) < 5e-3
 
 
+def test_track_hands_clip(stacked):
+    from mano_hand_tpu.fitting import track_hands_clip
+
+    rng = np.random.default_rng(7)
+    t_frames = 3
+    poses = jnp.asarray(
+        rng.normal(scale=0.15, size=(2, 16, 3)), jnp.float32
+    ) + jnp.asarray(
+        np.cumsum(rng.normal(scale=0.02, size=(t_frames, 2, 16, 3)), 0),
+        jnp.float32,
+    )
+    outs = jax.vmap(
+        lambda prm, pp, ss: core.forward_batched(prm, pp, ss)
+    )(stacked, jnp.swapaxes(poses, 0, 1),
+      jnp.zeros((2, t_frames, 10), jnp.float32))
+    targets = jnp.swapaxes(outs.posed_joints, 0, 1)      # [T, 2, 16, 3]
+
+    p_track, s_track, state = track_hands_clip(
+        stacked, targets, n_steps=120, data_term="joints", lr=0.05,
+        fit_trans=False,
+    )
+    assert p_track.shape == (t_frames, 2, 16, 3)
+    assert s_track.shape == (t_frames, 2, 10)
+    assert state.frame == t_frames
+    out_last = jax.vmap(
+        lambda prm, pp, ss: core.forward(prm, pp, ss)
+    )(stacked, p_track[-1], s_track[-1])
+    err = float(jnp.abs(out_last.posed_joints - targets[-1]).max())
+    assert err < 5e-3
+    with pytest.raises(ValueError, match=r"\[T, 2, rows"):
+        track_hands_clip(stacked, targets[0], n_steps=2)
+
+
 def test_hands_tracker_rejects_unknown_options(stacked):
     from mano_hand_tpu.fitting import make_hands_tracker
 
