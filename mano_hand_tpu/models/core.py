@@ -542,18 +542,7 @@ def forward_chunked(
     defaults are the bench sweep's winners (docs/benchmarking.md).
     """
     b = pose.shape[0]
-    chunk_size = max(1, min(chunk_size, b))  # max(1,..) keeps B=0 legal
-    pad = (-b) % chunk_size
-    if pad:
-        pose = jnp.concatenate(
-            [pose, jnp.zeros((pad, *pose.shape[1:]), pose.dtype)]
-        )
-        shape = jnp.concatenate(
-            [shape, jnp.zeros((pad, *shape.shape[1:]), shape.dtype)]
-        )
-    n_chunks = (b + pad) // chunk_size
-    pose_c = pose.reshape(n_chunks, chunk_size, *pose.shape[1:])
-    shape_c = shape.reshape(n_chunks, chunk_size, *shape.shape[1:])
+    pose_c, shape_c, chunk_size = _pad_and_chunk(pose, shape, chunk_size)
     if use_pallas_fused_full:
         # Each kernel route defaults to ITS OWN swept tile, not the other's.
         bb = FUSED_FULL_BEST_BLOCK_B if block_b is None else block_b
@@ -579,7 +568,59 @@ def forward_chunked(
             params, ps[0], ps[1], precision
         ).verts
     verts = jax.lax.map(chunk_fn, (pose_c, shape_c))
-    return verts.reshape(n_chunks * chunk_size, *verts.shape[2:])[:b]
+    return verts.reshape(-1, *verts.shape[2:])[:b]
+
+
+def _pad_and_chunk(pose, shape, chunk_size):
+    """Zero-pad the batch to a chunk multiple and reshape to
+    [n_chunks, chunk, ...] — the shared scaffolding of every chunked
+    evaluator (static pad, jit-safe)."""
+    b = pose.shape[0]
+    chunk_size = max(1, min(chunk_size, b))  # max(1,..) keeps B=0 legal
+    pad = (-b) % chunk_size
+    if pad:
+        pose = jnp.concatenate(
+            [pose, jnp.zeros((pad, *pose.shape[1:]), pose.dtype)]
+        )
+        shape = jnp.concatenate(
+            [shape, jnp.zeros((pad, *shape.shape[1:]), shape.dtype)]
+        )
+    n_chunks = (b + pad) // chunk_size
+    return (
+        pose.reshape(n_chunks, chunk_size, *pose.shape[1:]),
+        shape.reshape(n_chunks, chunk_size, *shape.shape[1:]),
+        chunk_size,
+    )
+
+
+def keypoints_chunked(
+    params: ManoParams,
+    pose: jnp.ndarray,     # [B, J, 3]
+    shape: jnp.ndarray,    # [B, S]
+    tip_vertex_ids=None,
+    order: str = "mano",
+    chunk_size: int = 8192,
+    precision=DEFAULT_PRECISION,
+) -> jnp.ndarray:
+    """Huge-batch keypoints [B, 16(+T), 3] without a [B, V, 3] vertex slab.
+
+    The synthetic-data-factory path: generating 21-keypoint labels for
+    millions of poses (e.g. to train a neural regressor, examples/11)
+    needs only the [B, K, 3] keypoints — 250 MB at B=1M versus 9.3 GB of
+    vertices. Chunks evaluate through the fused-basis forward and reduce
+    to keypoints in-chunk, so full-mesh vertices never accumulate across
+    the batch.
+    """
+    b = pose.shape[0]
+    tips = resolve_tip_ids(tip_vertex_ids, params.v_template.shape[-2])
+    pose_c, shape_c, _ = _pad_and_chunk(pose, shape, chunk_size)
+
+    def chunk_fn(ps):
+        out = forward_batched(params, ps[0], ps[1], precision)
+        return select_keypoints(out.verts, out.posed_joints, tips, order)
+
+    kp = jax.lax.map(chunk_fn, (pose_c, shape_c))
+    return kp.reshape(-1, *kp.shape[2:])[:b]
 
 
 @functools.partial(jax.jit, static_argnames=("precision",))

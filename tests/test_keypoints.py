@@ -78,6 +78,29 @@ def test_keypoints_batched(params32):
     )
 
 
+def test_keypoints_chunked_matches_unchunked(params32):
+    """Odd batch (partial trailing chunk) through the chunked reducer
+    equals the direct path — padding never leaks into results."""
+    rng = np.random.default_rng(13)
+    b = 37
+    pose = jnp.asarray(rng.normal(scale=0.3, size=(b, 16, 3)), jnp.float32)
+    beta = jnp.asarray(rng.normal(size=(b, 10)), jnp.float32)
+    ref = core.keypoints(
+        core.forward_batched(params32, pose, beta), "smplx", "openpose"
+    )
+    kp = core.keypoints_chunked(params32, pose, beta, "smplx",
+                                order="openpose", chunk_size=16)
+    assert kp.shape == (b, 21, 3)
+    np.testing.assert_allclose(np.asarray(kp), np.asarray(ref), atol=1e-6)
+    # 16-joint variant, chunk larger than batch.
+    kp16 = core.keypoints_chunked(params32, pose, beta, chunk_size=1024)
+    np.testing.assert_allclose(
+        np.asarray(kp16),
+        np.asarray(core.forward_batched(params32, pose, beta).posed_joints),
+        atol=1e-6,
+    )
+
+
 def test_openpose_permutation_is_consistent():
     perm = np.array(constants.MANO21_TO_OPENPOSE)
     assert sorted(perm.tolist()) == list(range(21))  # bijection
