@@ -275,6 +275,43 @@ def forward_batched(
     )(pose, shape)
 
 
+def sample_poses(
+    params: ManoParams,
+    key,                     # jax PRNG key
+    n: int,
+    pca_scale: float = 1.0,
+    global_rot_scale: float = 0.0,
+    component_vars: Optional[jnp.ndarray] = None,
+    precision=DEFAULT_PRECISION,
+) -> jnp.ndarray:
+    """Draw ``n`` anatomically plausible random poses [n, J, 3].
+
+    Samples PCA coefficients ``z ~ N(0, pca_scale^2 I)`` (optionally
+    scaled per component by ``component_vars``, e.g. from
+    ``fitting.pose_component_variances`` over scan poses) and decodes
+    through the asset's basis + MEAN pose — the distribution the model
+    was built from (/root/reference/dump_model.py:24-43 is the
+    reference's implicit version of this: scan poses ARE decoded
+    coefficients). Unlike raw axis-angle noise, samples bend joints
+    along directions real hands use — the right prior for synthetic
+    training data (examples/11, ``keypoints_chunked``) and for
+    randomized fitting restarts. ``global_rot_scale > 0`` adds a random
+    axis-angle global rotation row.
+    """
+    k1, k2 = jax.random.split(jnp.asarray(key))
+    n_pca = params.pca_mean.shape[-1]
+    dtype = params.v_template.dtype
+    z = jax.random.normal(k1, (n, n_pca), dtype) * pca_scale
+    if component_vars is not None:
+        z = z * jnp.sqrt(jnp.asarray(component_vars, dtype))
+    global_rot = None
+    if global_rot_scale:
+        global_rot = (
+            jax.random.normal(k2, (n, 3), dtype) * global_rot_scale
+        )
+    return decode_pca(params, z, global_rot, precision)
+
+
 # ------------------------------------------------------------- keypoints
 def resolve_tip_ids(tip_vertex_ids, n_verts: int):
     """Normalize a fingertip-vertex spec to a tuple of valid vertex ids.

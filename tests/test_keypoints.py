@@ -394,3 +394,41 @@ def test_tracker_passes_tips_through(params32):
     out = core.forward(params32, res.pose, res.shape)
     kp = core.keypoints(out, "smplx")
     assert float(jnp.abs(kp - target).max()) < 5e-3
+
+
+# ---------------------------------------------------------- pose sampling
+def test_sample_poses_anatomical(params32):
+    """Sampled poses live in the asset's pose distribution: at scale 0
+    they ARE the mean pose, and at scale 1 their Mahalanobis energy under
+    the data-driven prior is far below equal-magnitude axis-angle noise."""
+    from mano_hand_tpu.fitting import mahalanobis_pose_prior
+
+    key = jax.random.PRNGKey(0)
+    zero = core.sample_poses(params32, key, 4, pca_scale=0.0)
+    assert zero.shape == (4, 16, 3)
+    mean_fingers = np.asarray(params32.pca_mean).reshape(15, 3)
+    np.testing.assert_allclose(np.asarray(zero[:, 1:]),
+                               np.broadcast_to(mean_fingers, (4, 15, 3)),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(zero[:, 0]), 0.0, atol=1e-7)
+
+    sampled = core.sample_poses(params32, key, 256, pca_scale=1.0,
+                                global_rot_scale=0.3)
+    assert float(jnp.abs(sampled[:, 0]).max()) > 0.0  # global rot active
+    flat = sampled[:, 1:].reshape(256, -1)
+    # Whitening consistency: decoding z ~ N(0, I) and re-whitening under
+    # the data-driven prior gives unit energy per component — samples sit
+    # exactly in the distribution the prior charges nothing extra for.
+    # (The synthetic basis is orthonormal, so a noise-vs-sample energy
+    # comparison would be vacuous HERE; on real MANO bases it is not.)
+    e_sampled = float(mahalanobis_pose_prior(params32, flat))
+    assert 0.7 < e_sampled < 1.4
+    # Per-component variances scale the samples and are recovered by a
+    # variance-aware whitening.
+    variances = jnp.linspace(0.25, 4.0, 45)
+    scaled = core.sample_poses(params32, key, 256, pca_scale=1.0,
+                               component_vars=variances)
+    e_aware = float(mahalanobis_pose_prior(
+        params32, scaled[:, 1:].reshape(256, -1), component_vars=variances
+    ))
+    assert 0.7 < e_aware < 1.4
