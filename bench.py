@@ -671,7 +671,7 @@ def run_benchmarks(args, device_str: str) -> dict:
 
     def config4b_lm():
         # Second-order solver throughput: each LM step builds the [R, 58]
-        # residual Jacobian + normal equations + Cholesky per problem.
+        # residual Jacobian + normal equations + batched LU solve per problem.
         # Default backend is the analytic assembly (fitting/jacobian.py,
         # measured 1.96x the jacfwd replay); record which one ran so the
         # number is attributable.

@@ -1,0 +1,70 @@
+"""On-chip probe: fit_lm ms/step at the bench's config-4 shape.
+
+Used to attribute LM-step time while optimizing the solver (roadmap
+round-3 close-out #1). Current subjects: the batched-LU normal-equation
+solve (landed; isolated probe bench_results/probe_solve.py measured 8x
+the vmapped Cholesky) and JtJ/Jtr contraction precision.
+
+Run: JAX_PLATFORMS=axon python bench_results/probe_lm_solve.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_compile_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+import jax.numpy as jnp
+
+from mano_hand_tpu.assets import synthetic
+from mano_hand_tpu.fitting import lm
+from mano_hand_tpu.models import core
+
+B, STEPS = 256, 30
+
+
+def run(label, **kw):
+    params = synthetic.synthetic_params(seed=0, dtype="float32")
+    key = jax.random.PRNGKey(7)
+    pose = 0.3 * jax.random.normal(key, (B, 16, 3), jnp.float32)
+    shape = 0.5 * jax.random.normal(
+        jax.random.fold_in(key, 1), (B, 10), jnp.float32
+    )
+    target = jax.vmap(lambda p, s: core.forward(params, p, s).verts)(
+        pose, shape
+    )
+    jax.block_until_ready(target)
+    fit = lambda: lm.fit_lm(params, target, n_steps=STEPS, **kw)  # noqa: E731
+    out = fit()
+    jax.block_until_ready(out)
+    n = 10
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fit()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    per_step = dt / STEPS
+    print(
+        f"{label:16s} {per_step*1e3:7.3f} ms/step "
+        f"({1/per_step:6.1f} steps/s)  final_loss="
+        f"{float(out.final_loss.mean()):.3e}"
+    )
+
+
+def main():
+    print("devices:", jax.devices())
+    run("analytic+LU")
+    run("ad+LU", jacobian="ad")
+
+
+if __name__ == "__main__":
+    main()

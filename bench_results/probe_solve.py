@@ -1,0 +1,113 @@
+"""On-chip probe: batched [B, 58, 58] SPD solve variants for the LM step.
+
+Roadmap round-3 close-out #1: the batched Cholesky is ~1.5-2 ms of the
+5.5 ms LM step at b=256. Probe the candidate replacements in isolation
+before wiring anything into fitting/lm.py.
+
+Run: JAX_PLATFORMS=axon python bench_results/probe_solve.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+B, P = 256, 58
+
+
+def make_spd(key):
+    j = jax.random.normal(key, (B, 2400, P), jnp.float32)
+    a = jnp.einsum("brp,brq->bpq", j, j) + 1e-3 * jnp.eye(P)
+    b = jax.random.normal(jax.random.fold_in(key, 1), (B, P), jnp.float32)
+    return a, b
+
+
+def time_fn(fn, *args, iters=30):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    # slope method: time 1x and (1+iters)x, difference removes dispatch
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t1 = time.perf_counter()
+    return (t1 - t0) / iters
+
+
+def v_cho(a, b):
+    return jax.vmap(
+        lambda ai, bi: jax.scipy.linalg.cho_solve(
+            jax.scipy.linalg.cho_factor(ai), bi
+        )
+    )(a, b)
+
+
+def v_lu(a, b):
+    return jnp.linalg.solve(a, b[..., None])[..., 0]
+
+
+def v_pos(a, b):
+    return jax.vmap(
+        lambda ai, bi: jax.scipy.linalg.solve(ai, bi, assume_a="pos")
+    )(a, b)
+
+
+def v_inv(a, b):
+    return jnp.einsum("bpq,bq->bp", jnp.linalg.inv(a), b)
+
+
+def v_cg(a, b):
+    # 58-dim SPD, damped: Jacobi-preconditioned CG, fixed 12 iters.
+    d = jnp.reciprocal(jnp.diagonal(a, axis1=-2, axis2=-1))
+
+    def mv(x):
+        return jnp.einsum("bpq,bq->bp", a, x)
+
+    x = jnp.zeros_like(b)
+    r = b - mv(x)
+    z = d * r
+    p = z
+    rz = jnp.sum(r * z, -1)
+    for _ in range(12):
+        ap = mv(p)
+        alpha = rz / jnp.sum(p * ap, -1)
+        x = x + alpha[:, None] * p
+        r = r - alpha[:, None] * ap
+        z = d * r
+        rz_new = jnp.sum(r * z, -1)
+        p = z + (rz_new / rz)[:, None] * p
+        rz = rz_new
+    return x
+
+
+def main():
+    print("devices:", jax.devices())
+    key = jax.random.PRNGKey(0)
+    a, b = jax.jit(make_spd)(key)
+    jax.block_until_ready((a, b))
+    ref = None
+    for name, fn in [
+        ("cho_factor/cho_solve (current)", v_cho),
+        ("jnp.linalg.solve (LU)", v_lu),
+        ("scipy solve assume_a=pos", v_pos),
+        ("inv + matmul", v_inv),
+        ("Jacobi-PCG 12 iters", v_cg),
+    ]:
+        try:
+            jfn = jax.jit(fn)
+            t = time_fn(jfn, a, b)
+            x = jfn(a, b)
+            if ref is None:
+                ref = x
+                err = 0.0
+            else:
+                err = float(
+                    jnp.max(jnp.abs(x - ref) / (jnp.abs(ref) + 1e-6))
+                )
+            print(f"{name:35s} {t*1e3:8.3f} ms  rel_err={err:.2e}")
+        except Exception as e:  # noqa: BLE001
+            print(f"{name:35s} FAILED: {type(e).__name__}: {e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -59,8 +59,12 @@ def cmd_demo(args) -> int:
     model.set_params(
         pose_pca=DEMO_POSE_PCA, shape=DEMO_SHAPE, global_rot=DEMO_GLOBAL_ROT
     )
-    model.export_obj(args.out)
-    print(f"wrote {args.out} (+ restpose twin), backend={args.backend}")
+    if str(args.out).lower().endswith(".ply"):
+        model.export_ply(args.out)
+        print(f"wrote {args.out} (binary PLY), backend={args.backend}")
+    else:
+        model.export_obj(args.out)
+        print(f"wrote {args.out} (+ restpose twin), backend={args.backend}")
     return 0
 
 
@@ -497,7 +501,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="asset path (.npz/.pkl) or 'synthetic'")
     d.add_argument("--side", default=None, choices=[None, "left", "right"])
     d.add_argument("--backend", default="jax", choices=["np", "jax"])
-    d.add_argument("--out", default="hand.obj")
+    d.add_argument("--out", default="hand.obj",
+                   help="output mesh; a .ply suffix writes binary PLY "
+                        "with normals instead of the OBJ pair")
     d.set_defaults(fn=cmd_demo)
 
     c = sub.add_parser("convert", help="convert assets between formats")

@@ -5,6 +5,7 @@ from mano_hand_tpu.io.obj import (
     format_obj,
     restpose_path,
 )
+from mano_hand_tpu.io.ply import export_ply
 
 # Checkpoint backends: io.checkpoints (flat npz, canonical) and
 # io.orbax_ckpt (Orbax PyTree checkpoints: sharded/async, optional) are
@@ -15,6 +16,7 @@ __all__ = [
     "export_obj",
     "export_obj_pair",
     "export_obj_sequence",
+    "export_ply",
     "format_obj",
     "restpose_path",
 ]

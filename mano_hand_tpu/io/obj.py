@@ -17,19 +17,42 @@ import numpy as np
 PathLike = Union[str, Path]
 
 
-def format_obj(verts: np.ndarray, faces: np.ndarray) -> str:
+def format_obj(
+    verts: np.ndarray,
+    faces: np.ndarray,
+    normals: np.ndarray | None = None,
+) -> str:
     """Build the OBJ text for one mesh. Matches the reference's '%f'/'%d'
-    formatting (6-decimal fixed point, 1-indexed faces)."""
+    formatting (6-decimal fixed point, 1-indexed faces).
+
+    With ``normals`` ([V, 3], e.g. from ops.vertex_normals), emits ``vn``
+    lines and ``f a//a`` face refs — per-vertex normals share the vertex
+    index. The reference never writes normals (its viewer recomputes
+    them); plain calls stay byte-identical to it.
+    """
     verts = np.asarray(verts, dtype=np.float64).reshape(-1, 3)
     faces = np.asarray(faces).reshape(-1, 3) + 1
     v_lines = "\n".join("v %f %f %f" % (x, y, z) for x, y, z in verts)
-    f_lines = "\n".join("f %d %d %d" % (a, b, c) for a, b, c in faces)
-    return v_lines + "\n" + f_lines + "\n"
+    if normals is None:
+        f_lines = "\n".join("f %d %d %d" % (a, b, c) for a, b, c in faces)
+        return v_lines + "\n" + f_lines + "\n"
+    normals = np.asarray(normals, dtype=np.float64).reshape(-1, 3)
+    if normals.shape != verts.shape:
+        raise ValueError(
+            f"normals shape {normals.shape} != verts {verts.shape}"
+        )
+    n_lines = "\n".join("vn %f %f %f" % (x, y, z) for x, y, z in normals)
+    f_lines = "\n".join(
+        "f %d//%d %d//%d %d//%d" % (a, a, b, b, c, c)
+        for a, b, c in faces
+    )
+    return v_lines + "\n" + n_lines + "\n" + f_lines + "\n"
 
 
 def export_obj(
     verts: np.ndarray, faces: np.ndarray, path: PathLike,
     use_native: bool | None = None,
+    normals: np.ndarray | None = None,
 ) -> None:
     """Write a single mesh as OBJ.
 
@@ -37,8 +60,16 @@ def export_obj(
     output is byte-identical, so the switch is transparent. A single-mesh
     write never triggers a compile (a subprocess `make` would dwarf the
     millisecond write); ``use_native=True`` forces (and builds) the native
-    path, ``False`` forces Python.
+    path, ``False`` forces Python. ``normals`` adds ``vn``/``f a//a``
+    records (Python path only — the native writer speaks the reference's
+    normal-free dialect).
     """
+    if normals is not None:
+        if use_native:
+            raise ValueError("native objio does not write normals")
+        with open(path, "w") as fp:
+            fp.write(format_obj(verts, faces, normals))
+        return
     if use_native is not False:
         from mano_hand_tpu.io import native
 

@@ -120,6 +120,23 @@ class MANOModel:
         """Write posed + rest-pose OBJ pair (mano_np.py:181-201 parity)."""
         export_obj_pair(self.verts, self.rest_verts, self.faces, path)
 
+    def export_ply(
+        self, path: Union[str, Path],
+        with_normals: bool = True, binary: bool = True,
+    ) -> None:
+        """Write the posed mesh as PLY (binary by default; beyond the
+        reference, which only speaks OBJ). Normals are the area-weighted
+        vertex normals of the current pose, computed in NumPy so the np
+        backend's no-JAX-device contract (see __init__) holds here too."""
+        from mano_hand_tpu.io.ply import export_ply, vertex_normals_np
+
+        normals = (
+            vertex_normals_np(self.verts, self.faces)
+            if with_normals else None
+        )
+        export_ply(self.verts, self.faces, path,
+                   normals=normals, binary=binary)
+
     def keypoints(self, tip_vertex_ids=None, order: str = "mano"):
         """Current-state keypoints [16(+T), 3] (float64 numpy).
 

@@ -1,0 +1,123 @@
+"""PLY export + OBJ normal records (io/ply.py, io/obj.py)."""
+
+import numpy as np
+import pytest
+
+from mano_hand_tpu.io import export_ply, format_obj
+from mano_hand_tpu.models import core
+from mano_hand_tpu.ops import vertex_normals
+
+
+def _posed(params):
+    out = core.forward(
+        params,
+        np.zeros((16, 3), np.float32),
+        np.zeros((params.shape_basis.shape[-1],), np.float32),
+    )
+    return np.asarray(out.verts)
+
+
+def _parse_header(blob: bytes):
+    end = blob.index(b"end_header\n") + len(b"end_header\n")
+    header = blob[:end].decode("ascii").splitlines()
+    return header, blob[end:]
+
+
+def test_binary_ply_roundtrip(params, tmp_path):
+    verts = _posed(params)
+    path = export_ply(verts, params.faces, tmp_path / "hand.ply")
+    header, body = _parse_header(path.read_bytes())
+    assert header[1] == "format binary_little_endian 1.0"
+    assert f"element vertex {len(verts)}" in header
+    assert f"element face {len(params.faces)}" in header
+    vbytes = len(verts) * 3 * 4
+    got_v = np.frombuffer(body[:vbytes], "<f4").reshape(-1, 3)
+    np.testing.assert_allclose(got_v, verts.astype("<f4"))
+    rec = np.frombuffer(
+        body[vbytes:], dtype=[("n", "u1"), ("idx", "<i4", (3,))]
+    )
+    assert (rec["n"] == 3).all()
+    np.testing.assert_array_equal(rec["idx"], np.asarray(params.faces))
+
+
+def test_ascii_ply_and_normals(params, tmp_path):
+    verts = _posed(params)
+    normals = np.asarray(vertex_normals(verts, params.faces))
+    path = export_ply(
+        verts, params.faces, tmp_path / "hand.ply",
+        normals=normals, binary=False,
+    )
+    lines = path.read_text().splitlines()
+    assert "format ascii 1.0" in lines[1]
+    assert "property float nx" in lines
+    istart = lines.index("end_header") + 1
+    first = np.array(lines[istart].split(), dtype=np.float64)
+    assert first.shape == (6,)
+    # %.9g round-trips float32 exactly — ascii must equal binary
+    np.testing.assert_array_equal(
+        first.astype(np.float32)[:3], verts[0].astype(np.float32)
+    )
+    np.testing.assert_array_equal(
+        first.astype(np.float32)[3:], normals[0].astype(np.float32)
+    )
+    face_lines = lines[istart + len(verts):]
+    assert len(face_lines) == len(params.faces)
+    assert all(l.startswith("3 ") for l in face_lines)
+
+
+def test_point_cloud_ply(tmp_path):
+    pts = np.random.default_rng(0).normal(size=(50, 3))
+    path = export_ply(pts, None, tmp_path / "cloud.ply")
+    header, body = _parse_header(path.read_bytes())
+    assert not any(h.startswith("element face") for h in header)
+    assert len(body) == 50 * 3 * 4
+
+
+def test_ply_validation(tmp_path):
+    verts = np.zeros((4, 3))
+    with pytest.raises(ValueError, match="normals"):
+        export_ply(verts, None, tmp_path / "x.ply",
+                   normals=np.zeros((3, 3)))
+    with pytest.raises(ValueError, match="out of range"):
+        export_ply(verts, np.array([[0, 1, 9]]), tmp_path / "x.ply")
+
+
+def test_numpy_normals_match_jax(params):
+    from mano_hand_tpu.io.ply import vertex_normals_np
+
+    verts = _posed(params)
+    got = vertex_normals_np(verts, params.faces)
+    want = np.asarray(vertex_normals(verts, params.faces))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_model_export_ply_and_cli(params, tmp_path):
+    from mano_hand_tpu.cli import main
+    from mano_hand_tpu.models.layer import MANOModel
+
+    # np backend: export_ply must not touch JAX (normals are NumPy)
+    model = MANOModel(params, backend="np")
+    out = tmp_path / "hand.ply"
+    model.export_ply(out)
+    header, _ = _parse_header(out.read_bytes())
+    assert "property float nx" in header  # normals on by default
+
+    cli_out = tmp_path / "cli.ply"
+    assert main(["demo", "--out", str(cli_out)]) == 0
+    header, _ = _parse_header(cli_out.read_bytes())
+    assert f"element vertex {len(model.verts)}" in header
+
+
+def test_obj_with_normals(params):
+    verts = _posed(params)
+    normals = np.asarray(vertex_normals(verts, params.faces))
+    text = format_obj(verts, params.faces, normals)
+    lines = text.splitlines()
+    vn = [l for l in lines if l.startswith("vn ")]
+    f = [l for l in lines if l.startswith("f ")]
+    assert len(vn) == len(verts) and len(f) == len(params.faces)
+    # v//vn refs share the (1-indexed) vertex id
+    a = f[0].split()[1]
+    assert "//" in a and a.split("//")[0] == a.split("//")[1]
+    with pytest.raises(ValueError, match="normals"):
+        format_obj(verts, params.faces, normals[:-1])
