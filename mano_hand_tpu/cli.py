@@ -195,7 +195,14 @@ def cmd_fit(args) -> int:
     from mano_hand_tpu.io.checkpoints import save_fit_result
 
     params = _load_params(args.asset, args.side).astype(np.float32)
-    targets = np.load(args.targets)  # [V|J, 3|2] or [B, V|J, 3|2]
+    if str(args.targets).lower().endswith(".ply"):
+        # Scanner output directly: the vertex cloud of a PLY (any faces
+        # are irrelevant to the ICP data terms, which resample anyway).
+        from mano_hand_tpu.io.ply import read_ply
+
+        targets = read_ply(args.targets).verts
+    else:
+        targets = np.load(args.targets)  # [V|J, 3|2] or [B, V|J, 3|2]
     if args.data_term not in ("joints", "keypoints2d"):
         # Name the real conflict for BOTH keypoint flags here — sending
         # the user to --tips from the openpose check would ping-pong them
@@ -544,7 +551,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "joints with --data-term joints; [16,2]/[B,16,2] "
                         "image points with --data-term keypoints2d; "
                         "[N,3]/[B,N,3] scan points with --data-term "
-                        "points or point_to_plane")
+                        "points or point_to_plane (a .ply file loads "
+                        "its vertex cloud directly)")
     f.add_argument("--pose-space", default=None,
                    choices=["aa", "pca", "6d"],
                    help="pose parameterization: axis-angle (both solvers' "

@@ -25,6 +25,7 @@ from mano_hand_tpu.fitting.solvers import (
     fit_with_optimizer,
 )
 from mano_hand_tpu.fitting.lm import LMResult, fit_lm
+from mano_hand_tpu.fitting.restarts import fit_restarts
 from mano_hand_tpu.fitting.tracking import (
     TrackState,
     make_hands_tracker,
@@ -48,6 +49,7 @@ __all__ = [
     "fit_with_optimizer",
     "LMResult",
     "fit_lm",
+    "fit_restarts",
     "TrackState",
     "make_hands_tracker",
     "make_tracker",

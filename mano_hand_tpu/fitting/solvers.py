@@ -186,7 +186,8 @@ def _check_data_term(data_term: str, camera, conf) -> None:
     if data_term == "keypoints2d":
         if camera is None:
             raise ValueError(
-                "data_term='keypoints2d' needs a viz.camera.Camera"
+                "data_term='keypoints2d' needs a viz.camera.Camera (or "
+                "WeakPerspectiveCamera)"
             )
     elif camera is not None or conf is not None:
         # Accepting these would silently fit unweighted/unprojected data.
@@ -527,10 +528,13 @@ def fit(
     BASELINE.json config 4 at batch=256. ``lr`` and the prior weights are
     traced operands, so a hyperparameter sweep reuses one compiled program.
     ``data_term='keypoints2d'`` fits 2D detector output: posed joints are
-    projected through ``camera`` (a ``viz.camera.Camera``) and compared in
-    image space, optionally confidence-weighted; pair with
-    ``fit_trans=True`` (adds a global translation DOF) and nonzero priors
-    — depth is only observable through perspective scaling. For a custom
+    projected through ``camera`` (a pinhole ``viz.camera.Camera``, or a
+    ``viz.WeakPerspectiveCamera`` for HMR-style (s, tx, ty) annotations)
+    and compared in image space, optionally confidence-weighted; pair
+    with ``fit_trans=True`` (adds a global translation DOF) and nonzero
+    priors — under pinhole projection depth is only observable through
+    perspective scaling, and under weak perspective not at all (keep the
+    z-prior on). For a custom
     optimizer use ``fit_with_optimizer`` (not jitted at this level so the
     transformation can be any optax object).
 

@@ -5,7 +5,7 @@ from mano_hand_tpu.io.obj import (
     format_obj,
     restpose_path,
 )
-from mano_hand_tpu.io.ply import export_ply
+from mano_hand_tpu.io.ply import export_ply, read_ply
 
 # Checkpoint backends: io.checkpoints (flat npz, canonical) and
 # io.orbax_ckpt (Orbax PyTree checkpoints: sharded/async, optional) are
@@ -18,5 +18,6 @@ __all__ = [
     "export_obj_sequence",
     "export_ply",
     "format_obj",
+    "read_ply",
     "restpose_path",
 ]

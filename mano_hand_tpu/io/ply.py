@@ -1,21 +1,23 @@
-"""PLY (Stanford polygon) export — binary and ASCII.
+"""PLY (Stanford polygon) I/O — binary and ASCII.
 
 The reference only writes Wavefront OBJ (/root/reference/mano_np.py:181-201).
 PLY is the other lingua franca of the scan-registration world (most range
 scanners and point-cloud tools emit it), and the binary flavor is ~5x
 smaller and loads without text parsing — the right interchange format for
-the registration pipeline this framework adds (fit_lm ICP terms). Writer
-only; scan INPUT is plain arrays (objectives take [N, 3] clouds directly).
+the registration pipeline this framework adds (fit_lm ICP terms).
 
-Binary is little-endian, float32 positions (+ optional float32 normals),
-uchar-count int32 face indices — the layout every PLY reader (MeshLab,
-Open3D, trimesh) expects.
+``export_ply`` writes little-endian binary (or ASCII), float32 positions
+(+ optional float32 normals), uchar-count int32 face indices — the layout
+every PLY reader (MeshLab, Open3D, trimesh) expects. ``read_ply`` loads
+scanner/tool output back: both byte orders, float/double coordinates,
+extra vertex properties (colors etc.) skipped by offset, faces optional —
+so `cli fit --data-term points scan.ply` consumes real scans directly.
 """
 
 from __future__ import annotations
 
 from pathlib import Path
-from typing import Optional, Union
+from typing import NamedTuple, Optional, Union
 
 import numpy as np
 
@@ -39,6 +41,210 @@ def vertex_normals_np(verts: np.ndarray, faces: np.ndarray) -> np.ndarray:
     return acc / np.maximum(
         np.linalg.norm(acc, axis=-1, keepdims=True), 1e-12
     )
+
+
+class PlyMesh(NamedTuple):
+    """What ``read_ply`` returns. ``faces`` / ``normals`` are None when the
+    file has no face element / no nx,ny,nz properties (point clouds)."""
+
+    verts: np.ndarray                  # [V, 3] float
+    faces: Optional[np.ndarray]        # [F, 3] int32 or None
+    normals: Optional[np.ndarray]      # [V, 3] float or None
+
+
+# PLY scalar type names (both the 1.0-spec names and the C-style aliases
+# tools emit) → numpy dtype codes, endianness applied at parse time.
+_PLY_TYPES = {
+    "char": "i1", "int8": "i1", "uchar": "u1", "uint8": "u1",
+    "short": "i2", "int16": "i2", "ushort": "u2", "uint16": "u2",
+    "int": "i4", "int32": "i4", "uint": "u4", "uint32": "u4",
+    "float": "f4", "float32": "f4", "double": "f8", "float64": "f8",
+}
+
+
+def _parse_faces_loop(body, offset, count, props, idx_prop, bo, out):
+    """General (mixed-size lists / extra scalars) face parse; returns the
+    advanced offset. Dtypes hoisted — the loop body is pure offset math."""
+    specs = []
+    for p, spec in props:
+        if isinstance(spec, tuple):
+            _, cnt_t, item_t = spec
+            specs.append((p, np.dtype(bo + cnt_t), np.dtype(bo + item_t)))
+        else:
+            specs.append((p, None, np.dtype(bo + spec)))
+    for _ in range(count):
+        for p, cnt_d, item_d in specs:
+            if cnt_d is None:
+                offset += item_d.itemsize
+                continue
+            n = int(np.frombuffer(body, cnt_d, count=1, offset=offset)[0])
+            offset += cnt_d.itemsize
+            items = np.frombuffer(body, item_d, count=n, offset=offset)
+            offset += item_d.itemsize * n
+            if p == idx_prop:
+                out.append(items)
+    return offset
+
+
+def read_ply(path: PathLike) -> PlyMesh:
+    """Load a PLY mesh or point cloud (binary either endianness, or ASCII).
+
+    Tolerant of what scanners actually write: extra vertex properties
+    (colors, quality, ...) are skipped; the face list count may be any
+    integer type; non-triangle faces are rejected with a clear error
+    (MANO-side consumers are triangle-only). Only list properties named
+    ``vertex_indices``/``vertex_index`` are honored on faces.
+    """
+    blob = Path(path).read_bytes()
+    marker = b"end_header"
+    idx = blob.find(marker)
+    if not blob.startswith(b"ply") or idx < 0:
+        raise ValueError(f"{path}: not a PLY file")
+    body = blob[blob.index(b"\n", idx) + 1:]
+    header = blob[:idx].decode("ascii", "replace").splitlines()
+
+    fmt = None
+    elements = []  # (name, count, [(prop_name, dtype_code | list spec)])
+    for line in header[1:]:
+        parts = line.split()
+        if not parts or parts[0] == "comment":
+            continue
+        if parts[0] == "format":
+            fmt = parts[1]
+        elif parts[0] == "element":
+            elements.append((parts[1], int(parts[2]), []))
+        elif parts[0] == "property":
+            if not elements:
+                raise ValueError(f"{path}: property before any element")
+            if parts[1] == "list":
+                elements[-1][2].append(
+                    (parts[4], ("list", _PLY_TYPES[parts[2]],
+                                _PLY_TYPES[parts[3]]))
+                )
+            else:
+                elements[-1][2].append((parts[2], _PLY_TYPES[parts[1]]))
+    if fmt not in ("ascii", "binary_little_endian", "binary_big_endian"):
+        raise ValueError(f"{path}: unsupported format {fmt!r}")
+    bo = ">" if fmt == "binary_big_endian" else "<"
+
+    verts = faces = normals = None
+    offset = 0
+    ascii_rows = (
+        body.decode("ascii", "replace").split("\n") if fmt == "ascii"
+        else None
+    )
+    row_cursor = 0
+    for name, count, props in elements:
+        is_vertex = name == "vertex"
+        is_face = name == "face"
+        if is_vertex:
+            if any(isinstance(d, tuple) for _, d in props):
+                raise ValueError(f"{path}: list property on vertex element")
+            rec = np.dtype([(p, bo + d) for p, d in props])
+            if fmt == "ascii":
+                rows = ascii_rows[row_cursor:row_cursor + count]
+                row_cursor += count
+                data = np.loadtxt(
+                    rows, dtype=np.float64, ndmin=2
+                ) if count else np.zeros((0, len(props)))
+                cols = {p: data[:, i] for i, (p, _) in enumerate(props)}
+            else:
+                data = np.frombuffer(
+                    body, rec, count=count, offset=offset
+                )
+                offset += rec.itemsize * count
+                cols = {p: data[p] for p, _ in props}
+            for need in ("x", "y", "z"):
+                if need not in cols:
+                    raise ValueError(f"{path}: vertex missing '{need}'")
+            verts = np.stack(
+                [cols["x"], cols["y"], cols["z"]], axis=1
+            ).astype(np.float64)
+            if all(k in cols for k in ("nx", "ny", "nz")):
+                normals = np.stack(
+                    [cols["nx"], cols["ny"], cols["nz"]], axis=1
+                ).astype(np.float64)
+        elif is_face:
+            out = []
+            lists = [
+                (p, spec) for p, spec in props if isinstance(spec, tuple)
+            ]
+            idx_prop = next(
+                (p for p, _ in lists
+                 if p in ("vertex_indices", "vertex_index")), None
+            )
+            if fmt == "ascii":
+                rows = ascii_rows[row_cursor:row_cursor + count]
+                row_cursor += count
+                for r in rows:
+                    vals = r.split()
+                    # Per-row: scalars and lists in property order; pick
+                    # the vertex-index list, skip everything else.
+                    pos = 0
+                    for p, spec in props:
+                        if isinstance(spec, tuple):
+                            n = int(vals[pos])
+                            items = vals[pos + 1:pos + 1 + n]
+                            pos += 1 + n
+                            if p == idx_prop:
+                                out.append([int(v) for v in items])
+                        else:
+                            pos += 1
+            elif (count and len(props) == 1 and idx_prop is not None):
+                # Fast path — the layout every mesh tool (and export_ply)
+                # writes: one list property, uniform triangle counts. One
+                # vectorized frombuffer instead of ~4 tiny calls per face
+                # (a 10^5-face scan loads in ms, not seconds). Falls back
+                # to the general loop below on mixed-size lists.
+                _, cnt_t, item_t = props[0][1]
+                n0 = int(np.frombuffer(
+                    body, np.dtype(bo + cnt_t), count=1, offset=offset
+                )[0])
+                rec = np.dtype([
+                    ("n", bo + cnt_t), ("idx", bo + item_t, (n0,))
+                ])
+                try:
+                    data = np.frombuffer(
+                        body, rec, count=count, offset=offset
+                    )
+                except ValueError:   # mixed counts shrank the tail
+                    data = None
+                if data is not None and (data["n"] == n0).all():
+                    offset += rec.itemsize * count
+                    if n0 != 3:
+                        raise ValueError(
+                            f"{path}: non-triangle faces "
+                            "(triangulate first)"
+                        )
+                    out = list(data["idx"])
+                else:
+                    offset = _parse_faces_loop(
+                        body, offset, count, props, idx_prop, bo, out
+                    )
+            else:
+                offset = _parse_faces_loop(
+                    body, offset, count, props, idx_prop, bo, out
+                )
+            if idx_prop is not None:
+                if any(len(f) != 3 for f in out):
+                    raise ValueError(
+                        f"{path}: non-triangle faces (triangulate first)"
+                    )
+                faces = np.asarray(out, np.int32).reshape(-1, 3)
+        else:
+            # Unknown element: skip its data so later elements stay aligned.
+            if fmt == "ascii":
+                row_cursor += count
+            else:
+                if any(isinstance(d, tuple) for _, d in props):
+                    raise ValueError(
+                        f"{path}: cannot skip binary list element {name!r}"
+                    )
+                rec = np.dtype([(p, bo + d) for p, d in props])
+                offset += rec.itemsize * count
+    if verts is None:
+        raise ValueError(f"{path}: no vertex element")
+    return PlyMesh(verts=verts, faces=faces, normals=normals)
 
 
 def _ply_header(

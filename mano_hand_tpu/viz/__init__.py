@@ -10,13 +10,19 @@ actual video files on any host, and whole animation clips render as one
 batched XLA program on TPU.
 """
 
-from mano_hand_tpu.viz.camera import Camera, look_at, view_rotation
+from mano_hand_tpu.viz.camera import (
+    Camera,
+    WeakPerspectiveCamera,
+    look_at,
+    view_rotation,
+)
 from mano_hand_tpu.viz.render import render_mesh, render_sequence
 from mano_hand_tpu.viz.png import write_png, write_gif
 from mano_hand_tpu.viz.avi import write_avi, read_avi_info
 
 __all__ = [
     "Camera",
+    "WeakPerspectiveCamera",
     "look_at",
     "view_rotation",
     "render_mesh",

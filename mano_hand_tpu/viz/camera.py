@@ -41,6 +41,39 @@ class Camera(NamedTuple):
         return jnp.concatenate([xy, v[..., 2:3]], axis=-1)
 
 
+class WeakPerspectiveCamera(NamedTuple):
+    """Weak-perspective (scaled-orthographic) camera.
+
+    ``project(v) = scale * (R @ v).xy + trans2d`` — the (s, tx, ty)
+    convention HMR-family regressors and many hand datasets annotate with:
+    no depth division, so image position is linear in the joints. Use as
+    the ``camera=`` of ``fitting.fit(data_term="keypoints2d")``
+    interchangeably with the pinhole ``Camera`` (both expose
+    ``project``); prefer it when the hand's depth extent is small
+    relative to its distance, or when the annotations were made under
+    this model in the first place (fitting a pinhole camera to
+    weak-perspective annotations bakes the mismatch into the pose).
+    The third output column is view-space depth, same as ``Camera`` —
+    informational here, never part of the 2D residual.
+    """
+
+    rot: jnp.ndarray      # [3, 3]
+    scale: float = 1.0
+    trans2d: jnp.ndarray = None  # [2]; None = origin
+
+    def transform(self, verts: jnp.ndarray) -> jnp.ndarray:
+        """World verts [..., 3] -> view space [..., 3] (rotation only)."""
+        return verts @ self.rot.T
+
+    def project(self, verts: jnp.ndarray) -> jnp.ndarray:
+        """World verts [..., 3] -> (x, y, depth) [..., 3]."""
+        v = self.transform(verts)
+        xy = self.scale * v[..., :2]
+        if self.trans2d is not None:
+            xy = xy + jnp.asarray(self.trans2d, v.dtype)
+        return jnp.concatenate([xy, v[..., 2:3]], axis=-1)
+
+
 def view_rotation(axis_angle: Sequence[float]) -> jnp.ndarray:
     """Axis-angle view matrix, the rasterizer-side analogue of the demo's
     transforms3d usage. Accepts a length-3 vector; angle = norm."""

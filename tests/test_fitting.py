@@ -335,6 +335,34 @@ def test_fit_to_2d_keypoints_batched(params32):
     assert np.all(np.asarray(res.final_loss) < np.asarray(res.loss_history[:, 0]))
 
 
+def test_fit_to_2d_keypoints_weak_perspective(params32):
+    """The HMR-style (s, tx, ty) camera plugs into the same 2D data term
+    and recovers pose from its scaled-orthographic projections."""
+    from mano_hand_tpu.viz import WeakPerspectiveCamera
+    from mano_hand_tpu.viz.camera import view_rotation
+
+    camera = WeakPerspectiveCamera(
+        rot=view_rotation([0.3, 0.7, 0.1]),
+        scale=2.5,
+        trans2d=jnp.asarray([0.1, -0.05], jnp.float32),
+    )
+    rng = np.random.default_rng(11)
+    pose = rng.normal(scale=0.2, size=(16, 3)).astype(np.float32)
+    target_xy = _project_joints(params32, camera, pose, np.zeros(3))
+    res = fit(params32, np.asarray(target_xy), n_steps=80, lr=0.02,
+              data_term="keypoints2d", camera=camera, fit_trans=True,
+              pose_space="pca", n_pca=15,
+              pose_prior_weight=1e-4, shape_prior_weight=1e-3)
+    got_xy = _project_joints(
+        params32, camera, np.asarray(res.pose), np.asarray(res.trans)
+    )
+    err = np.abs(np.asarray(got_xy) - np.asarray(target_xy)).max()
+    assert err < 0.02, err
+    # Depth is entirely unobservable under weak perspective: the recovered
+    # z-translation must not have run away (the prior pins it).
+    assert abs(float(res.trans[2])) < 0.5
+
+
 def test_fit_keypoints2d_requires_camera(params32):
     with pytest.raises(ValueError, match="camera"):
         fit(params32, np.zeros((16, 2), np.float32), n_steps=2,

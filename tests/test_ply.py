@@ -108,6 +108,116 @@ def test_model_export_ply_and_cli(params, tmp_path):
     assert f"element vertex {len(model.verts)}" in header
 
 
+def test_read_ply_roundtrip(params, tmp_path):
+    from mano_hand_tpu.io import read_ply
+
+    verts = _posed(params)
+    normals = np.asarray(vertex_normals(verts, params.faces))
+    for binary in (True, False):
+        path = export_ply(
+            verts, params.faces, tmp_path / f"rt_{binary}.ply",
+            normals=normals, binary=binary,
+        )
+        mesh = read_ply(path)
+        np.testing.assert_array_equal(
+            mesh.verts.astype(np.float32), verts.astype(np.float32)
+        )
+        np.testing.assert_array_equal(mesh.faces, np.asarray(params.faces))
+        np.testing.assert_array_equal(
+            mesh.normals.astype(np.float32), normals.astype(np.float32)
+        )
+    cloud = export_ply(verts[:50], None, tmp_path / "cloud.ply")
+    mesh = read_ply(cloud)
+    assert mesh.faces is None and mesh.normals is None
+    np.testing.assert_array_equal(
+        mesh.verts.astype(np.float32), verts[:50].astype(np.float32)
+    )
+
+
+def test_read_ply_scanner_variants(tmp_path):
+    """Big-endian doubles, extra vertex properties (colors), uint8 face
+    list counts — the things real scanner exports throw at a reader."""
+    from mano_hand_tpu.io import read_ply
+
+    verts = np.array([[0, 0, 0], [1, 0, 0], [0, 1, 0]], np.float64)
+    colors = np.array([[255, 0, 0], [0, 255, 0], [0, 0, 255]], np.uint8)
+    header = "\n".join([
+        "ply", "format binary_big_endian 1.0",
+        "element vertex 3",
+        "property double x", "property double y", "property double z",
+        "property uchar red", "property uchar green", "property uchar blue",
+        "element face 1",
+        "property list uchar uint vertex_indices",
+        "end_header",
+    ]) + "\n"
+    rec = np.zeros(3, dtype=[("xyz", ">f8", (3,)), ("rgb", "u1", (3,))])
+    rec["xyz"] = verts
+    rec["rgb"] = colors
+    face = b"\x03" + np.array([0, 1, 2], ">u4").tobytes()
+    path = tmp_path / "scan.ply"
+    path.write_bytes(header.encode() + rec.tobytes() + face)
+    mesh = read_ply(path)
+    np.testing.assert_array_equal(mesh.verts, verts)
+    np.testing.assert_array_equal(mesh.faces, [[0, 1, 2]])
+    assert mesh.normals is None
+
+    quad = header.replace("uchar uint", "uchar int")
+    path2 = tmp_path / "quad.ply"
+    path2.write_bytes(
+        quad.encode() + rec.tobytes()
+        + b"\x04" + np.array([0, 1, 2, 0], ">i4").tobytes()
+    )
+    with pytest.raises(ValueError, match="non-triangle"):
+        read_ply(path2)
+
+    bad = tmp_path / "bad.ply"
+    bad.write_bytes(b"solid something\n")
+    with pytest.raises(ValueError, match="not a PLY"):
+        read_ply(bad)
+
+    # Extra scalar property on faces → the general per-face parse path.
+    hdr = "\n".join([
+        "ply", "format binary_little_endian 1.0",
+        "element vertex 3",
+        "property float x", "property float y", "property float z",
+        "element face 2",
+        "property uchar flags",
+        "property list uchar int vertex_indices",
+        "end_header",
+    ]) + "\n"
+    vb = verts.astype("<f4").tobytes()
+    f1 = b"\x07\x03" + np.array([0, 1, 2], "<i4").tobytes()
+    f2 = b"\x00\x03" + np.array([2, 1, 0], "<i4").tobytes()
+    p3 = tmp_path / "flags.ply"
+    p3.write_bytes(hdr.encode() + vb + f1 + f2)
+    mesh = read_ply(p3)
+    np.testing.assert_array_equal(mesh.faces, [[0, 1, 2], [2, 1, 0]])
+
+
+def test_cli_fit_ply_target(params, tmp_path, capsys):
+    """`cli fit scan.ply --data-term points`: PLY cloud consumed directly."""
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.cli import main
+    from mano_hand_tpu.models import core
+
+    p32 = params.astype(np.float32)
+    rng = np.random.default_rng(3)
+    pose = rng.normal(scale=0.2, size=(16, 3)).astype(np.float32)
+    out_true = core.jit_forward(
+        p32, jnp.asarray(pose), jnp.zeros(10, jnp.float32)
+    )
+    cloud = np.asarray(out_true.verts)[rng.permutation(778)[:120]]
+    ply = export_ply(cloud, None, tmp_path / "scan.ply")
+    out = tmp_path / "reg.npz"
+    rc = main([
+        "fit", str(ply), "--data-term", "points",
+        "--solver", "lm", "--steps", "5", "--out", str(out),
+    ])
+    assert rc == 0
+    assert "fit (lm, 5 steps)" in capsys.readouterr().out
+
+
 def test_obj_with_normals(params):
     verts = _posed(params)
     normals = np.asarray(vertex_normals(verts, params.faces))

@@ -1,0 +1,92 @@
+"""Multi-restart fitting (fitting/restarts.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mano_hand_tpu.fitting import fit, fit_lm, fit_restarts
+from mano_hand_tpu.models import core
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+def test_restarts_beat_zero_init_on_rotated_cloud(params32):
+    """ICP to a strongly rotated scan: the zero-pose basin is wrong, and
+    only a restart near the true orientation registers. Deterministic:
+    the target pose IS one of the poses fit_restarts(key=0) will sample
+    (same PRNG path), so that restart starts in the right basin."""
+    n_restarts, pca_scale, rot_scale = 6, 0.8, 1.8
+    sampled = core.sample_poses(
+        params32, jax.random.PRNGKey(0), n_restarts - 1,
+        pca_scale=pca_scale, global_rot_scale=rot_scale,
+    )
+    # The sampled pose with the LARGEST global rotation — the one a
+    # zero init is least able to reach through ICP's frozen assignments.
+    k = int(jnp.argmax(jnp.linalg.norm(sampled[:, 0], axis=-1)))
+    true_pose = sampled[k]
+    cloud = core.forward(
+        params32, true_pose, jnp.zeros(10, jnp.float32)
+    ).verts
+
+    zero_only = fit_lm(params32, cloud, n_steps=12, data_term="points")
+    best, losses = fit_restarts(
+        params32, cloud, n_restarts=n_restarts, key=0, solver="lm",
+        pca_scale=pca_scale, global_rot_scale=rot_scale,
+        n_steps=12, data_term="points",
+    )
+    assert losses.shape == (n_restarts,)
+    # Restart k+1 (after the zero restart) started at the true pose.
+    assert float(losses[k + 1]) < 1e-8
+    assert float(best.final_loss) <= float(losses[0]) + 1e-12
+    assert float(best.final_loss) < 0.01 * float(zero_only.final_loss)
+
+
+def test_restarts_never_worse_than_plain_fit(params32):
+    target = core.forward(
+        params32,
+        0.1 * jax.random.normal(jax.random.PRNGKey(3), (16, 3)),
+        jnp.zeros(10, jnp.float32),
+    ).verts
+    plain = fit(params32, target, n_steps=40, lr=0.05)
+    best, losses = fit_restarts(
+        params32, target, n_restarts=3, key=1, n_steps=40, lr=0.05,
+    )
+    assert best.pose.shape == (16, 3) and best.shape.shape == (10,)
+    # include_zero: restart 0 IS the plain fit
+    np.testing.assert_allclose(
+        float(losses[0]), float(plain.final_loss), rtol=1e-5
+    )
+    assert float(best.final_loss) <= float(losses[0]) * (1 + 1e-6)
+
+
+def test_restarts_validation(params32):
+    target = np.zeros((778, 3), np.float32)
+    with pytest.raises(ValueError, match="init"):
+        fit_restarts(params32, target, init={"pose": None})
+    with pytest.raises(ValueError, match="pose_space"):
+        fit_restarts(params32, target, pose_space="pca")
+    with pytest.raises(ValueError, match="ONE problem"):
+        fit_restarts(params32, np.zeros((2, 778, 3), np.float32))
+    with pytest.raises(ValueError, match="n_restarts"):
+        fit_restarts(params32, target, n_restarts=0)
+    with pytest.raises(ValueError, match="solver"):
+        fit_restarts(params32, target, solver="newton")
+
+
+def test_restarts_with_trans_and_adam(params32):
+    """fit_trans plumbs a zero trans seed per restart (adam path)."""
+    target = core.forward(
+        params32, jnp.zeros((16, 3)), jnp.zeros(10, jnp.float32)
+    ).verts + jnp.asarray([0.03, -0.01, 0.02])
+    best, losses = fit_restarts(
+        params32, target, n_restarts=2, key=2,
+        n_steps=60, lr=0.05, fit_trans=True,
+    )
+    assert best.trans.shape == (3,)
+    np.testing.assert_allclose(
+        np.asarray(best.trans), [0.03, -0.01, 0.02], atol=5e-3
+    )
