@@ -89,13 +89,17 @@ def fit_hands(
             f"([2, ...] leaves); got side={stacked.side!r}. For one hand "
             "use fit()."
         )
-    solvers._check_data_term(data_term, camera, target_conf)
-    if data_term == "points":
+    # Unsupported-term rejection FIRST: running the generic validator
+    # before it would demand a camera for a silhouette term this entry
+    # point does not support at all.
+    if data_term in ("points", "silhouette"):
         raise ValueError(
             "fit_hands supports verts/joints/keypoints2d; for scan "
             "registration fit each hand with fit_lm (ICP needs per-hand "
-            "correspondence anyway)"
+            "correspondence anyway), and for masks fit each hand with "
+            "fit(data_term='silhouette') on its instance mask"
         )
+    solvers._check_data_term(data_term, camera, target_conf)
     dtype = stacked.v_template.dtype
     targets = jnp.asarray(targets, dtype)
     if targets.ndim != 3 or targets.shape[0] != 2:
@@ -224,11 +228,11 @@ def fit_hands_sequence(
             f"output; got side={stacked.side!r}. For one hand use "
             "fit_sequence()."
         )
-    solvers._check_data_term(data_term, camera, target_conf)
-    if data_term == "points":
+    if data_term in ("points", "silhouette"):
         raise ValueError(
             "fit_hands_sequence supports verts/joints/keypoints2d"
         )
+    solvers._check_data_term(data_term, camera, target_conf)
     dtype = stacked.v_template.dtype
     targets = jnp.asarray(targets, dtype)
     if targets.ndim != 4 or targets.shape[1] != 2:

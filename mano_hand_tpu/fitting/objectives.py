@@ -114,6 +114,28 @@ def keypoint2d_l2(
     )
 
 
+def silhouette_iou_loss(pred_sil: jnp.ndarray,    # [..., H, W] in [0, 1]
+                        target_mask: jnp.ndarray,  # [..., H, W] in [0, 1]
+                        ) -> jnp.ndarray:
+    """1 - soft IoU between a rendered soft silhouette and a target mask.
+
+    The standard mask-supervision energy: scale-free (a hand covering 4%
+    of the frame weighs the same as one covering 40% — a plain per-pixel
+    MSE is dominated by the background and goes flat) and bounded in
+    [0, 1]. Soft intersection = sum(p*t), soft union = sum(p + t - p*t)
+    (the SoftRas convention): with a binary target the loss is 0 iff the
+    prediction is 1 on the mask and 0 off it; for two SOFT images it
+    bottoms out slightly above 0 (p*p < p), which shifts the floor, not
+    the argmin. Reduction is over the two image axes only, so
+    batched/clip inputs get one loss per image — mean over frames at the
+    call site. The epsilon keeps the empty-empty case (no hand in frame,
+    no mask) a well-defined zero loss.
+    """
+    inter = jnp.sum(pred_sil * target_mask, axis=(-2, -1))
+    union = jnp.sum(pred_sil + target_mask, axis=(-2, -1)) - inter
+    return 1.0 - (inter + 1e-6) / (union + 1e-6)
+
+
 def huber(sq_dist: jnp.ndarray, delta: float) -> jnp.ndarray:
     """Huber penalty on per-point SQUARED distances.
 

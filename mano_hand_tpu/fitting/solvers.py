@@ -178,22 +178,27 @@ class FitResult(NamedTuple):
 
 def _check_data_term(data_term: str, camera, conf) -> None:
     """One validation policy for every solver entry point."""
-    if data_term not in ("verts", "joints", "keypoints2d", "points"):
+    if data_term not in ("verts", "joints", "keypoints2d", "points",
+                         "silhouette"):
         raise ValueError(
-            "data_term must be 'verts', 'joints', 'keypoints2d' or "
-            f"'points', got {data_term!r}"
+            "data_term must be 'verts', 'joints', 'keypoints2d', 'points' "
+            f"or 'silhouette', got {data_term!r}"
         )
-    if data_term == "keypoints2d":
+    if data_term in ("keypoints2d", "silhouette"):
         if camera is None:
             raise ValueError(
-                "data_term='keypoints2d' needs a viz.camera.Camera (or "
+                f"data_term={data_term!r} needs a viz.camera.Camera (or "
                 "WeakPerspectiveCamera)"
+            )
+        if conf is not None and data_term == "silhouette":
+            raise ValueError(
+                "target_conf only applies to data_term='keypoints2d'"
             )
     elif camera is not None or conf is not None:
         # Accepting these would silently fit unweighted/unprojected data.
         raise ValueError(
-            "camera/target_conf only apply to data_term='keypoints2d', "
-            f"got data_term={data_term!r}"
+            "camera/target_conf only apply to the image-space data terms "
+            f"('keypoints2d', 'silhouette'), got data_term={data_term!r}"
         )
 
 
@@ -219,6 +224,54 @@ def normalize_tips_kwarg(fn):
             tip_vertex_ids, params.v_template.shape[-2]
         )
         return fn(params, *args, tip_vertex_ids=tip_vertex_ids, **kw)
+
+    return wrapper
+
+
+def validate_mask_target(fn):
+    """Reject out-of-range silhouette targets BEFORE the jit boundary.
+
+    Segmentation masks routinely arrive as uint8 0/255; the soft-IoU
+    loss's [0, 1] precondition would otherwise fail SILENTLY — with p in
+    [0, 1] and t up to 255 the "intersection" exceeds the "union", the
+    loss goes negative at ~255x the documented scale, and the data
+    gradient swamps the priors this ill-posed term depends on. Value
+    checks are impossible inside jit (tracers carry no values), so this
+    runs on the concrete target at the outermost wrapper; traced targets
+    (an already-jitted caller) pass through unchecked.
+
+    The target and ``data_term`` are located by BINDING the call to the
+    wrapped function's signature (``functools.wraps`` chains through
+    jit's ``__wrapped__``), so keyword targets (``targets=frames``) and
+    positional ``data_term`` both resolve — a (params, target, *args)
+    wrapper shape would break the former and silently skip the latter.
+    """
+    import inspect
+
+    sig = inspect.signature(fn)
+    target_name = list(sig.parameters)[1]   # fit: target_verts; seq: targets
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kw):
+        try:
+            bound = sig.bind(*args, **kw)
+        except TypeError:
+            # Malformed call: let the real function raise its own error.
+            return fn(*args, **kw)
+        if bound.arguments.get("data_term") == "silhouette":
+            target = bound.arguments.get(target_name)
+            if target is not None and not isinstance(target,
+                                                     jax.core.Tracer):
+                import numpy as np
+                t = np.asarray(target)
+                if t.size and (float(t.min()) < 0.0
+                               or float(t.max()) > 1.0):
+                    raise ValueError(
+                        "silhouette target mask must be in [0, 1], got "
+                        f"range [{float(t.min()):g}, {float(t.max()):g}] "
+                        "— divide a 0/255 uint8 mask by 255"
+                    )
+        return fn(*args, **kw)
 
     return wrapper
 
@@ -310,7 +363,8 @@ def normalize_conf(target_conf, n_kp: int, dtype):
 
 def _data_loss(out, offset, target, data_term: str, camera, conf,
                robust: str = "none", robust_scale: float = 0.01,
-               tips=None, keypoint_order: str = "mano"):
+               tips=None, keypoint_order: str = "mano",
+               faces=None, sil_sigma: float = 0.7):
     """The one data-term dispatch shared by every Adam solver.
 
     - ``verts``: full-mesh L2 (known correspondence).
@@ -324,6 +378,12 @@ def _data_loss(out, offset, target, data_term: str, camera, conf,
       observed point to its nearest mesh vertex. Partial views are fine;
       pair with the priors (unobserved regions are unconstrained) and
       ``fit_trans=True`` when the scan is not origin-aligned.
+    - ``silhouette``: soft-IoU against a binary/float [H, W] mask — the
+      mesh is differentiably rasterized through ``camera`` at the
+      target's resolution (viz.soft_silhouette) and compared as images.
+      The only term that observes the SURFACE from one view without any
+      detector; heavily ill-posed alone (any pose with the same outline
+      matches), so pair with priors, and with keypoints2d when available.
 
     ``robust="huber"`` replaces the per-point squared distance with a
     Huber penalty at scale ``robust_scale`` (same units as the data:
@@ -333,6 +393,18 @@ def _data_loss(out, offset, target, data_term: str, camera, conf,
     """
     if robust not in ("none", "huber"):
         raise ValueError(f"robust must be 'none' or 'huber', got {robust!r}")
+    if data_term == "silhouette":
+        if robust != "none":
+            # The IoU is already bounded per image; there is no per-point
+            # distance for Huber to act on.
+            raise ValueError("robust does not apply to data_term='silhouette'")
+        from mano_hand_tpu.viz.silhouette import soft_silhouette
+        sil = soft_silhouette(
+            out.verts + offset, faces, camera,
+            height=target.shape[-2], width=target.shape[-1],
+            sigma=sil_sigma,
+        )
+        return jnp.mean(objectives.silhouette_iou_loss(sil, target))
     if (robust == "huber" and not isinstance(robust_scale, jax.core.Tracer)
             and float(robust_scale) <= 0):
         # A zero scale makes the whole data term identically zero (the
@@ -406,6 +478,7 @@ def _fit_single(
     self_penetration_weight: float = 0.0,
     self_penetration_radius: float = 0.004,
     self_pen_mask: Optional[jnp.ndarray] = None,
+    sil_sigma: float = 0.7,
 ) -> FitResult:
     _check_data_term(data_term, camera, conf)
     _check_pose_prior(pose_prior, pose_space)
@@ -457,7 +530,8 @@ def _fit_single(
         out = model_out(p)
         offset = p["trans"] if fit_trans else 0.0
         data = _data_loss(out, offset, target, data_term, camera, conf,
-                          robust, robust_scale, tips, keypoint_order)
+                          robust, robust_scale, tips, keypoint_order,
+                          params.faces, sil_sigma)
         # Prior weights may be traced scalars (see fit): plain multiplies.
         reg = (
             _pose_reg(pose_space, pose_prior, pose_prior_vars, params, p,
@@ -488,6 +562,7 @@ def _fit_single(
     )
 
 
+@validate_mask_target
 @normalize_tips_kwarg
 @prepare_self_pen
 @functools.partial(
@@ -521,6 +596,7 @@ def fit(
     self_penetration_weight: float = 0.0,   # STATIC: nonzero recompiles
     self_penetration_radius: float = 0.004,
     _self_pen_mask=None,         # built by prepare_self_pen; do not pass
+    sil_sigma: float = 0.7,      # silhouette edge softness, pixels
 ) -> FitResult:
     """Recover pose/shape for one target mesh or a batch of them.
 
@@ -534,7 +610,14 @@ def fit(
     with ``fit_trans=True`` (adds a global translation DOF) and nonzero
     priors — under pinhole projection depth is only observable through
     perspective scaling, and under weak perspective not at all (keep the
-    z-prior on). For a custom
+    z-prior on). ``data_term='silhouette'`` fits a segmentation MASK
+    instead: the mesh is differentiably rasterized through ``camera``
+    (viz.soft_silhouette, edge softness ``sil_sigma`` pixels) and scored
+    by soft IoU at the target's [H, W] resolution — the right term when
+    a segmenter is trusted but no keypoint detector is; it observes only
+    the outline, so keep the pose priors on (and combine with keypoints
+    by summing fits' losses via ``fit_with_optimizer`` components if both
+    are available). For a custom
     optimizer use ``fit_with_optimizer`` (not jitted at this level so the
     transformation can be any optax object).
 
@@ -574,9 +657,11 @@ def fit(
         self_penetration_weight=self_penetration_weight,
         self_penetration_radius=self_penetration_radius,
         _self_pen_mask=_self_pen_mask,
+        sil_sigma=sil_sigma,
     )
 
 
+@validate_mask_target
 @prepare_self_pen
 def fit_with_optimizer(
     params: ManoParams,
@@ -601,6 +686,7 @@ def fit_with_optimizer(
     self_penetration_weight: float = 0.0,
     self_penetration_radius: float = 0.004,
     _self_pen_mask=None,
+    sil_sigma: float = 0.7,
 ) -> FitResult:
     _check_data_term(data_term, camera, target_conf)
     target_verts = jnp.asarray(target_verts, params.v_template.dtype)
@@ -629,6 +715,7 @@ def fit_with_optimizer(
         self_penetration_weight=self_penetration_weight,
         self_penetration_radius=self_penetration_radius,
         self_pen_mask=_self_pen_mask,
+        sil_sigma=sil_sigma,
     )
     if data_term == "points" and target_verts.shape[-2] == 0:
         # A zero-point cloud (empty depth-scan foreground) would mean() over
@@ -667,6 +754,7 @@ class SequenceFitResult(NamedTuple):
     trans: Optional[jnp.ndarray] = None  # [T, 3] when fit_trans=True
 
 
+@validate_mask_target
 @normalize_tips_kwarg
 @prepare_self_pen
 @functools.partial(
@@ -699,6 +787,7 @@ def fit_sequence(
     self_penetration_weight: float = 0.0,   # STATIC: nonzero recompiles
     self_penetration_radius: float = 0.004,
     _self_pen_mask=None,
+    sil_sigma: float = 0.7,
 ) -> SequenceFitResult:
     """Track a whole motion clip as ONE optimization problem.
 
@@ -768,7 +857,7 @@ def fit_sequence(
         )
         data = _data_loss(out, offset, targets, data_term, camera,
                           target_conf, robust, robust_scale, tips,
-                          keypoint_order)
+                          keypoint_order, params.faces, sil_sigma)
         # t_frames is static: skip velocity terms for single-frame clips
         # (mean over an empty array is NaN and would poison every grad).
         # Velocity couples whichever representation is being optimized —

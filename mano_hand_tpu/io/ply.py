@@ -147,6 +147,15 @@ def read_ply(path: PathLike) -> PlyMesh:
                 data = np.loadtxt(
                     rows, dtype=np.float64, ndmin=2
                 ) if count else np.zeros((0, len(props)))
+                if data.shape[0] != count:
+                    # loadtxt silently skips blank/'#' lines; rows were
+                    # sliced BY count, so a skip desyncs every later
+                    # element block — fail here with the real cause.
+                    raise ValueError(
+                        f"{path}: vertex element declares {count} rows but "
+                        f"{data.shape[0]} parsed (blank or comment line "
+                        "inside the vertex block?)"
+                    )
                 cols = {p: data[:, i] for i, (p, _) in enumerate(props)}
             else:
                 data = np.frombuffer(
@@ -176,8 +185,16 @@ def read_ply(path: PathLike) -> PlyMesh:
             if fmt == "ascii":
                 rows = ascii_rows[row_cursor:row_cursor + count]
                 row_cursor += count
-                for r in rows:
+                for i, r in enumerate(rows):
                     vals = r.split()
+                    if not vals:
+                        # Same scanner artifact as the vertex-block check:
+                        # a blank row would otherwise IndexError below
+                        # with no file/element context.
+                        raise ValueError(
+                            f"{path}: blank line inside the face element "
+                            f"(row {i} of {count})"
+                        )
                     # Per-row: scalars and lists in property order; pick
                     # the vertex-index list, skip everything else.
                     pos = 0

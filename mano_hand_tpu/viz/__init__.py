@@ -17,6 +17,7 @@ from mano_hand_tpu.viz.camera import (
     view_rotation,
 )
 from mano_hand_tpu.viz.render import render_mesh, render_sequence
+from mano_hand_tpu.viz.silhouette import soft_silhouette
 from mano_hand_tpu.viz.png import write_png, write_gif
 from mano_hand_tpu.viz.avi import write_avi, read_avi_info
 
@@ -27,6 +28,7 @@ __all__ = [
     "view_rotation",
     "render_mesh",
     "render_sequence",
+    "soft_silhouette",
     "write_png",
     "write_gif",
     "write_avi",

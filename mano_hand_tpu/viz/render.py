@@ -39,6 +39,36 @@ def _shade(
     return 0.35 + 0.65 * lambert
 
 
+def ndc_to_pixels(proj_xy: jnp.ndarray, height: int, width: int):
+    """NDC xy [..., 2] -> pixel coords [..., 2], y flipped so +y in world
+    points up on screen. THE raster-space mapping — the hard renderer and
+    the soft silhouette both use it, which is what guarantees that masks
+    fitted via ``soft_silhouette`` line up pixel-for-pixel with
+    ``render_mesh`` output (pinned by a registration test)."""
+    sx = (proj_xy[..., 0] * 0.5 + 0.5) * width
+    sy = (1.0 - (proj_xy[..., 1] * 0.5 + 0.5)) * height
+    return jnp.stack([sx, sy], axis=-1)
+
+
+def chunked_pixel_grid(height: int, width: int, chunk_rows: int, dtype):
+    """Pixel-center coordinates grouped into row chunks for ``lax.map``:
+    (gx, gy), each [height // chunk_rows, chunk_rows * width]."""
+    ys = jnp.arange(height, dtype=dtype) + 0.5
+    xs = jnp.arange(width, dtype=dtype) + 0.5
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    return (
+        gx.reshape(height // chunk_rows, chunk_rows * width),
+        gy.reshape(height // chunk_rows, chunk_rows * width),
+    )
+
+
+def best_chunk_rows(height: int, chunk_rows: int) -> int:
+    """Largest divisor of ``height`` that is <= ``chunk_rows`` — keeps odd
+    image heights (e.g. 100- or 180-row masks) from silently degrading to
+    one-row chunks and multiplying the ``lax.map`` trip count."""
+    return max(c for c in range(1, chunk_rows + 1) if height % c == 0)
+
+
 def _raster_chunk(px, py, corners, depths, intens):
     """Coverage test of a pixel chunk against every face.
 
@@ -86,19 +116,12 @@ def _render_impl(
     height: int, width: int, chunk_rows: int,
 ):
     proj = camera.project(verts)                                # [V, 3]
-    # NDC -> pixel centers; y flipped so +y in world points up on screen.
-    sx = (proj[:, 0] * 0.5 + 0.5) * width
-    sy = (1.0 - (proj[:, 1] * 0.5 + 0.5)) * height
-    screen = jnp.stack([sx, sy], axis=-1)                       # [V, 2]
+    screen = ndc_to_pixels(proj[:, :2], height, width)          # [V, 2]
     corners = screen[faces]                                     # [F, 3, 2]
     depths = proj[:, 2][faces]                                  # [F, 3]
     intens = _shade(verts, faces, camera, light_dir)[faces]     # [F, 3]
 
-    ys = (jnp.arange(height, dtype=jnp.float32) + 0.5)
-    xs = (jnp.arange(width, dtype=jnp.float32) + 0.5)
-    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")                # [H, W]
-    gx = gx.reshape(height // chunk_rows, chunk_rows * width)
-    gy = gy.reshape(height // chunk_rows, chunk_rows * width)
+    gx, gy = chunked_pixel_grid(height, width, chunk_rows, jnp.float32)
 
     def row_chunk(pix):
         px, py = pix
@@ -125,8 +148,7 @@ def render_mesh(
     """Render one mesh to an [H, W, 3] float image in [0, 1]."""
     if camera is None:
         camera = default_hand_camera()
-    if height % chunk_rows:
-        chunk_rows = 1
+    chunk_rows = best_chunk_rows(height, chunk_rows)
     return _render_impl(
         jnp.asarray(verts, jnp.float32),
         jnp.asarray(faces, jnp.int32),

@@ -175,6 +175,40 @@ def test_read_ply_scanner_variants(tmp_path):
     with pytest.raises(ValueError, match="not a PLY"):
         read_ply(bad)
 
+    # A blank line inside an ASCII vertex block: np.loadtxt silently
+    # skips it, which would desync the vertex and face blocks — the
+    # reader must fail with the real cause, not a downstream parse error.
+    blank = tmp_path / "blank.ply"
+    blank.write_text("\n".join([
+        "ply", "format ascii 1.0",
+        "element vertex 3",
+        "property float x", "property float y", "property float z",
+        "element face 1",
+        "property list uchar int vertex_indices",
+        "end_header",
+        "0 0 0", "", "1 0 0",   # blank line swallows the third vertex row
+        "0 1 0",
+        "3 0 1 2",
+    ]) + "\n")
+    with pytest.raises(ValueError, match="declares 3 rows"):
+        read_ply(blank)
+
+    # Same artifact inside the FACE block: named error, not IndexError.
+    blankf = tmp_path / "blankface.ply"
+    blankf.write_text("\n".join([
+        "ply", "format ascii 1.0",
+        "element vertex 3",
+        "property float x", "property float y", "property float z",
+        "element face 2",
+        "property list uchar int vertex_indices",
+        "end_header",
+        "0 0 0", "1 0 0", "0 1 0",
+        "3 0 1 2", "",
+        "3 2 1 0",
+    ]) + "\n")
+    with pytest.raises(ValueError, match="blank line inside the face"):
+        read_ply(blankf)
+
     # Extra scalar property on faces → the general per-face parse path.
     hdr = "\n".join([
         "ply", "format binary_little_endian 1.0",

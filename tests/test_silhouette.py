@@ -1,0 +1,267 @@
+"""Tests for the differentiable soft silhouette and mask-based fitting.
+
+The reference has no image-based fitting of any kind; this is a
+beyond-reference capability (viz/silhouette.py, SoftRas-style), so the
+tests pin the renderer's geometry analytically (known triangles at known
+pixels), its gradients, and the end-to-end mask-fitting path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mano_hand_tpu import fitting, viz
+from mano_hand_tpu.assets import synthetic_params
+from mano_hand_tpu.fitting import objectives
+from mano_hand_tpu.models import core
+from mano_hand_tpu.viz.camera import Camera
+from mano_hand_tpu.viz.silhouette import soft_silhouette
+
+# An identity camera with focal 1 and z-offset 1: NDC xy == world xy for
+# points in the z=0 plane, so pixel positions are exact by construction.
+_CAM = Camera(
+    rot=jnp.eye(3, dtype=jnp.float32),
+    trans=jnp.asarray([0.0, 0.0, 1.0], jnp.float32),
+    focal=1.0,
+)
+
+
+def _tri(xy):
+    """A z=0 triangle from NDC corner coords [3, 2] -> verts [3, 3]."""
+    xy = np.asarray(xy, np.float32)
+    return jnp.asarray(np.concatenate([xy, np.zeros((3, 1), np.float32)], 1))
+
+
+class TestSoftSilhouette:
+    def test_interior_exterior_edge_values(self):
+        # A triangle covering the right half of the image; with a small
+        # sigma the occupancy is ~1 well inside, ~0 well outside, and
+        # 0.5 on the boundary edge (x = 0 -> pixel column w/2).
+        verts = _tri([[0.0, -2.0], [0.0, 2.0], [2.5, 0.0]])
+        faces = jnp.asarray([[0, 1, 2]], jnp.int32)
+        sil = soft_silhouette(
+            verts, faces, _CAM, height=32, width=32, sigma=0.4
+        )
+        assert sil.shape == (32, 32)
+        assert float(sil.min()) >= 0.0 and float(sil.max()) <= 1.0
+        assert float(sil[16, 24]) > 0.95      # interior
+        assert float(sil[16, 4]) < 0.05       # exterior
+        # The vertical edge runs through x_ndc=0 = pixel x=16; pixel
+        # centers at 15.5/16.5 sit half a pixel either side of it.
+        assert 0.1 < float(sil[16, 15]) < 0.5
+        assert 0.5 < float(sil[16, 16]) < 0.9
+
+    def test_union_of_disjoint_triangles(self):
+        # Two far-apart triangles: the aggregated image is the sum of the
+        # individual ones (no overlap to saturate the union).
+        t1 = _tri([[-1.5, -1.5], [-1.5, 1.5], [-0.5, 0.0]])
+        t2 = _tri([[1.5, -1.5], [1.5, 1.5], [0.5, 0.0]])
+        both = jnp.concatenate([t1, t2])
+        f1 = jnp.asarray([[0, 1, 2]], jnp.int32)
+        f_both = jnp.asarray([[0, 1, 2], [3, 4, 5]], jnp.int32)
+        kw = dict(camera=_CAM, height=24, width=24, sigma=0.5)
+        s1 = soft_silhouette(t1, f1, **kw)
+        s2 = soft_silhouette(t2, f1, **kw)
+        s12 = soft_silhouette(both, f_both, **kw)
+        np.testing.assert_allclose(
+            np.asarray(s12), np.asarray(s1 + s2), atol=1e-4
+        )
+
+    def test_overlapping_faces_saturate_not_sum(self):
+        # The same triangle twice must NOT double the occupancy — the
+        # probabilistic union keeps it in [0, 1].
+        t = _tri([[-1.0, -1.0], [-1.0, 1.0], [1.0, 0.0]])
+        faces2 = jnp.asarray([[0, 1, 2], [0, 1, 2]], jnp.int32)
+        sil = soft_silhouette(t, faces2, _CAM, height=16, width=16,
+                              sigma=0.5)
+        assert float(sil.max()) <= 1.0
+
+    def test_batch_axes_map(self):
+        t = _tri([[-1.0, -1.0], [-1.0, 1.0], [1.0, 0.0]])
+        f = jnp.asarray([[0, 1, 2]], jnp.int32)
+        batched = jnp.stack([t, t + 0.1])
+        sil = soft_silhouette(batched, f, _CAM, height=16, width=16)
+        assert sil.shape == (2, 16, 16)
+        one = soft_silhouette(t, f, _CAM, height=16, width=16)
+        np.testing.assert_allclose(np.asarray(sil[0]), np.asarray(one),
+                                   atol=1e-6)
+
+    def test_odd_height_uses_largest_divisor_chunks(self):
+        # 20 rows with the default chunk_rows=8 must pick 4-row chunks
+        # (not silently degrade to 1-row chunks) and agree exactly with
+        # the unchunked computation.
+        from mano_hand_tpu.viz.render import best_chunk_rows
+        assert best_chunk_rows(20, 8) == 5
+        assert best_chunk_rows(100, 8) == 5
+        assert best_chunk_rows(7, 8) == 7
+        assert best_chunk_rows(13, 8) == 1
+        t = _tri([[-1.0, -1.0], [-1.0, 1.0], [1.0, 0.0]])
+        f = jnp.asarray([[0, 1, 2]], jnp.int32)
+        a = soft_silhouette(t, f, _CAM, height=20, width=16)
+        b = soft_silhouette(t, f, _CAM, height=20, width=16, chunk_rows=1)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
+
+    def test_gradients_finite_and_nonzero(self):
+        t = _tri([[-1.0, -1.0], [-1.0, 1.0], [1.0, 0.0]])
+        f = jnp.asarray([[0, 1, 2]], jnp.int32)
+
+        def coverage(v):
+            return jnp.mean(
+                soft_silhouette(v, f, _CAM, height=16, width=16, sigma=1.0)
+            )
+
+        g = jax.grad(coverage)(t)
+        assert np.all(np.isfinite(np.asarray(g)))
+        assert float(jnp.abs(g).max()) > 0.0
+
+    def test_mesh_silhouette_on_hand_asset(self):
+        params = synthetic_params(seed=0, n_verts=64, n_faces=96,
+                                  dtype=np.float32)
+        out = core.forward(params, jnp.zeros((16, 3), jnp.float32),
+                           jnp.zeros((10,), jnp.float32))
+        sil = viz.soft_silhouette(out.verts, params.faces, height=32,
+                                  width=32)
+        # The default hand camera frames the blob: some coverage, not all.
+        total = float(sil.sum())
+        assert 1.0 < total < 32 * 32 * 0.9
+        assert np.all(np.isfinite(np.asarray(sil)))
+
+
+class TestSilhouetteIoULoss:
+    def test_identical_binary_is_zero(self):
+        # Binary masks: self-IoU is exactly 1. (For two SOFT images the
+        # product intersection bottoms out slightly above 0 — documented.)
+        m = jnp.asarray(
+            np.random.default_rng(0).random((8, 8)) > 0.5, jnp.float32
+        )
+        assert float(objectives.silhouette_iou_loss(m, m)) < 1e-5
+
+    def test_disjoint_is_one(self):
+        a = jnp.zeros((8, 8)).at[:4].set(1.0)
+        b = jnp.zeros((8, 8)).at[4:].set(1.0)
+        assert float(objectives.silhouette_iou_loss(a, b)) > 0.99
+
+    def test_empty_empty_is_zero(self):
+        z = jnp.zeros((8, 8))
+        assert float(objectives.silhouette_iou_loss(z, z)) == pytest.approx(
+            0.0, abs=1e-6
+        )
+
+    def test_batched_reduction(self):
+        a = jnp.zeros((3, 8, 8)).at[:, :4].set(1.0)
+        out = objectives.silhouette_iou_loss(a, a)
+        assert out.shape == (3,)
+
+
+class TestSilhouetteFitting:
+    @pytest.fixture(scope="class")
+    def small(self):
+        return synthetic_params(seed=3, n_verts=64, n_faces=96,
+                                dtype=np.float32)
+
+    def test_fit_recovers_translation(self, small):
+        # Target mask: the soft silhouette of the hand displaced in the
+        # image plane — the signal silhouettes observe most strongly.
+        # Under a PINHOLE camera the depth axis is the classic silhouette
+        # pathology (pushing the hand toward the camera inflates the mask
+        # — measured: z drifts to -0.15 m and the fit stalls), exactly
+        # the keypoints2d docstring's ill-posedness warning; the
+        # weak-perspective camera removes that axis by construction, so
+        # the planar recovery asserts cleanly.
+        cam = viz.WeakPerspectiveCamera(
+            rot=jnp.eye(3, dtype=jnp.float32), scale=3.0
+        )
+        true_trans = jnp.asarray([0.05, 0.04, 0.0], jnp.float32)
+        target_out = core.forward(small, jnp.zeros((16, 3), jnp.float32),
+                                  jnp.zeros((10,), jnp.float32))
+        # Binarized, the way real segmentation masks arrive. (A SOFT
+        # target sets a high loss floor on this wispy random-triangle
+        # mesh — most of its mask mass is fractional boundary pixels —
+        # which would mask the convergence signal.)
+        target = (
+            soft_silhouette(target_out.verts + true_trans, small.faces,
+                            cam, height=32, width=32, sigma=1.0) > 0.5
+        ).astype(jnp.float32)
+        res = fitting.fit(
+            small, target, n_steps=300, lr=0.01,
+            data_term="silhouette", camera=cam, sil_sigma=1.0,
+            fit_trans=True, pose_prior_weight=1.0, shape_prior_weight=1.0,
+        )
+        # The aligned soft-vs-binary floor (boundary pixels are
+        # irreducibly fractional): the fit must reach it...
+        floor = float(objectives.silhouette_iou_loss(
+            soft_silhouette(target_out.verts + true_trans, small.faces,
+                            cam, height=32, width=32, sigma=1.0), target
+        ))
+        out1 = core.forward(small, res.pose, res.shape)
+        sil1 = soft_silhouette(out1.verts + res.trans, small.faces, cam,
+                               height=32, width=32, sigma=1.0)
+        loss1 = float(objectives.silhouette_iou_loss(sil1, target))
+        assert loss1 < floor + 0.01
+        # ...and the planar displacement itself must be recovered (z is
+        # structurally unobservable under weak perspective and stays 0).
+        err = np.linalg.norm(np.asarray(res.trans[:2] - true_trans[:2]))
+        assert err < 0.01
+        assert float(jnp.abs(res.trans[2])) < 1e-6
+
+    def test_sequence_accepts_masks(self, small):
+        target = jnp.zeros((3, 16, 16)).at[:, 4:12, 4:12].set(1.0)
+        res = fitting.fit_sequence(
+            small, target, n_steps=5, data_term="silhouette",
+            camera=viz.camera.default_hand_camera(),
+        )
+        assert res.pose.shape == (3, 16, 3)
+        assert np.all(np.isfinite(np.asarray(res.final_loss)))
+
+    def test_validation_errors(self, small):
+        mask = jnp.zeros((16, 16))
+        with pytest.raises(ValueError, match="needs a viz.camera.Camera"):
+            fitting.fit(small, mask, data_term="silhouette")
+        cam = viz.camera.default_hand_camera()
+        with pytest.raises(ValueError, match="robust does not apply"):
+            fitting.fit(small, mask, data_term="silhouette", camera=cam,
+                        robust="huber", n_steps=2)
+        with pytest.raises(ValueError, match="target_conf"):
+            fitting.fit(small, mask, data_term="silhouette", camera=cam,
+                        target_conf=jnp.ones((16,)), n_steps=2)
+        # The most common real-world mistake: a raw uint8 0/255 mask.
+        # Unchecked it would produce a negative, ~255x-scaled loss.
+        mask255 = np.zeros((16, 16), np.uint8)
+        mask255[4:12, 4:12] = 255
+        with pytest.raises(ValueError, match="divide a 0/255"):
+            fitting.fit(small, mask255, data_term="silhouette", camera=cam,
+                        n_steps=2)
+        with pytest.raises(ValueError, match="divide a 0/255"):
+            fitting.fit_sequence(
+                small, np.stack([mask255] * 2), data_term="silhouette",
+                camera=cam, n_steps=2,
+            )
+        # Normalized, the same mask is accepted.
+        fitting.fit(small, mask255 / 255.0, data_term="silhouette",
+                    camera=cam, n_steps=2)
+        # The mask check binds the call to the real signature, so a
+        # POSITIONAL data_term is still caught...
+        with pytest.raises(ValueError, match="divide a 0/255"):
+            fitting.fit_sequence(
+                small, np.stack([mask255] * 2), 2, 0.03, "silhouette", cam
+            )
+        # ...and keyword-target calls (every parameter by name) still
+        # work for the other data terms.
+        target = core.forward(small).verts
+        res = fitting.fit(small, target_verts=target, n_steps=2)
+        assert res.pose.shape == (16, 3)
+        seq = fitting.fit_sequence(
+            small, targets=jnp.stack([target] * 2), n_steps=2
+        )
+        assert seq.pose.shape == (2, 16, 3)
+
+    def test_fit_hands_rejects_silhouette(self):
+        from mano_hand_tpu.assets import synthetic_pair
+        left, right = synthetic_pair(seed=0, dtype=np.float32)
+        stacked = core.stack_params(left, right)
+        with pytest.raises(ValueError, match="instance mask"):
+            fitting.fit_hands(
+                stacked, jnp.zeros((2, 16, 16)), data_term="silhouette",
+                camera=viz.camera.default_hand_camera(),
+            )
