@@ -5,6 +5,17 @@ round-3 close-out #1). Current subjects: the batched-LU normal-equation
 solve (landed; isolated probe bench_results/probe_solve.py measured 8x
 the vmapped Cholesky) and JtJ/Jtr contraction precision.
 
+METHODOLOGY NOTE (the first version of this probe was wrong): timing a
+loop of enqueued fits and blocking only once at the end measured
+0.049 ms/step for the analytic path — physically impossible (the
+[B, V, 3, P] Jacobian slab alone costs more HBM traffic than that per
+step). On the axon tunnel, back-to-back dispatches of the SAME program
+with the SAME operands do not reliably serialize into device-time sums
+the way local backends do. Always block per call, and difference two
+n_steps variants (slope method) so the ~70 ms tunnel dispatch cost and
+any fixed per-call overhead cancel — the same discipline bench.py uses
+for the forward configs.
+
 Run: JAX_PLATFORMS=axon python bench_results/probe_lm_solve.py
 """
 
@@ -29,7 +40,9 @@ from mano_hand_tpu.assets import synthetic
 from mano_hand_tpu.fitting import lm
 from mano_hand_tpu.models import core
 
-B, STEPS = 256, 30
+B = 256
+STEPS_LO, STEPS_HI = 30, 90
+REPEATS = 6
 
 
 def run(label, **kw):
@@ -43,20 +56,26 @@ def run(label, **kw):
         pose, shape
     )
     jax.block_until_ready(target)
-    fit = lambda: lm.fit_lm(params, target, n_steps=STEPS, **kw)  # noqa: E731
-    out = fit()
-    jax.block_until_ready(out)
-    n = 10
-    t0 = time.perf_counter()
-    for _ in range(n):
-        out = fit()
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / n
-    per_step = dt / STEPS
+
+    def timed(n_steps):
+        out = lm.fit_lm(params, target, n_steps=n_steps, **kw)
+        jax.block_until_ready(out)          # warm/compile
+        best = float("inf")
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            out = lm.fit_lm(params, target, n_steps=n_steps, **kw)
+            jax.block_until_ready(out)      # block EVERY call
+            best = min(best, time.perf_counter() - t0)
+        return best, out
+
+    t_lo, _ = timed(STEPS_LO)
+    t_hi, out = timed(STEPS_HI)
+    per_step = (t_hi - t_lo) / (STEPS_HI - STEPS_LO)
     print(
-        f"{label:16s} {per_step*1e3:7.3f} ms/step "
-        f"({1/per_step:6.1f} steps/s)  final_loss="
-        f"{float(out.final_loss.mean()):.3e}"
+        f"{label:16s} slope {per_step*1e3:7.3f} ms/step "
+        f"({1/per_step:6.1f} steps/s)  "
+        f"[t{STEPS_LO}={t_lo*1e3:.1f}ms t{STEPS_HI}={t_hi*1e3:.1f}ms]  "
+        f"final_loss={float(out.final_loss.mean()):.3e}"
     )
 
 

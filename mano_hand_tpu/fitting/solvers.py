@@ -190,6 +190,14 @@ def _check_data_term(data_term: str, camera, conf) -> None:
                 f"data_term={data_term!r} needs a viz.camera.Camera (or "
                 "WeakPerspectiveCamera)"
             )
+        if is_multiview(camera):
+            if data_term != "silhouette":
+                raise ValueError(
+                    "a camera list (multi-view) is only supported for "
+                    "data_term='silhouette'; keypoints2d takes one camera"
+                )
+            if len(camera) == 0:
+                raise ValueError("camera list is empty")
         if conf is not None and data_term == "silhouette":
             raise ValueError(
                 "target_conf only applies to data_term='keypoints2d'"
@@ -361,6 +369,38 @@ def normalize_conf(target_conf, n_kp: int, dtype):
     return target_conf
 
 
+def is_multiview(camera) -> bool:
+    """True when ``camera`` is a LIST of cameras (multi-view silhouette).
+
+    THE one detection everywhere: a plain ``isinstance(camera, tuple)``
+    is wrong because ``Camera``/``WeakPerspectiveCamera`` are NamedTuples
+    — tuple subclasses — and a single camera would read as a "list" of
+    its own fields. A camera is whatever exposes ``project``.
+    """
+    return (isinstance(camera, (list, tuple))
+            and not hasattr(camera, "project"))
+
+
+def check_silhouette_views(camera, target, fn_name: str) -> int:
+    """Per-problem target rank for the silhouette term (2, or 3 when
+    multi-view), after validating the view axis against the camera list.
+    Static shapes, so a views/cameras mismatch fails here by name instead
+    of as a broadcast error mid-trace."""
+    if not is_multiview(camera):
+        return 2
+    if target.ndim < 3 or target.shape[-3] != len(camera):
+        # ndim < 3 = a single [H, W] mask with a camera list: without
+        # this, the batched dispatch would read mask ROWS as problems
+        # and die mid-trace — the unnamed failure this check pre-empts.
+        views = target.shape[-3] if target.ndim >= 3 else "no"
+        raise ValueError(
+            f"{fn_name}: {len(camera)} cameras but target has "
+            f"{views} views on axis -3 (shape {target.shape}; "
+            "multi-view silhouette targets are [..., n_views, H, W])"
+        )
+    return 3
+
+
 def _data_loss(out, offset, target, data_term: str, camera, conf,
                robust: str = "none", robust_scale: float = 0.01,
                tips=None, keypoint_order: str = "mano",
@@ -384,6 +424,10 @@ def _data_loss(out, offset, target, data_term: str, camera, conf,
       The only term that observes the SURFACE from one view without any
       detector; heavily ill-posed alone (any pose with the same outline
       matches), so pair with priors, and with keypoints2d when available.
+      A TUPLE of cameras with [..., C, H, W] targets fits all views
+      jointly (mean per-view IoU) — the visual-hull setup: two or more
+      calibrated views restore the depth axis a single outline cannot
+      observe.
 
     ``robust="huber"`` replaces the per-point squared distance with a
     Huber penalty at scale ``robust_scale`` (same units as the data:
@@ -399,11 +443,19 @@ def _data_loss(out, offset, target, data_term: str, camera, conf,
             # distance for Huber to act on.
             raise ValueError("robust does not apply to data_term='silhouette'")
         from mano_hand_tpu.viz.silhouette import soft_silhouette
-        sil = soft_silhouette(
-            out.verts + offset, faces, camera,
-            height=target.shape[-2], width=target.shape[-1],
-            sigma=sil_sigma,
-        )
+        verts = out.verts + offset
+        h, w = target.shape[-2], target.shape[-1]
+        if is_multiview(camera):
+            # Multi-view: one [H, W] render per calibrated camera, view
+            # axis stacked at -3 to line up with [..., C, H, W] targets.
+            sil = jnp.stack(
+                [soft_silhouette(verts, faces, c, height=h, width=w,
+                                 sigma=sil_sigma) for c in camera],
+                axis=-3,
+            )
+        else:
+            sil = soft_silhouette(verts, faces, camera, height=h, width=w,
+                                  sigma=sil_sigma)
         return jnp.mean(objectives.silhouette_iou_loss(sil, target))
     if (robust == "huber" and not isinstance(robust_scale, jax.core.Tracer)
             and float(robust_scale) <= 0):
@@ -723,7 +775,10 @@ def fit_with_optimizer(
         raise ValueError("points target cloud is empty ([..., 0, 3])")
     target_conf = normalize_conf(target_conf, n_kp,
                                  params.v_template.dtype)
-    if target_verts.ndim == 2:
+    single_ndim = 2
+    if data_term == "silhouette":
+        single_ndim = check_silhouette_views(camera, target_verts, "fit")
+    if target_verts.ndim == single_ndim:
         return single(target_verts, target_conf, init=init)
     # Batched problems: map conf per-problem when it is [B, J]; a shared
     # [J] conf (or None) broadcasts via in_axes=None. A warm-start init
@@ -815,12 +870,18 @@ def fit_sequence(
     _check_pose_prior(pose_prior, pose_space)
     dtype = params.v_template.dtype
     targets = jnp.asarray(targets, dtype)
-    if targets.ndim != 3:
+    want_ndim = 3
+    if data_term == "silhouette":
+        want_ndim = 1 + check_silhouette_views(camera, targets,
+                                               "fit_sequence")
+    if targets.ndim != want_ndim:
         # A [V, 3]/[J, 3] single frame would otherwise be read as V or J
         # one-point frames via broadcasting and fit garbage silently.
         raise ValueError(
-            "fit_sequence targets must be [T, rows, coords]; for a single "
-            f"frame use fit(). Got shape {targets.shape}"
+            "fit_sequence targets must be [T, rows, coords] ([T, H, W] "
+            "masks / [T, n_views, H, W] multi-view for the silhouette "
+            "term); for a single frame use fit(). Got shape "
+            f"{targets.shape}"
         )
     if data_term == "points" and targets.shape[-2] == 0:
         raise ValueError("points target cloud is empty ([T, 0, 3])")

@@ -187,13 +187,14 @@ def read_ply(path: PathLike) -> PlyMesh:
                 row_cursor += count
                 for i, r in enumerate(rows):
                     vals = r.split()
-                    if not vals:
+                    if not vals or vals[0].startswith("#"):
                         # Same scanner artifact as the vertex-block check:
-                        # a blank row would otherwise IndexError below
-                        # with no file/element context.
+                        # a blank/comment row would otherwise die below as
+                        # an int() parse error with no file/element
+                        # context.
                         raise ValueError(
-                            f"{path}: blank line inside the face element "
-                            f"(row {i} of {count})"
+                            f"{path}: blank or comment line inside the "
+                            f"face element (row {i} of {count})"
                         )
                     # Per-row: scalars and lists in property order; pick
                     # the vertex-index list, skip everything else.

@@ -206,8 +206,23 @@ def test_read_ply_scanner_variants(tmp_path):
         "3 0 1 2", "",
         "3 2 1 0",
     ]) + "\n")
-    with pytest.raises(ValueError, match="blank line inside the face"):
+    with pytest.raises(ValueError, match="blank or comment line inside"):
         read_ply(blankf)
+
+    commentf = tmp_path / "commentface.ply"
+    commentf.write_text("\n".join([
+        "ply", "format ascii 1.0",
+        "element vertex 3",
+        "property float x", "property float y", "property float z",
+        "element face 2",
+        "property list uchar int vertex_indices",
+        "end_header",
+        "0 0 0", "1 0 0", "0 1 0",
+        "3 0 1 2", "# exported by scannertool",
+        "3 2 1 0",
+    ]) + "\n")
+    with pytest.raises(ValueError, match="blank or comment line inside"):
+        read_ply(commentf)
 
     # Extra scalar property on faces → the general per-face parse path.
     hdr = "\n".join([
