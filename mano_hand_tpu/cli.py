@@ -196,13 +196,47 @@ def cmd_fit(args) -> int:
 
     params = _load_params(args.asset, args.side).astype(np.float32)
     if str(args.targets).lower().endswith(".ply"):
+        if args.data_term == "silhouette":
+            # A point cloud is not an image; without this the value guard
+            # below would emit a nonsense "divide by 255" for vert coords.
+            print("a .ply is a point cloud, not a mask: use a .npy/.png "
+                  "[H, W] image with --data-term silhouette",
+                  file=sys.stderr)
+            return 2
         # Scanner output directly: the vertex cloud of a PLY (any faces
         # are irrelevant to the ICP data terms, which resample anyway).
         from mano_hand_tpu.io.ply import read_ply
 
         targets = read_ply(args.targets).verts
+    elif str(args.targets).lower().endswith(".png"):
+        if args.data_term != "silhouette":
+            print("a .png target is a segmentation mask: use "
+                  "--data-term silhouette", file=sys.stderr)
+            return 2
+        try:
+            from PIL import Image
+        except ImportError:
+            print("reading .png masks needs Pillow; save the mask as a "
+                  ".npy [H, W] float array in [0, 1] instead",
+                  file=sys.stderr)
+            return 2
+        # Grayscale, normalized to [0, 1] — the range the soft-IoU loss
+        # is defined on (the library rejects raw 0/255 by value).
+        targets = (
+            np.asarray(Image.open(args.targets).convert("L"), np.float32)
+            / 255.0
+        )
     else:
         targets = np.load(args.targets)  # [V|J, 3|2] or [B, V|J, 3|2]
+        if args.data_term == "silhouette":
+            targets = np.asarray(targets, np.float32)
+            if targets.size and (targets.min() < 0 or targets.max() > 1):
+                # Mirror the library's value guard with a CLI-shaped
+                # error instead of a traceback.
+                print("mask values must be in [0, 1] (got "
+                      f"[{targets.min():g}, {targets.max():g}]); divide "
+                      "a 0/255 mask by 255", file=sys.stderr)
+                return 2
     if args.data_term not in ("joints", "keypoints2d"):
         # Name the real conflict for BOTH keypoint flags here — sending
         # the user to --tips from the openpose check would ping-pong them
@@ -230,29 +264,41 @@ def cmd_fit(args) -> int:
     kp_kw = {}
     if args.data_term in ("joints", "keypoints2d"):
         kp_kw = dict(tip_vertex_ids=tips, keypoint_order=args.keypoint_order)
-    if args.data_term == "keypoints2d":
-        want = (n_kp, 2)
-    elif args.data_term == "joints":
-        want = (n_kp, 3)
-    elif args.data_term in ("points", "point_to_plane"):
-        want = (None, 3)  # any number of scan points, 3D
+    if args.data_term == "silhouette":
+        # Masks are [H, W] / [B, H, W] images, not [rows, coords] arrays.
+        # A zero-size image has a constant 0 IoU loss (the empty-empty
+        # epsilon case) — zero gradients, and the INIT would be saved as
+        # a "successful" fit (same class the point-term empty check
+        # keeps out).
+        if targets.ndim not in (2, 3) or 0 in targets.shape:
+            print(f"mask targets must be non-empty [H, W] or [B, H, W] "
+                  f"for --data-term silhouette, got {targets.shape}",
+                  file=sys.stderr)
+            return 2
     else:
-        want = (params.n_verts, 3)
-    rows_ok = (
-        targets.ndim >= 2
-        and (targets.shape[-2] == want[0] if want[0] is not None
-             else targets.shape[-2] > 0)  # empty scan would fit to NaN
-    )
-    if (targets.ndim not in (2, 3) or targets.shape[-1] != want[1]
-            or not rows_ok):
-        rows = "N" if want[0] is None else str(want[0])
-        print(
-            f"targets must be [{rows}, {want[1]}] or "
-            f"[B, {rows}, {want[1]}] for --data-term {args.data_term}, "
-            f"got {targets.shape}",
-            file=sys.stderr,
+        if args.data_term == "keypoints2d":
+            want = (n_kp, 2)
+        elif args.data_term == "joints":
+            want = (n_kp, 3)
+        elif args.data_term in ("points", "point_to_plane"):
+            want = (None, 3)  # any number of scan points, 3D
+        else:
+            want = (params.n_verts, 3)
+        rows_ok = (
+            targets.ndim >= 2
+            and (targets.shape[-2] == want[0] if want[0] is not None
+                 else targets.shape[-2] > 0)  # empty scan would fit to NaN
         )
-        return 2
+        if (targets.ndim not in (2, 3) or targets.shape[-1] != want[1]
+                or not rows_ok):
+            rows = "N" if want[0] is None else str(want[0])
+            print(
+                f"targets must be [{rows}, {want[1]}] or "
+                f"[B, {rows}, {want[1]}] for --data-term {args.data_term}, "
+                f"got {targets.shape}",
+                file=sys.stderr,
+            )
+            return 2
     if not 0.0 <= args.trim < 1.0:
         print(f"--trim must be in [0, 1), got {args.trim}", file=sys.stderr)
         return 2
@@ -287,6 +333,16 @@ def cmd_fit(args) -> int:
         print("--conf only applies to --data-term keypoints2d",
               file=sys.stderr)
         return 2
+    if args.data_term != "silhouette":
+        # Refuse rather than silently drop (the --tips/--trim pattern):
+        # these flags change the fit ONLY through the mask path.
+        for flag, val in (("--camera-scale", args.camera_scale),
+                          ("--camera-rot", args.camera_rot),
+                          ("--sil-sigma", args.sil_sigma)):
+            if val is not None:
+                print(f"{flag} only applies to --data-term silhouette",
+                      file=sys.stderr)
+                return 2
     if args.solver == "lm" and (args.pose_prior != "l2"
                                 or args.pose_prior_weight is not None):
         # Either prior flag under LM is a contradiction, not a preference
@@ -300,8 +356,8 @@ def cmd_fit(args) -> int:
         if args.lr is not None:
             print("note: --lr only applies to --solver adam; ignored",
                   file=sys.stderr)
-        if args.data_term == "keypoints2d":
-            print("--data-term keypoints2d requires --solver adam",
+        if args.data_term in ("keypoints2d", "silhouette"):
+            print(f"--data-term {args.data_term} requires --solver adam",
                   file=sys.stderr)
             return 2
         if args.robust != "none":
@@ -368,13 +424,50 @@ def cmd_fit(args) -> int:
                       file=sys.stderr)
             return 2
         # Shape is weakly observable from 16 joints; regularize it
-        # (unless the user set an explicit weight).
+        # (unless the user set an explicit weight). A mask observes shape
+        # only through the outline area — hold it near zero by default.
         shape_prior = (
             args.shape_prior if args.shape_prior is not None
-            else (0.0 if args.data_term == "verts" else 1e-3)
+            else (0.0 if args.data_term == "verts"
+                  else 1.0 if args.data_term == "silhouette" else 1e-3)
         )
         kp2d = {}
         default_lr = 0.05
+        if args.data_term == "silhouette":
+            if args.robust != "none":
+                print("--robust does not apply to --data-term silhouette "
+                      "(the IoU is already bounded per image)",
+                      file=sys.stderr)
+                return 2
+            from mano_hand_tpu.viz.camera import (
+                WeakPerspectiveCamera, view_rotation,
+            )
+
+            try:
+                rot = [float(x)
+                       for x in (args.camera_rot or "0,0,0").split(",")]
+                if len(rot) != 3:
+                    raise ValueError(f"need 3 components, got {len(rot)}")
+            except ValueError as e:
+                print(f"--camera-rot must be 'x,y,z' axis-angle: {e}",
+                      file=sys.stderr)
+                return 2
+            # Weak perspective by design: under a pinhole camera a mask
+            # fit inflates the hand toward the lens (measured, see
+            # docs/api.md); the scaled-orthographic model removes that
+            # axis. Translation is the one thing an outline observes
+            # strongly — always fit it.
+            default_lr = 0.01
+            kp2d = dict(
+                camera=WeakPerspectiveCamera(
+                    rot=view_rotation(rot),
+                    scale=(3.0 if args.camera_scale is None
+                           else args.camera_scale),
+                ),
+                fit_trans=True,
+                sil_sigma=(1.0 if args.sil_sigma is None
+                           else args.sil_sigma),
+            )
         if args.data_term == "keypoints2d":
             from mano_hand_tpu.viz.camera import look_at
 
@@ -406,8 +499,11 @@ def cmd_fit(args) -> int:
                 n_pca=15,
             )
         # One decision point for the effective pose space: the user's
-        # explicit choice, else pca for depth-blind 2D data, else aa.
-        pose_space = args.pose_space or ("pca" if kp2d else "aa")
+        # explicit choice, else pca for depth-blind 2D keypoints, else aa
+        # (incl. silhouette — the mask defaults are validated in aa).
+        pose_space = args.pose_space or (
+            "pca" if args.data_term == "keypoints2d" else "aa"
+        )
         if args.pose_prior == "mahalanobis" and pose_space == "6d":
             print("--pose-prior mahalanobis needs axis-angle statistics: "
                   "use --pose-space aa or pca", file=sys.stderr)
@@ -419,6 +515,12 @@ def cmd_fit(args) -> int:
         if pose_prior_weight is None:
             if args.data_term == "keypoints2d":
                 pose_prior_weight = 1e-4
+            elif args.data_term == "silhouette":
+                # An outline alone cannot pin articulation: hold the pose
+                # hard and let translation do the observable work (the
+                # weight the mask-recovery tests validate). Lower it when
+                # combining with more views or keypoints.
+                pose_prior_weight = 1.0
             elif args.pose_prior == "mahalanobis":
                 pose_prior_weight = 1e-3
             else:
@@ -543,8 +645,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     f = sub.add_parser(
         "fit",
-        help="recover pose/shape from target verts, 3D joints, or 2D "
-             "keypoints",
+        help="recover pose/shape from target verts, 3D joints, 2D "
+             "keypoints, scan points, or segmentation masks",
     )
     f.add_argument("targets",
                    help=".npy of [V,3]/[B,V,3] verts; [16,3]/[B,16,3] "
@@ -552,7 +654,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "image points with --data-term keypoints2d; "
                         "[N,3]/[B,N,3] scan points with --data-term "
                         "points or point_to_plane (a .ply file loads "
-                        "its vertex cloud directly)")
+                        "its vertex cloud directly); an [H,W]/[B,H,W] "
+                        ".npy mask in [0,1] or a .png with --data-term "
+                        "silhouette")
     f.add_argument("--pose-space", default=None,
                    choices=["aa", "pca", "6d"],
                    help="pose parameterization: axis-angle (both solvers' "
@@ -563,14 +667,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "keypoints2d defaults to pca when unset")
     f.add_argument("--data-term", default="verts",
                    choices=["verts", "joints", "keypoints2d", "points",
-                            "point_to_plane"],
+                            "point_to_plane", "silhouette"],
                    help="fit to a full target mesh, sparse 3D keypoints "
                         "(detector/mocap output), 2D keypoints projected "
-                        "through a pinhole camera, or a correspondence-"
+                        "through a pinhole camera, a correspondence-"
                         "free point cloud (partial depth-sensor scans): "
                         "'points' = chamfer/point-to-point ICP, "
                         "'point_to_plane' = LM-only normal-distance "
-                        "polish after a points fit")
+                        "polish after a points fit, or a segmentation "
+                        "mask ('silhouette': soft-IoU through the "
+                        "differentiable rasterizer, weak-perspective "
+                        "camera; multi-view fitting is a library/example "
+                        "feature — see examples/12)")
     f.add_argument("--init", default=None,
                    help="warm-start from a previous fit checkpoint (.npz "
                         "with pose/shape, e.g. a coarse --data-term joints "
@@ -609,6 +717,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "(keypoints2d only)")
     f.add_argument("--focal", type=float, default=2.2,
                    help="pinhole focal in NDC units (keypoints2d only)")
+    f.add_argument("--camera-scale", type=float, default=None,
+                   help="weak-perspective scale (silhouette only): NDC "
+                        "units per meter (default 3.0)")
+    f.add_argument("--camera-rot", default=None,
+                   help="axis-angle view rotation 'x,y,z' of the "
+                        "silhouette camera (silhouette only; "
+                        "default 0,0,0)")
+    f.add_argument("--sil-sigma", type=float, default=None,
+                   help="silhouette edge softness in pixels (default "
+                        "1.0 — about right; larger blurs the optimum "
+                        "itself, measured in docs/roadmap.md)")
     f.add_argument("--pose-prior", default="l2",
                    choices=["l2", "mahalanobis"],
                    help="pose regularizer: isotropic L2 toward zero, or "
@@ -617,11 +736,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(adam solver, aa/pca pose spaces)")
     f.add_argument("--pose-prior-weight", type=float, default=None,
                    help="pose prior weight (default: 1e-4 for "
-                        "keypoints2d, 1e-3 for --pose-prior mahalanobis, "
-                        "else 0)")
+                        "keypoints2d, 1.0 for silhouette — an outline "
+                        "cannot pin articulation, 1e-3 for --pose-prior "
+                        "mahalanobis, else 0)")
     f.add_argument("--shape-prior", type=float, default=None,
                    help="shape regularizer. adam: L2 prior weight (default "
-                        "0 for verts, 1e-3 for joints/keypoints2d). lm "
+                        "0 for verts, 1.0 for silhouette, 1e-3 for "
+                        "joints/keypoints2d). lm "
                         "with joints: Tikhonov residual-ROW weight, which "
                         "enters the least-squares loss SQUARED (default "
                         "0.1) — not numerically comparable to the adam "
@@ -630,14 +751,15 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--side", default=None, choices=[None, "left", "right"])
     f.add_argument("--solver", default=None, choices=["lm", "adam"],
                    help="default: lm for --data-term verts/point_to_plane, "
-                        "adam for joints/keypoints2d/points; lm also "
-                        "supports joints and points (second-order ICP); "
-                        "keypoints2d is adam-only, point_to_plane lm-only")
+                        "adam for joints/keypoints2d/points/silhouette; "
+                        "lm also supports joints and points (second-order "
+                        "ICP); keypoints2d/silhouette are adam-only, "
+                        "point_to_plane lm-only")
     f.add_argument("--steps", type=int, default=None,
                    help="default: 25 (lm) / 200 (adam)")
     f.add_argument("--lr", type=float, default=None,
                    help="adam learning rate (default 0.05; 0.02 for "
-                        "keypoints2d; adam only)")
+                        "keypoints2d, 0.01 for silhouette; adam only)")
     f.add_argument("--out", default="fit.npz")
     f.set_defaults(fn=cmd_fit)
 

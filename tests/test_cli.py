@@ -282,6 +282,95 @@ def test_convert_official_pickle_to_npz(tmp_path, params):
     assert back.side == "left"
 
 
+def test_fit_subcommand_silhouette(tmp_path, capsys):
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+    from mano_hand_tpu.viz.camera import WeakPerspectiveCamera
+    from mano_hand_tpu.viz.silhouette import soft_silhouette
+
+    p32 = synthetic_params(seed=0).astype(np.float32)
+    # The CLI's default camera: weak perspective, scale 3, no rotation.
+    cam = WeakPerspectiveCamera(rot=jnp.eye(3, dtype=jnp.float32),
+                                scale=3.0)
+    true_t = np.array([0.04, 0.03, 0.0], np.float32)
+    gt = core.forward(p32)
+    mask = np.asarray(
+        (soft_silhouette(gt.verts + true_t, p32.faces, cam,
+                         height=32, width=32, sigma=1.0) > 0.5)
+    ).astype(np.float32)
+    np.save(tmp_path / "mask.npy", mask)
+    out = tmp_path / "sil.npz"
+    rc = cli.main([
+        "fit", str(tmp_path / "mask.npy"), "--data-term", "silhouette",
+        "--steps", "250", "--out", str(out),
+    ])
+    assert rc == 0
+    assert "fit (adam, 250 steps)" in capsys.readouterr().out
+    ckpt = np.load(out)
+    # Translation is what an outline observes: recovered to a few mm.
+    assert np.linalg.norm(ckpt["trans"][:2] - true_t[:2]) < 0.012
+
+    # PNG masks load through Pillow, normalized from 0/255.
+    from PIL import Image
+
+    png = tmp_path / "mask.png"
+    Image.fromarray((mask * 255).astype(np.uint8), "L").save(png)
+    rc = cli.main([
+        "fit", str(png), "--data-term", "silhouette",
+        "--steps", "3", "--out", str(tmp_path / "sil2.npz"),
+    ])
+    assert rc == 0
+
+    # Guard rails: LM cannot fit masks; .png implies silhouette; raw
+    # 0/255 .npy masks are named, not crashed on; masks must be images.
+    rc = cli.main(["fit", str(tmp_path / "mask.npy"),
+                   "--data-term", "silhouette", "--solver", "lm"])
+    assert rc == 2
+    assert "requires --solver adam" in capsys.readouterr().err
+    rc = cli.main(["fit", str(png)])
+    assert rc == 2
+    assert "--data-term silhouette" in capsys.readouterr().err
+    np.save(tmp_path / "mask255.npy", mask * 255)
+    rc = cli.main(["fit", str(tmp_path / "mask255.npy"),
+                   "--data-term", "silhouette"])
+    assert rc == 2
+    assert "divide" in capsys.readouterr().err
+    np.save(tmp_path / "vec.npy", np.zeros((16,), np.float32))
+    rc = cli.main(["fit", str(tmp_path / "vec.npy"),
+                   "--data-term", "silhouette"])
+    assert rc == 2
+    assert "[H, W]" in capsys.readouterr().err
+    rc = cli.main(["fit", str(tmp_path / "mask.npy"),
+                   "--data-term", "silhouette", "--robust", "huber"])
+    assert rc == 2
+    assert "does not apply" in capsys.readouterr().err
+    rc = cli.main(["fit", str(tmp_path / "mask.npy"),
+                   "--data-term", "silhouette", "--camera-rot", "1,2"])
+    assert rc == 2
+    assert "--camera-rot" in capsys.readouterr().err
+    # Silhouette-only flags refuse (not silently drop) under other terms.
+    np.save(tmp_path / "verts.npy",
+            np.zeros((p32.n_verts, 3), np.float32))
+    rc = cli.main(["fit", str(tmp_path / "verts.npy"),
+                   "--sil-sigma", "2.0"])
+    assert rc == 2
+    assert "--sil-sigma only applies" in capsys.readouterr().err
+    # A point cloud is not a mask.
+    from mano_hand_tpu.io.ply import export_ply
+    export_ply(np.zeros((5, 3)), None, tmp_path / "scan.ply")
+    rc = cli.main(["fit", str(tmp_path / "scan.ply"),
+                   "--data-term", "silhouette"])
+    assert rc == 2
+    assert "point cloud, not a mask" in capsys.readouterr().err
+    # Empty masks would save the init as a "successful" zero-loss fit.
+    np.save(tmp_path / "empty.npy", np.zeros((0, 32), np.float32))
+    rc = cli.main(["fit", str(tmp_path / "empty.npy"),
+                   "--data-term", "silhouette"])
+    assert rc == 2
+    assert "non-empty" in capsys.readouterr().err
+
+
 def test_fit_subcommand_keypoints2d(tmp_path, capsys):
     import jax.numpy as jnp
 
