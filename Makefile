@@ -24,7 +24,7 @@ check-cold:
 # Correctness-only bench pass on CPU (small sizes); real numbers need the TPU.
 bench-cpu:
 	python bench.py --platform cpu --big-batch 2048 --chunk 512 --iters 4 \
-	  --fit-steps 20 --pallas-sweep off --init-retries 2
+	  --fit-steps 20 --pallas-sweep off --init-retries 2 --sil-size 24
 
 # Unattended TPU bench: keep retrying through tunnel outages until one run
 # completes (each attempt already probes with minutes-scale backoff).

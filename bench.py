@@ -874,6 +874,62 @@ def run_benchmarks(args, device_str: str) -> dict:
 
     section("config5_track", config5_track)
 
+    # -- config 6: the differentiable mask path -----------------------------
+    def config6_silhouette():
+        # Soft-rasterizer throughput (the render half of
+        # fit(data_term="silhouette")) and the end-to-end mask-fit step
+        # rate (16 renders fwd+bwd per Adam step). [P, F] pair slabs are
+        # row-chunked inside the renderer, so one render is 8 dense
+        # [512, F] distance blocks — VPU work, not MXU.
+        from mano_hand_tpu.fitting import fit as fit_fn
+        from mano_hand_tpu.viz.camera import WeakPerspectiveCamera
+        from mano_hand_tpu.viz.silhouette import soft_silhouette
+
+        b6, hw = 16, args.sil_size
+        cam = WeakPerspectiveCamera(rot=jnp.eye(3, dtype=jnp.float32),
+                                    scale=3.0)
+        pose6 = jnp.asarray(rng.normal(scale=0.2, size=(b6, 16, 3)),
+                            jnp.float32)
+        beta6 = jnp.zeros((b6, 10), jnp.float32)
+
+        sil_sum = loop_scalar(
+            lambda prm, p, s: soft_silhouette(
+                core.forward_batched(prm, p, s).verts, prm.faces, cam,
+                height=hw, width=hw,
+            ).sum()
+        )
+        t_render = slope_time(
+            lambda m: looped(sil_sum, m, right, pose6, beta6),
+            1, 3, iters=max(2, args.iters // 3),
+        )
+        results["config6_sil_renders_per_sec"] = b6 / t_render
+        log(f"config6 soft silhouette {hw}x{hw} (batch {b6} incl. "
+            f"forward): {b6 / t_render:,.0f} renders/s")
+
+        if args.skip_fit:
+            return
+        verts6 = core.jit_forward_batched(right, pose6, beta6).verts
+        masks = (soft_silhouette(
+            verts6 + jnp.asarray([0.02, 0.01, 0.0], jnp.float32),
+            right.faces, cam, height=hw, width=hw, sigma=1.0,
+        ) > 0.5).astype(jnp.float32)
+
+        def run_fit(steps):
+            return lambda: float(
+                fit_fn(right, masks, n_steps=steps, lr=0.01,
+                       data_term="silhouette", camera=cam, sil_sigma=1.0,
+                       fit_trans=True, pose_prior_weight=1.0,
+                       shape_prior_weight=1.0).final_loss.sum()
+            )
+
+        t_step = slope_time(run_fit, 4, 12, iters=max(2, args.iters // 3))
+        results["config6_sil_fit_steps_per_sec"] = 1.0 / t_step
+        log(f"config6 mask fit b={b6} {hw}x{hw}: {1.0 / t_step:,.1f} "
+            f"steps/s ({t_step * 1e3:.2f} ms/step, fwd+bwd through the "
+            "rasterizer)")
+
+    section("config6_silhouette", config6_silhouette)
+
     # -- memory high-water mark ---------------------------------------------
     try:
         stats = dev.memory_stats() or {}
@@ -1013,6 +1069,9 @@ def main() -> int:
     ap.add_argument("--chunk", type=int, default=8192)
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--fit-steps", type=int, default=100)
+    ap.add_argument("--sil-size", type=int, default=64,
+                    help="mask resolution for the silhouette config "
+                         "(smaller for CPU correctness runs)")
     ap.add_argument("--skip-fit", action="store_true")
     ap.add_argument("--pallas-sweep", choices=["off", "quick", "full"],
                     default="full",

@@ -343,6 +343,19 @@ def cmd_fit(args) -> int:
                 print(f"{flag} only applies to --data-term silhouette",
                       file=sys.stderr)
                 return 2
+    else:
+        # Degenerate-value guards (same class as the empty-mask check):
+        # scale 0 projects everything to one point (constant image, zero
+        # gradients, the init saved as a "fit"); sigma 0 divides by zero
+        # in the rasterizer and negative sigma inverts inside/outside.
+        if args.camera_scale is not None and args.camera_scale <= 0:
+            print(f"--camera-scale must be > 0, got {args.camera_scale}",
+                  file=sys.stderr)
+            return 2
+        if args.sil_sigma is not None and args.sil_sigma <= 0:
+            print(f"--sil-sigma must be > 0, got {args.sil_sigma}",
+                  file=sys.stderr)
+            return 2
     if args.solver == "lm" and (args.pose_prior != "l2"
                                 or args.pose_prior_weight is not None):
         # Either prior flag under LM is a contradiction, not a preference

@@ -61,6 +61,7 @@ def test_bench_cpu_tiny_run_end_to_end():
         "--platform", "cpu", "--big-batch", "256", "--chunk", "128",
         "--iters", "2", "--skip-fit", "--pallas-sweep", "off",
         "--init-retries", "2", "--init-timeout", "60",
+        "--sil-size", "24",
     )
     assert rc == 0, line
     assert line["value"] is not None and line["value"] > 0
@@ -70,6 +71,6 @@ def test_bench_cpu_tiny_run_end_to_end():
     d = line["detail"]
     for key in ("config2_b1024_evals_per_sec", "config3_b65536_evals_per_sec",
                 "config5_seq240_ms", "flops_per_eval", "achieved_gflops",
-                "config1_zero_pose_max_err"):
+                "config1_zero_pose_max_err", "config6_sil_renders_per_sec"):
         assert key in d, f"missing {key}: {sorted(d)}"
     assert "config_errors" not in line, line.get("config_errors")

@@ -369,6 +369,15 @@ def test_fit_subcommand_silhouette(tmp_path, capsys):
                    "--data-term", "silhouette"])
     assert rc == 2
     assert "non-empty" in capsys.readouterr().err
+    # Degenerate camera/sigma values: constant image or NaN occupancy.
+    rc = cli.main(["fit", str(tmp_path / "mask.npy"),
+                   "--data-term", "silhouette", "--camera-scale", "0"])
+    assert rc == 2
+    assert "--camera-scale must be > 0" in capsys.readouterr().err
+    rc = cli.main(["fit", str(tmp_path / "mask.npy"),
+                   "--data-term", "silhouette", "--sil-sigma", "-1"])
+    assert rc == 2
+    assert "--sil-sigma must be > 0" in capsys.readouterr().err
 
 
 def test_fit_subcommand_keypoints2d(tmp_path, capsys):
