@@ -881,7 +881,6 @@ def run_benchmarks(args, device_str: str) -> dict:
         # rate (16 renders fwd+bwd per Adam step). [P, F] pair slabs are
         # row-chunked inside the renderer, so one render is 8 dense
         # [512, F] distance blocks — VPU work, not MXU.
-        from mano_hand_tpu.fitting import fit as fit_fn
         from mano_hand_tpu.viz.camera import WeakPerspectiveCamera
         from mano_hand_tpu.viz.silhouette import soft_silhouette
 
@@ -916,10 +915,10 @@ def run_benchmarks(args, device_str: str) -> dict:
 
         def run_fit(steps):
             return lambda: float(
-                fit_fn(right, masks, n_steps=steps, lr=0.01,
-                       data_term="silhouette", camera=cam, sil_sigma=1.0,
-                       fit_trans=True, pose_prior_weight=1.0,
-                       shape_prior_weight=1.0).final_loss.sum()
+                fit(right, masks, n_steps=steps, lr=0.01,
+                    data_term="silhouette", camera=cam, sil_sigma=1.0,
+                    fit_trans=True, pose_prior_weight=1.0,
+                    shape_prior_weight=1.0).final_loss.sum()
             )
 
         t_step = slope_time(run_fit, 4, 12, iters=max(2, args.iters // 3))

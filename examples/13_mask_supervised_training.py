@@ -1,0 +1,178 @@
+"""Train a 2D-to-3D lifter with MASK-ONLY supervision (no 3D labels).
+
+The weakly-supervised setup differentiable rendering exists for: a
+network maps noisy 2D keypoint detections to global rotation +
+translation, the mesh head poses the hand, the soft rasterizer renders
+it into TWO calibrated views, and the ONLY loss is silhouette IoU
+against segmentation masks — no 3D pose, translation, or vertex labels
+anywhere. Gradients flow network -> pose/trans -> FK/skinning ->
+rasterizer -> IoU. Two views make translation (z included) observable;
+a second view is cheaper than a single 3D label.
+
+Tiny sizes so CI runs it; the structure is the real one.
+
+    python examples/13_mask_supervised_training.py [--platform cpu]
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default="",
+                    help="force a JAX platform, e.g. 'cpu'")
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--size", type=int, default=24, help="mask resolution")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import optax
+
+    from mano_hand_tpu import ops
+    from mano_hand_tpu.assets import synthetic_params
+    from mano_hand_tpu.fitting import objectives
+    from mano_hand_tpu.models import core
+    from mano_hand_tpu.viz import WeakPerspectiveCamera, view_rotation
+    from mano_hand_tpu.viz.silhouette import soft_silhouette
+
+    # Small asset: the rasterizer's [pixels, faces] slabs dominate the
+    # step, and 96 faces keep CI fast with the full pipeline intact.
+    params = synthetic_params(seed=0, n_verts=64, n_faces=96,
+                              dtype=np.float32)
+    h = w = args.size
+    front = WeakPerspectiveCamera(rot=jnp.eye(3, dtype=jnp.float32),
+                                  scale=3.0)
+    side = WeakPerspectiveCamera(rot=view_rotation([0.0, np.pi / 2, 0.0]),
+                                 scale=3.0)
+    cams = (front, side)
+    n_joints = params.j_regressor.shape[0]
+
+    def pose_rotmats(rot6d):                     # [B, 6] global only
+        """Full [B, 16, 3, 3] rotations: predicted global, rest fingers."""
+        glob = ops.matrix_from_6d(rot6d)[:, None]          # [B, 1, 3, 3]
+        eye = jnp.broadcast_to(
+            jnp.eye(3, dtype=rot6d.dtype),
+            (rot6d.shape[0], n_joints - 1, 3, 3),
+        )
+        return jnp.concatenate([glob, eye], axis=1)
+
+    def geometry(rot6d, trans):
+        out = core.forward_batched_rotmats(
+            params, pose_rotmats(rot6d),
+            jnp.zeros((rot6d.shape[0], params.shape_basis.shape[-1]),
+                      rot6d.dtype),
+        )
+        verts = out.verts + trans[:, None, :]
+        joints = out.posed_joints + trans[:, None, :]
+        return verts, joints
+
+    def render_views(verts):                     # [B, V, 3] -> [B, 2, H, W]
+        return jnp.stack(
+            [soft_silhouette(verts, params.faces, c, height=h, width=w,
+                             sigma=1.0) for c in cams],
+            axis=1,
+        )
+
+    def sample_batch(key, batch):
+        """(noisy 2D keypoints, target masks, true trans, true rot6d)."""
+        k1, k2, k3 = jax.random.split(key, 3)
+        aa = 0.4 * jax.random.normal(k1, (batch, 3))       # global rot
+        trans = 0.04 * jax.random.normal(k2, (batch, 3))
+        rot6d_true = ops.matrix_to_6d(ops.rotation_matrix(aa[:, None, :])
+                                      .reshape(batch, 3, 3))
+        verts, joints = geometry(rot6d_true, trans)
+        masks = (render_views(verts) > 0.5).astype(jnp.float32)
+        kp2d = front.project(joints)[..., :2]
+        kp2d = kp2d + 0.01 * jax.random.normal(k3, kp2d.shape)
+        return kp2d, masks, trans, rot6d_true
+
+    class LiftNet(nn.Module):
+        """Noisy 2D keypoints -> (global 6D rotation, translation)."""
+
+        @nn.compact
+        def __call__(self, kp2d):                # [B, J, 2]
+            x = kp2d.reshape(kp2d.shape[0], -1)
+            for width in (96, 96):
+                x = nn.relu(nn.Dense(width)(x))
+            rot6d = nn.Dense(6)(x) + jnp.asarray(
+                [1.0, 0, 0, 0, 1.0, 0], jnp.float32
+            )
+            trans = 0.1 * nn.Dense(3)(x)
+            return rot6d, trans
+
+    net = LiftNet()
+    key = jax.random.PRNGKey(0)
+    kp0 = sample_batch(key, args.batch)[0]
+    variables = net.init(key, kp0)
+    opt = optax.adam(2e-3)
+    opt_state = opt.init(variables)
+
+    @jax.jit
+    def train_step(variables, opt_state, key):
+        kp2d, masks, _, _ = sample_batch(key, args.batch)
+
+        def loss_fn(v):
+            rot6d, trans = net.apply(v, kp2d)
+            verts, _ = geometry(rot6d, trans)
+            sils = render_views(verts)
+            # The ONLY supervision: per-view soft IoU against the masks.
+            return jnp.mean(objectives.silhouette_iou_loss(sils, masks))
+
+        loss, grads = jax.value_and_grad(loss_fn)(variables)
+        updates, opt_state = opt.update(grads, opt_state, variables)
+        return optax.apply_updates(variables, updates), opt_state, loss
+
+    # The loss has an IRREDUCIBLE floor: a soft rendering scored against
+    # a binarized mask pays for every fractional boundary pixel even at
+    # the true pose (measured ~0.25 at these sizes). Judge training by
+    # the EXCESS over that floor, computed from ground-truth geometry.
+    kp_ev, masks_ev, trans_true, rot6d_ev = sample_batch(
+        jax.random.PRNGKey(777), args.batch
+    )
+    verts_true, _ = geometry(rot6d_ev, trans_true)
+    floor = float(jnp.mean(objectives.silhouette_iou_loss(
+        render_views(verts_true), masks_ev
+    )))
+
+    losses = []
+    for step in range(args.steps):
+        key = jax.random.fold_in(key, step + 1)
+        variables, opt_state, loss = train_step(variables, opt_state, key)
+        if step % max(1, args.steps // 5) == 0 or step == args.steps - 1:
+            losses.append(float(loss))
+            print(f"step {step:4d}: 1 - IoU = {float(loss):.4f} "
+                  f"(floor ~{floor:.3f})")
+
+    excess0, excess1 = losses[0] - floor, losses[-1] - floor
+    assert excess1 < 0.6 * excess0, (
+        f"training did not close the gap to the floor: "
+        f"{excess0:.4f} -> {excess1:.4f}"
+    )
+    # Held-out: translation error of the lifter — learned from masks
+    # alone, never from a translation label.
+    rot6d, trans = net.apply(variables, kp_ev)
+    terr = float(jnp.mean(jnp.linalg.norm(trans - trans_true, axis=-1)))
+    # No-information baseline: predicting zero translation.
+    base = float(jnp.mean(jnp.linalg.norm(trans_true, axis=-1)))
+    assert terr < 0.8 * base, (terr, base)
+    print(f"trained (mask-only supervision): held-out mean translation "
+          f"error {terr * 1e3:.1f} mm (predict-zero baseline "
+          f"{base * 1e3:.1f} mm); excess-over-floor 1-IoU "
+          f"{excess0:.3f} -> {excess1:.3f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

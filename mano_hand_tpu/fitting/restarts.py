@@ -28,7 +28,8 @@ from mano_hand_tpu.models import core
 
 def fit_restarts(
     params: ManoParams,
-    target: jnp.ndarray,        # [V|J|N, 3] or [J, 2] — ONE problem
+    target: jnp.ndarray,        # [V|J|N, 3] | [J, 2] | [H, W] mask
+                                #   | [n_views, H, W] — ONE problem
     n_restarts: int = 8,
     key=0,
     solver: str = "adam",       # "adam" (fitting.fit) | "lm" (fit_lm)
@@ -67,9 +68,18 @@ def fit_restarts(
     if n_restarts < 1:
         raise ValueError(f"n_restarts must be >= 1, got {n_restarts}")
     target = jnp.asarray(target, params.v_template.dtype)
-    if target.ndim != 2:
+    want_ndim = 2
+    if solver_kw.get("data_term") == "silhouette":
+        # Masks are [H, W] per problem — or [n_views, H, W] with a
+        # camera list (the multi-view term); restarts matter here
+        # because outlines are the most multi-modal data of all.
+        want_ndim = solvers.check_silhouette_views(
+            solver_kw.get("camera"), target, "fit_restarts"
+        )
+    if target.ndim != want_ndim:
         raise ValueError(
-            "fit_restarts solves ONE problem (target [rows, 2|3]); for "
+            "fit_restarts solves ONE problem (target [rows, 2|3], or an "
+            "[H, W] / [n_views, H, W] mask for the silhouette term); for "
             f"independent batches call the solver directly, got shape "
             f"{target.shape}"
         )

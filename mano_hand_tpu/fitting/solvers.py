@@ -279,6 +279,26 @@ def validate_mask_target(fn):
                         f"range [{float(t.min()):g}, {float(t.max()):g}] "
                         "— divide a 0/255 uint8 mask by 255"
                     )
+            # Degenerate render parameters give a constant/NaN image and
+            # a zero-gradient "fit" of the init; sil_sigma is traced
+            # INSIDE the jitted solver, so its value check belongs here.
+            sigma = bound.arguments.get("sil_sigma", 1.0)
+            if (not isinstance(sigma, jax.core.Tracer)
+                    and float(sigma) <= 0):
+                raise ValueError(f"sil_sigma must be > 0 pixels, "
+                                 f"got {sigma}")
+            cam = bound.arguments.get("camera")
+            cams = cam if is_multiview(cam) else (cam,)
+            for c in cams:
+                scale = getattr(c, "scale", None)
+                if (scale is not None
+                        and not isinstance(scale, jax.core.Tracer)
+                        and float(scale) <= 0):
+                    raise ValueError(
+                        "weak-perspective camera scale must be > 0 (a "
+                        "zero scale projects every vertex to one point "
+                        f"— constant mask, zero gradients), got {scale}"
+                    )
         return fn(*args, **kw)
 
     return wrapper

@@ -140,6 +140,12 @@ def soft_silhouette(
     """
     if camera is None:
         camera = default_hand_camera()
+    if not isinstance(sigma, jax.core.Tracer) and float(sigma) <= 0:
+        # sigma 0 divides by zero (NaN occupancy everywhere); negative
+        # inverts inside/outside and the fit optimizes the complement.
+        # Traced sigmas (jitted callers) pass through — their concrete
+        # value was checked at the caller's jit boundary.
+        raise ValueError(f"sigma must be > 0 pixels, got {sigma}")
     chunk_rows = best_chunk_rows(height, chunk_rows)
     verts = jnp.asarray(verts)
     faces = jnp.asarray(faces, jnp.int32)

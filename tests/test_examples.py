@@ -35,6 +35,8 @@ def test_example_runs(script, tmp_path):
         "10_two_hands_fitting": ["--steps", "120"],
         "11_neural_pose_regression": ["--steps", "150", "--batch", "16"],
         "12_silhouette_fitting": ["--steps", "150", "--size", "24"],
+        "13_mask_supervised_training": ["--steps", "200", "--batch", "12",
+                                        "--size", "20"],
     }.get(script.stem, [])
     out = _run(script, *extra, tmp_path=tmp_path)
     assert any(k in out for k in ("wrote", "fit", "tracked", "fused kernel",

@@ -303,6 +303,22 @@ class TestSilhouetteFitting:
         # Normalized, the same mask is accepted.
         fitting.fit(small, mask255 / 255.0, data_term="silhouette",
                     camera=cam, n_steps=2)
+        # Degenerate render parameters are library-level errors, not just
+        # CLI guards: zero sigma is NaN occupancy, zero camera scale a
+        # constant image (the init would come back as a "fit").
+        with pytest.raises(ValueError, match="sil_sigma must be > 0"):
+            fitting.fit(small, mask, data_term="silhouette", camera=cam,
+                        sil_sigma=0.0, n_steps=2)
+        bad_cam = viz.WeakPerspectiveCamera(
+            rot=jnp.eye(3, dtype=jnp.float32), scale=0.0
+        )
+        with pytest.raises(ValueError, match="camera scale must be > 0"):
+            fitting.fit(small, mask, data_term="silhouette",
+                        camera=bad_cam, n_steps=2)
+        with pytest.raises(ValueError, match="sigma must be > 0"):
+            soft_silhouette(jnp.zeros((4, 3)),
+                            jnp.asarray([[0, 1, 2]], jnp.int32),
+                            cam, height=8, width=8, sigma=-1.0)
         # The mask check binds the call to the real signature, so a
         # POSITIONAL data_term is still caught...
         with pytest.raises(ValueError, match="divide a 0/255"):
@@ -349,6 +365,37 @@ class TestSilhouetteFitting:
         # Warm starts keep every frame locked on (per-frame budget far
         # below a cold fit's).
         assert max(errs) < 0.012, errs
+
+    def test_restarts_accept_masks(self, small):
+        # Outlines are the most multi-modal data term of all (any pose
+        # with the same silhouette ties); restarts must accept masks —
+        # single view and [n_views, H, W] multi-view alike.
+        cam = viz.WeakPerspectiveCamera(
+            rot=jnp.eye(3, dtype=jnp.float32), scale=3.0
+        )
+        gt = core.forward(small)
+        mask = (soft_silhouette(gt.verts, small.faces, cam, height=24,
+                                width=24, sigma=1.0) > 0.5
+                ).astype(jnp.float32)
+        best, losses = fitting.fit_restarts(
+            small, mask, n_restarts=3, n_steps=5,
+            data_term="silhouette", camera=cam, fit_trans=True,
+            pose_prior_weight=1.0, shape_prior_weight=1.0,
+        )
+        assert best.pose.shape == (16, 3)
+        assert losses.shape == (3,)
+        # include_zero: never worse than the plain zero-init fit.
+        single = fitting.fit(
+            small, mask, n_steps=5, data_term="silhouette", camera=cam,
+            fit_trans=True, pose_prior_weight=1.0, shape_prior_weight=1.0,
+        )
+        assert float(best.final_loss) <= float(single.final_loss) + 1e-6
+        multi = jnp.stack([mask, mask])
+        best2, _ = fitting.fit_restarts(
+            small, multi, n_restarts=2, n_steps=3,
+            data_term="silhouette", camera=(cam, cam), fit_trans=True,
+        )
+        assert best2.pose.shape == (16, 3)
 
     def test_fit_hands_rejects_silhouette(self):
         from mano_hand_tpu.assets import synthetic_pair
