@@ -10,6 +10,13 @@
 # jaxlib at interpreter startup, before conftest could set it, and cache
 # hits would otherwise error-log a harmless pseudo-feature mismatch per
 # load. `make check-cold` measures the cold-cache time.
+# Segfault hazard (diagnosed 5/5 reproducible, fixed in conftest.py):
+# deserializing a LARGE cached executable late in a full-suite process
+# (~300 live executables) crashes inside XLA's deserialize_executable.
+# conftest's autouse module fixture calls jax.clear_caches() at module
+# boundaries, which keeps the live count bounded and the suite green —
+# do not remove it. Also avoid two concurrent pytest processes on the
+# shared cache dir.
 .PHONY: check check-cold test bench-cpu bench-tpu-wait
 
 check: test

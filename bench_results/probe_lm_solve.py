@@ -29,8 +29,16 @@ import jax
 
 jax.config.update(
     "jax_compilation_cache_dir",
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 ".jax_compile_cache"),
+    # Overridable so a probe retry loop never shares the test suite's
+    # cache (concurrent access to one cache dir has produced segfaults
+    # in jax's cache reader — see the Makefile note).
+    os.environ.get(
+        "MANO_PROBE_CACHE_DIR",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_compile_cache",
+        ),
+    ),
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 

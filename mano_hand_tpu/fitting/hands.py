@@ -31,6 +31,31 @@ from mano_hand_tpu.fitting import objectives, solvers
 from mano_hand_tpu.models import core
 
 
+def _hands_silhouette_loss(stacked, verts, targets, camera, sil_sigma,
+                           per_hand: bool):
+    """Mask loss for the two-hand solvers.
+
+    ``verts`` carries the hand axis at -3 ([2, V, 3] or [T, 2, V, 3]);
+    each hand renders with ITS OWN faces (left/right winding differs in
+    the stacked tree). ``per_hand`` scores [.., 2, H, W] instance masks
+    per hand; otherwise the two renders combine by the same
+    probabilistic union the rasterizer uses across faces — one soft
+    image of BOTH hands against one combined segmenter mask.
+    """
+    from mano_hand_tpu.viz.silhouette import soft_silhouette
+
+    h, w = targets.shape[-2], targets.shape[-1]
+    sil_l = soft_silhouette(verts[..., 0, :, :], stacked.faces[0], camera,
+                            height=h, width=w, sigma=sil_sigma)
+    sil_r = soft_silhouette(verts[..., 1, :, :], stacked.faces[1], camera,
+                            height=h, width=w, sigma=sil_sigma)
+    if per_hand:
+        sil = jnp.stack([sil_l, sil_r], axis=-3)
+    else:
+        sil = 1.0 - (1.0 - sil_l) * (1.0 - sil_r)
+    return jnp.mean(objectives.silhouette_iou_loss(sil, targets))
+
+
 class HandsFitResult(NamedTuple):
     pose: jnp.ndarray          # [2, 16, 3] axis-angle (left, right)
     shape: jnp.ndarray         # [2, S]
@@ -39,6 +64,7 @@ class HandsFitResult(NamedTuple):
     trans: Optional[jnp.ndarray] = None  # [2, 3] when fit_trans=True
 
 
+@solvers.validate_mask_target
 @solvers.normalize_tips_kwarg
 @functools.partial(
     jax.jit,
@@ -63,6 +89,7 @@ def fit_hands(
     init: Optional[dict] = None,
     tip_vertex_ids=None,
     keypoint_order: str = "mano",
+    sil_sigma: float = 0.7,
 ) -> HandsFitResult:
     """Recover both hands' pose/shape (and translation) from one frame.
 
@@ -73,6 +100,17 @@ def fit_hands(
     extension. ``fit_trans=True`` gives each hand its own translation —
     effectively mandatory for real two-hand observations, which are never
     both origin-centered.
+
+    ``data_term="silhouette"`` fits segmentation masks: per-hand
+    ``[2, H, W]`` instance masks, or ONE combined ``[H, W]`` mask — the
+    common segmenter output where both hands share a class — scored
+    against the soft UNION of the two hands' renders. The combined form
+    is where joint fitting earns its keep: each hand explains part of
+    one observation, and ``repulsion_weight`` keeps the explanation from
+    collapsing both hands onto the same blob. A combined mask cannot say
+    WHICH hand explains which region — from a cold start the swapped
+    assignment is an equally good optimum (measured) — so warm-start
+    ``init["trans"]`` from detector boxes or the previous frame.
 
     ``repulsion_weight > 0`` adds ``objectives.inter_penetration``
     between the two fitted surfaces at ``repulsion_radius`` (meters):
@@ -90,19 +128,26 @@ def fit_hands(
             "use fit()."
         )
     # Unsupported-term rejection FIRST: running the generic validator
-    # before it would demand a camera for a silhouette term this entry
-    # point does not support at all.
-    if data_term in ("points", "silhouette"):
+    # before it would demand a camera for a term this entry point does
+    # not support at all.
+    if data_term == "points":
         raise ValueError(
-            "fit_hands supports verts/joints/keypoints2d; for scan "
-            "registration fit each hand with fit_lm (ICP needs per-hand "
-            "correspondence anyway), and for masks fit each hand with "
-            "fit(data_term='silhouette') on its instance mask"
+            "fit_hands supports verts/joints/keypoints2d/silhouette; for "
+            "scan registration fit each hand with fit_lm (ICP needs "
+            "per-hand correspondence anyway)"
         )
     solvers._check_data_term(data_term, camera, target_conf)
     dtype = stacked.v_template.dtype
     targets = jnp.asarray(targets, dtype)
-    if targets.ndim != 3 or targets.shape[0] != 2:
+    per_hand_masks = False
+    if data_term == "silhouette":
+        # [H, W] = ONE combined mask covering both hands (a segmenter's
+        # single hand class — the hands render as a soft UNION);
+        # [2, H, W] = per-hand instance masks.
+        per_hand_masks = solvers.check_hands_silhouette(
+            camera, robust, targets, seq=False, fn_name="fit_hands"
+        )
+    elif targets.ndim != 3 or targets.shape[0] != 2:
         raise ValueError(
             f"targets must be [2, rows, coords] hand-major, got "
             f"{targets.shape}"
@@ -147,10 +192,16 @@ def fit_hands(
             lambda prm, pose, shape: core.forward(prm, pose, shape)
         )(stacked, p["pose"], p["shape"])
         offset = p["trans"][:, None, :] if fit_trans else 0.0
-        data = solvers._data_loss(
-            out, offset, targets, data_term, camera, target_conf,
-            robust, robust_scale, tips, keypoint_order,
-        )
+        if data_term == "silhouette":
+            data = _hands_silhouette_loss(
+                stacked, out.verts + offset, targets, camera, sil_sigma,
+                per_hand_masks,
+            )
+        else:
+            data = solvers._data_loss(
+                out, offset, targets, data_term, camera, target_conf,
+                robust, robust_scale, tips, keypoint_order,
+            )
         reg = (
             pose_prior_weight * objectives.l2_prior(p["pose"][:, 1:])
             + shape_prior_weight * objectives.l2_prior(p["shape"])
@@ -184,6 +235,7 @@ class HandsSequenceFitResult(NamedTuple):
     trans: Optional[jnp.ndarray] = None  # [T, 2, 3] when fit_trans=True
 
 
+@solvers.validate_mask_target
 @solvers.normalize_tips_kwarg
 @functools.partial(
     jax.jit,
@@ -209,6 +261,7 @@ def fit_hands_sequence(
     repulsion_radius: float = 0.004,
     tip_vertex_ids=None,
     keypoint_order: str = "mano",
+    sil_sigma: float = 0.7,
 ) -> HandsSequenceFitResult:
     """Track a two-hand clip as ONE optimization problem.
 
@@ -228,14 +281,21 @@ def fit_hands_sequence(
             f"output; got side={stacked.side!r}. For one hand use "
             "fit_sequence()."
         )
-    if data_term in ("points", "silhouette"):
+    if data_term == "points":
         raise ValueError(
-            "fit_hands_sequence supports verts/joints/keypoints2d"
+            "fit_hands_sequence supports verts/joints/keypoints2d/"
+            "silhouette"
         )
     solvers._check_data_term(data_term, camera, target_conf)
     dtype = stacked.v_template.dtype
     targets = jnp.asarray(targets, dtype)
-    if targets.ndim != 4 or targets.shape[1] != 2:
+    per_hand_masks = False
+    if data_term == "silhouette":
+        # [T, H, W] combined per frame, or [T, 2, H, W] per-hand.
+        per_hand_masks = solvers.check_hands_silhouette(
+            camera, robust, targets, seq=True, fn_name="fit_hands_sequence"
+        )
+    elif targets.ndim != 4 or targets.shape[1] != 2:
         raise ValueError(
             "targets must be [T, 2, rows, coords] frame-major, got "
             f"{targets.shape}; for one frame use fit_hands()"
@@ -274,10 +334,16 @@ def fit_hands_sequence(
             lambda x: jnp.swapaxes(x, 0, 1), out_hm     # [T, 2, ...]
         )
         offset = p["trans"][..., None, :] if fit_trans else 0.0
-        data = solvers._data_loss(
-            out, offset, targets, data_term, camera, target_conf,
-            robust, robust_scale, tips, keypoint_order,
-        )
+        if data_term == "silhouette":
+            data = _hands_silhouette_loss(
+                stacked, out.verts + offset, targets, camera, sil_sigma,
+                per_hand_masks,
+            )
+        else:
+            data = solvers._data_loss(
+                out, offset, targets, data_term, camera, target_conf,
+                robust, robust_scale, tips, keypoint_order,
+            )
         if t_frames > 1:
             vel = p["pose"][1:] - p["pose"][:-1]
             reg = smooth_pose_weight * jnp.mean(vel ** 2)

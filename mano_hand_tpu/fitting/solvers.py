@@ -290,15 +290,19 @@ def validate_mask_target(fn):
             cam = bound.arguments.get("camera")
             cams = cam if is_multiview(cam) else (cam,)
             for c in cams:
-                scale = getattr(c, "scale", None)
-                if (scale is not None
-                        and not isinstance(scale, jax.core.Tracer)
-                        and float(scale) <= 0):
-                    raise ValueError(
-                        "weak-perspective camera scale must be > 0 (a "
-                        "zero scale projects every vertex to one point "
-                        f"— constant mask, zero gradients), got {scale}"
-                    )
+                # Either projection's magnification: a zero collapses
+                # every vertex to one point (constant mask, zero
+                # gradients, the init returned as a "fit").
+                for attr in ("scale", "focal"):
+                    val = getattr(c, attr, None)
+                    if (val is not None
+                            and not isinstance(val, jax.core.Tracer)
+                            and float(val) <= 0):
+                        raise ValueError(
+                            f"camera {attr} must be > 0 (a zero {attr} "
+                            "projects every vertex to one point — "
+                            f"constant mask, zero gradients), got {val}"
+                        )
         return fn(*args, **kw)
 
     return wrapper
@@ -419,6 +423,36 @@ def check_silhouette_views(camera, target, fn_name: str) -> int:
             "multi-view silhouette targets are [..., n_views, H, W])"
         )
     return 3
+
+
+def check_hands_silhouette(camera, robust, targets, seq: bool,
+                           fn_name: str) -> bool:
+    """Shared validation for the two-hand mask term; returns ``per_hand``
+    (instance masks vs one combined mask). One definition for fit_hands
+    AND fit_hands_sequence so the rules cannot drift."""
+    if is_multiview(camera):
+        raise ValueError(
+            f"{fn_name} takes ONE camera; multi-view silhouette is a "
+            "single-hand feature (fit with a camera tuple)"
+        )
+    if robust != "none":
+        raise ValueError("robust does not apply to data_term='silhouette'")
+    combined_ndim = 3 if seq else 2          # [T, H, W] / [H, W]
+    hand_axis = 1 if seq else 0
+    ok = (
+        targets.ndim in (combined_ndim, combined_ndim + 1)
+        and (targets.ndim == combined_ndim
+             or targets.shape[hand_axis] == 2)
+        and 0 not in targets.shape
+    )
+    if not ok:
+        t = "[T, " if seq else "["
+        raise ValueError(
+            f"silhouette targets must be {t}H, W] combined masks or "
+            f"per-hand {t}2, H, W] instance masks, got {targets.shape}"
+            + ("; for one frame use fit_hands()" if seq else "")
+        )
+    return targets.ndim == combined_ndim + 1
 
 
 def _data_loss(out, offset, target, data_term: str, camera, conf,

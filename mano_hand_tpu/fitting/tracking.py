@@ -235,7 +235,19 @@ def track_hands_clip(
     clip jointly, acausally).
     """
     targets = jnp.asarray(targets)
-    if targets.ndim != 4 or targets.shape[1] != 2:
+    if tracker_kw.get("data_term") == "silhouette":
+        # Mask clips: [T, H, W] combined or [T, 2, H, W] per-hand — the
+        # same layouts fit_hands accepts per frame (each frame slice is
+        # [H, W] / [2, H, W]).
+        if targets.ndim not in (3, 4) or (
+            targets.ndim == 4 and targets.shape[1] != 2
+        ):
+            raise ValueError(
+                "silhouette clips must be [T, H, W] combined masks or "
+                f"[T, 2, H, W] per-hand instance masks, got "
+                f"{targets.shape}"
+            )
+    elif targets.ndim != 4 or targets.shape[1] != 2:
         raise ValueError(
             f"targets must be [T, 2, rows, coords], got {targets.shape}"
         )

@@ -55,6 +55,25 @@ import pytest  # noqa: E402
 from mano_hand_tpu.assets import synthetic_pair, synthetic_params  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _bound_live_executables():
+    """Clear jax's in-process executable caches after every module.
+
+    Deserializing a LARGE cached executable late in a full-suite process
+    segfaults inside XLA's ``backend.deserialize_executable`` once a few
+    hundred executables are live (reproduced 5/5 at whichever big
+    program happens to load last — silhouette fits, then pallas VJPs
+    after reordering — while every subset and each module alone pass).
+    Dropping compiled programs at module boundaries keeps the live count
+    bounded; re-loads hit the warm persistent cache, so the wall-time
+    cost is seconds, and the deserializations now happen in a
+    low-executable-count process, which is exactly the state that never
+    crashes.
+    """
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture(scope="session")
 def params():
     """Session-wide synthetic right-hand asset (float64)."""
