@@ -195,19 +195,28 @@ def cmd_fit(args) -> int:
     from mano_hand_tpu.io.checkpoints import save_fit_result
 
     params = _load_params(args.asset, args.side).astype(np.float32)
-    if str(args.targets).lower().endswith(".ply"):
+    tgt_lower = str(args.targets).lower()
+    if tgt_lower.endswith((".ply", ".obj")):
         if args.data_term == "silhouette":
-            # A point cloud is not an image; without this the value guard
-            # below would emit a nonsense "divide by 255" for vert coords.
-            print("a .ply is a point cloud, not a mask: use a .npy/.png "
+            # A mesh/point cloud is not an image; without this the value
+            # guard below would emit a nonsense "divide by 255" for
+            # vertex coordinates.
+            print("a .ply/.obj is geometry, not a mask: use a .npy/.png "
                   "[H, W] image with --data-term silhouette",
                   file=sys.stderr)
             return 2
-        # Scanner output directly: the vertex cloud of a PLY (any faces
-        # are irrelevant to the ICP data terms, which resample anyway).
-        from mano_hand_tpu.io.ply import read_ply
+        # Scanner/DCC output directly: the vertex cloud (any faces are
+        # irrelevant to the ICP data terms, which resample anyway; for
+        # --data-term verts an OBJ written by this package or the
+        # reference is in vertex correspondence already).
+        if tgt_lower.endswith(".obj"):
+            from mano_hand_tpu.io.obj import read_obj
 
-        targets = read_ply(args.targets).verts
+            targets = read_obj(args.targets).verts
+        else:
+            from mano_hand_tpu.io.ply import read_ply
+
+            targets = read_ply(args.targets).verts
     elif str(args.targets).lower().endswith(".png"):
         if args.data_term != "silhouette":
             print("a .png target is a segmentation mask: use "
@@ -666,10 +675,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "joints with --data-term joints; [16,2]/[B,16,2] "
                         "image points with --data-term keypoints2d; "
                         "[N,3]/[B,N,3] scan points with --data-term "
-                        "points or point_to_plane (a .ply file loads "
-                        "its vertex cloud directly); an [H,W]/[B,H,W] "
-                        ".npy mask in [0,1] or a .png with --data-term "
-                        "silhouette")
+                        "points or point_to_plane (a .ply or .obj file "
+                        "loads its vertex cloud directly); an "
+                        "[H,W]/[B,H,W] .npy mask in [0,1] or a .png "
+                        "with --data-term silhouette")
     f.add_argument("--pose-space", default=None,
                    choices=["aa", "pca", "6d"],
                    help="pose parameterization: axis-angle (both solvers' "

@@ -240,7 +240,8 @@ class HandsSequenceFitResult(NamedTuple):
 @functools.partial(
     jax.jit,
     static_argnames=("n_steps", "data_term", "fit_trans", "robust",
-                     "robust_scale", "tip_vertex_ids", "keypoint_order"),
+                     "robust_scale", "tip_vertex_ids", "keypoint_order",
+                     "mask_layout"),
 )
 def fit_hands_sequence(
     stacked: ManoParams,        # core.stack_params(left, right)
@@ -262,6 +263,7 @@ def fit_hands_sequence(
     tip_vertex_ids=None,
     keypoint_order: str = "mano",
     sil_sigma: float = 0.7,
+    mask_layout: str = "auto",   # "combined" | "per_hand" | "auto"
 ) -> HandsSequenceFitResult:
     """Track a two-hand clip as ONE optimization problem.
 
@@ -286,6 +288,11 @@ def fit_hands_sequence(
             "fit_hands_sequence supports verts/joints/keypoints2d/"
             "silhouette"
         )
+    if mask_layout != "auto" and data_term != "silhouette":
+        raise ValueError(
+            "mask_layout only applies to data_term='silhouette', got "
+            f"data_term={data_term!r}"
+        )
     solvers._check_data_term(data_term, camera, target_conf)
     dtype = stacked.v_template.dtype
     targets = jnp.asarray(targets, dtype)
@@ -293,7 +300,8 @@ def fit_hands_sequence(
     if data_term == "silhouette":
         # [T, H, W] combined per frame, or [T, 2, H, W] per-hand.
         per_hand_masks = solvers.check_hands_silhouette(
-            camera, robust, targets, seq=True, fn_name="fit_hands_sequence"
+            camera, robust, targets, seq=True,
+            fn_name="fit_hands_sequence", mask_layout=mask_layout,
         )
     elif targets.ndim != 4 or targets.shape[1] != 2:
         raise ValueError(

@@ -426,10 +426,18 @@ def check_silhouette_views(camera, target, fn_name: str) -> int:
 
 
 def check_hands_silhouette(camera, robust, targets, seq: bool,
-                           fn_name: str) -> bool:
+                           fn_name: str,
+                           mask_layout: str = "auto") -> bool:
     """Shared validation for the two-hand mask term; returns ``per_hand``
     (instance masks vs one combined mask). One definition for fit_hands
-    AND fit_hands_sequence so the rules cannot drift."""
+    AND fit_hands_sequence so the rules cannot drift.
+
+    The one genuinely ambiguous shape — a [2, H, W] target at a SEQUENCE
+    entry point, which reads equally as a 2-frame combined clip or as
+    ONE frame of per-hand masks sent to the wrong function — refuses to
+    guess: ``mask_layout="combined"`` claims the clip reading; the
+    per-hand single frame belongs to fit_hands.
+    """
     if is_multiview(camera):
         raise ValueError(
             f"{fn_name} takes ONE camera; multi-view silhouette is a "
@@ -437,22 +445,39 @@ def check_hands_silhouette(camera, robust, targets, seq: bool,
         )
     if robust != "none":
         raise ValueError("robust does not apply to data_term='silhouette'")
+    if mask_layout not in ("auto", "combined", "per_hand"):
+        raise ValueError(
+            "mask_layout must be 'auto', 'combined' or 'per_hand', got "
+            f"{mask_layout!r}"
+        )
     combined_ndim = 3 if seq else 2          # [T, H, W] / [H, W]
     hand_axis = 1 if seq else 0
-    ok = (
-        targets.ndim in (combined_ndim, combined_ndim + 1)
-        and (targets.ndim == combined_ndim
-             or targets.shape[hand_axis] == 2)
-        and 0 not in targets.shape
-    )
+    per_hand_ok = (targets.ndim == combined_ndim + 1
+                   and targets.shape[hand_axis] == 2)
+    combined_ok = targets.ndim == combined_ndim
+    if mask_layout == "combined":
+        ok = combined_ok
+    elif mask_layout == "per_hand":
+        ok = per_hand_ok
+    else:
+        ok = combined_ok or per_hand_ok
+        if seq and combined_ok and targets.shape[0] == 2:
+            raise ValueError(
+                f"{fn_name}: a [2, H, W] mask target is ambiguous — a "
+                "2-frame combined clip or ONE frame of per-hand instance "
+                "masks. Pass mask_layout='combined' for the clip reading; "
+                "for one frame of per-hand masks use fit_hands()"
+            )
+    ok = ok and 0 not in targets.shape
     if not ok:
         t = "[T, " if seq else "["
         raise ValueError(
             f"silhouette targets must be {t}H, W] combined masks or "
-            f"per-hand {t}2, H, W] instance masks, got {targets.shape}"
+            f"per-hand {t}2, H, W] instance masks "
+            f"(mask_layout={mask_layout!r}), got {targets.shape}"
             + ("; for one frame use fit_hands()" if seq else "")
         )
-    return targets.ndim == combined_ndim + 1
+    return per_hand_ok and mask_layout != "combined"
 
 
 def _data_loss(out, offset, target, data_term: str, camera, conf,

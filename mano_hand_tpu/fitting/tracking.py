@@ -238,15 +238,15 @@ def track_hands_clip(
     if tracker_kw.get("data_term") == "silhouette":
         # Mask clips: [T, H, W] combined or [T, 2, H, W] per-hand — the
         # same layouts fit_hands accepts per frame (each frame slice is
-        # [H, W] / [2, H, W]).
-        if targets.ndim not in (3, 4) or (
-            targets.ndim == 4 and targets.shape[1] != 2
-        ):
-            raise ValueError(
-                "silhouette clips must be [T, H, W] combined masks or "
-                f"[T, 2, H, W] per-hand instance masks, got "
-                f"{targets.shape}"
-            )
+        # [H, W] / [2, H, W]). mask_layout resolves the one ambiguous
+        # shape exactly as in fit_hands_sequence (the shared validator).
+        from mano_hand_tpu.fitting import solvers
+
+        solvers.check_hands_silhouette(
+            tracker_kw.get("camera"), tracker_kw.get("robust", "none"),
+            targets, seq=True, fn_name="track_hands_clip",
+            mask_layout=tracker_kw.pop("mask_layout", "auto"),
+        )
     elif targets.ndim != 4 or targets.shape[1] != 2:
         raise ValueError(
             f"targets must be [T, 2, rows, coords], got {targets.shape}"

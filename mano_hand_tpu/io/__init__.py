@@ -3,6 +3,7 @@ from mano_hand_tpu.io.obj import (
     export_obj_pair,
     export_obj_sequence,
     format_obj,
+    read_obj,
     restpose_path,
 )
 from mano_hand_tpu.io.ply import export_ply, read_ply
@@ -18,6 +19,7 @@ __all__ = [
     "export_obj_sequence",
     "export_ply",
     "format_obj",
+    "read_obj",
     "read_ply",
     "restpose_path",
 ]

@@ -139,3 +139,79 @@ def export_obj_sequence(
     for p, verts in zip(paths, verts_seq):
         export_obj(verts, faces, p, use_native=False)
     return paths
+
+
+def read_obj(path: PathLike):
+    """Parse a Wavefront OBJ into a ``ply.PlyMesh`` (verts, faces, normals).
+
+    The read half of the reference's only export format
+    (/root/reference/mano_np.py:181-201) — so meshes written by this
+    package, the reference, or any DCC tool round-trip as fit targets
+    (``cli fit hand.obj``). Handles the real-world dialect: ``v`` with
+    optional per-vertex color columns (ignored), ``f`` with ``v``,
+    ``v/vt``, ``v//vn`` or ``v/vt/vn`` references (vertex index taken,
+    negative = relative from the end), polygons fan-triangulated,
+    ``vn`` lines returned only when they map 1:1 onto vertices (the
+    layout this package writes; OBJ's general per-corner normal
+    indexing has no per-vertex equivalent).
+    """
+    from mano_hand_tpu.io.ply import PlyMesh
+
+    verts: list[list[float]] = []
+    normals: list[list[float]] = []
+    faces: list[list[int]] = []
+    with open(path, "r", encoding="utf-8", errors="replace") as fh:
+        for ln, raw in enumerate(fh, 1):
+            parts = raw.split()
+            if not parts or parts[0].startswith("#"):
+                continue
+            tag = parts[0]
+            if tag in ("v", "vn"):
+                if len(parts) < 4:
+                    raise ValueError(
+                        f"{path}:{ln}: '{tag}' line needs 3 components: "
+                        f"{raw.rstrip()!r}"
+                    )
+                try:
+                    xyz = [float(x) for x in parts[1:4]]
+                except ValueError:
+                    raise ValueError(
+                        f"{path}:{ln}: bad '{tag}' component: "
+                        f"{raw.rstrip()!r}"
+                    ) from None
+                (verts if tag == "v" else normals).append(xyz)
+            elif tag == "f":
+                if len(parts) < 4:
+                    raise ValueError(
+                        f"{path}:{ln}: face line needs >= 3 vertices: "
+                        f"{raw.rstrip()!r}"
+                    )
+                idx = []
+                for ref in parts[1:]:
+                    v = ref.split("/", 1)[0]
+                    try:
+                        i = int(v)
+                    except ValueError:
+                        raise ValueError(
+                            f"{path}:{ln}: bad face reference {ref!r}"
+                        ) from None
+                    # OBJ is 1-indexed; negative counts from the end of
+                    # the vertices seen SO FAR (the spec's streaming rule).
+                    idx.append(i - 1 if i > 0 else len(verts) + i)
+                # Fan-triangulate polygons (quads are common DCC output).
+                for k in range(1, len(idx) - 1):
+                    faces.append([idx[0], idx[k], idx[k + 1]])
+    if not verts:
+        raise ValueError(f"{path}: no vertex lines — not an OBJ mesh?")
+    v = np.asarray(verts, np.float64)
+    f = np.asarray(faces, np.int32) if faces else None
+    if f is not None and (f.min() < 0 or f.max() >= len(verts)):
+        raise ValueError(
+            f"{path}: face index out of range (0..{len(verts) - 1} after "
+            "1-indexed conversion)"
+        )
+    n = (
+        np.asarray(normals, np.float64)
+        if len(normals) == len(verts) else None
+    )
+    return PlyMesh(verts=v, faces=f, normals=n)

@@ -362,7 +362,7 @@ def test_fit_subcommand_silhouette(tmp_path, capsys):
     rc = cli.main(["fit", str(tmp_path / "scan.ply"),
                    "--data-term", "silhouette"])
     assert rc == 2
-    assert "point cloud, not a mask" in capsys.readouterr().err
+    assert "geometry, not a mask" in capsys.readouterr().err
     # Empty masks would save the init as a "successful" zero-loss fit.
     np.save(tmp_path / "empty.npy", np.zeros((0, 32), np.float32))
     rc = cli.main(["fit", str(tmp_path / "empty.npy"),

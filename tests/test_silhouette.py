@@ -468,11 +468,39 @@ class TestSilhouetteFitting:
             data_term="silhouette", camera=cam,
         )
         assert seq.pose.shape == (3, 2, 16, 3)
+        # A [2, H, W] target at a SEQUENCE entry is genuinely ambiguous
+        # (2-frame combined clip vs one frame of per-hand masks): refuse
+        # to guess; mask_layout='combined' claims the clip reading.
+        with pytest.raises(ValueError, match="ambiguous"):
+            fitting.fit_hands_sequence(
+                small_stacked, masks, n_steps=2,
+                data_term="silhouette", camera=cam,
+            )
+        seq2 = fitting.fit_hands_sequence(
+            small_stacked, masks, n_steps=2, data_term="silhouette",
+            camera=cam, mask_layout="combined",
+        )
+        assert seq2.pose.shape == (2, 2, 16, 3)
+        with pytest.raises(ValueError, match="mask_layout only applies"):
+            fitting.fit_hands_sequence(
+                small_stacked, jnp.zeros((3, 2, 16, 3)), n_steps=2,
+                mask_layout="combined",
+            )
         # The causal clip convenience accepts the same mask layouts.
         from mano_hand_tpu.fitting import track_hands_clip
         poses, shapes, _ = track_hands_clip(
-            small_stacked, jnp.stack([masks[0]] * 2), n_steps=2,
+            small_stacked, jnp.stack([masks[0]] * 3), n_steps=2,
             data_term="silhouette", camera=cam, sil_sigma=1.0,
+        )
+        assert poses.shape == (3, 2, 16, 3)
+        with pytest.raises(ValueError, match="ambiguous"):
+            track_hands_clip(
+                small_stacked, masks, n_steps=2,
+                data_term="silhouette", camera=cam,
+            )
+        poses, _, _ = track_hands_clip(
+            small_stacked, masks, n_steps=2, data_term="silhouette",
+            camera=cam, mask_layout="combined",
         )
         assert poses.shape == (2, 2, 16, 3)
         with pytest.raises(ValueError, match="ONE camera"):
