@@ -308,6 +308,12 @@ def cmd_fit(args) -> int:
                 file=sys.stderr,
             )
             return 2
+    if args.heatmap and (args.data_term != "verts" or targets.ndim != 2):
+        # The heatmap colors per-vertex errors against the target, which
+        # needs known correspondence and ONE problem.
+        print("--heatmap requires --data-term verts with a single "
+              "[V, 3] target", file=sys.stderr)
+        return 2
     if not 0.0 <= args.trim < 1.0:
         print(f"--trim must be in [0, 1), got {args.trim}", file=sys.stderr)
         return 2
@@ -577,6 +583,26 @@ def cmd_fit(args) -> int:
     final = float(np.max(np.asarray(res.final_loss)))
     print(f"fit ({args.solver}, {steps} steps) -> {path} "
           f"(worst final loss {final:.3e})")
+    if args.heatmap:
+        from mano_hand_tpu.models import core
+        from mano_hand_tpu.viz import error_colormap, render_mesh
+        from mano_hand_tpu.viz.png import write_png
+
+        import jax.numpy as jnp
+
+        fitted = core.forward(
+            params, jnp.asarray(res.pose), jnp.asarray(res.shape)
+        ).verts
+        if getattr(res, "trans", None) is not None:
+            fitted = fitted + jnp.asarray(res.trans)
+        errs = jnp.linalg.norm(
+            fitted - jnp.asarray(targets, jnp.float32), axis=-1
+        )
+        img = render_mesh(fitted, params.faces,
+                          vertex_colors=error_colormap(errs))
+        write_png(np.asarray(img), args.heatmap)
+        print(f"error heatmap (max {float(errs.max()) * 1e3:.2f} mm) -> "
+              f"{args.heatmap}")
     return 0
 
 
@@ -783,6 +809,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="adam learning rate (default 0.05; 0.02 for "
                         "keypoints2d, 0.01 for silhouette; adam only)")
     f.add_argument("--out", default="fit.npz")
+    f.add_argument("--heatmap", default=None,
+                   help="also render the fitted mesh with per-vertex "
+                        "error colors (blue=0 -> red=max) to this PNG "
+                        "(--data-term verts, single target)")
     f.set_defaults(fn=cmd_fit)
 
     e = sub.add_parser(

@@ -160,6 +160,7 @@ def read_obj(path: PathLike):
     verts: list[list[float]] = []
     normals: list[list[float]] = []
     faces: list[list[int]] = []
+    vn_identity = True
     with open(path, "r", encoding="utf-8", errors="replace") as fh:
         for ln, raw in enumerate(fh, 1):
             parts = raw.split()
@@ -188,16 +189,32 @@ def read_obj(path: PathLike):
                     )
                 idx = []
                 for ref in parts[1:]:
-                    v = ref.split("/", 1)[0]
+                    fields = ref.split("/")
                     try:
-                        i = int(v)
+                        i = int(fields[0])
                     except ValueError:
                         raise ValueError(
                             f"{path}:{ln}: bad face reference {ref!r}"
                         ) from None
                     # OBJ is 1-indexed; negative counts from the end of
                     # the vertices seen SO FAR (the spec's streaming rule).
-                    idx.append(i - 1 if i > 0 else len(verts) + i)
+                    vi = i - 1 if i > 0 else len(verts) + i
+                    idx.append(vi)
+                    # Track whether vn references are the IDENTITY map
+                    # onto vertices; general per-corner vn indexing has
+                    # no per-vertex equivalent, so anything else means
+                    # "no normals" rather than silently mis-associated
+                    # ones (a DCC's vn order need not match v order).
+                    if len(fields) == 3 and fields[2]:
+                        try:
+                            ni = int(fields[2])
+                        except ValueError:
+                            raise ValueError(
+                                f"{path}:{ln}: bad face reference {ref!r}"
+                            ) from None
+                        ni = ni - 1 if ni > 0 else len(normals) + ni
+                        if ni != vi:
+                            vn_identity = False
                 # Fan-triangulate polygons (quads are common DCC output).
                 for k in range(1, len(idx) - 1):
                     faces.append([idx[0], idx[k], idx[k + 1]])
@@ -212,6 +229,6 @@ def read_obj(path: PathLike):
         )
     n = (
         np.asarray(normals, np.float64)
-        if len(normals) == len(verts) else None
+        if len(normals) == len(verts) and vn_identity else None
     )
     return PlyMesh(verts=v, faces=f, normals=n)

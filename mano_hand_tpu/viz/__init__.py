@@ -16,7 +16,11 @@ from mano_hand_tpu.viz.camera import (
     look_at,
     view_rotation,
 )
-from mano_hand_tpu.viz.render import render_mesh, render_sequence
+from mano_hand_tpu.viz.render import (
+    error_colormap,
+    render_mesh,
+    render_sequence,
+)
 from mano_hand_tpu.viz.silhouette import soft_silhouette
 from mano_hand_tpu.viz.png import write_png, write_gif
 from mano_hand_tpu.viz.avi import write_avi, read_avi_info
@@ -26,6 +30,7 @@ __all__ = [
     "WeakPerspectiveCamera",
     "look_at",
     "view_rotation",
+    "error_colormap",
     "render_mesh",
     "render_sequence",
     "soft_silhouette",

@@ -69,11 +69,12 @@ def best_chunk_rows(height: int, chunk_rows: int) -> int:
     return max(c for c in range(1, chunk_rows + 1) if height % c == 0)
 
 
-def _raster_chunk(px, py, corners, depths, intens):
+def _raster_chunk(px, py, corners, depths, attrs):
     """Coverage test of a pixel chunk against every face.
 
     px/py: [P] pixel centers; corners: [F, 3, 2] screen xy;
-    depths/intens: [F, 3]. Returns (rgb_intensity [P], hit [P]).
+    depths: [F, 3]; attrs: [F, 3, C] per-corner attribute channels.
+    Returns (interpolated attrs [P, C], hit [P]).
     """
     ax, ay = corners[:, 0, 0], corners[:, 0, 1]
     bx, by = corners[:, 1, 0], corners[:, 1, 1]
@@ -104,8 +105,8 @@ def _raster_chunk(px, py, corners, depths, intens):
         ],
         axis=-1,
     )                                                           # [P, 3]
-    shade = (intens[best] * lam).sum(-1)
-    return shade, hit
+    vals = (attrs[best] * lam[:, :, None]).sum(1)               # [P, C]
+    return vals, hit
 
 
 @functools.partial(
@@ -114,23 +115,33 @@ def _raster_chunk(px, py, corners, depths, intens):
 def _render_impl(
     verts, faces, camera, light_dir, base_color, bg_color,
     height: int, width: int, chunk_rows: int,
+    vertex_colors=None,
 ):
     proj = camera.project(verts)                                # [V, 3]
     screen = ndc_to_pixels(proj[:, :2], height, width)          # [V, 2]
     corners = screen[faces]                                     # [F, 3, 2]
     depths = proj[:, 2][faces]                                  # [F, 3]
     intens = _shade(verts, faces, camera, light_dir)[faces]     # [F, 3]
+    if vertex_colors is None:
+        attrs = intens[:, :, None]                              # [F, 3, 1]
+    else:
+        # Gouraud per-vertex colors, still Lambert-shaded so geometry
+        # reads under the heatmap/albedo.
+        attrs = vertex_colors[faces] * intens[:, :, None]       # [F, 3, 3]
 
     gx, gy = chunked_pixel_grid(height, width, chunk_rows, jnp.float32)
 
     def row_chunk(pix):
         px, py = pix
-        return _raster_chunk(px, py, corners, depths, intens)
+        return _raster_chunk(px, py, corners, depths, attrs)
 
-    shade, hit = jax.lax.map(row_chunk, (gx, gy))               # chunked
-    shade = shade.reshape(height, width, 1)
+    vals, hit = jax.lax.map(row_chunk, (gx, gy))                # chunked
+    vals = vals.reshape(height, width, -1)
     hit = hit.reshape(height, width, 1)
-    rgb = shade * base_color[None, None, :]
+    if vertex_colors is None:
+        rgb = vals * base_color[None, None, :]
+    else:
+        rgb = vals
     return jnp.where(hit, rgb, bg_color[None, None, :])
 
 
@@ -144,11 +155,26 @@ def render_mesh(
     base_color: Sequence[float] = _BASE,
     bg_color: Sequence[float] = _BG,
     chunk_rows: int = 16,
+    vertex_colors=None,            # [V, 3] per-vertex RGB (Gouraud)
 ) -> jnp.ndarray:
-    """Render one mesh to an [H, W, 3] float image in [0, 1]."""
+    """Render one mesh to an [H, W, 3] float image in [0, 1].
+
+    ``vertex_colors`` swaps the uniform albedo for per-vertex RGB,
+    barycentrically interpolated and Lambert-shaded — the fit-diagnostic
+    path: map per-vertex errors through ``error_colormap`` and SEE where
+    a registration is off instead of reading a scalar loss.
+    """
     if camera is None:
         camera = default_hand_camera()
     chunk_rows = best_chunk_rows(height, chunk_rows)
+    if vertex_colors is not None:
+        vertex_colors = jnp.asarray(vertex_colors, jnp.float32)
+        # np.shape reads metadata only — no device-to-host transfer.
+        if vertex_colors.shape != (np.shape(verts)[-2], 3):
+            raise ValueError(
+                f"vertex_colors must be [V, 3] matching verts, got "
+                f"{vertex_colors.shape}"
+            )
     return _render_impl(
         jnp.asarray(verts, jnp.float32),
         jnp.asarray(faces, jnp.int32),
@@ -157,6 +183,37 @@ def render_mesh(
         jnp.asarray(base_color, jnp.float32),
         jnp.asarray(bg_color, jnp.float32),
         height, width, chunk_rows,
+        vertex_colors=vertex_colors,
+    )
+
+
+def error_colormap(
+    values,                        # [V] per-vertex scalars (e.g. meters)
+    vmax: Optional[float] = None,  # None = max of values
+) -> jnp.ndarray:
+    """Map per-vertex scalars to a blue→white→red ramp ([V, 3] RGB).
+
+    The registration-error convention: 0 = cool blue, midscale = white,
+    ``vmax`` (default the max) = red. Pass the result as ``render_mesh``'s
+    ``vertex_colors`` to see WHERE a fit deviates — e.g.
+    ``error_colormap(jnp.linalg.norm(fit_verts - target_verts, axis=-1))``.
+    """
+    v = jnp.asarray(values, jnp.float32)
+    # Both branches guard /0: an explicit vmax=0 (e.g. a shared scale
+    # derived from a perfect fit) must yield all-blue, not all-NaN.
+    top = jnp.maximum(
+        jnp.asarray(vmax, jnp.float32) if vmax is not None else v.max(),
+        1e-12,
+    )
+    t = jnp.clip(v / top, 0.0, 1.0)
+    lo = jnp.asarray([0.23, 0.30, 0.75], jnp.float32)   # cool blue
+    mid = jnp.asarray([0.96, 0.96, 0.96], jnp.float32)  # white
+    hi = jnp.asarray([0.71, 0.02, 0.15], jnp.float32)   # red
+    s = t[:, None]
+    return jnp.where(
+        s < 0.5,
+        lo + (mid - lo) * (2.0 * s),
+        mid + (hi - mid) * (2.0 * s - 1.0),
     )
 
 

@@ -282,6 +282,39 @@ def test_convert_official_pickle_to_npz(tmp_path, params):
     assert back.side == "left"
 
 
+def test_fit_heatmap(tmp_path, capsys):
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+
+    p32 = synthetic_params(seed=0).astype(np.float32)
+    pose = np.random.default_rng(3).normal(
+        scale=0.2, size=(16, 3)
+    ).astype(np.float32)
+    targets = np.asarray(core.forward(p32, jnp.asarray(pose)).verts)
+    np.save(tmp_path / "t.npy", targets)
+    png = tmp_path / "err.png"
+    rc = cli.main([
+        "fit", str(tmp_path / "t.npy"), "--solver", "lm", "--steps", "10",
+        "--out", str(tmp_path / "f.npz"), "--heatmap", str(png),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "error heatmap" in out and "mm" in out
+    from PIL import Image
+
+    img = Image.open(png)
+    assert img.size == (256, 256) and img.mode == "RGB"
+    # Heatmaps need correspondence: only single verts targets qualify.
+    np.save(tmp_path / "j.npy", np.zeros((16, 3), np.float32))
+    rc = cli.main([
+        "fit", str(tmp_path / "j.npy"), "--data-term", "joints",
+        "--heatmap", str(png),
+    ])
+    assert rc == 2
+    assert "--heatmap requires" in capsys.readouterr().err
+
+
 def test_fit_subcommand_silhouette(tmp_path, capsys):
     import jax.numpy as jnp
 

@@ -332,6 +332,16 @@ def test_read_obj_dialects(tmp_path):
     bad.write_text("v a b c\n")
     with pytest.raises(ValueError, match="bad 'v' component"):
         read_obj(bad)
+    # vn count == vertex count but the f v//vn refs are NOT the identity
+    # map: silently returning file-order normals would mis-associate
+    # them — drop them instead.
+    remap = tmp_path / "remap.obj"
+    remap.write_text("\n".join([
+        "v 0 0 0", "v 1 0 0", "v 0 1 0",
+        "vn 0 0 1", "vn 0 1 0", "vn 1 0 0",
+        "f 1//3 2//2 3//1",
+    ]) + "\n")
+    assert read_obj(remap).normals is None
 
 
 def test_cli_fit_obj_target(params, tmp_path, capsys):

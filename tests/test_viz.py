@@ -127,6 +127,56 @@ def test_render_mano_mesh_smoke(params32):
     assert 0.01 < covered < 0.9  # the hand is in frame, not filling it
 
 
+def test_render_vertex_colors_interpolate():
+    # One triangle with pure R/G/B corners under head-on light: the
+    # pixel nearest each corner is dominated by that corner's channel,
+    # and the centroid mixes all three roughly equally.
+    verts = np.array([
+        [-0.6, -0.6, 1.0], [0.6, -0.6, 1.0], [0.0, 0.6, 1.0],
+    ])
+    faces = np.array([[0, 1, 2]], np.int32)
+    colors = np.eye(3, dtype=np.float32)
+    cam = viz.Camera(rot=jnp.eye(3), trans=jnp.zeros(3), focal=1.0)
+    img = np.asarray(viz.render_mesh(
+        verts, faces, cam, height=64, width=64,
+        light_dir=(0.0, 0.0, 1.0), bg_color=(0.0, 0.0, 0.0),
+        vertex_colors=colors,
+    ))
+    # Corner 0 is bottom-left in world = (y flipped) top... verts y=-0.6
+    # maps to the LOWER half of the image (sy flips +y up).
+    near0 = img[50, 16]                   # near vertex 0 (red)
+    assert near0[0] > 2.0 * max(near0[1], near0[2])
+    near2 = img[18, 32]                   # near vertex 2 (blue)
+    assert near2[2] > 2.0 * max(near2[0], near2[1])
+    center = img[38, 32]                  # centroid-ish: balanced mix
+    assert center.min() > 0.08 and center.max() - center.min() < 0.03
+    with pytest.raises(ValueError, match="vertex_colors must be"):
+        viz.render_mesh(verts, faces, cam, vertex_colors=np.eye(4))
+
+
+def test_error_colormap_ramp():
+    vals = jnp.asarray([0.0, 0.5, 1.0])
+    rgb = np.asarray(viz.error_colormap(vals, vmax=1.0))
+    assert rgb.shape == (3, 3)
+    assert rgb[0, 2] > rgb[0, 0]          # zero error: blue-dominant
+    np.testing.assert_allclose(rgb[1], [0.96, 0.96, 0.96], atol=1e-6)
+    assert rgb[2, 0] > rgb[2, 2]          # max error: red-dominant
+    # Auto-vmax normalizes by the max value.
+    auto = np.asarray(viz.error_colormap(vals * 0.01))
+    np.testing.assert_allclose(auto, rgb, atol=1e-6)
+    # All-zero errors (perfect fit) stay finite and blue — including
+    # under an EXPLICIT vmax=0 (a shared scale from a perfect fit).
+    z = np.asarray(viz.error_colormap(jnp.zeros(5)))
+    assert np.isfinite(z).all() and (z[:, 2] > z[:, 0]).all()
+    z0 = np.asarray(viz.error_colormap(jnp.zeros(5), vmax=0.0))
+    assert np.isfinite(z0).all() and (z0[:, 2] > z0[:, 0]).all()
+    # The documented usage example is runnable as written.
+    fit_v = jnp.zeros((4, 3))
+    tgt_v = jnp.ones((4, 3)) * 0.01
+    ex = viz.error_colormap(jnp.linalg.norm(fit_v - tgt_v, axis=-1))
+    assert ex.shape == (4, 3)
+
+
 def test_render_sequence_shapes(params32):
     from mano_hand_tpu.models import core
 
