@@ -1,11 +1,12 @@
 # Pre-commit gate: `make check` MUST pass (full suite incl. the golden demo
 # fixture on the virtual 8-device CPU mesh) before any snapshot commit.
 #
-# Wall time on this box (1 CPU core): ~13 min with a COLD compilation
-# cache, ~7 min warm (291 tests as of round 3 — the round-3 features
-# added ~60). The suite is compile-bound; tests/conftest.py keeps a
-# persistent XLA compilation cache in .jax_compile_cache/ (gitignored), so
-# every run after the first skips recompilation of unchanged programs.
+# Wall time on this box (1 CPU core): ~11 min warm (~367 tests late in
+# round 3; cold adds the one-off compile time). The suite is
+# compile-bound; tests/conftest.py keeps a persistent XLA compilation
+# cache in .jax_compile_cache/ (gitignored), so every run after the
+# first skips recompilation of unchanged programs, and clears the
+# in-process executable caches at module boundaries (see below).
 # TF_CPP_MIN_LOG_LEVEL=3 must be set OUTSIDE the process: a site hook loads
 # jaxlib at interpreter startup, before conftest could set it, and cache
 # hits would otherwise error-log a harmless pseudo-feature mismatch per
