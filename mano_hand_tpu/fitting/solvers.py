@@ -266,19 +266,23 @@ def validate_mask_target(fn):
         except TypeError:
             # Malformed call: let the real function raise its own error.
             return fn(*args, **kw)
-        if bound.arguments.get("data_term") == "silhouette":
-            target = bound.arguments.get(target_name)
-            if target is not None and not isinstance(target,
-                                                     jax.core.Tracer):
-                import numpy as np
-                t = np.asarray(target)
-                if t.size and (float(t.min()) < 0.0
-                               or float(t.max()) > 1.0):
-                    raise ValueError(
-                        "silhouette target mask must be in [0, 1], got "
-                        f"range [{float(t.min()):g}, {float(t.max()):g}] "
-                        "— divide a 0/255 uint8 mask by 255"
-                    )
+        is_sil = bound.arguments.get("data_term") == "silhouette"
+        masks = []
+        if is_sil:
+            masks.append(bound.arguments.get(target_name))
+        masks.append(bound.arguments.get("target_mask"))  # aux (kp2d+mask)
+        for m in masks:
+            if m is None or isinstance(m, jax.core.Tracer):
+                continue
+            import numpy as np
+            t = np.asarray(m)
+            if t.size and (float(t.min()) < 0.0 or float(t.max()) > 1.0):
+                raise ValueError(
+                    "silhouette target mask must be in [0, 1], got "
+                    f"range [{float(t.min()):g}, {float(t.max()):g}] "
+                    "— divide a 0/255 uint8 mask by 255"
+                )
+        if is_sil or bound.arguments.get("target_mask") is not None:
             # Degenerate render parameters give a constant/NaN image and
             # a zero-gradient "fit" of the init; sil_sigma is traced
             # INSIDE the jitted solver, so its value check belongs here.
@@ -610,6 +614,8 @@ def _fit_single(
     self_penetration_radius: float = 0.004,
     self_pen_mask: Optional[jnp.ndarray] = None,
     sil_sigma: float = 0.7,
+    target_mask: Optional[jnp.ndarray] = None,  # [H, W] aux mask
+    mask_weight: float = 0.1,
 ) -> FitResult:
     _check_data_term(data_term, camera, conf)
     _check_pose_prior(pose_prior, pose_space)
@@ -663,6 +669,15 @@ def _fit_single(
         data = _data_loss(out, offset, target, data_term, camera, conf,
                           robust, robust_scale, tips, keypoint_order,
                           params.faces, sil_sigma)
+        if target_mask is not None:
+            # The standard tracking energy: sparse keypoints pin the
+            # skeleton, the mask refines the surface outline — both
+            # through ONE camera. Reuses the silhouette term verbatim.
+            data = data + mask_weight * _data_loss(
+                out, offset, target_mask, "silhouette", camera, None,
+                "none", robust_scale, None, "mano", params.faces,
+                sil_sigma,
+            )
         # Prior weights may be traced scalars (see fit): plain multiplies.
         reg = (
             _pose_reg(pose_space, pose_prior, pose_prior_vars, params, p,
@@ -728,6 +743,8 @@ def fit(
     self_penetration_radius: float = 0.004,
     _self_pen_mask=None,         # built by prepare_self_pen; do not pass
     sil_sigma: float = 0.7,      # silhouette edge softness, pixels
+    target_mask: Optional[jnp.ndarray] = None,  # [H, W] / [B, H, W]
+    mask_weight: float = 0.1,
 ) -> FitResult:
     """Recover pose/shape for one target mesh or a batch of them.
 
@@ -746,9 +763,11 @@ def fit(
     (viz.soft_silhouette, edge softness ``sil_sigma`` pixels) and scored
     by soft IoU at the target's [H, W] resolution — the right term when
     a segmenter is trusted but no keypoint detector is; it observes only
-    the outline, so keep the pose priors on (and combine with keypoints
-    by summing fits' losses via ``fit_with_optimizer`` components if both
-    are available). For a custom
+    the outline, so keep the pose priors on. When BOTH a detector and a
+    segmenter are available, fit keypoints2d and pass the mask as
+    ``target_mask`` (+ ``mask_weight``): the classic tracking energy —
+    sparse keypoints pin the skeleton, the mask refines the outline,
+    both through the one ``camera``. For a custom
     optimizer use ``fit_with_optimizer`` (not jitted at this level so the
     transformation can be any optax object).
 
@@ -789,6 +808,8 @@ def fit(
         self_penetration_radius=self_penetration_radius,
         _self_pen_mask=_self_pen_mask,
         sil_sigma=sil_sigma,
+        target_mask=target_mask,
+        mask_weight=mask_weight,
     )
 
 
@@ -818,8 +839,25 @@ def fit_with_optimizer(
     self_penetration_radius: float = 0.004,
     _self_pen_mask=None,
     sil_sigma: float = 0.7,
+    target_mask: Optional[jnp.ndarray] = None,
+    mask_weight: float = 0.1,
 ) -> FitResult:
     _check_data_term(data_term, camera, target_conf)
+    if target_mask is not None:
+        if data_term != "keypoints2d":
+            # The pure-mask problem is data_term='silhouette'; the aux
+            # mask exists to COMBINE with the keypoint term.
+            raise ValueError(
+                "target_mask is the auxiliary mask for "
+                "data_term='keypoints2d' (for mask-only fitting use "
+                f"data_term='silhouette'); got data_term={data_term!r}"
+            )
+        target_mask = jnp.asarray(target_mask, params.v_template.dtype)
+        if target_mask.ndim not in (2, 3) or 0 in target_mask.shape:
+            raise ValueError(
+                "target_mask must be a non-empty [H, W] (or batched "
+                f"[B, H, W]) mask, got {target_mask.shape}"
+            )
     target_verts = jnp.asarray(target_verts, params.v_template.dtype)
     tips, n_kp = check_keypoint_spec(
         params, data_term, tip_vertex_ids, keypoint_order, target_verts,
@@ -847,6 +885,7 @@ def fit_with_optimizer(
         self_penetration_radius=self_penetration_radius,
         self_pen_mask=_self_pen_mask,
         sil_sigma=sil_sigma,
+        mask_weight=mask_weight,
     )
     if data_term == "points" and target_verts.shape[-2] == 0:
         # A zero-point cloud (empty depth-scan foreground) would mean() over
@@ -858,10 +897,18 @@ def fit_with_optimizer(
     if data_term == "silhouette":
         single_ndim = check_silhouette_views(camera, target_verts, "fit")
     if target_verts.ndim == single_ndim:
-        return single(target_verts, target_conf, init=init)
+        if target_mask is not None and target_mask.ndim != 2:
+            raise ValueError(
+                "single-problem fits take one [H, W] target_mask, got "
+                f"{target_mask.shape}"
+            )
+        return single(target_verts, target_conf, init=init,
+                      target_mask=target_mask)
     # Batched problems: map conf per-problem when it is [B, J]; a shared
     # [J] conf (or None) broadcasts via in_axes=None. A warm-start init
-    # must carry the batch on every leaf (one seed per problem).
+    # must carry the batch on every leaf (one seed per problem). The aux
+    # mask follows the conf policy: [B, H, W] maps per problem, [H, W]
+    # is shared.
     if init:
         validate_batched_init(
             init, target_verts.shape[0],
@@ -873,10 +920,21 @@ def fit_with_optimizer(
         )
     conf_axis = 0 if (target_conf is not None
                       and target_conf.ndim == 2) else None
+    mask_axis = 0 if (target_mask is not None
+                      and target_mask.ndim == 3) else None
+    if (mask_axis == 0
+            and target_mask.shape[0] != target_verts.shape[0]):
+        # Named error, not vmap's generic "inconsistent sizes".
+        raise ValueError(
+            f"batched target_mask has {target_mask.shape[0]} masks for "
+            f"{target_verts.shape[0]} problems (shapes "
+            f"{target_mask.shape} vs {target_verts.shape}); pass one "
+            "[H, W] mask to share it"
+        )
     return jax.vmap(
-        lambda t, c, i: single(t, c, init=i),
-        in_axes=(0, conf_axis, 0 if init else None),
-    )(target_verts, target_conf, init)
+        lambda t, c, i, m: single(t, c, init=i, target_mask=m),
+        in_axes=(0, conf_axis, 0 if init else None, mask_axis),
+    )(target_verts, target_conf, init, target_mask)
 
 
 # ------------------------------------------------------------- sequences

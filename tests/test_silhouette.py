@@ -404,6 +404,79 @@ class TestSilhouetteFitting:
         )
         assert best2.pose.shape == (16, 3)
 
+    def test_keypoints_plus_mask(self, small):
+        # The classic tracking energy: 2D keypoints pin the skeleton,
+        # the aux mask refines the outline through the SAME camera. The
+        # combined fit must track the mask without giving up keypoint
+        # accuracy.
+        cam = viz.WeakPerspectiveCamera(
+            rot=jnp.eye(3, dtype=jnp.float32), scale=3.0
+        )
+        true_t = jnp.asarray([0.03, 0.02, 0.0], jnp.float32)
+        gt = core.forward(small)
+        # A BIASED detector (systematic +0.05 NDC shift): keypoints
+        # alone drag the whole hand off the true outline; the mask term
+        # pulls it back. With clean keypoints the mask has nothing to
+        # add (measured: IoUs tie to 3 decimals) — the aux term exists
+        # for exactly this imperfect-detector regime.
+        kp2d = cam.project(gt.posed_joints + true_t)[..., :2] + 0.05
+        mask = (soft_silhouette(gt.verts + true_t, small.faces, cam,
+                                height=32, width=32, sigma=1.0) > 0.5
+                ).astype(jnp.float32)
+        # Strong priors matter here: with weak ones the mask term wins
+        # IoU by CONTORTING the pose (measured: truth error got WORSE,
+        # 35 vs 24 mm) — held near rest, the keypoint/mask compromise
+        # goes into translation and the fit lands 2x closer to truth.
+        kw = dict(n_steps=300, lr=0.01, data_term="keypoints2d",
+                  camera=cam, fit_trans=True, pose_prior_weight=1.0,
+                  shape_prior_weight=1.0)
+        kp_only = fitting.fit(small, kp2d, **kw)
+        both = fitting.fit(small, kp2d, target_mask=mask,
+                           mask_weight=0.5, **kw)
+
+        def scores(res):
+            out = core.forward(small, res.pose, res.shape)
+            verts = out.verts + res.trans
+            sil = soft_silhouette(verts, small.faces, cam,
+                                  height=32, width=32, sigma=1.0)
+            iou = float(objectives.silhouette_iou_loss(sil, mask))
+            truth = float(jnp.mean(jnp.linalg.norm(
+                verts - (gt.verts + true_t), axis=-1
+            )))
+            return iou, truth
+
+        iou_kp, true_kp = scores(kp_only)
+        iou_both, true_both = scores(both)
+        assert iou_both < iou_kp            # the mask term did its job
+        # ...and doing its job means the COMBINED fit lands closer to
+        # the true geometry than trusting the biased detector alone
+        # (measured 10.3 vs 23.6 mm).
+        assert true_both < 0.6 * true_kp, (true_both, true_kp)
+
+        # Validation: aux masks belong to keypoints2d; values in [0, 1];
+        # batched masks map per problem.
+        with pytest.raises(ValueError, match="auxiliary mask"):
+            fitting.fit(small, gt.verts, target_mask=mask, n_steps=2)
+        with pytest.raises(ValueError, match="divide a 0/255"):
+            fitting.fit(small, kp2d, target_mask=mask * 255.0,
+                        n_steps=2, data_term="keypoints2d", camera=cam)
+        batched = fitting.fit(
+            small, jnp.stack([kp2d] * 2), target_mask=jnp.stack([mask] * 2),
+            n_steps=2, data_term="keypoints2d", camera=cam, fit_trans=True,
+        )
+        assert batched.pose.shape == (2, 16, 3)
+        shared = fitting.fit(
+            small, jnp.stack([kp2d] * 2), target_mask=mask,
+            n_steps=2, data_term="keypoints2d", camera=cam, fit_trans=True,
+        )
+        assert shared.pose.shape == (2, 16, 3)
+        with pytest.raises(ValueError, match="3 masks for 2 problems"):
+            fitting.fit(
+                small, jnp.stack([kp2d] * 2),
+                target_mask=jnp.stack([mask] * 3), n_steps=2,
+                data_term="keypoints2d", camera=cam,
+            )
+
     @pytest.fixture(scope="class")
     def small_stacked(self):
         left = synthetic_params(seed=4, side="left", n_verts=64,
