@@ -429,6 +429,33 @@ def check_silhouette_views(camera, target, fn_name: str) -> int:
     return 3
 
 
+def check_aux_mask(data_term, target_mask, dtype, n_frames=None):
+    """THE validation for the auxiliary keypoints2d mask (fit AND
+    fit_sequence — one copy, one error text). Returns the cast mask."""
+    if data_term != "keypoints2d":
+        # The pure-mask problem is data_term='silhouette'; the aux mask
+        # exists to COMBINE with the keypoint term.
+        raise ValueError(
+            "target_mask is the auxiliary mask for "
+            "data_term='keypoints2d' (for mask-only fitting use "
+            f"data_term='silhouette'); got data_term={data_term!r}"
+        )
+    target_mask = jnp.asarray(target_mask, dtype)
+    if n_frames is None:
+        if target_mask.ndim not in (2, 3) or 0 in target_mask.shape:
+            raise ValueError(
+                "target_mask must be a non-empty [H, W] (or batched "
+                f"[B, H, W]) mask, got {target_mask.shape}"
+            )
+    elif (target_mask.ndim != 3 or target_mask.shape[0] != n_frames
+          or 0 in target_mask.shape):
+        raise ValueError(
+            "fit_sequence target_mask must be [T, H, W] per-frame "
+            f"masks matching {n_frames} frames, got {target_mask.shape}"
+        )
+    return target_mask
+
+
 def check_hands_silhouette(camera, robust, targets, seq: bool,
                            fn_name: str,
                            mask_layout: str = "auto") -> bool:
@@ -844,20 +871,9 @@ def fit_with_optimizer(
 ) -> FitResult:
     _check_data_term(data_term, camera, target_conf)
     if target_mask is not None:
-        if data_term != "keypoints2d":
-            # The pure-mask problem is data_term='silhouette'; the aux
-            # mask exists to COMBINE with the keypoint term.
-            raise ValueError(
-                "target_mask is the auxiliary mask for "
-                "data_term='keypoints2d' (for mask-only fitting use "
-                f"data_term='silhouette'); got data_term={data_term!r}"
-            )
-        target_mask = jnp.asarray(target_mask, params.v_template.dtype)
-        if target_mask.ndim not in (2, 3) or 0 in target_mask.shape:
-            raise ValueError(
-                "target_mask must be a non-empty [H, W] (or batched "
-                f"[B, H, W]) mask, got {target_mask.shape}"
-            )
+        target_mask = check_aux_mask(
+            data_term, target_mask, params.v_template.dtype
+        )
     target_verts = jnp.asarray(target_verts, params.v_template.dtype)
     tips, n_kp = check_keypoint_spec(
         params, data_term, tip_vertex_ids, keypoint_order, target_verts,
@@ -980,6 +996,8 @@ def fit_sequence(
     self_penetration_radius: float = 0.004,
     _self_pen_mask=None,
     sil_sigma: float = 0.7,
+    target_mask: Optional[jnp.ndarray] = None,  # [T, H, W] aux masks
+    mask_weight: float = 0.1,
 ) -> SequenceFitResult:
     """Track a whole motion clip as ONE optimization problem.
 
@@ -1022,6 +1040,10 @@ def fit_sequence(
         )
     if data_term == "points" and targets.shape[-2] == 0:
         raise ValueError("points target cloud is empty ([T, 0, 3])")
+    if target_mask is not None:
+        target_mask = check_aux_mask(
+            data_term, target_mask, dtype, n_frames=targets.shape[0]
+        )
     tips, n_kp = check_keypoint_spec(
         params, data_term, tip_vertex_ids, keypoint_order, targets,
         "fit_sequence",
@@ -1056,6 +1078,14 @@ def fit_sequence(
         data = _data_loss(out, offset, targets, data_term, camera,
                           target_conf, robust, robust_scale, tips,
                           keypoint_order, params.faces, sil_sigma)
+        if target_mask is not None:
+            # Per-frame aux masks over the whole clip — same combined
+            # energy as fit's, one camera (see fit's docstring).
+            data = data + mask_weight * _data_loss(
+                out, offset, target_mask, "silhouette", camera, None,
+                "none", robust_scale, None, "mano", params.faces,
+                sil_sigma,
+            )
         # t_frames is static: skip velocity terms for single-frame clips
         # (mean over an empty array is NaN and would poison every grad).
         # Velocity couples whichever representation is being optimized —

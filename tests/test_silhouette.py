@@ -268,6 +268,33 @@ class TestSilhouetteFitting:
         )
         assert seq.pose.shape == (3, 16, 3)
 
+    def test_sequence_keypoints_plus_mask(self, small):
+        cam = viz.WeakPerspectiveCamera(
+            rot=jnp.eye(3, dtype=jnp.float32), scale=3.0
+        )
+        gt = core.forward(small)
+        kp = jnp.stack([cam.project(gt.posed_joints)[..., :2]] * 3)
+        masks = jnp.stack([
+            (soft_silhouette(gt.verts, small.faces, cam, height=16,
+                             width=16, sigma=1.0) > 0.5).astype(jnp.float32)
+        ] * 3)
+        res = fitting.fit_sequence(
+            small, kp, n_steps=3, data_term="keypoints2d", camera=cam,
+            fit_trans=True, target_mask=masks, mask_weight=0.2,
+        )
+        assert res.pose.shape == (3, 16, 3)
+        assert np.isfinite(np.asarray(res.final_loss)).all()
+        with pytest.raises(ValueError, match="matching 3 frames"):
+            fitting.fit_sequence(
+                small, kp, n_steps=2, data_term="keypoints2d", camera=cam,
+                target_mask=masks[:2],
+            )
+        with pytest.raises(ValueError, match="auxiliary mask"):
+            fitting.fit_sequence(
+                small, jnp.stack([gt.verts] * 3), n_steps=2,
+                target_mask=masks,
+            )
+
     def test_sequence_accepts_masks(self, small):
         target = jnp.zeros((3, 16, 16)).at[:, 4:12, 4:12].set(1.0)
         res = fitting.fit_sequence(
