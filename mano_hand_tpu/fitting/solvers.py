@@ -271,10 +271,11 @@ def validate_mask_target(fn):
         if is_sil:
             masks.append(bound.arguments.get(target_name))
         masks.append(bound.arguments.get("target_mask"))  # aux (kp2d+mask)
+        import numpy as np
+
         for m in masks:
             if m is None or isinstance(m, jax.core.Tracer):
                 continue
-            import numpy as np
             t = np.asarray(m)
             if t.size and (float(t.min()) < 0.0 or float(t.max()) > 1.0):
                 raise ValueError(
@@ -294,10 +295,10 @@ def validate_mask_target(fn):
             cam = bound.arguments.get("camera")
             cams = cam if is_multiview(cam) else (cam,)
             for c in cams:
-                # Either projection's magnification: a zero collapses
+                # Any projection's magnification: a zero collapses
                 # every vertex to one point (constant mask, zero
                 # gradients, the init returned as a "fit").
-                for attr in ("scale", "focal"):
+                for attr in ("scale", "focal", "fx", "fy"):
                     val = getattr(c, attr, None)
                     if (val is not None
                             and not isinstance(val, jax.core.Tracer)
@@ -307,6 +308,24 @@ def validate_mask_target(fn):
                             "projects every vertex to one point — "
                             f"constant mask, zero gradients), got {val}"
                         )
+                # An IntrinsicsCamera bakes the image resolution into
+                # its NDC; rasterizing a DIFFERENT-resolution mask
+                # through it silently rescales the projection (e.g. a
+                # 256px hand crop against a 640x480 calibration).
+                cw, ch = getattr(c, "width", None), getattr(c, "height",
+                                                           None)
+                if cw is not None and ch is not None:
+                    for m in masks:
+                        if m is None or isinstance(m, jax.core.Tracer):
+                            continue
+                        mh, mw = np.shape(m)[-2:]
+                        if (mh, mw) != (int(ch), int(cw)):
+                            raise ValueError(
+                                f"mask resolution {mh}x{mw} does not "
+                                "match the IntrinsicsCamera calibration "
+                                f"{int(ch)}x{int(cw)} — crop/resize "
+                                "masks AND adjust K together"
+                            )
         return fn(*args, **kw)
 
     return wrapper

@@ -12,7 +12,9 @@ batched XLA program on TPU.
 
 from mano_hand_tpu.viz.camera import (
     Camera,
+    IntrinsicsCamera,
     WeakPerspectiveCamera,
+    from_intrinsics,
     look_at,
     view_rotation,
 )
@@ -27,7 +29,9 @@ from mano_hand_tpu.viz.avi import write_avi, read_avi_info
 
 __all__ = [
     "Camera",
+    "IntrinsicsCamera",
     "WeakPerspectiveCamera",
+    "from_intrinsics",
     "look_at",
     "view_rotation",
     "error_colormap",

@@ -74,6 +74,105 @@ class WeakPerspectiveCamera(NamedTuple):
         return jnp.concatenate([xy, v[..., 2:3]], axis=-1)
 
 
+class IntrinsicsCamera(NamedTuple):
+    """Pinhole camera from a REAL calibration matrix (pixel units).
+
+    Datasets annotate with K = [[fx, 0, cx], [0, fy, cy], [0, 0, 1]] and
+    pixel keypoints; this camera exposes that convention on top of the
+    package's NDC plumbing. ``project`` returns NDC such that the
+    rasterizer's NDC→pixel mapping (render.ndc_to_pixels at this
+    ``width``/``height``) lands each vertex on the raster sample of its
+    intrinsic pixel (u, v) = (fx·X/Z + cx, fy·Y/Z + cy) — i.e. raster
+    coordinate u + 0.5, the center of OpenCV pixel u — so renders, soft
+    silhouettes, and mask fitting line up with the dataset's images
+    pixel-for-pixel. Convert pixel-space detector keypoints once with
+    ``pixels_to_ndc`` and fit as usual (residuals then live in NDC:
+    2/width pixel units — scale `robust_scale` accordingly).
+    """
+
+    rot: jnp.ndarray     # [3, 3] world -> camera
+    trans: jnp.ndarray   # [3]
+    fx: float
+    fy: float
+    cx: float
+    cy: float
+    width: int
+    height: int
+
+    def transform(self, verts: jnp.ndarray) -> jnp.ndarray:
+        """World verts [..., 3] -> view space [..., 3]."""
+        return verts @ self.rot.T + self.trans
+
+    def project(self, verts: jnp.ndarray) -> jnp.ndarray:
+        """World verts [..., 3] -> (x_ndc, y_ndc, depth) [..., 3]."""
+        v = self.transform(verts)
+        z = jnp.maximum(v[..., 2:3], EPS)
+        u = self.fx * v[..., 0:1] / z + self.cx
+        w = self.fy * v[..., 1:2] / z + self.cy
+        # ONE uv->NDC mapping (pixels_to_ndc) serves projection and
+        # keypoint conversion — they must match by contract.
+        xy = self.pixels_to_ndc(jnp.concatenate([u, w], axis=-1))
+        return jnp.concatenate([xy, v[..., 2:3]], axis=-1)
+
+    def pixels_to_ndc(self, uv: jnp.ndarray) -> jnp.ndarray:
+        """OpenCV-convention pixel coords [..., 2] (u right, v down,
+        origin top-left, integer values at pixel CENTERS — the K-matrix
+        convention) -> the NDC space ``project`` emits. Run detector
+        annotations through this once, then fit(data_term='keypoints2d').
+
+        The +0.5 shifts between conventions: the rasterizer samples
+        pixel i at continuous coordinate i+0.5, so intrinsic coordinate
+        u lands on raster coordinate u+0.5 — without it every render
+        and mask would sit half a pixel off the dataset image.
+        """
+        uv = jnp.asarray(uv)
+        return jnp.stack(
+            [2.0 * (uv[..., 0] + 0.5) / self.width - 1.0,
+             1.0 - 2.0 * (uv[..., 1] + 0.5) / self.height],
+            axis=-1,
+        )
+
+    def ndc_to_pixels(self, xy: jnp.ndarray) -> jnp.ndarray:
+        """Inverse of ``pixels_to_ndc`` (e.g. to draw fitted joints on
+        the dataset image, OpenCV convention)."""
+        xy = jnp.asarray(xy)
+        return jnp.stack(
+            [(xy[..., 0] + 1.0) * 0.5 * self.width - 0.5,
+             (1.0 - xy[..., 1]) * 0.5 * self.height - 0.5],
+            axis=-1,
+        )
+
+
+def from_intrinsics(
+    k_matrix,                      # [3, 3] calibration matrix K
+    width: int,
+    height: int,
+    rot=None,                      # [3, 3] world->camera; default identity
+    trans=(0.0, 0.0, 0.5),         # [3]; hands need positive view z
+) -> IntrinsicsCamera:
+    """Build an ``IntrinsicsCamera`` from a dataset's K matrix."""
+    k = np.asarray(k_matrix, np.float64)
+    if k.shape != (3, 3):
+        raise ValueError(f"K must be [3, 3], got {k.shape}")
+    if k[0, 0] <= 0 or k[1, 1] <= 0:
+        raise ValueError(f"fx/fy must be > 0, got {k[0, 0]}, {k[1, 1]}")
+    if abs(k[0, 1]) > 1e-6:
+        # Silently dropping the skew term would bias every projected u
+        # by skew*Y/Z pixels; refuse the unsupported calibration.
+        raise ValueError(
+            f"skewed calibrations (K[0,1]={k[0, 1]:g}) are not supported"
+        )
+    return IntrinsicsCamera(
+        rot=jnp.asarray(
+            np.eye(3) if rot is None else np.asarray(rot), jnp.float32
+        ),
+        trans=jnp.asarray(trans, jnp.float32),
+        fx=float(k[0, 0]), fy=float(k[1, 1]),
+        cx=float(k[0, 2]), cy=float(k[1, 2]),
+        width=int(width), height=int(height),
+    )
+
+
 def view_rotation(axis_angle: Sequence[float]) -> jnp.ndarray:
     """Axis-angle view matrix, the rasterizer-side analogue of the demo's
     transforms3d usage. Accepts a length-3 vector; angle = norm."""
