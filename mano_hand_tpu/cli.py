@@ -371,6 +371,38 @@ def cmd_fit(args) -> int:
             print(f"--sil-sigma must be > 0, got {args.sil_sigma}",
                   file=sys.stderr)
             return 2
+    intr_cam = None
+    if args.camera_k:
+        # Dataset calibration: K entries + image size. Takes precedence
+        # over the synthetic-camera flags; keypoint targets are then
+        # PIXEL coordinates (the annotation convention) and are
+        # converted once via pixels_to_ndc. Validated BEFORE solver
+        # resolution so e.g. a verts fit (LM default) still refuses it.
+        if args.data_term not in ("keypoints2d", "silhouette"):
+            print("--camera-k only applies to --data-term "
+                  "keypoints2d/silhouette", file=sys.stderr)
+            return 2
+        try:
+            fx, fy, cx, cy = (float(x) for x in args.camera_k.split(","))
+            w_str, _, h_str = (args.camera_size or "").partition("x")
+            cam_w, cam_h = int(w_str), int(h_str)
+        except ValueError as e:
+            print("--camera-k must be 'fx,fy,cx,cy' with "
+                  f"--camera-size 'WxH': {e}", file=sys.stderr)
+            return 2
+        from mano_hand_tpu.viz.camera import from_intrinsics
+
+        try:
+            intr_cam = from_intrinsics(
+                [[fx, 0, cx], [0, fy, cy], [0, 0, 1]], cam_w, cam_h,
+            )
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+    elif args.camera_size is not None:
+        print("--camera-size only applies with --camera-k",
+              file=sys.stderr)
+        return 2
     if args.solver == "lm" and (args.pose_prior != "l2"
                                 or args.pose_prior_weight is not None):
         # Either prior flag under LM is a contradiction, not a preference
@@ -467,45 +499,59 @@ def cmd_fit(args) -> int:
                       "(the IoU is already bounded per image)",
                       file=sys.stderr)
                 return 2
-            from mano_hand_tpu.viz.camera import (
-                WeakPerspectiveCamera, view_rotation,
-            )
+            if intr_cam is not None:
+                if args.camera_scale is not None or args.camera_rot:
+                    print("--camera-scale/--camera-rot conflict with "
+                          "--camera-k (the calibration IS the camera)",
+                          file=sys.stderr)
+                    return 2
+                if targets.shape[-2:] != (intr_cam.height,
+                                          intr_cam.width):
+                    # Both sides HxW so a transposed mask reads as the
+                    # mismatch it is.
+                    print(f"mask resolution {targets.shape[-2]}x"
+                          f"{targets.shape[-1]} (HxW) must match "
+                          f"--camera-size {intr_cam.height}x"
+                          f"{intr_cam.width} (HxW)",
+                          file=sys.stderr)
+                    return 2
+                sil_camera = intr_cam
+            else:
+                from mano_hand_tpu.viz.camera import (
+                    WeakPerspectiveCamera, view_rotation,
+                )
 
-            try:
-                rot = [float(x)
-                       for x in (args.camera_rot or "0,0,0").split(",")]
-                if len(rot) != 3:
-                    raise ValueError(f"need 3 components, got {len(rot)}")
-            except ValueError as e:
-                print(f"--camera-rot must be 'x,y,z' axis-angle: {e}",
-                      file=sys.stderr)
-                return 2
-            # Weak perspective by design: under a pinhole camera a mask
-            # fit inflates the hand toward the lens (measured, see
-            # docs/api.md); the scaled-orthographic model removes that
-            # axis. Translation is the one thing an outline observes
-            # strongly — always fit it.
-            default_lr = 0.01
-            kp2d = dict(
-                camera=WeakPerspectiveCamera(
+                try:
+                    rot = [float(x)
+                           for x in (args.camera_rot or "0,0,0").split(",")]
+                    if len(rot) != 3:
+                        raise ValueError(
+                            f"need 3 components, got {len(rot)}"
+                        )
+                except ValueError as e:
+                    print(f"--camera-rot must be 'x,y,z' axis-angle: {e}",
+                          file=sys.stderr)
+                    return 2
+                # Weak perspective by design: under a pinhole camera a
+                # mask fit inflates the hand toward the lens (measured,
+                # see docs/api.md); the scaled-orthographic model removes
+                # that axis. (A REAL calibration via --camera-k is the
+                # exception: its depth is meaningful, trust it.)
+                sil_camera = WeakPerspectiveCamera(
                     rot=view_rotation(rot),
                     scale=(3.0 if args.camera_scale is None
                            else args.camera_scale),
-                ),
+                )
+            # Translation is the one thing an outline observes strongly
+            # — always fit it.
+            default_lr = 0.01
+            kp2d = dict(
+                camera=sil_camera,
                 fit_trans=True,
                 sil_sigma=(1.0 if args.sil_sigma is None
                            else args.sil_sigma),
             )
         if args.data_term == "keypoints2d":
-            from mano_hand_tpu.viz.camera import look_at
-
-            try:
-                eye = [float(x) for x in args.camera_eye.split(",")]
-                if len(eye) != 3:
-                    raise ValueError(f"need 3 components, got {len(eye)}")
-            except ValueError as e:
-                print(f"--camera-eye must be 'x,y,z': {e}", file=sys.stderr)
-                return 2
             conf = None
             if args.conf:
                 conf = np.load(args.conf).astype(np.float32)
@@ -516,12 +562,43 @@ def cmd_fit(args) -> int:
                           f"{targets.shape}, got {conf.shape}",
                           file=sys.stderr)
                     return 2
+            if intr_cam is not None:
+                if args.camera_eye is not None or args.focal is not None:
+                    # Refuse rather than silently drop (the file-wide
+                    # pattern): the calibration IS the camera.
+                    print("--camera-eye/--focal conflict with --camera-k",
+                          file=sys.stderr)
+                    return 2
+                # Dataset convention: the .npy targets are PIXEL
+                # coordinates on the calibrated image; convert once.
+                targets = np.asarray(intr_cam.pixels_to_ndc(
+                    targets.astype(np.float32)
+                ))
+                kp_camera = intr_cam
+            else:
+                from mano_hand_tpu.viz.camera import look_at
+
+                try:
+                    eye = [float(x) for x in
+                           (args.camera_eye or "0,0,-0.75").split(",")]
+                    if len(eye) != 3:
+                        raise ValueError(
+                            f"need 3 components, got {len(eye)}"
+                        )
+                except ValueError as e:
+                    print(f"--camera-eye must be 'x,y,z': {e}",
+                          file=sys.stderr)
+                    return 2
+                kp_camera = look_at(
+                    eye=eye,
+                    focal=2.2 if args.focal is None else args.focal,
+                )
             # 2D data is depth-blind: fit a global translation, use the
             # better-conditioned PCA pose space, a mild pose prior, and a
             # gentler step (the defaults the library-level tests validate).
             default_lr = 0.02
             kp2d = dict(
-                camera=look_at(eye=eye, focal=args.focal),
+                camera=kp_camera,
                 target_conf=conf,
                 fit_trans=True,
                 n_pca=15,
@@ -760,11 +837,20 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--conf", default=None,
                    help=".npy of [16]/[B,16] keypoint confidences "
                         "(keypoints2d only)")
-    f.add_argument("--camera-eye", default="0,0,-0.75",
+    f.add_argument("--camera-eye", default=None,
                    help="camera position 'x,y,z' looking at the origin "
-                        "(keypoints2d only)")
-    f.add_argument("--focal", type=float, default=2.2,
-                   help="pinhole focal in NDC units (keypoints2d only)")
+                        "(keypoints2d only; default 0,0,-0.75; "
+                        "conflicts with --camera-k)")
+    f.add_argument("--focal", type=float, default=None,
+                   help="pinhole focal in NDC units (keypoints2d only; "
+                        "default 2.2; conflicts with --camera-k)")
+    f.add_argument("--camera-k", default=None,
+                   help="dataset calibration 'fx,fy,cx,cy' in pixels "
+                        "(with --camera-size): keypoints2d targets are "
+                        "then PIXEL coordinates; silhouette masks must "
+                        "match the calibrated resolution")
+    f.add_argument("--camera-size", default=None,
+                   help="calibrated image size 'WxH' (with --camera-k)")
     f.add_argument("--camera-scale", type=float, default=None,
                    help="weak-perspective scale (silhouette only): NDC "
                         "units per meter (default 3.0)")

@@ -162,6 +162,12 @@ def from_intrinsics(
         raise ValueError(
             f"skewed calibrations (K[0,1]={k[0, 1]:g}) are not supported"
         )
+    if width <= 0 or height <= 0:
+        # pixels_to_ndc divides by these; zero would make every NDC
+        # target inf and the fit would "succeed" on NaNs.
+        raise ValueError(
+            f"width/height must be > 0, got {width}x{height}"
+        )
     return IntrinsicsCamera(
         rot=jnp.asarray(
             np.eye(3) if rot is None else np.asarray(rot), jnp.float32

@@ -282,6 +282,75 @@ def test_convert_official_pickle_to_npz(tmp_path, params):
     assert back.side == "left"
 
 
+def test_fit_camera_k(tmp_path, capsys):
+    """--camera-k: pixel keypoints through a dataset K matrix."""
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+    from mano_hand_tpu.viz.camera import from_intrinsics
+
+    p32 = synthetic_params(seed=0).astype(np.float32)
+    K = [[240.0, 0, 32.0], [0, 240.0, 28.0], [0, 0, 1]]
+    cam = from_intrinsics(K, width=64, height=56, trans=(0.0, 0.0, 0.5))
+    gt = core.forward(p32)
+    true_t = jnp.asarray([0.02, -0.01, 0.0], jnp.float32)
+    uv = np.asarray(cam.ndc_to_pixels(
+        cam.project(gt.posed_joints + true_t)[..., :2]
+    ))
+    np.save(tmp_path / "uv.npy", uv.astype(np.float32))
+    out = tmp_path / "fit.npz"
+    rc = cli.main([
+        "fit", str(tmp_path / "uv.npy"), "--data-term", "keypoints2d",
+        "--camera-k", "240,240,32,28", "--camera-size", "64x56",
+        "--steps", "200", "--out", str(out),
+    ])
+    assert rc == 0
+    ckpt = np.load(out)
+    fitted = core.forward(p32, jnp.asarray(ckpt["pose"]),
+                          jnp.asarray(ckpt["shape"]))
+    uv_fit = np.asarray(cam.ndc_to_pixels(cam.project(
+        fitted.posed_joints + jnp.asarray(ckpt["trans"])
+    )[..., :2]))
+    assert np.linalg.norm(uv_fit - uv, axis=-1).mean() < 1.0
+
+    # Guard rails.
+    rc = cli.main(["fit", str(tmp_path / "uv.npy"), "--data-term",
+                   "keypoints2d", "--camera-k", "240,240,32"])
+    assert rc == 2
+    assert "--camera-k must be" in capsys.readouterr().err
+    rc = cli.main(["fit", str(tmp_path / "uv.npy"), "--data-term",
+                   "keypoints2d", "--camera-size", "64x56"])
+    assert rc == 2
+    assert "only applies with --camera-k" in capsys.readouterr().err
+    np.save(tmp_path / "mask.npy", np.zeros((32, 32), np.float32))
+    rc = cli.main(["fit", str(tmp_path / "mask.npy"), "--data-term",
+                   "silhouette", "--camera-k", "240,240,32,28",
+                   "--camera-size", "64x56"])
+    assert rc == 2
+    assert "must match --camera-size" in capsys.readouterr().err
+    rc = cli.main(["fit", str(tmp_path / "mask.npy"), "--data-term",
+                   "silhouette", "--camera-k", "240,240,32,28",
+                   "--camera-size", "64x56", "--camera-scale", "2.0"])
+    assert rc == 2
+    assert "conflict with --camera-k" in capsys.readouterr().err
+    np.save(tmp_path / "v.npy", np.zeros((p32.n_verts, 3), np.float32))
+    rc = cli.main(["fit", str(tmp_path / "v.npy"),
+                   "--camera-k", "240,240,32,28",
+                   "--camera-size", "64x56"])
+    assert rc == 2
+    assert "--camera-k only applies" in capsys.readouterr().err
+    rc = cli.main(["fit", str(tmp_path / "uv.npy"), "--data-term",
+                   "keypoints2d", "--camera-k", "240,240,32,28",
+                   "--camera-size", "64x56", "--focal", "5.0"])
+    assert rc == 2
+    assert "conflict with --camera-k" in capsys.readouterr().err
+    rc = cli.main(["fit", str(tmp_path / "uv.npy"), "--data-term",
+                   "keypoints2d", "--camera-k", "240,240,32,28",
+                   "--camera-size", "0x56"])
+    assert rc == 2
+    assert "width/height must be > 0" in capsys.readouterr().err
+
+
 def test_fit_heatmap(tmp_path, capsys):
     import jax.numpy as jnp
 
