@@ -86,6 +86,16 @@ class TestSoftSilhouette:
         one = soft_silhouette(t, f, _CAM, height=16, width=16)
         np.testing.assert_allclose(np.asarray(sil[0]), np.asarray(one),
                                    atol=1e-6)
+        # Both batch executions produce identical images (auto switches
+        # between them by slab size; they must be interchangeable).
+        for mode in ("vmap", "map"):
+            alt = soft_silhouette(batched, f, _CAM, height=16, width=16,
+                                  batch_mode=mode)
+            np.testing.assert_allclose(np.asarray(alt), np.asarray(sil),
+                                       atol=1e-6)
+        with pytest.raises(ValueError, match="batch_mode must be"):
+            soft_silhouette(batched, f, _CAM, height=16, width=16,
+                            batch_mode="loop")
 
     def test_odd_height_uses_largest_divisor_chunks(self):
         # 20 rows with the default chunk_rows=8 must pick 4-row chunks
