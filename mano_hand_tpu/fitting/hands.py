@@ -130,11 +130,12 @@ def fit_hands(
     # Unsupported-term rejection FIRST: running the generic validator
     # before it would demand a camera for a term this entry point does
     # not support at all.
-    if data_term == "points":
+    if data_term in ("points", "depth"):
         raise ValueError(
             "fit_hands supports verts/joints/keypoints2d/silhouette; for "
             "scan registration fit each hand with fit_lm (ICP needs "
-            "per-hand correspondence anyway)"
+            "per-hand correspondence anyway), and for depth images fit "
+            "each hand on its cropped depth region"
         )
     solvers._check_data_term(data_term, camera, target_conf)
     dtype = stacked.v_template.dtype
@@ -283,7 +284,7 @@ def fit_hands_sequence(
             f"output; got side={stacked.side!r}. For one hand use "
             "fit_sequence()."
         )
-    if data_term == "points":
+    if data_term in ("points", "depth"):
         raise ValueError(
             "fit_hands_sequence supports verts/joints/keypoints2d/"
             "silhouette"

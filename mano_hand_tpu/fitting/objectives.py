@@ -136,6 +136,38 @@ def silhouette_iou_loss(pred_sil: jnp.ndarray,    # [..., H, W] in [0, 1]
     return 1.0 - (inter + 1e-6) / (union + 1e-6)
 
 
+def depth_loss(pred_depth: jnp.ndarray,    # [..., H, W] meters
+               target_depth: jnp.ndarray,  # [..., H, W]; <=0 = invalid
+               penalty=None) -> jnp.ndarray:
+    """Masked mean squared depth error against a sensor depth image.
+
+    Depth sensors return 0 (or negative sentinel) where they have no
+    reading — those pixels carry no information and are excluded, the
+    universal depth-map convention. ``penalty`` maps per-pixel SQUARED
+    errors (e.g. ``huber`` — sensor depth is heavy-tailed at object
+    boundaries). Reduction over the image axes only: one loss per
+    image, mean over frames at the call site. An image with zero valid
+    pixels contributes 0 (not NaN); the solvers reject all-invalid
+    targets up front where values are concrete.
+    """
+    valid = target_depth > 0.0          # NaN > 0 is False: NaN-invalid
+    #   sensor maps (the ROS/Open3D float convention) mask out too.
+    # The double-where: sanitize the INPUT before it enters the residual,
+    # not just the output — masking sq afterwards still leaves
+    # (pred - NaN) in the graph, and backward's 0-cotangent times that
+    # NaN poisons every gradient (the classic jnp.where pitfall).
+    safe_target = jnp.where(valid, target_depth, 0.0)
+    sq = jnp.where(valid, (pred_depth - safe_target) ** 2, 0.0)
+    if penalty is not None:
+        sq = penalty(sq)
+        sq = jnp.where(valid, sq, 0.0)  # penalty(0) need not be 0
+    v = valid.astype(pred_depth.dtype)
+    return (
+        jnp.sum(sq, axis=(-2, -1))
+        / jnp.maximum(jnp.sum(v, axis=(-2, -1)), 1.0)
+    )
+
+
 def huber(sq_dist: jnp.ndarray, delta: float) -> jnp.ndarray:
     """Huber penalty on per-point SQUARED distances.
 

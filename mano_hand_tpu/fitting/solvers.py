@@ -179,12 +179,12 @@ class FitResult(NamedTuple):
 def _check_data_term(data_term: str, camera, conf) -> None:
     """One validation policy for every solver entry point."""
     if data_term not in ("verts", "joints", "keypoints2d", "points",
-                         "silhouette"):
+                         "silhouette", "depth"):
         raise ValueError(
-            "data_term must be 'verts', 'joints', 'keypoints2d', 'points' "
-            f"or 'silhouette', got {data_term!r}"
+            "data_term must be 'verts', 'joints', 'keypoints2d', "
+            f"'points', 'silhouette' or 'depth', got {data_term!r}"
         )
-    if data_term in ("keypoints2d", "silhouette"):
+    if data_term in ("keypoints2d", "silhouette", "depth"):
         if camera is None:
             raise ValueError(
                 f"data_term={data_term!r} needs a viz.camera.Camera (or "
@@ -194,11 +194,11 @@ def _check_data_term(data_term: str, camera, conf) -> None:
             if data_term != "silhouette":
                 raise ValueError(
                     "a camera list (multi-view) is only supported for "
-                    "data_term='silhouette'; keypoints2d takes one camera"
+                    f"data_term='silhouette'; {data_term} takes one camera"
                 )
             if len(camera) == 0:
                 raise ValueError("camera list is empty")
-        if conf is not None and data_term == "silhouette":
+        if conf is not None and data_term != "keypoints2d":
             raise ValueError(
                 "target_conf only applies to data_term='keypoints2d'"
             )
@@ -206,7 +206,8 @@ def _check_data_term(data_term: str, camera, conf) -> None:
         # Accepting these would silently fit unweighted/unprojected data.
         raise ValueError(
             "camera/target_conf only apply to the image-space data terms "
-            f"('keypoints2d', 'silhouette'), got data_term={data_term!r}"
+            "('keypoints2d', 'silhouette', 'depth'), got "
+            f"data_term={data_term!r}"
         )
 
 
@@ -266,7 +267,9 @@ def validate_mask_target(fn):
         except TypeError:
             # Malformed call: let the real function raise its own error.
             return fn(*args, **kw)
-        is_sil = bound.arguments.get("data_term") == "silhouette"
+        data_term = bound.arguments.get("data_term")
+        is_sil = data_term == "silhouette"
+        is_depth = data_term == "depth"
         masks = []
         if is_sil:
             masks.append(bound.arguments.get(target_name))
@@ -283,7 +286,21 @@ def validate_mask_target(fn):
                     f"range [{float(t.min()):g}, {float(t.max()):g}] "
                     "— divide a 0/255 uint8 mask by 255"
                 )
-        if is_sil or bound.arguments.get("target_mask") is not None:
+        if is_depth:
+            d = bound.arguments.get(target_name)
+            if d is not None and not isinstance(d, jax.core.Tracer):
+                t = np.asarray(d)
+                if t.size and not (t > 0).any():
+                    # All pixels invalid -> zero valid-pixel loss, zero
+                    # gradients, the init saved as a "fit".
+                    raise ValueError(
+                        "depth target has no valid (positive) pixels"
+                    )
+                # Joins the camera-resolution check below (the [0, 1]
+                # range check does NOT apply — depth is in meters).
+                masks.append(d)
+        if (is_sil or is_depth
+                or bound.arguments.get("target_mask") is not None):
             # Degenerate render parameters give a constant/NaN image and
             # a zero-gradient "fit" of the init; sil_sigma is traced
             # INSIDE the jitted solver, so its value check belongs here.
@@ -557,6 +574,11 @@ def _data_loss(out, offset, target, data_term: str, camera, conf,
       jointly (mean per-view IoU) — the visual-hull setup: two or more
       calibrated views restore the depth axis a single outline cannot
       observe.
+    - ``depth``: a sensor depth image [H, W] in view-space meters
+      (<= 0 = invalid, excluded — the universal depth-map convention),
+      compared against the soft z-buffer render (viz.soft_depth). The
+      one single-view image term that observes FULL 3D translation;
+      ``robust="huber"`` bounds the boundary-pixel tails.
 
     ``robust="huber"`` replaces the per-point squared distance with a
     Huber penalty at scale ``robust_scale`` (same units as the data:
@@ -566,6 +588,31 @@ def _data_loss(out, offset, target, data_term: str, camera, conf,
     """
     if robust not in ("none", "huber"):
         raise ValueError(f"robust must be 'none' or 'huber', got {robust!r}")
+    if (robust == "huber" and not isinstance(robust_scale, jax.core.Tracer)
+            and float(robust_scale) <= 0):
+        # A zero scale makes the whole data term identically zero (the
+        # fit would silently return the initialization); negative rewards
+        # outliers. robust_scale is static in the jitted entry points, so
+        # it is always concrete there (incl. numpy scalars — hence
+        # float(), not an isinstance whitelist). Checked before ANY term
+        # branch so the depth path gets it too.
+        raise ValueError(f"robust_scale must be > 0, got {robust_scale}")
+    if data_term == "depth":
+        # A sensor depth image: the ONE single-view term that observes
+        # full 3D translation (a silhouette cannot see z; depth IS z).
+        # Invalid (<= 0) pixels are excluded; Huber applies per pixel
+        # (sensor depth is heavy-tailed at object boundaries).
+        from mano_hand_tpu.viz.silhouette import soft_depth
+        penalty = (
+            (lambda sq: objectives.huber(sq, robust_scale))
+            if robust == "huber" else None
+        )
+        pred = soft_depth(
+            out.verts + offset, faces, camera,
+            height=target.shape[-2], width=target.shape[-1],
+            sigma=sil_sigma,
+        )
+        return jnp.mean(objectives.depth_loss(pred, target, penalty))
     if data_term == "silhouette":
         if robust != "none":
             # The IoU is already bounded per image; there is no per-point
@@ -586,14 +633,6 @@ def _data_loss(out, offset, target, data_term: str, camera, conf,
             sil = soft_silhouette(verts, faces, camera, height=h, width=w,
                                   sigma=sil_sigma)
         return jnp.mean(objectives.silhouette_iou_loss(sil, target))
-    if (robust == "huber" and not isinstance(robust_scale, jax.core.Tracer)
-            and float(robust_scale) <= 0):
-        # A zero scale makes the whole data term identically zero (the
-        # fit would silently return the initialization); negative rewards
-        # outliers. robust_scale is static in the jitted entry points, so
-        # it is always concrete there (incl. numpy scalars — hence
-        # float(), not an isinstance whitelist).
-        raise ValueError(f"robust_scale must be > 0, got {robust_scale}")
     penalty = (
         (lambda sq: objectives.huber(sq, robust_scale))
         if robust == "huber" else None

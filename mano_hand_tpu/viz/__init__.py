@@ -23,7 +23,7 @@ from mano_hand_tpu.viz.render import (
     render_mesh,
     render_sequence,
 )
-from mano_hand_tpu.viz.silhouette import soft_silhouette
+from mano_hand_tpu.viz.silhouette import soft_depth, soft_silhouette
 from mano_hand_tpu.viz.png import write_png, write_gif
 from mano_hand_tpu.viz.avi import write_avi, read_avi_info
 
@@ -37,6 +37,7 @@ __all__ = [
     "error_colormap",
     "render_mesh",
     "render_sequence",
+    "soft_depth",
     "soft_silhouette",
     "write_png",
     "write_gif",
