@@ -197,12 +197,14 @@ def cmd_fit(args) -> int:
     params = _load_params(args.asset, args.side).astype(np.float32)
     tgt_lower = str(args.targets).lower()
     if tgt_lower.endswith((".ply", ".obj")):
-        if args.data_term == "silhouette":
+        if args.data_term in ("silhouette", "depth"):
             # A mesh/point cloud is not an image; without this the value
-            # guard below would emit a nonsense "divide by 255" for
-            # vertex coordinates.
-            print("a .ply/.obj is geometry, not a mask: use a .npy/.png "
-                  "[H, W] image with --data-term silhouette",
+            # guard below would emit a nonsense error for vertex
+            # coordinates.
+            fmt = (".npy/.png" if args.data_term == "silhouette"
+                   else ".npy")   # PNG cannot carry meters
+            print(f"a .ply/.obj is geometry, not an image: use a {fmt} "
+                  f"[H, W] image with --data-term {args.data_term}",
                   file=sys.stderr)
             return 2
         # Scanner/DCC output directly: the vertex cloud (any faces are
@@ -246,6 +248,17 @@ def cmd_fit(args) -> int:
                       f"[{targets.min():g}, {targets.max():g}]); divide "
                       "a 0/255 mask by 255", file=sys.stderr)
                 return 2
+        elif args.data_term == "depth":
+            targets = np.asarray(targets, np.float32)
+            if targets.size and targets.ndim >= 2 and not (
+                (targets > 0).any(axis=(-2, -1)).all()
+            ):
+                # Per image: one dropped-out frame in a batch would fit
+                # to nothing and report its init as converged.
+                print("depth target has image(s) with no valid "
+                      "(positive) pixels — depth is view-space meters, "
+                      "<= 0 or NaN = no reading", file=sys.stderr)
+                return 2
     if args.data_term not in ("joints", "keypoints2d"):
         # Name the real conflict for BOTH keypoint flags here — sending
         # the user to --tips from the openpose check would ping-pong them
@@ -273,16 +286,15 @@ def cmd_fit(args) -> int:
     kp_kw = {}
     if args.data_term in ("joints", "keypoints2d"):
         kp_kw = dict(tip_vertex_ids=tips, keypoint_order=args.keypoint_order)
-    if args.data_term == "silhouette":
-        # Masks are [H, W] / [B, H, W] images, not [rows, coords] arrays.
-        # A zero-size image has a constant 0 IoU loss (the empty-empty
-        # epsilon case) — zero gradients, and the INIT would be saved as
-        # a "successful" fit (same class the point-term empty check
-        # keeps out).
+    if args.data_term in ("silhouette", "depth"):
+        # Masks/depth maps are [H, W] / [B, H, W] images, not
+        # [rows, coords] arrays. A zero-size image has a constant loss —
+        # zero gradients, and the INIT would be saved as a "successful"
+        # fit (same class the point-term empty check keeps out).
         if targets.ndim not in (2, 3) or 0 in targets.shape:
-            print(f"mask targets must be non-empty [H, W] or [B, H, W] "
-                  f"for --data-term silhouette, got {targets.shape}",
-                  file=sys.stderr)
+            print(f"image targets must be non-empty [H, W] or [B, H, W] "
+                  f"for --data-term {args.data_term}, got "
+                  f"{targets.shape}", file=sys.stderr)
             return 2
     else:
         if args.data_term == "keypoints2d":
@@ -348,17 +360,26 @@ def cmd_fit(args) -> int:
         print("--conf only applies to --data-term keypoints2d",
               file=sys.stderr)
         return 2
-    if args.data_term != "silhouette":
+    if args.data_term not in ("silhouette", "depth"):
         # Refuse rather than silently drop (the --tips/--trim pattern):
-        # these flags change the fit ONLY through the mask path.
+        # these flags change the fit ONLY through the rasterized paths.
         for flag, val in (("--camera-scale", args.camera_scale),
                           ("--camera-rot", args.camera_rot),
                           ("--sil-sigma", args.sil_sigma)):
             if val is not None:
-                print(f"{flag} only applies to --data-term silhouette",
-                      file=sys.stderr)
+                print(f"{flag} only applies to --data-term "
+                      "silhouette/depth", file=sys.stderr)
                 return 2
     else:
+        if args.data_term == "depth" and (
+            args.camera_scale is not None or args.camera_rot
+        ):
+            # Weak perspective has no meaningful depth axis — a depth
+            # image only makes sense under a real (pinhole) projection.
+            print("--camera-scale/--camera-rot are the weak-perspective "
+                  "silhouette flags; --data-term depth uses the default "
+                  "pinhole camera or --camera-k", file=sys.stderr)
+            return 2
         # Degenerate-value guards (same class as the empty-mask check):
         # scale 0 projects everything to one point (constant image, zero
         # gradients, the init saved as a "fit"); sigma 0 divides by zero
@@ -378,9 +399,9 @@ def cmd_fit(args) -> int:
         # PIXEL coordinates (the annotation convention) and are
         # converted once via pixels_to_ndc. Validated BEFORE solver
         # resolution so e.g. a verts fit (LM default) still refuses it.
-        if args.data_term not in ("keypoints2d", "silhouette"):
+        if args.data_term not in ("keypoints2d", "silhouette", "depth"):
             print("--camera-k only applies to --data-term "
-                  "keypoints2d/silhouette", file=sys.stderr)
+                  "keypoints2d/silhouette/depth", file=sys.stderr)
             return 2
         try:
             fx, fy, cx, cy = (float(x) for x in args.camera_k.split(","))
@@ -416,7 +437,7 @@ def cmd_fit(args) -> int:
         if args.lr is not None:
             print("note: --lr only applies to --solver adam; ignored",
                   file=sys.stderr)
-        if args.data_term in ("keypoints2d", "silhouette"):
+        if args.data_term in ("keypoints2d", "silhouette", "depth"):
             print(f"--data-term {args.data_term} requires --solver adam",
                   file=sys.stderr)
             return 2
@@ -489,7 +510,8 @@ def cmd_fit(args) -> int:
         shape_prior = (
             args.shape_prior if args.shape_prior is not None
             else (0.0 if args.data_term == "verts"
-                  else 1.0 if args.data_term == "silhouette" else 1e-3)
+                  else 1.0 if args.data_term in ("silhouette", "depth")
+                  else 1e-3)
         )
         kp2d = {}
         default_lr = 0.05
@@ -547,6 +569,39 @@ def cmd_fit(args) -> int:
             default_lr = 0.01
             kp2d = dict(
                 camera=sil_camera,
+                fit_trans=True,
+                sil_sigma=(1.0 if args.sil_sigma is None
+                           else args.sil_sigma),
+            )
+        if args.data_term == "depth":
+            # Depth needs a REAL projection (weak perspective has no
+            # depth axis): the dataset calibration when given, else the
+            # default pinhole framing. One depth image observes full 3D
+            # translation — always fit it.
+            if args.camera_eye is not None or args.focal is not None:
+                # Refuse rather than silently drop: these pinhole flags
+                # LOOK applicable here but the depth camera is the
+                # default framing or --camera-k only.
+                print("--camera-eye/--focal apply to keypoints2d; "
+                      "--data-term depth uses the default pinhole "
+                      "camera or --camera-k", file=sys.stderr)
+                return 2
+            if intr_cam is not None:
+                depth_camera = intr_cam
+                if targets.shape[-2:] != (intr_cam.height,
+                                          intr_cam.width):
+                    print(f"depth resolution {targets.shape[-2]}x"
+                          f"{targets.shape[-1]} (HxW) must match "
+                          f"--camera-size {intr_cam.height}x"
+                          f"{intr_cam.width} (HxW)", file=sys.stderr)
+                    return 2
+            else:
+                from mano_hand_tpu.viz.camera import default_hand_camera
+
+                depth_camera = default_hand_camera()
+            default_lr = 0.01
+            kp2d = dict(
+                camera=depth_camera,
                 fit_trans=True,
                 sil_sigma=(1.0 if args.sil_sigma is None
                            else args.sil_sigma),
@@ -620,11 +675,11 @@ def cmd_fit(args) -> int:
         if pose_prior_weight is None:
             if args.data_term == "keypoints2d":
                 pose_prior_weight = 1e-4
-            elif args.data_term == "silhouette":
-                # An outline alone cannot pin articulation: hold the pose
+            elif args.data_term in ("silhouette", "depth"):
+                # A single image cannot pin articulation: hold the pose
                 # hard and let translation do the observable work (the
-                # weight the mask-recovery tests validate). Lower it when
-                # combining with more views or keypoints.
+                # weight the image-recovery tests validate). Lower it
+                # when combining with more views or keypoints.
                 pose_prior_weight = 1.0
             elif args.pose_prior == "mahalanobis":
                 pose_prior_weight = 1e-3
@@ -792,7 +847,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "keypoints2d defaults to pca when unset")
     f.add_argument("--data-term", default="verts",
                    choices=["verts", "joints", "keypoints2d", "points",
-                            "point_to_plane", "silhouette"],
+                            "point_to_plane", "silhouette", "depth"],
                    help="fit to a full target mesh, sparse 3D keypoints "
                         "(detector/mocap output), 2D keypoints projected "
                         "through a pinhole camera, a correspondence-"
@@ -803,7 +858,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "mask ('silhouette': soft-IoU through the "
                         "differentiable rasterizer, weak-perspective "
                         "camera; multi-view fitting is a library/example "
-                        "feature — see examples/12)")
+                        "feature — see examples/12), or a sensor depth "
+                        "image ('depth': [H,W] .npy in view-space "
+                        "meters, <=0/NaN = no reading — the one "
+                        "single-view image term that observes full 3D "
+                        "translation; pinhole/--camera-k only)")
     f.add_argument("--init", default=None,
                    help="warm-start from a previous fit checkpoint (.npz "
                         "with pose/shape, e.g. a coarse --data-term joints "

@@ -190,6 +190,14 @@ def _check_data_term(data_term: str, camera, conf) -> None:
                 f"data_term={data_term!r} needs a viz.camera.Camera (or "
                 "WeakPerspectiveCamera)"
             )
+        if data_term == "depth" and hasattr(camera, "scale"):
+            # Weak perspective's z column is rotation-only (roughly 0
+            # for an origin-centered hand) — a meters-scale depth target
+            # against it is a meaningless residual, silently.
+            raise ValueError(
+                "data_term='depth' needs a real projection (Camera or "
+                "IntrinsicsCamera); weak perspective has no depth axis"
+            )
         if is_multiview(camera):
             if data_term != "silhouette":
                 raise ValueError(
@@ -290,11 +298,15 @@ def validate_mask_target(fn):
             d = bound.arguments.get(target_name)
             if d is not None and not isinstance(d, jax.core.Tracer):
                 t = np.asarray(d)
-                if t.size and not (t > 0).any():
-                    # All pixels invalid -> zero valid-pixel loss, zero
-                    # gradients, the init saved as a "fit".
+                # PER IMAGE, not whole-array: one all-invalid frame in a
+                # batch/clip (sensor dropout) would contribute zero
+                # gradients and report its untouched init as a converged
+                # fit.
+                if t.size and not (t > 0).any(axis=(-2, -1)).all():
                     raise ValueError(
-                        "depth target has no valid (positive) pixels"
+                        "depth target has image(s) with no valid "
+                        "(positive) pixels — drop dropped-out frames "
+                        "before fitting"
                     )
                 # Joins the camera-resolution check below (the [0, 1]
                 # range check does NOT apply — depth is in meters).

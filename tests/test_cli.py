@@ -351,6 +351,53 @@ def test_fit_camera_k(tmp_path, capsys):
     assert "width/height must be > 0" in capsys.readouterr().err
 
 
+def test_fit_depth_term(tmp_path, capsys):
+    """--data-term depth: sensor depth .npy through the default pinhole."""
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+    from mano_hand_tpu.viz.camera import default_hand_camera
+    from mano_hand_tpu.viz.silhouette import soft_depth
+
+    p32 = synthetic_params(seed=0).astype(np.float32)
+    cam = default_hand_camera()
+    true_t = jnp.asarray([0.02, 0.01, 0.02], jnp.float32)
+    gt = core.forward(p32)
+    depth = np.array(soft_depth(gt.verts + true_t, p32.faces, cam,
+                                height=32, width=32, sigma=1.0))
+    depth[depth > 5.0] = 0.0             # sensor holes
+    np.save(tmp_path / "depth.npy", depth.astype(np.float32))
+    out = tmp_path / "fit.npz"
+    rc = cli.main([
+        "fit", str(tmp_path / "depth.npy"), "--data-term", "depth",
+        "--steps", "250", "--out", str(out),
+    ])
+    assert rc == 0
+    ckpt = np.load(out)
+    err = np.linalg.norm(ckpt["trans"] - np.asarray(true_t))
+    assert err < 0.01, ckpt["trans"]     # full 3D, z included
+
+    # Guard rails.
+    np.save(tmp_path / "zero.npy", np.zeros((16, 16), np.float32))
+    rc = cli.main(["fit", str(tmp_path / "zero.npy"),
+                   "--data-term", "depth"])
+    assert rc == 2
+    assert "no valid (positive) pixels" in capsys.readouterr().err
+    rc = cli.main(["fit", str(tmp_path / "depth.npy"),
+                   "--data-term", "depth", "--camera-scale", "3.0"])
+    assert rc == 2
+    assert "weak-perspective" in capsys.readouterr().err
+    rc = cli.main(["fit", str(tmp_path / "depth.npy"),
+                   "--data-term", "depth", "--solver", "lm"])
+    assert rc == 2
+    assert "requires --solver adam" in capsys.readouterr().err
+    rc = cli.main(["fit", str(tmp_path / "depth.npy"),
+                   "--data-term", "depth", "--focal", "3.0"])
+    assert rc == 2
+    assert "--camera-eye/--focal apply to keypoints2d" in \
+        capsys.readouterr().err
+
+
 def test_fit_heatmap(tmp_path, capsys):
     import jax.numpy as jnp
 
@@ -464,7 +511,7 @@ def test_fit_subcommand_silhouette(tmp_path, capsys):
     rc = cli.main(["fit", str(tmp_path / "scan.ply"),
                    "--data-term", "silhouette"])
     assert rc == 2
-    assert "geometry, not a mask" in capsys.readouterr().err
+    assert "geometry, not an image" in capsys.readouterr().err
     # Empty masks would save the init as a "successful" zero-loss fit.
     np.save(tmp_path / "empty.npy", np.zeros((0, 32), np.float32))
     rc = cli.main(["fit", str(tmp_path / "empty.npy"),

@@ -206,6 +206,33 @@ class TestDepthFitting:
         assert err < 0.01, np.asarray(res.trans)
         assert abs(float(res.trans[2] - true_t[2])) < 0.01   # z itself
 
+    def test_depth_sequence_and_tracking(self):
+        # The clip solver and streaming tracker take depth frames with
+        # no extra plumbing (the shared _data_loss dispatch).
+        from mano_hand_tpu.viz.silhouette import soft_depth
+
+        small = synthetic_params(seed=3, n_verts=64, n_faces=96,
+                                 dtype=np.float32)
+        cam = viz.camera.default_hand_camera()
+        gt = core.forward(small)
+        frames = jnp.stack([
+            soft_depth(gt.verts + jnp.asarray([0.01 * t, 0.0, 0.01 * t]),
+                       small.faces, cam, height=16, width=16, sigma=1.0)
+            for t in range(3)
+        ])
+        res = fitting.fit_sequence(
+            small, frames, n_steps=3, data_term="depth", camera=cam,
+            fit_trans=True,
+        )
+        assert res.pose.shape == (3, 16, 3)
+        assert np.isfinite(np.asarray(res.final_loss)).all()
+        state, step = fitting.make_tracker(
+            small, n_steps=3, data_term="depth", camera=cam,
+            fit_trans=True, sil_sigma=1.0,
+        )
+        state, out = step(state, frames[0])
+        assert np.isfinite(np.asarray(out.final_loss)).all()
+
     def test_depth_validation(self):
         small = synthetic_params(seed=3, n_verts=64, n_faces=96,
                                  dtype=np.float32)
@@ -222,6 +249,20 @@ class TestDepthFitting:
         with pytest.raises(ValueError, match="only supported for"):
             fitting.fit(small, jnp.ones((2, 16, 16)), data_term="depth",
                         camera=(cam, cam), n_steps=2)
+        # Weak perspective has no depth axis: a meters target against
+        # its rotation-only z column is a meaningless residual.
+        wcam = viz.WeakPerspectiveCamera(
+            rot=jnp.eye(3, dtype=jnp.float32), scale=3.0
+        )
+        with pytest.raises(ValueError, match="no depth axis"):
+            fitting.fit(small, jnp.ones((16, 16)), data_term="depth",
+                        camera=wcam, n_steps=2)
+        # Per-image dropout: one all-invalid frame in a clip would fit
+        # to nothing and report its init as converged.
+        frames = jnp.ones((3, 16, 16)).at[1].set(0.0)
+        with pytest.raises(ValueError, match="image\\(s\\) with no valid"):
+            fitting.fit_sequence(small, frames, data_term="depth",
+                                 camera=cam, n_steps=2)
         # Huber composes (sensor depth is heavy-tailed at boundaries).
         res = fitting.fit(small, jnp.ones((16, 16)), data_term="depth",
                           camera=cam, n_steps=2, robust="huber",
