@@ -881,8 +881,10 @@ def run_benchmarks(args, device_str: str) -> dict:
         # rate (16 renders fwd+bwd per Adam step). [P, F] pair slabs are
         # row-chunked inside the renderer, so one render is 8 dense
         # [512, F] distance blocks — VPU work, not MXU.
-        from mano_hand_tpu.viz.camera import WeakPerspectiveCamera
-        from mano_hand_tpu.viz.silhouette import soft_silhouette
+        from mano_hand_tpu.viz.camera import (
+            WeakPerspectiveCamera, default_hand_camera,
+        )
+        from mano_hand_tpu.viz.silhouette import soft_depth, soft_silhouette
 
         b6, hw = 16, args.sil_size
         cam = WeakPerspectiveCamera(rot=jnp.eye(3, dtype=jnp.float32),
@@ -904,6 +906,21 @@ def run_benchmarks(args, device_str: str) -> dict:
         results["config6_sil_renders_per_sec"] = b6 / t_render
         log(f"config6 soft silhouette {hw}x{hw} (batch {b6} incl. "
             f"forward): {b6 / t_render:,.0f} renders/s")
+
+        pin = default_hand_camera()          # depth needs a real projection
+        depth_sum = loop_scalar(
+            lambda prm, p, s: soft_depth(
+                core.forward_batched(prm, p, s).verts, prm.faces, pin,
+                height=hw, width=hw,
+            ).sum()
+        )
+        t_depth = slope_time(
+            lambda m: looped(depth_sum, m, right, pose6, beta6),
+            1, 3, iters=max(2, args.iters // 3),
+        )
+        results["config6_depth_renders_per_sec"] = b6 / t_depth
+        log(f"config6 soft depth {hw}x{hw} (batch {b6} incl. forward): "
+            f"{b6 / t_depth:,.0f} renders/s")
 
         if args.skip_fit:
             return

@@ -71,6 +71,7 @@ def test_bench_cpu_tiny_run_end_to_end():
     d = line["detail"]
     for key in ("config2_b1024_evals_per_sec", "config3_b65536_evals_per_sec",
                 "config5_seq240_ms", "flops_per_eval", "achieved_gflops",
-                "config1_zero_pose_max_err", "config6_sil_renders_per_sec"):
+                "config1_zero_pose_max_err", "config6_sil_renders_per_sec",
+                "config6_depth_renders_per_sec"):
         assert key in d, f"missing {key}: {sorted(d)}"
     assert "config_errors" not in line, line.get("config_errors")
