@@ -38,6 +38,7 @@ def test_example_runs(script, tmp_path):
         "13_mask_supervised_training": ["--steps", "200", "--batch", "12",
                                         "--size", "20"],
         "14_dataset_calibration": ["--steps", "200", "--size", "40"],
+        "15_depth_fitting": ["--steps", "200", "--size", "24"],
     }.get(script.stem, [])
     out = _run(script, *extra, tmp_path=tmp_path)
     assert any(k in out for k in ("wrote", "fit", "tracked", "fused kernel",
