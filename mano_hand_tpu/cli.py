@@ -918,9 +918,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "silhouette camera (silhouette only; "
                         "default 0,0,0)")
     f.add_argument("--sil-sigma", type=float, default=None,
-                   help="silhouette edge softness in pixels (default "
-                        "1.0 — about right; larger blurs the optimum "
-                        "itself, measured in docs/roadmap.md)")
+                   help="rasterizer edge softness in pixels for the "
+                        "silhouette/depth terms (default 1.0 — about "
+                        "right; larger blurs the optimum itself, "
+                        "measured in docs/roadmap.md)")
     f.add_argument("--pose-prior", default="l2",
                    choices=["l2", "mahalanobis"],
                    help="pose regularizer: isotropic L2 toward zero, or "
@@ -929,13 +930,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(adam solver, aa/pca pose spaces)")
     f.add_argument("--pose-prior-weight", type=float, default=None,
                    help="pose prior weight (default: 1e-4 for "
-                        "keypoints2d, 1.0 for silhouette — an outline "
-                        "cannot pin articulation, 1e-3 for --pose-prior "
-                        "mahalanobis, else 0)")
+                        "keypoints2d, 1.0 for silhouette/depth — a "
+                        "single image cannot pin articulation, 1e-3 for "
+                        "--pose-prior mahalanobis, else 0)")
     f.add_argument("--shape-prior", type=float, default=None,
                    help="shape regularizer. adam: L2 prior weight (default "
-                        "0 for verts, 1.0 for silhouette, 1e-3 for "
-                        "joints/keypoints2d). lm "
+                        "0 for verts, 1.0 for silhouette/depth, 1e-3 "
+                        "for joints/keypoints2d). lm "
                         "with joints: Tikhonov residual-ROW weight, which "
                         "enters the least-squares loss SQUARED (default "
                         "0.1) — not numerically comparable to the adam "
@@ -944,15 +945,16 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--side", default=None, choices=[None, "left", "right"])
     f.add_argument("--solver", default=None, choices=["lm", "adam"],
                    help="default: lm for --data-term verts/point_to_plane, "
-                        "adam for joints/keypoints2d/points/silhouette; "
-                        "lm also supports joints and points (second-order "
-                        "ICP); keypoints2d/silhouette are adam-only, "
-                        "point_to_plane lm-only")
+                        "adam for joints/keypoints2d/points/silhouette/"
+                        "depth; lm also supports joints and points "
+                        "(second-order ICP); keypoints2d/silhouette/depth "
+                        "are adam-only, point_to_plane lm-only")
     f.add_argument("--steps", type=int, default=None,
                    help="default: 25 (lm) / 200 (adam)")
     f.add_argument("--lr", type=float, default=None,
                    help="adam learning rate (default 0.05; 0.02 for "
-                        "keypoints2d, 0.01 for silhouette; adam only)")
+                        "keypoints2d, 0.01 for silhouette/depth; "
+                        "adam only)")
     f.add_argument("--out", default="fit.npz")
     f.add_argument("--heatmap", default=None,
                    help="also render the fitted mesh with per-vertex "
