@@ -228,6 +228,36 @@ def test_intrinsics_camera_pixel_exact():
                     camera=cam, n_steps=2)
 
 
+def test_intrinsics_camera_render_alignment(params32):
+    """render_mesh through a calibration: the hand's rendered centroid
+    lands where the projection says it should — including an off-center
+    principal point (real calibrations never sit exactly at W/2)."""
+    from mano_hand_tpu.models import core
+    from mano_hand_tpu.viz.camera import from_intrinsics
+
+    out = core.jit_forward(params32, jnp.zeros((16, 3)), jnp.zeros(10))
+    # Framed so the WHOLE hand stays on-image (off-frame clipping would
+    # decouple the rendered centroid from the mean projected vertex).
+    K = np.array([[100.0, 0, 40.0], [0, 100.0, 40.0], [0, 0, 1]])
+    cam = from_intrinsics(K, width=96, height=96, trans=(0.0, 0.0, 0.55))
+    img = np.asarray(viz.render_mesh(
+        np.asarray(out.verts), np.asarray(params32.faces), cam,
+        height=96, width=96,
+    ))
+    covered = np.abs(img - 1.0).max(-1) > 1e-3          # non-background
+    assert 0.01 < covered.mean() < 0.9
+    cy, cx = np.argwhere(covered).mean(0)
+    # Predicted centroid: mean projected vertex, in raster coords
+    # (u + 0.5 — the half-pixel convention the camera handles).
+    uv = np.asarray(cam.ndc_to_pixels(cam.project(out.verts)[..., :2]))
+    assert uv.min() > 1.0 and uv.max() < 95.0           # fully in frame
+    pu, pv = uv.mean(0) + 0.5
+    assert abs(cx - pu) < 3.0 and abs(cy - pv) < 3.0, (cx, cy, pu, pv)
+    # The principal point (40, 40) is off-center in the 96px image, so
+    # the hand must NOT render centered.
+    assert cx < 46.0
+
+
 def test_intrinsics_camera_fit_pixel_keypoints(params32):
     # The dataset workflow: pixel keypoints + K matrix -> convert once
     # with pixels_to_ndc -> fit as usual; translation recovered.
