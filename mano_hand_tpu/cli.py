@@ -730,9 +730,17 @@ def cmd_fit(args) -> int:
         errs = jnp.linalg.norm(
             fitted - jnp.asarray(targets, jnp.float32), axis=-1
         )
-        img = render_mesh(fitted, params.faces,
-                          vertex_colors=error_colormap(errs))
-        write_png(np.asarray(img), args.heatmap)
+        colors = error_colormap(errs)
+        if str(args.heatmap).lower().endswith(".glb"):
+            # A 3D-inspectable heatmap: the fitted mesh with COLOR_0
+            # vertex colors, orbitable in any glTF viewer.
+            from mano_hand_tpu.io.gltf import export_glb
+
+            export_glb(np.asarray(fitted), np.asarray(params.faces),
+                       args.heatmap, vertex_colors=np.asarray(colors))
+        else:
+            img = render_mesh(fitted, params.faces, vertex_colors=colors)
+            write_png(np.asarray(img), args.heatmap)
         print(f"error heatmap (max {float(errs.max()) * 1e3:.2f} mm) -> "
               f"{args.heatmap}")
     return 0
@@ -957,8 +965,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "adam only)")
     f.add_argument("--out", default="fit.npz")
     f.add_argument("--heatmap", default=None,
-                   help="also render the fitted mesh with per-vertex "
-                        "error colors (blue=0 -> red=max) to this PNG "
+                   help="also export the fitted mesh with per-vertex "
+                        "error colors (blue=0 -> red=max): a rendered "
+                        "PNG, or with a .glb extension a 3D mesh with "
+                        "COLOR_0 vertex colors any glTF viewer can orbit "
                         "(--data-term verts, single target)")
     f.set_defaults(fn=cmd_fit)
 

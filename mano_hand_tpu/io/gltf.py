@@ -38,6 +38,7 @@ def export_glb(
     normals: Optional[np.ndarray] = None,   # [V, 3]; computed if None
     morph_frames: Optional[Sequence[np.ndarray]] = None,  # T x [V, 3]
     fps: float = 30.0,
+    vertex_colors: Optional[np.ndarray] = None,  # [V, 3] RGB in [0, 1]
 ) -> str:
     """Write a mesh (optionally an animated clip) as a GLB file.
 
@@ -46,7 +47,10 @@ def export_glb(
     mesh) driven by a step-less linear weight animation at ``fps`` —
     exactly one target active per frame time. Viewers play it directly;
     the data path is the same `[T, V, 3]` array `fit_sequence` or
-    `evaluate_sequence` produce. Returns the path.
+    `evaluate_sequence` produce. ``vertex_colors`` writes a float
+    ``COLOR_0`` attribute — e.g. ``viz.error_colormap`` output, making a
+    fit-error heatmap inspectable as a 3D object in any glTF viewer
+    (``cli fit --heatmap err.glb``). Returns the path.
     """
     verts = np.asarray(verts, np.float32)
     faces = np.asarray(faces, np.uint32)
@@ -57,6 +61,13 @@ def export_glb(
     if normals is None:
         normals = _vertex_normals_np(verts, faces)
     normals = np.asarray(normals, np.float32)
+    if vertex_colors is not None:
+        vertex_colors = np.asarray(vertex_colors, np.float32)
+        if vertex_colors.shape != verts.shape:
+            raise ValueError(
+                f"vertex_colors must be [V, 3] matching verts, got "
+                f"{vertex_colors.shape}"
+            )
 
     buffers: list[bytes] = []
     views = []
@@ -93,6 +104,9 @@ def export_glb(
         "indices": a_idx,
         "mode": 4,  # TRIANGLES
     }
+    if vertex_colors is not None:
+        primitive["attributes"]["COLOR_0"] = add(vertex_colors,
+                                                 target=34962)
     gltf = {
         "asset": {"version": "2.0", "generator": "mano_hand_tpu"},
         "scene": 0,

@@ -64,6 +64,56 @@ def test_static_glb_roundtrip(params32, tmp_path):
     np.testing.assert_allclose(np.linalg.norm(nrm, axis=-1), 1.0, atol=1e-4)
 
 
+def test_glb_vertex_colors(params32, tmp_path):
+    """COLOR_0 round-trips byte-exact — the 3D heatmap export path."""
+    verts, faces = _mesh(params32)
+    colors = np.random.default_rng(0).random((verts.shape[0], 3)).astype(
+        np.float32
+    )
+    path = tmp_path / "colored.glb"
+    export_glb(verts, faces, path, vertex_colors=colors)
+    glb = read_glb(path)
+    g = glb["gltf"]
+    prim = g["meshes"][0]["primitives"][0]
+    a = g["accessors"][prim["attributes"]["COLOR_0"]]
+    assert a["count"] == verts.shape[0] and a["type"] == "VEC3"
+    view = g["bufferViews"][a["bufferView"]]
+    raw = glb["bin"][view["byteOffset"]:view["byteOffset"]
+                     + view["byteLength"]]
+    np.testing.assert_array_equal(
+        np.frombuffer(raw, np.float32).reshape(-1, 3), colors
+    )
+    with pytest.raises(ValueError, match="vertex_colors must be"):
+        export_glb(verts, faces, path, vertex_colors=colors[:5])
+    # Plain exports carry no COLOR_0 (viewers would tint the mesh black
+    # if an all-zero attribute slipped in).
+    export_glb(verts, faces, path)
+    prim = read_glb(path)["gltf"]["meshes"][0]["primitives"][0]
+    assert "COLOR_0" not in prim["attributes"]
+
+
+def test_cli_fit_heatmap_glb(params32, tmp_path, capsys):
+    import jax.numpy as jnp
+
+    from mano_hand_tpu import cli
+    from mano_hand_tpu.models import core
+
+    pose = np.random.default_rng(4).normal(
+        scale=0.2, size=(16, 3)
+    ).astype(np.float32)
+    targets = np.asarray(core.forward(params32, jnp.asarray(pose)).verts)
+    np.save(tmp_path / "t.npy", targets)
+    glb_path = tmp_path / "err.glb"
+    rc = cli.main([
+        "fit", str(tmp_path / "t.npy"), "--solver", "lm", "--steps", "8",
+        "--out", str(tmp_path / "f.npz"), "--heatmap", str(glb_path),
+    ])
+    assert rc == 0
+    assert "error heatmap" in capsys.readouterr().out
+    prim = read_glb(glb_path)["gltf"]["meshes"][0]["primitives"][0]
+    assert "COLOR_0" in prim["attributes"]
+
+
 def test_animated_glb(params32, tmp_path):
     rng = np.random.default_rng(1)
     poses = jnp.asarray(rng.normal(scale=0.2, size=(4, 16, 3)), jnp.float32)
