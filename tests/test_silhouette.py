@@ -588,6 +588,17 @@ class TestSilhouetteFitting:
             data_term="silhouette", camera=(cam, cam), fit_trans=True,
         )
         assert best2.pose.shape == (16, 3)
+        # Depth restarts ride the same [H, W] single-problem path.
+        from mano_hand_tpu.viz.silhouette import soft_depth
+
+        pin = viz.camera.default_hand_camera()
+        dimg = soft_depth(gt.verts, small.faces, pin, height=16, width=16)
+        best3, losses3 = fitting.fit_restarts(
+            small, dimg, n_restarts=2, n_steps=3,
+            data_term="depth", camera=pin, fit_trans=True,
+        )
+        assert best3.pose.shape == (16, 3)
+        assert np.isfinite(np.asarray(losses3)).all()
 
     def test_keypoints_plus_mask(self, small):
         # The classic tracking energy: 2D keypoints pin the skeleton,
