@@ -137,6 +137,44 @@ class MANOModel:
         export_ply(self.verts, self.faces, path,
                    normals=normals, binary=binary)
 
+    def fit(self, target, solver: str = "adam", **solver_kw):
+        """Recover pose/shape from a target and ADOPT the solution.
+
+        The stateful counterpart of ``fitting.fit``/``fitting.fit_lm``:
+        one call fits a SINGLE problem (this wrapper holds one hand's
+        state), writes the recovered pose/shape into the model, runs
+        ``update()``, and returns the solver result. Any library data
+        term and option passes through ``solver_kw`` (data_term, camera,
+        priors, ...). ``fit_trans`` is refused — the wrapper, like the
+        reference, keeps the hand origin-centered and has no translation
+        state; use the functional API when fitting placement.
+        """
+        from mano_hand_tpu import fitting
+
+        if solver not in ("adam", "lm"):
+            raise ValueError(f"solver must be 'adam' or 'lm', got {solver!r}")
+        if solver_kw.get("fit_trans"):
+            raise ValueError(
+                "MANOModel.fit has no translation state (the wrapper is "
+                "origin-centered like the reference); use fitting.fit("
+                "..., fit_trans=True) directly"
+            )
+        # An explicit fit_trans=False means "off" — drop it rather than
+        # leak a kwarg fit_lm's signature does not have.
+        solver_kw.pop("fit_trans", None)
+        fn = fitting.fit if solver == "adam" else fitting.fit_lm
+        res = fn(self._params_jax, target, **solver_kw)
+        if np.asarray(res.pose).ndim != 2:
+            raise ValueError(
+                "MANOModel.fit adopts ONE solution; batched targets "
+                f"produced pose shape {np.asarray(res.pose).shape} — use "
+                "fitting.fit for batches"
+            )
+        self.pose = np.asarray(res.pose, dtype=np.float64)
+        self.shape = np.asarray(res.shape, dtype=np.float64)
+        self.update()
+        return res
+
     def keypoints(self, tip_vertex_ids=None, order: str = "mano"):
         """Current-state keypoints [16(+T), 3] (float64 numpy).
 

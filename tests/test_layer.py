@@ -118,6 +118,39 @@ def test_call_batched_pca(params):
         m(pose_pca=pca, backend="np")
 
 
+def test_model_fit_adopts_solution(params):
+    """MANOModel.fit recovers from a target and updates the wrapper's
+    state in place — the stateful 'inverse set_params'."""
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models.layer import MANOModel
+
+    rng = np.random.default_rng(11)
+    true_pose = rng.normal(scale=0.25, size=(16, 3))
+    source = MANOModel(params, backend="jax")
+    target = source.set_params(pose_abs=true_pose)
+
+    model = MANOModel(params, backend="jax")
+    res = model.fit(jnp.asarray(target, jnp.float32), solver="lm",
+                    n_steps=15)
+    # The wrapper's state now IS the solution: verts match the target.
+    np.testing.assert_allclose(model.verts, target, atol=1e-3)
+    np.testing.assert_allclose(model.pose, true_pose, atol=1e-3)
+    assert np.asarray(res.final_loss).shape == ()
+
+    with pytest.raises(ValueError, match="no translation state"):
+        model.fit(jnp.asarray(target, jnp.float32), fit_trans=True)
+    # An explicit fit_trans=False is simply "off" — including for LM,
+    # whose signature has no such kwarg.
+    model.fit(jnp.asarray(target, jnp.float32), solver="lm", n_steps=2,
+              fit_trans=False)
+    with pytest.raises(ValueError, match="use fitting.fit for batches"):
+        model.fit(jnp.asarray(np.stack([target] * 2), jnp.float32),
+                  solver="lm", n_steps=2)
+    with pytest.raises(ValueError, match="solver must be"):
+        model.fit(jnp.asarray(target, jnp.float32), solver="bfgs")
+
+
 def test_export_obj(model, tmp_path):
     rng = np.random.default_rng(5)
     model.set_params(pose_abs=rng.normal(scale=0.3, size=(16, 3)))
