@@ -1,8 +1,9 @@
 # Pre-commit gate: `make check` MUST pass (full suite incl. the golden demo
 # fixture on the virtual 8-device CPU mesh) before any snapshot commit.
 #
-# Wall time on this box (1 CPU core): ~11 min warm (~367 tests late in
-# round 3; cold adds the one-off compile time). The suite is
+# Wall time on this box (1 CPU core): ~12-17 min warm depending on
+# background load (378 tests at round-3 end; cold adds the one-off
+# compile time). The suite is
 # compile-bound; tests/conftest.py keeps a persistent XLA compilation
 # cache in .jax_compile_cache/ (gitignored), so every run after the
 # first skips recompilation of unchanged programs, and clears the
