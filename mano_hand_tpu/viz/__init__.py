@@ -1,13 +1,17 @@
-"""TPU-native visualization: a pure-JAX mesh rasterizer.
+"""TPU-native visualization: pure-JAX rasterizers, hard and soft.
 
 The reference's visualization (C11, /root/reference/data_explore.py:1-18)
 depends on an external OpenGL viewer (vctoolkit + transforms3d) to render
 scan-pose animations to AVI. This subsystem replaces that with a
-dependency-free, jittable software renderer: camera transforms, a z-buffer
-triangle rasterizer with Lambert shading, and pure-Python PNG/GIF/AVI
-writers — so `cli render` produces shaded hand images, animations, and
-actual video files on any host, and whole animation clips render as one
-batched XLA program on TPU.
+dependency-free, jittable software renderer — camera transforms
+(pinhole, weak-perspective, and dataset K-matrix calibrations), a
+z-buffer triangle rasterizer with Lambert shading and per-vertex colors
+(fit-error heatmaps via ``error_colormap``), and pure-Python PNG/GIF/AVI
+writers — plus the DIFFERENTIABLE renders the fitting subsystem
+consumes: SoftRas-style soft silhouettes and a soft z-buffer depth
+renderer, sharing the hard rasterizer's exact NDC→pixel mapping so
+masks, depth maps, and shaded renders all line up pixel-for-pixel.
+Whole animation clips render as one batched XLA program on TPU.
 """
 
 from mano_hand_tpu.viz.camera import (
