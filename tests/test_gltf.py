@@ -92,6 +92,26 @@ def test_glb_vertex_colors(params32, tmp_path):
     assert "COLOR_0" not in prim["attributes"]
 
 
+def test_glb_colors_compose_with_animation(params32, tmp_path):
+    """COLOR_0 + morph targets in one file: an animated clip whose
+    constant per-vertex colors (e.g. a part or error map) ride along —
+    morph targets displace POSITION only, so the combination is valid
+    glTF and both attributes survive."""
+    verts, faces = _mesh(params32)
+    colors = np.tile(np.asarray([[0.2, 0.5, 0.9]], np.float32),
+                     (verts.shape[0], 1))
+    frames = [verts, verts + 0.01]
+    path = tmp_path / "anim_colored.glb"
+    export_glb(verts, faces, path, morph_frames=frames,
+               vertex_colors=colors)
+    g = read_glb(path)["gltf"]
+    prim = g["meshes"][0]["primitives"][0]
+    assert "COLOR_0" in prim["attributes"]
+    assert len(prim["targets"]) == 2
+    assert all(set(t) == {"POSITION"} for t in prim["targets"])
+    assert g["animations"][0]["channels"][0]["target"]["path"] == "weights"
+
+
 def test_cli_fit_heatmap_glb(params32, tmp_path, capsys):
     import jax.numpy as jnp
 
