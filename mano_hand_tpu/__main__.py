@@ -1,0 +1,8 @@
+"""``python -m mano_hand_tpu`` — the CLI entry point (see cli.py)."""
+
+import sys
+
+from mano_hand_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -521,6 +521,16 @@ def cmd_fit(args) -> int:
                       "(the IoU is already bounded per image)",
                       file=sys.stderr)
                 return 2
+            if args.camera_eye is not None or args.focal is not None:
+                # Refuse rather than silently drop (same contract as the
+                # depth branch): these pinhole flags LOOK applicable but
+                # the silhouette camera is weak-perspective
+                # (--camera-scale/--camera-rot) or --camera-k only.
+                print("--camera-eye/--focal apply to keypoints2d; "
+                      "--data-term silhouette uses a weak-perspective "
+                      "camera (--camera-scale/--camera-rot) or --camera-k",
+                      file=sys.stderr)
+                return 2
             if intr_cam is not None:
                 if args.camera_scale is not None or args.camera_rot:
                     print("--camera-scale/--camera-rot conflict with "
@@ -783,6 +793,33 @@ def cmd_info(args) -> int:
     return 0
 
 
+def cmd_verify(args) -> int:
+    # Truth anchor for user-supplied (license-gated) official pickles:
+    # the loaders can only be tested on synthetic replicas in-repo, so
+    # the decoded asset is audited at the user's machine instead —
+    # structural gates, numeric invariants, and canonical digests
+    # (assets/verify.py has the full contract).
+    from mano_hand_tpu.assets.verify import format_report, report_json, \
+        verify_asset
+
+    try:
+        report = verify_asset(args.asset, side=args.side,
+                              golden=args.golden)
+    except Exception as e:  # noqa: BLE001 — decode failures ARE the verdict
+        print(f"verify: {args.asset} failed to decode as a MANO asset: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(report_json(report, expect=args.expect))
+        ok = report.gates_ok and (
+            args.expect is None
+            or report.digests["combined"] == args.expect)
+        return 0 if ok else 1
+    text, rc = format_report(report, args.asset, expect=args.expect)
+    print(text)
+    return rc
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="mano_hand_tpu", description=__doc__)
     p.add_argument(
@@ -994,6 +1031,23 @@ def build_parser() -> argparse.ArgumentParser:
     i.add_argument("--asset", default="synthetic")
     i.add_argument("--side", default=None, choices=[None, "left", "right"])
     i.set_defaults(fn=cmd_info)
+
+    v = sub.add_parser(
+        "verify",
+        help="audit a MANO asset (official .pkl/.npz) against the public "
+             "structural facts + numeric invariants; print canonical "
+             "digests")
+    v.add_argument("asset", help="asset path (.pkl official/dumped, .npz)")
+    v.add_argument("--side", default=None, choices=[None, "left", "right"])
+    v.add_argument("--golden", default=None,
+                   help="second asset to diff numerically (e.g. the .npz "
+                        "converted from a known-good pickle)")
+    v.add_argument("--expect", default=None,
+                   help="expected combined sha256 (pin a verified digest "
+                        "in CI)")
+    v.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    v.set_defaults(fn=cmd_verify)
     return p
 
 

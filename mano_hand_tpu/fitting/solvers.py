@@ -294,6 +294,18 @@ def validate_mask_target(fn):
                     f"range [{float(t.min()):g}, {float(t.max()):g}] "
                     "— divide a 0/255 uint8 mask by 255"
                 )
+        if is_sil or is_depth:
+            # Image targets need at least [H, W]: name the shape error
+            # here, before an axis=(-2,-1) reduction or a shape[-2]
+            # lookup can raise a bare AxisError/IndexError downstream.
+            d = bound.arguments.get(target_name)
+            if d is not None and not isinstance(d, jax.core.Tracer):
+                if np.asarray(d).ndim < 2:
+                    raise ValueError(
+                        f"data_term='{data_term}' targets must be image-"
+                        f"shaped [..., H, W]; got shape "
+                        f"{np.asarray(d).shape}"
+                    )
         if is_depth:
             d = bound.arguments.get(target_name)
             if d is not None and not isinstance(d, jax.core.Tracer):
@@ -302,6 +314,8 @@ def validate_mask_target(fn):
                 # batch/clip (sensor dropout) would contribute zero
                 # gradients and report its untouched init as a converged
                 # fit.
+                # (t.ndim >= 2 is guaranteed: the image-shape gate above
+                # raised the named error for anything lower.)
                 if t.size and not (t > 0).any(axis=(-2, -1)).all():
                     raise ValueError(
                         "depth target has image(s) with no valid "

@@ -81,4 +81,9 @@ def make_fit_step(
         return FitState(fit_params["pose"], fit_params["shape"], opt_state), loss
 
     # Params ride as a jit argument, not a captured constant (axon dispatch).
-    return lambda state, targets: step(params, state, targets)
+    wrapper = lambda state, targets: step(params, state, targets)  # noqa: E731
+    # AOT introspection hooks (bench.py's mesh scaling table lowers the
+    # step to count collectives without running it).
+    wrapper.jitted = step
+    wrapper.bound_params = params
+    return wrapper

@@ -1,0 +1,136 @@
+"""Cooperative single-device lock for benchmark runs.
+
+The axon tunnel exposes ONE TPU chip; two benchmark processes contending
+for it (or for the single host CPU core) corrupt each other's timings —
+round 3's driver bench probed 8x into a tunnel outage while a leftover
+builder retry pipeline was still polling the same device (VERDICT.md
+"What's weak" #1). This module makes contention impossible by
+construction:
+
+- every bench acquires an exclusive ``flock`` on ``LOCK_PATH`` before
+  touching the backend;
+- a *driver* bench (the authoritative end-of-round run) additionally
+  writes a priority-claim file for its whole lifetime. Builder-side
+  retry loops poll that file and STAND DOWN while it is fresh, so the
+  driver never queues behind an hours-long builder loop;
+- a *builder* bench never waits: if the lock is held it exits
+  immediately (its wrapper loop retries later, see
+  ``scripts/bench_tpu_wait.sh`` — which is itself deadline-bounded, so
+  no retry loop outlives its usefulness).
+
+The lock is advisory: a driver that cannot get it within ``wait_s``
+proceeds anyway (logging loudly) — worst case equals today's behavior;
+it must never turn a flaky lockfile into a missing BENCH_r{N}.json.
+
+Shell-side counterpart: a claim is "fresh" when the file exists and its
+mtime is younger than ``CLAIM_FRESH_S`` (stale claims from crashed
+drivers must not wedge builders forever).
+"""
+
+from __future__ import annotations
+
+import errno
+import fcntl
+import json
+import os
+import time
+
+# MANO_DEVICE_LOCK_DIR redirects both files (tests isolate themselves so
+# a CI bench subprocess never queues behind a real builder pipeline).
+_LOCK_DIR = os.environ.get("MANO_DEVICE_LOCK_DIR", "/tmp")
+LOCK_PATH = os.path.join(_LOCK_DIR, "mano_tpu_device.lock")
+CLAIM_PATH = os.path.join(_LOCK_DIR, "mano_tpu_device.priority")
+CLAIM_FRESH_S = 2.0 * 3600.0
+
+
+class DeviceBusy(RuntimeError):
+    """A builder-role bench found the device lock held (stand down)."""
+
+
+def _claim_age_s() -> float | None:
+    try:
+        return time.time() - os.stat(CLAIM_PATH).st_mtime
+    except OSError:
+        return None
+
+
+def priority_claim_active() -> bool:
+    """True while a driver bench holds (or recently held) its claim."""
+    age = _claim_age_s()
+    return age is not None and age < CLAIM_FRESH_S
+
+
+class DeviceLock:
+    """``with DeviceLock(role, ...):`` around any device-touching bench.
+
+    role="driver": writes the priority claim, waits up to ``wait_s`` for
+    the flock (refreshing the claim so builders keep standing down),
+    then proceeds with or without it.
+    role="builder": raises DeviceBusy if a fresh driver claim exists or
+    the flock is held — never waits, never blocks a driver.
+    """
+
+    def __init__(self, role: str = "driver", wait_s: float = 1200.0,
+                 log=lambda m: None):
+        if role not in ("driver", "builder"):
+            raise ValueError(f"unknown role {role!r}")
+        self.role = role
+        self.wait_s = wait_s
+        self.log = log
+        self._fd = None
+        self._locked = False
+        self._claimed = False
+
+    def _write_claim(self) -> None:
+        tmp = f"{CLAIM_PATH}.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"pid": os.getpid(), "t": time.time()}, f)
+        os.replace(tmp, CLAIM_PATH)
+        self._claimed = True
+
+    def __enter__(self) -> "DeviceLock":
+        if self.role == "builder" and priority_claim_active():
+            raise DeviceBusy(
+                f"driver priority claim at {CLAIM_PATH} is fresh "
+                f"(age {_claim_age_s():.0f}s) — builder stands down")
+        if self.role == "driver":
+            self._write_claim()
+        self._fd = open(LOCK_PATH, "w")
+        deadline = time.time() + self.wait_s
+        while True:
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                self._locked = True
+                self._fd.truncate(0)
+                self._fd.write(json.dumps(
+                    {"pid": os.getpid(), "role": self.role}))
+                self._fd.flush()
+                self.log(f"device lock acquired ({self.role})")
+                return self
+            except OSError as e:
+                if e.errno not in (errno.EAGAIN, errno.EACCES):
+                    raise
+            if self.role == "builder":
+                self._fd.close()
+                self._fd = None
+                raise DeviceBusy("device lock held by another bench — "
+                                 "builder stands down")
+            if time.time() >= deadline:
+                self.log(f"WARNING: device lock still held after "
+                         f"{self.wait_s:.0f}s wait — proceeding WITHOUT "
+                         "it (advisory); expect contention in timings")
+                return self
+            self._write_claim()  # refresh mtime: builders keep yielding
+            time.sleep(10.0)
+
+    def __exit__(self, *exc) -> None:
+        if self._fd is not None:
+            if self._locked:
+                fcntl.flock(self._fd, fcntl.LOCK_UN)
+            self._fd.close()
+            self._fd = None
+        if self._claimed:
+            try:
+                os.remove(CLAIM_PATH)
+            except OSError:
+                pass

@@ -134,7 +134,15 @@ class IntrinsicsCamera(NamedTuple):
 
     def ndc_to_pixels(self, xy: jnp.ndarray) -> jnp.ndarray:
         """Inverse of ``pixels_to_ndc`` (e.g. to draw fitted joints on
-        the dataset image, OpenCV convention)."""
+        the dataset image, OpenCV convention).
+
+        NOT the same mapping as ``viz.render.ndc_to_pixels``: this one
+        returns OpenCV pixel-CENTER coordinates (integer u lands on the
+        center of pixel u, hence the -0.5), while the render helper
+        returns raster coordinates where pixel u's center sits at u+0.5.
+        Use this for dataset/annotation space, the render one for
+        indexing into rendered images; mixing them shifts everything by
+        half a pixel."""
         xy = jnp.asarray(xy)
         return jnp.stack(
             [(xy[..., 0] + 1.0) * 0.5 * self.width - 0.5,

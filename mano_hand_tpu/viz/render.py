@@ -40,11 +40,17 @@ def _shade(
 
 
 def ndc_to_pixels(proj_xy: jnp.ndarray, height: int, width: int):
-    """NDC xy [..., 2] -> pixel coords [..., 2], y flipped so +y in world
+    """NDC xy [..., 2] -> RASTER coords [..., 2], y flipped so +y in world
     points up on screen. THE raster-space mapping — the hard renderer and
     the soft silhouette both use it, which is what guarantees that masks
     fitted via ``soft_silhouette`` line up pixel-for-pixel with
-    ``render_mesh`` output (pinned by a registration test)."""
+    ``render_mesh`` output (pinned by a registration test).
+
+    NOT the same mapping as ``IntrinsicsCamera.ndc_to_pixels``: raster
+    coordinates put pixel u's center at u+0.5, whereas the camera method
+    returns OpenCV pixel-center coordinates (center of pixel u at
+    integer u, half a pixel lower). Keep renders in this space and
+    dataset annotations in the camera's."""
     sx = (proj_xy[..., 0] * 0.5 + 0.5) * width
     sy = (1.0 - (proj_xy[..., 1] * 0.5 + 0.5)) * height
     return jnp.stack([sx, sy], axis=-1)

@@ -1,0 +1,59 @@
+#!/bin/bash
+# Builder-side unattended TPU bench: retry through tunnel outages WITHOUT
+# ever contending with the authoritative driver bench.
+#
+# Round-3 lesson (VERDICT.md "What's weak" #1): an infinite nohup retry
+# loop left running at judge time competed with the driver's end-of-round
+# bench for the single core and the tunnel. This replacement is safe to
+# leave running because it
+#   1. self-expires: hard DEADLINE (default 3 h) on the whole loop;
+#   2. stands down: before AND during each attempt it defers to a fresh
+#      driver priority claim (/tmp/mano_tpu_device.priority, written by
+#      `python bench.py` in its default driver role) — bench.py --role
+#      builder exits rc=2 immediately when the claim or flock is held;
+#   3. bounds each attempt: `timeout` around every bench.py call.
+#
+# Usage: scripts/bench_tpu_wait.sh [OUT_BASENAME] [DEADLINE_S]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-bench_tpu}"; [ $# -ge 1 ] && shift
+DEADLINE_S="${1:-10800}"; [ $# -ge 1 ] && shift
+ATTEMPT_TIMEOUT_S="${ATTEMPT_TIMEOUT_S:-3600}"
+# Same path resolution as mano_hand_tpu.utils.devicelock (honors the
+# test-isolation env var so wrapper and bench.py agree on the claim).
+CLAIM="${MANO_DEVICE_LOCK_DIR:-/tmp}/mano_tpu_device.priority"
+START=$(date +%s)
+
+claim_fresh() {
+  # mirrors mano_hand_tpu.utils.devicelock.CLAIM_FRESH_S = 2 h
+  [ -f "$CLAIM" ] && [ $(( $(date +%s) - $(stat -c %Y "$CLAIM") )) -lt 7200 ]
+}
+
+while true; do
+  now=$(date +%s)
+  if [ $(( now - START )) -ge "$DEADLINE_S" ]; then
+    echo "[bench-tpu-wait] deadline ${DEADLINE_S}s reached; giving up" >&2
+    exit 1
+  fi
+  if claim_fresh; then
+    echo "[bench-tpu-wait] driver claim fresh; standing down 120s" >&2
+    sleep 120
+    continue
+  fi
+  if timeout -k 60 "$ATTEMPT_TIMEOUT_S" \
+      python bench.py --role builder --pallas-sweep full \
+      --init-retries 8 --init-timeout 120 --init-budget 900 --iters 10 \
+      "$@" > "$OUT.out" 2>> "$OUT.log"; then
+    echo "[bench-tpu-wait] bench complete -> $OUT.out" >&2
+    cat "$OUT.out"
+    exit 0
+  fi
+  rc=$?
+  if [ "$rc" -eq 2 ]; then
+    echo "[bench-tpu-wait] device busy (driver running); standing down 120s" >&2
+    sleep 120
+  else
+    echo "[bench-tpu-wait] attempt failed rc=$rc; retrying in 180s" >&2
+    sleep 180
+  fi
+done

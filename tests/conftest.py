@@ -34,9 +34,13 @@ import jax  # noqa: E402
 # deterministic run to run — so the gate pays full compilation only on a
 # cold cache. Repo-local dir (gitignored) so `git clean`/fresh clones start
 # cold; VERDICT r2 item 5 records cold vs warm wall times in the Makefile.
+# MANO_TEST_CACHE_DIR override: two pytest processes must NEVER share one
+# cache dir (executable-deserialize crashes, diagnosed round 3) — an
+# ad-hoc run alongside the main suite points here at its own directory.
 jax.config.update(
     "jax_compilation_cache_dir",
-    os.path.join(_ROOT, ".jax_compile_cache"),
+    os.environ.get("MANO_TEST_CACHE_DIR",
+                   os.path.join(_ROOT, ".jax_compile_cache")),
 )
 # Cache EVERYTHING: the suite's long tail is hundreds of sub-second
 # compiles (the default 1s threshold would skip them all and leave ~5 of
@@ -53,6 +57,15 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 from mano_hand_tpu.assets import synthetic_pair, synthetic_params  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "quick: core-correctness tests for the seconds-scale pre-commit "
+        "lane (`make check-quick`); the full suite remains the snapshot "
+        "gate",
+    )
 
 
 @pytest.fixture(autouse=True, scope="module")

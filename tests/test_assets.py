@@ -181,3 +181,7 @@ def test_official_pickle_without_chumpy(params, tmp_path):
     # load_model sniffing must also land on the official branch.
     from mano_hand_tpu.assets import load_model as _lm
     assert _lm(path).side == C.RIGHT
+
+
+# Pre-commit quick lane: core correctness, seconds-scale (make check-quick).
+pytestmark = __import__("pytest").mark.quick

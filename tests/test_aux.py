@@ -377,3 +377,7 @@ def test_replicated_sharding_and_xla_trace(tmp_path):
         jax.block_until_ready(jnp.ones(8) * 2)
     # The profiler writes a plugins/profile tree under the log dir.
     assert any((tmp_path / "trace").rglob("*"))
+
+
+# Pre-commit quick lane: core correctness, seconds-scale (make check-quick).
+pytestmark = __import__("pytest").mark.quick

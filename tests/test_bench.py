@@ -4,19 +4,26 @@ every failure mode. Round 1 shipped an untested harness that died with a
 traceback at backend init and captured nothing — never again."""
 
 import json
+import os
 import subprocess
 import sys
+import tempfile
 from pathlib import Path
 
 import numpy as np
 
 ROOT = Path(__file__).parent.parent
 
+# Isolated device-lock dir: a test bench run must never queue behind (or
+# stand down) a real builder pipeline on this machine — and vice versa.
+_LOCK_DIR = tempfile.mkdtemp(prefix="mano_test_lock_")
+
 
 def _run_bench(*extra, timeout=420):
     proc = subprocess.run(
         [sys.executable, str(ROOT / "bench.py"), *extra],
         capture_output=True, text=True, timeout=timeout, cwd=ROOT,
+        env={**os.environ, "MANO_DEVICE_LOCK_DIR": _LOCK_DIR},
     )
     lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
     assert len(lines) == 1, f"expected exactly one stdout line, got {lines!r}"
@@ -74,4 +81,25 @@ def test_bench_cpu_tiny_run_end_to_end():
                 "config1_zero_pose_max_err", "config6_sil_renders_per_sec",
                 "config6_depth_renders_per_sec"):
         assert key in d, f"missing {key}: {sorted(d)}"
+    assert "config_errors" not in line, line.get("config_errors")
+
+
+def test_bench_mesh_scaling_only():
+    """The scaling-table fast path: one row per device count with per-shard
+    shapes + collective counts, on a 2-device virtual CPU mesh."""
+    rc, line = _run_bench(
+        "--platform", "cpu", "--virtual-devices", "2",
+        "--mesh-scaling-only", "--mesh-scaling-batch", "64",
+        "--init-retries", "2", "--init-timeout", "60",
+    )
+    assert rc == 0, line
+    assert line["metric"] == "mesh_scaling_evals_per_sec"
+    table = line["detail"]["mesh_scaling"]
+    assert set(table) == {"1", "2"}, sorted(table)
+    assert table["2"]["per_shard_batch"] == 32
+    assert table["2"]["fit_step_loss_finite"]
+    # Data-parallel fit step must all-reduce (loss/grad mean across the
+    # data axis); the pure-DP forward needs no collectives at all.
+    assert table["2"]["fit_step_collectives"].get("all-reduce", 0) >= 1
+    assert table["2"]["forward_collectives"] == {}
     assert "config_errors" not in line, line.get("config_errors")

@@ -396,6 +396,16 @@ def test_fit_depth_term(tmp_path, capsys):
     assert rc == 2
     assert "--camera-eye/--focal apply to keypoints2d" in \
         capsys.readouterr().err
+    # The silhouette branch refuses the same inapplicable pinhole flags
+    # (it previously dropped them silently — ADVICE r3).
+    np.save(tmp_path / "mask.npy",
+            np.ones((16, 16), np.float32))
+    for flag in (["--camera-eye", "0,0,-1"], ["--focal", "3.0"]):
+        rc = cli.main(["fit", str(tmp_path / "mask.npy"),
+                       "--data-term", "silhouette", *flag])
+        assert rc == 2
+        assert "--camera-eye/--focal apply to keypoints2d" in \
+            capsys.readouterr().err
 
 
 def test_fit_heatmap(tmp_path, capsys):

@@ -173,3 +173,7 @@ def test_empty_and_singleton_batches(params):
         assert core.forward_batched_pallas(
             p32, pose, beta, block_b=8, block_v=128, interpret=True
         ).shape == (b, 778, 3)
+
+
+# Pre-commit quick lane: core correctness, seconds-scale (make check-quick).
+pytestmark = __import__("pytest").mark.quick

@@ -1,0 +1,83 @@
+"""Device-lock semantics (mano_hand_tpu/utils/devicelock.py).
+
+The contract under test is the round-4 reliability fix for VERDICT.md
+"What's weak" #1: a builder bench must never contend with the driver's
+end-of-round bench — it stands down instantly — while the driver must
+never be wedged by a stale lock (advisory timeout).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from mano_hand_tpu.utils import devicelock
+from mano_hand_tpu.utils.devicelock import DeviceBusy, DeviceLock
+
+
+@pytest.fixture(autouse=True)
+def _isolated_paths(tmp_path, monkeypatch):
+    monkeypatch.setattr(devicelock, "LOCK_PATH", str(tmp_path / "d.lock"))
+    monkeypatch.setattr(devicelock, "CLAIM_PATH", str(tmp_path / "d.claim"))
+
+
+def test_driver_writes_and_clears_claim():
+    with DeviceLock("driver", wait_s=5.0) as lk:
+        assert lk._locked
+        assert os.path.exists(devicelock.CLAIM_PATH)
+        assert devicelock.priority_claim_active()
+    assert not os.path.exists(devicelock.CLAIM_PATH)
+    assert not devicelock.priority_claim_active()
+
+
+def test_builder_stands_down_on_fresh_claim():
+    with DeviceLock("driver", wait_s=5.0):
+        with pytest.raises(DeviceBusy, match="stands down"):
+            DeviceLock("builder").__enter__()
+
+
+def test_builder_stands_down_on_held_lock_without_claim():
+    # A non-driver holder (no claim file): builder still must not wait.
+    holder = DeviceLock("driver", wait_s=5.0)
+    holder.__enter__()
+    os.remove(devicelock.CLAIM_PATH)  # simulate claimless holder
+    try:
+        with pytest.raises(DeviceBusy, match="lock held"):
+            DeviceLock("builder").__enter__()
+    finally:
+        holder.__exit__()
+
+
+def test_stale_claim_does_not_block_builder():
+    with open(devicelock.CLAIM_PATH, "w") as f:
+        f.write("{}")
+    old = time.time() - devicelock.CLAIM_FRESH_S - 10.0
+    os.utime(devicelock.CLAIM_PATH, (old, old))
+    assert not devicelock.priority_claim_active()
+    with DeviceLock("builder") as lk:  # proceeds: claim is stale
+        assert lk._locked
+
+
+def test_driver_proceeds_without_lock_after_timeout():
+    holder = DeviceLock("driver", wait_s=5.0)
+    holder.__enter__()
+    try:
+        msgs = []
+        with DeviceLock("driver", wait_s=0.0, log=msgs.append) as lk:
+            assert not lk._locked  # advisory: ran anyway
+        assert any("WITHOUT" in m for m in msgs)
+    finally:
+        holder.__exit__()
+
+
+def test_reacquire_after_release():
+    with DeviceLock("driver", wait_s=5.0):
+        pass
+    with DeviceLock("builder") as lk:
+        assert lk._locked
+
+
+# Pre-commit quick lane: core correctness, seconds-scale (make check-quick).
+pytestmark = __import__("pytest").mark.quick

@@ -62,3 +62,7 @@ def test_demo_matches_golden_jax_backend(params):
         p32, pose, jnp.asarray(cli.DEMO_SHAPE, jnp.float32)
     )
     assert np.abs(np.asarray(out.verts) - golden["verts"]).max() < 1e-4
+
+
+# Pre-commit quick lane: core correctness, seconds-scale (make check-quick).
+pytestmark = __import__("pytest").mark.quick

@@ -432,3 +432,7 @@ def test_sample_poses_anatomical(params32):
         params32, scaled[:, 1:].reshape(256, -1), component_vars=variances
     ))
     assert 0.7 < e_aware < 1.4
+
+
+# Pre-commit quick lane: core correctness, seconds-scale (make check-quick).
+pytestmark = __import__("pytest").mark.quick

@@ -203,3 +203,7 @@ def test_parity_with_reference_implementation(params, tmp_path):
     assert (tmp_path / "ours_restpose.obj").read_text() == (
         tmp_path / "ref_restpose.obj"
     ).read_text()
+
+
+# Pre-commit quick lane: core correctness, seconds-scale (make check-quick).
+pytestmark = __import__("pytest").mark.quick

@@ -67,3 +67,7 @@ def test_native_error_on_bad_path(tmp_path):
             np.zeros((1, 3)), np.zeros((1, 3), np.int32),
             tmp_path / "no_such_dir" / "x.obj",
         )
+
+
+# Pre-commit quick lane: core correctness, seconds-scale (make check-quick).
+pytestmark = __import__("pytest").mark.quick

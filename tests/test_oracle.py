@@ -126,3 +126,7 @@ def test_golden_digest(params):
 
 
 GOLDEN_ABS_SUM = 91.86533007749439
+
+
+# Pre-commit quick lane: core correctness, seconds-scale (make check-quick).
+pytestmark = __import__("pytest").mark.quick

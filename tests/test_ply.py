@@ -382,3 +382,7 @@ def test_obj_with_normals(params):
     assert "//" in a and a.split("//")[0] == a.split("//")[1]
     with pytest.raises(ValueError, match="normals"):
         format_obj(verts, params.faces, normals[:-1])
+
+
+# Pre-commit quick lane: core correctness, seconds-scale (make check-quick).
+pytestmark = __import__("pytest").mark.quick

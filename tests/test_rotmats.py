@@ -164,3 +164,7 @@ def test_flax_quat_format(params):
     ).verts
     got = ManoLayer(params=p32, pose_format="quat").apply({}, quats, beta)
     assert np.abs(np.asarray(got) - np.asarray(want)).max() < 1e-4
+
+
+# Pre-commit quick lane: core correctness, seconds-scale (make check-quick).
+pytestmark = __import__("pytest").mark.quick

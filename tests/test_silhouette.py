@@ -263,6 +263,12 @@ class TestDepthFitting:
         with pytest.raises(ValueError, match="image\\(s\\) with no valid"):
             fitting.fit_sequence(small, frames, data_term="depth",
                                  camera=cam, n_steps=2)
+        # A 1-d depth target must reach the solver's NAMED shape error,
+        # not trip a bare numpy AxisError in the per-image dropout check
+        # (its axis=(-2,-1) reduction needs ndim >= 2).
+        with pytest.raises(ValueError, match="(?i)shape|H, W|2-d"):
+            fitting.fit(small, jnp.ones((16,)), data_term="depth",
+                        camera=cam, n_steps=2)
         # Huber composes (sensor depth is heavy-tailed at boundaries).
         res = fitting.fit(small, jnp.ones((16, 16)), data_term="depth",
                           camera=cam, n_steps=2, robust="huber",

@@ -1,0 +1,128 @@
+"""Asset verification (assets/verify.py + `cli verify`).
+
+The real official pickle is license-gated and absent; these tests pin the
+audit's behavior on structurally-valid synthetic assets (which satisfy
+every hard gate by construction — assets/synthetic.py docstring) and on
+deliberately corrupted variants (which must fail the NAMED gate, not a
+random downstream error).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from mano_hand_tpu import cli
+from mano_hand_tpu.assets import save_npz, synthetic_params
+from mano_hand_tpu.assets.verify import (
+    compute_digests, format_report, verify_asset,
+)
+
+
+@pytest.fixture(scope="module")
+def asset_path(tmp_path_factory):
+    p = tmp_path_factory.mktemp("verify") / "hand.npz"
+    save_npz(synthetic_params(seed=7, dtype=np.float64), p)
+    return p
+
+
+def test_synthetic_passes_gates(asset_path):
+    report = verify_asset(asset_path)
+    failed = [f.name for f in report.findings
+              if f.level == "gate" and not f.ok]
+    assert report.gates_ok, failed
+    assert report.side == "right"
+    # Digest set covers every array field + the combined key.
+    assert "combined" in report.digests and len(report.digests) == 9
+
+
+def test_digests_deterministic_and_distinct(asset_path):
+    a = verify_asset(asset_path).digests
+    b = verify_asset(asset_path).digests
+    assert a == b
+    other = compute_digests(synthetic_params(seed=8, dtype=np.float64))
+    assert other["combined"] != a["combined"]
+
+
+def test_digest_shape_tagged():
+    from mano_hand_tpu.assets.verify import _digest
+
+    p = synthetic_params(seed=7, dtype=np.float64)
+    jr = np.asarray(p.j_regressor)
+    # Contiguity must not matter (same values, same shape)...
+    assert _digest(np.ascontiguousarray(jr)) == _digest(jr)
+    # ...but a transposed array must not collide even where its C-order
+    # bytes would (the shape header is what prevents it).
+    assert _digest(jr.T) != _digest(jr)
+    square = np.eye(4)      # symmetric: transpose is byte-identical
+    assert _digest(square.T) == _digest(square)
+    assert _digest(square.reshape(2, 8)) != _digest(square)
+
+
+def test_corrupt_lbs_fails_named_gate(asset_path, tmp_path):
+    p = synthetic_params(seed=7, dtype=np.float64)
+    bad = dataclasses.replace(
+        p, lbs_weights=np.asarray(p.lbs_weights) * 2.0)
+    bad_path = tmp_path / "bad.npz"
+    save_npz(bad, bad_path)
+    report = verify_asset(bad_path)
+    assert not report.gates_ok
+    failed = {f.name for f in report.findings
+              if f.level == "gate" and not f.ok}
+    assert "lbs_rows_sum_to_1" in failed
+
+
+def test_nonfinite_fails_named_gate(asset_path, tmp_path):
+    p = synthetic_params(seed=7, dtype=np.float64)
+    vt = np.asarray(p.v_template).copy()
+    vt[0, 0] = np.nan
+    bad_path = tmp_path / "nan.npz"
+    save_npz(dataclasses.replace(p, v_template=vt), bad_path)
+    report = verify_asset(bad_path)
+    failed = {f.name for f in report.findings
+              if f.level == "gate" and not f.ok}
+    assert "all_finite" in failed
+
+
+def test_golden_match_and_mismatch(asset_path, tmp_path):
+    report = verify_asset(asset_path, golden=asset_path)
+    assert report.gates_ok
+    p = synthetic_params(seed=7, dtype=np.float64)
+    nudged = dataclasses.replace(
+        p, v_template=np.asarray(p.v_template) + 1e-5)
+    other = tmp_path / "nudged.npz"
+    save_npz(nudged, other)
+    report = verify_asset(asset_path, golden=other)
+    golden = [f for f in report.findings if f.name == "matches_golden"]
+    assert golden and not golden[0].ok
+
+
+def test_cli_verify(asset_path, tmp_path, capsys):
+    assert cli.main(["verify", str(asset_path)]) == 0
+    out = capsys.readouterr().out
+    assert "RESULT: OK" in out and "combined:" in out
+
+    # --expect pins the digest; a wrong pin fails.
+    digest = verify_asset(asset_path).digests["combined"]
+    assert cli.main(["verify", str(asset_path), "--expect", digest]) == 0
+    capsys.readouterr()
+    assert cli.main(["verify", str(asset_path), "--expect", "0" * 64]) == 1
+    assert "MISMATCH" in capsys.readouterr().out
+
+    # --json is machine-readable and carries the same verdict.
+    assert cli.main(["verify", str(asset_path), "--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["gates_ok"] and data["digests"]["combined"] == digest
+
+    # Undecodable input: a clean error, not a traceback.
+    junk = tmp_path / "junk.pkl"
+    junk.write_bytes(b"not a pickle")
+    assert cli.main(["verify", str(junk)]) == 1
+    assert "failed to decode" in capsys.readouterr().err
+
+
+# Pre-commit quick lane: core correctness, seconds-scale (make check-quick).
+pytestmark = __import__("pytest").mark.quick
