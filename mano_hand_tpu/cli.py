@@ -433,6 +433,14 @@ def cmd_fit(args) -> int:
               "(LM regularizes via its Tikhonov shape rows)",
               file=sys.stderr)
         return 2
+    if args.solver == "lm" and args.joint_limits is not None:
+        print("--joint-limits requires --solver adam (the hinge prior "
+              "is a first-order energy term)", file=sys.stderr)
+        return 2
+    if args.joint_limit_weight is not None and args.joint_limits is None:
+        print("--joint-limit-weight without --joint-limits does nothing; "
+              "pass the bounds file", file=sys.stderr)
+        return 2
     if args.solver == "lm":
         if args.lr is not None:
             print("note: --lr only applies to --solver adam; ignored",
@@ -678,6 +686,33 @@ def cmd_fit(args) -> int:
             print("--pose-prior mahalanobis needs axis-angle statistics: "
                   "use --pose-space aa or pca", file=sys.stderr)
             return 2
+        joint_limits = None
+        if args.joint_limits is not None:
+            if pose_space == "6d":
+                print("--joint-limits are axis-angle bounds: use "
+                      "--pose-space aa or pca", file=sys.stderr)
+                return 2
+            try:
+                with np.load(args.joint_limits) as lim:
+                    if "lo" not in lim or "hi" not in lim:
+                        raise ValueError(
+                            f"needs keys lo/hi, has {sorted(lim.files)}")
+                    lo, hi = lim["lo"], lim["hi"]
+            except Exception as e:  # unreadable/malformed file
+                print(f"--joint-limits {args.joint_limits}: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+                return 2
+            n_dof = (params.n_joints - 1) * 3
+            if lo.shape != (n_dof,) or hi.shape != (n_dof,):
+                print(f"--joint-limits lo/hi must be [{n_dof}]; got "
+                      f"{lo.shape}/{hi.shape}", file=sys.stderr)
+                return 2
+            if not (np.asarray(lo) <= np.asarray(hi)).all():
+                print("--joint-limits has lo > hi entries — swapped "
+                      "bounds would wall off the whole axis",
+                      file=sys.stderr)
+                return 2
+            joint_limits = (lo, hi)
         # Default pose-prior weight: the 2D term is depth-blind and always
         # needs one; elsewhere the data-driven prior defaults on gently
         # when selected, and the isotropic prior stays off.
@@ -715,6 +750,9 @@ def cmd_fit(args) -> int:
             pose_space=pose_space,
             pose_prior=args.pose_prior,
             pose_prior_weight=pose_prior_weight,
+            joint_limits=joint_limits,
+            joint_limit_weight=(1.0 if args.joint_limit_weight is None
+                                else args.joint_limit_weight),
             robust=args.robust, robust_scale=args.robust_scale,
             init=init,
             **kp2d,
@@ -978,6 +1016,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "keypoints2d, 1.0 for silhouette/depth — a "
                         "single image cannot pin articulation, 1e-3 for "
                         "--pose-prior mahalanobis, else 0)")
+    f.add_argument("--joint-limits", default=None,
+                   help=".npz with per-DOF axis-angle bounds (keys lo, "
+                        "hi, each [45]; build with "
+                        "objectives.pose_limits_from_corpus) — adds the "
+                        "squared-hinge anatomical limit prior "
+                        "(adam solver, aa/pca pose spaces)")
+    f.add_argument("--joint-limit-weight", type=float, default=None,
+                   help="weight of the joint-limit hinge (default 1.0; "
+                        "only with --joint-limits)")
     f.add_argument("--shape-prior", type=float, default=None,
                    help="shape regularizer. adam: L2 prior weight (default "
                         "0 for verts, 1.0 for silhouette/depth, 1e-3 "

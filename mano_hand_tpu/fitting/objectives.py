@@ -325,6 +325,58 @@ def mahalanobis_pose_prior(
     return jnp.mean(z ** 2)
 
 
+def pose_limit_prior(
+    fingers_flat: jnp.ndarray,   # [..., 3*(J-1)] articulated axis-angle
+    lo: jnp.ndarray,             # [3*(J-1)] (or broadcastable) lower bounds
+    hi: jnp.ndarray,             # [3*(J-1)] upper bounds, radians
+) -> jnp.ndarray:
+    """Anatomical joint-limit prior: squared hinge outside per-DOF bounds.
+
+    Quadratic in / past the violation (``relu(lo - x)^2 + relu(x - hi)^2``)
+    so the energy is zero everywhere inside the admissible box — unlike
+    the Mahalanobis prior it never fights observations within range, it
+    only walls off hyperextension and reversed bends (the classic failure
+    of keypoint-only fits: a knuckle folded backwards explains 2D
+    observations exactly as well as the true pose). Bounds are per flat
+    axis-angle DOF; derive them from a pose corpus with
+    ``pose_limits_from_corpus`` (nothing anatomical ships hardcoded — the
+    corpus, e.g. the official assets' scan poses, is the anatomy).
+    Scalar output, same reduction contract as ``l2_prior``.
+    """
+    x = fingers_flat
+    lo = jnp.asarray(lo, x.dtype)
+    hi = jnp.asarray(hi, x.dtype)
+    under = jnp.maximum(lo - x, 0.0)
+    over = jnp.maximum(x - hi, 0.0)
+    return jnp.mean(under ** 2 + over ** 2)
+
+
+def pose_limits_from_corpus(params, poses, expand: float = 0.15):
+    """Per-DOF axis-angle bounds ``(lo, hi)`` from a pose corpus.
+
+    ``poses`` accepts the same formats as ``pose_component_variances``
+    ([N, 16, 3] full, [N, 15, 3] articulated, [N, 45] flat — e.g.
+    ``assets.scans.decode_scan_poses`` output). Bounds are the corpus
+    min/max per flat DOF, expanded by ``expand`` radians on both sides
+    (observed poses are a sample, not the boundary, of the feasible
+    set). Feed to ``fit(joint_limits=..., joint_limit_weight=...)``.
+    """
+    flat = _flat_articulated(params, poses)
+    return flat.min(axis=0) - expand, flat.max(axis=0) + expand
+
+
+def _flat_articulated(params, poses) -> jnp.ndarray:
+    """Normalize a pose corpus to flat articulated axis-angle [N, 3*(J-1)].
+
+    Accepts [N, J, 3] full (global-rotation row dropped), [N, J-1, 3]
+    articulated, or already-flat [N, 3*(J-1)]."""
+    poses = jnp.asarray(poses)
+    n_aa = jnp.asarray(params.pca_mean).shape[-1]
+    if poses.ndim == 3 and poses.shape[-2] * 3 == n_aa + 3:
+        poses = poses[..., 1:, :]  # drop the global-rotation row
+    return poses.reshape(poses.shape[0], n_aa)
+
+
 def pose_component_variances(params, poses) -> jnp.ndarray:
     """Per-component variances of a pose corpus in PCA component space.
 
@@ -335,11 +387,7 @@ def pose_component_variances(params, poses) -> jnp.ndarray:
     A small floor keeps near-degenerate components from exploding the
     whitened energy.
     """
-    poses = jnp.asarray(poses)
-    n_pca = jnp.asarray(params.pca_mean).shape[-1]
-    if poses.ndim == 3 and poses.shape[-2] * 3 == n_pca + 3:
-        poses = poses[..., 1:, :]  # drop the global-rotation row
-    flat = poses.reshape(poses.shape[0], n_pca)
+    flat = _flat_articulated(params, poses)
     pinv = jnp.linalg.pinv(jnp.asarray(params.pca_basis, flat.dtype))
     z = jnp.einsum("nf,fc->nc", flat - jnp.asarray(params.pca_mean,
                                                    flat.dtype), pinv,

@@ -116,7 +116,8 @@ def _pose_deviation(pose_space, p, dtype):
     return p["pose"]
 
 
-def _check_pose_prior(pose_prior: str, pose_space: str) -> None:
+def _check_pose_prior(pose_prior: str, pose_space: str,
+                      joint_limits=None) -> None:
     if pose_prior not in ("l2", "mahalanobis"):
         raise ValueError(
             f"pose_prior must be 'l2' or 'mahalanobis', got {pose_prior!r}"
@@ -128,6 +129,20 @@ def _check_pose_prior(pose_prior: str, pose_space: str) -> None:
             "pose_prior='mahalanobis' needs the axis-angle statistics, so "
             f"pose_space must be 'aa' or 'pca'; got {pose_space!r}"
         )
+    if joint_limits is not None:
+        if pose_space not in ("aa", "pca"):
+            # Same constraint as the Mahalanobis prior: the bounds live in
+            # axis-angle coordinates.
+            raise ValueError(
+                "joint_limits are per-axis-angle-DOF bounds, so pose_space "
+                f"must be 'aa' or 'pca'; got {pose_space!r}"
+            )
+        if len(joint_limits) != 2:
+            raise ValueError(
+                "joint_limits must be a (lo, hi) pair (e.g. from "
+                "objectives.pose_limits_from_corpus); got "
+                f"{len(joint_limits)} elements"
+            )
 
 
 def _fingers_flat(pose_space, params, p, precision=None):
@@ -146,15 +161,35 @@ def _fingers_flat(pose_space, params, p, precision=None):
 
 
 def _pose_reg(pose_space, pose_prior, pose_prior_vars, params, p, dtype,
-              pose_prior_weight):
-    """The pose prior term — THE one dispatch every solver loss uses."""
+              pose_prior_weight, joint_limits=None,
+              joint_limit_weight=0.0):
+    """The pose prior term — THE one dispatch every solver loss uses.
+
+    ``joint_limits`` ((lo, hi) per flat articulated DOF, e.g. from
+    ``objectives.pose_limits_from_corpus``) COMPOSES with either prior:
+    the l2/Mahalanobis term shapes the interior of the feasible set, the
+    hinge walls off its boundary (hyperextension reads 2D keypoints as
+    well as the true pose; only a boundary term rules it out). Needs the
+    axis-angle coordinates, so it applies under pose_space 'aa'/'pca' —
+    _check_pose_prior refuses '6d' + limits.
+    """
+    ff = (_fingers_flat(pose_space, params, p)
+          if pose_prior == "mahalanobis" or joint_limits is not None
+          else None)
     if pose_prior == "mahalanobis":
-        return pose_prior_weight * objectives.mahalanobis_pose_prior(
-            params, _fingers_flat(pose_space, params, p), pose_prior_vars
+        reg = pose_prior_weight * objectives.mahalanobis_pose_prior(
+            params, ff, pose_prior_vars
         )
-    return pose_prior_weight * objectives.l2_prior(
-        _pose_deviation(pose_space, p, dtype)
-    )
+    else:
+        reg = pose_prior_weight * objectives.l2_prior(
+            _pose_deviation(pose_space, p, dtype)
+        )
+    if joint_limits is not None:
+        lo, hi = joint_limits
+        reg = reg + joint_limit_weight * objectives.pose_limit_prior(
+            ff, lo, hi
+        )
+    return reg
 
 
 def _pose_to_aa(pose_space, params, p):
@@ -719,6 +754,8 @@ def _fit_single(
     init: Optional[dict] = None,
     pose_prior: str = "l2",
     pose_prior_vars: Optional[jnp.ndarray] = None,
+    joint_limits=None,           # (lo, hi) per flat articulated DOF
+    joint_limit_weight: float = 0.0,
     tips=None,
     keypoint_order: str = "mano",
     self_penetration_weight: float = 0.0,
@@ -729,7 +766,7 @@ def _fit_single(
     mask_weight: float = 0.1,
 ) -> FitResult:
     _check_data_term(data_term, camera, conf)
-    _check_pose_prior(pose_prior, pose_space)
+    _check_pose_prior(pose_prior, pose_space, joint_limits)
     dtype = params.v_template.dtype
     n_joints = params.j_regressor.shape[0]
     n_shape = params.shape_basis.shape[-1]
@@ -792,7 +829,8 @@ def _fit_single(
         # Prior weights may be traced scalars (see fit): plain multiplies.
         reg = (
             _pose_reg(pose_space, pose_prior, pose_prior_vars, params, p,
-                      dtype, pose_prior_weight)
+                      dtype, pose_prior_weight, joint_limits,
+                      joint_limit_weight)
             + shape_prior_weight * objectives.l2_prior(p["shape"])
         )
         if self_pen_mask is not None and self_penetration_weight:
@@ -848,6 +886,8 @@ def fit(
     init: Optional[dict] = None,
     pose_prior: str = "l2",
     pose_prior_vars: Optional[jnp.ndarray] = None,  # [C] component vars
+    joint_limits=None,           # (lo, hi) per flat articulated DOF
+    joint_limit_weight: float = 1.0,
     tip_vertex_ids=None,         # None | "smplx" | "manopth" | vertex ids
     keypoint_order: str = "mano",  # "mano" | "openpose" (21-kp targets)
     self_penetration_weight: float = 0.0,   # STATIC: nonzero recompiles
@@ -890,6 +930,16 @@ def fit(
     carry ill-posed fits — sparse joints, 2D keypoints, partial clouds —
     toward anatomically plausible poses instead of the flat zero pose.
 
+    ``joint_limits`` (a per-DOF ``(lo, hi)`` pair in articulated
+    axis-angle coordinates, e.g. from
+    ``objectives.pose_limits_from_corpus`` over the official assets'
+    scan poses) adds ``objectives.pose_limit_prior`` — a squared hinge
+    that is ZERO inside the admissible box and walls off hyperextension
+    and reversed bends outside it. It composes with either
+    ``pose_prior`` (interior shaping vs boundary enforcement) and costs
+    one elementwise pass; ``joint_limit_weight`` scales it (the default
+    1.0 is strong relative to a hinge violation measured in radians).
+
     ``tip_vertex_ids`` extends the keypoint data terms with fingertip
     vertex picks — the 21-keypoint convention every major hand dataset
     and detector uses (MANO's skeleton has no tips). Pass ``"smplx"`` or
@@ -914,6 +964,7 @@ def fit(
         data_term=data_term, camera=camera, target_conf=target_conf,
         fit_trans=fit_trans, robust=robust, robust_scale=robust_scale,
         init=init, pose_prior=pose_prior, pose_prior_vars=pose_prior_vars,
+        joint_limits=joint_limits, joint_limit_weight=joint_limit_weight,
         tip_vertex_ids=tip_vertex_ids, keypoint_order=keypoint_order,
         self_penetration_weight=self_penetration_weight,
         self_penetration_radius=self_penetration_radius,
@@ -944,6 +995,8 @@ def fit_with_optimizer(
     init: Optional[dict] = None,
     pose_prior: str = "l2",
     pose_prior_vars: Optional[jnp.ndarray] = None,
+    joint_limits=None,
+    joint_limit_weight: float = 1.0,
     tip_vertex_ids=None,
     keypoint_order: str = "mano",
     self_penetration_weight: float = 0.0,
@@ -979,6 +1032,8 @@ def fit_with_optimizer(
         robust_scale=robust_scale,
         pose_prior=pose_prior,
         pose_prior_vars=pose_prior_vars,
+        joint_limits=joint_limits,
+        joint_limit_weight=joint_limit_weight,
         tips=tips,
         keypoint_order=keypoint_order,
         self_penetration_weight=self_penetration_weight,
@@ -1074,6 +1129,8 @@ def fit_sequence(
     pose_space: str = "aa",
     pose_prior: str = "l2",
     pose_prior_vars: Optional[jnp.ndarray] = None,
+    joint_limits=None,
+    joint_limit_weight: float = 1.0,
     tip_vertex_ids=None,
     keypoint_order: str = "mano",
     self_penetration_weight: float = 0.0,   # STATIC: nonzero recompiles
@@ -1106,7 +1163,7 @@ def fit_sequence(
     lower toward 0 for fast motion sampled coarsely.
     """
     _check_data_term(data_term, camera, target_conf)
-    _check_pose_prior(pose_prior, pose_space)
+    _check_pose_prior(pose_prior, pose_space, joint_limits)
     dtype = params.v_template.dtype
     targets = jnp.asarray(targets, dtype)
     want_ndim = 3
@@ -1185,7 +1242,8 @@ def fit_sequence(
         reg = (
             reg
             + _pose_reg(pose_space, pose_prior, pose_prior_vars, params, p,
-                        dtype, pose_prior_weight)
+                        dtype, pose_prior_weight, joint_limits,
+                        joint_limit_weight)
             + shape_prior_weight * objectives.l2_prior(p["shape"])
         )
         if _self_pen_mask is not None and self_penetration_weight:

@@ -75,6 +75,9 @@ def make_tracker(
         # TypeError out of the first frame's solve.
         raise ValueError("self_penetration_weight requires solver='adam' "
                          "(LM's GN residual has no hinge term)")
+    if solver == "lm" and solver_kw.get("joint_limits") is not None:
+        raise ValueError("joint_limits requires solver='adam' (the limit "
+                         "hinge is a first-order energy term)")
     if solver == "adam" and solver_kw.get("self_penetration_weight"):
         # Build the [V, V] part-adjacency mask ONCE for the stream — the
         # per-frame path must not redo the O(V^2) host build + transfer

@@ -80,6 +80,56 @@ def test_fit_subcommand(tmp_path, capsys):
     np.testing.assert_allclose(ckpt["pose"], pose, atol=1e-3)
 
 
+def test_fit_subcommand_joint_limits(tmp_path, capsys):
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+
+    p32 = synthetic_params(seed=0).astype(np.float32)
+    rng = np.random.default_rng(4)
+    pose = rng.normal(scale=0.2, size=(16, 3)).astype(np.float32)
+    targets = np.asarray(core.jit_forward(
+        p32, jnp.asarray(pose), jnp.zeros(10, jnp.float32)
+    ).verts)
+    np.save(tmp_path / "t.npy", targets)
+    flat = pose[1:].reshape(45)
+    np.savez(tmp_path / "lim.npz", lo=flat - 0.3, hi=flat + 0.3)
+    out = tmp_path / "fit.npz"
+    rc = cli.main([
+        "fit", str(tmp_path / "t.npy"), "--solver", "adam",
+        "--steps", "150",
+        "--joint-limits", str(tmp_path / "lim.npz"), "--out", str(out),
+    ])
+    assert rc == 0
+    got = np.load(out)["pose"][1:].reshape(45)
+    assert (got > flat - 0.35).all() and (got < flat + 0.35).all()
+
+    # Guard rails: LM (incl. the verts-term DEFAULT resolution) has no
+    # hinge term; weight alone does nothing; the file must carry
+    # well-formed bounds.
+    capsys.readouterr()
+    for solver_args in (["--solver", "lm"], []):
+        rc = cli.main(["fit", str(tmp_path / "t.npy"), *solver_args,
+                       "--joint-limits", str(tmp_path / "lim.npz")])
+        assert rc == 2 and "--solver adam" in capsys.readouterr().err
+    rc = cli.main(["fit", str(tmp_path / "t.npy"), "--solver", "adam",
+                   "--joint-limit-weight", "2.0"])
+    assert rc == 2 and "does nothing" in capsys.readouterr().err
+    adam = ["fit", str(tmp_path / "t.npy"), "--solver", "adam"]
+    np.savez(tmp_path / "bad.npz", lo=flat + 1.0, hi=flat - 1.0)
+    rc = cli.main([*adam, "--joint-limits", str(tmp_path / "bad.npz")])
+    assert rc == 2 and "lo > hi" in capsys.readouterr().err
+    np.savez(tmp_path / "short.npz", lo=flat[:10], hi=flat[:10])
+    rc = cli.main([*adam, "--joint-limits", str(tmp_path / "short.npz")])
+    assert rc == 2 and "[45]" in capsys.readouterr().err
+    np.savez(tmp_path / "keys.npz", low=flat)
+    rc = cli.main([*adam, "--joint-limits", str(tmp_path / "keys.npz")])
+    assert rc == 2 and "lo/hi" in capsys.readouterr().err
+    rc = cli.main([*adam, "--pose-space", "6d",
+                   "--joint-limits", str(tmp_path / "lim.npz")])
+    assert rc == 2 and "axis-angle" in capsys.readouterr().err
+
+
 def test_fit_subcommand_pose_space_6d(tmp_path, capsys):
     import jax.numpy as jnp
 

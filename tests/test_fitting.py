@@ -801,3 +801,82 @@ def test_mahalanobis_prior_rejects_6d(params32):
             pose_prior="mahalanobis")
     with pytest.raises(ValueError, match="pose_prior"):
         fit(params32, target, n_steps=2, pose_prior="bogus")
+
+
+def test_pose_limit_prior_zero_inside_hinge_outside():
+    from mano_hand_tpu.fitting import objectives
+
+    lo = -np.full(45, 0.5, np.float32)
+    hi = np.full(45, 0.5, np.float32)
+    inside = jnp.zeros((3, 45), jnp.float32) + 0.49
+    assert float(objectives.pose_limit_prior(inside, lo, hi)) == 0.0
+    # One DOF 0.6 past the ceiling: mean((0.6)^2 / (3*45)) per element.
+    out = inside.at[0, 7].set(1.1)
+    got = float(objectives.pose_limit_prior(out, lo, hi))
+    np.testing.assert_allclose(got, (1.1 - 0.5) ** 2 / (3 * 45), rtol=1e-5)
+    # Symmetric below the floor.
+    under = inside.at[1, 3].set(-1.1)
+    np.testing.assert_allclose(
+        float(objectives.pose_limit_prior(under, lo, hi)), got, rtol=1e-5)
+
+
+def test_pose_limits_from_corpus_formats(params32):
+    from mano_hand_tpu.fitting import objectives
+
+    rng = np.random.default_rng(31)
+    full = _anatomical_pose_sample(params32, rng, 100,
+                                   np.full(45, 0.3))
+    lo_f, hi_f = objectives.pose_limits_from_corpus(params32, full)
+    assert lo_f.shape == (45,) and hi_f.shape == (45,)
+    flat = full[:, 1:, :].reshape(100, 45)
+    lo2, hi2 = objectives.pose_limits_from_corpus(params32, flat)
+    np.testing.assert_allclose(np.asarray(lo_f), np.asarray(lo2))
+    # Expansion margin on both sides of the observed range.
+    np.testing.assert_allclose(np.asarray(lo_f), flat.min(0) - 0.15,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(hi_f), flat.max(0) + 0.15,
+                               atol=1e-6)
+
+
+def test_joint_limits_wall_off_hyperextension(params32):
+    """Sparse-joint recovery with a deliberately out-of-range seed: the
+    hinge walls the solution into the admissible box without hurting
+    convergence for an in-range problem."""
+    rng = np.random.default_rng(37)
+    true_pose = np.zeros((16, 3), np.float32)
+    true_pose[1:, 0] = rng.uniform(0.1, 0.4, size=15)  # in-range bends
+    truth = core.forward(params32, jnp.asarray(true_pose),
+                         jnp.zeros(10, jnp.float32))
+    flat = true_pose[1:].reshape(45)
+    limits = (jnp.asarray(flat - 0.3), jnp.asarray(flat + 0.3))
+
+    res = fit(params32, truth.posed_joints, data_term="joints",
+              n_steps=300, lr=0.05, shape_prior_weight=1e-3,
+              joint_limits=limits, joint_limit_weight=1.0)
+    got_flat = np.asarray(res.pose)[1:].reshape(45)
+    # Inside the (slightly slackened) box and converged on the data.
+    assert (got_flat > np.asarray(limits[0]) - 0.05).all()
+    assert (got_flat < np.asarray(limits[1]) + 0.05).all()
+    err = core.forward(params32, res.pose, res.shape).posed_joints \
+        - truth.posed_joints
+    assert float(jnp.abs(err).max()) < 5e-3
+
+    # Unreachable targets + tight box: the hinge must dominate — final
+    # pose pinned at/inside the wall rather than hyperextending to chase
+    # the data. Box excludes the target pose entirely.
+    tight = (jnp.asarray(flat - 0.35), jnp.asarray(flat - 0.25))
+    res2 = fit(params32, truth.posed_joints, data_term="joints",
+               n_steps=300, lr=0.05, shape_prior_weight=1e-3,
+               joint_limits=tight, joint_limit_weight=100.0)
+    got2 = np.asarray(res2.pose)[1:].reshape(45)
+    assert (got2 < np.asarray(tight[1]) + 0.02).all()
+
+
+def test_joint_limits_validation(params32):
+    target = core.forward(params32).verts
+    lo = jnp.zeros(45)
+    with pytest.raises(ValueError, match="joint_limits"):
+        fit(params32, target, n_steps=2, pose_space="6d",
+            joint_limits=(lo, lo))
+    with pytest.raises(ValueError, match="lo, hi"):
+        fit(params32, target, n_steps=2, joint_limits=(lo,))
