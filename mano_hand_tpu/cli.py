@@ -115,6 +115,28 @@ def cmd_animate(args) -> int:
 
     params = _load_params(args.asset, args.side).astype(np.float32)
     poses = _load_pose_sequence(args.poses, params)
+    if str(args.out).endswith(".glb") and args.skinned:
+        # Engine-ready skeletal export: joint hierarchy + LBS weights
+        # + the clip as quaternion rotation tracks. Drivable/
+        # retargetable after export; plain LBS (pose correctives are
+        # not encodable in a glTF skin — the morph path is exact).
+        # Only the ONE rest-pose forward runs — the skin carries the
+        # animation, so the per-frame batched forward below would be
+        # thrown-away work at clip scale.
+        from mano_hand_tpu.io.gltf import export_glb_skinned
+
+        rest = core.forward(
+            params, jnp.zeros((params.n_joints, 3), jnp.float32),
+            jnp.zeros(params.n_shape, jnp.float32),
+        )
+        path = export_glb_skinned(
+            np.asarray(rest.verts), np.asarray(params.faces),
+            np.asarray(rest.joints), params.parents,
+            np.asarray(params.lbs_weights), args.out,
+            pose_frames=poses, fps=args.fps,
+        )
+        print(f"wrote {poses.shape[0]}-frame skinned GLB to {path}")
+        return 0
     shapes = np.zeros((poses.shape[0], params.n_shape))
     out = core.jit_forward_batched(
         params, jnp.asarray(poses, jnp.float32), jnp.asarray(shapes, jnp.float32)
@@ -893,6 +915,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "ONE viewer-ready animated file (morph targets)")
     a.add_argument("--fps", type=float, default=30.0,
                    help="playback rate for --out .glb")
+    a.add_argument("--skinned", action="store_true",
+                   help="with --out .glb: export a skeletal skin "
+                        "(joint nodes + LBS weights + quaternion "
+                        "rotation tracks — drivable in any engine) "
+                        "instead of baked morph targets (exact but "
+                        "frame-count-sized)")
     a.set_defaults(fn=cmd_animate)
 
     r = sub.add_parser("render", help="rasterize poses to PNG/GIF")

@@ -31,6 +31,87 @@ def _pad4(b: bytes, fill: bytes) -> bytes:
     return b + fill * (-len(b) % 4)
 
 
+class _Builder:
+    """Shared buffer/view/accessor assembly for both GLB exporters."""
+
+    _TYPES = {1: "SCALAR", 3: "VEC3", 4: "VEC4", 16: "MAT4"}
+    _CTYPES = {np.dtype(np.float32): _F32, np.dtype(np.uint32): _U32,
+               np.dtype(np.uint8): 5121}
+
+    def __init__(self):
+        self.buffers: list[bytes] = []
+        self.views = []
+        self.accessors = []
+
+    def add(self, data: np.ndarray, target=None, minmax=False):
+        data = np.ascontiguousarray(data)
+        raw = data.tobytes()
+        offset = sum(len(b) for b in self.buffers)
+        self.buffers.append(_pad4(raw, b"\x00"))
+        view = {"buffer": 0, "byteOffset": offset, "byteLength": len(raw)}
+        if target:
+            view["target"] = target
+        self.views.append(view)
+        acc = {
+            "bufferView": len(self.views) - 1,
+            "componentType": self._CTYPES[data.dtype],
+            "count": int(data.shape[0] if data.ndim > 1 else data.size),
+            "type": self._TYPES[1 if data.ndim == 1 else data.shape[-1]],
+        }
+        if minmax:
+            acc["min"] = [float(x) for x in data.min(axis=0)]
+            acc["max"] = [float(x) for x in data.max(axis=0)]
+        self.accessors.append(acc)
+        return len(self.accessors) - 1
+
+    def add_times(self, times: np.ndarray):
+        """Keyframe-time accessor (scalar min/max required by the spec)."""
+        idx = self.add(times)
+        self.accessors[idx]["min"] = [float(times.min())]
+        self.accessors[idx]["max"] = [float(times.max())]
+        return idx
+
+    def write(self, gltf: dict, path) -> str:
+        bin_chunk = b"".join(self.buffers)
+        gltf["buffers"] = [{"byteLength": len(bin_chunk)}]
+        gltf["bufferViews"] = self.views
+        gltf["accessors"] = self.accessors
+        json_chunk = _pad4(
+            json.dumps(gltf, separators=(",", ":")).encode(), b" ")
+        total = 12 + 8 + len(json_chunk) + 8 + len(bin_chunk)
+        with open(path, "wb") as f:
+            f.write(struct.pack("<III", _MAGIC, 2, total))
+            f.write(struct.pack("<II", len(json_chunk), _CHUNK_JSON))
+            f.write(json_chunk)
+            f.write(struct.pack("<II", len(bin_chunk), _CHUNK_BIN))
+            f.write(bin_chunk)
+        return str(path)
+
+
+def _check_mesh_args(verts, faces):
+    if verts.ndim != 2 or verts.shape[-1] != 3:
+        raise ValueError(f"verts must be [V, 3], got {verts.shape}")
+    if faces.ndim != 2 or faces.shape[-1] != 3:
+        raise ValueError(f"faces must be [F, 3], got {faces.shape}")
+
+
+def _check_fps(fps):
+    if not fps > 0:
+        # arange/fps would put inf/nan keyframe times into the JSON
+        # chunk (json.dumps emits bare Infinity — invalid glTF that
+        # strict viewers reject with an opaque parse error).
+        raise ValueError(f"fps must be > 0, got {fps}")
+
+
+def _check_colors(vertex_colors, verts):
+    vertex_colors = np.asarray(vertex_colors, np.float32)
+    if vertex_colors.shape != verts.shape:
+        raise ValueError(
+            f"vertex_colors must be [V, 3] matching verts, got "
+            f"{vertex_colors.shape}")
+    return vertex_colors
+
+
 def export_glb(
     verts: np.ndarray,            # [V, 3] float
     faces: np.ndarray,            # [F, 3] int
@@ -54,50 +135,17 @@ def export_glb(
     """
     verts = np.asarray(verts, np.float32)
     faces = np.asarray(faces, np.uint32)
-    if verts.ndim != 2 or verts.shape[-1] != 3:
-        raise ValueError(f"verts must be [V, 3], got {verts.shape}")
-    if faces.ndim != 2 or faces.shape[-1] != 3:
-        raise ValueError(f"faces must be [F, 3], got {faces.shape}")
+    _check_mesh_args(verts, faces)
     if normals is None:
         normals = _vertex_normals_np(verts, faces)
     normals = np.asarray(normals, np.float32)
     if vertex_colors is not None:
-        vertex_colors = np.asarray(vertex_colors, np.float32)
-        if vertex_colors.shape != verts.shape:
-            raise ValueError(
-                f"vertex_colors must be [V, 3] matching verts, got "
-                f"{vertex_colors.shape}"
-            )
+        vertex_colors = _check_colors(vertex_colors, verts)
 
-    buffers: list[bytes] = []
-    views = []
-    accessors = []
-
-    def add(data: np.ndarray, target=None, minmax=False):
-        raw = np.ascontiguousarray(data).tobytes()
-        offset = sum(len(b) for b in buffers)
-        buffers.append(_pad4(raw, b"\x00"))
-        view = {"buffer": 0, "byteOffset": offset, "byteLength": len(raw)}
-        if target:
-            view["target"] = target
-        views.append(view)
-        acc = {
-            "bufferView": len(views) - 1,
-            "componentType": _U32 if data.dtype == np.uint32 else _F32,
-            "count": int(data.shape[0] if data.ndim > 1 else data.size),
-            "type": {1: "SCALAR", 3: "VEC3"}[
-                1 if data.ndim == 1 else data.shape[-1]
-            ],
-        }
-        if minmax:
-            acc["min"] = [float(x) for x in data.min(axis=0)]
-            acc["max"] = [float(x) for x in data.max(axis=0)]
-        accessors.append(acc)
-        return len(accessors) - 1
-
-    a_pos = add(verts, target=34962, minmax=True)       # ARRAY_BUFFER
-    a_nrm = add(normals, target=34962)
-    a_idx = add(faces.reshape(-1), target=34963)        # ELEMENT_ARRAY
+    b = _Builder()
+    a_pos = b.add(verts, target=34962, minmax=True)       # ARRAY_BUFFER
+    a_nrm = b.add(normals, target=34962)
+    a_idx = b.add(faces.reshape(-1), target=34963)        # ELEMENT_ARRAY
 
     primitive = {
         "attributes": {"POSITION": a_pos, "NORMAL": a_nrm},
@@ -105,8 +153,8 @@ def export_glb(
         "mode": 4,  # TRIANGLES
     }
     if vertex_colors is not None:
-        primitive["attributes"]["COLOR_0"] = add(vertex_colors,
-                                                 target=34962)
+        primitive["attributes"]["COLOR_0"] = b.add(vertex_colors,
+                                                   target=34962)
     gltf = {
         "asset": {"version": "2.0", "generator": "mano_hand_tpu"},
         "scene": 0,
@@ -116,11 +164,7 @@ def export_glb(
     }
 
     if morph_frames is not None:
-        if not fps > 0:
-            # arange/fps would put inf/nan keyframe times into the JSON
-            # chunk (json.dumps emits bare Infinity — invalid glTF that
-            # strict viewers reject with an opaque parse error).
-            raise ValueError(f"fps must be > 0, got {fps}")
+        _check_fps(fps)
         frames = [np.asarray(f, np.float32) for f in morph_frames]
         if not frames:
             raise ValueError("morph_frames is empty")
@@ -131,20 +175,17 @@ def export_glb(
                 )
         targets = []
         for f in frames:
-            targets.append({"POSITION": add(f - verts, target=34962,
-                                            minmax=True)})
+            targets.append({"POSITION": b.add(f - verts, target=34962,
+                                              minmax=True)})
         primitive["targets"] = targets
         t_frames = len(frames)
         gltf["meshes"][0]["weights"] = [0.0] * t_frames
         # One-hot weight tracks sampled at frame times: LINEAR
         # interpolation cross-fades adjacent frames — smooth playback of
         # the clip without shipping per-frame meshes.
-        times = (np.arange(t_frames, dtype=np.float32) / fps)
-        a_time = add(times)
-        accessors[a_time]["min"] = [float(times.min())]
-        accessors[a_time]["max"] = [float(times.max())]
+        a_time = b.add_times(np.arange(t_frames, dtype=np.float32) / fps)
         weights = np.eye(t_frames, dtype=np.float32).reshape(-1)
-        a_wts = add(weights)
+        a_wts = b.add(weights)
         gltf["animations"] = [{
             "name": "clip",
             "samplers": [{
@@ -158,21 +199,177 @@ def export_glb(
             }],
         }]
 
-    bin_chunk = b"".join(buffers)
-    gltf["buffers"] = [{"byteLength": len(bin_chunk)}]
-    gltf["bufferViews"] = views
-    gltf["accessors"] = accessors
+    return b.write(gltf, path)
 
-    json_chunk = _pad4(json.dumps(gltf, separators=(",", ":")).encode(),
-                       b" ")
-    total = 12 + 8 + len(json_chunk) + 8 + len(bin_chunk)
-    with open(path, "wb") as f:
-        f.write(struct.pack("<III", _MAGIC, 2, total))
-        f.write(struct.pack("<II", len(json_chunk), _CHUNK_JSON))
-        f.write(json_chunk)
-        f.write(struct.pack("<II", len(bin_chunk), _CHUNK_BIN))
-        f.write(bin_chunk)
-    return str(path)
+
+def export_glb_skinned(
+    verts: np.ndarray,            # [V, 3] shaped REST-pose vertices
+    faces: np.ndarray,            # [F, 3] int
+    joints_rest: np.ndarray,      # [J, 3] shaped rest-pose joints
+    parents: Sequence[int],       # len J, parents[0] == -1 (root)
+    lbs_weights: np.ndarray,      # [V, J] skinning weights (rows sum to 1)
+    path,
+    pose_frames: Optional[np.ndarray] = None,  # [T, J, 3] axis-angle
+    trans_frames: Optional[np.ndarray] = None,  # [T, 3] root translation
+    fps: float = 30.0,
+    normals: Optional[np.ndarray] = None,
+    vertex_colors: Optional[np.ndarray] = None,
+    max_influences: int = 4,
+) -> str:
+    """Write a SKINNED GLB: real skeleton, LBS weights, rotation tracks.
+
+    The morph-target path (``export_glb``) ships baked vertices — exact
+    (pose correctives included) but frame-count-sized and unposeable
+    after export. This writes the model the way engines actually drive
+    hands: joint nodes in the MANO hierarchy (node translation = rest
+    offset from parent, so glTF's local-rotation compose IS the FK of
+    ops/fk.py — reference semantics /root/reference/mano_np.py:96-110),
+    inverse bind matrices from the rest joints, per-vertex JOINTS_0/
+    WEIGHTS_0, and (optionally) the pose clip as quaternion rotation
+    channels at ``fps`` (+ a root translation track). Any glTF engine
+    can then retarget, blend, or drive the skeleton live.
+
+    Honest divergence from the exact forward: glTF skinning is plain
+    LBS — the pose-corrective blendshapes (mano_np.py:87-91) cannot be
+    encoded in a skin, so posed surfaces differ from ``core.forward`` by
+    the corrective magnitude (millimeter-scale). Export morph targets
+    when exactness beats drivability. glTF caps influences at 4 per set;
+    rows are top-``max_influences`` re-normalized (MANO weights
+    concentrate on <=4 joints, so the dropped mass is tiny).
+    """
+    verts = np.asarray(verts, np.float32)
+    faces = np.asarray(faces, np.uint32)
+    _check_mesh_args(verts, faces)
+    joints_rest = np.asarray(joints_rest, np.float32)
+    w = np.asarray(lbs_weights, np.float32)
+    j = joints_rest.shape[0]
+    if joints_rest.shape != (j, 3) or len(parents) != j:
+        raise ValueError(
+            f"joints_rest {joints_rest.shape} / parents len {len(parents)} "
+            "disagree")
+    if parents[0] != -1 and parents[0] is not None:
+        raise ValueError(f"parents[0] must mark the root, got {parents[0]}")
+    if w.shape != (verts.shape[0], j):
+        raise ValueError(f"lbs_weights must be [V, {j}], got {w.shape}")
+    if not (1 <= max_influences <= 4):
+        raise ValueError("max_influences must be in 1..4 (glTF set size)")
+    if trans_frames is not None and pose_frames is None:
+        # Refuse rather than silently drop the caller's clip (every other
+        # bad input here raises; this one must too).
+        raise ValueError("trans_frames requires pose_frames (the root "
+                         "translation track rides the same keyframes)")
+    if normals is None:
+        normals = _vertex_normals_np(verts, faces)
+    normals = np.asarray(normals, np.float32)
+
+    b = _Builder()
+    add = b.add
+
+    # Top-k influence selection, re-normalized (glTF: 4 per attribute set).
+    order = np.argsort(-w, axis=1)[:, :max_influences]        # [V, k]
+    sel = np.take_along_axis(w, order, axis=1)                # [V, k]
+    sel = sel / np.maximum(sel.sum(axis=1, keepdims=True), 1e-12)
+    k = max_influences
+    joints0 = np.zeros((verts.shape[0], 4), np.uint8)
+    weights0 = np.zeros((verts.shape[0], 4), np.float32)
+    joints0[:, :k] = order.astype(np.uint8)
+    weights0[:, :k] = sel
+
+    a_pos = add(verts, target=34962, minmax=True)
+    a_nrm = add(normals, target=34962)
+    a_idx = add(faces.reshape(-1), target=34963)
+    a_j0 = add(joints0, target=34962)          # uint8 -> UNSIGNED_BYTE
+    a_w0 = add(weights0, target=34962)
+
+    primitive = {
+        "attributes": {"POSITION": a_pos, "NORMAL": a_nrm,
+                       "JOINTS_0": a_j0, "WEIGHTS_0": a_w0},
+        "indices": a_idx,
+        "mode": 4,
+    }
+    if vertex_colors is not None:
+        primitive["attributes"]["COLOR_0"] = add(
+            _check_colors(vertex_colors, verts), target=34962)
+
+    # Joint nodes: local translation = rest offset from parent; the mesh
+    # node (0) carries the skin, joints are nodes 1..J in input order.
+    nodes = [{"mesh": 0, "skin": 0, "name": "hand"}]
+    for jj in range(j):
+        par = parents[jj]
+        off = (joints_rest[jj] if (par is None or par < 0)
+               else joints_rest[jj] - joints_rest[par])
+        nodes.append({"name": f"joint_{jj}",
+                      "translation": [float(x) for x in off]})
+    for jj in range(j):
+        par = parents[jj]
+        if par is not None and par >= 0:
+            nodes[1 + par].setdefault("children", []).append(1 + jj)
+
+    # Inverse bind matrices: rotation-free rest pose -> translate(-p_j),
+    # column-major per glTF.
+    ibm = np.tile(np.eye(4, dtype=np.float32).reshape(1, 16), (j, 1))
+    ibm[:, 12:15] = -joints_rest
+    a_ibm = add(ibm)
+
+    gltf = {
+        "asset": {"version": "2.0", "generator": "mano_hand_tpu"},
+        "scene": 0,
+        "scenes": [{"nodes": [0, 1]}],
+        "nodes": nodes,
+        "meshes": [{"primitives": [primitive]}],
+        "skins": [{"inverseBindMatrices": a_ibm,
+                   "joints": list(range(1, j + 1)),
+                   "skeleton": 1}],
+    }
+
+    if pose_frames is not None:
+        _check_fps(fps)
+        pose_frames = np.asarray(pose_frames, np.float32)
+        if pose_frames.ndim != 3 or pose_frames.shape[1:] != (j, 3):
+            raise ValueError(
+                f"pose_frames must be [T, {j}, 3] axis-angle, got "
+                f"{pose_frames.shape}")
+        t_frames = pose_frames.shape[0]
+        a_time = b.add_times(np.arange(t_frames, dtype=np.float32) / fps)
+
+        # Axis-angle -> unit quaternion [x, y, z, w] per joint track.
+        theta = np.linalg.norm(pose_frames, axis=-1, keepdims=True)
+        half = 0.5 * theta
+        # sin(x)/x, series-guarded at zero like ops/rodrigues.py.
+        small = theta < 1e-6
+        sinc = np.where(small, 0.5 - theta * theta / 48.0,
+                        np.sin(half) / np.maximum(theta, 1e-12))
+        quat = np.concatenate(
+            [pose_frames * sinc, np.cos(half)], axis=-1
+        ).astype(np.float32)                                 # [T, J, 4]
+
+        samplers = []
+        channels = []
+        for jj in range(j):
+            a_rot = add(np.ascontiguousarray(quat[:, jj, :]))
+            samplers.append({"input": a_time,
+                             "interpolation": "LINEAR",
+                             "output": a_rot})
+            channels.append({"sampler": len(samplers) - 1,
+                             "target": {"node": 1 + jj,
+                                        "path": "rotation"}})
+        if trans_frames is not None:
+            trans_frames = np.asarray(trans_frames, np.float32)
+            if trans_frames.shape != (t_frames, 3):
+                raise ValueError(
+                    f"trans_frames must be [{t_frames}, 3], got "
+                    f"{trans_frames.shape}")
+            # Root translation composes with the root's rest offset.
+            a_tr = add(trans_frames + joints_rest[0])
+            samplers.append({"input": a_time,
+                             "interpolation": "LINEAR",
+                             "output": a_tr})
+            channels.append({"sampler": len(samplers) - 1,
+                             "target": {"node": 1, "path": "translation"}})
+        gltf["animations"] = [{"name": "clip", "samplers": samplers,
+                               "channels": channels}]
+
+    return b.write(gltf, path)
 
 
 def _vertex_normals_np(verts: np.ndarray, faces: np.ndarray) -> np.ndarray:

@@ -43,6 +43,7 @@ while true; do
   if timeout -k 60 "$ATTEMPT_TIMEOUT_S" \
       python bench.py --role builder --pallas-sweep full \
       --init-retries 8 --init-timeout 120 --init-budget 900 --iters 10 \
+      --profile "$OUT.trace" \
       "$@" > "$OUT.out" 2>> "$OUT.log"; then
     echo "[bench-tpu-wait] bench complete -> $OUT.out" >&2
     cat "$OUT.out"

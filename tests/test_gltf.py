@@ -198,3 +198,177 @@ def test_cli_animate_glb(params32, tmp_path, capsys):
     assert "animated GLB" in capsys.readouterr().out
     g = read_glb(out)["gltf"]
     assert len(g["meshes"][0]["primitives"][0]["targets"]) == 3
+
+
+# ---------------------------------------------------------------- skinned GLB
+def _decode_accessor(g, blob, idx):
+    """Minimal accessor decode for integrity tests."""
+    acc = g["accessors"][idx]
+    view = g["bufferViews"][acc["bufferView"]]
+    dt = {5126: np.float32, 5125: np.uint32, 5121: np.uint8}[
+        acc["componentType"]]
+    n_comp = {"SCALAR": 1, "VEC3": 3, "VEC4": 4, "MAT4": 16}[acc["type"]]
+    off = view.get("byteOffset", 0)
+    raw = blob[off:off + view["byteLength"]]
+    arr = np.frombuffer(raw, dt)[: acc["count"] * n_comp]
+    return arr.reshape(acc["count"], n_comp) if n_comp > 1 else arr
+
+
+def _gltf_skin_eval(g, blob, frame):
+    """Evaluate the exported glTF skin at one animation frame in numpy —
+    node-local quaternion rotations composed down the hierarchy exactly
+    as a glTF engine would, then the standard skin matrix apply."""
+    prim = g["meshes"][0]["primitives"][0]
+    verts = _decode_accessor(g, blob, prim["attributes"]["POSITION"])
+    j0 = _decode_accessor(g, blob, prim["attributes"]["JOINTS_0"])
+    w0 = _decode_accessor(g, blob, prim["attributes"]["WEIGHTS_0"])
+    skin = g["skins"][0]
+    ibm = _decode_accessor(g, blob, skin["inverseBindMatrices"])
+    joints = skin["joints"]
+
+    rot = {c["target"]["node"]: _decode_accessor(
+        g, blob, g["animations"][0]["samplers"][c["sampler"]]["output"])
+        for c in g["animations"][0]["channels"]
+        if c["target"]["path"] == "rotation"}
+
+    def quat_mat(q):
+        x, y, z, w = q
+        return np.array([
+            [1 - 2 * (y * y + z * z), 2 * (x * y - z * w),
+             2 * (x * z + y * w)],
+            [2 * (x * y + z * w), 1 - 2 * (x * x + z * z),
+             2 * (y * z - x * w)],
+            [2 * (x * z - y * w), 2 * (y * z + x * w),
+             1 - 2 * (x * x + y * y)],
+        ])
+
+    world = {}
+
+    def global_tf(node_idx):
+        if node_idx in world:
+            return world[node_idx]
+        node = g["nodes"][node_idx]
+        local = np.eye(4)
+        local[:3, 3] = node.get("translation", [0, 0, 0])
+        if node_idx in rot:
+            local[:3, :3] = quat_mat(rot[node_idx][frame])
+        parent = next((i for i, n in enumerate(g["nodes"])
+                       if node_idx in n.get("children", [])), None)
+        out = (global_tf(parent) @ local) if parent is not None else local
+        world[node_idx] = out
+        return out
+
+    mats = np.stack([global_tf(n) @ ibm[i].reshape(4, 4).T
+                     for i, n in enumerate(joints)])      # [J, 4, 4]
+    vh = np.concatenate([verts, np.ones((verts.shape[0], 1))], axis=1)
+    per_joint = np.einsum("jab,vb->vja", mats, vh)[..., :3]
+    w_full = np.zeros((verts.shape[0], len(joints)))
+    np.put_along_axis(w_full, j0.astype(np.int64), w0, axis=1)
+    return np.einsum("vj,vja->va", w_full, per_joint)
+
+
+def test_skinned_glb_matches_forward_lbs(params32, tmp_path):
+    """The exported skin, evaluated the way a glTF engine evaluates it,
+    must reproduce core.forward exactly on an asset where glTF's two
+    approximations vanish (pose correctives zeroed; weights already
+    4-sparse)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.io.gltf import export_glb_skinned
+    from mano_hand_tpu.models import core
+
+    w = np.asarray(params32.lbs_weights)
+    order = np.argsort(-w, axis=1)
+    w4 = np.zeros_like(w)
+    np.put_along_axis(w4, order[:, :4],
+                      np.take_along_axis(w, order[:, :4], axis=1), axis=1)
+    w4 = w4 / w4.sum(axis=1, keepdims=True)
+    p = dataclasses.replace(
+        params32,
+        lbs_weights=w4.astype(np.float32),
+        pose_basis=np.zeros_like(np.asarray(params32.pose_basis)),
+    )
+
+    rng = np.random.default_rng(5)
+    poses = rng.normal(scale=0.5, size=(3, 16, 3)).astype(np.float32)
+    rest = core.forward(p, jnp.zeros((16, 3), jnp.float32),
+                        jnp.zeros(10, jnp.float32))
+    out = tmp_path / "skin.glb"
+    export_glb_skinned(
+        np.asarray(rest.verts), np.asarray(p.faces),
+        np.asarray(rest.joints), p.parents,
+        np.asarray(p.lbs_weights), out, pose_frames=poses, fps=30.0,
+    )
+    parsed = read_glb(out)
+    g, blob = parsed["gltf"], parsed["bin"]
+    assert len(g["skins"][0]["joints"]) == 16
+    assert len(g["animations"][0]["channels"]) == 16
+
+    for t in range(3):
+        want = np.asarray(core.forward(
+            p, jnp.asarray(poses[t]), jnp.zeros(10, jnp.float32)).verts)
+        got = _gltf_skin_eval(g, blob, t)
+        err = np.abs(got - want).max()
+        assert err < 1e-5, f"frame {t}: {err}"
+
+
+def test_skinned_glb_validation(params32, tmp_path):
+    from mano_hand_tpu.io.gltf import export_glb_skinned
+    from mano_hand_tpu.models import core
+
+    import jax.numpy as jnp
+
+    rest = core.forward(params32, jnp.zeros((16, 3), jnp.float32),
+                        jnp.zeros(10, jnp.float32))
+    verts = np.asarray(rest.verts)
+    faces = np.asarray(params32.faces)
+    joints = np.asarray(rest.joints)
+    w = np.asarray(params32.lbs_weights)
+    out = tmp_path / "x.glb"
+    with pytest.raises(ValueError, match="parents\\[0\\]"):
+        export_glb_skinned(verts, faces, joints, (0,) * 16, w, out)
+    with pytest.raises(ValueError, match="lbs_weights"):
+        export_glb_skinned(verts, faces, joints, params32.parents,
+                           w[:, :8], out)
+    with pytest.raises(ValueError, match="pose_frames"):
+        export_glb_skinned(verts, faces, joints, params32.parents, w, out,
+                           pose_frames=np.zeros((2, 16, 2)))
+    with pytest.raises(ValueError, match="max_influences"):
+        export_glb_skinned(verts, faces, joints, params32.parents, w, out,
+                           max_influences=5)
+    with pytest.raises(ValueError, match="trans_frames"):
+        export_glb_skinned(verts, faces, joints, params32.parents, w, out,
+                           pose_frames=np.zeros((2, 16, 3)),
+                           trans_frames=np.zeros((3, 3)))
+    # trans_frames without pose_frames must refuse, not silently write a
+    # static GLB with the caller's clip dropped.
+    with pytest.raises(ValueError, match="requires pose_frames"):
+        export_glb_skinned(verts, faces, joints, params32.parents, w, out,
+                           trans_frames=np.zeros((3, 3)))
+    with pytest.raises(ValueError, match="faces must be"):
+        export_glb_skinned(verts, np.zeros((10, 4), np.uint32), joints,
+                           params32.parents, w, out)
+
+
+def test_cli_animate_skinned(params32, tmp_path, capsys):
+    from mano_hand_tpu.cli import main
+    from mano_hand_tpu.assets import save_npz
+
+    asset = tmp_path / "asset.npz"
+    save_npz(params32, asset)
+    poses = np.zeros((4, 16, 3), np.float32)
+    poses[:, 2, 0] = np.linspace(0, 0.8, 4)
+    ppath = tmp_path / "poses.npy"
+    np.save(ppath, poses)
+    out = tmp_path / "clip.glb"
+    rc = main(["animate", str(ppath), "--asset", str(asset), "--skinned",
+               "--out", str(out), "--fps", "24"])
+    assert rc == 0
+    assert "skinned GLB" in capsys.readouterr().out
+    g = read_glb(out)["gltf"]
+    prim = g["meshes"][0]["primitives"][0]
+    assert "JOINTS_0" in prim["attributes"]
+    assert "targets" not in prim          # rotations, not morphs
+    assert len(g["animations"][0]["channels"]) == 16
