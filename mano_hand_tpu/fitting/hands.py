@@ -84,6 +84,8 @@ def fit_hands(
     robust_scale: float = 0.01,
     pose_prior_weight: float = 0.0,
     shape_prior_weight: float = 0.0,
+    joint_limits=None,          # (lo, hi), each [45] shared or [2, 45]
+    joint_limit_weight: float = 1.0,
     repulsion_weight: float = 0.0,
     repulsion_radius: float = 0.004,
     init: Optional[dict] = None,
@@ -207,6 +209,14 @@ def fit_hands(
             pose_prior_weight * objectives.l2_prior(p["pose"][:, 1:])
             + shape_prior_weight * objectives.l2_prior(p["shape"])
         )
+        if joint_limits is not None:
+            # Bounds broadcast [45] (shared) or [2, 45] (per-hand —
+            # mirrored sides have mirrored boxes, see
+            # objectives.mirror_pose_limits) against [2, 45] poses.
+            lo, hi = joint_limits
+            reg = reg + joint_limit_weight * objectives.pose_limit_prior(
+                p["pose"][:, 1:].reshape(2, -1), lo, hi
+            )
         # repulsion_weight rides as a traced operand (hyperparameter
         # sweeps reuse one program), so the term is always computed;
         # at ~2x778^2 pairwise distances it is small next to the forward.
@@ -259,6 +269,8 @@ def fit_hands_sequence(
     smooth_trans_weight: float = 1e-3,
     pose_prior_weight: float = 0.0,
     shape_prior_weight: float = 1e-3,
+    joint_limits=None,          # (lo, hi), each [45] shared or [2, 45]
+    joint_limit_weight: float = 1.0,
     repulsion_weight: float = 0.0,
     repulsion_radius: float = 0.004,
     tip_vertex_ids=None,
@@ -366,6 +378,14 @@ def fit_hands_sequence(
             + pose_prior_weight * objectives.l2_prior(p["pose"][:, :, 1:])
             + shape_prior_weight * objectives.l2_prior(p["shape"])
         )
+        if joint_limits is not None:
+            # [T, 2, 45] against [45]/[2, 45] bounds — frames and hands
+            # both broadcast into the hinge's mean.
+            lo, hi = joint_limits
+            reg = reg + joint_limit_weight * objectives.pose_limit_prior(
+                p["pose"][:, :, 1:].reshape(
+                    p["pose"].shape[0], 2, -1), lo, hi
+            )
         verts = out.verts + offset
         # inter_penetration broadcasts over the frame axis: [T, V, 3]
         # per hand -> mean over frames comes out of the hinge means.

@@ -377,6 +377,25 @@ def _flat_articulated(params, poses) -> jnp.ndarray:
     return poses.reshape(poses.shape[0], n_aa)
 
 
+def mirror_pose_limits(lo, hi):
+    """Right-hand bounds from left-hand ones (or vice versa).
+
+    The official assets relate the two sides by negating the y/z
+    axis-angle components per joint (the scan extractor's [1, -1, -1]
+    mirror, /root/reference/dump_model.py:38). Negation swaps AND flips
+    a bound pair, so for those components ``lo' = -hi`` and
+    ``hi' = -lo``; the x (flexion) component carries over unchanged.
+    Use with ``fit_hands(joint_limits=(stack([lo, lo']), stack([hi,
+    hi'])))`` when the corpus covers only one side.
+    """
+    lo = jnp.asarray(lo)
+    hi = jnp.asarray(hi)
+    sign = jnp.tile(jnp.asarray([1.0, -1.0, -1.0], lo.dtype),
+                    lo.shape[-1] // 3)
+    flipped = sign < 0
+    return (jnp.where(flipped, -hi, lo), jnp.where(flipped, -lo, hi))
+
+
 def pose_component_variances(params, poses) -> jnp.ndarray:
     """Per-component variances of a pose corpus in PCA component space.
 

@@ -290,3 +290,61 @@ def test_fit_hands_validations(stacked, params_pair):
     with pytest.raises(ValueError, match="init"):
         fit_hands(stacked, targets, n_steps=2,
                   init={"pose": np.zeros((16, 3), np.float32)})
+
+
+def test_mirror_pose_limits_roundtrip():
+    from mano_hand_tpu.fitting import mirror_pose_limits, pose_limit_prior
+
+    rng = np.random.default_rng(41)
+    lo = rng.uniform(-0.5, 0.0, size=45).astype(np.float32)
+    hi = rng.uniform(0.1, 1.0, size=45).astype(np.float32)
+    rlo, rhi = mirror_pose_limits(lo, hi)
+    # Valid box, involutive mirror.
+    assert (np.asarray(rlo) <= np.asarray(rhi)).all()
+    blo, bhi = mirror_pose_limits(rlo, rhi)
+    np.testing.assert_allclose(np.asarray(blo), lo, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(bhi), hi, atol=1e-7)
+    # A pose inside the left box lands inside the right box under the
+    # [1, -1, -1] per-joint mirror — and exactly on the hinge's zero set.
+    pose = rng.uniform(lo, hi).astype(np.float32).reshape(15, 3)
+    mirrored = (pose * np.asarray([1.0, -1.0, -1.0],
+                                  np.float32)).reshape(1, 45)
+    assert float(pose_limit_prior(mirrored, rlo, rhi)) == 0.0
+    assert float(pose_limit_prior(pose.reshape(1, 45), lo, hi)) == 0.0
+
+
+def test_fit_hands_joint_limits_per_hand(stacked):
+    from mano_hand_tpu.fitting import mirror_pose_limits
+
+    pose, shape, trans, targets = _two_hand_targets(stacked, seed=5)
+    flat_l = np.asarray(pose)[0, 1:].reshape(45)
+    flat_r = np.asarray(pose)[1, 1:].reshape(45)
+    lo = np.minimum(flat_l, flat_r) - 0.25
+    hi = np.maximum(flat_l, flat_r) + 0.25
+    limits = (jnp.asarray(np.stack([lo, lo])),
+              jnp.asarray(np.stack([hi, hi])))
+    res = fit_hands(stacked, targets, n_steps=300, lr=0.05,
+                    fit_trans=True, joint_limits=limits,
+                    joint_limit_weight=1.0)
+    got = np.asarray(res.pose)[:, 1:].reshape(2, 45)
+    assert (got > lo - 0.05).all() and (got < hi + 0.05).all()
+    out = _forward2(stacked, res.pose, res.shape)
+    verts = out.verts + res.trans[:, None, :]
+    assert float(jnp.abs(verts - targets).max()) < 8e-3
+    # mirror helper integrates: right bounds derived from left-only data
+    # keep the same broadcast contract ([2, 45] box).
+    rlo, rhi = mirror_pose_limits(lo, hi)
+    limits2 = (jnp.stack([jnp.asarray(lo), rlo]),
+               jnp.stack([jnp.asarray(hi), rhi]))
+    res2 = fit_hands(stacked, targets, n_steps=5, lr=0.05,
+                     fit_trans=True, joint_limits=limits2)
+    assert np.isfinite(np.asarray(res2.final_loss)).all()
+
+    # Sequence variant: same broadcast contract over [T, 2, 45].
+    from mano_hand_tpu.fitting import fit_hands_sequence
+
+    clip = jnp.stack([targets, targets], axis=0)      # [T=2, 2, V, 3]
+    seq = fit_hands_sequence(stacked, clip, n_steps=5, fit_trans=True,
+                             joint_limits=limits,
+                             joint_limit_weight=1.0)
+    assert np.isfinite(np.asarray(seq.final_loss)).all()
