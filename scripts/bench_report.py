@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Summarize a bench run against targets and a reference run.
+
+    python scripts/bench_report.py bench_results/r04_tpu.out \
+        [--ref bench_results/r03_tpu_full1.json]
+
+Reads either a raw `bench.py` stdout line or a driver BENCH_r{N}.json
+wrapper ({"parsed": {...}}), prints the round-4 done-criteria
+(VERDICT.md r3 "Next round"): headline >= 13 M evals/s with gates green,
+config3 (B=65536) >= 0.85x headline, LM steps/s, config6 populated,
+sweep-stability hysteresis — and the per-key delta vs the reference run.
+Exit code 0 iff every applicable done-criterion passes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_line(path: str) -> dict:
+    with open(path) as f:
+        text = f.read().strip()
+    data = json.loads(text.splitlines()[-1] if "\n" in text else text)
+    if "parsed" in data and isinstance(data["parsed"], dict):
+        data = data["parsed"]
+    return data
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("run")
+    ap.add_argument("--ref", default="bench_results/r03_tpu_full1.json")
+    args = ap.parse_args()
+
+    line = load_line(args.run)
+    detail = line.get("detail", {})
+    try:
+        ref = load_line(args.ref).get("detail", {})
+    except OSError:
+        ref = {}
+
+    headline = line.get("value")
+    print(f"headline: {headline and f'{headline:,.0f}'} evals/s "
+          f"(vs_baseline {line.get('vs_baseline')})  "
+          f"device={line.get('device')}")
+    if line.get("error"):
+        print(f"ERROR: {line['error']}")
+        return 1
+
+    checks = []
+
+    def check(name, ok, msg):
+        checks.append((name, bool(ok)))
+        print(f"  [{'PASS' if ok else 'FAIL'}] {name}: {msg}")
+
+    check("headline_13M", headline and headline >= 13e6,
+          f"{headline:,.0f} vs the >=13 M floor (target 20 M)")
+    err = line.get("max_err_vs_numpy")
+    check("accuracy_gate", err is not None and err < 1e-4,
+          f"max err vs f64 oracle {err}")
+
+    c3 = detail.get("config3_fused_full_chunked_evals_per_sec")
+    if c3 and headline:
+        ratio = c3 / headline
+        check("config3_085x", ratio >= 0.85,
+              f"B=65536 at {c3:,.0f} = {ratio:.2f}x headline "
+              f"(chunk_size={detail.get('config3_fused_full_chunk_size')})")
+    lm = detail.get("config4_lm_steps_per_sec")
+    if lm is not None:
+        check("lm_180", lm >= 180,
+              f"{lm:,.1f} steps/s "
+              f"({detail.get('config4_lm_jacobian')} Jacobian)")
+    c6 = detail.get("config6_sil_renders_per_sec")
+    check("config6_populated", c6 is not None,
+          f"silhouette {c6} / depth "
+          f"{detail.get('config6_depth_renders_per_sec')} renders/s, "
+          f"mask fit {detail.get('config6_sil_fit_steps_per_sec')} steps/s")
+
+    for key in ("fused_full_sweep_stability", "fused_sweep_stability",
+                "pallas_sweep_stability"):
+        stab = detail.get(key)
+        if stab:
+            h = stab.get("hysteresis_pct")
+            print(f"  [info] {key}: first {stab.get('first'):,} -> "
+                  f"remeasured {stab.get('remeasured'):,} "
+                  f"(drift {h}%)")
+
+    if ref:
+        print("vs reference run:")
+        for k in sorted(set(detail) & set(ref)):
+            a, b = detail[k], ref[k]
+            if (isinstance(a, (int, float)) and isinstance(b, (int, float))
+                    and b and "per_sec" in k):
+                print(f"  {k}: {a:,.0f} vs {b:,.0f} ({a / b - 1:+.1%})")
+
+    bad = [n for n, ok in checks if not ok]
+    print("RESULT: " + ("ALL DONE-CRITERIA PASS" if not bad
+                        else f"failing: {', '.join(bad)}"))
+    return 0 if not bad else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,16 +40,40 @@ while true; do
     sleep 120
     continue
   fi
-  if timeout -k 60 "$ATTEMPT_TIMEOUT_S" \
+  # Run the attempt in the background and poll the driver claim while it
+  # is in flight: "stand down when another bench wants the device" must
+  # hold MID-ATTEMPT too, not just between attempts — a full bench takes
+  # tens of minutes and the driver must never contend with its tail.
+  timeout -k 60 "$ATTEMPT_TIMEOUT_S" \
       python bench.py --role builder --pallas-sweep full \
       --init-retries 8 --init-timeout 120 --init-budget 900 --iters 10 \
       --profile "$OUT.trace" \
-      "$@" > "$OUT.out" 2>> "$OUT.log"; then
+      "$@" > "$OUT.out" 2>> "$OUT.log" &
+  BPID=$!
+  preempted=0
+  while kill -0 "$BPID" 2>/dev/null; do
+    if claim_fresh; then
+      echo "[bench-tpu-wait] driver claim appeared mid-attempt; yielding" >&2
+      kill -TERM "$BPID" 2>/dev/null
+      sleep 5
+      kill -KILL "$BPID" 2>/dev/null
+      preempted=1
+      break
+    fi
+    sleep 15
+  done
+  wait "$BPID"
+  rc=$?
+  if [ "$preempted" -eq 1 ]; then
+    echo "[bench-tpu-wait] standing down 300s for the driver" >&2
+    sleep 300
+    continue
+  fi
+  if [ "$rc" -eq 0 ]; then
     echo "[bench-tpu-wait] bench complete -> $OUT.out" >&2
     cat "$OUT.out"
     exit 0
   fi
-  rc=$?
   if [ "$rc" -eq 2 ]; then
     echo "[bench-tpu-wait] device busy (driver running); standing down 120s" >&2
     sleep 120
