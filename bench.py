@@ -915,6 +915,7 @@ def run_benchmarks(args, device_str: str) -> dict:
         hardware the same code emits the scaling curve with zero changes
         (VERDICT r3 item 7; SURVEY.md §2.2). Run via `make mesh-scaling`.
         """
+        import functools as _ft
         import re
 
         import optax
@@ -942,13 +943,10 @@ def run_benchmarks(args, device_str: str) -> dict:
                     "collective-permute", "all-to-all")
 
         def count_collectives(hlo: str) -> dict:
-            found = {op: len(re.findall(rf"\b{op}(?:-start)?\b[^\n]*=|"
-                                        rf"= {op}", hlo))
+            # HLO text puts the op name right before its operand list:
+            # `%x = f32[...] all-reduce(...)` / `all-gather-start(...)`.
+            found = {op: len(re.findall(rf"\s{op}(?:-start)?\(", hlo))
                      for op in coll_ops}
-            # robust fallback: plain substring hits on op names
-            for op in coll_ops:
-                if not found[op]:
-                    found[op] = len(re.findall(rf"{op}(?:-start)?\(", hlo))
             return {k: v for k, v in found.items() if v}
 
         for d in counts:
@@ -964,8 +962,6 @@ def run_benchmarks(args, device_str: str) -> dict:
                 out_shardings=data_sh,
             )
             fwd_hlo = fwd.lower(right, pose_d, beta_d).compile().as_text()
-
-            import functools as _ft
 
             @_ft.partial(jax.jit, static_argnums=3,
                          in_shardings=(None, data_sh, data_sh),

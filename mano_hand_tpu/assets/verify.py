@@ -83,9 +83,10 @@ class VerifyReport:
 def _digest(arr: np.ndarray) -> str:
     """Canonical SHA-256: f64 (int64 for faces) C-order bytes, shape-tagged
     so e.g. a transposed regressor cannot collide."""
+    arr = np.asarray(arr)
     a = np.ascontiguousarray(
-        np.asarray(arr),
-        dtype=np.int64 if np.issubdtype(np.asarray(arr).dtype, np.integer)
+        arr,
+        dtype=np.int64 if np.issubdtype(arr.dtype, np.integer)
         else np.float64,
     )
     h = hashlib.sha256()

@@ -859,8 +859,9 @@ def cmd_verify(args) -> int:
     # the decoded asset is audited at the user's machine instead —
     # structural gates, numeric invariants, and canonical digests
     # (assets/verify.py has the full contract).
-    from mano_hand_tpu.assets.verify import format_report, report_json, \
-        verify_asset
+    from mano_hand_tpu.assets.verify import (
+        format_report, report_json, verify_asset,
+    )
 
     try:
         report = verify_asset(args.asset, side=args.side,
