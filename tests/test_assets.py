@@ -185,3 +185,40 @@ def test_official_pickle_without_chumpy(params, tmp_path):
 
 # Pre-commit quick lane: core correctness, seconds-scale (make check-quick).
 pytestmark = __import__("pytest").mark.quick
+
+
+def test_loader_failure_paths_are_named(params, tmp_path):
+    """Malformed inputs fail with NAMED errors at load time, not XLA
+    shape errors deep in a trace (the schema.validate contract) — the
+    failure half of the `cli verify` trust story."""
+    from mano_hand_tpu.assets import load_model, load_npz, save_npz
+
+    # Truncated npz: numpy's own error surfaces, not a silent partial.
+    good = tmp_path / "good.npz"
+    save_npz(params, good)
+    trunc = tmp_path / "trunc.npz"
+    trunc.write_bytes(good.read_bytes()[:200])
+    with pytest.raises(Exception):
+        load_npz(trunc)
+
+    # Missing keys: named KeyError/ValueError mentioning the field.
+    arrs = dict(np.load(good, allow_pickle=False))
+    arrs.pop("lbs_weights")
+    partial = tmp_path / "partial.npz"
+    np.savez(partial, **arrs)
+    with pytest.raises((KeyError, ValueError)):
+        load_npz(partial)
+
+    # Wrong-shape field: schema.validate names the field and both shapes.
+    arrs = dict(np.load(good, allow_pickle=False))
+    arrs["lbs_weights"] = arrs["lbs_weights"][:, :8]
+    bad = tmp_path / "bad.npz"
+    np.savez(bad, **arrs)
+    with pytest.raises(ValueError, match="lbs_weights"):
+        load_npz(bad)
+
+    # Not an asset at all: load_model's sniffing fails loudly.
+    junk = tmp_path / "junk.pkl"
+    junk.write_bytes(b"\x00\x01garbage")
+    with pytest.raises(Exception):
+        load_model(junk)
