@@ -14,6 +14,10 @@ from mano_hand_tpu.fitting.objectives import (
     self_penetration_mask,
     vertex_l2,
 )
+from mano_hand_tpu.fitting.initialize import (
+    initialize_from_joints,
+    rigid_align,
+)
 from mano_hand_tpu.fitting.hands import (
     HandsFitResult,
     HandsSequenceFitResult,
@@ -68,5 +72,7 @@ __all__ = [
     "pose_component_variances",
     "pose_limit_prior",
     "pose_limits_from_corpus",
+    "initialize_from_joints",
+    "rigid_align",
     "max_vertex_error",
 ]

@@ -1,0 +1,117 @@
+"""Analytic fit initialization: closed-form global pose from keypoints.
+
+The iterative solvers own articulation, but they are LOCAL: a fit seeded
+at the rest orientation routinely locks into a wrong basin when the
+observed hand is rotated far from it (the failure mode
+``fitting.restarts`` brute-forces with R restarts x full solves). This
+module replaces that brute force for the common case where 3D keypoints
+exist: the optimal rigid alignment of the rest skeleton to the observed
+keypoints has a CLOSED FORM (Kabsch, one 3x3 SVD), and its rotation /
+translation drop directly into ``fit``/``fit_lm``'s warm-start ``init``
+dict. One SVD instead of R full solves.
+
+Reference root: the reference has no fitting at all — its only "global
+pose" handling is the demo's hardcoded ``global_rot=[1,0,0]``
+(/root/reference/mano_np.py:213). Convention note: the model rotates
+about the ROOT JOINT (FK pivots the root at its rest position,
+ops/fk.py), so the recovered translation compensates the pivot —
+``model(x) = R (x - j0) + j0 + T`` is matched against the Kabsch frame
+``target ~= R x + tau`` by ``T = tau + R j0 - j0``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from mano_hand_tpu import ops
+from mano_hand_tpu.models import core
+
+
+def rigid_align(src: jnp.ndarray, dst: jnp.ndarray):
+    """Kabsch: the rotation/translation minimizing ||R src + t - dst||^2.
+
+    ``src``/``dst`` are [..., K, 3] paired points (K >= 3, not all
+    collinear). Returns ``(rot [..., 3, 3], t [..., 3])``; proper
+    rotations only (det +1 — reflections are folded out the standard
+    way, by flipping the smallest singular direction).
+    """
+    src = jnp.asarray(src)
+    dst = jnp.asarray(dst)
+    c_src = src.mean(axis=-2, keepdims=True)
+    c_dst = dst.mean(axis=-2, keepdims=True)
+    h = jnp.einsum("...ka,...kb->...ab", src - c_src, dst - c_dst)
+    u, _, vt = jnp.linalg.svd(h)
+    det = jnp.linalg.det(jnp.einsum("...ab,...bc->...ac",
+                                    jnp.swapaxes(vt, -1, -2),
+                                    jnp.swapaxes(u, -1, -2)))
+    flip = jnp.concatenate(
+        [jnp.ones_like(det)[..., None], jnp.ones_like(det)[..., None],
+         det[..., None]], axis=-1)
+    rot = jnp.einsum("...ba,...b,...bc->...ac", vt, flip,
+                     jnp.swapaxes(u, -1, -2))
+    t = c_dst[..., 0, :] - jnp.einsum("...ab,...b->...a",
+                                      rot, c_src[..., 0, :])
+    return rot, t
+
+
+def initialize_from_joints(
+    params,
+    target_keypoints: jnp.ndarray,   # [..., K, 3]; K = 16 or 16+tips
+    tip_vertex_ids=None,
+    keypoint_order: str = "mano",
+    shape: Optional[jnp.ndarray] = None,   # [..., S] if already estimated
+) -> dict:
+    """Closed-form ``init`` dict for ``fit``/``fit_lm`` from 3D keypoints.
+
+    Rigidly aligns the REST-pose skeleton (16 joints, plus fingertip
+    vertices when ``tip_vertex_ids`` is given — same spec/order contract
+    as the keypoint data terms) to the observed keypoints and returns
+    ``{"pose": [..., 16, 3] zeros with the global row set,
+    "trans": [..., 3]}`` — feed as ``fit(..., init=..., fit_trans=True)``
+    or drop "trans" for origin-centered problems. Articulation stays at
+    the rest pose: that is the solver's job; this gets it into the right
+    basin in one SVD. Batched targets broadcast.
+    """
+    target_keypoints = jnp.asarray(target_keypoints)
+    dtype = target_keypoints.dtype
+    n_joints = params.j_regressor.shape[0]
+    n_shape = params.shape_basis.shape[-1]
+    zero_pose = jnp.zeros((n_joints, 3), dtype)
+    if shape is None:
+        shape = jnp.zeros((n_shape,), dtype)
+    shape = jnp.asarray(shape, dtype)
+    if shape.ndim == 1:
+        rest = core.forward(params, zero_pose, shape)
+    elif shape.ndim == 2:
+        # Per-problem shape estimates: one rest skeleton each.
+        import jax
+
+        rest = jax.vmap(lambda s: core.forward(params, zero_pose, s))(shape)
+    else:
+        raise ValueError(
+            f"shape must be [S] or [B, S], got {shape.shape}")
+    rest_kp = core.keypoints(rest, tip_vertex_ids, keypoint_order) \
+        if tip_vertex_ids is not None else rest.posed_joints
+    if target_keypoints.shape[-2] != rest_kp.shape[-2]:
+        raise ValueError(
+            f"target has {target_keypoints.shape[-2]} keypoints but the "
+            f"spec yields {rest_kp.shape[-2]} (16 joints"
+            + (" + tips" if tip_vertex_ids is not None else
+               "; pass tip_vertex_ids for 21-keypoint targets") + ")")
+
+    rot, tau = rigid_align(
+        jnp.broadcast_to(rest_kp, target_keypoints.shape), target_keypoints
+    )
+    global_aa = ops.axis_angle_from_matrix(rot)
+
+    # The FK pivots the root rotation at the rest root joint j0, so the
+    # Kabsch frame's tau converts via T = tau + R j0 - j0.
+    j0 = rest.joints[..., 0, :].astype(dtype)
+    trans = tau + jnp.einsum("...ab,...b->...a", rot, j0) - j0
+
+    batch = target_keypoints.shape[:-2]
+    pose = jnp.zeros((*batch, n_joints, 3), dtype)
+    pose = pose.at[..., 0, :].set(global_aa)
+    return {"pose": pose, "trans": trans.astype(dtype)}

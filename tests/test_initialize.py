@@ -1,0 +1,141 @@
+"""Analytic global-pose initialization (fitting/initialize.py).
+
+The claim under test: one Kabsch SVD puts a far-rotated problem into the
+right basin, where the cold-started solver provably is not.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mano_hand_tpu.assets import synthetic_params
+from mano_hand_tpu.fitting import (
+    fit_lm, initialize_from_joints, rigid_align,
+)
+from mano_hand_tpu.models import core
+from mano_hand_tpu import ops
+
+
+@pytest.fixture(scope="module")
+def params32():
+    return synthetic_params(seed=0).astype(np.float32)
+
+
+def test_rigid_align_recovers_known_transform():
+    rng = np.random.default_rng(11)
+    src = rng.normal(size=(3, 30, 3)).astype(np.float32)  # batched
+    aa = rng.normal(scale=1.5, size=(3, 3)).astype(np.float32)
+    rot_true = np.asarray(ops.rotation_matrix(jnp.asarray(aa)))
+    t_true = rng.normal(size=(3, 3)).astype(np.float32)
+    dst = np.einsum("bij,bkj->bki", rot_true, src) + t_true[:, None, :]
+    rot, t = rigid_align(jnp.asarray(src), jnp.asarray(dst))
+    np.testing.assert_allclose(np.asarray(rot), rot_true, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(t), t_true, atol=1e-4)
+    # Proper rotation even for degenerate reflections: mirrored target.
+    dst_m = dst * np.asarray([-1.0, 1.0, 1.0], np.float32)
+    rot_m, _ = rigid_align(jnp.asarray(src), jnp.asarray(dst_m))
+    assert np.allclose(np.asarray(jnp.linalg.det(rot_m)), 1.0, atol=1e-4)
+
+
+def test_initialize_recovers_global_pose(params32):
+    rng = np.random.default_rng(13)
+    pose = np.zeros((16, 3), np.float32)
+    pose[0] = [2.6, 0.9, -0.4]                # far from rest (~2.9 rad)
+    pose[1:] = rng.normal(scale=0.15, size=(15, 3))  # mild articulation
+    trans = np.asarray([0.05, -0.02, 0.11], np.float32)
+    out = core.forward(params32, jnp.asarray(pose),
+                       jnp.zeros(10, jnp.float32))
+    target = out.posed_joints + trans
+
+    init = initialize_from_joints(params32, target)
+    assert init["pose"].shape == (16, 3)
+    # Global rotation within ~articulation noise of the truth.
+    r_est = np.asarray(ops.rotation_matrix(init["pose"][0]))
+    r_true = np.asarray(ops.rotation_matrix(jnp.asarray(pose[0])))
+    ang = np.arccos(np.clip((np.trace(r_est.T @ r_true) - 1) / 2, -1, 1))
+    assert ang < 0.25, f"global rotation off by {ang:.2f} rad"
+    # Rest of the pose row block untouched (articulation is solver work).
+    assert np.abs(np.asarray(init["pose"][1:])).max() == 0.0
+
+    # Alignment quality: the initialized rigid model explains the
+    # skeleton to within the articulation scale.
+    aligned = core.forward(params32, init["pose"],
+                           jnp.zeros(10, jnp.float32))
+    err = np.abs(np.asarray(aligned.posed_joints + init["trans"])
+                 - np.asarray(target)).max()
+    assert err < 0.03, err
+
+
+def test_initialize_puts_lm_on_the_fast_path(params32):
+    """The basin claim, measured: at ~pi global rotation cold LM crawls
+    a plateau for many steps (8e-3 max joint err after 8 — it does
+    eventually escape, ~25 steps on this asset), while LM warm-started
+    from ONE Kabsch SVD is at numerical floor within 5."""
+    rng = np.random.default_rng(17)
+    pose = np.zeros((16, 3), np.float32)
+    pose[0] = [0.0, 3.0, 0.4]
+    pose[1:] = rng.normal(scale=0.2, size=(15, 3))
+    truth = core.forward(params32, jnp.asarray(pose),
+                         jnp.zeros(10, jnp.float32))
+
+    def joint_err(res):
+        got = core.forward(params32, res.pose, res.shape).posed_joints
+        return float(jnp.abs(got - truth.posed_joints).max())
+
+    cold = fit_lm(params32, truth.posed_joints, data_term="joints",
+                  n_steps=8, shape_weight=1.0)
+    init = initialize_from_joints(params32, truth.posed_joints)
+    warm = fit_lm(params32, truth.posed_joints, data_term="joints",
+                  n_steps=8, shape_weight=1.0,
+                  init={"pose": init["pose"]})
+    e_cold, e_warm = joint_err(cold), joint_err(warm)
+    assert e_warm < 1e-6, e_warm
+    assert e_cold > 1e-3, ("cold LM no longer plateaus here — "
+                           "tighten the claim", e_cold)
+
+
+def test_initialize_batched_and_21kp(params32):
+    rng = np.random.default_rng(19)
+    poses = np.zeros((4, 16, 3), np.float32)
+    poses[:, 0] = rng.normal(scale=1.0, size=(4, 3))
+    out = core.forward_batched(params32, jnp.asarray(poses),
+                               jnp.zeros((4, 10), jnp.float32))
+    kp21 = core.keypoints(out, "smplx")
+    init = initialize_from_joints(params32, kp21, tip_vertex_ids="smplx")
+    assert init["pose"].shape == (4, 16, 3)
+    assert init["trans"].shape == (4, 3)
+    for i in range(4):
+        r_est = np.asarray(ops.rotation_matrix(init["pose"][i, 0]))
+        r_true = np.asarray(ops.rotation_matrix(jnp.asarray(poses[i, 0])))
+        ang = np.arccos(np.clip(
+            (np.trace(r_est.T @ r_true) - 1) / 2, -1, 1))
+        assert ang < 0.05, (i, ang)
+
+    with pytest.raises(ValueError, match="pass tip_vertex_ids"):
+        initialize_from_joints(params32, kp21)
+
+
+# Pre-commit quick lane: core correctness, seconds-scale.
+pytestmark = __import__("pytest").mark.quick
+
+
+def test_initialize_batched_shape(params32):
+    rng = np.random.default_rng(23)
+    shapes = rng.normal(scale=0.5, size=(3, 10)).astype(np.float32)
+    poses = np.zeros((3, 16, 3), np.float32)
+    poses[:, 0] = rng.normal(scale=0.8, size=(3, 3))
+    out = core.forward_batched(params32, jnp.asarray(poses),
+                               jnp.asarray(shapes))
+    init = initialize_from_joints(params32, out.posed_joints,
+                                  shape=shapes)
+    assert init["pose"].shape == (3, 16, 3)
+    aligned = core.forward_batched(params32, init["pose"],
+                                   jnp.asarray(shapes))
+    err = np.abs(np.asarray(aligned.posed_joints + init["trans"][:, None])
+                 - np.asarray(out.posed_joints)).max()
+    assert err < 1e-4, err      # rigid-only problem: exact alignment
+    with pytest.raises(ValueError, match="\\[S\\] or \\[B, S\\]"):
+        initialize_from_joints(params32, out.posed_joints,
+                               shape=shapes[None])
