@@ -77,21 +77,7 @@ def initialize_from_joints(
     target_keypoints = jnp.asarray(target_keypoints)
     dtype = target_keypoints.dtype
     n_joints = params.j_regressor.shape[0]
-    n_shape = params.shape_basis.shape[-1]
-    zero_pose = jnp.zeros((n_joints, 3), dtype)
-    if shape is None:
-        shape = jnp.zeros((n_shape,), dtype)
-    shape = jnp.asarray(shape, dtype)
-    if shape.ndim == 1:
-        rest = core.forward(params, zero_pose, shape)
-    elif shape.ndim == 2:
-        # Per-problem shape estimates: one rest skeleton each.
-        import jax
-
-        rest = jax.vmap(lambda s: core.forward(params, zero_pose, s))(shape)
-    else:
-        raise ValueError(
-            f"shape must be [S] or [B, S], got {shape.shape}")
+    rest = _rest_forward(params, shape, dtype)
     rest_kp = core.keypoints(rest, tip_vertex_ids, keypoint_order) \
         if tip_vertex_ids is not None else rest.posed_joints
     if target_keypoints.shape[-2] != rest_kp.shape[-2]:
@@ -101,8 +87,53 @@ def initialize_from_joints(
             + (" + tips" if tip_vertex_ids is not None else
                "; pass tip_vertex_ids for 21-keypoint targets") + ")")
 
+    return _init_from_pairs(rest, rest_kp, target_keypoints, n_joints)
+
+
+def initialize_from_verts(
+    params,
+    target_verts: jnp.ndarray,       # [..., V, 3] full-mesh targets
+    shape: Optional[jnp.ndarray] = None,
+) -> dict:
+    """Same closed form, seeded from DENSE correspondence: rest-pose
+    vertices vs a full [V, 3] target mesh (the ``data_term="verts"``
+    setting — every row is a correspondence, so the alignment is even
+    better conditioned than the 16-joint one)."""
+    target_verts = jnp.asarray(target_verts)
+    dtype = target_verts.dtype
+    n_joints = params.j_regressor.shape[0]
+    rest = _rest_forward(params, shape, dtype)
+    if target_verts.shape[-2] != rest.verts.shape[-2]:
+        raise ValueError(
+            f"target has {target_verts.shape[-2]} rows but the mesh has "
+            f"{rest.verts.shape[-2]} vertices (for unstructured clouds "
+            "use the ICP terms; Kabsch needs correspondences)")
+    return _init_from_pairs(rest, rest.verts, target_verts, n_joints)
+
+
+def _rest_forward(params, shape, dtype):
+    """Rest-pose forward for the init seeds: shape [S], per-problem
+    [B, S] (vmapped), or a named error."""
+    n_joints = params.j_regressor.shape[0]
+    n_shape = params.shape_basis.shape[-1]
+    zero_pose = jnp.zeros((n_joints, 3), dtype)
+    if shape is None:
+        shape = jnp.zeros((n_shape,), dtype)
+    shape = jnp.asarray(shape, dtype)
+    if shape.ndim == 1:
+        return core.forward(params, zero_pose, shape)
+    if shape.ndim == 2:
+        import jax
+
+        return jax.vmap(lambda s: core.forward(params, zero_pose, s))(shape)
+    raise ValueError(f"shape must be [S] or [B, S], got {shape.shape}")
+
+
+def _init_from_pairs(rest, rest_points, target, n_joints) -> dict:
+    """Kabsch on paired points -> the solver init dict (shared tail)."""
+    dtype = target.dtype
     rot, tau = rigid_align(
-        jnp.broadcast_to(rest_kp, target_keypoints.shape), target_keypoints
+        jnp.broadcast_to(rest_points, target.shape), target
     )
     global_aa = ops.axis_angle_from_matrix(rot)
 
@@ -111,7 +142,7 @@ def initialize_from_joints(
     j0 = rest.joints[..., 0, :].astype(dtype)
     trans = tau + jnp.einsum("...ab,...b->...a", rot, j0) - j0
 
-    batch = target_keypoints.shape[:-2]
+    batch = target.shape[:-2]
     pose = jnp.zeros((*batch, n_joints, 3), dtype)
     pose = pose.at[..., 0, :].set(global_aa)
     return {"pose": pose, "trans": trans.astype(dtype)}

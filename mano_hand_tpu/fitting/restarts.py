@@ -37,6 +37,7 @@ def fit_restarts(
     global_rot_scale: float = 0.5,
     component_vars: Optional[jnp.ndarray] = None,
     include_zero: bool = True,
+    include_kabsch: bool = True,
     **solver_kw,
 ):
     """Solve one fitting problem from ``n_restarts`` inits; keep the best.
@@ -48,6 +49,14 @@ def fit_restarts(
     the zero pose as restart 0, so the result is never worse than the
     plain single fit. ``solver_kw`` passes through to ``fitting.fit`` /
     ``fitting.fit_lm`` (data_term, priors, camera, fit_trans, ...).
+
+    ``include_kabsch`` (on by default) additionally seeds one restart
+    from the CLOSED-FORM rigid alignment of the rest model to the
+    target (``fitting.initialize_from_joints``/``_verts`` — applicable
+    to the correspondence terms "verts"/"joints"; silently inapplicable
+    elsewhere): on far-rotated problems that deterministic seed is in
+    the right basin by construction, while sampled restarts only cover
+    rotation space with luck.
 
     Restarts own the warm start, and sampled inits are axis-angle poses
     — ``init=`` and non-default ``pose_space`` are rejected rather than
@@ -87,12 +96,37 @@ def fit_restarts(
     dtype = params.v_template.dtype
     n_joints = params.j_regressor.shape[0]
     n_shape = params.shape_basis.shape[-1]
-    n_sampled = n_restarts - int(include_zero)
+
+    kabsch = None
+    if include_kabsch and target.shape[-1] == 3:
+        from mano_hand_tpu.fitting.initialize import (
+            initialize_from_joints, initialize_from_verts,
+        )
+
+        dt = solver_kw.get("data_term", "verts")
+        if dt == "joints":
+            kabsch = initialize_from_joints(
+                params, target,
+                tip_vertex_ids=solver_kw.get("tip_vertex_ids"),
+                keypoint_order=solver_kw.get("keypoint_order", "mano"),
+            )
+        elif dt == "verts":
+            kabsch = initialize_from_verts(params, target)
+
+    n_sampled = n_restarts - int(include_zero) - int(kabsch is not None)
+    if n_sampled < 0:
+        # No row left for the Kabsch seed (e.g. the long-standing
+        # n_restarts=1 call): drop it rather than break the documented
+        # never-worse-than-a-plain-fit contract.
+        kabsch = None
+        n_sampled = n_restarts - int(include_zero)
     if isinstance(key, int):
         key = jax.random.PRNGKey(key)
     poses = []
     if include_zero:
         poses.append(jnp.zeros((1, n_joints, 3), dtype))
+    if kabsch is not None:
+        poses.append(kabsch["pose"][None].astype(dtype))
     if n_sampled:
         poses.append(core.sample_poses(
             params, key, n_sampled,
@@ -104,7 +138,12 @@ def fit_restarts(
         "shape": jnp.zeros((n_restarts, n_shape), dtype),
     }
     if solver == "adam" and solver_kw.get("fit_trans"):
-        init["trans"] = jnp.zeros((n_restarts, 3), dtype)
+        trans = jnp.zeros((n_restarts, 3), dtype)
+        if kabsch is not None:
+            # The Kabsch row gets its own translation seed too.
+            trans = trans.at[int(include_zero)].set(
+                kabsch["trans"].astype(dtype))
+        init["trans"] = trans
 
     tiled = jnp.broadcast_to(target, (n_restarts, *target.shape))
     if solver == "adam":

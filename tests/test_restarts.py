@@ -90,3 +90,52 @@ def test_restarts_with_trans_and_adam(params32):
     np.testing.assert_allclose(
         np.asarray(best.trans), [0.03, -0.01, 0.02], atol=5e-3
     )
+
+
+def test_kabsch_seed_wins_on_far_rotation(params32):
+    """The deterministic Kabsch restart beats sampling on a ~pi-rotated
+    clean-mesh problem: with only 2 restarts (zero + Kabsch — no room
+    for lucky samples) LM still lands at numerical floor."""
+    from mano_hand_tpu.fitting import fit_restarts
+
+    rng = np.random.default_rng(31)
+    pose = np.zeros((16, 3), np.float32)
+    pose[0] = [0.1, 3.0, 0.3]
+    pose[1:] = rng.normal(scale=0.2, size=(15, 3))
+    truth = core.forward(params32, jnp.asarray(pose),
+                         jnp.zeros(10, jnp.float32))
+
+    best, losses = fit_restarts(
+        params32, truth.verts, n_restarts=2, solver="lm", n_steps=10,
+    )
+    got = core.forward(params32, best.pose, best.shape).verts
+    assert float(jnp.abs(got - truth.verts).max()) < 1e-4
+    # The Kabsch row (index 1, after the zero row) is the winner.
+    assert int(np.argmin(np.asarray(losses))) == 1
+
+    # Disabling it restores the old behavior (and a worse result here).
+    best_no, losses_no = fit_restarts(
+        params32, truth.verts, n_restarts=2, solver="lm", n_steps=10,
+        include_kabsch=False,
+    )
+    assert float(np.min(np.asarray(losses_no))) \
+        > float(np.min(np.asarray(losses)))
+
+    # Inapplicable terms keep working (silently no Kabsch row).
+    cloud = truth.verts[::3]
+    best_icp, _ = fit_restarts(
+        params32, cloud, n_restarts=2, solver="lm", n_steps=4,
+        data_term="points",
+    )
+    assert np.isfinite(float(best_icp.final_loss))
+
+
+def test_kabsch_seed_dropped_at_n1(params32):
+    # Long-standing n_restarts=1 contract: plain zero-init fit, no error.
+    from mano_hand_tpu.fitting import fit_restarts
+
+    target = core.forward(params32).verts
+    best, losses = fit_restarts(params32, target, n_restarts=1,
+                                solver="lm", n_steps=4)
+    assert losses.shape == (1,)
+    assert np.isfinite(float(best.final_loss))
