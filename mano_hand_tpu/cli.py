@@ -463,6 +463,15 @@ def cmd_fit(args) -> int:
         print("--joint-limit-weight without --joint-limits does nothing; "
               "pass the bounds file", file=sys.stderr)
         return 2
+    if args.restarts:
+        if args.init:
+            print("--restarts owns the initialization (zero + Kabsch + "
+                  "sampled seeds); drop --init", file=sys.stderr)
+            return 2
+        if args.restarts < 1:
+            print(f"--restarts must be >= 1, got {args.restarts}",
+                  file=sys.stderr)
+            return 2
     if args.solver == "lm":
         if args.lr is not None:
             print("note: --lr only applies to --solver adam; ignored",
@@ -507,8 +516,17 @@ def cmd_fit(args) -> int:
             print(f"--pose-space {args.pose_space} requires --solver adam "
                   "(LM optimizes axis-angle)", file=sys.stderr)
             return 2
-        res = fitting.fit_lm(params, targets, n_steps=steps, **lm_kw,
-                             **kp_kw)
+        if args.restarts:
+            try:
+                res, _losses = fitting.fit_restarts(
+                    params, targets, n_restarts=args.restarts,
+                    solver="lm", n_steps=steps, **lm_kw, **kp_kw)
+            except ValueError as e:   # e.g. batched targets
+                print(f"--restarts: {e}", file=sys.stderr)
+                return 2
+        else:
+            res = fitting.fit_lm(params, targets, n_steps=steps, **lm_kw,
+                                 **kp_kw)
     else:
         if args.trim:
             print("--trim requires --solver lm (the Adam chamfer path "
@@ -764,8 +782,8 @@ def cmd_fit(args) -> int:
             if err:
                 print(err, file=sys.stderr)
                 return 2
-        res = fitting.fit(
-            params, targets, n_steps=steps,
+        adam_kw = dict(
+            n_steps=steps,
             lr=default_lr if args.lr is None else args.lr,
             data_term=args.data_term,
             shape_prior_weight=shape_prior,
@@ -776,10 +794,24 @@ def cmd_fit(args) -> int:
             joint_limit_weight=(1.0 if args.joint_limit_weight is None
                                 else args.joint_limit_weight),
             robust=args.robust, robust_scale=args.robust_scale,
-            init=init,
             **kp2d,
             **kp_kw,
         )
+        if args.restarts:
+            if pose_space != "aa":
+                # fit_restarts samples axis-angle seeds by design.
+                print(f"--restarts requires the axis-angle pose space "
+                      f"(active: {pose_space})", file=sys.stderr)
+                return 2
+            try:
+                res, _losses = fitting.fit_restarts(
+                    params, targets, n_restarts=args.restarts,
+                    solver="adam", **adam_kw)
+            except ValueError as e:   # e.g. batched targets
+                print(f"--restarts: {e}", file=sys.stderr)
+                return 2
+        else:
+            res = fitting.fit(params, targets, init=init, **adam_kw)
     jax.block_until_ready(res.pose)
     path = save_fit_result(res, args.out)
     final = float(np.max(np.asarray(res.final_loss)))
@@ -1054,6 +1086,12 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--joint-limit-weight", type=float, default=None,
                    help="weight of the joint-limit hinge (default 1.0; "
                         "only with --joint-limits)")
+    f.add_argument("--restarts", type=int, default=0,
+                   help="solve ONE problem from N inits (zero + the "
+                        "closed-form Kabsch alignment on verts/joints "
+                        "targets + anatomical samples) and keep the "
+                        "best — for far-rotated or multi-modal targets; "
+                        "single-problem targets only")
     f.add_argument("--shape-prior", type=float, default=None,
                    help="shape regularizer. adam: L2 prior weight (default "
                         "0 for verts, 1.0 for silhouette/depth, 1e-3 "

@@ -693,3 +693,46 @@ def test_fit_subcommand_pose_prior(tmp_path, capsys):
     ])
     assert rc == 2
     assert "aa or pca" in capsys.readouterr().err
+
+
+def test_fit_restarts_flag(tmp_path, capsys):
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+
+    p32 = synthetic_params(seed=0).astype(np.float32)
+    rng = np.random.default_rng(9)
+    pose = np.zeros((16, 3), np.float32)
+    pose[0] = [0.2, 3.0, 0.2]                 # far-rotated: the restarts case
+    pose[1:] = rng.normal(scale=0.2, size=(15, 3))
+    target = np.asarray(core.jit_forward(
+        p32, jnp.asarray(pose), jnp.zeros(10, jnp.float32)).verts)
+    np.save(tmp_path / "t.npy", target)
+    out = tmp_path / "fit.npz"
+    rc = cli.main(["fit", str(tmp_path / "t.npy"), "--solver", "lm",
+                   "--steps", "12", "--restarts", "2", "--out", str(out)])
+    assert rc == 0
+    got = np.load(out)["pose"]
+    assert got.shape == (16, 3)
+    # The Kabsch row put LM in the right basin at only 2 restarts.
+    fitted = np.asarray(core.jit_forward(
+        p32, jnp.asarray(got), jnp.asarray(np.load(out)["shape"])).verts)
+    assert np.abs(fitted - target).max() < 1e-3
+
+    # Guard rails: batched targets and --init both refuse.
+    capsys.readouterr()
+    np.save(tmp_path / "batch.npy", np.stack([target, target]))
+    rc = cli.main(["fit", str(tmp_path / "batch.npy"), "--solver", "lm",
+                   "--restarts", "2"])
+    assert rc == 2 and "ONE problem" in capsys.readouterr().err
+    np.savez(tmp_path / "seed.npz", pose=pose)
+    rc = cli.main(["fit", str(tmp_path / "t.npy"), "--restarts", "2",
+                   "--init", str(tmp_path / "seed.npz")])
+    assert rc == 2 and "owns the initialization" in capsys.readouterr().err
+    # Adam route works too (and refuses non-aa spaces).
+    rc = cli.main(["fit", str(tmp_path / "t.npy"), "--solver", "adam",
+                   "--steps", "40", "--restarts", "2", "--out", str(out)])
+    assert rc == 0
+    rc = cli.main(["fit", str(tmp_path / "t.npy"), "--solver", "adam",
+                   "--pose-space", "6d", "--restarts", "2"])
+    assert rc == 2 and "axis-angle" in capsys.readouterr().err
