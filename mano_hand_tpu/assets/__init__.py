@@ -1,3 +1,4 @@
+from mano_hand_tpu.assets.mirror import mirror_params
 from mano_hand_tpu.assets.schema import ManoParams, validate
 from mano_hand_tpu.assets.synthetic import synthetic_pair, synthetic_params
 from mano_hand_tpu.assets.loader import (
@@ -28,6 +29,7 @@ __all__ = [
     "save_dumped_pickle",
     "extract_scan_poses",
     "save_scan_poses",
+    "mirror_params",
     "mirror_pose",
     "mirror_verts",
 ]

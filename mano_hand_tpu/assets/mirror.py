@@ -1,0 +1,79 @@
+"""Derive the opposite-side MANO asset by mirroring across the x=0 plane.
+
+The official release ships left and right as two separate license-gated
+files (/root/reference/dump_model.py:48-49), and the reference's only
+notion of their relation is the scan extractor's axis-angle mirror
+(`* [1, -1, -1]`, dump_model.py:38). This module makes the relation a
+first-class operation on the asset itself: given ONE side, produce a
+geometrically consistent opposite-side model.
+
+Math (reflection M = diag(-1, 1, 1), M = M^-1):
+
+- points mirror as ``x' = M x`` (template, shape blendshapes' offsets);
+- rotations conjugate: ``R' = M R M``, which on axis-angle is exactly
+  the reference's ``[1, -1, -1]`` component flip (axes are
+  pseudo-vectors), and on the pose-corrective COEFFICIENTS
+  ``(R - I)_ab`` is a sign ``s_a s_b`` per matrix entry — so the pose
+  basis re-signs as ``basis'[v, c, (j,a,b)] = s_c s_a s_b
+  basis[v, c, (j,a,b)]``;
+- PCA statistics live in flat axis-angle space: mean and component rows
+  multiply by the tiled ``[1, -1, -1]``;
+- triangle winding reverses so outward orientation survives the
+  reflection; regressor/skinning weights are per-vertex scalars and
+  carry over unchanged.
+
+The defining invariant (pinned by tests, exact in f64):
+``forward(mirror(params), mirror_pose(pose), shape).verts ==
+M @ forward(params, pose, shape).verts``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from mano_hand_tpu import constants as C
+from mano_hand_tpu.assets.schema import ManoParams, validate
+
+# Pose/vertex mirroring for ARRAYS lives in assets.scans (mirror_pose,
+# mirror_verts — the reference's dump_model.py:38 semantics); this module
+# mirrors the ASSET so those relations hold between the two sides.
+
+
+def mirror_params(params: ManoParams) -> ManoParams:
+    """The opposite-side asset (see module docstring for the math)."""
+    from mano_hand_tpu.assets.scans import MIRROR_AA, mirror_verts
+
+    s = -MIRROR_AA                 # x=0 reflection signs = [-1, 1, 1]
+
+    v_template = mirror_verts(params.v_template)
+    shape_basis = np.asarray(params.shape_basis) * s[None, :, None]
+
+    pb = np.asarray(params.pose_basis)         # [V, 3, (J-1)*9]
+    v, _, p = pb.shape
+    # Coefficient signs: s_a s_b per (a, b) rotation-matrix entry,
+    # repeated per joint; output signs: s_c per vertex coordinate.
+    ab = np.outer(s, s).reshape(9)             # [9] = s_a s_b, ab-major
+    coeff_sign = np.tile(ab, p // 9)           # [(J-1)*9]
+    pose_basis = pb * s[None, :, None] * coeff_sign[None, None, :]
+
+    n_aa = np.asarray(params.pca_mean).shape[-1]
+    aa_sign = np.tile(MIRROR_AA, n_aa // 3)
+    pca_basis = np.asarray(params.pca_basis) * aa_sign[None, :]
+    pca_mean = np.asarray(params.pca_mean) * aa_sign
+
+    faces = np.asarray(params.faces)[:, ::-1].copy()   # re-orient winding
+
+    dtype = np.asarray(params.v_template).dtype
+    side = C.LEFT if params.side == C.RIGHT else C.RIGHT
+    return validate(dataclasses.replace(
+        params,
+        v_template=v_template.astype(dtype),
+        shape_basis=shape_basis.astype(dtype),
+        pose_basis=pose_basis.astype(dtype),
+        pca_basis=pca_basis.astype(dtype),
+        pca_mean=pca_mean.astype(dtype),
+        faces=faces,
+        side=side,
+    ))

@@ -79,7 +79,23 @@ def cmd_convert(args) -> int:
         print(f"cannot load asset {args.src}: {type(e).__name__}: {e}",
               file=sys.stderr)
         return 2
+    note = ""
     dst = Path(args.dst)
+    if args.mirror:
+        from mano_hand_tpu.assets import mirror_params
+
+        params = mirror_params(params)
+        note = f" (mirrored -> {params.side})"
+        if (dst.suffix == ".pkl"
+                and params.side not in dst.name.lower()):
+            # The nine-key dumped-pickle format has no side field; the
+            # loader re-infers side from the FILENAME. A mirrored pickle
+            # without the side in its name would silently round-trip
+            # with the wrong-hand metadata.
+            print(f"--mirror to .pkl needs the side in the filename "
+                  f"(dumped pickles carry no side field): name it "
+                  f"*{params.side}*.pkl or write .npz", file=sys.stderr)
+            return 2
     if dst.suffix == ".npz":
         save_npz(params, dst)
     elif dst.suffix == ".pkl":
@@ -87,7 +103,7 @@ def cmd_convert(args) -> int:
     else:
         print(f"unsupported output format: {dst.suffix}", file=sys.stderr)
         return 2
-    print(f"converted {args.src} -> {dst}")
+    print(f"converted {args.src} -> {dst}{note}")
     return 0
 
 
@@ -937,6 +953,12 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("src")
     c.add_argument("dst", help="output path (.npz or .pkl)")
     c.add_argument("--side", default=None, choices=[None, "left", "right"])
+    c.add_argument("--mirror", action="store_true",
+                   help="write the OPPOSITE side: reflect the asset "
+                        "across x=0 (template/bases re-signed, winding "
+                        "reversed, PCA stats mirrored — "
+                        "assets.mirror_params); for when only one "
+                        "side's file is at hand")
     c.set_defaults(fn=cmd_convert)
 
     a = sub.add_parser("animate", help="batch-evaluate a pose sequence")
