@@ -698,6 +698,53 @@ def run_benchmarks(args, device_str: str) -> dict:
 
     section("config3d", config3d)
 
+    # -- config 3e: BOTH hands in ONE full-fusion launch (hand-major grid,
+    # ops/pallas_forward.py:forward_verts_fused_full_hands) — the two-hand
+    # workload otherwise pays two sequenced launches per pass.
+    verts_hands = None
+    def config3e_hands():
+        nonlocal verts_hands
+        if args.pallas_sweep == "off" or "block_b" not in fused_full_best:
+            return
+        stacked = core.stack_params(left, right)
+        bb = fused_full_best["block_b"]
+        iters = max(3, args.iters // 3)
+        best = None
+        for launch in dict.fromkeys((min(half, 8192), half)):
+            pose_h = jnp.stack([pose3[:half][:launch],
+                                pose3[half:][:launch]])
+            beta_h = jnp.stack([beta3[:half][:launch],
+                                beta3[half:][:launch]])
+            fwd = loop_scalar(
+                lambda prm, p, s: core.forward_hands_pallas_fused_full(
+                    prm, p, s, block_b=bb).sum()
+            )
+            try:
+                t = slope_time(
+                    lambda m: looped(fwd, m, stacked, pose_h, beta_h),
+                    1, 5, iters=iters)
+                rate = 2 * launch / t
+                log(f"config3e hands launch={launch}: {rate:,.0f} evals/s")
+                if np.isfinite(rate) and (best is None or rate > best[0]):
+                    best = (rate, launch)
+            except Exception as e:
+                log(f"config3e launch {launch} failed: "
+                    f"{type(e).__name__}: {str(e)[:200]}")
+        if best is None:
+            raise RuntimeError("no config3e launch succeeded")
+        results["config3_fused_full_hands_evals_per_sec"] = best[0]
+        results["fused_full_hands_launch"] = best[1]
+        # Accuracy probe through the COMPILED hands kernel (same
+        # compilation context as the timed path); hand 1 is `right`, the
+        # oracle side checked in the accuracy section.
+        verts_hands = jax.jit(
+            lambda prm, p, s: core.forward_hands_pallas_fused_full(
+                prm, p, s, block_b=bb)
+        )(stacked, jnp.stack([jnp.asarray(poses)] * 2),
+          jnp.stack([jnp.asarray(betas)] * 2))[1]
+
+    section("config3e_hands", config3e_hands)
+
     def config3_fused_full_chunked():
         if args.pallas_sweep == "off" or "block_b" not in fused_full_best:
             return
@@ -1037,7 +1084,7 @@ def run_benchmarks(args, device_str: str) -> dict:
         results["config1_zero_pose_max_err"] = err0
         log(f"config1 zero-pose max err vs oracle: {err0:.3e}")
         max_err = fast_err = highest_err = pallas_err = fused_err = 0.0
-        fused_full_err = 0.0
+        fused_full_err = hands_err = 0.0
         for i in range(8):
             w = oracle.forward(right64, pose=poses[i], shape=betas[i]).verts
             max_err = max(
@@ -1063,6 +1110,10 @@ def run_benchmarks(args, device_str: str) -> dict:
                 fused_full_err = max(fused_full_err, float(
                     np.abs(np.asarray(verts_fused_full[i]) - w).max()
                 ))
+            if verts_hands is not None:
+                hands_err = max(hands_err, float(
+                    np.abs(np.asarray(verts_hands[i]) - w).max()
+                ))
         results["max_err_vs_numpy"] = max_err
         log(f"random-pose max err vs oracle (model default precision): "
             f"{max_err:.3e}")
@@ -1084,6 +1135,10 @@ def run_benchmarks(args, device_str: str) -> dict:
             results["fused_full_max_err_vs_numpy"] = fused_full_err
             log(f"compiled FULL-fusion path max err vs oracle: "
                 f"{fused_full_err:.3e}")
+        if verts_hands is not None:
+            results["fused_full_hands_max_err_vs_numpy"] = hands_err
+            log(f"compiled two-hand single-launch path max err vs "
+                f"oracle: {hands_err:.3e}")
 
     section("accuracy", accuracy)
 
@@ -1287,7 +1342,8 @@ def run_benchmarks(args, device_str: str) -> dict:
                   results.get("config3_fused_evals_per_sec"),
                   results.get("config3_fused_chunked_evals_per_sec"),
                   results.get("config3_fused_full_evals_per_sec"),
-                  results.get("config3_fused_full_chunked_evals_per_sec")]
+                  results.get("config3_fused_full_chunked_evals_per_sec"),
+                  results.get("config3_fused_full_hands_evals_per_sec")]
     candidates = [c for c in candidates if c is not None and np.isfinite(c)]
     if not candidates:
         raise RuntimeError(f"no throughput config completed: {errors}")

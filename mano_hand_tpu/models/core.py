@@ -517,6 +517,31 @@ def forward_batched_pallas_fused_full(
     )
 
 
+def forward_hands_pallas_fused_full(
+    stacked: ManoParams,     # stack_params output, [2, ...] leaves
+    pose: jnp.ndarray,       # [2, B, J, 3]
+    shape: jnp.ndarray,      # [2, B, S]
+    precision=DEFAULT_PRECISION,
+    block_b: int = FUSED_FULL_BEST_BLOCK_B,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Both hands' full-fusion forward in ONE kernel launch: [2, B, V, 3].
+
+    The single-launch counterpart of ``forward_hands`` for the kernel
+    path: the grid runs hand-major over (hand, batch-tile), so the
+    two-hand workload of BASELINE configs 3/5 pays one launch instead of
+    two sequenced ones (ops/pallas_forward.py:
+    forward_verts_fused_full_hands). Inference path (no custom VJP —
+    fitting stays on the XLA solvers, docs/roadmap.md dead-end #2).
+    """
+    from mano_hand_tpu.ops import pallas_forward
+
+    return pallas_forward.forward_verts_fused_full_hands(
+        stacked, pose, shape, precision, block_b=block_b,
+        interpret=interpret,
+    )
+
+
 def stack_params(left: ManoParams, right: ManoParams) -> ManoParams:
     """Stack a (left, right) asset pair into one PyTree with [2, ...] leaves.
 

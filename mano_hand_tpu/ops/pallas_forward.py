@@ -508,23 +508,41 @@ def _fused_full_kernel(vp, levels, precision, split, *refs):
     """One batch tile of the COMPLETE forward: pose/shape slabs in,
     vertex coordinate planes out. ``split`` selects the pre-split-bf16
     HIGH path for the resident operands (see _fused_kernel_split)."""
+    n_in = 11 if split else 9
+    ins = [r[:] for r in refs[:n_in]]
+    outs = _fused_full_compute(vp, levels, precision, split, *ins)
+    for o, r in zip(outs, refs[n_in:n_in + 3]):
+        r[:] = o
+
+
+def _fused_full_kernel_hands(vp, levels, precision, split, *refs):
+    """Two-hand variant: identical math per (hand, batch-tile) grid cell;
+    every block carries a leading size-1 hand axis (the hand-major grid
+    keeps each hand's resident operands in VMEM across its whole batch
+    range — one refetch per hand, not per tile)."""
+    n_in = 11 if split else 9
+    ins = [r[0] for r in refs[:n_in]]
+    outs = _fused_full_compute(vp, levels, precision, split, *ins)
+    for o, r in zip(outs, refs[n_in:n_in + 3]):
+        r[0] = o
+
+
+def _fused_full_compute(vp, levels, precision, split, *ins):
+    """The full forward on VALUES (blocks already read): returns the
+    three output coordinate planes. Shared by the one-hand and two-hand
+    kernels."""
     if split:
         (basis_hi, basis_lo, wt_hi, wt_lo, jbx, jby, jbz,
-         shape_ref, px, py, pz) = refs[:11]
-        out = refs[11:14]
+         shape_aug, x, y, z) = ins
     else:
-        (basis_ref, wt_ref, jbx, jby, jbz,
-         shape_ref, px, py, pz) = refs[:9]
-        out = refs[9:12]
+        (basis_op, wt_op, jbx, jby, jbz, shape_aug, x, y, z) = ins
 
-    shape_aug = shape_ref[:]                              # [TB, Sp]
-    x, y, z = px[:], py[:], pz[:]                         # [TB, J]
     r_local = _rodrigues_slabs(x, y, z)
 
     # Shaped joints: [TB, Sp] x [Sp, J] per coordinate (tiny MXU dots).
-    jx = kernel_dot(shape_aug, jbx[:], precision)
-    jy = kernel_dot(shape_aug, jby[:], precision)
-    jz = kernel_dot(shape_aug, jbz[:], precision)
+    jx = kernel_dot(shape_aug, jbx, precision)
+    jy = kernel_dot(shape_aug, jby, precision)
+    jz = kernel_dot(shape_aug, jbz, precision)
 
     world_r, skin_t = _fk_slabs(r_local, jx, jy, jz, levels)
 
@@ -536,34 +554,34 @@ def _fused_full_kernel(vp, levels, precision, split, *refs):
         for a in range(3) for b in range(3)
     ]
     coeff = jnp.concatenate([shape_aug, *deltas], axis=1)
-    kp2 = (basis_hi if split else basis_ref).shape[0]
+    kp2 = (basis_hi if split else basis_op).shape[0]
     pad = kp2 - coeff.shape[1]
     if pad:
         coeff = jnp.concatenate(
             [coeff, jnp.zeros((coeff.shape[0], pad), coeff.dtype)], axis=1
         )
 
+    outs = []
     if split:
         c_hi, c_lo = _split_hi_lo(coeff)
-        vp_flat = _dot3(c_hi, c_lo, basis_hi[:], basis_lo[:])
-        w_hi, w_lo = wt_hi[:], wt_lo[:]
+        vp_flat = _dot3(c_hi, c_lo, basis_hi, basis_lo)
         for a in range(3):
             t_hi, t_lo = _split_hi_lo(skin_t[a])
-            acc = _dot3(t_hi, t_lo, w_hi, w_lo)
+            acc = _dot3(t_hi, t_lo, wt_hi, wt_lo)
             for c in range(3):
                 r_hi, r_lo = _split_hi_lo(world_r[3 * a + c])
-                m_ac = _dot3(r_hi, r_lo, w_hi, w_lo)
+                m_ac = _dot3(r_hi, r_lo, wt_hi, wt_lo)
                 acc = acc + m_ac * vp_flat[:, c * vp:(c + 1) * vp]
-            out[a][:] = acc
+            outs.append(acc)
     else:
-        vp_flat = kernel_dot(coeff, basis_ref[:], precision)
-        wt = wt_ref[:]
+        vp_flat = kernel_dot(coeff, basis_op, precision)
         for a in range(3):
-            acc = kernel_dot(skin_t[a], wt, precision)
+            acc = kernel_dot(skin_t[a], wt_op, precision)
             for c in range(3):
-                m_ac = kernel_dot(world_r[3 * a + c], wt, precision)
+                m_ac = kernel_dot(world_r[3 * a + c], wt_op, precision)
                 acc = acc + m_ac * vp_flat[:, c * vp:(c + 1) * vp]
-            out[a][:] = acc
+            outs.append(acc)
+    return tuple(outs)
 
 
 def forward_verts_fused_full(
@@ -649,6 +667,112 @@ def forward_verts_fused_full(
         interpret=interpret,
     )(*operands)
     return jnp.stack(outs, axis=-1)[:b, :v, :]
+
+
+def forward_verts_fused_full_hands(
+    params2,             # stacked ManoParams: [2, ...] array leaves (L, R)
+    pose: jnp.ndarray,   # [2, B, J, 3] axis-angle, hand-major
+    shape: jnp.ndarray,  # [2, B, S]
+    precision=DEFAULT_PRECISION,
+    block_b: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """BOTH hands' complete forward in ONE kernel launch: [2, B, V, 3].
+
+    The canonical two-hand workloads (BASELINE config 3's interleaved
+    L+R batch, config 5's two-hand clips) otherwise pay two sequenced
+    launches per pass; here the grid is (hand, batch-tile) — hand-major,
+    so each hand's resident operands (basis/weights/joint maps) are
+    fetched into VMEM once and reused across its whole batch range, and
+    the second hand's tiles follow without leaving the kernel. Same
+    math, layout, and precision policy as ``forward_verts_fused_full``
+    (the kernels share ``_fused_full_compute``); both hands must share
+    one kinematic tree (they do: stack_params requires it).
+
+    NOTE: the host-side launch scaffolding (operand prep, padding,
+    BlockSpecs, HIGH-path split) deliberately mirrors
+    ``forward_verts_fused_full`` line for line rather than sharing a
+    builder — the one-hand path is the measured headline kernel and
+    stays untouched; any change to either launch sequence must be
+    applied to BOTH (they differ only by the leading hand axis).
+    """
+    f32 = jnp.float32
+    v = params2.v_template.shape[-2]
+    j = params2.j_regressor.shape[-2]
+    s = params2.shape_basis.shape[-1]
+    if pose.ndim == 3 and pose.shape[-1] == 3 * j:
+        pose = pose.reshape(pose.shape[0], pose.shape[1], j, 3)
+    if pose.shape[0] != 2 or pose.ndim != 4:
+        raise ValueError(
+            f"pose must be [2, B, {j}, 3] (or flat [2, B, {3 * j}]), "
+            f"got {pose.shape}")
+    b = pose.shape[1]
+    if b == 0:
+        return jnp.zeros((2, 0, v, 3), f32)
+    perm, levels = level_layout(tuple(params2.parents))
+    basis2, wt2, jb = jax.vmap(
+        lambda p: fused_full_operands(p, precision)
+    )(params2)                       # [2, Kp2, 3VP], [2, J, VP], 3x[2, Sp, J]
+
+    pose_p = pose.reshape(2, b, j, 3).astype(f32)[:, :, jnp.asarray(perm), :]
+    sp = jb[0].shape[-2]
+    shape_aug = jnp.concatenate(
+        [shape.astype(f32), jnp.ones((2, b, 1), f32),
+         jnp.zeros((2, b, sp - s - 1), f32)], axis=-1
+    )                                                    # [2, B, Sp]
+
+    block_b = max(1, min(block_b, b))
+    bp = _cdiv(b, block_b) * block_b
+
+    def padb(xarr):
+        return jnp.pad(
+            xarr, [(0, 0), (0, bp - b)] + [(0, 0)] * (xarr.ndim - 2))
+
+    shape_aug = padb(shape_aug)
+    slabs = [padb(pose_p[:, :, :, c]) for c in range(3)]  # 3 x [2, Bp, J]
+
+    kp2, lanes = basis2.shape[-2:]
+    vp = lanes // 3
+    grid = (2, bp // block_b)        # hand-major: operands refetch once/hand
+    const_basis = pl.BlockSpec((1, kp2, 3 * vp), lambda h, i: (h, 0, 0),
+                               memory_space=pltpu.VMEM)
+    const_wt = pl.BlockSpec((1, j, vp), lambda h, i: (h, 0, 0),
+                            memory_space=pltpu.VMEM)
+    const_jb = pl.BlockSpec((1, sp, j), lambda h, i: (h, 0, 0),
+                            memory_space=pltpu.VMEM)
+    spec_bs = pl.BlockSpec((1, block_b, sp), lambda h, i: (h, i, 0),
+                           memory_space=pltpu.VMEM)
+    spec_bj = pl.BlockSpec((1, block_b, j), lambda h, i: (h, i, 0),
+                           memory_space=pltpu.VMEM)
+    spec_bv = pl.BlockSpec((1, block_b, vp), lambda h, i: (h, i, 0),
+                           memory_space=pltpu.VMEM)
+
+    canon = (jax.lax.Precision(precision)
+             if precision is not None else precision)
+    split = canon == jax.lax.Precision.HIGH
+    if split:
+        basis_hi, basis_lo = split_hi_lo_xla(basis2)
+        wt_hi, wt_lo = split_hi_lo_xla(wt2)
+        operands = (basis_hi, basis_lo, wt_hi, wt_lo, *jb,
+                    shape_aug, *slabs)
+        in_specs = [const_basis, const_basis, const_wt, const_wt,
+                    const_jb, const_jb, const_jb, spec_bs,
+                    *([spec_bj] * 3)]
+    else:
+        operands = (basis2, wt2, *jb, shape_aug, *slabs)
+        in_specs = [const_basis, const_wt,
+                    const_jb, const_jb, const_jb, spec_bs,
+                    *([spec_bj] * 3)]
+    outs = pl.pallas_call(
+        functools.partial(_fused_full_kernel_hands, vp, levels,
+                          precision, split),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[spec_bv] * 3,
+        out_shape=[jax.ShapeDtypeStruct((2, bp, vp), f32)] * 3,
+        interpret=interpret,
+    )(*operands)
+    return jnp.stack(outs, axis=-1)[:, :b, :v, :]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

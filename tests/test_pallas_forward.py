@@ -312,3 +312,44 @@ def test_full_fusion_shared_parent_inside_wide_level():
         p32, pose, beta, block_b=2, interpret=True
     )
     assert np.abs(np.asarray(got) - np.asarray(want)).max() < TOL
+
+
+def test_full_fusion_hands_single_launch(params32):
+    """Two-hand single-launch kernel == per-hand full-fusion kernels ==
+    the XLA forward_hands path, on distinct L/R assets."""
+    import dataclasses
+
+    left = params32
+
+    right = dataclasses.replace(
+        params32,
+        v_template=np.asarray(params32.v_template) * 1.05,
+        side="right" if params32.side == "left" else "left",
+    )
+    stacked = core.stack_params(left, right)
+    pose, beta = _rand(6, seed=9)
+    pose2 = jnp.stack([pose, pose * 0.5])
+    beta2 = jnp.stack([beta, -beta])
+
+    want = core.forward_hands(stacked, pose2, beta2).verts
+    got = core.forward_hands_pallas_fused_full(
+        stacked, pose2, beta2, block_b=4, interpret=True
+    )
+    assert got.shape == want.shape
+    assert np.abs(np.asarray(got) - np.asarray(want)).max() < TOL
+    # Per-hand agreement with the one-hand kernel (same compute core).
+    for h, prm in ((0, left), (1, right)):
+        one = pallas_forward.forward_verts_fused_full(
+            prm, pose2[h], beta2[h], block_b=4, interpret=True
+        )
+        assert np.abs(np.asarray(got[h]) - np.asarray(one)).max() < 1e-6
+
+    # Flat [2, B, 48] poses normalize like the one-hand API's [B, 48].
+    flat = core.forward_hands_pallas_fused_full(
+        stacked, pose2.reshape(2, 6, 48), beta2, block_b=4,
+        interpret=True)
+    assert np.abs(np.asarray(flat) - np.asarray(got)).max() == 0.0
+
+    with pytest.raises(ValueError, match="pose must be"):
+        core.forward_hands_pallas_fused_full(
+            stacked, pose, beta, interpret=True)
