@@ -1,0 +1,106 @@
+"""Detector to engine: 21 noisy keypoints -> closed-form init ->
+limit-constrained fit -> skinned glTF.
+
+The full production path a pose-estimation stack needs, end to end:
+
+1. a detector emits 21 noisy 3D keypoints of a hand rotated FAR from
+   the rest orientation (the case that defeats cold-started local
+   solvers);
+2. ``initialize_from_joints`` recovers the global pose in ONE Kabsch
+   SVD — no restart sweep;
+3. the articulated fit runs with a corpus-derived anatomical joint-limit
+   box (``pose_limits_from_corpus`` + the squared-hinge prior) walling
+   off hyperextension the sparse keypoints cannot rule out;
+4. the result ships as a SKINNED GLB (joint hierarchy, LBS weights,
+   quaternion track) any engine can drive — not a baked mesh.
+
+    python examples/17_detector_to_glb.py [--platform cpu]
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default="")
+    ap.add_argument("--out", default="detector_fit.glb")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.assets import synthetic_params
+    from mano_hand_tpu.fitting import (
+        fit, initialize_from_joints, pose_limits_from_corpus,
+    )
+    from mano_hand_tpu.io.gltf import export_glb_skinned
+    from mano_hand_tpu.models import core
+
+    params = synthetic_params(seed=0).astype(np.float32)
+    rng = np.random.default_rng(21)
+
+    # Anatomical box from a flexion-style corpus (with official assets:
+    # their scan poses via assets.scans.decode_scan_poses).
+    corpus = np.zeros((400, 16, 3), np.float32)
+    corpus[:, 1:, 0] = rng.uniform(0.0, 1.1, size=(400, 15))
+    lo, hi = pose_limits_from_corpus(params, corpus)
+
+    # "Detector output": 21 keypoints of a far-rotated, bent hand + noise.
+    true_pose = np.zeros((16, 3), np.float32)
+    true_pose[0] = [0.3, 2.8, 0.2]             # ~2.8 rad from rest
+    true_pose[1:, 0] = rng.uniform(0.2, 0.9, size=15)
+    truth = core.forward(params, jnp.asarray(true_pose),
+                         jnp.zeros(10, jnp.float32))
+    kp21 = np.asarray(core.keypoints(truth, "smplx")) \
+        + rng.normal(scale=2e-3, size=(21, 3)).astype(np.float32)
+
+    # 2. One SVD instead of a restart sweep.
+    init = initialize_from_joints(params, jnp.asarray(kp21),
+                                  tip_vertex_ids="smplx")
+    print(f"Kabsch init: global rot |aa| = "
+          f"{float(np.linalg.norm(init['pose'][0])):.2f} rad recovered "
+          "closed-form")
+
+    # 3. Articulated fit inside the anatomical box.
+    res = fit(params, jnp.asarray(kp21), data_term="joints",
+              tip_vertex_ids="smplx", n_steps=300, lr=0.03,
+              shape_prior_weight=1e-3,
+              joint_limits=(lo, hi), joint_limit_weight=1.0,
+              init={"pose": init["pose"]})
+    fitted = core.forward(params, res.pose, res.shape)
+    kp_err = float(jnp.abs(
+        core.keypoints(fitted, "smplx") - jnp.asarray(kp21)).max())
+    flat = np.asarray(res.pose)[1:].reshape(-1)
+    viol = max(float(np.maximum(np.asarray(lo) - flat, 0).max()),
+               float(np.maximum(flat - np.asarray(hi), 0).max()))
+    print(f"fit: keypoint err {kp_err * 1e3:.2f} mm, worst limit "
+          f"violation {viol:.3f} rad")
+    assert kp_err < 0.01 and viol < 0.05
+
+    # 4. Ship the skeleton, not a baked mesh: pose clip = rest -> fit.
+    clip = np.stack([np.zeros((16, 3), np.float32),
+                     np.asarray(res.pose, np.float32)])
+    rest = core.forward(params, jnp.zeros((16, 3), jnp.float32),
+                        res.shape)
+    path = export_glb_skinned(
+        np.asarray(rest.verts), np.asarray(params.faces),
+        np.asarray(rest.joints), params.parents,
+        np.asarray(params.lbs_weights), args.out,
+        pose_frames=clip, fps=2.0,
+    )
+    print(f"wrote skinned GLB to {path} (drivable joints, "
+          "rest->fit clip)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

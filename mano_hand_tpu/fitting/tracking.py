@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from typing import Callable, NamedTuple, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from mano_hand_tpu.assets.schema import ManoParams
@@ -55,11 +56,16 @@ def make_tracker(
     """Build a streaming tracker; returns ``(initial_state, track_step)``.
 
     ``track_step(state, target) -> (state, result)`` fits ONE frame,
-    seeded from ``state`` (rest pose for the first frame). ``solver`` is
-    ``"adam"`` (any data term, robust/priors via ``**solver_kw``) or
-    ``"lm"`` (verts/joints/ICP terms — converges in very few steps on
-    clean targets, the lowest-latency choice). All per-frame shapes are
-    static, so every frame after the first reuses one compiled program.
+    seeded from ``state`` (frame 0: the rest pose, or — on the 3D
+    correspondence terms "verts"/"joints" — the closed-form Kabsch
+    alignment of the rest skeleton to the first target, so a stream that
+    OPENS far from the rest orientation starts in the right basin
+    instead of burning its few per-frame steps escaping the wrong one).
+    ``solver`` is ``"adam"`` (any data term, robust/priors via
+    ``**solver_kw``) or ``"lm"`` (verts/joints/ICP terms — converges in
+    very few steps on clean targets, the lowest-latency choice). All
+    per-frame shapes are static, so every frame after the first reuses
+    one compiled program.
 
     The shape estimate is re-optimized each frame but warm-started, so it
     settles once the subject is established (one identity per stream —
@@ -99,7 +105,34 @@ def make_tracker(
 
     def track_step(state: TrackState, target) -> Tuple[TrackState, object]:
         target = jnp.asarray(target, dtype)
-        init = {"pose": state.pose, "shape": state.shape}
+        pose0 = state.pose
+        trans0 = state.trans
+        if (state.frame == 0 and data_term in ("verts", "joints")
+                and target.ndim == 2 and target.shape[-1] == 3):
+            # Closed-form first-frame seed (one SVD; `frame` is a Python
+            # int, so this branch never enters a trace): a stream that
+            # OPENS far from the rest orientation starts in the right
+            # basin instead of burning its few per-frame steps escaping
+            # the wrong one.
+            from mano_hand_tpu.fitting.initialize import (
+                initialize_from_joints, initialize_from_verts,
+            )
+
+            try:
+                seed = (initialize_from_joints(
+                            params, target,
+                            solver_kw.get("tip_vertex_ids"),
+                            solver_kw.get("keypoint_order", "mano"))
+                        if data_term == "joints"
+                        else initialize_from_verts(params, target))
+                pose0 = seed["pose"].astype(dtype)
+                if fit_trans:
+                    # The rotation seed only lands in the right basin
+                    # TOGETHER with its pivot-compensating translation.
+                    trans0 = seed["trans"].astype(dtype)
+            except ValueError:
+                pass   # row-count mismatch etc.: keep the rest seed
+        init = {"pose": pose0, "shape": state.shape}
         if solver == "lm":
             res = lm_mod.fit_lm(
                 params, target, n_steps=n_steps, data_term=data_term,
@@ -107,7 +140,7 @@ def make_tracker(
             )
         else:
             if fit_trans:
-                init["trans"] = state.trans
+                init["trans"] = trans0
             res = solvers.fit(
                 params, target, n_steps=n_steps, lr=lr,
                 data_term=data_term, camera=camera,
@@ -179,9 +212,37 @@ def make_hands_tracker(
 
     def track_step(state: TrackState, target) -> Tuple[TrackState, object]:
         target = jnp.asarray(target, dtype)
-        init = {"pose": state.pose, "shape": state.shape}
+        pose0, trans0 = state.pose, state.trans
+        if (state.frame == 0 and data_term in ("verts", "joints")
+                and target.ndim == 3 and target.shape[0] == 2
+                and target.shape[-1] == 3):
+            # Same frame-0 closed-form seed as make_tracker, per hand
+            # (each hand's rest skeleton differs — unstack the pytree).
+            from mano_hand_tpu.fitting.initialize import (
+                initialize_from_joints, initialize_from_verts,
+            )
+
+            try:
+                seeds = []
+                for h in range(2):
+                    prm = jax.tree_util.tree_map(lambda x: x[h], stacked)
+                    seeds.append(
+                        initialize_from_joints(
+                            prm, target[h],
+                            solver_kw.get("tip_vertex_ids"),
+                            solver_kw.get("keypoint_order", "mano"))
+                        if data_term == "joints"
+                        else initialize_from_verts(prm, target[h]))
+                pose0 = jnp.stack(
+                    [s["pose"] for s in seeds]).astype(dtype)
+                if fit_trans:
+                    trans0 = jnp.stack(
+                        [s["trans"] for s in seeds]).astype(dtype)
+            except ValueError:
+                pass   # row-count mismatch etc.: keep the rest seed
+        init = {"pose": pose0, "shape": state.shape}
         if fit_trans:
-            init["trans"] = state.trans
+            init["trans"] = trans0
         res = hands_mod.fit_hands(
             stacked, target, n_steps=n_steps, lr=lr, data_term=data_term,
             camera=camera, fit_trans=fit_trans,

@@ -76,3 +76,23 @@ def test_tracker_validation(params32):
         make_tracker(params32, solver="newton")
     with pytest.raises(ValueError, match="fit_trans"):
         make_tracker(params32, solver="lm", fit_trans=True)
+
+
+def test_tracker_kabsch_first_frame(params32):
+    """A stream opening ~pi from the rest orientation: the frame-0
+    Kabsch seed puts the few-step LM solve at floor; without it the
+    first frame is far off."""
+    rng = np.random.default_rng(43)
+    pose = np.zeros((16, 3), np.float32)
+    pose[0] = [0.1, 3.0, 0.2]
+    pose[1:] = rng.normal(scale=0.15, size=(15, 3))
+    truth = core.forward(params32, jnp.asarray(pose),
+                         jnp.zeros(10, jnp.float32))
+
+    state, step = make_tracker(params32, solver="lm", n_steps=6)
+    state, res = step(state, truth.verts)
+    got = core.forward(params32, res.pose, res.shape).verts
+    assert float(jnp.abs(got - truth.verts).max()) < 1e-4
+    # Frame 1 warm-starts from frame 0 as before.
+    state, res2 = step(state, truth.verts)
+    assert float(np.asarray(res2.final_loss)) < 1e-8
