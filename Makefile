@@ -52,6 +52,15 @@ bench-cpu:
 	python bench.py --platform cpu --big-batch 2048 --chunk 512 --iters 4 \
 	  --fit-steps 20 --pallas-sweep off --init-retries 2 --sil-size 24
 
+# Kernel-sweep LOGIC coverage off-TPU: every pallas config (3b-3e, the
+# chunk mini-sweep, winner re-measure, accuracy probes) through the
+# Pallas interpreter — a bench-plumbing bug must not debut on the
+# scarce real-chip window. Rates are interpreter overhead, not perf.
+bench-interpret:
+	python bench.py --platform cpu --big-batch 512 --chunk 128 --iters 2 \
+	  --fit-steps 10 --pallas-sweep quick --pallas-interpret --skip-fit \
+	  --init-retries 2 --sil-size 16
+
 # Unattended BUILDER-side TPU bench: lockfile-guarded, stands down for the
 # driver's priority claim, and self-expires (default 3 h) — see
 # scripts/bench_tpu_wait.sh. Override the artifact basename with OUT=...,

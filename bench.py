@@ -222,6 +222,12 @@ def run_benchmarks(args, device_str: str) -> dict:
     log(f"device: {dev.platform}:{dev.device_kind} "
         f"({len(jax.devices())} visible)")
     is_tpu = dev.platform in ("tpu", "axon")
+    # --pallas-interpret: run every kernel config through the Pallas
+    # interpreter so the SWEEP LOGIC (config3b-3e, chunk mini-sweep,
+    # winner re-measure) executes end-to-end in CI — a Python-level bug
+    # in bench plumbing must not debut on the scarce real-chip window.
+    # Rates measured this way are interpreter overhead, not perf.
+    ikw = {"interpret": True} if args.pallas_interpret else {}
 
     left64, right64 = synthetic_pair(seed=0)
     right = right64.astype(np.float32).device_put()
@@ -548,7 +554,7 @@ def run_benchmarks(args, device_str: str) -> dict:
 
         def make_fn(block_b, block_v):
             return lambda prm, p, s: core.forward_batched_pallas(
-                prm, p, s, block_b=block_b, block_v=block_v)
+                prm, p, s, block_b=block_b, block_v=block_v, **ikw)
 
         b3b = min(half, 8192)  # one un-chunked pallas launch per hand
         rate, (bb, bv), best_launch, stab = sweep_kernel(
@@ -569,7 +575,7 @@ def run_benchmarks(args, device_str: str) -> dict:
         # dispatch).
         verts_pallas = jax.jit(
             lambda prm, p, s: core.forward_batched_pallas(
-                prm, p, s, block_b=bb, block_v=bv)
+                prm, p, s, block_b=bb, block_v=bv, **ikw)
         )(right, jnp.asarray(poses), jnp.asarray(betas))
         prove_vjp(make_fn(bb, bv))
         results["pallas_vjp_compiles"] = True
@@ -584,7 +590,8 @@ def run_benchmarks(args, device_str: str) -> dict:
         if args.pallas_sweep == "off":
             return
         bb, bv = pallas_best.get("block", core.PALLAS_BEST_BLOCK)
-        rate, t3p = time_chunked(use_pallas=True, block_b=bb, block_v=bv)
+        rate, t3p = time_chunked(use_pallas=True, block_b=bb, block_v=bv,
+                                 **ikw)
         results["config3_pallas_chunked_evals_per_sec"] = rate
         log(f"config3p batch={b3} L+R pallas chunks (b={bb},v={bv}): "
             f"{rate:,.0f} evals/s ({t3p * 1e3:.1f} ms)")
@@ -604,7 +611,7 @@ def run_benchmarks(args, device_str: str) -> dict:
 
         def make_fn(block_b):
             return lambda prm, p, s: core.forward_batched_pallas_fused(
-                prm, p, s, block_b=block_b)
+                prm, p, s, block_b=block_b, **ikw)
 
         blocks = ([(core.FUSED_BEST_BLOCK_B,)]
                   if args.pallas_sweep == "quick"
@@ -625,7 +632,7 @@ def run_benchmarks(args, device_str: str) -> dict:
         # proof for the hybrid backward.
         verts_fused = jax.jit(
             lambda prm, p, s: core.forward_batched_pallas_fused(
-                prm, p, s, block_b=bb)
+                prm, p, s, block_b=bb, **ikw)
         )(right, jnp.asarray(poses), jnp.asarray(betas))
         prove_vjp(make_fn(bb))
         results["fused_vjp_compiles"] = True
@@ -637,7 +644,7 @@ def run_benchmarks(args, device_str: str) -> dict:
         if args.pallas_sweep == "off" or "block_b" not in fused_best:
             return
         rate, t3f = time_chunked(use_pallas_fused=True,
-                                 block_b=fused_best["block_b"])
+                                 block_b=fused_best["block_b"], **ikw)
         results["config3_fused_chunked_evals_per_sec"] = rate
         log(f"config3f batch={b3} L+R fused chunks "
             f"(block_b={fused_best['block_b']}): {rate:,.0f} evals/s "
@@ -659,7 +666,7 @@ def run_benchmarks(args, device_str: str) -> dict:
 
         def make_fn(block_b):
             return lambda prm, p, s: core.forward_batched_pallas_fused_full(
-                prm, p, s, block_b=block_b)
+                prm, p, s, block_b=block_b, **ikw)
 
         # 512 exceeds v5e's 16M scoped-vmem limit (measured); the sweep's
         # per-config isolation would catch it anyway — not worth the slot.
@@ -682,7 +689,7 @@ def run_benchmarks(args, device_str: str) -> dict:
         # timed path; readback deferred to the accuracy section.
         verts_fused_full = jax.jit(
             lambda prm, p, s: core.forward_batched_pallas_fused_full(
-                prm, p, s, block_b=bb)
+                prm, p, s, block_b=bb, **ikw)
         )(right, jnp.asarray(poses), jnp.asarray(betas))
         prove_vjp(make_fn(bb))
         results["fused_full_vjp_compiles"] = True
@@ -717,7 +724,7 @@ def run_benchmarks(args, device_str: str) -> dict:
                                 beta3[half:][:launch]])
             fwd = loop_scalar(
                 lambda prm, p, s: core.forward_hands_pallas_fused_full(
-                    prm, p, s, block_b=bb).sum()
+                    prm, p, s, block_b=bb, **ikw).sum()
             )
             try:
                 t = slope_time(
@@ -739,7 +746,7 @@ def run_benchmarks(args, device_str: str) -> dict:
         # oracle side checked in the accuracy section.
         verts_hands = jax.jit(
             lambda prm, p, s: core.forward_hands_pallas_fused_full(
-                prm, p, s, block_b=bb)
+                prm, p, s, block_b=bb, **ikw)
         )(stacked, jnp.stack([jnp.asarray(poses)] * 2),
           jnp.stack([jnp.asarray(betas)] * 2))[1]
 
@@ -760,7 +767,7 @@ def run_benchmarks(args, device_str: str) -> dict:
             try:
                 rate, t3g = time_chunked(chunk_size=ck,
                                          use_pallas_fused_full=True,
-                                         block_b=bb)
+                                         block_b=bb, **ikw)
                 tag = "single-launch" if ck == half else f"chunk={ck}"
                 log(f"config3g batch={b3} L+R full-fusion {tag} "
                     f"(block_b={bb}): {rate:,.0f} evals/s "
@@ -795,12 +802,12 @@ def run_benchmarks(args, device_str: str) -> dict:
 
         def fn(prm, p, s):
             return core.forward_batched_pallas_fused_full(prm, p, s,
-                                                          block_b=bb)
+                                                          block_b=bb, **ikw)
 
         with xla_trace(args.profile):
             interleaved_rate(fn, min(half, 8192), 2)
             time_chunked(chunk_size=half, use_pallas_fused_full=True,
-                         block_b=bb)
+                         block_b=bb, **ikw)
         results["profile_dir"] = args.profile
         log(f"xla profiler trace captured to {args.profile}")
 
@@ -1318,17 +1325,17 @@ def run_benchmarks(args, device_str: str) -> dict:
         if args.pallas_sweep != "off":
             analyze(
                 "config3_pallas_chunked",
-                jax.jit(chunked_interleaved(use_pallas=True)),
+                jax.jit(chunked_interleaved(use_pallas=True, **ikw)),
                 (left, right), pose3, beta3,
             )
             analyze(
                 "config3_fused_chunked",
-                jax.jit(chunked_interleaved(use_pallas_fused=True)),
+                jax.jit(chunked_interleaved(use_pallas_fused=True, **ikw)),
                 (left, right), pose3, beta3,
             )
             analyze(
                 "config3_fused_full_chunked",
-                jax.jit(chunked_interleaved(use_pallas_fused_full=True)),
+                jax.jit(chunked_interleaved(use_pallas_fused_full=True, **ikw)),
                 (left, right), pose3, beta3,
             )
 
@@ -1389,6 +1396,10 @@ def main() -> int:
                     help="mask resolution for the silhouette config "
                          "(smaller for CPU correctness runs)")
     ap.add_argument("--skip-fit", action="store_true")
+    ap.add_argument("--pallas-interpret", action="store_true",
+                    help="run kernel configs through the Pallas "
+                         "interpreter (CI coverage of the sweep logic "
+                         "off-TPU; rates are meaningless)")
     ap.add_argument("--pallas-sweep", choices=["off", "quick", "full"],
                     default="full",
                     help="Pallas skinning block-size sweep breadth (full by "
