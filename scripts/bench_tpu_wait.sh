@@ -13,6 +13,11 @@
 #      builder exits rc=2 immediately when the claim or flock is held;
 #   3. bounds each attempt: `timeout` around every bench.py call.
 #
+# Yield path validated live (r4, 2026-07-31): a driver claim written
+# mid-attempt killed the in-flight bench within one 15 s poll, stood the
+# wrapper down, left no orphan processes, and resumed cleanly after the
+# claim cleared.
+#
 # Usage: scripts/bench_tpu_wait.sh [OUT_BASENAME] [DEADLINE_S]
 set -u
 cd "$(dirname "$0")/.."
