@@ -1,0 +1,75 @@
+"""scripts/bench_report.py — the done-criteria verdict tool.
+
+Pinned against the archived round-3 run (a stable in-repo fixture): the
+tool must read both artifact formats, apply the round-4 gates, and
+return a truthful exit code.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).parent.parent
+
+
+def _run(*args):
+    return subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "bench_report.py"), *args],
+        capture_output=True, text=True, cwd=ROOT,
+    )
+
+
+def test_r03_archive_verdict():
+    p = _run("bench_results/r03_tpu_full1.json")
+    # r03's own known gaps: config3 at 0.66x, LM 97.9, no config6.
+    assert p.returncode == 1
+    assert "[PASS] headline_13M" in p.stdout
+    assert "[PASS] accuracy_gate" in p.stdout
+    assert "[FAIL] config3_085x" in p.stdout
+    assert "[FAIL] lm_180" in p.stdout
+    assert "[FAIL] config6_populated" in p.stdout
+    # Self-comparison deltas are +0.0%, not +100%.
+    assert "(+0.0%)" in p.stdout and "+100.0%" not in p.stdout
+
+
+def test_synthetic_passing_run(tmp_path):
+    line = {
+        "metric": "mano_forward_evals_per_sec", "value": 2.1e7,
+        "unit": "evals/s", "vs_baseline": 420.0,
+        "max_err_vs_numpy": 3e-6, "device": "tpu:v5e",
+        "detail": {
+            "config3_fused_full_chunked_evals_per_sec": 1.9e7,
+            "config3_fused_full_chunk_size": 32768,
+            "config4_lm_steps_per_sec": 205.0,
+            "config4_lm_jacobian": "analytic",
+            "config6_sil_renders_per_sec": 900.0,
+            "config6_depth_renders_per_sec": 700.0,
+            "config6_sil_fit_steps_per_sec": 40.0,
+            "fused_full_sweep_stability": {
+                "first": 2.2e7, "remeasured": 2.1e7,
+                "hysteresis_pct": 4.8, "per_cfg": {}},
+        },
+    }
+    run = tmp_path / "run.json"
+    run.write_text(json.dumps(line))
+    p = _run(str(run))
+    assert p.returncode == 0, p.stdout
+    assert "ALL DONE-CRITERIA PASS" in p.stdout
+    assert "drift 4.8%" in p.stdout
+
+    # Driver-wrapper format ({"parsed": ...}) reads identically.
+    wrapped = tmp_path / "wrapped.json"
+    wrapped.write_text(json.dumps({"n": 4, "rc": 0, "parsed": line}))
+    assert _run(str(wrapped)).returncode == 0
+
+    # A null (outage) run fails loudly with the recorded error.
+    nul = tmp_path / "null.json"
+    nul.write_text(json.dumps({
+        "metric": "mano_forward_evals_per_sec", "value": None,
+        "unit": "evals/s", "vs_baseline": None,
+        "error": "backend bring-up failed"}))
+    p = _run(str(nul))
+    assert p.returncode == 1 and "ERROR: backend bring-up" in p.stdout
