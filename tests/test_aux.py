@@ -381,3 +381,17 @@ def test_replicated_sharding_and_xla_trace(tmp_path):
 
 # Pre-commit quick lane: core correctness, seconds-scale (make check-quick).
 pytestmark = __import__("pytest").mark.quick
+
+
+def test_repo_shell_scripts_parse():
+    """`bash -n` every scripts/*.sh — syntax rot in ops tooling should
+    fail CI, not the 3 a.m. tunnel window."""
+    import subprocess
+    from pathlib import Path
+
+    scripts = sorted((Path(__file__).parent.parent / "scripts").glob("*.sh"))
+    assert scripts, "scripts/ lost its shell tooling?"
+    for s in scripts:
+        proc = subprocess.run(["bash", "-n", str(s)],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, (s.name, proc.stderr)
