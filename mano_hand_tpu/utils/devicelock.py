@@ -131,6 +131,12 @@ class DeviceLock:
             self._fd = None
         if self._claimed:
             try:
-                os.remove(CLAIM_PATH)
-            except OSError:
+                # Remove only OUR claim: a second driver (anomalous but
+                # possible) must not clear the surviving one's priority
+                # on its way out.
+                with open(CLAIM_PATH) as f:
+                    owner = json.load(f).get("pid")
+                if owner == os.getpid():
+                    os.remove(CLAIM_PATH)
+            except (OSError, ValueError):
                 pass

@@ -81,3 +81,18 @@ def test_reacquire_after_release():
 
 # Pre-commit quick lane: core correctness, seconds-scale (make check-quick).
 pytestmark = __import__("pytest").mark.quick
+
+
+def test_exit_preserves_foreign_claim():
+    # Anomalous double-driver: the one exiting first must not clear the
+    # surviving (other-process) driver's priority claim.
+    import json
+
+    a = DeviceLock("driver", wait_s=5.0)
+    a.__enter__()
+    with open(devicelock.CLAIM_PATH, "w") as f:
+        json.dump({"pid": 999999, "t": 0}, f)   # other driver's claim
+    a.__exit__()
+    assert os.path.exists(devicelock.CLAIM_PATH), \
+        "exit removed a claim it does not own"
+    os.remove(devicelock.CLAIM_PATH)
