@@ -1470,7 +1470,10 @@ def main() -> int:
             except Exception as e:
                 emit({"metric": "mano_forward_evals_per_sec", "value": None,
                       "unit": "evals/s", "vs_baseline": None,
-                      "error": f"backend bring-up failed: {e}"})
+                      "error": f"backend bring-up failed: {e}",
+                      "note": ("tunnel outage — archived on-chip runs + "
+                               "provenance: bench_results/README.md; "
+                               "verdict tool: scripts/bench_report.py")})
                 return 1
 
             if args.platform:
