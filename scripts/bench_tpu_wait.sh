@@ -36,10 +36,16 @@ claim_fresh() {
 
 while true; do
   now=$(date +%s)
-  if [ $(( now - START )) -ge "$DEADLINE_S" ]; then
+  remaining=$(( DEADLINE_S - (now - START) ))
+  if [ "$remaining" -le 0 ]; then
     echo "[bench-tpu-wait] deadline ${DEADLINE_S}s reached; giving up" >&2
     exit 1
   fi
+  # Cap each attempt by the REMAINING deadline, not just the per-attempt
+  # budget: an attempt started minutes before expiry must die AT the
+  # deadline, not up to an hour past it (observed live, r4 02:47 UTC —
+  # the deadline otherwise only gates new attempts).
+  attempt_cap=$(( remaining < ATTEMPT_TIMEOUT_S ? remaining : ATTEMPT_TIMEOUT_S ))
   if claim_fresh; then
     echo "[bench-tpu-wait] driver claim fresh; standing down 120s" >&2
     sleep 120
@@ -49,7 +55,7 @@ while true; do
   # is in flight: "stand down when another bench wants the device" must
   # hold MID-ATTEMPT too, not just between attempts — a full bench takes
   # tens of minutes and the driver must never contend with its tail.
-  timeout -k 60 "$ATTEMPT_TIMEOUT_S" \
+  timeout -k 60 "$attempt_cap" \
       python bench.py --role builder --pallas-sweep full \
       --init-retries 8 --init-timeout 120 --init-budget 900 --iters 10 \
       --profile "$OUT.trace" \
