@@ -89,6 +89,7 @@ class DeviceLock:
         self._claimed = True
 
     def __enter__(self) -> "DeviceLock":
+        os.makedirs(_LOCK_DIR, exist_ok=True)
         if self.role == "builder" and priority_claim_active():
             raise DeviceBusy(
                 f"driver priority claim at {CLAIM_PATH} is fresh "
