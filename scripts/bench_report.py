@@ -20,11 +20,41 @@ import sys
 
 
 def load_line(path: str) -> dict:
+    """Read a bench artifact in any of its real shapes.
+
+    The driver's BENCH_r{N}.json wrapper is PRETTY-PRINTED (multi-line
+    JSON), so parse the whole text first; the last-nonempty-line fallback
+    covers raw `bench.py` stdout captures with stderr noise mixed in.
+    A wrapper whose ``parsed`` is null (the round-3/4 outage artifacts)
+    becomes a null bench line carrying rc + tail so the verdict is
+    truthful instead of a crash.
+    """
     with open(path) as f:
         text = f.read().strip()
-    data = json.loads(text.splitlines()[-1] if "\n" in text else text)
-    if "parsed" in data and isinstance(data["parsed"], dict):
-        data = data["parsed"]
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError:
+        # Raw capture: the JSON line is usually last, but late stderr
+        # flushes (atexit noise in 2>&1 captures) can trail it — take the
+        # first parseable line from the end.
+        for ln in reversed([ln for ln in text.splitlines() if ln.strip()]):
+            try:
+                data = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(data, dict):
+                break
+        else:
+            raise ValueError(f"no JSON object line found in {path}")
+    if "parsed" in data:
+        if isinstance(data["parsed"], dict):
+            data = data["parsed"]
+        else:
+            tail = (data.get("tail") or "").strip().splitlines()
+            data = {"value": None,
+                    "error": (f"driver artifact parsed=null "
+                              f"(rc={data.get('rc')}); last stderr: "
+                              f"{tail[-1] if tail else ''}")}
     return data
 
 
@@ -35,10 +65,18 @@ def main() -> int:
     args = ap.parse_args()
 
     line = load_line(args.run)
+    if "n_devices" in line:  # a MULTICHIP_r{N}.json dryrun artifact
+        ok = (bool(line.get("ok")) and line.get("rc") == 0
+              and not line.get("skipped"))
+        print(f"multichip dryrun: n_devices={line.get('n_devices')} "
+              f"rc={line.get('rc')} ok={line.get('ok')} "
+              f"skipped={line.get('skipped')}")
+        print("RESULT: " + ("MULTICHIP OK" if ok else "MULTICHIP FAILING"))
+        return 0 if ok else 1
     detail = line.get("detail", {})
     try:
         ref = load_line(args.ref).get("detail", {})
-    except OSError:
+    except (OSError, ValueError):  # ref is informational-only
         ref = {}
 
     headline = line.get("value")

@@ -35,6 +35,31 @@ def test_r03_archive_verdict():
     assert "(+0.0%)" in p.stdout and "+100.0%" not in p.stdout
 
 
+def test_real_driver_artifacts_all_parse():
+    """The tool's one job is answering "did the round pass?" from the
+    driver's own artifacts — which are PRETTY-PRINTED multi-line JSON
+    wrappers, not bench.py's single line. Round 4 shipped a parser that
+    crashed on every real BENCH_r{N}.json (VERDICT r4 weak #1); pin the
+    verbatim in-repo files: rc=0-with-parsed (r02), valid-null (r03),
+    parsed=null rc=124 (r04), and the MULTICHIP dryrun shape."""
+    p = _run("BENCH_r02.json")
+    assert "Traceback" not in p.stderr, p.stderr
+    assert "headline:" in p.stdout  # parsed payload reached the verdict
+    assert "RESULT:" in p.stdout
+
+    p = _run("BENCH_r04.json")
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "parsed=null" in p.stdout and "rc=124" in p.stdout
+
+    p = _run("BENCH_r03.json")
+    assert p.returncode == 1
+    assert "ERROR: backend bring-up failed" in p.stdout
+
+    p = _run("MULTICHIP_r04.json")
+    assert p.returncode == 0
+    assert "MULTICHIP OK" in p.stdout
+
+
 def test_synthetic_passing_run(tmp_path):
     line = {
         "metric": "mano_forward_evals_per_sec", "value": 2.1e7,
