@@ -598,6 +598,11 @@ def forward_verts_fused_full(
     (10 f32 = 40 B); the r/t slabs and blend coefficients of the split
     pipeline never exist in HBM. Requires a level-aligned kinematic tree (all MANO-family
     assets); ``level_layout`` raises otherwise.
+
+    LOCKSTEP: the launch scaffolding below (operand prep, padding,
+    BlockSpecs, HIGH-path split) is deliberately mirrored line for line
+    in ``forward_verts_fused_full_hands`` — apply any change here to
+    that function too (they differ only by the leading hand axis).
     """
     f32 = jnp.float32
     v = params.v_template.shape[0]
@@ -689,7 +694,7 @@ def forward_verts_fused_full_hands(
     (the kernels share ``_fused_full_compute``); both hands must share
     one kinematic tree (they do: stack_params requires it).
 
-    NOTE: the host-side launch scaffolding (operand prep, padding,
+    LOCKSTEP: the host-side launch scaffolding (operand prep, padding,
     BlockSpecs, HIGH-path split) deliberately mirrors
     ``forward_verts_fused_full`` line for line rather than sharing a
     builder — the one-hand path is the measured headline kernel and
