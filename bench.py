@@ -70,6 +70,8 @@ def log(msg: str) -> None:
 _EMITTED = False  # guards the one-line contract across the signal path
 _ACTIVE_LOCK = None  # the live DeviceLock, for signal-time release
 _LIVE_PROBE = None  # the in-flight backend-probe child, for signal-time kill
+_PARTIAL = None  # (results, errors, device_str, is_tpu) live in run_benchmarks
+_FINAL_LINE = None  # the complete line once run_benchmarks finishes
 
 _OUTAGE_NOTE = ("tunnel outage — archived on-chip runs + provenance: "
                 "bench_results/README.md; verdict tool: "
@@ -131,12 +133,32 @@ def _signal_guard(signum, frame) -> None:
         except Exception:
             pass
     name = signal.Signals(signum).name
-    try:
-        if not _EMITTED:
-            emit(_null_line(f"killed by {name} before completion",
-                            outage=True))
-    except Exception:
-        pass
+    if not _EMITTED:
+        line = None
+        if _FINAL_LINE is not None:
+            # The run COMPLETED; the kill landed between lock release and
+            # the final emit. The full line, unlabeled, is the truth.
+            line = _FINAL_LINE
+        elif _PARTIAL is not None:
+            try:
+                line = assemble_line(*_PARTIAL)
+                line["partial"] = True
+                line["error"] = (f"killed by {name} mid-run; value "
+                                 "covers only the configs completed "
+                                 "before the signal")
+            except Exception:
+                line = None  # nothing salvageable → the null line
+        try:
+            if line is not None:
+                emit(line)
+        except Exception:
+            line = None  # unserializable salvage must not cost the null
+        if line is None and not _EMITTED:  # _EMITTED: print died mid-line
+            try:
+                emit(_null_line(f"killed by {name} before completion",
+                                outage=True))
+            except Exception:
+                pass
     try:
         log(f"bench: caught {name}; null artifact emitted, exiting")
     except Exception:
@@ -351,6 +373,10 @@ def run_benchmarks(args, device_str: str) -> dict:
 
     results: dict = {}
     errors: dict = {}
+    # Register the LIVE dicts for the signal guard: a kill mid-run then
+    # salvages every config completed so far into a partial artifact.
+    global _PARTIAL
+    _PARTIAL = (results, errors, device_str, is_tpu)
 
     def section(name, fn):
         """Fault-isolate one config; a crash records an error, not a wipe."""
@@ -1456,7 +1482,19 @@ def run_benchmarks(args, device_str: str) -> dict:
 
     section("memory_probe", memory_probe)
 
-    # -- headline + roofline -------------------------------------------------
+    global _FINAL_LINE
+    _FINAL_LINE = assemble_line(results, errors, device_str, is_tpu)
+    return _FINAL_LINE
+
+
+def assemble_line(results: dict, errors: dict, device_str: str,
+                  is_tpu: bool) -> dict:
+    """Headline + roofline + the final JSON line from whatever configs
+    completed. Top-level (not inline in run_benchmarks) so the signal
+    guard can salvage a PARTIAL artifact from the registered live dicts
+    when a kill lands mid-run — configs measured before the signal are a
+    strictly better driver artifact than a bare null. Raises when no
+    throughput config completed (callers fall back to the null line)."""
     candidates = [results.get("config2_b1024_evals_per_sec"),
                   results.get("config3_b65536_evals_per_sec"),
                   results.get("config3_pallas_chunked_evals_per_sec"),

@@ -104,6 +104,48 @@ def test_bench_sigterm_emits_null_line(tmp_path):
     assert not (tmp_path / "mano_tpu_device.priority").exists()
 
 
+def test_bench_sigterm_mid_run_salvages_partial_results(tmp_path):
+    """A kill landing AFTER some configs completed must emit those numbers
+    as a partial artifact, not discard them for a bare null — on the flaky
+    tunnel, a mid-run kill may hold the round's only on-chip data."""
+    out, err = tmp_path / "out.log", tmp_path / "err.log"
+    with open(out, "w") as fo, open(err, "w") as fe:
+        proc = subprocess.Popen(
+            [sys.executable, str(ROOT / "bench.py"),
+             "--platform", "cpu", "--big-batch", "256", "--chunk", "128",
+             "--iters", "2", "--skip-fit", "--pallas-sweep", "off",
+             "--init-retries", "2", "--init-timeout", "60",
+             "--sil-size", "24"],
+            stdout=fo, stderr=fe, cwd=ROOT,
+            env={**os.environ, "MANO_DEVICE_LOCK_DIR": str(tmp_path)},
+        )
+        try:
+            # config2's rate is recorded when its log line appears; a kill
+            # any time after that must salvage it.
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                if "config2 batch=1024" in err.read_text():
+                    break
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        f"bench exited before config2: {err.read_text()}")
+                time.sleep(0.2)
+            else:
+                raise AssertionError(f"config2 never ran: {err.read_text()}")
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=60)
+        finally:
+            proc.kill()
+    assert rc == 128 + signal.SIGTERM, err.read_text()
+    lines = [ln for ln in out.read_text().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    line = json.loads(lines[0])
+    assert line["partial"] is True
+    assert line["value"] is not None and line["value"] > 0
+    assert "SIGTERM" in line["error"] and "mid-run" in line["error"]
+    assert "config2_b1024_evals_per_sec" in line["detail"]
+
+
 def test_bench_cpu_tiny_run_end_to_end():
     """Full harness on CPU with minimal sizes: rc=0, all headline fields."""
     rc, line = _run_bench(
