@@ -109,8 +109,28 @@ def _null_line(error: str, outage: bool = False) -> dict:
     return line
 
 
+def _salvage(error: str) -> dict | None:
+    """A partial line from run_benchmarks' live dicts, or None.
+
+    Shared by the signal guard and main()'s crash handler: a kill OR an
+    unisolated exception mid-run must both preserve the configs already
+    measured — on the flaky tunnel they may be the round's only on-chip
+    numbers."""
+    if _PARTIAL is None:
+        return None
+    try:
+        line = assemble_line(*_PARTIAL)
+    except Exception:
+        return None
+    line["partial"] = True
+    line["error"] = error
+    return line
+
+
 def _signal_guard(signum, frame) -> None:
-    """Emit the guaranteed null line on SIGTERM/SIGINT, then exit.
+    """Emit the guaranteed artifact line on SIGTERM/SIGINT, then exit:
+    the completed line if the run finished, a partial salvage if configs
+    completed, else the null line.
 
     The driver harness bounds `python bench.py` with `timeout` (SIGTERM at
     ~30 min); without this handler a kill mid-probe leaves an EMPTY stdout
@@ -133,26 +153,21 @@ def _signal_guard(signum, frame) -> None:
         except Exception:
             pass
     name = signal.Signals(signum).name
+    kind = "already-emitted"
     if not _EMITTED:
-        line = None
         if _FINAL_LINE is not None:
             # The run COMPLETED; the kill landed between lock release and
             # the final emit. The full line, unlabeled, is the truth.
-            line = _FINAL_LINE
-        elif _PARTIAL is not None:
-            try:
-                line = assemble_line(*_PARTIAL)
-                line["partial"] = True
-                line["error"] = (f"killed by {name} mid-run; value "
-                                 "covers only the configs completed "
-                                 "before the signal")
-            except Exception:
-                line = None  # nothing salvageable → the null line
+            line, kind = _FINAL_LINE, "complete"
+        else:
+            line = _salvage(f"killed by {name} mid-run; value covers "
+                            "only the configs completed before the signal")
+            kind = "partial" if line is not None else "null"
         try:
             if line is not None:
                 emit(line)
         except Exception:
-            line = None  # unserializable salvage must not cost the null
+            line, kind = None, "null"  # bad salvage must not cost the null
         if line is None and not _EMITTED:  # _EMITTED: print died mid-line
             try:
                 emit(_null_line(f"killed by {name} before completion",
@@ -160,7 +175,7 @@ def _signal_guard(signum, frame) -> None:
             except Exception:
                 pass
     try:
-        log(f"bench: caught {name}; null artifact emitted, exiting")
+        log(f"bench: caught {name}; {kind} artifact emitted, exiting")
     except Exception:
         pass
     probe = _LIVE_PROBE
@@ -1006,6 +1021,39 @@ def run_benchmarks(args, device_str: str) -> dict:
         log(f"config4b LM b={b4}: {1.0 / t_step:,.1f} steps/s "
             f"({t_step * 1e3:.2f} ms/step, analytic Jacobian)")
 
+        # One-pass bf16 normal equations (fit_lm normal_eq="bf16", the
+        # roadmap's next 200+ steps/s candidate): measure speed AND the
+        # convergence ratio in the same compilation context — a silent
+        # precision collapse must show up here, not in production.
+        def run_lm_bf16(steps):
+            return lambda: float(
+                fit_lm(right, fit_targets, n_steps=steps,
+                       jacobian=lm_jacobian,
+                       normal_eq="bf16").final_loss.sum()
+            )
+
+        t_bf16 = slope_time(run_lm_bf16, 5, 15,
+                            iters=max(2, args.iters // 3))
+        results["config4_lm_bf16_steps_per_sec"] = 1.0 / t_bf16
+        # Convergence probe at n_steps=15: REUSES the slope-timed
+        # executables (n_steps is static on fit_lm — any other count
+        # would be a fresh compile AND a different compilation context
+        # than the timed path, against the CLAUDE.md numerics rule).
+        loss_hi = float(fit_lm(right, fit_targets, n_steps=15,
+                               jacobian=lm_jacobian).final_loss.mean())
+        loss_bf = float(fit_lm(right, fit_targets, n_steps=15,
+                               jacobian=lm_jacobian,
+                               normal_eq="bf16").final_loss.mean())
+        # The finite flag carries the collapse signal even when the ratio
+        # is unrepresentable (NaN scrubs to null in the artifact, which
+        # would look identical to "unmeasured").
+        results["config4_lm_bf16_finite"] = bool(np.isfinite(loss_bf))
+        results["config4_lm_bf16_loss_ratio"] = (
+            loss_bf / max(loss_hi, 1e-30))
+        log(f"config4b LM bf16-JtJ: {1.0 / t_bf16:,.1f} steps/s "
+            f"(final-loss ratio vs high {loss_bf / max(loss_hi, 1e-30):.3g},"
+            f" finite={np.isfinite(loss_bf)})")
+
     if not args.skip_fit:
         section("config4", config4)
         section("config4b_lm", config4b_lm)
@@ -1640,8 +1688,14 @@ def main() -> int:
             try:
                 line = run_benchmarks(args, device_str)
             except Exception as e:
-                emit({**_null_line(f"{type(e).__name__}: {str(e)[:600]}"),
-                      "device": device_str})
+                err = f"{type(e).__name__}: {str(e)[:600]}"
+                # An exception escaping a non-isolated statement mid-run
+                # (e.g. a device transfer when the tunnel drops) preserves
+                # completed configs the same way a kill does.
+                crash = _salvage(f"crashed mid-run ({err}); value covers "
+                                 "only the configs completed before the "
+                                 "crash")
+                emit({**(crash or _null_line(err)), "device": device_str})
                 return 1
     except DeviceBusy as e:
         emit(_null_line(f"device busy: {e}"))

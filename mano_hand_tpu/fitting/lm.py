@@ -62,8 +62,19 @@ def _fit_single(
     tips=None,
     keypoint_order: str = "mano",
     jacobian: str = "analytic",
+    normal_eq: str = "high",
 ) -> LMResult:
     dtype = params.v_template.dtype
+    # One-pass bf16 normal equations (roadmap candidate for 200+ steps/s):
+    # JtJ/Jtr are the step's largest matmuls ([R~2344, 58] contractions);
+    # Precision.DEFAULT runs them in one MXU pass instead of HIGH's three.
+    # J entries are O(1) and accumulation stays f32, and the damped
+    # accept/reject loop tolerates direction noise (same argument as the
+    # LU-vs-Cholesky note below) — but numerics are only trusted measured
+    # ON-CHIP, so the default stays "high" until the bench ratio says
+    # otherwise.
+    ne_precision = (core.DEFAULT_PRECISION if normal_eq == "high"
+                    else jax.lax.Precision.DEFAULT)
     n_joints = params.j_regressor.shape[0]
     n_shape = params.shape_basis.shape[-1]
 
@@ -235,10 +246,10 @@ def _fit_single(
             r = res_fn(flat)
             jac = jax.jacfwd(res_fn)(flat)             # [R, P]
         jtj = jnp.einsum(
-            "rp,rq->pq", jac, jac, precision=core.DEFAULT_PRECISION
+            "rp,rq->pq", jac, jac, precision=ne_precision
         )                                              # [P, P] (MXU)
         jtr = jnp.einsum(
-            "rp,r->p", jac, r, precision=core.DEFAULT_PRECISION
+            "rp,r->p", jac, r, precision=ne_precision
         )
         a = jtj + damping * jnp.diag(jnp.diag(jtj)) \
             + 1e-9 * jnp.eye(n_params, dtype=dtype)
@@ -278,7 +289,7 @@ def _fit_single(
     jax.jit,
     static_argnames=("n_steps", "data_term", "trim_fraction",
                      "robust_weights", "robust_scale", "tip_vertex_ids",
-                     "keypoint_order", "jacobian"),
+                     "keypoint_order", "jacobian", "normal_eq"),
 )
 def fit_lm(
     params: ManoParams,
@@ -297,6 +308,7 @@ def fit_lm(
     tip_vertex_ids=None,         # None | "smplx" | "manopth" | vertex ids
     keypoint_order: str = "mano",  # "mano" | "openpose"
     jacobian: str = "analytic",  # "analytic" | "ad"
+    normal_eq: str = "high",     # "high" | "bf16"
 ) -> LMResult:
     """Recover (pose, shape) by damped Gauss-Newton; batch via vmap.
 
@@ -342,6 +354,12 @@ def fit_lm(
     vs 10.7 for ``"ad"`` at batch 256 on a v5e chip (93 -> 182 steps/s),
     identical convergence (tests/test_jacobian.py). ``"ad"`` keeps the
     plain ``jax.jacfwd`` path as the cross-check.
+
+    ``normal_eq="bf16"`` builds JtJ/Jtr in one bf16 MXU pass instead of
+    the model default's three (f32 accumulation; the J entries are O(1)
+    so the normal matrix tolerates it the way the LU direction noise
+    does). Off by default pending the bench's on-chip convergence-ratio
+    measurement (bench config4b records both variants).
     """
     if data_term not in ("verts", "joints", "points",
                          "point_to_plane"):
@@ -387,6 +405,10 @@ def fit_lm(
         raise ValueError(
             f"jacobian must be 'analytic' or 'ad', got {jacobian!r}"
         )
+    if normal_eq not in ("high", "bf16"):
+        raise ValueError(
+            f"normal_eq must be 'high' or 'bf16', got {normal_eq!r}"
+        )
     single = functools.partial(
         _fit_single,
         params,
@@ -402,6 +424,7 @@ def fit_lm(
         tips=tips,
         keypoint_order=keypoint_order,
         jacobian=jacobian,
+        normal_eq=normal_eq,
     )
     if target_verts.ndim == 2:
         return single(target_verts, init=init)

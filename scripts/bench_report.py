@@ -85,7 +85,14 @@ def main() -> int:
           f"device={line.get('device')}")
     if line.get("error"):
         print(f"ERROR: {line['error']}")
-        return 1
+        # A PARTIAL artifact (mid-run kill salvage) still carries real
+        # numbers — fall through and judge what completed; a null stops
+        # here.
+        if headline is None:
+            return 1
+        if line.get("partial"):
+            print("note: partial artifact — absent configs are unmeasured, "
+                  "not failed")
 
     checks = []
 

@@ -28,6 +28,9 @@ ATTEMPT_TIMEOUT_S="${ATTEMPT_TIMEOUT_S:-3600}"
 # test-isolation env var so wrapper and bench.py agree on the claim).
 CLAIM="${MANO_DEVICE_LOCK_DIR:-/tmp}/mano_tpu_device.priority"
 START=$(date +%s)
+# A preserved partial from a PREVIOUS invocation must never be emitted as
+# this run's artifact at the deadline.
+rm -f "$OUT.partial.out"
 
 claim_fresh() {
   # mirrors mano_hand_tpu.utils.devicelock.CLAIM_FRESH_S = 2 h
@@ -38,6 +41,12 @@ while true; do
   now=$(date +%s)
   remaining=$(( DEADLINE_S - (now - START) ))
   if [ "$remaining" -le 0 ]; then
+    if [ -f "$OUT.partial.out" ]; then
+      echo "[bench-tpu-wait] deadline reached; emitting the preserved" \
+           "partial artifact" >&2
+      cat "$OUT.partial.out"
+      exit 0
+    fi
     echo "[bench-tpu-wait] deadline ${DEADLINE_S}s reached; giving up" >&2
     exit 1
   fi
@@ -75,6 +84,14 @@ while true; do
   done
   wait "$BPID"
   rc=$?
+  # A failed/preempted attempt may still have salvaged on-chip numbers
+  # (bench.py's partial artifact on SIGTERM/crash). The next attempt's
+  # `> "$OUT.out"` would truncate them — preserve the newest partial; at
+  # the deadline it is better than nothing.
+  if [ "$rc" -ne 0 ] && grep -q '"partial": true' "$OUT.out" 2>/dev/null; then
+    cp "$OUT.out" "$OUT.partial.out"
+    echo "[bench-tpu-wait] partial artifact preserved -> $OUT.partial.out" >&2
+  fi
   if [ "$preempted" -eq 1 ]; then
     echo "[bench-tpu-wait] standing down 300s for the driver" >&2
     sleep 300

@@ -60,6 +60,29 @@ def test_real_driver_artifacts_all_parse():
     assert "MULTICHIP OK" in p.stdout
 
 
+def test_partial_artifact_is_judged_not_discarded(tmp_path):
+    """A mid-run-kill salvage line (partial=true, real value, error set)
+    must get a verdict on the configs it carries — captured live from a
+    SIGTERM'd CPU run — rather than stopping at the error field."""
+    line = {
+        "metric": "mano_forward_evals_per_sec", "value": 34658.0,
+        "unit": "evals/s", "vs_baseline": 0.693, "max_err_vs_numpy": None,
+        "device": "cpu:cpu",
+        "detail": {"config2_b1024_evals_per_sec": 34658.0,
+                   "flops_per_eval": 994770.0},
+        "partial": True,
+        "error": "killed by SIGTERM mid-run; value covers only the "
+                 "configs completed before the signal",
+    }
+    run = tmp_path / "partial.json"
+    run.write_text(json.dumps(line))
+    p = _run(str(run))
+    assert p.returncode == 1  # headline/accuracy gates unmet in this one
+    assert "ERROR: killed by SIGTERM" in p.stdout
+    assert "partial artifact" in p.stdout
+    assert "RESULT:" in p.stdout  # the verdict ran anyway
+
+
 def test_synthetic_passing_run(tmp_path):
     line = {
         "metric": "mano_forward_evals_per_sec", "value": 2.1e7,

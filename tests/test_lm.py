@@ -323,3 +323,22 @@ def test_lm_robust_weights_validation(params32):
     with pytest.raises(ValueError, match="robust_scale"):
         fit_lm(params32, cloud, n_steps=1, data_term="points",
                robust_weights="tukey", robust_scale=-1.0)
+
+
+def test_lm_bf16_normal_eq_converges(params32):
+    """normal_eq="bf16" (one-pass MXU normal equations) must converge like
+    the default path. On CPU, Precision.DEFAULT is full f32, so this pins
+    the plumbing and the convergence loop; the bf16 NUMERICS are measured
+    on-chip by bench config4b's loss-ratio field (process note: precision
+    is only trusted in the shipped compilation context)."""
+    rng = np.random.default_rng(7)
+    pose = rng.normal(scale=0.25, size=(16, 3)).astype(np.float32)
+    target = core.jit_forward(
+        params32, jnp.asarray(pose), jnp.zeros(10)
+    ).verts
+    res = fit_lm(params32, target, n_steps=20, normal_eq="bf16")
+    assert np.asarray(res.final_loss).max() < 1e-12
+    assert np.abs(np.asarray(res.pose) - pose).max() < 1e-4
+
+    with pytest.raises(ValueError, match="normal_eq"):
+        fit_lm(params32, target, n_steps=2, normal_eq="fp8")
