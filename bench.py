@@ -362,6 +362,37 @@ def parse_mesh(spec: str):
     return out
 
 
+def _enable_compile_cache(locked: bool = True) -> None:
+    """Persistent XLA compile cache for bench runs (.jax_bench_cache).
+
+    Window math again: a cold full-sweep run pays ~dozens of TPU
+    compilations at 20-40 s each — a large slice of the driver's ~30-min
+    kill window. The builder wrapper's attempts warm this cache, so the
+    driver's end-of-round run (same machine, same programs) starts warm.
+    Safety vs the round-3 deserialize-segfault class: that crash needs
+    (a) hundreds of live executables in one process (the test suite's
+    conftest clears per module; a bench run compiles ~dozens) or (b) two
+    processes sharing one cache dir concurrently — the device lock
+    serializes real bench runs (``locked=False`` — an advisory-timeout
+    driver proceeding UNLOCKED — skips the shared dir for a per-pid one
+    so a wedged lock-holder can't share it), and the bench tests point
+    MANO_BENCH_CACHE_DIR at their own tmp dirs.
+    """
+    import jax
+
+    cache_dir = os.environ.get(
+        "MANO_BENCH_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_bench_cache"))
+    if not locked:
+        cache_dir = os.path.join("/tmp", f"mano_bench_cache_{os.getpid()}")
+        log("device lock NOT held: per-pid compile cache (no warm reuse)")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    log(f"compile cache: {cache_dir}")
+
+
 def run_benchmarks(args, device_str: str) -> dict:
     import jax
     import jax.numpy as jnp
@@ -1684,6 +1715,8 @@ def main() -> int:
             if args.platform:
                 import jax
                 jax.config.update("jax_platforms", args.platform)
+
+            _enable_compile_cache(locked=lock.acquired)
 
             try:
                 line = run_benchmarks(args, device_str)

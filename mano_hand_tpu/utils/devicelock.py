@@ -124,6 +124,14 @@ class DeviceLock:
             self._write_claim()  # refresh mtime: builders keep yielding
             time.sleep(10.0)
 
+    @property
+    def acquired(self) -> bool:
+        """True iff the flock is actually held (a driver past its
+        advisory wait proceeds with acquired=False — callers that need
+        exclusivity guarantees, e.g. shared-cache enablement, check
+        this)."""
+        return self._locked
+
     def __exit__(self, *exc) -> None:
         if self._fd is not None:
             if self._locked:

@@ -3,8 +3,10 @@ stdout JSON line), so its contract is tested: valid JSON on success AND on
 every failure mode. Round 1 shipped an untested harness that died with a
 traceback at backend init and captured nothing — never again."""
 
+import atexit
 import json
 import os
+import shutil
 import signal
 import subprocess
 import sys
@@ -18,14 +20,23 @@ ROOT = Path(__file__).parent.parent
 
 # Isolated device-lock dir: a test bench run must never queue behind (or
 # stand down) a real builder pipeline on this machine — and vice versa.
+# Same for the bench compile cache: a concurrent real bench (builder
+# pipeline) must never share a cache dir with a test bench process (the
+# round-3 two-writers crash class).
 _LOCK_DIR = tempfile.mkdtemp(prefix="mano_test_lock_")
+_CACHE_DIR = tempfile.mkdtemp(prefix="mano_test_bench_cache_")
+# The cache dir fills with real executable blobs (min entry size -1);
+# leaking one per pytest run would steadily eat /tmp on this box.
+atexit.register(shutil.rmtree, _CACHE_DIR, ignore_errors=True)
+_BENCH_ENV = {**os.environ, "MANO_DEVICE_LOCK_DIR": _LOCK_DIR,
+              "MANO_BENCH_CACHE_DIR": _CACHE_DIR}
 
 
 def _run_bench(*extra, timeout=420):
     proc = subprocess.run(
         [sys.executable, str(ROOT / "bench.py"), *extra],
         capture_output=True, text=True, timeout=timeout, cwd=ROOT,
-        env={**os.environ, "MANO_DEVICE_LOCK_DIR": _LOCK_DIR},
+        env=_BENCH_ENV,
     )
     lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
     assert len(lines) == 1, f"expected exactly one stdout line, got {lines!r}"
@@ -77,7 +88,8 @@ def test_bench_sigterm_emits_null_line(tmp_path):
              "--platform", "nosuchbackend", "--init-retries", "5",
              "--init-timeout", "60"],
             stdout=fo, stderr=fe, cwd=ROOT,
-            env={**os.environ, "MANO_DEVICE_LOCK_DIR": str(tmp_path)},
+            env={**os.environ, "MANO_DEVICE_LOCK_DIR": str(tmp_path),
+                 "MANO_BENCH_CACHE_DIR": str(tmp_path / "cache")},
         )
         try:
             # Land the signal mid-work: wait until the run is past lock
@@ -117,7 +129,8 @@ def test_bench_sigterm_mid_run_salvages_partial_results(tmp_path):
              "--init-retries", "2", "--init-timeout", "60",
              "--sil-size", "24"],
             stdout=fo, stderr=fe, cwd=ROOT,
-            env={**os.environ, "MANO_DEVICE_LOCK_DIR": str(tmp_path)},
+            env={**os.environ, "MANO_DEVICE_LOCK_DIR": str(tmp_path),
+                 "MANO_BENCH_CACHE_DIR": str(tmp_path / "cache")},
         )
         try:
             # config2's rate is recorded when its log line appears; a kill
