@@ -495,6 +495,7 @@ def forward_batched_pallas_fused_full(
     precision=DEFAULT_PRECISION,
     block_b: int = FUSED_FULL_BEST_BLOCK_B,
     interpret: bool = False,
+    stack_skin: bool = False,
 ) -> jnp.ndarray:
     """Batched forward with the WHOLE pipeline in one Pallas launch.
 
@@ -513,7 +514,7 @@ def forward_batched_pallas_fused_full(
     pose = pose.reshape(pose.shape[0], -1, 3)
     # Positional call: custom_vjp functions reject keyword arguments.
     return pallas_forward.forward_verts_fused_full_ad(
-        params, pose, shape, precision, block_b, interpret
+        params, pose, shape, precision, block_b, interpret, stack_skin
     )
 
 
@@ -524,6 +525,7 @@ def forward_hands_pallas_fused_full(
     precision=DEFAULT_PRECISION,
     block_b: int = FUSED_FULL_BEST_BLOCK_B,
     interpret: bool = False,
+    stack_skin: bool = False,
 ) -> jnp.ndarray:
     """Both hands' full-fusion forward in ONE kernel launch: [2, B, V, 3].
 
@@ -538,7 +540,7 @@ def forward_hands_pallas_fused_full(
 
     return pallas_forward.forward_verts_fused_full_hands(
         stacked, pose, shape, precision, block_b=block_b,
-        interpret=interpret,
+        interpret=interpret, stack_skin=stack_skin,
     )
 
 
@@ -589,6 +591,7 @@ def forward_chunked(
     interpret: bool = False,
     use_pallas_fused: bool = False,
     use_pallas_fused_full: bool = False,
+    stack_skin: bool = False,
 ) -> jnp.ndarray:
     """Memory-bounded huge-batch vertices via lax.map over chunks.
 
@@ -600,8 +603,9 @@ def forward_chunked(
     ``use_pallas_fused`` routes the whole vertex path (blend + skin) through
     the fully-fused kernel (ops/pallas_forward.py), where ``block_b`` is its
     batch tile; ``use_pallas_fused_full`` routes the ENTIRE forward
-    (Rodrigues + FK included) through the full-fusion kernel. Block
-    defaults are the bench sweep's winners (docs/benchmarking.md).
+    (Rodrigues + FK included) through the full-fusion kernel; its
+    ``stack_skin`` batches the skinny skin dots (full-fusion route only).
+    Block defaults are the bench sweep's winners (docs/benchmarking.md).
     """
     b = pose.shape[0]
     pose_c, shape_c, chunk_size = _pad_and_chunk(pose, shape, chunk_size)
@@ -611,6 +615,7 @@ def forward_chunked(
         chunk_fn = lambda ps: forward_batched_pallas_fused_full(  # noqa: E731
             params, ps[0], ps[1], precision,
             block_b=min(bb, chunk_size), interpret=interpret,
+            stack_skin=stack_skin,
         )
     elif use_pallas_fused:
         bb = FUSED_BEST_BLOCK_B if block_b is None else block_b

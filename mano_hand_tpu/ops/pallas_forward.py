@@ -504,33 +504,38 @@ def _fk_slabs(r_local, jx, jy, jz, levels):
     return world_r, skin_t
 
 
-def _fused_full_kernel(vp, levels, precision, split, *refs):
+def _fused_full_kernel(vp, levels, precision, split, stack_skin, *refs):
     """One batch tile of the COMPLETE forward: pose/shape slabs in,
     vertex coordinate planes out. ``split`` selects the pre-split-bf16
     HIGH path for the resident operands (see _fused_kernel_split)."""
     n_in = 11 if split else 9
     ins = [r[:] for r in refs[:n_in]]
-    outs = _fused_full_compute(vp, levels, precision, split, *ins)
+    outs = _fused_full_compute(vp, levels, precision, split, stack_skin,
+                               *ins)
     for o, r in zip(outs, refs[n_in:n_in + 3]):
         r[:] = o
 
 
-def _fused_full_kernel_hands(vp, levels, precision, split, *refs):
+def _fused_full_kernel_hands(vp, levels, precision, split, stack_skin,
+                             *refs):
     """Two-hand variant: identical math per (hand, batch-tile) grid cell;
     every block carries a leading size-1 hand axis (the hand-major grid
     keeps each hand's resident operands in VMEM across its whole batch
     range — one refetch per hand, not per tile)."""
     n_in = 11 if split else 9
     ins = [r[0] for r in refs[:n_in]]
-    outs = _fused_full_compute(vp, levels, precision, split, *ins)
+    outs = _fused_full_compute(vp, levels, precision, split, stack_skin,
+                               *ins)
     for o, r in zip(outs, refs[n_in:n_in + 3]):
         r[0] = o
 
 
-def _fused_full_compute(vp, levels, precision, split, *ins):
+def _fused_full_compute(vp, levels, precision, split, stack_skin, *ins):
     """The full forward on VALUES (blocks already read): returns the
     three output coordinate planes. Shared by the one-hand and two-hand
-    kernels."""
+    kernels. ``stack_skin`` batches each output coordinate's four K=16
+    skin dots into one [4*TB, J] dot (same FLOPs; fewer MXU pipeline
+    fills) — a measured-on-chip choice, see bench config3d."""
     if split:
         (basis_hi, basis_lo, wt_hi, wt_lo, jbx, jby, jbz,
          shape_aug, x, y, z) = ins
@@ -562,24 +567,52 @@ def _fused_full_compute(vp, levels, precision, split, *ins):
         )
 
     outs = []
+    tb = x.shape[0]
     if split:
         c_hi, c_lo = _split_hi_lo(coeff)
         vp_flat = _dot3(c_hi, c_lo, basis_hi, basis_lo)
         for a in range(3):
-            t_hi, t_lo = _split_hi_lo(skin_t[a])
-            acc = _dot3(t_hi, t_lo, wt_hi, wt_lo)
-            for c in range(3):
-                r_hi, r_lo = _split_hi_lo(world_r[3 * a + c])
-                m_ac = _dot3(r_hi, r_lo, wt_hi, wt_lo)
-                acc = acc + m_ac * vp_flat[:, c * vp:(c + 1) * vp]
+            if stack_skin:
+                # The four K=16 skin dots of this output coordinate share
+                # the SAME RHS (wt) — stacking their LHS rows into one
+                # [4*TB, J] dot amortizes the MXU pipeline fill the
+                # skinny K pays per pass (9 passes per tile instead of
+                # 36 under HIGH). Identical FLOPs and per-row math; rows
+                # slice back out of the product for the combine.
+                lhs = jnp.concatenate(
+                    [skin_t[a], world_r[3 * a + 0],
+                     world_r[3 * a + 1], world_r[3 * a + 2]], axis=0)
+                l_hi, l_lo = _split_hi_lo(lhs)
+                big = _dot3(l_hi, l_lo, wt_hi, wt_lo)    # [4*TB, VP]
+                acc = big[0:tb]
+                for c in range(3):
+                    acc = acc + (big[(1 + c) * tb:(2 + c) * tb]
+                                 * vp_flat[:, c * vp:(c + 1) * vp])
+            else:
+                t_hi, t_lo = _split_hi_lo(skin_t[a])
+                acc = _dot3(t_hi, t_lo, wt_hi, wt_lo)
+                for c in range(3):
+                    r_hi, r_lo = _split_hi_lo(world_r[3 * a + c])
+                    m_ac = _dot3(r_hi, r_lo, wt_hi, wt_lo)
+                    acc = acc + m_ac * vp_flat[:, c * vp:(c + 1) * vp]
             outs.append(acc)
     else:
         vp_flat = kernel_dot(coeff, basis_op, precision)
         for a in range(3):
-            acc = kernel_dot(skin_t[a], wt_op, precision)
-            for c in range(3):
-                m_ac = kernel_dot(world_r[3 * a + c], wt_op, precision)
-                acc = acc + m_ac * vp_flat[:, c * vp:(c + 1) * vp]
+            if stack_skin:
+                lhs = jnp.concatenate(
+                    [skin_t[a], world_r[3 * a + 0],
+                     world_r[3 * a + 1], world_r[3 * a + 2]], axis=0)
+                big = kernel_dot(lhs, wt_op, precision)  # [4*TB, VP]
+                acc = big[0:tb]
+                for c in range(3):
+                    acc = acc + (big[(1 + c) * tb:(2 + c) * tb]
+                                 * vp_flat[:, c * vp:(c + 1) * vp])
+            else:
+                acc = kernel_dot(skin_t[a], wt_op, precision)
+                for c in range(3):
+                    m_ac = kernel_dot(world_r[3 * a + c], wt_op, precision)
+                    acc = acc + m_ac * vp_flat[:, c * vp:(c + 1) * vp]
             outs.append(acc)
     return tuple(outs)
 
@@ -591,6 +624,7 @@ def forward_verts_fused_full(
     precision=DEFAULT_PRECISION,
     block_b: int = 128,
     interpret: bool = False,
+    stack_skin: bool = False,
 ) -> jnp.ndarray:
     """Batched vertices [B, V, 3] with the WHOLE forward in one kernel.
 
@@ -664,7 +698,7 @@ def forward_verts_fused_full(
                     *([spec_bj] * 3)]
     outs = pl.pallas_call(
         functools.partial(_fused_full_kernel, vp, levels,
-                          precision, split),
+                          precision, split, stack_skin),
         grid=grid,
         in_specs=in_specs,
         out_specs=[spec_bv] * 3,
@@ -681,6 +715,7 @@ def forward_verts_fused_full_hands(
     precision=DEFAULT_PRECISION,
     block_b: int = 128,
     interpret: bool = False,
+    stack_skin: bool = False,
 ) -> jnp.ndarray:
     """BOTH hands' complete forward in ONE kernel launch: [2, B, V, 3].
 
@@ -770,7 +805,7 @@ def forward_verts_fused_full_hands(
                     *([spec_bj] * 3)]
     outs = pl.pallas_call(
         functools.partial(_fused_full_kernel_hands, vp, levels,
-                          precision, split),
+                          precision, split, stack_skin),
         grid=grid,
         in_specs=in_specs,
         out_specs=[spec_bv] * 3,
@@ -780,23 +815,26 @@ def forward_verts_fused_full_hands(
     return jnp.stack(outs, axis=-1)[:, :b, :v, :]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def forward_verts_fused_full_ad(
     params, pose, shape,
     precision=DEFAULT_PRECISION, block_b: int = 128, interpret: bool = False,
+    stack_skin: bool = False,
 ):
     """Differentiable fully-fused forward — same hybrid VJP as
     ``forward_verts_fused_ad`` (the backward recomputes the tiny
     pre-stage in XLA regardless of how the forward was fused, so the
-    cotangent math is shared verbatim)."""
+    cotangent math is shared verbatim; ``stack_skin`` only reorders the
+    forward's MXU passes)."""
     return forward_verts_fused_full(
-        params, pose, shape, precision, block_b, interpret
+        params, pose, shape, precision, block_b, interpret, stack_skin
     )
 
 
-def _fwd_full(params, pose, shape, precision, block_b, interpret):
+def _fwd_full(params, pose, shape, precision, block_b, interpret,
+              stack_skin):
     out = forward_verts_fused_full(
-        params, pose, shape, precision, block_b, interpret
+        params, pose, shape, precision, block_b, interpret, stack_skin
     )
     return out, (params, pose, shape)
 
@@ -943,5 +981,11 @@ def _bwd(precision, block_b, interpret, residuals, g):
     )
 
 
+def _bwd_full(precision, block_b, interpret, stack_skin, residuals, g):
+    # stack_skin only reorders forward MXU passes; the hybrid backward
+    # is identical.
+    return _bwd(precision, block_b, interpret, residuals, g)
+
+
 forward_verts_fused_ad.defvjp(_fwd, _bwd)
-forward_verts_fused_full_ad.defvjp(_fwd_full, _bwd)
+forward_verts_fused_full_ad.defvjp(_fwd_full, _bwd_full)

@@ -123,6 +123,15 @@ def main() -> int:
           f"{detail.get('config6_depth_renders_per_sec')} renders/s, "
           f"mask fit {detail.get('config6_sil_fit_steps_per_sec')} steps/s")
 
+    bf16 = detail.get("config4_lm_bf16_steps_per_sec")
+    if bf16 is not None and lm:
+        # Decision data for flipping fit_lm's normal_eq default: speedup
+        # only counts if the loss ratio stays ~1 AND the path stayed finite.
+        print(f"  [info] lm bf16-JtJ: {bf16:,.1f} steps/s "
+              f"({bf16 / lm - 1:+.1%} vs high), loss ratio "
+              f"{detail.get('config4_lm_bf16_loss_ratio')}, "
+              f"finite={detail.get('config4_lm_bf16_finite')}")
+
     for key in ("fused_full_sweep_stability", "fused_sweep_stability",
                 "pallas_sweep_stability"):
         stab = detail.get(key)

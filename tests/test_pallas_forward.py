@@ -353,3 +353,45 @@ def test_full_fusion_hands_single_launch(params32):
     with pytest.raises(ValueError, match="pose must be"):
         core.forward_hands_pallas_fused_full(
             stacked, pose, beta, interpret=True)
+
+
+def test_full_fusion_stack_skin_parity(params32):
+    """stack_skin batches each coordinate's four K=16 skin dots into one
+    [4*TB, J] dot — identical per-row math, so interpret-mode results
+    must match the unstacked path to float tolerance, one-hand and
+    two-hand (LOCKSTEP pair), plus the VJP route."""
+    pose, beta = _rand(6, seed=11)
+    base = pallas_forward.forward_verts_fused_full(
+        params32, pose, beta, block_b=4, interpret=True
+    )
+    stacked = pallas_forward.forward_verts_fused_full(
+        params32, pose, beta, block_b=4, interpret=True, stack_skin=True
+    )
+    assert np.abs(np.asarray(stacked) - np.asarray(base)).max() < 1e-6
+
+    two = core.stack_params(params32, params32)
+    pose_h = jnp.stack([pose, pose])
+    beta_h = jnp.stack([beta, beta])
+    base_h = core.forward_hands_pallas_fused_full(
+        two, pose_h, beta_h, block_b=4, interpret=True
+    )
+    stacked_h = core.forward_hands_pallas_fused_full(
+        two, pose_h, beta_h, block_b=4, interpret=True, stack_skin=True
+    )
+    assert np.abs(np.asarray(stacked_h) - np.asarray(base_h)).max() < 1e-6
+
+    # The hybrid VJP is unchanged by the forward's pass ordering.
+    w = jnp.asarray(
+        np.random.default_rng(12).normal(size=(6, 778, 3)).astype(np.float32)
+    )
+
+    def loss(p, b, ss):
+        v = core.forward_batched_pallas_fused_full(
+            params32, p, b, block_b=4, interpret=True, stack_skin=ss
+        )
+        return jnp.sum(v * w)
+
+    g0 = jax.grad(loss, argnums=(0, 1))(pose, beta, False)
+    g1 = jax.grad(loss, argnums=(0, 1))(pose, beta, True)
+    for a, b_ in zip(g0, g1):
+        assert np.abs(np.asarray(a) - np.asarray(b_)).max() < 1e-6
