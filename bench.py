@@ -380,11 +380,13 @@ def _enable_compile_cache(locked: bool = True) -> None:
     """
     import jax
 
-    cache_dir = os.environ.get(
-        "MANO_BENCH_CACHE_DIR",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     ".jax_bench_cache"))
-    if not locked:
+    cache_dir = os.environ.get("MANO_BENCH_CACHE_DIR")
+    if cache_dir:
+        pass  # explicit override: the caller owns isolation (tests do)
+    elif locked:
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_bench_cache")
+    else:
         import atexit
         import shutil
 
@@ -392,7 +394,7 @@ def _enable_compile_cache(locked: bool = True) -> None:
         # Per-pid dirs hold full executable blobs; repeated unlocked runs
         # during an outage must not steadily eat /tmp.
         atexit.register(shutil.rmtree, cache_dir, ignore_errors=True)
-        log("device lock NOT held: per-pid compile cache (no warm reuse)")
+        log("lock-free run: per-pid compile cache (no warm reuse)")
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
@@ -922,13 +924,17 @@ def run_benchmarks(args, device_str: str) -> dict:
                 f"launch={best_launch}: {rate_st:,.0f} evals/s re-measured "
                 f"(first {rate_st_first:,.0f}; {rate_st / rate - 1:+.1%} "
                 "vs unstacked)")
-            if rate_st > rate:
-                # Accuracy probe through the compiled stacked path too
-                # before it can carry the fused-full headline.
+            if np.isfinite(rate_st) and rate_st > rate:
+                # Accuracy probe AND VJP execute-proof through the
+                # compiled stacked path before it can carry the
+                # fused-full headline (every compiled path gets probed
+                # in its shipped context, the AD route included).
                 verts_fused_full = jax.jit(
                     lambda prm, p, s: core.forward_batched_pallas_fused_full(
                         prm, p, s, block_b=bb, stack_skin=True, **ikw)
                 )(right, jnp.asarray(poses), jnp.asarray(betas))
+                prove_vjp(make_fn_stacked(bb))
+                results["fused_full_stacked_vjp_compiles"] = True
                 results["config3_fused_full_evals_per_sec"] = rate_st
                 results["fused_full_variant"] = "stack_skin"
                 fused_full_best["stack_skin"] = True
@@ -1043,15 +1049,18 @@ def run_benchmarks(args, device_str: str) -> dict:
         from mano_hand_tpu.utils.profiling import xla_trace
 
         bb = fused_full_best["block_b"]
+        # Trace the kernel THAT WON — when stack_skin carries the
+        # headline, an unstacked trace would describe the wrong program.
+        ss = fused_full_best.get("stack_skin", False)
 
         def fn(prm, p, s):
-            return core.forward_batched_pallas_fused_full(prm, p, s,
-                                                          block_b=bb, **ikw)
+            return core.forward_batched_pallas_fused_full(
+                prm, p, s, block_b=bb, stack_skin=ss, **ikw)
 
         with xla_trace(args.profile):
             interleaved_rate(fn, min(half, 8192), 2)
             time_chunked(chunk_size=half, use_pallas_fused_full=True,
-                         block_b=bb, **ikw)
+                         block_b=bb, stack_skin=ss, **ikw)
         results["profile_dir"] = args.profile
         log(f"xla profiler trace captured to {args.profile}")
 
@@ -1756,10 +1765,19 @@ def main() -> int:
 
     from mano_hand_tpu.utils.devicelock import DeviceBusy, DeviceLock
 
+    # A CPU-forced run (bench-interpret lane, CI) can never touch the TPU:
+    # taking the device lock would only preempt a real builder pipeline
+    # (observed live: three interpret runs each cost the wrapper a 300 s
+    # stand-down). Such runs skip the lock and use a per-pid compile
+    # cache so they also can't co-write the shared one.
+    use_lock = args.platform != "cpu"
+    import contextlib
+
     global _ACTIVE_LOCK
     try:
-        with DeviceLock(args.role, wait_s=args.lock_wait, log=log) as lock:
-            _ACTIVE_LOCK = lock
+        with (DeviceLock(args.role, wait_s=args.lock_wait, log=log)
+              if use_lock else contextlib.nullcontext()) as lock:
+            _ACTIVE_LOCK = lock if use_lock else None
             try:
                 device_str = bring_up_backend(
                     args.init_retries, args.init_timeout, args.platform,
@@ -1773,7 +1791,8 @@ def main() -> int:
                 import jax
                 jax.config.update("jax_platforms", args.platform)
 
-            _enable_compile_cache(locked=lock.acquired)
+            _enable_compile_cache(
+                locked=use_lock and lock.acquired)
 
             try:
                 line = run_benchmarks(args, device_str)

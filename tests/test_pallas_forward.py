@@ -369,6 +369,17 @@ def test_full_fusion_stack_skin_parity(params32):
     )
     assert np.abs(np.asarray(stacked) - np.asarray(base)).max() < 1e-6
 
+    # The non-split branch too (DEFAULT precision skips the hi/lo split;
+    # its stack_skin slicing is a separate code path).
+    base_d = pallas_forward.forward_verts_fused_full(
+        params32, pose, beta, precision="default", block_b=4, interpret=True
+    )
+    stacked_d = pallas_forward.forward_verts_fused_full(
+        params32, pose, beta, precision="default", block_b=4,
+        interpret=True, stack_skin=True
+    )
+    assert np.abs(np.asarray(stacked_d) - np.asarray(base_d)).max() < 1e-6
+
     two = core.stack_params(params32, params32)
     pose_h = jnp.stack([pose, pose])
     beta_h = jnp.stack([beta, beta])
