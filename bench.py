@@ -890,58 +890,66 @@ def run_benchmarks(args, device_str: str) -> dict:
         results["fused_full_vjp_compiles"] = True
         log("config3d fused-full VJP compiled + executed")
 
-        # stack_skin variant at the winning block: each output
-        # coordinate's four K=16 skin dots batched into one [4*TB, J]
-        # dot (same FLOPs, 4x fewer MXU pipeline fills on the
-        # skinny-K stage — the profiled-blind candidate for the ~5x
-        # headroom; interpret-parity pinned in
-        # tests/test_pallas_forward.py). Measured HERE, not promoted
-        # anywhere until its number wins.
-        def make_fn_stacked(block_b):
+        # stack_skin variants at the winning block: the skinny K=16 skin
+        # dots batched 4-way (per output coordinate, [4*TB, J]) or
+        # 12-way ("full", [12*TB, J]) — same FLOPs, 4x/12x fewer MXU
+        # pipeline fills on the skin stage (the profiled-blind candidate
+        # for the ~5x headroom; interpret-parity pinned in
+        # tests/test_pallas_forward.py). Measured with the sweep's
+        # first/re-measure protocol; only a finite re-measured win is
+        # promoted, after accuracy + VJP probes through the compiled
+        # winning path.
+        def make_fn_stacked(block_b, variant):
             return lambda prm, p, s: core.forward_batched_pallas_fused_full(
-                prm, p, s, block_b=block_b, stack_skin=True, **ikw)
+                prm, p, s, block_b=block_b, stack_skin=variant, **ikw)
 
-        try:
-            # Same protocol as the sweep winners: first-touch measurement
-            # PLUS a re-measure, and the re-measured number is the one
-            # that can win (the 19.6-vs-13.4 M within-process drift
-            # lesson — a single first-touch sample must not take the
-            # headline).
-            st_iters = max(3, args.iters // 3)
-            rate_st_first = interleaved_rate(
-                make_fn_stacked(bb), best_launch, st_iters)
-            rate_st = interleaved_rate(
-                make_fn_stacked(bb), best_launch, st_iters)
-            results["config3_fused_full_stacked_evals_per_sec"] = rate_st
-            results["fused_full_stacked_stability"] = {
-                "first": float(f"{rate_st_first:.5g}"),
-                "remeasured": float(f"{rate_st:.5g}"),
-                "hysteresis_pct": float(
-                    f"{100.0 * (rate_st_first / rate_st - 1.0):.3g}")
-                if rate_st else None,
-            }
-            log(f"config3d stack_skin at block_b={bb} "
-                f"launch={best_launch}: {rate_st:,.0f} evals/s re-measured "
-                f"(first {rate_st_first:,.0f}; {rate_st / rate - 1:+.1%} "
-                "vs unstacked)")
-            if np.isfinite(rate_st) and rate_st > rate:
-                # Accuracy probe AND VJP execute-proof through the
-                # compiled stacked path before it can carry the
-                # fused-full headline (every compiled path gets probed
-                # in its shipped context, the AD route included).
-                verts_fused_full = jax.jit(
+        st_iters = max(3, args.iters // 3)
+        best_stacked = None
+        for variant, tag in ((True, "stacked"), ("full", "stacked12")):
+            try:
+                fn = make_fn_stacked(bb, variant)
+                first = interleaved_rate(fn, best_launch, st_iters)
+                remeas = interleaved_rate(fn, best_launch, st_iters)
+                results[f"config3_fused_full_{tag}_evals_per_sec"] = remeas
+                results[f"fused_full_{tag}_stability"] = {
+                    "first": float(f"{first:.5g}"),
+                    "remeasured": float(f"{remeas:.5g}"),
+                    "hysteresis_pct": float(
+                        f"{100.0 * (first / remeas - 1.0):.3g}")
+                    if remeas else None,
+                }
+                log(f"config3d stack_skin={variant} at block_b={bb} "
+                    f"launch={best_launch}: {remeas:,.0f} evals/s "
+                    f"re-measured (first {first:,.0f}; "
+                    f"{remeas / rate - 1:+.1%} vs unstacked)")
+                if np.isfinite(remeas) and (
+                        best_stacked is None or remeas > best_stacked[0]):
+                    best_stacked = (remeas, variant)
+            except Exception as e:
+                log(f"config3d stack_skin={variant} failed: "
+                    f"{type(e).__name__}: {str(e)[:200]}")
+        if best_stacked is not None and best_stacked[0] > rate:
+            rate_st, variant = best_stacked
+            try:
+                # Probes must SUCCEED before promotion mutates anything:
+                # a VMEM overflow here (the 12-way product is untested on
+                # hardware) keeps the valid unstacked headline intact.
+                probe = jax.jit(
                     lambda prm, p, s: core.forward_batched_pallas_fused_full(
-                        prm, p, s, block_b=bb, stack_skin=True, **ikw)
+                        prm, p, s, block_b=bb, stack_skin=variant, **ikw)
                 )(right, jnp.asarray(poses), jnp.asarray(betas))
-                prove_vjp(make_fn_stacked(bb))
+                prove_vjp(make_fn_stacked(bb, variant))
+            except Exception as e:
+                log(f"config3d stack_skin={variant} won timing but its "
+                    f"probe failed — keeping unstacked headline: "
+                    f"{type(e).__name__}: {str(e)[:200]}")
+            else:
+                verts_fused_full = probe
                 results["fused_full_stacked_vjp_compiles"] = True
                 results["config3_fused_full_evals_per_sec"] = rate_st
-                results["fused_full_variant"] = "stack_skin"
-                fused_full_best["stack_skin"] = True
+                results["fused_full_variant"] = f"stack_skin={variant}"
+                fused_full_best["stack_skin"] = variant
                 rate = rate_st
-        except Exception as e:
-            log(f"config3d stack_skin failed: "
-                f"{type(e).__name__}: {str(e)[:200]}")
 
         # The full-fusion kernel subsumes the XLA-pre-stage fused kernel
         # (same math, strictly more fusion): when faster, it IS the fused

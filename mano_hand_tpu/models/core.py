@@ -495,7 +495,7 @@ def forward_batched_pallas_fused_full(
     precision=DEFAULT_PRECISION,
     block_b: int = FUSED_FULL_BEST_BLOCK_B,
     interpret: bool = False,
-    stack_skin: bool = False,
+    stack_skin=False,  # False | True (4-way) | "full" (12-way)
 ) -> jnp.ndarray:
     """Batched forward with the WHOLE pipeline in one Pallas launch.
 
@@ -525,7 +525,7 @@ def forward_hands_pallas_fused_full(
     precision=DEFAULT_PRECISION,
     block_b: int = FUSED_FULL_BEST_BLOCK_B,
     interpret: bool = False,
-    stack_skin: bool = False,
+    stack_skin=False,  # False | True (4-way) | "full" (12-way)
 ) -> jnp.ndarray:
     """Both hands' full-fusion forward in ONE kernel launch: [2, B, V, 3].
 
@@ -591,7 +591,7 @@ def forward_chunked(
     interpret: bool = False,
     use_pallas_fused: bool = False,
     use_pallas_fused_full: bool = False,
-    stack_skin: bool = False,
+    stack_skin=False,  # False | True (4-way) | "full" (12-way)
 ) -> jnp.ndarray:
     """Memory-bounded huge-batch vertices via lax.map over chunks.
 

@@ -566,54 +566,57 @@ def _fused_full_compute(vp, levels, precision, split, stack_skin, *ins):
             [coeff, jnp.zeros((coeff.shape[0], pad), coeff.dtype)], axis=1
         )
 
-    outs = []
     tb = x.shape[0]
+
+    # Skin-dot pass structure (all variants share one RHS, wt):
+    #   False  — 12 separate [TB, J] dots per tile (the original form);
+    #   True   — each output coordinate's four dots stacked into one
+    #            [4*TB, J] dot (3 dots per tile);
+    #   "full" — all twelve stacked into one [12*TB, J] dot.
+    # Identical FLOPs and per-row math in every case; stacking amortizes
+    # the MXU pipeline fill the skinny K=16 pays per pass (36 / 9 / 3
+    # passes per tile under the 3-pass HIGH policy). Rows slice back out
+    # of the product for the combine. VMEM note: "full" materializes a
+    # [12*TB, VP] f32 product (~5.5 MB at TB=128) — the bench's
+    # fault-isolated measurement decides whether it fits and pays.
+    def skin_dot(lhs):
+        if split:
+            l_hi, l_lo = _split_hi_lo(lhs)
+            return _dot3(l_hi, l_lo, wt_hi, wt_lo)
+        return kernel_dot(lhs, wt_op, precision)
+
+    def combine(acc, m_planes):
+        for c in range(3):
+            acc = acc + m_planes[c] * vp_flat[:, c * vp:(c + 1) * vp]
+        return acc
+
     if split:
         c_hi, c_lo = _split_hi_lo(coeff)
         vp_flat = _dot3(c_hi, c_lo, basis_hi, basis_lo)
-        for a in range(3):
-            if stack_skin:
-                # The four K=16 skin dots of this output coordinate share
-                # the SAME RHS (wt) — stacking their LHS rows into one
-                # [4*TB, J] dot amortizes the MXU pipeline fill the
-                # skinny K pays per pass (9 passes per tile instead of
-                # 36 under HIGH). Identical FLOPs and per-row math; rows
-                # slice back out of the product for the combine.
-                lhs = jnp.concatenate(
-                    [skin_t[a], world_r[3 * a + 0],
-                     world_r[3 * a + 1], world_r[3 * a + 2]], axis=0)
-                l_hi, l_lo = _split_hi_lo(lhs)
-                big = _dot3(l_hi, l_lo, wt_hi, wt_lo)    # [4*TB, VP]
-                acc = big[0:tb]
-                for c in range(3):
-                    acc = acc + (big[(1 + c) * tb:(2 + c) * tb]
-                                 * vp_flat[:, c * vp:(c + 1) * vp])
-            else:
-                t_hi, t_lo = _split_hi_lo(skin_t[a])
-                acc = _dot3(t_hi, t_lo, wt_hi, wt_lo)
-                for c in range(3):
-                    r_hi, r_lo = _split_hi_lo(world_r[3 * a + c])
-                    m_ac = _dot3(r_hi, r_lo, wt_hi, wt_lo)
-                    acc = acc + m_ac * vp_flat[:, c * vp:(c + 1) * vp]
-            outs.append(acc)
     else:
         vp_flat = kernel_dot(coeff, basis_op, precision)
+
+    outs = []
+    if stack_skin == "full":
+        big = skin_dot(jnp.concatenate([*skin_t, *world_r], axis=0))
         for a in range(3):
-            if stack_skin:
-                lhs = jnp.concatenate(
-                    [skin_t[a], world_r[3 * a + 0],
-                     world_r[3 * a + 1], world_r[3 * a + 2]], axis=0)
-                big = kernel_dot(lhs, wt_op, precision)  # [4*TB, VP]
-                acc = big[0:tb]
-                for c in range(3):
-                    acc = acc + (big[(1 + c) * tb:(2 + c) * tb]
-                                 * vp_flat[:, c * vp:(c + 1) * vp])
-            else:
-                acc = kernel_dot(skin_t[a], wt_op, precision)
-                for c in range(3):
-                    m_ac = kernel_dot(world_r[3 * a + c], wt_op, precision)
-                    acc = acc + m_ac * vp_flat[:, c * vp:(c + 1) * vp]
-            outs.append(acc)
+            outs.append(combine(
+                big[a * tb:(a + 1) * tb],
+                [big[(3 + 3 * a + c) * tb:(4 + 3 * a + c) * tb]
+                 for c in range(3)]))
+    elif stack_skin:
+        for a in range(3):
+            big = skin_dot(jnp.concatenate(
+                [skin_t[a], world_r[3 * a + 0],
+                 world_r[3 * a + 1], world_r[3 * a + 2]], axis=0))
+            outs.append(combine(
+                big[0:tb],
+                [big[(1 + c) * tb:(2 + c) * tb] for c in range(3)]))
+    else:
+        for a in range(3):
+            acc = skin_dot(skin_t[a])
+            outs.append(combine(
+                acc, [skin_dot(world_r[3 * a + c]) for c in range(3)]))
     return tuple(outs)
 
 
@@ -624,7 +627,7 @@ def forward_verts_fused_full(
     precision=DEFAULT_PRECISION,
     block_b: int = 128,
     interpret: bool = False,
-    stack_skin: bool = False,
+    stack_skin=False,  # False | True (4-way) | "full" (12-way)
 ) -> jnp.ndarray:
     """Batched vertices [B, V, 3] with the WHOLE forward in one kernel.
 
@@ -715,7 +718,7 @@ def forward_verts_fused_full_hands(
     precision=DEFAULT_PRECISION,
     block_b: int = 128,
     interpret: bool = False,
-    stack_skin: bool = False,
+    stack_skin=False,  # False | True (4-way) | "full" (12-way)
 ) -> jnp.ndarray:
     """BOTH hands' complete forward in ONE kernel launch: [2, B, V, 3].
 
@@ -819,7 +822,7 @@ def forward_verts_fused_full_hands(
 def forward_verts_fused_full_ad(
     params, pose, shape,
     precision=DEFAULT_PRECISION, block_b: int = 128, interpret: bool = False,
-    stack_skin: bool = False,
+    stack_skin=False,  # False | True (4-way) | "full" (12-way)
 ):
     """Differentiable fully-fused forward — same hybrid VJP as
     ``forward_verts_fused_ad`` (the backward recomputes the tiny

@@ -370,15 +370,21 @@ def test_full_fusion_stack_skin_parity(params32):
     assert np.abs(np.asarray(stacked) - np.asarray(base)).max() < 1e-6
 
     # The non-split branch too (DEFAULT precision skips the hi/lo split;
-    # its stack_skin slicing is a separate code path).
+    # its stack_skin slicing is a separate code path), and the 12-way
+    # "full" stacking in both precision branches.
     base_d = pallas_forward.forward_verts_fused_full(
         params32, pose, beta, precision="default", block_b=4, interpret=True
     )
-    stacked_d = pallas_forward.forward_verts_fused_full(
-        params32, pose, beta, precision="default", block_b=4,
-        interpret=True, stack_skin=True
+    for variant in (True, "full"):
+        stacked_d = pallas_forward.forward_verts_fused_full(
+            params32, pose, beta, precision="default", block_b=4,
+            interpret=True, stack_skin=variant
+        )
+        assert np.abs(np.asarray(stacked_d) - np.asarray(base_d)).max() < 1e-6
+    full12 = pallas_forward.forward_verts_fused_full(
+        params32, pose, beta, block_b=4, interpret=True, stack_skin="full"
     )
-    assert np.abs(np.asarray(stacked_d) - np.asarray(base_d)).max() < 1e-6
+    assert np.abs(np.asarray(full12) - np.asarray(base)).max() < 1e-6
 
     two = core.stack_params(params32, params32)
     pose_h = jnp.stack([pose, pose])
@@ -386,10 +392,13 @@ def test_full_fusion_stack_skin_parity(params32):
     base_h = core.forward_hands_pallas_fused_full(
         two, pose_h, beta_h, block_b=4, interpret=True
     )
-    stacked_h = core.forward_hands_pallas_fused_full(
-        two, pose_h, beta_h, block_b=4, interpret=True, stack_skin=True
-    )
-    assert np.abs(np.asarray(stacked_h) - np.asarray(base_h)).max() < 1e-6
+    for variant in (True, "full"):
+        stacked_h = core.forward_hands_pallas_fused_full(
+            two, pose_h, beta_h, block_b=4, interpret=True,
+            stack_skin=variant
+        )
+        assert np.abs(np.asarray(stacked_h) - np.asarray(base_h)).max() \
+            < 1e-6
 
     # The hybrid VJP is unchanged by the forward's pass ordering.
     w = jnp.asarray(
@@ -403,6 +412,7 @@ def test_full_fusion_stack_skin_parity(params32):
         return jnp.sum(v * w)
 
     g0 = jax.grad(loss, argnums=(0, 1))(pose, beta, False)
-    g1 = jax.grad(loss, argnums=(0, 1))(pose, beta, True)
-    for a, b_ in zip(g0, g1):
-        assert np.abs(np.asarray(a) - np.asarray(b_)).max() < 1e-6
+    for variant in (True, "full"):
+        g1 = jax.grad(loss, argnums=(0, 1))(pose, beta, variant)
+        for a, b_ in zip(g0, g1):
+            assert np.abs(np.asarray(a) - np.asarray(b_)).max() < 1e-6
