@@ -378,12 +378,17 @@ def cmd_fit(args) -> int:
         print("--robust-weights only applies to --data-term "
               "points/point_to_plane", file=sys.stderr)
         return 2
-    # Anything that is not LM's own parameterization (axis-angle) needs the
-    # Adam solver — ONE definition, shared with the explicit-LM guard below,
-    # so a future pose space fails safe instead of silently routing to LM.
-    needs_adam = args.pose_space not in (None, "aa")
+    # Pose spaces LM cannot optimize need the Adam solver — ONE
+    # definition, shared with the explicit-LM guard below, so a future
+    # pose space fails safe instead of silently routing to LM. LM
+    # handles "aa" (its native parameterization) and "pca" (GN in the
+    # truncated space, fit_lm pose_space="pca"); an UNSET solver still
+    # resolves pca to adam (priors/6d interplay live there) — pca-LM is
+    # an explicit `--solver lm` choice.
+    needs_adam = args.pose_space not in (None, "aa", "pca")
+    explicit_pca_lm = args.pose_space == "pca" and args.solver == "lm"
     if args.solver is None:
-        if needs_adam:
+        if needs_adam or args.pose_space == "pca":
             args.solver = "adam"
         else:
             args.solver = ("lm" if args.data_term
@@ -528,10 +533,30 @@ def cmd_fit(args) -> int:
             # Only reachable with an EXPLICIT --solver lm (an unset solver
             # resolves to adam for these spaces): a contradiction, not a
             # preference — refuse rather than silently drop it. 'aa' is
-            # exactly LM's parameterization and passes through.
+            # LM's native parameterization and 'pca' its GN-in-the-
+            # truncated-space mode; both pass through.
             print(f"--pose-space {args.pose_space} requires --solver adam "
-                  "(LM optimizes axis-angle)", file=sys.stderr)
+                  "(LM optimizes axis-angle or PCA coefficients)",
+                  file=sys.stderr)
             return 2
+        if explicit_pca_lm:
+            if args.restarts:
+                # fit_restarts samples axis-angle inits (restarts.py
+                # rejects pca): name the conflict here with the fix.
+                print("--restarts with --solver lm samples axis-angle "
+                      "inits; drop --pose-space pca or drop --restarts",
+                      file=sys.stderr)
+                return 2
+            if lm_kw.get("init"):
+                # JSON inits ship pose/shape arrays; the pca
+                # parameterization expects {global_rot, pca, shape}.
+                ik = set(lm_kw["init"])
+                if not ik <= {"global_rot", "pca", "shape"}:
+                    print("--init for --pose-space pca LM must hold "
+                          "global_rot/pca/shape keys, got "
+                          f"{sorted(ik)}", file=sys.stderr)
+                    return 2
+            lm_kw["pose_space"] = "pca"  # library-default n_pca (full)
         if args.restarts:
             try:
                 res, _losses = fitting.fit_restarts(
@@ -560,10 +585,16 @@ def cmd_fit(args) -> int:
             # alone would send the user into the opposite error.
             if needs_adam:
                 print("--data-term point_to_plane is LM-only and LM "
-                      "optimizes axis-angle: it cannot combine with "
-                      f"--pose-space {args.pose_space}; drop the pose "
-                      "space or use --data-term points",
+                      "optimizes axis-angle or PCA coefficients: it "
+                      f"cannot combine with --pose-space {args.pose_space}"
+                      "; drop the pose space or use --data-term points",
                       file=sys.stderr)
+            elif args.pose_space == "pca":
+                # Unset solver resolved pca->adam; the combination IS
+                # available, but only as an explicit LM choice.
+                print("--data-term point_to_plane requires --solver lm; "
+                      "pass --solver lm explicitly to combine it with "
+                      "--pose-space pca", file=sys.stderr)
             else:
                 print("--data-term point_to_plane requires --solver lm",
                       file=sys.stderr)

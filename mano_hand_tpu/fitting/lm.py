@@ -63,6 +63,8 @@ def _fit_single(
     keypoint_order: str = "mano",
     jacobian: str = "analytic",
     normal_eq: str = "high",
+    pose_space: str = "aa",
+    n_pca: int = 45,
 ) -> LMResult:
     dtype = params.v_template.dtype
     # One-pass bf16 normal equations (roadmap candidate for 200+ steps/s):
@@ -78,10 +80,21 @@ def _fit_single(
     n_joints = params.j_regressor.shape[0]
     n_shape = params.shape_basis.shape[-1]
 
-    theta0 = {
-        "pose": jnp.zeros((n_joints, 3), dtype),
-        "shape": jnp.zeros((n_shape,), dtype),
-    }
+    if pose_space == "pca":
+        # Same parameterization keys as the Adam solvers' PCA mode
+        # (solvers._pose_shapes): truncated finger-pose coefficients +
+        # a free global-rotation row. GN in 3+n_pca+S dims — the normal
+        # matrix shrinks quadratically with n_pca.
+        theta0 = {
+            "global_rot": jnp.zeros((3,), dtype),
+            "pca": jnp.zeros((n_pca,), dtype),
+            "shape": jnp.zeros((n_shape,), dtype),
+        }
+    else:
+        theta0 = {
+            "pose": jnp.zeros((n_joints, 3), dtype),
+            "shape": jnp.zeros((n_shape,), dtype),
+        }
     if init:
         # Warm start (same contract as solvers.fit): ICP in particular
         # needs one — nearest-neighbor assignments from the rest pose
@@ -98,7 +111,21 @@ def _fit_single(
                     f"init[{k!r}] shape {v.shape} != {theta0[k].shape}"
                 )
             theta0[k] = v
-    flat0, unravel = ravel_pytree(theta0)
+    flat0, unravel_raw = ravel_pytree(theta0)
+    if pose_space == "pca":
+        # The decode is part of the unravel, so every consumer — the
+        # residual, the Tikhonov rows, AND jacobian.forward_with_jacobian
+        # (whose jacfwd of the tiny joint chain then carries
+        # d pose/d (global_rot, pca) automatically, decode_pca being
+        # linear) — sees the familiar {"pose", "shape"} dict with zero
+        # PCA-specific code anywhere downstream.
+        def unravel(f):
+            raw = unravel_raw(f)
+            return {"pose": core.decode_pca(params, raw["pca"],
+                                            global_rot=raw["global_rot"]),
+                    "shape": raw["shape"]}
+    else:
+        unravel = unravel_raw
     n_params = flat0.shape[0]
     target = target_verts.reshape(-1)
 
@@ -289,7 +316,8 @@ def _fit_single(
     jax.jit,
     static_argnames=("n_steps", "data_term", "trim_fraction",
                      "robust_weights", "robust_scale", "tip_vertex_ids",
-                     "keypoint_order", "jacobian", "normal_eq"),
+                     "keypoint_order", "jacobian", "normal_eq",
+                     "pose_space", "n_pca"),
 )
 def fit_lm(
     params: ManoParams,
@@ -309,6 +337,8 @@ def fit_lm(
     keypoint_order: str = "mano",  # "mano" | "openpose"
     jacobian: str = "analytic",  # "analytic" | "ad"
     normal_eq: str = "high",     # "high" | "bf16"
+    pose_space: str = "aa",      # "aa" | "pca"
+    n_pca: int = 45,
 ) -> LMResult:
     """Recover (pose, shape) by damped Gauss-Newton; batch via vmap.
 
@@ -360,6 +390,17 @@ def fit_lm(
     so the normal matrix tolerates it the way the LU direction noise
     does). Off by default pending the bench's on-chip convergence-ratio
     measurement (bench config4b records both variants).
+
+    ``pose_space="pca"`` runs GN in the truncated PCA pose space
+    (``global_rot [3]`` + ``pca [n_pca]`` + shape — same keys as
+    ``solvers.fit``'s PCA mode, reference semantics
+    /root/reference/mano_np.py:66-72): the decode folds into the
+    parameter unravel, so the analytic Jacobian's joint-chain jacfwd
+    carries d pose/d coefficients automatically and the normal matrix
+    shrinks quadratically with ``n_pca`` (e.g. 58 -> 25 dims at
+    n_pca=12). The natural fit when targets are sparse (joints /
+    keypoints) or the pose prior of the PCA space is wanted implicitly;
+    returns the DECODED full pose.
     """
     if data_term not in ("verts", "joints", "points",
                          "point_to_plane"):
@@ -409,6 +450,18 @@ def fit_lm(
         raise ValueError(
             f"normal_eq must be 'high' or 'bf16', got {normal_eq!r}"
         )
+    if pose_space not in ("aa", "pca"):
+        raise ValueError(
+            "fit_lm pose_space must be 'aa' or 'pca' (6D adds nothing to "
+            f"GN — it optimizes rotations via the chain anyway), got "
+            f"{pose_space!r}"
+        )
+    if pose_space == "pca":
+        max_pca = params.pca_basis.shape[0]
+        if not 1 <= int(n_pca) <= max_pca:
+            raise ValueError(
+                f"n_pca must be in [1, {max_pca}], got {n_pca}"
+            )
     single = functools.partial(
         _fit_single,
         params,
@@ -425,6 +478,8 @@ def fit_lm(
         keypoint_order=keypoint_order,
         jacobian=jacobian,
         normal_eq=normal_eq,
+        pose_space=pose_space,
+        n_pca=n_pca,
     )
     if target_verts.ndim == 2:
         return single(target_verts, init=init)
@@ -435,10 +490,11 @@ def fit_lm(
             for k, v in init.items()}
     solvers.validate_batched_init(
         init, target_verts.shape[0],
-        # LM's theta0 is the "aa" parameterization with no n_pca/trans DOFs
-        # — same shape source as the Adam solvers, no hand-written mirror.
+        # LM's theta0 follows the Adam solvers' parameterizations ("aa"
+        # or "pca") with no trans DOF — same shape source, no
+        # hand-written mirror.
         solvers._batched_init_shapes(
-            "aa", params.j_regressor.shape[0], 0,
+            pose_space, params.j_regressor.shape[0], n_pca,
             params.shape_basis.shape[-1], fit_trans=False,
         ),
         target_verts.shape, "fit_lm",

@@ -84,6 +84,17 @@ def make_tracker(
     if solver == "lm" and solver_kw.get("joint_limits") is not None:
         raise ValueError("joint_limits requires solver='adam' (the limit "
                          "hinge is a first-order energy term)")
+    if solver_kw.get("pose_space", "aa") != "aa":
+        # The tracker's whole mechanism is the decoded-pose warm start
+        # ({"pose": ...} each frame) — structurally incompatible with a
+        # coefficient parameterization. Fail at build time with the why,
+        # not as an init-keys error out of the first frame's trace
+        # (fit_restarts guards the same way).
+        raise ValueError(
+            "make_tracker warm-starts the decoded pose each frame; "
+            f"pose_space must stay 'aa', got "
+            f"{solver_kw['pose_space']!r}"
+        )
     if solver == "adam" and solver_kw.get("self_penetration_weight"):
         # Build the [V, V] part-adjacency mask ONCE for the stream — the
         # per-frame path must not redo the O(V^2) host build + transfer

@@ -736,3 +736,47 @@ def test_fit_restarts_flag(tmp_path, capsys):
     rc = cli.main(["fit", str(tmp_path / "t.npy"), "--solver", "adam",
                    "--pose-space", "6d", "--restarts", "2"])
     assert rc == 2 and "axis-angle" in capsys.readouterr().err
+
+
+def test_fit_subcommand_pca_lm(tmp_path, capsys):
+    """--solver lm --pose-space pca runs GN in the truncated PCA space
+    (round 5); an unset solver still resolves pca to adam, and
+    pca-LM + --restarts names the conflict."""
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+
+    p32 = synthetic_params(seed=0).astype(np.float32)
+    rng = np.random.default_rng(2)
+    coeffs = jnp.asarray(rng.normal(scale=0.4, size=(8,)), jnp.float32)
+    pose = core.decode_pca(p32, coeffs)
+    targets = np.asarray(core.jit_forward(
+        p32, pose, jnp.zeros(10, jnp.float32)
+    ).verts)
+    np.save(tmp_path / "t.npy", targets)
+    out = tmp_path / "fitpca.npz"
+    rc = cli.main([
+        "fit", str(tmp_path / "t.npy"),
+        "--solver", "lm", "--pose-space", "pca", "--out", str(out),
+    ])
+    assert rc == 0
+    assert "fit (lm," in capsys.readouterr().out
+    ckpt = np.load(out)
+    assert ckpt["pose"].shape == (16, 3)  # decoded axis-angle out
+    got = np.asarray(core.jit_forward(
+        p32, jnp.asarray(ckpt["pose"]), jnp.asarray(ckpt["shape"])
+    ).verts)
+    assert np.abs(got - targets).max() < 1e-4
+
+    # Unset solver still routes pca to adam (priors live there).
+    rc = cli.main(["fit", str(tmp_path / "t.npy"),
+                   "--pose-space", "pca", "--steps", "5",
+                   "--out", str(out)])
+    assert rc == 0
+    assert "fit (adam, 5 steps)" in capsys.readouterr().out
+
+    rc = cli.main(["fit", str(tmp_path / "t.npy"),
+                   "--solver", "lm", "--pose-space", "pca",
+                   "--restarts", "2", "--out", str(out)])
+    assert rc == 2
+    assert "axis-angle inits" in capsys.readouterr().err

@@ -160,3 +160,36 @@ def test_lm_jacobian_validation(params32):
     target = jnp.zeros((778, 3), jnp.float32)
     with pytest.raises(ValueError, match="jacobian must be"):
         fit_lm(params32, target, n_steps=2, jacobian="magic")
+
+
+def test_pca_unravel_jacobian_exact(params32):
+    """The PCA-folding unravel (fit_lm pose_space="pca") at a NONZERO
+    iterate: the analytic verts Jacobian wrt (global_rot, pca, shape)
+    must match jacfwd of the same decoded forward column for column —
+    convergence tests alone could pass with a moderately wrong Jacobian
+    under damped GN."""
+    rng = np.random.default_rng(3)
+    theta = {
+        "global_rot": jnp.asarray(rng.normal(scale=0.4, size=(3,)),
+                                  jnp.float32),
+        "pca": jnp.asarray(rng.normal(scale=0.6, size=(8,)), jnp.float32),
+        "shape": jnp.asarray(rng.normal(size=(10,)), jnp.float32),
+    }
+    flat, unravel_raw = ravel_pytree(theta)
+
+    def unravel(f):
+        raw = unravel_raw(f)
+        return {"pose": core.decode_pca(params32, raw["pca"],
+                                        global_rot=raw["global_rot"]),
+                "shape": raw["shape"]}
+
+    fj = jm.forward_with_jacobian(params32, unravel, flat)
+
+    def verts_of(f):
+        th = unravel(f)
+        return core.forward(params32, th["pose"], th["shape"]).verts
+
+    want = jax.jacfwd(verts_of)(flat)        # [V, 3, 3+8+10]
+    np.testing.assert_allclose(np.asarray(fj.verts_jac), np.asarray(want),
+                               atol=2e-5)
+    assert fj.verts_jac.shape == (778, 3, 21)

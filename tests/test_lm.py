@@ -342,3 +342,48 @@ def test_lm_bf16_normal_eq_converges(params32):
 
     with pytest.raises(ValueError, match="normal_eq"):
         fit_lm(params32, target, n_steps=2, normal_eq="fp8")
+
+
+def test_lm_pca_pose_space(params32):
+    """GN in the truncated PCA space: targets generated from PCA
+    coefficients must be recovered to the loss floor with BOTH Jacobian
+    backends (the decode folds into the unravel, so analytic == AD), and
+    the returned pose is the DECODED [16, 3]."""
+    rng = np.random.default_rng(9)
+    coeffs = rng.normal(scale=0.5, size=(6,)).astype(np.float32)
+    groot = rng.normal(scale=0.2, size=(3,)).astype(np.float32)
+    pose = core.decode_pca(params32, jnp.asarray(coeffs),
+                           global_rot=jnp.asarray(groot))
+    target = core.jit_forward(params32, pose, jnp.zeros(10)).verts
+
+    for backend in ("analytic", "ad"):
+        res = fit_lm(params32, target, n_steps=25, pose_space="pca",
+                     n_pca=6, jacobian=backend)
+        assert np.asarray(res.final_loss).max() < 1e-12, backend
+        assert res.pose.shape == (16, 3)
+        assert np.abs(np.asarray(res.pose) - np.asarray(pose)).max() < 1e-3
+
+    # Warm start uses the raw parameterization keys; wrong keys fail.
+    res = fit_lm(params32, target, n_steps=5, pose_space="pca", n_pca=6,
+                 init={"pca": coeffs, "global_rot": groot})
+    assert np.asarray(res.final_loss).max() < 1e-12
+
+    with pytest.raises(ValueError, match="n_pca"):
+        fit_lm(params32, target, n_steps=2, pose_space="pca", n_pca=999)
+    with pytest.raises(ValueError, match="pose_space"):
+        fit_lm(params32, target, n_steps=2, pose_space="6d")
+
+
+def test_lm_pca_batched(params32):
+    """Batched PCA-space LM with per-problem warm starts."""
+    rng = np.random.default_rng(10)
+    coeffs = rng.normal(scale=0.4, size=(3, 6)).astype(np.float32)
+    pose = core.decode_pca(params32, jnp.asarray(coeffs))
+    targets = core.jit_forward_batched(
+        params32, pose, jnp.zeros((3, 10))
+    ).verts
+    res = fit_lm(params32, targets, n_steps=25, pose_space="pca", n_pca=6,
+                 init={"pca": coeffs * 0.9,
+                       "global_rot": np.zeros((3, 3), np.float32)})
+    assert np.asarray(res.final_loss).max() < 1e-12
+    assert res.pose.shape == (3, 16, 3)
