@@ -43,6 +43,7 @@ class LMResult(NamedTuple):
     #   path's data loss.
     loss_history: jnp.ndarray  # [..., n_steps]
     damping_history: jnp.ndarray  # [..., n_steps] lambda per step
+    trans: Optional[jnp.ndarray] = None  # [..., 3] when fit_trans
 
 
 def _fit_single(
@@ -65,6 +66,7 @@ def _fit_single(
     normal_eq: str = "high",
     pose_space: str = "aa",
     n_pca: int = 45,
+    fit_trans: bool = False,
 ) -> LMResult:
     dtype = params.v_template.dtype
     # One-pass bf16 normal equations (roadmap candidate for 200+ steps/s):
@@ -95,6 +97,12 @@ def _fit_single(
             "pose": jnp.zeros((n_joints, 3), dtype),
             "shape": jnp.zeros((n_shape,), dtype),
         }
+    if fit_trans:
+        # Global translation DOF (same key as solvers.fit): predictions
+        # are rigidly shifted, so its residual Jacobian is an identity
+        # block per 3D row — added explicitly on the analytic path, and
+        # free on the AD path.
+        theta0["trans"] = jnp.zeros((3,), dtype)
     if init:
         # Warm start (same contract as solvers.fit): ICP in particular
         # needs one — nearest-neighbor assignments from the rest pose
@@ -128,6 +136,18 @@ def _fit_single(
         unravel = unravel_raw
     n_params = flat0.shape[0]
     target = target_verts.reshape(-1)
+    # ravel_pytree flattens dict leaves in sorted-key order; the trans
+    # columns' flat range falls out of the same ordering.
+    if fit_trans:
+        off = 0
+        for k in sorted(theta0):
+            size = int(theta0[k].size)
+            if k == "trans":
+                trans_sl = slice(off, off + size)
+            off += size
+
+    def trans_of(flat):
+        return unravel_raw(flat)["trans"] if fit_trans else None
 
     def values_of(flat):
         """(verts, posed_joints) by the active backend's estimator.
@@ -138,13 +158,18 @@ def _fit_single(
         ~float32 rounding — enough to flip accepts at the floor).
         """
         if jacobian == "analytic":
-            return jacobian_mod.forward_values(params, unravel, flat)
-        p = unravel(flat)
-        # Fused-basis forward: under jacfwd the blend stage's 58 tangent
-        # columns batch into ONE [P, S+P] x [S+P, V*3] MXU matmul instead
-        # of 58 replays of the staged skinny contractions.
-        out = core.forward_fused(params, p["pose"], p["shape"])
-        return out.verts, out.posed_joints
+            verts, pj = jacobian_mod.forward_values(params, unravel, flat)
+        else:
+            p = unravel(flat)
+            # Fused-basis forward: under jacfwd the blend stage's 58
+            # tangent columns batch into ONE [P, S+P] x [S+P, V*3] MXU
+            # matmul instead of 58 replays of the staged contractions.
+            out = core.forward_fused(params, p["pose"], p["shape"])
+            verts, pj = out.verts, out.posed_joints
+        if fit_trans:
+            tr = trans_of(flat)
+            verts, pj = verts + tr, pj + tr
+        return verts, pj
 
     def rows_from(verts, posed_joints, p_shape, corr):
         """THE per-data-term residual row construction — shared by the
@@ -233,26 +258,44 @@ def _fit_single(
         (fitting/jacobian.py). Rows match ``residual`` exactly.
         """
         fj = jacobian_mod.forward_with_jacobian(params, unravel, flat)
-        res = rows_from(fj.verts, fj.posed_joints, unravel(flat)["shape"],
-                        corr)
+        verts, pj = fj.verts, fj.posed_joints
+        if fit_trans:
+            tr = trans_of(flat)
+            verts, pj = verts + tr, pj + tr
+        res = rows_from(verts, pj, unravel(flat)["shape"], corr)
+        eye3 = jnp.eye(3, dtype=dtype)
         if data_term == "points":
             idx, w = corr
             jac = (fj.verts_jac[idx] * w[:, None, None]).reshape(
                 -1, n_params
             )
+            # d res/d trans for w-scaled point rows: w ⊗ I3. The small
+            # chain never sees trans, so its jacfwd columns there are
+            # zero — the identity block is the whole derivative.
+            if fit_trans:
+                blk = (w[:, None, None] * eye3).reshape(-1, 3)
+                jac = jac.at[:, trans_sl].add(blk)
         elif data_term == "point_to_plane":
             idx, normals, w = corr
             jac = w[:, None] * jnp.einsum(
                 "nc,ncp->np", normals, fj.verts_jac[idx],
                 precision=core.DEFAULT_PRECISION,
             )
+            if fit_trans:  # d(n·(x+t-p))/dt = n, w-scaled
+                jac = jac.at[:, trans_sl].add(normals * w[:, None])
         elif data_term == "verts":
             jac = fj.verts_jac.reshape(-1, n_params)
+            if fit_trans:
+                jac = jac.at[:, trans_sl].add(
+                    jnp.tile(eye3, (verts.shape[0], 1)))
         else:  # joints (optionally extended with fingertips)
             _, kp_jac = jacobian_mod.keypoint_jacobian(
                 fj, tips, keypoint_order
             )
             jac = kp_jac.reshape(-1, n_params)
+            if fit_trans:  # every keypoint (joint or tip) translates
+                jac = jac.at[:, trans_sl].add(
+                    jnp.tile(eye3, (kp_jac.shape[0], 1)))
         jac = jnp.concatenate([jac, shape_weight * fj.shape_jac])
         return res, jac
 
@@ -308,6 +351,7 @@ def _fit_single(
         final_loss=loss_of(flat_fin),
         loss_history=history,
         damping_history=dhist,
+        trans=trans_of(flat_fin),
     )
 
 
@@ -317,7 +361,7 @@ def _fit_single(
     static_argnames=("n_steps", "data_term", "trim_fraction",
                      "robust_weights", "robust_scale", "tip_vertex_ids",
                      "keypoint_order", "jacobian", "normal_eq",
-                     "pose_space", "n_pca"),
+                     "pose_space", "n_pca", "fit_trans"),
 )
 def fit_lm(
     params: ManoParams,
@@ -339,6 +383,7 @@ def fit_lm(
     normal_eq: str = "high",     # "high" | "bf16"
     pose_space: str = "aa",      # "aa" | "pca"
     n_pca: int = 45,
+    fit_trans: bool = False,
 ) -> LMResult:
     """Recover (pose, shape) by damped Gauss-Newton; batch via vmap.
 
@@ -401,6 +446,13 @@ def fit_lm(
     n_pca=12). The natural fit when targets are sparse (joints /
     keypoints) or the pose prior of the PCA space is wanted implicitly;
     returns the DECODED full pose.
+
+    ``fit_trans=True`` adds a global translation DOF (key ``"trans"``,
+    as in ``solvers.fit``) — required for registering UNCENTERED scans
+    with the ICP terms, where no pose articulation can absorb a rigid
+    offset. Its residual Jacobian is an exact identity block per 3D row
+    (plane rows: the normal), composable with either pose space;
+    ``LMResult.trans`` carries the estimate (None otherwise).
     """
     if data_term not in ("verts", "joints", "points",
                          "point_to_plane"):
@@ -480,6 +532,7 @@ def fit_lm(
         normal_eq=normal_eq,
         pose_space=pose_space,
         n_pca=n_pca,
+        fit_trans=fit_trans,
     )
     if target_verts.ndim == 2:
         return single(target_verts, init=init)
@@ -491,11 +544,11 @@ def fit_lm(
     solvers.validate_batched_init(
         init, target_verts.shape[0],
         # LM's theta0 follows the Adam solvers' parameterizations ("aa"
-        # or "pca") with no trans DOF — same shape source, no
-        # hand-written mirror.
+        # or "pca", optional trans) — same shape source, no hand-written
+        # mirror.
         solvers._batched_init_shapes(
             pose_space, params.j_regressor.shape[0], n_pca,
-            params.shape_basis.shape[-1], fit_trans=False,
+            params.shape_basis.shape[-1], fit_trans=fit_trans,
         ),
         target_verts.shape, "fit_lm",
     )

@@ -137,7 +137,10 @@ def fit_restarts(
         "pose": jnp.concatenate(poses, axis=0),
         "shape": jnp.zeros((n_restarts, n_shape), dtype),
     }
-    if solver == "adam" and solver_kw.get("fit_trans"):
+    # Both solvers carry the trans DOF now (fit_lm grew it in round 5);
+    # the Kabsch rotation row only lands in the right basin TOGETHER
+    # with its pivot-compensating translation.
+    if solver_kw.get("fit_trans"):
         trans = jnp.zeros((n_restarts, 3), dtype)
         if kabsch is not None:
             # The Kabsch row gets its own translation seed too.

@@ -73,12 +73,11 @@ def make_tracker(
     """
     if solver not in ("adam", "lm"):
         raise ValueError(f"solver must be 'adam' or 'lm', got {solver!r}")
-    if solver == "lm" and fit_trans:
-        raise ValueError("fit_trans requires solver='adam' (LM has no "
-                         "translation DOF)")
+    # (fit_trans works with both solvers since LM grew its translation
+    # DOF — round 5; each branch below warm-starts it.)
     if solver == "lm" and solver_kw.get("self_penetration_weight"):
-        # Fail at build time like the fit_trans case above — not as a
-        # TypeError out of the first frame's solve.
+        # Fail at build time — not as a TypeError out of the first
+        # frame's solve.
         raise ValueError("self_penetration_weight requires solver='adam' "
                          "(LM's GN residual has no hinge term)")
     if solver == "lm" and solver_kw.get("joint_limits") is not None:
@@ -144,14 +143,14 @@ def make_tracker(
             except ValueError:
                 pass   # row-count mismatch etc.: keep the rest seed
         init = {"pose": pose0, "shape": state.shape}
+        if fit_trans:
+            init["trans"] = trans0
         if solver == "lm":
             res = lm_mod.fit_lm(
                 params, target, n_steps=n_steps, data_term=data_term,
-                init=init, **solver_kw,
+                fit_trans=fit_trans, init=init, **solver_kw,
             )
         else:
-            if fit_trans:
-                init["trans"] = trans0
             res = solvers.fit(
                 params, target, n_steps=n_steps, lr=lr,
                 data_term=data_term, camera=camera,

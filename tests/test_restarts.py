@@ -139,3 +139,23 @@ def test_kabsch_seed_dropped_at_n1(params32):
                                 solver="lm", n_steps=4)
     assert losses.shape == (1,)
     assert np.isfinite(float(best.final_loss))
+
+
+def test_restarts_lm_fit_trans_kabsch_seed(params32):
+    """solver='lm' + fit_trans (round 5): the Kabsch restart row carries
+    its pivot-compensating translation seed, so an uncentered rotated
+    target lands in the right basin by construction."""
+    rng = np.random.default_rng(21)
+    pose = np.zeros((16, 3), np.float32)
+    pose[0] = [0.2, 2.6, 0.1]          # far from rest orientation
+    pose[1:] = rng.normal(scale=0.1, size=(15, 3))
+    tr = np.array([0.12, -0.06, 0.2], np.float32)
+    target = core.forward(
+        params32, jnp.asarray(pose), jnp.zeros(10, jnp.float32)
+    ).verts + jnp.asarray(tr)
+    best, losses = fit_restarts(
+        params32, target, n_restarts=3, solver="lm", n_steps=25,
+        fit_trans=True,
+    )
+    assert float(best.final_loss) < 1e-10, np.asarray(losses)
+    assert np.abs(np.asarray(best.trans) - tr).max() < 1e-3

@@ -74,8 +74,26 @@ def test_tracker_warm_start_beats_cold(params32):
 def test_tracker_validation(params32):
     with pytest.raises(ValueError, match="solver"):
         make_tracker(params32, solver="newton")
-    with pytest.raises(ValueError, match="fit_trans"):
-        make_tracker(params32, solver="lm", fit_trans=True)
+    with pytest.raises(ValueError, match="pose_space"):
+        make_tracker(params32, solver="lm", pose_space="pca")
+
+
+def test_tracker_lm_fit_trans_follows_offset(params32):
+    """LM tracking with the translation DOF (round 5): a stream whose
+    subject drifts rigidly is followed frame to frame, trans
+    warm-started from the state."""
+    rng = np.random.default_rng(44)
+    pose = rng.normal(scale=0.2, size=(16, 3)).astype(np.float32)
+    verts = core.forward(params32, jnp.asarray(pose),
+                         jnp.zeros(10, jnp.float32)).verts
+    state, step = make_tracker(params32, solver="lm", n_steps=8,
+                               data_term="verts", fit_trans=True)
+    for i, off in enumerate(([0.0, 0.0, 0.0], [0.02, -0.01, 0.03],
+                             [0.04, -0.02, 0.06])):
+        target = verts + jnp.asarray(off, jnp.float32)
+        state, res = step(state, target)
+        assert float(res.final_loss) < 1e-9, (i, float(res.final_loss))
+        assert np.abs(np.asarray(res.trans) - np.asarray(off)).max() < 1e-3
 
 
 def test_tracker_kabsch_first_frame(params32):
