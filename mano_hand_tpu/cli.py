@@ -210,11 +210,14 @@ def cmd_render(args) -> int:
     return 0
 
 
-def _load_init(path):
-    """Warm-start checkpoint -> ({'pose', 'shape'}, None) or (None, error).
+def _load_init(path, want_trans=False):
+    """Warm-start checkpoint -> (init dict, None) or (None, error).
 
-    One loader for both solvers; leaf shapes (incl. batch agreement) are
-    validated by the library entry points.
+    The dict holds 'pose'/'shape', plus 'trans' when the checkpoint has
+    one (a --fit-trans run) AND the new fit wants it — otherwise the
+    stale estimate is dropped with a note (the solvers reject unknown
+    init keys). One loader for both solvers; leaf shapes (incl. batch
+    agreement) are validated by the library entry points.
     """
     from mano_hand_tpu.io.checkpoints import load_arrays
 
@@ -223,7 +226,14 @@ def _load_init(path):
     if missing:
         return None, (f"--init checkpoint lacks {sorted(missing)} "
                       f"(has {sorted(ck)})")
-    return {"pose": ck["pose"], "shape": ck["shape"]}, None
+    init = {"pose": ck["pose"], "shape": ck["shape"]}
+    if "trans" in ck:
+        if want_trans:
+            init["trans"] = ck["trans"]
+        else:
+            print("note: --init has a trans estimate but --fit-trans "
+                  "is off; ignoring it", file=sys.stderr)
+    return init, None
 
 
 def cmd_fit(args) -> int:
@@ -519,8 +529,10 @@ def cmd_fit(args) -> int:
             print("note: --shape-prior only applies to --solver adam or "
                   "--data-term joints/points/point_to_plane; ignored",
                   file=sys.stderr)
+        if args.fit_trans:
+            lm_kw["fit_trans"] = True
         if args.init:
-            init, err = _load_init(args.init)
+            init, err = _load_init(args.init, want_trans=args.fit_trans)
             if err:
                 print(err, file=sys.stderr)
                 return 2
@@ -825,7 +837,9 @@ def cmd_fit(args) -> int:
                 print("--init requires the axis-angle pose space "
                       f"(active: {pose_space})", file=sys.stderr)
                 return 2
-            init, err = _load_init(args.init)
+            init, err = _load_init(
+                args.init,
+                want_trans=args.fit_trans or kp2d.get("fit_trans", False))
             if err:
                 print(err, file=sys.stderr)
                 return 2
@@ -844,6 +858,10 @@ def cmd_fit(args) -> int:
             **kp2d,
             **kp_kw,
         )
+        # The 2D/image paths force translation on via their own dicts
+        # (kp2d/silhouette/depth); setdefault keeps that while --fit-trans
+        # turns it on for the 3D terms.
+        adam_kw.setdefault("fit_trans", args.fit_trans)
         if args.restarts:
             if pose_space != "aa":
                 # fit_restarts samples axis-angle seeds by design.
@@ -1090,6 +1108,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "fraction of the worst-matching scan points each "
                         "step (outlier defense; --solver lm with "
                         "--data-term points/point_to_plane only)")
+    f.add_argument("--fit-trans", action="store_true",
+                   help="fit a global translation too (uncentered "
+                        "targets/scans; both solvers — the 2D keypoint "
+                        "terms always fit it). Checkpoint gains a "
+                        "'trans' array; --init may carry one")
     f.add_argument("--conf", default=None,
                    help=".npy of [16]/[B,16] keypoint confidences "
                         "(keypoints2d only)")

@@ -780,3 +780,49 @@ def test_fit_subcommand_pca_lm(tmp_path, capsys):
                    "--restarts", "2", "--out", str(out)])
     assert rc == 2
     assert "axis-angle inits" in capsys.readouterr().err
+
+
+def test_fit_subcommand_fit_trans(tmp_path, capsys):
+    """--fit-trans (round 5): LM recovers a rigidly offset target from
+    the CLI, the checkpoint carries the trans array, and a second stage
+    warm-starts from it via --init."""
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+
+    p32 = synthetic_params(seed=0).astype(np.float32)
+    rng = np.random.default_rng(3)
+    pose = rng.normal(scale=0.2, size=(16, 3)).astype(np.float32)
+    tr = np.array([0.1, -0.05, 0.2], np.float32)
+    targets = np.asarray(core.jit_forward(
+        p32, jnp.asarray(pose), jnp.zeros(10, jnp.float32)
+    ).verts) + tr
+    np.save(tmp_path / "t.npy", targets)
+    out = tmp_path / "fit_tr.npz"
+    rc = cli.main([
+        "fit", str(tmp_path / "t.npy"),
+        "--solver", "lm", "--fit-trans", "--out", str(out),
+    ])
+    assert rc == 0
+    ckpt = np.load(out)
+    assert np.abs(ckpt["trans"] - tr).max() < 1e-3
+    assert np.abs(ckpt["pose"] - pose).max() < 1e-2
+
+    # Second stage consumes the trans seed; without --fit-trans it is
+    # dropped with a note instead of erroring.
+    out2 = tmp_path / "fit_tr2.npz"
+    rc = cli.main([
+        "fit", str(tmp_path / "t.npy"),
+        "--solver", "lm", "--fit-trans", "--init", str(out),
+        "--steps", "5", "--out", str(out2),
+    ])
+    assert rc == 0
+    assert np.abs(np.load(out2)["trans"] - tr).max() < 1e-3
+    capsys.readouterr()
+    rc = cli.main([
+        "fit", str(tmp_path / "t.npy"),
+        "--solver", "lm", "--init", str(out),
+        "--steps", "2", "--out", str(out2),
+    ])
+    assert rc == 0
+    assert "ignoring it" in capsys.readouterr().err
