@@ -37,6 +37,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -64,6 +65,8 @@ _PROBE_CODE = (
 
 
 def log(msg: str) -> None:
+    global _LAST_PROGRESS
+    _LAST_PROGRESS = time.time()
     print(msg, file=sys.stderr, flush=True)
 
 
@@ -72,14 +75,33 @@ _ACTIVE_LOCK = None  # the live DeviceLock, for signal-time release
 _LIVE_PROBE = None  # the in-flight backend-probe child, for signal-time kill
 _PARTIAL = None  # (results, errors, device_str, is_tpu) live in run_benchmarks
 _FINAL_LINE = None  # the complete line once run_benchmarks finishes
+_LAST_PROGRESS = time.time()  # bumped by log(); the watchdog's stall clock
+_WATCHDOG_ARMED = False  # stall detection live only once a TPU backend is up
+_EMERGENCY = False  # single-shot latch shared by signal guard + watchdog
+_EMERGENCY_LOCK = threading.Lock()  # makes the latch a true test-and-set
+_CLEANUP_DONE = False  # first emergency caller finished device cleanup
 
 _OUTAGE_NOTE = ("tunnel outage — archived on-chip runs + provenance: "
                 "bench_results/README.md; verdict tool: "
                 "scripts/bench_report.py")
 
 
+_EMIT_LOCK = threading.Lock()
+
+
 def emit(line: dict) -> None:
-    """The ONE stdout JSON line, NaN/inf scrubbed so it always parses."""
+    """The ONE stdout JSON line, NaN/inf scrubbed so it always parses.
+
+    Single-shot across THREADS as well as call sites: the watchdog thread
+    and main() can both reach their emit concurrently (e.g. the emit-by
+    deadline firing just as run_benchmarks completes), so the
+    check-flag/print pair must be atomic — the lock makes the second
+    caller a no-op instead of a second stdout line. The acquire carries a
+    timeout for the one case a lock can't serialize: a SIGNAL handler on
+    the main thread interrupting main() mid-emit (frame suspended while
+    holding the lock). Then _EMITTED is already True (flag is set before
+    print), so the post-timeout check still suppresses a double line.
+    """
     global _EMITTED
 
     def _finite(x):
@@ -89,13 +111,20 @@ def emit(line: dict) -> None:
             return {k: _finite(v) for k, v in x.items()}
         return x
 
-    # Serialize BEFORE setting the flag (a dumps TypeError must leave the
-    # backstop armed), and flag BEFORE printing (a signal landing between
-    # print and assignment must not double-emit; worst case flips to a
-    # partial line only if the print itself dies mid-write).
+    # Serialize BEFORE taking the lock/flag (a dumps TypeError must leave
+    # the backstop armed), and flag BEFORE printing (a signal landing
+    # between print and assignment must not double-emit; worst case flips
+    # to a partial line only if the print itself dies mid-write).
     text = json.dumps(_finite(line))
-    _EMITTED = True
-    print(text, flush=True)
+    got = _EMIT_LOCK.acquire(timeout=10.0)
+    try:
+        if _EMITTED:
+            return
+        _EMITTED = True
+        print(text, flush=True)
+    finally:
+        if got:
+            _EMIT_LOCK.release()
 
 
 def _null_line(error: str, outage: bool = False) -> dict:
@@ -119,7 +148,16 @@ def _salvage(error: str) -> dict | None:
     if _PARTIAL is None:
         return None
     try:
-        line = assemble_line(*_PARTIAL)
+        # Snapshot the LIVE dicts first: the watchdog thread can salvage
+        # while the main thread is still healthily inserting results
+        # (emit-by deadline on a slow run). assemble_line both iterates
+        # and mutates its results dict — doing that on the shared object
+        # from another thread risks 'dict changed size during iteration',
+        # which the except below would turn into a null line, silently
+        # discarding every number already measured.
+        results, errors, device_str, is_tpu = _PARTIAL
+        line = assemble_line(dict(results), dict(errors), device_str,
+                             is_tpu)
     except Exception:
         return None
     line["partial"] = True
@@ -153,6 +191,40 @@ def _signal_guard(signum, frame) -> None:
         except Exception:
             pass
     name = signal.Signals(signum).name
+    _emergency_exit(f"killed by {name}", 128 + signum)
+
+
+def _emergency_exit(cause: str, rc: int) -> None:
+    """The shared last-resort path (signal guard AND watchdog thread):
+    emit the best available artifact line — complete > partial salvage >
+    null — release the device, and hard-exit (no unwinding through
+    JAX/subprocess frames). Single-shot: a second caller (e.g. SIGTERM
+    landing while the watchdog is mid-emergency) exits without a second
+    line."""
+    global _EMERGENCY, _CLEANUP_DONE
+    # True test-and-set: the watchdog thread and the main-thread signal
+    # handler can race into this function; a bare check-then-assign has a
+    # bytecode gap the GIL can switch in, running the whole body twice
+    # (double lock release, nondeterministic rc). acquire() with timeout:
+    # a stuck holder must not deadlock the signal handler forever.
+    got = _EMERGENCY_LOCK.acquire(timeout=5.0)
+    try:
+        first = not _EMERGENCY
+        _EMERGENCY = True
+    finally:
+        if got:
+            _EMERGENCY_LOCK.release()
+    if not first:
+        # Another caller is mid-emergency. Exiting instantly could cut
+        # its artifact line mid-write (os._exit does not flush stdio) or
+        # its device cleanup mid-release (a dead driver's priority claim
+        # wedges builders for 2 h) — wait, bounded, for the WHOLE first
+        # pass to finish. sleep releases the GIL so the other thread
+        # keeps making progress.
+        deadline = time.time() + 20.0
+        while not _CLEANUP_DONE and time.time() < deadline:
+            time.sleep(0.1)
+        os._exit(rc)
     kind = "already-emitted"
     if not _EMITTED:
         if _FINAL_LINE is not None:
@@ -160,8 +232,8 @@ def _signal_guard(signum, frame) -> None:
             # the final emit. The full line, unlabeled, is the truth.
             line, kind = _FINAL_LINE, "complete"
         else:
-            line = _salvage(f"killed by {name} mid-run; value covers "
-                            "only the configs completed before the signal")
+            line = _salvage(f"{cause} mid-run; value covers "
+                            "only the configs completed before it")
             kind = "partial" if line is not None else "null"
         try:
             if line is not None:
@@ -170,12 +242,12 @@ def _signal_guard(signum, frame) -> None:
             line, kind = None, "null"  # bad salvage must not cost the null
         if line is None and not _EMITTED:  # _EMITTED: print died mid-line
             try:
-                emit(_null_line(f"killed by {name} before completion",
+                emit(_null_line(f"{cause} before completion",
                                 outage=True))
             except Exception:
                 pass
     try:
-        log(f"bench: caught {name}; {kind} artifact emitted, exiting")
+        log(f"bench: {cause}; {kind} artifact emitted, exiting")
     except Exception:
         pass
     probe = _LIVE_PROBE
@@ -197,12 +269,66 @@ def _signal_guard(signum, frame) -> None:
                     os.remove(_dl.CLAIM_PATH)
     except Exception:
         pass
-    os._exit(128 + signum)
+    _CLEANUP_DONE = True
+    os._exit(rc)
 
 
 def install_signal_guard() -> None:
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, _signal_guard)
+
+
+WATCHDOG_RC = 3  # distinct from null-run 1 / DeviceBusy 2 / signal 128+N
+
+
+def start_watchdog(stall_s: float, emit_by_s: float, t0: float) -> None:
+    """Arm the emergency watchdog THREAD. Two triggers, both of which the
+    signal guard alone cannot cover:
+
+    - **stall**: no progress (``log()`` call) for ``stall_s`` seconds.
+      Observed live (r5, 2026-08-01): a tunnel drop mid-measurement left
+      the main thread blocked inside a PJRT RPC — Python signal handlers
+      only run between bytecodes in the MAIN thread, so the driver's
+      SIGTERM was never delivered and its follow-up SIGKILL would have
+      produced an empty stdout (the BENCH_r04 failure, resurrected). A
+      daemon thread keeps running because the blocked RPC releases the
+      GIL, so it can emit the salvage line and ``os._exit``. Armed only
+      once a TPU backend is up (``arm_watchdog_stall``) — the hang class
+      is tunnel-specific, and CPU/interpreter lanes have legitimately
+      long quiet gaps on a busy 1-core box.
+    - **deadline**: ``emit_by_s`` seconds of wall clock since ``t0``.
+      The driver harness kills flagless runs at ~30 min; a slow-but-live
+      run must emit what it has BEFORE that, not be cut mid-line.
+
+    ``stall_s``/``emit_by_s`` of 0 disable the respective trigger; with
+    both off (the CPU/interpreter lanes) no thread is spawned at all.
+    """
+    if not (stall_s or emit_by_s):
+        return
+
+    def _watch() -> None:
+        while True:
+            time.sleep(2.0)
+            now = time.time()
+            if emit_by_s and now - t0 >= emit_by_s:
+                _emergency_exit(
+                    f"watchdog: emit-by deadline ({emit_by_s:.0f}s) hit",
+                    WATCHDOG_RC)
+            if (stall_s and _WATCHDOG_ARMED
+                    and now - _LAST_PROGRESS >= stall_s):
+                _emergency_exit(
+                    f"watchdog: no progress for {stall_s:.0f}s "
+                    "(hung device RPC — tunnel drop mid-measurement?)",
+                    WATCHDOG_RC)
+
+    threading.Thread(target=_watch, name="bench-watchdog",
+                     daemon=True).start()
+
+
+def arm_watchdog_stall() -> None:
+    global _WATCHDOG_ARMED, _LAST_PROGRESS
+    _LAST_PROGRESS = time.time()
+    _WATCHDOG_ARMED = True
 
 
 def bring_up_backend(retries: int, probe_timeout: float,
@@ -1750,6 +1876,20 @@ def main() -> int:
                          "authoritative run; claims priority, builder "
                          "loops stand down) or 'builder' (never waits: "
                          "exits immediately if the device is claimed)")
+    ap.add_argument("--stall-timeout", type=float, default=600.0,
+                    help="watchdog: emit the salvage artifact and exit if "
+                         "no measurement progress for this many seconds "
+                         "(hung tunnel RPCs defeat the SIGTERM guard — "
+                         "the watchdog thread still runs). TPU runs only; "
+                         "0 disables")
+    ap.add_argument("--emit-by", type=float, default=-1.0,
+                    help="watchdog: hard wall-clock seconds from start by "
+                         "which the artifact line MUST be on stdout "
+                         "(emit best-available and exit). Default: 1620 "
+                         "(27 min, inside the driver harness's ~30-min "
+                         "kill) for flagless TPU runs; 0 (off) when "
+                         "--platform cpu; the builder wrapper passes its "
+                         "own value under its attempt cap")
     ap.add_argument("--lock-wait", type=float, default=300.0,
                     help="driver-role seconds to wait for the device lock "
                          "before proceeding without it (advisory). Window "
@@ -1758,6 +1898,10 @@ def main() -> int:
                          "+ 20 min probing leaves margin for the run itself")
     args = ap.parse_args()
     install_signal_guard()
+    if args.emit_by < 0:
+        args.emit_by = 0.0 if args.platform == "cpu" else 1620.0
+    start_watchdog(0.0 if args.platform == "cpu" else args.stall_timeout,
+                   args.emit_by, time.time())
 
     if args.virtual_devices:
         # Must land in XLA_FLAGS before jaxlib initializes (the probe
@@ -1801,6 +1945,12 @@ def main() -> int:
 
             _enable_compile_cache(
                 locked=use_lock and lock.acquired)
+            # Same predicate as run_benchmarks' is_tpu: the tunneled
+            # plugin can surface as platform "axon", not "tpu" — a
+            # startswith("tpu") gate would leave the stall watchdog
+            # disarmed on the exact backend whose hangs it exists for.
+            if device_str.split(":")[0] in ("tpu", "axon"):
+                arm_watchdog_stall()
 
             try:
                 line = run_benchmarks(args, device_str)

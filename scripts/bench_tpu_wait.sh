@@ -64,9 +64,14 @@ while true; do
   # is in flight: "stand down when another bench wants the device" must
   # hold MID-ATTEMPT too, not just between attempts — a full bench takes
   # tens of minutes and the driver must never contend with its tail.
+  # --emit-by just under the attempt cap: a hung tunnel RPC blocks the
+  # SIGTERM guard (signal handlers need the main thread between
+  # bytecodes), so the in-process watchdog must flush the salvage line
+  # BEFORE timeout escalates to SIGKILL (observed live, r5).
   timeout -k 60 "$attempt_cap" \
       python bench.py --role builder --pallas-sweep full \
       --init-retries 8 --init-timeout 120 --init-budget 900 --iters 10 \
+      --emit-by $(( attempt_cap > 150 ? attempt_cap - 90 : attempt_cap )) \
       --profile "$OUT.trace" \
       "$@" > "$OUT.out" 2>> "$OUT.log" &
   BPID=$!
@@ -84,13 +89,49 @@ while true; do
   done
   wait "$BPID"
   rc=$?
-  # A failed/preempted attempt may still have salvaged on-chip numbers
-  # (bench.py's partial artifact on SIGTERM/crash). The next attempt's
-  # `> "$OUT.out"` would truncate them — preserve the newest partial; at
-  # the deadline it is better than nothing.
-  if [ "$rc" -ne 0 ] && grep -q '"partial": true' "$OUT.out" 2>/dev/null; then
-    cp "$OUT.out" "$OUT.partial.out"
-    echo "[bench-tpu-wait] partial artifact preserved -> $OUT.partial.out" >&2
+  # A nonzero rc does not mean an empty artifact. Two salvage grades:
+  # - COMPLETE line despite rc!=0 (watchdog emit-by fired in the window
+  #   between run completion and the final emit — kind "complete": no
+  #   "partial" flag, no "error" field, a real value): as good as rc=0;
+  #   accept it rather than rerun tens of on-chip minutes.
+  # - PARTIAL salvage (bench.py's artifact on SIGTERM/watchdog/crash):
+  #   the next attempt's `> "$OUT.out"` would truncate it — preserve the
+  #   newest; at the deadline it is better than nothing.
+  if [ "$rc" -ne 0 ] && [ -s "$OUT.out" ]; then
+    # Classify by PARSING, not grepping: a line SIGKILLed mid-write can
+    # truncate after "value" but before the trailing "partial"/"error"
+    # keys, which greps would promote to "complete". json.loads rejects
+    # the truncation instead.
+    verdict=$(python - "$OUT.out" <<'PY'
+import json, sys
+try:
+    lines = [ln for ln in open(sys.argv[1]).read().splitlines()
+             if ln.strip()]
+    line = json.loads(lines[-1]) if lines else {}
+except Exception:
+    print("invalid")
+else:
+    if line.get("partial"):
+        print("partial")
+    elif line.get("value") is not None and "error" not in line:
+        print("complete")
+    else:
+        print("other")
+PY
+    )
+    case "$verdict" in
+      complete)
+        echo "[bench-tpu-wait] complete artifact despite rc=$rc" \
+             "(watchdog cut the tail); accepting -> $OUT.out" >&2
+        cat "$OUT.out"
+        exit 0
+        ;;
+      partial)
+        cp "$OUT.out" "$OUT.partial.out"
+        echo "[bench-tpu-wait] partial artifact preserved ->" \
+             "$OUT.partial.out" >&2
+        ;;
+    esac
   fi
   if [ "$preempted" -eq 1 ]; then
     echo "[bench-tpu-wait] standing down 300s for the driver" >&2

@@ -159,6 +159,64 @@ def test_bench_sigterm_mid_run_salvages_partial_results(tmp_path):
     assert "config2_b1024_evals_per_sec" in line["detail"]
 
 
+def test_watchdog_stall_emits_salvage_from_thread(tmp_path):
+    """A tunnel drop mid-measurement leaves the main thread blocked inside
+    a C-level RPC: SIGTERM is queued but Python signal handlers only run
+    between bytecodes in the MAIN thread, so the guard never fires
+    (observed live, r5 2026-08-01 — TERM on the hung bench produced
+    nothing; only SIGKILL worked, which would have left the driver an
+    empty stdout). The watchdog THREAD must detect the stall and emit the
+    salvage line itself. Simulated with a GIL-releasing sleep."""
+    script = (
+        "import sys, time\n"
+        f"sys.path.insert(0, {str(ROOT)!r})\n"
+        "import bench\n"
+        "bench._PARTIAL = ({'config2_b1024_evals_per_sec': 123.0}, {},\n"
+        "                  'tpu:fake', True)\n"
+        "bench.start_watchdog(stall_s=2.0, emit_by_s=0.0, t0=time.time())\n"
+        "bench.arm_watchdog_stall()\n"
+        "time.sleep(60)  # the 'hung RPC': blocks, releases the GIL\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=30, cwd=ROOT,
+        env={**os.environ, "MANO_DEVICE_LOCK_DIR": str(tmp_path)},
+    )
+    assert proc.returncode == 3, (proc.returncode, proc.stderr)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    line = json.loads(lines[0])
+    assert line["partial"] is True
+    assert line["value"] == 123.0
+    assert "no progress" in line["error"]
+
+
+def test_watchdog_emit_by_deadline_bounds_the_run(tmp_path):
+    """--emit-by must put SOME valid line on stdout by the given wall
+    clock even while bring-up is still probing — the driver's ~30-min
+    kill must never again catch an artifact-less process (BENCH_r04)."""
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "bench.py"),
+         "--platform", "nosuchbackend", "--emit-by", "8",
+         "--init-retries", "30", "--init-timeout", "60",
+         "--init-budget", "300"],
+        capture_output=True, text=True, timeout=60, cwd=ROOT,
+        env={**os.environ, "MANO_DEVICE_LOCK_DIR": str(tmp_path),
+             "MANO_BENCH_CACHE_DIR": str(tmp_path / "cache")},
+    )
+    assert proc.returncode == 3, (proc.returncode, proc.stderr)
+    assert time.time() - t0 < 40
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    line = json.loads(lines[0])
+    assert line["metric"] == "mano_forward_evals_per_sec"
+    assert line["value"] is None
+    assert "emit-by deadline" in line["error"]
+    # The dead run's priority claim must not wedge later builder loops.
+    assert not (tmp_path / "mano_tpu_device.priority").exists()
+
+
 def test_bench_cpu_tiny_run_end_to_end():
     """Full harness on CPU with minimal sizes: rc=0, all headline fields."""
     rc, line = _run_bench(
