@@ -558,7 +558,22 @@ def run_benchmarks(args, device_str: str) -> dict:
     global _PARTIAL
     _PARTIAL = (results, errors, device_str, is_tpu)
 
+    # Sections are REGISTERED here in source order and executed by the
+    # runner at the bottom of this function in done-criteria-first order
+    # (see the priority list there). All cross-section data flows through
+    # `results` or the nonlocals each consumer section reads. Two known
+    # deferral effects beyond the schedule itself: inline (non-section)
+    # code now runs BEFORE every section (so observational probes must be
+    # sections — see hbm_peak), and sections' rng draws land after all
+    # inline draws, so input values differ draw-for-draw from pre-r5
+    # artifacts (shape-bound rates are unaffected).
+    _registered: list = []
+
     def section(name, fn):
+        """Register one fault-isolated config for the ordered runner."""
+        _registered.append((name, fn))
+
+    def run_section(name, fn):
         """Fault-isolate one config; a crash records an error, not a wipe."""
         if args.mesh_scaling_only and name != "mesh_scaling":
             return
@@ -939,7 +954,13 @@ def run_benchmarks(args, device_str: str) -> dict:
                   else [(32,), (64,), (128,), (256,)])
         rate, (bb,), best_launch, stab = sweep_kernel(
             "config3c fused", make_fn, blocks, min(half, 8192))
-        results["config3_fused_evals_per_sec"] = rate
+        # config3d runs FIRST under the criteria-ordered runner and may
+        # already have promoted its (faster) full-fusion rate into this
+        # key; only overwrite when the pre-stage kernel actually wins,
+        # and then drop the stale full_fusion variant tag.
+        if rate > results.get("config3_fused_evals_per_sec", 0.0):
+            results["config3_fused_evals_per_sec"] = rate
+            results.pop("config3_fused_variant", None)
         results["fused_best_block_b"] = bb
         results["fused_best_launch"] = best_launch
         results["fused_sweep_stability"] = stab
@@ -1487,6 +1508,10 @@ def run_benchmarks(args, device_str: str) -> dict:
         section("mesh_scaling", mesh_scaling)
 
     if args.mesh_scaling_only:
+        # Early-return path: drive the deferred runner here (its
+        # mesh-scaling-only skip reduces the schedule to this section).
+        for name, fn in _registered:
+            run_section(name, fn)
         table = results.get("mesh_scaling", {})
         rates = [row["evals_per_sec"] for row in table.values()
                  if row.get("evals_per_sec")]
@@ -1673,19 +1698,27 @@ def run_benchmarks(args, device_str: str) -> dict:
     section("config6_silhouette", config6_silhouette)
 
     # -- memory high-water mark ---------------------------------------------
-    try:
-        stats = dev.memory_stats() or {}
-        # Key name varies by PJRT plugin; take the first peak-ish one.
-        peak = next((stats[k] for k in
-                     ("peak_bytes_in_use", "peak_bytes", "max_bytes_in_use")
-                     if k in stats), None)
-        if peak is not None:
-            results["hbm_peak_bytes"] = int(peak)
-            log(f"HBM peak: {peak / 2**30:.2f} GiB")
-        else:
-            log(f"no peak-memory key; memory_stats keys = {sorted(stats)}")
-    except Exception as e:
-        log(f"memory stats unavailable: {type(e).__name__}")
+    # A SECTION (not inline code): under the deferred runner, inline code
+    # executes at registration time — before any benchmark ran — and
+    # would record the pre-benchmark peak.
+    def hbm_peak():
+        try:
+            stats = dev.memory_stats() or {}
+            # Key name varies by PJRT plugin; take the first peak-ish one.
+            peak = next((stats[k] for k in
+                         ("peak_bytes_in_use", "peak_bytes",
+                          "max_bytes_in_use")
+                         if k in stats), None)
+            if peak is not None:
+                results["hbm_peak_bytes"] = int(peak)
+                log(f"HBM peak: {peak / 2**30:.2f} GiB")
+            else:
+                log("no peak-memory key; memory_stats keys = "
+                    f"{sorted(stats)}")
+        except Exception as e:
+            log(f"memory stats unavailable: {type(e).__name__}")
+
+    section("hbm_peak", hbm_peak)
 
     # -- analytic peak memory (compiler-reported, backend-independent) ------
     # The axon runtime exposes no memory_stats; XLA's own buffer assignment
@@ -1760,6 +1793,24 @@ def run_benchmarks(args, device_str: str) -> dict:
             )
 
     section("memory_probe", memory_probe)
+
+    # -- ordered execution: done-criteria first -----------------------------
+    # Tunnel-up windows can last MINUTES, not hours (r5 live: a window
+    # opened, delivered two configs, and died ~3 min in) — so a short
+    # window's partial salvage must carry the round's DECIDING numbers,
+    # not warm-up trivia. The headline sweep (config3d), the B=65536
+    # route (criterion: >=0.85x headline), and the LM rate (criterion:
+    # >=180 steps/s) run right after warm-up; everything else follows in
+    # registration order. The readback tail (accuracy onward) keeps its
+    # position: the first D2H permanently degrades later axon dispatches,
+    # and accuracy can only probe kernels whose sections already ran.
+    priority = ["config1_warmup", "sync_probe", "config3d",
+                "config3_fused_full_chunked", "config3",
+                "config4", "config4b_lm", "config3e_hands"]
+    rank = {name: i for i, name in enumerate(priority)}
+    for name, fn in sorted(_registered,
+                           key=lambda nf: rank.get(nf[0], len(priority))):
+        run_section(name, fn)
 
     global _FINAL_LINE
     _FINAL_LINE = assemble_line(results, errors, device_str, is_tpu)
