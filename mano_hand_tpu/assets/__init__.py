@@ -6,6 +6,7 @@ from mano_hand_tpu.assets.loader import (
     load_model,
     load_npz,
     load_official_pickle,
+    load_smpl_pickle,
     save_dumped_pickle,
     save_npz,
 )
@@ -25,6 +26,7 @@ __all__ = [
     "load_npz",
     "load_dumped_pickle",
     "load_official_pickle",
+    "load_smpl_pickle",
     "save_npz",
     "save_dumped_pickle",
     "extract_scan_poses",

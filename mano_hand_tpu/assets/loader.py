@@ -116,6 +116,8 @@ def _infer_side(path: PathLike, explicit: str | None) -> str:
     if explicit is not None:
         return explicit
     name = Path(path).name.lower()
+    if "neutral" in name:
+        return C.NEUTRAL
     return C.LEFT if "left" in name else C.RIGHT
 
 
@@ -169,6 +171,47 @@ def load_official_pickle(path: PathLike, side: str | None = None) -> ManoParams:
     )
 
 
+def load_smpl_pickle(path: PathLike, side: str | None = None) -> ManoParams:
+    """Load an official SMPL-family body pickle (SMPL/SMPL-H style keys)
+    into the same params PyTree the whole framework runs on.
+
+    The compute core is topology-generic (level-parallel FK over any
+    topologically-ordered tree, shape/pose blendshapes by contraction —
+    see tests/test_generic_topology.py's 24-joint suite), so a body model
+    is just a bigger asset: V=6890, J=24, P=207 for SMPL. The official
+    pickle shares MANO's chumpy-era container (same tolerant unpickling,
+    sparse J_regressor, ``kintree_table``) but carries no hand-pose PCA —
+    we synthesize a pass-through PCA space (identity basis, zero mean,
+    dims (J-1)*3) so every pose-PCA API keeps working and decodes to the
+    coefficients themselves.
+
+    Body assets are unsided (``side='neutral'``); SMPL's root parent
+    arrives as uint32 ``2**32 - 1`` in ``kintree_table[0, 0]``, mapped to
+    the -1 sentinel like the reference's ``None``
+    (/root/reference/dump_model.py:18 semantics).
+    """
+    with open(path, "rb") as f:
+        raw = _tolerant_load(f, encoding="latin1")
+    j_reg = _dense(raw["J_regressor"]).astype(np.float64)
+    j = j_reg.shape[0]
+    n_aa = (j - 1) * 3
+    return validate(
+        ManoParams(
+            v_template=_dense(raw["v_template"]).astype(np.float64),
+            shape_basis=_dense(raw["shapedirs"]).astype(np.float64),
+            pose_basis=_dense(raw["posedirs"]).astype(np.float64),
+            j_regressor=j_reg,
+            lbs_weights=_dense(raw["weights"]).astype(np.float64),
+            pca_basis=np.eye(n_aa, dtype=np.float64),
+            pca_mean=np.zeros(n_aa, dtype=np.float64),
+            faces=_dense(raw["f"]).astype(np.int32),
+            parents=_parents_from(
+                _dense(raw["kintree_table"]).astype(np.int64)[0]),
+            side=C.NEUTRAL if side is None else side,
+        )
+    )
+
+
 def save_npz(params: ManoParams, path: PathLike) -> None:
     """Canonical on-disk form: a flat .npz, no pickle objects."""
     arrays = {f: np.asarray(getattr(params, f)) for f in ARRAY_FIELDS}
@@ -210,8 +253,30 @@ def load_model(path: PathLike, side: str | None = None) -> ManoParams:
     p = Path(path)
     if p.suffix == ".npz":
         return load_npz(p, side=side)
-    # Both pickle flavors end in .pkl; sniff by content.
+    # All pickle flavors end in .pkl; sniff by content: reference-style
+    # dumped keys, then official MANO (has hand-PCA keys), then
+    # SMPL-family body (same container, no hand-PCA).
     try:
         return load_dumped_pickle(p, side=side)
     except (KeyError, UnicodeDecodeError):
+        pass
+    try:
         return load_official_pickle(p, side=side)
+    except KeyError as e:
+        # Fall through to the body loader ONLY when what's missing is the
+        # hand-PCA pair — any other missing key is a corrupt official
+        # pickle that must fail loudly, not load as a fabricated body.
+        if e.args and e.args[0] not in ("hands_components", "hands_mean"):
+            raise
+        loaded = load_smpl_pickle(p, side=side)
+        if loaded.n_joints == C.N_JOINTS:
+            # A 16-joint asset without hand-PCA keys is a broken MANO
+            # file, not a body model; identity-PCA would silently replace
+            # the real MANO pose space. (load_smpl_pickle called directly
+            # still accepts any topology.)
+            raise KeyError(
+                "hands_components/hands_mean missing from a 16-joint "
+                "asset — corrupt MANO pickle? Use load_smpl_pickle "
+                "explicitly to load it as a PCA-less body."
+            ) from e
+        return loaded

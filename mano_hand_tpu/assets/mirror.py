@@ -66,7 +66,8 @@ def mirror_params(params: ManoParams) -> ManoParams:
     faces = np.asarray(params.faces)[:, ::-1].copy()   # re-orient winding
 
     dtype = np.asarray(params.v_template).dtype
-    side = C.LEFT if params.side == C.RIGHT else C.RIGHT
+    side = (C.NEUTRAL if params.side == C.NEUTRAL
+            else C.LEFT if params.side == C.RIGHT else C.RIGHT)
     return validate(dataclasses.replace(
         params,
         v_template=v_template.astype(dtype),

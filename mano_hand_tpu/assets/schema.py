@@ -132,6 +132,7 @@ def validate(p: ManoParams) -> ManoParams:
             f"faces indices must be in [0, {v}); got range "
             f"[{faces.min()}, {faces.max()}]"
         )
-    if p.side not in (C.LEFT, C.RIGHT):
-        raise ValueError(f"side must be 'left' or 'right', got {p.side!r}")
+    if p.side not in (C.LEFT, C.RIGHT, C.NEUTRAL):
+        raise ValueError(
+            f"side must be 'left', 'right' or 'neutral', got {p.side!r}")
     return p

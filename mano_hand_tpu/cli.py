@@ -991,7 +991,7 @@ def build_parser() -> argparse.ArgumentParser:
     d = sub.add_parser("demo", help="export the reference demo mesh")
     d.add_argument("--asset", default="synthetic",
                    help="asset path (.npz/.pkl) or 'synthetic'")
-    d.add_argument("--side", default=None, choices=[None, "left", "right"])
+    d.add_argument("--side", default=None, choices=[None, "left", "right", "neutral"])
     d.add_argument("--backend", default="jax", choices=["np", "jax"])
     d.add_argument("--out", default="hand.obj",
                    help="output mesh; a .ply suffix writes binary PLY "
@@ -1001,7 +1001,7 @@ def build_parser() -> argparse.ArgumentParser:
     c = sub.add_parser("convert", help="convert assets between formats")
     c.add_argument("src")
     c.add_argument("dst", help="output path (.npz or .pkl)")
-    c.add_argument("--side", default=None, choices=[None, "left", "right"])
+    c.add_argument("--side", default=None, choices=[None, "left", "right", "neutral"])
     c.add_argument("--mirror", action="store_true",
                    help="write the OPPOSITE side: reflect the asset "
                         "across x=0 (template/bases re-signed, winding "
@@ -1013,7 +1013,7 @@ def build_parser() -> argparse.ArgumentParser:
     a = sub.add_parser("animate", help="batch-evaluate a pose sequence")
     a.add_argument("poses", help=".npy of [T,16,3] or [T,15,3] axis-angles")
     a.add_argument("--asset", default="synthetic")
-    a.add_argument("--side", default=None, choices=[None, "left", "right"])
+    a.add_argument("--side", default=None, choices=[None, "left", "right", "neutral"])
     a.add_argument("--out", default="frames",
                    help="output dir for OBJ frames, or a .glb path for "
                         "ONE viewer-ready animated file (morph targets)")
@@ -1031,7 +1031,7 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--poses", default=None,
                    help=".npy of [T,16,3]/[T,15,3]/[16,3]; default rest pose")
     r.add_argument("--asset", default="synthetic")
-    r.add_argument("--side", default=None, choices=[None, "left", "right"])
+    r.add_argument("--side", default=None, choices=[None, "left", "right", "neutral"])
     r.add_argument("--out", default="render",
                    help="output dir for PNGs, or a .gif path")
     r.add_argument("--size", type=int, default=256)
@@ -1177,7 +1177,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "0.1) — not numerically comparable to the adam "
                         "weight")
     f.add_argument("--asset", default="synthetic")
-    f.add_argument("--side", default=None, choices=[None, "left", "right"])
+    f.add_argument("--side", default=None, choices=[None, "left", "right", "neutral"])
     f.add_argument("--solver", default=None, choices=["lm", "adam"],
                    help="default: lm for --data-term verts/point_to_plane, "
                         "adam for joints/keypoints2d/points/silhouette/"
@@ -1204,7 +1204,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="serialize the compiled forward (jax.export) for serving",
     )
     e.add_argument("--asset", default="synthetic")
-    e.add_argument("--side", default=None, choices=[None, "left", "right"])
+    e.add_argument("--side", default=None, choices=[None, "left", "right", "neutral"])
     e.add_argument("--out", default="mano_fwd.jaxexp")
     e.add_argument("--batch", type=int, default=0,
                    help="pin the batch size; default 0 = symbolic (any B)")
@@ -1219,7 +1219,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     i = sub.add_parser("info", help="print asset summary")
     i.add_argument("--asset", default="synthetic")
-    i.add_argument("--side", default=None, choices=[None, "left", "right"])
+    i.add_argument("--side", default=None, choices=[None, "left", "right", "neutral"])
     i.set_defaults(fn=cmd_info)
 
     v = sub.add_parser(
@@ -1228,7 +1228,7 @@ def build_parser() -> argparse.ArgumentParser:
              "structural facts + numeric invariants; print canonical "
              "digests")
     v.add_argument("asset", help="asset path (.pkl official/dumped, .npz)")
-    v.add_argument("--side", default=None, choices=[None, "left", "right"])
+    v.add_argument("--side", default=None, choices=[None, "left", "right", "neutral"])
     v.add_argument("--golden", default=None,
                    help="second asset to diff numerically (e.g. the .npz "
                         "converted from a known-good pickle)")

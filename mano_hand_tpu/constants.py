@@ -23,6 +23,9 @@ MANO_PARENTS = (-1, 0, 1, 2, 0, 4, 5, 0, 7, 8, 0, 10, 11, 0, 13, 14)
 
 LEFT = "left"
 RIGHT = "right"
+# Body-family assets (SMPL et al.) are unsided; the tag keeps mirror/scan
+# logic honest (mirroring a neutral asset keeps it neutral).
+NEUTRAL = "neutral"
 
 # ---------------------------------------------------------------- keypoints
 # The MANO skeleton regresses 16 joints (no fingertips — the tips are mesh

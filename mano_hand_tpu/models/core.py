@@ -44,11 +44,13 @@ def decode_pca(
     global_rot: Optional[jnp.ndarray] = None,
     precision=DEFAULT_PRECISION,
 ) -> jnp.ndarray:
-    """PCA pose coefficients [n<=45] -> full pose [16, 3].
+    """PCA pose coefficients [n<=(J-1)*3] -> full pose [J, 3].
 
     Reference semantics (/root/reference/mano_np.py:66-72): truncated basis
     rows, add the mean pose, prepend the global-rotation row. The number of
-    coefficients is a static property of the input shape.
+    coefficients is a static property of the input shape; the articulated
+    joint count comes from the asset (15 for MANO, 23 for SMPL bodies,
+    whose synthesized identity basis makes this a pass-through).
     """
     n = pca_coeffs.shape[-1]
     flat = (
@@ -56,7 +58,8 @@ def decode_pca(
                    precision=precision)
         + params.pca_mean
     )
-    fingers = flat.reshape(*pca_coeffs.shape[:-1], 15, 3)
+    n_arti = params.pca_mean.shape[-1] // 3
+    fingers = flat.reshape(*pca_coeffs.shape[:-1], n_arti, 3)
     root_shape = (*pca_coeffs.shape[:-1], 1, 3)
     if global_rot is None:
         root = jnp.zeros(root_shape, dtype=fingers.dtype)

@@ -62,15 +62,17 @@ def decode_pca_pose(
     pca_coeffs: np.ndarray,
     global_rot: np.ndarray | None = None,
 ) -> np.ndarray:
-    """PCA coefficients [n<=45] (+ optional global rot [3]) -> pose [16, 3].
+    """PCA coefficients [n<=(J-1)*3] (+ optional global rot [3]) -> pose
+    [J, 3].
 
     Semantics of mano_np.py:66-72: truncated basis rows, add mean, reshape
-    to [15, 3], prepend the global-rotation row (zeros if not given).
+    to [J-1, 3] (15 for MANO), prepend the global-rotation row (zeros if
+    not given).
     """
     pca_coeffs = np.asarray(pca_coeffs, dtype=np.float64)
     n = pca_coeffs.shape[-1]
     flat = pca_coeffs @ np.asarray(params.pca_basis)[:n] + np.asarray(params.pca_mean)
-    fingers = flat.reshape(15, 3)
+    fingers = flat.reshape(np.asarray(params.pca_mean).shape[-1] // 3, 3)
     root = (
         np.zeros((1, 3))
         if global_rot is None

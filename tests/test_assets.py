@@ -183,6 +183,96 @@ def test_official_pickle_without_chumpy(params, tmp_path):
     assert _lm(path).side == C.RIGHT
 
 
+def test_smpl_family_pickle_loads_and_runs(tmp_path):
+    """An official-style SMPL body pickle (24 joints, no hand-PCA keys)
+    loads into the same params PyTree and runs through the topology-
+    generic forward. The synthesized pass-through PCA space (identity
+    basis, zero mean) keeps every pose-PCA API live: decode(c) == c."""
+    import pickle
+
+    import scipy.sparse as sp
+
+    from mano_hand_tpu.assets import load_model, load_smpl_pickle
+    from mano_hand_tpu.assets.synthetic import synthetic_params
+
+    body = synthetic_params(seed=11, n_verts=437, n_joints=24, n_shape=16,
+                            n_faces=870)
+    raw = {
+        "v_template": np.asarray(body.v_template),
+        "shapedirs": np.asarray(body.shape_basis),
+        "posedirs": np.asarray(body.pose_basis),
+        "J_regressor": sp.csc_matrix(np.asarray(body.j_regressor)),
+        "weights": np.asarray(body.lbs_weights),
+        "f": np.asarray(body.faces, np.uint32),
+        # SMPL's uint32 root sentinel (2**32 - 1) must map to -1.
+        "kintree_table": np.stack([
+            np.asarray([4294967295] + list(body.parents[1:]), np.uint32),
+            np.arange(24, dtype=np.uint32),
+        ]),
+    }
+    path = tmp_path / "SMPL_NEUTRAL.pkl"
+    with open(path, "wb") as f:
+        pickle.dump(raw, f, protocol=2)
+
+    loaded = load_smpl_pickle(path)
+    np.testing.assert_array_equal(loaded.v_template, body.v_template)
+    np.testing.assert_array_equal(loaded.lbs_weights, body.lbs_weights)
+    assert loaded.parents == body.parents and loaded.parents[0] == -1
+    assert loaded.side == "neutral"
+    assert loaded.n_joints == 24 and loaded.n_shape == 16
+    # Pass-through PCA space: identity basis, zero mean, (J-1)*3 dims.
+    np.testing.assert_array_equal(loaded.pca_basis, np.eye(69))
+    np.testing.assert_array_equal(loaded.pca_mean, np.zeros(69))
+
+    # load_model sniffing: dumped -> official -> SMPL chain lands here.
+    assert load_model(path).side == "neutral"
+
+    # The body asset runs through the generic JAX core.
+    from mano_hand_tpu.models import core
+
+    b32 = loaded.astype(np.float32)
+    rng = np.random.default_rng(0)
+    pose = rng.normal(scale=0.2, size=(3, 24, 3)).astype(np.float32)
+    beta = rng.normal(size=(3, 16)).astype(np.float32)
+    out = core.forward_batched(b32, pose, beta)
+    assert out.verts.shape == (3, 437, 3)
+    assert np.isfinite(np.asarray(out.verts)).all()
+
+    # Mirroring an unsided body keeps it neutral (geometry still flips).
+    from mano_hand_tpu.assets import mirror_params
+
+    assert mirror_params(loaded).side == "neutral"
+
+    # Round-trip through the nine-key dumped format must keep the neutral
+    # tag (filename inference knows 'neutral', not just left/right).
+    from mano_hand_tpu.assets import save_dumped_pickle
+
+    dumped = tmp_path / "body_neutral.pkl"
+    save_dumped_pickle(loaded, dumped)
+    assert load_model(dumped).side == "neutral"
+
+    # A 16-joint pickle missing the hand-PCA keys is a corrupt MANO
+    # asset: the sniffing chain must fail loudly, not fabricate a body.
+    hand = synthetic_params(seed=3)
+    raw16 = {
+        "v_template": np.asarray(hand.v_template),
+        "shapedirs": np.asarray(hand.shape_basis),
+        "posedirs": np.asarray(hand.pose_basis),
+        "J_regressor": sp.csc_matrix(np.asarray(hand.j_regressor)),
+        "weights": np.asarray(hand.lbs_weights),
+        "f": np.asarray(hand.faces, np.uint32),
+        "kintree_table": np.stack([
+            np.asarray([4294967295] + list(hand.parents[1:]), np.uint32),
+            np.arange(16, dtype=np.uint32),
+        ]),
+    }
+    broken = tmp_path / "MANO_RIGHT_broken.pkl"
+    with open(broken, "wb") as f:
+        pickle.dump(raw16, f, protocol=2)
+    with pytest.raises(KeyError, match="corrupt MANO"):
+        load_model(broken)
+
+
 # Pre-commit quick lane: core correctness, seconds-scale (make check-quick).
 pytestmark = __import__("pytest").mark.quick
 
