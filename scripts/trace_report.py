@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Summarize an XLA profiler trace: top ops by device-track time.
+
+The builder pipeline captures a trace of the winning kernel on every full
+TPU bench (``bench.py --profile DIR`` -> ``DIR/plugins/profile/<run>/
+*.trace.json.gz``). This tool turns that capture into the numbers the
+roadmap's headroom work needs (kernel math bound ~68 M evals/s vs
+measured 13-20 M): which ops actually burn the time.
+
+Stdlib only (gzip + json over the Chrome-trace export — the .xplane.pb
+twin needs TensorFlow tooling this image doesn't carry).
+
+    python scripts/trace_report.py bench_results/r05_tpu.trace [--top 15]
+    python scripts/trace_report.py DIR --json   # machine-readable
+
+Ranks complete ('X') events by summed wall duration per (track, op name).
+On TPU captures the device tracks (process names like '/device:TPU:0')
+carry the XLA op timeline; host tracks are reported separately so
+dispatch overhead is visible next to device compute. Durations are SUMS
+over a track (nested slices double-count parents; compare names at the
+same nesting level — XLA op rows are leaves, so their sums are honest).
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def find_traces(path: str) -> list[str]:
+    if os.path.isfile(path):
+        return [path]
+    hits = sorted(glob.glob(
+        os.path.join(path, "**", "*.trace.json.gz"), recursive=True))
+    return hits
+
+
+def load_events(trace_path: str) -> list[dict]:
+    """Events from one capture; a truncated/corrupt file (tunnel drop
+    mid-write) degrades to a warning, not a traceback."""
+    try:
+        with gzip.open(trace_path, "rt") as f:
+            return json.load(f).get("traceEvents", [])
+    except Exception as e:  # gzip EOFError, JSONDecodeError, OSError
+        print(f"skipping unreadable trace {trace_path}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return []
+
+
+def summarize(events: list[dict]) -> dict:
+    """Per (process, op name): total µs, count. Returns
+    {process_name: [(name, total_us, count), ...] sorted by total}."""
+    proc_names: dict = {}
+    thread_names: dict = {}
+    for e in events:
+        if e.get("ph") == "M":
+            pid = e.get("pid")
+            args = e.get("args") or {}
+            if e.get("name") == "process_name":
+                proc_names[pid] = args.get("name", str(pid))
+            elif e.get("name") == "thread_name":
+                thread_names[(pid, e.get("tid"))] = args.get("name", "")
+    totals: dict = collections.defaultdict(
+        lambda: collections.defaultdict(lambda: [0.0, 0]))
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        pid = e.get("pid")
+        proc = proc_names.get(pid, str(pid))
+        tname = thread_names.get((pid, e.get("tid")), "")
+        track = f"{proc}:{tname}" if tname else proc
+        cell = totals[track][e.get("name", "?")]
+        cell[0] += float(e.get("dur", 0.0))
+        cell[1] += 1
+    return {
+        track: sorted(
+            ((name, tot, cnt) for name, (tot, cnt) in per.items()),
+            key=lambda row: -row[1],
+        )
+        for track, per in totals.items()
+    }
+
+
+def is_device_track(track: str) -> bool:
+    t = track.lower()
+    return "tpu" in t or "/device" in t or "xla op" in t
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path", help="trace dir (bench --profile DIR) or one "
+                                 "*.trace.json.gz")
+    ap.add_argument("--top", type=int, default=15)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--all-tracks", action="store_true",
+                    help="include host tracks in the table (device tracks "
+                         "are always shown first)")
+    args = ap.parse_args()
+
+    traces = find_traces(args.path)
+    if not traces:
+        print(f"no *.trace.json.gz under {args.path}", file=sys.stderr)
+        return 1
+
+    # Summarize PER capture file: pid namespaces are file-local (every
+    # capture calls its device track pid 1), so concatenating events
+    # would merge runs and double-count same-named ops. With more than
+    # one capture, tracks are qualified by their run directory.
+    summary: dict = {}
+    for t in traces:
+        per = summarize(load_events(t))
+        run = os.path.basename(os.path.dirname(t))
+        for track, rows in per.items():
+            key = f"{run}:{track}" if len(traces) > 1 else track
+            summary[key] = rows
+    if not summary:
+        print("trace holds no complete events", file=sys.stderr)
+        return 1
+
+    device = {k: v for k, v in summary.items() if is_device_track(k)}
+    host = {k: v for k, v in summary.items() if not is_device_track(k)}
+
+    if args.json:
+        out = {
+            "traces": traces,
+            "tracks": {
+                track: [
+                    {"name": n, "total_us": round(tot, 1), "count": c}
+                    for n, tot, c in rows[:args.top]
+                ]
+                for track, rows in {**device, **host}.items()
+            },
+        }
+        print(json.dumps(out))
+        return 0
+
+    def show(track: str, rows) -> None:
+        track_total = sum(tot for _, tot, _ in rows)
+        print(f"\n== {track}  (sum {track_total / 1e3:.2f} ms over "
+              f"{len(rows)} op names)")
+        width = max((len(n[:60]) for n, _, _ in rows[:args.top]),
+                    default=4)
+        for name, tot, cnt in rows[:args.top]:
+            pct = 100.0 * tot / track_total if track_total else 0.0
+            print(f"  {name[:60]:<{width}}  {tot / 1e3:9.3f} ms "
+                  f"{pct:5.1f}%  x{cnt}")
+
+    if device:
+        for track, rows in device.items():
+            show(track, rows)
+    else:
+        print("(no device track found — host-only capture)")
+    if args.all_tracks or not device:
+        for track, rows in host.items():
+            show(track, rows)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        rc = main()
+    except BrokenPipeError:  # `| head` closing the pipe is not an error
+        # Point stdout at devnull so the interpreter's shutdown flush
+        # doesn't raise a second BrokenPipeError (exit 120 otherwise).
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        rc = 0
+    sys.exit(rc)

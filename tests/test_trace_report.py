@@ -1,0 +1,129 @@
+"""scripts/trace_report.py — the trace-to-numbers tool the headroom work
+reads. Input format pinned by a synthetic Chrome-trace capture; ranking,
+track split, and JSON mode asserted."""
+
+import gzip
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).parent.parent
+
+
+def _write_trace(path: Path, events) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with gzip.open(path, "wt") as f:
+        json.dump({"displayTimeUnit": "ns", "traceEvents": events}, f)
+
+
+def _fixture(tmp_path: Path) -> Path:
+    # Layout mirrors jax.profiler: DIR/plugins/profile/<run>/*.trace.json.gz
+    tdir = tmp_path / "trace"
+    events = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 1, "tid": 7, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": 2, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        # Device ops: fused_kernel dominates, two launches.
+        {"ph": "X", "pid": 1, "tid": 7, "name": "fused_full.3",
+         "ts": 0, "dur": 900.0},
+        {"ph": "X", "pid": 1, "tid": 7, "name": "fused_full.3",
+         "ts": 1000, "dur": 850.0},
+        {"ph": "X", "pid": 1, "tid": 7, "name": "dot.2",
+         "ts": 2000, "dur": 300.0},
+        # Host-side dispatch noise must not pollute the device ranking.
+        {"ph": "X", "pid": 2, "name": "ExecuteSharded", "ts": 0,
+         "dur": 5000.0},
+        # Non-complete events are ignored.
+        {"ph": "B", "pid": 1, "tid": 7, "name": "ignored", "ts": 0},
+    ]
+    _write_trace(tdir / "plugins" / "profile" / "run1" / "t.trace.json.gz",
+                 events)
+    return tdir
+
+
+def _run(*argv):
+    return subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "trace_report.py"),
+         *map(str, argv)],
+        capture_output=True, text=True, timeout=60,
+    )
+
+
+def test_ranks_device_ops_by_total_time(tmp_path):
+    proc = _run(_fixture(tmp_path))
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "/device:TPU:0:XLA Ops" in out
+    # fused_full (1750 us total, 2 launches) outranks dot (300 us).
+    assert out.index("fused_full.3") < out.index("dot.2")
+    assert "x2" in out and "1.750 ms" in out
+    # Host track hidden by default when a device track exists.
+    assert "ExecuteSharded" not in out
+    assert "ExecuteSharded" in _run(_fixture(tmp_path),
+                                    "--all-tracks").stdout
+
+
+def test_json_mode_is_machine_readable(tmp_path):
+    proc = _run(_fixture(tmp_path), "--json")
+    assert proc.returncode == 0, proc.stderr
+    data = json.loads(proc.stdout)
+    rows = data["tracks"]["/device:TPU:0:XLA Ops"]
+    assert rows[0]["name"] == "fused_full.3"
+    assert rows[0]["total_us"] == 1750.0 and rows[0]["count"] == 2
+
+
+def test_missing_dir_fails_cleanly(tmp_path):
+    proc = _run(tmp_path / "nope")
+    assert proc.returncode == 1
+    assert "no *.trace.json.gz" in proc.stderr
+
+
+def test_multiple_captures_keep_their_own_tracks(tmp_path):
+    """Two runs in one profile dir both use pid 1 for their device track;
+    the totals must NOT merge (they would double-count same-named ops)."""
+    tdir = _fixture(tmp_path)
+    second = (tdir / "plugins" / "profile" / "run2" / "t.trace.json.gz")
+    _write_trace(second, [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 1, "tid": 7, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "X", "pid": 1, "tid": 7, "name": "fused_full.3",
+         "ts": 0, "dur": 111.0},
+    ])
+    proc = _run(tdir, "--json")
+    assert proc.returncode == 0, proc.stderr
+    tracks = json.loads(proc.stdout)["tracks"]
+    assert "run1:/device:TPU:0:XLA Ops" in tracks
+    assert "run2:/device:TPU:0:XLA Ops" in tracks
+    assert tracks["run1:/device:TPU:0:XLA Ops"][0]["total_us"] == 1750.0
+    assert tracks["run2:/device:TPU:0:XLA Ops"][0]["total_us"] == 111.0
+
+
+def test_truncated_capture_warns_and_continues(tmp_path):
+    tdir = _fixture(tmp_path)
+    bad = tdir / "plugins" / "profile" / "run0" / "t.trace.json.gz"
+    bad.parent.mkdir(parents=True)
+    good_bytes = (tdir / "plugins" / "profile" / "run1" /
+                  "t.trace.json.gz").read_bytes()
+    bad.write_bytes(good_bytes[: len(good_bytes) // 2])
+    proc = _run(tdir, "--json")
+    assert proc.returncode == 0, proc.stderr
+    assert "skipping unreadable trace" in proc.stderr
+    assert "run1:/device:TPU:0:XLA Ops" in json.loads(proc.stdout)["tracks"]
+
+
+def test_closed_pipe_exits_clean(tmp_path):
+    """`trace_report DIR | head -1` must exit 0 with no 'Exception
+    ignored' shutdown noise (interpreter flush re-raising BrokenPipe)."""
+    script = (f"{sys.executable} {ROOT / 'scripts' / 'trace_report.py'} "
+              f"{_fixture(tmp_path)} --all-tracks | head -1")
+    proc = subprocess.run(["bash", "-c",
+                           f"set -o pipefail; {script}"],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, (proc.returncode, proc.stderr)
+    assert "BrokenPipeError" not in proc.stderr
