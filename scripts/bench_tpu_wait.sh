@@ -44,6 +44,7 @@ while true; do
     if [ -f "$OUT.partial.out" ]; then
       echo "[bench-tpu-wait] deadline reached; emitting the preserved" \
            "partial artifact" >&2
+      cp "$OUT.partial.out" "$OUT.partial.json" 2>/dev/null || true
       cat "$OUT.partial.out"
       exit 0
     fi
@@ -123,11 +124,15 @@ PY
       complete)
         echo "[bench-tpu-wait] complete artifact despite rc=$rc" \
              "(watchdog cut the tail); accepting -> $OUT.out" >&2
+        cp "$OUT.out" "$OUT.full.json" 2>/dev/null || true
         cat "$OUT.out"
         exit 0
         ;;
       partial)
         cp "$OUT.out" "$OUT.partial.out"
+        # Tracked copy immediately (not only at the deadline): a wrapper
+        # killed outright must still leave committable on-chip numbers.
+        cp "$OUT.out" "$OUT.partial.json" 2>/dev/null || true
         echo "[bench-tpu-wait] partial artifact preserved ->" \
              "$OUT.partial.out" >&2
         ;;
@@ -140,6 +145,11 @@ PY
   fi
   if [ "$rc" -eq 0 ]; then
     echo "[bench-tpu-wait] bench complete -> $OUT.out" >&2
+    # Also write a TRACKED copy: $OUT.out matches .gitignore's transient
+    # patterns, so a window that opens when nobody is watching would
+    # otherwise leave the round's only on-chip numbers uncommittable at
+    # the driver's end-of-round auto-commit.
+    cp "$OUT.out" "$OUT.full.json" 2>/dev/null || true
     cat "$OUT.out"
     exit 0
   fi
