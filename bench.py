@@ -1594,6 +1594,37 @@ def run_benchmarks(args, device_str: str) -> dict:
 
     section("accuracy", accuracy)
 
+    # -- segmented-tree kernel probe (SMPL-H): first Mosaic lowering of the
+    # generalized level layout must happen HERE with a recorded verdict,
+    # not in a user's hands — the spanning-range concats and per-wrist
+    # segments only existed under the interpreter until a chip ran this
+    # (the CLAUDE.md probe-every-compiled-path rule). Readback tail:
+    # it compares on host.
+    def smplh_tree_probe():
+        import dataclasses
+
+        from mano_hand_tpu import constants as C2
+        from mano_hand_tpu.assets import synthetic_params as synth
+
+        rig = dataclasses.replace(
+            synth(seed=13, n_verts=389, n_joints=52, n_shape=16,
+                  n_faces=700),
+            parents=C2.SMPLH_PARENTS,
+        ).astype(np.float32)
+        rngp = np.random.default_rng(6)
+        pose_s = jnp.asarray(
+            rngp.normal(scale=0.3, size=(8, 52, 3)), jnp.float32)
+        beta_s = jnp.asarray(rngp.normal(size=(8, 16)), jnp.float32)
+        want = core.forward_batched(rig, pose_s, beta_s).verts
+        got = core.forward_batched_pallas_fused_full(
+            rig, pose_s, beta_s, block_b=8, **ikw)
+        err = float(np.abs(np.asarray(got) - np.asarray(want)).max())
+        results["smplh_fused_full_max_err"] = err
+        log(f"SMPL-H segmented-tree fused-full kernel: max err {err:.3e} "
+            f"vs the staged path (52-joint rig{' , Mosaic' if is_tpu else ''})")
+
+    section("smplh_tree_probe", smplh_tree_probe)
+
     # -- config 5t: streaming tracker per-frame latency ---------------------
     def config5_track():
         # Online (causal) tracking: one warm-started LM solve per frame —

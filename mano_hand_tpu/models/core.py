@@ -506,8 +506,9 @@ def forward_batched_pallas_fused_full(
     blendshapes and skinning all run per batch tile in VMEM
     (ops/pallas_forward.py:forward_verts_fused_full) — no XLA pre-stage,
     no r/t slab HBM round-trips. Inputs are just (pose, shape); returns
-    verts only. Differentiable via the shared hybrid VJP. Requires a
-    level-aligned kinematic tree (all MANO-family assets qualify).
+    verts only. Differentiable via the shared hybrid VJP. Any
+    topologically ordered kinematic tree lays out (level_layout splits
+    BFS levels into parent-aligned segments).
     """
     from mano_hand_tpu.ops import pallas_forward
 

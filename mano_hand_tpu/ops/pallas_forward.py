@@ -321,41 +321,48 @@ def forward_verts_fused(
 
 @functools.lru_cache(maxsize=None)
 def level_layout(parents: tuple):
-    """Static layout for lane-ordered FK: ``(perm, levels)``.
+    """Static layout for lane-ordered FK: ``(perm, segments)``.
 
     ``perm`` lists original joint indices in [root, level1, level2, ...]
-    order; ``levels`` holds ``(start, size, parent_start, parent_size)``
-    lane ranges into the permuted order (parent_size == 1 broadcasts the
-    shared parent; == size pairs one-to-one). Raises for trees where a
-    level's parents are not exactly the previous level (or one shared
-    joint) in order — callers fall back to the XLA-pre-stage kernel.
+    order; ``segments`` holds ``(start, size, parent_start, parent_size)``
+    lane ranges into the permuted order (``parent_size == 1`` broadcasts
+    a shared parent; ``== size`` pairs one-to-one with a consecutive
+    parent run). Parent positions are ABSOLUTE lanes into the
+    accumulated permuted order, so ANY topologically ordered tree lays
+    out: each BFS level is greedily split into shared-parent or
+    consecutive-parent segments (SMPL-H's two hands hanging off the two
+    mid-tree wrists become separate per-wrist segments). MANO-family
+    trees emit exactly one whole-level segment per level — the layout,
+    and therefore the compiled kernel, is unchanged for them.
     """
     from mano_hand_tpu.ops import fk
 
-    levels_orig = fk.tree_levels(tuple(parents))
+    parents = tuple(parents)
+    levels_orig = fk.tree_levels(parents)
     perm = [0]
-    prev = [0]
-    prev_start = 0
-    out_levels = []
+    pos = {0: 0}
+    segments = []
     for lv in levels_orig:
-        order = sorted(lv, key=lambda j: (prev.index(parents[j]), j))
-        par_pos = [prev.index(parents[j]) for j in order]
-        start = len(perm)
-        if len(set(par_pos)) == 1:
-            pinfo = (prev_start + par_pos[0], 1)
-        elif par_pos == list(range(len(prev))) and len(order) == len(prev):
-            pinfo = (prev_start, len(prev))
-        else:
-            raise ValueError(
-                "kinematic tree is not level-aligned (parents of a level "
-                "must be one shared joint or exactly the previous level "
-                "in order); use the XLA-pre-stage fused kernel instead"
-            )
-        out_levels.append((start, len(order), *pinfo))
-        perm.extend(order)
-        prev = order
-        prev_start = start
-    return tuple(perm), tuple(out_levels)
+        order = sorted(lv, key=lambda j: (pos[parents[j]], j))
+        ppos = [pos[parents[j]] for j in order]
+        i = 0
+        while i < len(order):
+            start = len(perm)
+            k = i + 1
+            if k < len(order) and ppos[k] == ppos[i]:
+                while k < len(order) and ppos[k] == ppos[i]:
+                    k += 1
+                pinfo = (ppos[i], 1)  # shared parent, broadcasts
+            else:
+                while k < len(order) and ppos[k] == ppos[k - 1] + 1:
+                    k += 1
+                pinfo = (ppos[i], 1 if k - i == 1 else k - i)
+            for j_ in order[i:k]:
+                pos[j_] = len(perm)
+                perm.append(j_)
+            segments.append((start, k - i, *pinfo))
+            i = k
+    return tuple(perm), tuple(segments)
 
 
 def fused_full_operands(params: ManoParams, precision=DEFAULT_PRECISION):
@@ -444,32 +451,45 @@ def _rodrigues_slabs(x, y, z):
     )
 
 
+def _slice_parts(parts, bounds, lo, hi):
+    """[lo, hi) lane range out of an accumulated parts list.
+
+    A range inside one part is a plain slice (the only case MANO-family
+    trees hit — their parent runs never span segments, so the compiled
+    program is identical to the pre-generalization layout); a spanning
+    range concatenates just the covering pieces.
+    """
+    segs = []
+    for arr, b in zip(parts, bounds):
+        e = b + arr.shape[1]
+        if e <= lo or b >= hi:
+            continue
+        segs.append(arr[:, max(lo - b, 0):min(hi, e) - b])
+    return segs[0] if len(segs) == 1 else jnp.concatenate(segs, axis=1)
+
+
 def _fk_slabs(r_local, jx, jy, jz, levels):
     """Level-parallel FK on lane slabs; returns (world_rot 9-tuple,
     skin_t 3-tuple), each [TB, J] in permuted joint order.
 
-    Each level's compose is elementwise on contiguous, parent-aligned
-    lane slices (see level_layout) — concat accumulates the result, no
+    Each segment's compose is elementwise on contiguous, parent-aligned
+    lane slices (see level_layout; parent positions are absolute lanes
+    into the accumulated order) — concat accumulates the result, no
     scatters. Equivalent to ops.fk.forward_kinematics +
     skinning_transforms (mano_np.py:96-110 semantics).
     """
-    jroot = [jx[:, 0:1], jy[:, 0:1], jz[:, 0:1]]
     jslab = (jx, jy, jz)
     parts_r = [[r[:, 0:1]] for r in r_local]   # 9 lists of lane chunks
-    parts_t = [[jroot[0]], [jroot[1]], [jroot[2]]]
-    prev_r = [r[:, 0:1] for r in r_local]
-    prev_t = jroot
-    prev_j = jroot
-    prev_start = 0
+    parts_t = [[jx[:, 0:1]], [jy[:, 0:1]], [jz[:, 0:1]]]
+    bounds = [0]  # start lane of each accumulated part
     for (st, sz, pst, psz) in levels:
-        # Parent slab: the (pst, psz) lane range RELATIVE to the previous
-        # level's slabs — width sz (one-to-one) or 1 (shared parent,
-        # broadcasts; the shared joint may sit anywhere in the previous
-        # level, hence the explicit offset rather than the whole slab).
-        rel = pst - prev_start
-        pr = [r[:, rel:rel + psz] for r in prev_r]
-        pt = [t[:, rel:rel + psz] for t in prev_t]
-        pj = [c[:, rel:rel + psz] for c in prev_j]
+        # Parent slab: the (pst, psz) ABSOLUTE lane range out of the
+        # accumulated parts — width sz (one-to-one) or 1 (shared parent,
+        # broadcasts). Rest-joint coords slice from the full [TB, J]
+        # slabs directly.
+        pr = [_slice_parts(p, bounds, pst, pst + psz) for p in parts_r]
+        pt = [_slice_parts(p, bounds, pst, pst + psz) for p in parts_t]
+        pj = [jslab[c][:, pst:pst + psz] for c in range(3)]
         rl = [r[:, st:st + sz] for r in r_local]
         jl = [jslab[c][:, st:st + sz] for c in range(3)]
         loc = [jl[c] - pj[c] for c in range(3)]
@@ -490,8 +510,7 @@ def _fk_slabs(r_local, jx, jy, jz, levels):
             parts_r[i].append(new_r[i])
         for a in range(3):
             parts_t[a].append(new_t[a])
-        prev_r, prev_t, prev_j = new_r, new_t, jl
-        prev_start = st
+        bounds.append(st)
     world_r = tuple(jnp.concatenate(ps, axis=1) for ps in parts_r)
     world_t = [jnp.concatenate(ps, axis=1) for ps in parts_t]
     # Inverse bind: skin_t = world_t - world_rot @ j_rest (fk.py:82-97).
@@ -633,8 +652,10 @@ def forward_verts_fused_full(
 
     Per-eval HBM input traffic is pose (48 f32 = 192 B) + shape
     (10 f32 = 40 B); the r/t slabs and blend coefficients of the split
-    pipeline never exist in HBM. Requires a level-aligned kinematic tree (all MANO-family
-    assets); ``level_layout`` raises otherwise.
+    pipeline never exist in HBM. Any topologically ordered kinematic
+    tree lays out (``level_layout`` splits levels into parent-aligned
+    segments; MANO-family trees compile identically to the whole-level
+    layout, SMPL-H's per-wrist hand chains become extra segments).
 
     LOCKSTEP: the launch scaffolding below (operand prep, padding,
     BlockSpecs, HIGH-path split) is deliberately mirrored line for line

@@ -116,3 +116,53 @@ def test_fitting_recovers_pose_on_generic_body(body32):
     ).verts
     init_loss = float(((zero - targets) ** 2).mean())
     assert float(np.asarray(res.final_loss).mean()) < init_loss * 1e-2
+
+
+# The real SMPL-H tree: 22 body joints, then two whole hands hanging off
+# DIFFERENT mid-tree parents (the wrists) — the widest and least
+# level-aligned rig in the SMPL family.
+from mano_hand_tpu.constants import SMPLH_PARENTS  # noqa: E402
+
+
+def test_smplh_scale_52_joint_rig():
+    """SMPL-H scale: 52 joints (22 body + 2 x 15 fingers) on the REAL
+    SMPL-H tree. Oracle parity through the generic core, BOTH fused
+    kernels (the full-fusion level layout splits the two per-wrist hand
+    chains into parent-aligned segments), and LM at 169 solve dims."""
+    import dataclasses
+
+    rig64 = dataclasses.replace(
+        synthetic_params(seed=13, n_verts=389, n_joints=52, n_shape=16,
+                         n_faces=700),
+        parents=SMPLH_PARENTS,
+    )
+    rig = rig64.astype(np.float32)
+    rng = np.random.default_rng(6)
+    pose = rng.normal(scale=0.3, size=(3, 52, 3)).astype(np.float32)
+    beta = rng.normal(size=(3, 16)).astype(np.float32)
+
+    out = core.forward_batched(rig, jnp.asarray(pose), jnp.asarray(beta))
+    for i in range(3):
+        want = oracle.forward(rig64, pose=pose[i], shape=beta[i]).verts
+        assert np.abs(np.asarray(out.verts[i]) - want).max() < TOL
+
+    got = pallas_forward.forward_verts_fused(
+        rig, jnp.asarray(pose), jnp.asarray(beta), block_b=4,
+        interpret=True,
+    )
+    assert np.abs(np.asarray(got) - np.asarray(out.verts)).max() < TOL
+
+    got_full = pallas_forward.forward_verts_fused_full(
+        rig, jnp.asarray(pose), jnp.asarray(beta), block_b=4,
+        interpret=True,
+    )
+    assert np.abs(np.asarray(got_full) - np.asarray(out.verts)).max() < TOL
+
+    # LM recovers the pose at this scale too ((J-1)*3 + S = 169 dims).
+    from mano_hand_tpu.fitting import fit_lm
+
+    target = out.verts[:1]
+    res = fit_lm(rig, target, n_steps=12)
+    err = float(jnp.abs(core.forward_batched(
+        rig, res.pose, res.shape).verts - target).max())
+    assert err < TOL

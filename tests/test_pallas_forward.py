@@ -276,18 +276,26 @@ def test_full_fusion_chunked_route(params32):
     assert np.abs(np.asarray(got) - np.asarray(want)).max() < TOL
 
 
-def test_level_layout_mano_and_rejection():
+def test_level_layout_mano_and_segment_split():
     from mano_hand_tpu.constants import MANO_PARENTS
 
     perm, levels = pallas_forward.level_layout(tuple(MANO_PARENTS))
     assert perm[0] == 0 and sorted(perm) == list(range(16))
+    # MANO stays the whole-level layout: one segment per level — the
+    # generalization must not change the compiled MANO program.
     assert [lv[1] for lv in levels] == [5, 5, 5]
     # L1 shares the root parent (broadcast); deeper levels pair 1:1.
     assert levels[0][3] == 1 and levels[1][3] == 5
-    # Two level-2 parents but three level-2 joints (1 has two children,
-    # 2 has one): neither one-shared-parent nor one-to-one — rejected.
-    with pytest.raises(ValueError, match="level-aligned"):
-        pallas_forward.level_layout((-1, 0, 0, 1, 2, 1))
+    assert levels == ((1, 5, 0, 1), (6, 5, 1, 5), (11, 5, 6, 5))
+
+    # Two level-2 parents with uneven child counts (1 has two children,
+    # 2 has one): neither one-shared-parent nor one-to-one as a whole —
+    # the level SPLITS into a broadcast segment and a singleton.
+    perm2, segs2 = pallas_forward.level_layout((-1, 0, 0, 1, 2, 1))
+    assert sorted(perm2) == list(range(6))
+    # perm: [0, 1, 2, {3,5}(parent 1), 4(parent 2)]
+    assert perm2 == (0, 1, 2, 3, 5, 4)
+    assert segs2 == ((1, 2, 0, 1), (3, 2, 1, 1), (5, 1, 2, 1))
 
 
 def test_full_fusion_shared_parent_inside_wide_level():
