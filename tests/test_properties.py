@@ -1,0 +1,134 @@
+"""Hypothesis property tests on the math core.
+
+SURVEY.md §4's test plan calls for property tests beyond fixed fixtures;
+these randomize the INPUT STRUCTURE itself — arbitrary kinematic trees
+for the segmented level layout (the round-5 generalization), rotation
+group laws for the Rodrigues path — so the invariants hold everywhere,
+not just on the MANO tree the fixtures pin.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from mano_hand_tpu.ops import fk, pallas_forward, rodrigues
+
+
+# -- strategies -------------------------------------------------------------
+
+@st.composite
+def topo_trees(draw, max_joints=24):
+    """A random topologically ordered parent tuple (parents[i] < i)."""
+    n = draw(st.integers(min_value=2, max_value=max_joints))
+    parents = [-1]
+    for i in range(1, n):
+        parents.append(draw(st.integers(min_value=0, max_value=i - 1)))
+    return tuple(parents)
+
+
+# -- segmented level layout + slab FK ---------------------------------------
+
+@given(tree=topo_trees(), seed=st.integers(0, 2**31 - 1))
+@settings(max_examples=40, deadline=None)
+def test_layout_invariants_on_any_tree(tree, seed):
+    """Structural invariants of the segmented layout: a permutation with
+    root first; segments tile the non-root lanes exactly once, in order;
+    every child's parent lane (broadcast or consecutive) is the lane its
+    parent was placed at — on ANY topologically ordered tree."""
+    perm, segments = pallas_forward.level_layout(tree)
+    n = len(tree)
+    assert perm[0] == 0 and sorted(perm) == list(range(n))
+    pos = {j: i for i, j in enumerate(perm)}
+    covered = []
+    for (st_, sz, pst, psz) in segments:
+        assert psz in (1, sz)
+        covered.extend(range(st_, st_ + sz))
+        for k in range(sz):
+            child = perm[st_ + k]
+            want_parent_lane = pst if psz == 1 else pst + k
+            assert pos[tree[child]] == want_parent_lane
+            assert want_parent_lane < st_  # parents strictly earlier
+    assert covered == list(range(1, n))
+
+
+@given(tree=topo_trees(max_joints=16), seed=st.integers(0, 2**31 - 1))
+@settings(max_examples=25, deadline=None)
+def test_fk_slabs_match_reference_fk_on_any_tree(tree, seed):
+    """The kernel's slab FK (segment compose + parts slicing) equals the
+    array-form reference FK + inverse bind on random trees and poses —
+    the numeric half of the segmented-layout guarantee."""
+    n = len(tree)
+    rng = np.random.default_rng(seed)
+    aa = rng.normal(scale=0.6, size=(2, n, 3)).astype(np.float32)
+    joints = rng.normal(scale=0.1, size=(n, 3)).astype(np.float32)
+
+    perm, segments = pallas_forward.level_layout(tree)
+    permv = np.asarray(perm)
+    aa_p = aa[:, permv, :]
+    j_p = joints[permv]
+
+    r_local = pallas_forward._rodrigues_slabs(
+        jnp.asarray(aa_p[:, :, 0]), jnp.asarray(aa_p[:, :, 1]),
+        jnp.asarray(aa_p[:, :, 2]))
+    jx = jnp.broadcast_to(jnp.asarray(j_p[:, 0]), (2, n))
+    jy = jnp.broadcast_to(jnp.asarray(j_p[:, 1]), (2, n))
+    jz = jnp.broadcast_to(jnp.asarray(j_p[:, 2]), (2, n))
+    world_r, skin_t = pallas_forward._fk_slabs(r_local, jx, jy, jz,
+                                               segments)
+
+    for b in range(2):
+        rot = rodrigues.rotation_matrix(jnp.asarray(aa[b]))
+        wrot, wt = fk.forward_kinematics(tree, rot, jnp.asarray(joints))
+        # Inverse bind (fk.skinning_transforms semantics).
+        want_skin_t = np.asarray(wt) - np.einsum(
+            "jab,jb->ja", np.asarray(wrot), joints)
+        got_rot = np.stack(
+            [np.asarray(world_r[i][b]) for i in range(9)], axis=0
+        ).reshape(3, 3, n).transpose(2, 0, 1)[np.argsort(permv)]
+        got_t = np.stack(
+            [np.asarray(skin_t[a][b]) for a in range(3)], axis=1
+        )[np.argsort(permv)]
+        np.testing.assert_allclose(got_rot, np.asarray(wrot)[...],
+                                   atol=2e-6)
+        np.testing.assert_allclose(got_t, want_skin_t, atol=2e-6)
+
+
+# -- rotation group laws ----------------------------------------------------
+
+@given(st.integers(0, 2**31 - 1), st.floats(1e-6, 3.0))
+@settings(max_examples=40, deadline=None)
+def test_rodrigues_is_a_rotation(seed, scale):
+    rng = np.random.default_rng(seed)
+    aa = jnp.asarray(rng.normal(scale=scale, size=(4, 3)), jnp.float32)
+    R = np.asarray(rodrigues.rotation_matrix(aa))
+    eye = np.broadcast_to(np.eye(3, dtype=np.float32), R.shape)
+    np.testing.assert_allclose(R @ R.transpose(0, 2, 1), eye, atol=1e-5)
+    np.testing.assert_allclose(np.linalg.det(R), 1.0, atol=1e-5)
+
+
+@given(st.integers(0, 2**31 - 1))
+@settings(max_examples=25, deadline=None)
+def test_rodrigues_log_round_trip(seed):
+    """exp(log(R)) == R for rotations away from the pi boundary."""
+    rng = np.random.default_rng(seed)
+    aa = rng.normal(size=(4, 3)).astype(np.float32)
+    norm = np.linalg.norm(aa, axis=-1, keepdims=True)
+    aa = aa / np.maximum(norm, 1e-9) * np.minimum(norm, 2.8)
+    R = rodrigues.rotation_matrix(jnp.asarray(aa))
+    back = rodrigues.rotation_matrix(rodrigues.axis_angle_from_matrix(R))
+    np.testing.assert_allclose(np.asarray(back), np.asarray(R), atol=1e-4)
+
+
+@given(st.integers(0, 2**31 - 1))
+@settings(max_examples=15, deadline=None)
+def test_rodrigues_gradients_finite_near_zero(seed):
+    rng = np.random.default_rng(seed)
+    tiny = jnp.asarray(rng.normal(scale=1e-7, size=(3,)), jnp.float32)
+
+    g = jax.grad(lambda a: rodrigues.rotation_matrix(a[None])[0].sum())(
+        tiny)
+    assert np.isfinite(np.asarray(g)).all()
+    g0 = jax.grad(lambda a: rodrigues.rotation_matrix(a[None])[0].sum())(
+        jnp.zeros(3, jnp.float32))
+    assert np.isfinite(np.asarray(g0)).all()
