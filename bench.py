@@ -1601,6 +1601,8 @@ def run_benchmarks(args, device_str: str) -> dict:
     # (the CLAUDE.md probe-every-compiled-path rule). Readback tail:
     # it compares on host.
     def smplh_tree_probe():
+        if not (is_tpu or args.pallas_interpret):
+            return  # Mosaic path needs a TPU; CPU runs use --pallas-interpret
         import dataclasses
 
         from mano_hand_tpu import constants as C2
