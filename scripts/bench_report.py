@@ -123,6 +123,22 @@ def main() -> int:
           f"{detail.get('config6_depth_renders_per_sec')} renders/s, "
           f"mask fit {detail.get('config6_sil_fit_steps_per_sec')} steps/s")
 
+    smplh = detail.get("smplh_fused_full_max_err")
+    if smplh is not None:
+        # Present only when the segmented-tree kernel actually compiled
+        # (TPU or interpreter lane) — then it must meet the same 1e-4 gate
+        # as every other compiled path.
+        check("smplh_tree_gate", smplh < 1e-4,
+              f"segmented-tree (SMPL-H) fused-full max err {smplh:.3e}")
+
+    hands = detail.get("config3_fused_full_hands_evals_per_sec")
+    if hands is not None and headline:
+        # r4 verdict item 4: the first on-chip number decides whether the
+        # two-hand single-launch kernel becomes the two-hand default.
+        print(f"  [info] config3e two-hand single launch: {hands:,.0f} "
+              f"evals/s ({hands / headline - 1:+.1%} vs headline) — "
+              "default-decision data")
+
     bf16 = detail.get("config4_lm_bf16_steps_per_sec")
     if bf16 is not None and lm:
         # Decision data for flipping fit_lm's normal_eq default: speedup
