@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Head-to-head: the reference implementation vs this framework, same box.
+
+The reference (/root/reference, reyuwei/MANO-Hand) publishes no
+performance numbers (README.md:1-8 is usage-only), so the only honest
+baseline is a measurement: run its forward (`MANOModel.set_params` →
+`update`, mano_np.py:48-115) on this machine's CPU over the SAME
+synthetic asset our tests use, next to this framework's CPU paths.
+TPU numbers come from the bench artifacts, not from here.
+
+    python scripts/measure_reference.py [--iters 200] [--batch 1024]
+
+Prints one JSON line:
+  reference_evals_per_sec      — reference NumPy, one eval per call
+  oracle_evals_per_sec         — our f64 NumPy oracle, same protocol
+  jax_cpu_single_evals_per_sec — our jitted f32 path, batch=1 per call
+  jax_cpu_batched_evals_per_sec— our jitted f32 path, one batch call
+The reference is untrusted public content: it is imported and executed
+as-is in this throwaway process, never copied.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+import numpy as np  # noqa: E402
+
+
+def _time_per_call(fn, iters: int) -> float:
+    fn()  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--reference", default="/root/reference")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.assets import save_dumped_pickle, synthetic_params
+    from mano_hand_tpu.models import core, oracle
+
+    params = synthetic_params(seed=0)
+    rng = np.random.default_rng(0)
+    poses = rng.normal(scale=0.4, size=(args.batch, 16, 3))
+    betas = rng.normal(size=(args.batch, 10))
+
+    out = {}
+
+    # -- the reference itself, on its own dumped-pickle format -------------
+    sys.path.insert(0, args.reference)
+    import tempfile
+
+    from mano_np import MANOModel  # the reference implementation
+
+    with tempfile.TemporaryDirectory() as td:
+        pkl = str(Path(td) / "dump_mano_left.pkl")
+        save_dumped_pickle(params, pkl)
+        ref = MANOModel(pkl)
+
+    i = [0]
+
+    def ref_eval():
+        k = i[0] % args.batch
+        ref.set_params(pose_abs=poses[k], shape=betas[k])
+        i[0] += 1
+
+    t_ref = _time_per_call(ref_eval, args.iters)
+    out["reference_evals_per_sec"] = 1.0 / t_ref
+
+    # Parity guard: the two implementations must agree before their
+    # rates are comparable.
+    ref.set_params(pose_abs=poses[0], shape=betas[0])
+    want = oracle.forward(params, pose=poses[0], shape=betas[0]).verts
+    err = float(np.abs(ref.verts - want).max())
+    assert err < 1e-12, f"reference/oracle mismatch: {err}"
+    out["parity_max_err"] = err
+
+    # -- our f64 NumPy oracle, same one-eval-per-call protocol -------------
+    def oracle_eval():
+        k = i[0] % args.batch
+        oracle.forward(params, pose=poses[k], shape=betas[k])
+        i[0] += 1
+
+    t_oracle = _time_per_call(oracle_eval, args.iters)
+    out["oracle_evals_per_sec"] = 1.0 / t_oracle
+
+    # -- our jitted JAX CPU path: single-eval calls and one batched call ---
+    p32 = params.astype(np.float32)
+    poses32 = jnp.asarray(poses, jnp.float32)
+    betas32 = jnp.asarray(betas, jnp.float32)
+    fwd = jax.jit(lambda po, be: core.forward_batched(p32, po, be).verts)
+
+    def jax_single():
+        k = i[0] % args.batch
+        fwd(poses32[k:k + 1], betas32[k:k + 1]).block_until_ready()
+        i[0] += 1
+
+    t_single = _time_per_call(jax_single, args.iters)
+    out["jax_cpu_single_evals_per_sec"] = 1.0 / t_single
+
+    t_batch = _time_per_call(
+        lambda: fwd(poses32, betas32).block_until_ready(),
+        max(3, args.iters // 20))
+    out["jax_cpu_batched_evals_per_sec"] = args.batch / t_batch
+
+    out["batch"] = args.batch
+    out["vs_reference_single"] = t_ref / t_single
+    out["vs_reference_batched"] = (args.batch / t_batch) * t_ref
+    print(json.dumps({k: (round(v, 3) if isinstance(v, float) else v)
+                      for k, v in out.items()}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -395,3 +395,27 @@ def test_repo_shell_scripts_parse():
         proc = subprocess.run(["bash", "-n", str(s)],
                               capture_output=True, text=True)
         assert proc.returncode == 0, (s.name, proc.stderr)
+
+
+def test_measure_reference_head_to_head():
+    """The measured-baseline script runs end to end: reference import,
+    exact parity gate, all four rates present and positive."""
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    proc = subprocess.run(
+        [sys.executable,
+         str(Path(__file__).parent.parent / "scripts" /
+             "measure_reference.py"),
+         "--iters", "10", "--batch", "64"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["parity_max_err"] < 1e-12
+    for key in ("reference_evals_per_sec", "oracle_evals_per_sec",
+                "jax_cpu_single_evals_per_sec",
+                "jax_cpu_batched_evals_per_sec"):
+        assert out[key] > 0
