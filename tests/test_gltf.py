@@ -372,3 +372,29 @@ def test_cli_animate_skinned(params32, tmp_path, capsys):
     assert "JOINTS_0" in prim["attributes"]
     assert "targets" not in prim          # rotations, not morphs
     assert len(g["animations"][0]["channels"]) == 16
+
+
+def test_skinned_glb_for_body_model(tmp_path):
+    """Skinned glTF export is model-family generic: a 24-joint SMPL-scale
+    body exports a valid skinned GLB (24 joint nodes, IBMs, weights)."""
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.assets import synthetic_params
+    from mano_hand_tpu.io.gltf import export_glb_skinned, read_glb
+    from mano_hand_tpu.models import core
+
+    body = synthetic_params(seed=4, n_verts=437, n_joints=24, n_shape=16,
+                            n_faces=870).astype(np.float32)
+    rng = np.random.default_rng(0)
+    clip = rng.normal(scale=0.2, size=(3, 24, 3)).astype(np.float32)
+    rest = core.forward(body, jnp.zeros((24, 3), jnp.float32),
+                        jnp.zeros(16, jnp.float32))
+    out = tmp_path / "body.glb"
+    export_glb_skinned(np.asarray(rest.verts), np.asarray(body.faces),
+                       np.asarray(rest.joints), body.parents,
+                       np.asarray(body.lbs_weights), str(out),
+                       pose_frames=clip)
+    assert out.exists() and out.stat().st_size > 0
+    doc = read_glb(str(out))["gltf"]
+    # One node per joint (+ mesh/root scaffolding), a skin with 24 joints.
+    assert len(doc["skins"][0]["joints"]) == 24
