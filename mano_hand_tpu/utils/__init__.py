@@ -1,4 +1,6 @@
 from mano_hand_tpu.utils.config import ManoConfig
+from mano_hand_tpu.utils.data import batches, prefetch_to_device
 from mano_hand_tpu.utils.profiling import Timer, time_jax_fn, xla_trace
 
-__all__ = ["ManoConfig", "Timer", "time_jax_fn", "xla_trace"]
+__all__ = ["ManoConfig", "Timer", "batches", "prefetch_to_device",
+           "time_jax_fn", "xla_trace"]

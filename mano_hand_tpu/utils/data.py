@@ -1,0 +1,113 @@
+"""Input pipeline: epoch batching and ahead-of-time device prefetch.
+
+The reference has no data loading at all (its only dataset loop is the
+serial Python iteration of /root/reference/data_explore.py:12-15). On
+TPU the input pattern that matters is *overlap*: while the chip runs
+step N, the host should already be shipping batch N+1, so dispatch
+never waits on a host->device copy. These helpers are the standard JAX
+recipe for that, shaped for this framework's (pose, shape, target)
+arrays and composable with the mesh shardings in ``parallel``:
+
+    from mano_hand_tpu.utils.data import batches, prefetch_to_device
+
+    it = prefetch_to_device(
+        batches({"pose": poses, "target": verts}, batch_size=256,
+                shuffle=True, seed=0),
+        size=2,                                  # batches in flight
+        sharding=parallel.batch_sharding(mesh),  # optional: shard as shipped
+    )
+    for batch in it:
+        state, loss = step(state, batch["target"])
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterable, Iterator, Mapping, Optional
+
+import jax
+import numpy as np
+
+
+def batches(
+    arrays: Mapping[str, np.ndarray],
+    batch_size: int,
+    shuffle: bool = False,
+    seed: int = 0,
+    drop_remainder: bool = True,
+    epochs: int = 1,
+) -> Iterator[dict]:
+    """Slice a dict of equal-leading-dim arrays into per-epoch batches.
+
+    ``drop_remainder=True`` keeps every batch the same static shape — on
+    TPU a ragged tail batch is a fresh XLA compile, which costs more
+    than the dropped samples (pad upstream if every sample matters).
+    ``epochs`` repeats with a fresh shuffle order each epoch (seeded:
+    identical runs see identical order).
+    """
+    # Validate HERE, not in the generator body: a generator defers its
+    # body to first next(), which would surface call-site mistakes deep
+    # inside the consumer (e.g. mid-prefetch) instead of at the call.
+    if not arrays:
+        raise ValueError("batches() needs at least one array")
+    if batch_size < 1:
+        raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+    n = len(next(iter(arrays.values())))
+    for name, a in arrays.items():
+        if len(a) != n:
+            raise ValueError(
+                f"leading dims disagree: {name} has {len(a)}, expected {n}")
+    if n < batch_size and drop_remainder:
+        raise ValueError(
+            f"batch_size {batch_size} exceeds dataset size {n} and "
+            "drop_remainder would yield nothing")
+
+    def gen():
+        rng = np.random.default_rng(seed)
+        for _ in range(epochs):
+            order = rng.permutation(n) if shuffle else None
+            stop = n - batch_size + 1 if drop_remainder else n
+            for lo in range(0, stop, batch_size):
+                if order is None:
+                    # Plain slices are views — no per-batch host copy on
+                    # the sequential path.
+                    yield {k: a[lo:lo + batch_size]
+                           for k, a in arrays.items()}
+                else:
+                    idx = order[lo:lo + batch_size]
+                    yield {k: a[idx] for k, a in arrays.items()}
+
+    return gen()
+
+
+def prefetch_to_device(
+    iterator: Iterable,
+    size: int = 2,
+    sharding: Optional[jax.sharding.Sharding] = None,
+) -> Iterator:
+    """Keep ``size`` batches already ON DEVICE ahead of the consumer.
+
+    ``jax.device_put`` is async (it returns before the copy completes),
+    so enqueueing the next batches while the current step runs overlaps
+    H2D transfer with compute — the chip never idles on input. With a
+    ``sharding`` (e.g. ``parallel.batch_sharding(mesh)``) each batch
+    lands already sharded across the mesh, so the consuming ``pjit``
+    step starts without a layout change.
+
+    PyTrees pass through ``jax.device_put`` whole, so dict batches from
+    :func:`batches` keep their structure.
+    """
+    if size < 1:
+        raise ValueError(f"size must be >= 1, got {size}")
+    put = (lambda x: jax.device_put(x, sharding)) if sharding is not None \
+        else jax.device_put
+    queue: collections.deque = collections.deque()
+    it = iter(iterator)
+    try:
+        while True:
+            while len(queue) < size:
+                queue.append(put(next(it)))
+            yield queue.popleft()
+    except StopIteration:
+        while queue:
+            yield queue.popleft()
