@@ -1,0 +1,80 @@
+"""Bulk offline registration: a stored dataset of target meshes, fitted
+at throughput with the batched LM solver + the input pipeline.
+
+The mocap post-processing workflow: thousands of captured frames on
+disk, each needing (pose, shape) recovered — throughput matters, not
+single-frame latency. The pieces composing here:
+
+1. ``utils.data.batches`` slices the dataset into STATIC-shape batches
+   (one XLA program total — a ragged tail would be a recompile);
+2. ``utils.data.prefetch_to_device`` keeps the next batches' H2D copies
+   in flight while the chip solves the current one;
+3. ``fit_lm`` vmaps the damped Gauss-Newton solve across the batch —
+   every frame in a batch converges in the same ~15 steps.
+
+    python examples/20_bulk_registration.py [--platform cpu]
+"""
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default="")
+    ap.add_argument("--frames", type=int, default=512)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=15)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.assets import synthetic_params
+    from mano_hand_tpu.fitting import fit_lm
+    from mano_hand_tpu.models import core
+    from mano_hand_tpu.utils.data import batches, prefetch_to_device
+
+    params = synthetic_params(seed=0).astype(np.float32)
+
+    # The "captured dataset": target vertex clouds for random poses.
+    rng = np.random.default_rng(0)
+    true_pose = rng.normal(scale=0.3, size=(args.frames, 16, 3)).astype(
+        np.float32)
+    true_beta = rng.normal(scale=0.5, size=(args.frames, 10)).astype(
+        np.float32)
+    targets = np.asarray(core.jit_forward_batched(
+        params, jnp.asarray(true_pose), jnp.asarray(true_beta)).verts)
+    print(f"dataset: {args.frames} frames of [778, 3] targets "
+          f"({targets.nbytes / 2**20:.1f} MiB)")
+
+    # Fit every batch through ONE compiled LM program; prefetch keeps the
+    # next batch's transfer overlapped with the current solve.
+    t0 = time.perf_counter()
+    done = 0
+    worst = 0.0
+    for b in prefetch_to_device(
+            batches({"target": targets}, batch_size=args.batch), size=2):
+        res = fit_lm(params, b["target"], n_steps=args.steps)
+        verts = core.jit_forward_batched(params, res.pose, res.shape).verts
+        worst = max(worst, float(jnp.abs(verts - b["target"]).max()))
+        done += len(b["target"])
+    dt = time.perf_counter() - t0
+    print(f"fit {done} frames in {dt:.2f} s "
+          f"({done / dt:,.1f} frames/s, {args.steps} LM steps each); "
+          f"worst vertex error {worst * 1e3:.4f} mm")
+    assert worst < 1e-4
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
