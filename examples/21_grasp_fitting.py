@@ -1,0 +1,115 @@
+"""Hand-object grasp fitting: compose your own energy from the library's
+objective terms.
+
+The built-in solvers cover the common energies; when a workflow needs a
+custom one — here, a hand grasping a RIGID OBJECT — the pure functions
+compose directly into a jitted optax loop:
+
+    E(theta, beta) = keypoint attraction        (objectives.joint_l2)
+                   + object non-penetration     (objectives.inter_penetration
+                                                 vs the object point cloud)
+                   + pose prior                 (objectives.l2_prior)
+
+The object term is the two-hand repulsion reused verbatim: a hinge on
+hand-vertex-to-object-point distances inside a contact radius. Without
+it, the keypoint fit drives fingers THROUGH the object; with it, the
+hand wraps the surface (penetration drops orders of magnitude at
+millimeter-level keypoint cost).
+
+    python examples/21_grasp_fitting.py [--platform cpu]
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default="")
+    ap.add_argument("--steps", type=int, default=300)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    import optax
+
+    from mano_hand_tpu.assets import synthetic_params
+    from mano_hand_tpu.fitting import objectives
+    from mano_hand_tpu.models import core
+
+    params = synthetic_params(seed=0).astype(np.float32)
+    rng = np.random.default_rng(21)
+
+    # The grasp target: a curled pose, keypoints observed with noise.
+    true_pose = np.zeros((16, 3), np.float32)
+    true_pose[1:, 0] = rng.uniform(0.3, 0.9, size=15)
+    truth = core.forward(params, jnp.asarray(true_pose),
+                         jnp.zeros(10, jnp.float32))
+    kp = np.array(core.keypoints(truth, "smplx"))
+    kp = kp + rng.normal(scale=1.5e-3, size=kp.shape).astype(np.float32)
+
+    # The rigid object: a small ball sitting against the palm — exactly
+    # where a naive keypoint fit pushes vertices through.
+    palm = np.asarray(truth.verts).mean(axis=0)
+    centre = palm + np.float32([0.0, 0.015, 0.012])
+    sph = rng.normal(size=(256, 3)).astype(np.float32)
+    sph /= np.linalg.norm(sph, axis=1, keepdims=True)
+    obj = jnp.asarray(centre + 0.012 * sph)   # r = 12 mm point cloud
+
+    contact_r = 0.004  # hinge radius: "skin thickness" of the contact
+
+    def penetration(verts):
+        return objectives.inter_penetration(verts, obj, radius=contact_r)
+
+    def energy(state, w_pen):
+        out = core.forward(params, state["pose"], state["shape"])
+        e_kp = objectives.joint_l2(
+            core.keypoints(out, "smplx"), jnp.asarray(kp))
+        return (e_kp + w_pen * penetration(out.verts)
+                + 1e-3 * objectives.l2_prior(state["shape"]))
+
+    def solve(w_pen):
+        opt = optax.adam(0.02)
+        state = {"pose": jnp.zeros((16, 3), jnp.float32),
+                 "shape": jnp.zeros(10, jnp.float32)}
+        opt_state = opt.init(state)
+
+        @jax.jit
+        def step(state, opt_state):
+            loss, g = jax.value_and_grad(
+                lambda s: energy(s, w_pen))(state)
+            updates, opt_state = opt.update(g, opt_state)
+            return optax.apply_updates(state, updates), opt_state, loss
+
+        for _ in range(args.steps):
+            state, opt_state, loss = step(state, opt_state)
+        out = core.forward(params, state["pose"], state["shape"])
+        kp_err = float(jnp.abs(
+            core.keypoints(out, "smplx") - jnp.asarray(kp)).max())
+        return out, kp_err
+
+    naive, kp_naive = solve(w_pen=0.0)
+    pen_naive = float(penetration(naive.verts))
+    grasp, kp_grasp = solve(w_pen=50.0)
+    pen_grasp = float(penetration(grasp.verts))
+
+    print(f"naive keypoint fit: kp err {kp_naive * 1e3:.2f} mm, "
+          f"object penetration energy {pen_naive:.2e}")
+    print(f"grasp fit (+object term): kp err {kp_grasp * 1e3:.2f} mm, "
+          f"object penetration energy {pen_grasp:.2e} "
+          f"({pen_naive / max(pen_grasp, 1e-12):.0f}x less)")
+    assert kp_grasp < 0.01
+    assert pen_grasp < pen_naive * 0.2 or pen_grasp < 1e-8
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

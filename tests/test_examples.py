@@ -42,6 +42,7 @@ def test_example_runs(script, tmp_path):
         "18_uncentered_scan_lm": ["--points", "200", "--steps", "12"],
         "20_bulk_registration": ["--frames", "64", "--batch", "32",
                                  "--steps", "8"],
+        "21_grasp_fitting": ["--steps", "200"],
     }.get(script.stem, [])
     out = _run(script, *extra, tmp_path=tmp_path)
     assert any(k in out for k in ("wrote", "fit", "tracked", "fused kernel",
