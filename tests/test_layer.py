@@ -207,3 +207,36 @@ def test_parity_with_reference_implementation(params, tmp_path):
 
 # Pre-commit quick lane: core correctness, seconds-scale (make check-quick).
 pytestmark = __import__("pytest").mark.quick
+
+
+def test_stateful_wrapper_on_body_model():
+    """MANOModel is model-family generic: a 24-joint body drives the
+    same stateful surface — set_params (abs + pass-through PCA), verts,
+    keypoint-free joint read, and .fit recovery."""
+    import dataclasses
+
+    from mano_hand_tpu.assets.synthetic import synthetic_params
+
+    body = synthetic_params(seed=6, n_verts=437, n_joints=24, n_shape=16,
+                            n_faces=870)
+    # Body assets carry the loader's pass-through PCA space (identity
+    # basis, zero mean — assets.load_smpl_pickle): coefficients ARE the
+    # leading articulated axis-angle dims.
+    body = dataclasses.replace(
+        body, pca_basis=np.eye(69), pca_mean=np.zeros(69))
+    m = MANOModel(body, backend="jax")
+    assert m.verts.shape == (437, 3)
+    rng = np.random.default_rng(0)
+    pose = rng.normal(scale=0.25, size=(24, 3))
+    verts = m.set_params(pose_abs=pose, shape=rng.normal(size=16))
+    assert verts.shape == (437, 3) and np.isfinite(verts).all()
+    assert m.J.shape == (24, 3)
+    # Pass-through PCA branch: coefficients ARE the articulated pose.
+    v2 = m.set_params(pose_pca=np.zeros(9), global_rot=np.zeros(3),
+                      shape=np.zeros(16))
+    np.testing.assert_allclose(
+        v2, MANOModel(body, backend="jax").verts, atol=1e-6)
+
+    target = np.asarray(verts)
+    m.fit(target, n_steps=12, solver="lm")  # adopts the solution in-state
+    assert np.abs(m.verts - target).max() < 1e-4
