@@ -132,3 +132,43 @@ def test_rodrigues_gradients_finite_near_zero(seed):
     g0 = jax.grad(lambda a: rodrigues.rotation_matrix(a[None])[0].sum())(
         jnp.zeros(3, jnp.float32))
     assert np.isfinite(np.asarray(g0)).all()
+
+
+# -- objective-term laws ----------------------------------------------------
+
+@given(st.integers(0, 2**31 - 1), st.floats(0.002, 0.05))
+@settings(max_examples=20, deadline=None)
+def test_inter_penetration_zero_iff_separated(seed, radius):
+    """The contact hinge is exactly zero once clouds are >= radius apart,
+    and strictly positive when any pair is inside the radius."""
+    from mano_hand_tpu.fitting import objectives
+
+    rng = np.random.default_rng(seed)
+    a_np = rng.normal(scale=0.02, size=(32, 3)).astype(np.float32)
+    a = jnp.asarray(a_np)
+    # True separation needs a shift beyond the cloud's own x-extent —
+    # a shift smaller than the diameter leaves cross pairs arbitrarily
+    # close.
+    span = float(a_np[:, 0].max() - a_np[:, 0].min())
+    far = a + jnp.asarray([span + 2.0 * radius, 0.0, 0.0], jnp.float32)
+    assert float(objectives.inter_penetration(a, far, radius)) == 0.0
+    touching = a + jnp.asarray([0.25 * radius, 0.0, 0.0], jnp.float32)
+    assert float(objectives.inter_penetration(a, touching, radius)) > 0.0
+
+
+@given(st.integers(0, 2**31 - 1))
+@settings(max_examples=20, deadline=None)
+def test_pose_limit_prior_zero_inside_box(seed):
+    """The anatomical hinge is zero everywhere inside [lo, hi] and grows
+    monotonically with the violation outside."""
+    from mano_hand_tpu.fitting import objectives
+
+    rng = np.random.default_rng(seed)
+    lo = jnp.asarray(-np.abs(rng.normal(size=45)), jnp.float32)
+    hi = jnp.asarray(np.abs(rng.normal(size=45)), jnp.float32)
+    inside = lo + (hi - lo) * jnp.asarray(
+        rng.uniform(size=45), jnp.float32)
+    assert float(objectives.pose_limit_prior(inside, lo, hi)) == 0.0
+    v1 = float(objectives.pose_limit_prior(hi + 0.1, lo, hi))
+    v2 = float(objectives.pose_limit_prior(hi + 0.3, lo, hi))
+    assert 0.0 < v1 < v2
