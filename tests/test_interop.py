@@ -318,3 +318,36 @@ def test_torch_layer_unbatched_and_rotmat(params32):
     verts_rm.sum().backward()
     assert np.isfinite(rot_t.grad.numpy()).all()
     assert float(rot_t.grad.abs().sum()) > 0.0
+
+
+def test_bridges_are_model_family_generic():
+    """torch AND flax bridges drive a 24-joint body rig unchanged: the
+    drop-in layers carry no hand constants."""
+    from mano_hand_tpu.assets.synthetic import synthetic_params
+    from mano_hand_tpu.interop import TorchManoLayer
+
+    body = synthetic_params(seed=8, n_verts=437, n_joints=24, n_shape=16,
+                            n_faces=870).astype(np.float32)
+
+    # torch: forward + gradients through the autograd.Function bridge.
+    layer = TorchManoLayer(body)
+    pose_t = torch.zeros((2, 24, 3), requires_grad=True)
+    beta_t = torch.zeros((2, 16), requires_grad=True)
+    verts_t, joints_t = layer(pose_t, beta_t)
+    assert verts_t.shape == (2, 437, 3) and joints_t.shape == (2, 24, 3)
+    want = core.forward_batched(body, jnp.zeros((2, 24, 3)),
+                                jnp.zeros((2, 16))).verts
+    np.testing.assert_allclose(verts_t.detach().numpy(),
+                               np.asarray(want), atol=1e-5)
+    verts_t.sum().backward()
+    assert torch.isfinite(pose_t.grad).all()
+    assert torch.isfinite(beta_t.grad).all()
+
+    # flax: the mesh head initializes and applies on the body rig.
+    head = ManoLayer(params=body)
+    rng = jax.random.PRNGKey(0)
+    pose_in = jnp.zeros((3, 24, 3), jnp.float32)
+    variables = head.init(rng, pose_in)
+    verts = head.apply(variables, pose_in)  # __call__ returns verts
+    assert verts.shape == (3, 437, 3)
+    assert np.isfinite(np.asarray(verts)).all()
