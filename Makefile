@@ -19,7 +19,8 @@
 # boundaries, which keeps the live count bounded and the suite green —
 # do not remove it. Also avoid two concurrent pytest processes on the
 # shared cache dir.
-.PHONY: check check-cold test bench-cpu bench-tpu-wait mesh-scaling
+.PHONY: check check-cold test bench-cpu bench-tpu-wait mesh-scaling \
+	check-quick serve-smoke
 
 check: test
 
@@ -27,9 +28,11 @@ test:
 	TF_CPP_MIN_LOG_LEVEL=3 python -m pytest tests/ -q
 
 # Seconds-scale pre-commit lane: the core-correctness modules (parity vs
-# the f64 oracle, assets/IO, golden demo, device lock). The FULL suite is
-# still the snapshot-commit gate; this lane catches core breakage between
-# snapshots without the ~17-minute wall (VERDICT r3 item 8).
+# the f64 oracle, assets/IO, golden demo, device lock, and the serving
+# engine's bucket/mask/recompile/AOT contracts — tests/test_serving.py is
+# quick-marked). The FULL suite is still the snapshot-commit gate; this
+# lane catches core breakage between snapshots without the ~17-minute
+# wall (VERDICT r3 item 8).
 check-quick:
 	TF_CPP_MIN_LOG_LEVEL=3 python -m pytest tests/ -q -m quick
 
@@ -59,7 +62,18 @@ bench-cpu:
 bench-interpret:
 	python bench.py --platform cpu --big-batch 512 --chunk 128 --iters 2 \
 	  --fit-steps 10 --pallas-sweep quick --pallas-interpret --skip-fit \
-	  --init-retries 2 --sil-size 16
+	  --init-retries 2 --sil-size 16 --serving-requests 64 \
+	  --serving-max-rows 16 --serving-max-bucket 32
+
+# Serving-leg smoke (the bench-interpret counterpart for config7): the
+# whole serving-engine plumbing — bucket warm-up, ragged request stream,
+# interleaved engine-vs-direct overhead ratio, recompile/padding
+# counters — on CPU at small sizes, emitting the one-line serving
+# artifact. `scripts/bench_report.py` applies the serving done-criteria
+# (ratio >= 0.9x, zero steady recompiles) to it.
+serve-smoke:
+	python bench.py --platform cpu --serving-only --serving-requests 96 \
+	  --serving-max-rows 16 --serving-max-bucket 32 --init-retries 2
 
 # Unattended BUILDER-side TPU bench: lockfile-guarded, stands down for the
 # driver's priority claim, and self-expires (default 3 h) — see

@@ -577,6 +577,8 @@ def run_benchmarks(args, device_str: str) -> dict:
         """Fault-isolate one config; a crash records an error, not a wipe."""
         if args.mesh_scaling_only and name != "mesh_scaling":
             return
+        if args.serving_only and name != "config7_serving":
+            return
         try:
             fn()
         except Exception as e:  # noqa: BLE001 — isolation is the point
@@ -1730,6 +1732,66 @@ def run_benchmarks(args, device_str: str) -> dict:
 
     section("config6_silhouette", config6_silhouette)
 
+    # -- config 7: the bucketed serving engine ------------------------------
+    # Engine-vs-direct throughput, steady-state recompile count, and
+    # padding waste for the micro-batching layer (serving/engine.py).
+    # Registered in the READBACK TAIL (after accuracy): the engine hands
+    # results back as host arrays, and the first D2H permanently degrades
+    # later axon dispatches — so it must never run before the timed
+    # sections. Wall-clock timing is the honest metric here: the engine
+    # IS the host+device pipeline (on the tunnel the per-batch sync
+    # overhead is part of what it amortizes), so slope-timing would
+    # measure the wrong thing. Everything except the absolute rate is
+    # meaningful on CPU (recompiles, waste, ratio) — the lane
+    # `make serve-smoke` and the bench-interpret run both exercise it.
+    def config7_serving():
+        # THE shared protocol (serving/measure.py:serve_bench_run — the
+        # same code path `mano serve-bench` prints): warm every bucket,
+        # settle, one timed ragged pass, then the fixed-warm-bucket
+        # overhead bound as a MEDIAN over interleaved engine/direct
+        # trials (background load on this box drifts 5x between
+        # seconds; a non-interleaved pass once read 0.12x from a spike).
+        from mano_hand_tpu.serving.measure import serve_bench_run
+
+        srv = serve_bench_run(
+            right,
+            requests=args.serving_requests,
+            max_rows=args.serving_max_rows,
+            max_bucket=args.serving_max_bucket,
+            seed=7,
+            log=lambda m: log(f"config7 {m}"),
+        )
+        results["serving"] = srv
+        log(f"config7 serving: engine {srv['engine_evals_per_sec']:,.0f} "
+            f"evals/s ragged, {srv['engine_fixed_evals_per_sec']:,.0f} "
+            f"fixed b={srv['warm_bucket']} vs direct "
+            f"{srv['direct_evals_per_sec']:,.0f} (ratio "
+            f"{srv['engine_vs_direct_ratio']:.2f}x, median "
+            f"{srv['ratio_median']:.2f} over trials "
+            f"{srv['ratio_trials']}), "
+            f"{srv['steady_recompiles']} steady recompiles, "
+            f"{srv['padding_waste']:.1%} padding waste")
+
+    section("config7_serving", config7_serving)
+
+    if args.serving_only:
+        # Fast serving-layer artifact (`make serve-smoke`): the deferred
+        # runner's serving-only skip reduces the schedule to config7.
+        for name, fn in _registered:
+            run_section(name, fn)
+        srv = results.get("serving", {})
+        line = {
+            "metric": "serving_engine_evals_per_sec",
+            "value": srv.get("engine_evals_per_sec"),
+            "unit": "evals/s",
+            "vs_baseline": None,
+            "device": device_str,
+            "detail": results,
+        }
+        if errors:
+            line["config_errors"] = errors
+        return line
+
     # -- memory high-water mark ---------------------------------------------
     # A SECTION (not inline code): under the deferred runner, inline code
     # executes at registration time — before any benchmark ran — and
@@ -1934,6 +1996,18 @@ def main() -> int:
     ap.add_argument("--mesh-scaling-only", action="store_true",
                     help="run ONLY the scaling table (fast structural "
                          "artifact; `make mesh-scaling`)")
+    ap.add_argument("--serving-requests", type=int, default=192,
+                    help="requests per measured pass of the serving-"
+                         "engine leg (config7)")
+    ap.add_argument("--serving-max-rows", type=int, default=32,
+                    help="serving leg request sizes are uniform in "
+                         "[1, this]")
+    ap.add_argument("--serving-max-bucket", type=int, default=64,
+                    help="largest power-of-two serving bucket (bounds "
+                         "the leg's warm-up compiles)")
+    ap.add_argument("--serving-only", action="store_true",
+                    help="run ONLY the serving-engine leg (fast "
+                         "serving-layer artifact; `make serve-smoke`)")
     ap.add_argument("--profile", default="",
                     help="directory for an XLA profiler trace of the "
                          "winning full-fusion kernel (off by default)")

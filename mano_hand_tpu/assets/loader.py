@@ -116,7 +116,10 @@ def _infer_side(path: PathLike, explicit: str | None) -> str:
     if explicit is not None:
         return explicit
     name = Path(path).name.lower()
-    if "neutral" in name:
+    # 'neutral' only marks an unsided asset when NO side marker is
+    # present: a sided file whose name merely mentions neutral (e.g.
+    # neutral_pose_left.pkl) must keep its handedness (ADVICE.md r5).
+    if "neutral" in name and "left" not in name and "right" not in name:
         return C.NEUTRAL
     return C.LEFT if "left" in name else C.RIGHT
 

@@ -16,6 +16,10 @@ Subcommands cover the reference's executable entry points (SURVEY.md §3):
   fit      — recover pose/shape from target vertices or sparse 3D joint
              keypoints (.npy) by Adam or Levenberg-Marquardt; writes a
              .npz checkpoint
+  serve-bench — drive the bucketed micro-batching engine (serving/)
+             with a synthetic ragged request stream; one JSON line of
+             serving metrics (engine-vs-direct ratio, recompiles,
+             padding waste, per-bucket latency)
   info     — print an asset's schema summary
 
 Run as ``python -m mano_hand_tpu.cli <subcommand>``.
@@ -935,6 +939,78 @@ def cmd_export_aot(args) -> int:
     return 0
 
 
+def cmd_serve_bench(args) -> int:
+    """Drive the serving engine with a synthetic ragged request stream and
+    print ONE JSON line of serving metrics (engine vs direct-jit
+    throughput, recompiles, padding waste, per-bucket latency). The
+    protocol itself lives in ``serving.measure.serve_bench_run`` —
+    shared with bench.py's config7 leg so the two cannot diverge."""
+    import os
+    import threading
+    import time
+
+    import jax
+
+    from mano_hand_tpu.serving.measure import serve_bench_run
+
+    if args.requests < 1:
+        print(f"--requests must be >= 1, got {args.requests}",
+              file=sys.stderr)
+        return 2
+    if args.min_rows < 1 or args.max_rows < args.min_rows:
+        print(f"need 1 <= --min-rows <= --max-rows, got "
+              f"({args.min_rows}, {args.max_rows})", file=sys.stderr)
+        return 2
+    if args.max_rows > args.max_bucket:
+        print(f"--max-rows {args.max_rows} exceeds --max-bucket "
+              f"{args.max_bucket}", file=sys.stderr)
+        return 2
+    params = _load_params(args.asset, args.side).astype(np.float32)
+
+    # Deadline watchdog for device backends (CLAUDE.md): a tunnel drop
+    # mid-dispatch hangs the engine's dispatcher inside a C-level PJRT
+    # RPC where neither signals nor thread joins can reach it — only a
+    # hard exit lands. Armed BEFORE any jax backend call: resolving the
+    # backend itself initializes PJRT in-process and hangs on a wedged
+    # tunnel, so an auto default (--emit-by unset) arms provisionally at
+    # 900 s and is DISARMED below once the backend resolves to cpu. The
+    # JSON line stays valid either way (null + error on the kill path).
+    emit_by = 900.0 if args.emit_by < 0 else args.emit_by
+    disarm = threading.Event()
+    if emit_by > 0:
+        t0 = time.time()
+
+        def _watch():
+            while time.time() - t0 < emit_by:
+                if disarm.is_set():
+                    return
+                time.sleep(2.0)
+            print(json.dumps({
+                "engine_evals_per_sec": None,
+                "error": f"serve-bench deadline ({emit_by:.0f}s) hit — "
+                         "hung device RPC (tunnel drop mid-dispatch?)",
+            }), flush=True)
+            os._exit(3)
+
+        threading.Thread(target=_watch, name="serve-bench-watchdog",
+                         daemon=True).start()
+    if args.emit_by < 0 and jax.default_backend() == "cpu":
+        disarm.set()  # auto mode: no tunnel to guard against on cpu
+    out = serve_bench_run(
+        params,
+        requests=args.requests,
+        min_rows=args.min_rows,
+        max_rows=args.max_rows,
+        max_bucket=args.max_bucket,
+        max_delay_s=args.max_delay_ms * 1e-3,
+        aot_dir=args.aot_dir or None,
+        seed=args.seed,
+    )
+    out["backend"] = jax.default_backend()
+    print(json.dumps(out))
+    return 0
+
+
 def cmd_info(args) -> int:
     params = _load_params(args.asset, args.side)
     info = {
@@ -1216,6 +1292,35 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("--platforms", default="",
                    help="comma-separated lowering platforms; default cpu,tpu")
     e.set_defaults(fn=cmd_export_aot)
+
+    sb = sub.add_parser(
+        "serve-bench",
+        help="measure the bucketed micro-batching engine on a synthetic "
+             "ragged request stream (one JSON line of serving metrics)",
+    )
+    sb.add_argument("--asset", default="synthetic")
+    sb.add_argument("--side", default=None,
+                    choices=[None, "left", "right", "neutral"])
+    sb.add_argument("--requests", type=int, default=256,
+                    help="requests per measured pass")
+    sb.add_argument("--min-rows", type=int, default=1)
+    sb.add_argument("--max-rows", type=int, default=64,
+                    help="request batch sizes are uniform in "
+                         "[--min-rows, --max-rows]")
+    sb.add_argument("--max-bucket", type=int, default=256)
+    sb.add_argument("--max-delay-ms", type=float, default=2.0,
+                    help="coalescing window once a request is pending")
+    sb.add_argument("--aot-dir", default="",
+                    help="persistent per-bucket AOT artifact cache "
+                         "(serving/engine.py); empty = in-memory only")
+    sb.add_argument("--emit-by", type=float, default=-1.0,
+                    help="hard wall-clock deadline in seconds: emit a "
+                         "null JSON line and hard-exit if the run hangs "
+                         "(tunnel drops leave the dispatcher in an "
+                         "unkillable device RPC). Default: 900 on "
+                         "device backends, off on cpu; 0 disables")
+    sb.add_argument("--seed", type=int, default=0)
+    sb.set_defaults(fn=cmd_serve_bench)
 
     i = sub.add_parser("info", help="print asset summary")
     i.add_argument("--asset", default="synthetic")

@@ -553,3 +553,32 @@ def fit_lm(
         target_verts.shape, "fit_lm",
     )
     return jax.vmap(lambda t, i: single(t, init=i))(target_verts, init)
+
+
+def fit_lm_bucketed(
+    params: ManoParams,
+    target_verts: jnp.ndarray,   # [B, rows, 3]
+    *,
+    min_bucket: int = 1,
+    max_bucket: int = 1024,
+    counters=None,
+    init: Optional[dict] = None,
+    **kw,
+) -> LMResult:
+    """``fit_lm`` for many-small-problem streams with ragged batch sizes.
+
+    The serving bucket policy (serving/buckets.py) applied to the GN
+    solver — the tracking workload shape: per-frame batches of
+    independent problems whose count varies (detections appear and
+    drop). The problem batch pads to the nearest power-of-two bucket
+    (pad problems repeat problem 0 — live numerics, normal convergence)
+    and every leaf of the LMResult is sliced back to the live problems,
+    so steady ragged traffic reuses ``log2(max_bucket)`` compiled scan
+    programs with zero retraces after warm-up (tests/test_serving.py
+    asserts this via ``counters``, a utils.profiling.ServingCounters).
+    All ``fit_lm`` kwargs pass through.
+    """
+    return solvers.bucketed_fit_call(
+        fit_lm, params, target_verts, min_bucket=min_bucket,
+        max_bucket=max_bucket, counters=counters, init=init,
+        fn_name="fit_lm_bucketed", **kw)

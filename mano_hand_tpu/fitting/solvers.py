@@ -1264,3 +1264,94 @@ def fit_sequence(
         loss_history=history,
         trans=p_final.get("trans"),
     )
+
+
+# ----------------------------------------------------- bucketed wrappers
+def _jit_cache_size(fn) -> Optional[int]:
+    """Entry count of the underlying jit cache, unwrapping the validation
+    decorators (they all ``functools.wraps``). None when unavailable —
+    the counters then simply don't tick, they never lie."""
+    while not hasattr(fn, "_cache_size") and hasattr(fn, "__wrapped__"):
+        fn = fn.__wrapped__
+    try:
+        return int(fn._cache_size())
+    except Exception:  # noqa: BLE001 — observability must not break fits
+        return None
+
+
+def bucketed_fit_call(fit_fn, params, targets, *, min_bucket, max_bucket,
+                      counters, init, fn_name, **kw):
+    """Shared engine of ``fit_bucketed``/``fit_lm_bucketed``.
+
+    Pads the PROBLEM axis (leading dim) of a batched-fit call up to a
+    power-of-two bucket (serving/buckets.py) so tracking-style workloads
+    with ragged problem counts reuse ``log2(max_bucket)`` compiled fit
+    programs instead of retracing per novel count. Pad problems repeat
+    problem 0 (live numerics, normal convergence); their results are
+    sliced back off every leaf of the returned NamedTuple. Warm-start
+    ``init`` leaves are padded the same way. ``counters``
+    (utils.profiling.ServingCounters) observes real retraces via the
+    solver's jit cache size — not a guess — plus padding waste.
+    """
+    from mano_hand_tpu.serving import buckets as bucket_mod
+
+    targets = jnp.asarray(targets)
+    if targets.ndim < 3:
+        raise ValueError(
+            f"{fn_name} wraps BATCHED problems ([B, rows, coords] / "
+            f"[B, H, W] targets); got {targets.shape} — call the "
+            "unbucketed solver for a single problem")
+    b = targets.shape[0]
+    bucket = bucket_mod.bucket_for(
+        b, bucket_mod.bucket_sizes(min_bucket, max_bucket))
+    padded = bucket_mod.pad_rows(targets, bucket)
+    if init is not None:
+        init = bucket_mod.pad_tree_rows(init, bucket)
+    # Per-problem auxiliary kwargs ride the same problem axis as the
+    # targets and must pad with them (an unpadded [B, ...] conf against
+    # [bucket, ...] targets dies as a vmap axis mismatch mid-trace).
+    # Batched-vs-shared is decided by RANK, exactly like the solvers
+    # themselves do (conf: [B, J] vs [J]; mask: [B, H, W] vs [H, W]) —
+    # a shape[0]==b test alone would pad a shared [H, W] mask whose
+    # height merely coincides with the problem count.
+    for aux, batched_ndim in (("target_conf", 2), ("target_mask", 3)):
+        v = kw.get(aux)
+        if v is not None:
+            v = jnp.asarray(v)
+            if v.ndim == batched_ndim and v.shape[0] == b:
+                kw[aux] = bucket_mod.pad_rows(v, bucket)
+    before = _jit_cache_size(fit_fn)
+    res = fit_fn(params, padded, init=init, **kw)
+    after = _jit_cache_size(fit_fn)
+    if counters is not None:
+        if before is not None and after is not None and after > before:
+            counters.count_compile(after - before)
+        counters.count_dispatch(bucket, b)
+    return type(res)(*(None if x is None else x[:b] for x in res))
+
+
+def fit_bucketed(
+    params: ManoParams,
+    target_verts: jnp.ndarray,   # [B, rows, coords] / [B, H, W]
+    *,
+    min_bucket: int = 1,
+    max_bucket: int = 1024,
+    counters=None,
+    init: Optional[dict] = None,
+    **kw,
+) -> FitResult:
+    """``fit`` for many-small-problem streams with ragged problem counts.
+
+    The serving engine's bucket policy applied to FITTING (the tracking
+    shape of the workload: per-frame batches of independent problems
+    whose count varies frame to frame): the problem batch is padded to
+    the nearest power-of-two bucket and the pad problems' results are
+    masked off, so steady traffic reuses a handful of compiled programs
+    — zero retraces after warm-up (pinned in tests/test_serving.py).
+    All ``fit`` kwargs pass through; ``counters`` observes compiles and
+    padding waste.
+    """
+    return bucketed_fit_call(
+        fit, params, target_verts, min_bucket=min_bucket,
+        max_bucket=max_bucket, counters=counters, init=init,
+        fn_name="fit_bucketed", **kw)

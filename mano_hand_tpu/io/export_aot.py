@@ -24,6 +24,7 @@ Typical use::
 
 from __future__ import annotations
 
+import hashlib
 import json
 import struct
 from typing import Optional, Sequence, Union
@@ -32,11 +33,36 @@ import jax
 import jax.numpy as jnp
 from jax import export as jax_export
 
-from mano_hand_tpu.assets.schema import ManoParams
+import numpy as np
+
+from mano_hand_tpu.assets.schema import ARRAY_FIELDS, ManoParams
 from mano_hand_tpu.models import core
 from mano_hand_tpu.ops.common import DEFAULT_PRECISION
 
 _MAGIC = b"MANOAOT1"
+
+
+def params_digest(params: ManoParams, n_hex: int = 16) -> str:
+    """Content digest of a parameter set (hex, ``n_hex`` chars).
+
+    Keys the serving engine's persistent per-bucket artifact cache
+    (serving/engine.py): artifacts bake parameters in as constants, so a
+    cache file is only reusable for the EXACT parameter values — the
+    digest covers every array leaf's bytes plus dtype/shape and the
+    static metadata (parents/side). Two assets differing anywhere get
+    different artifact names instead of silently serving each other's
+    meshes.
+    """
+    h = hashlib.sha256()
+    for name in ARRAY_FIELDS:
+        a = np.ascontiguousarray(np.asarray(getattr(params, name)))
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    h.update(repr(params.parents).encode())
+    h.update(params.side.encode())
+    return h.hexdigest()[:n_hex]
 
 
 def export_forward(

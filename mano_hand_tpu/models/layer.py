@@ -47,6 +47,8 @@ class MANOModel:
         self._dtype = np.dtype(dtype)
         self._params_jax_cache = None  # built lazily: the np backend must
         # work without touching any JAX device (e.g. accelerator offline)
+        self._bucket_exes = {}  # bucket -> compiled forward (forward_bucketed)
+        self.serving_counters = None  # built with the first bucketed call
         self.backend = self._check_backend(backend)
 
         self.n_joints = model.n_joints
@@ -260,6 +262,80 @@ class MANOModel:
                 (*np.shape(pose)[:-2], self.n_shape_params)
             )
         return np.asarray(self._evaluate(pose, shape, backend).verts)
+
+    def forward_bucketed(
+        self,
+        pose: np.ndarray,           # [n, J, 3], any n >= 1
+        shape: Optional[np.ndarray] = None,
+        *,
+        min_bucket: int = 1,
+        max_bucket: int = 1024,
+        donate: Optional[bool] = None,
+    ) -> np.ndarray:
+        """Bucket-aware batched forward: verts [n, V, 3] for ANY n without
+        a per-n recompile.
+
+        The serving-shaped entry point (serving/buckets.py policy): the
+        batch is padded to the nearest power-of-two bucket, runs through
+        a per-bucket compiled-executable cache held on this instance,
+        and the pad rows are sliced back off — so ragged request sizes
+        compile ``log2(max_bucket)`` programs total instead of one per
+        novel n. Inputs are donated to XLA (``donate_argnums``) on
+        device backends (``donate=None`` auto-disables on CPU, where
+        donation is unimplemented). Results are bit-identical to the
+        direct ``__call__`` jax path at the same dtype — the pad rows
+        are dead rows of an independent-per-row ``vmap``
+        (tests/test_serving.py pins this). Compile/padding behaviour is
+        observable on ``self.serving_counters``. For a full async
+        micro-batching front end (request coalescing, AOT persistence),
+        use ``serving.ServingEngine``.
+        """
+        from mano_hand_tpu.serving import buckets as bucket_mod
+        from mano_hand_tpu.utils.profiling import ServingCounters
+
+        if self.serving_counters is None:
+            self.serving_counters = ServingCounters()
+        pose = np.asarray(pose, self._dtype)
+        if pose.ndim != 3 or pose.shape[1:] != (self.n_joints, 3):
+            raise ValueError(
+                f"forward_bucketed pose must be [n, {self.n_joints}, 3], "
+                f"got {pose.shape} (single poses: use __call__)")
+        n = pose.shape[0]
+        if shape is None:
+            shape = np.zeros((n, self.n_shape_params), self._dtype)
+        else:
+            shape = np.asarray(shape, self._dtype)
+            if shape.shape != (n, self.n_shape_params):
+                raise ValueError(
+                    f"forward_bucketed shape must be "
+                    f"[{n}, {self.n_shape_params}], got {shape.shape}")
+        from mano_hand_tpu.serving.engine import (
+            build_bucket_executable, default_donate,
+        )
+
+        sizes = bucket_mod.bucket_sizes(min_bucket, max_bucket)
+        bucket = bucket_mod.bucket_for(n, sizes)
+        donate = default_donate() if donate is None else bool(donate)
+        # Keyed by (bucket, donate): an explicit donate flip must build
+        # its own executable, not silently reuse one compiled under the
+        # opposite donation policy.
+        key = (bucket, donate)
+        exe = self._bucket_exes.get(key)
+        if exe is None:
+            # THE shared per-bucket build (serving/engine.py): jit fast
+            # dispatch, traced params, eager dummy-batch warm-up —
+            # donation policy and warm-up protocol stay in lockstep with
+            # the engine by construction.
+            exe = build_bucket_executable(
+                self._params_jax, bucket, self.n_joints,
+                self.n_shape_params, self._dtype, donate=donate,
+            )
+            self._bucket_exes[key] = exe
+            self.serving_counters.count_compile()
+        out = exe(bucket_mod.pad_rows(pose, bucket),
+                  bucket_mod.pad_rows(shape, bucket))
+        self.serving_counters.count_dispatch(bucket, n)
+        return np.asarray(out)[:n]
 
     def _evaluate(self, pose, shape, backend: str):
         if backend == "np":

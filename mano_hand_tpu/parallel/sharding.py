@@ -40,6 +40,20 @@ from mano_hand_tpu.models import core
 from mano_hand_tpu.ops.common import DEFAULT_PRECISION
 from mano_hand_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
+
+def _shard_map(fn, **kw):
+    """``jax.shard_map`` across jax versions: older jaxlibs ship it as
+    ``jax.experimental.shard_map.shard_map`` with ``check_rep`` in place
+    of ``check_vma`` — same semantics for these collective-free uses."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+    return sm(fn, **kw)
+
+
 PARAM_SPECS = {
     "v_template": P(MODEL_AXIS, None),
     "shape_basis": P(MODEL_AXIS, None, None),
@@ -194,7 +208,7 @@ def shard_map_forward(params, mesh: Mesh, n_verts: int | None = None):
 
         return jax.vmap(one)(pose, shape)
 
-    shard_fn = jax.shard_map(
+    shard_fn = _shard_map(
         per_shard,
         mesh=mesh,
         in_specs=(param_specs, P(DATA_AXIS), P(DATA_AXIS)),
@@ -251,7 +265,7 @@ def pallas_forward_dp(
         )[:, :true_v]
 
     batch_spec = P((DATA_AXIS, MODEL_AXIS))
-    shard_fn = jax.shard_map(
+    shard_fn = _shard_map(
         per_shard,
         mesh=mesh,
         in_specs=(P(), batch_spec, batch_spec),

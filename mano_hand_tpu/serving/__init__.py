@@ -1,0 +1,29 @@
+"""Serving layer: shape-bucketed dynamic micro-batching for the hot paths.
+
+The production-traffic story (ROADMAP north star): independent
+forward/fitting requests with ragged batch sizes are coalesced into
+power-of-two shape buckets, dispatched through a per-bucket compiled
+executable cache (in-memory + persistent AOT artifacts), and overlapped
+with host-side batch assembly via double-buffered async dispatch.
+
+    from mano_hand_tpu.serving import ServingEngine, bucket_for, bucket_sizes
+"""
+
+from mano_hand_tpu.serving.buckets import (
+    bucket_for,
+    bucket_sizes,
+    pad_rows,
+    pad_tree_rows,
+)
+from mano_hand_tpu.serving.engine import ServingEngine
+from mano_hand_tpu.serving.measure import measure_overhead, serve_bench_run
+
+__all__ = [
+    "ServingEngine",
+    "measure_overhead",
+    "serve_bench_run",
+    "bucket_for",
+    "bucket_sizes",
+    "pad_rows",
+    "pad_tree_rows",
+]

@@ -1,0 +1,87 @@
+"""Shape-bucket policy for the serving/fitting hot paths.
+
+Every jitted entry point retraces — and on the tunneled chip recompiles,
+at minutes of dead time — for each NOVEL leading batch dimension. The
+fix is a shape policy: round every request batch up to a power-of-two
+bucket, pad the tail rows, and mask them back out of the results. The
+whole request universe then compiles into ``log2(max_bucket)`` programs,
+once, ever.
+
+Padding is row-independent by construction: the batched forward is a
+``vmap`` over independent per-row programs, so pad rows cannot perturb
+live rows — the engine's padded/masked results are bit-identical to a
+direct unpadded call at the same dtype (pinned in tests/test_serving.py).
+
+This module is pure numpy/python (no jax import): the bucket policy is
+host-side bookkeeping, usable from the engine, the model layer, and the
+fitting wrappers without dragging a backend in.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+
+def bucket_sizes(min_bucket: int = 1, max_bucket: int = 1024) -> Tuple[int, ...]:
+    """The powers of two in [min_bucket, max_bucket], endpoints rounded up.
+
+    >>> bucket_sizes(8, 64)
+    (8, 16, 32, 64)
+    """
+    if min_bucket < 1 or max_bucket < min_bucket:
+        raise ValueError(
+            f"need 1 <= min_bucket <= max_bucket, got "
+            f"({min_bucket}, {max_bucket})")
+    lo = 1 << (int(min_bucket) - 1).bit_length()
+    hi = 1 << (int(max_bucket) - 1).bit_length()
+    return tuple(1 << e for e in range(lo.bit_length() - 1,
+                                       hi.bit_length()))
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """The smallest bucket >= n. ``buckets`` must be sorted ascending.
+
+    Raises when n exceeds the largest bucket: a silently truncated
+    request would drop rows, and a silently grown one would recompile —
+    the caller decides (the engine rejects at submit; batch workloads
+    chunk upstream via ``core.forward_chunked``).
+    """
+    if n < 1:
+        raise ValueError(f"request rows must be >= 1, got {n}")
+    for b in buckets:
+        if n <= b:
+            return int(b)
+    raise ValueError(
+        f"request of {n} rows exceeds the largest bucket "
+        f"{buckets[-1]}; raise max_bucket or chunk the request")
+
+
+def pad_rows(arr: np.ndarray, bucket: int) -> np.ndarray:
+    """Pad ``arr``'s leading dim up to ``bucket`` by repeating row 0.
+
+    Row 0 (real data) rather than zeros: pad rows then run the exact
+    numeric regime of live traffic — no denormals, no degenerate
+    geometry — so a pad row can never cost more than a live row, and
+    fitting pad problems converge like their live neighbours instead of
+    wandering. Works on numpy and jax arrays (returns the input's kind).
+    """
+    n = arr.shape[0]
+    if n == bucket:
+        return arr
+    if n > bucket:
+        raise ValueError(f"cannot pad {n} rows down to bucket {bucket}")
+    if isinstance(arr, np.ndarray):
+        pad = np.broadcast_to(arr[:1], (bucket - n, *arr.shape[1:]))
+        return np.concatenate([arr, pad])
+    import jax.numpy as jnp
+
+    pad = jnp.broadcast_to(arr[:1], (bucket - n, *arr.shape[1:]))
+    return jnp.concatenate([arr, pad])
+
+
+def pad_tree_rows(tree: dict, bucket: int) -> dict:
+    """``pad_rows`` over every leaf of a flat {name: array} dict (warm-start
+    seeds for the bucketed fit wrappers)."""
+    return {k: pad_rows(np.asarray(v), bucket) for k, v in tree.items()}

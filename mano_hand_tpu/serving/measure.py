@@ -1,0 +1,199 @@
+"""Engine-overhead measurement shared by `mano serve-bench` and bench.py.
+
+The one number that judges the engine (acceptance bound: >= 0.9x a
+direct jit call at the same warm batch size) is a wall-clock ratio on a
+busy 1-core box where background load drifts 5x between seconds. Two
+defenses, both load-bearing:
+
+* **interleave** the engine and direct passes per trial, alternating
+  which side goes first, so a load spike or monotone drift costs both
+  sides instead of whichever side it happened to land on (observed
+  live: a 0.12x "ratio" whose engine pass ate a spike the direct pass
+  missed);
+* **min-time over trials** for both sides: rates and the headline ratio
+  come from each side's fastest trial (the least-loaded window — the
+  time_jax_fn min-of-iters reasoning), with the per-trial ratios and
+  their median kept alongside as the noise record.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Sequence, Tuple
+
+import numpy as np
+
+
+def measure_overhead(
+    engine,
+    direct: Callable[[np.ndarray, np.ndarray], None],
+    fixed: Sequence[Tuple[np.ndarray, np.ndarray]],
+    trials: int = 7,
+) -> dict:
+    """Interleaved engine-vs-direct timing over fixed-size batches.
+
+    ``fixed`` is a list of (pose, shape) request pairs, every one at the
+    SAME batch size (the warm bucket); ``direct`` runs one pair through
+    the direct jit path and blocks until done. Returns engine/direct
+    rates (evals/s, fastest trial), the headline ratio from those SAME
+    fastest trials (min-time is the stable estimator on a drifting box
+    — the time_jax_fn min-of-iters reasoning; and the headline ratio
+    must be the quotient of the two rates printed next to it, not a
+    third number that can contradict them), plus the per-trial ratios
+    and their median for the noise record.
+    """
+    rows = sum(p.shape[0] for p, _ in fixed)
+    ratios: List[float] = []
+    dt_e_best = dt_d_best = float("inf")
+
+    def run_engine():
+        t0 = time.perf_counter()
+        futs = [engine.submit(p, s) for p, s in fixed]
+        for f in futs:
+            f.result()
+        return time.perf_counter() - t0
+
+    def run_direct():
+        t0 = time.perf_counter()
+        for p, s in fixed:
+            direct(p, s)
+        return time.perf_counter() - t0
+
+    for t in range(max(1, trials)):
+        # Alternate which side goes first: a monotone drift (thermal,
+        # cache settling, a background process ramping) otherwise lands
+        # on the same side every trial and biases every ratio one way.
+        if t % 2 == 0:
+            dt_e, dt_d = run_engine(), run_direct()
+        else:
+            dt_d, dt_e = run_direct(), run_engine()
+        ratios.append(dt_d / dt_e)
+        dt_e_best = min(dt_e_best, dt_e)
+        dt_d_best = min(dt_d_best, dt_d)
+    return {
+        "engine_fixed_evals_per_sec": float(f"{rows / dt_e_best:.5g}"),
+        "direct_evals_per_sec": float(f"{rows / dt_d_best:.5g}"),
+        "engine_vs_direct_ratio": float(f"{dt_d_best / dt_e_best:.4g}"),
+        "ratio_median": float(f"{float(np.median(ratios)):.4g}"),
+        "ratio_trials": [float(f"{r:.3g}") for r in ratios],
+    }
+
+
+def serve_bench_run(
+    params,
+    *,
+    requests: int = 192,
+    min_rows: int = 1,
+    max_rows: int = 32,
+    max_bucket: int = 64,
+    max_delay_s: float = 0.002,
+    aot_dir=None,
+    seed: int = 0,
+    trials: int = 7,
+    log: Callable[[str], None] = None,
+) -> dict:
+    """THE serving benchmark protocol — shared by ``bench.py`` config7
+    and `mano serve-bench` so the two artifacts cannot diverge.
+
+    Phases: warm every bucket; settle the pipeline with one ragged pass;
+    time a second ragged pass (engine_evals_per_sec) and count steady
+    recompiles; then the fixed-warm-bucket overhead bound via
+    ``measure_overhead``. The fixed requests are exactly the LARGEST
+    bucket — coalescing cannot merge two of them (they would overflow),
+    so each dispatch is one request at one batch size, directly
+    comparable to a direct jit call at that size.
+
+    Returns the flat serving metrics dict (rates + overhead + counters
+    snapshot). Raises on engine failure — callers own fault isolation.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+    from mano_hand_tpu.serving.engine import ServingEngine
+
+    if requests < 1:
+        raise ValueError(f"requests must be >= 1, got {requests}")
+    # Request sizes can never exceed the largest bucket (the engine
+    # rejects them at submit); clamp rather than crash the leg.
+    max_rows = min(max_rows, max_bucket)
+    min_rows = max(1, min(min_rows, max_rows))
+    # The asset's own joint/shape dims, NOT the MANO constants: the CLI
+    # serves SMPL-family body assets (24/52 joints) through the same
+    # engine, and the engine validates request shapes against params.
+    n_joints, n_shape = params.n_joints, params.n_shape
+    rng = np.random.default_rng(seed)
+    sizes = rng.integers(min_rows, max_rows + 1, size=requests)
+    stream = [
+        (rng.normal(scale=0.4, size=(n, n_joints, 3)).astype(np.float32),
+         rng.normal(size=(n, n_shape)).astype(np.float32))
+        for n in (int(s) for s in sizes)
+    ]
+    eng = ServingEngine(params, max_bucket=max_bucket,
+                        max_delay_s=max_delay_s, aot_dir=aot_dir)
+
+    def run_stream():
+        futs = [eng.submit(p, s) for p, s in stream]
+        for f in futs:
+            f.result()
+
+    prm_dev = params.astype(np.float32).device_put()
+
+    def direct(p, s):
+        # THE existing shared direct entry (core.jit_forward_batched) —
+        # the same program family the bit-identity tests compare the
+        # engine against; a private re-jit here would be a second
+        # definition of "the direct path" free to drift from it.
+        jax.block_until_ready(core.jit_forward_batched(
+            prm_dev, jnp.asarray(p), jnp.asarray(s)).verts)
+
+    with eng:
+        if log:
+            log(f"serving: warming buckets {eng.buckets}")
+        eng.warmup()
+        # Numerics probe in the SAME process/backend as the timed path
+        # (the CLAUDE.md on-chip rule): the engine's compiled per-bucket
+        # executables — including an AOT-loaded one when aot_dir is warm
+        # — against the direct jit forward. A silent precision collapse
+        # in the serving path must surface as a number here, not ship.
+        probe_p, probe_s = stream[0]
+        got = eng.forward(probe_p, probe_s)
+        want = np.asarray(core.jit_forward_batched(
+            prm_dev, jnp.asarray(probe_p), jnp.asarray(probe_s)).verts)
+        numerics_err = float(np.abs(got - want).max())
+        run_stream()                       # settle the pipeline
+        compiles_warm = eng.counters.compiles
+        t0 = time.perf_counter()
+        run_stream()                       # the measured steady pass
+        dt = time.perf_counter() - t0
+        steady_recompiles = eng.counters.compiles - compiles_warm
+        # Snapshot HERE: the counters must describe the RAGGED stream
+        # (its padding waste, queue depth, latency) — the synthetic
+        # fixed-bucket overhead burst below would dilute padding_waste
+        # toward zero and overwrite the latency picture.
+        snapshot = eng.counters.snapshot()
+
+        warm_bucket = eng.buckets[-1]
+        # Enough batches that one scheduler hiccup cannot carry a whole
+        # phase: ~100 ms+ per side per trial on this box, not ~50 ms.
+        fixed = [
+            (rng.normal(scale=0.4,
+                        size=(warm_bucket, n_joints, 3)).astype(np.float32),
+             rng.normal(size=(warm_bucket, n_shape)).astype(np.float32))
+            for _ in range(max(24, requests // 4))
+        ]
+        eng.forward(*fixed[0])             # settle
+        direct(*fixed[0])                  # compile outside the timing
+        overhead = measure_overhead(eng, direct, fixed, trials=trials)
+
+    return {
+        "engine_evals_per_sec": float(f"{float(sizes.sum()) / dt:.5g}"),
+        **overhead,
+        "engine_vs_direct_max_abs_err": numerics_err,
+        "warm_bucket": warm_bucket,
+        "steady_recompiles": int(steady_recompiles),
+        "requests": int(requests),
+        "rows": [int(sizes.min()), int(sizes.max())],
+        "buckets": list(eng.buckets),
+        **snapshot,
+    }

@@ -97,17 +97,24 @@ def prefetch_to_device(
     PyTrees pass through ``jax.device_put`` whole, so dict batches from
     :func:`batches` keep their structure.
     """
+    # Validate HERE, not in the generator body (the batches() pattern): a
+    # generator defers its body to first next(), which would surface a
+    # bad size deep inside the consumer instead of at the call.
     if size < 1:
         raise ValueError(f"size must be >= 1, got {size}")
     put = (lambda x: jax.device_put(x, sharding)) if sharding is not None \
         else jax.device_put
-    queue: collections.deque = collections.deque()
-    it = iter(iterator)
-    try:
-        while True:
-            while len(queue) < size:
-                queue.append(put(next(it)))
-            yield queue.popleft()
-    except StopIteration:
-        while queue:
-            yield queue.popleft()
+
+    def gen():
+        queue: collections.deque = collections.deque()
+        it = iter(iterator)
+        try:
+            while True:
+                while len(queue) < size:
+                    queue.append(put(next(it)))
+                yield queue.popleft()
+        except StopIteration:
+            while queue:
+                yield queue.popleft()
+
+    return gen()

@@ -8,8 +8,9 @@ naive timing meaningless) and the XLA profiler trace for xprof/tensorboard.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
 import jax
 import numpy as np
@@ -80,3 +81,112 @@ def xla_trace(log_dir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+# Per-bucket latency samples are bounded so a long-lived server cannot
+# grow memory with traffic; 8192 samples give stable p99 estimates.
+_LATENCY_RESERVOIR = 8192
+
+
+class ServingCounters:
+    """Observability for the bucketed serving paths (serving/engine.py,
+    the bucketed fit wrappers, MANOModel.forward_bucketed).
+
+    The load-bearing counter is ``compiles``: it increments ONLY when a
+    bucket executable is built by tracing + compiling from scratch, so
+    "zero recompiles on steady-state traffic" is a testable number, not
+    a hope. ``aot_loads`` counts executables revived from a persistent
+    artifact instead (a cold process hitting a warm on-disk bucket).
+    Padding waste and queue depth quantify the bucket policy itself;
+    per-bucket latency quantiles quantify what a caller actually waits.
+
+    Thread-safe: the engine's dispatcher thread and submitters both
+    write here.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.compiles = 0          # fresh trace+compile events (cache misses)
+        self.aot_loads = 0         # executables revived from disk artifacts
+        self.dispatches = 0        # batches sent to the device
+        self.rows_live = 0         # real request rows dispatched
+        self.rows_padded = 0       # pad rows dispatched alongside them
+        self.queue_depth_peak = 0  # max pending requests seen at coalesce
+        self._latencies: Dict[int, list] = {}  # bucket -> [seconds]
+        self._latency_writes: Dict[int, int] = {}  # per-bucket write cursor
+
+    # -- writers ----------------------------------------------------------
+    def count_compile(self, n: int = 1) -> None:
+        with self._lock:
+            self.compiles += n
+
+    def count_aot_load(self, n: int = 1) -> None:
+        with self._lock:
+            self.aot_loads += n
+
+    def count_dispatch(self, bucket: int, live_rows: int) -> None:
+        with self._lock:
+            self.dispatches += 1
+            self.rows_live += live_rows
+            self.rows_padded += bucket - live_rows
+
+    def observe_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            if depth > self.queue_depth_peak:
+                self.queue_depth_peak = depth
+
+    def record_latency(self, bucket: int, seconds: float) -> None:
+        with self._lock:
+            bucket = int(bucket)
+            samples = self._latencies.setdefault(bucket, [])
+            if len(samples) >= _LATENCY_RESERVOIR:
+                # Ring overwrite on a PER-SAMPLE cursor: keying the slot
+                # off the dispatch counter would make every request of a
+                # batch land in one slot (only the last survives — a
+                # systematic low bias on p99), and adjacent batches
+                # would keep re-hitting near-identical slots.
+                cursor = self._latency_writes.get(bucket, 0)
+                samples[cursor % _LATENCY_RESERVOIR] = seconds
+            self._latency_writes[bucket] = \
+                self._latency_writes.get(bucket, 0) + 1
+            if len(samples) < _LATENCY_RESERVOIR:
+                samples.append(seconds)
+
+    # -- readers ----------------------------------------------------------
+    @property
+    def padding_waste(self) -> float:
+        """Fraction of dispatched rows that were padding, in [0, 1)."""
+        with self._lock:
+            total = self.rows_live + self.rows_padded
+            return self.rows_padded / total if total else 0.0
+
+    def latency_quantiles(self) -> dict:
+        """{bucket: {"p50_ms", "p99_ms", "n"}} over the recorded samples."""
+        with self._lock:
+            items = {b: list(s) for b, s in self._latencies.items()}
+        out = {}
+        for b, s in sorted(items.items()):
+            if not s:
+                continue
+            arr = np.asarray(s)
+            out[b] = {
+                "p50_ms": float(np.percentile(arr, 50) * 1e3),
+                "p99_ms": float(np.percentile(arr, 99) * 1e3),
+                "n": int(arr.size),
+            }
+        return out
+
+    def snapshot(self) -> dict:
+        """JSON-able state dump (the bench/CLI serving metrics block)."""
+        with self._lock:
+            base = {
+                "compiles": self.compiles,
+                "aot_loads": self.aot_loads,
+                "dispatches": self.dispatches,
+                "rows_live": self.rows_live,
+                "rows_padded": self.rows_padded,
+                "queue_depth_peak": self.queue_depth_peak,
+            }
+        base["padding_waste"] = round(self.padding_waste, 4)
+        base["latency_by_bucket"] = self.latency_quantiles()
+        return base

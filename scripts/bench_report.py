@@ -100,6 +100,50 @@ def main() -> int:
         checks.append((name, bool(ok)))
         print(f"  [{'PASS' if ok else 'FAIL'}] {name}: {msg}")
 
+    def judge_serving(srv):
+        """Done-criteria of the serving-engine leg (config7 / the
+        serving-only artifact): engine overhead bound and steady-state
+        zero-recompile, plus the observability numbers as info."""
+        ratio = srv.get("engine_vs_direct_ratio")
+        # No :, formats here: a failed/absent leg leaves None values, and
+        # the verdict must say FAIL, not crash.
+        check("serving_overhead_09x",
+              ratio is not None and ratio >= 0.9,
+              f"engine {srv.get('engine_fixed_evals_per_sec')} vs "
+              f"direct {srv.get('direct_evals_per_sec')} evals/s at "
+              f"warm bucket b={srv.get('warm_bucket')} (ratio {ratio}, "
+              f"median {srv.get('ratio_median')} over trials "
+              f"{srv.get('ratio_trials')})")
+        check("serving_zero_recompiles",
+              srv.get("steady_recompiles") == 0,
+              f"{srv.get('steady_recompiles')} steady-state recompiles "
+              f"after {srv.get('compiles')} warm-up compiles")
+        nerr = srv.get("engine_vs_direct_max_abs_err")
+        if nerr is not None:
+            # The compiled serving path's numerics probe, run in the
+            # same process/backend as the timed path (CLAUDE.md rule) —
+            # same 1e-4 gate as every other compiled path.
+            check("serving_numerics_gate", nerr < 1e-4,
+                  f"engine-vs-direct max abs err {nerr:.3e} "
+                  "(compiled serving-path probe)")
+        lat = {b: (q.get("p50_ms"), q.get("p99_ms"))
+               for b, q in (srv.get("latency_by_bucket") or {}).items()}
+        print(f"  [info] serving: ragged "
+              f"{srv.get('engine_evals_per_sec')} evals/s over "
+              f"{srv.get('requests')} requests, padding waste "
+              f"{srv.get('padding_waste')}, queue depth peak "
+              f"{srv.get('queue_depth_peak')}, p50/p99 ms by bucket "
+              f"{lat}")
+
+    if line.get("metric") == "serving_engine_evals_per_sec":
+        # A `bench.py --serving-only` artifact (make serve-smoke): only
+        # the serving criteria apply.
+        judge_serving(detail.get("serving", {}))
+        bad = [n for n, ok in checks if not ok]
+        print("RESULT: " + ("SERVING CRITERIA PASS" if not bad
+                            else f"failing: {', '.join(bad)}"))
+        return 0 if not bad else 1
+
     check("headline_13M", headline and headline >= 13e6,
           f"{headline:,.0f} vs the >=13 M floor (target 20 M)")
     err = line.get("max_err_vs_numpy")
@@ -122,6 +166,19 @@ def main() -> int:
           f"silhouette {c6} / depth "
           f"{detail.get('config6_depth_renders_per_sec')} renders/s, "
           f"mask fit {detail.get('config6_sil_fit_steps_per_sec')} steps/s")
+
+    srv = detail.get("serving")
+    if srv:
+        # Serving-engine leg (config7): present wherever it ran (full
+        # runs and CPU lanes alike) — judge it with the same criteria.
+        judge_serving(srv)
+    elif "config7_serving" in (line.get("config_errors") or {}):
+        # The leg RAN and crashed: the serving criteria must fail
+        # loudly, not silently vanish from the verdict. (An artifact
+        # with no serving block AND no error predates the leg — the
+        # archived r0x runs — and is judged on what it has.)
+        check("serving_leg_ran", False,
+              f"config7 crashed: {line['config_errors']['config7_serving']}")
 
     smplh = detail.get("smplh_fused_full_max_err")
     if smplh is not None:

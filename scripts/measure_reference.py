@@ -15,14 +15,18 @@ Prints one JSON line:
   oracle_evals_per_sec         — our f64 NumPy oracle, same protocol
   jax_cpu_single_evals_per_sec — our jitted f32 path, batch=1 per call
   jax_cpu_batched_evals_per_sec— our jitted f32 path, one batch call
-The reference is untrusted public content: it is imported and executed
-as-is in this throwaway process, never copied.
+The reference is untrusted public content: its timing leg runs in a
+SUBPROCESS with a stripped environment (`python -I`, minimal env, cwd in
+a throwaway temp dir) and communicates over JSON + .npy files only —
+nothing from that tree is imported into this process (ADVICE.md r5).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 from pathlib import Path
@@ -31,6 +35,63 @@ ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 
 import numpy as np  # noqa: E402
+
+# The child that imports and times the UNTRUSTED reference. Isolated-mode
+# python (-I: no user site, PYTHONPATH ignored) + the stripped env below
+# contain what that code can reach; it talks back through one stdout JSON
+# line and one verts .npy it writes inside the sandbox dir.
+_CHILD = r"""
+import json, sys, time
+import numpy as np
+
+workdir, ref_dir, pkl, iters = (
+    sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4]))
+sys.path.insert(0, ref_dir)
+from mano_np import MANOModel  # the reference implementation
+
+poses = np.load(workdir + "/poses.npy")
+betas = np.load(workdir + "/betas.npy")
+ref = MANOModel(pkl)
+
+def ev(k):
+    ref.set_params(pose_abs=poses[k % len(poses)],
+                   shape=betas[k % len(betas)])
+
+ev(0)  # warm
+t0 = time.perf_counter()
+for i in range(iters):
+    ev(i)
+dt = (time.perf_counter() - t0) / iters
+
+ref.set_params(pose_abs=poses[0], shape=betas[0])
+np.save(workdir + "/ref_verts0.npy", np.asarray(ref.verts))
+print(json.dumps({"reference_evals_per_sec": 1.0 / dt}))
+"""
+
+
+def _run_reference_leg(ref_dir: str, pkl: str, workdir: str,
+                       iters: int) -> float:
+    """Time the reference in a contained child; returns evals/sec."""
+    env = {
+        # Just enough to start CPython; no PYTHONPATH, no HOME secrets,
+        # no credentials — the reference tree's code sees only the
+        # sandbox dir and its own sources.
+        "PATH": os.defpath,
+        "HOME": workdir,
+        "TMPDIR": workdir,
+        "LANG": "C.UTF-8",
+    }
+    proc = subprocess.run(
+        [sys.executable, "-I", "-c", _CHILD, workdir, ref_dir, pkl,
+         str(iters)],
+        capture_output=True, text=True, timeout=600, cwd=workdir, env=env,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"reference subprocess failed (rc={proc.returncode}): "
+            f"{proc.stderr.strip()[-500:]}")
+    return float(json.loads(proc.stdout.strip().splitlines()[-1])
+                 ["reference_evals_per_sec"])
 
 
 def _time_per_call(fn, iters: int) -> float:
@@ -63,34 +124,27 @@ def main() -> int:
 
     out = {}
 
-    # -- the reference itself, on its own dumped-pickle format -------------
-    sys.path.insert(0, args.reference)
+    # -- the reference itself, contained in a stripped-env subprocess ------
     import tempfile
-
-    from mano_np import MANOModel  # the reference implementation
 
     with tempfile.TemporaryDirectory() as td:
         pkl = str(Path(td) / "dump_mano_left.pkl")
         save_dumped_pickle(params, pkl)
-        ref = MANOModel(pkl)
-
-    i = [0]
-
-    def ref_eval():
-        k = i[0] % args.batch
-        ref.set_params(pose_abs=poses[k], shape=betas[k])
-        i[0] += 1
-
-    t_ref = _time_per_call(ref_eval, args.iters)
-    out["reference_evals_per_sec"] = 1.0 / t_ref
+        np.save(Path(td) / "poses.npy", poses)
+        np.save(Path(td) / "betas.npy", betas)
+        rate_ref = _run_reference_leg(args.reference, pkl, td, args.iters)
+        ref_verts0 = np.load(Path(td) / "ref_verts0.npy")
+    out["reference_evals_per_sec"] = rate_ref
+    t_ref = 1.0 / rate_ref
 
     # Parity guard: the two implementations must agree before their
-    # rates are comparable.
-    ref.set_params(pose_abs=poses[0], shape=betas[0])
+    # rates are comparable (the child reports its pose[0] verts for it).
     want = oracle.forward(params, pose=poses[0], shape=betas[0]).verts
-    err = float(np.abs(ref.verts - want).max())
+    err = float(np.abs(ref_verts0 - want).max())
     assert err < 1e-12, f"reference/oracle mismatch: {err}"
     out["parity_max_err"] = err
+
+    i = [0]
 
     # -- our f64 NumPy oracle, same one-eval-per-call protocol -------------
     def oracle_eval():

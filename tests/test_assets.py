@@ -101,6 +101,25 @@ def test_load_model_sniffs_format(params, tmp_path):
     assert load_model(pkl).side == C.LEFT
 
 
+def test_infer_side_neutral_vs_sided(params, tmp_path):
+    """'neutral' marks an UNSIDED asset only when no side marker is in the
+    name: a sided file mentioning neutral (neutral_pose_left.pkl) keeps
+    its handedness, and a bare neutral name stays neutral (ADVICE.md r5)."""
+    cases = {
+        "neutral_pose_left.pkl": C.LEFT,
+        "neutral_pose_right.pkl": C.RIGHT,
+        "body_neutral.pkl": C.NEUTRAL,
+        "dump_mano_left.pkl": C.LEFT,
+        "hand.pkl": C.RIGHT,  # no marker at all: the historical default
+    }
+    for name, want in cases.items():
+        path = tmp_path / name
+        save_dumped_pickle(params, path)
+        assert load_dumped_pickle(path).side == want, name
+        # An explicit side always wins over any filename inference.
+        assert load_dumped_pickle(path, side=C.NEUTRAL).side == C.NEUTRAL
+
+
 def test_pytree_registration(params):
     """ManoParams must be a PyTree with static parents/side."""
     import jax

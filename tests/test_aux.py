@@ -398,12 +398,16 @@ def test_repo_shell_scripts_parse():
 
 
 def test_measure_reference_head_to_head():
-    """The measured-baseline script runs end to end: reference import,
-    exact parity gate, all four rates present and positive."""
+    """The measured-baseline script runs end to end: the contained
+    reference subprocess, exact parity gate, all four rates present and
+    positive."""
     import json
     import subprocess
     import sys
     from pathlib import Path
+
+    if not Path("/root/reference/mano_np.py").exists():
+        pytest.skip("reference tree not mounted on this machine")
 
     proc = subprocess.run(
         [sys.executable,

@@ -223,7 +223,8 @@ def test_bench_cpu_tiny_run_end_to_end():
         "--platform", "cpu", "--big-batch", "256", "--chunk", "128",
         "--iters", "2", "--skip-fit", "--pallas-sweep", "off",
         "--init-retries", "2", "--init-timeout", "60",
-        "--sil-size", "24",
+        "--sil-size", "24", "--serving-requests", "32",
+        "--serving-max-rows", "8", "--serving-max-bucket", "16",
     )
     assert rc == 0, line
     assert line["value"] is not None and line["value"] > 0
@@ -236,6 +237,13 @@ def test_bench_cpu_tiny_run_end_to_end():
                 "config1_zero_pose_max_err", "config6_sil_renders_per_sec",
                 "config6_depth_renders_per_sec"):
         assert key in d, f"missing {key}: {sorted(d)}"
+    # The serving leg (config7) rode along: its block is present with the
+    # load-bearing counters (the RATIO is judged in `make serve-smoke` —
+    # this CPU run shares the box with the whole suite).
+    srv = d["serving"]
+    assert srv["steady_recompiles"] == 0
+    assert srv["engine_evals_per_sec"] > 0
+    assert 0.0 <= srv["padding_waste"] < 1.0
     assert "config_errors" not in line, line.get("config_errors")
 
 

@@ -121,3 +121,62 @@ def test_synthetic_passing_run(tmp_path):
         "error": "backend bring-up failed"}))
     p = _run(str(nul))
     assert p.returncode == 1 and "ERROR: backend bring-up" in p.stdout
+
+
+def test_serving_metrics_block(tmp_path):
+    """The serving leg (config7) and the serving-only artifact both get
+    the serving criteria: overhead >= 0.9x and zero steady recompiles."""
+    srv = {
+        "engine_evals_per_sec": 8114.4,
+        "engine_fixed_evals_per_sec": 13234.0,
+        "direct_evals_per_sec": 10206.0,
+        "engine_vs_direct_ratio": 1.297,
+        "ratio_trials": [1.2, 1.3, 1.1],
+        "warm_bucket": 32, "steady_recompiles": 0, "requests": 64,
+        "compiles": 6, "aot_loads": 0, "dispatches": 54,
+        "rows_live": 1480, "rows_padded": 248,
+        "queue_depth_peak": 64, "padding_waste": 0.1435,
+        "latency_by_bucket": {"32": {"p50_ms": 26.6, "p99_ms": 76.0,
+                                     "n": 138}},
+    }
+    # Serving-only artifact (`make serve-smoke`): judged on its own.
+    only = tmp_path / "serve_only.json"
+    only.write_text(json.dumps({
+        "metric": "serving_engine_evals_per_sec", "value": 8114.4,
+        "unit": "evals/s", "vs_baseline": None, "device": "cpu:cpu",
+        "detail": {"serving": srv}}))
+    p = _run(str(only))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] serving_overhead_09x" in p.stdout
+    assert "[PASS] serving_zero_recompiles" in p.stdout
+    assert "SERVING CRITERIA PASS" in p.stdout
+
+    # A slow engine fails the overhead gate.
+    bad = dict(srv, engine_vs_direct_ratio=0.7, steady_recompiles=2)
+    only.write_text(json.dumps({
+        "metric": "serving_engine_evals_per_sec", "value": 8114.4,
+        "unit": "evals/s", "vs_baseline": None, "device": "cpu:cpu",
+        "detail": {"serving": bad}}))
+    p = _run(str(only))
+    assert p.returncode == 1
+    assert "[FAIL] serving_overhead_09x" in p.stdout
+    assert "[FAIL] serving_zero_recompiles" in p.stdout
+
+    # Inside a full run the block rides along without disturbing the
+    # other gates.
+    full = tmp_path / "full.json"
+    full.write_text(json.dumps({
+        "metric": "mano_forward_evals_per_sec", "value": 2.1e7,
+        "unit": "evals/s", "vs_baseline": 420.0,
+        "max_err_vs_numpy": 3e-6, "device": "tpu:v5e",
+        "detail": {
+            "config3_fused_full_chunked_evals_per_sec": 1.9e7,
+            "config4_lm_steps_per_sec": 205.0,
+            "config6_sil_renders_per_sec": 900.0,
+            "serving": srv,
+        },
+    }))
+    p = _run(str(full))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] serving_overhead_09x" in p.stdout
+    assert "[info] serving:" in p.stdout

@@ -883,3 +883,22 @@ def test_body_asset_through_the_cli(tmp_path, capsys):
         b32, jnp.asarray(got["pose"]),
         jnp.asarray(got["shape"])).verts) - targets).max()
     assert err < 1e-4
+
+
+def test_serve_bench_subcommand(capsys):
+    """The serving benchmark CLI: one JSON line, zero steady recompiles,
+    the counters block present (tiny sizes — this is a plumbing test,
+    the honest ratio lives in `make serve-smoke`/bench config7)."""
+    assert cli.main(["serve-bench", "--requests", "8", "--max-rows", "4",
+                     "--max-bucket", "8", "--seed", "1"]) == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["steady_recompiles"] == 0
+    assert line["compiles"] == 4          # buckets 1, 2, 4, 8
+    assert line["warm_bucket"] == 8
+    assert line["engine_evals_per_sec"] > 0
+    assert 0.0 <= line["padding_waste"] < 1.0
+    assert line["buckets"] == [1, 2, 4, 8]
+    # Bad geometry is refused with the CLI contract (rc=2, not a crash).
+    assert cli.main(["serve-bench", "--max-rows", "64",
+                     "--max-bucket", "32"]) == 2
+    assert cli.main(["serve-bench", "--min-rows", "0"]) == 2

@@ -77,5 +77,7 @@ def test_prefetch_drains_short_iterators():
     arrs = _arrays(8)
     got = list(prefetch_to_device(batches(arrs, 4), size=8))
     assert len(got) == 2
+    # Misuse fires AT THE CALL (validating wrapper over the generator,
+    # same contract as batches()) — no next() needed to trigger it.
     with pytest.raises(ValueError, match="size must be"):
-        next(prefetch_to_device(iter([]), size=0))
+        prefetch_to_device(iter([]), size=0)
