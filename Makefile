@@ -20,7 +20,7 @@
 # do not remove it. Also avoid two concurrent pytest processes on the
 # shared cache dir.
 .PHONY: check check-cold test bench-cpu bench-tpu-wait mesh-scaling \
-	check-quick serve-smoke
+	check-quick serve-smoke specialize-smoke
 
 check: test
 
@@ -59,11 +59,16 @@ bench-cpu:
 # chunk mini-sweep, winner re-measure, accuracy probes) through the
 # Pallas interpreter — a bench-plumbing bug must not debut on the
 # scarce real-chip window. Rates are interpreter overhead, not perf.
+# Also sweeps the specialization leg (config8: full-vs-pose-only forward
+# AND the frozen-betas LM half, which runs despite --skip-fit by design)
+# at reduced sizes — the spec-lm batch stays below the b>=64 judging
+# floor, so bench_report records its numbers without applying criteria.
 bench-interpret:
 	python bench.py --platform cpu --big-batch 512 --chunk 128 --iters 2 \
 	  --fit-steps 10 --pallas-sweep quick --pallas-interpret --skip-fit \
 	  --init-retries 2 --sil-size 16 --serving-requests 64 \
-	  --serving-max-rows 16 --serving-max-bucket 32
+	  --serving-max-rows 16 --serving-max-bucket 32 \
+	  --spec-batch 64 --spec-fit-batch 8
 
 # Serving-leg smoke (the bench-interpret counterpart for config7): the
 # whole serving-engine plumbing — bucket warm-up, ragged request stream,
@@ -74,6 +79,17 @@ bench-interpret:
 serve-smoke:
 	python bench.py --platform cpu --serving-only --serving-requests 96 \
 	  --serving-max-rows 16 --serving-max-bucket 32 --init-retries 2
+
+# Specialization-split smoke (the quick-lane half of PR 2's tooling):
+# the seconds-scale correctness story of the shape/pose split — bit-
+# identity of specialize+forward_posed vs the full forward, ShapedHand
+# pytree round-trips, the engine's composed subject+bucket caches, and
+# frozen-betas LM convergence. These tests are quick-marked, so `make
+# check-quick` covers them too; this target is the focused loop while
+# working on the split. Bench-side numbers: the default `python
+# bench.py` config8 leg (criteria in scripts/bench_report.py).
+specialize-smoke:
+	TF_CPP_MIN_LOG_LEVEL=3 python -m pytest tests/test_specialize.py -q
 
 # Unattended BUILDER-side TPU bench: lockfile-guarded, stands down for the
 # driver's priority claim, and self-expires (default 3 h) — see

@@ -17,6 +17,8 @@ Covers the BASELINE.json config suite:
   3b. Pallas fused-skinning kernel            — block-size sweep, best wins
   4. pose-fitting batch=256, 100 Adam steps   — fitting throughput
   5. 120-frame x 2-hand temporal sequence     — latency
+  8. shape-specialization split               — pose-only vs full forward,
+     and the frozen-betas (48-col) LM step vs the 58-col solve
 
 Resilience: the axon TPU tunnel is flaky — backend init can fail OR hang.
 Bring-up therefore probes `jax.devices()` in a SUBPROCESS (a hang there is
@@ -1312,6 +1314,192 @@ def run_benchmarks(args, device_str: str) -> dict:
         section("config4", config4)
         section("config4b_lm", config4b_lm)
 
+    # -- config 8: the shape-specialization split ---------------------------
+    # Full vs pose-only forward, and 58-col vs frozen-betas (48-col) LM.
+    # Both halves compare the SAME numeric path with and without the baked
+    # shape stage (models/core.py:specialize) — comparing across numeric
+    # paths (fused vs staged) would conflate the fusion win with the
+    # specialization win; the fused-full rate is config2/3's job.
+    def config8_specialization():
+        b8 = args.spec_batch
+        pose8 = jnp.asarray(rng.normal(scale=0.6, size=(b8, 16, 3)),
+                            jnp.float32)
+        beta8 = jnp.asarray(rng.normal(size=(10,)), jnp.float32)
+        beta8_b = jnp.broadcast_to(beta8, (b8, 10))
+        shaped = jax.jit(core.specialize)(right, beta8)
+
+        # Full side: betas are a per-call INPUT, so they must vary inside
+        # the loop — with a loop-constant shape operand XLA hoists the
+        # whole shape stage out of the fori_loop (loop-invariant code
+        # motion, verified live) and the "full" side silently times the
+        # pose-only program.
+        def full_run(prm, pose, shape, m):
+            def body(i, acc):
+                pp = pose + i.astype(pose.dtype) * 1e-6
+                ss = shape + i.astype(shape.dtype) * 1e-6
+                out = jax.vmap(lambda q, s: core.forward(prm, q, s))(pp, ss)
+                return acc + out.verts.sum()
+
+            return jax.lax.fori_loop(0, m, body, jnp.zeros((), pose.dtype))
+
+        full_j = jax.jit(full_run, static_argnums=3)
+
+        def posed_run(sh, pose, m):
+            def body(i, acc):
+                pp = pose + i.astype(pose.dtype) * 1e-6
+                return acc + core.forward_posed_batched(sh, pp).verts.sum()
+
+            return jax.lax.fori_loop(0, m, body, jnp.zeros((), pose.dtype))
+
+        posed_j = jax.jit(posed_run, static_argnums=2)
+
+        def paired_slope(run_a, run_b, m1, m2, trials,
+                         min_delta_s=0.030):
+            """Two-point slope for BOTH sides of a comparison, with the
+            serving leg's drift defense (serving/measure.py) applied to
+            slope timing: each trial measures all four points
+            INTERLEAVED (order alternating), the per-point estimate is
+            the min over trials, and the slopes come from those mins —
+            on this busy 1-core box a sequential pair of slope_time
+            calls hands one side the load spike and the ratio lies
+            (observed live: 0.86x..3.1x scatter for the same programs).
+            Shares slope_time's adaptive rescale: grow the loop counts
+            until both deltas clear the noise floor.
+            """
+            scale = 1
+            while True:
+                a, b = m1 * scale, m2 * scale
+                thunks = {"a1": run_a(a), "a2": run_a(b),
+                          "b1": run_b(a), "b2": run_b(b)}
+                for th in thunks.values():  # compile + settle
+                    th()
+                best = {k: float("inf") for k in thunks}
+                for t in range(trials):
+                    keys = sorted(thunks) if t % 2 == 0 \
+                        else sorted(thunks, reverse=True)
+                    for k in keys:
+                        t0 = time.perf_counter()
+                        thunks[k]()
+                        best[k] = min(best[k],
+                                      time.perf_counter() - t0)
+                d_a = best["a2"] - best["a1"]
+                d_b = best["b2"] - best["b1"]
+                if min(d_a, d_b) >= min_delta_s:
+                    return d_a / (b - a), d_b / (b - a)
+                # Same growth policy as slope_time: bounded by a
+                # ~2.5 s-per-call budget; below-noise never reports.
+                worst = max(best["a2"], best["b2"])
+                factor = min(8, int(2.5 / max(worst, 1e-9)))
+                if factor < 2:
+                    log("WARNING: paired slope still below the noise "
+                        f"floor at m={b} with no in-budget rescale "
+                        "left — reporting NaN")
+                    return float("nan"), float("nan")
+                scale *= factor
+                log(f"paired slope delta ({d_a * 1e3:.1f}, "
+                    f"{d_b * 1e3:.1f}) ms lost in noise; rescaling "
+                    f"x{factor} -> m=({m1 * scale},{m2 * scale})")
+
+        # Starting loop counts sized so small-batch lanes (interpret,
+        # the in-suite tiny run) clear the noise floor WITHOUT the
+        # adaptive rescale — a rescale doubles the compile count, and in
+        # a fresh-cache subprocess the compiles, not the runs, are the
+        # budget (the suite's 870 s tier-1 window).
+        ms = max(1, 256 // max(1, b8))
+        t_full, t_posed = paired_slope(
+            lambda m: looped(full_j, m, right, pose8, beta8_b),
+            lambda m: looped(posed_j, m, shaped, pose8),
+            2 * ms, 10 * ms, trials=max(3, args.iters))
+        # Numerics probe in the same process/backend as the timed path
+        # (CLAUDE.md on-chip rule): full vs pose-only, compiled, one
+        # scalar readback. The staged pair is bit-identical at matched
+        # batching structure; the broadcast-shaped batched program read
+        # here may differ by float rounding — same 1e-4 gate as every
+        # compiled path.
+        err = float(jax.jit(
+            lambda prm, sh, pp, ss: jnp.max(jnp.abs(
+                jax.vmap(lambda q, s: core.forward(prm, q, s).verts)(pp, ss)
+                - core.forward_posed_batched(sh, pp).verts))
+        )(right, shaped, pose8, beta8_b))
+        # In-context supplement (the CLAUDE.md rule's strict reading):
+        # the TIMED executables' own scalar outputs, compared at the
+        # already-compiled m=2*ms point — a precision collapse that only
+        # manifests inside the fori_loop fusion context shows up HERE.
+        # The sides' inputs differ by the full side's i-scaled shape
+        # perturbation (~1e-5 relative at most), so the gate is
+        # collapse-scale (1e-3, vs the ~2.4e-3 single-pass-bf16 class),
+        # not rounding-scale; the elementwise probe above carries the
+        # tight 1e-4 gate.
+        s_full = float(full_j(right, pose8, beta8_b, 2 * ms))
+        s_posed = float(posed_j(shaped, pose8, 2 * ms))
+        rel = abs(s_full - s_posed) / max(abs(s_full), 1e-30)
+        spec = results.setdefault("specialization", {})
+        spec.update({
+            "batch": b8,
+            "full_evals_per_sec": float(f"{b8 / t_full:.5g}"),
+            "posed_evals_per_sec": float(f"{b8 / t_posed:.5g}"),
+            "posed_speedup": float(f"{t_full / t_posed:.4g}"),
+            "posed_vs_full_max_abs_err": err,
+            "timed_loop_rel_diff": float(f"{rel:.3g}"),
+        })
+        log(f"config8 specialization b={b8}: full {b8 / t_full:,.0f} vs "
+            f"pose-only {b8 / t_posed:,.0f} evals/s "
+            f"({t_full / t_posed:.2f}x), max err {err:.3e}")
+
+    if args.spec_batch > 0:
+        section("config8_specialization", config8_specialization)
+
+    def config8_spec_lm():
+        # Frozen-betas LM vs the 58-col solve on the same targets/steps —
+        # the tracking-serving criterion (>= 1.1x at b >= 64). Registered
+        # REGARDLESS of --skip-fit: the leg is sized small
+        # (--spec-fit-batch) and bench-interpret (which passes
+        # --skip-fit to dodge config4's cost) must still cover its
+        # plumbing off-chip.
+        bf = args.spec_fit_batch
+        pose_f = rng.normal(scale=0.3, size=(bf, 16, 3)).astype(np.float32)
+        beta_f = jnp.asarray(rng.normal(size=(10,)), jnp.float32)
+        targets = core.jit_forward_batched(
+            right, jnp.asarray(pose_f),
+            jnp.broadcast_to(beta_f, (bf, 10))).verts
+
+        def run_full(steps):
+            return lambda: float(
+                fit_lm(right, targets, n_steps=steps).final_loss.sum())
+
+        def run_frozen(steps):
+            return lambda: float(
+                fit_lm(right, targets, n_steps=steps,
+                       frozen_shape=beta_f).final_loss.sum())
+
+        it = max(2, args.iters // 3)
+        t58 = slope_time(run_full, 4, 12, iters=it)
+        t48 = slope_time(run_frozen, 4, 12, iters=it)
+        # Convergence probe at n_steps=12 REUSES the slope-timed
+        # executables (static n_steps — any other count would be a fresh
+        # compile in a different compilation context).
+        loss58 = float(fit_lm(right, targets,
+                              n_steps=12).final_loss.mean())
+        loss48 = float(fit_lm(right, targets, n_steps=12,
+                              frozen_shape=beta_f).final_loss.mean())
+        spec = results.setdefault("specialization", {})
+        spec.update({
+            "fit_batch": bf,
+            "lm_full_steps_per_sec": float(f"{1.0 / t58:.5g}"),
+            "lm_frozen_steps_per_sec": float(f"{1.0 / t48:.5g}"),
+            "lm_frozen_speedup": float(f"{t58 / t48:.4g}"),
+            "lm_full_cols": 58,
+            "lm_frozen_cols": 48,
+            "lm_frozen_loss_ratio": float(f"{loss48 / max(loss58, 1e-30):.4g}"),
+            "lm_frozen_finite": bool(np.isfinite(loss48)),
+        })
+        log(f"config8 LM b={bf}: 58-col {1.0 / t58:,.1f} vs frozen 48-col "
+            f"{1.0 / t48:,.1f} steps/s ({t58 / t48:.2f}x), loss ratio "
+            f"{loss48 / max(loss58, 1e-30):.3g}")
+
+    if args.spec_fit_batch > 0:
+        section("config8_spec_lm", config8_spec_lm)
+
     # -- config 5: 120-frame two-hand temporal sequence ---------------------
     def config5():
         t_frames, hands = 120, 2
@@ -2008,6 +2196,16 @@ def main() -> int:
     ap.add_argument("--serving-only", action="store_true",
                     help="run ONLY the serving-engine leg (fast "
                          "serving-layer artifact; `make serve-smoke`)")
+    ap.add_argument("--spec-batch", type=int, default=256,
+                    help="batch for the specialization leg's full-vs-"
+                         "pose-only forward comparison (config8); "
+                         "0 skips the forward half")
+    ap.add_argument("--spec-fit-batch", type=int, default=64,
+                    help="problem batch for the specialization leg's "
+                         "58-col vs frozen-betas LM comparison (the "
+                         "done-criterion is judged at >= 64); 0 skips "
+                         "the LM half (its scan compiles dominate "
+                         "fresh-cache smoke lanes)")
     ap.add_argument("--profile", default="",
                     help="directory for an XLA profiler trace of the "
                          "winning full-fusion kernel (off by default)")

@@ -116,11 +116,20 @@ def forward_with_jacobian(
     unravel,                 # ravel_pytree unravel for {"pose", "shape"}
     flat: jnp.ndarray,       # [P] flattened (pose, shape)
     precision=DEFAULT_PRECISION,
+    shape_frozen: bool = False,
 ) -> ForwardJacobian:
     """One forward pass + its full analytic Jacobian.
 
     ``unravel`` defines the column layout — the same ravel the solver
     optimizes in, so no ordering assumptions are baked in here.
+
+    ``shape_frozen=True`` declares that ``unravel`` injects beta as a
+    CONSTANT (the specialization split's pose-only tracking mode, where
+    ``flat`` carries only the 48 pose columns): ``d_shape`` from the
+    small chain's jacfwd is then exactly zero, so the shape-basis term
+    of ``dv`` — a [V, 3, S] x [S, P] contraction of structural zeros —
+    is skipped outright. Bit-safe: adding an exactly-zero slab is the
+    identity, so the assembled Jacobian is unchanged.
     """
     n_params = flat.shape[0]
     small = _small_chain(params, unravel, precision)
@@ -134,12 +143,11 @@ def forward_with_jacobian(
     v_posed = _v_posed(params, rot, shape, precision)
     n_pose_basis = params.pose_basis.shape[-1]
     d_vec_rot = d_rot[1:].reshape(n_pose_basis, n_params)
-    dv = (
-        jnp.einsum("vcf,fp->vcp", params.pose_basis, d_vec_rot,
-                   precision=precision)
-        + jnp.einsum("vcs,sp->vcp", params.shape_basis, d_shape,
-                     precision=precision)
-    )
+    dv = jnp.einsum("vcf,fp->vcp", params.pose_basis, d_vec_rot,
+                    precision=precision)
+    if not shape_frozen:
+        dv = dv + jnp.einsum("vcs,sp->vcp", params.shape_basis, d_shape,
+                             precision=precision)
 
     # verts_v = (sum_j w_vj A_j) v_v + sum_j w_vj b_j; product rule over
     # the three theta-dependent factors. Intermediates stay [V, 3, P].

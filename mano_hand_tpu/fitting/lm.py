@@ -67,6 +67,7 @@ def _fit_single(
     pose_space: str = "aa",
     n_pca: int = 45,
     fit_trans: bool = False,
+    frozen_shape: Optional[jnp.ndarray] = None,  # [S]: pose-only GN
 ) -> LMResult:
     dtype = params.v_template.dtype
     # One-pass bf16 normal equations (roadmap candidate for 200+ steps/s):
@@ -82,6 +83,15 @@ def _fit_single(
     n_joints = params.j_regressor.shape[0]
     n_shape = params.shape_basis.shape[-1]
 
+    # Frozen-betas (pose-only) mode, the specialization split's tracking
+    # counterpart (models/core.py:specialize): beta is a known per-subject
+    # constant, so it leaves the parameter vector entirely — 48 free
+    # columns instead of 58 in axis-angle — and re-enters through the
+    # unravel below, exactly like the PCA decode does.
+    freeze = frozen_shape is not None
+    if freeze:
+        frozen_shape = jnp.asarray(frozen_shape, dtype).reshape(n_shape)
+
     if pose_space == "pca":
         # Same parameterization keys as the Adam solvers' PCA mode
         # (solvers._pose_shapes): truncated finger-pose coefficients +
@@ -90,13 +100,13 @@ def _fit_single(
         theta0 = {
             "global_rot": jnp.zeros((3,), dtype),
             "pca": jnp.zeros((n_pca,), dtype),
-            "shape": jnp.zeros((n_shape,), dtype),
         }
     else:
         theta0 = {
             "pose": jnp.zeros((n_joints, 3), dtype),
-            "shape": jnp.zeros((n_shape,), dtype),
         }
+    if not freeze:
+        theta0["shape"] = jnp.zeros((n_shape,), dtype)
     if fit_trans:
         # Global translation DOF (same key as solvers.fit): predictions
         # are rigidly shifted, so its residual Jacobian is an identity
@@ -120,18 +130,22 @@ def _fit_single(
                 )
             theta0[k] = v
     flat0, unravel_raw = ravel_pytree(theta0)
-    if pose_space == "pca":
-        # The decode is part of the unravel, so every consumer — the
-        # residual, the Tikhonov rows, AND jacobian.forward_with_jacobian
-        # (whose jacfwd of the tiny joint chain then carries
+    if pose_space == "pca" or freeze:
+        # The decode — and, in frozen mode, the constant beta injection —
+        # is part of the unravel, so every consumer — the residual, the
+        # Tikhonov rows, AND jacobian.forward_with_jacobian (whose
+        # jacfwd of the tiny joint chain then carries
         # d pose/d (global_rot, pca) automatically, decode_pca being
-        # linear) — sees the familiar {"pose", "shape"} dict with zero
-        # PCA-specific code anywhere downstream.
+        # linear, and sees exact-zero d_shape for a frozen beta) — sees
+        # the familiar {"pose", "shape"} dict with zero mode-specific
+        # code anywhere downstream.
         def unravel(f):
             raw = unravel_raw(f)
-            return {"pose": core.decode_pca(params, raw["pca"],
-                                            global_rot=raw["global_rot"]),
-                    "shape": raw["shape"]}
+            pose = (core.decode_pca(params, raw["pca"],
+                                    global_rot=raw["global_rot"])
+                    if pose_space == "pca" else raw["pose"])
+            return {"pose": pose,
+                    "shape": frozen_shape if freeze else raw["shape"]}
     else:
         unravel = unravel_raw
     n_params = flat0.shape[0]
@@ -257,7 +271,8 @@ def _fit_single(
         the vertex Jacobian is three [V, 3, P]-bounded einsums
         (fitting/jacobian.py). Rows match ``residual`` exactly.
         """
-        fj = jacobian_mod.forward_with_jacobian(params, unravel, flat)
+        fj = jacobian_mod.forward_with_jacobian(params, unravel, flat,
+                                                shape_frozen=freeze)
         verts, pj = fj.verts, fj.posed_joints
         if fit_trans:
             tr = trans_of(flat)
@@ -384,6 +399,7 @@ def fit_lm(
     pose_space: str = "aa",      # "aa" | "pca"
     n_pca: int = 45,
     fit_trans: bool = False,
+    frozen_shape: Optional[jnp.ndarray] = None,  # [S] or [B, S]
 ) -> LMResult:
     """Recover (pose, shape) by damped Gauss-Newton; batch via vmap.
 
@@ -453,6 +469,19 @@ def fit_lm(
     offset. Its residual Jacobian is an exact identity block per 3D row
     (plane rows: the normal), composable with either pose space;
     ``LMResult.trans`` carries the estimate (None otherwise).
+
+    ``frozen_shape`` pins beta to a KNOWN per-subject value (e.g. the
+    betas baked by ``models.core.specialize``) and solves for pose only
+    — the specialization split's tracking mode: 48 free columns instead
+    of 58 in axis-angle, a [48, 48] normal matrix, and the analytic
+    Jacobian skips the shape-basis tangent slab entirely
+    (fitting/jacobian.py ``shape_frozen``). Composes with either pose
+    space, ``fit_trans``, and every data term; a [B, S] array gives each
+    batched problem its own frozen subject. ``LMResult.shape`` returns
+    the frozen betas; warm-start ``init`` must not carry a ``"shape"``
+    key (there is no such free parameter — the validation names it).
+    With fixed true betas it reaches the same optimum as the full
+    58-col solve on shape-consistent targets (tests/test_specialize.py).
     """
     if data_term not in ("verts", "joints", "points",
                          "point_to_plane"):
@@ -514,6 +543,15 @@ def fit_lm(
             raise ValueError(
                 f"n_pca must be in [1, {max_pca}], got {n_pca}"
             )
+    n_shape = params.shape_basis.shape[-1]
+    if frozen_shape is not None:
+        frozen_shape = jnp.asarray(frozen_shape, params.v_template.dtype)
+        if frozen_shape.ndim not in (1, 2) \
+                or frozen_shape.shape[-1] != n_shape:
+            raise ValueError(
+                f"frozen_shape must be [{n_shape}] (or [B, {n_shape}] for "
+                f"batched problems), got {frozen_shape.shape}"
+            )
     single = functools.partial(
         _fit_single,
         params,
@@ -535,24 +573,43 @@ def fit_lm(
         fit_trans=fit_trans,
     )
     if target_verts.ndim == 2:
-        return single(target_verts, init=init)
-    if init is None:
-        return jax.vmap(lambda t: single(t, init=None))(target_verts)
-    # Batched warm start: one seed per problem on every init leaf.
-    init = {k: jnp.asarray(v, params.v_template.dtype)
-            for k, v in init.items()}
-    solvers.validate_batched_init(
-        init, target_verts.shape[0],
-        # LM's theta0 follows the Adam solvers' parameterizations ("aa"
-        # or "pca", optional trans) — same shape source, no hand-written
-        # mirror.
-        solvers._batched_init_shapes(
-            pose_space, params.j_regressor.shape[0], n_pca,
-            params.shape_basis.shape[-1], fit_trans=fit_trans,
-        ),
-        target_verts.shape, "fit_lm",
-    )
-    return jax.vmap(lambda t, i: single(t, init=i))(target_verts, init)
+        if frozen_shape is not None and frozen_shape.ndim != 1:
+            raise ValueError(
+                "single-problem fit_lm takes one frozen_shape [S], got "
+                f"{frozen_shape.shape}"
+            )
+        return single(target_verts, init=init, frozen_shape=frozen_shape)
+    # Batched problems: a [B, S] frozen_shape maps per problem (each its
+    # own frozen subject); a shared [S] broadcasts via in_axes=None —
+    # the target_conf policy applied to the frozen betas.
+    fs_axis = None
+    if frozen_shape is not None and frozen_shape.ndim == 2:
+        if frozen_shape.shape[0] != target_verts.shape[0]:
+            raise ValueError(
+                f"batched frozen_shape has {frozen_shape.shape[0]} rows "
+                f"for {target_verts.shape[0]} problems"
+            )
+        fs_axis = 0
+    if init is not None:
+        # Batched warm start: one seed per problem on every init leaf.
+        init = {k: jnp.asarray(v, params.v_template.dtype)
+                for k, v in init.items()}
+        solvers.validate_batched_init(
+            init, target_verts.shape[0],
+            # LM's theta0 follows the Adam solvers' parameterizations
+            # ("aa" or "pca", optional trans, frozen beta dropped) —
+            # same shape source, no hand-written mirror.
+            solvers._batched_init_shapes(
+                pose_space, params.j_regressor.shape[0], n_pca,
+                params.shape_basis.shape[-1], fit_trans=fit_trans,
+                freeze_shape=frozen_shape is not None,
+            ),
+            target_verts.shape, "fit_lm",
+        )
+    return jax.vmap(
+        lambda t, i, f: single(t, init=i, frozen_shape=f),
+        in_axes=(0, 0 if init else None, fs_axis),
+    )(target_verts, init, frozen_shape)
 
 
 def fit_lm_bucketed(

@@ -69,13 +69,17 @@ def _pose_init(pose_space, prefix, n_joints, n_pca, dtype, allowed):
 
 
 def _batched_init_shapes(pose_space, n_joints, n_pca, n_shape, fit_trans,
-                         allowed=frozenset({"aa", "pca", "6d"})):
+                         allowed=frozenset({"aa", "pca", "6d"}),
+                         freeze_shape=False):
     """Full per-problem parameter shapes for the active parameterization —
     plain tuples (no array materialization; this runs on every batched
     warm-started call). Pose shapes come from ``_pose_shapes``, the same
-    source ``_pose_init`` builds from."""
+    source ``_pose_init`` builds from. ``freeze_shape`` drops the beta
+    entry (frozen-betas mode: beta is a constant, not a parameter, so a
+    seeded ``init["shape"]`` must fail the key check by name)."""
     shapes = dict(_pose_shapes(pose_space, n_joints, n_pca, allowed))
-    shapes["shape"] = (n_shape,)
+    if not freeze_shape:
+        shapes["shape"] = (n_shape,)
     if fit_trans:
         shapes["trans"] = (3,)
     return shapes
@@ -764,16 +768,24 @@ def _fit_single(
     sil_sigma: float = 0.7,
     target_mask: Optional[jnp.ndarray] = None,  # [H, W] aux mask
     mask_weight: float = 0.1,
+    frozen_shape: Optional[jnp.ndarray] = None,  # [S]: pose-only fit
 ) -> FitResult:
     _check_data_term(data_term, camera, conf)
     _check_pose_prior(pose_prior, pose_space, joint_limits)
     dtype = params.v_template.dtype
     n_joints = params.j_regressor.shape[0]
     n_shape = params.shape_basis.shape[-1]
+    # Frozen-betas mode (the specialization split, models/core.py): beta
+    # is a known per-subject constant, so it leaves the parameter dict —
+    # the optimizer state, gradients and updates all shrink to pose-only.
+    freeze = frozen_shape is not None
+    if freeze:
+        frozen_shape = jnp.asarray(frozen_shape, dtype).reshape(n_shape)
 
     theta0 = _pose_init(pose_space, (), n_joints, n_pca, dtype,
                         allowed={"aa", "pca", "6d"})
-    theta0["shape"] = jnp.zeros((n_shape,), dtype)
+    if not freeze:
+        theta0["shape"] = jnp.zeros((n_shape,), dtype)
     if fit_trans:
         # Global translation DOF: the model itself has none (the reference
         # keeps hands at the origin), but image-space fitting needs the
@@ -803,13 +815,16 @@ def _fit_single(
                 )
             theta0[k] = v
 
+    def shape_of(p):
+        return frozen_shape if freeze else p["shape"]
+
     def model_out(p):
         if pose_space == "6d":
             return core.forward_rotmats(
-                params, ops.matrix_from_6d(p["rot6d"]), p["shape"]
+                params, ops.matrix_from_6d(p["rot6d"]), shape_of(p)
             )
         return core.forward(params, _pose_to_aa(pose_space, params, p),
-                            p["shape"])
+                            shape_of(p))
 
     def loss_fn(p):
         out = model_out(p)
@@ -827,12 +842,13 @@ def _fit_single(
                 sil_sigma,
             )
         # Prior weights may be traced scalars (see fit): plain multiplies.
-        reg = (
-            _pose_reg(pose_space, pose_prior, pose_prior_vars, params, p,
-                      dtype, pose_prior_weight, joint_limits,
-                      joint_limit_weight)
-            + shape_prior_weight * objectives.l2_prior(p["shape"])
-        )
+        reg = _pose_reg(pose_space, pose_prior, pose_prior_vars, params, p,
+                        dtype, pose_prior_weight, joint_limits,
+                        joint_limit_weight)
+        if not freeze:
+            # A frozen beta is a constant: its prior would add a constant
+            # with zero gradient — skip the term (and its backward).
+            reg = reg + shape_prior_weight * objectives.l2_prior(p["shape"])
         if self_pen_mask is not None and self_penetration_weight:
             # Static gate (see prepare_self_pen; the weight check keeps a
             # prebuilt-mask-with-zero-weight call from tracing the dense
@@ -849,7 +865,7 @@ def _fit_single(
     )
     return FitResult(
         pose=_pose_to_aa(pose_space, params, p_final),
-        shape=p_final["shape"],
+        shape=shape_of(p_final),
         final_loss=final_loss,
         loss_history=history,
         pca=p_final.get("pca"),
@@ -896,6 +912,7 @@ def fit(
     sil_sigma: float = 0.7,      # silhouette edge softness, pixels
     target_mask: Optional[jnp.ndarray] = None,  # [H, W] / [B, H, W]
     mask_weight: float = 0.1,
+    frozen_shape: Optional[jnp.ndarray] = None,  # [S] or [B, S]
 ) -> FitResult:
     """Recover pose/shape for one target mesh or a batch of them.
 
@@ -955,6 +972,14 @@ def fit(
     other, the classic failure of sparse keypoint observations. The
     part-adjacency mask is built from the asset's skinning weights
     before the jit boundary (``prepare_self_pen``).
+
+    ``frozen_shape`` pins beta to a known per-subject constant and fits
+    pose only (the specialization split's first-order counterpart of
+    ``fit_lm``'s frozen mode — see ``models.core.specialize``): the
+    parameter dict, optimizer state and gradients all shrink to the
+    pose DOFs, the shape prior drops out, and ``FitResult.shape``
+    returns the frozen betas. [B, S] gives batched problems their own
+    subjects; ``init`` must not seed ``"shape"``.
     """
     return fit_with_optimizer(
         params, target_verts, optax.adam(lr),
@@ -972,6 +997,7 @@ def fit(
         sil_sigma=sil_sigma,
         target_mask=target_mask,
         mask_weight=mask_weight,
+        frozen_shape=frozen_shape,
     )
 
 
@@ -1005,6 +1031,7 @@ def fit_with_optimizer(
     sil_sigma: float = 0.7,
     target_mask: Optional[jnp.ndarray] = None,
     mask_weight: float = 0.1,
+    frozen_shape: Optional[jnp.ndarray] = None,
 ) -> FitResult:
     _check_data_term(data_term, camera, target_conf)
     if target_mask is not None:
@@ -1012,6 +1039,14 @@ def fit_with_optimizer(
             data_term, target_mask, params.v_template.dtype
         )
     target_verts = jnp.asarray(target_verts, params.v_template.dtype)
+    if frozen_shape is not None:
+        frozen_shape = jnp.asarray(frozen_shape, params.v_template.dtype)
+        n_sh = params.shape_basis.shape[-1]
+        if frozen_shape.ndim not in (1, 2) or frozen_shape.shape[-1] != n_sh:
+            raise ValueError(
+                f"frozen_shape must be [{n_sh}] (or [B, {n_sh}] for "
+                f"batched problems), got {frozen_shape.shape}"
+            )
     tips, n_kp = check_keypoint_spec(
         params, data_term, tip_vertex_ids, keypoint_order, target_verts,
         "fit",
@@ -1057,19 +1092,26 @@ def fit_with_optimizer(
                 "single-problem fits take one [H, W] target_mask, got "
                 f"{target_mask.shape}"
             )
+        if frozen_shape is not None and frozen_shape.ndim != 1:
+            raise ValueError(
+                "single-problem fits take one frozen_shape [S], got "
+                f"{frozen_shape.shape}"
+            )
         return single(target_verts, target_conf, init=init,
-                      target_mask=target_mask)
+                      target_mask=target_mask, frozen_shape=frozen_shape)
     # Batched problems: map conf per-problem when it is [B, J]; a shared
     # [J] conf (or None) broadcasts via in_axes=None. A warm-start init
     # must carry the batch on every leaf (one seed per problem). The aux
     # mask follows the conf policy: [B, H, W] maps per problem, [H, W]
-    # is shared.
+    # is shared — and the frozen betas follow it too ([B, S] per
+    # problem, [S] one shared subject).
     if init:
         validate_batched_init(
             init, target_verts.shape[0],
             _batched_init_shapes(
                 pose_space, params.j_regressor.shape[0], n_pca,
                 params.shape_basis.shape[-1], fit_trans,
+                freeze_shape=frozen_shape is not None,
             ),
             target_verts.shape, "fit",
         )
@@ -1077,6 +1119,8 @@ def fit_with_optimizer(
                       and target_conf.ndim == 2) else None
     mask_axis = 0 if (target_mask is not None
                       and target_mask.ndim == 3) else None
+    fs_axis = 0 if (frozen_shape is not None
+                    and frozen_shape.ndim == 2) else None
     if (mask_axis == 0
             and target_mask.shape[0] != target_verts.shape[0]):
         # Named error, not vmap's generic "inconsistent sizes".
@@ -1086,10 +1130,17 @@ def fit_with_optimizer(
             f"{target_mask.shape} vs {target_verts.shape}); pass one "
             "[H, W] mask to share it"
         )
+    if fs_axis == 0 and frozen_shape.shape[0] != target_verts.shape[0]:
+        raise ValueError(
+            f"batched frozen_shape has {frozen_shape.shape[0]} rows for "
+            f"{target_verts.shape[0]} problems; pass one [S] vector to "
+            "share the subject"
+        )
     return jax.vmap(
-        lambda t, c, i, m: single(t, c, init=i, target_mask=m),
-        in_axes=(0, conf_axis, 0 if init else None, mask_axis),
-    )(target_verts, target_conf, init, target_mask)
+        lambda t, c, i, m, f: single(t, c, init=i, target_mask=m,
+                                     frozen_shape=f),
+        in_axes=(0, conf_axis, 0 if init else None, mask_axis, fs_axis),
+    )(target_verts, target_conf, init, target_mask, frozen_shape)
 
 
 # ------------------------------------------------------------- sequences
@@ -1311,10 +1362,12 @@ def bucketed_fit_call(fit_fn, params, targets, *, min_bucket, max_bucket,
     # targets and must pad with them (an unpadded [B, ...] conf against
     # [bucket, ...] targets dies as a vmap axis mismatch mid-trace).
     # Batched-vs-shared is decided by RANK, exactly like the solvers
-    # themselves do (conf: [B, J] vs [J]; mask: [B, H, W] vs [H, W]) —
-    # a shape[0]==b test alone would pad a shared [H, W] mask whose
-    # height merely coincides with the problem count.
-    for aux, batched_ndim in (("target_conf", 2), ("target_mask", 3)):
+    # themselves do (conf: [B, J] vs [J]; mask: [B, H, W] vs [H, W];
+    # frozen betas: [B, S] vs [S]) — a shape[0]==b test alone would pad
+    # a shared [H, W] mask whose height merely coincides with the
+    # problem count.
+    for aux, batched_ndim in (("target_conf", 2), ("target_mask", 3),
+                              ("frozen_shape", 2)):
         v = kw.get(aux)
         if v is not None:
             v = jnp.asarray(v)

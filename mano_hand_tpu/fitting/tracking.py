@@ -51,6 +51,7 @@ def make_tracker(
     fit_trans: bool = False,
     shape_prior_weight: float = 1e-3,
     camera=None,
+    frozen_shape=None,           # [S]: pose-only tracking, betas pinned
     **solver_kw,
 ) -> Tuple[TrackState, Callable]:
     """Build a streaming tracker; returns ``(initial_state, track_step)``.
@@ -70,6 +71,14 @@ def make_tracker(
     The shape estimate is re-optimized each frame but warm-started, so it
     settles once the subject is established (one identity per stream —
     the same collapse ``fit_sequence`` gets by construction).
+
+    ``frozen_shape`` pins beta for the WHOLE stream (the specialization
+    split's tracking mode, ``models.core.specialize``): every frame
+    solves pose only — 48 free columns instead of 58 on the LM path —
+    and ``TrackState.shape`` carries the constant. The right mode once
+    the subject's betas are known (a calibration fit, an enrolled user);
+    with the true betas the per-frame solves reach the same optimum as
+    the free-shape solve (tests/test_specialize.py).
     """
     if solver not in ("adam", "lm"):
         raise ValueError(f"solver must be 'adam' or 'lm', got {solver!r}")
@@ -106,9 +115,12 @@ def make_tracker(
     dtype = params.v_template.dtype
     n_joints = params.j_regressor.shape[0]
     n_shape = params.shape_basis.shape[-1]
+    if frozen_shape is not None:
+        frozen_shape = jnp.asarray(frozen_shape, dtype).reshape(n_shape)
     state0 = TrackState(
         pose=jnp.zeros((n_joints, 3), dtype),
-        shape=jnp.zeros((n_shape,), dtype),
+        shape=(jnp.zeros((n_shape,), dtype) if frozen_shape is None
+               else frozen_shape),
         trans=jnp.zeros((3,), dtype) if fit_trans else None,
         frame=0,
     )
@@ -142,13 +154,18 @@ def make_tracker(
                     trans0 = seed["trans"].astype(dtype)
             except ValueError:
                 pass   # row-count mismatch etc.: keep the rest seed
-        init = {"pose": pose0, "shape": state.shape}
+        init = {"pose": pose0}
+        if frozen_shape is None:
+            # Free-shape mode warm-starts beta; in frozen mode there is
+            # no such parameter to seed (the solvers would reject it).
+            init["shape"] = state.shape
         if fit_trans:
             init["trans"] = trans0
         if solver == "lm":
             res = lm_mod.fit_lm(
                 params, target, n_steps=n_steps, data_term=data_term,
-                fit_trans=fit_trans, init=init, **solver_kw,
+                fit_trans=fit_trans, init=init,
+                frozen_shape=frozen_shape, **solver_kw,
             )
         else:
             res = solvers.fit(
@@ -156,7 +173,7 @@ def make_tracker(
                 data_term=data_term, camera=camera,
                 fit_trans=fit_trans,
                 shape_prior_weight=shape_prior_weight,
-                init=init, **solver_kw,
+                init=init, frozen_shape=frozen_shape, **solver_kw,
             )
         new_state = TrackState(
             pose=res.pose,

@@ -15,8 +15,9 @@ the [B, V, 3, 3] blend-rotation intermediate.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
-from typing import NamedTuple, Optional
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -36,6 +37,145 @@ class ManoOutput(NamedTuple):
     rest_verts: jnp.ndarray    # [..., V, 3] blendshaped mesh pre-skinning
     rot_mats: jnp.ndarray      # [..., J, 3, 3] per-joint rotations
     posed_joints: jnp.ndarray  # [..., J, 3] world joints after FK
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ShapedHand:
+    """A subject's shape stage, baked once by ``specialize``.
+
+    The MANO forward factors cleanly at the shape/pose boundary
+    (/root/reference/mano_np.py:81-83 vs 87-115): ``v_shaped`` and the
+    rest joints depend ONLY on beta, while the pose stage (pose blend,
+    FK, LBS) consumes them plus the pose. This PyTree carries everything
+    the pose stage needs — the baked shape constants AND the
+    shape-independent parameter leaves (referenced, not copied) — so
+    ``forward_posed(shaped, pose)`` is self-contained. A registered
+    dataclass like ``ManoParams``: jit/vmap/grad-friendly, ``parents``
+    static aux data.
+    """
+
+    v_shaped: Any      # [V, 3] shape-blendshaped template (mano_np.py:81)
+    joints: Any        # [J, 3] rest joints, Jreg @ v_shaped (mano_np.py:83)
+    shape: Any         # [S] the baked betas (provenance / LMResult.shape)
+    pose_basis: Any    # [V, 3, P] pose-corrective basis (shared leaf)
+    lbs_weights: Any   # [V, J] skinning weights (shared leaf)
+    parents: Tuple[int, ...] = dataclasses.field(
+        default=constants.MANO_PARENTS, metadata={"static": True}
+    )
+
+    @property
+    def n_joints(self) -> int:
+        return self.joints.shape[-2]
+
+    @property
+    def n_verts(self) -> int:
+        return self.v_shaped.shape[-2]
+
+
+def specialize(
+    params: ManoParams,
+    shape: Optional[jnp.ndarray] = None,  # [S]
+    precision=DEFAULT_PRECISION,
+) -> ShapedHand:
+    """Bake one subject's betas into a :class:`ShapedHand`.
+
+    Runs EXACTLY the shape stage of ``forward_rotmats`` — the same
+    ``ops.shape_blend`` / ``ops.regress_joints`` calls at the same
+    precision — so ``forward_posed(specialize(params, beta), pose)`` is
+    bit-identical to ``forward(params, pose, beta)`` in the same
+    precision/batching context (pinned in tests/test_specialize.py).
+    The serving pattern: per-subject traffic (frame-to-frame tracking,
+    per-user inference) holds beta fixed across thousands of calls, so
+    the shape stage is paid once here instead of per call. Batch over
+    subjects with ``jax.vmap`` over ``shape`` (params closed over) —
+    but note the shared basis leaves are then broadcast per row; for a
+    one-subject stream keep ONE ShapedHand and batch only the pose.
+    """
+    dtype = params.v_template.dtype
+    if shape is None:
+        shape = jnp.zeros((params.shape_basis.shape[-1],), dtype=dtype)
+    shape = jnp.asarray(shape).astype(dtype)
+    v_shaped = ops.shape_blend(
+        params.v_template, params.shape_basis, shape, precision
+    )
+    joints = ops.regress_joints(params.j_regressor, v_shaped, precision)
+    return ShapedHand(
+        v_shaped=v_shaped,
+        joints=joints,
+        shape=shape,
+        pose_basis=params.pose_basis,
+        lbs_weights=params.lbs_weights,
+        parents=params.parents,
+    )
+
+
+def forward_posed(
+    shaped: ShapedHand,
+    pose: Optional[jnp.ndarray] = None,   # [J, 3] axis-angle, row 0 global
+    precision=DEFAULT_PRECISION,
+) -> ManoOutput:
+    """Pose-only forward over a baked shape stage.
+
+    The second half of the ``specialize``/``forward_posed`` split: pose
+    blend -> FK -> LBS (/root/reference/mano_np.py:87-115), identical
+    op-for-op to the corresponding stages of ``forward`` — so the output
+    is bit-identical to the full path under the same precision and
+    batching structure, while skipping the per-call shape blend and
+    joint regression entirely. Batch with ``jax.vmap`` over ``pose``
+    (one subject, many poses) — the steady-state serving shape.
+    """
+    n_joints = shaped.joints.shape[0]
+    dtype = shaped.v_shaped.dtype
+    if pose is None:
+        pose = jnp.zeros((n_joints, 3), dtype=dtype)
+    pose = pose.reshape(n_joints, 3).astype(dtype)
+    return forward_posed_rotmats(shaped, ops.rotation_matrix(pose), precision)
+
+
+def forward_posed_rotmats(
+    shaped: ShapedHand,
+    rot_mats: jnp.ndarray,   # [J, 3, 3] per-joint rotations, row 0 global
+    precision=DEFAULT_PRECISION,
+) -> ManoOutput:
+    """Pose-only forward from rotation MATRICES (``forward_posed`` minus
+    Rodrigues — same input contract as ``forward_rotmats``)."""
+    n_joints = shaped.joints.shape[0]
+    dtype = shaped.v_shaped.dtype
+    rot_mats = rot_mats.reshape(n_joints, 3, 3).astype(dtype)
+    v_posed = ops.pose_blend(
+        shaped.v_shaped, shaped.pose_basis, rot_mats, precision
+    )
+    world_rot, world_t = ops.forward_kinematics(
+        shaped.parents, rot_mats, shaped.joints, precision
+    )
+    skin_rot, skin_t = ops.skinning_transforms(
+        world_rot, world_t, shaped.joints, precision
+    )
+    verts = ops.skin(shaped.lbs_weights, skin_rot, skin_t, v_posed, precision)
+    return ManoOutput(
+        verts=verts,
+        joints=shaped.joints,
+        rest_verts=v_posed,
+        rot_mats=rot_mats,
+        posed_joints=world_t,
+    )
+
+
+def forward_posed_batched(
+    shaped: ShapedHand,
+    pose: jnp.ndarray,       # [B, J, 3]
+    precision=DEFAULT_PRECISION,
+) -> ManoOutput:
+    """vmap the pose-only forward over a pose batch; the ShapedHand is
+    closed over (ONE subject's constants shared by every row — the
+    steady-state serving/tracking shape). Results match a direct
+    ``forward_batched(params, pose, broadcast(beta), fused=False)`` to
+    float rounding (the shared-vs-per-row shape stage changes batched
+    contraction shapes by design; the bit-identity contract holds at
+    matched batching structure — tests/test_specialize.py)."""
+    pose = pose.reshape(pose.shape[0], -1, 3)
+    return jax.vmap(lambda q: forward_posed(shaped, q, precision))(pose)
 
 
 def decode_pca(
@@ -711,6 +851,26 @@ def jit_forward_rotmats(params, rot_mats, shape,
                         precision=DEFAULT_PRECISION):
     """Convenience jitted single-hand rotation-matrix forward."""
     return forward_rotmats(params, rot_mats, shape, precision)
+
+
+@functools.partial(jax.jit, static_argnames=("precision",))
+def jit_specialize(params, shape, precision=DEFAULT_PRECISION):
+    """Convenience jitted shape-stage bake (params ride as runtime
+    arguments, like every jitted entry here — constant-baking would
+    change float folding and break the bit-identity contract)."""
+    return specialize(params, shape, precision)
+
+
+@functools.partial(jax.jit, static_argnames=("precision",))
+def jit_forward_posed(shaped, pose, precision=DEFAULT_PRECISION):
+    """Convenience jitted single-hand pose-only forward."""
+    return forward_posed(shaped, pose, precision)
+
+
+@functools.partial(jax.jit, static_argnames=("precision",))
+def jit_forward_posed_batched(shaped, pose, precision=DEFAULT_PRECISION):
+    """Convenience jitted batched pose-only forward."""
+    return forward_posed_batched(shaped, pose, precision)
 
 
 @functools.partial(jax.jit, static_argnames=("precision",))

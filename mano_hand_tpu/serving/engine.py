@@ -35,6 +35,15 @@ tunneled chip need their own kill-9-capable supervisor (the
 `serve-bench` CLI arms a hard-exit deadline watchdog; bench.py's
 config7 rides under bench's own watchdog).
 
+* **specializes per subject** (the shape-split cache, PR 2): dominant
+  production streams hold betas fixed per subject for thousands of
+  calls, so ``specialize(betas)`` bakes the shape stage ONCE
+  (models/core.py:specialize) and ``submit(pose, subject=key)`` runs a
+  pose-only program thereafter. The pose-only per-bucket executables
+  take the baked constants as runtime arguments, so they are shared by
+  ALL subjects — steady-state per-subject traffic composes both caches
+  with zero recompiles (counted, not hoped: ``ServingCounters``).
+
 Typical use::
 
     eng = ServingEngine(params, max_bucket=256, aot_dir="serve_cache/")
@@ -42,6 +51,8 @@ Typical use::
         fut = eng.submit(pose_n16x3, shape_n10)   # async
         verts = fut.result()                      # [n, 778, 3]
         verts = eng.forward(pose, shape)          # sync convenience
+        subj = eng.specialize(betas)              # bake the shape stage
+        verts = eng.forward(pose, subject=subj)   # pose-only fast path
     print(eng.counters.snapshot())
 """
 
@@ -99,14 +110,41 @@ def build_bucket_executable(params_dev, bucket: int, n_joints: int,
     return lambda p, s: jitted(params_dev, p, s)
 
 
-class _Request:
-    __slots__ = ("pose", "shape", "rows", "squeeze", "future", "t_submit")
+def build_posed_bucket_executable(shaped_dev, bucket: int, n_joints: int,
+                                  dtype, donate: bool):
+    """The per-bucket POSE-ONLY executable (specialization fast path).
 
-    def __init__(self, pose, shape, rows, squeeze):
+    The ShapedHand rides as a runtime ARGUMENT — same reasoning as the
+    params above (constant-baking changes float folding), with a second
+    payoff: ONE compiled program per bucket serves EVERY subject, so a
+    new subject costs one specialization (a data computation) and zero
+    compiles. Only the pose buffer is donated; the shaped constants are
+    reused across the whole steady-state stream. Eagerly warmed with a
+    dummy pose batch; the caller counts the compile.
+    """
+    import jax
+
+    from mano_hand_tpu.models import core
+
+    jitted = jax.jit(
+        lambda sh, p: core.forward_posed_batched(sh, p).verts,
+        donate_argnums=(1,) if donate else (),
+    )
+    jax.block_until_ready(jitted(
+        shaped_dev, np.zeros((bucket, n_joints, 3), dtype)))
+    return jitted
+
+
+class _Request:
+    __slots__ = ("pose", "shape", "rows", "squeeze", "subject", "future",
+                 "t_submit")
+
+    def __init__(self, pose, shape, rows, squeeze, subject=None):
         self.pose = pose
-        self.shape = shape
+        self.shape = shape          # None on the pose-only (subject) path
         self.rows = rows
         self.squeeze = squeeze
+        self.subject = subject      # specialization digest or None (full)
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
 
@@ -162,6 +200,9 @@ class ServingEngine:
         self._n_shape = params.n_shape
         self._params_dev = None        # device-resident params (jit path)
         self._exes: dict = {}          # bucket -> compiled callable
+        self._shaped: dict = {}        # betas digest -> core.ShapedHand
+        self._posed_exes: dict = {}    # bucket -> pose-only executable
+        #   (subject-agnostic: the shaped constants are runtime args)
         self._exe_lock = threading.Lock()
         self._queue: queue.Queue = queue.Queue()
         self._thread: Optional[threading.Thread] = None
@@ -200,11 +241,69 @@ class ServingEngine:
         self.stop()
 
     # ------------------------------------------------------------- requests
-    def submit(self, pose, shape=None) -> Future:
+    def specialize(self, shape) -> str:
+        """Bake one subject's betas; returns the subject key for
+        ``submit(pose, subject=key)``.
+
+        The per-subject specialization cache (models/core.py:specialize
+        made serving-shaped): the first call for a betas value runs the
+        shape stage ONCE on device and stores the ShapedHand under a
+        content digest; repeats are a dict hit. Steady-state per-subject
+        traffic then composes BOTH caches — this one (shape stage baked)
+        and the pose-only bucket-executable cache (one compiled program
+        per bucket, shared across subjects) — so a warm stream runs with
+        zero recompiles AND zero shape-stage recomputes, observable on
+        ``counters`` (``specializations``/``shaped_hits``).
+        """
+        shape = np.ascontiguousarray(
+            np.asarray(shape, self._dtype).reshape(self._n_shape))
+        import hashlib
+
+        key = hashlib.sha256(shape.tobytes()).hexdigest()[:16]
+        with self._exe_lock:
+            hit = key in self._shaped
+        if hit:
+            self.counters.count_specialize(hit=True)
+            return key
+        from mano_hand_tpu.models import core
+
+        if self._params_dev is None:
+            self._params_dev = self._params.device_put()
+        shaped = core.jit_specialize(self._params_dev, shape)
+        with self._exe_lock:
+            # First writer wins, like the executable caches.
+            self._shaped.setdefault(key, shaped)
+        self.counters.count_specialize(hit=False)
+        return key
+
+    def warmup_posed(self, bucket_list: Optional[Sequence[int]] = None,
+                     ) -> dict:
+        """Build the pose-only per-bucket executables up front (requires
+        at least one ``specialize``d subject for the warm-up batch).
+        Returns {bucket: "jit" | "cached"} — after this, pose-only
+        traffic over these buckets compiles NOTHING, for any number of
+        subjects (the acceptance criterion's composed-cache half)."""
+        out = {}
+        for b in bucket_list or self.buckets:
+            if b not in self.buckets:
+                raise ValueError(f"{b} is not one of {self.buckets}")
+            with self._exe_lock:
+                known = b in self._posed_exes
+            out[b] = "cached" if known else "jit"
+            if not known:
+                self._posed_executable(b)
+        return out
+
+    def submit(self, pose, shape=None, subject: Optional[str] = None,
+               ) -> Future:
         """Enqueue one forward request; returns a Future of the verts.
 
         ``pose`` is [n, J, 3] (Future resolves to [n, V, 3]) or a single
         [J, 3] (resolves to [V, 3]). ``shape`` defaults to zeros.
+        ``subject`` (a key from ``specialize``) routes the request down
+        the pose-only fast path instead — the baked shape stage is
+        reused and only the pose stage runs per call; ``shape`` must be
+        omitted there (the subject IS the shape).
         """
         pose = np.asarray(pose, self._dtype)
         squeeze = pose.ndim == 2
@@ -224,7 +323,18 @@ class ServingEngine:
                 f"request of {n} rows exceeds the largest bucket "
                 f"{self.buckets[-1]}; chunk upstream "
                 "(core.forward_chunked) or raise max_bucket")
-        if shape is None:
+        if subject is not None:
+            if shape is not None:
+                raise ValueError(
+                    "pass either shape (full path) or subject (pose-only "
+                    "path), not both — the subject IS the baked shape")
+            with self._exe_lock:
+                known = subject in self._shaped
+            if not known:
+                raise ValueError(
+                    f"unknown subject {subject!r}; call "
+                    "specialize(betas) first")
+        elif shape is None:
             shape = np.zeros((n, self._n_shape), self._dtype)
         else:
             shape = np.asarray(shape, self._dtype)
@@ -237,7 +347,7 @@ class ServingEngine:
         if self._failure is not None:
             raise RuntimeError(
                 "serving engine dispatcher died") from self._failure
-        req = _Request(pose, shape, n, squeeze)
+        req = _Request(pose, shape, n, squeeze, subject)
         self.start()
         self._queue.put(req)
         if self._failure is not None:
@@ -249,9 +359,10 @@ class ServingEngine:
                 "serving engine dispatcher died") from self._failure
         return req.future
 
-    def forward(self, pose, shape=None) -> np.ndarray:
+    def forward(self, pose, shape=None,
+                subject: Optional[str] = None) -> np.ndarray:
         """Synchronous convenience: ``submit(...).result()``."""
-        return self.submit(pose, shape).result()
+        return self.submit(pose, shape, subject=subject).result()
 
     def warmup(self, bucket_list: Optional[Sequence[int]] = None) -> dict:
         """Build (or AOT-load) executables for the given buckets up front.
@@ -357,6 +468,31 @@ class ServingEngine:
             exe = self._exes.setdefault(bucket, loaded)
         return exe
 
+    def _posed_executable(self, bucket: int):
+        """The pose-only per-bucket entry — in-memory then jit, no AOT
+        tier (the ShapedHand is a runtime argument, so the artifact
+        would bake nothing subject-specific; the jit compile is already
+        amortized across ALL subjects). Compiles count on ``counters``
+        exactly like the full path's."""
+        with self._exe_lock:
+            exe = self._posed_exes.get(bucket)
+            proto = (next(iter(self._shaped.values()))
+                     if self._shaped else None)
+        if exe is not None:
+            return exe
+        if proto is None:
+            # Unreachable through submit (it requires a registered
+            # subject), but warmup_posed can get here.
+            raise RuntimeError(
+                "no specialized subject to warm the pose-only path "
+                "with; call specialize(betas) first")
+        exe = build_posed_bucket_executable(
+            proto, bucket, self._n_joints, self._dtype, donate=self.donate)
+        self.counters.count_compile()
+        with self._exe_lock:
+            exe = self._posed_exes.setdefault(bucket, exe)
+        return exe
+
     # ------------------------------------------------------------ dispatch
     def _coalesce(self, first: _Request):
         """Gather more pending requests behind ``first`` until the largest
@@ -372,6 +508,13 @@ class ServingEngine:
                 break
             if nxt is _SENTINEL:
                 self._queue.put(_SENTINEL)  # re-post for the main loop
+                break
+            if nxt.subject != first.subject:
+                # A batch is one program over one parameter set: full and
+                # pose-only requests — or two different subjects' shaped
+                # constants — cannot share a dispatch. The mismatched
+                # request leads the next batch (the overflow rule).
+                self._leftover = nxt
                 break
             if rows + nxt.rows > self.buckets[-1]:
                 # Would overflow the largest bucket: dispatch what we
@@ -433,14 +576,21 @@ class ServingEngine:
         try:
             bucket = bucket_mod.bucket_for(rows, self.buckets)
             if len(reqs) == 1:
-                pose, shape = reqs[0].pose, reqs[0].shape
+                pose = reqs[0].pose
             else:
                 pose = np.concatenate([r.pose for r in reqs])
-                shape = np.concatenate([r.shape for r in reqs])
             pose = bucket_mod.pad_rows(pose, bucket)
-            shape = bucket_mod.pad_rows(shape, bucket)
-            exe = self._executable(bucket)
-            out = exe(pose, shape)  # async dispatch: returns pre-completion
+            subject = reqs[0].subject  # uniform per batch (_coalesce)
+            if subject is not None:
+                with self._exe_lock:
+                    shaped = self._shaped[subject]
+                out = self._posed_executable(bucket)(shaped, pose)
+            else:
+                shape = (reqs[0].shape if len(reqs) == 1 else
+                         np.concatenate([r.shape for r in reqs]))
+                shape = bucket_mod.pad_rows(shape, bucket)
+                exe = self._executable(bucket)
+                out = exe(pose, shape)  # async dispatch: pre-completion
             self.counters.count_dispatch(bucket, rows)
             return out, reqs, bucket
         except BaseException as e:

@@ -112,6 +112,8 @@ class ServingCounters:
         self.rows_live = 0         # real request rows dispatched
         self.rows_padded = 0       # pad rows dispatched alongside them
         self.queue_depth_peak = 0  # max pending requests seen at coalesce
+        self.specializations = 0   # shape-stage bakes (subject-cache misses)
+        self.shaped_hits = 0       # subject-cache hits (bake reused)
         self._latencies: Dict[int, list] = {}  # bucket -> [seconds]
         self._latency_writes: Dict[int, int] = {}  # per-bucket write cursor
 
@@ -123,6 +125,17 @@ class ServingCounters:
     def count_aot_load(self, n: int = 1) -> None:
         with self._lock:
             self.aot_loads += n
+
+    def count_specialize(self, hit: bool) -> None:
+        """One per-subject specialization lookup (serving/engine.py): a
+        miss ran the shape-stage bake (a DATA computation — not a
+        compile; ``compiles`` stays the zero-recompile criterion's
+        counter), a hit reused the cached ShapedHand."""
+        with self._lock:
+            if hit:
+                self.shaped_hits += 1
+            else:
+                self.specializations += 1
 
     def count_dispatch(self, bucket: int, live_rows: int) -> None:
         with self._lock:
@@ -186,6 +199,8 @@ class ServingCounters:
                 "rows_live": self.rows_live,
                 "rows_padded": self.rows_padded,
                 "queue_depth_peak": self.queue_depth_peak,
+                "specializations": self.specializations,
+                "shaped_hits": self.shaped_hits,
             }
         base["padding_waste"] = round(self.padding_waste, 4)
         base["latency_by_bucket"] = self.latency_quantiles()

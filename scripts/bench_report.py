@@ -135,6 +135,55 @@ def main() -> int:
               f"{srv.get('queue_depth_peak')}, p50/p99 ms by bucket "
               f"{lat}")
 
+    def judge_specialization(spec):
+        """Done-criteria of the shape-specialization leg (config8):
+        pose-only forward >= 1.15x the full forward, frozen-betas LM
+        step >= 1.1x the 58-col step at b >= 64, numerics gated."""
+        sp = spec.get("posed_speedup")
+        if "batch" in spec:
+            # The forward half RAN (its section always records "batch");
+            # judge it — including the case where a NaN slope/probe was
+            # scrubbed to null by bench.py's emit (sp/nerr None must
+            # FAIL, not silently skip: that is a numerically broken or
+            # unmeasurable path, not an unmeasured one). A deliberately
+            # skipped half (--spec-batch 0) records no keys at all and
+            # is skipped here, like the LM half's guard below.
+            check("spec_posed_115x", sp is not None and sp >= 1.15,
+                  f"pose-only {spec.get('posed_evals_per_sec')} vs full "
+                  f"{spec.get('full_evals_per_sec')} evals/s at "
+                  f"b={spec.get('batch')} (speedup {sp}x, bit-identical "
+                  "staged pair)")
+            nerr = spec.get("posed_vs_full_max_abs_err")
+            # Same 1e-4 gate as every other compiled path (CLAUDE.md
+            # numerics rule).
+            check("spec_numerics_gate", nerr is not None and nerr < 1e-4,
+                  f"pose-only vs full max abs err "
+                  f"{'NaN (scrubbed)' if nerr is None else f'{nerr:.3e}'}")
+            tl = spec.get("timed_loop_rel_diff")
+            if tl is not None:
+                # The timed executables' own in-context cross-check
+                # (collapse-scale gate; see bench.py config8).
+                check("spec_timed_context_gate", tl < 1e-3,
+                      f"timed-loop scalar rel diff {tl:.3g} "
+                      "(in-context collapse probe, gate 1e-3)")
+        lm_sp = spec.get("lm_frozen_speedup")
+        bf = spec.get("fit_batch")
+        if lm_sp is not None:
+            finite = spec.get("lm_frozen_finite")
+            msg = (f"58-col {spec.get('lm_full_steps_per_sec')} vs frozen "
+                   f"48-col {spec.get('lm_frozen_steps_per_sec')} steps/s "
+                   f"at b={bf} (speedup {lm_sp}x, loss ratio "
+                   f"{spec.get('lm_frozen_loss_ratio')}, finite={finite})")
+            # A diverged (non-finite) frozen solve must fail regardless
+            # of batch size — speed means nothing off a NaN loss.
+            check("spec_lm_frozen_finite", bool(finite), msg)
+            if bf is not None and bf >= 64:
+                check("spec_lm_frozen_11x", lm_sp >= 1.1, msg)
+            else:
+                # The speed criterion is defined at b >= 64; a smaller
+                # smoke run records the numbers without judging them.
+                print(f"  [info] spec LM (b<64, speed unjudged): {msg}")
+
     if line.get("metric") == "serving_engine_evals_per_sec":
         # A `bench.py --serving-only` artifact (make serve-smoke): only
         # the serving criteria apply.
@@ -179,6 +228,23 @@ def main() -> int:
         # archived r0x runs — and is judged on what it has.)
         check("serving_leg_ran", False,
               f"config7 crashed: {line['config_errors']['config7_serving']}")
+
+    spec = detail.get("specialization")
+    cfg_errs = line.get("config_errors") or {}
+    if spec:
+        # Shape-specialization leg (config8, PR 2) — same presence rule
+        # as serving: judge it wherever it ran.
+        judge_specialization(spec)
+        for name in ("config8_specialization", "config8_spec_lm"):
+            if name in cfg_errs:
+                # One half ran, the other crashed: the missing half's
+                # criteria must fail loudly, not vanish.
+                check(f"{name}_ran", False, f"crashed: {cfg_errs[name]}")
+    elif ("config8_specialization" in cfg_errs
+          or "config8_spec_lm" in cfg_errs):
+        check("specialization_leg_ran", False,
+              f"config8 crashed: "
+              f"{cfg_errs.get('config8_specialization') or cfg_errs.get('config8_spec_lm')}")
 
     smplh = detail.get("smplh_fused_full_max_err")
     if smplh is not None:

@@ -225,6 +225,12 @@ def test_bench_cpu_tiny_run_end_to_end():
         "--init-retries", "2", "--init-timeout", "60",
         "--sil-size", "24", "--serving-requests", "32",
         "--serving-max-rows", "8", "--serving-max-bucket", "16",
+        # Tiny specialization forward half only: this test checks
+        # PLUMBING inside the suite's 870 s tier-1 window, and the LM
+        # half's scan compiles are never warm here (fresh bench cache
+        # per run). The LM half is covered by `make bench-interpret`;
+        # the criteria-sized leg runs in the bench-cpu lane.
+        "--spec-batch", "16", "--spec-fit-batch", "0",
     )
     assert rc == 0, line
     assert line["value"] is not None and line["value"] > 0
@@ -244,6 +250,12 @@ def test_bench_cpu_tiny_run_end_to_end():
     assert srv["steady_recompiles"] == 0
     assert srv["engine_evals_per_sec"] > 0
     assert 0.0 <= srv["padding_waste"] < 1.0
+    # The specialization leg's forward half (config8) rode along too
+    # (the LM half is disabled above; `make bench-interpret` covers it).
+    spec = d["specialization"]
+    assert spec["posed_evals_per_sec"] > 0
+    assert spec["posed_vs_full_max_abs_err"] < 1e-4
+    assert "lm_frozen_steps_per_sec" not in spec
     assert "config_errors" not in line, line.get("config_errors")
 
 

@@ -226,6 +226,29 @@ def test_layer_forward_bucketed(params):
         model.forward_bucketed(pose[0])
 
 
+def test_layer_forward_bucketed_parity_edges(params):
+    """Satellite (ISSUE 2): forward_bucketed == direct ``__call__`` at
+    awkward batch sizes — single row (bucket 1, maximal relative pad
+    pressure at the other end), non-powers of two straddling bucket
+    boundaries — and the bucket-policy edge: a request LARGER than the
+    largest bucket refuses by name instead of silently truncating or
+    recompiling an off-policy shape."""
+    from mano_hand_tpu.models.layer import MANOModel
+
+    model = MANOModel(params)
+    rng = np.random.default_rng(17)
+    for n in (1, 3, 7, 11, 15):
+        pose = rng.normal(scale=0.4, size=(n, 16, 3)).astype(np.float32)
+        shape = rng.normal(size=(n, 10)).astype(np.float32)
+        got = model.forward_bucketed(pose, shape, max_bucket=16)
+        want = model(pose=pose, shape=shape)
+        assert got.shape == (n, 778, 3)
+        np.testing.assert_array_equal(got, np.asarray(want, np.float32))
+    pose = rng.normal(scale=0.4, size=(17, 16, 3)).astype(np.float32)
+    with pytest.raises(ValueError, match="exceeds the largest bucket"):
+        model.forward_bucketed(pose, max_bucket=16)
+
+
 # --------------------------------------------------- bucketed fit wrappers
 def test_fit_lm_bucketed_matches_and_reuses(params32):
     from mano_hand_tpu.fitting import fit_lm, fit_lm_bucketed

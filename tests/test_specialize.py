@@ -1,0 +1,291 @@
+"""The shape-specialization split (ISSUE 2 tentpole), CPU-verified.
+
+The forward factors at the shape/pose boundary
+(/root/reference/mano_np.py:81-83 vs 87-115); ``specialize`` bakes the
+shape stage once and ``forward_posed`` replays ONLY the pose stage.
+Everything that matters is deterministic on CPU and pinned here:
+
+* bit-identity — the split output equals the full staged forward
+  EXACTLY (f32 ==, not allclose) at matched batching structure, both
+  unbatched and vmapped; the broadcast-shaped serving program is the
+  one documented rounding-level exception (different batched
+  contraction shapes by design);
+* ``ShapedHand`` is a real pytree: flatten/unflatten, jit round-trip,
+  tree_map all preserve it;
+* the serving engine's composed caches: per-subject specialization
+  cache (hit/miss counters) x per-bucket pose-only executables —
+  steady multi-subject traffic compiles NOTHING after warm-up;
+* frozen-betas fitting reaches the same optimum as the 58-col solve.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mano_hand_tpu.models import core
+from mano_hand_tpu.serving import ServingEngine, bucket_for, pad_rows
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+def _beta(seed=3, scale=0.5):
+    return jnp.asarray(
+        np.random.default_rng(seed).normal(scale=scale, size=10), jnp.float32)
+
+
+def _poses(n, seed=0, scale=0.4):
+    return jnp.asarray(
+        np.random.default_rng(seed).normal(scale=scale, size=(n, 16, 3)),
+        jnp.float32)
+
+
+# ------------------------------------------------------------ the split
+def test_specialize_bakes_the_shape_stage(params32):
+    beta = _beta()
+    sh = core.jit_specialize(params32, beta)
+    assert sh.v_shaped.shape == (778, 3)
+    assert sh.joints.shape == (16, 3)
+    np.testing.assert_array_equal(np.asarray(sh.shape), np.asarray(beta))
+    # Default betas = zeros: the rest template and its regressed joints.
+    sh0 = core.specialize(params32)
+    np.testing.assert_array_equal(
+        np.asarray(sh0.v_shaped), np.asarray(params32.v_template))
+    # The baked joints ARE the full forward's rest joints.
+    out = core.jit_forward(params32, _poses(1)[0], beta)
+    np.testing.assert_array_equal(np.asarray(sh.joints),
+                                  np.asarray(out.joints))
+
+
+def test_forward_posed_bit_identical_single(params32):
+    """THE acceptance criterion: specialize + forward_posed == the full
+    forward, f32 EXACT (same ops, same precision, same structure)."""
+    beta = _beta()
+    sh = core.jit_specialize(params32, beta)
+    for i, pose in enumerate(_poses(4, seed=11, scale=0.6)):
+        got = core.jit_forward_posed(sh, pose)
+        want = core.jit_forward(params32, pose, beta)
+        for field, a, b in zip(core.ManoOutput._fields, got, want):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"pose {i}, field {field}")
+
+
+def test_forward_posed_bit_identical_batched_matched(params32):
+    """Vmapped split == vmapped full staged forward, f32 exact — the
+    batching structure matches (per-row specialize under the same vmap),
+    so every contraction has identical shapes on both sides."""
+    poses = _poses(6, seed=5, scale=0.5)
+    betas = jnp.asarray(
+        np.random.default_rng(7).normal(scale=0.5, size=(6, 10)), jnp.float32)
+
+    split = jax.jit(lambda prm, pp, ss: jax.vmap(
+        lambda q, s: core.forward_posed(core.specialize(prm, s), q).verts
+    )(pp, ss))(params32, poses, betas)
+    full = jax.jit(lambda prm, pp, ss: core.forward_batched(
+        prm, pp, ss, fused=False).verts)(params32, poses, betas)
+    np.testing.assert_array_equal(np.asarray(split), np.asarray(full))
+
+
+def test_forward_posed_batched_broadcast_rounding(params32):
+    """The serving fast path (ONE ShapedHand broadcast over a pose batch)
+    matches the full batched forward to float rounding — the shared
+    shape stage changes batched contraction shapes by design, so this
+    is the documented rounding-level (not bitwise) pairing."""
+    beta = _beta()
+    sh = core.jit_specialize(params32, beta)
+    poses = _poses(5, seed=9)
+    got = core.jit_forward_posed_batched(sh, poses)
+    want = core.jit_forward_batched(
+        params32, poses, jnp.broadcast_to(beta, (5, 10)))
+    np.testing.assert_allclose(np.asarray(got.verts),
+                               np.asarray(want.verts), atol=1e-6)
+    assert np.asarray(got.joints).shape == (5, 16, 3)
+
+
+def test_shaped_hand_pytree_roundtrip(params32):
+    beta = _beta()
+    sh = core.jit_specialize(params32, beta)
+    leaves, treedef = jax.tree_util.tree_flatten(sh)
+    assert len(leaves) == 5  # v_shaped, joints, shape, pose_basis, weights
+    sh2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert isinstance(sh2, core.ShapedHand)
+    assert sh2.parents == params32.parents  # static aux survives
+    # Through jit as argument AND return value.
+    sh3 = jax.jit(lambda s: s)(sh)
+    assert isinstance(sh3, core.ShapedHand)
+    for a, b in zip(jax.tree_util.tree_leaves(sh),
+                    jax.tree_util.tree_leaves(sh3)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # tree_map keeps the structure (and the static parents).
+    doubled = jax.tree_util.tree_map(lambda x: x * 2, sh)
+    assert isinstance(doubled, core.ShapedHand)
+    np.testing.assert_array_equal(np.asarray(doubled.joints),
+                                  2 * np.asarray(sh.joints))
+    # ... and the posed forward still runs on the jit-round-tripped tree.
+    out = core.jit_forward_posed(sh3, _poses(1)[0])
+    assert out.verts.shape == (778, 3)
+
+
+# ------------------------------------------------- model-layer cache
+def test_layer_specialization_cache(params):
+    from mano_hand_tpu.models.layer import MANOModel
+
+    model = MANOModel(params)
+    beta = np.asarray(_beta())
+    model.set_params(shape=beta)
+    shaped1 = model._shaped_cache[1]
+    # Pose-only updates reuse the bake (the reference's per-frame loop).
+    model.set_params(pose_abs=np.asarray(_poses(1)[0]))
+    assert model._shaped_cache[1] is shaped1
+    # The wrapper's verts equal the one-jit full forward bit-for-bit
+    # (the split is exact at unbatched structure).
+    want = core.jit_forward(
+        model._params_jax, jnp.asarray(model.pose, jnp.float32),
+        jnp.asarray(beta, jnp.float32))
+    np.testing.assert_array_equal(
+        model.verts, np.asarray(want.verts, np.float64))
+    # A betas change replaces the cache entry.
+    model.set_params(shape=beta * 0.5)
+    assert model._shaped_cache[1] is not shaped1
+
+
+# ------------------------------------------------- serving: both caches
+def test_engine_subject_cache_and_zero_recompiles(params32):
+    """Steady-state pose-only traffic composes BOTH caches: the subject
+    specialization cache (hit/miss counted) and the shared per-bucket
+    pose-only executables — a second subject costs one bake and ZERO
+    compiles, and warm traffic compiles nothing at all."""
+    rng = np.random.default_rng(0)
+    beta1, beta2 = (rng.normal(size=10).astype(np.float32) for _ in range(2))
+    with ServingEngine(params32, max_bucket=8) as eng:
+        s1 = eng.specialize(beta1)
+        assert eng.specialize(beta1) == s1            # cache hit
+        assert eng.counters.specializations == 1
+        assert eng.counters.shaped_hits == 1
+        assert eng.warmup_posed() == {1: "jit", 2: "jit", 4: "jit",
+                                      8: "jit"}
+        warm = eng.counters.compiles
+        for seed in range(3):
+            for n in (1, 3, 5, 8):
+                pose = rng.normal(scale=0.4, size=(n, 16, 3)).astype(
+                    np.float32)
+                got = eng.forward(pose, subject=s1)
+                assert got.shape == (n, 778, 3)
+                # Bit-identical to the direct pose-only program at the
+                # same padded size (same program family — the
+                # engine-contract analogue of the full path's test).
+                b = bucket_for(n, eng.buckets)
+                want = np.asarray(core.jit_forward_posed_batched(
+                    eng._shaped[s1],
+                    jnp.asarray(pad_rows(pose, b))).verts)[:n]
+                np.testing.assert_array_equal(got, want)
+                # ... and rounding-level vs the full path.
+                full = np.asarray(core.jit_forward_batched(
+                    params32, jnp.asarray(pose),
+                    jnp.broadcast_to(jnp.asarray(beta1), (n, 10))).verts)
+                assert np.abs(got - full).max() < 1e-6
+        # Second subject: one more specialization, zero new compiles —
+        # the pose-only executables take the ShapedHand as a runtime
+        # argument, so they are shared across subjects.
+        s2 = eng.specialize(beta2)
+        pose = rng.normal(scale=0.4, size=(4, 16, 3)).astype(np.float32)
+        eng.forward(pose, subject=s2)
+        assert eng.counters.compiles == warm
+        assert eng.counters.specializations == 2
+        # Mixed full/pose-only submits coalesce safely (never into one
+        # batch) and all resolve.
+        futs = [eng.submit(pose, subject=s1), eng.submit(pose),
+                eng.submit(pose, subject=s2)]
+        for f in futs:
+            assert f.result().shape == (4, 778, 3)
+        with pytest.raises(ValueError, match="not both"):
+            eng.submit(pose, shape=np.zeros((4, 10), np.float32),
+                       subject=s1)
+        with pytest.raises(ValueError, match="unknown subject"):
+            eng.submit(pose, subject="deadbeef")
+    snap = eng.counters.snapshot()
+    assert snap["specializations"] == 2 and snap["shaped_hits"] == 1
+
+
+# ------------------------------------------------- frozen-betas fitting
+def test_frozen_lm_reaches_the_58col_optimum(params32):
+    """Satellite criterion: with the true betas pinned, the 48-col GN
+    solve lands at the same optimum as the full 58-col solve."""
+    from mano_hand_tpu.fitting import fit_lm
+
+    beta = _beta()
+    pose_true = _poses(1, seed=21, scale=0.3)[0]
+    target = core.jit_forward(params32, pose_true, beta).verts
+    frozen = fit_lm(params32, target, n_steps=12, frozen_shape=beta)
+    full = fit_lm(params32, target, n_steps=12)
+    assert float(frozen.final_loss) < 1e-10
+    assert float(frozen.final_loss) <= 2.0 * max(float(full.final_loss),
+                                                 1e-12)
+    np.testing.assert_allclose(np.asarray(frozen.pose),
+                               np.asarray(pose_true), atol=1e-4)
+    # The frozen betas come back verbatim as the result's shape.
+    np.testing.assert_array_equal(np.asarray(frozen.shape),
+                                  np.asarray(beta))
+    # Per-problem frozen subjects on the batched path.
+    poses = _poses(3, seed=22, scale=0.25)
+    betas = jnp.asarray(np.random.default_rng(23).normal(
+        scale=0.5, size=(3, 10)), jnp.float32)
+    targets = core.jit_forward_batched(params32, poses, betas).verts
+    res = fit_lm(params32, targets, n_steps=10, frozen_shape=betas)
+    assert float(jnp.max(res.final_loss)) < 1e-8
+    np.testing.assert_array_equal(np.asarray(res.shape), np.asarray(betas))
+    # Seeding the non-existent beta parameter fails by name.
+    with pytest.raises(ValueError, match="init keys"):
+        fit_lm(params32, target, n_steps=2, frozen_shape=beta,
+               init={"shape": beta})
+
+
+def test_frozen_tracking_sequence(params32):
+    """Pose-only tracking (frozen betas) follows a synthetic fixed-shape
+    sequence to the same optimum as the free 58-col tracker."""
+    from mano_hand_tpu.fitting import make_tracker
+
+    beta = _beta()
+    t_frames = 4
+    base = _poses(1, seed=31, scale=0.25)[0]
+    clip = jnp.stack([base * (1.0 + 0.1 * t) for t in range(t_frames)])
+    targets = core.jit_forward_batched(
+        params32, clip, jnp.broadcast_to(beta, (t_frames, 10))).verts
+
+    state_f, step_f = make_tracker(params32, n_steps=8, solver="lm",
+                                   data_term="verts", frozen_shape=beta)
+    state_o, step_o = make_tracker(params32, n_steps=8, solver="lm",
+                                   data_term="verts")
+    for t in range(t_frames):
+        state_f, res_f = step_f(state_f, targets[t])
+        state_o, res_o = step_o(state_o, targets[t])
+    np.testing.assert_array_equal(np.asarray(state_f.shape),
+                                  np.asarray(beta))  # betas never moved
+    np.testing.assert_allclose(np.asarray(state_f.pose),
+                               np.asarray(clip[-1]), atol=1e-4)
+    # Same optimum as the free-shape solve (fixed-shape sequence).
+    np.testing.assert_allclose(np.asarray(state_f.pose),
+                               np.asarray(state_o.pose), atol=1e-3)
+
+
+def test_frozen_adam_fit(params32):
+    """First-order counterpart: frozen-betas Adam fits pose only and
+    returns the pinned betas."""
+    from mano_hand_tpu.fitting import fit
+
+    beta = _beta()
+    pose_true = _poses(1, seed=41, scale=0.2)[0]
+    target = core.jit_forward(params32, pose_true, beta).verts
+    res = fit(params32, target, n_steps=80, lr=0.05, frozen_shape=beta)
+    assert float(res.final_loss) < 1e-5
+    np.testing.assert_array_equal(np.asarray(res.shape), np.asarray(beta))
+    with pytest.raises(ValueError, match="init keys"):
+        fit(params32, target, n_steps=2, frozen_shape=beta,
+            init={"shape": np.zeros(10, np.float32)})
