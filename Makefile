@@ -20,12 +20,18 @@
 # do not remove it. Also avoid two concurrent pytest processes on the
 # shared cache dir.
 .PHONY: check check-cold test bench-cpu bench-tpu-wait mesh-scaling \
-	check-quick serve-smoke specialize-smoke
+	check-quick serve-smoke specialize-smoke chaos-smoke
 
-check: test
+check: test chaos-smoke
 
+# tests/test_runtime.py is excluded here and covered by the chaos-smoke
+# prerequisite instead (its own pytest process + cache dir): `make
+# check` would otherwise pay the real-time deadline/backoff/hang sleeps
+# of the chaos matrix twice. A bare `pytest tests/` (e.g. the tier-1
+# verify command) still collects it.
 test:
-	TF_CPP_MIN_LOG_LEVEL=3 python -m pytest tests/ -q
+	TF_CPP_MIN_LOG_LEVEL=3 python -m pytest tests/ -q \
+	  --ignore=tests/test_runtime.py
 
 # Seconds-scale pre-commit lane: the core-correctness modules (parity vs
 # the f64 oracle, assets/IO, golden demo, device lock, and the serving
@@ -68,14 +74,17 @@ bench-interpret:
 	  --fit-steps 10 --pallas-sweep quick --pallas-interpret --skip-fit \
 	  --init-retries 2 --sil-size 16 --serving-requests 64 \
 	  --serving-max-rows 16 --serving-max-bucket 32 \
-	  --spec-batch 64 --spec-fit-batch 8
+	  --spec-batch 64 --spec-fit-batch 8 --recovery-requests 6
 
 # Serving-leg smoke (the bench-interpret counterpart for config7): the
 # whole serving-engine plumbing — bucket warm-up, ragged request stream,
 # interleaved engine-vs-direct overhead ratio, recompile/padding
 # counters — on CPU at small sizes, emitting the one-line serving
-# artifact. `scripts/bench_report.py` applies the serving done-criteria
-# (ratio >= 0.9x, zero steady recompiles) to it.
+# artifact — PLUS the fault-recovery drill (config7_recovery).
+# `scripts/bench_report.py` applies the serving done-criteria (ratio
+# >= 0.9x, zero steady recompiles) and the recovery criteria (100%
+# futures resolved under fault, bit-identical CPU failover, zero
+# post-recovery recompiles) to it.
 serve-smoke:
 	python bench.py --platform cpu --serving-only --serving-requests 96 \
 	  --serving-max-rows 16 --serving-max-bucket 32 --init-retries 2
@@ -90,6 +99,18 @@ serve-smoke:
 # bench.py` config8 leg (criteria in scripts/bench_report.py).
 specialize-smoke:
 	TF_CPP_MIN_LOG_LEVEL=3 python -m pytest tests/test_specialize.py -q
+
+# Fault-tolerance matrix (runtime/ + the supervised ServingEngine, PR 3):
+# every chaos class — hang, transient error, persistent outage, latency
+# spike, silent wrong output — through the supervised dispatch /
+# breaker / CPU-failover stack on CPU. Wired into `make check` as a
+# SEPARATE pytest process on its own compile-cache dir (the CLAUDE.md
+# rule: two pytest processes must never share .jax_compile_cache/ —
+# make runs prerequisites sequentially, but an operator re-running
+# chaos-smoke beside a live full suite must stay safe by default).
+chaos-smoke:
+	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_adhoc \
+	  python -m pytest tests/test_runtime.py -q
 
 # Unattended BUILDER-side TPU bench: lockfile-guarded, stands down for the
 # driver's priority claim, and self-expires (default 3 h) — see

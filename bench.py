@@ -284,20 +284,23 @@ WATCHDOG_RC = 3  # distinct from null-run 1 / DeviceBusy 2 / signal 128+N
 
 
 def start_watchdog(stall_s: float, emit_by_s: float, t0: float) -> None:
-    """Arm the emergency watchdog THREAD. Two triggers, both of which the
-    signal guard alone cannot cover:
+    """Arm the emergency watchdog THREAD — the unified
+    ``runtime.supervise.Watchdog`` (PR 3: one audited implementation
+    behind this, cli.py serve-bench, and any future long device loop).
+    Two triggers, both of which the signal guard alone cannot cover —
+    SIGTERM is insufficient here because Python signal handlers run only
+    on the MAIN thread between bytecodes, and a tunnel drop leaves that
+    thread blocked inside a C-level PJRT RPC that never reaches the next
+    bytecode (observed live r5, 2026-08-01: TERM no-op, only SIGKILL
+    landed, stdout would have died empty — the BENCH_r04 failure,
+    resurrected). A daemon watchdog thread keeps running because the
+    blocked RPC releases the GIL, so it can emit the salvage line and
+    ``os._exit``:
 
     - **stall**: no progress (``log()`` call) for ``stall_s`` seconds.
-      Observed live (r5, 2026-08-01): a tunnel drop mid-measurement left
-      the main thread blocked inside a PJRT RPC — Python signal handlers
-      only run between bytecodes in the MAIN thread, so the driver's
-      SIGTERM was never delivered and its follow-up SIGKILL would have
-      produced an empty stdout (the BENCH_r04 failure, resurrected). A
-      daemon thread keeps running because the blocked RPC releases the
-      GIL, so it can emit the salvage line and ``os._exit``. Armed only
-      once a TPU backend is up (``arm_watchdog_stall``) — the hang class
-      is tunnel-specific, and CPU/interpreter lanes have legitimately
-      long quiet gaps on a busy 1-core box.
+      Armed only once a TPU backend is up (``arm_watchdog_stall``) — the
+      hang class is tunnel-specific, and CPU/interpreter lanes have
+      legitimately long quiet gaps on a busy 1-core box.
     - **deadline**: ``emit_by_s`` seconds of wall clock since ``t0``.
       The driver harness kills flagless runs at ~30 min; a slow-but-live
       run must emit what it has BEFORE that, not be cut mid-line.
@@ -307,24 +310,17 @@ def start_watchdog(stall_s: float, emit_by_s: float, t0: float) -> None:
     """
     if not (stall_s or emit_by_s):
         return
+    from mano_hand_tpu.runtime.supervise import Watchdog
 
-    def _watch() -> None:
-        while True:
-            time.sleep(2.0)
-            now = time.time()
-            if emit_by_s and now - t0 >= emit_by_s:
-                _emergency_exit(
-                    f"watchdog: emit-by deadline ({emit_by_s:.0f}s) hit",
-                    WATCHDOG_RC)
-            if (stall_s and _WATCHDOG_ARMED
-                    and now - _LAST_PROGRESS >= stall_s):
-                _emergency_exit(
-                    f"watchdog: no progress for {stall_s:.0f}s "
-                    "(hung device RPC — tunnel drop mid-measurement?)",
-                    WATCHDOG_RC)
-
-    threading.Thread(target=_watch, name="bench-watchdog",
-                     daemon=True).start()
+    Watchdog(
+        lambda cause: _emergency_exit(cause, WATCHDOG_RC),
+        deadline_s=emit_by_s or None,
+        stall_s=stall_s or None,
+        t0=t0,
+        progress=lambda: _LAST_PROGRESS,
+        armed=lambda: _WATCHDOG_ARMED,
+        name="bench-watchdog",
+    ).start()
 
 
 def arm_watchdog_stall() -> None:
@@ -579,7 +575,8 @@ def run_benchmarks(args, device_str: str) -> dict:
         """Fault-isolate one config; a crash records an error, not a wipe."""
         if args.mesh_scaling_only and name != "mesh_scaling":
             return
-        if args.serving_only and name != "config7_serving":
+        if args.serving_only and name not in ("config7_serving",
+                                              "config7_recovery"):
             return
         try:
             fn()
@@ -1962,6 +1959,41 @@ def run_benchmarks(args, device_str: str) -> dict:
 
     section("config7_serving", config7_serving)
 
+    # -- config 7r: fault-recovery drill (runtime/, PR 3) -------------------
+    # THE shared protocol (serving/measure.py:recovery_drill_run — the
+    # same code path behind `mano serve-bench --chaos drill` and the
+    # quick-lane chaos matrix in tests/test_runtime.py): one SUPERVISED
+    # engine driven through every tunnel failure class — transient
+    # error, latency spike, hang, persistent outage — via deterministic
+    # chaos injection, then through recovery. Faults are injected
+    # in-process (nothing stresses the real chip), so the criteria —
+    # 100% of futures resolved under every fault, bit-identical CPU
+    # failover, zero post-recovery recompiles — gate EVERY lane, CPU
+    # and interpreter included. Rides in the readback tail for the same
+    # D2H reason as config7.
+    def config7_recovery():
+        from mano_hand_tpu.serving.measure import recovery_drill_run
+
+        rec = recovery_drill_run(
+            right,
+            requests_per_class=args.recovery_requests,
+            max_bucket=8,
+            deadline_s=5.0,
+            seed=11,
+            log=lambda m: log(f"config7r {m}"),
+        )
+        results["recovery"] = rec
+        log(f"config7r recovery drill: "
+            f"{rec['futures_resolved_fraction']:.0%} futures resolved, "
+            f"failover overhead {rec['failover_overhead_ratio']}x, "
+            f"failover-vs-cpu err "
+            f"{rec['failover_vs_cpu_direct_max_abs_err']}, "
+            f"{rec['post_recovery_steady_recompiles']} post-recovery "
+            f"recompiles (breaker: {rec['breaker_opens']} opens, "
+            f"{rec['breaker_probes']} probes)")
+
+    section("config7_recovery", config7_recovery)
+
     if args.serving_only:
         # Fast serving-layer artifact (`make serve-smoke`): the deferred
         # runner's serving-only skip reduces the schedule to config7.
@@ -2194,8 +2226,13 @@ def main() -> int:
                     help="largest power-of-two serving bucket (bounds "
                          "the leg's warm-up compiles)")
     ap.add_argument("--serving-only", action="store_true",
-                    help="run ONLY the serving-engine leg (fast "
-                         "serving-layer artifact; `make serve-smoke`)")
+                    help="run ONLY the serving-engine leg + the "
+                         "fault-recovery drill (fast serving-layer "
+                         "artifact; `make serve-smoke`)")
+    ap.add_argument("--recovery-requests", type=int, default=12,
+                    help="requests per fault class in the recovery "
+                         "drill (config7_recovery; faults are injected "
+                         "in-process, no chip involved)")
     ap.add_argument("--spec-batch", type=int, default=256,
                     help="batch for the specialization leg's full-vs-"
                          "pose-only forward comparison (config8); "

@@ -944,58 +944,104 @@ def cmd_serve_bench(args) -> int:
     print ONE JSON line of serving metrics (engine vs direct-jit
     throughput, recompiles, padding waste, per-bucket latency). The
     protocol itself lives in ``serving.measure.serve_bench_run`` —
-    shared with bench.py's config7 leg so the two cannot diverge."""
+    shared with bench.py's config7 leg so the two cannot diverge.
+    ``--chaos`` injects a deterministic fault plan under supervised
+    dispatch (``runtime/``), or runs the full recovery drill with
+    ``--chaos drill``."""
     import os
-    import threading
-    import time
 
     import jax
 
     from mano_hand_tpu.serving.measure import serve_bench_run
 
-    if args.requests < 1:
-        print(f"--requests must be >= 1, got {args.requests}",
-              file=sys.stderr)
-        return 2
-    if args.min_rows < 1 or args.max_rows < args.min_rows:
-        print(f"need 1 <= --min-rows <= --max-rows, got "
-              f"({args.min_rows}, {args.max_rows})", file=sys.stderr)
-        return 2
-    if args.max_rows > args.max_bucket:
-        print(f"--max-rows {args.max_rows} exceeds --max-bucket "
-              f"{args.max_bucket}", file=sys.stderr)
-        return 2
+    if args.chaos != "drill":
+        # The drill fixes its own protocol sizes; these knobs shape the
+        # serve_bench_run stream only.
+        if args.requests < 1:
+            print(f"--requests must be >= 1, got {args.requests}",
+                  file=sys.stderr)
+            return 2
+        if args.min_rows < 1 or args.max_rows < args.min_rows:
+            print(f"need 1 <= --min-rows <= --max-rows, got "
+                  f"({args.min_rows}, {args.max_rows})", file=sys.stderr)
+            return 2
+        if args.max_rows > args.max_bucket:
+            print(f"--max-rows {args.max_rows} exceeds --max-bucket "
+                  f"{args.max_bucket}", file=sys.stderr)
+            return 2
     params = _load_params(args.asset, args.side).astype(np.float32)
 
     # Deadline watchdog for device backends (CLAUDE.md): a tunnel drop
     # mid-dispatch hangs the engine's dispatcher inside a C-level PJRT
-    # RPC where neither signals nor thread joins can reach it — only a
-    # hard exit lands. Armed BEFORE any jax backend call: resolving the
+    # RPC where neither signals nor thread joins can reach it — SIGTERM
+    # is insufficient because Python handlers run only on the MAIN
+    # thread between bytecodes, which a thread wedged in a C call never
+    # reaches; only a hard exit from a still-running daemon THREAD
+    # lands (the unified runtime.supervise.Watchdog, shared with
+    # bench.py). Armed BEFORE any jax backend call: resolving the
     # backend itself initializes PJRT in-process and hangs on a wedged
     # tunnel, so an auto default (--emit-by unset) arms provisionally at
     # 900 s and is DISARMED below once the backend resolves to cpu. The
     # JSON line stays valid either way (null + error on the kill path).
+    from mano_hand_tpu.runtime.supervise import Watchdog
+
     emit_by = 900.0 if args.emit_by < 0 else args.emit_by
-    disarm = threading.Event()
-    if emit_by > 0:
-        t0 = time.time()
 
-        def _watch():
-            while time.time() - t0 < emit_by:
-                if disarm.is_set():
-                    return
-                time.sleep(2.0)
-            print(json.dumps({
-                "engine_evals_per_sec": None,
-                "error": f"serve-bench deadline ({emit_by:.0f}s) hit — "
-                         "hung device RPC (tunnel drop mid-dispatch?)",
-            }), flush=True)
-            os._exit(3)
+    def _hard_exit(cause: str) -> None:
+        print(json.dumps({
+            "engine_evals_per_sec": None,
+            "error": f"serve-bench {cause} — hung device RPC (tunnel "
+                     "drop mid-dispatch?)",
+        }), flush=True)
+        os._exit(3)
 
-        threading.Thread(target=_watch, name="serve-bench-watchdog",
-                         daemon=True).start()
+    wd = Watchdog(_hard_exit, deadline_s=emit_by or None,
+                  name="serve-bench-watchdog").start()
     if args.emit_by < 0 and jax.default_backend() == "cpu":
-        disarm.set()  # auto mode: no tunnel to guard against on cpu
+        wd.disarm()  # auto mode: no tunnel to guard against on cpu
+
+    if args.chaos == "drill":
+        # The full fault-recovery drill (the same protocol as bench.py
+        # config7_recovery): every fault class + recovery, one JSON
+        # line of drill metrics, judged by scripts/bench_report.py.
+        from mano_hand_tpu.serving.measure import recovery_drill_run
+
+        # The drill fixes its own protocol sizes (its request stream
+        # needs a largest bucket >= 8); only the deadline is tunable.
+        kw = ({} if args.deadline_s is None
+              else {"deadline_s": args.deadline_s})
+        out = recovery_drill_run(
+            params, max_bucket=8, seed=args.seed,
+            log=lambda m: print(m, file=sys.stderr), **kw)
+        out["backend"] = jax.default_backend()
+        print(json.dumps(out))
+        return 0
+    policy = None
+    if args.chaos:
+        # A custom fault schedule under supervised dispatch: the plan
+        # wraps the PRIMARY executables; the breaker's probe always
+        # answers True (the fault is simulated, there is no real
+        # outage to wait out) so the run measures the engine's reaction
+        # to the schedule, not probe policy.
+        from mano_hand_tpu.runtime import (
+            ChaosPlan, CircuitBreaker, DispatchPolicy,
+        )
+
+        try:
+            plan = ChaosPlan(args.chaos)
+        except ValueError as e:
+            # Same contract as every other argument guard here: a
+            # message + rc 2, not a traceback.
+            print(f"--chaos {args.chaos!r}: {e}", file=sys.stderr)
+            return 2
+        policy = DispatchPolicy(
+            deadline_s=30.0 if args.deadline_s is None else args.deadline_s,
+            retries=2,
+            breaker=CircuitBreaker(
+                failure_threshold=3, probe=lambda: True,
+                probe_interval_s=1.0, respect_priority_claim=False),
+            chaos=plan,
+        )
     out = serve_bench_run(
         params,
         requests=args.requests,
@@ -1005,8 +1051,11 @@ def cmd_serve_bench(args) -> int:
         max_delay_s=args.max_delay_ms * 1e-3,
         aot_dir=args.aot_dir or None,
         seed=args.seed,
+        policy=policy,
     )
     out["backend"] = jax.default_backend()
+    if args.chaos:
+        out["chaos"] = args.chaos
     print(json.dumps(out))
     return 0
 
@@ -1313,6 +1362,23 @@ def build_parser() -> argparse.ArgumentParser:
     sb.add_argument("--aot-dir", default="",
                     help="persistent per-bucket AOT artifact cache "
                          "(serving/engine.py); empty = in-memory only")
+    sb.add_argument("--chaos", default="",
+                    help="inject a deterministic fault plan "
+                         "(runtime/chaos.py spec, e.g. "
+                         "'error@0-1,latency:0.2@4,hang@7') into the "
+                         "engine's primary executables under supervised "
+                         "dispatch, or 'drill' to run the full recovery "
+                         "drill (every fault class + recovery; "
+                         "serving/measure.py:recovery_drill_run) and "
+                         "print its one-line artifact")
+    sb.add_argument("--deadline-s", type=float, default=None,
+                    help="per-batch supervised dispatch deadline used "
+                         "with --chaos (hung batches are abandoned, "
+                         "retried, then failed over to CPU). Default: "
+                         "30 for a --chaos plan, the drill protocol's "
+                         "own 2 s for --chaos drill — raise it on the "
+                         "real tunnel, where a healthy dispatch can "
+                         "take seconds")
     sb.add_argument("--emit-by", type=float, default=-1.0,
                     help="hard wall-clock deadline in seconds: emit a "
                          "null JSON line and hard-exit if the run hangs "

@@ -52,6 +52,8 @@ def make_tracker(
     shape_prior_weight: float = 1e-3,
     camera=None,
     frozen_shape=None,           # [S]: pose-only tracking, betas pinned
+    deadline_s: Optional[float] = None,
+    retries: int = 0,
     **solver_kw,
 ) -> Tuple[TrackState, Callable]:
     """Build a streaming tracker; returns ``(initial_state, track_step)``.
@@ -79,6 +81,16 @@ def make_tracker(
     the subject's betas are known (a calibration fit, an enrolled user);
     with the true betas the per-frame solves reach the same optimum as
     the free-shape solve (tests/test_specialize.py).
+
+    ``deadline_s``/``retries`` opt every frame's solve into SUPERVISED
+    execution (``runtime.supervise.supervised_call``): a live tracker
+    is exactly the long-running device loop a tunnel drop wedges
+    forever (the C-level RPC no signal clears), so each frame's device
+    work is bounded by the deadline, transient failures get bounded
+    retries with backoff, and a terminal failure raises
+    (``RetriesExhausted``) WITHOUT corrupting ``state`` — the caller
+    keeps the last good warm start and can resume the stream after the
+    outage. Default (both unset): the plain direct call, zero overhead.
     """
     if solver not in ("adam", "lm"):
         raise ValueError(f"solver must be 'adam' or 'lm', got {solver!r}")
@@ -161,20 +173,32 @@ def make_tracker(
             init["shape"] = state.shape
         if fit_trans:
             init["trans"] = trans0
-        if solver == "lm":
-            res = lm_mod.fit_lm(
-                params, target, n_steps=n_steps, data_term=data_term,
-                fit_trans=fit_trans, init=init,
-                frozen_shape=frozen_shape, **solver_kw,
-            )
-        else:
-            res = solvers.fit(
+        def solve():
+            if solver == "lm":
+                return lm_mod.fit_lm(
+                    params, target, n_steps=n_steps, data_term=data_term,
+                    fit_trans=fit_trans, init=init,
+                    frozen_shape=frozen_shape, **solver_kw,
+                )
+            return solvers.fit(
                 params, target, n_steps=n_steps, lr=lr,
                 data_term=data_term, camera=camera,
                 fit_trans=fit_trans,
                 shape_prior_weight=shape_prior_weight,
                 init=init, frozen_shape=frozen_shape, **solver_kw,
             )
+
+        if deadline_s is not None or retries:
+            from mano_hand_tpu.runtime.supervise import supervised_call
+
+            # block_until_ready INSIDE the supervised window — the hang
+            # class lives in the device work, not the Python dispatch.
+            res = supervised_call(
+                lambda: jax.block_until_ready(solve()),
+                deadline_s=deadline_s, retries=retries,
+                name=f"track-step-{solver}")
+        else:
+            res = solve()
         new_state = TrackState(
             pose=res.pose,
             shape=res.shape,

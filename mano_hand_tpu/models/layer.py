@@ -164,7 +164,9 @@ class MANOModel:
         export_ply(self.verts, self.faces, path,
                    normals=normals, binary=binary)
 
-    def fit(self, target, solver: str = "adam", **solver_kw):
+    def fit(self, target, solver: str = "adam",
+            deadline_s: Optional[float] = None, retries: int = 0,
+            **solver_kw):
         """Recover pose/shape from a target and ADOPT the solution.
 
         The stateful counterpart of ``fitting.fit``/``fitting.fit_lm``:
@@ -175,6 +177,15 @@ class MANOModel:
         priors, ...). ``fit_trans`` is refused — the wrapper, like the
         reference, keeps the hand origin-centered and has no translation
         state; use the functional API when fitting placement.
+
+        ``deadline_s``/``retries`` opt the solve into SUPERVISED
+        execution (``runtime.supervise.supervised_call``): a long fit
+        against a tunneled device can wedge inside a C-level RPC that
+        no signal clears — supervised, the blocked solve is abandoned
+        at the deadline (``DeadlineExceeded`` -> bounded retries ->
+        ``RetriesExhausted``), and the model's state stays untouched on
+        failure. Deterministic solver errors (bad shapes, bad options)
+        are never retried. Default (both unset): the plain direct call.
         """
         from mano_hand_tpu import fitting
 
@@ -190,7 +201,20 @@ class MANOModel:
         # leak a kwarg fit_lm's signature does not have.
         solver_kw.pop("fit_trans", None)
         fn = fitting.fit if solver == "adam" else fitting.fit_lm
-        res = fn(self._params_jax, target, **solver_kw)
+        if deadline_s is not None or retries:
+            from mano_hand_tpu.runtime.supervise import supervised_call
+
+            # block_until_ready INSIDE the supervised window: the solver
+            # returns asynchronously-dispatched arrays, and the hang
+            # being guarded against lives in the device work, not the
+            # Python call.
+            res = supervised_call(
+                lambda: jax.block_until_ready(
+                    fn(self._params_jax, target, **solver_kw)),
+                deadline_s=deadline_s, retries=retries,
+                name=f"model-fit-{solver}")
+        else:
+            res = fn(self._params_jax, target, **solver_kw)
         if np.asarray(res.pose).ndim != 2:
             raise ValueError(
                 "MANOModel.fit adopts ONE solution; batched targets "

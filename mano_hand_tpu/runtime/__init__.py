@@ -1,0 +1,74 @@
+"""Fault-tolerant device runtime (PR 3 tentpole).
+
+The operational defenses bench.py and scripts/ accreted against the
+flaky TPU tunnel — killable probes, deadline watchdogs, bounded
+classified retries, priority-claim awareness — promoted into a tested,
+reusable subsystem the library itself uses:
+
+* ``runtime.chaos``     — deterministic fault injection (hang, transient
+                          /persistent error, latency spike, silent wrong
+                          output) so every tunnel failure mode reproduces
+                          on CPU in the quick test lane;
+* ``runtime.supervise`` — supervised calls (per-attempt deadlines on a
+                          disposable thread, exponential backoff +
+                          jitter, transient-vs-deterministic failure
+                          classification), the unified ``Watchdog``
+                          thread, and the killable-subprocess escalation
+                          path (``run_python``);
+* ``runtime.health``    — the healthy/degraded/down circuit breaker with
+                          killable re-probe and device-lock awareness.
+
+``serving.ServingEngine`` composes all three through a
+``DispatchPolicy``: supervised per-batch dispatch, breaker-gated CPU
+graceful degradation, and recompile-free failback.
+"""
+
+from mano_hand_tpu.runtime.chaos import (
+    ChaosPlan,
+    FaultEvent,
+    InjectedFault,
+    parse_plan,
+)
+from mano_hand_tpu.runtime.health import (
+    DEGRADED,
+    DOWN,
+    HEALTHY,
+    CircuitBreaker,
+    device_probe,
+)
+from mano_hand_tpu.runtime.supervise import (
+    DETERMINISTIC,
+    TRANSIENT,
+    DeadlineExceeded,
+    DispatchPolicy,
+    RetriesExhausted,
+    Watchdog,
+    backoff_delay,
+    call_with_deadline,
+    classify_failure,
+    run_python,
+    supervised_call,
+)
+
+__all__ = [
+    "ChaosPlan",
+    "FaultEvent",
+    "InjectedFault",
+    "parse_plan",
+    "CircuitBreaker",
+    "device_probe",
+    "HEALTHY",
+    "DEGRADED",
+    "DOWN",
+    "DispatchPolicy",
+    "DeadlineExceeded",
+    "RetriesExhausted",
+    "Watchdog",
+    "TRANSIENT",
+    "DETERMINISTIC",
+    "backoff_delay",
+    "call_with_deadline",
+    "classify_failure",
+    "run_python",
+    "supervised_call",
+]

@@ -1,0 +1,205 @@
+"""Deterministic fault injection for device-call sites (PR 3 tentpole).
+
+Every failure mode the axon tunnel has shown in production — hang
+forever in a C-level RPC, transient gRPC-style error, persistent error
+(an hours-long outage), latency spike, silent wrong output — becomes a
+schedulable event that a ``ChaosPlan`` injects into any wrapped
+callable (a compiled bucket executable, a device transfer, a probe).
+The plan is driven by a per-plan CALL INDEX, not wall clock or
+randomness, so the quick test lane reproduces each tunnel pathology
+on CPU bit-for-bit, run after run (tests/test_runtime.py).
+
+Plan spec grammar (``parse_plan``) — comma-separated events::
+
+    KIND[:PARAM]@SEL
+
+    KIND   hang      block until the plan's ``release`` event is set
+                     (the unkillable-RPC stand-in; a supervised caller
+                     deadline-kills it, an unsupervised one wedges —
+                     exactly like the real tunnel)
+           error     raise InjectedFault(transient=True) whose message
+                     carries "UNAVAILABLE" (the gRPC marker class
+                     supervise.classify_failure treats as retryable)
+           fatal     raise InjectedFault(transient=False) ("INVALID_
+                     ARGUMENT" marker — the compile-error class that
+                     must NOT be retried)
+           latency   sleep PARAM seconds, then run the call
+           wrong     run the call, return the result + PARAM (default
+                     1.0): the silent-corruption mode that motivates
+                     probing numerics in the shipped compilation
+                     context (CLAUDE.md rule)
+    SEL    N         exactly call index N (0-based)
+           N-M       calls N..M inclusive
+           N-        every call from N onward (a persistent outage)
+           *         every call
+
+    "error@0-1"            two transient faults, then clean
+    "hang@2"               call 2 wedges
+    "error@0-"             persistent outage (never self-clears)
+    "latency:0.2@1-3"      200 ms spikes on calls 1-3
+    "wrong:0.5@4"          call 4 silently returns verts + 0.5
+
+``schedule(spec)`` swaps the event list and resets the call index, so
+one long-lived engine can be driven through a whole fault matrix
+without rebuilding its executable caches (serving/measure.py's
+recovery drill does exactly this).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+
+class InjectedFault(RuntimeError):
+    """A fault raised by a ChaosPlan. ``transient`` mirrors the real
+    tunnel's split: retryable RPC blips vs deterministic failures."""
+
+    def __init__(self, message: str, transient: bool = True):
+        super().__init__(message)
+        self.transient = transient
+
+
+class FaultEvent:
+    """One scheduled fault: ``kind`` over call indices [start, stop]."""
+
+    __slots__ = ("kind", "start", "stop", "param")
+
+    def __init__(self, kind: str, start: int, stop: Optional[int],
+                 param: float = 0.0):
+        self.kind = kind
+        self.start = start
+        self.stop = stop            # None = open-ended (persistent)
+        self.param = param
+
+    def matches(self, idx: int) -> bool:
+        return idx >= self.start and (self.stop is None or idx <= self.stop)
+
+    def __repr__(self) -> str:  # test/log readability
+        sel = (f"{self.start}" if self.stop == self.start
+               else f"{self.start}-{'' if self.stop is None else self.stop}")
+        return f"FaultEvent({self.kind}@{sel}, param={self.param})"
+
+
+_KINDS = ("hang", "error", "fatal", "latency", "wrong")
+
+
+def _parse_event(token: str) -> FaultEvent:
+    head, _, sel = token.partition("@")
+    if not sel:
+        raise ValueError(f"chaos event {token!r} lacks '@SELECTOR'")
+    kind, _, param_s = head.partition(":")
+    if kind not in _KINDS:
+        raise ValueError(f"unknown chaos kind {kind!r} (one of {_KINDS})")
+    if kind == "latency" and not param_s:
+        raise ValueError("latency events need ':SECONDS' (e.g. latency:0.2)")
+    param = float(param_s) if param_s else (1.0 if kind == "wrong" else 0.0)
+    if sel == "*":
+        return FaultEvent(kind, 0, None, param)
+    lo, dash, hi = sel.partition("-")
+    start = int(lo)
+    if not dash:
+        return FaultEvent(kind, start, start, param)
+    return FaultEvent(kind, start, int(hi) if hi else None, param)
+
+
+class ChaosPlan:
+    """A deterministic, schedulable fault plan over wrapped callables.
+
+    All callables wrapped by one plan share ONE call counter — faults
+    land on the plan's dispatch timeline regardless of which bucket
+    executable a given dispatch hits, matching how a tunnel outage hits
+    whatever happens to be in flight.
+
+    Thread-safe (the engine's dispatcher and a test driver both touch
+    it). ``release`` frees any hung calls: test teardown / drill exit
+    sets it so abandoned worker threads unwind instead of sleeping
+    forever in the process.
+    """
+
+    def __init__(self, spec: str = ""):
+        self._lock = threading.Lock()
+        self._events: List[FaultEvent] = []
+        self._calls = 0
+        self.faults_injected = 0
+        self.release = threading.Event()
+        if spec:
+            self.schedule(spec)
+
+    # -------------------------------------------------------------- control
+    def schedule(self, spec: str) -> "ChaosPlan":
+        """Replace the event list and restart the call index at 0
+        (``faults_injected`` keeps accumulating — it is the plan's
+        lifetime audit trail, snapshotted per phase by callers)."""
+        events = [_parse_event(t.strip())
+                  for t in spec.split(",") if t.strip()]
+        with self._lock:
+            self._events = events
+            self._calls = 0
+        return self
+
+    def clear(self) -> None:
+        """Drop every scheduled event (the fault 'clears' — recovery)."""
+        with self._lock:
+            self._events = []
+
+    @property
+    def calls(self) -> int:
+        with self._lock:
+            return self._calls
+
+    def _next(self) -> Tuple[int, Optional[FaultEvent]]:
+        with self._lock:
+            idx = self._calls
+            self._calls += 1
+            ev = next((e for e in self._events if e.matches(idx)), None)
+            if ev is not None:
+                self.faults_injected += 1
+            return idx, ev
+
+    # ------------------------------------------------------------- wrapping
+    def wrap(self, fn: Callable, on_fault: Optional[Callable] = None,
+             ) -> Callable:
+        """Wrap ``fn`` so each invocation consults the plan first.
+
+        ``on_fault`` (e.g. ``ServingCounters.count_fault``) fires once
+        per injected fault, before the fault takes effect.
+        """
+
+        def chaotic(*args, **kwargs):
+            idx, ev = self._next()
+            if ev is None:
+                return fn(*args, **kwargs)
+            if on_fault is not None:
+                on_fault()
+            if ev.kind == "hang":
+                # The unkillable-RPC stand-in: block until released.
+                # A supervised caller abandons this (daemon) thread at
+                # its deadline; the raise after release keeps a stale
+                # result from ever surfacing.
+                self.release.wait()
+                raise InjectedFault(
+                    f"chaos: hang at call {idx} released", transient=True)
+            if ev.kind == "error":
+                raise InjectedFault(
+                    f"chaos: UNAVAILABLE injected transient RPC error "
+                    f"at call {idx}", transient=True)
+            if ev.kind == "fatal":
+                raise InjectedFault(
+                    f"chaos: INVALID_ARGUMENT injected deterministic "
+                    f"failure at call {idx}", transient=False)
+            if ev.kind == "latency":
+                time.sleep(ev.param)
+                return fn(*args, **kwargs)
+            # wrong: silent corruption — runs the call, skews the result.
+            return np.asarray(fn(*args, **kwargs)) + ev.param
+
+        return chaotic
+
+
+def parse_plan(spec: str) -> ChaosPlan:
+    """``spec`` (grammar above) -> a fresh ChaosPlan."""
+    return ChaosPlan(spec)

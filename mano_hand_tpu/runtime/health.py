@@ -1,0 +1,160 @@
+"""Device health state machine / circuit breaker for the serving paths.
+
+The tunnel's outage profile (10-15 h, r3/r4) makes per-call retries the
+wrong tool past the first seconds: every retry burns a deadline worth
+of wall clock against a device that is simply GONE. The breaker turns
+repeated failures into a STATE — healthy -> degraded -> down — so the
+engine stops paying the primary path and fails over to CPU, and
+re-probes the device on a bounded cadence until it comes back.
+
+Probing is the dangerous part, with two hard-won rules baked in:
+
+* a probe must be KILLABLE: `jax.devices()` on a wedged tunnel HANGS
+  the calling process (BENCH_r01), so the default probe runs it in a
+  subprocess via ``supervise.run_python`` and kills on timeout — never
+  in-process;
+* a probe must STAND DOWN for the driver bench's priority claim
+  (utils/devicelock.py): a recovering engine hammering `jax.devices()`
+  during the authoritative end-of-round bench window is exactly the
+  contention class the device lock exists to prevent. While the claim
+  is fresh the breaker stays open without probing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from mano_hand_tpu.runtime import supervise
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+DOWN = "down"
+
+# Same platform-selection caveat as bench.py's probe: a site hook on
+# this image overrides JAX_PLATFORMS at interpreter startup, so the
+# probe must select platforms through the config API.
+_PROBE_CODE = (
+    "import jax;"
+    "plat = {platform!r};"
+    "plat and jax.config.update('jax_platforms', plat);"
+    "d = jax.devices();"
+    "print(d[0].platform + ':' + d[0].device_kind)"
+)
+
+
+def device_probe(platform: str = "", timeout_s: float = 30.0) -> bool:
+    """Probe backend liveness in a killable subprocess (True = alive)."""
+    return supervise.run_python(
+        _PROBE_CODE.format(platform=platform), timeout_s).ok
+
+
+class CircuitBreaker:
+    """healthy -> degraded -> down, with killable re-probe to close.
+
+    * ``record_failure()``: one failed primary attempt. The state moves
+      to DEGRADED immediately and to DOWN once ``failure_threshold``
+      CONSECUTIVE failures accumulate.
+    * ``record_success()``: a primary success resets to HEALTHY.
+    * ``allow_primary()``: the dispatch-time gate. True while not DOWN.
+      When DOWN it re-probes at most every ``probe_interval_s`` —
+      skipping entirely while a driver priority claim is fresh (see
+      module docstring) — and a successful probe closes the breaker
+      (HEALTHY) and returns True, restoring the primary path; the
+      still-warm executable caches make that failback recompile-free
+      (asserted in tests/test_runtime.py).
+
+    Thread-safe; the probe itself runs outside the lock (it can take
+    ``probe timeout`` seconds — other dispatchers keep failing over to
+    CPU meanwhile instead of queueing on the lock).
+    """
+
+    def __init__(
+        self,
+        failure_threshold: int = 3,
+        probe: Optional[Callable[[], bool]] = None,
+        probe_interval_s: float = 30.0,
+        respect_priority_claim: bool = True,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if failure_threshold < 1:
+            raise ValueError(
+                f"failure_threshold must be >= 1, got {failure_threshold}")
+        self.failure_threshold = int(failure_threshold)
+        self.probe = probe if probe is not None else device_probe
+        self.probe_interval_s = float(probe_interval_s)
+        self.respect_priority_claim = bool(respect_priority_claim)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._state = HEALTHY
+        self._consecutive_failures = 0
+        self._last_probe_t: Optional[float] = None
+        self._probing = False
+        self.probes = 0            # lifetime probe attempts (audit)
+        self.opens = 0             # times the breaker tripped to DOWN
+
+    # -------------------------------------------------------------- state
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def reset(self) -> None:
+        with self._lock:
+            self._state = HEALTHY
+            self._consecutive_failures = 0
+            self._last_probe_t = None
+
+    def record_failure(self) -> str:
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._consecutive_failures >= self.failure_threshold:
+                if self._state != DOWN:
+                    self.opens += 1
+                self._state = DOWN
+            elif self._state == HEALTHY:
+                self._state = DEGRADED
+            return self._state
+
+    def record_success(self) -> str:
+        with self._lock:
+            self._consecutive_failures = 0
+            self._state = HEALTHY
+            return self._state
+
+    # ----------------------------------------------------------- the gate
+    def allow_primary(self) -> bool:
+        with self._lock:
+            if self._state != DOWN:
+                return True
+            if self.respect_priority_claim:
+                # Lazy import so CPU-only users never touch the lock
+                # module's env resolution unless a breaker actually
+                # opens with claim-awareness on.
+                from mano_hand_tpu.utils import devicelock
+
+                if devicelock.priority_claim_active():
+                    # The driver bench owns the device window: no
+                    # probes, no primary traffic, stay failed over.
+                    return False
+            now = self.clock()
+            if (self._probing
+                    or (self._last_probe_t is not None
+                        and now - self._last_probe_t
+                        < self.probe_interval_s)):
+                return False
+            self._probing = True       # one prober at a time
+            self._last_probe_t = now
+            self.probes += 1
+        try:
+            ok = bool(self.probe())
+        except Exception:  # noqa: BLE001 — a crashing probe is a failed one
+            ok = False
+        with self._lock:
+            self._probing = False
+            if ok:
+                self._state = HEALTHY
+                self._consecutive_failures = 0
+                return True
+            return False

@@ -1,0 +1,340 @@
+"""Supervised execution of device calls: deadlines, retries, watchdogs.
+
+bench.py grew these defenses one incident at a time (BENCH_r01 hung
+init, r3's unbounded retry loop, r4's empty stdout, r5's undeliverable
+SIGTERM); this module is their promotion into ONE audited code path the
+library itself can use (serving/engine.py's dispatch loop, the fitting
+wrappers' opt-in supervision, cli.py's serve-bench watchdog).
+
+Why SIGTERM is insufficient — the fact every primitive here is built
+around: a tunnel drop mid-dispatch leaves the calling thread blocked
+inside a C-level PJRT RPC. CPython delivers signal handlers only on the
+MAIN thread, between bytecodes — a thread parked in a C call never
+reaches the next bytecode, so SIGTERM is accepted by the process and
+then never acted on (observed live, r5: 20 min at ~1% CPU, TERM no-op,
+only SIGKILL landed). The survivable defenses are therefore:
+
+* run the risky call on a DISPOSABLE worker thread and bound the wait
+  (``call_with_deadline``) — the wedged thread is abandoned (daemon),
+  the caller gets ``DeadlineExceeded`` and keeps its guarantees;
+* keep a daemon WATCHDOG thread that can still run while the main
+  thread is wedged — a blocked RPC releases the GIL — and have it
+  escalate (emit artifacts, ``os._exit``) (``Watchdog``);
+* for work that must be KILLABLE for real (backend probes that can hang
+  the whole process at init), run it in a SUBPROCESS and ``kill()`` it
+  (``run_python``) — SIGKILL is the one signal a wedged RPC cannot
+  block, and it only works from outside the process.
+
+Failure classification: retrying a deterministic failure (a compile
+error, a shape mismatch) burns the retry budget reproducing the same
+crash — exactly the r3 bare-retry-loop incident generalized. So
+``supervised_call`` retries only what ``classify_failure`` deems
+transient, with exponential backoff + jitter bounded by a cap.
+"""
+
+from __future__ import annotations
+
+import random
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+TRANSIENT = "transient"
+DETERMINISTIC = "deterministic"
+
+# gRPC/PJRT status markers that indicate the tunnel, not the program:
+# worth a bounded retry. INVALID_ARGUMENT et al. are deliberately absent
+# — those are compile/shape errors that reproduce deterministically.
+_TRANSIENT_MARKERS = (
+    "UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED", "CANCELLED",
+    "UNKNOWN: ", "INTERNAL: ", "connection reset", "connection refused",
+    "socket closed", "broken pipe", "tunnel",
+)
+
+
+class DeadlineExceeded(RuntimeError):
+    """A supervised call outlived its deadline and was abandoned."""
+
+
+class RetriesExhausted(RuntimeError):
+    """Every allowed attempt of a supervised call failed transiently."""
+
+    def __init__(self, message: str, cause: BaseException, attempts: int):
+        super().__init__(message)
+        self.cause = cause
+        self.attempts = attempts
+
+
+def classify_failure(exc: BaseException) -> str:
+    """``transient`` (bounded retry is rational) or ``deterministic``
+    (retrying reproduces the failure — never retry).
+
+    Unknown exception types default to DETERMINISTIC: the r3 incident
+    showed an optimistic retry loop is worse than a clean failure.
+    """
+    transient = getattr(exc, "transient", None)
+    if transient is not None:          # chaos.InjectedFault and friends
+        return TRANSIENT if transient else DETERMINISTIC
+    if isinstance(exc, DeadlineExceeded):
+        return TRANSIENT
+    if isinstance(exc, (ValueError, TypeError, KeyError, IndexError,
+                        AttributeError, NotImplementedError,
+                        ZeroDivisionError, AssertionError)):
+        return DETERMINISTIC
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return TRANSIENT
+    msg = f"{type(exc).__name__}: {exc}"
+    if any(marker in msg for marker in _TRANSIENT_MARKERS):
+        return TRANSIENT
+    return DETERMINISTIC
+
+
+def call_with_deadline(fn: Callable, deadline_s: Optional[float],
+                       name: str = "supervised-call"):
+    """Run ``fn()`` with a hard wall-clock bound.
+
+    ``deadline_s=None`` calls inline (no thread). Otherwise the call
+    runs on a disposable daemon thread; if it has not finished inside
+    the deadline the thread is ABANDONED (it cannot be killed — see the
+    module docstring) and ``DeadlineExceeded`` raises in the caller.
+    The abandoned thread's eventual result/exception is discarded.
+    """
+    if deadline_s is None:
+        return fn()
+    box: list = []
+
+    def run() -> None:
+        try:
+            box.append((True, fn()))
+        except BaseException as e:  # noqa: BLE001 — relayed to the caller
+            box.append((False, e))
+
+    t = threading.Thread(target=run, name=name, daemon=True)
+    t.start()
+    t.join(deadline_s)
+    if not box:
+        # Still running (or died without reporting — impossible short of
+        # interpreter teardown): the caller moves on, the thread is
+        # leaked by design.
+        raise DeadlineExceeded(
+            f"{name} exceeded its {deadline_s:.3g}s deadline and was "
+            "abandoned (a wedged device RPC cannot be interrupted "
+            "in-process — only a subprocess kill -9 truly clears one)")
+    ok, payload = box[0]
+    if ok:
+        return payload
+    raise payload
+
+
+def backoff_delay(attempt: int, base_s: float, cap_s: float,
+                  jitter: float, rng: Optional[random.Random] = None,
+                  ) -> float:
+    """Exponential backoff with full-ish jitter: ``base * 2^attempt``
+    capped at ``cap_s``, scaled by a uniform factor in
+    ``[1 - jitter, 1 + jitter]``. ``jitter=0`` is fully deterministic
+    (tests)."""
+    delay = min(base_s * (2.0 ** attempt), cap_s)
+    if jitter:
+        r = rng if rng is not None else random
+        delay *= 1.0 + jitter * (2.0 * r.random() - 1.0)
+    return max(0.0, delay)
+
+
+def supervised_call(
+    fn: Callable,
+    *,
+    deadline_s: Optional[float] = None,
+    retries: int = 0,
+    backoff_s: float = 0.05,
+    backoff_cap_s: float = 2.0,
+    jitter: float = 0.5,
+    classify: Callable[[BaseException], str] = classify_failure,
+    keep_trying: Optional[Callable[[], bool]] = None,
+    on_retry: Optional[Callable] = None,
+    on_deadline_kill: Optional[Callable] = None,
+    on_attempt_failure: Optional[Callable] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    name: str = "supervised-call",
+):
+    """THE supervised dispatch primitive: ``fn()`` under a per-attempt
+    deadline, with bounded classified retries.
+
+    * deterministic failures raise IMMEDIATELY, unretried (a compile
+      error rerun is the same compile error, minutes later);
+    * transient failures (including deadline kills) are retried up to
+      ``retries`` times with exponential backoff + jitter;
+    * ``keep_trying`` (e.g. a circuit breaker's ``allow_primary``) is
+      consulted before each retry so an opened breaker short-circuits
+      the remaining budget;
+    * hooks (``on_retry``/``on_deadline_kill``/``on_attempt_failure``)
+      feed counters and breakers without coupling this module to them.
+
+    Raises the deterministic failure as-is, or ``RetriesExhausted``
+    (carrying ``.cause`` and ``.attempts``) when the budget runs out.
+    """
+    last: Optional[BaseException] = None
+    attempts = 0
+    for attempt in range(max(0, retries) + 1):
+        if attempt > 0:
+            if keep_trying is not None and not keep_trying():
+                break
+            if on_retry is not None:
+                on_retry()
+            sleep(backoff_delay(attempt - 1, backoff_s, backoff_cap_s,
+                                jitter))
+        attempts += 1
+        try:
+            return call_with_deadline(fn, deadline_s, name=name)
+        except DeadlineExceeded as e:
+            last = e
+            if on_deadline_kill is not None:
+                on_deadline_kill()
+            if on_attempt_failure is not None:
+                on_attempt_failure()
+        except BaseException as e:  # noqa: BLE001 — classified below
+            if classify(e) == DETERMINISTIC:
+                raise
+            last = e
+            if on_attempt_failure is not None:
+                on_attempt_failure()
+    raise RetriesExhausted(
+        f"{name} failed {attempts} attempt(s); last: "
+        f"{type(last).__name__}: {last}", cause=last, attempts=attempts)
+
+
+@dataclass
+class DispatchPolicy:
+    """Supervision knobs for ``ServingEngine`` dispatch (serving/engine.py).
+
+    ``deadline_s`` bounds each device call (None = unbounded — the
+    pre-PR-3 behavior, kept for directly-attached devices where hangs
+    are not a failure mode). ``breaker`` is a
+    ``runtime.health.CircuitBreaker`` (None = no health tracking);
+    ``chaos`` a ``runtime.chaos.ChaosPlan`` injected into the PRIMARY
+    executables only (the fallback path stays clean, so failover is
+    observable recovery, not roulette). ``cpu_fallback`` enables
+    graceful degradation to CPU-bucketed executables when the primary
+    path is exhausted or the breaker is open.
+    """
+
+    deadline_s: Optional[float] = None
+    retries: int = 2
+    backoff_s: float = 0.05
+    backoff_cap_s: float = 2.0
+    jitter: float = 0.5
+    breaker: Optional[object] = None
+    chaos: Optional[object] = None
+    cpu_fallback: bool = True
+
+
+class Watchdog:
+    """The unified deadline/stall watchdog THREAD (satellite of PR 3).
+
+    One implementation behind bench.py's ``--stall-timeout``/
+    ``--emit-by``, cli.py serve-bench's hard-exit deadline, and any
+    future long-running device loop. A daemon thread polls two
+    triggers and fires ``on_trigger(cause)`` at most once:
+
+    * **deadline**: ``now - t0 >= deadline_s`` — the artifact MUST be
+      out before an external killer (the driver harness's ~30-min
+      ``timeout``) cuts the process mid-line;
+    * **stall**: no progress (caller-updated timestamp) for
+      ``stall_s`` while ``armed()`` — the hung-RPC trigger; see the
+      module docstring for why a signal handler cannot cover this.
+
+    ``on_trigger`` runs ON the watchdog thread and typically ends in
+    ``os._exit`` — it must not assume the main thread is runnable.
+    """
+
+    def __init__(
+        self,
+        on_trigger: Callable[[str], None],
+        *,
+        deadline_s: Optional[float] = None,
+        stall_s: Optional[float] = None,
+        t0: Optional[float] = None,
+        progress: Optional[Callable[[], float]] = None,
+        armed: Optional[Callable[[], bool]] = None,
+        poll_s: float = 2.0,
+        name: str = "watchdog",
+        clock: Callable[[], float] = time.time,
+    ):
+        if stall_s and progress is None:
+            raise ValueError("a stall trigger needs a progress() source")
+        self.on_trigger = on_trigger
+        self.deadline_s = deadline_s or None
+        self.stall_s = stall_s or None
+        self.t0 = clock() if t0 is None else t0
+        self.progress = progress
+        self.armed = armed
+        self.poll_s = poll_s
+        self.name = name
+        self.clock = clock
+        self._disarmed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Watchdog":
+        if self.deadline_s is None and self.stall_s is None:
+            return self  # nothing to watch: spawn no thread at all
+        self._thread = threading.Thread(
+            target=self._loop, name=self.name, daemon=True)
+        self._thread.start()
+        return self
+
+    def disarm(self) -> None:
+        """Permanently stand the watchdog down (e.g. the guarded phase
+        finished, or the backend resolved to one that cannot hang)."""
+        self._disarmed.set()
+
+    def _loop(self) -> None:
+        while not self._disarmed.wait(self.poll_s):
+            now = self.clock()
+            if self.deadline_s and now - self.t0 >= self.deadline_s:
+                self.on_trigger(
+                    f"{self.name}: emit-by deadline "
+                    f"({self.deadline_s:.0f}s) hit")
+                return
+            if (self.stall_s and (self.armed is None or self.armed())
+                    and now - self.progress() >= self.stall_s):
+                self.on_trigger(
+                    f"{self.name}: no progress for {self.stall_s:.0f}s "
+                    "(hung device RPC — tunnel drop mid-measurement?)")
+                return
+
+
+@dataclass
+class ProbeResult:
+    ok: bool
+    out: str = ""
+    err: str = ""
+    rc: Optional[int] = None
+    killed: bool = field(default=False)
+
+
+def run_python(code: str, timeout_s: float) -> ProbeResult:
+    """Run ``python -c code`` in a KILLABLE subprocess.
+
+    The in-process primitives above can only abandon a wedged call;
+    this is the escalation path that truly clears one — SIGKILL from
+    outside the process (bench.py's backend-probe pattern, reusable).
+    A hang past ``timeout_s`` is killed and reported, never waited out.
+    """
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    except OSError as e:
+        return ProbeResult(ok=False, err=f"spawn failed: {e}")
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        return ProbeResult(ok=proc.returncode == 0, out=out.strip(),
+                           err=err.strip(), rc=proc.returncode)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, err = proc.communicate()
+        return ProbeResult(ok=False, out=(out or "").strip(),
+                           err=f"probe hung > {timeout_s:.0f}s (killed)",
+                           rc=proc.returncode, killed=True)

@@ -15,11 +15,17 @@ from mano_hand_tpu.serving.buckets import (
     pad_rows,
     pad_tree_rows,
 )
-from mano_hand_tpu.serving.engine import ServingEngine
-from mano_hand_tpu.serving.measure import measure_overhead, serve_bench_run
+from mano_hand_tpu.serving.engine import ServingEngine, ServingError
+from mano_hand_tpu.serving.measure import (
+    measure_overhead,
+    recovery_drill_run,
+    serve_bench_run,
+)
 
 __all__ = [
     "ServingEngine",
+    "ServingError",
+    "recovery_drill_run",
     "measure_overhead",
     "serve_bench_run",
     "bucket_for",

@@ -30,10 +30,24 @@ round-trip are all pinned in tests/test_serving.py.
 
 Tunnel caveat (CLAUDE.md): a tunnel drop mid-dispatch hangs the
 dispatcher thread inside a C-level PJRT RPC that neither signals nor
-``stop()``'s join can interrupt — long-lived engine processes on the
-tunneled chip need their own kill-9-capable supervisor (the
-`serve-bench` CLI arms a hard-exit deadline watchdog; bench.py's
-config7 rides under bench's own watchdog).
+``stop()``'s join can interrupt — SIGTERM handlers need the main
+thread between bytecodes, so only SIGKILL (from OUTSIDE the process)
+truly clears one. PR 3's answer is layered: pass a
+``runtime.DispatchPolicy`` and every device call runs SUPERVISED — a
+per-batch deadline on a disposable worker thread (the wedged RPC is
+abandoned, the batch retried or failed over), bounded classified
+retries with backoff + jitter, a circuit breaker
+(``runtime.health.CircuitBreaker``: healthy -> degraded -> down, with
+killable-subprocess re-probe) gating **graceful degradation to
+CPU-bucketed executables** and recompile-free failback; and
+``stop(timeout_s=...)`` resolves EVERY in-flight and queued future
+with a structured ``ServingError`` even when the dispatcher itself is
+wedged. Fault modes are reproducible on CPU via
+``runtime.chaos.ChaosPlan`` (the policy's ``chaos`` field wraps the
+PRIMARY executables only). Process-level escalation (the true
+``kill -9``) still belongs to an external supervisor — the
+`serve-bench` CLI arms the unified ``runtime.supervise.Watchdog``;
+bench.py rides under its own instance of the same class.
 
 * **specializes per subject** (the shape-split cache, PR 2): dominant
   production streams hold betas fixed per subject for thousands of
@@ -71,6 +85,26 @@ from mano_hand_tpu.serving import buckets as bucket_mod
 from mano_hand_tpu.utils.profiling import ServingCounters
 
 _SENTINEL = object()
+
+
+class ServingError(RuntimeError):
+    """Structured terminal failure of one serving request.
+
+    The engine's future-resolution guarantee is "a result or a
+    ServingError, within the configured deadline" — never a hang. The
+    fields tell the caller WHICH guarantee fired: ``phase`` is
+    ``"dispatch"`` (the batch failed after supervision was exhausted)
+    or ``"shutdown"`` (``stop()`` found the dispatcher wedged or dead
+    with this request outstanding); ``attempts`` counts primary tries;
+    ``cause`` is the last underlying exception, if any.
+    """
+
+    def __init__(self, message: str, *, phase: str = "dispatch",
+                 attempts: int = 0, cause=None):
+        super().__init__(message)
+        self.phase = phase
+        self.attempts = attempts
+        self.cause = cause
 
 
 def default_donate() -> bool:
@@ -135,6 +169,35 @@ def build_posed_bucket_executable(shaped_dev, bucket: int, n_joints: int,
     return jitted
 
 
+def build_cpu_fallback_executable(params_host, bucket: int, n_joints: int,
+                                  n_shape: int, dtype):
+    """The graceful-degradation executable: the SAME program family as
+    ``build_bucket_executable`` (params as runtime ARGUMENTS — the
+    bit-identity policy, so failover results match a direct CPU
+    bucketed call exactly), pinned to the host CPU backend via
+    committed inputs. Never donated (CPU donation is unimplemented)
+    and never chaos-wrapped (the fallback is the clean path failover
+    is measured against). Eagerly warmed like its siblings.
+    """
+    import jax
+
+    from mano_hand_tpu.models import core
+
+    cpu = jax.devices("cpu")[0]
+    params_cpu = jax.device_put(params_host, cpu)
+    jitted = jax.jit(lambda q, p, s: core.forward_batched(q, p, s).verts)
+
+    def put(x):
+        return jax.device_put(np.asarray(x), cpu)
+
+    jax.block_until_ready(jitted(
+        params_cpu,
+        put(np.zeros((bucket, n_joints, 3), dtype)),
+        put(np.zeros((bucket, n_shape), dtype)),
+    ))
+    return lambda p, s: jitted(params_cpu, put(p), put(s))
+
+
 class _Request:
     __slots__ = ("pose", "shape", "rows", "squeeze", "subject", "future",
                  "t_submit")
@@ -168,6 +231,14 @@ class ServingEngine:
         (2 = classic double buffering).
     counters: a shared ServingCounters (e.g. process-global); default a
         private one, exposed as ``self.counters``.
+    policy: a ``runtime.DispatchPolicy`` enabling supervised dispatch
+        (per-batch deadline, classified retries with backoff, circuit-
+        breaker-gated CPU failover, optional chaos injection). None
+        (default) keeps the unsupervised fast path: zero threads, zero
+        overhead per dispatch — right for directly-attached devices.
+        Supervision trades the double-buffered device overlap for a
+        bounded-latency guarantee: each supervised batch is resolved to
+        a host array inside its own deadline before the next launches.
     """
 
     def __init__(
@@ -182,6 +253,7 @@ class ServingEngine:
         inflight_depth: int = 2,
         dtype=np.float32,
         counters: Optional[ServingCounters] = None,
+        policy=None,
     ):
         self._params = params.astype(dtype)
         self._dtype = np.dtype(dtype)
@@ -198,16 +270,26 @@ class ServingEngine:
         self.counters = counters if counters is not None else ServingCounters()
         self._n_joints = params.n_joints
         self._n_shape = params.n_shape
+        self._policy = policy
         self._params_dev = None        # device-resident params (jit path)
         self._exes: dict = {}          # bucket -> compiled callable
         self._shaped: dict = {}        # betas digest -> core.ShapedHand
+        self._subject_betas: dict = {}  # betas digest -> host [S] array
+        #   (the fallback path re-runs the FULL forward for a subject,
+        #   so it needs the raw betas the ShapedHand was baked from)
         self._posed_exes: dict = {}    # bucket -> pose-only executable
         #   (subject-agnostic: the shaped constants are runtime args)
+        self._cpu_exes: dict = {}      # bucket -> CPU fallback executable
         self._exe_lock = threading.Lock()
         self._queue: queue.Queue = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._running = False
         self._failure: Optional[BaseException] = None
+        # EVERY unresolved request, from submit to future resolution:
+        # the shutdown sweep resolves these even when the dispatcher is
+        # wedged inside a C-level RPC it will never return from.
+        self._live: dict = {}
+        self._live_lock = threading.Lock()
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "ServingEngine":
@@ -222,17 +304,74 @@ class ServingEngine:
             self._thread.start()
         return self
 
-    def stop(self) -> None:
-        """Drain pending work, stop the dispatcher, resolve every future."""
+    def stop(self, timeout_s: Optional[float] = None) -> None:
+        """Drain pending work, stop the dispatcher, resolve EVERY future.
+
+        ``timeout_s`` bounds the join: if the dispatcher does not exit
+        in time (wedged inside a device RPC — un-interruptible from
+        in-process, see the module docstring), the thread is ABANDONED
+        (daemon) and every outstanding future is resolved with a
+        structured ``ServingError(phase="shutdown")`` so no caller ever
+        blocks forever on a dead engine. Default: a supervised engine
+        waits PROGRESS-AWARE — one supervised batch is bounded by the
+        policy (deadline x attempts + grace), a queued backlog of them
+        is not, so the implicit bound is per-batch windows re-armed as
+        long as outstanding futures keep resolving (a draining backlog
+        makes progress every window; a wedged RPC cannot make any). An
+        unsupervised engine keeps the historical blocking join (its
+        dispatch path has nothing that can wedge on CPU).
+        """
         if self._thread is None:
             return
         self._running = False
         self._queue.put(_SENTINEL)
-        self._thread.join()
+        if timeout_s is not None:
+            self._thread.join(timeout_s)
+        elif self._policy is not None and self._policy.deadline_s:
+            per_batch = (self._policy.deadline_s
+                         * (self._policy.retries + 2)
+                         + self._policy.backoff_cap_s
+                         * (self._policy.retries + 1) + 5.0)
+            while True:
+                with self._live_lock:
+                    before = len(self._live)
+                self._thread.join(per_batch)
+                if not self._thread.is_alive():
+                    break
+                with self._live_lock:
+                    after = len(self._live)
+                if after >= before:
+                    # A full per-batch window with zero futures resolved
+                    # (racing submits can only grow the count): wedged,
+                    # not draining.
+                    break
+        else:
+            self._thread.join()
+        if self._thread.is_alive():
+            err = ServingError(
+                "dispatcher wedged in a device call at stop() — thread "
+                "abandoned (only an external kill -9 clears a hung "
+                "device RPC; see runtime/supervise.py)",
+                phase="shutdown")
+            self._failure = err
+            self._thread = None
+            self._sweep_live(err)
+            self._drain_cancelled(err)
+            # If the abandoned thread ever unwedges it must find a
+            # sentinel (the drain above may have eaten the original)
+            # and exit instead of blocking on the empty queue forever.
+            self._queue.put(_SENTINEL)
+            return
         self._thread = None
         # A submit racing the shutdown can enqueue AFTER the dispatcher's
         # own drain; nothing will read the queue now, so sweep it again.
         self._drain_cancelled(self._failure)
+        # Belt over braces: the registry must be empty here (the
+        # dispatcher resolved or poisoned everything it saw) — if a
+        # crash path missed one, resolving it late beats a hung caller.
+        self._sweep_live(self._failure or ServingError(
+            "serving engine stopped before this request was resolved",
+            phase="shutdown"))
 
     def __enter__(self) -> "ServingEngine":
         return self.start()
@@ -273,6 +412,9 @@ class ServingEngine:
         with self._exe_lock:
             # First writer wins, like the executable caches.
             self._shaped.setdefault(key, shaped)
+            # The raw betas ride along for the CPU fallback path, which
+            # re-runs the FULL forward (broadcasting these per row).
+            self._subject_betas.setdefault(key, shape)
         self.counters.count_specialize(hit=False)
         return key
 
@@ -348,6 +490,7 @@ class ServingEngine:
             raise RuntimeError(
                 "serving engine dispatcher died") from self._failure
         req = _Request(pose, shape, n, squeeze, subject)
+        self._register(req)
         self.start()
         self._queue.put(req)
         if self._failure is not None:
@@ -384,6 +527,12 @@ class ServingEngine:
             before = self.counters.aot_loads
             self._executable(b)
             out[b] = "aot" if self.counters.aot_loads > before else "jit"
+        if self._policy is not None and self._policy.cpu_fallback:
+            # Warm the graceful-degradation tier alongside the primary:
+            # compiling the fallback DURING an outage would stack a
+            # cold compile on top of the failure it exists to absorb.
+            for b in bucket_list or self.buckets:
+                self._fallback_executable(b)
         return out
 
     # ---------------------------------------------------------- executables
@@ -462,6 +611,14 @@ class ServingEngine:
                 tmp = path.with_suffix(f".tmp{os.getpid()}")
                 tmp.write_bytes(export_forward(self._params, batch=bucket))
                 os.replace(tmp, path)
+        if self._policy is not None and self._policy.chaos is not None:
+            # Chaos wraps the PRIMARY executable ONCE, at cache time:
+            # every later dispatch attempt consults the plan (each
+            # attempt advances the plan's call index), while the CPU
+            # fallback path stays clean by construction — failover is
+            # measured recovery, not roulette.
+            loaded = self._policy.chaos.wrap(
+                loaded, on_fault=self.counters.count_fault)
         with self._exe_lock:
             # Two threads can race the build; first writer wins so the
             # cache never flips executables under steady traffic.
@@ -489,8 +646,38 @@ class ServingEngine:
         exe = build_posed_bucket_executable(
             proto, bucket, self._n_joints, self._dtype, donate=self.donate)
         self.counters.count_compile()
+        if self._policy is not None and self._policy.chaos is not None:
+            # Same primary-only chaos wrapping as the full path.
+            exe = self._policy.chaos.wrap(
+                exe, on_fault=self.counters.count_fault)
         with self._exe_lock:
             exe = self._posed_exes.setdefault(bucket, exe)
+        return exe
+
+    def _fallback_executable(self, bucket: int):
+        """The CPU graceful-degradation entry — in-memory then jit.
+
+        Normally built eagerly by ``warmup()`` (which warms the whole
+        fallback tier whenever ``policy.cpu_fallback`` is set — a cold
+        compile must not stack on top of the outage it absorbs); this
+        lazy path only pays the compile if a failover hits a bucket
+        that was never warmed. Counted as a compile either way. Serves
+        both request kinds: full requests directly, subject
+        requests by re-running the full forward with the stored betas
+        — the same program family as the primary, params as runtime
+        args, so failover results are bit-identical to a direct CPU
+        bucketed call (the parity criterion in tests/test_runtime.py).
+        """
+        with self._exe_lock:
+            exe = self._cpu_exes.get(bucket)
+        if exe is not None:
+            return exe
+        exe = build_cpu_fallback_executable(
+            self._params, bucket, self._n_joints, self._n_shape,
+            self._dtype)
+        self.counters.count_compile()
+        with self._exe_lock:
+            exe = self._cpu_exes.setdefault(bucket, exe)
         return exe
 
     # ------------------------------------------------------------ dispatch
@@ -551,7 +738,9 @@ class ServingEngine:
                 self.counters.observe_queue_depth(
                     self._queue.qsize() + 1)
                 reqs, rows = self._coalesce(first)
-                inflight.append(self._launch(reqs, rows))
+                item = self._launch(reqs, rows)
+                if item is not None:  # None: batch resolved to an error
+                    inflight.append(item)
                 # Double buffering: block on the OLDEST batch only once
                 # the pipeline is full — assembly of the next batch then
                 # overlaps the device executing this one.
@@ -581,24 +770,118 @@ class ServingEngine:
                 pose = np.concatenate([r.pose for r in reqs])
             pose = bucket_mod.pad_rows(pose, bucket)
             subject = reqs[0].subject  # uniform per batch (_coalesce)
-            if subject is not None:
+            shape = None
+            if subject is None:
+                shape = (reqs[0].shape if len(reqs) == 1 else
+                         np.concatenate([r.shape for r in reqs]))
+                shape = bucket_mod.pad_rows(shape, bucket)
+            if self._policy is not None:
+                # Supervised: resolved to a HOST array inside the
+                # policy's deadline/retry/failover envelope before the
+                # next batch launches (bounded latency over overlap).
+                out = self._supervised_dispatch(bucket, pose, shape,
+                                                subject)
+            elif subject is not None:
                 with self._exe_lock:
                     shaped = self._shaped[subject]
                 out = self._posed_executable(bucket)(shaped, pose)
             else:
-                shape = (reqs[0].shape if len(reqs) == 1 else
-                         np.concatenate([r.shape for r in reqs]))
-                shape = bucket_mod.pad_rows(shape, bucket)
                 exe = self._executable(bucket)
                 out = exe(pose, shape)  # async dispatch: pre-completion
             self.counters.count_dispatch(bucket, rows)
             return out, reqs, bucket
+        except ServingError as e:
+            # Supervision exhausted for THIS batch: its futures get the
+            # structured error and the dispatcher lives on — a failed
+            # batch is traffic, not an engine invariant breach. (The
+            # fault may clear; later submits must still be servable.)
+            self._poison(reqs, e)
+            return None
         except BaseException as e:
             # This batch's requests live only in our locals — the outer
             # crash handler cannot see them, so a caller blocked on one
             # of these futures would otherwise hang forever.
             self._poison(reqs, e)
             raise
+
+    def _supervised_dispatch(self, bucket: int, pose, shape,
+                             subject: Optional[str]):
+        """One batch through the full fault-tolerance envelope:
+        supervised primary attempts (deadline + classified retries with
+        backoff, breaker-gated), then CPU graceful degradation, then a
+        structured ``ServingError``. Deterministic failures (compile
+        errors, shape bugs) are NOT retried and NOT failed over — they
+        propagate and stay engine-fatal, the pre-PR-3 contract.
+
+        Executables are fetched (and so possibly built) OUTSIDE the
+        per-attempt deadline: builds are warm-up-class work — size the
+        deadline for dispatch, and ``warmup()`` engines ahead of
+        supervised traffic.
+        """
+        from mano_hand_tpu.runtime import supervise
+
+        pol = self._policy
+        breaker = pol.breaker
+        if subject is not None:
+            with self._exe_lock:
+                shaped = self._shaped[subject]
+            exe = self._posed_executable(bucket)
+            primary = lambda: np.asarray(exe(shaped, pose))  # noqa: E731
+        else:
+            exe = self._executable(bucket)
+            primary = lambda: np.asarray(exe(pose, shape))   # noqa: E731
+
+        last = None
+        attempts = 0
+        if breaker is None or breaker.allow_primary():
+            try:
+                out = supervise.supervised_call(
+                    primary,
+                    deadline_s=pol.deadline_s,
+                    retries=pol.retries,
+                    backoff_s=pol.backoff_s,
+                    backoff_cap_s=pol.backoff_cap_s,
+                    jitter=pol.jitter,
+                    keep_trying=(breaker.allow_primary
+                                 if breaker is not None else None),
+                    on_retry=self.counters.count_retry,
+                    on_deadline_kill=self.counters.count_deadline_kill,
+                    on_attempt_failure=(breaker.record_failure
+                                        if breaker is not None else None),
+                    name=f"serve-dispatch-b{bucket}",
+                )
+                if breaker is not None:
+                    breaker.record_success()
+                return out
+            except supervise.RetriesExhausted as e:
+                last, attempts = e.cause, e.attempts
+        if pol.cpu_fallback:
+            self.counters.count_failover()
+            if subject is not None:
+                with self._exe_lock:
+                    betas = self._subject_betas[subject]
+                fb_shape = np.ascontiguousarray(np.broadcast_to(
+                    betas[None], (bucket, self._n_shape)))
+            else:
+                fb_shape = shape
+            fb = self._fallback_executable(bucket)  # built un-deadlined
+            try:
+                return supervise.call_with_deadline(
+                    lambda: np.asarray(fb(pose, fb_shape)),
+                    pol.deadline_s, name=f"serve-fallback-b{bucket}")
+            except BaseException as e:
+                raise ServingError(
+                    f"dispatch failed on the primary path "
+                    f"({attempts} attempt(s)) AND the CPU fallback: "
+                    f"{type(e).__name__}: {e}",
+                    attempts=attempts, cause=e) from e
+        raise ServingError(
+            "dispatch failed: primary path "
+            + ("unavailable (circuit breaker open)" if last is None
+               else f"exhausted after {attempts} attempt(s): "
+                    f"{type(last).__name__}: {last}")
+            + " and cpu_fallback is disabled",
+            attempts=attempts, cause=last)
 
     def _resolve(self, item) -> None:
         out, reqs, bucket = item
@@ -612,14 +895,37 @@ class ServingEngine:
         for r in reqs:
             piece = verts[lo:lo + r.rows]
             lo += r.rows
-            r.future.set_result(piece[0] if r.squeeze else piece)
+            if not r.future.done():  # a shutdown sweep can win the race
+                r.future.set_result(piece[0] if r.squeeze else piece)
+            self._deregister(r)
             self.counters.record_latency(bucket, now - r.t_submit)
 
-    @staticmethod
-    def _poison(reqs, exc: BaseException) -> None:
+    # ------------------------------------------------- resolution guarantees
+    # Every request is registered at submit and deregistered at the ONE
+    # place its future is resolved; ``_sweep_live`` is the last-resort
+    # resolver for a wedged/dead dispatcher. The invariant under test
+    # (tests/test_runtime.py): no future handed out by submit() can ever
+    # be waited on forever.
+    def _register(self, req: _Request) -> None:
+        with self._live_lock:
+            self._live[id(req)] = req
+
+    def _deregister(self, req: _Request) -> None:
+        with self._live_lock:
+            self._live.pop(id(req), None)
+
+    def _sweep_live(self, exc: BaseException) -> None:
+        with self._live_lock:
+            reqs, self._live = list(self._live.values()), {}
         for r in reqs:
             if not r.future.done():
                 r.future.set_exception(exc)
+
+    def _poison(self, reqs, exc: BaseException) -> None:
+        for r in reqs:
+            if not r.future.done():
+                r.future.set_exception(exc)
+            self._deregister(r)
 
     def _drain_cancelled(self, exc: Optional[BaseException] = None) -> None:
         """After stop()/crash: no request future may hang forever."""
@@ -630,9 +936,10 @@ class ServingEngine:
                 return
             if req is _SENTINEL:
                 continue
-            if exc is not None:
-                req.future.set_exception(exc)
-            else:
+            if not req.future.done():
                 req.future.set_exception(
-                    RuntimeError("serving engine stopped before this "
-                                 "request was dispatched"))
+                    exc if exc is not None else
+                    ServingError("serving engine stopped before this "
+                                 "request was dispatched",
+                                 phase="shutdown"))
+            self._deregister(req)

@@ -90,6 +90,7 @@ def serve_bench_run(
     aot_dir=None,
     seed: int = 0,
     trials: int = 7,
+    policy=None,
     log: Callable[[str], None] = None,
 ) -> dict:
     """THE serving benchmark protocol — shared by ``bench.py`` config7
@@ -129,8 +130,12 @@ def serve_bench_run(
          rng.normal(size=(n, n_shape)).astype(np.float32))
         for n in (int(s) for s in sizes)
     ]
+    # ``policy`` (a runtime.DispatchPolicy) runs the whole protocol
+    # under supervised dispatch — `mano serve-bench --chaos <plan>`
+    # uses it to measure what a fault schedule does to live metrics.
     eng = ServingEngine(params, max_bucket=max_bucket,
-                        max_delay_s=max_delay_s, aot_dir=aot_dir)
+                        max_delay_s=max_delay_s, aot_dir=aot_dir,
+                        policy=policy)
 
     def run_stream():
         futs = [eng.submit(p, s) for p, s in stream]
@@ -196,4 +201,218 @@ def serve_bench_run(
         "rows": [int(sizes.min()), int(sizes.max())],
         "buckets": list(eng.buckets),
         **snapshot,
+    }
+
+
+def recovery_drill_run(
+    params,
+    *,
+    requests_per_class: int = 12,
+    max_rows: int = 5,
+    max_bucket: int = 8,
+    deadline_s: float = 2.0,
+    latency_spike_s: float = 0.05,
+    seed: int = 0,
+    log: Callable[[str], None] = None,
+) -> dict:
+    """THE fault-recovery drill protocol — shared by ``bench.py``
+    config7_recovery, `mano serve-bench --chaos drill`, and
+    tests/test_runtime.py so the three artifacts cannot diverge.
+
+    One supervised ``ServingEngine`` (runtime.DispatchPolicy: per-batch
+    deadline, 1 retry, circuit breaker with a drill-controlled probe,
+    CPU fallback) is driven through every tunnel failure class via a
+    rescheduled ``ChaosPlan`` — transient error, latency spike, hang,
+    persistent outage — then through recovery. The done-criteria
+    (scripts/bench_report.py) read the returned numbers:
+
+    * ``futures_resolved_fraction`` == 1.0: every submitted future
+      resolved (result or structured ServingError) under every fault;
+    * ``failover_vs_cpu_direct_max_abs_err`` == 0.0: failover results
+      are bit-identical to a direct CPU bucketed call (the fallback
+      runs the same params-as-runtime-args program family);
+    * ``post_recovery_steady_recompiles`` == 0: after the fault clears
+      and the breaker re-closes, the still-warm primary executables
+      serve with zero recompiles — failback is free.
+
+    ``failover_overhead_ratio`` (failover vs healthy seconds/request,
+    single-pass wall clock on a drifting box — an indicator, not a
+    slope-grade measurement) quantifies what degraded mode costs.
+    Everything runs on whatever backend is up; faults are injected
+    in-process, so no chip is required and none is harmed.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+    from mano_hand_tpu.runtime.chaos import ChaosPlan
+    from mano_hand_tpu.runtime.health import CircuitBreaker
+    from mano_hand_tpu.runtime.supervise import DispatchPolicy
+    from mano_hand_tpu.serving.engine import ServingEngine, ServingError
+
+    n_joints, n_shape = params.n_joints, params.n_shape
+    rng = np.random.default_rng(seed)
+
+    def make_stream(n):
+        sizes = rng.integers(1, max_rows + 1, size=n)
+        return [
+            (rng.normal(scale=0.4,
+                        size=(int(s), n_joints, 3)).astype(np.float32),
+             rng.normal(size=(int(s), n_shape)).astype(np.float32))
+            for s in sizes
+        ]
+
+    tunnel_ok = [True]           # the drill's hand on the simulated tunnel
+    plan = ChaosPlan()
+    breaker = CircuitBreaker(
+        failure_threshold=2,
+        probe=lambda: tunnel_ok[0],
+        probe_interval_s=0.0,           # drill wants instant re-probes
+        respect_priority_claim=False,   # the fake tunnel needs no lock
+    )
+    policy = DispatchPolicy(
+        deadline_s=deadline_s, retries=1, backoff_s=0.01,
+        backoff_cap_s=0.02, jitter=0.0, breaker=breaker, chaos=plan,
+        cpu_fallback=True,
+    )
+    eng = ServingEngine(params.astype(np.float32), max_bucket=max_bucket,
+                        max_delay_s=0.001, policy=policy)
+    resolve_timeout = deadline_s * (policy.retries + 2) + 30.0
+
+    # Bit-identity reference: the SAME program family as the fallback
+    # (params as runtime args, forward_batched), pinned to CPU.
+    cpu = jax.devices("cpu")[0]
+    prm_cpu = jax.device_put(params.astype(np.float32), cpu)
+    ref = jax.jit(lambda q, p, s: core.forward_batched(q, p, s).verts)
+
+    def cpu_direct(p, s):
+        return np.asarray(ref(prm_cpu, jax.device_put(jnp.asarray(p), cpu),
+                              jax.device_put(jnp.asarray(s), cpu)))
+
+    def run_pass(stream):
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, s) for p, s in stream]
+        ok = err = unresolved = 0
+        for f in futs:
+            try:
+                f.result(timeout=resolve_timeout)
+                ok += 1
+            except ServingError:
+                err += 1
+            except Exception:       # noqa: BLE001 — a timeout IS the bug
+                unresolved += 1
+        return ok, err, unresolved, time.perf_counter() - t0
+
+    before = {}
+
+    def delta(counters):
+        out = {k: getattr(eng.counters, k) - before.get(k, 0)
+               for k in ("retries", "faults_injected", "deadline_kills",
+                         "failovers")}
+        for k in out:
+            before[k] = getattr(eng.counters, k)
+        return out
+
+    classes = {}
+    try:
+        with eng:
+            eng.warmup()
+            warm_compiles = eng.counters.compiles
+            # Healthy baseline for the failover-overhead ratio.
+            healthy = make_stream(requests_per_class)
+            ok, err, un, t_healthy = run_pass(healthy)
+            delta(eng.counters)   # zero the counter cursor
+            healthy_per_req = t_healthy / max(1, len(healthy))
+            if log:
+                log(f"recovery drill: healthy baseline "
+                    f"{healthy_per_req * 1e3:.2f} ms/request")
+
+            specs = [
+                ("transient", "error@0,error@3", True),
+                ("latency", f"latency:{latency_spike_s}@0-2", True),
+                ("hang", "hang@0", True),
+                ("persistent", "error@0-", False),
+            ]
+            t_failover = None
+            failover_err = None
+            for name, spec, tunnel_up in specs:
+                breaker.reset()
+                tunnel_ok[0] = tunnel_up
+                plan.schedule(spec)
+                stream = make_stream(requests_per_class)
+                ok, err, un, dt = run_pass(stream)
+                d = delta(eng.counters)
+                classes[name] = {
+                    "submitted": len(stream),
+                    "resolved_ok": ok,
+                    "resolved_error": err,
+                    "unresolved": un,
+                    **d,
+                }
+                if name == "persistent":
+                    # The first pass opened the breaker and compiled the
+                    # fallback executables; a SECOND pass, still under
+                    # fault, times steady degraded serving so the
+                    # overhead ratio describes failover, not the one-off
+                    # fallback compiles.
+                    stream2 = make_stream(requests_per_class)
+                    ok2, err2, un2, dt2 = run_pass(stream2)
+                    t_failover = dt2 / max(1, len(stream2))
+                    for k, v in (("submitted", len(stream2)),
+                                 ("resolved_ok", ok2),
+                                 ("resolved_error", err2),
+                                 ("unresolved", un2)):
+                        classes[name][k] += v
+                    # Failover parity probe: one more request, compared
+                    # bitwise against the direct CPU program.
+                    p, s = make_stream(1)[0]
+                    got = eng.forward(p, s)
+                    failover_err = float(
+                        np.abs(got - cpu_direct(p, s)).max())
+                    d2 = delta(eng.counters)
+                    for k, v in d2.items():
+                        classes[name][k] += v
+                plan.clear()
+                tunnel_ok[0] = True
+                if log:
+                    log(f"recovery drill [{name}]: {ok} ok / {err} err / "
+                        f"{un} unresolved over {len(stream)} requests "
+                        f"({d})")
+
+            # Recovery: fault cleared, tunnel probe green. The breaker
+            # is still DOWN from the persistent class — the first
+            # dispatch re-probes, closes it, and fails back to the warm
+            # primary executables, which must serve with ZERO further
+            # compiles (the failback-is-free criterion).
+            run_pass(make_stream(requests_per_class))      # settle
+            compiles_settled = eng.counters.compiles
+            ok, err, un, t_rec = run_pass(make_stream(requests_per_class))
+            steady = eng.counters.compiles - compiles_settled
+            delta(eng.counters)
+    finally:
+        plan.release.set()   # free any abandoned hung worker threads
+
+    total_submitted = sum(c["submitted"] for c in classes.values())
+    total_unresolved = sum(c["unresolved"] for c in classes.values())
+    resolved_fraction = (
+        1.0 - total_unresolved / total_submitted if total_submitted else 0.0)
+    ratio = (t_failover / healthy_per_req
+             if t_failover and healthy_per_req else None)
+    return {
+        "deadline_s": deadline_s,
+        "requests_per_class": requests_per_class,
+        "classes": classes,
+        "futures_resolved_fraction": float(f"{resolved_fraction:.6g}"),
+        "failover_vs_cpu_direct_max_abs_err": failover_err,
+        "failover_overhead_ratio": (float(f"{ratio:.4g}")
+                                    if ratio is not None else None),
+        "healthy_s_per_request": float(f"{healthy_per_req:.5g}"),
+        "failover_s_per_request": (float(f"{t_failover:.5g}")
+                                   if t_failover is not None else None),
+        "post_recovery_steady_recompiles": int(steady),
+        "post_recovery_ok": ok,
+        "warmup_compiles": int(warm_compiles),
+        "breaker_opens": breaker.opens,
+        "breaker_probes": breaker.probes,
+        "breaker_state_final": breaker.state,
     }

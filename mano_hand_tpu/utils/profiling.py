@@ -114,6 +114,13 @@ class ServingCounters:
         self.queue_depth_peak = 0  # max pending requests seen at coalesce
         self.specializations = 0   # shape-stage bakes (subject-cache misses)
         self.shaped_hits = 0       # subject-cache hits (bake reused)
+        # Fault-tolerance counters (runtime/, PR 3): the recovery
+        # drill's done-criteria read these, so resilience is a set of
+        # numbers, not a hope — same philosophy as ``compiles``.
+        self.retries = 0           # supervised dispatch retry attempts
+        self.faults_injected = 0   # chaos-plan faults fired (tests/drills)
+        self.failovers = 0         # dispatches served by the CPU fallback
+        self.deadline_kills = 0    # supervised calls abandoned at deadline
         self._latencies: Dict[int, list] = {}  # bucket -> [seconds]
         self._latency_writes: Dict[int, int] = {}  # per-bucket write cursor
 
@@ -136,6 +143,22 @@ class ServingCounters:
                 self.shaped_hits += 1
             else:
                 self.specializations += 1
+
+    def count_retry(self, n: int = 1) -> None:
+        with self._lock:
+            self.retries += n
+
+    def count_fault(self, n: int = 1) -> None:
+        with self._lock:
+            self.faults_injected += n
+
+    def count_failover(self, n: int = 1) -> None:
+        with self._lock:
+            self.failovers += n
+
+    def count_deadline_kill(self, n: int = 1) -> None:
+        with self._lock:
+            self.deadline_kills += n
 
     def count_dispatch(self, bucket: int, live_rows: int) -> None:
         with self._lock:
@@ -201,6 +224,10 @@ class ServingCounters:
                 "queue_depth_peak": self.queue_depth_peak,
                 "specializations": self.specializations,
                 "shaped_hits": self.shaped_hits,
+                "retries": self.retries,
+                "faults_injected": self.faults_injected,
+                "failovers": self.failovers,
+                "deadline_kills": self.deadline_kills,
             }
         base["padding_waste"] = round(self.padding_waste, 4)
         base["latency_by_bucket"] = self.latency_quantiles()

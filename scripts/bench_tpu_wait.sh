@@ -18,6 +18,30 @@
 # wrapper down, left no orphan processes, and resumed cleanly after the
 # claim cleared.
 #
+# SUPERVISION SEMANTICS (the audited Python counterpart of every piece
+# of this script is mano_hand_tpu/runtime/supervise.py — this wrapper
+# is the process-level escalation tier it cannot be):
+#   - Why `timeout -k 60` (SIGKILL after SIGTERM) and not SIGTERM alone:
+#     a tunnel drop wedges bench.py's main thread inside a C-level PJRT
+#     RPC, and CPython delivers signal handlers only on the MAIN thread
+#     between bytecodes — a thread parked in a C call never reaches the
+#     next bytecode, so SIGTERM is accepted and never acted on (observed
+#     live r5). Only SIGKILL, from OUTSIDE the process, clears it; this
+#     wrapper is the kill -9-capable supervisor everything long-running
+#     on the chip must have (runtime.supervise.Watchdog covers the
+#     in-process half: it emits the salvage artifact BEFORE our -k
+#     window closes, which is why --emit-by rides under the attempt cap).
+#   - The retry loop here is the shell rendering of
+#     runtime.supervise.supervised_call: bounded attempts (the DEADLINE
+#     self-expiry — the r3 incident was exactly this loop without a
+#     bound), per-attempt deadlines (`timeout`), backoff between
+#     attempts (the sleeps), and failure classification (rc=2 device-
+#     busy stands down rather than burning the budget; only other
+#     nonzero rcs count as retryable failures).
+#   - The claim_fresh poll is the shell half of
+#     runtime.health.CircuitBreaker's priority-claim awareness: while
+#     the driver's claim is fresh, no probes, no attempts.
+#
 # Usage: scripts/bench_tpu_wait.sh [OUT_BASENAME] [DEADLINE_S]
 set -u
 cd "$(dirname "$0")/.."

@@ -1,0 +1,548 @@
+"""The fault-tolerant device runtime (runtime/, ISSUE 3 tentpole), CPU-run.
+
+Every tunnel failure mode the ops notes record — hang-forever in a
+C-level RPC, transient gRPC error, persistent multi-hour outage,
+latency spike, silent wrong output — is reproduced here deterministically
+via the chaos harness and driven through the supervised ServingEngine:
+deadline kills, classified retries with backoff, breaker transitions
+(healthy -> degraded -> down), CPU graceful degradation (bit-identical
+to the direct CPU program), recompile-free failback, and the
+future-resolution guarantee (a result or a structured ServingError,
+never a hang — including when the dispatcher itself is wedged or dead).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mano_hand_tpu.models import core
+from mano_hand_tpu.runtime import chaos, health, supervise
+from mano_hand_tpu.runtime.supervise import DispatchPolicy
+from mano_hand_tpu.serving.engine import ServingEngine, ServingError
+from mano_hand_tpu.utils.profiling import ServingCounters
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+def _req(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.normal(scale=0.4, size=(n, 16, 3)).astype(np.float32),
+            rng.normal(size=(n, 10)).astype(np.float32))
+
+
+def _direct(params32, pose, shape):
+    return np.asarray(core.jit_forward_batched(
+        params32, jnp.asarray(pose), jnp.asarray(shape)).verts)
+
+
+# ------------------------------------------------------------- chaos plans
+def test_parse_plan_grammar():
+    plan = chaos.parse_plan("error@1-2,latency:0.2@4,wrong@6,hang@8-,"
+                            "fatal@3,error@*")
+    kinds = [(e.kind, e.start, e.stop, e.param) for e in plan._events]
+    assert (("error", 1, 2, 0.0) in kinds and ("latency", 4, 4, 0.2) in kinds
+            and ("wrong", 6, 6, 1.0) in kinds and ("hang", 8, None, 0.0)
+            in kinds and ("fatal", 3, 3, 0.0) in kinds
+            and ("error", 0, None, 0.0) in kinds)
+    with pytest.raises(ValueError, match="lacks '@SELECTOR'"):
+        chaos.parse_plan("error")
+    with pytest.raises(ValueError, match="unknown chaos kind"):
+        chaos.parse_plan("explode@1")
+    with pytest.raises(ValueError, match="latency events need"):
+        chaos.parse_plan("latency@1")
+
+
+def test_chaos_wrap_semantics():
+    plan = chaos.ChaosPlan("error@0,latency:0.01@2,wrong:0.5@3,fatal@4")
+    hits = []
+    fn = plan.wrap(lambda x: x * 2.0, on_fault=lambda: hits.append(1))
+    with pytest.raises(chaos.InjectedFault, match="UNAVAILABLE") as e0:
+        fn(1.0)                                   # call 0: transient error
+    assert e0.value.transient
+    assert fn(2.0) == 4.0                         # call 1: clean
+    t0 = time.perf_counter()
+    assert fn(3.0) == 6.0                         # call 2: latency, correct
+    assert time.perf_counter() - t0 >= 0.01
+    assert fn(4.0) == 8.5                         # call 3: silently wrong
+    with pytest.raises(chaos.InjectedFault, match="INVALID_ARGUMENT") as e4:
+        fn(5.0)                                   # call 4: deterministic
+    assert not e4.value.transient
+    assert plan.faults_injected == 4 and len(hits) == 4
+    # schedule() restarts the call index; the audit trail accumulates.
+    plan.schedule("error@0")
+    with pytest.raises(chaos.InjectedFault):
+        fn(1.0)
+    assert plan.faults_injected == 5
+    plan.clear()
+    assert fn(1.0) == 2.0
+
+
+def test_chaos_hang_released_by_event():
+    plan = chaos.ChaosPlan("hang@0")
+    fn = plan.wrap(lambda: "ok")
+    t = threading.Timer(0.05, plan.release.set)
+    t.start()
+    with pytest.raises(chaos.InjectedFault, match="released"):
+        fn()
+    t.join()
+
+
+# ----------------------------------------------------- supervise primitives
+def test_classify_failure_matrix():
+    C = supervise.classify_failure
+    assert C(ValueError("bad shape")) == supervise.DETERMINISTIC
+    assert C(TypeError("x")) == supervise.DETERMINISTIC
+    assert C(RuntimeError("UNAVAILABLE: socket closed")) == \
+        supervise.TRANSIENT
+    assert C(RuntimeError("INVALID_ARGUMENT: bad HLO")) == \
+        supervise.DETERMINISTIC
+    assert C(supervise.DeadlineExceeded("d")) == supervise.TRANSIENT
+    assert C(chaos.InjectedFault("x", transient=True)) == supervise.TRANSIENT
+    assert C(chaos.InjectedFault("x", transient=False)) == \
+        supervise.DETERMINISTIC
+    assert C(ConnectionError("reset")) == supervise.TRANSIENT
+    # Unknown failures default DETERMINISTIC: the r3 incident's lesson —
+    # an optimistic retry loop is worse than a clean failure.
+    assert C(RuntimeError("who knows")) == supervise.DETERMINISTIC
+
+
+def test_call_with_deadline_passthrough_and_kill():
+    assert supervise.call_with_deadline(lambda: 7, None) == 7
+    assert supervise.call_with_deadline(lambda: 7, 5.0) == 7
+    with pytest.raises(ValueError, match="boom"):
+        supervise.call_with_deadline(
+            lambda: (_ for _ in ()).throw(ValueError("boom")), 5.0)
+    gate = threading.Event()
+    t0 = time.perf_counter()
+    with pytest.raises(supervise.DeadlineExceeded, match="abandoned"):
+        supervise.call_with_deadline(gate.wait, 0.1)
+    assert time.perf_counter() - t0 < 2.0
+    gate.set()  # unwedge the abandoned daemon thread
+
+
+def test_supervised_call_retries_transient_then_succeeds():
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise chaos.InjectedFault("UNAVAILABLE blip", transient=True)
+        return "ok"
+
+    retried = []
+    out = supervise.supervised_call(
+        flaky, retries=3, backoff_s=0.001, jitter=0.0,
+        on_retry=lambda: retried.append(1))
+    assert out == "ok" and state["n"] == 3 and len(retried) == 2
+
+
+def test_supervised_call_never_retries_deterministic():
+    state = {"n": 0}
+
+    def broken():
+        state["n"] += 1
+        raise ValueError("a compile error rerun is the same compile error")
+
+    with pytest.raises(ValueError):
+        supervise.supervised_call(broken, retries=5, backoff_s=0.001)
+    assert state["n"] == 1
+
+
+def test_supervised_call_exhaustion_carries_cause():
+    def always():
+        raise chaos.InjectedFault("UNAVAILABLE forever", transient=True)
+
+    failures = []
+    with pytest.raises(supervise.RetriesExhausted) as e:
+        supervise.supervised_call(
+            always, retries=2, backoff_s=0.001, jitter=0.0,
+            on_attempt_failure=lambda: failures.append(1))
+    assert e.value.attempts == 3 and len(failures) == 3
+    assert isinstance(e.value.cause, chaos.InjectedFault)
+
+
+def test_supervised_call_keep_trying_short_circuits():
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise chaos.InjectedFault("UNAVAILABLE", transient=True)
+
+    with pytest.raises(supervise.RetriesExhausted) as e:
+        supervise.supervised_call(
+            always, retries=10, backoff_s=0.001,
+            keep_trying=lambda: False)   # breaker opened: stop burning
+    assert e.value.attempts == 1 and len(calls) == 1
+
+
+def test_backoff_delay_grows_caps_and_is_deterministic():
+    ds = [supervise.backoff_delay(a, 0.1, 1.0, jitter=0.0)
+          for a in range(6)]
+    assert ds == [0.1, 0.2, 0.4, 0.8, 1.0, 1.0]  # 2^a growth, capped
+    import random
+
+    rng = random.Random(0)
+    j = supervise.backoff_delay(1, 0.1, 1.0, jitter=0.5, rng=rng)
+    assert 0.1 <= j <= 0.3  # within +-50% of 0.2
+
+
+def test_watchdog_deadline_fires_and_disarm_holds():
+    fired = []
+    supervise.Watchdog(fired.append, deadline_s=0.05, poll_s=0.02,
+                       name="t-wd").start()
+    deadline = time.time() + 5.0
+    while not fired and time.time() < deadline:
+        time.sleep(0.02)
+    assert fired and "emit-by deadline" in fired[0]
+
+    quiet = []
+    wd = supervise.Watchdog(quiet.append, deadline_s=0.05,
+                            poll_s=0.02).start()
+    wd.disarm()
+    time.sleep(0.2)
+    assert not quiet
+    # No triggers configured: no thread at all.
+    assert supervise.Watchdog(quiet.append).start()._thread is None
+
+
+def test_watchdog_stall_needs_progress_source():
+    with pytest.raises(ValueError, match="progress"):
+        supervise.Watchdog(lambda c: None, stall_s=1.0)
+
+
+def test_run_python_success_and_kill():
+    ok = supervise.run_python("print('alive')", timeout_s=30.0)
+    assert ok.ok and ok.out == "alive"
+    t0 = time.perf_counter()
+    hung = supervise.run_python("import time; time.sleep(60)",
+                                timeout_s=0.5)
+    assert not hung.ok and hung.killed
+    assert time.perf_counter() - t0 < 30.0
+
+
+# --------------------------------------------------------- circuit breaker
+def test_breaker_transitions_and_counts():
+    br = health.CircuitBreaker(failure_threshold=2, probe=lambda: False,
+                               probe_interval_s=1e9,
+                               respect_priority_claim=False)
+    assert br.state == health.HEALTHY and br.allow_primary()
+    assert br.record_failure() == health.DEGRADED
+    assert br.allow_primary()            # degraded still serves primary
+    assert br.record_failure() == health.DOWN
+    assert br.opens == 1
+    assert not br.allow_primary()        # probed once (fails), then caches
+    assert br.probes == 1
+    assert not br.allow_primary()        # inside the interval: no probe
+    assert br.probes == 1
+    assert br.record_success() == health.HEALTHY
+    with pytest.raises(ValueError, match="failure_threshold"):
+        health.CircuitBreaker(failure_threshold=0)
+
+
+def test_breaker_probe_closes_on_recovery():
+    tunnel = [False]
+    br = health.CircuitBreaker(failure_threshold=1, probe=lambda: tunnel[0],
+                               probe_interval_s=0.0,
+                               respect_priority_claim=False)
+    br.record_failure()
+    assert br.state == health.DOWN and not br.allow_primary()
+    tunnel[0] = True
+    assert br.allow_primary()            # probe green -> breaker closes
+    assert br.state == health.HEALTHY
+
+
+def test_breaker_stands_down_for_priority_claim(tmp_path, monkeypatch):
+    """A recovering engine must NEVER probe into the driver bench's
+    device window (the round-3 contention class, generalized)."""
+    from mano_hand_tpu.utils import devicelock
+
+    claim = tmp_path / "d.claim"
+    monkeypatch.setattr(devicelock, "CLAIM_PATH", str(claim))
+    claim.write_text("{}")               # fresh driver claim
+    probes = []
+    br = health.CircuitBreaker(
+        failure_threshold=1,
+        probe=lambda: probes.append(1) or True,
+        probe_interval_s=0.0, respect_priority_claim=True)
+    br.record_failure()
+    assert not br.allow_primary() and not probes  # no probe, stay down
+    claim.unlink()                        # driver done: probe resumes
+    assert br.allow_primary() and probes
+
+
+# ------------------------------------------------ the engine chaos matrix
+def _policy(plan=None, breaker=None, **kw):
+    kw.setdefault("deadline_s", None)
+    kw.setdefault("retries", 2)
+    kw.setdefault("backoff_s", 0.001)
+    kw.setdefault("jitter", 0.0)
+    return DispatchPolicy(breaker=breaker, chaos=plan, **kw)
+
+
+def test_engine_transient_fault_then_recover(params32):
+    plan = chaos.ChaosPlan("error@0")
+    br = health.CircuitBreaker(failure_threshold=3, probe=lambda: True,
+                               probe_interval_s=0.0,
+                               respect_priority_claim=False)
+    pose, shape = _req(3, seed=1)
+    with ServingEngine(params32, max_bucket=4,
+                       policy=_policy(plan, br)) as eng:
+        got = eng.forward(pose, shape)
+    np.testing.assert_array_equal(got, _direct(params32, pose, shape))
+    assert eng.counters.retries == 1
+    assert eng.counters.faults_injected == 1
+    assert eng.counters.failovers == 0
+    assert br.state == health.HEALTHY
+
+
+def test_engine_latency_spike_rides_through(params32):
+    plan = chaos.ChaosPlan("latency:0.02@0")
+    pose, shape = _req(3, seed=2)
+    with ServingEngine(params32, max_bucket=4,
+                       policy=_policy(plan)) as eng:
+        got = eng.forward(pose, shape)
+    np.testing.assert_array_equal(got, _direct(params32, pose, shape))
+    assert eng.counters.retries == 0
+    assert eng.counters.deadline_kills == 0
+
+
+def test_engine_hang_is_deadline_killed_and_retried(params32):
+    plan = chaos.ChaosPlan("hang@0")
+    pose, shape = _req(3, seed=3)
+    try:
+        with ServingEngine(params32, max_bucket=4,
+                           policy=_policy(plan, deadline_s=1.0,
+                                          retries=1)) as eng:
+            eng.warmup([4])   # the deadline must time dispatch, not compile
+            t0 = time.perf_counter()
+            got = eng.forward(pose, shape)
+            assert time.perf_counter() - t0 >= 1.0  # paid one deadline
+    finally:
+        plan.release.set()    # free the abandoned worker thread
+    np.testing.assert_array_equal(got, _direct(params32, pose, shape))
+    assert eng.counters.deadline_kills == 1
+    assert eng.counters.retries == 1
+
+
+def test_engine_persistent_fault_opens_breaker_failover_failback(params32):
+    """THE acceptance scenario: a persistent outage opens the breaker,
+    traffic fails over to CPU executables bit-identical to the direct
+    program, and when the fault clears the probe re-closes the breaker
+    and the warm primary path serves with ZERO recompiles."""
+    plan = chaos.ChaosPlan("error@0-")
+    tunnel = [False]
+    br = health.CircuitBreaker(failure_threshold=2, probe=lambda: tunnel[0],
+                               probe_interval_s=0.0,
+                               respect_priority_claim=False)
+    with ServingEngine(params32, max_bucket=4,
+                       policy=_policy(plan, br, retries=1)) as eng:
+        eng.warmup([4])       # primary AND fallback tiers warmed
+        warm = eng.counters.compiles
+        for seed in range(3):
+            pose, shape = _req(3, seed=10 + seed)
+            got = eng.forward(pose, shape)
+            np.testing.assert_array_equal(
+                got, _direct(params32, pose, shape))  # bit-identical
+        assert br.state == health.DOWN
+        assert eng.counters.failovers == 3
+        assert eng.counters.compiles == warm  # degraded mode: no compiles
+
+        # The fault clears; the tunnel probe goes green.
+        plan.clear()
+        tunnel[0] = True
+        for seed in range(3):
+            pose, shape = _req(3, seed=20 + seed)
+            got = eng.forward(pose, shape)
+            np.testing.assert_array_equal(
+                got, _direct(params32, pose, shape))
+        assert br.state == health.HEALTHY       # probe re-closed it
+        assert eng.counters.failovers == 3      # primary serves again
+        assert eng.counters.compiles == warm    # failback was FREE
+
+
+def test_engine_wrong_output_fault_is_detectable(params32):
+    """The silent-corruption mode: the engine resolves normally (that is
+    the point — nothing in-band flags it), and the corruption is exactly
+    measurable against the direct path, which is why numerics probes in
+    the shipped compilation context are a standing CLAUDE.md rule."""
+    plan = chaos.ChaosPlan("wrong:1.0@0")
+    pose, shape = _req(3, seed=4)
+    with ServingEngine(params32, max_bucket=4,
+                       policy=_policy(plan, retries=0)) as eng:
+        got = eng.forward(pose, shape)
+    want = _direct(params32, pose, shape)
+    np.testing.assert_allclose(got, want + 1.0, rtol=0, atol=1e-6)
+    assert eng.counters.faults_injected == 1
+
+
+def test_engine_no_fallback_resolves_with_serving_error(params32):
+    plan = chaos.ChaosPlan("error@0-")
+    pose, shape = _req(3, seed=5)
+    with ServingEngine(params32, max_bucket=4,
+                       policy=_policy(plan, retries=1,
+                                      cpu_fallback=False)) as eng:
+        fut = eng.submit(pose, shape)
+        with pytest.raises(ServingError) as e:
+            fut.result(timeout=30.0)
+        assert e.value.phase == "dispatch" and e.value.attempts == 2
+        assert isinstance(e.value.cause, chaos.InjectedFault)
+        # A failed batch is traffic, not an engine crash: the fault
+        # clears and the SAME engine serves again.
+        plan.clear()
+        got = eng.forward(pose, shape)
+    np.testing.assert_array_equal(got, _direct(params32, pose, shape))
+
+
+def test_engine_stop_resolves_futures_when_dispatcher_wedged(params32):
+    """The shutdown guarantee: a dispatcher wedged in an un-interruptible
+    call (deadline_s=None — the unsupervised-dispatch hang class) cannot
+    strand submitted futures; stop(timeout_s=...) abandons the thread
+    and resolves every in-flight AND queued future with a structured
+    ServingError."""
+    plan = chaos.ChaosPlan("hang@0")
+    eng = ServingEngine(params32, max_bucket=4,
+                        policy=_policy(plan, retries=0,
+                                       cpu_fallback=False))
+    try:
+        eng.warmup([4])
+        f1 = eng.submit(*_req(3, seed=6))   # wedges the dispatcher
+        time.sleep(0.2)                     # let it enter the hang
+        f2 = eng.submit(*_req(3, seed=7))   # queued behind the wedge
+        eng.stop(timeout_s=0.5)
+        for f in (f1, f2):
+            with pytest.raises(ServingError) as e:
+                f.result(timeout=5.0)
+            assert e.value.phase == "shutdown"
+        # The engine is marked failed: submit cannot hand out a future
+        # nobody will resolve.
+        with pytest.raises(RuntimeError):
+            eng.submit(*_req(2, seed=8))
+    finally:
+        plan.release.set()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_engine_worker_death_mid_launch_resolves_future(params32):
+    """The crash half of the guarantee: an executable raising an
+    engine-fatal (deterministic) error kills the dispatcher, but the
+    in-flight future is poisoned, a racing queued future is swept, and
+    later submits raise instead of blocking forever."""
+    eng = ServingEngine(params32, max_bucket=4)
+    eng._exes = {b: (lambda p, s: (_ for _ in ()).throw(
+        RuntimeError("worker died mid-launch"))) for b in eng.buckets}
+    with eng:
+        fut = eng.submit(*_req(3, seed=9))
+        with pytest.raises(RuntimeError, match="worker died"):
+            fut.result(timeout=30.0)
+        deadline = time.time() + 5.0   # dispatcher death is async
+        while time.time() < deadline:
+            try:
+                eng.submit(*_req(3, seed=9))
+            except RuntimeError:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("submit still accepted work after worker death")
+
+
+def test_engine_counters_snapshot_has_runtime_fields(params32):
+    c = ServingCounters()
+    snap = c.snapshot()
+    for key in ("retries", "faults_injected", "failovers",
+                "deadline_kills"):
+        assert snap[key] == 0
+    c.count_retry()
+    c.count_fault(2)
+    c.count_failover()
+    c.count_deadline_kill()
+    snap = c.snapshot()
+    assert (snap["retries"], snap["faults_injected"], snap["failovers"],
+            snap["deadline_kills"]) == (1, 2, 1, 1)
+
+
+# ------------------------------------------------------ the recovery drill
+def test_recovery_drill_meets_done_criteria(params32):
+    """The bench/CLI-shared protocol end to end (the ISSUE acceptance
+    criterion, quick-lane edition): under EVERY fault class all futures
+    resolve, failover is bit-identical to the direct CPU program, and
+    post-recovery serving pays zero recompiles."""
+    from mano_hand_tpu.serving.measure import recovery_drill_run
+
+    out = recovery_drill_run(params32, requests_per_class=6, max_rows=4,
+                             max_bucket=4, deadline_s=1.0, seed=2)
+    assert set(out["classes"]) == {"transient", "latency", "hang",
+                                   "persistent"}
+    for name, cls in out["classes"].items():
+        assert cls["unresolved"] == 0, (name, cls)
+        assert cls["resolved_ok"] + cls["resolved_error"] == \
+            cls["submitted"], (name, cls)
+    assert out["futures_resolved_fraction"] == 1.0
+    assert out["failover_vs_cpu_direct_max_abs_err"] == 0.0
+    assert out["post_recovery_steady_recompiles"] == 0
+    assert out["classes"]["hang"]["deadline_kills"] >= 1
+    assert out["classes"]["persistent"]["failovers"] >= 6
+    assert out["breaker_opens"] >= 1
+    assert out["breaker_state_final"] == health.HEALTHY
+    assert out["failover_overhead_ratio"] > 0
+
+
+# -------------------------------------- pallas-interpreter composition
+def test_chaos_composes_with_pallas_interpreter(params32):
+    """The harness wraps ANY compiled path: the Pallas kernel under the
+    interpreter (the off-chip lane kernel code runs in) behind a
+    transient fault, supervised-retried back to a correct result."""
+    pose, shape = _req(4, seed=12)
+    plan = chaos.ChaosPlan("error@0")
+    fn = plan.wrap(lambda: np.asarray(core.forward_batched_pallas(
+        params32, jnp.asarray(pose), jnp.asarray(shape), interpret=True)))
+    got = supervise.supervised_call(fn, retries=1, backoff_s=0.001,
+                                    jitter=0.0)
+    assert plan.faults_injected == 1
+    np.testing.assert_allclose(got, _direct(params32, pose, shape),
+                               atol=2e-5)
+
+
+# ------------------------------------------- supervised long-fit wrappers
+def test_tracker_supervised_step_and_deadline(params32, monkeypatch):
+    from mano_hand_tpu.fitting import tracking
+
+    target = np.asarray(core.forward(
+        params32, jnp.zeros((16, 3), jnp.float32),
+        jnp.zeros(10, jnp.float32)).verts)
+    state, step = tracking.make_tracker(
+        params32, n_steps=2, solver="adam", deadline_s=120.0, retries=1)
+    state, res = step(state, target)
+    assert state.frame == 1 and np.isfinite(np.asarray(res.pose)).all()
+
+    # A wedged per-frame solve is abandoned at the deadline and surfaces
+    # as RetriesExhausted — the state keeps the last good warm start.
+    gate = threading.Event()
+    monkeypatch.setattr(tracking.solvers, "fit",
+                        lambda *a, **k: gate.wait())
+    state2, step2 = tracking.make_tracker(
+        params32, n_steps=2, solver="adam", deadline_s=0.1, retries=0)
+    with pytest.raises(supervise.RetriesExhausted):
+        step2(state2, target)
+    assert state2.frame == 0
+    gate.set()
+
+
+def test_model_fit_supervised(params):
+    from mano_hand_tpu.models.layer import MANOModel
+
+    model = MANOModel(params)
+    target = model(pose=np.zeros((16, 3)))
+    res = model.fit(target, solver="adam", n_steps=5, deadline_s=300.0)
+    assert np.isfinite(np.asarray(res.pose)).all()
+    assert np.allclose(model.pose, np.asarray(res.pose, np.float64))
+
+
+pytestmark = pytest.mark.quick
