@@ -20,18 +20,20 @@
 # do not remove it. Also avoid two concurrent pytest processes on the
 # shared cache dir.
 .PHONY: check check-cold test bench-cpu bench-tpu-wait mesh-scaling \
-	check-quick serve-smoke specialize-smoke chaos-smoke
+	check-quick serve-smoke specialize-smoke chaos-smoke coalesce-smoke
 
-check: test chaos-smoke
+check: test chaos-smoke coalesce-smoke
 
 # tests/test_runtime.py is excluded here and covered by the chaos-smoke
 # prerequisite instead (its own pytest process + cache dir): `make
 # check` would otherwise pay the real-time deadline/backoff/hang sleeps
-# of the chaos matrix twice. A bare `pytest tests/` (e.g. the tier-1
-# verify command) still collects it.
+# of the chaos matrix twice. tests/test_serving_coalesce.py is likewise
+# covered by coalesce-smoke (same pattern, its own cache dir). A bare
+# `pytest tests/` (e.g. the tier-1 verify command) still collects both.
 test:
 	TF_CPP_MIN_LOG_LEVEL=3 python -m pytest tests/ -q \
-	  --ignore=tests/test_runtime.py
+	  --ignore=tests/test_runtime.py \
+	  --ignore=tests/test_serving_coalesce.py
 
 # Seconds-scale pre-commit lane: the core-correctness modules (parity vs
 # the f64 oracle, assets/IO, golden demo, device lock, and the serving
@@ -74,7 +76,8 @@ bench-interpret:
 	  --fit-steps 10 --pallas-sweep quick --pallas-interpret --skip-fit \
 	  --init-retries 2 --sil-size 16 --serving-requests 64 \
 	  --serving-max-rows 16 --serving-max-bucket 32 \
-	  --spec-batch 64 --spec-fit-batch 8 --recovery-requests 6
+	  --spec-batch 64 --spec-fit-batch 8 --recovery-requests 6 \
+	  --coalesce-subjects 8 --coalesce-requests 48 --coalesce-max-bucket 32
 
 # Serving-leg smoke (the bench-interpret counterpart for config7): the
 # whole serving-engine plumbing — bucket warm-up, ragged request stream,
@@ -87,7 +90,8 @@ bench-interpret:
 # post-recovery recompiles) to it.
 serve-smoke:
 	python bench.py --platform cpu --serving-only --serving-requests 96 \
-	  --serving-max-rows 16 --serving-max-bucket 32 --init-retries 2
+	  --serving-max-rows 16 --serving-max-bucket 32 --init-retries 2 \
+	  --coalesce-subjects 8 --coalesce-requests 48 --coalesce-max-bucket 32
 
 # Specialization-split smoke (the quick-lane half of PR 2's tooling):
 # the seconds-scale correctness story of the shape/pose split — bit-
@@ -111,6 +115,15 @@ specialize-smoke:
 chaos-smoke:
 	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_adhoc \
 	  python -m pytest tests/test_runtime.py -q
+
+# Cross-subject coalescing matrix (the PR-4 tentpole): gathered-dispatch
+# bit-identity, mixed-subject parity at awkward batch compositions, LRU
+# eviction/table growth, overflow parking. Wired into `make check` as a
+# SEPARATE pytest process on its own compile-cache dir (the CLAUDE.md
+# rule: two pytest processes must never share .jax_compile_cache/).
+coalesce-smoke:
+	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_coalesce \
+	  python -m pytest tests/test_serving_coalesce.py -q
 
 # Unattended BUILDER-side TPU bench: lockfile-guarded, stands down for the
 # driver's priority claim, and self-expires (default 3 h) — see

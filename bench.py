@@ -19,6 +19,8 @@ Covers the BASELINE.json config suite:
   5. 120-frame x 2-hand temporal sequence     — latency
   8. shape-specialization split               — pose-only vs full forward,
      and the frozen-betas (48-col) LM step vs the 58-col solve
+  9. cross-subject coalescing                 — mixed-subject gathered
+     engine dispatch vs per-subject-split dispatch (serving/measure.py)
 
 Resilience: the axon TPU tunnel is flaky — backend init can fail OR hang.
 Bring-up therefore probes `jax.devices()` in a SUBPROCESS (a hang there is
@@ -576,7 +578,8 @@ def run_benchmarks(args, device_str: str) -> dict:
         if args.mesh_scaling_only and name != "mesh_scaling":
             return
         if args.serving_only and name not in ("config7_serving",
-                                              "config7_recovery"):
+                                              "config7_recovery",
+                                              "config9_coalesce"):
             return
         try:
             fn()
@@ -1994,9 +1997,48 @@ def run_benchmarks(args, device_str: str) -> dict:
 
     section("config7_recovery", config7_recovery)
 
+    # -- config 9: cross-subject coalescing (PR 4) --------------------------
+    # THE shared protocol (serving/measure.py:coalesce_bench_run — also
+    # behind `mano serve-bench --subjects`): a mixed-subject pose-only
+    # stream (many users, each their own baked betas) through the
+    # gathered engine dispatch vs the pre-PR-4 per-subject-split
+    # dispatch, with the interleaved min-over-trials drift defense.
+    # Criteria (scripts/bench_report.py): engine >= 1.3x split on a
+    # >= 8-subject stream, the gathered path f32 BIT-identical to the
+    # per-subject posed program, zero steady recompiles after warmup +
+    # table growth. Rides in the readback tail for the same D2H reason
+    # as config7; everything except the absolute rates is meaningful on
+    # CPU, which is where the criterion is defined.
+    def config9_coalesce():
+        from mano_hand_tpu.serving.measure import coalesce_bench_run
+
+        cz = coalesce_bench_run(
+            right,
+            subjects=args.coalesce_subjects,
+            requests=args.coalesce_requests,
+            max_rows=args.coalesce_max_rows,
+            max_bucket=args.coalesce_max_bucket,
+            seed=9,
+            log=lambda m: log(f"config9 {m}"),
+        )
+        results["coalesce"] = cz
+        log(f"config9 coalesce: engine {cz['engine_evals_per_sec']:,.0f} "
+            f"vs split {cz['split_evals_per_sec']:,.0f} evals/s (ratio "
+            f"{cz['engine_vs_split_ratio']:.2f}x, median "
+            f"{cz['ratio_median']:.2f}), width "
+            f"{cz['coalesce_width_mean']}, "
+            f"{cz['mixed_subject_batches']} mixed batches, "
+            f"{cz['table_growths']} growths, "
+            f"{cz['steady_recompiles']} steady recompiles, gather err "
+            f"{cz['gather_vs_posed_max_abs_err']:.1e}")
+
+    if args.coalesce_subjects > 0:
+        section("config9_coalesce", config9_coalesce)
+
     if args.serving_only:
         # Fast serving-layer artifact (`make serve-smoke`): the deferred
-        # runner's serving-only skip reduces the schedule to config7.
+        # runner's serving-only skip reduces the schedule to config7
+        # (+ the recovery drill and the config9 coalescing leg).
         for name, fn in _registered:
             run_section(name, fn)
         srv = results.get("serving", {})
@@ -2226,9 +2268,26 @@ def main() -> int:
                     help="largest power-of-two serving bucket (bounds "
                          "the leg's warm-up compiles)")
     ap.add_argument("--serving-only", action="store_true",
-                    help="run ONLY the serving-engine leg + the "
-                         "fault-recovery drill (fast serving-layer "
+                    help="run ONLY the serving-engine leg, the "
+                         "fault-recovery drill and the mixed-subject "
+                         "coalescing leg (fast serving-layer "
                          "artifact; `make serve-smoke`)")
+    ap.add_argument("--coalesce-subjects", type=int, default=12,
+                    help="distinct baked subjects in the mixed-subject "
+                         "coalescing leg (config9; >= 8 engages the "
+                         "speed criterion, > 8 also exercises a table "
+                         "capacity growth; 0 skips the leg)")
+    ap.add_argument("--coalesce-requests", type=int, default=96,
+                    help="requests per measured pass of the coalescing "
+                         "leg (config9)")
+    ap.add_argument("--coalesce-max-rows", type=int, default=4,
+                    help="config9 request sizes are uniform in "
+                         "[1, max-rows] — small on purpose: the "
+                         "multi-tenant stream PR 4 targets is "
+                         "few-rows-per-user")
+    ap.add_argument("--coalesce-max-bucket", type=int, default=64,
+                    help="largest power-of-two bucket of the config9 "
+                         "engine")
     ap.add_argument("--recovery-requests", type=int, default=12,
                     help="requests per fault class in the recovery "
                          "drill (config7_recovery; faults are injected "

@@ -947,7 +947,9 @@ def cmd_serve_bench(args) -> int:
     shared with bench.py's config7 leg so the two cannot diverge.
     ``--chaos`` injects a deterministic fault plan under supervised
     dispatch (``runtime/``), or runs the full recovery drill with
-    ``--chaos drill``."""
+    ``--chaos drill``; ``--subjects N`` switches to the mixed-subject
+    coalescing protocol (bench.py config9's
+    ``serving.measure.coalesce_bench_run``)."""
     import os
 
     import jax
@@ -1004,6 +1006,14 @@ def cmd_serve_bench(args) -> int:
         # The full fault-recovery drill (the same protocol as bench.py
         # config7_recovery): every fault class + recovery, one JSON
         # line of drill metrics, judged by scripts/bench_report.py.
+        if args.subjects > 0:
+            # Same policy as the --aot-dir guard below: refuse rather
+            # than silently not run the protocol the caller asked for.
+            print("--subjects does not compose with --chaos drill (the "
+                  "drill fixes its own protocol, which already drives "
+                  "mixed-subject pose-only streams); use --subjects "
+                  "with a custom --chaos plan instead", file=sys.stderr)
+            return 2
         from mano_hand_tpu.serving.measure import recovery_drill_run
 
         # The drill fixes its own protocol sizes (its request stream
@@ -1042,6 +1052,39 @@ def cmd_serve_bench(args) -> int:
                 probe_interval_s=1.0, respect_priority_claim=False),
             chaos=plan,
         )
+    if args.subjects > 0:
+        # The PR-4 mixed-subject coalescing protocol (the same code
+        # path as bench.py config9, judged by scripts/bench_report.py);
+        # composes with --chaos: the plan wraps the gathered primary
+        # executables under the supervised policy built above.
+        if args.aot_dir:
+            # The gathered pose-only programs take the subject table as
+            # a runtime argument, so a persistent AOT artifact would
+            # bake nothing — refuse rather than silently not measure
+            # the tier the caller asked for.
+            print("--aot-dir does not apply to --subjects (the gathered "
+                  "programs have no AOT tier; table and index are "
+                  "runtime arguments)", file=sys.stderr)
+            return 2
+        from mano_hand_tpu.serving.measure import coalesce_bench_run
+
+        out = coalesce_bench_run(
+            params,
+            subjects=args.subjects,
+            requests=args.requests,
+            min_rows=args.min_rows,
+            max_rows=args.max_rows,
+            max_bucket=args.max_bucket,
+            max_delay_s=args.max_delay_ms * 1e-3,
+            seed=args.seed,
+            policy=policy,
+            log=lambda m: print(m, file=sys.stderr),
+        )
+        out["backend"] = jax.default_backend()
+        if args.chaos:
+            out["chaos"] = args.chaos
+        print(json.dumps(out))
+        return 0
     out = serve_bench_run(
         params,
         requests=args.requests,
@@ -1385,6 +1428,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "(tunnel drops leave the dispatcher in an "
                          "unkillable device RPC). Default: 900 on "
                          "device backends, off on cpu; 0 disables")
+    sb.add_argument("--subjects", type=int, default=0,
+                    help="run the MIXED-SUBJECT coalescing protocol "
+                         "instead (serving/measure.py:coalesce_bench_run,"
+                         " shared with bench.py config9): this many "
+                         "baked subjects submit an interleaved pose-only "
+                         "stream through the gathered engine dispatch, "
+                         "measured against the per-subject-split "
+                         "baseline. 0 = the classic full-path protocol")
     sb.add_argument("--seed", type=int, default=0)
     sb.set_defaults(fn=cmd_serve_bench)
 

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any, NamedTuple, Optional, Tuple
+from typing import Any, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -176,6 +176,197 @@ def forward_posed_batched(
     matched batching structure — tests/test_specialize.py)."""
     pose = pose.reshape(pose.shape[0], -1, 3)
     return jax.vmap(lambda q: forward_posed(shaped, q, precision))(pose)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SubjectTable:
+    """A device-resident stack of baked shape stages (PR-4 tentpole).
+
+    Every ``specialize()``d subject becomes one ROW of the per-subject
+    leaves (``v_shaped [C, V, 3]``, ``joints [C, J, 3]``,
+    ``shape [C, S]``); the shape-independent parameter leaves
+    (``pose_basis``, ``lbs_weights``) are stored ONCE, unbatched — they
+    are identical for every subject, and keeping them out of the
+    per-row axis is also what makes ``forward_posed_gather``
+    bit-identical to the shared-ShapedHand posed program (the shared
+    leaves enter the same contractions with the same shapes).
+
+    ``C`` is a CAPACITY, not an occupancy: the serving engine grows it
+    by doubling, so the gathered programs — whose shapes depend only on
+    (C, bucket) — recompile ``O(log subjects)`` times, and an LRU
+    eviction merely rewrites a row (a data operation; no program ever
+    sees which rows are live). All row updates are FUNCTIONAL
+    (``table_set_row`` returns a new table); a snapshot captured by an
+    in-flight dispatch therefore stays valid however the live table
+    mutates behind it.
+    """
+
+    v_shaped: Any      # [C, V, 3] per-subject shaped templates
+    joints: Any        # [C, J, 3] per-subject rest joints
+    shape: Any         # [C, S] the baked betas per subject (provenance)
+    pose_basis: Any    # [V, 3, P] pose-corrective basis (shared, unbatched)
+    lbs_weights: Any   # [V, J] skinning weights (shared, unbatched)
+    parents: Tuple[int, ...] = dataclasses.field(
+        default=constants.MANO_PARENTS, metadata={"static": True}
+    )
+
+    @property
+    def capacity(self) -> int:
+        return self.v_shaped.shape[0]
+
+    @property
+    def n_joints(self) -> int:
+        return self.joints.shape[-2]
+
+    @property
+    def n_verts(self) -> int:
+        return self.v_shaped.shape[-2]
+
+
+def subject_table(params: ManoParams, capacity: int = 1) -> SubjectTable:
+    """An empty (zero-row) :class:`SubjectTable` over ``params``.
+
+    Rows are populated with ``table_set_row``; unwritten rows are zeros
+    and harmless — the gather index decides which rows a program ever
+    reads, and the engine never hands out an unwritten slot.
+    """
+    if capacity < 1:
+        raise ValueError(f"capacity must be >= 1, got {capacity}")
+    dtype = params.v_template.dtype
+    n_v = params.v_template.shape[0]
+    n_j = params.j_regressor.shape[0]
+    n_s = params.shape_basis.shape[-1]
+    return SubjectTable(
+        v_shaped=jnp.zeros((capacity, n_v, 3), dtype),
+        joints=jnp.zeros((capacity, n_j, 3), dtype),
+        shape=jnp.zeros((capacity, n_s), dtype),
+        pose_basis=params.pose_basis,
+        lbs_weights=params.lbs_weights,
+        parents=params.parents,
+    )
+
+
+def stack_shaped(shaped: Sequence[ShapedHand]) -> SubjectTable:
+    """Stack ``specialize``d hands into a :class:`SubjectTable` (capacity
+    == len(shaped)). The shared leaves are taken from the first entry —
+    they are parameter leaves, identical across subjects of one asset;
+    stacking hands from DIFFERENT assets is a caller error."""
+    if not shaped:
+        raise ValueError("need at least one ShapedHand to stack")
+    first = shaped[0]
+    for s in shaped[1:]:
+        if tuple(s.parents) != tuple(first.parents):
+            raise ValueError(
+                "cannot stack ShapedHands with different kinematic trees")
+    return SubjectTable(
+        v_shaped=jnp.stack([s.v_shaped for s in shaped]),
+        joints=jnp.stack([s.joints for s in shaped]),
+        shape=jnp.stack([s.shape for s in shaped]),
+        pose_basis=first.pose_basis,
+        lbs_weights=first.lbs_weights,
+        parents=first.parents,
+    )
+
+
+def table_set_row(table: SubjectTable, slot, shaped: ShapedHand,
+                  ) -> SubjectTable:
+    """Write one subject's baked constants into row ``slot`` — FUNCTIONAL
+    (returns a new table; the input is untouched, so snapshots held by
+    in-flight dispatches stay valid). ``slot`` may be a traced int32
+    scalar: one compiled update program covers every slot of a given
+    capacity. Never donate the old table into this update — its buffers
+    are exactly what an in-flight snapshot still reads."""
+    return dataclasses.replace(
+        table,
+        v_shaped=table.v_shaped.at[slot].set(shaped.v_shaped),
+        joints=table.joints.at[slot].set(shaped.joints),
+        shape=table.shape.at[slot].set(shaped.shape),
+    )
+
+
+def table_grow(table: SubjectTable, capacity: int) -> SubjectTable:
+    """Grow the per-subject leaves to ``capacity`` (zero-filled tail).
+
+    The doubling schedule lives in the CALLER (serving engine); this is
+    the mechanism. Shrinking is refused — rows would silently vanish.
+    """
+    pad = capacity - table.capacity
+    if pad < 0:
+        raise ValueError(
+            f"cannot shrink a subject table from {table.capacity} "
+            f"to {capacity} rows")
+    if pad == 0:
+        return table
+
+    def grow(leaf):
+        return jnp.concatenate(
+            [leaf, jnp.zeros((pad, *leaf.shape[1:]), leaf.dtype)])
+
+    return dataclasses.replace(
+        table,
+        v_shaped=grow(table.v_shaped),
+        joints=grow(table.joints),
+        shape=grow(table.shape),
+    )
+
+
+def table_row(table: SubjectTable, slot: int) -> ShapedHand:
+    """Read one subject back out as a :class:`ShapedHand` (shared leaves
+    referenced, not copied) — the inverse of ``table_set_row``."""
+    return ShapedHand(
+        v_shaped=table.v_shaped[slot],
+        joints=table.joints[slot],
+        shape=table.shape[slot],
+        pose_basis=table.pose_basis,
+        lbs_weights=table.lbs_weights,
+        parents=table.parents,
+    )
+
+
+def forward_posed_gather(
+    table: SubjectTable,
+    subject_idx: jnp.ndarray,  # [B] int32 row indices into the table
+    pose: jnp.ndarray,         # [B, J, 3]
+    precision=DEFAULT_PRECISION,
+) -> ManoOutput:
+    """Mixed-subject pose-only forward: row ``r`` runs the pose stage
+    over subject ``subject_idx[r]``'s baked shape constants, gathered
+    from the table INSIDE the jitted program.
+
+    This is what turns the subject from a per-batch executable constant
+    into a per-row runtime index (the PR-4 coalescing tentpole): one
+    compiled program per (capacity, batch) shape serves every mixture
+    of subjects. Bit-identity contract (pinned in
+    tests/test_serving_coalesce.py): at a matched batch size, row ``r``
+    equals the corresponding row of
+    ``forward_posed_batched(shaped_of(subject_idx[r]), pose)`` EXACTLY
+    (f32 ``==``) — the shared basis leaves stay unbatched (closed over,
+    so every contraction keeps the shapes of the shared-ShapedHand
+    program), the gathered per-row constants enter only elementwise ops
+    and per-row-batched contractions, and vmapped rows are computed
+    independently, so a row's bits depend only on its own inputs.
+    """
+    n_joints = table.joints.shape[-2]
+    dtype = table.v_shaped.dtype
+    pose = pose.reshape(pose.shape[0], n_joints, 3).astype(dtype)
+    idx = jnp.asarray(subject_idx, jnp.int32)
+    v_rows = table.v_shaped[idx]
+    j_rows = table.joints[idx]
+    s_rows = table.shape[idx]
+
+    def row(v_shaped, joints, shape, q):
+        sh = ShapedHand(
+            v_shaped=v_shaped,
+            joints=joints,
+            shape=shape,
+            pose_basis=table.pose_basis,     # closed over: stays unbatched
+            lbs_weights=table.lbs_weights,   # closed over: stays unbatched
+            parents=table.parents,
+        )
+        return forward_posed(sh, q, precision)
+
+    return jax.vmap(row)(v_rows, j_rows, s_rows, pose)
 
 
 def decode_pca(
@@ -878,3 +1069,19 @@ def jit_forward_batched_rotmats(params, rot_mats, shape,
                                 precision=DEFAULT_PRECISION):
     """Convenience jitted batched rotation-matrix forward."""
     return forward_batched_rotmats(params, rot_mats, shape, precision)
+
+
+@functools.partial(jax.jit, static_argnames=("precision",))
+def jit_forward_posed_gather(table, subject_idx, pose,
+                             precision=DEFAULT_PRECISION):
+    """Convenience jitted mixed-subject gathered pose-only forward (table
+    and index ride as runtime arguments — one program per
+    (capacity, batch) shape, shared by every subject mixture)."""
+    return forward_posed_gather(table, subject_idx, pose, precision)
+
+
+# One compiled row-update program per table capacity (``slot`` is traced,
+# so writing row 7 and row 12 reuse the same executable). Deliberately
+# NOT donated: the old table's buffers are what in-flight dispatch
+# snapshots still read (see table_set_row).
+jit_table_set_row = jax.jit(table_set_row)

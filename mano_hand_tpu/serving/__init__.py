@@ -14,9 +14,11 @@ from mano_hand_tpu.serving.buckets import (
     bucket_sizes,
     pad_rows,
     pad_tree_rows,
+    subject_index_rows,
 )
 from mano_hand_tpu.serving.engine import ServingEngine, ServingError
 from mano_hand_tpu.serving.measure import (
+    coalesce_bench_run,
     measure_overhead,
     recovery_drill_run,
     serve_bench_run,
@@ -25,6 +27,7 @@ from mano_hand_tpu.serving.measure import (
 __all__ = [
     "ServingEngine",
     "ServingError",
+    "coalesce_bench_run",
     "recovery_drill_run",
     "measure_overhead",
     "serve_bench_run",
@@ -32,4 +35,5 @@ __all__ = [
     "bucket_sizes",
     "pad_rows",
     "pad_tree_rows",
+    "subject_index_rows",
 ]

@@ -81,6 +81,31 @@ def pad_rows(arr: np.ndarray, bucket: int) -> np.ndarray:
     return jnp.concatenate([arr, pad])
 
 
+def subject_index_rows(slots: Sequence[int], rows: Sequence[int],
+                       bucket: int) -> np.ndarray:
+    """The per-row int32 subject index of a coalesced mixed-subject batch.
+
+    Request ``k`` contributes ``rows[k]`` rows of table slot
+    ``slots[k]``; the result is padded to ``bucket`` by repeating row 0
+    (the ``pad_rows`` contract: pad rows replay live traffic's regime,
+    here the first request's subject). Host-side bookkeeping like the
+    rest of this module — the produced array is the gathered dispatch's
+    ``subject_idx`` runtime argument.
+    """
+    slots = np.asarray(slots, np.int32)
+    rows = np.asarray(rows, np.int64)
+    if slots.shape != rows.shape:
+        raise ValueError(
+            f"slots and rows must pair up, got {slots.shape} vs "
+            f"{rows.shape}")
+    if rows.size and rows.min() < 1:
+        raise ValueError("every request must contribute >= 1 row")
+    idx = np.repeat(slots, rows)
+    if idx.size < 1:
+        raise ValueError("a batch needs at least one row")
+    return pad_rows(idx, bucket)
+
+
 def pad_tree_rows(tree: dict, bucket: int) -> dict:
     """``pad_rows`` over every leaf of a flat {name: array} dict (warm-start
     seeds for the bucketed fit wrappers)."""

@@ -53,10 +53,32 @@ bench.py rides under its own instance of the same class.
   production streams hold betas fixed per subject for thousands of
   calls, so ``specialize(betas)`` bakes the shape stage ONCE
   (models/core.py:specialize) and ``submit(pose, subject=key)`` runs a
-  pose-only program thereafter. The pose-only per-bucket executables
-  take the baked constants as runtime arguments, so they are shared by
-  ALL subjects — steady-state per-subject traffic composes both caches
-  with zero recompiles (counted, not hoped: ``ServingCounters``).
+  pose-only program thereafter — steady-state per-subject traffic
+  composes both caches with zero recompiles (counted, not hoped:
+  ``ServingCounters``);
+
+* **coalesces ACROSS subjects** (PR 4): every baked subject lives in a
+  device-resident ``models.core.SubjectTable`` row, and the pose-only
+  per-bucket executables are GATHERED programs
+  (core.forward_posed_gather) taking the table plus an int32 [B]
+  subject index as runtime arguments — the subject is a per-row index,
+  not a per-batch executable constant, so a realistic multi-tenant
+  stream (many users, each their own betas) merges into one dispatch
+  per bucket instead of degenerating into single-request batches.
+  Results stay bit-identical to the per-subject posed program at the
+  same bucket size (the shared basis leaves stay unbatched inside the
+  gather — see core.forward_posed_gather). Table capacity grows by
+  DOUBLING (gathered programs recompile ``O(log subjects)`` times,
+  counted), and above ``max_subjects`` the least-recently-used subject
+  is EVICTED — a row rewrite, never a recompile (the table is a
+  runtime argument; ``specializations_evicted`` counts it), with the
+  raw betas retained so an evicted subject re-bakes transparently on
+  its next dispatch. Full-path and pose-only requests still never
+  share a batch; ``_pending`` parks requests for a genuine bucket
+  overflow (``coalesce_overflows``), that kind split, or — rarely —
+  when one batch would otherwise span more distinct subjects than
+  ``max_subjects`` table rows (which ``_resolve_batch`` could never
+  pin at once).
 
 Typical use::
 
@@ -67,6 +89,10 @@ Typical use::
         verts = eng.forward(pose, shape)          # sync convenience
         subj = eng.specialize(betas)              # bake the shape stage
         verts = eng.forward(pose, subject=subj)   # pose-only fast path
+        # Different subjects' submits coalesce into ONE gathered
+        # dispatch per bucket (the multi-tenant steady state):
+        futs = [eng.submit(p, subject=eng.specialize(b))
+                for p, b in zip(user_poses, user_betas)]
     print(eng.counters.snapshot())
 """
 
@@ -144,28 +170,32 @@ def build_bucket_executable(params_dev, bucket: int, n_joints: int,
     return lambda p, s: jitted(params_dev, p, s)
 
 
-def build_posed_bucket_executable(shaped_dev, bucket: int, n_joints: int,
+def build_posed_gather_executable(table_dev, bucket: int, n_joints: int,
                                   dtype, donate: bool):
-    """The per-bucket POSE-ONLY executable (specialization fast path).
+    """The per-bucket POSE-ONLY executable (gathered, PR 4).
 
-    The ShapedHand rides as a runtime ARGUMENT — same reasoning as the
-    params above (constant-baking changes float folding), with a second
-    payoff: ONE compiled program per bucket serves EVERY subject, so a
-    new subject costs one specialization (a data computation) and zero
-    compiles. Only the pose buffer is donated; the shaped constants are
-    reused across the whole steady-state stream. Eagerly warmed with a
-    dummy pose batch; the caller counts the compile.
+    The SubjectTable and the int32 [B] subject index ride as runtime
+    ARGUMENTS — same reasoning as the params above (constant-baking
+    changes float folding), with the coalescing payoff on top: ONE
+    compiled program per (bucket, table capacity) serves EVERY mixture
+    of subjects, so a new subject costs one specialization (a data
+    computation) and zero compiles, and requests for DIFFERENT subjects
+    share a dispatch. Only the pose buffer is donated; the table is
+    reused across the whole steady-state stream (donating it would
+    invalidate the buffers other in-flight snapshots read). Eagerly
+    warmed with a dummy batch; the caller counts the compile.
     """
     import jax
 
     from mano_hand_tpu.models import core
 
     jitted = jax.jit(
-        lambda sh, p: core.forward_posed_batched(sh, p).verts,
-        donate_argnums=(1,) if donate else (),
+        lambda tab, idx, p: core.forward_posed_gather(tab, idx, p).verts,
+        donate_argnums=(2,) if donate else (),
     )
     jax.block_until_ready(jitted(
-        shaped_dev, np.zeros((bucket, n_joints, 3), dtype)))
+        table_dev, np.zeros((bucket,), np.int32),
+        np.zeros((bucket, n_joints, 3), dtype)))
     return jitted
 
 
@@ -231,6 +261,14 @@ class ServingEngine:
         (2 = classic double buffering).
     counters: a shared ServingCounters (e.g. process-global); default a
         private one, exposed as ``self.counters``.
+    max_subjects: capacity ceiling of the device-resident subject table.
+        Within it, capacity grows by doubling (each growth retraces the
+        warm gathered executables once — ``O(log subjects)`` compiles,
+        counted); above it, the least-recently-used subject's table row
+        is evicted and reused (``specializations_evicted``) — never a
+        recompile, because the table is a runtime argument. Evicted
+        subjects keep their betas registered and re-bake transparently
+        on their next dispatch.
     policy: a ``runtime.DispatchPolicy`` enabling supervised dispatch
         (per-batch deadline, classified retries with backoff, circuit-
         breaker-gated CPU failover, optional chaos injection). None
@@ -254,6 +292,7 @@ class ServingEngine:
         dtype=np.float32,
         counters: Optional[ServingCounters] = None,
         policy=None,
+        max_subjects: int = 4096,
     ):
         self._params = params.astype(dtype)
         self._dtype = np.dtype(dtype)
@@ -271,17 +310,43 @@ class ServingEngine:
         self._n_joints = params.n_joints
         self._n_shape = params.n_shape
         self._policy = policy
+        if max_subjects < 1:
+            raise ValueError(
+                f"max_subjects must be >= 1, got {max_subjects}")
+        self.max_subjects = int(max_subjects)
         self._params_dev = None        # device-resident params (jit path)
         self._exes: dict = {}          # bucket -> compiled callable
-        self._shaped: dict = {}        # betas digest -> core.ShapedHand
         self._subject_betas: dict = {}  # betas digest -> host [S] array
-        #   (the fallback path re-runs the FULL forward for a subject,
-        #   so it needs the raw betas the ShapedHand was baked from)
-        self._posed_exes: dict = {}    # bucket -> pose-only executable
-        #   (subject-agnostic: the shaped constants are runtime args)
+        #   Never evicted (40 bytes/subject): the CPU fallback re-runs
+        #   the FULL forward from raw betas, and an evicted subject
+        #   re-bakes its table row from here on its next dispatch.
+        # The device-resident subject table (PR 4). Updated ONLY
+        # functionally (core.table_set_row/table_grow return new
+        # pytrees), so the snapshot a dispatch captures under
+        # ``_exe_lock`` stays valid however specialize/evict mutate the
+        # live reference afterwards.
+        self._table = None             # core.SubjectTable or None
+        self._subject_slots: dict = {}  # betas digest -> table row
+        self._subject_lru = collections.OrderedDict()  # digest -> None
+        self._next_slot = 0            # first never-used row
+        #   (an eviction reuses the victim's row directly, so the only
+        #   allocation states are next-fresh-row, grow, or evict)
+        self._gather_exes: dict = {}   # bucket -> (capacity, executable)
+        #   (subject-agnostic AND mix-agnostic: table + index are
+        #   runtime args; invalidated only by a capacity growth)
         self._cpu_exes: dict = {}      # bucket -> CPU fallback executable
         self._exe_lock = threading.Lock()
+        # Serializes _install_subject's bake-and-swap so table mutation
+        # device work can stage OUTSIDE _exe_lock (see _install_subject;
+        # lock order: _install_lock -> _exe_lock, never the reverse).
+        self._install_lock = threading.Lock()
         self._queue: queue.Queue = queue.Queue()
+        # Requests parked by _coalesce (bucket overflow, a full-vs-
+        # pose-only kind split, or a batch already spanning max_subjects
+        # distinct subjects — see _admit): they LEAD the next batches,
+        # so a parked request can never starve behind the live queue.
+        # Owned by the dispatcher thread; the crash handler sweeps it.
+        self._pending: collections.deque = collections.deque()
         self._thread: Optional[threading.Thread] = None
         self._running = False
         self._failure: Optional[BaseException] = None
@@ -357,6 +422,10 @@ class ServingEngine:
             self._thread = None
             self._sweep_live(err)
             self._drain_cancelled(err)
+            # Parked requests were resolved by the sweep (they are
+            # registered); drop the stale objects so a later restart
+            # does not re-dispatch already-resolved work.
+            self._pending.clear()
             # If the abandoned thread ever unwedges it must find a
             # sentinel (the drain above may have eaten the original)
             # and exit instead of blocking on the empty queue forever.
@@ -380,19 +449,27 @@ class ServingEngine:
         self.stop()
 
     # ------------------------------------------------------------- requests
+    # Capacity the subject table starts at (clamped to max_subjects):
+    # small enough that one-subject engines stay one-subject-sized, big
+    # enough that the common few-subject tests/streams never grow.
+    _TABLE_INIT_CAPACITY = 8
+
     def specialize(self, shape) -> str:
         """Bake one subject's betas; returns the subject key for
         ``submit(pose, subject=key)``.
 
         The per-subject specialization cache (models/core.py:specialize
         made serving-shaped): the first call for a betas value runs the
-        shape stage ONCE on device and stores the ShapedHand under a
-        content digest; repeats are a dict hit. Steady-state per-subject
-        traffic then composes BOTH caches — this one (shape stage baked)
-        and the pose-only bucket-executable cache (one compiled program
-        per bucket, shared across subjects) — so a warm stream runs with
-        zero recompiles AND zero shape-stage recomputes, observable on
-        ``counters`` (``specializations``/``shaped_hits``).
+        shape stage ONCE on device and writes it into a row of the
+        device-resident subject table under a content digest; repeats
+        are a dict hit (which also refreshes the LRU position). Steady-
+        state traffic then composes BOTH caches — this one (shape stage
+        baked) and the gathered bucket-executable cache (one compiled
+        program per bucket x table capacity, shared across every subject
+        MIXTURE) — so a warm stream runs with zero recompiles AND zero
+        shape-stage recomputes, observable on ``counters``
+        (``specializations``/``shaped_hits``/``table_growths``/
+        ``specializations_evicted``).
         """
         shape = np.ascontiguousarray(
             np.asarray(shape, self._dtype).reshape(self._n_shape))
@@ -400,40 +477,148 @@ class ServingEngine:
 
         key = hashlib.sha256(shape.tobytes()).hexdigest()[:16]
         with self._exe_lock:
-            hit = key in self._shaped
+            hit = key in self._subject_slots
+            if hit:
+                self._subject_lru.move_to_end(key)
         if hit:
             self.counters.count_specialize(hit=True)
             return key
+        self._install_subject(key, shape)
+        return key
+
+    def _install_subject(self, key: str, betas: np.ndarray,
+                         protected=()) -> int:
+        """Bake ``betas`` and write them into a table row; returns the
+        slot. Grows the table (doubling) while under ``max_subjects``,
+        else evicts the least-recently-used subject's row — skipping
+        ``protected`` digests (the subjects of the batch being launched,
+        so resolving one batch can never evict its own members). Grown
+        tables invalidate the warm gathered executables; they are
+        rebuilt EAGERLY here (warm-up-class work — a growth compile must
+        not land inside a latency-sensitive dispatch), counted like
+        every compile. Counts ``specializations`` itself, and only when
+        THIS call installed the row — a racing writer's install is that
+        writer's count (one bake, one count).
+
+        Locking: ``_install_lock`` serializes installers for the whole
+        bake-and-swap, so the functional grow/set_row staged OUTSIDE
+        ``_exe_lock`` can never lose a concurrent row write; ``_exe_lock``
+        is held only for the dict/slot bookkeeping and the final swap.
+        The dispatcher blocks on ``_exe_lock`` for every batch, and on
+        the tunneled backend a device call (the row write's first-per-
+        capacity trace, or a tunnel hiccup inside it) can stall for
+        seconds — it must never sit inside the lock the dispatch path
+        needs. Lock order is _install_lock -> _exe_lock, never the
+        reverse (_resolve_batch releases _exe_lock before calling here).
+        """
         from mano_hand_tpu.models import core
 
         if self._params_dev is None:
             self._params_dev = self._params.device_put()
-        shaped = core.jit_specialize(self._params_dev, shape)
-        with self._exe_lock:
-            # First writer wins, like the executable caches.
-            self._shaped.setdefault(key, shaped)
-            # The raw betas ride along for the CPU fallback path, which
-            # re-runs the FULL forward (broadcasting these per row).
-            self._subject_betas.setdefault(key, shape)
+        shaped = core.jit_specialize(self._params_dev, betas)
+        with self._install_lock:
+            grew = False
+            with self._exe_lock:
+                if key in self._subject_slots:     # racing writer won
+                    self._subject_lru.move_to_end(key)
+                    return self._subject_slots[key]
+                self._subject_betas.setdefault(key, betas)
+                table = self._table
+                cap = (table.capacity if table is not None
+                       else min(self._TABLE_INIT_CAPACITY,
+                                self.max_subjects))
+                if self._next_slot < cap:
+                    slot = self._next_slot
+                    self._next_slot += 1
+                elif cap < self.max_subjects:
+                    cap = min(self.max_subjects, cap * 2)
+                    grew = True
+                    slot = self._next_slot
+                    self._next_slot += 1
+                else:
+                    for victim in self._subject_lru:
+                        if victim not in protected:
+                            break
+                    else:
+                        raise RuntimeError(
+                            f"one batch references more live subjects "
+                            f"than max_subjects={self.max_subjects} "
+                            f"table rows")
+                    # The victim leaves the maps NOW (an in-between
+                    # dispatch sees neither victim nor newcomer — its
+                    # row is unreferenced data until the swap below).
+                    slot = self._subject_slots.pop(victim)
+                    del self._subject_lru[victim]
+                    self.counters.count_evict()
+            # Device work on a STAGED table, outside _exe_lock (no
+            # other writer can interleave: installs are the table's
+            # only mutators and _install_lock serializes them).
+            if table is None:
+                table = core.subject_table(self._params_dev, cap)
+            elif grew:
+                table = core.table_grow(table, cap)
+                self.counters.count_table_growth()
+            table = core.jit_table_set_row(table, slot, shaped)
+            with self._exe_lock:
+                self._table = table
+                self._subject_slots[key] = slot
+                self._subject_lru[key] = None
+                stale = ([b for b, (c, _) in self._gather_exes.items()
+                          if c != cap] if grew else [])
         self.counters.count_specialize(hit=False)
-        return key
+        for b in stale:
+            self._gather_executable(b)
+        return slot
+
+    def _resolve_batch(self, reqs):
+        """Map a coalesced pose-only batch to (table snapshot, slots),
+        re-baking any subject evicted while the requests sat queued.
+        The snapshot and the slot list come from ONE locked read, so the
+        dispatched program sees a consistent table; a concurrent
+        specialize/evict only ever swaps the LIVE reference."""
+        digests = {r.subject for r in reqs}
+        for _ in range(len(digests) + 2):
+            with self._exe_lock:
+                missing = [k for k in digests
+                           if k not in self._subject_slots]
+                if not missing:
+                    table = self._table
+                    slots = {k: self._subject_slots[k] for k in digests}
+                    for k in digests:
+                        self._subject_lru.move_to_end(k)
+                    return table, [slots[r.subject] for r in reqs]
+                betas = {k: self._subject_betas[k] for k in missing}
+            for k, b in betas.items():
+                # _install_subject counts the re-bake (a fresh
+                # specialization): the eviction traded this recompute
+                # for table space, and the counter keeps the trade
+                # observable.
+                self._install_subject(k, b, protected=digests)
+        raise RuntimeError(           # racing evictions kept winning
+            "could not pin this batch's subjects into the table; "
+            "max_subjects is too small for the live working set")
 
     def warmup_posed(self, bucket_list: Optional[Sequence[int]] = None,
                      ) -> dict:
-        """Build the pose-only per-bucket executables up front (requires
-        at least one ``specialize``d subject for the warm-up batch).
-        Returns {bucket: "jit" | "cached"} — after this, pose-only
-        traffic over these buckets compiles NOTHING, for any number of
-        subjects (the acceptance criterion's composed-cache half)."""
+        """Build the gathered pose-only per-bucket executables up front
+        (requires at least one ``specialize``d subject, so the table —
+        whose capacity the programs are shaped over — exists). Returns
+        {bucket: "jit" | "cached"} — after this, pose-only traffic over
+        these buckets compiles NOTHING, for any number or mixture of
+        subjects up to the current capacity (the composed-cache
+        criterion; a capacity growth retraces once, counted)."""
         out = {}
         for b in bucket_list or self.buckets:
             if b not in self.buckets:
                 raise ValueError(f"{b} is not one of {self.buckets}")
             with self._exe_lock:
-                known = b in self._posed_exes
+                entry = self._gather_exes.get(b)
+                cap = self._table.capacity if self._table is not None \
+                    else None
+            known = entry is not None and entry[0] == cap
             out[b] = "cached" if known else "jit"
             if not known:
-                self._posed_executable(b)
+                self._gather_executable(b)
         return out
 
     def submit(self, pose, shape=None, subject: Optional[str] = None,
@@ -471,7 +656,14 @@ class ServingEngine:
                     "pass either shape (full path) or subject (pose-only "
                     "path), not both — the subject IS the baked shape")
             with self._exe_lock:
-                known = subject in self._shaped
+                # Betas registry, not the slot map: an EVICTED subject
+                # is still servable (its row re-bakes at dispatch);
+                # only a never-specialized key is a caller error.
+                known = subject in self._subject_betas
+                if subject in self._subject_lru:
+                    # Live traffic refreshes LRU position at submit, so
+                    # queued requests' subjects resist eviction.
+                    self._subject_lru.move_to_end(subject)
             if not known:
                 raise ValueError(
                     f"unknown subject {subject!r}; call "
@@ -625,33 +817,52 @@ class ServingEngine:
             exe = self._exes.setdefault(bucket, loaded)
         return exe
 
-    def _posed_executable(self, bucket: int):
-        """The pose-only per-bucket entry — in-memory then jit, no AOT
-        tier (the ShapedHand is a runtime argument, so the artifact
-        would bake nothing subject-specific; the jit compile is already
-        amortized across ALL subjects). Compiles count on ``counters``
-        exactly like the full path's."""
-        with self._exe_lock:
-            exe = self._posed_exes.get(bucket)
-            proto = (next(iter(self._shaped.values()))
-                     if self._shaped else None)
-        if exe is not None:
-            return exe
-        if proto is None:
+    def _gather_executable(self, bucket: int, table=None):
+        """The gathered pose-only per-bucket entry — in-memory then jit,
+        no AOT tier (table and index are runtime arguments, so the
+        artifact would bake nothing subject-specific; the jit compile
+        is already amortized across ALL subject mixtures). Keyed on the
+        table CAPACITY as well as the bucket: a growth makes the warm
+        entry stale, and the rebuild — O(log subjects) times ever — is
+        counted on ``counters`` exactly like every compile.
+
+        ``table`` pins the capacity the caller will actually invoke the
+        executable with (the dispatch snapshot from ``_resolve_batch``)
+        — resolving against the LIVE table instead would let a racing
+        growth hand back a wider program whose jit then silently
+        retraces on the snapshot mid-dispatch. Default (None): the live
+        table (warm-up paths).
+        """
+        if table is None:
+            with self._exe_lock:
+                table = self._table
+        if table is None:
             # Unreachable through submit (it requires a registered
             # subject), but warmup_posed can get here.
             raise RuntimeError(
                 "no specialized subject to warm the pose-only path "
                 "with; call specialize(betas) first")
-        exe = build_posed_bucket_executable(
-            proto, bucket, self._n_joints, self._dtype, donate=self.donate)
+        cap = table.capacity
+        with self._exe_lock:
+            entry = self._gather_exes.get(bucket)
+        if entry is not None and entry[0] == cap:
+            return entry[1]
+        exe = build_posed_gather_executable(
+            table, bucket, self._n_joints, self._dtype, donate=self.donate)
         self.counters.count_compile()
         if self._policy is not None and self._policy.chaos is not None:
             # Same primary-only chaos wrapping as the full path.
             exe = self._policy.chaos.wrap(
                 exe, on_fault=self.counters.count_fault)
         with self._exe_lock:
-            exe = self._posed_exes.setdefault(bucket, exe)
+            cur = self._gather_exes.get(bucket)
+            if cur is not None and cur[0] == cap:
+                return cur[1]  # racing builder won at the same capacity
+            if cur is None or cur[0] < cap:
+                # Never let a build against an OLD snapshot clobber a
+                # newer-capacity entry (capacity only grows): the stale
+                # program still serves THIS dispatch, uncached.
+                self._gather_exes[bucket] = (cap, exe)
         return exe
 
     def _fallback_executable(self, bucket: int):
@@ -681,10 +892,68 @@ class ServingEngine:
         return exe
 
     # ------------------------------------------------------------ dispatch
+    def _admit(self, nxt: _Request, posed: bool, subjects: set,
+               rows: int) -> Optional[str]:
+        """Why ``nxt`` cannot join the batch being coalesced, or None.
+
+        ``"kind"``: full-path and pose-only requests cannot share a
+        program. ``"subjects"``: admitting one more DISTINCT subject
+        would exceed the table's ``max_subjects`` rows (so _resolve_batch
+        could never pin the batch). ``"overflow"``: the rows would
+        exceed the largest bucket — the one reason that also stops the
+        scan (anything later would overflow too once this batch is
+        near-full). Note what is ABSENT: a subject-equality rule —
+        different subjects coalescing is the PR-4 tentpole.
+        """
+        if (nxt.subject is not None) != posed:
+            return "kind"
+        if rows + nxt.rows > self.buckets[-1]:
+            return "overflow"
+        if (posed and nxt.subject not in subjects
+                and len(subjects) >= self.max_subjects):
+            return "subjects"
+        return None
+
     def _coalesce(self, first: _Request):
         """Gather more pending requests behind ``first`` until the largest
-        bucket fills or ``max_delay_s`` elapses. Returns (requests, rows)."""
+        bucket fills or ``max_delay_s`` elapses. Returns (requests, rows).
+
+        Same-path requests coalesce regardless of subject (the gathered
+        dispatch takes a per-row subject index); a request that cannot
+        join — any reason _admit names: other path kind, genuine bucket
+        overflow (``coalesce_overflows``), or a max_subjects-wide batch
+        — is parked on ``_pending``, which leads the next batches, so
+        head-of-line blocking is bounded to one batch instead of
+        starving behind the live queue.
+        """
         reqs, rows = [first], first.rows
+        posed = first.subject is not None
+        subjects = {first.subject} if posed else set()
+
+        def admit(nxt, fresh=True) -> Optional[str]:
+            why = self._admit(nxt, posed, subjects, rows)
+            if why is None:
+                reqs.append(nxt)
+                if posed:
+                    subjects.add(nxt.subject)
+                return None
+            self._pending.append(nxt)
+            if why == "overflow" and fresh:
+                # Count each overflowING request once, at its FIRST
+                # park from the live queue — a re-park of an already-
+                # parked request is the same capacity event, not a new
+                # one.
+                self.counters.count_overflow()
+            return why
+
+        # Parked requests first — they have already waited a batch.
+        # Snapshot the count: admit() re-parks rejects on the right.
+        for _ in range(len(self._pending)):
+            if rows >= self.buckets[-1]:
+                break
+            nxt = self._pending.popleft()
+            if admit(nxt, fresh=False) is None:
+                rows += nxt.rows
         deadline = time.perf_counter() + self.max_delay_s
         while rows < self.buckets[-1]:
             timeout = deadline - time.perf_counter()
@@ -696,30 +965,24 @@ class ServingEngine:
             if nxt is _SENTINEL:
                 self._queue.put(_SENTINEL)  # re-post for the main loop
                 break
-            if nxt.subject != first.subject:
-                # A batch is one program over one parameter set: full and
-                # pose-only requests — or two different subjects' shaped
-                # constants — cannot share a dispatch. The mismatched
-                # request leads the next batch (the overflow rule).
-                self._leftover = nxt
+            why = admit(nxt)
+            if why is None:
+                rows += nxt.rows
+            elif why == "overflow":
+                # Genuine overflow: dispatch what we have (the parked
+                # overhang leads the next batch). A kind/subjects park
+                # keeps scanning instead — later same-path requests can
+                # still fill this batch.
                 break
-            if rows + nxt.rows > self.buckets[-1]:
-                # Would overflow the largest bucket: dispatch what we
-                # have; the overhang leads the next batch.
-                self._leftover = nxt
-                break
-            reqs.append(nxt)
-            rows += nxt.rows
         return reqs, rows
 
     def _dispatch_loop(self) -> None:
         inflight: collections.deque = collections.deque()
-        self._leftover: Optional[_Request] = None
         try:
             while True:
-                first = self._leftover
-                self._leftover = None
-                if first is None:
+                if self._pending:
+                    first = self._pending.popleft()
+                else:
                     try:
                         # With work in flight, never WAIT on the queue:
                         # an empty instant means nothing to assemble, so
@@ -736,7 +999,7 @@ class ServingEngine:
                         break
                     continue
                 self.counters.observe_queue_depth(
-                    self._queue.qsize() + 1)
+                    self._queue.qsize() + len(self._pending) + 1)
                 reqs, rows = self._coalesce(first)
                 item = self._launch(reqs, rows)
                 if item is not None:  # None: batch resolved to an error
@@ -753,11 +1016,12 @@ class ServingEngine:
             self._failure = e
             for item in inflight:
                 self._poison(item[1], e)
-            if self._leftover is not None:
-                # An overflow request parked by _coalesce is in neither
-                # inflight nor the queue — its future must not hang.
-                self._poison([self._leftover], e)
-                self._leftover = None
+            if self._pending:
+                # Requests parked by _coalesce are in neither inflight
+                # nor the queue — their futures must not hang (the PR-3
+                # poison guarantee extended to the _pending deque).
+                self._poison(list(self._pending), e)
+                self._pending.clear()
             self._drain_cancelled(e)
             raise
 
@@ -769,9 +1033,15 @@ class ServingEngine:
             else:
                 pose = np.concatenate([r.pose for r in reqs])
             pose = bucket_mod.pad_rows(pose, bucket)
-            subject = reqs[0].subject  # uniform per batch (_coalesce)
-            shape = None
-            if subject is None:
+            posed = reqs[0].subject is not None  # uniform kind (_coalesce)
+            shape = table = idx = None
+            n_subjects = 1
+            if posed:
+                table, slots = self._resolve_batch(reqs)
+                idx = bucket_mod.subject_index_rows(
+                    slots, [r.rows for r in reqs], bucket)
+                n_subjects = len(set(slots))
+            else:
                 shape = (reqs[0].shape if len(reqs) == 1 else
                          np.concatenate([r.shape for r in reqs]))
                 shape = bucket_mod.pad_rows(shape, bucket)
@@ -780,15 +1050,16 @@ class ServingEngine:
                 # policy's deadline/retry/failover envelope before the
                 # next batch launches (bounded latency over overlap).
                 out = self._supervised_dispatch(bucket, pose, shape,
-                                                subject)
-            elif subject is not None:
-                with self._exe_lock:
-                    shaped = self._shaped[subject]
-                out = self._posed_executable(bucket)(shaped, pose)
+                                                reqs, table, idx)
+            elif posed:
+                out = self._gather_executable(bucket, table)(table, idx,
+                                                             pose)
             else:
                 exe = self._executable(bucket)
                 out = exe(pose, shape)  # async dispatch: pre-completion
-            self.counters.count_dispatch(bucket, rows)
+            self.counters.count_dispatch(bucket, rows,
+                                         requests=len(reqs),
+                                         subjects=n_subjects)
             return out, reqs, bucket
         except ServingError as e:
             # Supervision exhausted for THIS batch: its futures get the
@@ -805,13 +1076,18 @@ class ServingEngine:
             raise
 
     def _supervised_dispatch(self, bucket: int, pose, shape,
-                             subject: Optional[str]):
+                             reqs, table, idx):
         """One batch through the full fault-tolerance envelope:
         supervised primary attempts (deadline + classified retries with
         backoff, breaker-gated), then CPU graceful degradation, then a
         structured ``ServingError``. Deterministic failures (compile
         errors, shape bugs) are NOT retried and NOT failed over — they
-        propagate and stay engine-fatal, the pre-PR-3 contract.
+        propagate and stay engine-fatal, the pre-PR-3 contract. A
+        pose-only batch (``table``/``idx`` set) runs the gathered
+        primary; its fallback re-runs the FULL forward with each row's
+        raw betas — mixed subjects included — in the same
+        params-as-runtime-args program family, so failover stays
+        bit-identical to a direct CPU bucketed call.
 
         Executables are fetched (and so possibly built) OUTSIDE the
         per-attempt deadline: builds are warm-up-class work — size the
@@ -822,11 +1098,9 @@ class ServingEngine:
 
         pol = self._policy
         breaker = pol.breaker
-        if subject is not None:
-            with self._exe_lock:
-                shaped = self._shaped[subject]
-            exe = self._posed_executable(bucket)
-            primary = lambda: np.asarray(exe(shaped, pose))  # noqa: E731
+        if table is not None:
+            exe = self._gather_executable(bucket, table)
+            primary = lambda: np.asarray(exe(table, idx, pose))  # noqa: E731
         else:
             exe = self._executable(bucket)
             primary = lambda: np.asarray(exe(pose, shape))   # noqa: E731
@@ -857,11 +1131,17 @@ class ServingEngine:
                 last, attempts = e.cause, e.attempts
         if pol.cpu_fallback:
             self.counters.count_failover()
-            if subject is not None:
+            if table is not None:
+                # Per-ROW betas for the mixed-subject batch (pad rows
+                # repeat request 0's betas, matching pad_rows/idx row 0).
                 with self._exe_lock:
-                    betas = self._subject_betas[subject]
-                fb_shape = np.ascontiguousarray(np.broadcast_to(
-                    betas[None], (bucket, self._n_shape)))
+                    betas = [self._subject_betas[r.subject] for r in reqs]
+                fb_shape = bucket_mod.pad_rows(
+                    np.concatenate([
+                        np.broadcast_to(b[None], (r.rows, self._n_shape))
+                        for b, r in zip(betas, reqs)]),
+                    bucket)
+                fb_shape = np.ascontiguousarray(fb_shape)
             else:
                 fb_shape = shape
             fb = self._fallback_executable(bucket)  # built un-deadlined

@@ -114,6 +114,15 @@ class ServingCounters:
         self.queue_depth_peak = 0  # max pending requests seen at coalesce
         self.specializations = 0   # shape-stage bakes (subject-cache misses)
         self.shaped_hits = 0       # subject-cache hits (bake reused)
+        # Cross-subject coalescing telemetry (PR 4): the per-dispatch
+        # request count and subject mix quantify what the gathered
+        # dispatch actually merged; overflow/eviction/growth events are
+        # the capacity-management audit trail.
+        self.requests_dispatched = 0   # requests merged across dispatches
+        self.mixed_subject_batches = 0  # dispatches mixing >= 2 subjects
+        self.coalesce_overflows = 0    # requests parked: bucket overflow
+        self.specializations_evicted = 0  # LRU table-slot evictions
+        self.table_growths = 0         # subject-table capacity doublings
         # Fault-tolerance counters (runtime/, PR 3): the recovery
         # drill's done-criteria read these, so resilience is a set of
         # numbers, not a hope — same philosophy as ``compiles``.
@@ -160,11 +169,40 @@ class ServingCounters:
         with self._lock:
             self.deadline_kills += n
 
-    def count_dispatch(self, bucket: int, live_rows: int) -> None:
+    def count_dispatch(self, bucket: int, live_rows: int,
+                       requests: int = 1, subjects: int = 1) -> None:
+        """One batch sent to the device. ``requests`` is how many submit()
+        calls the batch coalesced (the coalesce-width numerator);
+        ``subjects`` how many DISTINCT specialized subjects rode in it
+        (>= 2 marks a mixed-subject gathered dispatch — the PR-4
+        first-class case). Single-request callers (the bucketed fit
+        wrappers, forward_bucketed) keep the defaults."""
         with self._lock:
             self.dispatches += 1
             self.rows_live += live_rows
             self.rows_padded += bucket - live_rows
+            self.requests_dispatched += requests
+            if subjects > 1:
+                self.mixed_subject_batches += 1
+
+    def count_overflow(self, n: int = 1) -> None:
+        """A request parked by _coalesce because admitting it would
+        overflow the largest bucket. Genuine capacity overflow ONLY:
+        the other park reasons (a path-kind mismatch, or a batch
+        already spanning max_subjects distinct subjects) are not
+        capacity events and are not counted here."""
+        with self._lock:
+            self.coalesce_overflows += n
+
+    def count_evict(self, n: int = 1) -> None:
+        """One LRU eviction from the subject table (the slot is reused;
+        compiled programs are untouched — the table is a runtime arg)."""
+        with self._lock:
+            self.specializations_evicted += n
+
+    def count_table_growth(self, n: int = 1) -> None:
+        with self._lock:
+            self.table_growths += n
 
     def observe_queue_depth(self, depth: int) -> None:
         with self._lock:
@@ -196,6 +234,14 @@ class ServingCounters:
             total = self.rows_live + self.rows_padded
             return self.rows_padded / total if total else 0.0
 
+    @property
+    def coalesce_width_mean(self) -> float:
+        """Mean submit() requests merged per dispatch (1.0 = the
+        degenerate single-request batches PR 4 exists to fix)."""
+        with self._lock:
+            return (self.requests_dispatched / self.dispatches
+                    if self.dispatches else 0.0)
+
     def latency_quantiles(self) -> dict:
         """{bucket: {"p50_ms", "p99_ms", "n"}} over the recorded samples."""
         with self._lock:
@@ -224,11 +270,17 @@ class ServingCounters:
                 "queue_depth_peak": self.queue_depth_peak,
                 "specializations": self.specializations,
                 "shaped_hits": self.shaped_hits,
+                "requests_dispatched": self.requests_dispatched,
+                "mixed_subject_batches": self.mixed_subject_batches,
+                "coalesce_overflows": self.coalesce_overflows,
+                "specializations_evicted": self.specializations_evicted,
+                "table_growths": self.table_growths,
                 "retries": self.retries,
                 "faults_injected": self.faults_injected,
                 "failovers": self.failovers,
                 "deadline_kills": self.deadline_kills,
             }
         base["padding_waste"] = round(self.padding_waste, 4)
+        base["coalesce_width_mean"] = round(self.coalesce_width_mean, 3)
         base["latency_by_bucket"] = self.latency_quantiles()
         return base

@@ -180,3 +180,65 @@ def test_serving_metrics_block(tmp_path):
     assert p.returncode == 0, p.stdout
     assert "[PASS] serving_overhead_09x" in p.stdout
     assert "[info] serving:" in p.stdout
+
+
+def test_coalesce_metrics_block(tmp_path):
+    """The cross-subject coalescing leg (config9, PR 4): >= 1.3x over
+    the per-subject split at >= 8 subjects, bit-identical gather, zero
+    steady recompiles — judged inside a serving-only artifact AND as a
+    raw `serve-bench --subjects` line (no bench.py envelope)."""
+    cz = {
+        "subjects": 12, "requests": 96, "rows": [1, 4],
+        "engine_evals_per_sec": 19557.0, "split_evals_per_sec": 1717.0,
+        "engine_vs_split_ratio": 11.39, "ratio_median": 10.2,
+        "ratio_trials": [10.2, 11.4, 9.8],
+        "gather_vs_posed_max_abs_err": 0.0, "steady_recompiles": 0,
+        "table_growths": 1, "specializations_evicted": 0,
+        "coalesce_overflows": 2, "mixed_subject_batches": 38,
+        "coalesce_width_mean": 19.4, "padding_waste": 0.07,
+        "dispatches": 40,
+    }
+    # Raw serve-bench --subjects artifact: judged on its own.
+    raw = tmp_path / "coalesce_raw.json"
+    raw.write_text(json.dumps(dict(cz, backend="cpu")))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] coalesce_13x" in p.stdout
+    assert "[PASS] coalesce_bitwise_gather" in p.stdout
+    assert "[PASS] coalesce_zero_recompiles" in p.stdout
+    assert "COALESCE CRITERIA PASS" in p.stdout
+
+    # A non-bitwise gather or a steady recompile fails loudly.
+    raw.write_text(json.dumps(dict(
+        cz, gather_vs_posed_max_abs_err=3e-8, steady_recompiles=1)))
+    p = _run(str(raw))
+    assert p.returncode == 1
+    assert "[FAIL] coalesce_bitwise_gather" in p.stdout
+    assert "[FAIL] coalesce_zero_recompiles" in p.stdout
+
+    # Under 8 subjects the speed bar is unjudged, numerics still gated.
+    raw.write_text(json.dumps(dict(cz, subjects=4, engine_vs_split_ratio=0.9)))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    assert "speed unjudged" in p.stdout and "coalesce_13x" not in p.stdout
+
+    # Inside a serving-only artifact the block rides with the serving
+    # criteria (the `make serve-smoke` shape).
+    only = tmp_path / "serve_only.json"
+    only.write_text(json.dumps({
+        "metric": "serving_engine_evals_per_sec", "value": 8114.4,
+        "unit": "evals/s", "vs_baseline": None, "device": "cpu:cpu",
+        "detail": {
+            "serving": {
+                "engine_evals_per_sec": 8114.4,
+                "engine_vs_direct_ratio": 1.297,
+                "warm_bucket": 32, "steady_recompiles": 0,
+                "requests": 64, "compiles": 6, "aot_loads": 0,
+                "dispatches": 54, "padding_waste": 0.14,
+            },
+            "coalesce": cz,
+        }}))
+    p = _run(str(only))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] coalesce_13x" in p.stdout
+    assert "SERVING CRITERIA PASS" in p.stdout

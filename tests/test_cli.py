@@ -902,3 +902,19 @@ def test_serve_bench_subcommand(capsys):
     assert cli.main(["serve-bench", "--max-rows", "64",
                      "--max-bucket", "32"]) == 2
     assert cli.main(["serve-bench", "--min-rows", "0"]) == 2
+
+
+def test_serve_bench_subjects_mode(capsys):
+    """`serve-bench --subjects N` runs the mixed-subject coalescing
+    protocol (bench.py config9's shared code path) and prints its one
+    JSON line — tiny sizes, plumbing only; the honest ratio lives in
+    the config9 leg."""
+    assert cli.main(["serve-bench", "--subjects", "2", "--requests", "6",
+                     "--max-rows", "2", "--max-bucket", "8",
+                     "--seed", "1"]) == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["subjects"] == 2
+    assert line["gather_vs_posed_max_abs_err"] == 0.0
+    assert line["steady_recompiles"] == 0
+    assert line["engine_vs_split_ratio"] > 0
+    assert line["backend"] == "cpu"

@@ -468,6 +468,98 @@ def test_engine_counters_snapshot_has_runtime_fields(params32):
             snap["deadline_kills"]) == (1, 2, 1, 1)
 
 
+def test_engine_mixed_subject_batch_under_chaos(params32):
+    """PR-4 composition: a gathered MIXED-SUBJECT batch rides the same
+    fault envelope — a transient fault is retried back to bit-correct
+    results, and a persistent outage fails the whole mixed batch over
+    to the CPU full-forward path with per-row betas, bit-identical to
+    the direct CPU program."""
+    rng = np.random.default_rng(7)
+    betas = [rng.normal(size=10).astype(np.float32) for _ in range(3)]
+    poses = [rng.normal(scale=0.4, size=(n, 16, 3)).astype(np.float32)
+             for n in (1, 2, 2)]
+
+    def submit_all(eng, keys):
+        # Hold the dispatcher so the three subjects' requests land in
+        # ONE gathered batch deterministically.
+        orig = eng.start
+        eng.start = lambda: eng
+        try:
+            futs = [eng.submit(p, subject=k) for p, k in zip(poses, keys)]
+        finally:
+            eng.start = orig
+        eng.start()
+        return futs
+
+    # Transient fault: one retry, results bitwise vs the per-subject
+    # posed program at the dispatch bucket (1+2+2 rows -> bucket 8).
+    plan = chaos.ChaosPlan()
+    with ServingEngine(params32, max_bucket=8,
+                       policy=_policy(plan, retries=1)) as eng:
+        keys = [eng.specialize(b) for b in betas]
+        eng.warmup_posed()
+        plan.schedule("error@0")
+        futs = submit_all(eng, keys)
+        from mano_hand_tpu.serving import pad_rows
+
+        for p, b, f in zip(poses, betas, futs):
+            got = f.result(timeout=30.0)
+            want = np.asarray(core.jit_forward_posed_batched(
+                core.jit_specialize(params32, jnp.asarray(b)),
+                jnp.asarray(pad_rows(p, 8))).verts)[:p.shape[0]]
+            np.testing.assert_array_equal(got, want)
+    assert eng.counters.retries == 1
+    assert eng.counters.faults_injected == 1
+    assert eng.counters.mixed_subject_batches == 1
+
+    # Persistent outage: the mixed batch fails over to the CPU
+    # full-forward program with PER-ROW betas — bit-identical to the
+    # direct CPU call with each request's own betas.
+    plan2 = chaos.ChaosPlan("error@0-")
+    tunnel = [False]
+    br = health.CircuitBreaker(failure_threshold=1, probe=lambda: tunnel[0],
+                               probe_interval_s=0.0,
+                               respect_priority_claim=False)
+    with ServingEngine(params32, max_bucket=8,
+                       policy=_policy(plan2, br, retries=0)) as eng2:
+        keys = [eng2.specialize(b) for b in betas]
+        eng2.warmup_posed()
+        eng2.warmup([8])      # fallback tier warm for the batch bucket
+        futs = submit_all(eng2, keys)
+        for p, b, f in zip(poses, betas, futs):
+            got = f.result(timeout=30.0)
+            want = _direct(params32, p,
+                           np.broadcast_to(b[None], (p.shape[0], 10)))
+            np.testing.assert_array_equal(got, want)
+    assert eng2.counters.failovers >= 1
+    assert eng2.counters.mixed_subject_batches == 1
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_parked_overflow_future_resolves_on_dispatcher_death(params32):
+    """Satellite (PR 4, extending the PR-3 poison path): a request
+    parked on _pending by an overflow is in neither inflight nor the
+    queue — when the dispatcher dies mid-launch, its future must be
+    poisoned too, never stranded."""
+    eng = ServingEngine(params32, max_bucket=4)
+    eng._exes = {b: (lambda p, s: (_ for _ in ()).throw(
+        RuntimeError("worker died mid-launch"))) for b in eng.buckets}
+    orig = eng.start
+    eng.start = lambda: eng
+    try:
+        f1 = eng.submit(*_req(3, seed=6))   # fills bucket 4
+        f2 = eng.submit(*_req(3, seed=7))   # overflow -> parked
+    finally:
+        eng.start = orig
+    with eng:
+        with pytest.raises(RuntimeError, match="worker died"):
+            f1.result(timeout=30.0)
+        with pytest.raises(RuntimeError, match="worker died"):
+            f2.result(timeout=30.0)         # the parked one
+    assert eng.counters.coalesce_overflows == 1
+
+
 # ------------------------------------------------------ the recovery drill
 def test_recovery_drill_meets_done_criteria(params32):
     """The bench/CLI-shared protocol end to end (the ISSUE acceptance

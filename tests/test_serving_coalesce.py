@@ -1,0 +1,319 @@
+"""Cross-subject coalescing (the PR-4 tentpole), CPU-verified.
+
+The subject becomes a per-ROW runtime index instead of a per-batch
+executable constant: every ``specialize``d subject lives in a row of a
+device-resident ``core.SubjectTable``, and the engine's pose-only
+dispatch is the GATHERED program ``core.forward_posed_gather`` — so
+requests for different subjects coalesce into one dispatch. Everything
+that matters is deterministic on CPU and pinned here:
+
+* bit-identity — the gathered program's rows equal the per-subject
+  posed program (``forward_posed_batched``) EXACTLY (f32 ``==``) at a
+  matched batch size, for any subject mixture, any table capacity, and
+  through the LIVE engine at awkward batch compositions;
+* table mechanics — functional row writes (snapshots stay valid),
+  capacity growth by doubling (counted; zero recompiles once grown),
+  LRU eviction above ``max_subjects`` (counted; never a recompile, and
+  an evicted subject transparently re-bakes on its next dispatch);
+* coalescing policy — mixed-subject pose-only requests merge into one
+  dispatch; full-path and pose-only requests never share one; overflow
+  parks on ``_pending`` (counted) and still dispatches next.
+"""
+
+import collections
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mano_hand_tpu.models import core
+from mano_hand_tpu.serving import (
+    ServingEngine,
+    bucket_for,
+    pad_rows,
+    subject_index_rows,
+)
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+def _betas(n, seed=3, scale=0.5):
+    rng = np.random.default_rng(seed)
+    return [rng.normal(scale=scale, size=10).astype(np.float32)
+            for _ in range(n)]
+
+
+def _poses(n, seed=0, scale=0.4):
+    return np.random.default_rng(seed).normal(
+        scale=scale, size=(n, 16, 3)).astype(np.float32)
+
+
+def _prestuffed(eng, submits):
+    """Submit every (pose, kwargs) pair with the dispatcher HELD OFF,
+    then start it: the queue is drained in one _coalesce scan, so batch
+    composition is deterministic (no timing races)."""
+    orig_start = eng.start
+    eng.start = lambda: eng          # hold the dispatcher
+    try:
+        futs = [eng.submit(p, **kw) for p, kw in submits]
+    finally:
+        eng.start = orig_start
+    eng.start()
+    return futs
+
+
+# ---------------------------------------------------------- the gather op
+def test_forward_posed_gather_bit_identical(params32):
+    """THE acceptance criterion: at a matched batch size, every row of
+    the gathered mixed-subject program equals the corresponding row of
+    the per-subject posed program EXACTLY (f32 ==)."""
+    betas = _betas(3)
+    shaped = [core.jit_specialize(params32, jnp.asarray(b)) for b in betas]
+    table = core.stack_shaped(shaped)
+    poses = jnp.asarray(_poses(8, seed=11, scale=0.6))
+    idx = np.random.default_rng(1).integers(0, 3, size=8).astype(np.int32)
+    got = np.asarray(core.jit_forward_posed_gather(
+        table, jnp.asarray(idx), poses).verts)
+    for si in range(3):
+        want = np.asarray(core.jit_forward_posed_batched(
+            shaped[si], poses).verts)
+        rows = np.where(idx == si)[0]
+        np.testing.assert_array_equal(got[rows], want[rows],
+                                      err_msg=f"subject {si}")
+
+
+def test_table_mechanics_functional_and_grow(params32):
+    betas = _betas(2, seed=7)
+    shaped = [core.jit_specialize(params32, jnp.asarray(b)) for b in betas]
+    t0 = core.subject_table(params32, capacity=2)
+    t1 = core.jit_table_set_row(t0, 0, shaped[0])
+    t2 = core.jit_table_set_row(t1, 1, shaped[1])
+    # Functional: earlier snapshots are untouched by later writes.
+    np.testing.assert_array_equal(np.asarray(t0.v_shaped[1]),
+                                  np.zeros((778, 3), np.float32))
+    np.testing.assert_array_equal(np.asarray(t1.v_shaped[1]),
+                                  np.zeros((778, 3), np.float32))
+    np.testing.assert_array_equal(np.asarray(t2.v_shaped[0]),
+                                  np.asarray(shaped[0].v_shaped))
+    # Capacity growth pads rows and changes NO gathered result.
+    poses = jnp.asarray(_poses(5, seed=2))
+    idx = jnp.asarray([0, 1, 0, 1, 1], jnp.int32)
+    got = np.asarray(core.jit_forward_posed_gather(t2, idx, poses).verts)
+    tbig = core.table_grow(t2, 8)
+    got2 = np.asarray(core.jit_forward_posed_gather(tbig, idx, poses).verts)
+    np.testing.assert_array_equal(got, got2)
+    with pytest.raises(ValueError, match="shrink"):
+        core.table_grow(t2, 1)
+    # Row read-back round-trips.
+    row = core.table_row(t2, 1)
+    np.testing.assert_array_equal(np.asarray(row.joints),
+                                  np.asarray(shaped[1].joints))
+    # ... and the pytree survives jit as a runtime argument.
+    t3 = jax.jit(lambda t: t)(t2)
+    assert isinstance(t3, core.SubjectTable) and t3.capacity == 2
+
+
+def test_subject_index_rows():
+    idx = subject_index_rows([5, 2, 5], [1, 2, 3], 8)
+    np.testing.assert_array_equal(idx, np.array([5, 2, 2, 5, 5, 5, 5, 5],
+                                                np.int32))
+    assert idx.dtype == np.int32
+    with pytest.raises(ValueError, match="pair up"):
+        subject_index_rows([1, 2], [1], 4)
+    with pytest.raises(ValueError, match=">= 1 row"):
+        subject_index_rows([1], [0], 4)
+    with pytest.raises(ValueError, match="cannot pad"):
+        subject_index_rows([1, 2], [3, 3], 4)
+
+
+# ------------------------------------------------------- engine parity
+def test_engine_mixed_subject_parity_awkward_compositions(params32):
+    """Mixed-subject batches through the LIVE engine, composition held
+    deterministic by pre-stuffing the queue: 1 subject, many subjects,
+    interleaved full/pose-only, oversize — every future bit-identical
+    to its per-subject posed reference at the dispatch bucket size."""
+    rng = np.random.default_rng(23)
+    betas = _betas(4, seed=23)
+    with ServingEngine(params32, max_bucket=16, max_delay_s=0.0) as eng:
+        keys = [eng.specialize(b) for b in betas]
+        shaped = [core.jit_specialize(params32, jnp.asarray(b))
+                  for b in betas]
+        eng.warmup_posed()
+        eng.warmup()
+
+        # One batch, many subjects, awkward sizes 1+2+3+5 = 11 -> b16.
+        sizes = [1, 2, 3, 5]
+        poses = [rng.normal(scale=0.4, size=(n, 16, 3)).astype(np.float32)
+                 for n in sizes]
+        futs = _prestuffed(eng, [
+            (p, {"subject": keys[i]}) for i, p in enumerate(poses)])
+        assert eng.counters.dispatches == 0 or True  # dispatch is async
+        bucket = bucket_for(sum(sizes), eng.buckets)
+        for i, (p, f) in enumerate(zip(poses, futs)):
+            got = f.result(timeout=60.0)
+            want = np.asarray(core.jit_forward_posed_batched(
+                shaped[i], jnp.asarray(pad_rows(p, bucket))).verts)
+            np.testing.assert_array_equal(got, want[:p.shape[0]],
+                                          err_msg=f"request {i}")
+        assert eng.counters.mixed_subject_batches >= 1
+
+        # Single-subject single request (the degenerate composition).
+        p1 = rng.normal(scale=0.4, size=(3, 16, 3)).astype(np.float32)
+        got = eng.forward(p1, subject=keys[0])
+        want = np.asarray(core.jit_forward_posed_batched(
+            shaped[0], jnp.asarray(pad_rows(p1, 4))).verts)[:3]
+        np.testing.assert_array_equal(got, want)
+
+        # Interleaved full-path and pose-only: kinds never share a
+        # batch, every future resolves correctly.
+        d0 = eng.counters.dispatches
+        pf = rng.normal(scale=0.4, size=(2, 16, 3)).astype(np.float32)
+        sf = rng.normal(size=(2, 10)).astype(np.float32)
+        futs = _prestuffed(eng, [
+            (pf, {}), (p1, {"subject": keys[1]}),
+            (pf, {"shape": sf}), (p1, {"subject": keys[2]})])
+        full_want = np.asarray(core.jit_forward_batched(
+            params32, jnp.asarray(pf),
+            jnp.zeros((2, 10), jnp.float32)).verts)
+        np.testing.assert_array_equal(futs[0].result(timeout=60.0),
+                                      full_want)
+        full_want2 = np.asarray(core.jit_forward_batched(
+            params32, jnp.asarray(pf), jnp.asarray(sf)).verts)
+        np.testing.assert_array_equal(futs[2].result(timeout=60.0),
+                                      full_want2)
+        for i, k in ((1, 1), (3, 2)):
+            got = futs[i].result(timeout=60.0)
+            want = np.asarray(core.jit_forward_posed_batched(
+                shaped[k], jnp.asarray(pad_rows(p1, 8))).verts)[:3]
+            np.testing.assert_array_equal(got, want)
+        # 2 pose-only requests (6 rows -> one b8 batch) + 2 full
+        # requests (4 rows -> one b4 batch): exactly two dispatches.
+        assert eng.counters.dispatches - d0 == 2
+
+        # Oversize still refuses by name at submit.
+        big = rng.normal(scale=0.4, size=(17, 16, 3)).astype(np.float32)
+        with pytest.raises(ValueError, match="exceeds the largest"):
+            eng.submit(big, subject=keys[0])
+
+
+def test_engine_overflow_parks_counts_and_dispatches(params32):
+    """Genuine overflow: the overhang parks on _pending (counted) and
+    leads the NEXT batch — nothing is lost, nothing starves."""
+    rng = np.random.default_rng(31)
+    betas = _betas(2, seed=31)
+    with ServingEngine(params32, max_bucket=8, max_delay_s=0.0) as eng:
+        keys = [eng.specialize(b) for b in betas]
+        eng.warmup_posed()
+        poses = [rng.normal(scale=0.4, size=(n, 16, 3)).astype(np.float32)
+                 for n in (5, 2, 6)]          # 5+2 fit b8; 6 overflows
+        futs = _prestuffed(eng, [
+            (p, {"subject": keys[i % 2]}) for i, p in enumerate(poses)])
+        for p, f in zip(poses, futs):
+            assert f.result(timeout=60.0).shape == (p.shape[0], 778, 3)
+        assert eng.counters.coalesce_overflows >= 1
+        assert eng.counters.dispatches == 2
+
+
+def test_engine_lru_eviction_and_rebake(params32):
+    """Above max_subjects the LRU subject's row is evicted (counted,
+    no recompile — the table is a runtime arg) and a later submit for
+    it transparently re-bakes, still bit-correct."""
+    betas = _betas(3, seed=41)
+    rng = np.random.default_rng(41)
+    with ServingEngine(params32, max_bucket=8, max_subjects=2) as eng:
+        k0, k1 = eng.specialize(betas[0]), eng.specialize(betas[1])
+        eng.warmup_posed()
+        warm = eng.counters.compiles
+        p = rng.normal(scale=0.4, size=(2, 16, 3)).astype(np.float32)
+        eng.forward(p, subject=k0)     # k0 most recently USED
+        k2 = eng.specialize(betas[2])  # evicts k1 (LRU)
+        assert eng.counters.specializations_evicted == 1
+        assert eng.counters.compiles == warm   # eviction != recompile
+        with eng._exe_lock:
+            assert k1 not in eng._subject_slots
+            assert k1 in eng._subject_betas    # betas survive eviction
+        # The evicted subject still serves: its row re-bakes at
+        # dispatch (one more specialization, k0 evicted in turn).
+        got = eng.forward(p, subject=k1)
+        shaped1 = core.jit_specialize(params32, jnp.asarray(betas[1]))
+        want = np.asarray(core.jit_forward_posed_batched(
+            shaped1, jnp.asarray(pad_rows(p, 2))).verts)
+        np.testing.assert_array_equal(got, want)
+        assert eng.counters.specializations_evicted == 2
+        assert eng.counters.compiles == warm
+        assert eng.counters.specializations == 4  # 3 subjects + 1 rebake
+        eng.forward(p, subject=k2)     # k2 still resident
+    snap = eng.counters.snapshot()
+    assert snap["specializations_evicted"] == 2
+    assert snap["table_growths"] == 0  # capacity pinned by max_subjects
+
+
+def test_engine_table_growth_counted_zero_steady_recompiles(params32):
+    """Capacity doubles past the initial 8 rows: growths are counted,
+    the warm gathered executables are rebuilt ONCE per growth (counted
+    compiles), and steady traffic afterwards compiles nothing."""
+    betas = _betas(9, seed=51)
+    rng = np.random.default_rng(51)
+    with ServingEngine(params32, max_bucket=8, max_subjects=64) as eng:
+        keys = [eng.specialize(b) for b in betas[:8]]
+        eng.warmup_posed([4, 8])
+        warm = eng.counters.compiles
+        assert eng.counters.table_growths == 0     # 8 fit the initial 8
+        keys.append(eng.specialize(betas[8]))      # 9th: capacity 8->16
+        assert eng.counters.table_growths == 1
+        # The growth retraced the two warm gather buckets eagerly.
+        assert eng.counters.compiles == warm + 2
+        warm = eng.counters.compiles
+        for seed in range(3):     # steady mixed traffic, warm buckets only
+            for n in (3, 7):
+                p = rng.normal(scale=0.4,
+                               size=(n, 16, 3)).astype(np.float32)
+                got = eng.forward(p, subject=keys[(seed * 3 + n) % 9])
+                assert got.shape == (n, 778, 3)
+        assert eng.counters.compiles == warm       # ZERO steady
+        assert eng.counters.specializations_evicted == 0
+
+
+def test_counters_snapshot_has_coalesce_fields():
+    from mano_hand_tpu.utils.profiling import ServingCounters
+
+    c = ServingCounters()
+    snap = c.snapshot()
+    for k in ("requests_dispatched", "mixed_subject_batches",
+              "coalesce_overflows", "specializations_evicted",
+              "table_growths", "coalesce_width_mean"):
+        assert k in snap and snap[k] == 0 or snap[k] == 0.0
+    c.count_dispatch(8, 6, requests=3, subjects=2)
+    c.count_dispatch(4, 4, requests=1, subjects=1)
+    c.count_overflow()
+    c.count_evict()
+    c.count_table_growth()
+    snap = c.snapshot()
+    assert snap["requests_dispatched"] == 4
+    assert snap["mixed_subject_batches"] == 1
+    assert snap["coalesce_overflows"] == 1
+    assert snap["specializations_evicted"] == 1
+    assert snap["table_growths"] == 1
+    assert snap["coalesce_width_mean"] == 2.0
+
+
+def test_coalesce_bench_run_smoke(params32):
+    """The shared config9 protocol end to end at tiny sizes: the
+    criteria fields are present, the gathered path probes bitwise, and
+    steady state recompiles nothing."""
+    from mano_hand_tpu.serving.measure import coalesce_bench_run
+
+    out = coalesce_bench_run(params32, subjects=3, requests=12,
+                             max_rows=2, max_bucket=8, trials=2, seed=5)
+    assert out["gather_vs_posed_max_abs_err"] == 0.0
+    assert out["steady_recompiles"] == 0
+    assert out["subjects"] == 3 and out["requests"] == 12
+    assert out["engine_vs_split_ratio"] > 0
+    assert out["coalesce_width_mean"] >= 1.0

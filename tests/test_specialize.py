@@ -180,10 +180,13 @@ def test_engine_subject_cache_and_zero_recompiles(params32):
                 assert got.shape == (n, 778, 3)
                 # Bit-identical to the direct pose-only program at the
                 # same padded size (same program family — the
-                # engine-contract analogue of the full path's test).
+                # engine-contract analogue of the full path's test; the
+                # gathered dispatch preserves it, see
+                # core.forward_posed_gather). The reference ShapedHand
+                # is re-baked by the same jitted program the engine used.
                 b = bucket_for(n, eng.buckets)
                 want = np.asarray(core.jit_forward_posed_batched(
-                    eng._shaped[s1],
+                    core.jit_specialize(params32, jnp.asarray(beta1)),
                     jnp.asarray(pad_rows(pose, b))).verts)[:n]
                 np.testing.assert_array_equal(got, want)
                 # ... and rounding-level vs the full path.
