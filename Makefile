@@ -20,20 +20,23 @@
 # do not remove it. Also avoid two concurrent pytest processes on the
 # shared cache dir.
 .PHONY: check check-cold test bench-cpu bench-tpu-wait mesh-scaling \
-	check-quick serve-smoke specialize-smoke chaos-smoke coalesce-smoke
+	check-quick serve-smoke specialize-smoke chaos-smoke coalesce-smoke \
+	overload-smoke
 
-check: test chaos-smoke coalesce-smoke
+check: test chaos-smoke coalesce-smoke overload-smoke
 
 # tests/test_runtime.py is excluded here and covered by the chaos-smoke
 # prerequisite instead (its own pytest process + cache dir): `make
 # check` would otherwise pay the real-time deadline/backoff/hang sleeps
 # of the chaos matrix twice. tests/test_serving_coalesce.py is likewise
-# covered by coalesce-smoke (same pattern, its own cache dir). A bare
-# `pytest tests/` (e.g. the tier-1 verify command) still collects both.
+# covered by coalesce-smoke, and tests/test_overload.py by
+# overload-smoke (same pattern, their own cache dirs). A bare
+# `pytest tests/` (e.g. the tier-1 verify command) still collects all.
 test:
 	TF_CPP_MIN_LOG_LEVEL=3 python -m pytest tests/ -q \
 	  --ignore=tests/test_runtime.py \
-	  --ignore=tests/test_serving_coalesce.py
+	  --ignore=tests/test_serving_coalesce.py \
+	  --ignore=tests/test_overload.py
 
 # Seconds-scale pre-commit lane: the core-correctness modules (parity vs
 # the f64 oracle, assets/IO, golden demo, device lock, and the serving
@@ -77,7 +80,8 @@ bench-interpret:
 	  --init-retries 2 --sil-size 16 --serving-requests 64 \
 	  --serving-max-rows 16 --serving-max-bucket 32 \
 	  --spec-batch 64 --spec-fit-batch 8 --recovery-requests 6 \
-	  --coalesce-subjects 8 --coalesce-requests 48 --coalesce-max-bucket 32
+	  --coalesce-subjects 8 --coalesce-requests 48 --coalesce-max-bucket 32 \
+	  --overload-bursts 16
 
 # Serving-leg smoke (the bench-interpret counterpart for config7): the
 # whole serving-engine plumbing — bucket warm-up, ragged request stream,
@@ -124,6 +128,17 @@ chaos-smoke:
 coalesce-smoke:
 	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_coalesce \
 	  python -m pytest tests/test_serving_coalesce.py -q
+
+# Overload/admission matrix (the PR-5 tentpole): bounded admission +
+# tier quotas (shed without a device dispatch), per-request deadline
+# plumbing (expiry at submit / parked / failover), the submit-vs-stop
+# race, backpressure load(), and a small end-to-end saturation drill.
+# Wired into `make check` as a SEPARATE pytest process on its own
+# compile-cache dir (the CLAUDE.md rule: two pytest processes must
+# never share .jax_compile_cache/).
+overload-smoke:
+	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_overload \
+	  python -m pytest tests/test_overload.py -q
 
 # Unattended BUILDER-side TPU bench: lockfile-guarded, stands down for the
 # driver's priority claim, and self-expires (default 3 h) — see

@@ -579,7 +579,8 @@ def run_benchmarks(args, device_str: str) -> dict:
             return
         if args.serving_only and name not in ("config7_serving",
                                               "config7_recovery",
-                                              "config9_coalesce"):
+                                              "config9_coalesce",
+                                              "config10_overload"):
             return
         try:
             fn()
@@ -2035,10 +2036,48 @@ def run_benchmarks(args, device_str: str) -> dict:
     if args.coalesce_subjects > 0:
         section("config9_coalesce", config9_coalesce)
 
+    # -- config 10: overload/saturation drill (PR 5) ------------------------
+    # THE shared protocol (serving/measure.py:overload_drill_run — also
+    # behind `mano serve-bench --overload`): a burst submitter drives a
+    # bounded-admission, deadline-carrying engine at N x its MEASURED
+    # service rate (the device half throttled by a chaos "sat" plan, so
+    # saturation is deterministic and no chip is harmed). Criteria
+    # (scripts/bench_report.py): every future resolves within its
+    # deadline budget as result/shed/expired, shed decisions touch no
+    # device (the max_queued=0 probe), tier-0 goodput >= 95% at 4x
+    # achieved saturation, zero steady recompiles. Rides in the
+    # readback tail for the same D2H reason as config7; every criterion
+    # is CPU-defined.
+    def config10_overload():
+        from mano_hand_tpu.serving.measure import overload_drill_run
+
+        ov = overload_drill_run(
+            right,
+            saturation=args.overload_saturation,
+            bursts=args.overload_bursts,
+            seed=13,
+            log=lambda m: log(f"config10 {m}"),
+        )
+        results["overload"] = ov
+        log(f"config10 overload: {ov['submitted']} submitted at "
+            f"{ov['saturation_achieved']}x achieved saturation "
+            f"({ov['offered_rate_req_per_s']:,.0f} offered vs "
+            f"{ov['service_rate_req_per_s']:,.0f} served req/s), "
+            f"{ov['resolved_within_budget_fraction']:.0%} in budget, "
+            f"tier-0 goodput {ov['tier0_goodput']}, "
+            f"{ov['outcomes']['shed']} shed / "
+            f"{ov['outcomes']['expired']} expired, shed decision p50 "
+            f"{ov['shed_probe']['decision_p50_us']} µs, "
+            f"{ov['steady_recompiles']} steady recompiles")
+
+    if args.overload_saturation > 0:
+        section("config10_overload", config10_overload)
+
     if args.serving_only:
         # Fast serving-layer artifact (`make serve-smoke`): the deferred
         # runner's serving-only skip reduces the schedule to config7
-        # (+ the recovery drill and the config9 coalescing leg).
+        # (+ the recovery drill, the config9 coalescing leg and the
+        # config10 overload drill).
         for name, fn in _registered:
             run_section(name, fn)
         srv = results.get("serving", {})
@@ -2269,9 +2308,9 @@ def main() -> int:
                          "the leg's warm-up compiles)")
     ap.add_argument("--serving-only", action="store_true",
                     help="run ONLY the serving-engine leg, the "
-                         "fault-recovery drill and the mixed-subject "
-                         "coalescing leg (fast serving-layer "
-                         "artifact; `make serve-smoke`)")
+                         "fault-recovery drill, the mixed-subject "
+                         "coalescing leg and the overload drill (fast "
+                         "serving-layer artifact; `make serve-smoke`)")
     ap.add_argument("--coalesce-subjects", type=int, default=12,
                     help="distinct baked subjects in the mixed-subject "
                          "coalescing leg (config9; >= 8 engages the "
@@ -2292,6 +2331,15 @@ def main() -> int:
                     help="requests per fault class in the recovery "
                          "drill (config7_recovery; faults are injected "
                          "in-process, no chip involved)")
+    ap.add_argument("--overload-saturation", type=float, default=4.0,
+                    help="offered-load multiple of the MEASURED service "
+                         "rate in the overload drill (config10; the "
+                         "done-criteria are judged at >= 4x achieved; "
+                         "0 skips the leg)")
+    ap.add_argument("--overload-bursts", type=int, default=40,
+                    help="arrival bursts in the overload drill "
+                         "(config10; one burst per 10 ms — saturation "
+                         "is throttled in-process, no chip involved)")
     ap.add_argument("--spec-batch", type=int, default=256,
                     help="batch for the specialization leg's full-vs-"
                          "pose-only forward comparison (config8); "

@@ -949,7 +949,9 @@ def cmd_serve_bench(args) -> int:
     dispatch (``runtime/``), or runs the full recovery drill with
     ``--chaos drill``; ``--subjects N`` switches to the mixed-subject
     coalescing protocol (bench.py config9's
-    ``serving.measure.coalesce_bench_run``)."""
+    ``serving.measure.coalesce_bench_run``); ``--overload`` runs the
+    overload/saturation drill (bench.py config10's
+    ``serving.measure.overload_drill_run``)."""
     import os
 
     import jax
@@ -1001,6 +1003,33 @@ def cmd_serve_bench(args) -> int:
                   name="serve-bench-watchdog").start()
     if args.emit_by < 0 and jax.default_backend() == "cpu":
         wd.disarm()  # auto mode: no tunnel to guard against on cpu
+
+    if args.overload:
+        # The overload/saturation drill (the same protocol as bench.py
+        # config10: serving/measure.py:overload_drill_run — bounded
+        # admission + per-request deadlines + priority shedding at N x
+        # the measured service rate), one JSON line of drill metrics,
+        # judged by scripts/bench_report.py.
+        if (args.chaos or args.subjects > 0 or args.aot_dir
+                or args.deadline_s is not None):
+            # Same policy as the other composition guards: the drill
+            # fixes its own protocol (its own chaos saturation plan,
+            # its own bounded engine, its own request TTL) — refuse
+            # rather than silently not run what the caller asked for
+            # (--deadline-s is the --chaos per-batch knob; the drill's
+            # request TTL is a protocol constant).
+            print("--overload fixes its own protocol and does not "
+                  "compose with --chaos, --subjects, --aot-dir, or "
+                  "--deadline-s", file=sys.stderr)
+            return 2
+        from mano_hand_tpu.serving.measure import overload_drill_run
+
+        out = overload_drill_run(
+            params, saturation=args.overload_saturation, seed=args.seed,
+            log=lambda m: print(m, file=sys.stderr))
+        out["backend"] = jax.default_backend()
+        print(json.dumps(out))
+        return 0
 
     if args.chaos == "drill":
         # The full fault-recovery drill (the same protocol as bench.py
@@ -1436,6 +1465,21 @@ def build_parser() -> argparse.ArgumentParser:
                          "stream through the gathered engine dispatch, "
                          "measured against the per-subject-split "
                          "baseline. 0 = the classic full-path protocol")
+    sb.add_argument("--overload", action="store_true",
+                    help="run the OVERLOAD/saturation drill instead "
+                         "(serving/measure.py:overload_drill_run, "
+                         "shared with bench.py config10): bounded "
+                         "admission + per-request deadlines + priority "
+                         "shedding under a burst submitter at "
+                         "--overload-saturation x the measured service "
+                         "rate, one JSON line judged by "
+                         "scripts/bench_report.py. Saturation is "
+                         "throttled in-process (chaos 'sat' plan) — no "
+                         "chip required, none harmed")
+    sb.add_argument("--overload-saturation", type=float, default=4.0,
+                    help="offered-load multiple of the measured "
+                         "service rate for --overload (criteria are "
+                         "judged at >= 4x achieved)")
     sb.add_argument("--seed", type=int, default=0)
     sb.set_defaults(fn=cmd_serve_bench)
 

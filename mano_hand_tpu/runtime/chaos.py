@@ -24,12 +24,22 @@ Plan spec grammar (``parse_plan``) — comma-separated events::
                      ARGUMENT" marker — the compile-error class that
                      must NOT be retried)
            latency   sleep PARAM seconds, then run the call
+           sat       saturation throttle: sleep PARAM seconds, then run
+                     the call — mechanically a latency event, but named
+                     for its role: an OPEN-ended sat plan ("sat:T@0-")
+                     models the slow-device half of an overload (every
+                     dispatch pays T, so device throughput is capped
+                     and a sustained arrival rate above it grows the
+                     backlog). The arrival-burst half lives in the
+                     DRIVER (serving/measure.py:overload_drill_run's
+                     burst submitter) — chaos wraps device calls, so it
+                     can slow the service rate but cannot generate load
            wrong     run the call, return the result + PARAM (default
                      1.0): the silent-corruption mode that motivates
                      probing numerics in the shipped compilation
                      context (CLAUDE.md rule)
     SEL    N         exactly call index N (0-based)
-           N-M       calls N..M inclusive
+           N-M       calls N..M inclusive (N <= M)
            N-        every call from N onward (a persistent outage)
            *         every call
 
@@ -37,7 +47,15 @@ Plan spec grammar (``parse_plan``) — comma-separated events::
     "hang@2"               call 2 wedges
     "error@0-"             persistent outage (never self-clears)
     "latency:0.2@1-3"      200 ms spikes on calls 1-3
+    "sat:0.02@0-"          every dispatch throttled 20 ms (saturation)
     "wrong:0.5@4"          call 4 silently returns verts + 0.5
+
+    Specs are VALIDATED at parse time: unknown kinds, malformed or
+    misplaced ``:PARAM`` (hang/error/fatal take none; latency/sat
+    require a non-negative one), non-integer or negative selector
+    indices, and inverted ranges (``N-M`` with N > M, which can match
+    no call) all raise ``ValueError`` with the offending token — a
+    typo'd plan must fail the run, not silently inject nothing.
 
 ``schedule(spec)`` swaps the event list and resets the call index, so
 one long-lived engine can be driven through a whole fault matrix
@@ -84,26 +102,68 @@ class FaultEvent:
         return f"FaultEvent({self.kind}@{sel}, param={self.param})"
 
 
-_KINDS = ("hang", "error", "fatal", "latency", "wrong")
+_KINDS = ("hang", "error", "fatal", "latency", "sat", "wrong")
+# Which kinds take a ':PARAM' — and whether they REQUIRE one. A param on
+# a kind that ignores it ("hang:2@0") is a typo'd latency/sat plan that
+# would otherwise silently inject the wrong fault class.
+_PARAM_REQUIRED = ("latency", "sat")
+_PARAM_ALLOWED = ("latency", "sat", "wrong")
+
+
+def _parse_index(text: str, token: str) -> int:
+    try:
+        idx = int(text)
+    except ValueError:
+        raise ValueError(
+            f"chaos event {token!r}: selector index {text!r} is not an "
+            "integer (expected N, N-M, N-, or *)") from None
+    if idx < 0:
+        raise ValueError(
+            f"chaos event {token!r}: selector index {idx} is negative "
+            "(call indices are 0-based)")
+    return idx
 
 
 def _parse_event(token: str) -> FaultEvent:
     head, _, sel = token.partition("@")
     if not sel:
         raise ValueError(f"chaos event {token!r} lacks '@SELECTOR'")
-    kind, _, param_s = head.partition(":")
+    kind, colon, param_s = head.partition(":")
     if kind not in _KINDS:
         raise ValueError(f"unknown chaos kind {kind!r} (one of {_KINDS})")
-    if kind == "latency" and not param_s:
-        raise ValueError("latency events need ':SECONDS' (e.g. latency:0.2)")
-    param = float(param_s) if param_s else (1.0 if kind == "wrong" else 0.0)
+    if kind in _PARAM_REQUIRED and not param_s:
+        raise ValueError(
+            f"{kind} events need ':SECONDS' (e.g. {kind}:0.2)")
+    if colon and kind not in _PARAM_ALLOWED:
+        raise ValueError(
+            f"chaos event {token!r}: {kind} takes no ':PARAM' "
+            f"(only {_PARAM_ALLOWED} do)")
+    if param_s:
+        try:
+            param = float(param_s)
+        except ValueError:
+            raise ValueError(
+                f"chaos event {token!r}: param {param_s!r} is not a "
+                "number") from None
+        if kind in _PARAM_REQUIRED and param < 0:
+            raise ValueError(
+                f"chaos event {token!r}: {kind} seconds must be >= 0")
+    else:
+        param = 1.0 if kind == "wrong" else 0.0
     if sel == "*":
         return FaultEvent(kind, 0, None, param)
     lo, dash, hi = sel.partition("-")
-    start = int(lo)
+    start = _parse_index(lo, token)
     if not dash:
         return FaultEvent(kind, start, start, param)
-    return FaultEvent(kind, start, int(hi) if hi else None, param)
+    if not hi:
+        return FaultEvent(kind, start, None, param)
+    stop = _parse_index(hi, token)
+    if stop < start:
+        raise ValueError(
+            f"chaos event {token!r}: range {start}-{stop} is inverted "
+            "and would match no call")
+    return FaultEvent(kind, start, stop, param)
 
 
 class ChaosPlan:
@@ -191,7 +251,9 @@ class ChaosPlan:
                 raise InjectedFault(
                     f"chaos: INVALID_ARGUMENT injected deterministic "
                     f"failure at call {idx}", transient=False)
-            if ev.kind == "latency":
+            if ev.kind in ("latency", "sat"):
+                # sat is semantically a sustained throughput throttle;
+                # mechanically both sleep, then run the call.
                 time.sleep(ev.param)
                 return fn(*args, **kwargs)
             # wrong: silent corruption — runs the call, skews the result.

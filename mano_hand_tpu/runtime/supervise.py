@@ -151,12 +151,14 @@ def supervised_call(
     backoff_s: float = 0.05,
     backoff_cap_s: float = 2.0,
     jitter: float = 0.5,
+    give_up_by: Optional[float] = None,
     classify: Callable[[BaseException], str] = classify_failure,
     keep_trying: Optional[Callable[[], bool]] = None,
     on_retry: Optional[Callable] = None,
     on_deadline_kill: Optional[Callable] = None,
     on_attempt_failure: Optional[Callable] = None,
     sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
     name: str = "supervised-call",
 ):
     """THE supervised dispatch primitive: ``fn()`` under a per-attempt
@@ -169,11 +171,19 @@ def supervised_call(
     * ``keep_trying`` (e.g. a circuit breaker's ``allow_primary``) is
       consulted before each retry so an opened breaker short-circuits
       the remaining budget;
+    * ``give_up_by`` (a ``clock()``-domain timestamp — ``time.monotonic``
+      by default) is an END-TO-END bound over ALL attempts: no retry
+      starts past it, and each attempt's deadline is clipped to the
+      remaining budget. The serving engine passes the latest request
+      deadline of the batch here, so supervision never burns retry
+      budget producing a result every caller has already expired out of
+      (PR 5: shedding late work beats serving it);
     * hooks (``on_retry``/``on_deadline_kill``/``on_attempt_failure``)
       feed counters and breakers without coupling this module to them.
 
     Raises the deterministic failure as-is, or ``RetriesExhausted``
-    (carrying ``.cause`` and ``.attempts``) when the budget runs out.
+    (carrying ``.cause`` and ``.attempts``) when the budget runs out —
+    including when ``give_up_by`` cut it short.
     """
     last: Optional[BaseException] = None
     attempts = 0
@@ -181,13 +191,34 @@ def supervised_call(
         if attempt > 0:
             if keep_trying is not None and not keep_trying():
                 break
-            if on_retry is not None:
-                on_retry()
+            if give_up_by is not None and clock() >= give_up_by:
+                # The whole-call budget is spent: a retry now could only
+                # finish after every consumer's deadline. RetriesExhausted
+                # below carries the last transient cause.
+                break
             sleep(backoff_delay(attempt - 1, backoff_s, backoff_cap_s,
                                 jitter))
+            if give_up_by is not None and clock() >= give_up_by:
+                # The backoff itself consumed the remaining budget:
+                # launching the attempt now would still start fn() on a
+                # disposable thread (call_with_deadline only bounds the
+                # JOIN) — a real dispatch for a result nobody will read,
+                # and on the tunnel a thread that can wedge in a C-level
+                # RPC. Checked AFTER the sleep so no retry ever starts
+                # past give_up_by, as documented.
+                break
+            if on_retry is not None:
+                on_retry()
         attempts += 1
+        eff_deadline = deadline_s
+        if give_up_by is not None:
+            remaining = give_up_by - clock()
+            # Clip, never extend (the attempt itself starts pre-budget;
+            # only its join window shrinks).
+            eff_deadline = (remaining if eff_deadline is None
+                            else min(eff_deadline, remaining))
         try:
-            return call_with_deadline(fn, deadline_s, name=name)
+            return call_with_deadline(fn, eff_deadline, name=name)
         except DeadlineExceeded as e:
             last = e
             if on_deadline_kill is not None:

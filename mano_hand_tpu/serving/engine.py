@@ -80,6 +80,28 @@ bench.py rides under its own instance of the same class.
   ``max_subjects`` table rows (which ``_resolve_batch`` could never
   pin at once).
 
+* **survives too much traffic** (PR 5): serving millions of users means
+  the arrival rate WILL exceed device throughput sometimes, and an
+  unbounded queue turns that into unbounded backlog and unbounded
+  latency for everyone. The overload layer is three rules, all enforced
+  before chip time is spent: **bounded admission** (``max_queued`` +
+  per-tier quotas) sheds at ``submit`` with a structured
+  ``ServingError(kind="shed")`` in O(µs); **per-request deadlines**
+  (``submit(deadline_s=...)``) ride the request through coalescing,
+  parking, eviction re-bake, and failover, and are swept BEFORE
+  dispatch at every boundary (queue head, coalesce, launch, failover) —
+  chip time is never spent on a result nobody will read, and a result
+  that arrives late resolves as ``kind="expired"`` rather than
+  pretending to be fresh; **priority classes**
+  (``submit(priority=...)``) shed batch tiers first (tier quotas
+  reserve headroom for tier 0) and parked tier-0 requests lead every
+  next batch, so interactive traffic cannot starve. ``load()`` is the
+  backpressure signal callers poll to back off BEFORE the hard shed.
+  The guarantee is measured, not asserted:
+  serving/measure.py:overload_drill_run drives 4x sustained saturation
+  and bench_report judges resolution-within-budget, tier-0 goodput,
+  and zero steady-state recompiles.
+
 Typical use::
 
     eng = ServingEngine(params, max_bucket=256, aot_dir="serve_cache/")
@@ -118,17 +140,28 @@ class ServingError(RuntimeError):
 
     The engine's future-resolution guarantee is "a result or a
     ServingError, within the configured deadline" — never a hang. The
-    fields tell the caller WHICH guarantee fired: ``phase`` is
-    ``"dispatch"`` (the batch failed after supervision was exhausted)
-    or ``"shutdown"`` (``stop()`` found the dispatcher wedged or dead
-    with this request outstanding); ``attempts`` counts primary tries;
-    ``cause`` is the last underlying exception, if any.
+    fields tell the caller WHICH guarantee fired:
+
+    * ``kind`` is the overload-aware discriminator (PR 5):
+      ``"shed"`` — refused at admission (bounded queue / tier quota;
+      an O(µs) bookkeeping decision, no device involved — retry later,
+      see ``ServingEngine.load``); ``"expired"`` — the request's own
+      ``deadline_s`` passed before a result could be delivered (swept
+      without spending chip time wherever possible); ``"error"`` — the
+      dispatch itself failed after supervision was exhausted;
+      ``"shutdown"`` — ``stop()`` found the request outstanding.
+    * ``phase`` names where in the pipeline it fired (``"admission"``,
+      ``"coalesce"``, ``"dispatch"``, ``"failover"``, ``"readback"``,
+      ``"shutdown"``); ``attempts`` counts primary tries; ``cause`` is
+      the last underlying exception, if any.
     """
 
     def __init__(self, message: str, *, phase: str = "dispatch",
-                 attempts: int = 0, cause=None):
+                 kind: Optional[str] = None, attempts: int = 0, cause=None):
         super().__init__(message)
         self.phase = phase
+        self.kind = kind if kind is not None else (
+            "shutdown" if phase == "shutdown" else "error")
         self.attempts = attempts
         self.cause = cause
 
@@ -230,9 +263,10 @@ def build_cpu_fallback_executable(params_host, bucket: int, n_joints: int,
 
 class _Request:
     __slots__ = ("pose", "shape", "rows", "squeeze", "subject", "future",
-                 "t_submit")
+                 "t_submit", "deadline", "tier")
 
-    def __init__(self, pose, shape, rows, squeeze, subject=None):
+    def __init__(self, pose, shape, rows, squeeze, subject=None,
+                 deadline=None, tier=0):
         self.pose = pose
         self.shape = shape          # None on the pose-only (subject) path
         self.rows = rows
@@ -240,6 +274,8 @@ class _Request:
         self.subject = subject      # specialization digest or None (full)
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
+        self.deadline = deadline    # absolute time.monotonic() or None
+        self.tier = tier            # priority class (0 = interactive)
 
 
 class ServingEngine:
@@ -277,6 +313,27 @@ class ServingEngine:
         Supervision trades the double-buffered device overlap for a
         bounded-latency guarantee: each supervised batch is resolved to
         a host array inside its own deadline before the next launches.
+    max_queued: bounded admission (PR 5). None (default) keeps the
+        historical unbounded queue; an int caps OUTSTANDING requests
+        (submitted, not yet resolved — queued, parked, and in flight),
+        and a ``submit`` that would exceed the cap raises a structured
+        ``ServingError(kind="shed")`` in O(µs), without touching the
+        device or even starting the dispatcher. Shedding at the door is
+        the whole defense: a sustained arrival rate above device
+        throughput otherwise grows the backlog — and every caller's
+        latency — without bound, and a stale interactive pose is
+        worthless (PAPER.md §0).
+    tier_quotas: per-priority admission thresholds over the SHARED
+        outstanding count, e.g. ``{1: 16}``: a tier-``t`` submit is
+        shed once outstanding >= its quota. Defaults (requires
+        ``max_queued``): tier 0 may fill the whole queue
+        (``max_queued``), tiers >= 1 only half — so overload sheds low
+        tiers FIRST and the headroom above a low tier's quota is
+        reserved for tier-0 (interactive) traffic by construction.
+        Quotas are clamped to ``max_queued``.
+    busy_fraction: the soft backpressure threshold: ``load()`` reports
+        a tier "busy" (try later) once outstanding crosses this
+        fraction of its quota, before hard shedding begins.
     """
 
     def __init__(
@@ -293,6 +350,9 @@ class ServingEngine:
         counters: Optional[ServingCounters] = None,
         policy=None,
         max_subjects: int = 4096,
+        max_queued: Optional[int] = None,
+        tier_quotas: Optional[dict] = None,
+        busy_fraction: float = 0.75,
     ):
         self._params = params.astype(dtype)
         self._dtype = np.dtype(dtype)
@@ -314,6 +374,25 @@ class ServingEngine:
             raise ValueError(
                 f"max_subjects must be >= 1, got {max_subjects}")
         self.max_subjects = int(max_subjects)
+        if max_queued is not None and max_queued < 0:
+            raise ValueError(
+                f"max_queued must be >= 0 (0 sheds everything), got "
+                f"{max_queued}")
+        self.max_queued = None if max_queued is None else int(max_queued)
+        if tier_quotas is not None and self.max_queued is None:
+            raise ValueError(
+                "tier_quotas require max_queued (quotas are thresholds "
+                "over the bounded outstanding count)")
+        for t, q in (tier_quotas or {}).items():
+            if t < 0 or q < 0:
+                raise ValueError(
+                    f"tier_quotas entries must be non-negative, got "
+                    f"{{{t}: {q}}}")
+        self._tier_quotas = dict(tier_quotas or {})
+        if not 0.0 < busy_fraction <= 1.0:
+            raise ValueError(
+                f"busy_fraction must be in (0, 1], got {busy_fraction}")
+        self.busy_fraction = float(busy_fraction)
         self._params_dev = None        # device-resident params (jit path)
         self._exes: dict = {}          # bucket -> compiled callable
         self._subject_betas: dict = {}  # betas digest -> host [S] array
@@ -621,7 +700,71 @@ class ServingEngine:
                 self._gather_executable(b)
         return out
 
+    # ------------------------------------------------- admission (PR 5)
+    def _quota(self, tier: int) -> int:
+        """Outstanding-count threshold at which tier ``tier`` sheds.
+        Tier 0 defaults to the whole queue; lower-priority tiers to
+        half of it — the gap is tier-0's reserved headroom."""
+        q = self._tier_quotas.get(tier)
+        if q is None:
+            q = self.max_queued if tier <= 0 else self.max_queued // 2
+        return min(q, self.max_queued)
+
+    def load(self) -> dict:
+        """The backpressure signal: a point-in-time load snapshot
+        callers can poll BEFORE submitting (soft "try later"), instead
+        of discovering overload via a shed exception. Per tier:
+        ``"ok"`` (admitting), ``"busy"`` (admitting, but outstanding has
+        crossed ``busy_fraction`` of the tier's quota — back off now
+        and the hard shed may never come), ``"shed"`` (a submit at this
+        instant would raise ``ServingError(kind="shed")``). With
+        admission unbounded (``max_queued=None``) every tier is "ok"
+        and only the observability numbers carry signal."""
+        with self._live_lock:
+            outstanding = len(self._live)
+        queued = self._queue.qsize() + len(self._pending)
+        tiers = {}
+        if self.max_queued is not None:
+            for t in sorted({0, 1} | set(self._tier_quotas)):
+                q = self._quota(t)
+                if outstanding >= q:
+                    state = "shed"
+                elif outstanding >= self.busy_fraction * q:
+                    state = "busy"
+                else:
+                    state = "ok"
+                tiers[str(t)] = state
+        return {
+            "outstanding": outstanding,
+            "queued": queued,
+            "max_queued": self.max_queued,
+            "admission": tiers,
+            "backlog_peak": self.counters.backlog_peak,
+        }
+
+    # --------------------------------------------------- deadlines (PR 5)
+    def _is_expired(self, req: _Request, now: Optional[float] = None,
+                    ) -> bool:
+        return (req.deadline is not None
+                and (time.monotonic() if now is None else now)
+                >= req.deadline)
+
+    def _expire(self, req: _Request, phase: str) -> None:
+        """Resolve one request as ``kind="expired"`` — the sweep that
+        keeps chip time off results nobody will read. Counted once: the
+        ``done()`` guard makes a double sweep (e.g. coalesce then a
+        shutdown drain) a no-op."""
+        if not req.future.done():
+            req.future.set_exception(ServingError(
+                f"request expired before {phase} (deadline_s elapsed "
+                f"{time.monotonic() - req.deadline:.3g}s ago); a stale "
+                "result would not be read, so none was produced",
+                phase=phase, kind="expired"))
+            self.counters.count_expired(req.tier)
+        self._deregister(req)
+
     def submit(self, pose, shape=None, subject: Optional[str] = None,
+               *, priority: int = 0, deadline_s: Optional[float] = None,
                ) -> Future:
         """Enqueue one forward request; returns a Future of the verts.
 
@@ -631,6 +774,18 @@ class ServingEngine:
         the pose-only fast path instead — the baked shape stage is
         reused and only the pose stage runs per call; ``shape`` must be
         omitted there (the subject IS the shape).
+
+        ``priority`` is the admission tier (0 = interactive, >= 1 =
+        batch/fitting): under a bounded queue (``max_queued``) overload
+        sheds high-numbered tiers first — a shed raises a structured
+        ``ServingError(kind="shed")`` HERE, in O(µs), without touching
+        the device (poll ``load()`` to back off before that happens).
+        ``deadline_s`` is this request's end-to-end time-to-live: once
+        it elapses the request resolves to
+        ``ServingError(kind="expired")`` instead of a result, and the
+        engine sweeps it WITHOUT dispatching wherever the expiry is
+        seen pre-dispatch (queue, parked, failover) — an already-
+        expired deadline resolves the returned future immediately.
         """
         pose = np.asarray(pose, self._dtype)
         squeeze = pose.ndim == 2
@@ -678,11 +833,47 @@ class ServingEngine:
                 raise ValueError(
                     f"shape must be [{n}, {self._n_shape}] to match pose, "
                     f"got {shape.shape}")
+        tier = int(priority)
+        if tier < 0:
+            raise ValueError(f"priority must be >= 0, got {priority}")
         if self._failure is not None:
             raise RuntimeError(
                 "serving engine dispatcher died") from self._failure
-        req = _Request(pose, shape, n, squeeze, subject)
-        self._register(req)
+        self.counters.count_tier_submit(tier)
+        deadline = (None if deadline_s is None
+                    else time.monotonic() + float(deadline_s))
+        req = _Request(pose, shape, n, squeeze, subject,
+                       deadline=deadline, tier=tier)
+        if deadline is not None and float(deadline_s) <= 0:
+            # Born expired: resolve the future right here — no
+            # registration, no queue slot, no dispatch (the satellite
+            # edge case; count_expired keeps it observable).
+            self._expire(req, "admission")
+            return req.future
+        if self.max_queued is not None:
+            # Admission check ATOMIC with registration (one _live_lock
+            # hold): concurrent submitters cannot both squeeze past the
+            # same last slot, so the bound is a bound, not a hint. The
+            # whole decision is dict bookkeeping — O(µs), no device.
+            quota = self._quota(tier)
+            with self._live_lock:
+                outstanding = len(self._live)
+                admitted = outstanding < quota
+                if admitted:
+                    self._live[id(req)] = req
+                    outstanding += 1
+            if not admitted:
+                self.counters.count_shed(tier)
+                raise ServingError(
+                    f"admission shed: {outstanding} outstanding >= "
+                    f"tier-{tier} quota {quota} "
+                    f"(max_queued={self.max_queued}); the engine is "
+                    "over capacity for this priority class — poll "
+                    "load() and retry later",
+                    phase="admission", kind="shed")
+            self.counters.observe_backlog(outstanding)
+        else:
+            self.counters.observe_backlog(self._register(req))
         self.start()
         self._queue.put(req)
         if self._failure is not None:
@@ -694,10 +885,13 @@ class ServingEngine:
                 "serving engine dispatcher died") from self._failure
         return req.future
 
-    def forward(self, pose, shape=None,
-                subject: Optional[str] = None) -> np.ndarray:
+    def forward(self, pose, shape=None, subject: Optional[str] = None,
+                *, priority: int = 0,
+                deadline_s: Optional[float] = None) -> np.ndarray:
         """Synchronous convenience: ``submit(...).result()``."""
-        return self.submit(pose, shape, subject=subject).result()
+        return self.submit(pose, shape, subject=subject,
+                           priority=priority,
+                           deadline_s=deadline_s).result()
 
     def warmup(self, bucket_list: Optional[Sequence[int]] = None) -> dict:
         """Build (or AOT-load) executables for the given buckets up front.
@@ -931,6 +1125,12 @@ class ServingEngine:
         subjects = {first.subject} if posed else set()
 
         def admit(nxt, fresh=True) -> Optional[str]:
+            if self._is_expired(nxt):
+                # The pre-dispatch deadline sweep (PR 5): an expired
+                # request is resolved HERE — never batched, never
+                # parked, never costing a device row.
+                self._expire(nxt, "coalesce")
+                return "expired"
             why = self._admit(nxt, posed, subjects, rows)
             if why is None:
                 reqs.append(nxt)
@@ -976,12 +1176,26 @@ class ServingEngine:
                 break
         return reqs, rows
 
+    def _pop_parked(self) -> _Request:
+        """Take the highest-priority (lowest-tier) parked request,
+        earliest-parked among ties. Parked requests already lead the
+        next batches (the anti-starvation rule); under priority classes
+        the lead goes to tier 0 FIRST, so a parked interactive request
+        can never starve behind parked batch work either."""
+        best = 0
+        for i in range(1, len(self._pending)):
+            if self._pending[i].tier < self._pending[best].tier:
+                best = i
+        req = self._pending[best]
+        del self._pending[best]
+        return req
+
     def _dispatch_loop(self) -> None:
         inflight: collections.deque = collections.deque()
         try:
             while True:
                 if self._pending:
-                    first = self._pending.popleft()
+                    first = self._pop_parked()
                 else:
                     try:
                         # With work in flight, never WAIT on the queue:
@@ -997,6 +1211,12 @@ class ServingEngine:
                 if first is _SENTINEL:
                     if not self._running:
                         break
+                    continue
+                if self._is_expired(first):
+                    # Deadline sweep at the head of batch assembly: an
+                    # expired request (sat queued or parked too long)
+                    # resolves without a dispatch.
+                    self._expire(first, "dispatch")
                     continue
                 self.counters.observe_queue_depth(
                     self._queue.qsize() + len(self._pending) + 1)
@@ -1026,6 +1246,24 @@ class ServingEngine:
             raise
 
     def _launch(self, reqs, rows):
+        # Final deadline sweep at the launch boundary: coalescing can
+        # hold a batch for max_delay_s (and a predecessor batch can hold
+        # the loop far longer), so re-check each member NOW — the last
+        # instant a sweep still costs zero chip time. An all-expired
+        # batch dispatches nothing at all.
+        if any(r.deadline is not None for r in reqs):
+            now = time.monotonic()
+            alive = []
+            for r in reqs:
+                if self._is_expired(r, now):
+                    self._expire(r, "dispatch")
+                else:
+                    alive.append(r)
+            if not alive:
+                return None
+            if len(alive) != len(reqs):
+                reqs = alive
+                rows = sum(r.rows for r in reqs)
         try:
             bucket = bucket_mod.bucket_for(rows, self.buckets)
             if len(reqs) == 1:
@@ -1105,6 +1343,13 @@ class ServingEngine:
             exe = self._executable(bucket)
             primary = lambda: np.asarray(exe(pose, shape))   # noqa: E731
 
+        # End-to-end deadline plumbing (PR 5): supervision gives up once
+        # every request in the batch has expired — a retry or failover
+        # past the LATEST member deadline produces a result nobody will
+        # read. Any member without a deadline keeps the budget unbounded.
+        deadlines = [r.deadline for r in reqs]
+        give_up_by = (None if any(d is None for d in deadlines)
+                      else max(deadlines))
         last = None
         attempts = 0
         if breaker is None or breaker.allow_primary():
@@ -1116,6 +1361,7 @@ class ServingEngine:
                     backoff_s=pol.backoff_s,
                     backoff_cap_s=pol.backoff_cap_s,
                     jitter=pol.jitter,
+                    give_up_by=give_up_by,
                     keep_trying=(breaker.allow_primary
                                  if breaker is not None else None),
                     on_retry=self.counters.count_retry,
@@ -1129,6 +1375,26 @@ class ServingEngine:
                 return out
             except supervise.RetriesExhausted as e:
                 last, attempts = e.cause, e.attempts
+        # Deadline sweep at the post-primary boundary: the primary
+        # attempts may have consumed the batch's whole deadline budget
+        # (give_up_by kills the attempt at the LATEST member deadline,
+        # so by then every member has expired), and an expired request
+        # must not buy a fallback dispatch — nor resolve as
+        # kind="error" when the only thing that failed is its own
+        # deadline. Runs with cpu_fallback on OR off: each member
+        # resolves as expired and the batch-level error reaches only
+        # already-done futures (_poison's done() guard makes it a
+        # no-op).
+        now = time.monotonic()
+        if all(self._is_expired(r, now) for r in reqs):
+            for r in reqs:
+                self._expire(r, "failover")
+            raise ServingError(
+                f"every request in the batch expired during the "
+                f"primary attempts ({attempts}); no further dispatch "
+                "attempted — no caller would read the result",
+                phase="failover", kind="expired",
+                attempts=attempts, cause=last)
         if pol.cpu_fallback:
             self.counters.count_failover()
             if table is not None:
@@ -1171,12 +1437,21 @@ class ServingEngine:
             self._poison(reqs, e)  # same reasoning as _launch
             raise
         now = time.perf_counter()
+        mono = time.monotonic()
         lo = 0
         for r in reqs:
             piece = verts[lo:lo + r.rows]
             lo += r.rows
+            if self._is_expired(r, mono):
+                # The result exists but arrived past the request's own
+                # deadline: a stale pose is worthless (PAPER.md §0), so
+                # the contract stays "a result WITHIN the deadline, or
+                # expired" — never a late result that looks fresh.
+                self._expire(r, "readback")
+                continue
             if not r.future.done():  # a shutdown sweep can win the race
                 r.future.set_result(piece[0] if r.squeeze else piece)
+                self.counters.count_served(r.tier)
             self._deregister(r)
             self.counters.record_latency(bucket, now - r.t_submit)
 
@@ -1186,9 +1461,13 @@ class ServingEngine:
     # resolver for a wedged/dead dispatcher. The invariant under test
     # (tests/test_runtime.py): no future handed out by submit() can ever
     # be waited on forever.
-    def _register(self, req: _Request) -> None:
+    def _register(self, req: _Request) -> int:
+        """Returns the post-insert outstanding count (one lock hold —
+        the unbounded submit path feeds it to observe_backlog without
+        a second acquisition)."""
         with self._live_lock:
             self._live[id(req)] = req
+            return len(self._live)
 
     def _deregister(self, req: _Request) -> None:
         with self._live_lock:

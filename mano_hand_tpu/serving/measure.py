@@ -363,6 +363,299 @@ def coalesce_bench_run(
     }
 
 
+def overload_drill_run(
+    params,
+    *,
+    saturation: float = 4.0,
+    bursts: int = 40,
+    burst_interval_s: float = 0.01,
+    tier0_fraction: float = 0.125,
+    # Defaults sized for this box's load drift (5x between seconds,
+    # CLAUDE.md): an admitted request's worst-case queue wait is
+    # max_queued / service_rate (~135 ms healthy at the measured ~300
+    # req/s), so deadline_s=0.4 keeps tier-0 goodput green through a
+    # ~3x transient service collapse while still expiring work a real
+    # tracker would consider stale.
+    max_queued: int = 40,
+    tier1_quota: int = 14,
+    deadline_s: float = 0.4,
+    sat_latency_s: float = 0.02,
+    max_bucket: int = 8,
+    batch_deadline_s: float = 0.5,
+    shed_probe_submits: int = 256,
+    seed: int = 0,
+    log: Callable[[str], None] = None,
+) -> dict:
+    """THE overload/saturation drill protocol — shared by ``bench.py``
+    config10, `mano serve-bench --overload`, and tests/test_overload.py
+    so the three artifacts cannot diverge (the recovery-drill pattern).
+
+    The scenario PR 5 exists for: a sustained arrival rate ABOVE device
+    throughput. The device half is simulated with a chaos saturation
+    plan (``sat:T@0-`` throttles every dispatch, capping service rate
+    deterministically on CPU); the arrival half is a burst submitter
+    (every ``burst_interval_s``, a burst sized to ``saturation`` x the
+    MEASURED service rate — calibrated in-protocol, so "4x" means 4x
+    this box today, not a guess). Two priority tiers ride the stream:
+    tier 0 (interactive, ``tier0_fraction`` of arrivals — deliberately
+    under capacity on its own) and tier 1 (batch), against a bounded
+    engine (``max_queued`` total, ``tier1_quota`` for tier 1) with a
+    per-request ``deadline_s``.
+
+    Returned criteria numbers (scripts/bench_report.py judges):
+
+    * ``resolved_within_budget_fraction`` == 1.0 — EVERY submitted
+      future resolves inside its budget (``deadline_s`` plus one
+      supervised-batch window for the pre-dispatch sweep to run) as
+      result, shed, or expired — never a hang, never a quietly-late
+      result;
+    * ``tier0_goodput`` >= 0.95 at >= 4x achieved saturation — the
+      quota headroom actually protects interactive traffic while tier 1
+      absorbs the shedding;
+    * ``shed_probe.dispatches`` == 0 — shed decisions are admission
+      bookkeeping: the probe engine (``max_queued=0``) sheds every
+      submit without ever starting its dispatcher, touching a device,
+      or even device_put-ting params, and the per-decision wall time is
+      recorded in µs;
+    * ``steady_recompiles`` == 0 — overload grows NO new programs: the
+      warm bucket executables serve the whole drill.
+
+    Everything runs on whatever backend is up; saturation is injected
+    in-process, so no chip is required and none is harmed.
+    """
+    from mano_hand_tpu.runtime.chaos import ChaosPlan
+    from mano_hand_tpu.runtime.supervise import DispatchPolicy
+    from mano_hand_tpu.serving.engine import ServingEngine, ServingError
+
+    if saturation <= 0:
+        raise ValueError(f"saturation must be > 0, got {saturation}")
+    if bursts < 1:
+        raise ValueError(f"bursts must be >= 1, got {bursts}")
+    if not 0.0 < tier0_fraction < 1.0:
+        raise ValueError(
+            f"tier0_fraction must be in (0, 1), got {tier0_fraction}")
+    if max_queued < 1:
+        raise ValueError(
+            f"max_queued={max_queued} admits nothing — the drill needs "
+            "at least one admitted request to calibrate (the shed-only "
+            "path is the probe's job)")
+    n_joints = params.n_joints
+    rng = np.random.default_rng(seed)
+
+    def one_pose():
+        return rng.normal(
+            scale=0.4, size=(1, n_joints, 3)).astype(np.float32)
+
+    # ---- Phase A: the shed probe (no device, no dispatcher) -----------
+    # max_queued=0 sheds EVERY submit at admission; the engine is never
+    # started, so the numbers below prove the shed path is pure host
+    # bookkeeping: zero dispatches, no dispatcher thread, params never
+    # transferred — and each decision lands in microseconds.
+    probe = ServingEngine(params, max_bucket=max_bucket, max_queued=0)
+    probe_pose = one_pose()
+    shed_us: List[float] = []
+    for _ in range(max(1, shed_probe_submits)):
+        t0 = time.perf_counter()
+        try:
+            probe.submit(probe_pose, deadline_s=deadline_s)
+            raise RuntimeError("shed probe submit was admitted at "
+                               "max_queued=0")
+        except ServingError as e:
+            if e.kind != "shed":
+                raise
+        shed_us.append((time.perf_counter() - t0) * 1e6)
+    shed_probe = {
+        "sheds": len(shed_us),
+        "dispatches": probe.counters.dispatches,
+        "engine_started": probe._thread is not None,
+        "params_device_put": probe._params_dev is not None,
+        "decision_p50_us": float(f"{np.percentile(shed_us, 50):.4g}"),
+        "decision_p99_us": float(f"{np.percentile(shed_us, 99):.4g}"),
+    }
+    if log:
+        log(f"overload: shed probe {shed_probe['sheds']} sheds, "
+            f"{shed_probe['dispatches']} dispatches, p50 "
+            f"{shed_probe['decision_p50_us']:.1f} µs")
+
+    # ---- Phase B: the saturated engine --------------------------------
+    plan = ChaosPlan(f"sat:{sat_latency_s}@0-")
+    policy = DispatchPolicy(
+        deadline_s=batch_deadline_s, retries=0, backoff_s=0.0,
+        backoff_cap_s=0.0, jitter=0.0, breaker=None, chaos=plan,
+        # The fallback tier would bypass the sat throttle and quietly
+        # raise capacity mid-drill; overload is not a fault, so keep
+        # one deterministic service rate.
+        cpu_fallback=False,
+    )
+    eng = ServingEngine(
+        params, max_bucket=max_bucket, max_delay_s=0.001, policy=policy,
+        max_queued=max_queued, tier_quotas={1: tier1_quota})
+
+    outcomes = {"ok": 0, "shed": 0, "expired": 0, "error": 0,
+                "unresolved": 0}
+    by_tier = {0: dict(outcomes), 1: dict(outcomes)}
+    records: List[tuple] = []   # (tier, t_submit, future|None, done_box)
+    load_mid = None
+
+    with eng:
+        eng.warmup()
+        # Calibrate THIS box's saturated service rate: waves sized under
+        # the tier-0 quota headroom (so calibration itself never sheds),
+        # submitted-then-drained three times. Includes the sat throttle
+        # and the real coalescing path — "4x saturation" is defined
+        # against this number.
+        # Clamped to max_queued: the tier-0 quota defaults to the whole
+        # queue, so a wave <= max_queued is never shed even when the cap
+        # is smaller than a bucket.
+        wave = min(max(max_bucket, min(max_queued // 2, 3 * max_bucket)),
+                   max_queued)
+        served = 0
+        t0 = time.perf_counter()
+        for _ in range(3):
+            futs = [eng.submit(one_pose()) for _ in range(wave)]
+            for f in futs:
+                f.result()
+            served += wave
+        service_rate = served / (time.perf_counter() - t0)
+        compiles_warm = eng.counters.compiles
+        offered_rate = saturation * service_rate
+        burst_n = max(1, int(round(offered_rate * burst_interval_s)))
+        budget_s = deadline_s + batch_deadline_s + 0.25
+        if log:
+            log(f"overload: service rate {service_rate:,.0f} req/s "
+                f"(sat throttle {sat_latency_s}s), offering "
+                f"{offered_rate:,.0f} req/s = {burst_n}/burst x "
+                f"{bursts} bursts")
+
+        t_stream0 = time.monotonic()
+        next_t = t_stream0
+        for b in range(bursts):
+            for _ in range(burst_n):
+                tier = 0 if rng.random() < tier0_fraction else 1
+                t_sub = time.monotonic()
+                done_box: List[float] = []
+                try:
+                    fut = eng.submit(one_pose(), priority=tier,
+                                     deadline_s=deadline_s)
+                except ServingError as e:
+                    if e.kind != "shed":
+                        raise
+                    records.append((tier, t_sub, None, done_box))
+                    continue
+                fut.add_done_callback(
+                    lambda f, box=done_box: box.append(time.monotonic()))
+                records.append((tier, t_sub, fut, done_box))
+            if b == bursts // 2:
+                load_mid = eng.load()
+            next_t += burst_interval_s
+            lag = next_t - time.monotonic()
+            if lag > 0:
+                time.sleep(lag)
+            # Behind schedule: submit the next burst immediately — a
+            # slow submitter must compress bursts, not quietly lower
+            # the offered rate.
+        t_stream1 = time.monotonic()
+
+        # Resolution wait: every future must be DONE within its budget;
+        # the wait itself gets a grace window past the last budget so a
+        # straggler is recorded as unresolved, not crashed into.
+        wait_end = t_stream1 + budget_s + 10.0
+        for tier, t_sub, fut, done_box in records:
+            if fut is None:
+                continue
+            try:
+                fut.result(timeout=max(0.0, wait_end - time.monotonic()))
+            except ServingError:
+                pass
+            except Exception:   # noqa: BLE001 — a timeout IS the bug
+                pass
+        steady_recompiles = eng.counters.compiles - compiles_warm
+        snap = eng.counters.snapshot()
+
+    # ---- Classification ----------------------------------------------
+    # concurrent.futures wakes result() waiters BEFORE invoking done-
+    # callbacks, so a future can be done() for a moment before its
+    # done_box timestamp lands. The engine's stop() join sequences the
+    # dispatcher's callbacks ahead of this point in the normal case;
+    # the short drain below closes the remaining (wedged-stop) window
+    # so a resolved-in-budget future is never misclassified unresolved.
+    drain_end = time.monotonic() + 1.0
+    for _, _, fut, done_box in records:
+        while (fut is not None and fut.done() and not done_box
+               and time.monotonic() < drain_end):
+            time.sleep(0.001)
+    in_budget = 0
+    resolve_lat: List[float] = []
+    for tier, t_sub, fut, done_box in records:
+        if fut is None:
+            outcome = "shed"        # resolved AT submit: latency ~0
+            in_budget += 1
+        elif not fut.done() or not done_box:
+            outcome = "unresolved"
+        else:
+            lat = done_box[0] - t_sub
+            resolve_lat.append(lat)
+            if lat <= budget_s:
+                in_budget += 1
+            exc = fut.exception()
+            if exc is None:
+                outcome = "ok"
+            elif isinstance(exc, ServingError) and exc.kind == "expired":
+                outcome = "expired"
+            elif isinstance(exc, ServingError) and exc.kind == "shed":
+                outcome = "shed"
+            else:
+                outcome = "error"
+        outcomes[outcome] += 1
+        by_tier[tier][outcome] += 1
+
+    submitted = len(records)
+    stream_s = max(t_stream1 - t_stream0, 1e-9)
+    achieved = (submitted / stream_s) / service_rate if service_rate else 0.0
+    t0_total = sum(by_tier[0].values())
+    tier0_goodput = by_tier[0]["ok"] / t0_total if t0_total else None
+    if log:
+        log(f"overload: {submitted} submitted at {achieved:.2f}x "
+            f"achieved saturation -> {outcomes['ok']} ok / "
+            f"{outcomes['shed']} shed / {outcomes['expired']} expired / "
+            f"{outcomes['unresolved']} unresolved; tier-0 goodput "
+            f"{tier0_goodput if tier0_goodput is None else f'{tier0_goodput:.1%}'}, "
+            f"{steady_recompiles} steady recompiles")
+    return {
+        "saturation_target": float(saturation),
+        "saturation_achieved": float(f"{achieved:.4g}"),
+        "service_rate_req_per_s": float(f"{service_rate:.5g}"),
+        "offered_rate_req_per_s": float(f"{offered_rate:.5g}"),
+        "bursts": int(bursts),
+        "burst_requests": int(burst_n),
+        "burst_interval_s": burst_interval_s,
+        "deadline_s": deadline_s,
+        "budget_s": float(f"{budget_s:.4g}"),
+        "tier0_fraction": tier0_fraction,
+        "max_queued": int(max_queued),
+        "tier1_quota": int(tier1_quota),
+        "sat_latency_s": sat_latency_s,
+        "submitted": submitted,
+        "outcomes": outcomes,
+        "by_tier": {str(t): c for t, c in by_tier.items()},
+        "tier0_goodput": (None if tier0_goodput is None
+                          else float(f"{tier0_goodput:.6g}")),
+        "resolved_within_budget_fraction": float(
+            f"{in_budget / submitted if submitted else 0.0:.6g}"),
+        "resolve_p99_s": (float(f"{np.percentile(resolve_lat, 99):.4g}")
+                          if resolve_lat else None),
+        "shed_probe": shed_probe,
+        "steady_recompiles": int(steady_recompiles),
+        "backlog_peak": snap["backlog_peak"],
+        "shed": snap["shed"],
+        "expired": snap["expired"],
+        "dispatches": snap["dispatches"],
+        "coalesce_width_mean": snap["coalesce_width_mean"],
+        "tiers": snap["tiers"],
+        "load_mid_drill": load_mid,
+    }
+
+
 def recovery_drill_run(
     params,
     *,

@@ -130,6 +130,18 @@ class ServingCounters:
         self.faults_injected = 0   # chaos-plan faults fired (tests/drills)
         self.failovers = 0         # dispatches served by the CPU fallback
         self.deadline_kills = 0    # supervised calls abandoned at deadline
+        # Overload counters (PR 5): bounded admission and per-request
+        # deadlines make "survives too much traffic" a set of numbers —
+        # sheds and expiries are the work NOT done (by design), the
+        # backlog high-water is how close the bound came, and the
+        # per-tier ledgers are the goodput criterion's raw material.
+        self.shed = 0              # submits refused at admission
+        self.expired = 0           # requests expired before/at delivery
+        self.backlog_peak = 0      # max outstanding requests seen at submit
+        self.tier_submitted: Dict[int, int] = {}   # tier -> offered
+        self.tier_served: Dict[int, int] = {}      # tier -> results delivered
+        self.tier_shed: Dict[int, int] = {}        # tier -> admission sheds
+        self.tier_expired: Dict[int, int] = {}     # tier -> expiries
         self._latencies: Dict[int, list] = {}  # bucket -> [seconds]
         self._latency_writes: Dict[int, int] = {}  # per-bucket write cursor
 
@@ -168,6 +180,40 @@ class ServingCounters:
     def count_deadline_kill(self, n: int = 1) -> None:
         with self._lock:
             self.deadline_kills += n
+
+    def count_tier_submit(self, tier: int = 0) -> None:
+        """One submit() OFFERED in this priority tier — counted before
+        admission, so shed + expired + served + in-flight sums back to
+        it (the goodput denominator)."""
+        with self._lock:
+            self.tier_submitted[tier] = self.tier_submitted.get(tier, 0) + 1
+
+    def count_served(self, tier: int = 0) -> None:
+        """One request resolved with a RESULT (the goodput numerator —
+        a request resolved to shed/expired/error is not served)."""
+        with self._lock:
+            self.tier_served[tier] = self.tier_served.get(tier, 0) + 1
+
+    def count_shed(self, tier: int = 0) -> None:
+        """One submit refused at admission (bounded queue / tier quota).
+        The decision is O(µs) bookkeeping — no device dispatch, which
+        the overload drill's shed probe verifies with ``dispatches``."""
+        with self._lock:
+            self.shed += 1
+            self.tier_shed[tier] = self.tier_shed.get(tier, 0) + 1
+
+    def count_expired(self, tier: int = 0) -> None:
+        """One request whose deadline passed before a result could be
+        delivered — swept pre-dispatch (no chip time) or expired at
+        readback (a stale pose is worthless; see serving/engine.py)."""
+        with self._lock:
+            self.expired += 1
+            self.tier_expired[tier] = self.tier_expired.get(tier, 0) + 1
+
+    def observe_backlog(self, outstanding: int) -> None:
+        with self._lock:
+            if outstanding > self.backlog_peak:
+                self.backlog_peak = outstanding
 
     def count_dispatch(self, bucket: int, live_rows: int,
                        requests: int = 1, subjects: int = 1) -> None:
@@ -227,25 +273,20 @@ class ServingCounters:
                 samples.append(seconds)
 
     # -- readers ----------------------------------------------------------
-    @property
-    def padding_waste(self) -> float:
-        """Fraction of dispatched rows that were padding, in [0, 1)."""
-        with self._lock:
-            total = self.rows_live + self.rows_padded
-            return self.rows_padded / total if total else 0.0
+    # The derived-metric formulas live in these static helpers so the
+    # properties (which take the lock themselves) and snapshot() (which
+    # computes them INSIDE its single lock hold) can never drift apart.
+    @staticmethod
+    def _waste_ratio(rows_live: int, rows_padded: int) -> float:
+        total = rows_live + rows_padded
+        return rows_padded / total if total else 0.0
 
-    @property
-    def coalesce_width_mean(self) -> float:
-        """Mean submit() requests merged per dispatch (1.0 = the
-        degenerate single-request batches PR 4 exists to fix)."""
-        with self._lock:
-            return (self.requests_dispatched / self.dispatches
-                    if self.dispatches else 0.0)
+    @staticmethod
+    def _width_mean(requests_dispatched: int, dispatches: int) -> float:
+        return requests_dispatched / dispatches if dispatches else 0.0
 
-    def latency_quantiles(self) -> dict:
-        """{bucket: {"p50_ms", "p99_ms", "n"}} over the recorded samples."""
-        with self._lock:
-            items = {b: list(s) for b, s in self._latencies.items()}
+    @staticmethod
+    def _quantiles(items: Dict[int, list]) -> dict:
         out = {}
         for b, s in sorted(items.items()):
             if not s:
@@ -258,8 +299,37 @@ class ServingCounters:
             }
         return out
 
+    @property
+    def padding_waste(self) -> float:
+        """Fraction of dispatched rows that were padding, in [0, 1)."""
+        with self._lock:
+            return self._waste_ratio(self.rows_live, self.rows_padded)
+
+    @property
+    def coalesce_width_mean(self) -> float:
+        """Mean submit() requests merged per dispatch (1.0 = the
+        degenerate single-request batches PR 4 exists to fix)."""
+        with self._lock:
+            return self._width_mean(self.requests_dispatched,
+                                    self.dispatches)
+
+    def latency_quantiles(self) -> dict:
+        """{bucket: {"p50_ms", "p99_ms", "n"}} over the recorded samples."""
+        with self._lock:
+            items = {b: list(s) for b, s in self._latencies.items()}
+        return self._quantiles(items)
+
     def snapshot(self) -> dict:
-        """JSON-able state dump (the bench/CLI serving metrics block)."""
+        """JSON-able state dump (the bench/CLI serving metrics block).
+
+        ONE lock-held copy: every raw counter, the derived ratios, and
+        the latency samples are read inside a single acquisition, so a
+        snapshot taken mid-overload (concurrent submitters hammering
+        the shed/dispatch counters) is internally consistent — its
+        ``padding_waste`` is exactly ``rows_padded / (rows_live +
+        rows_padded)`` of the SAME dict, never a torn tuple where the
+        ratio reflects a later write than the integers beside it (the
+        PR-5 drill telemetry depends on this; pinned in tests)."""
         with self._lock:
             base = {
                 "compiles": self.compiles,
@@ -279,8 +349,28 @@ class ServingCounters:
                 "faults_injected": self.faults_injected,
                 "failovers": self.failovers,
                 "deadline_kills": self.deadline_kills,
+                "shed": self.shed,
+                "expired": self.expired,
+                "backlog_peak": self.backlog_peak,
             }
-        base["padding_waste"] = round(self.padding_waste, 4)
-        base["coalesce_width_mean"] = round(self.coalesce_width_mean, 3)
-        base["latency_by_bucket"] = self.latency_quantiles()
+            base["padding_waste"] = round(
+                self._waste_ratio(self.rows_live, self.rows_padded), 4)
+            base["coalesce_width_mean"] = round(
+                self._width_mean(self.requests_dispatched,
+                                 self.dispatches), 3)
+            tiers = sorted(set(self.tier_submitted) | set(self.tier_served)
+                           | set(self.tier_shed) | set(self.tier_expired))
+            base["tiers"] = {
+                str(t): {
+                    "submitted": self.tier_submitted.get(t, 0),
+                    "served": self.tier_served.get(t, 0),
+                    "shed": self.tier_shed.get(t, 0),
+                    "expired": self.tier_expired.get(t, 0),
+                }
+                for t in tiers
+            }
+            items = {b: list(s) for b, s in self._latencies.items()}
+        # Percentile math alone happens outside the lock (pure reads of
+        # the copied sample lists; submitters never wait on numpy).
+        base["latency_by_bucket"] = self._quantiles(items)
         return base

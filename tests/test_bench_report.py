@@ -242,3 +242,106 @@ def test_coalesce_metrics_block(tmp_path):
     assert p.returncode == 0, p.stdout
     assert "[PASS] coalesce_13x" in p.stdout
     assert "SERVING CRITERIA PASS" in p.stdout
+
+
+def test_overload_metrics_block(tmp_path):
+    """The overload/saturation drill (config10, PR 5): every future
+    resolved within its budget, sheds without a device dispatch, tier-0
+    goodput >= 95% under genuine saturation, zero steady recompiles —
+    judged inside a serving-only artifact AND as a raw `serve-bench
+    --overload` line (no bench.py envelope)."""
+    ov = {
+        "saturation_target": 4.0, "saturation_achieved": 3.9,
+        "service_rate_req_per_s": 300.0, "offered_rate_req_per_s": 1200.0,
+        "submitted": 480, "budget_s": 1.15, "resolve_p99_s": 0.41,
+        "outcomes": {"ok": 180, "shed": 290, "expired": 10, "error": 0,
+                     "unresolved": 0},
+        "by_tier": {"0": {"ok": 60, "shed": 0, "expired": 1, "error": 0,
+                          "unresolved": 0},
+                    "1": {"ok": 120, "shed": 290, "expired": 9,
+                          "error": 0, "unresolved": 0}},
+        "tier0_goodput": 0.984, "resolved_within_budget_fraction": 1.0,
+        "shed_probe": {"sheds": 256, "dispatches": 0,
+                       "engine_started": False,
+                       "params_device_put": False,
+                       "decision_p50_us": 11.4, "decision_p99_us": 54.7},
+        "steady_recompiles": 0, "backlog_peak": 38, "max_queued": 40,
+        "coalesce_width_mean": 5.2,
+        "load_mid_drill": {"outstanding": 38, "admission": {"0": "busy",
+                                                            "1": "shed"}},
+    }
+    # Raw serve-bench --overload artifact: judged on its own.
+    raw = tmp_path / "overload_raw.json"
+    raw.write_text(json.dumps(dict(ov, backend="cpu")))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] overload_all_resolved_in_budget" in p.stdout
+    assert "[PASS] overload_shed_no_dispatch" in p.stdout
+    assert "[PASS] overload_tier0_goodput_95" in p.stdout
+    assert "[PASS] overload_zero_steady_recompiles" in p.stdout
+    assert "OVERLOAD CRITERIA PASS" in p.stdout
+
+    # An unresolved future, a probe dispatch, or starved tier 0 FAILS.
+    raw.write_text(json.dumps(dict(
+        ov, resolved_within_budget_fraction=0.998, tier0_goodput=0.80,
+        shed_probe=dict(ov["shed_probe"], dispatches=3))))
+    p = _run(str(raw))
+    assert p.returncode == 1
+    assert "[FAIL] overload_all_resolved_in_budget" in p.stdout
+    assert "[FAIL] overload_shed_no_dispatch" in p.stdout
+    assert "[FAIL] overload_tier0_goodput_95" in p.stdout
+
+    # A within-budget kind="error" resolution is still a criteria
+    # failure: the contract is result, shed, or expired.
+    raw.write_text(json.dumps(dict(
+        ov, outcomes=dict(ov["outcomes"], error=5))))
+    p = _run(str(raw))
+    assert p.returncode == 1
+    assert "[FAIL] overload_all_resolved_in_budget" in p.stdout
+
+    # A submitter that never truly saturated leaves goodput unjudged;
+    # the resolution and recompile gates still apply.
+    raw.write_text(json.dumps(dict(ov, saturation_achieved=1.4,
+                                   tier0_goodput=0.5)))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    assert "goodput unjudged" in p.stdout
+    assert "overload_tier0_goodput_95" not in p.stdout
+
+    # Inside a serving-only artifact the block rides with the serving
+    # criteria (`make serve-smoke`), and a crashed leg fails loudly.
+    only = tmp_path / "serve_only_ov.json"
+    only.write_text(json.dumps({
+        "metric": "serving_engine_evals_per_sec", "value": 8114.4,
+        "unit": "evals/s", "vs_baseline": None, "device": "cpu:cpu",
+        "detail": {
+            "serving": {
+                "engine_evals_per_sec": 8114.4,
+                "engine_vs_direct_ratio": 1.297,
+                "warm_bucket": 32, "steady_recompiles": 0,
+                "requests": 64, "compiles": 6, "aot_loads": 0,
+                "dispatches": 54, "padding_waste": 0.14,
+            },
+            "overload": ov,
+        }}))
+    p = _run(str(only))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] overload_all_resolved_in_budget" in p.stdout
+    assert "SERVING CRITERIA PASS" in p.stdout
+
+    only.write_text(json.dumps({
+        "metric": "serving_engine_evals_per_sec", "value": 8114.4,
+        "unit": "evals/s", "vs_baseline": None, "device": "cpu:cpu",
+        "config_errors": {"config10_overload": "boom"},
+        "detail": {
+            "serving": {
+                "engine_evals_per_sec": 8114.4,
+                "engine_vs_direct_ratio": 1.297,
+                "warm_bucket": 32, "steady_recompiles": 0,
+                "requests": 64, "compiles": 6, "aot_loads": 0,
+                "dispatches": 54, "padding_waste": 0.14,
+            },
+        }}))
+    p = _run(str(only))
+    assert p.returncode == 1
+    assert "[FAIL] overload_leg_ran" in p.stdout

@@ -904,6 +904,20 @@ def test_serve_bench_subcommand(capsys):
     assert cli.main(["serve-bench", "--min-rows", "0"]) == 2
 
 
+def test_serve_bench_overload_guard(capsys):
+    """`--overload` fixes its own protocol: composing it with --chaos,
+    --subjects, --aot-dir, or --deadline-s (the --chaos per-batch knob;
+    the drill's request TTL is a protocol constant) refuses with rc 2
+    instead of silently ignoring the flag."""
+    assert cli.main(["serve-bench", "--overload",
+                     "--chaos", "drill"]) == 2
+    assert cli.main(["serve-bench", "--overload",
+                     "--subjects", "2"]) == 2
+    assert cli.main(["serve-bench", "--overload",
+                     "--deadline-s", "1.0"]) == 2
+    assert "--deadline-s" in capsys.readouterr().err
+
+
 def test_serve_bench_subjects_mode(capsys):
     """`serve-bench --subjects N` runs the mixed-subject coalescing
     protocol (bench.py config9's shared code path) and prints its one

@@ -1,0 +1,549 @@
+"""Overload robustness (the PR-5 tentpole), CPU-verified.
+
+"Survives too much traffic" is three rules enforced before chip time is
+spent, all deterministic on CPU and pinned here:
+
+* bounded admission — ``max_queued`` + per-tier quotas shed at
+  ``submit()`` with a structured ``ServingError(kind="shed")`` in O(µs),
+  without starting the dispatcher, transferring params, or dispatching;
+* per-request deadlines — ``submit(deadline_s=...)`` rides the request
+  end-to-end, and the expiry sweeps fire at every pre-dispatch boundary
+  (submit itself, the queue head, coalescing, the launch boundary, the
+  failover boundary) plus readback, so an expired request never buys a
+  dispatch and a late result never masquerades as fresh;
+* priority classes — overload sheds high-numbered (batch) tiers first,
+  and parked tier-0 requests lead the next batch, so interactive
+  traffic cannot starve.
+
+Plus the PR-5 satellites: chaos plan specs are validated at parse time
+(a typo'd plan fails the run instead of silently injecting nothing),
+``ServingCounters.snapshot()`` is a single lock-held copy (no torn
+telemetry mid-overload), and ``submit()`` racing ``stop()`` can never
+strand a future (the ``_live`` registry + the post-join drain sweep).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mano_hand_tpu.runtime import chaos, supervise
+from mano_hand_tpu.runtime.supervise import DispatchPolicy
+from mano_hand_tpu.serving.engine import ServingEngine, ServingError
+from mano_hand_tpu.utils.profiling import ServingCounters
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+def _pose(n=1, seed=0):
+    return np.random.default_rng(seed).normal(
+        scale=0.4, size=(n, 16, 3)).astype(np.float32)
+
+
+class _held:
+    """Hold the dispatcher off (the prestuffed trick from
+    tests/test_serving_coalesce.py) so queue/park composition is
+    deterministic, then release it on exit."""
+
+    def __init__(self, eng):
+        self.eng = eng
+
+    def __enter__(self):
+        self.eng.start = lambda: self.eng
+        return self.eng
+
+    def __exit__(self, *exc):
+        del self.eng.start          # restore the class method
+        self.eng.start()
+
+
+# ------------------------------------------- chaos spec validation (sat.)
+@pytest.mark.parametrize("spec", [
+    "explode@1",       # unknown kind
+    "hang:2@0",        # param on a kind that takes none (typo'd latency)
+    "error:1@0-",      # ditto
+    "sat@0-",          # sat REQUIRES ':SECONDS'
+    "latency@1",       # latency likewise
+    "latency:abc@1",   # non-numeric param
+    "sat:-0.1@0",      # negative seconds
+    "error@5-2",       # inverted range: would match no call
+    "error@x",         # non-integer selector
+    "error@x-3",       # non-integer range start
+    "error@1-y",       # non-integer range stop
+    "wrong:1.0",       # missing '@SELECTOR'
+])
+def test_chaos_rejects_malformed_specs(spec):
+    """A typo'd plan must fail the run at parse time, not silently
+    inject nothing (the PR-5 chaos-validation satellite)."""
+    with pytest.raises(ValueError):
+        chaos.parse_plan(spec)
+
+
+def test_chaos_valid_specs_still_parse():
+    plan = chaos.parse_plan(
+        "sat:0.01@0-,latency:0.2@1-3,wrong@6,wrong:0.5@7,hang@8-,error@*")
+    assert len(plan._events) == 6
+
+
+def test_chaos_sat_kind_throttles_then_runs():
+    plan = chaos.ChaosPlan("sat:0.05@0-")
+    t0 = time.perf_counter()
+    assert plan.wrap(lambda: 7)() == 7
+    assert time.perf_counter() - t0 >= 0.05
+    assert plan.faults_injected == 1
+
+
+# ------------------------------------------------------- bounded admission
+def test_bounded_admission_sheds_at_cap(params32):
+    eng = ServingEngine(params32, max_bucket=4, max_queued=2)
+    with _held(eng):
+        futs = [eng.submit(_pose()), eng.submit(_pose())]
+        with pytest.raises(ServingError) as ei:
+            eng.submit(_pose())
+    assert ei.value.kind == "shed"
+    assert ei.value.phase == "admission"
+    for f in futs:
+        assert f.result(timeout=30).shape == (1, 778, 3)
+    eng.stop()
+    snap = eng.counters.snapshot()
+    assert snap["shed"] == 1
+    assert snap["tiers"]["0"] == {
+        "submitted": 3, "served": 2, "shed": 1, "expired": 0}
+    assert snap["backlog_peak"] == 2
+
+
+def test_shed_touches_no_device_and_is_fast(params32):
+    """The acceptance criterion's shed half: at max_queued=0 EVERY
+    submit sheds as pure host bookkeeping — the dispatcher thread never
+    starts, params are never device_put, nothing dispatches."""
+    eng = ServingEngine(params32, max_bucket=4, max_queued=0)
+    for _ in range(16):
+        with pytest.raises(ServingError) as ei:
+            eng.submit(_pose(), deadline_s=1.0)
+        assert ei.value.kind == "shed"
+    assert eng._thread is None
+    assert eng._params_dev is None
+    assert eng.counters.dispatches == 0
+    assert eng.counters.shed == 16
+
+
+def test_tier_quotas_shed_low_priority_first(params32):
+    """Default quotas: tier 0 may fill max_queued, tiers >= 1 only half
+    — the gap is tier-0's reserved headroom, so overload sheds batch
+    traffic first by construction."""
+    eng = ServingEngine(params32, max_bucket=8, max_queued=4)
+    with _held(eng):
+        futs = [eng.submit(_pose(), priority=1),
+                eng.submit(_pose(), priority=1)]
+        # outstanding == 2 == tier-1 quota (max_queued // 2): tier 1
+        # sheds, tier 0 still has its reserved headroom.
+        with pytest.raises(ServingError) as e1:
+            eng.submit(_pose(), priority=1)
+        assert e1.value.kind == "shed"
+        futs += [eng.submit(_pose(), priority=0),
+                 eng.submit(_pose(), priority=0)]
+        # outstanding == 4 == max_queued: now tier 0 sheds too.
+        with pytest.raises(ServingError) as e0:
+            eng.submit(_pose(), priority=0)
+        assert e0.value.kind == "shed"
+    for f in futs:
+        f.result(timeout=30)
+    eng.stop()
+    snap = eng.counters.snapshot()
+    assert snap["tiers"]["1"]["shed"] == 1 and snap["tiers"]["0"]["shed"] == 1
+    assert snap["tiers"]["0"]["served"] == 2
+    assert snap["tiers"]["1"]["served"] == 2
+
+
+def test_pop_parked_prefers_tier0_fifo_within_tier(params32):
+    """_pop_parked: among parked requests the lowest tier goes first
+    (earliest-parked among ties) — a parked interactive request cannot
+    starve behind parked batch work."""
+    from mano_hand_tpu.serving.engine import _Request
+
+    eng = ServingEngine(params32, max_bucket=4)
+    reqs = [_Request(_pose(seed=i), None, 1, False, tier=t)
+            for i, t in enumerate([1, 0, 1, 0])]
+    eng._pending.extend(reqs)
+    assert eng._pop_parked() is reqs[1]   # first tier-0
+    assert eng._pop_parked() is reqs[3]   # second tier-0
+    assert eng._pop_parked() is reqs[0]   # then tier 1, FIFO
+    assert eng._pop_parked() is reqs[2]
+
+
+def test_parked_overflow_request_still_dispatches(params32):
+    """A genuine bucket-overflow park (3 + 2 rows > max bucket 4) is
+    counted once and the parked request leads the next batch."""
+    eng = ServingEngine(params32, max_bucket=4)
+    with _held(eng):
+        f_a = eng.submit(_pose(3, seed=1))
+        f_b = eng.submit(_pose(2, seed=2), priority=1)
+    assert f_a.result(timeout=30).shape == (3, 778, 3)
+    assert f_b.result(timeout=30).shape == (2, 778, 3)
+    eng.stop()
+    snap = eng.counters.snapshot()
+    assert snap["coalesce_overflows"] == 1
+    assert snap["dispatches"] == 2
+    assert snap["tiers"]["1"]["served"] == 1
+
+
+def test_admission_arg_validation(params32):
+    with pytest.raises(ValueError):
+        ServingEngine(params32, max_bucket=4, max_queued=-1)
+    with pytest.raises(ValueError):
+        ServingEngine(params32, max_bucket=4, tier_quotas={1: 4})
+    with pytest.raises(ValueError):
+        ServingEngine(params32, max_bucket=4, max_queued=8,
+                      tier_quotas={1: -2})
+    with pytest.raises(ValueError):
+        ServingEngine(params32, max_bucket=4, max_queued=8,
+                      busy_fraction=0.0)
+    with pytest.raises(ValueError):
+        ServingEngine(params32, max_bucket=4, max_queued=8,
+                      busy_fraction=1.5)
+    eng = ServingEngine(params32, max_bucket=4, max_queued=8)
+    with pytest.raises(ValueError):
+        eng.submit(_pose(), priority=-1)
+
+
+# ---------------------------------------------------- backpressure load()
+def test_load_backpressure_states(params32):
+    eng = ServingEngine(params32, max_bucket=8, max_queued=4,
+                        busy_fraction=0.5)
+    with _held(eng):
+        ld = eng.load()
+        assert ld["outstanding"] == 0 and ld["max_queued"] == 4
+        assert ld["admission"] == {"0": "ok", "1": "ok"}
+        futs = [eng.submit(_pose()), eng.submit(_pose())]
+        ld = eng.load()
+        # outstanding 2: tier-1 quota (2) reached -> shed; tier 0 at
+        # busy_fraction (0.5 * 4) -> busy.
+        assert ld["admission"] == {"0": "busy", "1": "shed"}
+        futs += [eng.submit(_pose()), eng.submit(_pose())]
+        assert eng.load()["admission"]["0"] == "shed"
+    for f in futs:
+        f.result(timeout=30)
+    eng.stop()
+    assert eng.load()["backlog_peak"] == 4
+
+
+def test_load_unbounded_reports_observability_only(params32):
+    eng = ServingEngine(params32, max_bucket=4)
+    ld = eng.load()
+    assert ld["max_queued"] is None
+    assert ld["admission"] == {}
+
+
+# --------------------------------------------- deadline plumbing (satellite)
+def test_deadline_already_expired_at_submit(params32):
+    """Born expired: the future resolves right at submit — no
+    registration, no queue slot, no dispatcher, no device."""
+    eng = ServingEngine(params32, max_bucket=4, max_queued=8)
+    fut = eng.submit(_pose(), deadline_s=0.0)
+    assert fut.done()
+    with pytest.raises(ServingError) as ei:
+        fut.result()
+    assert ei.value.kind == "expired"
+    assert ei.value.phase == "admission"
+    assert eng._thread is None
+    assert eng.counters.dispatches == 0
+    assert eng.counters.expired == 1
+    assert eng.load()["outstanding"] == 0   # never occupied a slot
+
+
+def test_deadline_expires_while_queued_no_dispatch(params32):
+    """The queue-head sweep: a request whose deadline lapses while it
+    waits resolves as expired WITHOUT buying a dispatch; its neighbors
+    still dispatch normally."""
+    eng = ServingEngine(params32, max_bucket=4)
+    with _held(eng):
+        doomed = eng.submit(_pose(seed=1), deadline_s=0.02)
+        alive = eng.submit(_pose(seed=2))
+        time.sleep(0.06)
+    assert alive.result(timeout=30).shape == (1, 778, 3)
+    with pytest.raises(ServingError) as ei:
+        doomed.result(timeout=30)
+    eng.stop()
+    assert ei.value.kind == "expired"
+    assert eng.counters.dispatches == 1        # only `alive`'s batch
+    assert eng.counters.expired == 1
+
+
+def test_deadline_expires_while_parked(params32):
+    """The park sweep: a request parked by _coalesce (bucket overflow)
+    whose deadline lapses while the predecessor batch runs is swept
+    when it would lead the next batch — expired, zero dispatches
+    spent on it."""
+    pol = DispatchPolicy(deadline_s=None, retries=0, jitter=0.0,
+                         chaos=chaos.ChaosPlan("sat:0.15@0"),
+                         cpu_fallback=False)
+    eng = ServingEngine(params32, max_bucket=4, policy=pol)
+    eng.warmup()
+    with _held(eng):
+        first = eng.submit(_pose(3, seed=1))
+        # 3 + 2 rows overflow bucket 4: this one PARKS, and its 0.05 s
+        # deadline lapses during the predecessor's 0.15 s dispatch.
+        parked = eng.submit(_pose(2, seed=2), deadline_s=0.05)
+    assert first.result(timeout=30).shape == (3, 778, 3)
+    with pytest.raises(ServingError) as ei:
+        parked.result(timeout=30)
+    eng.stop()
+    assert ei.value.kind == "expired"
+    assert eng.counters.coalesce_overflows == 1
+    assert eng.counters.dispatches == 1
+    assert eng.counters.expired == 1
+
+
+def test_deadline_expiry_during_failover_skips_fallback(params32):
+    """The failover sweep: when the primary attempts consume the whole
+    request deadline, CPU failover is SKIPPED — an expired request must
+    not buy a fallback dispatch."""
+    plan = chaos.ChaosPlan("hang@0-")
+    pol = DispatchPolicy(deadline_s=0.5, retries=0, backoff_s=0.0,
+                         jitter=0.0, chaos=plan, cpu_fallback=True)
+    eng = ServingEngine(params32, max_bucket=4, policy=pol,
+                        max_delay_s=0.0)
+    try:
+        with eng:
+            eng.warmup()
+            fut = eng.submit(_pose(), deadline_s=0.08)
+            with pytest.raises(ServingError) as ei:
+                fut.result(timeout=30)
+    finally:
+        plan.release.set()        # let the abandoned hang thread exit
+    assert ei.value.kind == "expired"
+    assert ei.value.phase == "failover"
+    assert eng.counters.failovers == 0
+    assert eng.counters.deadline_kills == 1   # give_up_by clipped 0.5->0.08
+    assert eng.counters.expired == 1
+
+
+def test_deadline_expiry_post_primary_without_fallback(params32):
+    """The post-primary sweep runs with cpu_fallback OFF too: a batch
+    whose give_up_by killed the primary attempt resolves kind="expired"
+    (its own deadline was the only failure), never kind="error" — the
+    drill runs fallback-less, so this is the drill's own edge."""
+    plan = chaos.ChaosPlan("hang@0-")
+    pol = DispatchPolicy(deadline_s=0.5, retries=0, backoff_s=0.0,
+                         jitter=0.0, chaos=plan, cpu_fallback=False)
+    eng = ServingEngine(params32, max_bucket=4, policy=pol,
+                        max_delay_s=0.0)
+    try:
+        with eng:
+            eng.warmup()
+            fut = eng.submit(_pose(), deadline_s=0.08)
+            with pytest.raises(ServingError) as ei:
+                fut.result(timeout=30)
+    finally:
+        plan.release.set()
+    assert ei.value.kind == "expired"
+    assert ei.value.phase == "failover"
+    assert eng.counters.failovers == 0
+    assert eng.counters.expired == 1
+
+
+def test_deadline_expiry_at_readback_discards_late_result(params32):
+    """A result that arrives past the request's own deadline resolves
+    as expired, not as a quietly-late result — while a no-deadline
+    batchmate from the SAME dispatch is served normally."""
+    pol = DispatchPolicy(deadline_s=None, retries=0, jitter=0.0,
+                         chaos=chaos.ChaosPlan("sat:0.12@0"),
+                         cpu_fallback=False)
+    eng = ServingEngine(params32, max_bucket=8, policy=pol)
+    eng.warmup()
+    with _held(eng):
+        unbounded = eng.submit(_pose(seed=1))
+        doomed = eng.submit(_pose(seed=2), deadline_s=0.05)
+    assert unbounded.result(timeout=30).shape == (1, 778, 3)
+    with pytest.raises(ServingError) as ei:
+        doomed.result(timeout=30)
+    eng.stop()
+    assert ei.value.kind == "expired"
+    assert ei.value.phase == "readback"
+    assert eng.counters.dispatches == 1       # ONE coalesced batch
+    snap = eng.counters.snapshot()
+    assert snap["tiers"]["0"]["served"] == 1
+    assert snap["tiers"]["0"]["expired"] == 1
+
+
+# --------------------------------------- give_up_by (supervise plumbing)
+def test_supervised_call_respects_give_up_by():
+    """No retry starts past give_up_by, and the per-attempt deadline is
+    clipped to the remaining budget (fake clock: fully deterministic)."""
+    t = [0.0]
+    sleeps = []
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        t[0] += s
+
+    calls = []
+
+    def fn():
+        calls.append(t[0])
+        raise chaos.InjectedFault("transient", transient=True)
+
+    with pytest.raises(supervise.RetriesExhausted) as ei:
+        supervise.supervised_call(
+            fn, deadline_s=None, retries=5, backoff_s=1.0,
+            backoff_cap_s=1.0, jitter=0.0, give_up_by=0.5,
+            clock=lambda: t[0], sleep=fake_sleep)
+    # Attempt 1 at t=0 fails; the pre-sleep check passes (0 < 0.5), the
+    # backoff sleep runs (t=1.0), and the POST-sleep check sees the
+    # budget spent and stops — attempt 2 never launches fn() (an
+    # attempt's thread would really dispatch even when the join window
+    # is non-positive). Never 6 attempts, never a wasted dispatch.
+    assert ei.value.attempts == 1
+    assert len(sleeps) == 1
+    assert len(calls) == 1
+
+
+def test_supervised_call_give_up_by_clips_attempt_deadline():
+    """Wall-clock version: a 10 s per-attempt deadline is clipped to
+    the ~0.1 s remaining end-to-end budget."""
+    t0 = time.monotonic()
+    with pytest.raises(supervise.RetriesExhausted) as ei:
+        supervise.supervised_call(
+            lambda: time.sleep(30), deadline_s=10.0, retries=0,
+            backoff_s=0.0, backoff_cap_s=0.0, jitter=0.0,
+            give_up_by=time.monotonic() + 0.1)
+    assert time.monotonic() - t0 < 5.0
+    assert isinstance(ei.value.cause, supervise.DeadlineExceeded)
+
+
+# ------------------------------------- snapshot atomicity (satellite)
+def test_counters_snapshot_atomic_under_concurrent_writers():
+    """snapshot() is ONE lock-held copy: the derived ratios and the
+    per-tier ledgers always agree with the raw integers beside them,
+    even while submitter threads hammer the counters (the drill's
+    mid-overload telemetry must never report torn tuples)."""
+    c = ServingCounters()
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            c.count_dispatch(8, 3, requests=2)   # padded rows: 5 each
+            c.count_shed(0)
+            c.count_shed(1)
+            c.count_expired(1)
+            c.count_tier_submit(0)
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for th in threads:
+        th.start()
+    try:
+        for _ in range(200):
+            s = c.snapshot()
+            d = s["dispatches"]
+            assert s["requests_dispatched"] == 2 * d
+            assert s["rows_live"] == 3 * d
+            assert s["rows_padded"] == 5 * d
+            assert s["coalesce_width_mean"] == (2.0 if d else 0.0)
+            total = s["rows_live"] + s["rows_padded"]
+            assert s["padding_waste"] == round(
+                s["rows_padded"] / total if total else 0.0, 4)
+            # Each count_* call updates the total AND its tier ledger
+            # under one lock hold, and snapshot() copies both under
+            # one hold — so the total always equals the ledger sum
+            # (the pair can never tear apart). Cross-CALL drift (a
+            # writer between its shed(0) and shed(1)) is expected.
+            tiers = s["tiers"]
+            assert s["shed"] == (tiers.get("0", {}).get("shed", 0)
+                                 + tiers.get("1", {}).get("shed", 0))
+            assert s["expired"] == tiers.get("1", {}).get("expired", 0)
+    finally:
+        stop.set()
+        for th in threads:
+            th.join()
+
+
+# ------------------------------------- submit() vs stop() (satellite)
+def test_submit_racing_stop_never_strands_a_future(params32):
+    """The drain-sweep regression (serving/engine.py:435 `_live`
+    registry + stop()'s post-join `_drain_cancelled`): a submit landing
+    in ANY interleaving with stop() — including after the dispatcher's
+    own drain — resolves its future as a result or a structured
+    ServingError, never a hang."""
+    for trial in range(6):
+        eng = ServingEngine(params32, max_bucket=4, max_queued=64)
+        eng.start()
+        barrier = threading.Barrier(2)
+        futs = []
+
+        def submitter():
+            barrier.wait()
+            for i in range(8):
+                try:
+                    futs.append(eng.submit(_pose(seed=i)))
+                except (ServingError, RuntimeError):
+                    pass          # refused outright: also resolved
+                if trial % 2:
+                    time.sleep(0.0005)   # vary the interleaving
+
+        th = threading.Thread(target=submitter)
+        th.start()
+        barrier.wait()
+        if trial % 3 == 0:
+            time.sleep(0.001)
+        eng.stop(timeout_s=10.0)
+        th.join(10.0)
+        assert not th.is_alive()
+        # A submit that landed entirely after stop() revives the
+        # dispatcher by contract (start() inside submit); a final stop
+        # drains that too.
+        eng.stop(timeout_s=10.0)
+        for f in futs:
+            exc = None
+            try:
+                got = f.result(timeout=5.0)
+                assert got.shape == (1, 778, 3)
+            except ServingError as e:
+                exc = e
+            if exc is not None:
+                assert exc.kind in ("shutdown", "error")
+
+
+# --------------------------------------------------- the drill, end to end
+def test_overload_drill_small_max_queued_calibrates(params32):
+    """Calibration waves are clamped to max_queued: a cap smaller than
+    one bucket must not shed (and crash) the drill's own calibration."""
+    from mano_hand_tpu.serving.measure import overload_drill_run
+
+    out = overload_drill_run(params32, max_queued=4, tier1_quota=2,
+                             bursts=2, seed=3)
+    assert out["outcomes"]["unresolved"] == 0
+    assert out["backlog_peak"] <= 4
+    with pytest.raises(ValueError):
+        overload_drill_run(params32, max_queued=0, bursts=1)
+
+
+def test_overload_drill_meets_done_criteria(params32):
+    """A small end-to-end saturation drill (the bench.py config10 /
+    `serve-bench --overload` protocol at reduced size): every future
+    resolves within its budget, sheds touch no device, overload
+    compiles nothing."""
+    from mano_hand_tpu.serving.measure import overload_drill_run
+
+    out = overload_drill_run(params32, bursts=10, seed=5)
+    assert out["resolved_within_budget_fraction"] == 1.0
+    assert out["outcomes"]["unresolved"] == 0
+    assert out["outcomes"]["error"] == 0
+    probe = out["shed_probe"]
+    assert probe["sheds"] > 0
+    assert probe["dispatches"] == 0
+    assert not probe["engine_started"]
+    assert not probe["params_device_put"]
+    assert out["steady_recompiles"] == 0
+    # The bounded queue actually bounded: backlog never exceeded cap.
+    assert out["backlog_peak"] <= out["max_queued"]
+    # Saturation genuinely exceeded capacity -> shedding happened.
+    assert out["saturation_achieved"] > 1.0
+    assert out["outcomes"]["shed"] > 0
+    assert out["tier0_goodput"] is not None
+    assert out["tier0_goodput"] >= 0.95
