@@ -21,22 +21,25 @@
 # shared cache dir.
 .PHONY: check check-cold test bench-cpu bench-tpu-wait mesh-scaling \
 	check-quick serve-smoke specialize-smoke chaos-smoke coalesce-smoke \
-	overload-smoke
+	overload-smoke coldstart-smoke
 
-check: test chaos-smoke coalesce-smoke overload-smoke
+check: test chaos-smoke coalesce-smoke overload-smoke coldstart-smoke
 
 # tests/test_runtime.py is excluded here and covered by the chaos-smoke
 # prerequisite instead (its own pytest process + cache dir): `make
 # check` would otherwise pay the real-time deadline/backoff/hang sleeps
 # of the chaos matrix twice. tests/test_serving_coalesce.py is likewise
-# covered by coalesce-smoke, and tests/test_overload.py by
-# overload-smoke (same pattern, their own cache dirs). A bare
-# `pytest tests/` (e.g. the tier-1 verify command) still collects all.
+# covered by coalesce-smoke, tests/test_overload.py by overload-smoke,
+# and tests/test_coldstart.py by coldstart-smoke (same pattern, their
+# own cache dirs). A bare `pytest tests/` (e.g. the tier-1 verify
+# command) still collects all — test_coldstart is `slow`-marked, so the
+# tier-1 `-m 'not slow'` lane skips it by design.
 test:
 	TF_CPP_MIN_LOG_LEVEL=3 python -m pytest tests/ -q \
 	  --ignore=tests/test_runtime.py \
 	  --ignore=tests/test_serving_coalesce.py \
-	  --ignore=tests/test_overload.py
+	  --ignore=tests/test_overload.py \
+	  --ignore=tests/test_coldstart.py
 
 # Seconds-scale pre-commit lane: the core-correctness modules (parity vs
 # the f64 oracle, assets/IO, golden demo, device lock, and the serving
@@ -81,21 +84,27 @@ bench-interpret:
 	  --serving-max-rows 16 --serving-max-bucket 32 \
 	  --spec-batch 64 --spec-fit-batch 8 --recovery-requests 6 \
 	  --coalesce-subjects 8 --coalesce-requests 48 --coalesce-max-bucket 32 \
-	  --overload-bursts 16
+	  --overload-bursts 16 --coldstart-requests 8 --coldstart-subjects 3 \
+	  --coldstart-max-bucket 4 --coldstart-waves 2
 
 # Serving-leg smoke (the bench-interpret counterpart for config7): the
 # whole serving-engine plumbing — bucket warm-up, ragged request stream,
 # interleaved engine-vs-direct overhead ratio, recompile/padding
 # counters — on CPU at small sizes, emitting the one-line serving
-# artifact — PLUS the fault-recovery drill (config7_recovery).
-# `scripts/bench_report.py` applies the serving done-criteria (ratio
-# >= 0.9x, zero steady recompiles) and the recovery criteria (100%
-# futures resolved under fault, bit-identical CPU failover, zero
-# post-recovery recompiles) to it.
+# artifact — PLUS the fault-recovery drill (config7_recovery), the
+# coalescing/overload legs, and the cold-start drill (config11, at
+# reduced sizes). `scripts/bench_report.py` applies the serving
+# done-criteria (ratio >= 0.9x, zero steady recompiles), the recovery
+# criteria (100% futures resolved under fault, bit-identical CPU
+# failover, zero post-recovery recompiles), and the cold-start criteria
+# (zero compiles after restore, restored-subject bit-identity, counted
+# degradation) to it.
 serve-smoke:
 	python bench.py --platform cpu --serving-only --serving-requests 96 \
 	  --serving-max-rows 16 --serving-max-bucket 32 --init-retries 2 \
-	  --coalesce-subjects 8 --coalesce-requests 48 --coalesce-max-bucket 32
+	  --coalesce-subjects 8 --coalesce-requests 48 --coalesce-max-bucket 32 \
+	  --coldstart-requests 16 --coldstart-subjects 4 \
+	  --coldstart-max-bucket 4 --coldstart-waves 3
 
 # Specialization-split smoke (the quick-lane half of PR 2's tooling):
 # the seconds-scale correctness story of the shape/pose split — bit-
@@ -139,6 +148,17 @@ coalesce-smoke:
 overload-smoke:
 	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_overload \
 	  python -m pytest tests/test_overload.py -q
+
+# Crash-safe restart matrix (the PR-6 tentpole): executable-lattice
+# bake/load bit-identity, every artifact damage class degrading to a
+# counted recompile, SubjectTable checkpoint/restore (orbax + pickle
+# fallback, LRU order, restore-vs-specialize race), and the cold-start
+# drill end-to-end. Wired into `make check` as a SEPARATE pytest
+# process on its own compile-cache dir (the CLAUDE.md rule: two pytest
+# processes must never share .jax_compile_cache/).
+coldstart-smoke:
+	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_coldstart \
+	  python -m pytest tests/test_coldstart.py -q
 
 # Unattended BUILDER-side TPU bench: lockfile-guarded, stands down for the
 # driver's priority claim, and self-expires (default 3 h) — see

@@ -580,7 +580,8 @@ def run_benchmarks(args, device_str: str) -> dict:
         if args.serving_only and name not in ("config7_serving",
                                               "config7_recovery",
                                               "config9_coalesce",
-                                              "config10_overload"):
+                                              "config10_overload",
+                                              "config11_coldstart"):
             return
         try:
             fn()
@@ -2073,6 +2074,44 @@ def run_benchmarks(args, device_str: str) -> dict:
     if args.overload_saturation > 0:
         section("config10_overload", config10_overload)
 
+    # -- config 11: cold-start/restart drill (PR 6) -------------------------
+    # THE shared protocol (serving/measure.py:cold_start_drill_run — also
+    # behind `mano serve-bench --cold-start`): bake the full executable
+    # lattice + SubjectTable checkpoint, kill the engine mid-traffic,
+    # cold-boot a fresh one, and measure process-start -> first served
+    # result -> p99-stable. Criteria (scripts/bench_report.py): ZERO jit
+    # compiles after restore with every reachable program served from
+    # the lattice (aot_loads accounting), restored subjects f32
+    # BIT-identical to fresh bakes, every damage injection (truncated
+    # entry, schema bump, digest mismatch, half-written checkpoint)
+    # degraded to a counted recompile with 100% of futures resolved,
+    # and a hang fault during boot cleared by the supervised path.
+    # Restarts are simulated in-process; every criterion is CPU-defined.
+    def config11_coldstart():
+        from mano_hand_tpu.serving.measure import cold_start_drill_run
+
+        cs = cold_start_drill_run(
+            right,
+            subjects=args.coldstart_subjects,
+            requests=args.coldstart_requests,
+            max_bucket=args.coldstart_max_bucket,
+            p99_waves=args.coldstart_waves,
+            seed=17,
+            log=lambda m: log(f"config11 {m}"),
+        )
+        results["coldstart"] = cs
+        log(f"config11 cold start: {cs['compiles_after_restore']} "
+            f"compiles after restore ({cs['aot_loads']}/"
+            f"{cs['expected_programs']} programs from the lattice), "
+            f"first result {cs['t_first_result_s'] * 1e3:,.0f} ms, "
+            f"p99 stable {cs['t_p99_stable_s'] * 1e3:,.0f} ms, "
+            f"restored-vs-fresh err {cs['restored_vs_fresh_max_abs_err']}, "
+            f"{len(cs['injections'])} damage injections degraded, hang "
+            f"leg {cs['hang_leg']['deadline_kills']} deadline kill(s)")
+
+    if args.coldstart_requests > 0:
+        section("config11_coldstart", config11_coldstart)
+
     if args.serving_only:
         # Fast serving-layer artifact (`make serve-smoke`): the deferred
         # runner's serving-only skip reduces the schedule to config7
@@ -2309,8 +2348,9 @@ def main() -> int:
     ap.add_argument("--serving-only", action="store_true",
                     help="run ONLY the serving-engine leg, the "
                          "fault-recovery drill, the mixed-subject "
-                         "coalescing leg and the overload drill (fast "
-                         "serving-layer artifact; `make serve-smoke`)")
+                         "coalescing leg, the overload drill and the "
+                         "cold-start drill (fast serving-layer "
+                         "artifact; `make serve-smoke`)")
     ap.add_argument("--coalesce-subjects", type=int, default=12,
                     help="distinct baked subjects in the mixed-subject "
                          "coalescing leg (config9; >= 8 engages the "
@@ -2340,6 +2380,22 @@ def main() -> int:
                     help="arrival bursts in the overload drill "
                          "(config10; one burst per 10 ms — saturation "
                          "is throttled in-process, no chip involved)")
+    ap.add_argument("--coldstart-requests", type=int, default=32,
+                    help="requests per stream of the cold-start drill "
+                         "(config11: lattice bake, kill, zero-compile "
+                         "restore, damage injections; restarts are "
+                         "simulated in-process, no chip involved; "
+                         "0 skips the leg)")
+    ap.add_argument("--coldstart-subjects", type=int, default=6,
+                    help="baked subjects the cold-start drill "
+                         "checkpoints and restores (config11)")
+    ap.add_argument("--coldstart-max-bucket", type=int, default=8,
+                    help="largest power-of-two bucket of the config11 "
+                         "engines (bounds the lattice size: every "
+                         "bucket bakes full+gather+cpu entries)")
+    ap.add_argument("--coldstart-waves", type=int, default=6,
+                    help="post-restore request waves used to call the "
+                         "p99 settled (config11)")
     ap.add_argument("--spec-batch", type=int, default=256,
                     help="batch for the specialization leg's full-vs-"
                          "pose-only forward comparison (config8); "

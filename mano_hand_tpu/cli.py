@@ -951,7 +951,9 @@ def cmd_serve_bench(args) -> int:
     coalescing protocol (bench.py config9's
     ``serving.measure.coalesce_bench_run``); ``--overload`` runs the
     overload/saturation drill (bench.py config10's
-    ``serving.measure.overload_drill_run``)."""
+    ``serving.measure.overload_drill_run``); ``--cold-start`` runs the
+    restart drill against a persistent ``--aot-dir`` (bench.py
+    config11's ``serving.measure.cold_start_drill_run``)."""
     import os
 
     import jax
@@ -1003,6 +1005,41 @@ def cmd_serve_bench(args) -> int:
                   name="serve-bench-watchdog").start()
     if args.emit_by < 0 and jax.default_backend() == "cpu":
         wd.disarm()  # auto mode: no tunnel to guard against on cpu
+
+    if args.cold_start:
+        # The cold-start/restart drill (the same protocol as bench.py
+        # config11: serving/measure.py:cold_start_drill_run — lattice
+        # bake, mid-traffic kill, zero-compile restore, damage
+        # injections, hang-composed boot), one JSON line of drill
+        # metrics, judged by scripts/bench_report.py.
+        if (args.chaos or args.subjects > 0 or args.overload
+                or args.deadline_s is not None):
+            # The flag-guard convention (PR 4/5): the drill fixes its
+            # own protocol — its own chaos hang leg, its own engines,
+            # its own deadlines — refuse rather than silently not run
+            # what the caller asked for.
+            print("--cold-start fixes its own protocol and does not "
+                  "compose with --chaos, --subjects, --overload, or "
+                  "--deadline-s", file=sys.stderr)
+            return 2
+        if not args.aot_dir:
+            # Refuse the aot-dir-less invocation by name: the drill's
+            # whole subject is the persistent artifact directory a
+            # restart reuses — defaulting to a temp dir would measure
+            # a lattice no real restart could ever hit.
+            print("--cold-start requires --aot-dir (the executable "
+                  "lattice and SubjectTable checkpoint live there; "
+                  "without it there is nothing for a restart to "
+                  "restore from)", file=sys.stderr)
+            return 2
+        from mano_hand_tpu.serving.measure import cold_start_drill_run
+
+        out = cold_start_drill_run(
+            params, aot_dir=args.aot_dir, seed=args.seed,
+            log=lambda m: print(m, file=sys.stderr))
+        out["backend"] = jax.default_backend()
+        print(json.dumps(out))
+        return 0
 
     if args.overload:
         # The overload/saturation drill (the same protocol as bench.py
@@ -1476,6 +1513,20 @@ def build_parser() -> argparse.ArgumentParser:
                          "scripts/bench_report.py. Saturation is "
                          "throttled in-process (chaos 'sat' plan) — no "
                          "chip required, none harmed")
+    sb.add_argument("--cold-start", action="store_true",
+                    help="run the cold-start/restart drill instead "
+                         "(serving/measure.py:cold_start_drill_run, "
+                         "the bench.py config11 protocol): bake the "
+                         "executable lattice + SubjectTable checkpoint "
+                         "into a drill-owned coldstart_drill/ subdir of "
+                         "--aot-dir (required; a production lattice in "
+                         "the dir itself is never touched), kill the "
+                         "mid-traffic, cold-boot, and judge zero jit "
+                         "compiles after restore, restored-subject "
+                         "bit-identity, and counted degradation of "
+                         "every damage injection; does not compose "
+                         "with --chaos/--subjects/--overload/"
+                         "--deadline-s")
     sb.add_argument("--overload-saturation", type=float, default=4.0,
                     help="offered-load multiple of the measured "
                          "service rate for --overload (criteria are "

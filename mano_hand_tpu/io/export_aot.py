@@ -126,6 +126,11 @@ def export_forward(
         "tip_vertex_ids": list(tips) if tips else None,
         "keypoint_order": keypoint_order,
         "platforms": list(platforms),
+        # Provenance guard (PR 6): a consumer that KNOWS which parameter
+        # set it wants can detect an artifact baked from a different one
+        # (same filename, wrong constants) instead of silently serving
+        # another asset's meshes — see ServingEngine._executable.
+        "params_digest": params_digest(params),
     }).encode()
     return _MAGIC + struct.pack("<I", len(header)) + header + blob
 
@@ -175,13 +180,8 @@ class AotForward:
         )
 
 
-def load_forward(src) -> AotForward:
-    """Load an artifact from a path or raw bytes; no model assets needed."""
-    if isinstance(src, (bytes, bytearray)):
-        data = bytes(src)
-    else:
-        with open(src, "rb") as f:
-            data = f.read()
+def _split_container(data: bytes):
+    """(meta, blob) of a ``_MAGIC`` container; ValueError on damage."""
     if data[: len(_MAGIC)] != _MAGIC:
         raise ValueError(
             "not a MANO AOT artifact (bad magic); expected a file written "
@@ -195,5 +195,377 @@ def load_forward(src) -> AotForward:
     if len(data) < off + hlen:
         raise ValueError("truncated MANO AOT artifact (incomplete header)")
     meta = json.loads(data[off:off + hlen].decode())
-    blob = data[off + hlen:]
+    return meta, data[off + hlen:]
+
+
+def load_forward(src) -> AotForward:
+    """Load an artifact from a path or raw bytes; no model assets needed."""
+    if isinstance(src, (bytes, bytearray)):
+        data = bytes(src)
+    else:
+        with open(src, "rb") as f:
+            data = f.read()
+    meta, blob = _split_container(data)
     return AotForward(meta, jax_export.deserialize(bytearray(blob)))
+
+
+# --------------------------------------------------------------------------
+# The executable lattice (PR 6): EVERY program the serving engine can
+# reach — (bucket x kind {full, pose-only gathered} x table capacity x
+# platform, plus the PR-3 CPU-failover tier) — pre-baked as versioned
+# artifacts keyed by params_digest, so a restarted process boots with
+# ZERO re-traces instead of a recompile storm.
+#
+# Unlike ``export_forward`` (constants baked in; a consumer needs only
+# jax), lattice entries keep the parameters / subject table as runtime
+# ARGUMENTS — the engine's bit-identity policy (constant-baking changes
+# XLA's float folding). The pytree containers (ManoParams, SubjectTable)
+# are not export-serializable, so entries use a FLAT-LEAF calling
+# convention: a plain tuple of the array leaves in a fixed order, with
+# the static aux data (parents, side) baked at trace time and guarded by
+# the digest. Measured on CPU: a deserialized entry's results are
+# f32 BIT-identical to the live jitted program (pinned in
+# tests/test_coldstart.py).
+#
+# Manifest format (``lattice.json``, documented in README "Cold start &
+# persistence"):
+#
+#     {"schema": 1,                 # LATTICE_SCHEMA_VERSION
+#      "params_digest": "<hex16>",  # params_digest() of the asset
+#      "dtype": "float32", "n_joints": 16, "n_shape": 10,
+#      "entries": {"full/b8":        {"file": ..., "sha256": ...,
+#                                     "bucket": 8, "platforms": [...]},
+#                  "gather/b8/c16":  {..., "capacity": 16},
+#                  "cpu/b8":         {...}}}
+#
+# Versioning rule: ``schema`` bumps on ANY incompatible change (calling
+# convention, key layout, checksum scheme). A loader seeing a different
+# schema — or a different params_digest, or a damaged entry — must
+# DEGRADE to a counted recompile (structured telemetry, never a crash,
+# never a silently-wrong executable); only same-schema, same-digest,
+# checksum-clean entries are served.
+
+LATTICE_SCHEMA_VERSION = 1
+LATTICE_MANIFEST = "lattice.json"
+
+# SubjectTable leaves in lattice calling-convention order.
+_TABLE_FIELDS = ("v_shaped", "joints", "shape", "pose_basis", "lbs_weights")
+
+
+def params_leaves(params: ManoParams):
+    """A ManoParams' array leaves as the flat tuple lattice ``full``/
+    ``cpu`` entries take (ARRAY_FIELDS order; parents/side ride as
+    static aux at bake, guarded by the digest)."""
+    return tuple(jnp.asarray(getattr(params, n)) for n in ARRAY_FIELDS)
+
+
+def table_leaves(table):
+    """A SubjectTable's array leaves as the flat tuple lattice ``gather``
+    entries take (fixed order; parents ride as static aux at bake)."""
+    return tuple(jnp.asarray(getattr(table, n)) for n in _TABLE_FIELDS)
+
+
+def _avals(leaves):
+    return tuple(
+        jax.ShapeDtypeStruct(np.asarray(a).shape, np.asarray(a).dtype)
+        for a in leaves)
+
+
+def _pack(kind: str, params: ManoParams, extra: dict, exported) -> bytes:
+    header = json.dumps({
+        "program": kind,
+        "schema": LATTICE_SCHEMA_VERSION,
+        "params_digest": params_digest(params),
+        "n_joints": params.j_regressor.shape[0],
+        "n_shape": params.shape_basis.shape[-1],
+        "dtype": str(params.v_template.dtype),
+        **extra,
+    }).encode()
+    blob = bytes(exported.serialize())
+    return _MAGIC + struct.pack("<I", len(header)) + header + blob
+
+
+def export_serve_full(
+    params: ManoParams, bucket: int, *,
+    platforms: Sequence[str] = ("cpu", "tpu"),
+    precision=DEFAULT_PRECISION,
+) -> bytes:
+    """One ``full`` lattice entry: the bucketed full forward with params
+    as runtime arguments — the SAME program family as the engine's live
+    ``build_bucket_executable`` jit, so a lattice-served bucket stays
+    bit-identical to the direct path. Call convention:
+    ``call(params_leaves, pose[b, J, 3], shape[b, S]) -> verts``."""
+    import dataclasses
+
+    from mano_hand_tpu.models import core
+
+    dtype = params.v_template.dtype
+    n_j = params.j_regressor.shape[0]
+    n_s = params.shape_basis.shape[-1]
+
+    def fn(leaves, pose, shape):
+        q = dataclasses.replace(
+            params, **{n: x for n, x in zip(ARRAY_FIELDS, leaves)})
+        return core.forward_batched(q, pose, shape,
+                                    precision=precision).verts
+
+    exported = jax_export.export(
+        jax.jit(fn), platforms=tuple(platforms))(
+        _avals(params_leaves(params)),
+        jax.ShapeDtypeStruct((bucket, n_j, 3), dtype),
+        jax.ShapeDtypeStruct((bucket, n_s), dtype))
+    return _pack("serve_full", params,
+                 {"bucket": int(bucket), "platforms": list(platforms)},
+                 exported)
+
+
+def export_serve_gather(
+    params: ManoParams, bucket: int, capacity: int, *,
+    platforms: Sequence[str] = ("cpu", "tpu"),
+    precision=DEFAULT_PRECISION,
+) -> bytes:
+    """One ``gather`` lattice entry: the mixed-subject pose-only program
+    (core.forward_posed_gather) at (bucket, table capacity), table and
+    index as runtime arguments. Call convention:
+    ``call(table_leaves, idx[b] int32, pose[b, J, 3]) -> verts``."""
+    import dataclasses
+
+    from mano_hand_tpu.models import core
+
+    dtype = params.v_template.dtype
+    n_j = params.j_regressor.shape[0]
+    table = core.subject_table(params, capacity)
+
+    def fn(leaves, idx, pose):
+        t = dataclasses.replace(
+            table, **{n: x for n, x in zip(_TABLE_FIELDS, leaves)})
+        return core.forward_posed_gather(t, idx, pose,
+                                         precision=precision).verts
+
+    exported = jax_export.export(
+        jax.jit(fn), platforms=tuple(platforms))(
+        _avals(table_leaves(table)),
+        jax.ShapeDtypeStruct((bucket,), np.int32),
+        jax.ShapeDtypeStruct((bucket, n_j, 3), dtype))
+    return _pack("serve_gather", params,
+                 {"bucket": int(bucket), "capacity": int(capacity),
+                  "platforms": list(platforms)},
+                 exported)
+
+
+def _entry_name(digest: str, key: str) -> str:
+    return f"lat_{digest}_{key.replace('/', '_')}.jaxexp"
+
+
+def bake_lattice(
+    params: ManoParams,
+    out_dir,
+    *,
+    buckets: Sequence[int],
+    capacities: Sequence[int] = (),
+    platforms: Sequence[str] = ("cpu", "tpu"),
+    cpu_fallback: bool = True,
+    log=None,
+) -> dict:
+    """Pre-bake the full executable lattice into ``out_dir``; returns the
+    manifest dict (also written as ``lattice.json``).
+
+    Entries: ``full/b{B}`` for every bucket; ``gather/b{B}/c{C}`` for
+    every (bucket, capacity) pair; ``cpu/b{B}`` (the PR-3 failover tier,
+    platforms=("cpu",)) when ``cpu_fallback``. Baking is trace + lower +
+    serialize — no backend compile — so it is warm-up-class host work.
+    Every write is atomic (temp + rename) and the manifest lands LAST,
+    so a process killed mid-bake leaves either no manifest (no lattice —
+    the engine jit-compiles as before) or a complete, checksummed one.
+    """
+    import os
+    from pathlib import Path
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    digest = params_digest(params)
+    # MERGE into an existing same-schema, same-digest manifest: two
+    # engines with different bucket/capacity configs sharing one
+    # aot_dir (or a drill beside a production engine) must union their
+    # entries, not clobber each other's. Any other manifest (different
+    # digest, different schema, unreadable) is replaced wholesale.
+    entries = {}
+    prior = out_dir / LATTICE_MANIFEST
+    if prior.exists():
+        try:
+            old = json.loads(prior.read_text())
+            if (old.get("schema") == LATTICE_SCHEMA_VERSION
+                    and old.get("params_digest") == digest):
+                entries = dict(old.get("entries") or {})
+        except (OSError, ValueError):
+            pass
+
+    def emit(key: str, data: bytes, meta: dict):
+        name = _entry_name(digest, key)
+        tmp = out_dir / f"{name}.tmp{os.getpid()}"
+        tmp.write_bytes(data)
+        os.replace(tmp, out_dir / name)
+        entries[key] = {
+            "file": name,
+            "sha256": hashlib.sha256(data).hexdigest(),
+            **meta,
+        }
+        if log:
+            log(f"lattice: baked {key} ({len(data)} bytes)")
+
+    for b in buckets:
+        emit(f"full/b{b}",
+             export_serve_full(params, b, platforms=platforms),
+             {"bucket": int(b), "platforms": list(platforms)})
+        for c in capacities:
+            emit(f"gather/b{b}/c{c}",
+                 export_serve_gather(params, b, c, platforms=platforms),
+                 {"bucket": int(b), "capacity": int(c),
+                  "platforms": list(platforms)})
+        if cpu_fallback:
+            emit(f"cpu/b{b}",
+                 export_serve_full(params, b, platforms=("cpu",)),
+                 {"bucket": int(b), "platforms": ["cpu"]})
+
+    manifest = {
+        "schema": LATTICE_SCHEMA_VERSION,
+        "params_digest": digest,
+        "dtype": str(params.v_template.dtype),
+        "n_joints": int(params.j_regressor.shape[0]),
+        "n_shape": int(params.shape_basis.shape[-1]),
+        "entries": entries,
+    }
+    tmp = out_dir / f"{LATTICE_MANIFEST}.tmp{os.getpid()}"
+    tmp.write_text(json.dumps(manifest, indent=1, sort_keys=True))
+    os.replace(tmp, out_dir / LATTICE_MANIFEST)
+    return manifest
+
+
+class ExecutableLattice:
+    """Boot-time view of a baked lattice directory.
+
+    ``get(kind, bucket, capacity)`` returns the jitted deserialized
+    program, or None when the entry is absent or DAMAGED — a truncated,
+    corrupted, checksum- or digest-mismatched entry is reported through
+    ``on_failure`` (the engine counts it as ``aot_load_failures``) and
+    the caller falls back to a jit compile; a bad entry can never crash
+    boot or serve silently-wrong results (the checksum covers the whole
+    file; the header digest re-checks provenance after the checksum).
+    Deserialized programs are cached, so a warm entry is a dict hit.
+    """
+
+    def __init__(self, directory, manifest: dict, on_failure=None):
+        from pathlib import Path
+
+        self.dir = Path(directory)
+        self.manifest = manifest
+        self._on_failure = on_failure
+        self._cache: dict = {}
+        self._bad: set = set()
+
+    @staticmethod
+    def key_of(kind: str, bucket: int, capacity=None) -> str:
+        if kind == "gather":
+            return f"gather/b{bucket}/c{capacity}"
+        return f"{kind}/b{bucket}"
+
+    def __contains__(self, key: str) -> bool:
+        return key in self.manifest.get("entries", {})
+
+    def _fail(self, key: str, reason: str):
+        import warnings
+
+        self._bad.add(key)
+        if self._on_failure is not None:
+            self._on_failure(key, reason)
+        warnings.warn(
+            f"lattice entry {key}: {reason}; degrading to a jit "
+            "recompile (counted)")
+        return None
+
+    def get(self, kind: str, bucket: int, capacity=None, platform=None):
+        """``platform`` (e.g. ``jax.default_backend()``) additionally
+        requires the entry to have been lowered for that backend — an
+        entry baked for other platforms is a counted degrade, not a
+        call-time crash in the middle of boot."""
+        key = self.key_of(kind, bucket, capacity)
+        if key in self._cache:
+            return self._cache[key]
+        if key in self._bad:
+            return None
+        ent = self.manifest.get("entries", {}).get(key)
+        if ent is None:
+            return None        # never baked: a plain miss, not a failure
+        path = self.dir / ent["file"]
+        try:
+            data = path.read_bytes()
+        except OSError as e:
+            return self._fail(key, f"unreadable ({e})")
+        got = hashlib.sha256(data).hexdigest()
+        if got != ent["sha256"]:
+            return self._fail(
+                key, "checksum mismatch (truncated or corrupted entry)")
+        try:
+            meta, blob = _split_container(data)
+        except ValueError as e:
+            return self._fail(key, str(e))
+        if meta.get("schema") != self.manifest.get("schema"):
+            return self._fail(
+                key, f"entry schema {meta.get('schema')} != manifest "
+                     f"{self.manifest.get('schema')}")
+        if meta.get("params_digest") != self.manifest.get("params_digest"):
+            return self._fail(
+                key, "entry params_digest does not match the manifest "
+                     "(artifact baked from a different parameter set)")
+        if platform is not None and platform not in (
+                meta.get("platforms") or ()):
+            return self._fail(
+                key, f"entry was lowered for {meta.get('platforms')}, "
+                     f"not the running backend {platform!r}")
+        try:
+            call = jax.jit(jax_export.deserialize(bytearray(blob)).call)
+        except Exception as e:  # noqa: BLE001 — degrade, never crash boot
+            return self._fail(key, f"deserialize failed "
+                                   f"({type(e).__name__}: {e})")
+        self._cache[key] = call
+        return call
+
+
+def load_lattice(aot_dir, params_or_digest, *, on_failure=None):
+    """Open ``aot_dir``'s lattice for the given parameter set.
+
+    Returns None when no manifest exists (no lattice was ever baked —
+    not a fault) AND when the manifest is unusable (unparseable, wrong
+    schema version, or baked for a different ``params_digest``): those
+    report through ``on_failure("<manifest>", reason)`` and the engine
+    boots latticeless — a counted recompile storm beats wrong results.
+    """
+    from pathlib import Path
+
+    path = Path(aot_dir) / LATTICE_MANIFEST
+    if not path.exists():
+        return None
+    digest = (params_or_digest if isinstance(params_or_digest, str)
+              else params_digest(params_or_digest))
+
+    def fail(reason):
+        import warnings
+
+        if on_failure is not None:
+            on_failure("<manifest>", reason)
+        warnings.warn(f"lattice manifest {path}: {reason}; booting "
+                      "without the lattice (counted)")
+        return None
+
+    try:
+        manifest = json.loads(path.read_text())
+    except (OSError, ValueError) as e:
+        return fail(f"unreadable ({type(e).__name__}: {e})")
+    if manifest.get("schema") != LATTICE_SCHEMA_VERSION:
+        return fail(f"schema {manifest.get('schema')} != supported "
+                    f"{LATTICE_SCHEMA_VERSION} (versioning rule: bump = "
+                    "re-bake)")
+    if manifest.get("params_digest") != digest:
+        return fail(f"params_digest {manifest.get('params_digest')} does "
+                    f"not match this parameter set ({digest})")
+    return ExecutableLattice(aot_dir, manifest, on_failure=on_failure)

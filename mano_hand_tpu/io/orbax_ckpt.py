@@ -94,6 +94,107 @@ def wait() -> None:
         _ASYNC_CKPTR.wait_until_finished()
 
 
+# --------------------------------------------------------------------------
+# Warm-state persistence (PR 6): a (meta, arrays) state pair — JSON-able
+# metadata plus a flat dict of numpy arrays — written either through
+# Orbax (the JAX-ecosystem-native path: sharded, async-capable) or a
+# pickle fallback when orbax is absent, so the SubjectTable checkpoint
+# (serving/engine.py:checkpoint_subjects) works on every install. The
+# two layouts are self-describing: the loader detects which backend
+# wrote a directory, so a checkpoint travels between installs.
+
+_STATE_META = "state_meta.json"
+_STATE_ARRAYS = "arrays"          # orbax PyTree subdirectory
+_STATE_PICKLE = "state.pkl"       # pickle-fallback single file
+
+
+def save_state(meta: dict, arrays: dict, path: PathLike,
+               *, backend: Optional[str] = None) -> Path:
+    """Persist ``(meta, arrays)`` into directory ``path``.
+
+    ``backend``: None auto-selects (orbax when importable, else pickle);
+    ``"orbax"`` / ``"pickle"`` force one (tests pin the fallback this
+    way). Writes are crash-safe at the directory level: the meta file
+    lands LAST, so a half-written checkpoint is detected as absent by
+    ``load_state`` rather than restored half-blank.
+    """
+    import json
+    import os
+
+    path = Path(path).absolute()
+    if backend is None:
+        backend = "orbax" if available() else "pickle"
+    if backend not in ("orbax", "pickle"):
+        raise ValueError(f"backend must be 'orbax' or 'pickle', "
+                         f"got {backend!r}")
+    path.mkdir(parents=True, exist_ok=True)
+    arrays = {k: np.asarray(v) for k, v in arrays.items()}
+    # Zero-size arrays ride in the meta sidecar as (shape, dtype):
+    # orbax/tensorstore refuses empty tensors, and an empty leaf carries
+    # no bytes anyway. Applied to both backends so the layouts agree.
+    empty = {k: [list(v.shape), str(v.dtype)]
+             for k, v in arrays.items() if v.size == 0}
+    arrays = {k: v for k, v in arrays.items() if v.size > 0}
+    meta = {**meta, "_empty_arrays": empty}
+    if backend == "orbax":
+        if arrays:
+            ocp = _ocp()
+            ckptr = ocp.StandardCheckpointer()
+            ckptr.save(path / _STATE_ARRAYS, arrays, force=True)
+            ckptr.wait_until_finished()
+        else:
+            # Every array was empty this time: a STALE arrays/ dir from
+            # a previous checkpoint at this path must not be restored
+            # against the new meta (load_state keys off its existence).
+            import shutil
+
+            shutil.rmtree(path / _STATE_ARRAYS, ignore_errors=True)
+    else:
+        import pickle
+
+        tmp = path / f"{_STATE_PICKLE}.tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump(arrays, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path / _STATE_PICKLE)
+    tmp = path / f"{_STATE_META}.tmp{os.getpid()}"
+    tmp.write_text(json.dumps({**meta, "backend": backend},
+                              indent=1, sort_keys=True))
+    os.replace(tmp, path / _STATE_META)
+    return path
+
+
+def load_state(path: PathLike):
+    """Restore a ``save_state`` checkpoint: ``(meta, arrays)`` with
+    host-resident numpy arrays. Raises FileNotFoundError when ``path``
+    holds no complete checkpoint (no meta file — including the killed-
+    mid-write case, whose meta never landed)."""
+    import json
+    import pickle
+
+    path = Path(path).absolute()
+    meta_path = path / _STATE_META
+    if not meta_path.exists():
+        raise FileNotFoundError(
+            f"no complete checkpoint at {path} (missing {_STATE_META})")
+    meta = json.loads(meta_path.read_text())
+    backend = meta.get("backend", "pickle")
+    if backend == "orbax":
+        arrays_dir = path / _STATE_ARRAYS
+        if arrays_dir.exists():
+            ocp = _ocp()
+            restored = ocp.StandardCheckpointer().restore(arrays_dir)
+            arrays = {k: np.asarray(v) for k, v in restored.items()}
+        else:
+            arrays = {}     # every array was empty (meta sidecar only)
+    else:
+        with open(path / _STATE_PICKLE, "rb") as f:
+            arrays = pickle.load(f)
+        arrays = {k: np.asarray(v) for k, v in arrays.items()}
+    for k, (shape, dtype) in (meta.pop("_empty_arrays", None) or {}).items():
+        arrays[k] = np.zeros(shape, dtype)
+    return meta, arrays
+
+
 def load(path: PathLike, target: Optional[Any] = None) -> dict:
     """Restore a checkpoint as a dict of numpy arrays.
 

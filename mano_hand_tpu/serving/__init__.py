@@ -19,7 +19,9 @@ from mano_hand_tpu.serving.buckets import (
 from mano_hand_tpu.serving.engine import ServingEngine, ServingError
 from mano_hand_tpu.serving.measure import (
     coalesce_bench_run,
+    cold_start_drill_run,
     measure_overhead,
+    overload_drill_run,
     recovery_drill_run,
     serve_bench_run,
 )
@@ -28,6 +30,8 @@ __all__ = [
     "ServingEngine",
     "ServingError",
     "coalesce_bench_run",
+    "cold_start_drill_run",
+    "overload_drill_run",
     "recovery_drill_run",
     "measure_overhead",
     "serve_bench_run",

@@ -656,6 +656,390 @@ def overload_drill_run(
     }
 
 
+def cold_start_drill_run(
+    params,
+    *,
+    subjects: int = 6,
+    requests: int = 48,
+    max_rows: int = 4,
+    max_bucket: int = 8,
+    max_subjects: int = 8,
+    aot_dir=None,
+    p99_waves: int = 6,
+    hang_deadline_s: float = 2.0,
+    seed: int = 0,
+    log: Callable[[str], None] = None,
+) -> dict:
+    """THE cold-start/restart drill protocol — shared by ``bench.py``
+    config11, `mano serve-bench --cold-start`, and tests/test_coldstart.py
+    so the three artifacts cannot diverge (the recovery-drill pattern).
+
+    The scenario PR 6 exists for: at scale, process restarts are routine
+    — and a recompile storm at boot is an outage, while every subject
+    specialized since PR 2/4 evaporates with the process. The drill
+    treats restart as a fault class with measured criteria:
+
+    * **Phase A (the doomed process)**: a warm engine — ``subjects``
+      baked, every bucket warmed — ``bake_lattice()``s its reachable
+      executable lattice, checkpoints its SubjectTable, then is KILLED
+      mid-traffic (a burst of in-flight futures + ``stop(timeout_s=)``):
+      every outstanding future must still resolve (result or structured
+      ServingError) — the PR-3 no-hang guarantee at death.
+    * **Phase B (the cold start)**: a fresh engine on the same artifacts
+      restores the checkpoint and warms every program, measuring
+      process-start -> restore done -> warm done -> FIRST served result
+      -> p99-stable (wave p99s within 1.5x of the settled p99). The
+      criteria: ``compiles_after_restore`` == 0 with ``aot_loads`` ==
+      the full reachable program count (the lattice served everything —
+      proof by accounting, not hope), and a restored subject's pose-only
+      results f32 BIT-identical to a freshly-baked one.
+    * **Phase C (damage injections)**: a truncated lattice entry, a
+      schema-bumped manifest (the versioning rule), a digest-mismatched
+      manifest, and a half-written SubjectTable checkpoint — each boots
+      a fresh engine against the damaged artifacts and must DEGRADE to
+      counted recompiles/re-specializes (``aot_load_failures``) while
+      still resolving 100% of its stream; never a crash, never a
+      silently-wrong executable.
+    * **Phase D (chaos composes)**: the restore/boot runs under a
+      ``hang`` chaos fault with a supervised policy — the wedged first
+      dispatch must hit the PR-3 deadline-kill path (and the lattice-
+      loaded CPU failover tier stands warm behind it), not wedge boot.
+
+    Everything runs on whatever backend is up; restarts are simulated
+    in-process (fresh engine == cold executable caches; the jit
+    persistent compilation cache is not consulted by the counters), so
+    no chip is required and none is harmed.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.io.export_aot import LATTICE_MANIFEST
+    from mano_hand_tpu.models import core
+    from mano_hand_tpu.runtime.chaos import ChaosPlan
+    from mano_hand_tpu.runtime.supervise import DispatchPolicy
+    from mano_hand_tpu.serving import buckets as bucket_mod
+    from mano_hand_tpu.serving.engine import ServingEngine, ServingError
+
+    if subjects < 1:
+        raise ValueError(f"subjects must be >= 1, got {subjects}")
+    if requests < 1:
+        raise ValueError(f"requests must be >= 1, got {requests}")
+    max_rows = min(max_rows, max_bucket)
+    n_joints, n_shape = params.n_joints, params.n_shape
+    rng = np.random.default_rng(seed)
+    betas = [rng.normal(size=(n_shape,)).astype(np.float32)
+             for _ in range(subjects)]
+
+    tmp_root = None
+    from pathlib import Path
+
+    if aot_dir is None:
+        tmp_root = tempfile.mkdtemp(prefix="mano_coldstart_")
+        aot_dir = Path(tmp_root)
+    else:
+        # The drill OWNS a subdirectory of the caller's dir: its engines
+        # are drill-sized, and although bake_lattice merges into a
+        # same-digest manifest, a production lattice living in aot_dir
+        # proper must never share a manifest (or damage-leg copies)
+        # with drill artifacts. Re-runs still reuse the warm drill
+        # lattice — the restart-measures-something-real property.
+        aot_dir = Path(aot_dir) / "coldstart_drill"
+        aot_dir.mkdir(parents=True, exist_ok=True)
+    ckpt = aot_dir / "subjects_ckpt"
+
+    def make_stream(n, keys):
+        """Half full-path, half pose-only across the baked subjects —
+        both program kinds exercise the lattice. (pose, shape, subject)
+        submit triples, same shape as the recovery drill's."""
+        sizes = rng.integers(1, max_rows + 1, size=n)
+        out = []
+        for i, s in enumerate(sizes):
+            pose = rng.normal(
+                scale=0.4, size=(int(s), n_joints, 3)).astype(np.float32)
+            if keys and i % 2 == 1:
+                out.append((pose, None, keys[i % len(keys)]))
+            else:
+                out.append((pose, rng.normal(
+                    size=(int(s), n_shape)).astype(np.float32), None))
+        return out
+
+    def run_stream(eng, stream, timeout_s=60.0):
+        """(resolved_ok, resolved_error, unresolved, wall_s)."""
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, s, subject=k) for p, s, k in stream]
+        ok = err = un = 0
+        for f in futs:
+            try:
+                f.result(timeout=timeout_s)
+                ok += 1
+            except ServingError:
+                err += 1
+            except Exception:   # noqa: BLE001 — a timeout IS the bug
+                un += 1
+        return ok, err, un, time.perf_counter() - t0
+
+    engine_kw = dict(max_bucket=max_bucket, max_delay_s=0.001,
+                     max_subjects=max_subjects)
+
+    # ---- Phase A: the doomed process ----------------------------------
+    eng_a = ServingEngine(params, aot_dir=aot_dir, **engine_kw)
+    probe_pose = rng.normal(
+        scale=0.4, size=(2, n_joints, 3)).astype(np.float32)
+    with eng_a:
+        keys = [eng_a.specialize(b) for b in betas]
+        eng_a.warmup()
+        eng_a.warmup_posed()
+        manifest = eng_a.bake_lattice(include_cpu_fallback=True)
+        stream_a = make_stream(requests, keys)
+        ok_a, err_a, un_a, _ = run_stream(eng_a, stream_a)
+        # The reference results a restored subject must reproduce
+        # bitwise, captured through the LIVE warm engine.
+        want_posed = [np.asarray(eng_a.forward(probe_pose, subject=k))
+                      for k in keys[:min(3, len(keys))]]
+        eng_a.checkpoint_subjects(ckpt)
+        # The kill: a burst left in flight, then a bounded stop — the
+        # process dies with work outstanding, as real kills do.
+        kill_futs = [eng_a.submit(p, s, subject=k)
+                     for p, s, k in make_stream(
+                         min(requests, 16), keys)]
+    # context exit == stop(): every future must be DONE now (result or
+    # structured error), the PR-3 guarantee at death.
+    killed_resolved = sum(f.done() for f in kill_futs)
+    baked_compiles = eng_a.counters.compiles
+    if log:
+        log(f"cold-start A: {len(manifest['entries'])} lattice entries "
+            f"baked, checkpoint written, killed with "
+            f"{killed_resolved}/{len(kill_futs)} in-flight futures "
+            f"resolved")
+
+    # ---- Phase B: the cold start --------------------------------------
+    # Expected reachable programs at boot: every bucket's full program +
+    # every bucket's gathered program at the restored capacity. (The CPU
+    # failover tier is unreachable without a supervising policy; phase D
+    # accounts for it.)
+    eng_b = ServingEngine(params, aot_dir=aot_dir, **engine_kw)
+    t0 = time.perf_counter()
+    with eng_b:
+        restore = eng_b.restore_subjects(ckpt)
+        t_restore = time.perf_counter() - t0
+        warm_full = eng_b.warmup()
+        warm_posed = eng_b.warmup_posed()
+        t_warm = time.perf_counter() - t0
+        first = eng_b.forward(probe_pose, subject=keys[0])
+        t_first = time.perf_counter() - t0
+        # Bit-identity: the restored subject vs the phase-A warm engine,
+        # AND vs a freshly-baked ShapedHand through the posed program at
+        # the same padded size (the PR-4 gather contract, now across a
+        # restart).
+        restored_err = 0.0
+        for k, want in zip(keys, want_posed):
+            got = np.asarray(eng_b.forward(probe_pose, subject=k))
+            restored_err = max(restored_err,
+                               float(np.abs(got - want).max()))
+        b = bucket_mod.bucket_for(probe_pose.shape[0], eng_b.buckets)
+        fresh = core.jit_specialize(
+            params.astype(np.float32).device_put(), jnp.asarray(betas[0]))
+        fresh_out = np.asarray(core.jit_forward_posed_batched(
+            fresh, jnp.asarray(bucket_mod.pad_rows(probe_pose, b)))
+            .verts)[:probe_pose.shape[0]]
+        got0 = np.asarray(eng_b.forward(probe_pose, subject=keys[0]))
+        restored_vs_fresh = float(np.abs(got0 - fresh_out).max())
+        # p99 settling: waves of the steady stream; stable once every
+        # later wave's p99 sits within 1.5x of the settled p99.
+        wave_p99 = []
+        wave_t = []
+        for _ in range(max(1, p99_waves)):
+            stream = make_stream(requests, keys)
+            t_w0 = time.perf_counter()
+            futs = [(eng_b.submit(p, s, subject=k), time.perf_counter())
+                    for p, s, k in stream]
+            lats = []
+            for f, t_sub in futs:
+                f.result(timeout=60.0)
+                lats.append(time.perf_counter() - t_sub)
+            wave_p99.append(float(np.percentile(lats, 99)))
+            wave_t.append(time.perf_counter() - t0)
+        settled = float(np.median(wave_p99[-min(3, len(wave_p99)):]))
+        t_p99 = wave_t[-1]
+        for i, p99 in enumerate(wave_p99):
+            if all(w <= 1.5 * settled for w in wave_p99[i:]):
+                t_p99 = wave_t[i]
+                break
+        compiles_after_restore = eng_b.counters.compiles
+        aot_loads = eng_b.counters.aot_loads
+        snap_b = eng_b.counters.snapshot()
+    expected_programs = 2 * len(eng_b.buckets)
+    if log:
+        log(f"cold-start B: restore {restore}, first result at "
+            f"{t_first * 1e3:.0f} ms, p99 stable at {t_p99 * 1e3:.0f} ms "
+            f"({compiles_after_restore} compiles, {aot_loads}/"
+            f"{expected_programs} programs from the lattice, restored-vs-"
+            f"fresh err {restored_vs_fresh:.1e})")
+
+    # ---- Phase C: damage injections -----------------------------------
+    import json
+
+    injections = {}
+
+    def injection_leg(name: str, damage):
+        """Copy the artifacts, apply ``damage(dir)``, cold-boot against
+        them; the leg must resolve its whole stream with the damage
+        degraded to counted recompiles/re-specializes."""
+        leg_dir = aot_dir.parent / f"{aot_dir.name}_{name}"
+        if leg_dir.exists():
+            shutil.rmtree(leg_dir)
+        shutil.copytree(aot_dir, leg_dir)
+        damage(leg_dir)
+        eng = ServingEngine(params, aot_dir=leg_dir, **engine_kw)
+        import warnings
+
+        with eng, warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            rs = eng.restore_subjects(leg_dir / "subjects_ckpt")
+            eng.warmup()
+            leg_keys = [eng.specialize(b) for b in betas]
+            eng.warmup_posed()
+            ok, err, un, _ = run_stream(
+                eng, make_stream(requests, leg_keys))
+        injections[name] = {
+            "submitted": requests,
+            "resolved_ok": ok,
+            "resolved_error": err,
+            "unresolved": un,
+            "futures_resolved_fraction": 1.0 - un / requests,
+            "aot_load_failures": eng.counters.aot_load_failures,
+            "recompiles": eng.counters.compiles,
+            "aot_loads": eng.counters.aot_loads,
+            "subjects_restored": eng.counters.subjects_restored,
+            "restore": rs,
+        }
+        shutil.rmtree(leg_dir, ignore_errors=True)
+        if log:
+            i = injections[name]
+            log(f"cold-start C [{name}]: {i['aot_load_failures']} load "
+                f"failures -> {i['recompiles']} recompiles, "
+                f"{i['resolved_ok']}/{i['submitted']} ok, "
+                f"{i['unresolved']} unresolved")
+
+    def truncate_entry(d):
+        # Key off the engine's REAL bucket ladder: a non-power-of-two
+        # max_bucket argument rounds UP at bucket_sizes(), so the raw
+        # argument may name an entry that was never baked.
+        key = f"full/b{eng_b.buckets[-1]}"
+        ent = manifest["entries"][key]
+        f = d / ent["file"]
+        f.write_bytes(f.read_bytes()[:64])
+        # Remove the legacy per-bucket artifacts too: this leg pins the
+        # FULL degradation chain (lattice -> legacy -> jit) ending in a
+        # counted recompile, not a quiet save by the older tier. The
+        # other legs keep them, demonstrating tier fallback instead.
+        for legacy in d.glob("serve_*.jaxexp"):
+            legacy.unlink()
+
+    def bump_schema(d):
+        man = json.loads((d / LATTICE_MANIFEST).read_text())
+        man["schema"] = man["schema"] + 1
+        (d / LATTICE_MANIFEST).write_text(json.dumps(man))
+
+    def mismatch_digest(d):
+        man = json.loads((d / LATTICE_MANIFEST).read_text())
+        man["params_digest"] = "0" * len(man["params_digest"])
+        (d / LATTICE_MANIFEST).write_text(json.dumps(man))
+
+    def damage_ckpt(d):
+        # A process killed mid-checkpoint: the meta file never landed
+        # (save_state writes it LAST), so restore must degrade cleanly.
+        meta = d / "subjects_ckpt" / "state_meta.json"
+        meta.write_text(meta.read_text()[: max(1, meta.stat().st_size // 2)])
+
+    injection_leg("truncated_entry", truncate_entry)
+    injection_leg("schema_bump", bump_schema)
+    injection_leg("digest_mismatch", mismatch_digest)
+    injection_leg("damaged_checkpoint", damage_ckpt)
+
+    # ---- Phase D: restore under a hang fault --------------------------
+    # The boot itself runs supervised: the chaos plan wedges the FIRST
+    # post-restore dispatch; the deadline kill must clear it (the PR-3
+    # path), the retry serve the result, and the lattice-loaded CPU
+    # failover tier stand warm behind the whole arrangement — boot can
+    # degrade, never wedge.
+    plan = ChaosPlan("hang@0")
+    policy = DispatchPolicy(
+        deadline_s=hang_deadline_s, retries=1, backoff_s=0.01,
+        backoff_cap_s=0.02, jitter=0.0, breaker=None, chaos=plan,
+        cpu_fallback=True,
+    )
+    eng_d = ServingEngine(params, aot_dir=aot_dir, policy=policy,
+                          **engine_kw)
+    try:
+        with eng_d:
+            rs_d = eng_d.restore_subjects(ckpt)
+            eng_d.warmup()          # primary + CPU failover tiers
+            eng_d.warmup_posed()    # gathered tier (restored capacity)
+            hang_stream = make_stream(min(requests, 12), keys)
+            ok_d, err_d, un_d, _ = run_stream(
+                eng_d, hang_stream,
+                timeout_s=hang_deadline_s * 4 + 30.0)
+    finally:
+        plan.release.set()   # free the abandoned hung worker thread
+    hang_leg = {
+        "submitted": len(hang_stream),
+        "resolved_ok": ok_d,
+        "resolved_error": err_d,
+        "unresolved": un_d,
+        "futures_resolved_fraction": 1.0 - un_d / len(hang_stream),
+        "deadline_kills": eng_d.counters.deadline_kills,
+        "compiles_after_restore": eng_d.counters.compiles,
+        "aot_loads": eng_d.counters.aot_loads,
+        "expected_programs": 3 * len(eng_d.buckets),
+        "subjects_restored": eng_d.counters.subjects_restored,
+        "restore": rs_d,
+    }
+    if log:
+        log(f"cold-start D [hang]: {hang_leg['deadline_kills']} deadline "
+            f"kill(s), {ok_d}/{len(hang_stream)} ok, "
+            f"{hang_leg['aot_loads']}/{hang_leg['expected_programs']} "
+            f"programs from the lattice, "
+            f"{hang_leg['compiles_after_restore']} compiles")
+
+    if tmp_root is not None:
+        shutil.rmtree(tmp_root, ignore_errors=True)
+
+    return {
+        "subjects": int(subjects),
+        "requests": int(requests),
+        "max_subjects": int(max_subjects),
+        "buckets": list(eng_b.buckets),
+        "lattice_entries": len(manifest["entries"]),
+        "baked_compiles": int(baked_compiles),
+        "killed_inflight": len(kill_futs),
+        "killed_futures_resolved_fraction": float(
+            f"{killed_resolved / len(kill_futs):.6g}"),
+        "restore": restore,
+        "warmup_sources": {str(b): s for b, s in warm_full.items()},
+        "warmup_posed_sources": {str(b): s for b, s in warm_posed.items()},
+        "compiles_after_restore": int(compiles_after_restore),
+        "aot_loads": int(aot_loads),
+        "aot_load_failures": int(snap_b["aot_load_failures"]),
+        "expected_programs": int(expected_programs),
+        "subjects_restored": int(snap_b["subjects_restored"]),
+        "restored_vs_warm_max_abs_err": float(restored_err),
+        "restored_vs_fresh_max_abs_err": float(restored_vs_fresh),
+        "t_restore_s": float(f"{t_restore:.5g}"),
+        "t_warm_s": float(f"{t_warm:.5g}"),
+        "t_first_result_s": float(f"{t_first:.5g}"),
+        "t_p99_stable_s": float(f"{t_p99:.5g}"),
+        "wave_p99_ms": [float(f"{w * 1e3:.4g}") for w in wave_p99],
+        "injections": injections,
+        "hang_leg": hang_leg,
+        "phase_a": {"submitted": requests, "resolved_ok": ok_a,
+                    "resolved_error": err_a, "unresolved": un_a},
+    }
+
+
 def recovery_drill_run(
     params,
     *,

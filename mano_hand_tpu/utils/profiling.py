@@ -108,6 +108,13 @@ class ServingCounters:
         self._lock = threading.Lock()
         self.compiles = 0          # fresh trace+compile events (cache misses)
         self.aot_loads = 0         # executables revived from disk artifacts
+        # Crash-safe restart telemetry (PR 6): a damaged/mismatched AOT
+        # artifact or lattice entry DEGRADES to a counted recompile —
+        # this is the count (never a crash, never silently served);
+        # ``subjects_restored`` counts SubjectTable rows revived from a
+        # checkpoint without re-running the shape-stage bake.
+        self.aot_load_failures = 0
+        self.subjects_restored = 0
         self.dispatches = 0        # batches sent to the device
         self.rows_live = 0         # real request rows dispatched
         self.rows_padded = 0       # pad rows dispatched alongside them
@@ -153,6 +160,20 @@ class ServingCounters:
     def count_aot_load(self, n: int = 1) -> None:
         with self._lock:
             self.aot_loads += n
+
+    def count_aot_load_failure(self, n: int = 1) -> None:
+        """One AOT artifact / lattice entry that could NOT be served
+        (truncated, corrupted, checksum or params_digest mismatch) and
+        fell back to a jit compile — the structured-degradation counter
+        the cold-start drill's corruption legs assert on."""
+        with self._lock:
+            self.aot_load_failures += n
+
+    def count_restore(self, n: int = 1) -> None:
+        """One subject revived from a SubjectTable checkpoint (row
+        written from persisted bytes; no shape-stage bake ran)."""
+        with self._lock:
+            self.subjects_restored += n
 
     def count_specialize(self, hit: bool) -> None:
         """One per-subject specialization lookup (serving/engine.py): a
@@ -334,6 +355,8 @@ class ServingCounters:
             base = {
                 "compiles": self.compiles,
                 "aot_loads": self.aot_loads,
+                "aot_load_failures": self.aot_load_failures,
+                "subjects_restored": self.subjects_restored,
                 "dispatches": self.dispatches,
                 "rows_live": self.rows_live,
                 "rows_padded": self.rows_padded,

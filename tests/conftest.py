@@ -66,6 +66,13 @@ def pytest_configure(config):
         "lane (`make check-quick`); the full suite remains the snapshot "
         "gate",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: wall-clock-heavy drill/e2e modules excluded from the "
+        "tier-1 `-m 'not slow'` lane; each has its own make smoke "
+        "target (separate pytest process + compile-cache dir) wired "
+        "into `make check`",
+    )
 
 
 @pytest.fixture(autouse=True, scope="module")

@@ -345,3 +345,112 @@ def test_overload_metrics_block(tmp_path):
     p = _run(str(only))
     assert p.returncode == 1
     assert "[FAIL] overload_leg_ran" in p.stdout
+
+
+def test_coldstart_metrics_block(tmp_path):
+    """The cold-start/restart drill (config11, PR 6): zero jit compiles
+    after restore with every program lattice-served, restored subjects
+    bit-identical, damage injections degraded-and-counted, hang faults
+    cleared by the supervised path — judged inside a serving-only
+    artifact AND as a raw `serve-bench --cold-start` line."""
+    cs = {
+        "subjects": 6, "requests": 32, "buckets": [1, 2, 4, 8],
+        "lattice_entries": 12, "baked_compiles": 8,
+        "killed_inflight": 16, "killed_futures_resolved_fraction": 1.0,
+        "restore": {"restored": 6, "betas_only": 0, "skipped": 0},
+        "warmup_sources": {"1": "aot", "2": "aot", "4": "aot", "8": "aot"},
+        "warmup_posed_sources": {"1": "aot", "2": "aot", "4": "aot",
+                                 "8": "aot"},
+        "compiles_after_restore": 0, "aot_loads": 8,
+        "aot_load_failures": 0, "expected_programs": 8,
+        "subjects_restored": 6,
+        "restored_vs_warm_max_abs_err": 0.0,
+        "restored_vs_fresh_max_abs_err": 0.0,
+        "t_restore_s": 0.05, "t_warm_s": 5.8, "t_first_result_s": 5.8,
+        "t_p99_stable_s": 6.9, "wave_p99_ms": [98.6, 85.6, 104.4],
+        "injections": {
+            "truncated_entry": {
+                "submitted": 32, "resolved_ok": 32, "resolved_error": 0,
+                "unresolved": 0, "futures_resolved_fraction": 1.0,
+                "aot_load_failures": 1, "recompiles": 1, "aot_loads": 7,
+                "subjects_restored": 6, "restore": {"restored": 6}},
+            "schema_bump": {
+                "submitted": 32, "resolved_ok": 32, "resolved_error": 0,
+                "unresolved": 0, "futures_resolved_fraction": 1.0,
+                "aot_load_failures": 1, "recompiles": 4, "aot_loads": 4,
+                "subjects_restored": 6, "restore": {"restored": 6}},
+            "damaged_checkpoint": {
+                "submitted": 32, "resolved_ok": 32, "resolved_error": 0,
+                "unresolved": 0, "futures_resolved_fraction": 1.0,
+                "aot_load_failures": 0, "recompiles": 0, "aot_loads": 8,
+                "subjects_restored": 0,
+                "restore": {"restored": 0, "error": "JSONDecodeError"}},
+        },
+        "hang_leg": {
+            "submitted": 12, "resolved_ok": 12, "resolved_error": 0,
+            "unresolved": 0, "futures_resolved_fraction": 1.0,
+            "deadline_kills": 1, "compiles_after_restore": 0,
+            "aot_loads": 12, "expected_programs": 12,
+            "subjects_restored": 6, "restore": {"restored": 6}},
+        "phase_a": {"submitted": 32, "resolved_ok": 32,
+                    "resolved_error": 0, "unresolved": 0},
+    }
+    # Raw serve-bench --cold-start artifact: judged on its own.
+    raw = tmp_path / "coldstart_raw.json"
+    raw.write_text(json.dumps(dict(cs, backend="cpu")))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] coldstart_zero_compiles_after_restore" in p.stdout
+    assert "[PASS] coldstart_restored_bit_identical" in p.stdout
+    assert "[PASS] coldstart_damage_degrades_counted" in p.stdout
+    assert "[PASS] coldstart_hang_hits_supervised_path" in p.stdout
+    assert "COLDSTART CRITERIA PASS" in p.stdout
+
+    # A compile after restore, a program NOT served from the lattice,
+    # or a non-bit-identical restored subject FAILS.
+    raw.write_text(json.dumps(dict(
+        cs, compiles_after_restore=1, aot_loads=7,
+        restored_vs_fresh_max_abs_err=3e-7)))
+    p = _run(str(raw))
+    assert p.returncode == 1
+    assert "[FAIL] coldstart_zero_compiles_after_restore" in p.stdout
+    assert "[FAIL] coldstart_restored_bit_identical" in p.stdout
+
+    # An injection that resolves futures but was never COUNTED (no
+    # aot_load_failures, no restore error) fails the degradation gate;
+    # so does an unresolved future in any leg or an unkilled hang.
+    bad_inj = dict(cs["injections"],
+                   schema_bump=dict(cs["injections"]["schema_bump"],
+                                    aot_load_failures=0))
+    raw.write_text(json.dumps(dict(
+        cs, injections=bad_inj,
+        hang_leg=dict(cs["hang_leg"], deadline_kills=0))))
+    p = _run(str(raw))
+    assert p.returncode == 1
+    assert "[FAIL] coldstart_damage_degrades_counted" in p.stdout
+    assert "[FAIL] coldstart_hang_hits_supervised_path" in p.stdout
+
+    # Inside a serving-only artifact the block rides with the serving
+    # criteria, and a crashed leg fails loudly.
+    only = tmp_path / "serve_only_cs.json"
+    srv = {"engine_evals_per_sec": 8114.4,
+           "engine_vs_direct_ratio": 1.297, "warm_bucket": 32,
+           "steady_recompiles": 0, "requests": 64, "compiles": 6,
+           "aot_loads": 0, "dispatches": 54, "padding_waste": 0.14}
+    only.write_text(json.dumps({
+        "metric": "serving_engine_evals_per_sec", "value": 8114.4,
+        "unit": "evals/s", "vs_baseline": None, "device": "cpu:cpu",
+        "detail": {"serving": srv, "coldstart": cs}}))
+    p = _run(str(only))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] coldstart_zero_compiles_after_restore" in p.stdout
+    assert "SERVING CRITERIA PASS" in p.stdout
+
+    only.write_text(json.dumps({
+        "metric": "serving_engine_evals_per_sec", "value": 8114.4,
+        "unit": "evals/s", "vs_baseline": None, "device": "cpu:cpu",
+        "config_errors": {"config11_coldstart": "boom"},
+        "detail": {"serving": srv}}))
+    p = _run(str(only))
+    assert p.returncode == 1
+    assert "[FAIL] coldstart_leg_ran" in p.stdout

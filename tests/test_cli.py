@@ -918,6 +918,27 @@ def test_serve_bench_overload_guard(capsys):
     assert "--deadline-s" in capsys.readouterr().err
 
 
+def test_serve_bench_cold_start_guard(capsys):
+    """Satellite (ISSUE 6): `--cold-start` fixes its own protocol —
+    composing it with --overload/--subjects/--chaos/--deadline-s, or
+    invoking it WITHOUT --aot-dir (the restart drill is about the
+    persistent artifact directory; a temp dir would measure nothing a
+    real restart could reuse), refuses with rc 2 instead of silently
+    running something else."""
+    assert cli.main(["serve-bench", "--cold-start",
+                     "--aot-dir", "/tmp/x", "--overload"]) == 2
+    assert cli.main(["serve-bench", "--cold-start",
+                     "--aot-dir", "/tmp/x", "--subjects", "2"]) == 2
+    assert cli.main(["serve-bench", "--cold-start",
+                     "--aot-dir", "/tmp/x", "--chaos", "drill"]) == 2
+    assert cli.main(["serve-bench", "--cold-start",
+                     "--aot-dir", "/tmp/x", "--deadline-s", "1.0"]) == 2
+    err = capsys.readouterr().err
+    assert "--cold-start" in err and "--deadline-s" in err
+    assert cli.main(["serve-bench", "--cold-start"]) == 2
+    assert "requires --aot-dir" in capsys.readouterr().err
+
+
 def test_serve_bench_subjects_mode(capsys):
     """`serve-bench --subjects N` runs the mixed-subject coalescing
     protocol (bench.py config9's shared code path) and prints its one

@@ -140,15 +140,72 @@ def test_engine_corrupt_aot_artifact_self_heals(params32, tmp_path):
     (artifact,) = cache.iterdir()
     artifact.write_bytes(artifact.read_bytes()[:100])  # truncate it
     eng2 = ServingEngine(params32, max_bucket=4, aot_dir=cache)
-    with eng2, pytest.warns(UserWarning, match="corrupt serving artifact"):
+    with eng2, pytest.warns(UserWarning, match="invalid serving artifact"):
         got = eng2.forward(*_reqs([3], seed=9)[0])
     assert eng2.counters.compiles == 1 and eng2.counters.aot_loads == 0
+    # Structured degradation (PR 6): the damaged artifact is COUNTED,
+    # not just warned about — telemetry, never a crash.
+    assert eng2.counters.aot_load_failures == 1
     np.testing.assert_allclose(got, want, atol=1e-6)
     # ... and the good artifact was rewritten for the NEXT process.
     eng3 = ServingEngine(params32, max_bucket=4, aot_dir=cache)
     with eng3:
         eng3.forward(*_reqs([3], seed=9)[0])
     assert eng3.counters.aot_loads == 1 and eng3.counters.compiles == 0
+
+
+def test_engine_aot_artifact_damage_never_raises_from_warmup(
+        params32, tmp_path):
+    """Satellite (ISSUE 6): every damage class on the legacy single-
+    bucket artifact path — truncation, byte corruption, and a
+    params_digest MISMATCH (a valid artifact baked from another
+    parameter set copied over this one's name, which would otherwise
+    silently serve the wrong meshes) — must fall back to jit inside
+    ``warmup()`` with ``aot_load_failures`` counted, never raise."""
+    import dataclasses
+
+    cache = tmp_path / "serve_cache"
+    with ServingEngine(params32, max_bucket=2, aot_dir=cache) as eng:
+        eng.warmup([2])
+    (artifact,) = cache.iterdir()
+    good = artifact.read_bytes()
+
+    def boot_and_warm():
+        eng = ServingEngine(params32, max_bucket=2, aot_dir=cache)
+        with eng, pytest.warns(UserWarning, match="invalid serving"):
+            assert eng.warmup([2]) == {2: "jit"}   # fell back, no raise
+            out = eng.forward(*_reqs([2], seed=3)[0])
+        assert eng.counters.aot_load_failures == 1
+        assert eng.counters.compiles == 1 and eng.counters.aot_loads == 0
+        return out
+
+    want = None
+    for damage in (
+        good[:30],                                # truncated mid-header
+        good[:12] + b"\x00" + good[13:],          # corrupted header byte
+        good[: len(good) // 2],                   # truncated payload
+    ):
+        artifact.write_bytes(damage)
+        got = boot_and_warm()
+        if want is None:
+            want = got
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    # Digest mismatch: bake a VALID artifact from different params and
+    # plant it under this engine's artifact name.
+    other = dataclasses.replace(
+        params32, v_template=params32.v_template + np.float32(1e-3))
+    from mano_hand_tpu.io.export_aot import export_forward
+
+    artifact.write_bytes(export_forward(other, batch=2))
+    got = boot_and_warm()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    # ... and the healed artifact serves the NEXT process from disk.
+    eng = ServingEngine(params32, max_bucket=2, aot_dir=cache)
+    with eng:
+        eng.warmup([2])
+    assert eng.counters.aot_loads == 1
+    assert eng.counters.aot_load_failures == 0
 
 
 def test_engine_zero_recompiles_on_steady_traffic(params32):
