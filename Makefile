@@ -21,9 +21,9 @@
 # shared cache dir.
 .PHONY: check check-cold test bench-cpu bench-tpu-wait mesh-scaling \
 	check-quick serve-smoke specialize-smoke chaos-smoke coalesce-smoke \
-	overload-smoke coldstart-smoke
+	overload-smoke coldstart-smoke analyze
 
-check: test chaos-smoke coalesce-smoke overload-smoke coldstart-smoke
+check: analyze test chaos-smoke coalesce-smoke overload-smoke coldstart-smoke
 
 # tests/test_runtime.py is excluded here and covered by the chaos-smoke
 # prerequisite instead (its own pytest process + cache dir): `make
@@ -47,8 +47,24 @@ test:
 # quick-marked). The FULL suite is still the snapshot-commit gate; this
 # lane catches core breakage between snapshots without the ~17-minute
 # wall (VERDICT r3 item 8).
-check-quick:
+check-quick: analyze
 	TF_CPP_MIN_LOG_LEVEL=3 python -m pytest tests/ -q -m quick
+
+# Project-invariant static analysis (analysis/, PR 7): the policy
+# linter (CLAUDE.md rules as lints — bare jax.devices(), JAX_PLATFORMS
+# env writes, the r3 unbounded-retry pattern, wall-clock deadlines,
+# device work under _exe_lock), the engine lock-discipline checker
+# (documented order _install_lock -> _exe_lock, no cycles), the jaxpr
+# program auditor (all five program families traced on CPU: no f64,
+# no host callbacks, donation as designed, primitive counts vs the
+# committed analysis/baseline.json), and the fused-launch lockstep-
+# drift detector. Seconds-scale, chip never touched. Runs in BOTH
+# check lanes. Own compile-cache dir (the CLAUDE.md rule: never share
+# .jax_compile_cache/ with a live pytest process — the auditor
+# initializes a jax backend).
+analyze:
+	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_analyze \
+	  python -m mano_hand_tpu.cli analyze
 
 check-cold:
 	rm -rf .jax_compile_cache

@@ -1,0 +1,44 @@
+"""Project-invariant static analysis (`mano analyze`, PR 7).
+
+Six PRs of serving machinery accumulated hard-won invariants that lived
+only as comments and incident lore. This package turns them into
+machine-checked rules, runnable on CPU in seconds — every future kernel
+or scheduling change is vetted here before it ever reaches the scarce
+chip, the same way ``make bench-interpret`` keeps plumbing bugs off it.
+
+Four checkers:
+
+* :mod:`.policy` — an AST linter encoding the repo's written rules
+  (CLAUDE.md / docs/roadmap.md process notes) as lints: bare
+  ``jax.devices()`` outside a killable subprocess, ``JAX_PLATFORMS``
+  env mutation, unbounded retry loops around device calls (the r3
+  incident), wall-clock ``time.time()`` in deadline/TTL arithmetic,
+  device work lexically inside an ``_exe_lock`` hold.
+* :mod:`.locks` — extracts the ``with self.<lock>`` nesting graph of
+  ``serving/engine.py`` (plus intra-class call edges) and fails on any
+  cycle or any edge violating the documented
+  ``_install_lock -> _exe_lock`` order.
+* :mod:`.jaxpr_audit` — abstract-evals every reachable program family
+  on CPU and asserts no float64 leaks, no host callbacks, donation
+  as designed, and primitive counts within the committed
+  ``baseline.json``.
+* :mod:`.lockstep` — fingerprints the launch scaffolding of
+  ``forward_verts_fused_full`` and its two-hand mirror and fails when
+  one changes without the other (the documented LOCKSTEP constraint).
+
+Audited sites silence a rule with ``# analysis: allow(<rule>)`` on (or
+directly above) the flagged line. ``mano analyze --update-baseline``
+recommits intentional jaxpr/lockstep baseline changes.
+"""
+
+from __future__ import annotations
+
+from .common import (  # noqa: F401
+    Finding,
+    baseline_path,
+    load_baseline,
+    save_baseline,
+)
+from .policy import POLICY_RULES, lint_paths, lint_source  # noqa: F401
+from .locks import check_lock_discipline  # noqa: F401
+from .lockstep import LOCKSTEP_PAIR, check_lockstep, fingerprint_function  # noqa: F401

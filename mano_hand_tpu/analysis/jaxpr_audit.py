@@ -1,0 +1,265 @@
+"""Jaxpr program auditor: abstract-eval every reachable program family.
+
+Two silent precision collapses were only caught by probing compiled
+paths in the same compilation context as the timed path
+(docs/roadmap.md process notes). This auditor moves the cheap half of
+that probe to review time: every program family the serving engine (or
+bench) can dispatch is traced ON CPU — no chip, no compile — and the
+resulting jaxpr is audited for
+
+* **float64 leaks** — an f64 aval anywhere (inputs, outputs, any
+  equation) doubles bandwidth on the serving hot path and silently
+  changes numerics vs the committed f32 contract;
+* **host callbacks** — a ``pure_callback``/``io_callback``/debug print
+  that sneaks into a jitted program syncs the device per batch (and
+  hangs with the tunnel down mid-dispatch);
+* **donation** — each family's documented ``donate_argnums`` actually
+  reach the lowering (pose/shape donated on the full path, pose only on
+  the gathered path — the table must NOT be donated, other in-flight
+  snapshots read it; the CPU failover tier donates nothing);
+* **primitive counts** — the flattened per-program primitive histogram
+  must match ``analysis/baseline.json``, so silent compile-graph bloat
+  (an accidental extra transpose sweep, a dropped fusion) shows up in
+  review instead of on the chip. Intentional changes:
+  ``mano analyze --update-baseline``.
+
+Program families (ISSUE 7): full forward, posed (pose-only fast path),
+gathered (PR-4 coalescing), fused one-/two-hand single-launch kernels,
+and the CPU-failover tier.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .common import Finding
+
+#: Fixed trace shapes: primitive counts are only comparable at fixed
+#: shapes, and small ones keep the audit in the seconds range.
+_BUCKET = 8
+_CAPACITY = 4
+
+_CALLBACK_MARKERS = ("callback", "outside_call", "infeed", "outfeed")
+
+
+class ProgramSpec(NamedTuple):
+    name: str               # e.g. "gathered"
+    family: str             # one of the five audited families
+    fn: Callable            # positional-args callable to trace
+    args: Tuple             # concrete CPU example arguments
+    donate_argnums: Tuple[int, ...]   # as built for device serving
+    expect_donated: Tuple[int, ...]   # flat arg indices that MUST donate
+    lowerable: bool = True  # False: Pallas TPU program — jaxpr only
+
+
+def build_program_specs() -> List[ProgramSpec]:
+    """The audited programs, built exactly the way serving builds them
+    (params/table as runtime ARGUMENTS — the bit-identity policy)."""
+    import jax
+
+    from mano_hand_tpu.assets import synthetic_pair, synthetic_params
+    from mano_hand_tpu.models import core
+    from mano_hand_tpu.ops import pallas_forward
+
+    params = synthetic_params(seed=0).astype(np.float32)
+    left, right = synthetic_pair(seed=0)
+    params2 = core.stack_params(left.astype(np.float32),
+                                right.astype(np.float32))
+    j, s = params.n_joints, params.n_shape
+    pose = np.zeros((_BUCKET, j, 3), np.float32)
+    shape = np.zeros((_BUCKET, s), np.float32)
+    shaped = jax.device_put(core.specialize(params, np.zeros(s, np.float32)))
+    table = core.subject_table(params, _CAPACITY)
+    idx = np.zeros((_BUCKET,), np.int32)
+    pose2 = np.zeros((2, _BUCKET, j, 3), np.float32)
+    shape2 = np.zeros((2, _BUCKET, s), np.float32)
+
+    return [
+        # serving/engine.py:build_bucket_executable — pose+shape donated
+        # on device backends.
+        ProgramSpec(
+            "full", "full",
+            lambda q, p, sh: core.forward_batched(q, p, sh).verts,
+            (params, pose, shape), donate_argnums=(1, 2),
+            expect_donated=(1, 2)),
+        # models/core.py:jit_forward_posed_batched — the PR-2 pose-only
+        # fast path over one baked subject.
+        ProgramSpec(
+            "posed", "posed",
+            lambda sh, p: core.forward_posed_batched(sh, p).verts,
+            (shaped, pose), donate_argnums=(), expect_donated=()),
+        # serving/engine.py:build_posed_gather_executable — pose donated,
+        # table NOT (in-flight snapshots read it).
+        ProgramSpec(
+            "gathered", "gathered",
+            lambda tab, ix, p: core.forward_posed_gather(tab, ix, p).verts,
+            (table, idx, pose), donate_argnums=(2,),
+            expect_donated=(2,)),
+        # ops/pallas_forward.py one-/two-hand single-launch kernels.
+        # Jaxpr-audited only: lowering a TPU pallas_call needs the chip
+        # (the interpret lane covers execution; `make bench-interpret`).
+        ProgramSpec(
+            "fused_one", "fused",
+            lambda q, p, sh: pallas_forward.forward_verts_fused_full(
+                q, p, sh),
+            (params, pose, shape), donate_argnums=(),
+            expect_donated=(), lowerable=False),
+        ProgramSpec(
+            "fused_two", "fused",
+            lambda q2, p2, sh2: pallas_forward.forward_verts_fused_full_hands(
+                q2, p2, sh2),
+            (params2, pose2, shape2), donate_argnums=(),
+            expect_donated=(), lowerable=False),
+        # serving/engine.py:build_cpu_fallback_executable — never
+        # donated (CPU donation is unimplemented; the clean tier).
+        ProgramSpec(
+            "cpu_fallback", "cpu_fallback",
+            lambda q, p, sh: core.forward_batched(q, p, sh).verts,
+            (params, pose, shape), donate_argnums=(),
+            expect_donated=()),
+    ]
+
+
+def _walk_jaxpr(jaxpr) -> Tuple[Dict[str, int], List, List[str]]:
+    """Flattened (primitive histogram, all avals, callback prims) of a
+    jaxpr including every nested sub-jaxpr (pjit bodies, scans, conds,
+    pallas kernels)."""
+    from jax.extend import core as jex_core  # jaxpr types
+
+    counts: Dict[str, int] = {}
+    avals: List = []
+    callbacks: List[str] = []
+
+    def visit(jx) -> None:
+        closed = getattr(jx, "jaxpr", None)
+        inner = closed if closed is not None and hasattr(
+            closed, "eqns") else jx
+        for v in (*inner.invars, *inner.outvars, *inner.constvars):
+            aval = getattr(v, "aval", None)
+            if aval is not None:
+                avals.append(aval)
+        for eqn in inner.eqns:
+            name = eqn.primitive.name
+            counts[name] = counts.get(name, 0) + 1
+            if any(m in name for m in _CALLBACK_MARKERS):
+                callbacks.append(name)
+            for v in (*eqn.invars, *eqn.outvars):
+                aval = getattr(v, "aval", None)
+                if aval is not None:
+                    avals.append(aval)
+            for p in eqn.params.values():
+                for sub in (p if isinstance(p, (tuple, list)) else (p,)):
+                    if isinstance(sub, (jex_core.Jaxpr, jex_core.ClosedJaxpr)):
+                        visit(sub)
+
+    visit(jaxpr)
+    return counts, avals, callbacks
+
+
+def _donated_flags(fn: Callable, args: Tuple,
+                   donate_argnums: Tuple[int, ...]) -> List[bool]:
+    """Flat per-leaf donation flags as recorded by the lowering."""
+    import warnings
+
+    import jax
+
+    with warnings.catch_warnings():
+        # The audit lowers on CPU, where XLA declines donation with a
+        # warning; args_info still records the REQUEST, which is what
+        # the rule checks (the device build donates for real).
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        lowered = jax.jit(fn, donate_argnums=donate_argnums).lower(*args)
+    info = jax.tree_util.tree_leaves(lowered.args_info)
+    return [bool(a.donated) for a in info]
+
+
+def _leaf_arg_index(args: Tuple) -> List[int]:
+    """Map each flat leaf to the positional argument it came from."""
+    import jax
+
+    owners: List[int] = []
+    for i, a in enumerate(args):
+        owners.extend([i] * len(jax.tree_util.tree_leaves(a)))
+    return owners
+
+
+def audit_programs(
+    baseline: Optional[dict],
+    specs: Optional[Sequence[ProgramSpec]] = None,
+) -> Tuple[List[Finding], dict]:
+    """Audit all program families.
+
+    Returns (findings, measured) where ``measured`` is the would-be
+    baseline ``{"programs": {name: {"primitives": {...}}}}`` for
+    ``--update-baseline``.
+    """
+    import jax
+
+    findings: List[Finding] = []
+    measured: dict = {"programs": {}}
+    here = "analysis/jaxpr_audit.py"
+    if specs is None:
+        specs = build_program_specs()
+
+    for spec in specs:
+        jaxpr = jax.make_jaxpr(spec.fn)(*spec.args)
+        counts, avals, callbacks = _walk_jaxpr(jaxpr)
+        measured["programs"][spec.name] = {
+            "primitives": dict(sorted(counts.items()))}
+
+        f64 = sorted({str(getattr(a, "dtype", ""))
+                      for a in avals
+                      if str(getattr(a, "dtype", "")) in
+                      ("float64", "complex128")})
+        if f64:
+            findings.append(Finding(
+                "jaxpr-f64-leak", here, 0,
+                f"program {spec.name!r} carries {'/'.join(f64)} values "
+                "— the serving contract is f32 end to end (two silent "
+                "precision collapses, docs/roadmap.md process notes)"))
+        if callbacks:
+            findings.append(Finding(
+                "jaxpr-host-callback", here, 0,
+                f"program {spec.name!r} embeds host callback(s) "
+                f"{sorted(set(callbacks))} — a per-batch host sync on "
+                "the dispatch path (and a hang when the tunnel drops "
+                "mid-call)"))
+
+        if spec.lowerable:
+            flags = _donated_flags(spec.fn, spec.args, spec.donate_argnums)
+            owners = _leaf_arg_index(spec.args)
+            donated_args = {o for o, fl in zip(owners, flags) if fl}
+            want = set(spec.expect_donated)
+            if donated_args != want:
+                findings.append(Finding(
+                    "jaxpr-donation", here, 0,
+                    f"program {spec.name!r}: donated args {sorted(donated_args)} "
+                    f"!= designed {sorted(want)} (full path donates "
+                    "pose+shape, gathered donates pose only — the table "
+                    "is read by in-flight snapshots — and the CPU "
+                    "failover tier donates nothing)"))
+
+        base = ((baseline or {}).get("programs", {})
+                .get(spec.name, {}).get("primitives"))
+        if base is None:
+            findings.append(Finding(
+                "jaxpr-baseline", here, 0,
+                f"program {spec.name!r} has no committed primitive-count "
+                "baseline — run `mano analyze --update-baseline` and "
+                "commit analysis/baseline.json"))
+        elif base != measured["programs"][spec.name]["primitives"]:
+            now = measured["programs"][spec.name]["primitives"]
+            delta = {k: (base.get(k, 0), now.get(k, 0))
+                     for k in sorted(set(base) | set(now))
+                     if base.get(k, 0) != now.get(k, 0)}
+            findings.append(Finding(
+                "jaxpr-primitive-drift", here, 0,
+                f"program {spec.name!r} primitive counts drifted from "
+                f"baseline: {delta} (was -> is). Intentional? "
+                "`mano analyze --update-baseline` and justify the graph "
+                "change in the PR; unintentional bloat lands on the "
+                "chip as compile time + HBM traffic"))
+    return findings, measured

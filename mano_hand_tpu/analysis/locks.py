@@ -1,0 +1,244 @@
+"""Lock-discipline checker for ``serving/engine.py``-style classes.
+
+The engine's documented order is ``_install_lock -> _exe_lock``, never
+the reverse (engine.py:_install_subject docstring): the dispatcher
+blocks on ``_exe_lock`` for every batch, so anything that could make an
+``_exe_lock`` holder wait on an installer inverts the latency design —
+and a genuine inversion deadlocks under concurrency.
+
+The checker is purely lexical, which is what makes it a REVIEW-time
+gate:
+
+* lock attributes are discovered from ``self.<name> = threading.Lock()``
+  (or ``RLock``) assignments in ``__init__``;
+* within each method, a ``with self.<lock>:`` nested inside another
+  acquires an ordering edge ``outer -> inner``;
+* a call ``self.m(...)`` made while a lock is lexically held adds edges
+  from every held lock to every lock ``m`` may acquire — transitively
+  through the intra-class call graph (a conservative
+  over-approximation: a callee that acquires only on paths the caller
+  never takes still counts, which is the right bias for a deadlock
+  gate);
+* violations: any cycle in the edge graph (including a self-edge — a
+  re-acquire of a non-reentrant ``threading.Lock`` deadlocks
+  immediately), and any edge that runs AGAINST the documented order.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .common import Finding, apply_pragmas
+
+#: The documented order for the serving engine (outer first).
+ENGINE_LOCK_ORDER = ("_install_lock", "_exe_lock")
+
+ENGINE_PATH = Path(__file__).resolve().parents[1] / "serving" / "engine.py"
+
+
+def _attr_of_self(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attributes assigned a ``threading.Lock()``/``RLock()`` anywhere
+    in the class body (``__init__`` in practice)."""
+    locks: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if not (isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Attribute)
+                and v.func.attr in ("Lock", "RLock")):
+            continue
+        for t in node.targets:
+            attr = _attr_of_self(t)
+            if attr:
+                locks.add(attr)
+    return locks
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Per-method facts: direct nesting edges, lock acquisitions, and
+    self-method calls annotated with the locks lexically held."""
+
+    def __init__(self, locks: Set[str], methods: Set[str]):
+        self.locks = locks
+        self.methods = methods
+        self.held: List[str] = []
+        self.acquires: Set[str] = set()      # locks acquired in this body
+        self.edges: List[Tuple[str, str, int]] = []   # (outer, inner, line)
+        self.calls: List[Tuple[Tuple[str, ...], str, int]] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        entered: List[str] = []
+        for item in node.items:
+            attr = _attr_of_self(item.context_expr)
+            if attr in self.locks:
+                self.acquires.add(attr)
+                # A re-acquire of a non-reentrant Lock (attr already in
+                # held) lands here as the self-edge (attr, attr): a
+                # guaranteed self-deadlock, reported as a cycle of one.
+                for outer in self.held:
+                    self.edges.append((outer, attr, node.lineno))
+                self.held.append(attr)
+                entered.append(attr)
+        self.generic_visit(node)
+        for _ in entered:
+            self.held.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        attr = _attr_of_self(node.func)
+        if attr in self.methods:
+            # Recorded even when no lock is held: lock-free calls still
+            # propagate acquisition sets through the call-graph fixpoint
+            # (m1 holds A -> m2 (lock-free) -> m3 acquires B).
+            self.calls.append((tuple(self.held), attr, node.lineno))
+        self.generic_visit(node)
+
+    # Nested defs/lambdas run later, outside the lexical lock context.
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        saved, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = saved
+
+
+def _transitive_acquires(scans: Dict[str, "_MethodScan"]
+                         ) -> Dict[str, Set[str]]:
+    """Locks each method may acquire, directly or via self-calls
+    anywhere in its body (fixpoint over the intra-class call graph)."""
+    callees: Dict[str, Set[str]] = {
+        name: {c for c in _all_self_calls(scan) if c in scans}
+        for name, scan in scans.items()}
+    acq = {name: set(scan.acquires) for name, scan in scans.items()}
+    changed = True
+    while changed:
+        changed = False
+        for name in scans:
+            for c in callees[name]:
+                add = acq.get(c, set()) - acq[name]
+                if add:
+                    acq[name] |= add
+                    changed = True
+    return acq
+
+
+def _all_self_calls(scan: "_MethodScan") -> Set[str]:
+    return {callee for _, callee, _ in scan.calls}
+
+
+def _find_cycle(edges: Set[Tuple[str, str]]) -> Optional[List[str]]:
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+    path: List[str] = []
+
+    def dfs(n: str) -> Optional[List[str]]:
+        color[n] = GREY
+        path.append(n)
+        for m in sorted(graph[n]):
+            if color[m] == GREY:
+                return path[path.index(m):] + [m]
+            if color[m] == WHITE:
+                cyc = dfs(m)
+                if cyc:
+                    return cyc
+        path.pop()
+        color[n] = BLACK
+        return None
+
+    for n in sorted(graph):
+        if color[n] == WHITE:
+            cyc = dfs(n)
+            if cyc:
+                return cyc
+    return None
+
+
+def check_lock_discipline(
+    path: Path = ENGINE_PATH,
+    order: Sequence[str] = ENGINE_LOCK_ORDER,
+    class_name: Optional[str] = None,
+) -> List[Finding]:
+    """Check one file's classes for lock-order violations and cycles."""
+    path = Path(path)
+    source = path.read_text()
+    tree = ast.parse(source, filename=str(path))
+    findings: List[Finding] = []
+    rel = path.name if path.is_absolute() else str(path)
+
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        if class_name is not None and cls.name != class_name:
+            continue
+        locks = _lock_attrs(cls)
+        if not locks:
+            continue
+        methods = {n.name for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        scans: Dict[str, _MethodScan] = {}
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan = _MethodScan(locks, methods)
+                for stmt in node.body:
+                    scan.visit(stmt)
+                scans[node.name] = scan
+
+        acq = _transitive_acquires(scans)
+        # Edge set: direct lexical nesting + (held locks x callee's
+        # transitive acquisitions) for every under-lock self-call.
+        edges: Dict[Tuple[str, str], Tuple[int, str]] = {}
+        for mname, scan in scans.items():
+            for a, b, line in scan.edges:
+                edges.setdefault((a, b), (line, f"{cls.name}.{mname}"))
+            for held, callee, line in scan.calls:
+                for inner in acq.get(callee, set()):
+                    for outer in held:
+                        edges.setdefault(
+                            (outer, inner),
+                            (line, f"{cls.name}.{mname} -> "
+                                   f"self.{callee}()"))
+
+        rank = {name: i for i, name in enumerate(order)}
+        for (a, b), (line, where) in sorted(edges.items(),
+                                            key=lambda kv: kv[1][0]):
+            if a == b:
+                findings.append(Finding(
+                    "lock-discipline", rel, line,
+                    f"{where}: re-acquisition of non-reentrant "
+                    f"self.{a} while already held — guaranteed "
+                    "deadlock"))
+            elif a in rank and b in rank and rank[a] > rank[b]:
+                findings.append(Finding(
+                    "lock-discipline", rel, line,
+                    f"{where}: acquires self.{b} while holding "
+                    f"self.{a}, inverting the documented order "
+                    f"{' -> '.join(order)} (engine.py:_install_subject "
+                    "docstring) — deadlocks against a compliant "
+                    "holder"))
+        cyc = _find_cycle({e for e in edges if e[0] != e[1]})
+        if cyc:
+            line = min(edges[(a, b)][0]
+                       for a, b in zip(cyc, cyc[1:]) if (a, b) in edges)
+            findings.append(Finding(
+                "lock-discipline", rel, line,
+                f"{cls.name}: lock-nesting cycle "
+                f"{' -> '.join(cyc)} — two threads taking opposite "
+                "arcs deadlock"))
+    return apply_pragmas(findings, source)

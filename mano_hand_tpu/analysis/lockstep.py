@@ -1,0 +1,119 @@
+"""Lockstep-drift detector for the mirrored fused-launch scaffolding.
+
+``ops/pallas_forward.py:forward_verts_fused_full`` and its two-hand
+mirror ``forward_verts_fused_full_hands`` deliberately duplicate the
+host-side launch scaffolding (operand prep, padding, BlockSpecs,
+HIGH-path split) line for line instead of sharing a builder — the
+one-hand path is the measured headline kernel and stays untouched
+(both docstrings carry the LOCKSTEP note). The constraint was
+previously enforced by reviewers remembering it.
+
+This detector fingerprints each function's normalized AST (docstring
+stripped, positions excluded — comments and formatting never matter)
+and compares both against the committed baseline:
+
+* exactly ONE fingerprint changed -> FAIL: the mirror drifted;
+* BOTH changed -> a lockstep edit; passes, with a reminder to
+  recommit the baseline (``mano analyze --update-baseline``);
+* neither changed -> clean.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from .common import Finding
+
+OPS_PATH = (Path(__file__).resolve().parents[1] / "ops"
+            / "pallas_forward.py")
+
+#: The mirrored pair under the LOCKSTEP constraint.
+LOCKSTEP_PAIR = ("forward_verts_fused_full",
+                 "forward_verts_fused_full_hands")
+
+
+def _strip_docstring(fn: ast.FunctionDef) -> ast.FunctionDef:
+    # Mutating is safe: the tree is parsed fresh per fingerprint call.
+    if (fn.body and isinstance(fn.body[0], ast.Expr)
+            and isinstance(fn.body[0].value, ast.Constant)
+            and isinstance(fn.body[0].value.value, str)):
+        fn.body = fn.body[1:] or [ast.Pass()]
+    return fn
+
+
+def fingerprint_function(path: Path, func_name: str) -> str:
+    """sha256 of the function's normalized AST (no docstring, no
+    source positions) — stable under comments/reformatting, changed by
+    any code edit."""
+    tree = ast.parse(Path(path).read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == func_name:
+            dump = ast.dump(_strip_docstring(node),
+                            include_attributes=False)
+            return hashlib.sha256(dump.encode()).hexdigest()
+    raise ValueError(f"{path} has no function {func_name!r}")
+
+
+def _lineno(path: Path, func_name: str) -> int:
+    tree = ast.parse(Path(path).read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == func_name:
+            return node.lineno
+    return 0
+
+
+def check_lockstep(
+    baseline: Dict[str, str],
+    path: Path = OPS_PATH,
+    pair: Sequence[str] = LOCKSTEP_PAIR,
+) -> List[Finding]:
+    """Compare the pair's fingerprints against the committed baseline.
+
+    Returns failures only for one-sided drift; a lockstep edit of both
+    passes (recommit the baseline to re-arm detection).
+    """
+    path = Path(path)
+    rel = path.name if path.is_absolute() else str(path)
+    current = {name: fingerprint_function(path, name) for name in pair}
+    missing = [n for n in pair if n not in baseline]
+    if missing:
+        return [Finding(
+            "lockstep-drift", rel, _lineno(path, missing[0]),
+            f"no committed lockstep baseline for {missing} — run "
+            "`mano analyze --update-baseline` and commit "
+            "analysis/baseline.json")]
+    changed = [n for n in pair if current[n] != baseline[n]]
+    if len(changed) == 1:
+        drifted = changed[0]
+        (untouched,) = [n for n in pair if n != drifted]
+        return [Finding(
+            "lockstep-drift", rel, _lineno(path, drifted),
+            f"{drifted} changed but its LOCKSTEP mirror {untouched} "
+            "did not (see both docstrings: the launch scaffolding is "
+            "mirrored line for line) — apply the change to BOTH, then "
+            "`mano analyze --update-baseline`")]
+    return []
+
+
+def lockstep_stale(baseline: Dict[str, str],
+                   path: Path = OPS_PATH,
+                   pair: Sequence[str] = LOCKSTEP_PAIR) -> Optional[str]:
+    """Non-failing advisory: both fingerprints moved in lockstep, so
+    the committed baseline should be regenerated."""
+    current = {name: fingerprint_function(Path(path), name)
+               for name in pair}
+    changed = [n for n in pair
+               if baseline.get(n) is not None and current[n] != baseline[n]]
+    if len(changed) == len(pair):
+        return ("lockstep pair edited in lockstep (OK) — recommit the "
+                "baseline with `mano analyze --update-baseline`")
+    return None
+
+
+def current_fingerprints(path: Path = OPS_PATH,
+                         pair: Sequence[str] = LOCKSTEP_PAIR
+                         ) -> Dict[str, str]:
+    return {name: fingerprint_function(Path(path), name) for name in pair}

@@ -1,0 +1,306 @@
+"""AST policy linter: the repo's written rules as machine-checked lints.
+
+Every rule codifies an invariant that already cost an incident or a
+debugging session (CLAUDE.md, docs/roadmap.md process notes):
+
+``bare-devices``
+    ``jax.devices()`` / ``jax.local_devices()`` with no platform
+    argument resolves the DEFAULT backend — on this box one real TPU
+    behind a flaky tunnel, where the call HANGS for hours when the
+    tunnel is down (r3: ~10 h, r4: 15+ h). Probe in a killable
+    subprocess (``bench.py``/``runtime/health.py``) instead.
+    ``jax.devices("cpu")`` is exempt: the host backend cannot hang.
+
+``platforms-env``
+    Mutating ``os.environ["JAX_PLATFORMS"]`` selects nothing here: a
+    site hook re-sets jax_platforms at interpreter startup, overriding
+    the env var. Only ``jax.config.update("jax_platforms", ...)`` wins.
+
+``unbounded-retry``
+    A ``while True`` loop with a device call and no ``break``/``return``
+    is the r3 incident as a lint rule: a leftover builder retry loop
+    polled a downed tunnel for hours while the driver bench queued
+    behind it. Bound every retry loop by a deadline or an attempt
+    count (``scripts/bench_tpu_wait.sh`` is the pattern).
+
+``wallclock-deadline``
+    ``time.time()`` in deadline/TTL arithmetic breaks on a clock jump
+    (NTP step, suspend/resume): a wait can give up instantly or never.
+    Use ``time.monotonic()``; wall clock is only for CROSS-PROCESS
+    timestamps (file mtimes — the devicelock claim-age check).
+
+``device-under-exe-lock``
+    ``serving/engine.py``'s dispatcher blocks on ``_exe_lock`` for
+    every batch; on the tunneled backend a device call inside that lock
+    (device_put / jit build / block_until_ready) can stall the entire
+    serving path for seconds. Stage device work OUTSIDE the lock (the
+    ``_install_subject`` bake-and-swap pattern).
+
+Audited sites: ``# analysis: allow(<rule>)`` on or directly above the
+flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterable, List, Optional
+
+from .common import Finding, apply_pragmas
+
+POLICY_RULES = (
+    "bare-devices",
+    "platforms-env",
+    "unbounded-retry",
+    "wallclock-deadline",
+    "device-under-exe-lock",
+)
+
+_DEADLINE_NAME_RE = re.compile(
+    r"deadline|expir|ttl|timeout|time_left|budget", re.IGNORECASE)
+
+#: Calls that touch the device / build executables; flagged inside an
+#: ``_exe_lock`` hold and used as the "device call" marker for the
+#: retry-loop rule (any ``jax.*`` call counts there too).
+_DEVICE_ATTRS = {"device_put", "block_until_ready", "devices",
+                 "local_devices"}
+
+
+def _attr_chain(node: ast.AST) -> Optional[str]:
+    """Dotted-name string of a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_bare_devices(call: ast.Call) -> bool:
+    chain = _attr_chain(call.func)
+    if chain not in ("jax.devices", "jax.local_devices"):
+        return False
+    # An explicit platform argument pins the backend; only the
+    # argument-less default-backend form can hang on the tunnel.
+    return not call.args and not call.keywords
+
+
+def _is_device_call(call: ast.Call) -> bool:
+    chain = _attr_chain(call.func)
+    if chain is None:
+        return False
+    if chain.startswith("jax."):
+        return True
+    leaf = chain.rsplit(".", 1)[-1]
+    return leaf in _DEVICE_ATTRS or leaf.startswith("jit_")
+
+
+def _mentions_deadline_name(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and _DEADLINE_NAME_RE.search(sub.id):
+            return True
+        if isinstance(sub, ast.Attribute) and \
+                _DEADLINE_NAME_RE.search(sub.attr):
+            return True
+    return False
+
+
+def _contains_wallclock(node: ast.AST) -> bool:
+    return any(isinstance(sub, ast.Call)
+               and _attr_chain(sub.func) == "time.time"
+               for sub in ast.walk(node))
+
+
+def _walk_same_frame(node: ast.AST) -> Iterable[ast.AST]:
+    """``node`` and its descendants, NOT descending into nested
+    def/lambda (their bodies run later, in another frame — neither
+    their calls nor their returns belong to the enclosing context)."""
+    stack = [node]
+    while stack:
+        sub = stack.pop()
+        yield sub
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            continue   # the def node itself is same-frame; its body isn't
+        stack.extend(ast.iter_child_nodes(sub))
+
+
+def _iter_body_calls(node: ast.AST) -> Iterable[ast.Call]:
+    return (sub for sub in _walk_same_frame(node)
+            if isinstance(sub, ast.Call))
+
+
+class _PolicyVisitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[Finding] = []
+        self._exe_lock_depth = 0
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        self.findings.append(
+            Finding(rule, self.path, getattr(node, "lineno", 0), message))
+
+    # -- bare-devices / device-under-exe-lock ------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_bare_devices(node):
+            self._emit(
+                "bare-devices", node,
+                "bare jax.devices() resolves the default backend and "
+                "HANGS for hours when the device tunnel is down — probe "
+                "in a killable subprocess (bench.py/runtime/health.py), "
+                "or pass an explicit platform")
+        chain = _attr_chain(node.func) or ""
+        if chain == "os.environ.setdefault" and node.args:
+            key = node.args[0]
+            if isinstance(key, ast.Constant) and key.value == "JAX_PLATFORMS":
+                self._emit(
+                    "platforms-env", node,
+                    "JAX_PLATFORMS env is overridden by a site hook at "
+                    "interpreter startup; select platforms via "
+                    'jax.config.update("jax_platforms", ...) instead')
+        if self._exe_lock_depth > 0:
+            leaf = chain.rsplit(".", 1)[-1]
+            if (chain in ("jax.device_put", "jax.jit",
+                          "jax.block_until_ready")
+                    or leaf in ("device_put", "block_until_ready")
+                    or leaf.startswith("jit_")
+                    or leaf in ("lower", "compile")):
+                self._emit(
+                    "device-under-exe-lock", node,
+                    f"{chain}() lexically inside an _exe_lock hold: the "
+                    "dispatcher blocks on _exe_lock per batch, and a "
+                    "device call here can stall serving for seconds on "
+                    "the tunneled backend — stage device work outside "
+                    "the lock (engine.py:_install_subject pattern)")
+        self.generic_visit(node)
+
+    # -- platforms-env (subscript assignment) ------------------------
+    def _check_environ_target(self, target: ast.AST) -> None:
+        if not isinstance(target, ast.Subscript):
+            return
+        chain = _attr_chain(target.value)
+        if chain not in ("os.environ", "environ"):
+            return
+        key = target.slice
+        if isinstance(key, ast.Constant) and key.value == "JAX_PLATFORMS":
+            self._emit(
+                "platforms-env", target,
+                "JAX_PLATFORMS env is overridden by a site hook at "
+                "interpreter startup; select platforms via "
+                'jax.config.update("jax_platforms", ...) instead')
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._check_environ_target(t)
+        self._check_wallclock_assign(node.targets, node.value, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_environ_target(node.target)
+        self._check_wallclock_assign([node.target], node.value, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._check_environ_target(node.target)
+        if node.value is not None:
+            self._check_wallclock_assign([node.target], node.value, node)
+        self.generic_visit(node)
+
+    # -- wallclock-deadline ------------------------------------------
+    def _check_wallclock_assign(self, targets, value, node) -> None:
+        if not _contains_wallclock(value):
+            return
+        if any(_mentions_deadline_name(t) for t in targets):
+            self._emit(
+                "wallclock-deadline", node,
+                "deadline/TTL computed from wall-clock time.time(): a "
+                "clock jump (NTP step, suspend) breaks the wait — use "
+                "time.monotonic() for deadline arithmetic (time.time() "
+                "is for cross-process timestamps like file mtimes)")
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        sides = [node.left, *node.comparators]
+        if (any(_contains_wallclock(s) for s in sides)
+                and any(_mentions_deadline_name(s) for s in sides
+                        if not _contains_wallclock(s))):
+            self._emit(
+                "wallclock-deadline", node,
+                "wall-clock time.time() compared against a deadline/TTL: "
+                "a clock jump breaks the wait — use time.monotonic()")
+        self.generic_visit(node)
+
+    # -- unbounded-retry ---------------------------------------------
+    def visit_While(self, node: ast.While) -> None:
+        test = node.test
+        is_true = (isinstance(test, ast.Constant) and bool(test.value))
+        if is_true:
+            # Same-frame walk: a `return` inside a nested def does NOT
+            # exit this loop and must not count as a bound.
+            has_exit = any(
+                isinstance(sub, (ast.Break, ast.Return))
+                for stmt in node.body
+                for sub in _walk_same_frame(stmt))
+            touches_device = any(_is_device_call(c)
+                                 for stmt in node.body
+                                 for c in _iter_body_calls(stmt))
+            if touches_device and not has_exit:
+                self._emit(
+                    "unbounded-retry", node,
+                    "unbounded `while True` retry loop around a device "
+                    "call (the r3 incident: a bare retry loop polled a "
+                    "downed tunnel for hours) — bound it by a deadline "
+                    "or attempt count (scripts/bench_tpu_wait.sh is the "
+                    "pattern)")
+        self.generic_visit(node)
+
+    # Nested def/lambda bodies run LATER, outside the lexical lock
+    # context — a deferred jax call stored under the lock is the
+    # engine's normal caching pattern, not a violation.
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        saved, self._exe_lock_depth = self._exe_lock_depth, 0
+        self.generic_visit(node)
+        self._exe_lock_depth = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved, self._exe_lock_depth = self._exe_lock_depth, 0
+        self.generic_visit(node)
+        self._exe_lock_depth = saved
+
+    # -- with self._exe_lock ------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        holds = any(
+            (chain := _attr_chain(item.context_expr)) is not None
+            and chain.endswith("_exe_lock")
+            for item in node.items)
+        if holds:
+            self._exe_lock_depth += 1
+        self.generic_visit(node)
+        if holds:
+            self._exe_lock_depth -= 1
+
+
+def lint_source(source: str, path: str = "<source>") -> List[Finding]:
+    """Lint one file's source; pragma-silenced findings are dropped."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("syntax-error", path, e.lineno or 0,
+                        f"file does not parse: {e.msg}")]
+    v = _PolicyVisitor(path)
+    v.visit(tree)
+    return apply_pragmas(v.findings, source)
+
+
+def lint_paths(paths: Iterable[Path],
+               root: Optional[Path] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for p in paths:
+        p = Path(p)
+        rel = str(p.relative_to(root)) if root else str(p)
+        findings.extend(lint_source(p.read_text(), rel))
+    return findings

@@ -1169,6 +1169,33 @@ def cmd_serve_bench(args) -> int:
     return 0
 
 
+def cmd_analyze(args) -> int:
+    """Project-invariant static analysis (analysis/, PR 7): the policy
+    linter, lock-discipline checker, lockstep-drift detector, and (on
+    CPU, no chip touched) the jaxpr program auditor. Exit 0 iff clean;
+    each failure names the rule, file:line, and its escape hatch."""
+    import os
+
+    import jax
+
+    if not args.platform:
+        # The site-hook rule the linter itself enforces: env selection
+        # is overridden at interpreter startup; only the config API
+        # reliably pins the host backend — the auditor must trace on
+        # CPU even when the TPU tunnel is configured (and down).
+        jax.config.update("jax_platforms", "cpu")
+    cache = os.environ.get("MANO_TEST_CACHE_DIR")
+    if cache:
+        # The compile-cache rule (CLAUDE.md): `make analyze` may run
+        # beside a live pytest process, and two processes must never
+        # share one cache dir — the Makefile points this at its own.
+        jax.config.update("jax_compilation_cache_dir", cache)
+    from mano_hand_tpu.analysis.run import run_analysis
+
+    return run_analysis(update_baseline=args.update_baseline,
+                        skip_jaxpr=args.skip_jaxpr, as_json=args.json)
+
+
 def cmd_info(args) -> int:
     params = _load_params(args.asset, args.side)
     info = {
@@ -1533,6 +1560,26 @@ def build_parser() -> argparse.ArgumentParser:
                          "judged at >= 4x achieved)")
     sb.add_argument("--seed", type=int, default=0)
     sb.set_defaults(fn=cmd_serve_bench)
+
+    an = sub.add_parser(
+        "analyze",
+        help="run the project-invariant static-analysis pass (policy "
+             "linter, lock-discipline checker, jaxpr program auditor, "
+             "lockstep-drift detector); exit 0 iff clean",
+    )
+    an.add_argument("--update-baseline", action="store_true",
+                    help="recommit analysis/baseline.json (jaxpr "
+                         "primitive counts + lockstep fingerprints) "
+                         "after an INTENTIONAL program/scaffolding "
+                         "change; justify the diff in the PR")
+    an.add_argument("--skip-jaxpr", action="store_true",
+                    help="skip the jaxpr program auditor (the one "
+                         "checker that imports jax and traces; the "
+                         "pure-AST checkers run in milliseconds)")
+    an.add_argument("--json", action="store_true",
+                    help="one machine-readable JSON line instead of "
+                         "the report")
+    an.set_defaults(fn=cmd_analyze)
 
     i = sub.add_parser("info", help="print asset summary")
     i.add_argument("--asset", default="synthetic")

@@ -29,6 +29,10 @@ def make_mesh(
     ``data=-1`` absorbs all remaining devices. ICI-friendly layout comes
     from mesh_utils when the sizes allow; otherwise a plain reshape.
     """
+    # Reached only after bring-up proved the backend answers: callers
+    # (bench.py --mesh-scaling, tests' virtual mesh) run behind the
+    # killable-subprocess probe; a mesh build is never the first
+    # backend touch.  # analysis: allow(bare-devices)
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if data == -1:

@@ -70,6 +70,11 @@ def global_mesh(
     real pods — keep ``model`` a divisor of the per-host device count so
     the vertex-sharded all-reduce never crosses DCN.
     """
+    # Reached only after initialize()/bring-up proved the backend
+    # answers (see the jax.process_count() note above): global-mesh
+    # construction is never the first backend touch, so the killable-
+    # subprocess probe rule is satisfied upstream.
+    # analysis: allow(bare-devices)
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if data is None:

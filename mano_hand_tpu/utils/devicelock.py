@@ -97,7 +97,12 @@ class DeviceLock:
         if self.role == "driver":
             self._write_claim()
         self._fd = open(LOCK_PATH, "w")
-        deadline = time.time() + self.wait_s
+        # Monotonic deadline arithmetic: an NTP step or suspend/resume
+        # during the (up to 20-minute) wait must not make the driver
+        # give up instantly or wait forever. Wall-clock time.time()
+        # stays ONLY in the cross-process claim timestamps above, which
+        # are compared against file mtimes on the same wall clock.
+        deadline = time.monotonic() + self.wait_s
         while True:
             try:
                 fcntl.flock(self._fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
@@ -116,7 +121,7 @@ class DeviceLock:
                 self._fd = None
                 raise DeviceBusy("device lock held by another bench — "
                                  "builder stands down")
-            if time.time() >= deadline:
+            if time.monotonic() >= deadline:
                 self.log(f"WARNING: device lock still held after "
                          f"{self.wait_s:.0f}s wait — proceeding WITHOUT "
                          "it (advisory); expect contention in timings")

@@ -1,0 +1,20 @@
+"""Fixture: every violation here is pragma-silenced — must lint clean."""
+import time
+
+import jax
+
+
+def audited_probe():
+    # Bring-up already proved the backend answers upstream.
+    # analysis: allow(bare-devices)
+    return jax.devices()
+
+
+def audited_trailing():
+    return jax.devices()  # analysis: allow(bare-devices)
+
+
+def audited_two_rules(timeout_s):
+    # analysis: allow(wallclock-deadline, bare-devices)
+    deadline = time.time() + timeout_s
+    return deadline

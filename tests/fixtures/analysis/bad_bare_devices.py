@@ -1,0 +1,15 @@
+"""Fixture: violates `bare-devices` (parsed by tests, never imported)."""
+import jax
+
+
+def probe():
+    return len(jax.devices())          # line 6: bare default-backend call
+
+
+def probe_local():
+    return jax.local_devices()         # line 10: same rule
+
+
+def fine():
+    # An explicit platform pins the host backend — exempt.
+    return jax.devices("cpu")

@@ -1,0 +1,30 @@
+"""Fixture: violates `device-under-exe-lock` (parsed, never imported)."""
+import threading
+
+import jax
+import numpy as np
+
+
+class Engine:
+    def __init__(self):
+        self._exe_lock = threading.Lock()
+        self._exes = {}
+
+    def bad_build(self, bucket):
+        with self._exe_lock:
+            exe = jax.jit(lambda p: p * 2)          # line 15: compile in lock
+            jax.block_until_ready(                   # line 16: device wait
+                exe(np.zeros((bucket,))))
+            self._exes[bucket] = exe
+        return exe
+
+    def fine_build(self, bucket):
+        exe = jax.jit(lambda p: p * 2)               # staged OUTSIDE the lock
+        jax.block_until_ready(exe(np.zeros((bucket,))))
+        with self._exe_lock:
+            return self._exes.setdefault(bucket, exe)
+
+    def fine_deferred(self, bucket):
+        with self._exe_lock:
+            # A lambda body runs LATER, outside the lock: exempt.
+            self._exes[bucket] = lambda p: jax.device_put(p)

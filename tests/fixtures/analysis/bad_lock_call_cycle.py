@@ -1,0 +1,29 @@
+"""Fixture: a lock cycle reachable only THROUGH the intra-class call
+graph — method a() holds lock_a and calls helper(), which acquires
+lock_b; method b() holds lock_b and calls other(), which acquires
+lock_a. No single method nests them, yet two threads deadlock. Parsed
+by tests, never imported."""
+import threading
+
+
+class CycleEngine:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self.state = 0
+
+    def a(self):
+        with self._a_lock:
+            self.helper()
+
+    def helper(self):
+        with self._b_lock:
+            self.state += 1
+
+    def b(self):
+        with self._b_lock:
+            self.other()
+
+    def other(self):
+        with self._a_lock:
+            self.state -= 1

@@ -1,0 +1,22 @@
+"""Fixture: seeds the `_exe_lock -> _install_lock` INVERSION the
+lock-discipline checker must catch (the acceptance-criteria case:
+engine.py's documented order is _install_lock -> _exe_lock, never the
+reverse). Parsed by tests, never imported."""
+import threading
+
+
+class BadEngine:
+    def __init__(self):
+        self._exe_lock = threading.Lock()
+        self._install_lock = threading.Lock()
+        self._exes = {}
+
+    def good_install(self):
+        with self._install_lock:          # documented order: OK
+            with self._exe_lock:
+                self._exes.clear()
+
+    def bad_dispatch(self):
+        with self._exe_lock:              # INVERSION: exe held ...
+            with self._install_lock:      # ... then install acquired
+                self._exes.clear()

@@ -1,0 +1,14 @@
+"""Fixture: lexical re-acquire of a non-reentrant Lock — a guaranteed
+self-deadlock (threading.Lock, not RLock). Parsed, never imported."""
+import threading
+
+
+class ReacquireEngine:
+    def __init__(self):
+        self._exe_lock = threading.Lock()
+        self.n = 0
+
+    def bad(self):
+        with self._exe_lock:
+            with self._exe_lock:      # deadlocks immediately
+                self.n += 1

@@ -1,0 +1,16 @@
+"""Fixture: violates `platforms-env` (parsed by tests, never imported)."""
+import os
+
+
+def force_cpu():
+    os.environ["JAX_PLATFORMS"] = "cpu"            # line 6: overridden by hook
+
+
+def default_cpu():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # line 10: same rule
+
+
+def fine():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")      # the sanctioned way

@@ -1,0 +1,31 @@
+"""Fixture: violates `unbounded-retry` (parsed by tests, never imported).
+
+The r3 incident shape: poll the device forever, swallowing failures.
+"""
+import time
+
+import jax
+
+
+def wait_for_tpu():
+    while True:                        # line 11: no break/return, device call
+        try:
+            jax.devices("tpu")         # pinned platform: only the LOOP is bad
+        except Exception:
+            time.sleep(30.0)
+
+
+def bounded_fine():
+    for _ in range(8):                 # attempt-bounded: exempt
+        try:
+            return jax.devices("cpu")
+        except Exception:
+            time.sleep(1.0)
+
+
+def while_true_with_exit_fine():
+    while True:
+        try:
+            return jax.devices("cpu")  # returns out of the loop: exempt
+        except RuntimeError:
+            break

@@ -1,0 +1,24 @@
+"""Fixture: violates `wallclock-deadline` (parsed by tests, never imported)."""
+import time
+
+
+def wait(timeout_s: float) -> bool:
+    deadline = time.time() + timeout_s      # line 6: wall-clock deadline
+    while time.time() < deadline:           # line 7: wall-clock compare
+        time.sleep(0.1)
+    return False
+
+
+def fine(timeout_s: float) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        time.sleep(0.1)
+    return False
+
+
+def mtime_fine(path: str) -> float:
+    import os
+
+    # Cross-process timestamp vs a file mtime: wall clock is CORRECT
+    # here (the devicelock claim-age pattern) and must not be flagged.
+    return time.time() - os.stat(path).st_mtime

@@ -1,0 +1,25 @@
+"""Fixture: consistent lock discipline — must pass the checker."""
+import threading
+
+
+class GoodEngine:
+    def __init__(self):
+        self._install_lock = threading.Lock()
+        self._exe_lock = threading.Lock()
+        self.table = None
+        self._exes = {}
+
+    def install(self, table):
+        staged = table                    # device work staged lock-free
+        with self._install_lock:
+            with self._exe_lock:          # documented order
+                self.table = staged
+
+    def dispatch(self):
+        with self._exe_lock:              # inner lock alone: fine
+            return self.table
+
+    def resolve(self):
+        with self._exe_lock:
+            snap = self.table
+        self.install(snap)                # call AFTER release: no edge

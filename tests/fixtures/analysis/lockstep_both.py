@@ -1,0 +1,21 @@
+"""Fixture: the SAME clamp edit applied to BOTH halves of the pair — a
+lockstep edit the detector must pass (with a baseline-regeneration
+reminder). Parsed by tests, never imported."""
+
+
+def launch_one(pose, block_b=128):
+    """One-hand launch (mirror of launch_two)."""
+    b = pose.shape[0]
+    block_b = max(8, min(block_b, b))      # EDITED in lockstep
+    bp = -(-b // block_b) * block_b
+    pad = bp - b
+    return pose, pad
+
+
+def launch_two(pose, block_b=128):
+    """Two-hand launch (mirror of launch_one; leading hand axis)."""
+    b = pose.shape[1]
+    block_b = max(8, min(block_b, b))      # EDITED in lockstep
+    bp = -(-b // block_b) * block_b
+    pad = bp - b
+    return pose, pad

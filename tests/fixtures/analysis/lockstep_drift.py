@@ -1,0 +1,21 @@
+"""Fixture: the ONE-HAND launch edited (block clamp changed) without
+its two-hand mirror — the drift the detector must fail. Parsed by
+tests, never imported."""
+
+
+def launch_one(pose, block_b=128):
+    """One-hand launch (mirror of launch_two)."""
+    b = pose.shape[0]
+    block_b = max(8, min(block_b, b))      # EDITED: clamp floor 1 -> 8
+    bp = -(-b // block_b) * block_b
+    pad = bp - b
+    return pose, pad
+
+
+def launch_two(pose, block_b=128):
+    """Two-hand launch (mirror of launch_one; leading hand axis)."""
+    b = pose.shape[1]
+    block_b = max(1, min(block_b, b))      # NOT edited: drifted
+    bp = -(-b // block_b) * block_b
+    pad = bp - b
+    return pose, pad

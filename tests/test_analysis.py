@@ -1,0 +1,284 @@
+"""The static-analysis subsystem (mano_hand_tpu/analysis/, PR 7).
+
+Every shipped rule is proven to FIRE on a fixture that deliberately
+violates it (tests/fixtures/analysis/), and proven CLEAN on the
+patterns it must not flag — including HEAD itself: the policy scope,
+the real engine.py lock graph, the committed lockstep baseline, and
+the jaxpr baseline are all checked here, so `make check-quick` fails
+the moment a PR re-introduces an incident pattern.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from mano_hand_tpu.analysis import (
+    check_lock_discipline,
+    check_lockstep,
+    fingerprint_function,
+    lint_source,
+)
+from mano_hand_tpu.analysis.common import (
+    REPO_ROOT,
+    default_policy_paths,
+    load_baseline,
+    pragma_map,
+)
+from mano_hand_tpu.analysis.jaxpr_audit import (
+    ProgramSpec,
+    audit_programs,
+    build_program_specs,
+)
+from mano_hand_tpu.analysis.lockstep import (
+    LOCKSTEP_PAIR,
+    OPS_PATH,
+    lockstep_stale,
+)
+from mano_hand_tpu.analysis.policy import lint_paths
+
+FIXTURES = Path(__file__).parent / "fixtures" / "analysis"
+
+# Pre-commit quick lane: this whole module IS the review-time gate.
+pytestmark = pytest.mark.quick
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+def _lint_fixture(name: str):
+    src = (FIXTURES / name).read_text()
+    return lint_source(src, name), src
+
+
+# --------------------------------------------------------------- policy
+def test_bare_devices_fires_and_exempts_platform_arg():
+    findings, _ = _lint_fixture("bad_bare_devices.py")
+    assert _rules(findings) == ["bare-devices"]
+    assert sorted(f.line for f in findings) == [6, 10]  # fine() exempt
+
+
+def test_platforms_env_fires_on_assign_and_setdefault():
+    findings, _ = _lint_fixture("bad_platforms_env.py")
+    assert _rules(findings) == ["platforms-env"]
+    assert sorted(f.line for f in findings) == [6, 10]
+
+
+def test_unbounded_retry_fires_only_on_exitless_device_loop():
+    findings, _ = _lint_fixture("bad_retry_loop.py")
+    assert _rules(findings) == ["unbounded-retry"]
+    assert [f.line for f in findings] == [11]
+    assert "r3" in findings[0].message
+
+
+def test_unbounded_retry_nested_def_return_is_not_an_exit():
+    # Review regression: a `return` inside a nested def runs in another
+    # frame and must not count as a loop bound.
+    src = ("import jax\n"
+           "def outer():\n"
+           "    while True:\n"
+           "        def cb():\n"
+           "            return 1\n"
+           "        jax.device_put(cb)\n")
+    findings = lint_source(src)
+    assert _rules(findings) == ["unbounded-retry"]
+
+
+def test_wallclock_deadline_fires_on_annotated_assign():
+    # Review regression: `deadline: float = time.time() + s` is the
+    # same bug as the plain assign and must fire.
+    src = ("import time\n"
+           "def wait(s):\n"
+           "    deadline: float = time.time() + s\n"
+           "    return deadline\n")
+    findings = lint_source(src)
+    assert _rules(findings) == ["wallclock-deadline"]
+    assert findings[0].line == 3
+
+
+def test_wallclock_deadline_fires_and_spares_mtime_use():
+    findings, _ = _lint_fixture("bad_wallclock_deadline.py")
+    assert _rules(findings) == ["wallclock-deadline"]
+    assert sorted(f.line for f in findings) == [6, 7]
+
+
+def test_device_under_exe_lock_fires_and_spares_deferred():
+    findings, _ = _lint_fixture("bad_device_under_lock.py")
+    assert _rules(findings) == ["device-under-exe-lock"]
+    assert sorted(f.line for f in findings) == [15, 16]
+
+
+def test_pragma_silences_on_same_and_previous_line():
+    findings, src = _lint_fixture("allowed_pragma.py")
+    assert findings == []
+    # The pragma itself parsed as expected.
+    allowed = pragma_map(src)
+    assert any("bare-devices" in v for v in allowed.values())
+
+
+def test_policy_scope_is_clean_on_head():
+    # The acceptance criterion: `mano analyze` policy section passes on
+    # HEAD — every real violation was fixed or pragma-audited.
+    paths = default_policy_paths(REPO_ROOT)
+    assert any(p.name == "bench.py" for p in paths)
+    assert any(p.name == "engine.py" for p in paths)
+    assert lint_paths(paths, root=REPO_ROOT) == []
+
+
+# ------------------------------------------------------- lock discipline
+def test_seeded_exe_to_install_inversion_is_caught():
+    findings = check_lock_discipline(FIXTURES / "bad_lock_inversion.py")
+    assert findings, "the seeded inversion fixture must fail"
+    assert any("inverting the documented order" in f.message
+               for f in findings)
+    assert any("_exe_lock" in f.message and "_install_lock" in f.message
+               for f in findings)
+
+
+def test_cross_method_call_cycle_is_caught():
+    findings = check_lock_discipline(FIXTURES / "bad_lock_call_cycle.py")
+    assert any("cycle" in f.message for f in findings)
+
+
+def test_nonreentrant_reacquire_is_caught():
+    findings = check_lock_discipline(FIXTURES / "bad_lock_reacquire.py")
+    assert any("re-acquisition" in f.message for f in findings)
+
+
+def test_good_lock_fixture_and_real_engine_are_clean():
+    assert check_lock_discipline(FIXTURES / "good_locks.py") == []
+    assert check_lock_discipline() == []   # serving/engine.py, HEAD
+
+
+# ------------------------------------------------------------- lockstep
+def _fixture_baseline():
+    base = FIXTURES / "lockstep_base.py"
+    pair = ("launch_one", "launch_two")
+    return {n: fingerprint_function(base, n) for n in pair}, pair
+
+
+def test_lockstep_one_sided_edit_fails():
+    baseline, pair = _fixture_baseline()
+    findings = check_lockstep(baseline, FIXTURES / "lockstep_drift.py",
+                              pair)
+    assert len(findings) == 1
+    assert "launch_one" in findings[0].message
+    assert "launch_two" in findings[0].message
+    assert findings[0].rule == "lockstep-drift"
+
+
+def test_lockstep_edit_of_both_passes_with_stale_note():
+    baseline, pair = _fixture_baseline()
+    both = FIXTURES / "lockstep_both.py"
+    assert check_lockstep(baseline, both, pair) == []
+    assert lockstep_stale(baseline, both, pair) is not None
+
+
+def test_lockstep_unchanged_pair_is_clean():
+    baseline, pair = _fixture_baseline()
+    base = FIXTURES / "lockstep_base.py"
+    assert check_lockstep(baseline, base, pair) == []
+    assert lockstep_stale(baseline, base, pair) is None
+
+
+def test_lockstep_fingerprint_ignores_comments_not_code():
+    base = FIXTURES / "lockstep_base.py"
+    drift = FIXTURES / "lockstep_drift.py"
+    # launch_two differs between the files only by a comment.
+    assert (fingerprint_function(base, "launch_two")
+            == fingerprint_function(drift, "launch_two"))
+    assert (fingerprint_function(base, "launch_one")
+            != fingerprint_function(drift, "launch_one"))
+
+
+def test_committed_lockstep_baseline_matches_head():
+    baseline = load_baseline().get("lockstep", {})
+    assert set(baseline) == set(LOCKSTEP_PAIR), \
+        "analysis/baseline.json must carry both lockstep fingerprints"
+    assert check_lockstep(baseline, OPS_PATH, LOCKSTEP_PAIR) == []
+    assert lockstep_stale(baseline, OPS_PATH, LOCKSTEP_PAIR) is None
+
+
+# ----------------------------------------------------------- jaxpr audit
+def test_jaxpr_audit_clean_on_head_baseline():
+    findings, measured = audit_programs(load_baseline())
+    assert findings == [], [f.format() for f in findings]
+    # All five families represented by the six audited programs.
+    fams = {s.family for s in build_program_specs()}
+    assert fams == {"full", "posed", "gathered", "fused", "cpu_fallback"}
+    assert set(measured["programs"]) == {
+        "full", "posed", "gathered", "fused_one", "fused_two",
+        "cpu_fallback"}
+
+
+def _tiny_spec(fn, args, name="tiny", donate=(), expect=()):
+    return ProgramSpec(name, name, fn, args, donate_argnums=donate,
+                       expect_donated=expect)
+
+
+def _tiny_baseline(measured):
+    return {"programs": measured["programs"]}
+
+
+def test_f64_leak_is_caught():
+    import jax
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        spec = _tiny_spec(lambda x: x * 2.0,
+                          (np.zeros(4, np.float64),))
+        findings, measured = audit_programs(
+            {"programs": {"tiny": {"primitives": {}}}}, specs=[spec])
+    assert any(f.rule == "jaxpr-f64-leak" for f in findings)
+    del jax  # imported to assert availability explicitly
+
+
+def test_host_callback_is_caught():
+    import jax
+
+    def fn(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v), jax.ShapeDtypeStruct((4,), np.float32),
+            x)
+
+    spec = _tiny_spec(fn, (np.zeros(4, np.float32),))
+    findings, measured = audit_programs(None, specs=[spec])
+    assert any(f.rule == "jaxpr-host-callback" for f in findings)
+
+
+def test_donation_mismatch_is_caught():
+    # Designed to donate arg 1 but built without: the drift the rule
+    # exists for (a refactor silently dropping donate_argnums).
+    spec = _tiny_spec(lambda a, b: a + b,
+                      (np.zeros(4, np.float32), np.zeros(4, np.float32)),
+                      donate=(), expect=(1,))
+    findings, _ = audit_programs(None, specs=[spec])
+    assert any(f.rule == "jaxpr-donation" for f in findings)
+
+
+def test_primitive_drift_is_caught_and_exact_match_passes():
+    spec = _tiny_spec(lambda x: x * 2.0 + 1.0,
+                      (np.zeros(4, np.float32),))
+    _, measured = audit_programs(None, specs=[spec])
+    ok, _ = audit_programs(_tiny_baseline(measured), specs=[spec])
+    assert not any(f.rule == "jaxpr-primitive-drift" for f in ok)
+    perturbed = {
+        "programs": {"tiny": {"primitives": dict(
+            measured["programs"]["tiny"]["primitives"], mul=99)}}}
+    bad, _ = audit_programs(perturbed, specs=[spec])
+    assert any(f.rule == "jaxpr-primitive-drift" for f in bad)
+
+
+# ------------------------------------------------------------------ CLI
+def test_cli_analyze_passes_on_head(capsys):
+    from mano_hand_tpu.cli import main
+
+    assert main(["analyze", "--skip-jaxpr"]) == 0
+    out = capsys.readouterr().out
+    assert "ANALYZE OK" in out
+    assert "[PASS] policy" in out
+    assert "[PASS] lock-discipline" in out
+    assert "[PASS] lockstep" in out

@@ -72,6 +72,37 @@ def test_driver_proceeds_without_lock_after_timeout():
         holder.__exit__()
 
 
+def test_wait_deadline_survives_wallclock_jump(monkeypatch):
+    """PR-7 regression (analysis `wallclock-deadline` rule): the wait
+    deadline is monotonic, so an NTP-style wall-clock step mid-wait can
+    neither abort the advisory wait early (forward jump, the old
+    ``time.time() >= deadline`` bug) nor extend it forever (backward
+    jump). Wall clock remains in use ONLY for the cross-process
+    claim-age/mtime comparison."""
+    holder = DeviceLock("driver", wait_s=5.0)
+    holder.__enter__()
+    real_sleep = time.sleep
+    monkeypatch.setattr(time, "sleep", lambda s: real_sleep(0.01))
+    # A huge forward step, active for every wall-clock read during the
+    # wait: the pre-fix code computed AND compared the deadline on
+    # time.time(), so a jump this large between iterations aborted the
+    # wait instantly.
+    t_jumped = time.time() + 1e9
+    monkeypatch.setattr(time, "time", lambda: t_jumped)
+    try:
+        msgs = []
+        start = time.monotonic()
+        with DeviceLock("driver", wait_s=0.6, log=msgs.append) as lk:
+            elapsed = time.monotonic() - start
+            assert not lk._locked          # advisory: proceeded unlocked
+        assert elapsed >= 0.5, \
+            "wall-clock jump shortened the monotonic wait window"
+        assert elapsed < 5.0
+        assert any("WITHOUT" in m for m in msgs)
+    finally:
+        holder.__exit__()
+
+
 def test_reacquire_after_release():
     with DeviceLock("driver", wait_s=5.0):
         pass
