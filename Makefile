@@ -21,9 +21,10 @@
 # shared cache dir.
 .PHONY: check check-cold test bench-cpu bench-tpu-wait mesh-scaling \
 	check-quick serve-smoke specialize-smoke chaos-smoke coalesce-smoke \
-	overload-smoke coldstart-smoke analyze
+	overload-smoke coldstart-smoke obs-smoke analyze
 
-check: analyze test chaos-smoke coalesce-smoke overload-smoke coldstart-smoke
+check: analyze test chaos-smoke coalesce-smoke overload-smoke \
+	coldstart-smoke obs-smoke
 
 # tests/test_runtime.py is excluded here and covered by the chaos-smoke
 # prerequisite instead (its own pytest process + cache dir): `make
@@ -39,7 +40,8 @@ test:
 	  --ignore=tests/test_runtime.py \
 	  --ignore=tests/test_serving_coalesce.py \
 	  --ignore=tests/test_overload.py \
-	  --ignore=tests/test_coldstart.py
+	  --ignore=tests/test_coldstart.py \
+	  --ignore=tests/test_obs.py
 
 # Seconds-scale pre-commit lane: the core-correctness modules (parity vs
 # the f64 oracle, assets/IO, golden demo, device lock, and the serving
@@ -101,7 +103,7 @@ bench-interpret:
 	  --spec-batch 64 --spec-fit-batch 8 --recovery-requests 6 \
 	  --coalesce-subjects 8 --coalesce-requests 48 --coalesce-max-bucket 32 \
 	  --overload-bursts 16 --coldstart-requests 8 --coldstart-subjects 3 \
-	  --coldstart-max-bucket 4 --coldstart-waves 2
+	  --coldstart-max-bucket 4 --coldstart-waves 2 --tracing-requests 48
 
 # Serving-leg smoke (the bench-interpret counterpart for config7): the
 # whole serving-engine plumbing — bucket warm-up, ragged request stream,
@@ -112,15 +114,17 @@ bench-interpret:
 # reduced sizes). `scripts/bench_report.py` applies the serving
 # done-criteria (ratio >= 0.9x, zero steady recompiles), the recovery
 # criteria (100% futures resolved under fault, bit-identical CPU
-# failover, zero post-recovery recompiles), and the cold-start criteria
+# failover, zero post-recovery recompiles), the cold-start criteria
 # (zero compiles after restore, restored-subject bit-identity, counted
-# degradation) to it.
+# degradation), and the tracing criteria (config12: overhead <= 3%,
+# zero recompiles with tracing on, every span closed exactly once) to
+# it.
 serve-smoke:
 	python bench.py --platform cpu --serving-only --serving-requests 96 \
 	  --serving-max-rows 16 --serving-max-bucket 32 --init-retries 2 \
 	  --coalesce-subjects 8 --coalesce-requests 48 --coalesce-max-bucket 32 \
 	  --coldstart-requests 16 --coldstart-subjects 4 \
-	  --coldstart-max-bucket 4 --coldstart-waves 3
+	  --coldstart-max-bucket 4 --coldstart-waves 3 --tracing-requests 96
 
 # Specialization-split smoke (the quick-lane half of PR 2's tooling):
 # the seconds-scale correctness story of the shape/pose split — bit-
@@ -175,6 +179,17 @@ overload-smoke:
 coldstart-smoke:
 	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_coldstart \
 	  python -m pytest tests/test_coldstart.py -q
+
+# Observability matrix (the PR-8 tentpole): span lifecycle across every
+# terminal kind composed with chaos plans and failover, ring bounds,
+# flight-recorder incident capture, load() quantiles, Chrome-trace
+# export, and stdout purity under `serve-bench --trace`. Wired into
+# `make check` as a SEPARATE pytest process on its own compile-cache
+# dir (the CLAUDE.md rule: two pytest processes must never share
+# .jax_compile_cache/).
+obs-smoke:
+	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_obs \
+	  python -m pytest tests/test_obs.py -q
 
 # Unattended BUILDER-side TPU bench: lockfile-guarded, stands down for the
 # driver's priority claim, and self-expires (default 3 h) — see

@@ -586,7 +586,8 @@ def run_benchmarks(args, device_str: str) -> dict:
                                               "config7_recovery",
                                               "config9_coalesce",
                                               "config10_overload",
-                                              "config11_coldstart"):
+                                              "config11_coldstart",
+                                              "config12_tracing"):
             return
         try:
             fn()
@@ -2120,6 +2121,44 @@ def run_benchmarks(args, device_str: str) -> dict:
     if args.coldstart_requests > 0:
         section("config11_coldstart", config11_coldstart)
 
+    # -- config 12: tracing-overhead leg (PR 8) -----------------------------
+    # THE shared protocol (serving/measure.py:tracing_overhead_run):
+    # the same ragged stream through a traced and an untraced engine,
+    # interleaved per trial — observability must cost <= 3% or it gets
+    # turned off in the incident it exists for. Criteria
+    # (scripts/bench_report.py): median paired overhead ratio <= 1.03,
+    # zero steady recompiles with tracing ON (events must never change
+    # program identity), and every submitted span closed exactly once.
+    # With --profile set, the traced engine's Chrome-trace host
+    # timeline is exported NEXT TO the XLA device capture, so
+    # `scripts/trace_report.py <profile-dir>` merges both halves of
+    # the run into one stage-breakdown report (ROADMAP item 2: the
+    # traces "have never been read"). Every criterion is CPU-defined.
+    def config12_tracing():
+        from mano_hand_tpu.serving.measure import tracing_overhead_run
+
+        trc = tracing_overhead_run(
+            right,
+            requests=args.tracing_requests,
+            max_rows=args.serving_max_rows,
+            max_bucket=args.serving_max_bucket,
+            trace_dir=args.profile or None,
+            seed=19,
+            log=lambda m: log(f"config12 {m}"),
+        )
+        results["tracing"] = trc
+        acc = trc["span_accounting"]
+        log(f"config12 tracing: overhead ratio "
+            f"{trc['tracing_overhead_ratio']:.3f} (trials "
+            f"{trc['ratio_trials']}), {trc['steady_recompiles']} steady "
+            f"recompiles, {acc['spans_closed']}/{acc['spans_started']} "
+            f"spans closed ({acc['spans_open']} open), "
+            f"{len(trc['stage_breakdown']['by_bucket_tier'])} stage "
+            f"cells")
+
+    if args.tracing_requests > 0:
+        section("config12_tracing", config12_tracing)
+
     if args.serving_only:
         # Fast serving-layer artifact (`make serve-smoke`): the deferred
         # runner's serving-only skip reduces the schedule to config7
@@ -2356,9 +2395,10 @@ def main() -> int:
     ap.add_argument("--serving-only", action="store_true",
                     help="run ONLY the serving-engine leg, the "
                          "fault-recovery drill, the mixed-subject "
-                         "coalescing leg, the overload drill and the "
-                         "cold-start drill (fast serving-layer "
-                         "artifact; `make serve-smoke`)")
+                         "coalescing leg, the overload drill, the "
+                         "cold-start drill and the tracing-overhead "
+                         "leg (fast serving-layer artifact; "
+                         "`make serve-smoke`)")
     ap.add_argument("--coalesce-subjects", type=int, default=12,
                     help="distinct baked subjects in the mixed-subject "
                          "coalescing leg (config9; >= 8 engages the "
@@ -2401,6 +2441,10 @@ def main() -> int:
                     help="largest power-of-two bucket of the config11 "
                          "engines (bounds the lattice size: every "
                          "bucket bakes full+gather+cpu entries)")
+    ap.add_argument("--tracing-requests", type=int, default=160,
+                    help="requests per pass of the tracing-overhead "
+                         "leg (config12: traced vs untraced engine, "
+                         "interleaved; 0 skips the leg)")
     ap.add_argument("--coldstart-waves", type=int, default=6,
                     help="post-restore request waves used to call the "
                          "p99 settled (config11)")

@@ -44,8 +44,15 @@ def run_analysis(
                      f"{len(default_policy_paths(root))} files linted"))
 
     locks = check_lock_discipline()
+    # PR 8: the obs/ tracer and flight recorder hold their own locks on
+    # the dispatch path — same cycle/re-acquire rules, no documented
+    # order (each class owns exactly one lock; any nesting edge a
+    # refactor introduces still gets cycle-checked).
+    for p in sorted((root / "mano_hand_tpu" / "obs").glob("*.py")):
+        locks += check_lock_discipline(p, order=())
     sections.append(("lock-discipline", locks,
-                     "serving/engine.py nesting graph + call edges"))
+                     "serving/engine.py + obs/ nesting graphs + call "
+                     "edges"))
 
     step = check_lockstep(baseline.get("lockstep", {}))
     stale_note = lockstep_stale(baseline.get("lockstep", {}))

@@ -953,12 +953,22 @@ def cmd_serve_bench(args) -> int:
     overload/saturation drill (bench.py config10's
     ``serving.measure.overload_drill_run``); ``--cold-start`` runs the
     restart drill against a persistent ``--aot-dir`` (bench.py
-    config11's ``serving.measure.cold_start_drill_run``)."""
+    config11's ``serving.measure.cold_start_drill_run``); ``--trace
+    DIR`` (PR 8) spans every request through an ``obs.Tracer`` and
+    exports the Chrome-trace timeline + final flight record into DIR
+    for `mano trace-report` — stdout stays EXACTLY one JSON line
+    (progress and incidents ride stderr / the trace dir)."""
     import os
 
     import jax
 
+    from mano_hand_tpu.obs import log as obs_log
     from mano_hand_tpu.serving.measure import serve_bench_run
+
+    # Progress rides the leveled stderr logger (PR 8): pinned to
+    # "info" here — an interactive bench wants its phases visible —
+    # while stdout remains the one-JSON-line artifact channel.
+    log = obs_log.get_logger("serve-bench", level="info").info
 
     if args.chaos != "drill":
         # The drill fixes its own protocol sizes; these knobs shape the
@@ -991,20 +1001,77 @@ def cmd_serve_bench(args) -> int:
     # JSON line stays valid either way (null + error on the kill path).
     from mano_hand_tpu.runtime.supervise import Watchdog
 
+    tracer = None
+    if args.trace:
+        # One tracer spans the whole invocation (PR 8); the protocols
+        # below pass it into their engines, and the timeline + final
+        # flight record are exported into --trace DIR before the JSON
+        # line prints.
+        from mano_hand_tpu.obs import Tracer
+
+        tracer = Tracer()
+
     emit_by = 900.0 if args.emit_by < 0 else args.emit_by
 
     def _hard_exit(cause: str) -> None:
+        # The one-JSON-line artifact prints FIRST: --emit-by exists so
+        # the driver finds stdout populated AT the deadline, and
+        # nothing — not even the flight-recorder dump — may delay it.
         print(json.dumps({
             "engine_evals_per_sec": None,
             "error": f"serve-bench {cause} — hung device RPC (tunnel "
                      "drop mid-dispatch?)",
         }), flush=True)
+        if tracer is not None:
+            # The flight recorder's reason to exist: the timeline up to
+            # the wedge lands on disk before the process dies (the
+            # watchdog already stamped the kill incident onto it). But
+            # the dump must never cost the kill itself: the same
+            # incident that wedged the dispatcher can wedge I/O too
+            # (try/except catches exceptions, not hangs), so the write
+            # runs on a disposable daemon thread with a BOUNDED join —
+            # the call_with_deadline reasoning — and os._exit lands
+            # regardless.
+            def dump():
+                try:
+                    from mano_hand_tpu.obs import write_trace_dir
+
+                    write_trace_dir(tracer, args.trace,
+                                    reason="watchdog_kill")
+                except Exception:  # noqa: BLE001 — best-effort dump
+                    pass
+
+            import threading
+
+            t = threading.Thread(target=dump, name="trace-dump",
+                                 daemon=True)
+            t.start()
+            t.join(10.0)
         os._exit(3)
 
     wd = Watchdog(_hard_exit, deadline_s=emit_by or None,
-                  name="serve-bench-watchdog").start()
+                  name="serve-bench-watchdog", tracer=tracer).start()
     if args.emit_by < 0 and jax.default_backend() == "cpu":
         wd.disarm()  # auto mode: no tunnel to guard against on cpu
+
+    def export_trace(out: dict) -> None:
+        """Drop the Chrome-trace timeline + final flight record into
+        --trace DIR and note the paths in the artifact. A full or
+        read-only trace dir must not discard a COMPLETED run: the
+        failure is recorded in the artifact and the one JSON line
+        still prints (the FlightRecorder disk-failure rule)."""
+        if tracer is None:
+            return
+        try:
+            from mano_hand_tpu.obs import write_trace_dir
+
+            out["trace_export"] = write_trace_dir(tracer, args.trace,
+                                                  reason="run_complete")
+        except OSError as e:
+            out["trace_export"] = {
+                "error": f"{type(e).__name__}: {e} (trace dir "
+                         f"{args.trace!r} unwritable; the run's "
+                         "metrics above are unaffected)"}
 
     if args.cold_start:
         # The cold-start/restart drill (the same protocol as bench.py
@@ -1036,8 +1103,9 @@ def cmd_serve_bench(args) -> int:
 
         out = cold_start_drill_run(
             params, aot_dir=args.aot_dir, seed=args.seed,
-            log=lambda m: print(m, file=sys.stderr))
+            tracer=tracer, log=log)
         out["backend"] = jax.default_backend()
+        export_trace(out)
         print(json.dumps(out))
         return 0
 
@@ -1063,8 +1131,9 @@ def cmd_serve_bench(args) -> int:
 
         out = overload_drill_run(
             params, saturation=args.overload_saturation, seed=args.seed,
-            log=lambda m: print(m, file=sys.stderr))
+            tracer=tracer, log=log)
         out["backend"] = jax.default_backend()
+        export_trace(out)
         print(json.dumps(out))
         return 0
 
@@ -1088,8 +1157,9 @@ def cmd_serve_bench(args) -> int:
               else {"deadline_s": args.deadline_s})
         out = recovery_drill_run(
             params, max_bucket=8, seed=args.seed,
-            log=lambda m: print(m, file=sys.stderr), **kw)
+            tracer=tracer, log=log, **kw)
         out["backend"] = jax.default_backend()
+        export_trace(out)
         print(json.dumps(out))
         return 0
     policy = None
@@ -1144,11 +1214,13 @@ def cmd_serve_bench(args) -> int:
             max_delay_s=args.max_delay_ms * 1e-3,
             seed=args.seed,
             policy=policy,
-            log=lambda m: print(m, file=sys.stderr),
+            tracer=tracer,
+            log=log,
         )
         out["backend"] = jax.default_backend()
         if args.chaos:
             out["chaos"] = args.chaos
+        export_trace(out)
         print(json.dumps(out))
         return 0
     out = serve_bench_run(
@@ -1161,12 +1233,39 @@ def cmd_serve_bench(args) -> int:
         aot_dir=args.aot_dir or None,
         seed=args.seed,
         policy=policy,
+        tracer=tracer,
     )
     out["backend"] = jax.default_backend()
     if args.chaos:
         out["chaos"] = args.chaos
+    export_trace(out)
     print(json.dumps(out))
     return 0
+
+
+def cmd_trace_report(args) -> int:
+    """`mano trace-report` — the CLI spelling of
+    scripts/trace_report.py (PR 8): one merged host+device timeline
+    report over an XLA ``--profile`` capture and/or an engine span
+    export. The script stays a standalone stdlib-only tool (it must
+    run where this package isn't importable — e.g. over an archived
+    artifact dir on a bare box), so the CLI loads it by path instead
+    of duplicating the logic."""
+    import importlib.util
+    from pathlib import Path
+
+    script = (Path(__file__).resolve().parents[1] / "scripts"
+              / "trace_report.py")
+    spec = importlib.util.spec_from_file_location(
+        "mano_trace_report", script)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    argv = [str(args.path), "--top", str(args.top)]
+    if args.json:
+        argv.append("--json")
+    if args.all_tracks:
+        argv.append("--all-tracks")
+    return mod.main(argv)
 
 
 def cmd_analyze(args) -> int:
@@ -1558,8 +1657,33 @@ def build_parser() -> argparse.ArgumentParser:
                     help="offered-load multiple of the measured "
                          "service rate for --overload (criteria are "
                          "judged at >= 4x achieved)")
+    sb.add_argument("--trace", default="",
+                    help="request-lifecycle tracing (PR 8): span every "
+                         "request through an obs.Tracer and export the "
+                         "Chrome-trace timeline + final flight record "
+                         "into this directory (read it with `mano "
+                         "trace-report DIR`). Composes with every "
+                         "protocol; stdout stays one JSON line. A "
+                         "watchdog kill dumps the timeline here before "
+                         "exiting")
     sb.add_argument("--seed", type=int, default=0)
     sb.set_defaults(fn=cmd_serve_bench)
+
+    tr = sub.add_parser(
+        "trace-report",
+        help="summarize an XLA --profile capture and/or an engine span "
+             "export (serve-bench --trace DIR) into one merged "
+             "host+device report: top device ops + per-bucket/tier "
+             "queue/dispatch/device/readback stage breakdown",
+    )
+    tr.add_argument("path", help="trace dir or one *.trace.json[.gz]")
+    tr.add_argument("--top", type=int, default=15)
+    tr.add_argument("--json", action="store_true",
+                    help="machine-readable JSON instead of the tables")
+    tr.add_argument("--all-tracks", action="store_true",
+                    help="include host tracks even when a device track "
+                         "exists")
+    tr.set_defaults(fn=cmd_trace_report)
 
     an = sub.add_parser(
         "analyze",

@@ -1,0 +1,34 @@
+"""Observability for the serving stack (PR 8): request-lifecycle
+tracing, an incident flight recorder, a unified host+device timeline,
+and the leveled stderr logger.
+
+Deliberately jax-free at import time: the tracer rides the engine's hot
+path and the logger is imported by everything — neither may pull a
+backend in.
+
+* ``obs.trace.Tracer`` — bounded lock-light span/event ring; threaded
+  through ``ServingEngine(tracer=...)``.
+* ``obs.recorder`` — ``FlightRecorder`` (auto-capture on incidents),
+  ``flight_record`` (one bounded artifact), ``write_trace_dir``
+  (Chrome-trace export for ``scripts/trace_report.py``).
+* ``obs.log`` — ``get_logger``: info/debug to leveled stderr,
+  warning through the ``warnings`` machinery, stdout never.
+"""
+
+from mano_hand_tpu.obs.log import Logger, get_logger
+from mano_hand_tpu.obs.recorder import (
+    FlightRecorder,
+    flight_record,
+    write_trace_dir,
+)
+from mano_hand_tpu.obs.trace import TERMINAL_KINDS, Tracer
+
+__all__ = [
+    "FlightRecorder",
+    "Logger",
+    "TERMINAL_KINDS",
+    "Tracer",
+    "flight_record",
+    "get_logger",
+    "write_trace_dir",
+]

@@ -226,15 +226,35 @@ class ChaosPlan:
         """Wrap ``fn`` so each invocation consults the plan first.
 
         ``on_fault`` (e.g. ``ServingCounters.count_fault``) fires once
-        per injected fault, before the fault takes effect.
+        per injected fault, before the fault takes effect. A hook that
+        accepts two positional arguments (the engine's tracing hook,
+        PR 8) is called as ``on_fault(kind, call_index)`` so the fault
+        lands on the request timeline with its identity; anything else
+        keeps the historical no-argument call. The arity is resolved
+        ONCE at wrap time, not per dispatch.
         """
+        notify = None
+        if on_fault is not None:
+            import inspect
+
+            try:
+                positional = [
+                    p for p in
+                    inspect.signature(on_fault).parameters.values()
+                    if p.kind in (p.POSITIONAL_ONLY,
+                                  p.POSITIONAL_OR_KEYWORD)]
+                rich = len(positional) >= 2
+            except (TypeError, ValueError):
+                rich = False
+            notify = ((lambda ev, idx: on_fault(ev.kind, idx)) if rich
+                      else (lambda ev, idx: on_fault()))
 
         def chaotic(*args, **kwargs):
             idx, ev = self._next()
             if ev is None:
                 return fn(*args, **kwargs)
-            if on_fault is not None:
-                on_fault()
+            if notify is not None:
+                notify(ev, idx)
             if ev.kind == "hang":
                 # The unkillable-RPC stand-in: block until released.
                 # A supervised caller abandons this (daemon) thread at

@@ -77,6 +77,7 @@ class CircuitBreaker:
         probe_interval_s: float = 30.0,
         respect_priority_claim: bool = True,
         clock: Callable[[], float] = time.monotonic,
+        on_transition: Optional[Callable[[str, str], None]] = None,
     ):
         if failure_threshold < 1:
             raise ValueError(
@@ -86,6 +87,12 @@ class CircuitBreaker:
         self.probe_interval_s = float(probe_interval_s)
         self.respect_priority_claim = bool(respect_priority_claim)
         self.clock = clock
+        # Observability hook (PR 8): called as ``on_transition(old,
+        # new)`` on every state CHANGE, outside the breaker lock (the
+        # hook may take its own — e.g. an obs.Tracer appending the
+        # transition to the request timeline). A tracing ServingEngine
+        # wires this automatically when the slot is free.
+        self.on_transition = on_transition
         self._lock = threading.Lock()
         self._state = HEALTHY
         self._consecutive_failures = 0
@@ -100,14 +107,27 @@ class CircuitBreaker:
         with self._lock:
             return self._state
 
+    def _notify(self, old: str, new: str) -> None:
+        """Fire ``on_transition`` for a state CHANGE — outside the
+        lock, and never letting a broken hook poison the dispatch path
+        that carried the state change."""
+        if old != new and self.on_transition is not None:
+            try:
+                self.on_transition(old, new)
+            except Exception:  # noqa: BLE001 — telemetry, not control
+                pass
+
     def reset(self) -> None:
         with self._lock:
+            old = self._state
             self._state = HEALTHY
             self._consecutive_failures = 0
             self._last_probe_t = None
+        self._notify(old, HEALTHY)
 
     def record_failure(self) -> str:
         with self._lock:
+            old = self._state
             self._consecutive_failures += 1
             if self._consecutive_failures >= self.failure_threshold:
                 if self._state != DOWN:
@@ -115,13 +135,17 @@ class CircuitBreaker:
                 self._state = DOWN
             elif self._state == HEALTHY:
                 self._state = DEGRADED
-            return self._state
+            new = self._state
+        self._notify(old, new)
+        return new
 
     def record_success(self) -> str:
         with self._lock:
+            old = self._state
             self._consecutive_failures = 0
             self._state = HEALTHY
-            return self._state
+        self._notify(old, HEALTHY)
+        return HEALTHY
 
     # ----------------------------------------------------------- the gate
     def allow_primary(self) -> bool:
@@ -153,8 +177,10 @@ class CircuitBreaker:
             ok = False
         with self._lock:
             self._probing = False
+            old = self._state
             if ok:
                 self._state = HEALTHY
                 self._consecutive_failures = 0
-                return True
-            return False
+        if ok:
+            self._notify(old, HEALTHY)
+        return ok

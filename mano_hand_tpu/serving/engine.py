@@ -102,6 +102,21 @@ bench.py rides under its own instance of the same class.
   and bench_report judges resolution-within-budget, tier-0 goodput,
   and zero steady-state recompiles.
 
+* **narrates itself** (PR 8): pass an ``obs.Tracer`` and every request
+  carries a SPAN — stamped at each boundary the engine already sweeps
+  deadlines at (submit -> coalesce/park -> launch -> dispatched ->
+  readback -> resolve) and closed EXACTLY ONCE at the future's terminal
+  kind (ok/shed/expired/error/shutdown), at the same sites that resolve
+  the future, so "every future resolves" and "every span closes" are
+  the same guarantee. Runtime events (chaos faults, breaker
+  transitions, deadline kills, failovers, evictions, lattice loads,
+  compiles) land on the same timeline; incidents trigger the flight
+  recorder (obs/recorder.py). ``load()`` grows per-tier latency
+  quantiles + backlog age from the tracer. The disabled path
+  (``tracer=None``, the default) adds zero calls; the enabled path
+  costs <= 3% end-to-end, measured by bench config12's paired
+  interleaved criterion — tracing must never change WHAT it measures.
+
 * **survives its own death** (PR 6): restart is just another fault
   class. ``bake_lattice()`` pre-bakes EVERY reachable program —
   (bucket x kind {full, gathered pose-only} x table capacity x
@@ -148,10 +163,16 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from mano_hand_tpu.obs import log as obs_log
 from mano_hand_tpu.serving import buckets as bucket_mod
 from mano_hand_tpu.utils.profiling import ServingCounters
 
 _SENTINEL = object()
+
+#: Degradation messages route through the obs logger's warning channel
+#: (a real ``warnings.warn`` — catchable/assertable, stderr, never
+#: stdout; see obs/log.py for the channel split).
+_LOG = obs_log.get_logger("serving.engine")
 
 
 class ServingError(RuntimeError):
@@ -282,7 +303,7 @@ def build_cpu_fallback_executable(params_host, bucket: int, n_joints: int,
 
 class _Request:
     __slots__ = ("pose", "shape", "rows", "squeeze", "subject", "future",
-                 "t_submit", "deadline", "tier")
+                 "t_submit", "deadline", "tier", "span")
 
     def __init__(self, pose, shape, rows, squeeze, subject=None,
                  deadline=None, tier=0):
@@ -295,6 +316,7 @@ class _Request:
         self.t_submit = time.perf_counter()
         self.deadline = deadline    # absolute time.monotonic() or None
         self.tier = tier            # priority class (0 = interactive)
+        self.span = None            # obs.Tracer span id (PR 8) or None
 
 
 class ServingEngine:
@@ -360,6 +382,15 @@ class ServingEngine:
     busy_fraction: the soft backpressure threshold: ``load()`` reports
         a tier "busy" (try later) once outstanding crosses this
         fraction of its quota, before hard shedding begins.
+    tracer: an ``obs.Tracer`` (PR 8). None (default) disables tracing
+        entirely — zero calls on every path. With a tracer, every
+        request carries a span (see the module docstring), runtime
+        events ride the same timeline, incidents (deadline kill,
+        failover, shed burst) notify the flight recorder, and
+        ``load()`` gains per-tier latency quantiles + backlog age.
+        When the policy carries a ``CircuitBreaker`` without an
+        ``on_transition`` hook, the engine wires breaker state changes
+        onto the timeline too.
     """
 
     def __init__(
@@ -379,6 +410,7 @@ class ServingEngine:
         max_queued: Optional[int] = None,
         tier_quotas: Optional[dict] = None,
         busy_fraction: float = 0.75,
+        tracer=None,
     ):
         self._params = params.astype(dtype)
         self._dtype = np.dtype(dtype)
@@ -419,6 +451,17 @@ class ServingEngine:
             raise ValueError(
                 f"busy_fraction must be in (0, 1], got {busy_fraction}")
         self.busy_fraction = float(busy_fraction)
+        self._tracer = tracer
+        if tracer is not None and policy is not None:
+            breaker = getattr(policy, "breaker", None)
+            if (breaker is not None
+                    and getattr(breaker, "on_transition", None) is None):
+                # Breaker state changes belong on the request timeline;
+                # only an unclaimed hook is taken (a caller-wired hook
+                # — e.g. a drill's own — wins).
+                breaker.on_transition = (
+                    lambda old, new: tracer.runtime_event(
+                        "breaker", old=old, new=new))
         self._params_dev = None        # device-resident params (jit path)
         # The executable lattice (PR 6): loaded lazily from aot_dir's
         # manifest (one boot-time JSON read; entries deserialize on
@@ -640,6 +683,7 @@ class ServingEngine:
             shaped = core.jit_specialize(self._params_dev, betas)
         with self._install_lock:
             grew = False
+            evicted = None
             with self._exe_lock:
                 if key in self._subject_slots:     # racing writer won
                     self._subject_lru.move_to_end(key)
@@ -672,6 +716,12 @@ class ServingEngine:
                     slot = self._subject_slots.pop(victim)
                     del self._subject_lru[victim]
                     self.counters.count_evict()
+                    evicted = victim
+            if evicted is not None and self._tracer is not None:
+                # Staged outside _exe_lock like the device work below:
+                # the dispatch path must never queue behind telemetry.
+                self._tracer.runtime_event("evict", subject=evicted,
+                                           slot=slot)
             # Device work on a STAGED table, outside _exe_lock (no
             # other writer can interleave: installs are the table's
             # only mutators and _install_lock serializes them).
@@ -784,13 +834,21 @@ class ServingEngine:
                 else:
                     state = "ok"
                 tiers[str(t)] = state
-        return {
+        out = {
             "outstanding": outstanding,
             "queued": queued,
             "max_queued": self.max_queued,
             "admission": tiers,
             "backlog_peak": self.counters.backlog_peak,
         }
+        if self._tracer is not None:
+            # PR 8: per-tier resolve-latency quantiles + backlog age.
+            # The tracer copies its samples and open-span starts in ONE
+            # lock hold (obs/trace.py:load_snapshot — the same
+            # torn-telemetry rule as ServingCounters.snapshot), so the
+            # quantiles and the age describe the same instant.
+            out.update(self._tracer.load_snapshot())
+        return out
 
     # --------------------------------------------------- deadlines (PR 5)
     def _is_expired(self, req: _Request, now: Optional[float] = None,
@@ -811,6 +869,8 @@ class ServingEngine:
                 "result would not be read, so none was produced",
                 phase=phase, kind="expired"))
             self.counters.count_expired(req.tier)
+            if self._tracer is not None:
+                self._tracer.close(req.span, "expired", phase=phase)
         self._deregister(req)
 
     def submit(self, pose, shape=None, subject: Optional[str] = None,
@@ -894,6 +954,13 @@ class ServingEngine:
                     else time.monotonic() + float(deadline_s))
         req = _Request(pose, shape, n, squeeze, subject,
                        deadline=deadline, tier=tier)
+        tr = self._tracer
+        if tr is not None:
+            # The span opens HERE — after validation (a caller error is
+            # not a request), before any resolution path, so every
+            # terminal kind below closes exactly this span.
+            req.span = tr.start("posed" if subject is not None else "full",
+                                tier=tier, rows=n)
         if deadline is not None and float(deadline_s) <= 0:
             # Born expired: resolve the future right here — no
             # registration, no queue slot, no dispatch (the satellite
@@ -914,6 +981,13 @@ class ServingEngine:
                     outstanding += 1
             if not admitted:
                 self.counters.count_shed(tier)
+                if tr is not None:
+                    # Shed is a terminal resolution: close the span on
+                    # the O(µs) admission path (two cheap tracer calls;
+                    # note_shed's streak detector turns a sustained
+                    # burst into ONE flight-recorder incident).
+                    tr.close(req.span, "shed")
+                    tr.note_shed()
                 raise ServingError(
                     f"admission shed: {outstanding} outstanding >= "
                     f"tier-{tier} quota {quota} "
@@ -924,6 +998,8 @@ class ServingEngine:
             self.counters.observe_backlog(outstanding)
         else:
             self.counters.observe_backlog(self._register(req))
+        if tr is not None:
+            tr.note_admit()   # resets the shed-burst streak
         self.start()
         self._queue.put(req)
         if self._failure is not None:
@@ -1149,9 +1225,7 @@ class ServingEngine:
         except Exception as e:  # noqa: BLE001 — degrade, not crash
             if strict:
                 raise
-            import warnings
-
-            warnings.warn(
+            _LOG.warning(
                 f"subject checkpoint {path}: {type(e).__name__}: {e}; "
                 "restoring nothing (subjects re-specialize on demand)")
             summary["error"] = f"{type(e).__name__}: {e}"
@@ -1183,6 +1257,17 @@ class ServingEngine:
         return summary
 
     # ---------------------------------------------------------- executables
+    def _on_chaos_fault(self, kind: Optional[str] = None,
+                        index: Optional[int] = None) -> None:
+        """Chaos-plan fault hook: the counter tick plus (PR 8) the
+        fault on the request timeline — ``ChaosPlan.wrap`` passes the
+        fault kind and call index when given a hook that accepts
+        them."""
+        self.counters.count_fault()
+        if self._tracer is not None:
+            self._tracer.runtime_event("chaos_fault", kind=kind,
+                                       index=index)
+
     def _artifact_path(self, bucket: int):
         from pathlib import Path
 
@@ -1244,14 +1329,19 @@ class ServingEngine:
                         np.zeros((bucket, self._n_joints, 3), self._dtype),
                         np.zeros((bucket, self._n_shape), self._dtype)))
                     self.counters.count_aot_load()
+                    if self._tracer is not None:
+                        self._tracer.runtime_event(
+                            "lattice_load", family="full", bucket=bucket)
                 except Exception as e:  # noqa: BLE001 — degrade
-                    import warnings
-
                     self.counters.count_aot_load_failure()
-                    warnings.warn(
+                    _LOG.warning(
                         f"lattice full/b{bucket} entry failed at "
                         f"execution ({type(e).__name__}: {e}); "
                         "recompiling (counted)")
+                    if self._tracer is not None:
+                        self._tracer.runtime_event(
+                            "lattice_load_failed", family="full",
+                            bucket=bucket)
                     loaded = None
         if loaded is None and self.aot_dir is not None:
             from mano_hand_tpu.io.export_aot import load_forward
@@ -1275,10 +1365,8 @@ class ServingEngine:
                     # bucket forever OR serve silently-wrong results:
                     # counted degradation, then the jit path below, which
                     # also re-exports a good artifact.
-                    import warnings
-
                     self.counters.count_aot_load_failure()
-                    warnings.warn(
+                    _LOG.warning(
                         f"invalid serving artifact {path} "
                         f"({type(e).__name__}: {e}); recompiling and "
                         "rewriting it")
@@ -1297,6 +1385,9 @@ class ServingEngine:
                 self._params_dev, bucket, self._n_joints, self._n_shape,
                 self._dtype, donate=self.donate)
             self.counters.count_compile()
+            if self._tracer is not None:
+                self._tracer.runtime_event("compile", family="full",
+                                           bucket=bucket)
             if self.aot_dir is not None:
                 import os
                 from pathlib import Path
@@ -1319,7 +1410,7 @@ class ServingEngine:
             # fallback path stays clean by construction — failover is
             # measured recovery, not roulette.
             loaded = self._policy.chaos.wrap(
-                loaded, on_fault=self.counters.count_fault)
+                loaded, on_fault=self._on_chaos_fault)
         with self._exe_lock:
             # Two threads can race the build; first writer wins so the
             # cache never flips executables under steady traffic.
@@ -1384,24 +1475,33 @@ class ServingEngine:
                         np.zeros((bucket, self._n_joints, 3),
                                  self._dtype)))
                     self.counters.count_aot_load()
+                    if self._tracer is not None:
+                        self._tracer.runtime_event(
+                            "lattice_load", family="gather",
+                            bucket=bucket, capacity=cap)
                 except Exception as e:  # noqa: BLE001 — degrade
-                    import warnings
-
                     self.counters.count_aot_load_failure()
-                    warnings.warn(
+                    _LOG.warning(
                         f"lattice gather/b{bucket}/c{cap} entry failed "
                         f"at execution ({type(e).__name__}: {e}); "
                         "recompiling (counted)")
+                    if self._tracer is not None:
+                        self._tracer.runtime_event(
+                            "lattice_load_failed", family="gather",
+                            bucket=bucket, capacity=cap)
                     exe = None
         if exe is None:
             exe = build_posed_gather_executable(
                 table, bucket, self._n_joints, self._dtype,
                 donate=self.donate)
             self.counters.count_compile()
+            if self._tracer is not None:
+                self._tracer.runtime_event("compile", family="gather",
+                                           bucket=bucket, capacity=cap)
         if self._policy is not None and self._policy.chaos is not None:
             # Same primary-only chaos wrapping as the full path.
             exe = self._policy.chaos.wrap(
-                exe, on_fault=self.counters.count_fault)
+                exe, on_fault=self._on_chaos_fault)
         with self._exe_lock:
             cur = self._gather_exes.get(bucket)
             if cur is not None and cur[0] == cap:
@@ -1466,20 +1566,28 @@ class ServingEngine:
                         np.zeros((bucket, self._n_joints, 3), self._dtype),
                         np.zeros((bucket, self._n_shape), self._dtype)))
                     self.counters.count_aot_load()
+                    if self._tracer is not None:
+                        self._tracer.runtime_event(
+                            "lattice_load", family="cpu", bucket=bucket)
                 except Exception as e:  # noqa: BLE001 — degrade
-                    import warnings
-
                     self.counters.count_aot_load_failure()
-                    warnings.warn(
+                    _LOG.warning(
                         f"lattice cpu/b{bucket} entry failed at "
                         f"execution ({type(e).__name__}: {e}); "
                         "recompiling (counted)")
+                    if self._tracer is not None:
+                        self._tracer.runtime_event(
+                            "lattice_load_failed", family="cpu",
+                            bucket=bucket)
                     exe = None
         if exe is None:
             exe = build_cpu_fallback_executable(
                 self._params, bucket, self._n_joints, self._n_shape,
                 self._dtype)
             self.counters.count_compile()
+            if self._tracer is not None:
+                self._tracer.runtime_event("compile", family="cpu",
+                                           bucket=bucket)
         with self._exe_lock:
             exe = self._cpu_exes.setdefault(bucket, exe)
         return exe
@@ -1535,8 +1643,12 @@ class ServingEngine:
                 reqs.append(nxt)
                 if posed:
                     subjects.add(nxt.subject)
+                if self._tracer is not None:
+                    self._tracer.event(nxt.span, "coalesce")
                 return None
             self._pending.append(nxt)
+            if self._tracer is not None:
+                self._tracer.event(nxt.span, "park", why=why)
             if why == "overflow" and fresh:
                 # Count each overflowING request once, at its FIRST
                 # park from the live queue — a re-park of an already-
@@ -1665,6 +1777,13 @@ class ServingEngine:
                 rows = sum(r.rows for r in reqs)
         try:
             bucket = bucket_mod.bucket_for(rows, self.buckets)
+            tr = self._tracer
+            if tr is not None:
+                # The launch boundary: queue/coalesce wait ends here;
+                # batch assembly, executable fetch, and the dispatch
+                # itself land between "launch" and "dispatched".
+                for r in reqs:
+                    tr.event(r.span, "launch", bucket=bucket)
             if len(reqs) == 1:
                 pose = reqs[0].pose
             else:
@@ -1697,6 +1816,12 @@ class ServingEngine:
             self.counters.count_dispatch(bucket, rows,
                                          requests=len(reqs),
                                          subjects=n_subjects)
+            if tr is not None:
+                # Supervised dispatch returns a HOST array (device time
+                # already paid); unsupervised returns an async handle —
+                # either way this is where the batch left the engine.
+                for r in reqs:
+                    tr.event(r.span, "dispatched")
             return out, reqs, bucket
         except ServingError as e:
             # Supervision exhausted for THIS batch: its futures get the
@@ -1749,6 +1874,21 @@ class ServingEngine:
         deadlines = [r.deadline for r in reqs]
         give_up_by = (None if any(d is None for d in deadlines)
                       else max(deadlines))
+        tr = self._tracer
+        if tr is None:
+            on_retry = self.counters.count_retry
+            on_kill = self.counters.count_deadline_kill
+        else:
+            def on_retry():
+                self.counters.count_retry()
+                tr.runtime_event("retry", bucket=bucket)
+
+            def on_kill():
+                # A deadline kill abandons a wedged worker thread — an
+                # incident worth a flight-recorder capture, not just a
+                # counter tick.
+                self.counters.count_deadline_kill()
+                tr.incident("deadline_kill", bucket=bucket)
         last = None
         attempts = 0
         if breaker is None or breaker.allow_primary():
@@ -1763,8 +1903,8 @@ class ServingEngine:
                     give_up_by=give_up_by,
                     keep_trying=(breaker.allow_primary
                                  if breaker is not None else None),
-                    on_retry=self.counters.count_retry,
-                    on_deadline_kill=self.counters.count_deadline_kill,
+                    on_retry=on_retry,
+                    on_deadline_kill=on_kill,
                     on_attempt_failure=(breaker.record_failure
                                         if breaker is not None else None),
                     name=f"serve-dispatch-b{bucket}",
@@ -1796,6 +1936,8 @@ class ServingEngine:
                 attempts=attempts, cause=last)
         if pol.cpu_fallback:
             self.counters.count_failover()
+            if tr is not None:
+                tr.incident("failover", bucket=bucket, attempts=attempts)
             if table is not None:
                 # Per-ROW betas for the mixed-subject batch (pad rows
                 # repeat request 0's betas, matching pad_rows/idx row 0).
@@ -1837,10 +1979,16 @@ class ServingEngine:
             raise
         now = time.perf_counter()
         mono = time.monotonic()
+        tr = self._tracer
         lo = 0
         for r in reqs:
             piece = verts[lo:lo + r.rows]
             lo += r.rows
+            if tr is not None:
+                # The batch's device wait ended at the np.asarray above;
+                # what remains per request is host-side slice + future
+                # delivery (the "readback" stage tail).
+                tr.event(r.span, "readback")
             if self._is_expired(r, mono):
                 # The result exists but arrived past the request's own
                 # deadline: a stale pose is worthless (PAPER.md §0), so
@@ -1851,6 +1999,8 @@ class ServingEngine:
             if not r.future.done():  # a shutdown sweep can win the race
                 r.future.set_result(piece[0] if r.squeeze else piece)
                 self.counters.count_served(r.tier)
+                if tr is not None:
+                    tr.close(r.span, "ok", bucket=bucket)
             self._deregister(r)
             self.counters.record_latency(bucket, now - r.t_submit)
 
@@ -1872,17 +2022,32 @@ class ServingEngine:
         with self._live_lock:
             self._live.pop(id(req), None)
 
+    @staticmethod
+    def _terminal_kind(exc: Optional[BaseException]) -> str:
+        """The span-close kind for an exception-resolved future —
+        exactly the ``ServingError.kind`` the caller sees; any other
+        exception class is an engine "error"."""
+        if isinstance(exc, ServingError):
+            return exc.kind
+        return "shutdown" if exc is None else "error"
+
     def _sweep_live(self, exc: BaseException) -> None:
         with self._live_lock:
             reqs, self._live = list(self._live.values()), {}
+        kind = self._terminal_kind(exc)
         for r in reqs:
             if not r.future.done():
                 r.future.set_exception(exc)
+                if self._tracer is not None:
+                    self._tracer.close(r.span, kind, phase="sweep")
 
     def _poison(self, reqs, exc: BaseException) -> None:
+        kind = self._terminal_kind(exc)
         for r in reqs:
             if not r.future.done():
                 r.future.set_exception(exc)
+                if self._tracer is not None:
+                    self._tracer.close(r.span, kind, phase="poison")
             self._deregister(r)
 
     def _drain_cancelled(self, exc: Optional[BaseException] = None) -> None:
@@ -1895,9 +2060,12 @@ class ServingEngine:
             if req is _SENTINEL:
                 continue
             if not req.future.done():
-                req.future.set_exception(
-                    exc if exc is not None else
-                    ServingError("serving engine stopped before this "
-                                 "request was dispatched",
-                                 phase="shutdown"))
+                err = (exc if exc is not None else
+                       ServingError("serving engine stopped before this "
+                                    "request was dispatched",
+                                    phase="shutdown"))
+                req.future.set_exception(err)
+                if self._tracer is not None:
+                    self._tracer.close(req.span, self._terminal_kind(err),
+                                       phase="drain")
             self._deregister(req)

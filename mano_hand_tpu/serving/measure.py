@@ -19,9 +19,24 @@ defenses, both load-bearing:
 from __future__ import annotations
 
 import time
-from typing import Callable, List, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from mano_hand_tpu.obs import Tracer, flight_record
+from mano_hand_tpu.obs import log as obs_log
+
+#: Progress messages default to the leveled stderr logger (PR 8
+#: structured-logging satellite): silent at the default "warning"
+#: level, visible under MANO_LOG=info — and NEVER stdout, which
+#: bench.py and `mano serve-bench` own as a one-JSON-line channel.
+#: Callers with their own sink (bench.py's log, the CLI's info logger)
+#: still pass ``log=``.
+_LOG = obs_log.get_logger("serving.measure")
+
+
+def _logger(log: Optional[Callable[[str], None]]):
+    return _LOG.info if log is None else log
 
 
 def measure_overhead(
@@ -91,6 +106,7 @@ def serve_bench_run(
     seed: int = 0,
     trials: int = 7,
     policy=None,
+    tracer=None,
     log: Callable[[str], None] = None,
 ) -> dict:
     """THE serving benchmark protocol — shared by ``bench.py`` config7
@@ -133,9 +149,14 @@ def serve_bench_run(
     # ``policy`` (a runtime.DispatchPolicy) runs the whole protocol
     # under supervised dispatch — `mano serve-bench --chaos <plan>`
     # uses it to measure what a fault schedule does to live metrics.
+    log = _logger(log)
+    # ``tracer`` (PR 8, `serve-bench --trace`): spans the whole stream;
+    # None keeps the historical untraced protocol (config7's numbers
+    # stay tracer-free — the overhead question has its own leg,
+    # ``tracing_overhead_run``/config12).
     eng = ServingEngine(params, max_bucket=max_bucket,
                         max_delay_s=max_delay_s, aot_dir=aot_dir,
-                        policy=policy)
+                        policy=policy, tracer=tracer)
 
     def run_stream():
         futs = [eng.submit(p, s) for p, s in stream]
@@ -191,7 +212,7 @@ def serve_bench_run(
         direct(*fixed[0])                  # compile outside the timing
         overhead = measure_overhead(eng, direct, fixed, trials=trials)
 
-    return {
+    out = {
         "engine_evals_per_sec": float(f"{float(sizes.sum()) / dt:.5g}"),
         **overhead,
         "engine_vs_direct_max_abs_err": numerics_err,
@@ -202,6 +223,10 @@ def serve_bench_run(
         "buckets": list(eng.buckets),
         **snapshot,
     }
+    if tracer is not None:
+        out["flight_record"] = flight_record(
+            tracer, eng.counters, reason="serve_bench_complete")
+    return out
 
 
 def coalesce_bench_run(
@@ -217,6 +242,7 @@ def coalesce_bench_run(
     trials: int = 7,
     max_subjects=None,
     policy=None,
+    tracer=None,
     log: Callable[[str], None] = None,
 ) -> dict:
     """THE mixed-subject coalescing benchmark protocol — shared by
@@ -270,9 +296,17 @@ def coalesce_bench_run(
         for n, s in zip(sizes, subj_of)
     ]
 
+    log = _logger(log)
+    # Every drill attaches a flight record (PR 8): a default tracer
+    # rides along when the caller brings none. Tracing is a measured
+    # <= 3% (config12); the criteria here carry order-of-magnitude
+    # margins.
+    if tracer is None:
+        tracer = Tracer()
     kw = {} if max_subjects is None else {"max_subjects": max_subjects}
     eng = ServingEngine(params, max_bucket=max_bucket,
-                        max_delay_s=max_delay_s, policy=policy, **kw)
+                        max_delay_s=max_delay_s, policy=policy,
+                        tracer=tracer, **kw)
 
     prm_dev = params.astype(np.float32).device_put()
     shaped = [core.jit_specialize(prm_dev, jnp.asarray(b)) for b in betas]
@@ -360,6 +394,8 @@ def coalesce_bench_run(
         "coalesce_width_mean": snapshot["coalesce_width_mean"],
         "padding_waste": snapshot["padding_waste"],
         "dispatches": snapshot["dispatches"],
+        "flight_record": flight_record(
+            tracer, eng.counters, reason="coalesce_drill_complete"),
     }
 
 
@@ -384,6 +420,7 @@ def overload_drill_run(
     batch_deadline_s: float = 0.5,
     shed_probe_submits: int = 256,
     seed: int = 0,
+    tracer=None,
     log: Callable[[str], None] = None,
 ) -> dict:
     """THE overload/saturation drill protocol — shared by ``bench.py``
@@ -439,6 +476,14 @@ def overload_drill_run(
             f"max_queued={max_queued} admits nothing — the drill needs "
             "at least one admitted request to calibrate (the shed-only "
             "path is the probe's job)")
+    log = _logger(log)
+    # One tracer spans BOTH engines (PR 8): the probe's pure-shed spans
+    # and the saturated engine's full mix land on one timeline, and the
+    # flight record's closed-exactly-once accounting covers every
+    # submit the drill made. A sustained shed run fires the tracer's
+    # shed_burst incident — the recorder trigger overload exists for.
+    if tracer is None:
+        tracer = Tracer()
     n_joints = params.n_joints
     rng = np.random.default_rng(seed)
 
@@ -451,7 +496,8 @@ def overload_drill_run(
     # started, so the numbers below prove the shed path is pure host
     # bookkeeping: zero dispatches, no dispatcher thread, params never
     # transferred — and each decision lands in microseconds.
-    probe = ServingEngine(params, max_bucket=max_bucket, max_queued=0)
+    probe = ServingEngine(params, max_bucket=max_bucket, max_queued=0,
+                          tracer=tracer)
     probe_pose = one_pose()
     shed_us: List[float] = []
     for _ in range(max(1, shed_probe_submits)):
@@ -489,7 +535,8 @@ def overload_drill_run(
     )
     eng = ServingEngine(
         params, max_bucket=max_bucket, max_delay_s=0.001, policy=policy,
-        max_queued=max_queued, tier_quotas={1: tier1_quota})
+        max_queued=max_queued, tier_quotas={1: tier1_quota},
+        tracer=tracer)
 
     outcomes = {"ok": 0, "shed": 0, "expired": 0, "error": 0,
                 "unresolved": 0}
@@ -653,6 +700,8 @@ def overload_drill_run(
         "coalesce_width_mean": snap["coalesce_width_mean"],
         "tiers": snap["tiers"],
         "load_mid_drill": load_mid,
+        "flight_record": flight_record(
+            tracer, eng.counters, reason="overload_drill_complete"),
     }
 
 
@@ -668,6 +717,7 @@ def cold_start_drill_run(
     p99_waves: int = 6,
     hang_deadline_s: float = 2.0,
     seed: int = 0,
+    tracer=None,
     log: Callable[[str], None] = None,
 ) -> dict:
     """THE cold-start/restart drill protocol — shared by ``bench.py``
@@ -727,6 +777,14 @@ def cold_start_drill_run(
         raise ValueError(f"subjects must be >= 1, got {subjects}")
     if requests < 1:
         raise ValueError(f"requests must be >= 1, got {requests}")
+    log = _logger(log)
+    # One tracer spans EVERY engine of the drill (PR 8): the doomed
+    # process, the cold boot, the damage-injection legs, and the
+    # hang-composed boot — so the flight record proves every submit
+    # across every restart phase closed exactly once, and the lattice
+    # loads / deadline kills land on one timeline.
+    if tracer is None:
+        tracer = Tracer()
     max_rows = min(max_rows, max_bucket)
     n_joints, n_shape = params.n_joints, params.n_shape
     rng = np.random.default_rng(seed)
@@ -782,7 +840,7 @@ def cold_start_drill_run(
         return ok, err, un, time.perf_counter() - t0
 
     engine_kw = dict(max_bucket=max_bucket, max_delay_s=0.001,
-                     max_subjects=max_subjects)
+                     max_subjects=max_subjects, tracer=tracer)
 
     # ---- Phase A: the doomed process ----------------------------------
     eng_a = ServingEngine(params, aot_dir=aot_dir, **engine_kw)
@@ -1037,6 +1095,10 @@ def cold_start_drill_run(
         "hang_leg": hang_leg,
         "phase_a": {"submitted": requests, "resolved_ok": ok_a,
                     "resolved_error": err_a, "unresolved": un_a},
+        # Counters are eng_b's (the cold boot the criteria judge); the
+        # span accounting inside covers every engine of the drill.
+        "flight_record": flight_record(
+            tracer, eng_b.counters, reason="coldstart_drill_complete"),
     }
 
 
@@ -1049,6 +1111,7 @@ def recovery_drill_run(
     deadline_s: float = 2.0,
     latency_spike_s: float = 0.05,
     seed: int = 0,
+    tracer=None,
     log: Callable[[str], None] = None,
 ) -> dict:
     """THE fault-recovery drill protocol — shared by ``bench.py``
@@ -1095,6 +1158,13 @@ def recovery_drill_run(
     from mano_hand_tpu.runtime.supervise import DispatchPolicy
     from mano_hand_tpu.serving.engine import ServingEngine, ServingError
 
+    log = _logger(log)
+    # The drill's tracer (PR 8): every fault class's spans — including
+    # the deadline-killed and failed-over ones — plus the breaker
+    # transitions and chaos faults as runtime events, attached to the
+    # artifact as a flight record.
+    if tracer is None:
+        tracer = Tracer()
     n_joints, n_shape = params.n_joints, params.n_shape
     rng = np.random.default_rng(seed)
     # Three subjects for the mixed-subject half of every stream; their
@@ -1133,7 +1203,7 @@ def recovery_drill_run(
         cpu_fallback=True,
     )
     eng = ServingEngine(params.astype(np.float32), max_bucket=max_bucket,
-                        max_delay_s=0.001, policy=policy)
+                        max_delay_s=0.001, policy=policy, tracer=tracer)
     resolve_timeout = deadline_s * (policy.retries + 2) + 30.0
 
     # Bit-identity reference: the SAME program family as the fallback
@@ -1303,4 +1373,147 @@ def recovery_drill_run(
         "breaker_opens": breaker.opens,
         "breaker_probes": breaker.probes,
         "breaker_state_final": breaker.state,
+        "flight_record": flight_record(
+            tracer, eng.counters, reason="recovery_drill_complete"),
     }
+
+
+def tracing_overhead_run(
+    params,
+    *,
+    requests: int = 160,
+    min_rows: int = 1,
+    max_rows: int = 16,
+    max_bucket: int = 32,
+    max_delay_s: float = 0.002,
+    seed: int = 0,
+    trials: int = 9,
+    trace_dir=None,
+    log: Callable[[str], None] = None,
+) -> dict:
+    """THE tracing-overhead protocol — bench.py config12 (PR 8).
+
+    Observability that slows the thing it observes gets turned off in
+    the exact incident it exists for, so the tracer's cost is a judged
+    number, not a belief. Two engines serve the SAME ragged request
+    stream — one with a live ``obs.Tracer`` spanning every request,
+    one untraced — interleaved per trial with alternating order (this
+    box's load moves 5x between seconds; a sequential pair hands one
+    side the spike and the ratio lies).
+
+    The headline estimator differs from the throughput legs on
+    purpose: ``tracing_overhead_ratio`` is the MEDIAN of the per-trial
+    paired ratios, not a min-over-min. Each trial's quotient cancels
+    the load drift common to its interleaved pair, and the median
+    rejects spike trials; min-over-min compares each side's fastest
+    WINDOW, and when those land in different load windows the quotient
+    carries window noise larger than the 3% bound being judged
+    (observed live while building this leg: per-trial ratios
+    0.97-1.02, min-over-min 1.05). The min-time rates still ride along
+    as the throughput record.
+
+    Returned criteria numbers (scripts/bench_report.py judges):
+
+    * ``tracing_overhead_ratio`` <= 1.03 — tracing costs at most 3%
+      end-to-end (median paired ratio, above; judged at >= 64 requests
+      — a plumbing-size run's per-pass time is noise-dominated, so
+      bench_report records its ratio without judging it, the coalesce
+      >= 8-subjects precedent);
+    * ``steady_recompiles`` == 0 on the TRACED engine — the tracer
+      must never change program identity (events are host tuples; no
+      shape, no constant, no jit boundary moves);
+    * ``span_accounting``: every submitted request's span closed
+      exactly once (started == closed, open == 0) — the config12 half
+      of the criterion the drills' flight records carry for the fault
+      paths.
+
+    ``trace_dir`` additionally exports the traced engine's Chrome-trace
+    timeline + final flight record there (obs.write_trace_dir), giving
+    `scripts/trace_report.py` a host-spans capture even when the
+    tunnel is down (the interpret lane's acceptance path).
+    """
+    from mano_hand_tpu.serving.engine import ServingEngine
+
+    if requests < 1:
+        raise ValueError(f"requests must be >= 1, got {requests}")
+    log = _logger(log)
+    max_rows = min(max_rows, max_bucket)
+    min_rows = max(1, min(min_rows, max_rows))
+    n_joints, n_shape = params.n_joints, params.n_shape
+    rng = np.random.default_rng(seed)
+    sizes = rng.integers(min_rows, max_rows + 1, size=requests)
+    stream = [
+        (rng.normal(scale=0.4, size=(n, n_joints, 3)).astype(np.float32),
+         rng.normal(size=(n, n_shape)).astype(np.float32))
+        for n in (int(s) for s in sizes)
+    ]
+    rows_total = int(sizes.sum())
+
+    tracer = Tracer()
+    eng_off = ServingEngine(params, max_bucket=max_bucket,
+                            max_delay_s=max_delay_s)
+    eng_on = ServingEngine(params, max_bucket=max_bucket,
+                           max_delay_s=max_delay_s, tracer=tracer)
+
+    def run(eng):
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, s) for p, s in stream]
+        for f in futs:
+            f.result()
+        return time.perf_counter() - t0
+
+    ratios: List[float] = []
+    dt_on_best = dt_off_best = float("inf")
+    with eng_off, eng_on:
+        eng_off.warmup()
+        eng_on.warmup()
+        run(eng_off)                 # settle both pipelines
+        run(eng_on)
+        compiles_warm = eng_on.counters.compiles
+        for t in range(max(1, trials)):
+            # Alternate which engine goes first: a monotone load drift
+            # otherwise lands on the same side every trial and biases
+            # the ratio one way (the measure_overhead defense).
+            if t % 2 == 0:
+                dt_on, dt_off = run(eng_on), run(eng_off)
+            else:
+                dt_off, dt_on = run(eng_off), run(eng_on)
+            ratios.append(dt_on / dt_off)
+            dt_on_best = min(dt_on_best, dt_on)
+            dt_off_best = min(dt_off_best, dt_off)
+        steady_recompiles = eng_on.counters.compiles - compiles_warm
+    # Both engines are STOPPED here: the span accounting below is the
+    # final word — anything still open is a leak, not in-flight work.
+    accounting = tracer.accounting()
+    stages = tracer.stage_breakdown()
+    ratio = float(np.median(ratios))
+    log(f"tracing: traced {rows_total / dt_on_best:,.0f} vs untraced "
+        f"{rows_total / dt_off_best:,.0f} evals/s (median paired ratio "
+        f"{ratio:.3f}, best-window {dt_on_best / dt_off_best:.3f}), "
+        f"{steady_recompiles} steady recompiles, spans "
+        f"{accounting['spans_closed']}/{accounting['spans_started']} "
+        f"closed")
+    out = {
+        "requests": int(requests),
+        "trials": int(max(1, trials)),
+        "rows": [int(sizes.min()), int(sizes.max())],
+        "buckets": list(eng_on.buckets),
+        "traced_evals_per_sec": float(f"{rows_total / dt_on_best:.5g}"),
+        "untraced_evals_per_sec": float(
+            f"{rows_total / dt_off_best:.5g}"),
+        "tracing_overhead_ratio": float(f"{ratio:.4g}"),
+        "ratio_best_window": float(f"{dt_on_best / dt_off_best:.4g}"),
+        "ratio_trials": [float(f"{r:.3g}") for r in ratios],
+        "steady_recompiles": int(steady_recompiles),
+        "span_accounting": accounting,
+        "stage_breakdown": stages,
+        "flight_record": flight_record(
+            tracer, eng_on.counters, reason="tracing_overhead_complete"),
+    }
+    if trace_dir is not None:
+        from mano_hand_tpu.obs import write_trace_dir
+
+        out["trace_export"] = write_trace_dir(
+            tracer, trace_dir, counters=eng_on.counters,
+            reason="tracing_overhead_complete")
+    return out

@@ -74,13 +74,37 @@ def time_jax_fn(
 
 
 @contextlib.contextmanager
-def xla_trace(log_dir: str):
-    """Capture an XLA profiler trace viewable in xprof/tensorboard."""
+def xla_trace(log_dir: str, tracer=None):
+    """Capture an XLA profiler trace viewable in xprof/tensorboard.
+
+    ``tracer`` (an ``obs.Tracer``, PR 8) additionally drops the engine
+    host-span timeline as ``<log_dir>/engine.trace.json`` when the
+    capture closes, so ``scripts/trace_report.py <log_dir>`` reads the
+    host and device halves of the SAME window as one merged report —
+    the unified-timeline entry point the roofline work drives.
+    """
     jax.profiler.start_trace(log_dir)
     try:
         yield
     finally:
         jax.profiler.stop_trace()
+        if tracer is not None:
+            # Best-effort inside a finally: a raise here would MASK an
+            # in-body exception, and a failed co-export must not cost
+            # the XLA capture that already landed.
+            try:
+                import json
+                from pathlib import Path
+
+                path = Path(log_dir) / "engine.trace.json"
+                path.write_text(json.dumps(tracer.chrome_trace()))
+            except Exception as e:  # noqa: BLE001 — degrade, not crash
+                import warnings
+
+                warnings.warn(
+                    f"engine-trace co-export into {log_dir} failed "
+                    f"({type(e).__name__}: {e}); the XLA capture is "
+                    "unaffected")
 
 
 # Per-bucket latency samples are bounded so a long-lived server cannot

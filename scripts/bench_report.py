@@ -187,6 +187,7 @@ def main() -> int:
               f"failover(s) on the persistent class, "
               f"{rec.get('warmup_compiles')} warm-up compiles "
               "(primary + fallback tiers)")
+        judge_flight_record("recovery", rec)
 
     def judge_coalesce(cz):
         """Done-criteria of the cross-subject coalescing leg (config9 /
@@ -279,6 +280,7 @@ def main() -> int:
               f"coalesce width mean {ov.get('coalesce_width_mean')})")
         print(f"  [info] overload: load snapshot mid-drill "
               f"{ov.get('load_mid_drill')}")
+        judge_flight_record("overload", ov)
 
     def judge_coldstart(cs):
         """Done-criteria of the cold-start/restart drill (config11 /
@@ -344,6 +346,81 @@ def main() -> int:
               f"{cs.get('wave_p99_ms')} ms; {cs.get('lattice_entries')} "
               f"lattice entries from {cs.get('baked_compiles')} baked "
               "compiles)")
+        judge_flight_record("coldstart", cs)
+
+    def judge_flight_record(prefix, art, submitted=None):
+        """The PR-8 span criterion shared by every drill artifact: the
+        attached flight record's accounting must show every submitted
+        request's span closed EXACTLY once (started == closed, none
+        open). Artifacts predating the flight recorder are judged on
+        what they have (the posed-failover precedent)."""
+        fr = art.get("flight_record")
+        if not fr:
+            return
+        acc = fr.get("accounting") or {}
+        started = acc.get("spans_started")
+        closed = acc.get("spans_closed")
+        check(f"{prefix}_spans_closed_once",
+              started is not None and started == closed
+              and acc.get("spans_open") == 0,
+              f"{closed}/{started} spans closed, "
+              f"{acc.get('spans_open')} open (by kind "
+              f"{acc.get('closed_by_kind')}; "
+              f"{acc.get('incidents')} incidents, "
+              f"{acc.get('events_dropped')} ring-dropped events; "
+              f"flight record reason={fr.get('reason')!r} "
+              f"schema={fr.get('schema')})")
+
+    def judge_tracing(trc):
+        """Done-criteria of the tracing-overhead leg (config12, PR 8):
+        tracing costs <= 3% end-to-end (median paired interleaved
+        ratio), compiles nothing (events never change program
+        identity), and every submitted span closed exactly once."""
+        ratio = trc.get("tracing_overhead_ratio")
+        reqs = trc.get("requests")
+        msg = (f"traced {trc.get('traced_evals_per_sec')} vs untraced "
+               f"{trc.get('untraced_evals_per_sec')} evals/s (median "
+               f"paired ratio {ratio}, best-window "
+               f"{trc.get('ratio_best_window')}, trials "
+               f"{trc.get('ratio_trials')})")
+        if reqs is not None and reqs >= 64:
+            check("tracing_overhead_3pct",
+                  ratio is not None and ratio <= 1.03, msg)
+        else:
+            # The 3% bound is defined at the leg's real sizes; a
+            # plumbing-size run's per-pass time is noise-dominated and
+            # records the numbers without judging them (the coalesce
+            # >= 8-subjects / spec-LM b >= 64 precedent).
+            print(f"  [info] tracing (requests<64, overhead unjudged): "
+                  f"{msg}")
+        check("tracing_zero_recompiles",
+              trc.get("steady_recompiles") == 0,
+              f"{trc.get('steady_recompiles')} steady recompiles with "
+              "tracing on (the tracer must never change program "
+              "identity)")
+        acc = trc.get("span_accounting") or {}
+        check("tracing_spans_closed_once",
+              acc.get("spans_started") is not None
+              and acc.get("spans_started") == acc.get("spans_closed")
+              and acc.get("spans_open") == 0,
+              f"{acc.get('spans_closed')}/{acc.get('spans_started')} "
+              f"spans closed, {acc.get('spans_open')} open (by kind "
+              f"{acc.get('closed_by_kind')})")
+        cells = (trc.get("stage_breakdown") or {}).get(
+            "by_bucket_tier") or {}
+
+        def p50(cell, stage):
+            # Judge artifacts on what they have: a trimmed/older cell
+            # prints "?" instead of crashing the verdict.
+            x = cell.get(f"{stage}_p50_ms")
+            return "?" if x is None else f"{x:.2f}"
+
+        brief = {k: (f"q{p50(v, 'queue')}/d{p50(v, 'device')}/"
+                     f"r{p50(v, 'readback')} ms p50")
+                 for k, v in cells.items()}
+        print(f"  [info] tracing: stage breakdown over "
+              f"{(trc.get('stage_breakdown') or {}).get('complete_spans')}"
+              f" complete spans — {brief}")
 
     def judge_specialization(spec):
         """Done-criteria of the shape-specialization leg (config8):
@@ -423,6 +500,16 @@ def main() -> int:
                             else f"failing: {', '.join(bad)}"))
         return 0 if not bad else 1
 
+    if "tracing_overhead_ratio" in line and "metric" not in line:
+        # A raw tracing_overhead_run artifact (no bench.py envelope):
+        # only the config12 criteria apply — same pattern as the raw
+        # drill artifacts above.
+        judge_tracing(line)
+        bad = [n for n, ok in checks if not ok]
+        print("RESULT: " + ("TRACING CRITERIA PASS" if not bad
+                            else f"failing: {', '.join(bad)}"))
+        return 0 if not bad else 1
+
     if "engine_vs_split_ratio" in line and "metric" not in line:
         # A raw `serve-bench --subjects` artifact (coalesce_bench_run's
         # own JSON line, no bench.py envelope): only the coalescing
@@ -465,6 +552,13 @@ def main() -> int:
             check("coldstart_leg_ran", False,
                   f"config11_coldstart crashed: "
                   f"{line['config_errors']['config11_coldstart']}")
+        trc = detail.get("tracing")
+        if trc:
+            judge_tracing(trc)
+        elif "config12_tracing" in (line.get("config_errors") or {}):
+            check("tracing_leg_ran", False,
+                  f"config12_tracing crashed: "
+                  f"{line['config_errors']['config12_tracing']}")
         bad = [n for n, ok in checks if not ok]
         print("RESULT: " + ("SERVING CRITERIA PASS" if not bad
                             else f"failing: {', '.join(bad)}"))
@@ -548,6 +642,16 @@ def main() -> int:
         check("coldstart_leg_ran", False,
               f"config11_coldstart crashed: "
               f"{line['config_errors']['config11_coldstart']}")
+
+    trc = detail.get("tracing")
+    if trc:
+        # Tracing-overhead leg (config12, PR 8) — same presence rule:
+        # judge it wherever it ran (every criterion is CPU-defined).
+        judge_tracing(trc)
+    elif "config12_tracing" in (line.get("config_errors") or {}):
+        check("tracing_leg_ran", False,
+              f"config12_tracing crashed: "
+              f"{line['config_errors']['config12_tracing']}")
 
     spec = detail.get("specialization")
     cfg_errs = line.get("config_errors") or {}

@@ -1,17 +1,28 @@
 #!/usr/bin/env python
-"""Summarize an XLA profiler trace: top ops by device-track time.
+"""Summarize an XLA profiler trace and/or an engine span export into
+one merged host+device timeline report.
 
-The builder pipeline captures a trace of the winning kernel on every full
-TPU bench (``bench.py --profile DIR`` -> ``DIR/plugins/profile/<run>/
-*.trace.json.gz``). This tool turns that capture into the numbers the
-roadmap's headroom work needs (kernel math bound ~68 M evals/s vs
-measured 13-20 M): which ops actually burn the time.
+The builder pipeline captures a trace of the winning kernel on every
+full TPU bench (``bench.py --profile DIR`` ->
+``DIR/plugins/profile/<run>/*.trace.json.gz``), and the serving
+engine's tracer exports its host-span timeline next to it
+(``DIR/engine.trace.json`` — written by bench config12, `mano
+serve-bench --trace DIR`, or ``obs.write_trace_dir``; marked by a
+``manoEngineTrace`` block). This tool turns either — or BOTH, merged —
+into the numbers the roadmap's headroom work needs (kernel math bound
+~68 M evals/s vs measured 13-20 M): which ops burn the device time,
+and where each REQUEST's wall time went (queue wait vs dispatch vs
+device vs readback, per bucket/tier). When the tunnel is down the
+engine export alone still yields the host-side stage breakdown (the
+interpret lane's acceptance path).
 
 Stdlib only (gzip + json over the Chrome-trace export — the .xplane.pb
-twin needs TensorFlow tooling this image doesn't carry).
+twin needs TensorFlow tooling this image doesn't carry; the engine
+export is plain Chrome-trace JSON plus the manoEngineTrace sidecar).
 
     python scripts/trace_report.py bench_results/r05_tpu.trace [--top 15]
     python scripts/trace_report.py DIR --json   # machine-readable
+    mano trace-report DIR                       # the CLI spelling
 
 Ranks complete ('X') events by summed wall duration per (track, op name).
 On TPU captures the device tracks (process names like '/device:TPU:0')
@@ -35,21 +46,33 @@ import sys
 def find_traces(path: str) -> list[str]:
     if os.path.isfile(path):
         return [path]
-    hits = sorted(glob.glob(
-        os.path.join(path, "**", "*.trace.json.gz"), recursive=True))
+    # Both capture families: XLA's gzipped Chrome traces and the
+    # engine's plain-JSON span exports (engine.trace.json).
+    hits = sorted(
+        glob.glob(os.path.join(path, "**", "*.trace.json.gz"),
+                  recursive=True)
+        + glob.glob(os.path.join(path, "**", "*.trace.json"),
+                    recursive=True))
     return hits
 
 
-def load_events(trace_path: str) -> list[dict]:
-    """Events from one capture; a truncated/corrupt file (tunnel drop
-    mid-write) degrades to a warning, not a traceback."""
+def load_capture(trace_path: str) -> dict:
+    """One capture file as a dict ({} on damage); a truncated/corrupt
+    file (tunnel drop mid-write) degrades to a warning, not a
+    traceback. Gzip or plain JSON by suffix."""
     try:
-        with gzip.open(trace_path, "rt") as f:
-            return json.load(f).get("traceEvents", [])
+        opener = (gzip.open if trace_path.endswith(".gz") else open)
+        with opener(trace_path, "rt") as f:
+            data = json.load(f)
+        return data if isinstance(data, dict) else {}
     except Exception as e:  # gzip EOFError, JSONDecodeError, OSError
         print(f"skipping unreadable trace {trace_path}: "
               f"{type(e).__name__}: {e}", file=sys.stderr)
-        return []
+        return {}
+
+
+def load_events(trace_path: str) -> list[dict]:
+    return load_capture(trace_path).get("traceEvents", [])
 
 
 def summarize(events: list[dict]) -> dict:
@@ -91,20 +114,48 @@ def is_device_track(track: str) -> bool:
     return "tpu" in t or "/device" in t or "xla op" in t
 
 
-def main() -> int:
+def show_stage_breakdown(run: str, engine: dict) -> None:
+    """The engine export's per-(bucket, tier) stage table: where one
+    request's wall time went — queue wait vs dispatch vs device vs
+    readback (obs/trace.py stage semantics; 'device' on the
+    unsupervised path includes pipeline wait)."""
+    acc = engine.get("accounting") or {}
+    stages = engine.get("stages") or {}
+    cells = stages.get("by_bucket_tier") or {}
+    print(f"\n== engine stage breakdown [{run}]  "
+          f"({stages.get('complete_spans')} complete spans; "
+          f"{acc.get('spans_closed')}/{acc.get('spans_started')} spans "
+          f"closed, {acc.get('spans_open')} open, "
+          f"{acc.get('incidents')} incidents)")
+    if not cells:
+        print("  (no complete spans in the ring)")
+        return
+    hdr = (f"  {'cell':<14} {'n':>5}  {'queue':>16} {'dispatch':>16} "
+           f"{'device':>16} {'readback':>16}")
+    print(hdr + "   (p50/p99 ms)")
+    for key, c in cells.items():
+        def pair(stage, c=c):
+            return (f"{c.get(f'{stage}_p50_ms', 0.0):7.2f}/"
+                    f"{c.get(f'{stage}_p99_ms', 0.0):8.2f}")
+        print(f"  {key:<14} {c.get('n', 0):>5}  {pair('queue')} "
+              f"{pair('dispatch')} {pair('device')} {pair('readback')}")
+
+
+def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("path", help="trace dir (bench --profile DIR) or one "
-                                 "*.trace.json.gz")
+    ap.add_argument("path", help="trace dir (bench --profile DIR / "
+                                 "serve-bench --trace DIR) or one "
+                                 "*.trace.json[.gz]")
     ap.add_argument("--top", type=int, default=15)
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--all-tracks", action="store_true",
                     help="include host tracks in the table (device tracks "
                          "are always shown first)")
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
 
     traces = find_traces(args.path)
     if not traces:
-        print(f"no *.trace.json.gz under {args.path}", file=sys.stderr)
+        print(f"no *.trace.json[.gz] under {args.path}", file=sys.stderr)
         return 1
 
     # Summarize PER capture file: pid namespaces are file-local (every
@@ -112,13 +163,22 @@ def main() -> int:
     # would merge runs and double-count same-named ops. With more than
     # one capture, tracks are qualified by their run directory.
     summary: dict = {}
+    engines: dict = {}   # run -> manoEngineTrace block (span exports)
     for t in traces:
-        per = summarize(load_events(t))
+        cap = load_capture(t)
+        per = summarize(cap.get("traceEvents", []))
         run = os.path.basename(os.path.dirname(t))
         for track, rows in per.items():
             key = f"{run}:{track}" if len(traces) > 1 else track
             summary[key] = rows
-    if not summary:
+        eng = cap.get("manoEngineTrace")
+        if isinstance(eng, dict) and eng.get("schema") == 1:
+            engines[run if len(traces) > 1 else "engine"] = eng
+        elif isinstance(eng, dict):
+            print(f"{t}: engine trace schema {eng.get('schema')} is not "
+                  "supported by this report (expected 1); its raw "
+                  "traceEvents are still summarized", file=sys.stderr)
+    if not summary and not engines:
         print("trace holds no complete events", file=sys.stderr)
         return 1
 
@@ -136,6 +196,8 @@ def main() -> int:
                 for track, rows in {**device, **host}.items()
             },
         }
+        if engines:
+            out["engine"] = engines
         print(json.dumps(out))
         return 0
 
@@ -158,6 +220,11 @@ def main() -> int:
     if args.all_tracks or not device:
         for track, rows in host.items():
             show(track, rows)
+    # The merged-timeline half: engine span exports print their stage
+    # breakdown AFTER the op tables, so device hot ops and per-request
+    # queue/dispatch/device/readback waits read as one report.
+    for run, eng in engines.items():
+        show_stage_breakdown(run, eng)
     return 0
 
 

@@ -231,6 +231,16 @@ def test_bench_cpu_tiny_run_end_to_end():
         # per run). The LM half is covered by `make bench-interpret`;
         # the criteria-sized leg runs in the bench-cpu lane.
         "--spec-batch", "16", "--spec-fit-batch", "0",
+        # Drill legs at the bench-interpret plumbing sizes (PR 8): the
+        # tier-1 lane sat 8 s under its 870 s budget at PR-8 HEAD, so
+        # the config12 tracing leg rides along HERE at plumbing size
+        # while the overload/cold-start drills drop to the sizes the
+        # bench-interpret lane already uses — their criteria-sized runs
+        # live in `make serve-smoke`, this test checks plumbing only.
+        "--recovery-requests", "6", "--overload-bursts", "16",
+        "--coldstart-requests", "8", "--coldstart-subjects", "3",
+        "--coldstart-max-bucket", "4", "--coldstart-waves", "2",
+        "--tracing-requests", "24",
     )
     assert rc == 0, line
     assert line["value"] is not None and line["value"] > 0

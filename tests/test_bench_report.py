@@ -454,3 +454,115 @@ def test_coldstart_metrics_block(tmp_path):
     p = _run(str(only))
     assert p.returncode == 1
     assert "[FAIL] coldstart_leg_ran" in p.stdout
+
+
+def test_tracing_metrics_block(tmp_path):
+    """The tracing-overhead leg (config12, PR 8): overhead <= 3%
+    (median paired ratio), zero steady recompiles with tracing on,
+    every span closed exactly once — judged inside a serving-only
+    artifact AND as a raw tracing_overhead_run line; drill artifacts'
+    attached flight records get the span criterion too."""
+    trc = {
+        "requests": 160, "trials": 9, "rows": [1, 32],
+        "buckets": [1, 2, 4, 8, 16, 32, 64],
+        "traced_evals_per_sec": 21887.0,
+        "untraced_evals_per_sec": 22772.0,
+        "tracing_overhead_ratio": 1.017, "ratio_best_window": 1.04,
+        "ratio_trials": [1.29, 1.07, 1.01, 1.02, 0.953, 0.914, 0.967,
+                         1.06, 1.08],
+        "steady_recompiles": 0,
+        "span_accounting": {"spans_started": 1600, "spans_closed": 1600,
+                            "spans_open": 0, "spans_double_closed": 0,
+                            "closed_by_kind": {"ok": 1600},
+                            "events_total": 9587,
+                            "events_dropped": 1395, "ring_len": 8192,
+                            "ring_capacity": 8192, "incidents": 0},
+        "stage_breakdown": {"complete_spans": 1280, "by_bucket_tier": {
+            "b64/tier0": {"n": 1272, "queue_p50_ms": 61.2,
+                          "queue_p99_ms": 125.3, "queue_mean_ms": 64.0,
+                          "dispatch_p50_ms": 0.43,
+                          "dispatch_p99_ms": 0.85,
+                          "dispatch_mean_ms": 0.5,
+                          "device_p50_ms": 7.03, "device_p99_ms": 11.5,
+                          "device_mean_ms": 7.2,
+                          "readback_p50_ms": 0.01,
+                          "readback_p99_ms": 0.12,
+                          "readback_mean_ms": 0.03,
+                          "total_p50_ms": 68.7, "total_p99_ms": 130.0,
+                          "total_mean_ms": 71.7}}},
+        "flight_record": {"schema": 1, "reason": "tracing_complete"},
+    }
+    # Raw tracing_overhead_run artifact: judged on its own.
+    raw = tmp_path / "tracing_raw.json"
+    raw.write_text(json.dumps(trc))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] tracing_overhead_3pct" in p.stdout
+    assert "[PASS] tracing_zero_recompiles" in p.stdout
+    assert "[PASS] tracing_spans_closed_once" in p.stdout
+    assert "TRACING CRITERIA PASS" in p.stdout
+
+    # Overhead > 3%, a recompile, or a leaked span FAILS.
+    raw.write_text(json.dumps(dict(
+        trc, tracing_overhead_ratio=1.06, steady_recompiles=1,
+        span_accounting=dict(trc["span_accounting"], spans_closed=1599,
+                             spans_open=1))))
+    p = _run(str(raw))
+    assert p.returncode == 1
+    assert "[FAIL] tracing_overhead_3pct" in p.stdout
+    assert "[FAIL] tracing_zero_recompiles" in p.stdout
+    assert "[FAIL] tracing_spans_closed_once" in p.stdout
+
+    # Below the 64-request floor the overhead bound is recorded, not
+    # judged (noise-dominated plumbing runs — the coalesce >= 8-subjects
+    # precedent); recompiles and span accounting still judge.
+    raw.write_text(json.dumps(dict(trc, requests=24,
+                                   tracing_overhead_ratio=1.2)))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    assert "overhead unjudged" in p.stdout
+    assert "tracing_overhead_3pct" not in p.stdout
+    assert "[PASS] tracing_spans_closed_once" in p.stdout
+
+    # Inside a serving-only envelope, and a drill's attached flight
+    # record gets the span criterion (judge_flight_record).
+    rec_fr = {"schema": 1, "reason": "recovery_drill_complete",
+              "accounting": {"spans_started": 50, "spans_closed": 50,
+                             "spans_open": 0, "spans_double_closed": 0,
+                             "closed_by_kind": {"ok": 50},
+                             "events_dropped": 0, "incidents": 9}}
+    env = {"metric": "serving_engine_evals_per_sec", "value": 1.0,
+           "unit": "evals/s", "device": "cpu",
+           "detail": {"serving": {"engine_vs_direct_ratio": 1.0,
+                                  "steady_recompiles": 0},
+                      "recovery": {
+                          "futures_resolved_fraction": 1.0,
+                          "failover_vs_cpu_direct_max_abs_err": 0.0,
+                          "failover_overhead_ratio": 1.2,
+                          "post_recovery_steady_recompiles": 0,
+                          "flight_record": rec_fr},
+                      "tracing": trc}}
+    art = tmp_path / "serving_only.json"
+    art.write_text(json.dumps(env))
+    p = _run(str(art))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] tracing_overhead_3pct" in p.stdout
+    assert "[PASS] recovery_spans_closed_once" in p.stdout
+
+    # A leaked span in the drill's flight record FAILS the drill judge.
+    env["detail"]["recovery"]["flight_record"]["accounting"][
+        "spans_open"] = 2
+    art.write_text(json.dumps(env))
+    p = _run(str(art))
+    assert p.returncode == 1
+    assert "[FAIL] recovery_spans_closed_once" in p.stdout
+
+    # A crashed config12 leg must fail loudly, not vanish.
+    env["detail"]["recovery"]["flight_record"]["accounting"][
+        "spans_open"] = 0
+    del env["detail"]["tracing"]
+    env["config_errors"] = {"config12_tracing": "RuntimeError: boom"}
+    art.write_text(json.dumps(env))
+    p = _run(str(art))
+    assert p.returncode == 1
+    assert "[FAIL] tracing_leg_ran" in p.stdout

@@ -1,0 +1,413 @@
+"""Observability subsystem (obs/, PR 8): span lifecycle across every
+terminal kind — composed with chaos plans and failover — ring bounds,
+incident flight recording, backpressure quantiles, and the Chrome-trace
+export contract.
+
+The invariant under test mirrors the engine's future-resolution
+guarantee: every span opened by ``submit`` closes EXACTLY once, at the
+same site that resolves the future, whatever path the request takes —
+including a wedged dispatcher swept by ``stop(timeout_s=)``.
+
+Lane placement: quick-marked (the seconds-scale `make check-quick`
+pre-commit lane) AND slow-marked — the timeout-bound tier-1
+``-m 'not slow'`` lane sat 8 s under its 870 s budget at PR-8 HEAD,
+so this module rides outside it; `make obs-smoke` (wired into
+`make check`, own compile-cache dir) is the canonical runner, exactly
+the test_coldstart precedent.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mano_hand_tpu.obs import (
+    FlightRecorder,
+    Tracer,
+    flight_record,
+    get_logger,
+    write_trace_dir,
+)
+from mano_hand_tpu.runtime.chaos import ChaosPlan
+from mano_hand_tpu.runtime.health import CircuitBreaker
+from mano_hand_tpu.runtime.supervise import DispatchPolicy
+from mano_hand_tpu.serving.engine import ServingEngine, ServingError
+
+pytestmark = [pytest.mark.quick, pytest.mark.slow]
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+def _pose(n=1, seed=0):
+    return np.random.default_rng(seed).normal(
+        scale=0.4, size=(n, 16, 3)).astype(np.float32)
+
+
+def _balanced(tracer):
+    acc = tracer.accounting()
+    assert acc["spans_started"] == acc["spans_closed"], acc
+    assert acc["spans_open"] == 0, acc
+    return acc
+
+
+# ------------------------------------------------------------ pure tracer
+def test_ring_bound_holds_and_drops_are_counted():
+    tr = Tracer(capacity=16)
+    for i in range(100):
+        tr.runtime_event("tick", i=i)
+    acc = tr.accounting()
+    assert acc["ring_len"] == 16
+    assert acc["events_total"] == 100
+    assert acc["events_dropped"] == 84
+
+
+def test_span_closes_exactly_once():
+    tr = Tracer()
+    s = tr.start("full", tier=1, rows=3)
+    assert tr.close(s, "ok")
+    assert not tr.close(s, "ok")        # second close: counted, no-op
+    acc = tr.accounting()
+    assert acc["spans_started"] == acc["spans_closed"] == 1
+    assert acc["spans_double_closed"] == 1
+    assert acc["closed_by_kind"] == {"ok": 1}
+
+
+def test_shed_burst_fires_once_per_crossing():
+    tr = Tracer(shed_burst_threshold=3)
+    fired = []
+    tr.on_incident(lambda reason, fields: fired.append(reason))
+    for _ in range(10):                 # one crossing, however long
+        tr.note_shed()
+    assert fired == ["shed_burst"]
+    tr.note_admit()                     # streak reset -> a new burst
+    for _ in range(3):
+        tr.note_shed()
+    assert fired == ["shed_burst", "shed_burst"]
+
+
+def test_stage_breakdown_partitions_total():
+    tr = Tracer()
+    s = tr.start("full", tier=0, rows=2)
+    for name in ("coalesce", "launch", "dispatched", "readback"):
+        kw = {"bucket": 4} if name == "launch" else {}
+        tr.event(s, name, **kw)
+        time.sleep(0.002)
+    tr.close(s, "ok", bucket=4)
+    st = tr.stage_breakdown()
+    assert st["complete_spans"] == 1
+    cell = st["by_bucket_tier"]["b4/tier0"]
+    parts = sum(cell[f"{k}_mean_ms"] for k in
+                ("queue", "dispatch", "device", "readback"))
+    assert abs(parts - cell["total_mean_ms"]) < 1e-6  # exact partition
+
+
+def test_chrome_trace_export_contract(tmp_path):
+    tr = Tracer()
+    s = tr.start("posed", tier=2, rows=1)
+    for name in ("launch", "dispatched", "readback"):
+        tr.event(s, name, **({"bucket": 8} if name == "launch" else {}))
+    tr.close(s, "ok", bucket=8)
+    tr.runtime_event("compile", family="full", bucket=8)
+    ct = tr.chrome_trace()
+    assert ct["manoEngineTrace"]["schema"] == 1
+    x = [e for e in ct["traceEvents"] if e["ph"] == "X"]
+    names = {e["name"] for e in x}
+    assert "request/posed/b8" in names
+    assert {"stage/queue", "stage/dispatch", "stage/device",
+            "stage/readback"} <= names
+    # The request slice spans its stage slices on the tier thread.
+    req = next(e for e in x if e["name"].startswith("request/"))
+    assert req["tid"] == 2
+    assert any(e["ph"] == "i" and e["name"] == "compile"
+               for e in ct["traceEvents"])
+    paths = write_trace_dir(tr, tmp_path)
+    data = json.loads((tmp_path / "engine.trace.json").read_text())
+    assert data["manoEngineTrace"]["accounting"]["spans_closed"] == 1
+    assert paths["flight"].endswith("flight_final.json")
+
+
+def test_flight_record_is_bounded():
+    tr = Tracer()
+    for _ in range(100):
+        s = tr.start("full")
+        tr.close(s, "ok")
+    fr = flight_record(tr, reason="test", max_spans=8, max_events=16)
+    assert fr["schema"] == 1 and fr["reason"] == "test"
+    assert len(fr["recent_spans"]) <= 8
+    assert len(fr["recent_runtime_events"]) <= 16
+    assert fr["accounting"]["spans_started"] == 100
+    json.dumps(fr)                      # must ride inside a bench line
+
+
+def test_flight_recorder_auto_capture_and_keep(tmp_path):
+    tr = Tracer()
+    rec = FlightRecorder(tr, out_dir=tmp_path, keep=3)
+    for i in range(5):
+        tr.incident("deadline_kill", bucket=i)
+    assert len(rec.captures) == 3       # keep bound, oldest evicted
+    assert rec.captures[-1]["reason"] == "deadline_kill"
+    assert rec.captures[-1]["seq"] == 5
+    assert len(list(tmp_path.glob("flight_*.json"))) == 5
+
+
+def test_logger_channels(capsys):
+    lg = get_logger("obs-test", level="info")
+    lg.info("progress line")
+    out = capsys.readouterr()
+    assert out.out == ""                # stdout NEVER
+    assert "progress line" in out.err
+    lg2 = get_logger("obs-test-quiet", level="warning")
+    lg2.info("suppressed")
+    assert capsys.readouterr().err == ""
+    with pytest.warns(UserWarning, match="obs-test-quiet: degraded") as rec:
+        lg2.warning("degraded thing")
+    # stacklevel contract: the warning is attributed to the caller's
+    # line (THIS file) — the degradation site — not the logger shim.
+    assert rec[0].filename == __file__
+
+
+def test_load_snapshot_one_hold_consistency():
+    """The torn-telemetry rule extended to the tracer (PR 8 satellite):
+    quantiles + backlog age are copied in one lock hold while writer
+    threads hammer the span table — every read must be internally
+    consistent (p50 <= p99, n monotone, age >= 0)."""
+    tr = Tracer()
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            s = tr.start("full", tier=0)
+            tr.close(s, "ok")
+
+    threads = [threading.Thread(target=writer) for _ in range(3)]
+    for th in threads:
+        th.start()
+    try:
+        last_n = 0
+        for _ in range(200):
+            snap = tr.load_snapshot()
+            assert snap["backlog_age_s"] >= 0.0
+            t0 = snap["latency_by_tier"].get("0")
+            if t0 is None:
+                continue
+            assert t0["p50_ms"] <= t0["p99_ms"] + 1e-9
+            assert t0["n"] >= last_n or t0["n"] == 2048  # reservoir cap
+            last_n = min(t0["n"], 2047)
+    finally:
+        stop.set()
+        for th in threads:
+            th.join()
+
+
+# ----------------------------------------------- engine span lifecycle
+def test_spans_ok_shed_expired(params32):
+    tr = Tracer()
+    eng = ServingEngine(params32, max_bucket=8, max_queued=1, tracer=tr)
+    with eng:
+        assert eng.forward(_pose(2)).shape == (2, 778, 3)      # ok
+        fut = eng.submit(_pose(), deadline_s=0.0)              # expired
+        with pytest.raises(ServingError):
+            fut.result()
+    # shed: fill the (stopped) engine's quota synchronously.
+    eng2 = ServingEngine(params32, max_bucket=8, max_queued=0, tracer=tr)
+    with pytest.raises(ServingError) as ei:
+        eng2.submit(_pose())
+    assert ei.value.kind == "shed"
+    acc = _balanced(tr)
+    assert acc["closed_by_kind"] == {"ok": 1, "expired": 1, "shed": 1}
+
+
+def test_spans_error_kind_under_persistent_fault(params32):
+    plan = ChaosPlan("error@0-")
+    policy = DispatchPolicy(deadline_s=5.0, retries=0, backoff_s=0.0,
+                            backoff_cap_s=0.0, jitter=0.0, breaker=None,
+                            chaos=plan, cpu_fallback=False)
+    tr = Tracer()
+    eng = ServingEngine(params32, max_bucket=4, policy=policy, tracer=tr)
+    with eng:
+        eng.warmup([4])
+        with pytest.raises(ServingError) as ei:
+            eng.forward(_pose(2))
+    assert ei.value.kind == "error"
+    acc = _balanced(tr)
+    assert acc["closed_by_kind"].get("error", 0) >= 1
+    names = [e[2] for e in tr.snapshot()["events"]]
+    assert "chaos_fault" in names
+
+
+def test_spans_ok_through_failover_with_incident(params32):
+    """Chaos + failover composition: a persistent primary fault served
+    by the CPU fallback still closes every span (kind ok), and the
+    failover lands as an incident the flight recorder captures."""
+    plan = ChaosPlan("error@0-")
+    policy = DispatchPolicy(deadline_s=5.0, retries=0, backoff_s=0.0,
+                            backoff_cap_s=0.0, jitter=0.0, breaker=None,
+                            chaos=plan, cpu_fallback=True)
+    tr = Tracer()
+    rec = FlightRecorder(tr)
+    eng = ServingEngine(params32, max_bucket=4, policy=policy, tracer=tr)
+    with eng:
+        eng.warmup([4])
+        out = eng.forward(_pose(2))
+    assert out.shape == (2, 778, 3)
+    acc = _balanced(tr)
+    assert acc["closed_by_kind"].get("ok", 0) >= 1
+    assert acc["incidents"] >= 1
+    assert any(c["reason"] == "failover" for c in rec.captures)
+    names = [e[2] for e in tr.snapshot()["events"]]
+    assert "incident:failover" in names and "chaos_fault" in names
+
+
+def test_breaker_transitions_ride_the_timeline(params32):
+    plan = ChaosPlan("error@0-1")
+    breaker = CircuitBreaker(failure_threshold=1, probe=lambda: True,
+                             probe_interval_s=0.0,
+                             respect_priority_claim=False)
+    policy = DispatchPolicy(deadline_s=5.0, retries=1, backoff_s=0.0,
+                            backoff_cap_s=0.0, jitter=0.0,
+                            breaker=breaker, chaos=plan,
+                            cpu_fallback=True)
+    tr = Tracer()
+    eng = ServingEngine(params32, max_bucket=4, policy=policy, tracer=tr)
+    assert breaker.on_transition is not None   # engine wired the hook
+    with eng:
+        eng.warmup([4])
+        eng.forward(_pose(2))
+    _balanced(tr)
+    trans = [e[3] for e in tr.snapshot()["events"] if e[2] == "breaker"]
+    assert trans, "breaker transitions missing from the timeline"
+    assert any(t["new"] == "down" for t in trans)
+
+
+def test_stop_timeout_sweep_closes_spans_as_shutdown(params32):
+    """The wedged-dispatcher sweep: spans of requests stranded behind a
+    hung device RPC close exactly once, as kind=shutdown — no leaks
+    across ``stop(timeout_s=)``."""
+    plan = ChaosPlan("hang@0-")
+    policy = DispatchPolicy(deadline_s=30.0, retries=0, backoff_s=0.0,
+                            backoff_cap_s=0.0, jitter=0.0, breaker=None,
+                            chaos=plan, cpu_fallback=False)
+    tr = Tracer()
+    eng = ServingEngine(params32, max_bucket=4, policy=policy, tracer=tr)
+    try:
+        with eng:
+            eng.warmup([4])
+        eng.start()
+        futs = [eng.submit(_pose()) for _ in range(3)]
+        deadline = time.monotonic() + 10.0
+        while plan.calls == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)            # dispatcher entered the hang
+        eng.stop(timeout_s=0.3)
+        for f in futs:
+            with pytest.raises((ServingError, RuntimeError)):
+                f.result(timeout=10.0)
+    finally:
+        plan.release.set()
+    time.sleep(0.1)
+    acc = _balanced(tr)
+    assert acc["closed_by_kind"].get("shutdown", 0) >= 1
+
+
+def test_load_gains_quantiles_and_backlog_age(params32):
+    tr = Tracer()
+    eng = ServingEngine(params32, max_bucket=8, tracer=tr)
+    with eng:
+        for i in range(4):
+            eng.forward(_pose(2, seed=i))
+        ld = eng.load()
+    assert ld["latency_by_tier"]["0"]["n"] == 4
+    assert ld["latency_by_tier"]["0"]["p50_ms"] > 0
+    assert ld["backlog_age_s"] == 0.0   # nothing open after the waits
+    # An open span ages the backlog.
+    s = tr.start("full")
+    time.sleep(0.02)
+    assert tr.load_snapshot()["backlog_age_s"] >= 0.02
+    tr.close(s, "ok")
+
+
+def test_untraced_engine_unchanged(params32):
+    """tracer=None is the zero-cost path: no obs state anywhere near
+    the request (the default every pre-PR-8 caller keeps)."""
+    eng = ServingEngine(params32, max_bucket=4)
+    with eng:
+        out = eng.forward(_pose(2))
+    assert out.shape == (2, 778, 3)
+    assert eng._tracer is None
+
+
+def test_tracing_overhead_run_accounts_every_span(params32):
+    from mano_hand_tpu.serving.measure import tracing_overhead_run
+
+    out = tracing_overhead_run(params32, requests=12, max_rows=4,
+                               max_bucket=8, trials=3)
+    acc = out["span_accounting"]
+    assert acc["spans_started"] == acc["spans_closed"] == 12 * (3 + 1)
+    assert acc["spans_open"] == 0
+    assert out["steady_recompiles"] == 0
+    assert out["tracing_overhead_ratio"] > 0
+    assert out["flight_record"]["schema"] == 1
+    assert out["stage_breakdown"]["complete_spans"] > 0
+
+
+def test_overload_drill_attaches_flight_record(params32):
+    from mano_hand_tpu.serving.measure import overload_drill_run
+
+    out = overload_drill_run(params32, saturation=2.0, bursts=4,
+                             shed_probe_submits=8, seed=3)
+    fr = out["flight_record"]
+    acc = fr["accounting"]
+    assert acc["spans_started"] == acc["spans_closed"], acc
+    assert acc["spans_open"] == 0
+    # Probe sheds + drill submits all span-accounted.
+    assert acc["spans_started"] >= out["submitted"] + 8
+    json.dumps(out)                     # the whole artifact stays JSON
+
+
+def test_xla_trace_co_exports_engine_timeline(tmp_path):
+    """utils.profiling.xla_trace(tracer=): the engine host-span
+    timeline lands NEXT TO the XLA capture so `trace_report <dir>`
+    merges both halves of the same window; a tracer-less call keeps
+    the historical behavior."""
+    import jax
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.utils.profiling import xla_trace
+
+    tr = Tracer()
+    s = tr.start("full", tier=0, rows=1)
+    for name in ("launch", "dispatched", "readback"):
+        tr.event(s, name, **({"bucket": 2} if name == "launch" else {}))
+    with xla_trace(str(tmp_path), tracer=tr):
+        jax.block_until_ready(jax.jit(lambda x: x + 1)(jnp.zeros(4)))
+        tr.close(s, "ok", bucket=2)
+    out = tmp_path / "engine.trace.json"
+    assert out.exists()
+    data = json.loads(out.read_text())
+    assert data["manoEngineTrace"]["schema"] == 1
+    assert data["manoEngineTrace"]["accounting"]["spans_closed"] == 1
+    # The XLA capture lands beside it (same dir tree), so one
+    # trace_report invocation reads both.
+    assert list(tmp_path.rglob("*.xplane.pb")) or \
+        list(tmp_path.rglob("*.trace.json.gz"))
+
+
+def test_load_quantiles_count_served_only():
+    """Shed/expired closes are O(µs) bookkeeping — feeding them into
+    the backpressure quantiles would make load() read FASTER as the
+    engine drowns. Only kind="ok" closes count."""
+    tr = Tracer()
+    s = tr.start("full", tier=0)
+    time.sleep(0.01)
+    tr.close(s, "ok")
+    for kind in ("shed", "expired", "error", "shutdown"):
+        sid = tr.start("full", tier=0)
+        tr.close(sid, kind)
+    snap = tr.load_snapshot()
+    t0 = snap["latency_by_tier"]["0"]
+    assert t0["n"] == 1                       # the served span only
+    assert t0["p50_ms"] >= 10.0               # not the µs shed closes

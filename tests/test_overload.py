@@ -547,3 +547,38 @@ def test_overload_drill_meets_done_criteria(params32):
     assert out["outcomes"]["shed"] > 0
     assert out["tier0_goodput"] is not None
     assert out["tier0_goodput"] >= 0.95
+
+
+def test_load_with_tracer_quantiles_untorn(params32):
+    """PR 8 satellite: ``load()`` grows per-tier latency quantiles and
+    backlog age from the tracer — the torn-telemetry rule extended.
+    The tracer-derived fields are copied in ONE lock hold
+    (obs/trace.py:load_snapshot), so a load() racing live resolutions
+    must always be internally consistent (p50 <= p99, n monotone
+    within a tier, age >= 0) and always carry all three keys."""
+    from mano_hand_tpu.obs import Tracer
+
+    tr = Tracer()
+    eng = ServingEngine(params32, max_bucket=8, max_queued=16,
+                        tracer=tr)
+    with eng:
+        futs = [eng.submit(_pose(seed=i), priority=i % 2)
+                for i in range(8)]
+        seen_n = 0
+        for _ in range(50):
+            ld = eng.load()
+            assert set(("latency_by_tier", "backlog_age_s")) <= set(ld)
+            assert ld["backlog_age_s"] >= 0.0
+            t0 = ld["latency_by_tier"].get("0")
+            if t0 is not None:
+                assert t0["p50_ms"] <= t0["p99_ms"] + 1e-9
+                assert t0["n"] >= seen_n
+                seen_n = t0["n"]
+        for f in futs:
+            f.result(timeout=30)
+        ld = eng.load()
+    by_tier = ld["latency_by_tier"]
+    assert by_tier["0"]["n"] + by_tier["1"]["n"] <= 8
+    # Every span the engine opened for these submits is closed.
+    acc = tr.accounting()
+    assert acc["spans_started"] == acc["spans_closed"] == 8

@@ -637,4 +637,10 @@ def test_model_fit_supervised(params):
     assert np.allclose(model.pose, np.asarray(res.pose, np.float64))
 
 
-pytestmark = pytest.mark.quick
+# quick: the seconds-scale `make check-quick` pre-commit lane. slow
+# (PR 8): the timeout-bound tier-1 `-m 'not slow'` lane sat 8 s under
+# its 870 s budget at PR-8 HEAD and flaked over it run-to-run; this
+# module's canonical runner is `make chaos-smoke` (own pytest process +
+# compile-cache dir, wired into `make check`) — the test_coldstart
+# precedent, which is also why `make test` already --ignore's it.
+pytestmark = [pytest.mark.quick, pytest.mark.slow]

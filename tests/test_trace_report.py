@@ -79,7 +79,7 @@ def test_json_mode_is_machine_readable(tmp_path):
 def test_missing_dir_fails_cleanly(tmp_path):
     proc = _run(tmp_path / "nope")
     assert proc.returncode == 1
-    assert "no *.trace.json.gz" in proc.stderr
+    assert "no *.trace.json[.gz]" in proc.stderr
 
 
 def test_multiple_captures_keep_their_own_tracks(tmp_path):
@@ -127,3 +127,102 @@ def test_closed_pipe_exits_clean(tmp_path):
                           capture_output=True, text=True, timeout=60)
     assert proc.returncode == 0, (proc.returncode, proc.stderr)
     assert "BrokenPipeError" not in proc.stderr
+
+
+def _engine_trace(path: Path, n_spans=3) -> None:
+    """A minimal engine span export (the obs.Tracer chrome_trace
+    shape, schema 1) written as plain *.trace.json."""
+    pid = 9001
+    events = [
+        {"ph": "M", "pid": pid, "name": "process_name",
+         "args": {"name": "mano-serving-engine"}},
+        {"ph": "M", "pid": pid, "tid": 0, "name": "thread_name",
+         "args": {"name": "tier 0"}},
+    ]
+    for i in range(n_spans):
+        t0 = i * 10_000.0
+        events.append({"ph": "X", "pid": pid, "tid": 0,
+                       "name": "request/full/b8", "ts": t0, "dur": 900.0,
+                       "args": {"terminal": "ok"}})
+        for stage, off, dur in (("queue", 0, 500.0),
+                                ("dispatch", 500, 50.0),
+                                ("device", 550, 300.0),
+                                ("readback", 850, 50.0)):
+            events.append({"ph": "X", "pid": pid, "tid": 0,
+                           "name": f"stage/{stage}", "ts": t0 + off,
+                           "dur": dur})
+    block = {
+        "schema": 1,
+        "accounting": {"spans_started": n_spans, "spans_closed": n_spans,
+                       "spans_open": 0, "spans_double_closed": 0,
+                       "closed_by_kind": {"ok": n_spans},
+                       "events_total": 6 * n_spans, "events_dropped": 0,
+                       "ring_len": 6 * n_spans, "ring_capacity": 8192,
+                       "incidents": 0},
+        "stages": {"complete_spans": n_spans, "by_bucket_tier": {
+            "b8/tier0": {"n": n_spans,
+                         "queue_p50_ms": 0.5, "queue_p99_ms": 0.5,
+                         "queue_mean_ms": 0.5,
+                         "dispatch_p50_ms": 0.05, "dispatch_p99_ms": 0.05,
+                         "dispatch_mean_ms": 0.05,
+                         "device_p50_ms": 0.3, "device_p99_ms": 0.3,
+                         "device_mean_ms": 0.3,
+                         "readback_p50_ms": 0.05, "readback_p99_ms": 0.05,
+                         "readback_mean_ms": 0.05,
+                         "total_p50_ms": 0.9, "total_p99_ms": 0.9,
+                         "total_mean_ms": 0.9}}},
+    }
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps({"displayTimeUnit": "ms",
+                                "traceEvents": events,
+                                "manoEngineTrace": block}))
+
+
+def test_engine_export_host_only_stage_breakdown(tmp_path):
+    """The tunnel-down acceptance path: an engine span export ALONE
+    yields the queue/dispatch/device/readback stage table."""
+    tdir = tmp_path / "trace"
+    _engine_trace(tdir / "engine.trace.json")
+    proc = _run(tdir)
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "engine stage breakdown" in out
+    assert "b8/tier0" in out
+    assert "queue" in out and "readback" in out
+    # Host-only capture: the engine host track is shown too.
+    assert "mano-serving-engine" in out
+
+
+def test_engine_export_merges_with_xla_capture(tmp_path):
+    """One dir holding an XLA device capture AND the engine span
+    export reads as ONE report: device top-ops first, then the
+    per-request stage breakdown."""
+    tdir = _fixture(tmp_path)
+    _engine_trace(tdir / "engine.trace.json")
+    proc = _run(tdir)
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "/device:TPU:0:XLA Ops" in out       # device half
+    assert "engine stage breakdown" in out      # host half
+    assert out.index("XLA Ops") < out.index("engine stage breakdown")
+    data = json.loads(_run(tdir, "--json").stdout)
+    assert any(k.endswith("XLA Ops") for k in data["tracks"])
+    eng = data["engine"]
+    block = next(iter(eng.values()))
+    assert block["accounting"]["spans_closed"] == 3
+    assert "b8/tier0" in block["stages"]["by_bucket_tier"]
+
+
+def test_engine_export_unknown_schema_degrades(tmp_path):
+    tdir = tmp_path / "trace"
+    _engine_trace(tdir / "engine.trace.json")
+    p = tdir / "engine.trace.json"
+    data = json.loads(p.read_text())
+    data["manoEngineTrace"]["schema"] = 99
+    p.write_text(json.dumps(data))
+    proc = _run(tdir)
+    assert proc.returncode == 0, proc.stderr
+    assert "schema 99 is not supported" in proc.stderr
+    assert "engine stage breakdown" not in proc.stdout
+    # The raw events still summarize as an ordinary host track.
+    assert "mano-serving-engine" in proc.stdout
