@@ -587,7 +587,8 @@ def run_benchmarks(args, device_str: str) -> dict:
                                               "config9_coalesce",
                                               "config10_overload",
                                               "config11_coldstart",
-                                              "config12_tracing"):
+                                              "config12_tracing",
+                                              "config13_metrics"):
             return
         try:
             fn()
@@ -2159,6 +2160,49 @@ def run_benchmarks(args, device_str: str) -> dict:
     if args.tracing_requests > 0:
         section("config12_tracing", config12_tracing)
 
+    # -- config 13: metrics + numerics-sentinel leg (PR 9) ------------------
+    # THE shared protocol (serving/measure.py:metrics_overhead_run):
+    # the same ragged stream through an OBSERVED engine (tracer +
+    # metrics registry scraped in-window + numerics sentinel probing
+    # every live program family against golden digests) and a bare
+    # engine, interleaved per trial — the aggregate health surface
+    # must cost <= 3% or it gets turned off in the incident it exists
+    # for; plus the sentinel drill (an injected chaos wrong-output
+    # fault MUST raise a numerics_drift incident while every future
+    # still resolves). Criteria (scripts/bench_report.py): median
+    # paired overhead <= 1.03 at >= 64 requests, zero steady
+    # recompiles observed, drill detection + recovery, spans closed
+    # once, SLO burn rates reported. Every criterion is CPU-defined.
+    # With --profile set, the final registry snapshot exports next to
+    # the XLA capture (metrics.json/metrics.prom — `mano status
+    # --metrics-dir` re-reads them).
+    def config13_metrics():
+        from mano_hand_tpu.serving.measure import metrics_overhead_run
+
+        mx = metrics_overhead_run(
+            right,
+            requests=args.metrics_requests,
+            max_rows=args.serving_max_rows,
+            max_bucket=args.serving_max_bucket,
+            metrics_dir=args.profile or None,
+            seed=23,
+            log=lambda m: log(f"config13 {m}"),
+        )
+        results["metrics"] = mx
+        acc = mx["span_accounting"]
+        drill = mx["sentinel_drill"]
+        log(f"config13 metrics: overhead ratio "
+            f"{mx['metrics_overhead_ratio']:.3f} (trials "
+            f"{mx['ratio_trials']}), {mx['steady_recompiles']} steady "
+            f"recompiles, {mx['registry_metrics']} exported metrics, "
+            f"golden {mx['golden']['golden_status']}, sentinel drill "
+            f"detected={drill['detected']} recovered="
+            f"{drill['recovered']} ({drill['incidents']} incident(s)), "
+            f"{acc['spans_closed']}/{acc['spans_started']} spans closed")
+
+    if args.metrics_requests > 0:
+        section("config13_metrics", config13_metrics)
+
     if args.serving_only:
         # Fast serving-layer artifact (`make serve-smoke`): the deferred
         # runner's serving-only skip reduces the schedule to config7
@@ -2441,6 +2485,13 @@ def main() -> int:
                     help="largest power-of-two bucket of the config11 "
                          "engines (bounds the lattice size: every "
                          "bucket bakes full+gather+cpu entries)")
+    ap.add_argument("--metrics-requests", type=int, default=160,
+                    help="requests per stream repetition of the "
+                         "metrics+sentinel leg (config13: observed — "
+                         "tracer + metrics registry + numerics "
+                         "sentinel — vs bare engine, paired "
+                         "interleaved, plus the sentinel wrong-output "
+                         "detection drill); 0 skips the leg")
     ap.add_argument("--tracing-requests", type=int, default=160,
                     help="requests per pass of the tracing-overhead "
                          "leg (config12: traced vs untraced engine, "

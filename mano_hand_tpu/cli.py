@@ -956,8 +956,11 @@ def cmd_serve_bench(args) -> int:
     config11's ``serving.measure.cold_start_drill_run``); ``--trace
     DIR`` (PR 8) spans every request through an ``obs.Tracer`` and
     exports the Chrome-trace timeline + final flight record into DIR
-    for `mano trace-report` — stdout stays EXACTLY one JSON line
-    (progress and incidents ride stderr / the trace dir)."""
+    for `mano trace-report`; ``--metrics DIR`` (PR 9) registers the
+    engine's telemetry on an ``obs.MetricsRegistry`` and persists the
+    final scrape (metrics.json + Prometheus text) for `mano status
+    --metrics-dir` — stdout stays EXACTLY one JSON line (progress and
+    incidents ride stderr / the trace dir)."""
     import os
 
     import jax
@@ -1010,6 +1013,27 @@ def cmd_serve_bench(args) -> int:
         from mano_hand_tpu.obs import Tracer
 
         tracer = Tracer()
+
+    metrics_reg = None
+    if args.metrics:
+        # The metrics registry (PR 9) exports the LIVE engine's
+        # telemetry — ServingCounters/load()/tracer as pull collectors
+        # — so it composes with the default protocol (optionally under
+        # a --chaos plan), whose engine registers itself. The drill
+        # protocols fix their own engines internally; refuse rather
+        # than silently export an empty registry (the flag-guard
+        # convention).
+        if (args.overload or args.cold_start or args.subjects > 0
+                or args.chaos == "drill"):
+            print("--metrics composes only with the default protocol "
+                  "(optionally under a --chaos plan); the drill "
+                  "protocols (--overload/--cold-start/--subjects/"
+                  "--chaos drill) fix their own engines and export "
+                  "nothing into a caller registry", file=sys.stderr)
+            return 2
+        from mano_hand_tpu.obs import MetricsRegistry
+
+        metrics_reg = MetricsRegistry()
 
     emit_by = 900.0 if args.emit_by < 0 else args.emit_by
 
@@ -1234,11 +1258,28 @@ def cmd_serve_bench(args) -> int:
         seed=args.seed,
         policy=policy,
         tracer=tracer,
+        metrics=metrics_reg,
     )
     out["backend"] = jax.default_backend()
     if args.chaos:
         out["chaos"] = args.chaos
     export_trace(out)
+    if metrics_reg is not None:
+        # The registry export (--metrics DIR): the final scrape as
+        # metrics.json + Prometheus text, readable later by `mano
+        # status --metrics-dir DIR`. An unwritable dir must not
+        # discard a COMPLETED run (the --trace export rule): the
+        # failure is recorded in the artifact, the JSON line prints.
+        try:
+            from mano_hand_tpu.obs.metrics import export_metrics_dir
+
+            out["metrics_export"] = export_metrics_dir(
+                metrics_reg.snapshot(), args.metrics)
+        except OSError as e:
+            out["metrics_export"] = {
+                "error": f"{type(e).__name__}: {e} (metrics dir "
+                         f"{args.metrics!r} unwritable; the run's "
+                         "metrics above are unaffected)"}
     print(json.dumps(out))
     return 0
 
@@ -1266,6 +1307,118 @@ def cmd_trace_report(args) -> int:
     if args.all_tracks:
         argv.append("--all-tracks")
     return mod.main(argv)
+
+
+def cmd_status(args) -> int:
+    """`mano status` — the operator's one-look health surface (PR 9):
+    host facts, tunnel/device health, the committed numerics goldens,
+    and (``--metrics-dir``) the last persisted metrics scrape of a
+    `serve-bench --metrics` run, as one JSON document on stdout.
+
+    Device health is probed ONLY in a killable subprocess
+    (runtime.supervise.run_python — the CLAUDE.md rule: an in-process
+    ``jax.devices()`` HANGS for hours when the tunnel is down, and no
+    signal can clear it). A failed or hung probe degrades the report
+    to host-only facts (``degraded: true``) instead of hanging the
+    command; rc stays 0 — status is a report, not a gate.
+
+    ``--prom`` re-renders the persisted metrics snapshot as Prometheus
+    text (a scrape endpoint must not pay a 20 s tunnel probe, so
+    probes are skipped in that mode)."""
+    from mano_hand_tpu.obs.metrics import METRICS_JSON, prometheus_text
+    from mano_hand_tpu.obs.sentinel import (
+        default_goldens_path, load_goldens,
+    )
+
+    metrics_snap = None
+    metrics_info = None
+    if args.metrics_dir:
+        from pathlib import Path
+
+        path = Path(args.metrics_dir) / METRICS_JSON
+        try:
+            metrics_snap = json.loads(path.read_text())
+            metrics_info = {
+                "path": str(path),
+                "schema": metrics_snap.get("schema"),
+                "metrics": len(metrics_snap.get("metrics") or {}),
+                "wall_time_utc": metrics_snap.get("wall_time_utc"),
+            }
+        except (OSError, ValueError) as e:
+            metrics_info = {"path": str(path),
+                            "error": f"{type(e).__name__}: {e}"}
+    if args.prom:
+        if metrics_snap is None:
+            print("--prom needs a readable --metrics-dir (the "
+                  "persisted scrape of a `serve-bench --metrics DIR` "
+                  "run)" + (f": {metrics_info['error']}"
+                            if metrics_info else ""), file=sys.stderr)
+            return 2
+        print(prometheus_text(metrics_snap), end="")
+        return 0
+
+    host = {"python": sys.version.split()[0], "platform": sys.platform}
+    from importlib import metadata
+
+    for pkg in ("jax", "jaxlib", "numpy"):
+        try:
+            host[pkg] = metadata.version(pkg)
+        except Exception:  # noqa: BLE001 — a missing dist is a fact
+            host[pkg] = None
+
+    from mano_hand_tpu.runtime import supervise
+
+    probes = {}
+    degraded = False
+    for plat in [p.strip() for p in args.platforms.split(",")
+                 if p.strip()]:
+        code = ["import jax"]
+        if plat != "default":
+            # The site-hook rule: only the config API pins a platform.
+            code.append(
+                f"jax.config.update('jax_platforms', {plat!r})")
+        # The jax.devices() below runs in the KILLABLE subprocess —
+        # a tunnel-down hang is killed at the timeout, never waited
+        # out in this process.
+        code.append("ds = jax.devices()")
+        code.append("print(len(ds), ds[0].platform, "
+                    "getattr(ds[0], 'device_kind', '?'))")
+        res = supervise.run_python("\n".join(code),
+                                   timeout_s=args.probe_timeout)
+        entry = {"ok": bool(res.ok)}
+        if res.ok:
+            parts = (res.out or "").split(None, 2)
+            if len(parts) == 3:
+                entry.update(devices=int(parts[0]), platform=parts[1],
+                             device_kind=parts[2])
+        else:
+            entry["error"] = res.err
+            entry["killed"] = bool(getattr(res, "killed", False))
+            degraded = True
+        probes[plat] = entry
+
+    gpath = default_goldens_path()
+    goldens = load_goldens(gpath)
+    report = {
+        "schema": 1,
+        "host": host,
+        "probes": probes,
+        "degraded": degraded,
+        "goldens": {
+            "path": str(gpath),
+            "present": goldens is not None,
+            "entries": sorted((goldens or {}).get("entries", {})),
+        },
+    }
+    if degraded:
+        report["note"] = (
+            "device probe failed/hung — host-only report (the tunnel "
+            "is probably down; serving degrades to the CPU tier, see "
+            "runtime/health.py)")
+    if metrics_info is not None:
+        report["metrics"] = metrics_info
+    print(json.dumps(report, indent=2))
+    return 0
 
 
 def cmd_analyze(args) -> int:
@@ -1666,6 +1819,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "protocol; stdout stays one JSON line. A "
                          "watchdog kill dumps the timeline here before "
                          "exiting")
+    sb.add_argument("--metrics", default="",
+                    help="metrics registry export (PR 9): register the "
+                         "run's engine telemetry (ServingCounters, "
+                         "load(), tracer) on an obs.metrics registry "
+                         "and persist the final scrape into this "
+                         "directory as metrics.json + metrics.prom "
+                         "(read them with `mano status --metrics-dir "
+                         "DIR [--prom]`). Default protocol only "
+                         "(optionally under a --chaos plan); the "
+                         "drill modes fix their own engines")
     sb.add_argument("--seed", type=int, default=0)
     sb.set_defaults(fn=cmd_serve_bench)
 
@@ -1684,6 +1847,32 @@ def build_parser() -> argparse.ArgumentParser:
                     help="include host tracks even when a device track "
                          "exists")
     tr.set_defaults(fn=cmd_trace_report)
+
+    st = sub.add_parser(
+        "status",
+        help="host + device health report (killable-subprocess tunnel "
+             "probe — never an in-process jax.devices()), committed "
+             "numerics goldens, and the last persisted metrics scrape",
+    )
+    st.add_argument("--platforms", default="cpu,default",
+                    help="comma-separated platforms to probe; "
+                         "'default' probes whatever the site hook "
+                         "configured (the tunnel on this box) — a "
+                         "down tunnel degrades the report, never "
+                         "hangs it")
+    st.add_argument("--probe-timeout", type=float, default=20.0,
+                    help="per-platform probe deadline in seconds; a "
+                         "hung probe is SIGKILLed at the deadline")
+    st.add_argument("--metrics-dir", default="",
+                    help="read the metrics.json a `serve-bench "
+                         "--metrics DIR` run persisted and include it "
+                         "in the report")
+    st.add_argument("--prom", action="store_true",
+                    help="print the persisted metrics snapshot as "
+                         "Prometheus text instead of the JSON report "
+                         "(requires --metrics-dir; skips the device "
+                         "probes — a scrape endpoint must stay fast)")
+    st.set_defaults(fn=cmd_status)
 
     an = sub.add_parser(
         "analyze",

@@ -1,10 +1,11 @@
-"""Observability for the serving stack (PR 8): request-lifecycle
+"""Observability for the serving stack (PR 8 + PR 9): request-lifecycle
 tracing, an incident flight recorder, a unified host+device timeline,
-and the leveled stderr logger.
+the leveled stderr logger, an exportable metrics registry with SLO
+accounting, and the numerics sentinel.
 
 Deliberately jax-free at import time: the tracer rides the engine's hot
 path and the logger is imported by everything — neither may pull a
-backend in.
+backend in (the sentinel imports jax lazily, at probe time only).
 
 * ``obs.trace.Tracer`` — bounded lock-light span/event ring; threaded
   through ``ServingEngine(tracer=...)``.
@@ -13,22 +14,41 @@ backend in.
   (Chrome-trace export for ``scripts/trace_report.py``).
 * ``obs.log`` — ``get_logger``: info/debug to leveled stderr,
   warning through the ``warnings`` machinery, stdout never.
+* ``obs.metrics`` — ``MetricsRegistry`` (counter/gauge/quantile
+  instruments, one-lock-hold snapshots), ``engine_registry`` (absorbs
+  ``ServingCounters``/``load()``/tracer/SLO as collectors),
+  ``prometheus_text`` + JSON export, ``slo_report`` burn rates.
+* ``obs.sentinel`` — ``NumericsSentinel``: low-rate golden-input
+  probe of every live program family in the serving compilation
+  context, f32-digest drift detection onto the incident timeline.
 """
 
 from mano_hand_tpu.obs.log import Logger, get_logger
+from mano_hand_tpu.obs.metrics import (
+    MetricsRegistry,
+    engine_registry,
+    prometheus_text,
+    slo_report,
+)
 from mano_hand_tpu.obs.recorder import (
     FlightRecorder,
     flight_record,
     write_trace_dir,
 )
+from mano_hand_tpu.obs.sentinel import NumericsSentinel
 from mano_hand_tpu.obs.trace import TERMINAL_KINDS, Tracer
 
 __all__ = [
     "FlightRecorder",
     "Logger",
+    "MetricsRegistry",
+    "NumericsSentinel",
     "TERMINAL_KINDS",
     "Tracer",
+    "engine_registry",
     "flight_record",
     "get_logger",
+    "prometheus_text",
+    "slo_report",
     "write_trace_dir",
 ]

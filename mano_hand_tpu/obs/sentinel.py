@@ -1,0 +1,493 @@
+"""Continuous numerics monitoring for the serving stack (PR 9).
+
+Two silent precision collapses in this repo's history were only ever
+caught by probing the compiled path ON the device, in the same
+compilation context as the timed path (docs/roadmap.md process notes;
+the CLAUDE.md numerics rule). Serving has the same exposure
+continuously: a lattice entry deserialized wrong, a driver regression,
+a tunnel-level corruption (chaos kind ``wrong``) all return
+plausible-looking floats that no retry, breaker, or deadline will ever
+flag. The ``NumericsSentinel`` turns the one-shot probe into a
+standing guarantee:
+
+* **Golden input.** A committed deterministic input
+  (``golden_inputs``: fixed seed, fixed rows — the same arrays every
+  process, every round).
+* **Every live program family, in the serving context.** Each probe
+  runs the golden input through the engine's OWN cached executables —
+  the chaos-wrapped, possibly lattice-loaded objects real dispatches
+  use (``ServingEngine.numerics_probe_targets``) — for every family
+  currently live: ``full``, gathered pose-only, and the CPU-failover
+  tier. Only already-warm families are probed: the sentinel never
+  compiles, so steady-state stays zero-recompile.
+* **f32 digests against clean references.** Each served output's
+  digest is compared against a clean reference executable built from
+  the SAME trace (the bit-identity policy: params/table as runtime
+  arguments ⇒ f32 ``==``). A mismatch raises a ``numerics_drift``
+  incident on the PR-8 tracer timeline — the flight recorder captures
+  the moment — and each probe rides a span closed EXACTLY once
+  (terminal kind ``probe``/``drift``/``error``), the engine's
+  span-accounting criterion extended to the sentinel itself.
+* **Committed goldens.** ``arm()`` additionally digests the clean
+  reference at the committed fixed shape and compares it against
+  ``obs/goldens.json`` (committed for the synthetic asset on the CPU
+  backend; regenerate with ``python -m mano_hand_tpu.obs.sentinel``
+  after an INTENTIONAL numerics change, the analysis-baseline
+  workflow). A mismatch there means the ENVIRONMENT drifted (new
+  XLA/jax float folding) — reported as ``numerics_golden_mismatch``,
+  distinct from a live serving-path drift.
+
+Proven by drill, not hoped: bench config13's sentinel drill
+(serving/measure.py:metrics_overhead_run) injects the chaos
+``wrong``-output fault into a live engine and the sentinel MUST detect
+it — judged by scripts/bench_report.py.
+
+Threading: ``start()`` arms a low-rate background daemon probe
+(bounded ``Event.wait`` loop — never a bare retry loop); every stamp
+is ``time.monotonic()`` (the analysis wallclock rule). On a tunneled
+backend a probe can hang in a device RPC like any dispatch — the
+thread is daemon (abandonable) and ``status()`` exposes the last-probe
+age so a wedged sentinel is itself observable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from pathlib import Path
+from typing import Dict, Optional
+
+import numpy as np
+
+from mano_hand_tpu.obs import log as obs_log
+from mano_hand_tpu.obs.metrics import metric
+
+GOLDENS_SCHEMA = 1
+#: Committed golden-input identity: rows and seed are part of the
+#: golden contract — change either and every committed digest is void.
+GOLDEN_SEED = 20260804
+GOLDEN_ROWS = 4
+
+_LOG = obs_log.get_logger("obs.sentinel")
+
+
+def default_goldens_path() -> Path:
+    return Path(__file__).resolve().parent / "goldens.json"
+
+
+def golden_inputs(n_joints: int, n_shape: int, rows: int = GOLDEN_ROWS,
+                  seed: int = GOLDEN_SEED):
+    """THE committed golden input: deterministic (fixed seed) pose and
+    shape arrays — identical bytes every process, every asset with the
+    same dims."""
+    rng = np.random.default_rng(seed)
+    pose = rng.normal(scale=0.4, size=(rows, n_joints, 3)).astype(
+        np.float32)
+    shape = rng.normal(size=(rows, n_shape)).astype(np.float32)
+    return pose, shape
+
+
+def f32_digest(arr) -> str:
+    """Content digest of an array's f32 bytes (the bit-identity
+    comparator: two digests equal iff the outputs are f32 ``==``)."""
+    a = np.ascontiguousarray(np.asarray(arr, np.float32))
+    return hashlib.sha256(a.tobytes()).hexdigest()[:16]
+
+
+def reference_digests(params, rows: int = GOLDEN_ROWS,
+                      seed: int = GOLDEN_SEED) -> dict:
+    """Clean-path golden digests on the CURRENT backend at the
+    committed fixed shape — what ``commit_goldens`` persists and
+    ``arm()`` re-derives for comparison."""
+    import jax
+
+    from mano_hand_tpu.models import core
+
+    pose, shape = golden_inputs(params.n_joints, params.n_shape,
+                                rows=rows, seed=seed)
+    prm = params.astype(np.float32).device_put()
+    full = np.asarray(jax.jit(
+        lambda q, p, s: core.forward_batched(q, p, s).verts)(
+            prm, pose, shape))
+    cpu_dev = jax.devices("cpu")[0]
+    prm_cpu = jax.device_put(params.astype(np.float32), cpu_dev)
+    cpu = np.asarray(jax.jit(
+        lambda q, p, s: core.forward_batched(q, p, s).verts)(
+            prm_cpu, jax.device_put(pose, cpu_dev),
+            jax.device_put(shape, cpu_dev)))
+    return {"full": f32_digest(full), "cpu": f32_digest(cpu)}
+
+
+def commit_goldens(params, path=None, rows: int = GOLDEN_ROWS,
+                   seed: int = GOLDEN_SEED) -> dict:
+    """Write the committed-goldens file for ``params`` on the current
+    backend (merging with existing entries — one file carries every
+    (params_digest, backend) pair ever committed)."""
+    import jax
+
+    from mano_hand_tpu.io.export_aot import params_digest
+
+    # Key on the f32-cast params: that is what a ServingEngine holds
+    # (engine __init__ casts to its dtype), so ``arm()``'s lookup key
+    # matches regardless of the asset file's storage dtype.
+    params = params.astype(np.float32)
+    path = Path(path) if path is not None else default_goldens_path()
+    data = {"schema": GOLDENS_SCHEMA, "rows": rows, "seed": seed,
+            "entries": {}}
+    if path.exists():
+        try:
+            old = json.loads(path.read_text())
+            if (old.get("schema") == GOLDENS_SCHEMA
+                    and old.get("rows") == rows
+                    and old.get("seed") == seed):
+                data["entries"] = dict(old.get("entries") or {})
+        except (OSError, ValueError):
+            pass   # damaged file: rewrite whole
+    key = f"{params_digest(params)}:{jax.default_backend()}"
+    data["entries"][key] = reference_digests(params, rows=rows,
+                                             seed=seed)
+    path.write_text(json.dumps(data, indent=2, sort_keys=True) + "\n")
+    return data
+
+
+def load_goldens(path=None) -> Optional[dict]:
+    path = Path(path) if path is not None else default_goldens_path()
+    if not path.exists():
+        return None
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    if data.get("schema") != GOLDENS_SCHEMA:
+        return None
+    return data
+
+
+class NumericsSentinel:
+    """Low-rate background numerics probe over one ``ServingEngine``.
+
+    One instance per engine; ``probe()`` for a manual pass, ``start()``
+    for the background loop. Thread-safe: one private lock guards the
+    result/counter state, never held across device work or tracer
+    calls (the obs/ lock rule)."""
+
+    def __init__(self, engine, tracer=None, interval_s: float = 60.0,
+                 goldens_path=None, clock=time.monotonic):
+        if interval_s <= 0:
+            raise ValueError(
+                f"interval_s must be > 0, got {interval_s}")
+        self._engine = engine
+        self._tracer = (tracer if tracer is not None
+                        else getattr(engine, "tracer", None))
+        self.interval_s = float(interval_s)
+        self._goldens_path = goldens_path
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._refs: Dict[str, object] = {}
+        self._params_cpu = None
+        self._cpu_dev = None
+        self.probes = 0
+        self.drifts = 0
+        self.probe_errors = 0
+        self.golden_status = "unchecked"   # unchecked|match|mismatch|absent
+        self._last: Optional[dict] = None
+        self._last_t: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- clean refs
+    # Each reference is the SAME trace as the engine's builder
+    # (serving/engine.py:build_*_executable) re-jitted without chaos
+    # wrapping: identical jaxpr -> identical XLA program -> f32
+    # bit-identical output (the params-as-runtime-args policy every
+    # bit-identity test in this repo pins). Compiles happen at the
+    # sentinel's FIRST probe of a (family, shape) — arm-time work,
+    # cached by jax thereafter; engine counters never tick.
+    def _ref_full(self):
+        ref = self._refs.get("full")
+        if ref is None:
+            import jax
+
+            from mano_hand_tpu.models import core
+
+            ref = jax.jit(
+                lambda q, p, s: core.forward_batched(q, p, s).verts)
+            self._refs["full"] = ref
+        return ref
+
+    def _ref_gather(self):
+        ref = self._refs.get("gather")
+        if ref is None:
+            import jax
+
+            from mano_hand_tpu.models import core
+
+            ref = jax.jit(
+                lambda t, i, p: core.forward_posed_gather(t, i, p).verts)
+            self._refs["gather"] = ref
+        return ref
+
+    def _cpu_inputs(self, params_host):
+        import jax
+
+        if self._params_cpu is None:
+            self._cpu_dev = jax.devices("cpu")[0]
+            self._params_cpu = jax.device_put(
+                params_host, self._cpu_dev)
+        return self._params_cpu, self._cpu_dev
+
+    # ---------------------------------------------------------------- probing
+    def arm(self) -> dict:
+        """One-time baseline: derive the clean golden digests at the
+        committed fixed shape and check them against the committed
+        goldens file (match / mismatch / absent for this
+        (params_digest, backend)). A mismatch is ENVIRONMENT drift —
+        incident ``numerics_golden_mismatch`` — not a serving-path
+        fault; per-probe serving checks are independent of it."""
+        import jax
+
+        from mano_hand_tpu.io.export_aot import params_digest
+
+        t = self._engine.numerics_probe_targets()
+        got = reference_digests(t["params"])
+        committed = load_goldens(self._goldens_path)
+        key = f"{params_digest(t['params'])}:{jax.default_backend()}"
+        entry = (committed or {}).get("entries", {}).get(key)
+        if entry is None:
+            status = "absent"
+        elif entry == got:
+            status = "match"
+        else:
+            status = "mismatch"
+            _LOG.warning(
+                f"committed golden digests for {key} do not match this "
+                f"environment (committed {entry}, derived {got}) — "
+                "XLA/jax numerics drifted since the goldens were "
+                "committed; regenerate with `python -m "
+                "mano_hand_tpu.obs.sentinel` if intentional")
+            if self._tracer is not None:
+                self._tracer.incident("numerics_golden_mismatch",
+                                      key=key)
+        with self._lock:
+            self.golden_status = status
+        return {"golden_status": status, "key": key, "derived": got,
+                "committed": entry}
+
+    def _probe_family(self, exe, want_fn, *args) -> dict:
+        served = np.asarray(exe(*args))
+        want = np.asarray(want_fn(*args))
+        rec = {
+            "served_digest": f32_digest(served),
+            "want_digest": f32_digest(want),
+            "max_abs_err": float(np.abs(
+                served.astype(np.float32)
+                - want.astype(np.float32)).max()),
+        }
+        rec["drift"] = rec["served_digest"] != rec["want_digest"]
+        return rec
+
+    def probe(self) -> dict:
+        """One probe pass NOW over every live family. Returns the
+        result dict ({family: {served_digest, want_digest, drift,
+        max_abs_err}}, ...); a drift raises the ``numerics_drift``
+        incident. The probe's span closes exactly once whatever
+        happens (terminal kind probe/drift/error)."""
+        tr = self._tracer
+        sid = tr.start("sentinel", tier=0, rows=GOLDEN_ROWS) \
+            if tr is not None else None
+        kind = "error"
+        families: Dict[str, dict] = {}
+        drifted: list = []
+        try:
+            t = self._engine.numerics_probe_targets()
+            pose, shape = golden_inputs(t["n_joints"], t["n_shape"])
+            if t["full"]:
+                b = min(t["full"])
+                pp, ss = _pad_rows(pose, b), _pad_rows(shape, b)
+                families["full"] = dict(
+                    bucket=b,
+                    **self._probe_family(
+                        t["full"][b],
+                        lambda p, s: self._ref_full()(
+                            t["params_dev"], p, s),
+                        pp, ss))
+            if t["cpu"]:
+                import jax
+
+                b = min(t["cpu"])
+                prm_cpu, cpu_dev = self._cpu_inputs(t["params"])
+                pp, ss = _pad_rows(pose, b), _pad_rows(shape, b)
+                families["cpu"] = dict(
+                    bucket=b,
+                    **self._probe_family(
+                        t["cpu"][b],
+                        lambda p, s: self._ref_full()(
+                            prm_cpu, jax.device_put(p, cpu_dev),
+                            jax.device_put(s, cpu_dev)),
+                        pp, ss))
+            if t["gather"] and t["table"] is not None:
+                b = min(t["gather"])
+                idx = np.zeros((b,), np.int32)   # row 0 always baked
+                pp = _pad_rows(pose, b)
+                families["gather"] = dict(
+                    bucket=b, capacity=t["table"].capacity,
+                    **self._probe_family(
+                        t["gather"][b],
+                        self._ref_gather(), t["table"], idx, pp))
+            drifted = [f for f, rec in families.items()
+                       if rec["drift"]]
+            kind = "drift" if drifted else "probe"
+        except Exception as e:  # noqa: BLE001 — a broken probe must
+            # not take down the path it observes; counted + logged.
+            with self._lock:
+                self.probe_errors += 1
+            _LOG.warning(
+                f"numerics probe failed: {type(e).__name__}: {e}")
+            families["probe_error"] = {"error":
+                                       f"{type(e).__name__}: {e}"}
+        finally:
+            if tr is not None:
+                tr.close(sid, kind,
+                         families=",".join(sorted(families)))
+        result = {
+            "families": families,
+            "drift": bool(drifted),
+            "drifted_families": drifted,
+            "t_monotonic": self._clock(),
+        }
+        with self._lock:
+            self.probes += 1
+            if drifted:
+                self.drifts += 1
+            self._last = result
+            self._last_t = result["t_monotonic"]
+        if drifted and tr is not None:
+            # Outside self._lock (the tracer runs incident hooks —
+            # the flight recorder — and no lock of ours may wrap a
+            # call out).
+            tr.incident("numerics_drift",
+                        families=",".join(drifted),
+                        err=max(families[f]["max_abs_err"]
+                                for f in drifted))
+        return result
+
+    # --------------------------------------------------- background loop
+    def start(self) -> "NumericsSentinel":
+        """Arm the background probe: one daemon thread, one probe per
+        ``interval_s``, BOUNDED wait (Event.wait — stops promptly,
+        never a bare retry loop)."""
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="mano-numerics-sentinel",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.probe()
+            except Exception:  # noqa: BLE001 — probe() already records
+                pass
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            # Bounded join: a probe wedged in a device RPC is abandoned
+            # (daemon), exactly the engine's stop() reasoning — but the
+            # handle is cleared ONLY when the thread actually exited:
+            # a wedged probe must keep reading armed=True (observable)
+            # and a later start() must not spawn a second loop beside
+            # it (start()'s is_alive() guard needs the handle).
+            t.join(timeout_s)
+            if not t.is_alive():
+                self._thread = None
+
+    def __enter__(self) -> "NumericsSentinel":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------- reporting
+    def status(self) -> dict:
+        """One lock-held copy of the sentinel's own accounting (the
+        torn-telemetry rule)."""
+        now = self._clock()
+        with self._lock:
+            return {
+                "probes": self.probes,
+                "drifts": self.drifts,
+                "probe_errors": self.probe_errors,
+                "golden_status": self.golden_status,
+                "armed": (self._thread is not None
+                          and self._thread.is_alive()),
+                "last_probe_age_s": (None if self._last_t is None
+                                     else max(0.0, now - self._last_t)),
+                "last": self._last,
+            }
+
+    def samples(self) -> dict:
+        """Registry-collector form of ``status()`` (obs/metrics.py)."""
+        st = self.status()
+        golden_code = {"unchecked": -1, "match": 0, "absent": 1,
+                       "mismatch": 2}.get(st["golden_status"], -1)
+        out = {
+            "sentinel_probes": metric(
+                "counter", st["probes"], help="numerics probes run"),
+            "sentinel_drifts": metric(
+                "counter", st["drifts"],
+                help="probes that detected numerics drift"),
+            "sentinel_probe_errors": metric(
+                "counter", st["probe_errors"],
+                help="probes that failed to complete"),
+            "sentinel_golden_status": metric(
+                "gauge", golden_code,
+                help="-1 unchecked, 0 match, 1 absent, 2 mismatch"),
+            "sentinel_armed": metric(
+                "gauge", 1.0 if st["armed"] else 0.0),
+        }
+        if st["last_probe_age_s"] is not None:
+            out["sentinel_last_probe_age_s"] = metric(
+                "gauge", st["last_probe_age_s"],
+                help="seconds since the last completed probe")
+        return out
+
+
+def _pad_rows(arr: np.ndarray, rows: int) -> np.ndarray:
+    """Golden rows padded (row-0 repeat) or truncated to the probe
+    bucket — self-contained so the sentinel never imports the bucket
+    policy it is auditing."""
+    if arr.shape[0] >= rows:
+        return np.ascontiguousarray(arr[:rows])
+    pad = np.broadcast_to(arr[:1],
+                          (rows - arr.shape[0],) + arr.shape[1:])
+    return np.ascontiguousarray(np.concatenate([arr, pad]))
+
+
+def main(argv=None) -> int:
+    """Regenerate the committed goldens for the synthetic asset on the
+    host CPU backend: ``python -m mano_hand_tpu.obs.sentinel``. Run it
+    after an INTENTIONAL numerics change and justify the diff in the
+    PR (the `mano analyze --update-baseline` workflow)."""
+    import jax
+
+    # The site-hook rule: only the config API reliably pins cpu.
+    jax.config.update("jax_platforms", "cpu")
+    from mano_hand_tpu.assets import synthetic_params
+
+    params = synthetic_params()
+    data = commit_goldens(params)
+    print(f"goldens committed to {default_goldens_path()}: "
+          f"{sorted(data['entries'])}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -514,6 +514,42 @@ class ServingEngine:
         self._live: dict = {}
         self._live_lock = threading.Lock()
 
+    @property
+    def tracer(self):
+        """The engine's ``obs.Tracer`` (or None): the wiring point for
+        ``obs.metrics.engine_registry`` and ``obs.NumericsSentinel``."""
+        return self._tracer
+
+    def numerics_probe_targets(self) -> dict:
+        """One consistent read of every LIVE program family — the raw
+        material of the numerics sentinel (obs/sentinel.py, PR 9).
+
+        Returns shallow copies of the executable caches (the same
+        chaos-wrapped, possibly lattice-loaded callables real
+        dispatches use — probing anything else would audit a path the
+        engine does not serve from), the current table snapshot, and
+        the params handles, all from ONE ``_exe_lock`` hold. The
+        sentinel probes only families present here, so it never
+        triggers a compile and steady-state stays zero-recompile. The
+        device_put of the params handle is staged OUTSIDE the lock
+        (the _install_subject rule: no device work under _exe_lock).
+        """
+        if self._params_dev is None:
+            self._params_dev = self._params.device_put()
+        with self._exe_lock:
+            return {
+                "full": dict(self._exes),
+                "gather": {b: exe for b, (_, exe)
+                           in self._gather_exes.items()},
+                "cpu": dict(self._cpu_exes),
+                "table": self._table,
+                "params": self._params,
+                "params_dev": self._params_dev,
+                "n_joints": self._n_joints,
+                "n_shape": self._n_shape,
+                "dtype": self._dtype,
+            }
+
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "ServingEngine":
         if self._thread is None or not self._thread.is_alive():

@@ -107,6 +107,7 @@ def serve_bench_run(
     trials: int = 7,
     policy=None,
     tracer=None,
+    metrics=None,
     log: Callable[[str], None] = None,
 ) -> dict:
     """THE serving benchmark protocol — shared by ``bench.py`` config7
@@ -157,6 +158,14 @@ def serve_bench_run(
     eng = ServingEngine(params, max_bucket=max_bucket,
                         max_delay_s=max_delay_s, aot_dir=aot_dir,
                         policy=policy, tracer=tracer)
+    # ``metrics`` (an obs.metrics.MetricsRegistry, PR 9 — `serve-bench
+    # --metrics DIR`): the run's engine registers its telemetry
+    # sources as pull collectors; the CALLER owns scrape timing and
+    # export, so the protocol's measured numbers stay registry-free.
+    if metrics is not None:
+        from mano_hand_tpu.obs.metrics import register_engine_collectors
+
+        register_engine_collectors(metrics, eng, tracer=tracer)
 
     def run_stream():
         futs = [eng.submit(p, s) for p, s in stream]
@@ -1516,4 +1525,285 @@ def tracing_overhead_run(
         out["trace_export"] = write_trace_dir(
             tracer, trace_dir, counters=eng_on.counters,
             reason="tracing_overhead_complete")
+    return out
+
+
+def metrics_overhead_run(
+    params,
+    *,
+    requests: int = 160,
+    min_rows: int = 1,
+    max_rows: int = 16,
+    max_bucket: int = 32,
+    max_delay_s: float = 0.002,
+    seed: int = 0,
+    trials: int = 13,
+    reps: int = 3,
+    metrics_dir=None,
+    log: Callable[[str], None] = None,
+) -> dict:
+    """THE metrics+sentinel protocol — bench.py config13 (PR 9).
+
+    Two questions, one leg. (1) **What does the aggregate health
+    surface cost?** An OBSERVED engine (tracer + metrics registry +
+    numerics sentinel — the full PR-9 wiring a production process
+    would run) serves the same ragged stream as a bare engine,
+    interleaved per trial with alternating order; the headline is the
+    MEDIAN paired ratio (the config12 estimator — min-over-min carries
+    window noise larger than the 3% bound, dead-end recorded there).
+    Each timed pass is ``reps`` stream repetitions ending in ONE
+    registry scrape (snapshot + Prometheus render) and ONE sentinel
+    probe, all inside the window — a scrape/probe rate still ~100x
+    denser than a production 15 s scrape interval against this pass
+    length. Two protocol choices are measured dead-ends, not style:
+    scraping INSIDE the submit loop serializes the scrape against
+    coalescing on this 1-core box and read 13% overhead for work that
+    costs 0.8 ms; and at reps=1 the ~3 ms scrape+probe tail is ~2% of
+    a ~0.14 s pass before the tracer's ~1.7% even starts — the bound
+    only becomes a statement about steady-state cost once the pass
+    amortizes the fixed tail (reps=3: measured median 1.002).
+    (2) **Does the sentinel actually catch silent corruption?** The
+    drill composes the chaos ``wrong``-output fault (the one failure
+    mode no retry, breaker, or deadline can see) into a live
+    supervised engine: traffic keeps resolving "successfully" with
+    corrupt floats, and the sentinel's next probe MUST flag the
+    primary family drifted while the un-wrapped CPU tier probes clean
+    — then recover once the fault clears. Detection is judged, not
+    hoped (scripts/bench_report.py).
+
+    Returned criteria numbers:
+
+    * ``metrics_overhead_ratio`` <= 1.03 at >= 64 requests (median
+      paired; smaller runs record without judging — the config12
+      precedent);
+    * ``steady_recompiles`` == 0 on the observed engine — scrapes and
+      probes must never change program identity (the sentinel probes
+      only already-live families by construction);
+    * ``sentinel_drill``: clean probe clean, injected ``wrong`` fault
+      DETECTED (``numerics_drift`` incident recorded + flight capture),
+      CPU tier clean, recovery after the fault clears, every future
+      resolved, probe spans closed exactly once;
+    * ``slo``: per-tier error-budget burn rates from the same counters
+      snapshot the export serves.
+
+    ``metrics_dir`` persists the observed engine's final registry
+    snapshot as ``metrics.json`` + ``metrics.prom`` (the scrape files
+    `mano status --metrics-dir` re-reads).
+    """
+    from mano_hand_tpu.obs.metrics import (
+        engine_registry, prometheus_text, slo_report,
+    )
+    from mano_hand_tpu.obs.recorder import FlightRecorder
+    from mano_hand_tpu.obs.sentinel import NumericsSentinel
+    from mano_hand_tpu.runtime.chaos import ChaosPlan
+    from mano_hand_tpu.runtime.supervise import DispatchPolicy
+    from mano_hand_tpu.serving.engine import ServingEngine
+
+    if requests < 1:
+        raise ValueError(f"requests must be >= 1, got {requests}")
+    log = _logger(log)
+    max_rows = min(max_rows, max_bucket)
+    min_rows = max(1, min(min_rows, max_rows))
+    n_joints, n_shape = params.n_joints, params.n_shape
+    rng = np.random.default_rng(seed)
+    sizes = rng.integers(min_rows, max_rows + 1, size=requests)
+    stream = [
+        (rng.normal(scale=0.4, size=(n, n_joints, 3)).astype(np.float32),
+         rng.normal(size=(n, n_shape)).astype(np.float32))
+        for n in (int(s) for s in sizes)
+    ]
+    rows_total = int(sizes.sum())
+
+    tracer = Tracer()
+    eng_bare = ServingEngine(params, max_bucket=max_bucket,
+                             max_delay_s=max_delay_s)
+    eng_obs = ServingEngine(params, max_bucket=max_bucket,
+                            max_delay_s=max_delay_s, tracer=tracer)
+    sentinel = NumericsSentinel(eng_obs, tracer=tracer,
+                                interval_s=3600.0)
+    reg = engine_registry(eng_obs, tracer=tracer, sentinel=sentinel)
+    reps = max(1, int(reps))
+
+    def run_bare():
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            futs = [eng_bare.submit(p, s) for p, s in stream]
+            for f in futs:
+                f.result()
+        return time.perf_counter() - t0
+
+    def run_obs():
+        # The observed pass carries the FULL health surface: the
+        # traced engine serves the stream, then ONE registry scrape
+        # (snapshot + Prometheus render) and ONE sentinel probe land
+        # inside the window — at the pass boundary, never inside the
+        # submit loop (the starved-coalescing dead-end above).
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            futs = [eng_obs.submit(p, s) for p, s in stream]
+            for f in futs:
+                f.result()
+        prometheus_text(reg.snapshot())
+        sentinel.probe()
+        return time.perf_counter() - t0
+
+    ratios: List[float] = []
+    dt_obs_best = dt_bare_best = float("inf")
+    with eng_bare, eng_obs:
+        eng_bare.warmup()
+        eng_obs.warmup()
+        golden = sentinel.arm()     # goldens check + reference compiles
+        sentinel.probe()            # land the probe-shape compiles
+        run_bare()                  # settle both pipelines
+        run_obs()
+        compiles_warm = eng_obs.counters.compiles
+        for t in range(max(1, trials)):
+            # Alternate which engine goes first (the measure_overhead
+            # monotone-drift defense).
+            if t % 2 == 0:
+                dt_obs, dt_bare = run_obs(), run_bare()
+            else:
+                dt_bare, dt_obs = run_bare(), run_obs()
+            ratios.append(dt_obs / dt_bare)
+            dt_obs_best = min(dt_obs_best, dt_obs)
+            dt_bare_best = min(dt_bare_best, dt_bare)
+        steady_recompiles = eng_obs.counters.compiles - compiles_warm
+        # Background-loop proof: the low-rate daemon probe fires on its
+        # own (bounded wait, not load-bearing for the ratio above).
+        before = sentinel.status()["probes"]
+        sentinel.interval_s = 0.02
+        sentinel.start()
+        deadline = time.monotonic() + 10.0
+        while (sentinel.status()["probes"] <= before
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        sentinel.stop()
+        background_probes = sentinel.status()["probes"] - before
+        slo = slo_report(eng_obs.counters.snapshot())
+        final_snapshot = reg.snapshot()
+    accounting = tracer.accounting()
+    ratio = float(np.median(ratios))
+    rows_total *= reps              # rows served per timed pass
+    log(f"metrics: observed {rows_total / dt_obs_best:,.0f} vs bare "
+        f"{rows_total / dt_bare_best:,.0f} evals/s (median paired "
+        f"ratio {ratio:.3f}), {steady_recompiles} steady recompiles, "
+        f"{sentinel.status()['probes']} probes "
+        f"({background_probes} background), golden "
+        f"{golden['golden_status']}")
+
+    # ---- the sentinel drill: injected silent corruption MUST be seen.
+    plan = ChaosPlan()
+    pol = DispatchPolicy(deadline_s=20.0, retries=0, chaos=plan)
+    tr3 = Tracer()
+    eng3 = ServingEngine(params, min_bucket=8, max_bucket=8,
+                         max_delay_s=max_delay_s, policy=pol,
+                         tracer=tr3)
+    rec3 = FlightRecorder(tr3, eng3.counters)
+    s3 = NumericsSentinel(eng3, tracer=tr3, interval_s=3600.0)
+    wave = [
+        (rng.normal(scale=0.4, size=(int(n), n_joints, 3)).astype(
+            np.float32),
+         rng.normal(size=(int(n), n_shape)).astype(np.float32))
+        for n in rng.integers(1, 5, size=12)
+    ]
+
+    def submit_wave():
+        # "Resolved" = the engine guarantee: a RESULT or a structured
+        # error within the window — never a hang. (The wrong-output
+        # fault resolves every future with a result; that it is the
+        # WRONG result is exactly what only the sentinel can see.)
+        import concurrent.futures as cf
+
+        futs = [eng3.submit(p, s) for p, s in wave]
+        resolved = 0
+        for f in futs:
+            try:
+                f.result(timeout=60.0)
+                resolved += 1
+            except cf.TimeoutError:
+                pass
+            except Exception:  # noqa: BLE001 — structured error resolves
+                resolved += 1
+        return resolved, len(futs)
+
+    with eng3:
+        eng3.warmup()               # primary + CPU-failover tier
+        s3.arm()
+        ok0, n0 = submit_wave()     # clean traffic
+        clean = s3.probe()
+        drill_compiles_warm = eng3.counters.compiles
+        # The silent-corruption fault: every wrapped (primary) call
+        # from here returns verts + 1.0 — no exception, so
+        # supervision/retries/failover never fire and every future
+        # still resolves "ok". Only the sentinel can see this.
+        plan.schedule("wrong:1.0@0-")
+        ok1, n1 = submit_wave()
+        detected = s3.probe()
+        plan.clear()                # the fault clears (tunnel healed)
+        recovered = s3.probe()
+        drill_recompiles = eng3.counters.compiles - drill_compiles_warm
+    drill_acc = tr3.accounting()
+    fam = detected["families"]
+    drill = {
+        "submitted": n0 + n1,
+        "futures_resolved_fraction": (ok0 + ok1) / (n0 + n1),
+        "clean_probe_drift": bool(clean["drift"]),
+        "detected": bool(detected["drift"]),
+        "drifted_families": detected["drifted_families"],
+        "drift_max_abs_err": max(
+            (fam[f]["max_abs_err"] for f in
+             detected["drifted_families"]), default=None),
+        "cpu_family_clean": ("cpu" in fam
+                             and not fam["cpu"]["drift"]),
+        "recovered": not recovered["drift"],
+        "incidents": drill_acc["incidents"],
+        "flight_capture_reasons": [c.get("reason")
+                                   for c in rec3.captures],
+        "faults_injected": int(eng3.counters.faults_injected),
+        "steady_recompiles": int(drill_recompiles),
+        "span_accounting": drill_acc,
+    }
+    log(f"sentinel drill: detected={drill['detected']} "
+        f"(families {drill['drifted_families']}, max err "
+        f"{drill['drift_max_abs_err']}), cpu clean "
+        f"{drill['cpu_family_clean']}, recovered "
+        f"{drill['recovered']}, {drill['futures_resolved_fraction']:.0%}"
+        f" of {drill['submitted']} futures resolved, "
+        f"{drill['incidents']} incident(s)")
+
+    out = {
+        "requests": int(requests),
+        "trials": int(max(1, trials)),
+        "reps_per_pass": int(reps),
+        "scrapes_per_pass": 1,
+        "probes_per_pass": 1,
+        "rows": [int(sizes.min()), int(sizes.max())],
+        "buckets": list(eng_obs.buckets),
+        "observed_evals_per_sec": float(
+            f"{rows_total / dt_obs_best:.5g}"),
+        "bare_evals_per_sec": float(
+            f"{rows_total / dt_bare_best:.5g}"),
+        "metrics_overhead_ratio": float(f"{ratio:.4g}"),
+        "ratio_best_window": float(
+            f"{dt_obs_best / dt_bare_best:.4g}"),
+        "ratio_trials": [float(f"{r:.3g}") for r in ratios],
+        "steady_recompiles": int(steady_recompiles),
+        "span_accounting": accounting,
+        "registry_metrics": len(final_snapshot.get("metrics", {})),
+        "registry_errors": final_snapshot.get("errors"),
+        "sentinel": {k: v for k, v in sentinel.status().items()
+                     if k != "last"},
+        "sentinel_background_probes": int(background_probes),
+        "golden": golden,
+        "slo": slo,
+        "sentinel_drill": drill,
+        "flight_record": flight_record(
+            tracer, eng_obs.counters,
+            reason="metrics_overhead_complete"),
+    }
+    if metrics_dir is not None:
+        from mano_hand_tpu.obs.metrics import export_metrics_dir
+
+        out["metrics_export"] = export_metrics_dir(
+            final_snapshot, metrics_dir, slo=slo)
     return out

@@ -58,11 +58,170 @@ def load_line(path: str) -> dict:
     return data
 
 
+def _device_class(line: dict) -> str:
+    """'tpu' / 'cpu' / 'unknown' — rates are only comparable within a
+    device class (a tunnel-down CPU-lane artifact judged against a TPU
+    round would always read as a catastrophic 'regression')."""
+    dev = line.get("device")
+    if isinstance(dev, str) and dev:
+        return dev.split(":", 1)[0]
+    return "unknown"
+
+
+def _numeric_rates(line: dict) -> dict:
+    """Flatten one artifact's throughput rates for cross-round
+    comparison: the headline ``value`` plus every ``*per_sec`` key in
+    ``detail`` (one nested level for the serving-style blocks). Every
+    extracted key is higher-is-better by construction."""
+    out = {}
+    v = line.get("value")
+    if isinstance(v, (int, float)):
+        # Key the headline by the artifact's own metric name: a
+        # serving-only envelope's value and a full-bench headline
+        # measure DIFFERENT things and must never compare as one
+        # "headline" config.
+        out[str(line.get("metric") or "headline")] = float(v)
+    for k, val in (line.get("detail") or {}).items():
+        if "bound" in k:
+            continue   # derived roofline ceilings, not measurements
+        if isinstance(val, (int, float)) and "per_sec" in k:
+            out[k] = float(val)
+        elif isinstance(val, dict):
+            for k2, v2 in val.items():
+                if isinstance(v2, (int, float)) and "per_sec" in k2:
+                    out[f"{k}.{k2}"] = float(v2)
+    return out
+
+
+def history_verdict(run_path: str, history_paths, tolerance: float,
+                    ) -> int:
+    """The cross-round perf-trend gate (`--history`, PR 9): compare a
+    fresh artifact against the BEST prior round per config and emit a
+    regression verdict.
+
+    Rules, shaped by the repo's real artifact history (r01/r04
+    parsed=null, r03/r05 valid-null tunnel-outage artifacts, r02 the
+    one real TPU round):
+
+    * a prior that is null/unparseable is SKIPPED with a note — an
+      outage round must never poison the baseline nor mask a real
+      regression ("best prior" simply ignores it);
+    * priors from a DIFFERENT device class than the fresh artifact are
+      excluded (a CPU smoke vs a TPU round is not a regression, it is
+      a different machine);
+    * a config present in history but absent from the fresh artifact
+      is reported as unmeasured, not regressed (the partial-artifact
+      policy);
+    * regression = fresh < (1 - tolerance) x best prior for that
+      config. Exit 1 iff any judged config regressed (or the fresh
+      artifact itself is null); exit 0 with an explicit
+      "no usable prior rounds" verdict when history holds nothing
+      comparable — nothing to regress against is a truthful pass.
+    """
+    from pathlib import Path
+
+    fresh = load_line(run_path)
+    fresh_rates = _numeric_rates(fresh)
+    fresh_class = _device_class(fresh)
+    print(f"HISTORY: {run_path} (device class {fresh_class}, "
+          f"{len(fresh_rates)} rate key(s)) vs best prior per config, "
+          f"tolerance {tolerance:.0%}")
+    if not fresh_rates:
+        print(f"  fresh artifact is null ({fresh.get('error')})")
+        print("RESULT: PERF HISTORY UNJUDGEABLE — fresh artifact "
+              "carries no rates")
+        return 1
+
+    best: dict = {}          # key -> (value, source path)
+    skipped, excluded, used = [], [], []
+    run_resolved = Path(run_path).resolve()
+    for p in history_paths:
+        if Path(p).resolve() == run_resolved:
+            continue         # the fresh artifact is not its own prior
+        try:
+            prior = load_line(str(p))
+        except (OSError, ValueError) as e:
+            skipped.append(f"{p} (unreadable: {e})")
+            continue
+        rates = _numeric_rates(prior)
+        if not rates:
+            skipped.append(f"{p} (null: {prior.get('error') or 'no rates'})")
+            continue
+        cls = _device_class(prior)
+        if cls != fresh_class:
+            excluded.append(f"{p} (device class {cls})")
+            continue
+        used.append(str(p))
+        for k, v in rates.items():
+            if k not in best or v > best[k][0]:
+                best[k] = (v, str(p))
+    for s in skipped:
+        print(f"  [skip] {s}")
+    for s in excluded:
+        print(f"  [excluded] {s}")
+    if not best:
+        print(f"  0 usable prior rounds ({len(skipped)} null, "
+              f"{len(excluded)} other-device)")
+        print("RESULT: PERF NO-REGRESSION (no usable prior rounds — "
+              "nothing to regress against)")
+        return 0
+
+    regressions, improved, unmeasured = [], 0, []
+    for k in sorted(best):
+        prior_v, src = best[k]
+        cur = fresh_rates.get(k)
+        if cur is None:
+            unmeasured.append(k)
+            continue
+        delta = cur / prior_v - 1
+        regressed = cur < (1 - tolerance) * prior_v
+        tag = "FAIL" if regressed else "PASS"
+        print(f"  [{tag}] {k}: {cur:,.0f} vs best prior {prior_v:,.0f} "
+              f"({delta:+.1%}; best from {src})")
+        if regressed:
+            regressions.append(k)
+        elif delta > 0:
+            improved += 1
+    if unmeasured:
+        print(f"  [info] in history but unmeasured in this artifact "
+              f"(not failed): {', '.join(unmeasured)}")
+    new_keys = sorted(set(fresh_rates) - set(best))
+    if new_keys:
+        print(f"  [info] first measurement (no prior): "
+              f"{', '.join(new_keys)}")
+    print(f"  judged {len(best) - len(unmeasured)} config(s) against "
+          f"{len(used)} prior round(s); {improved} improved")
+    if regressions:
+        print(f"RESULT: PERF REGRESSION — {', '.join(regressions)} "
+              f"below (1 - {tolerance:.0%}) x best prior")
+        return 1
+    print("RESULT: PERF NO-REGRESSION")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("run")
     ap.add_argument("--ref", default="bench_results/r03_tpu_full1.json")
+    ap.add_argument(
+        "--history", nargs="*", default=None, metavar="ARTIFACT",
+        help="perf-trend gate: compare RUN against the best prior "
+             "round per config over these artifacts (default with no "
+             "values: BENCH_r*.json in the current directory); "
+             "null/outage rounds are tolerated, cross-device priors "
+             "excluded; exit 1 iff a judged config regressed")
+    ap.add_argument(
+        "--history-tolerance", type=float, default=0.15,
+        help="regression threshold: fail a config below "
+             "(1 - T) x its best prior (default 0.15 — tunnel-window "
+             "timing noise measured across rounds sits well inside it)")
     args = ap.parse_args()
+
+    if args.history is not None:
+        import glob
+
+        paths = args.history or sorted(glob.glob("BENCH_r*.json"))
+        return history_verdict(args.run, paths, args.history_tolerance)
 
     line = load_line(args.run)
     if "n_devices" in line:  # a MULTICHIP_r{N}.json dryrun artifact
@@ -422,6 +581,100 @@ def main() -> int:
               f"{(trc.get('stage_breakdown') or {}).get('complete_spans')}"
               f" complete spans — {brief}")
 
+    def judge_metrics(mx):
+        """Done-criteria of the metrics+sentinel leg (config13, PR 9):
+        the aggregate health surface (tracer + metrics registry +
+        numerics sentinel) costs <= 3% end-to-end (median paired
+        interleaved ratio), compiles nothing, the sentinel drill
+        DETECTS an injected wrong-output fault (incident + flight
+        capture, every future still resolved, clean baseline and
+        recovery on both sides), every span — requests and sentinel
+        probes — closes exactly once, and the per-tier SLO burn rates
+        are reported from the same snapshot the export serves."""
+        ratio = mx.get("metrics_overhead_ratio")
+        reqs = mx.get("requests")
+        msg = (f"observed {mx.get('observed_evals_per_sec')} vs bare "
+               f"{mx.get('bare_evals_per_sec')} evals/s (median paired "
+               f"ratio {ratio}, best-window {mx.get('ratio_best_window')}, "
+               f"trials {mx.get('ratio_trials')}; "
+               f"{mx.get('registry_metrics')} exported metrics, "
+               f"{mx.get('scrapes_per_pass')} scrape + "
+               f"{mx.get('probes_per_pass')} probe per pass of "
+               f"{mx.get('reps_per_pass')}x{reqs} requests)")
+        if reqs is not None and reqs >= 64:
+            check("metrics_overhead_3pct",
+                  ratio is not None and ratio <= 1.03, msg)
+        else:
+            # The 3% bound is defined at the leg's real sizes (the
+            # config12 noise precedent); a plumbing-size run records
+            # the numbers without judging them.
+            print(f"  [info] metrics (requests<64, overhead unjudged): "
+                  f"{msg}")
+        check("metrics_zero_recompiles",
+              mx.get("steady_recompiles") == 0,
+              f"{mx.get('steady_recompiles')} steady recompiles with "
+              "the registry scraped and the sentinel probing (probes "
+              "touch only already-live program families)")
+        drill = mx.get("sentinel_drill") or {}
+        detected = (drill.get("detected")
+                    and not drill.get("clean_probe_drift")
+                    and drill.get("recovered")
+                    and drill.get("futures_resolved_fraction") == 1.0
+                    and (drill.get("incidents") or 0) >= 1
+                    and "numerics_drift"
+                    in (drill.get("flight_capture_reasons") or []))
+        check("metrics_sentinel_detects_wrong_output", detected,
+              f"injected wrong-output fault: detected="
+              f"{drill.get('detected')} (families "
+              f"{drill.get('drifted_families')}, max err "
+              f"{drill.get('drift_max_abs_err')}), clean baseline "
+              f"drift={drill.get('clean_probe_drift')}, CPU tier clean="
+              f"{drill.get('cpu_family_clean')}, recovered="
+              f"{drill.get('recovered')}, "
+              f"{drill.get('futures_resolved_fraction')} of "
+              f"{drill.get('submitted')} futures resolved, incidents "
+              f"{drill.get('incidents')}, flight captures "
+              f"{drill.get('flight_capture_reasons')}")
+        def _balanced(acc):
+            return (acc.get("spans_started") is not None
+                    and acc.get("spans_started") == acc.get("spans_closed")
+                    and acc.get("spans_open") == 0)
+
+        acc = mx.get("span_accounting") or {}
+        dacc = drill.get("span_accounting") or {}
+        balanced = _balanced(acc) and _balanced(dacc)
+        check("metrics_spans_closed_once", balanced,
+              f"leg {acc.get('spans_closed')}/{acc.get('spans_started')}"
+              f" closed ({acc.get('spans_open')} open, by kind "
+              f"{acc.get('closed_by_kind')}); drill "
+              f"{dacc.get('spans_closed')}/{dacc.get('spans_started')} "
+              f"closed ({dacc.get('spans_open')} open, by kind "
+              f"{dacc.get('closed_by_kind')}) — sentinel probe spans "
+              "included")
+        golden = (mx.get("golden") or {}).get("golden_status") \
+            or (mx.get("sentinel") or {}).get("golden_status")
+        check("metrics_golden_anchor", golden in ("match", "absent"),
+              f"committed-goldens check: {golden} (match = this "
+              "environment reproduces the committed digests; absent = "
+              "no golden committed for this (params, backend) — only "
+              "a mismatch, i.e. silent environment numerics drift, "
+              "fails)")
+        slo = mx.get("slo") or {}
+        tier0 = (slo.get("tiers") or {}).get("0") or {}
+        check("metrics_slo_reported",
+              bool(tier0.get("burn_rates")),
+              f"tier-0 SLO: goodput {tier0.get('goodput')} "
+              f"(burn {(tier0.get('burn_rates') or {}).get('goodput')}),"
+              f" deadline hit {tier0.get('deadline_hit_rate')}, shed "
+              f"fraction {tier0.get('shed_fraction')}, ok="
+              f"{tier0.get('ok')}")
+        print(f"  [info] metrics: sentinel "
+              f"{(mx.get('sentinel') or {}).get('probes')} probes "
+              f"({mx.get('sentinel_background_probes')} background), "
+              f"{(mx.get('sentinel') or {}).get('drifts')} drifts on "
+              f"the clean engine, registry errors "
+              f"{mx.get('registry_errors')}")
+
     def judge_specialization(spec):
         """Done-criteria of the shape-specialization leg (config8):
         pose-only forward >= 1.15x the full forward, frozen-betas LM
@@ -510,6 +763,16 @@ def main() -> int:
                             else f"failing: {', '.join(bad)}"))
         return 0 if not bad else 1
 
+    if "metrics_overhead_ratio" in line and "metric" not in line:
+        # A raw metrics_overhead_run artifact (no bench.py envelope):
+        # only the config13 criteria apply — same pattern as the raw
+        # drill artifacts above.
+        judge_metrics(line)
+        bad = [n for n, ok in checks if not ok]
+        print("RESULT: " + ("METRICS CRITERIA PASS" if not bad
+                            else f"failing: {', '.join(bad)}"))
+        return 0 if not bad else 1
+
     if "engine_vs_split_ratio" in line and "metric" not in line:
         # A raw `serve-bench --subjects` artifact (coalesce_bench_run's
         # own JSON line, no bench.py envelope): only the coalescing
@@ -559,6 +822,13 @@ def main() -> int:
             check("tracing_leg_ran", False,
                   f"config12_tracing crashed: "
                   f"{line['config_errors']['config12_tracing']}")
+        mx = detail.get("metrics")
+        if mx:
+            judge_metrics(mx)
+        elif "config13_metrics" in (line.get("config_errors") or {}):
+            check("metrics_leg_ran", False,
+                  f"config13_metrics crashed: "
+                  f"{line['config_errors']['config13_metrics']}")
         bad = [n for n, ok in checks if not ok]
         print("RESULT: " + ("SERVING CRITERIA PASS" if not bad
                             else f"failing: {', '.join(bad)}"))
@@ -652,6 +922,16 @@ def main() -> int:
         check("tracing_leg_ran", False,
               f"config12_tracing crashed: "
               f"{line['config_errors']['config12_tracing']}")
+
+    mx = detail.get("metrics")
+    if mx:
+        # Metrics+sentinel leg (config13, PR 9) — same presence rule:
+        # judge it wherever it ran (every criterion is CPU-defined).
+        judge_metrics(mx)
+    elif "config13_metrics" in (line.get("config_errors") or {}):
+        check("metrics_leg_ran", False,
+              f"config13_metrics crashed: "
+              f"{line['config_errors']['config13_metrics']}")
 
     spec = detail.get("specialization")
     cfg_errs = line.get("config_errors") or {}

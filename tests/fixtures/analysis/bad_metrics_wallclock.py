@@ -1,0 +1,28 @@
+"""Fixture: a PR-9-shaped metrics/sentinel module violating
+`wallclock-deadline` (parsed by tests, never imported) — the exact
+drift this PR's satellite guards against: observability code computing
+probe/scrape deadlines from wall clock instead of time.monotonic()."""
+import time
+
+
+class BadSentinelLoop:
+    def __init__(self, interval_s: float):
+        self.interval_s = interval_s
+        self.last_probe = 0.0
+
+    def probe_due(self) -> bool:
+        next_probe_deadline = time.time() + self.interval_s  # line 14
+        return time.time() >= next_probe_deadline            # line 15
+
+    def fine_due(self) -> bool:
+        # The monotonic form the real obs/sentinel.py uses.
+        deadline = time.monotonic() + self.interval_s
+        return time.monotonic() >= deadline
+
+
+def scrape_age_fine(path: str) -> float:
+    import os
+
+    # Cross-process mtime comparison of a persisted metrics.json:
+    # wall clock is CORRECT here (the devicelock claim-age pattern).
+    return time.time() - os.stat(path).st_mtime

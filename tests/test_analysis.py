@@ -119,12 +119,31 @@ def test_pragma_silences_on_same_and_previous_line():
     assert any("bare-devices" in v for v in allowed.values())
 
 
+def test_wallclock_rule_fires_on_metrics_shaped_fixture():
+    """Satellite (PR 9): the wallclock/monotonic policy rule covers
+    the new obs/metrics.py + obs/sentinel.py shape of code — a probe/
+    scrape deadline computed from time.time() fires, the monotonic
+    form and the cross-process mtime comparison stay clean."""
+    findings, _ = _lint_fixture("bad_metrics_wallclock.py")
+    assert _rules(findings) == ["wallclock-deadline"]
+    assert sorted(f.line for f in findings) == [14, 15]
+
+
 def test_policy_scope_is_clean_on_head():
     # The acceptance criterion: `mano analyze` policy section passes on
     # HEAD — every real violation was fixed or pragma-audited.
     paths = default_policy_paths(REPO_ROOT)
     assert any(p.name == "bench.py" for p in paths)
     assert any(p.name == "engine.py" for p in paths)
+    # PR 9: the new observability modules are IN scope (the rglob
+    # covers mano_hand_tpu/** — pinned so a future scope refactor
+    # cannot silently drop them) …
+    assert any(p.name == "metrics.py" and "obs" in p.parts
+               for p in paths)
+    assert any(p.name == "sentinel.py" and "obs" in p.parts
+               for p in paths)
+    # … and clean: every stamp in obs/metrics.py + obs/sentinel.py is
+    # time.monotonic() (wall clock only as export labels).
     assert lint_paths(paths, root=REPO_ROOT) == []
 
 

@@ -241,6 +241,14 @@ def test_bench_cpu_tiny_run_end_to_end():
         "--coldstart-requests", "8", "--coldstart-subjects", "3",
         "--coldstart-max-bucket", "4", "--coldstart-waves", "2",
         "--tracing-requests", "24",
+        # config13 (PR 9) is SKIPPED here, not shrunk: its sentinel
+        # drill fixes its own engine sizes (cold compiles in this
+        # test's fresh per-run bench cache) and the tier-1 lane has no
+        # budget for them — the leg's plumbing runs in `make
+        # bench-interpret` (--metrics-requests 48) and its e2e in
+        # `make metrics-smoke`; criteria-sized numbers live in `make
+        # serve-smoke` (the test_coldstart budget precedent).
+        "--metrics-requests", "0",
     )
     assert rc == 0, line
     assert line["value"] is not None and line["value"] > 0

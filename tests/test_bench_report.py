@@ -12,6 +12,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 ROOT = Path(__file__).parent.parent
 
 
@@ -566,3 +568,229 @@ def test_tracing_metrics_block(tmp_path):
     p = _run(str(art))
     assert p.returncode == 1
     assert "[FAIL] tracing_leg_ran" in p.stdout
+
+
+def _metrics_artifact(**over):
+    """A passing raw config13 (metrics_overhead_run) artifact;
+    override keys to break specific criteria."""
+    acc = {"spans_started": 100, "spans_closed": 100, "spans_open": 0,
+           "spans_double_closed": 0, "closed_by_kind": {"ok": 97,
+                                                        "probe": 3},
+           "events_total": 500, "events_dropped": 0, "ring_len": 500,
+           "ring_capacity": 8192, "incidents": 0}
+    dacc = dict(acc, spans_started=30, spans_closed=30,
+                closed_by_kind={"ok": 24, "probe": 5, "drift": 1},
+                incidents=1)
+    art = {
+        "requests": 160, "trials": 11, "reps_per_pass": 3,
+        "scrapes_per_pass": 1, "probes_per_pass": 1,
+        "observed_evals_per_sec": 14000.0,
+        "bare_evals_per_sec": 14100.0,
+        "metrics_overhead_ratio": 1.006, "ratio_best_window": 0.99,
+        "ratio_trials": [1.0, 1.01, 1.006],
+        "steady_recompiles": 0,
+        "span_accounting": acc,
+        "registry_metrics": 53, "registry_errors": None,
+        "sentinel": {"probes": 14, "drifts": 0, "probe_errors": 0,
+                     "golden_status": "match", "armed": False},
+        "sentinel_background_probes": 1,
+        "golden": {"golden_status": "match"},
+        "slo": {"schema": 1, "ok": True, "tiers": {"0": {
+            "submitted": 480, "served": 480, "shed": 0, "expired": 0,
+            "goodput": 1.0, "deadline_hit_rate": 1.0,
+            "shed_fraction": 0.0,
+            "burn_rates": {"goodput": 0.0, "deadline_hit": 0.0,
+                           "shed": 0.0}, "ok": True}}},
+        "sentinel_drill": {
+            "submitted": 24, "futures_resolved_fraction": 1.0,
+            "clean_probe_drift": False, "detected": True,
+            "drifted_families": ["full"], "drift_max_abs_err": 1.0,
+            "cpu_family_clean": True, "recovered": True,
+            "incidents": 1,
+            "flight_capture_reasons": ["numerics_drift"],
+            "faults_injected": 6, "steady_recompiles": 0,
+            "span_accounting": dacc},
+    }
+    art.update(over)
+    return art
+
+
+@pytest.mark.slow
+def test_metrics_block_passes_and_each_criterion_fails(tmp_path):
+    """The config13 judge (PR 9): a raw metrics artifact passes whole,
+    and each criterion fails alone — overhead bound, zero recompiles,
+    sentinel detection (incident + flight capture + recovery + every
+    future resolved), span accounting incl. the drill's probe spans,
+    the committed-golden anchor, and the SLO block."""
+    art = tmp_path / "mx.json"
+    art.write_text(json.dumps(_metrics_artifact()))
+    p = _run(str(art))
+    assert p.returncode == 0, p.stdout
+    assert "METRICS CRITERIA PASS" in p.stdout
+    assert "[PASS] metrics_overhead_3pct" in p.stdout
+    assert "[PASS] metrics_sentinel_detects_wrong_output" in p.stdout
+    assert "[PASS] metrics_golden_anchor" in p.stdout
+    assert "[PASS] metrics_slo_reported" in p.stdout
+
+    cases = {
+        "metrics_overhead_3pct": {"metrics_overhead_ratio": 1.08},
+        "metrics_zero_recompiles": {"steady_recompiles": 2},
+        "metrics_golden_anchor": {
+            "golden": {"golden_status": "mismatch"},
+            "sentinel": {"golden_status": "mismatch"}},
+        "metrics_slo_reported": {"slo": {"tiers": {}}},
+    }
+    for crit, over in cases.items():
+        art.write_text(json.dumps(_metrics_artifact(**over)))
+        p = _run(str(art))
+        assert p.returncode == 1, f"{crit}: {p.stdout}"
+        assert f"[FAIL] {crit}" in p.stdout
+
+    # Sentinel drill failure modes: undetected fault, a fault that was
+    # "detected" while the clean baseline also drifted (a broken
+    # comparator, not a detector), missing incident capture, stranded
+    # futures.
+    base = _metrics_artifact()
+    for over in (
+            {"detected": False},
+            {"clean_probe_drift": True},
+            {"flight_capture_reasons": []},
+            {"futures_resolved_fraction": 0.9},
+            {"incidents": 0}):
+        d = dict(base["sentinel_drill"], **over)
+        art.write_text(json.dumps(_metrics_artifact(sentinel_drill=d)))
+        p = _run(str(art))
+        assert p.returncode == 1, f"{over}: {p.stdout}"
+        assert "[FAIL] metrics_sentinel_detects_wrong_output" in p.stdout
+
+    # An unclosed sentinel probe span in the DRILL accounting fails
+    # the span criterion even when the request side is balanced.
+    d = dict(base["sentinel_drill"])
+    d["span_accounting"] = dict(d["span_accounting"],
+                                spans_closed=29, spans_open=1)
+    art.write_text(json.dumps(_metrics_artifact(sentinel_drill=d)))
+    p = _run(str(art))
+    assert p.returncode == 1
+    assert "[FAIL] metrics_spans_closed_once" in p.stdout
+
+    # Plumbing sizes record without judging the overhead bound (the
+    # config12 precedent); everything else still applies.
+    art.write_text(json.dumps(_metrics_artifact(
+        requests=48, metrics_overhead_ratio=1.5)))
+    p = _run(str(art))
+    assert p.returncode == 0, p.stdout
+    assert "overhead unjudged" in p.stdout
+
+
+@pytest.mark.slow
+def test_metrics_block_inside_serving_envelope(tmp_path):
+    """config13 rides the serving-only envelope like every other leg;
+    a crashed leg fails loudly instead of vanishing."""
+    env = {"metric": "serving_engine_evals_per_sec", "value": 1.0,
+           "unit": "evals/s", "device": "cpu",
+           "detail": {"serving": {"engine_vs_direct_ratio": 1.0,
+                                  "steady_recompiles": 0},
+                      "metrics": _metrics_artifact()}}
+    art = tmp_path / "env.json"
+    art.write_text(json.dumps(env))
+    p = _run(str(art))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] metrics_sentinel_detects_wrong_output" in p.stdout
+
+    env["detail"].pop("metrics")
+    env["config_errors"] = {"config13_metrics": "ValueError: boom"}
+    art.write_text(json.dumps(env))
+    p = _run(str(art))
+    assert p.returncode == 1
+    assert "[FAIL] metrics_leg_ran" in p.stdout
+
+
+# ------------------------------------------- --history (PR 9 tentpole)
+def test_history_on_committed_rounds_tolerates_nulls():
+    """The acceptance case: judged over the verbatim committed
+    BENCH_r01–r05 artifacts — three tunnel-outage nulls and one
+    parsed=null wrapper are SKIPPED with notes, r02 (the only real
+    round) judged against no usable prior is a truthful
+    no-regression."""
+    p = _run("BENCH_r02.json", "--history", "BENCH_r01.json",
+             "BENCH_r03.json", "BENCH_r04.json", "BENCH_r05.json")
+    assert p.returncode == 0, p.stdout
+    assert p.stdout.count("[skip]") == 4
+    assert "no usable prior rounds" in p.stdout
+    assert "PERF NO-REGRESSION" in p.stdout
+
+
+@pytest.mark.slow
+def test_history_null_fresh_artifact_is_unjudgeable():
+    p = _run("BENCH_r05.json", "--history", "BENCH_r02.json")
+    assert p.returncode == 1
+    assert "UNJUDGEABLE" in p.stdout
+
+
+@pytest.mark.slow
+def test_history_detects_regression_and_improvement(tmp_path):
+    """A fresh artifact regressed on one config against the best prior
+    fails by name; equal-or-better configs pass; a config present in
+    history but unmeasured now is informational, not failed."""
+    prior = {"metric": "mano_forward_evals_per_sec", "value": 10e6,
+             "device": "tpu:TPU v5 lite",
+             "detail": {"config2_b1024_evals_per_sec": 5e6,
+                        "config4_lm_steps_per_sec": 100.0,
+                        "serving": {"engine_evals_per_sec": 2e6}}}
+    older = {"metric": "mano_forward_evals_per_sec", "value": 8e6,
+             "device": "tpu:TPU v5 lite",
+             "detail": {"config2_b1024_evals_per_sec": 6e6}}
+    fresh = {"metric": "mano_forward_evals_per_sec", "value": 11e6,
+             "device": "tpu:TPU v5 lite",
+             "detail": {"config2_b1024_evals_per_sec": 4e6,
+                        "serving": {"engine_evals_per_sec": 2.1e6}}}
+    pp, op, fp = (tmp_path / "prior.json", tmp_path / "older.json",
+                  tmp_path / "fresh.json")
+    pp.write_text(json.dumps(prior))
+    op.write_text(json.dumps(older))
+    fp.write_text(json.dumps(fresh))
+    p = _run(str(fp), "--history", str(pp), str(op))
+    assert p.returncode == 1, p.stdout
+    # best prior for config2 is 6e6 (the older round); 4e6 is a -33%
+    # regression. headline (keyed by the artifact's own metric name —
+    # different protocols' headlines must never compare as one config)
+    # improved; the serving nested key passed; the LM config is
+    # unmeasured, not failed.
+    assert "[FAIL] config2_b1024_evals_per_sec" in p.stdout
+    assert "[PASS] mano_forward_evals_per_sec" in p.stdout
+    assert "[PASS] serving.engine_evals_per_sec" in p.stdout
+    assert "unmeasured in this artifact" in p.stdout
+    assert "config4_lm_steps_per_sec" in p.stdout
+    assert "PERF REGRESSION" in p.stdout
+    # Within tolerance passes: the same artifacts at a looser bound.
+    p = _run(str(fp), "--history", str(pp), str(op),
+             "--history-tolerance", "0.5")
+    assert p.returncode == 0
+    assert "PERF NO-REGRESSION" in p.stdout
+
+
+@pytest.mark.slow
+def test_history_excludes_cross_device_priors(tmp_path):
+    """A CPU-lane fresh artifact judged against a TPU round is a
+    different machine, not a regression — excluded, and with no
+    same-class prior left the verdict is an explicit no-baseline
+    pass."""
+    fresh = {"metric": "mano_forward_evals_per_sec", "value": 3e4,
+             "device": "cpu:cpu",
+             "detail": {"config2_b1024_evals_per_sec": 3e4}}
+    fp = tmp_path / "fresh_cpu.json"
+    fp.write_text(json.dumps(fresh))
+    p = _run(str(fp), "--history", "BENCH_r02.json")
+    assert p.returncode == 0, p.stdout
+    assert "[excluded]" in p.stdout and "device class tpu" in p.stdout
+    assert "no usable prior rounds" in p.stdout
+
+
+@pytest.mark.slow
+def test_history_excludes_the_run_itself():
+    """r02 judged with itself in the history list: the fresh artifact
+    is never its own prior (self-comparison would mask any
+    regression by construction)."""
+    p = _run("BENCH_r02.json", "--history", "BENCH_r02.json")
+    assert p.returncode == 0, p.stdout
+    assert "no usable prior rounds" in p.stdout

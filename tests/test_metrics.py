@@ -1,0 +1,480 @@
+"""Metrics & SLO layer + numerics sentinel (obs/metrics.py,
+obs/sentinel.py — the PR-9 tentpole).
+
+The invariants under test:
+
+* **Atomic snapshots.** The registry's export never publishes a torn
+  view of any single source (the PR-5 torn-telemetry rule extended to
+  the registry): the serving collector derives every serving metric —
+  and the SLO report — from ONE ``ServingCounters.snapshot()`` call,
+  so the exported ratios always agree with the exported integers even
+  under concurrent submit/resolve traffic.
+* **Counter-drift guard.** Every ``ServingCounters`` field reaches
+  both ``snapshot()`` and the metrics export; an unclassifiable key is
+  surfaced as a non-zero ``serving_unexported_keys`` gauge, never
+  silently dropped.
+* **The sentinel sees what supervision cannot.** A chaos
+  ``wrong``-output fault resolves every future "successfully" with
+  corrupt floats; the sentinel's next probe must flag exactly the
+  wrapped family, raise ONE ``numerics_drift`` incident (flight
+  recorder captures it), close its probe span exactly once — including
+  when the probe itself raises — and report recovery once the fault
+  clears.
+
+Lane placement: quick-marked (the seconds-scale `make check-quick`
+pre-commit lane) AND slow-marked — the timeout-bound tier-1
+``-m 'not slow'`` lane is budget-limited (the PR-8 precedent), so the
+canonical runner is `make metrics-smoke` (wired into `make check`,
+own compile-cache dir).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mano_hand_tpu.obs import (
+    FlightRecorder,
+    MetricsRegistry,
+    NumericsSentinel,
+    Tracer,
+    engine_registry,
+    prometheus_text,
+    slo_report,
+)
+from mano_hand_tpu.obs.metrics import (
+    load_samples,
+    metric,
+    sample,
+    serving_samples,
+    slo_samples,
+    tracer_samples,
+)
+from mano_hand_tpu.obs.sentinel import (
+    commit_goldens,
+    f32_digest,
+    golden_inputs,
+    load_goldens,
+)
+from mano_hand_tpu.runtime.chaos import ChaosPlan
+from mano_hand_tpu.runtime.supervise import DispatchPolicy
+from mano_hand_tpu.serving.engine import ServingEngine
+from mano_hand_tpu.utils.profiling import ServingCounters
+
+pytestmark = [pytest.mark.quick, pytest.mark.slow]
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+def _pose(n=1, seed=0):
+    return np.random.default_rng(seed).normal(
+        scale=0.4, size=(n, 16, 3)).astype(np.float32)
+
+
+# --------------------------------------------------------------- instruments
+def test_instruments_and_type_conflicts():
+    reg = MetricsRegistry()
+    c = reg.counter("requests", help="total requests")
+    c.inc()
+    c.inc(3)
+    assert c.value == 4
+    with pytest.raises(ValueError):
+        c.inc(-1)                      # counters are monotone
+    g = reg.gauge("backlog")
+    g.set(7)
+    g.inc(-2)
+    assert g.value == 5
+    q = reg.quantile("latency_ms", capacity=8)
+    for v in range(100):
+        q.observe(float(v))            # ring-bounded, never grows
+    assert len(q._samples_buf) == 8
+    # Re-registering the same name/type returns the SAME instrument;
+    # a different type is a programming error, not a silent shadow.
+    assert reg.counter("requests") is c
+    with pytest.raises(ValueError):
+        reg.gauge("requests")
+    with pytest.raises(ValueError):
+        reg.counter("bad name!")
+    snap = reg.snapshot()
+    assert snap["metrics"]["requests"]["samples"] == [[None, 4.0]]
+    kinds = {n: m["type"] for n, m in snap["metrics"].items()}
+    assert kinds == {"requests": "counter", "backlog": "gauge",
+                     "latency_ms": "quantile"}
+
+
+def test_collector_failure_degrades_not_raises():
+    reg = MetricsRegistry()
+    reg.counter("ok_metric").inc()
+    reg.register_collector("broken", lambda: 1 / 0)
+    snap = reg.snapshot()              # must not raise
+    assert "ok_metric" in snap["metrics"]
+    assert "ZeroDivisionError" in snap["errors"]["broken"]
+
+
+def test_prometheus_text_renders_and_reloads():
+    """The text exposition is a pure function of the snapshot: a
+    JSON-round-tripped snapshot (the `serve-bench --metrics` file
+    `mano status --prom` re-reads) renders byte-identically."""
+    reg = MetricsRegistry()
+    reg.counter("events", help="with \"quotes\" and\nnewline").inc(2)
+    reg.register_collector("labeled", lambda: {
+        "by_tier": metric("counter", samples=[
+            sample(3, {"tier": "0"}), sample(1, {"tier": "1"})])})
+    snap = reg.snapshot()
+    text = prometheus_text(snap)
+    assert "# TYPE mano_events counter" in text
+    assert "mano_events 2.0" in text
+    assert 'mano_by_tier{tier="0"} 3.0' in text
+    assert "# HELP mano_events" in text and "\nnewline" not in text
+    rendered = prometheus_text(json.loads(json.dumps(snap)))
+    assert rendered == text
+
+
+# --------------------------------------- torn-telemetry, registry edition
+def test_registry_snapshot_atomic_under_concurrent_submit_resolve():
+    """The PR-5 torn-telemetry class extended to the registry: the
+    serving collector's export derives from ONE counters snapshot, so
+    the exported derived values always agree with the exported
+    integers while writer threads hammer the counters (simulated
+    concurrent submit/resolve traffic)."""
+    c = ServingCounters()
+    reg = MetricsRegistry()
+
+    def collect():
+        snap = c.snapshot()            # the one lock-held copy
+        out = serving_samples(snap)
+        out.update(slo_samples(slo_report(snap)))
+        return out
+
+    reg.register_collector("serving", collect)
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            c.count_dispatch(8, 3, requests=2)
+            c.count_tier_submit(0)
+            c.count_served(0)
+            c.count_shed(1)
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for th in threads:
+        th.start()
+    try:
+        def val(snap, name):
+            return snap["metrics"][name]["samples"][0][1]
+
+        for _ in range(100):
+            snap = reg.snapshot()
+            assert not snap.get("errors")
+            d = val(snap, "serving_dispatches")
+            assert val(snap, "serving_requests_dispatched") == 2 * d
+            assert val(snap, "serving_rows_live") == 3 * d
+            assert val(snap, "serving_rows_padded") == 5 * d
+            assert val(snap, "serving_coalesce_width_mean") == \
+                (2.0 if d else 0.0)
+            assert val(snap, "serving_unexported_keys") == 0
+            # The SLO block rides the SAME snapshot: tier-0 goodput
+            # must be exactly served/submitted of the integers beside
+            # it (a second snapshot() call here would tear them).
+            tier0 = {tuple(sorted((s[0] or {}).items())): s[1]
+                     for s in snap["metrics"]["serving_tier_submitted"]
+                     ["samples"]}
+            sub0 = tier0[(("tier", "0"),)]
+            served = {tuple(sorted((s[0] or {}).items())): s[1]
+                      for s in snap["metrics"]["serving_tier_served"]
+                      ["samples"]}[(("tier", "0"),)]
+            good = [s[1] for s in
+                    snap["metrics"]["slo_goodput"]["samples"]
+                    if (s[0] or {}).get("tier") == "0"][0]
+            assert good == round(served / sub0 if sub0 else 1.0, 6)
+    finally:
+        stop.set()
+        for th in threads:
+            th.join()
+
+
+# ------------------------------------------------------ counter-drift guard
+def test_counter_drift_guard_every_field_exported():
+    """Satellite: every ``ServingCounters`` field must appear in BOTH
+    ``snapshot()`` and the metrics export — a new counter can no
+    longer silently skip telemetry. Introspected, not enumerated, so
+    this test fails the moment a field is added without export."""
+    c = ServingCounters()
+    c.count_dispatch(8, 3)
+    c.count_tier_submit(0)
+    c.record_latency(8, 0.01)
+    snap = c.snapshot()
+    public = {k for k, v in vars(c).items() if not k.startswith("_")}
+    # Every public attribute reaches snapshot() (the per-tier dicts
+    # fold into the "tiers" block, the reservoirs into
+    # latency_by_bucket).
+    folded = {"tier_submitted": "tiers", "tier_served": "tiers",
+              "tier_shed": "tiers", "tier_expired": "tiers"}
+    for field in public:
+        assert folded.get(field, field) in snap, \
+            f"ServingCounters.{field} missing from snapshot()"
+    # Every snapshot key reaches the export (scalars as
+    # serving_<key>, the structured blocks as their labeled forms).
+    out = serving_samples(snap)
+    for key in snap:
+        if key == "tiers":
+            assert "serving_tier_submitted" in out
+        elif key == "latency_by_bucket":
+            assert "serving_latency_p50_ms" in out
+        else:
+            assert f"serving_{key}" in out, \
+                f"snapshot key {key} missing from the metrics export"
+    assert out["serving_unexported_keys"]["samples"][0][1] == 0
+
+
+def test_counter_drift_guard_flags_unclassifiable_key():
+    """The failure mode the guard exists for: a snapshot key of a
+    shape the mapper does not understand is COUNTED, not dropped."""
+    out = serving_samples({"compiles": 1, "mystery": {"nested": True}})
+    assert out["serving_unexported_keys"]["samples"][0][1] == 1
+
+
+# ------------------------------------------------------------------ SLO math
+def test_slo_burn_rates():
+    snap = {"tiers": {
+        "0": {"submitted": 1000, "served": 980, "shed": 0,
+              "expired": 20},
+        "1": {"submitted": 100, "served": 60, "shed": 40,
+              "expired": 0},
+    }}
+    rep = slo_report(snap)
+    t0 = rep["tiers"]["0"]
+    # goodput 0.98 vs target 0.99: burn = 0.02 / 0.01 = 2.0
+    assert t0["goodput"] == 0.98
+    assert t0["burn_rates"]["goodput"] == pytest.approx(2.0)
+    # deadline hit 980/1000 = 0.98 vs 0.999: burn = 0.02 / 0.001 = 20
+    assert t0["burn_rates"]["deadline_hit"] == pytest.approx(20.0)
+    assert not t0["ok"] and not rep["ok"]
+    t1 = rep["tiers"]["1"]       # batch tier: shedding IS the design
+    assert t1["shed_fraction"] == 0.4
+    assert t1["burn_rates"]["shed"] == pytest.approx(0.4 / 0.75,
+                                                     abs=1e-4)
+    assert t1["ok"]
+    # A perfect tier burns nothing.
+    perfect = slo_report({"tiers": {"0": {
+        "submitted": 10, "served": 10, "shed": 0, "expired": 0}}})
+    assert perfect["ok"]
+    assert perfect["tiers"]["0"]["burn_rates"] == {
+        "goodput": 0.0, "deadline_hit": 0.0, "shed": 0.0}
+
+
+# ----------------------------------------------------------- engine wiring
+def test_engine_registry_absorbs_counters_load_tracer(params32):
+    tr = Tracer()
+    eng = ServingEngine(params32, max_bucket=8, max_queued=16,
+                        tracer=tr)
+    reg = engine_registry(eng, tracer=tr)
+    with eng:
+        eng.warmup([1, 8])
+        eng.forward(_pose(2))
+        snap = reg.snapshot()
+    m = snap["metrics"]
+    assert not snap.get("errors")
+    assert m["serving_dispatches"]["samples"][0][1] >= 1
+    assert m["load_outstanding"]["samples"][0][1] == 0
+    admission = {(s[0] or {}).get("tier"): s[1]
+                 for s in m["load_admission_state"]["samples"]}
+    assert admission["0"] == 0          # ok
+    assert m["trace_spans_started"]["samples"][0][1] == 1
+    assert m["trace_spans_closed"]["samples"][0][1] == 1
+    assert "slo_goodput" in m
+    text = prometheus_text(snap)
+    assert "mano_serving_compiles" in text
+    assert tracer_samples(tr.accounting())["trace_spans_open"][
+        "samples"][0][1] == 0
+    assert load_samples(eng.load())["load_queued"]["samples"][0][1] == 0
+
+
+# ---------------------------------------------------------------- sentinel
+def test_sentinel_clean_probe_all_families(params32, tmp_path):
+    """A clean engine probes clean on every LIVE family — full, the
+    CPU-failover tier, and the gathered pose-only path — through the
+    engine's own cached executables, with zero engine compiles caused
+    by the probe itself."""
+    tr = Tracer()
+    eng = ServingEngine(params32, max_bucket=8,
+                        policy=DispatchPolicy(deadline_s=30.0),
+                        tracer=tr)
+    s = NumericsSentinel(eng, tracer=tr, goldens_path=tmp_path / "g.json")
+    with eng:
+        eng.warmup([1, 8])               # primary + CPU-failover tier
+        subj = eng.specialize(np.zeros(10, np.float32))
+        eng.forward(_pose(2)[0], subject=subj)   # gather exe goes live
+        compiles = eng.counters.compiles
+        res = s.probe()
+        assert eng.counters.compiles == compiles   # probe compiles nothing
+    assert not res["drift"]
+    assert set(res["families"]) == {"full", "cpu", "gather"}
+    for fam, rec in res["families"].items():
+        assert rec["served_digest"] == rec["want_digest"], fam
+        assert rec["max_abs_err"] == 0.0, fam
+    acc = tr.accounting()
+    assert acc["spans_started"] == acc["spans_closed"]
+    assert acc["closed_by_kind"]["probe"] == 1
+
+
+def test_sentinel_detects_wrong_output_and_recovers(params32, tmp_path):
+    """The drill in miniature: a chaos ``wrong`` fault corrupts served
+    floats with every future still resolving ok — only the sentinel
+    sees it: exactly the wrapped family drifts, ONE numerics_drift
+    incident fires (flight recorder captures it), the un-wrapped CPU
+    tier probes clean, and a probe after the fault clears reports
+    recovery."""
+    plan = ChaosPlan()
+    tr = Tracer()
+    rec = FlightRecorder(tr)
+    eng = ServingEngine(params32, min_bucket=8, max_bucket=8,
+                        policy=DispatchPolicy(deadline_s=30.0,
+                                              retries=0, chaos=plan),
+                        tracer=tr)
+    s = NumericsSentinel(eng, tracer=tr,
+                         goldens_path=tmp_path / "g.json")
+    with eng:
+        eng.warmup()
+        assert not s.probe()["drift"]
+        plan.schedule("wrong:1.0@0-")
+        fut = eng.submit(_pose(2))
+        out = fut.result()               # resolves — silently corrupt
+        assert np.isfinite(out).all()
+        det = s.probe()
+        assert det["drift"]
+        assert det["drifted_families"] == ["full"]
+        assert not det["families"]["cpu"]["drift"]
+        assert det["families"]["full"]["max_abs_err"] == \
+            pytest.approx(1.0)
+        plan.clear()
+        assert not s.probe()["drift"]    # recovery
+    assert s.status()["drifts"] == 1
+    assert tr.accounting()["incidents"] == 1
+    assert [c["reason"] for c in rec.captures] == ["numerics_drift"]
+
+
+def test_sentinel_probe_span_closes_exactly_once_on_error(params32):
+    """Satellite: the probe's span closes EXACTLY once even when the
+    probe itself blows up mid-flight — the engine's span-accounting
+    guarantee extended to the sentinel."""
+    tr = Tracer()
+    eng = ServingEngine(params32, max_bucket=8, tracer=tr)
+    s = NumericsSentinel(eng, tracer=tr)
+
+    def boom():
+        raise RuntimeError("probe transport died")
+
+    eng.numerics_probe_targets = boom
+    res = s.probe()                      # must not raise
+    assert "probe_error" in res["families"]
+    assert s.status()["probe_errors"] == 1
+    acc = tr.accounting()
+    assert acc["spans_started"] == 1
+    assert acc["spans_closed"] == 1
+    assert acc["spans_open"] == 0
+    assert acc["spans_double_closed"] == 0
+    assert acc["closed_by_kind"] == {"error": 1}
+
+
+def test_sentinel_golden_commit_match_and_mismatch(params32, tmp_path):
+    gpath = tmp_path / "goldens.json"
+    commit_goldens(params32, gpath)
+    data = load_goldens(gpath)
+    assert data is not None and len(data["entries"]) == 1
+    tr = Tracer()
+    eng = ServingEngine(params32, max_bucket=8, tracer=tr)
+    with eng:
+        eng.warmup([1])
+        s = NumericsSentinel(eng, tracer=tr, goldens_path=gpath)
+        assert s.arm()["golden_status"] == "match"
+        # Corrupt the committed digest: arm must flag ENVIRONMENT
+        # drift (incident), distinct from a serving-path drift.
+        key = next(iter(data["entries"]))
+        data["entries"][key]["full"] = "deadbeefdeadbeef"
+        gpath.write_text(json.dumps(data))
+        s2 = NumericsSentinel(eng, tracer=tr, goldens_path=gpath)
+        assert s2.arm()["golden_status"] == "mismatch"
+        # No golden for this (params, backend): absent, never a fail.
+        s3 = NumericsSentinel(eng, tracer=tr,
+                              goldens_path=tmp_path / "none.json")
+        assert s3.arm()["golden_status"] == "absent"
+    assert tr.accounting()["incidents"] == 1   # the mismatch only
+
+
+def test_committed_goldens_match_this_environment(params32):
+    """The committed obs/goldens.json must reproduce on HEAD in this
+    container — the cross-session numerics anchor (a failure here
+    means XLA/jax float folding changed underneath the repo;
+    regenerate via `python -m mano_hand_tpu.obs.sentinel` and justify
+    the diff)."""
+    eng = ServingEngine(params32, max_bucket=8)
+    with eng:
+        eng.warmup([1])
+        s = NumericsSentinel(eng)
+        assert s.arm()["golden_status"] == "match"
+
+
+def test_sentinel_background_loop_probes_and_stops(params32):
+    eng = ServingEngine(params32, max_bucket=8)
+    s = NumericsSentinel(eng, interval_s=0.02)
+    with eng:
+        eng.warmup([1])
+        with s:
+            deadline = time.monotonic() + 10.0
+            while (s.status()["probes"] < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+        assert s.status()["probes"] >= 2
+        assert not s.status()["armed"]
+        assert s.status()["last_probe_age_s"] is not None
+    samples = s.samples()
+    assert samples["sentinel_probes"]["samples"][0][1] >= 2
+    assert samples["sentinel_drifts"]["samples"][0][1] == 0
+
+
+def test_golden_inputs_deterministic_and_digest_stable():
+    p1, s1 = golden_inputs(16, 10)
+    p2, s2 = golden_inputs(16, 10)
+    assert f32_digest(p1) == f32_digest(p2)
+    assert (p1 == p2).all() and (s1 == s2).all()
+    assert f32_digest(p1) != f32_digest(p1 + 1e-7)   # digests are exact
+
+
+# -------------------------------------------------------- the config13 leg
+def test_metrics_overhead_run_small_e2e(params32, tmp_path):
+    """Plumbing-size config13: structure, drill detection, span
+    accounting, SLO block, and the metrics-dir export (the honest
+    overhead ratio lives in `make serve-smoke` / bench config13)."""
+    from mano_hand_tpu.serving.measure import metrics_overhead_run
+
+    out = metrics_overhead_run(
+        params32, requests=12, max_rows=4, max_bucket=8, trials=2,
+        reps=1, metrics_dir=tmp_path / "mx")
+    assert out["steady_recompiles"] == 0
+    assert out["metrics_overhead_ratio"] > 0
+    acc = out["span_accounting"]
+    assert acc["spans_started"] == acc["spans_closed"]
+    assert acc["spans_open"] == 0
+    drill = out["sentinel_drill"]
+    assert drill["detected"] and not drill["clean_probe_drift"]
+    assert drill["cpu_family_clean"] and drill["recovered"]
+    assert drill["futures_resolved_fraction"] == 1.0
+    assert drill["incidents"] >= 1
+    assert "numerics_drift" in drill["flight_capture_reasons"]
+    dacc = drill["span_accounting"]
+    assert dacc["spans_started"] == dacc["spans_closed"]
+    assert out["sentinel"]["golden_status"] == "match"
+    assert out["sentinel_background_probes"] >= 1
+    assert out["slo"]["tiers"]["0"]["burn_rates"]["goodput"] == 0.0
+    prom = (tmp_path / "mx" / "metrics.prom").read_text()
+    assert "mano_serving_dispatches" in prom
+    assert "mano_sentinel_probes" in prom
+    snap = json.loads((tmp_path / "mx" / "metrics.json").read_text())
+    assert snap["schema"] == 1
+    assert json.loads((tmp_path / "mx" / "slo.json").read_text())["ok"]

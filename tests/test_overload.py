@@ -422,7 +422,11 @@ def test_counters_snapshot_atomic_under_concurrent_writers():
     """snapshot() is ONE lock-held copy: the derived ratios and the
     per-tier ledgers always agree with the raw integers beside them,
     even while submitter threads hammer the counters (the drill's
-    mid-overload telemetry must never report torn tuples)."""
+    mid-overload telemetry must never report torn tuples). PR 9
+    extends this class to the metrics REGISTRY — the export and the
+    SLO burn rates must derive from the same one-hold snapshot
+    (tests/test_metrics.py:
+    test_registry_snapshot_atomic_under_concurrent_submit_resolve)."""
     c = ServingCounters()
     stop = threading.Event()
 
