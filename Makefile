@@ -21,10 +21,11 @@
 # shared cache dir.
 .PHONY: check check-cold test bench-cpu bench-tpu-wait mesh-scaling \
 	check-quick serve-smoke specialize-smoke chaos-smoke coalesce-smoke \
-	overload-smoke coldstart-smoke obs-smoke metrics-smoke analyze
+	overload-smoke coldstart-smoke obs-smoke metrics-smoke \
+	posed-kernel-smoke analyze
 
 check: analyze test chaos-smoke coalesce-smoke overload-smoke \
-	coldstart-smoke obs-smoke metrics-smoke
+	coldstart-smoke obs-smoke metrics-smoke posed-kernel-smoke
 
 # tests/test_runtime.py is excluded here and covered by the chaos-smoke
 # prerequisite instead (its own pytest process + cache dir): `make
@@ -42,7 +43,8 @@ test:
 	  --ignore=tests/test_overload.py \
 	  --ignore=tests/test_coldstart.py \
 	  --ignore=tests/test_obs.py \
-	  --ignore=tests/test_metrics.py
+	  --ignore=tests/test_metrics.py \
+	  --ignore=tests/test_pallas_posed.py
 
 # Seconds-scale pre-commit lane: the core-correctness modules (parity vs
 # the f64 oracle, assets/IO, golden demo, device lock, and the serving
@@ -58,7 +60,8 @@ check-quick: analyze
 # env writes, the r3 unbounded-retry pattern, wall-clock deadlines,
 # device work under _exe_lock), the engine lock-discipline checker
 # (documented order _install_lock -> _exe_lock, no cycles), the jaxpr
-# program auditor (all five program families traced on CPU: no f64,
+# program auditor (seven programs over the five families traced on
+# CPU, incl. the PR-10 fused gathered serving kernel: no f64,
 # no host callbacks, donation as designed, primitive counts vs the
 # committed analysis/baseline.json), and the fused-launch lockstep-
 # drift detector. Seconds-scale, chip never touched. Runs in BOTH
@@ -95,7 +98,10 @@ bench-cpu:
 # Also sweeps the specialization leg (config8: full-vs-pose-only forward
 # AND the frozen-betas LM half, which runs despite --skip-fit by design)
 # at reduced sizes — the spec-lm batch stays below the b>=64 judging
-# floor, so bench_report records its numbers without applying criteria.
+# floor, so bench_report records its numbers without applying criteria —
+# and the fused gathered-kernel leg (config14: the whole fused-vs-XLA
+# engine protocol + lm_e2e sub-leg through the Pallas interpreter; a
+# config14 plumbing bug must not debut on the scarce chip).
 bench-interpret:
 	python bench.py --platform cpu --big-batch 512 --chunk 128 --iters 2 \
 	  --fit-steps 10 --pallas-sweep quick --pallas-interpret --skip-fit \
@@ -105,7 +111,8 @@ bench-interpret:
 	  --coalesce-subjects 8 --coalesce-requests 48 --coalesce-max-bucket 32 \
 	  --overload-bursts 16 --coldstart-requests 8 --coldstart-subjects 3 \
 	  --coldstart-max-bucket 4 --coldstart-waves 2 --tracing-requests 48 \
-	  --metrics-requests 48
+	  --metrics-requests 48 --posed-requests 32 --posed-subjects 6 \
+	  --posed-max-bucket 32 --posed-lm-batch 8
 
 # Serving-leg smoke (the bench-interpret counterpart for config7): the
 # whole serving-engine plumbing — bucket warm-up, ragged request stream,
@@ -126,14 +133,18 @@ bench-interpret:
 # its fixed per-pass scrape+probe tail (~3 ms) must be amortized by
 # the pass length or the ratio judges the tail, not the steady cost —
 # measured at 96 requests: 1.049 vs 1.002 at 160 (the reps dead-end in
-# serving/measure.py:metrics_overhead_run's docstring).
+# serving/measure.py:metrics_overhead_run's docstring). config14 (the
+# fused gathered kernel, PR 10) runs its parity/recompile criteria here
+# too — the speed ratio is interpreter overhead on CPU and is recorded
+# unjudged (the chip leg is queued via bench-tpu-wait).
 serve-smoke:
 	python bench.py --platform cpu --serving-only --serving-requests 96 \
 	  --serving-max-rows 16 --serving-max-bucket 32 --init-retries 2 \
 	  --coalesce-subjects 8 --coalesce-requests 48 --coalesce-max-bucket 32 \
 	  --coldstart-requests 16 --coldstart-subjects 4 \
 	  --coldstart-max-bucket 4 --coldstart-waves 3 --tracing-requests 96 \
-	  --metrics-requests 160
+	  --metrics-requests 160 --posed-requests 48 --posed-subjects 8 \
+	  --posed-max-bucket 32 --posed-lm-batch 8
 
 # Specialization-split smoke (the quick-lane half of PR 2's tooling):
 # the seconds-scale correctness story of the shape/pose split — bit-
@@ -199,6 +210,19 @@ coldstart-smoke:
 obs-smoke:
 	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_obs \
 	  python -m pytest tests/test_obs.py -q
+
+# Fused gathered-serving-kernel matrix (the PR-10 tentpole): interpret-
+# mode parity of the fused Pallas gather+pose kernel vs the XLA
+# gathered/posed programs (mixed-subject batches, awkward compositions,
+# LRU-evicted re-bake), the engine's posed_kernel="fused" tier
+# (capacity gate, zero steady recompiles, sentinel same-trace
+# reference, chaos failover to the bit-identical CPU tier), and the
+# config14 protocol plumbing at tiny sizes. Wired into `make check` as
+# a SEPARATE pytest process on its own compile-cache dir (the CLAUDE.md
+# rule: two pytest processes must never share .jax_compile_cache/).
+posed-kernel-smoke:
+	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_posed \
+	  python -m pytest tests/test_pallas_posed.py -q
 
 # Metrics & SLO matrix (the PR-9 tentpole): registry instrument/
 # collector atomicity under concurrent writers, the counter-drift

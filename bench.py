@@ -588,7 +588,8 @@ def run_benchmarks(args, device_str: str) -> dict:
                                               "config10_overload",
                                               "config11_coldstart",
                                               "config12_tracing",
-                                              "config13_metrics"):
+                                              "config13_metrics",
+                                              "config14_posed_kernel"):
             return
         try:
             fn()
@@ -2203,6 +2204,58 @@ def run_benchmarks(args, device_str: str) -> dict:
     if args.metrics_requests > 0:
         section("config13_metrics", config13_metrics)
 
+    # -- config 14: fused gathered serving kernel (PR 10) -------------------
+    # THE shared protocol (serving/measure.py:posed_kernel_bench_run):
+    # the SAME mixed-subject pose-only stream through two engines — the
+    # fused Pallas gathered kernel tier (posed_kernel="fused",
+    # ops/pallas_posed.py) vs the PR-4 XLA gathered program — slope-
+    # timed through the engine (marginal cost of the stream's tail, so
+    # the fixed dispatch overhead both sides share cancels), all four
+    # timing points interleaved per trial. Criteria
+    # (scripts/bench_report.py): fused parity <= 1e-5 vs the posed
+    # reference (mixed-subject coalesced batches included), XLA side
+    # bit-identical (0.0), zero steady recompiles on BOTH tiers; the
+    # speed ratio is judged only on a real TPU (the CPU lane runs the
+    # kernel through the Pallas interpreter — emulation overhead, not
+    # perf; the chip leg is queued via scripts/bench_tpu_wait.sh).
+    # The lm_e2e sub-leg (ROADMAP 2b: end-to-end fit_lm steps/s with
+    # the landed batched-LU solve — 8x in isolation, never measured
+    # end-to-end on chip) rides in the same artifact so the first
+    # tunnel-up window measures both halves of ROADMAP item 2. With
+    # --profile set, the fused engine's span timeline exports to
+    # <profile>/posed_kernel/ for scripts/trace_report.py.
+    def config14_posed_kernel():
+        from mano_hand_tpu.serving.measure import posed_kernel_bench_run
+
+        pk = posed_kernel_bench_run(
+            right,
+            subjects=args.posed_subjects,
+            requests=args.posed_requests,
+            max_rows=args.posed_max_rows,
+            max_bucket=args.posed_max_bucket,
+            lm_batch=args.posed_lm_batch,
+            interpret=True if args.pallas_interpret else None,
+            trace_dir=args.profile or None,
+            seed=29,
+            log=lambda m: log(f"config14 {m}"),
+        )
+        results["posed_kernel"] = pk
+        log(f"config14 posed kernel: fused "
+            f"{pk['fused_evals_per_sec']:,.0f} vs xla "
+            f"{pk['xla_evals_per_sec']:,.0f} evals/s (slope ratio "
+            f"{pk['fused_vs_xla_ratio']}x, platform {pk['platform']}, "
+            f"interpret={pk['interpret']}), parity fused "
+            f"{pk['fused_vs_gather_max_abs_err']:.2e} / xla "
+            f"{pk['xla_vs_gather_max_abs_err']:.2e}, steady recompiles "
+            f"{pk['steady_recompiles_fused']}/"
+            f"{pk['steady_recompiles_xla']}"
+            + (f", lm_e2e {pk['lm_e2e_steps_per_sec']:,.1f} steps/s "
+               f"at b={pk['lm_e2e_batch']}"
+               if "lm_e2e_steps_per_sec" in pk else ""))
+
+    if args.posed_requests > 0:
+        section("config14_posed_kernel", config14_posed_kernel)
+
     if args.serving_only:
         # Fast serving-layer artifact (`make serve-smoke`): the deferred
         # runner's serving-only skip reduces the schedule to config7
@@ -2330,9 +2383,14 @@ def run_benchmarks(args, device_str: str) -> dict:
     # registration order. The readback tail (accuracy onward) keeps its
     # position: the first D2H permanently degrades later axon dispatches,
     # and accuracy can only probe kernels whose sections already ran.
+    # config14 rides in the priority block (after the headline trio):
+    # the fused GATHERED kernel + the lm_e2e sub-leg are exactly the
+    # ROADMAP-item-2 numbers the next short tunnel-up window must
+    # salvage first (r5 lesson: windows last minutes).
     priority = ["config1_warmup", "sync_probe", "config3d",
                 "config3_fused_full_chunked", "config3",
-                "config4", "config4b_lm", "config3e_hands"]
+                "config4", "config4b_lm", "config14_posed_kernel",
+                "config3e_hands"]
     rank = {name: i for i, name in enumerate(priority)}
     for name, fn in sorted(_registered,
                            key=lambda nf: rank.get(nf[0], len(priority))):
@@ -2499,6 +2557,26 @@ def main() -> int:
     ap.add_argument("--coldstart-waves", type=int, default=6,
                     help="post-restore request waves used to call the "
                          "p99 settled (config11)")
+    ap.add_argument("--posed-requests", type=int, default=96,
+                    help="requests per slope pass of the fused-gathered-"
+                         "kernel leg (config14: fused Pallas tier vs XLA "
+                         "gathered program through two engines, slope-"
+                         "timed; 0 skips the leg)")
+    ap.add_argument("--posed-subjects", type=int, default=8,
+                    help="distinct baked subjects in the config14 "
+                         "mixed-subject stream")
+    ap.add_argument("--posed-max-rows", type=int, default=4,
+                    help="config14 request sizes are uniform in "
+                         "[1, posed-max-rows]")
+    ap.add_argument("--posed-max-bucket", type=int, default=64,
+                    help="largest power-of-two bucket of the config14 "
+                         "engines")
+    ap.add_argument("--posed-lm-batch", type=int, default=32,
+                    help="problem batch of config14's end-to-end "
+                         "fit_lm steps/s sub-leg (ROADMAP 2b; the "
+                         "batched-LU solve measured end to end); 0 "
+                         "skips the sub-leg (its step-count programs "
+                         "are cold compiles in plumbing-size lanes)")
     ap.add_argument("--spec-batch", type=int, default=256,
                     help="batch for the specialization leg's full-vs-"
                          "pose-only forward comparison (config8); "

@@ -23,9 +23,10 @@ resulting jaxpr is audited for
   review instead of on the chip. Intentional changes:
   ``mano analyze --update-baseline``.
 
-Program families (ISSUE 7): full forward, posed (pose-only fast path),
-gathered (PR-4 coalescing), fused one-/two-hand single-launch kernels,
-and the CPU-failover tier.
+Program families (ISSUE 7, extended by PR 10): full forward, posed
+(pose-only fast path), gathered (PR-4 coalescing), fused one-/two-hand
+single-launch kernels, the FUSED gathered pose-only serving kernel
+(PR 10), and the CPU-failover tier.
 """
 
 from __future__ import annotations
@@ -61,7 +62,7 @@ def build_program_specs() -> List[ProgramSpec]:
 
     from mano_hand_tpu.assets import synthetic_pair, synthetic_params
     from mano_hand_tpu.models import core
-    from mano_hand_tpu.ops import pallas_forward
+    from mano_hand_tpu.ops import pallas_forward, pallas_posed
 
     params = synthetic_params(seed=0).astype(np.float32)
     left, right = synthetic_pair(seed=0)
@@ -111,6 +112,20 @@ def build_program_specs() -> List[ProgramSpec]:
             lambda q2, p2, sh2: pallas_forward.forward_verts_fused_full_hands(
                 q2, p2, sh2),
             (params2, pose2, shape2), donate_argnums=(),
+            expect_donated=(), lowerable=False),
+        # serving/engine.py:build_posed_gather_fused_executable — the
+        # PR-10 fused gathered serving kernel (ops/pallas_posed.py).
+        # Jaxpr-audited only, like its fused siblings (TPU pallas
+        # lowering needs the chip; the interpret lane covers
+        # execution — `make posed-kernel-smoke` / bench config14). The
+        # live builder donates the pose buffer exactly like the XLA
+        # gathered family; donation flags need a lowering, so that
+        # contract is pinned by the XLA twin above.
+        ProgramSpec(
+            "gathered_fused", "fused",
+            lambda tab, ix, p: pallas_posed.forward_posed_gather_fused(
+                tab, ix, p),
+            (table, idx, pose), donate_argnums=(),
             expect_donated=(), lowerable=False),
         # serving/engine.py:build_cpu_fallback_executable — never
         # donated (CPU donation is unimplemented; the clean tier).

@@ -369,6 +369,40 @@ def forward_posed_gather(
     return jax.vmap(row)(v_rows, j_rows, s_rows, pose)
 
 
+def forward_posed_gather_fused(
+    table: SubjectTable,
+    subject_idx: jnp.ndarray,  # [B] int32 row indices into the table
+    pose: jnp.ndarray,         # [B, J, 3]
+    precision=DEFAULT_PRECISION,
+    block_b: Optional[int] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Mixed-subject pose-only forward in ONE Pallas launch; verts only.
+
+    The kernel twin of ``forward_posed_gather`` (ops/pallas_posed.py):
+    the SubjectTable row gather, pose-corrective blend, FK and skinning
+    all run per batch tile in VMEM — table and index stay runtime
+    arguments, so one compiled program per (capacity, batch) shape
+    serves every subject mixture with zero per-subject recompiles.
+    Numerics are within ~1e-5 (f32) of the XLA gathered program per
+    row, NOT bit-identical (the kernel's 3-pass MXU precision policy);
+    the serving engine selects this tier with
+    ``ServingEngine(posed_kernel="fused")``. Inference only (no VJP —
+    solvers stay on XLA, the measured dead-end).
+    """
+    from mano_hand_tpu.ops import pallas_posed
+
+    if pose.shape[0] == 0:
+        return jnp.zeros((0, table.n_verts, 3), table.v_shaped.dtype)
+    pose = pose.reshape(pose.shape[0], -1, 3)
+    bb = pallas_posed.POSED_FUSED_BEST_BLOCK_B if block_b is None \
+        else block_b
+    return pallas_posed.forward_posed_gather_fused(
+        table, subject_idx, pose, precision,
+        block_b=min(bb, pose.shape[0]), interpret=interpret,
+    )
+
+
 def decode_pca(
     params: ManoParams,
     pca_coeffs: jnp.ndarray,
@@ -1078,6 +1112,17 @@ def jit_forward_posed_gather(table, subject_idx, pose,
     and index ride as runtime arguments — one program per
     (capacity, batch) shape, shared by every subject mixture)."""
     return forward_posed_gather(table, subject_idx, pose, precision)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("precision", "block_b", "interpret"))
+def jit_forward_posed_gather_fused(table, subject_idx, pose,
+                                   precision=DEFAULT_PRECISION,
+                                   block_b=None, interpret=False):
+    """Convenience jitted FUSED gathered pose-only forward (verts only;
+    table and index ride as runtime arguments, like the XLA twin)."""
+    return forward_posed_gather_fused(table, subject_idx, pose,
+                                      precision, block_b, interpret)
 
 
 # One compiled row-update program per table capacity (``slot`` is traced,

@@ -217,16 +217,31 @@ class NumericsSentinel:
             self._refs["full"] = ref
         return ref
 
-    def _ref_gather(self):
-        ref = self._refs.get("gather")
+    def _ref_gather(self, targets):
+        """Tier-aware (PR 10): the engine's gather callables are either
+        the XLA gathered program or the fused Pallas kernel
+        (``targets["gather_fused"]``) — the two families are ~1e-5
+        apart, not bit-identical, so the clean reference MUST re-jit
+        the SAME family (an XLA reference under the fused tier would
+        read as permanent drift; the same-trace rule every other
+        reference here follows)."""
+        fused = bool(targets.get("gather_fused"))
+        key = "gather_fused" if fused else "gather"
+        ref = self._refs.get(key)
         if ref is None:
             import jax
 
             from mano_hand_tpu.models import core
 
-            ref = jax.jit(
-                lambda t, i, p: core.forward_posed_gather(t, i, p).verts)
-            self._refs["gather"] = ref
+            if fused:
+                interp = bool(targets.get("gather_fused_interpret"))
+                ref = jax.jit(
+                    lambda t, i, p: core.forward_posed_gather_fused(
+                        t, i, p, interpret=interp))
+            else:
+                ref = jax.jit(
+                    lambda t, i, p: core.forward_posed_gather(t, i, p).verts)
+            self._refs[key] = ref
         return ref
 
     def _cpu_inputs(self, params_host):
@@ -333,9 +348,11 @@ class NumericsSentinel:
                 pp = _pad_rows(pose, b)
                 families["gather"] = dict(
                     bucket=b, capacity=t["table"].capacity,
+                    family=("gather_fused" if t.get("gather_fused")
+                            else "gather"),
                     **self._probe_family(
                         t["gather"][b],
-                        self._ref_gather(), t["table"], idx, pp))
+                        self._ref_gather(t), t["table"], idx, pp))
             drifted = [f for f, rec in families.items()
                        if rec["drift"]]
             kind = "drift" if drifted else "probe"

@@ -1,0 +1,360 @@
+"""Pallas TPU kernel: fused SubjectTable gather + pose-only forward.
+
+The serving engine's steady-state work is the gathered pose-only path
+(``models/core.py:forward_posed_gather``): every interactive request is
+"row ``r`` of the batch runs the pose stage over subject
+``idx[r]``'s baked shape constants". That path is pure XLA today, while
+the full forward's Pallas fusion measured 2.2x (docs/roadmap.md PR-10 /
+ROADMAP item 2a). This kernel is its Pallas twin: ONE launch covers
+
+    row gather   rows = onehot(idx) @ table_planes       (MXU, exact*)
+    pose blend   v_posed = rows + deltas @ pose_basis2   (MXU)
+    FK           level-parallel lane-slab compose        (VPU)
+    skinning     12 skinny [TB, J] dots + FMA combine    (MXU + VPU)
+
+per batch tile, structured the way ``forward_verts_fused_full``
+(ops/pallas_forward.py) fused the full forward — the FK/Rodrigues slab
+machinery (``_rodrigues_slabs``/``_fk_slabs``/``level_layout``) is
+REUSED from there verbatim (imported, not copied; the mirrored
+one-/two-hand launch pair itself is untouched, so the CLAUDE.md
+lockstep contract is unaffected).
+
+Design points specific to the gathered path:
+
+* **The gather lives INSIDE the launch.** The packed per-subject planes
+  (``[C, 3*VP]`` coordinate-major v_shaped rows, three ``[C, J]`` rest-
+  joint slabs) are VMEM-resident operands with constant index maps —
+  fetched once per launch — and each batch tile gathers its rows with a
+  one-hot MXU matmul built in-register from the int32 index block
+  (``broadcasted_iota == idx``). No per-row HBM gather slab ever
+  exists: the XLA path materializes ``[B, V, 3]`` gathered rows in HBM
+  (~9.3 KB/eval written + re-read); here per-eval HBM input traffic is
+  pose (192 B) + index (4 B).
+* **The one-hot gather is policy-exact.** A 0/1 one-hot splits as
+  ``hi = onehot, lo = 0``, so the standard 3-pass bf16 decomposition
+  (ops/common.kernel_dot's HIGH path) degenerates to
+  ``onehot @ t_hi + onehot @ t_lo`` — it reconstructs the table's
+  bf16-pair representation exactly (~4e-6 relative), with no
+  single-pass bf16 rounding of the gathered VALUES. Gathers are
+  hardwired to this 3-pass form at every precision (it is a data
+  movement, not a contraction — running it below HIGH would round
+  baked rows to bf16 and blow the 1e-5 parity budget).
+* **Runtime arguments only.** The table and the ``[B]`` int32 subject
+  index are runtime args exactly like the XLA gathered program: one
+  compiled kernel per (capacity, bucket) serves EVERY subject mixture
+  — a new subject costs zero recompiles (the PR-4 contract carried
+  into the kernel tier).
+* **Capacity is a VMEM budget.** The resident packed table costs
+  ``C * 3 * VP * 4`` bytes (~10.5 KB/row at V=778) beside the ~1.5 MB
+  pose basis; ``POSED_FUSED_MAX_CAPACITY`` (512 -> ~5.5 MB) keeps the
+  launch inside the 16 MB scoped-vmem budget the block-size sweeps
+  established. Above it the caller (ServingEngine) stays on the XLA
+  gathered program. The one-hot gather's FLOPs also scale with C —
+  another reason the big-capacity regime belongs to XLA until a chip
+  sweep says otherwise.
+
+Respected measured dead-ends (docs/roadmap.md): HIGH stays the 3-pass
+bf16 decomposition (never in-kernel native HIGHEST), launches stay
+<= 8192 rows (serving buckets are <= 1024), and the solvers stay on
+XLA — this kernel serves inference only (no custom VJP).
+
+Numerics contract: within ~1e-5 max abs err (f32) of
+``core.forward_posed_gather`` per row — NOT bit-identical (the kernel's
+3-pass MXU policy vs XLA's CPU f32 math), which is why the serving
+engine keeps the fused tier OUT of the PR-6 AOT lattice (the lattice's
+contract is bit-identity with the live jit of the XLA family) and
+exports the tier to the PR-9 numerics sentinel so drift is judged
+against a clean SAME-TRACE fused reference.
+
+Reference semantics: pose blend + FK + LBS of
+/root/reference/mano_np.py:87-115 over baked per-subject constants
+(mano_np.py:81-83 baked at ``specialize`` time).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mano_hand_tpu.ops.common import (
+    DEFAULT_PRECISION, LANE, SUBLANE, cdiv as _cdiv,
+    dot3 as _dot3, kernel_dot, split_hi_lo as _split_hi_lo,
+    split_hi_lo_xla,
+)
+from mano_hand_tpu.ops.pallas_forward import (
+    _fk_slabs, _rodrigues_slabs, level_layout,
+)
+
+#: Largest SubjectTable capacity the fused kernel keeps VMEM-resident
+#: (~5.5 MB of packed rows at V=778, beside the ~1.5 MB pose basis).
+#: The serving engine falls back to the XLA gathered program above it.
+POSED_FUSED_MAX_CAPACITY = 512
+
+#: Batch tile for the fused gathered kernel. 64 mirrors the full-fusion
+#: kernel's swept winner (core.FUSED_FULL_BEST_BLOCK_B — same skinny
+#: skin-dot structure, same VMEM pressure class); the chip sweep for
+#: THIS kernel is queued behind the tunnel (bench config14), and its
+#: winner lands here, one line, when it runs.
+POSED_FUSED_BEST_BLOCK_B = 64
+
+
+def posed_fused_capacity_ok(capacity: int) -> bool:
+    """Whether a table of ``capacity`` rows fits the fused kernel's
+    VMEM residency budget — THE predicate the serving engine gates its
+    kernel-tier selection on (one definition; the kernel launch raises
+    on violation rather than letting Mosaic OOM obscurely)."""
+    return capacity <= POSED_FUSED_MAX_CAPACITY
+
+
+def posed_gather_operands(table):
+    """Launch operands from a :class:`core.SubjectTable`, all f32:
+
+    ``(tvs [C, 3*VP], (tjx, tjy, tjz) [C, J] x3, pose_basis2 [Pp, 3*VP],
+    wt2 [J, VP])``
+
+    ``tvs`` packs the baked v_shaped rows coordinate-major (the fused
+    kernels' layout: three aligned V-planes per row, V padded to the
+    lane width); the joint slabs and ``wt2`` carry the joint axis in
+    ``level_layout`` order so FK composes on aligned lane slices;
+    ``pose_basis2`` rows follow the in-kernel delta layout (ab-major,
+    permuted non-root joints — the pose-row half of
+    ``pallas_forward.fused_full_operands``, without the shape/template
+    rows: the shape stage arrives pre-baked via the gathered planes).
+    Built inside the caller's jit with the table as a runtime arg, so
+    XLA hoists nothing subject-specific into the executable.
+    """
+    f32 = jnp.float32
+    perm, _ = level_layout(tuple(table.parents))
+    perm_arr = jnp.asarray(perm)
+    c = table.capacity
+    v = table.n_verts
+    j = table.n_joints
+    p = table.pose_basis.shape[-1]
+    vp = _cdiv(v, LANE) * LANE
+    pp = _cdiv(p, SUBLANE) * SUBLANE
+
+    tvs = jnp.pad(
+        jnp.asarray(table.v_shaped, f32).transpose(0, 2, 1),  # [C, 3, V]
+        [(0, 0), (0, 0), (0, vp - v)],
+    ).reshape(c, 3 * vp)
+
+    tj = jnp.asarray(table.joints, f32)[:, perm_arr, :]       # [C, J, 3]
+    tjx, tjy, tjz = tj[:, :, 0], tj[:, :, 1], tj[:, :, 2]
+
+    # Pose rows: ab-major, joints in perm order (root excluded) — the
+    # exact row order the in-kernel delta concat produces (see
+    # pallas_forward.fused_full_operands for the original derivation).
+    pb = jnp.asarray(table.pose_basis, f32).transpose(2, 1, 0)  # [P, 3, V]
+    order = [
+        (perm[pos] - 1) * 9 + 3 * a + b
+        for a in range(3) for b in range(3)
+        for pos in range(1, j)
+    ]
+    basis = pb[jnp.asarray(order, jnp.int32)]                 # [P, 3, V]
+    pose_basis2 = jnp.pad(
+        basis, [(0, pp - p), (0, 0), (0, vp - v)]
+    ).reshape(pp, 3 * vp)
+
+    wt2 = jnp.pad(
+        jnp.asarray(table.lbs_weights, f32).T[perm_arr],
+        [(0, 0), (0, vp - v)],
+    )                                                         # [J, VP]
+    return tvs, (tjx, tjy, tjz), pose_basis2, wt2
+
+
+def _gather_dot(onehot, t_hi, t_lo):
+    """One-hot row gather as a 3-pass MXU matmul — EXACT reconstruction
+    of the (hi, lo) bf16 pair (the one-hot's own lo-split is
+    identically zero, so the a_lo*b_hi pass vanishes and nothing is
+    rounded through single-pass bf16). Hardwired at every precision:
+    a gather is data movement, and running it below this fidelity
+    would round the baked table rows themselves."""
+    oh_hi, _ = _split_hi_lo(onehot)   # 0/1 are exact in bf16; lo == 0
+    d = functools.partial(jnp.dot, preferred_element_type=jnp.float32)
+    return d(oh_hi, t_hi) + d(oh_hi, t_lo)
+
+
+def _posed_gather_kernel(vp, levels, precision, split, *refs):
+    """One batch tile of the gathered pose-only forward: index + pose
+    slabs in, vertex coordinate planes out.
+
+    Blocks (constant index maps — resident across the launch): the
+    packed table planes (pre-split to bf16 hi/lo pairs at the XLA level
+    in BOTH modes — the gather consumes the pair directly, and
+    re-splitting ``[C, 3*VP]`` per grid step would redo the work the
+    full kernel's HIGH path moved out of the loop), the three joint
+    slabs (f32 — tiny), the pose basis and skin weights (hi/lo pairs
+    under HIGH, f32 otherwise). Per tile: idx [TB, 1] int32 and the
+    three pose coordinate slabs [TB, J].
+    """
+    if split:
+        (tvs_hi, tvs_lo, tjx, tjy, tjz,
+         pb_hi, pb_lo, wt_hi, wt_lo, idx, x, y, z) = (r[:] for r in refs[:13])
+        outs = refs[13:16]
+    else:
+        (tvs_hi, tvs_lo, tjx, tjy, tjz,
+         pb_op, wt_op, idx, x, y, z) = (r[:] for r in refs[:11])
+        outs = refs[11:14]
+
+    tb = x.shape[0]
+    c = tvs_hi.shape[0]
+    # One-hot gather rows, built in-register from the index block. An
+    # out-of-range index gathers zeros (the XLA path clamps instead) —
+    # unreachable through the engine, which only hands out live slots.
+    iota = jax.lax.broadcasted_iota(jnp.int32, (tb, c), 1)
+    onehot = (iota == idx).astype(jnp.float32)               # [TB, C]
+    vs_rows = _gather_dot(onehot, tvs_hi, tvs_lo)            # [TB, 3*VP]
+    # Rest-joint slabs gather under the kernel's precision policy
+    # (kernel_dot's HIGH path is the same exact-for-one-hot 3-pass).
+    jx = kernel_dot(onehot, tjx, precision)                  # [TB, J]
+    jy = kernel_dot(onehot, tjy, precision)
+    jz = kernel_dot(onehot, tjz, precision)
+
+    r_local = _rodrigues_slabs(x, y, z)
+    world_r, skin_t = _fk_slabs(r_local, jx, jy, jz, levels)
+
+    # Pose-corrective deltas in-register: ab-major over non-root joints
+    # in perm order — matching posed_gather_operands' basis row order.
+    deltas = [
+        r_local[3 * a + b][:, 1:] - (1.0 if a == b else 0.0)
+        for a in range(3) for b in range(3)
+    ]
+    coeff = jnp.concatenate(deltas, axis=1)                  # [TB, 9(J-1)]
+    pp = (pb_hi if split else pb_op).shape[0]
+    pad = pp - coeff.shape[1]
+    if pad:
+        coeff = jnp.concatenate(
+            [coeff, jnp.zeros((tb, pad), coeff.dtype)], axis=1)
+
+    if split:
+        c_hi, c_lo = _split_hi_lo(coeff)
+        vp_flat = vs_rows + _dot3(c_hi, c_lo, pb_hi, pb_lo)
+
+        def skin_dot(lhs):
+            l_hi, l_lo = _split_hi_lo(lhs)
+            return _dot3(l_hi, l_lo, wt_hi, wt_lo)
+    else:
+        vp_flat = vs_rows + kernel_dot(coeff, pb_op, precision)
+
+        def skin_dot(lhs):
+            return kernel_dot(lhs, wt_op, precision)
+
+    for a in range(3):
+        acc = skin_dot(skin_t[a])
+        for cc in range(3):
+            m_ac = skin_dot(world_r[3 * a + cc])
+            acc = acc + m_ac * vp_flat[:, cc * vp:(cc + 1) * vp]
+        outs[a][:] = acc
+
+
+def forward_posed_gather_fused(
+    table,                     # core.SubjectTable (runtime argument)
+    subject_idx: jnp.ndarray,  # [B] int32 row indices into the table
+    pose: jnp.ndarray,         # [B, J, 3] axis-angle (row 0 global)
+    precision=DEFAULT_PRECISION,
+    block_b: int = POSED_FUSED_BEST_BLOCK_B,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Mixed-subject pose-only vertices [B, V, 3] in ONE kernel launch.
+
+    The Pallas twin of ``core.forward_posed_gather`` (verts only): the
+    SubjectTable row gather, pose-corrective blend, FK and skinning all
+    run per batch tile in VMEM. The table and index are runtime
+    arguments — one compiled program per (capacity, batch) shape serves
+    every subject mixture, zero per-subject recompiles. Per-row
+    numerics are within ~1e-5 (f32) of the XLA gathered program, not
+    bit-identical (3-pass MXU policy vs XLA f32 — the parity gate in
+    tests/test_pallas_posed.py and bench config14). Inference path
+    only: no custom VJP (solvers stay on XLA — the measured fitting
+    dead-end, docs/roadmap.md).
+    """
+    f32 = jnp.float32
+    v = table.n_verts
+    j = table.n_joints
+    b = pose.shape[0]
+    if b == 0:
+        return jnp.zeros((0, v, 3), f32)
+    c = table.capacity
+    if not posed_fused_capacity_ok(c):
+        raise ValueError(
+            f"table capacity {c} exceeds the fused kernel's VMEM "
+            f"residency budget (POSED_FUSED_MAX_CAPACITY="
+            f"{POSED_FUSED_MAX_CAPACITY}); use core.forward_posed_gather "
+            "(the XLA program) at this scale — the serving engine's "
+            "capacity gate does exactly that")
+    if b > 8192:
+        raise ValueError(
+            f"batch {b} exceeds the 8192-rows-per-launch measured "
+            "dead-end (docs/roadmap.md); chunk upstream")
+    perm, levels = level_layout(tuple(table.parents))
+    tvs, (tjx, tjy, tjz), pose_basis2, wt2 = posed_gather_operands(table)
+
+    pose_p = pose.reshape(b, j, 3).astype(f32)[:, jnp.asarray(perm), :]
+    idx = jnp.asarray(subject_idx, jnp.int32).reshape(b, 1)
+
+    block_b = max(1, min(block_b, b))
+    bp = _cdiv(b, block_b) * block_b
+
+    def padb(xarr):
+        return jnp.pad(xarr, [(0, bp - b)] + [(0, 0)] * (xarr.ndim - 1))
+
+    # Pad rows gather row 0 (always baked first) and are sliced off.
+    idx = padb(idx)
+    slabs = [padb(pose_p[:, :, cc]) for cc in range(3)]      # 3 x [Bp, J]
+
+    vp = tvs.shape[1] // 3
+    pp = pose_basis2.shape[0]
+    grid = (bp // block_b,)
+    const_tvs = pl.BlockSpec((c, 3 * vp), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM)
+    const_tj = pl.BlockSpec((c, j), lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+    const_pb = pl.BlockSpec((pp, 3 * vp), lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+    const_wt = pl.BlockSpec((j, vp), lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+    spec_idx = pl.BlockSpec((block_b, 1), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    spec_bj = pl.BlockSpec((block_b, j), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+    spec_bv = pl.BlockSpec((block_b, vp), lambda i: (i, 0),
+                           memory_space=pltpu.VMEM)
+
+    # The packed table is pre-split to its bf16 (hi, lo) pair at the
+    # XLA level in BOTH precision modes: the gather consumes the pair
+    # directly (see _gather_dot) and the fold-proof split must not run
+    # per grid step. MUST be split_hi_lo_xla (ops/common.py: the
+    # convert-based split compiles to lo == 0 under XLA:TPU).
+    tvs_hi, tvs_lo = split_hi_lo_xla(tvs)
+
+    canon = (jax.lax.Precision(precision)
+             if precision is not None else precision)
+    split = canon == jax.lax.Precision.HIGH
+    if split:
+        pb_hi, pb_lo = split_hi_lo_xla(pose_basis2)
+        wt_hi, wt_lo = split_hi_lo_xla(wt2)
+        operands = (tvs_hi, tvs_lo, tjx, tjy, tjz,
+                    pb_hi, pb_lo, wt_hi, wt_lo, idx, *slabs)
+        in_specs = [const_tvs, const_tvs, const_tj, const_tj, const_tj,
+                    const_pb, const_pb, const_wt, const_wt, spec_idx,
+                    *([spec_bj] * 3)]
+    else:
+        operands = (tvs_hi, tvs_lo, tjx, tjy, tjz,
+                    pose_basis2, wt2, idx, *slabs)
+        in_specs = [const_tvs, const_tvs, const_tj, const_tj, const_tj,
+                    const_pb, const_wt, spec_idx,
+                    *([spec_bj] * 3)]
+    outs = pl.pallas_call(
+        functools.partial(_posed_gather_kernel, vp, levels,
+                          precision, split),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[spec_bv] * 3,
+        out_shape=[jax.ShapeDtypeStruct((bp, vp), jnp.float32)] * 3,
+        interpret=interpret,
+    )(*operands)
+    return jnp.stack(outs, axis=-1)[:b, :v, :]

@@ -272,6 +272,50 @@ def build_posed_gather_executable(table_dev, bucket: int, n_joints: int,
     return jitted
 
 
+def default_posed_interpret() -> bool:
+    """Fused-posed-kernel interpret default: the Pallas TPU kernel
+    needs Mosaic (a real chip); every other backend runs it through the
+    Pallas interpreter — compiled XLA emulation, slower than the chip
+    kernel but numerically the same program (the interpret lane the
+    whole PR-10 tier was proven in)."""
+    import jax
+
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def build_posed_gather_fused_executable(table_dev, bucket: int,
+                                        n_joints: int, dtype, donate: bool,
+                                        interpret: bool):
+    """The per-bucket FUSED gathered pose-only executable (PR 10).
+
+    Same calling convention and runtime-argument contract as
+    ``build_posed_gather_executable`` — the SubjectTable and the int32
+    [B] index are runtime ARGUMENTS, one compiled kernel per
+    (bucket, capacity) serves every subject mixture, only the pose
+    buffer is donated — but the program body is the single Pallas
+    launch ``core.forward_posed_gather_fused`` (gather + pose blend +
+    FK + skin in VMEM, ops/pallas_posed.py). Numerics are within ~1e-5
+    of the XLA gathered program, NOT bit-identical, which is why this
+    tier never loads from (or bakes into) the PR-6 AOT lattice: the
+    lattice's contract is bit-identity with the live jit of the XLA
+    family, and a silent family swap across a restart would break it.
+    Eagerly warmed; the caller counts the compile.
+    """
+    import jax
+
+    from mano_hand_tpu.models import core
+
+    jitted = jax.jit(
+        lambda tab, idx, p: core.forward_posed_gather_fused(
+            tab, idx, p, interpret=interpret),
+        donate_argnums=(2,) if donate else (),
+    )
+    jax.block_until_ready(jitted(
+        table_dev, np.zeros((bucket,), np.int32),
+        np.zeros((bucket, n_joints, 3), dtype)))
+    return jitted
+
+
 def build_cpu_fallback_executable(params_host, bucket: int, n_joints: int,
                                   n_shape: int, dtype):
     """The graceful-degradation executable: the SAME program family as
@@ -382,6 +426,25 @@ class ServingEngine:
     busy_fraction: the soft backpressure threshold: ``load()`` reports
         a tier "busy" (try later) once outstanding crosses this
         fraction of its quota, before hard shedding begins.
+    posed_kernel: which program family serves the gathered pose-only
+        path (PR 10). ``"xla"`` (default) keeps the PR-4 XLA gathered
+        program; ``"fused"`` selects the single-launch Pallas kernel
+        (``core.forward_posed_gather_fused``: SubjectTable row gather +
+        pose blend + FK + skinning in VMEM, ops/pallas_posed.py) — same
+        runtime-argument contract (zero per-subject recompiles, one
+        program per bucket x capacity), numerics within ~1e-5 of the
+        XLA family rather than bit-identical. The fused tier composes
+        with supervised dispatch/chaos/failover unchanged (the CPU
+        fallback stays the clean bit-identity tier) and is exported to
+        the numerics sentinel, but is gated by table capacity: above
+        ``pallas_posed.POSED_FUSED_MAX_CAPACITY`` (VMEM residency) the
+        engine silently serves the XLA family instead, and it never
+        enters the PR-6 AOT lattice (the lattice contract is
+        bit-identity with the live XLA jit).
+    posed_kernel_interpret: run the fused tier through the Pallas
+        interpreter (None = auto: real TPU backends use Mosaic,
+        everything else interprets — the CPU lanes/tests/bench-interpret
+        path). Ignored under ``posed_kernel="xla"``.
     tracer: an ``obs.Tracer`` (PR 8). None (default) disables tracing
         entirely — zero calls on every path. With a tracer, every
         request carries a span (see the module docstring), runtime
@@ -411,6 +474,8 @@ class ServingEngine:
         tier_quotas: Optional[dict] = None,
         busy_fraction: float = 0.75,
         tracer=None,
+        posed_kernel: str = "xla",
+        posed_kernel_interpret: Optional[bool] = None,
     ):
         self._params = params.astype(dtype)
         self._dtype = np.dtype(dtype)
@@ -451,6 +516,14 @@ class ServingEngine:
             raise ValueError(
                 f"busy_fraction must be in (0, 1], got {busy_fraction}")
         self.busy_fraction = float(busy_fraction)
+        if posed_kernel not in ("xla", "fused"):
+            raise ValueError(
+                f"posed_kernel must be 'xla' or 'fused', got "
+                f"{posed_kernel!r}")
+        self._posed_kernel = posed_kernel
+        # None = resolve lazily at first build (a jax backend query —
+        # the engine's constructor touches no backend by design).
+        self._posed_interpret = posed_kernel_interpret
         self._tracer = tracer
         if tracer is not None and policy is not None:
             breaker = getattr(policy, "breaker", None)
@@ -520,6 +593,33 @@ class ServingEngine:
         ``obs.metrics.engine_registry`` and ``obs.NumericsSentinel``."""
         return self._tracer
 
+    @property
+    def posed_kernel(self) -> str:
+        """The SELECTED gathered-path kernel tier ("xla" | "fused");
+        whether the fused tier actually serves also depends on the
+        live table capacity — see ``_posed_fused_active``."""
+        return self._posed_kernel
+
+    def _resolve_posed_interpret(self) -> bool:
+        """The fused tier's interpret flag, resolved once (a jax
+        backend query — must never run inside ``_exe_lock``)."""
+        if self._posed_interpret is None:
+            self._posed_interpret = default_posed_interpret()
+        return self._posed_interpret
+
+    def _posed_fused_active(self, capacity: Optional[int]) -> bool:
+        """Whether the fused kernel serves the gathered path at this
+        table capacity — the ONE tier-selection predicate (shared by
+        the executable builder and the sentinel export). Above the
+        kernel's VMEM residency budget the XLA family serves instead;
+        the flip is a capacity growth, i.e. warm-up-class work, counted
+        like every growth recompile."""
+        if self._posed_kernel != "fused" or capacity is None:
+            return False
+        from mano_hand_tpu.ops import pallas_posed
+
+        return pallas_posed.posed_fused_capacity_ok(capacity)
+
     def numerics_probe_targets(self) -> dict:
         """One consistent read of every LIVE program family — the raw
         material of the numerics sentinel (obs/sentinel.py, PR 9).
@@ -536,11 +636,24 @@ class ServingEngine:
         """
         if self._params_dev is None:
             self._params_dev = self._params.device_put()
+        # Resolved OUTSIDE the lock (a jax backend query) — the
+        # _install_subject rule: no device/backend work under _exe_lock.
+        interp = (self._resolve_posed_interpret()
+                  if self._posed_kernel == "fused" else False)
         with self._exe_lock:
+            cap = self._table.capacity if self._table is not None else None
             return {
                 "full": dict(self._exes),
-                "gather": {b: exe for b, (_, exe)
-                           in self._gather_exes.items()},
+                # Capacity-CONSISTENT entries only: a stale entry (built
+                # before a table growth; rebuilt eagerly by
+                # _install_subject, but a probe can race that rebuild)
+                # may be a FUSED program whose jit would raise on a
+                # table past the capacity gate — and would disagree
+                # with the gather_fused flag below either way. A probe
+                # that finds no current-capacity entry simply skips the
+                # family this round (the sentinel's live-families rule).
+                "gather": {b: exe for b, (c, exe)
+                           in self._gather_exes.items() if c == cap},
                 "cpu": dict(self._cpu_exes),
                 "table": self._table,
                 "params": self._params,
@@ -548,6 +661,13 @@ class ServingEngine:
                 "n_joints": self._n_joints,
                 "n_shape": self._n_shape,
                 "dtype": self._dtype,
+                # PR 10: which family the "gather" callables actually
+                # are, so the sentinel derives its clean reference from
+                # the SAME trace (fused is not bit-identical to XLA —
+                # an XLA reference would read as permanent drift).
+                "posed_kernel": self._posed_kernel,
+                "gather_fused": self._posed_fused_active(cap),
+                "gather_fused_interpret": interp,
             }
 
     # ------------------------------------------------------------ lifecycle
@@ -1484,7 +1604,24 @@ class ServingEngine:
         if entry is not None and entry[0] == cap:
             return entry[1]
         exe = None
-        lat = self._get_lattice()
+        fused = self._posed_fused_active(cap)
+        if fused:
+            # The fused kernel tier (PR 10): same runtime-argument
+            # contract (zero per-subject recompiles), different program
+            # family — and deliberately NO lattice tier for it (fused
+            # is within ~1e-5 of the XLA family, not bit-identical;
+            # serving a lattice-persisted XLA program under the fused
+            # selection would silently swap numerics across a restart).
+            # Resolve interpret BEFORE any build (backend query).
+            interp = self._resolve_posed_interpret()
+            exe = build_posed_gather_fused_executable(
+                table, bucket, self._n_joints, self._dtype,
+                donate=self.donate, interpret=interp)
+            self.counters.count_compile()
+            if self._tracer is not None:
+                self._tracer.runtime_event("compile", family="gather_fused",
+                                           bucket=bucket, capacity=cap)
+        lat = self._get_lattice() if exe is None else None
         if lat is not None:
             # Lattice tier (PR 6): the gathered program finally has a
             # persistent form — table and index are runtime arguments,

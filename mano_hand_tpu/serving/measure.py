@@ -1807,3 +1807,281 @@ def metrics_overhead_run(
         out["metrics_export"] = export_metrics_dir(
             final_snapshot, metrics_dir, slo=slo)
     return out
+
+
+def posed_kernel_bench_run(
+    params,
+    *,
+    subjects: int = 8,
+    requests: int = 96,
+    min_rows: int = 1,
+    max_rows: int = 4,
+    max_bucket: int = 64,
+    max_delay_s: float = 0.002,
+    seed: int = 0,
+    trials: int = 5,
+    lm_batch: int = 32,
+    lm_steps: Tuple[int, int] = (4, 10),
+    lm_iters: int = 3,
+    interpret: Optional[bool] = None,
+    trace_dir=None,
+    log: Callable[[str], None] = None,
+) -> dict:
+    """THE fused-vs-XLA gathered-dispatch benchmark protocol — bench.py
+    config14 (PR 10).
+
+    The serving hot path's kernel tier, measured where it serves: the
+    SAME mixed-subject pose-only stream drives TWO engines — one on the
+    fused Pallas gathered kernel (``posed_kernel="fused"``,
+    ops/pallas_posed.py), one on the PR-4 XLA gathered program — and
+    the comparison is SLOPE-TIMED through the engine (t(all requests)
+    minus t(half), so per-eval cost sheds the fixed submit/coalesce/
+    dispatcher overhead both sides share; naive per-pass timing on the
+    tunnel lies — bench.py:slope_time's reasoning applied at the
+    request-stream level). All four timing points run INTERLEAVED per
+    trial with alternating order and min-over-trials per point (the
+    measure_overhead drift defense; this box's load moves 5x between
+    seconds).
+
+    Returned criteria numbers (scripts/bench_report.py judges):
+
+    * ``fused_vs_gather_max_abs_err`` <= 1e-5 — the fused tier's rows
+      vs the per-subject posed program (== ``forward_posed_gather``
+      bit-identically) at matched padded size, probed through the LIVE
+      engine, mixed-subject coalesced batches included (the kernel's
+      rows are computed independently, so parity is row-wise
+      well-defined at any batch composition);
+    * ``xla_vs_gather_max_abs_err`` == 0.0 — the control side keeps the
+      PR-4 bit-identity contract (a nonzero here means the harness, not
+      the kernel, drifted);
+    * ``steady_recompiles_fused`` == ``steady_recompiles_xla`` == 0 —
+      both tiers serve every subject mixture from warm executables
+      (table + index are runtime args on BOTH);
+    * ``fused_vs_xla_ratio`` — the headline speed number, judged ONLY
+      on a real TPU (``platform``/``interpret`` ride in the artifact:
+      the CPU lane runs the kernel through the Pallas interpreter,
+      where the ratio measures emulation overhead, not the chip — the
+      chip leg is queued via scripts/bench_tpu_wait.sh).
+
+    The ``lm_e2e_*`` sub-leg rides along (ROADMAP item 2b): end-to-end
+    ``fit_lm`` steps/s with the batched-LU normal equations that landed
+    8x the vmapped Cholesky in ISOLATION but were never measured
+    end-to-end on chip — slope-timed over ``lm_steps`` so the fixed
+    setup cost cancels, recorded here so the first tunnel-up window
+    measures both halves of ROADMAP item 2 in one artifact.
+
+    ``trace_dir`` exports the fused engine's Chrome-trace timeline +
+    flight record into ``<trace_dir>/posed_kernel/`` (a subdirectory so
+    config12's export is not clobbered); ``scripts/trace_report.py``
+    globs recursively and reports both.
+    """
+    import jax
+
+    from mano_hand_tpu.models import core
+    from mano_hand_tpu.serving import buckets as bucket_mod
+    from mano_hand_tpu.serving.engine import ServingEngine
+
+    if subjects < 1:
+        raise ValueError(f"subjects must be >= 1, got {subjects}")
+    if requests < 2:
+        raise ValueError(f"requests must be >= 2, got {requests}")
+    log = _logger(log)
+    max_rows = min(max_rows, max_bucket)
+    min_rows = max(1, min(min_rows, max_rows))
+    n_joints, n_shape = params.n_joints, params.n_shape
+    rng = np.random.default_rng(seed)
+    betas = [rng.normal(size=(n_shape,)).astype(np.float32)
+             for _ in range(subjects)]
+    sizes = rng.integers(min_rows, max_rows + 1, size=requests)
+    subj_of = rng.integers(0, subjects, size=requests)
+    stream = [
+        (rng.normal(scale=0.4,
+                    size=(int(n), n_joints, 3)).astype(np.float32), int(s))
+        for n, s in zip(sizes, subj_of)
+    ]
+    # The two slope points: the full stream and its first half. Request
+    # mix (sizes, subjects) is identical over the shared prefix, so the
+    # slope is the marginal cost of the TAIL requests with the fixed
+    # overhead (dispatcher wake, first-batch assembly) cancelled.
+    m1 = max(1, requests // 2)
+    m2 = requests
+    rows_m1 = int(sizes[:m1].sum())
+    rows_m2 = int(sizes.sum())
+    d_rows = rows_m2 - rows_m1
+
+    tracer_f, tracer_x = Tracer(), Tracer()
+    eng_f = ServingEngine(params, max_bucket=max_bucket,
+                          max_delay_s=max_delay_s, tracer=tracer_f,
+                          posed_kernel="fused",
+                          posed_kernel_interpret=interpret)
+    eng_x = ServingEngine(params, max_bucket=max_bucket,
+                          max_delay_s=max_delay_s, tracer=tracer_x,
+                          posed_kernel="xla")
+
+    prm_dev = params.astype(np.float32).device_put()
+    shaped = [core.jit_specialize(prm_dev, b) for b in betas]
+    # The row-wise parity reference: the per-subject posed program —
+    # the PR-4 gathered family is f32 bit-identical to it per row, so
+    # one reference serves both sides' parity numbers.
+    ref_exe = jax.jit(
+        lambda sh, p: core.forward_posed_batched(sh, p).verts)
+
+    def ref_one(pose, si):
+        b = bucket_mod.bucket_for(pose.shape[0], eng_f.buckets)
+        out = ref_exe(shaped[si],
+                      np.asarray(bucket_mod.pad_rows(pose, b)))
+        return np.asarray(out)[:pose.shape[0]]
+
+    def run_stream(eng, keys, m):
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, subject=keys[si]) for p, si in stream[:m]]
+        for f in futs:
+            f.result()
+        return time.perf_counter() - t0
+
+    results = {}
+    with eng_f, eng_x:
+        keys_f = [eng_f.specialize(b) for b in betas]
+        keys_x = [eng_x.specialize(b) for b in betas]
+        log(f"posed-kernel: {subjects} subjects baked on both engines, "
+            f"warming buckets {eng_f.buckets}")
+        src_f = eng_f.warmup_posed()
+        eng_x.warmup_posed()
+        for b in eng_f.buckets:   # warm the parity reference's buckets
+            jax.block_until_ready(ref_exe(
+                shaped[0], np.zeros((b, n_joints, 3), np.float32)))
+
+        # Parity through the LIVE engines (the CLAUDE.md in-context
+        # rule): sequential single requests AND a concurrently-
+        # submitted mixed-subject burst that coalesces into gathered
+        # batches on each side.
+        err_f = err_x = 0.0
+        probe = stream[:min(8, len(stream))]
+        for pose, si in probe:
+            err_f = max(err_f, float(np.abs(
+                eng_f.forward(pose, subject=keys_f[si])
+                - ref_one(pose, si)).max()))
+            err_x = max(err_x, float(np.abs(
+                eng_x.forward(pose, subject=keys_x[si])
+                - ref_one(pose, si)).max()))
+        futs_f = [eng_f.submit(p, subject=keys_f[si]) for p, si in probe]
+        futs_x = [eng_x.submit(p, subject=keys_x[si]) for p, si in probe]
+        for (pose, si), ff, fx in zip(probe, futs_f, futs_x):
+            want = ref_one(pose, si)
+            err_f = max(err_f, float(np.abs(ff.result() - want).max()))
+            err_x = max(err_x, float(np.abs(fx.result() - want).max()))
+
+        run_stream(eng_f, keys_f, m2)
+        run_stream(eng_x, keys_x, m2)   # settle both sides untimed
+        compiles_f = eng_f.counters.compiles
+        compiles_x = eng_x.counters.compiles
+
+        thunks = {
+            "f1": lambda: run_stream(eng_f, keys_f, m1),
+            "f2": lambda: run_stream(eng_f, keys_f, m2),
+            "x1": lambda: run_stream(eng_x, keys_x, m1),
+            "x2": lambda: run_stream(eng_x, keys_x, m2),
+        }
+        best = {k: float("inf") for k in thunks}
+        for t in range(max(1, trials)):
+            order = sorted(thunks) if t % 2 == 0 \
+                else sorted(thunks, reverse=True)
+            for k in order:
+                best[k] = min(best[k], thunks[k]())
+        steady_f = eng_f.counters.compiles - compiles_f
+        steady_x = eng_x.counters.compiles - compiles_x
+        snap_f = eng_f.counters.snapshot()
+        cap = eng_f.numerics_probe_targets()
+        results.update({
+            "capacity": cap["table"].capacity,
+            "gather_fused_active": bool(cap["gather_fused"]),
+            "interpret": bool(cap["gather_fused_interpret"]),
+        })
+
+    d_f = best["f2"] - best["f1"]
+    d_x = best["x2"] - best["x1"]
+    fused_rate = d_rows / d_f if d_f > 0 else float("nan")
+    xla_rate = d_rows / d_x if d_x > 0 else float("nan")
+    ratio = d_x / d_f if d_f > 0 and d_x > 0 else float("nan")
+    platform = jax.default_backend()
+    log(f"posed-kernel: fused {fused_rate:,.0f} vs xla {xla_rate:,.0f} "
+        f"evals/s (slope ratio {ratio:.2f}x, platform {platform}, "
+        f"interpret={results.get('interpret')}), parity fused "
+        f"{err_f:.2e} / xla {err_x:.2e}, steady recompiles "
+        f"{steady_f}/{steady_x}")
+
+    # -- ROADMAP 2b sub-leg: end-to-end LM steps/s (batched-LU solve) --
+    # lm_batch=0 skips it: the two fit_lm step-count programs are cold
+    # compiles in a fresh cache, which plumbing-size lanes (the bench
+    # tiny-e2e test inside the tier-1 budget) cannot afford — the
+    # config13 skip precedent. The judge prints lm_e2e only when
+    # present, so a skipped sub-leg is unmeasured, never failed.
+    lm = {}
+    if lm_batch > 0:
+        from mano_hand_tpu.fitting import fit_lm
+
+        lm_pose = rng.normal(
+            scale=0.3, size=(lm_batch, n_joints, 3)).astype(np.float32)
+        lm_beta = rng.normal(size=(n_shape,)).astype(np.float32)
+        targets = core.jit_forward_batched(
+            prm_dev, lm_pose,
+            np.broadcast_to(lm_beta, (lm_batch, n_shape))).verts
+
+        def run_lm(steps):
+            return float(fit_lm(prm_dev, targets,
+                                n_steps=steps).final_loss.sum())
+
+        run_lm(lm_steps[0])   # compile + settle both step-count programs
+        run_lm(lm_steps[1])
+        best_lm = {s: float("inf") for s in lm_steps}
+        for t in range(max(1, lm_iters)):
+            order = lm_steps if t % 2 == 0 else lm_steps[::-1]
+            for s in order:
+                t0 = time.perf_counter()
+                run_lm(s)
+                best_lm[s] = min(best_lm[s], time.perf_counter() - t0)
+        d_lm = best_lm[lm_steps[1]] - best_lm[lm_steps[0]]
+        lm_rate = ((lm_steps[1] - lm_steps[0]) / d_lm
+                   if d_lm > 0 else float("nan"))
+        log(f"posed-kernel lm_e2e b={lm_batch}: {lm_rate:,.1f} steps/s "
+            f"(batched-LU normal equations, analytic Jacobian)")
+        lm = {
+            "lm_e2e_steps_per_sec": float(f"{lm_rate:.5g}"),
+            "lm_e2e_batch": int(lm_batch),
+            "lm_e2e_steps": list(lm_steps),
+            "lm_e2e_jacobian": "analytic",
+            "lm_e2e_normal_eq": "high",
+        }
+
+    results.update({
+        "subjects": int(subjects),
+        "requests": int(requests),
+        "rows": [int(sizes.min()), int(sizes.max())],
+        "buckets": list(eng_f.buckets),
+        "platform": platform,
+        "warmup_posed_sources": src_f,
+        "slope_points": {"m1": m1, "m2": m2,
+                         "rows_m1": rows_m1, "rows_m2": rows_m2},
+        "fused_evals_per_sec": float(f"{fused_rate:.5g}"),
+        "xla_evals_per_sec": float(f"{xla_rate:.5g}"),
+        "fused_vs_xla_ratio": float(f"{ratio:.4g}"),
+        "fused_vs_gather_max_abs_err": err_f,
+        "xla_vs_gather_max_abs_err": err_x,
+        "steady_recompiles_fused": int(steady_f),
+        "steady_recompiles_xla": int(steady_x),
+        "mixed_subject_batches": snap_f["mixed_subject_batches"],
+        "coalesce_width_mean": snap_f["coalesce_width_mean"],
+        "dispatches": snap_f["dispatches"],
+        **lm,
+        "flight_record": flight_record(
+            tracer_f, eng_f.counters, reason="posed_kernel_complete"),
+    })
+    if trace_dir is not None:
+        import os
+
+        from mano_hand_tpu.obs import write_trace_dir
+
+        results["trace_export"] = write_trace_dir(
+            tracer_f, os.path.join(str(trace_dir), "posed_kernel"),
+            counters=eng_f.counters, reason="posed_kernel_complete")
+    return results

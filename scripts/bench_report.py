@@ -724,6 +724,72 @@ def main() -> int:
                 # smoke run records the numbers without judging them.
                 print(f"  [info] spec LM (b<64, speed unjudged): {msg}")
 
+    def judge_posed_kernel(pk):
+        """Done-criteria of the fused gathered-serving-kernel leg
+        (config14, PR 10): the fused Pallas tier within 1e-5 of the
+        posed reference per row through the LIVE engine (mixed-subject
+        coalesced batches included), the XLA control side bit-identical
+        (the PR-4 contract intact), zero steady recompiles on BOTH
+        kernel tiers, and — on a real TPU only — the fused slope >= 1.2x
+        the XLA gathered program (the CPU lane runs the kernel through
+        the Pallas interpreter, where the ratio measures emulation
+        overhead; its numbers are recorded unjudged, the coalesce
+        subjects<8 precedent)."""
+        ferr = pk.get("fused_vs_gather_max_abs_err")
+        check("posed_fused_parity",
+              ferr is not None and ferr <= 1e-5,
+              f"fused-vs-posed-reference max abs err "
+              f"{'missing' if ferr is None else f'{ferr:.3e}'} "
+              f"(gate 1e-5; probed through the live engine, "
+              f"{pk.get('mixed_subject_batches')} mixed-subject batches)")
+        xerr = pk.get("xla_vs_gather_max_abs_err")
+        check("posed_xla_bitwise", xerr == 0.0,
+              f"XLA-gathered control vs posed reference max abs err "
+              f"{xerr} (f32 bit-identity — the PR-4 contract)")
+        sf, sx = (pk.get("steady_recompiles_fused"),
+                  pk.get("steady_recompiles_xla"))
+        check("posed_zero_recompiles", sf == 0 and sx == 0,
+              f"steady recompiles fused {sf} / xla {sx} after warmup "
+              f"(capacity {pk.get('capacity')}, table + index as "
+              "runtime args on both tiers)")
+        ratio = pk.get("fused_vs_xla_ratio")
+        msg = (f"fused {pk.get('fused_evals_per_sec')} vs xla "
+               f"{pk.get('xla_evals_per_sec')} evals/s through the "
+               f"engine (slope ratio {ratio}x over "
+               f"{pk.get('requests')} requests x "
+               f"{pk.get('subjects')} subjects, platform "
+               f"{pk.get('platform')}, interpret={pk.get('interpret')})")
+        on_chip = (pk.get("platform") in ("tpu", "axon")
+                   and not pk.get("interpret"))
+        if on_chip:
+            check("posed_fused_12x", ratio is not None and ratio >= 1.2,
+                  msg)
+        else:
+            print(f"  [info] posed kernel (interpreter/CPU lane, speed "
+                  f"unjudged — chip leg queued via bench_tpu_wait): {msg}")
+        lm = pk.get("lm_e2e_steps_per_sec")
+        if lm is not None:
+            # ROADMAP 2b decision data: end-to-end steps/s of the landed
+            # batched-LU solve. The 200+ steps/s target is judged by the
+            # full bench's lm_180 criterion at chip scale; here it is
+            # recorded wherever the leg ran.
+            print(f"  [info] posed kernel lm_e2e: {lm:,.1f} steps/s at "
+                  f"b={pk.get('lm_e2e_batch')} "
+                  f"({pk.get('lm_e2e_jacobian')} Jacobian, "
+                  f"normal_eq={pk.get('lm_e2e_normal_eq')}, steps "
+                  f"{pk.get('lm_e2e_steps')})")
+        judge_flight_record("posed_kernel", pk)
+
+    if ("fused_vs_gather_max_abs_err" in line and "metric" not in line):
+        # A raw posed_kernel_bench_run artifact (no bench.py envelope):
+        # only the config14 criteria apply — same pattern as the raw
+        # drill artifacts below.
+        judge_posed_kernel(line)
+        bad = [n for n, ok in checks if not ok]
+        print("RESULT: " + ("POSED-KERNEL CRITERIA PASS" if not bad
+                            else f"failing: {', '.join(bad)}"))
+        return 0 if not bad else 1
+
     if "futures_resolved_fraction" in line and "metric" not in line:
         # A raw `serve-bench --chaos drill` artifact: only the recovery
         # criteria apply.
@@ -829,6 +895,13 @@ def main() -> int:
             check("metrics_leg_ran", False,
                   f"config13_metrics crashed: "
                   f"{line['config_errors']['config13_metrics']}")
+        pk = detail.get("posed_kernel")
+        if pk:
+            judge_posed_kernel(pk)
+        elif "config14_posed_kernel" in (line.get("config_errors") or {}):
+            check("posed_kernel_leg_ran", False,
+                  f"config14_posed_kernel crashed: "
+                  f"{line['config_errors']['config14_posed_kernel']}")
         bad = [n for n, ok in checks if not ok]
         print("RESULT: " + ("SERVING CRITERIA PASS" if not bad
                             else f"failing: {', '.join(bad)}"))
@@ -932,6 +1005,17 @@ def main() -> int:
         check("metrics_leg_ran", False,
               f"config13_metrics crashed: "
               f"{line['config_errors']['config13_metrics']}")
+
+    pk = detail.get("posed_kernel")
+    if pk:
+        # Fused gathered-kernel leg (config14, PR 10) — same presence
+        # rule: judge it wherever it ran (parity/recompile criteria are
+        # backend-independent; the speed ratio self-gates on platform).
+        judge_posed_kernel(pk)
+    elif "config14_posed_kernel" in (line.get("config_errors") or {}):
+        check("posed_kernel_leg_ran", False,
+              f"config14_posed_kernel crashed: "
+              f"{line['config_errors']['config14_posed_kernel']}")
 
     spec = detail.get("specialization")
     cfg_errs = line.get("config_errors") or {}

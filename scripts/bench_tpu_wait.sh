@@ -42,6 +42,18 @@
 #     runtime.health.CircuitBreaker's priority-claim awareness: while
 #     the driver's claim is fresh, no probes, no attempts.
 #
+# FIRST-WINDOW PAYLOAD (PR 10 / ROADMAP item 2): the queued chip
+# measurement for the fused GATHERED serving kernel rides every attempt
+# automatically — bench.py registers config14 (fused-vs-XLA gathered
+# slope through two engines + the lm_e2e end-to-end fit_lm steps/s
+# sub-leg) by default and schedules it inside the done-criteria-first
+# priority block, so even a minutes-long tunnel window (the r5 lesson)
+# salvages it; the --profile capture below gives the stage split the
+# roadmap says to READ before touching kernels, and the fused engine's
+# span timeline lands in "$OUT.trace/posed_kernel/" —
+#   python scripts/trace_report.py "$OUT.trace"   # merged stage report
+#   python scripts/bench_report.py "$OUT.out"     # config14 verdict
+#
 # Usage: scripts/bench_tpu_wait.sh [OUT_BASENAME] [DEADLINE_S]
 set -u
 cd "$(dirname "$0")/.."

@@ -225,12 +225,13 @@ def test_committed_lockstep_baseline_matches_head():
 def test_jaxpr_audit_clean_on_head_baseline():
     findings, measured = audit_programs(load_baseline())
     assert findings == [], [f.format() for f in findings]
-    # All five families represented by the six audited programs.
+    # All five families represented by the seven audited programs (the
+    # PR-10 fused gathered serving kernel audits under "fused").
     fams = {s.family for s in build_program_specs()}
     assert fams == {"full", "posed", "gathered", "fused", "cpu_fallback"}
     assert set(measured["programs"]) == {
         "full", "posed", "gathered", "fused_one", "fused_two",
-        "cpu_fallback"}
+        "gathered_fused", "cpu_fallback"}
 
 
 def _tiny_spec(fn, args, name="tiny", donate=(), expect=()):

@@ -249,6 +249,14 @@ def test_bench_cpu_tiny_run_end_to_end():
         # `make metrics-smoke`; criteria-sized numbers live in `make
         # serve-smoke` (the test_coldstart budget precedent).
         "--metrics-requests", "0",
+        # config14 (PR 10) rides at plumbing size with its fit_lm
+        # sub-leg SKIPPED (two cold step-count compiles — the config13
+        # budget reasoning; the sub-leg's plumbing runs in `make
+        # bench-interpret`, criteria-sized numbers in `make
+        # serve-smoke`).
+        "--posed-requests", "12", "--posed-subjects", "3",
+        "--posed-max-rows", "2", "--posed-max-bucket", "8",
+        "--posed-lm-batch", "0",
     )
     assert rc == 0, line
     assert line["value"] is not None and line["value"] > 0
@@ -274,6 +282,16 @@ def test_bench_cpu_tiny_run_end_to_end():
     assert spec["posed_evals_per_sec"] > 0
     assert spec["posed_vs_full_max_abs_err"] < 1e-4
     assert "lm_frozen_steps_per_sec" not in spec
+    # The fused gathered-kernel leg (config14, PR 10) rode along at
+    # plumbing size: parity + zero recompiles hold everywhere; the
+    # speed ratio and the skipped lm_e2e sub-leg are serve-smoke /
+    # bench-interpret material.
+    pk = d["posed_kernel"]
+    assert pk["fused_vs_gather_max_abs_err"] < 1e-5
+    assert pk["xla_vs_gather_max_abs_err"] == 0.0
+    assert pk["steady_recompiles_fused"] == 0
+    assert pk["steady_recompiles_xla"] == 0
+    assert "lm_e2e_steps_per_sec" not in pk
     assert "config_errors" not in line, line.get("config_errors")
 
 

@@ -246,6 +246,96 @@ def test_coalesce_metrics_block(tmp_path):
     assert "SERVING CRITERIA PASS" in p.stdout
 
 
+def test_posed_kernel_metrics_block(tmp_path):
+    """The fused gathered-kernel leg (config14, PR 10): parity <= 1e-5
+    through the live engine, bit-identical XLA control, zero steady
+    recompiles on both tiers, speed judged only on a real chip —
+    judged as a raw posed_kernel_bench_run artifact AND inside a
+    serving-only envelope."""
+    pk = {
+        "subjects": 8, "requests": 96, "rows": [1, 4],
+        "capacity": 8, "gather_fused_active": True,
+        "platform": "cpu", "interpret": True,
+        "slope_points": {"m1": 48, "m2": 96,
+                         "rows_m1": 118, "rows_m2": 239},
+        "fused_evals_per_sec": 21000.0, "xla_evals_per_sec": 31000.0,
+        "fused_vs_xla_ratio": 0.68,
+        "fused_vs_gather_max_abs_err": 2.7e-6,
+        "xla_vs_gather_max_abs_err": 0.0,
+        "steady_recompiles_fused": 0, "steady_recompiles_xla": 0,
+        "mixed_subject_batches": 17, "coalesce_width_mean": 4.2,
+        "dispatches": 60,
+        "lm_e2e_steps_per_sec": 208.5, "lm_e2e_batch": 32,
+        "lm_e2e_steps": [4, 10], "lm_e2e_jacobian": "analytic",
+        "lm_e2e_normal_eq": "high",
+    }
+    # Raw artifact, CPU/interpret lane: parity + recompiles judged,
+    # the speed ratio recorded unjudged (interpreter overhead).
+    raw = tmp_path / "posed_raw.json"
+    raw.write_text(json.dumps(pk))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] posed_fused_parity" in p.stdout
+    assert "[PASS] posed_xla_bitwise" in p.stdout
+    assert "[PASS] posed_zero_recompiles" in p.stdout
+    assert "speed unjudged" in p.stdout
+    assert "posed_fused_12x" not in p.stdout
+    assert "lm_e2e: 208.5 steps/s" in p.stdout
+    assert "POSED-KERNEL CRITERIA PASS" in p.stdout
+
+    # On a real TPU the speed criterion applies — and fails below 1.2x.
+    raw.write_text(json.dumps(dict(
+        pk, platform="tpu", interpret=False, fused_vs_xla_ratio=1.1)))
+    p = _run(str(raw))
+    assert p.returncode == 1
+    assert "[FAIL] posed_fused_12x" in p.stdout
+    raw.write_text(json.dumps(dict(
+        pk, platform="tpu", interpret=False, fused_vs_xla_ratio=2.2)))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] posed_fused_12x" in p.stdout
+
+    # Each criterion fails loudly on its own.
+    raw.write_text(json.dumps(dict(pk, fused_vs_gather_max_abs_err=3e-5)))
+    p = _run(str(raw))
+    assert p.returncode == 1 and "[FAIL] posed_fused_parity" in p.stdout
+    raw.write_text(json.dumps(dict(pk, xla_vs_gather_max_abs_err=1e-7)))
+    p = _run(str(raw))
+    assert p.returncode == 1 and "[FAIL] posed_xla_bitwise" in p.stdout
+    raw.write_text(json.dumps(dict(pk, steady_recompiles_fused=1)))
+    p = _run(str(raw))
+    assert p.returncode == 1 and "[FAIL] posed_zero_recompiles" in p.stdout
+
+    # Inside a serving-only envelope the block rides with the serving
+    # criteria; a crashed leg fails loudly instead of vanishing.
+    only = tmp_path / "serve_only.json"
+    envelope = {
+        "metric": "serving_engine_evals_per_sec", "value": 8114.4,
+        "unit": "evals/s", "vs_baseline": None, "device": "cpu:cpu",
+        "detail": {
+            "serving": {
+                "engine_evals_per_sec": 8114.4,
+                "engine_vs_direct_ratio": 1.297,
+                "warm_bucket": 32, "steady_recompiles": 0,
+                "requests": 64, "compiles": 6, "aot_loads": 0,
+                "dispatches": 54, "padding_waste": 0.14,
+            },
+            "posed_kernel": pk,
+        }}
+    only.write_text(json.dumps(envelope))
+    p = _run(str(only))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] posed_fused_parity" in p.stdout
+    assert "SERVING CRITERIA PASS" in p.stdout
+    crashed = dict(envelope, config_errors={
+        "config14_posed_kernel": "RuntimeError: boom"})
+    del crashed["detail"]["posed_kernel"]
+    only.write_text(json.dumps(crashed))
+    p = _run(str(only))
+    assert p.returncode == 1
+    assert "[FAIL] posed_kernel_leg_ran" in p.stdout
+
+
 def test_overload_metrics_block(tmp_path):
     """The overload/saturation drill (config10, PR 5): every future
     resolved within its budget, sheds without a device dispatch, tier-0

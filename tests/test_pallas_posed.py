@@ -1,0 +1,378 @@
+"""The fused gathered serving kernel (the PR-10 tentpole), CPU-verified.
+
+``ops/pallas_posed.py:forward_posed_gather_fused`` runs the SubjectTable
+row gather + pose-corrective blend + FK + skinning in ONE Pallas launch,
+with the table and the int32 [B] subject index as runtime arguments —
+the Pallas twin of ``core.forward_posed_gather``. Everything provable
+off-chip is pinned here through the Pallas interpreter (the tunnel-down
+acceptance path; the chip numbers ride bench config14 via
+scripts/bench_tpu_wait.sh):
+
+* parity — within 1e-5 max abs err (f32) of the XLA gathered program
+  per row, for any subject mixture, any block tile, and through the
+  LIVE engine at awkward mixed-subject batch compositions;
+* the engine tier — ``ServingEngine(posed_kernel="fused")`` serves
+  every mixture with ZERO steady recompiles (table + index stay
+  runtime args), LRU-evicted subjects re-bake transparently, and the
+  capacity gate falls back to the XLA family above the kernel's VMEM
+  residency budget;
+* fault composition — a persistent primary outage under the fused tier
+  fails over to the CPU full-forward tier BIT-identically to the direct
+  CPU program (the PR-3/4 contract is tier-independent);
+* the sentinel — probes the fused family against a same-trace clean
+  reference (0.0 err; an XLA reference would read as permanent drift)
+  and still catches an injected wrong-output fault.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mano_hand_tpu.models import core
+from mano_hand_tpu.ops import pallas_posed
+from mano_hand_tpu.runtime import chaos, health
+from mano_hand_tpu.runtime.supervise import DispatchPolicy
+from mano_hand_tpu.serving import ServingEngine, bucket_for, pad_rows
+
+# quick: the seconds-scale `make check-quick` pre-commit lane. slow:
+# the tier-1 `-m 'not slow'` lane is budget-bound (870 s); canonical
+# runner `make posed-kernel-smoke` (own pytest process + cache dir, in
+# `make check`) — the test_coldstart/test_serving_coalesce precedent,
+# which is also why `make test` --ignore's this module.
+pytestmark = [pytest.mark.quick, pytest.mark.slow]
+
+#: The fused kernel is NOT bit-identical to the XLA gathered program
+#: (3-pass bf16 MXU policy vs XLA f32); the PR-10 acceptance gate.
+TOL = 1e-5
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+def _betas(n, seed=3, scale=0.5):
+    rng = np.random.default_rng(seed)
+    return [rng.normal(scale=scale, size=10).astype(np.float32)
+            for _ in range(n)]
+
+
+def _table(params32, betas):
+    return core.stack_shaped(
+        [core.specialize(params32, b) for b in betas])
+
+
+def _policy(plan=None, breaker=None, **kw):
+    kw.setdefault("deadline_s", None)
+    kw.setdefault("retries", 0)
+    kw.setdefault("backoff_s", 0.001)
+    kw.setdefault("jitter", 0.0)
+    return DispatchPolicy(breaker=breaker, chaos=plan, **kw)
+
+
+# ------------------------------------------------------------ the kernel
+def test_fused_parity_vs_xla_gathered(params32):
+    """Kernel vs the XLA gathered program: every row within TOL for a
+    mixed index, at several batch tiles (incl. a tile larger than the
+    batch and a ragged final tile), and vs the per-subject posed
+    program row-wise (the same reference the engine criteria use)."""
+    rng = np.random.default_rng(5)
+    betas = _betas(6, seed=5)
+    table = _table(params32, betas)
+    idx = rng.integers(0, 6, size=11).astype(np.int32)
+    pose = rng.normal(scale=0.4, size=(11, 16, 3)).astype(np.float32)
+    want = np.asarray(core.forward_posed_gather(table, idx, pose).verts)
+    for bb in (3, 4, 64):
+        got = np.asarray(core.forward_posed_gather_fused(
+            table, idx, pose, block_b=bb, interpret=True))
+        assert np.abs(got - want).max() < TOL, f"block_b={bb}"
+    # Row-wise vs the per-subject posed program (bit-identical to the
+    # gathered rows — so the same TOL must hold).
+    got = np.asarray(core.forward_posed_gather_fused(
+        table, idx, pose, block_b=4, interpret=True))
+    for r in range(11):
+        want_r = np.asarray(core.forward_posed(
+            core.table_row(table, int(idx[r])), pose[r]).verts)
+        assert np.abs(got[r] - want_r).max() < TOL, f"row {r}"
+
+
+def test_fused_single_subject_and_highest_precision(params32):
+    """Degenerate one-subject table; HIGHEST precision plumbs through
+    (the 6-pass kernel_dot path) within the same gate."""
+    betas = _betas(1, seed=7)
+    table = _table(params32, betas)
+    pose = np.random.default_rng(7).normal(
+        scale=0.4, size=(3, 16, 3)).astype(np.float32)
+    idx = np.zeros(3, np.int32)
+    want = np.asarray(core.forward_posed_gather(table, idx, pose).verts)
+    got = np.asarray(core.forward_posed_gather_fused(
+        table, idx, pose, interpret=True))
+    assert np.abs(got - want).max() < TOL
+    hi = jax.lax.Precision.HIGHEST
+    want_hi = np.asarray(core.forward_posed_gather(
+        table, idx, pose, precision=hi).verts)
+    got_hi = np.asarray(core.forward_posed_gather_fused(
+        table, idx, pose, precision=hi, interpret=True))
+    assert np.abs(got_hi - want_hi).max() < TOL
+
+
+def test_fused_guards(params32):
+    """Empty batch short-circuits; over-budget capacity and oversize
+    launches refuse by name (the VMEM-residency gate and the measured
+    8192-rows dead-end)."""
+    table = _table(params32, _betas(2, seed=9))
+    out = core.forward_posed_gather_fused(
+        table, np.zeros((0,), np.int32),
+        np.zeros((0, 16, 3), np.float32), interpret=True)
+    assert out.shape == (0, 778, 3)
+    assert pallas_posed.posed_fused_capacity_ok(
+        pallas_posed.POSED_FUSED_MAX_CAPACITY)
+    assert not pallas_posed.posed_fused_capacity_ok(
+        pallas_posed.POSED_FUSED_MAX_CAPACITY + 1)
+    grown = core.table_grow(table, pallas_posed.POSED_FUSED_MAX_CAPACITY + 1)
+    with pytest.raises(ValueError, match="VMEM"):
+        pallas_posed.forward_posed_gather_fused(
+            grown, np.zeros((1,), np.int32),
+            np.zeros((1, 16, 3), np.float32), interpret=True)
+    with pytest.raises(ValueError, match="8192"):
+        pallas_posed.forward_posed_gather_fused(
+            table, np.zeros((8193,), np.int32),
+            np.zeros((8193, 16, 3), np.float32), interpret=True)
+
+
+def test_fused_jit_runtime_args_no_retrace(params32):
+    """One jitted program serves every subject mixture AND every
+    functional table update (row rewrite) at fixed shapes — the
+    runtime-arguments contract the serving tier relies on."""
+    betas = _betas(3, seed=11)
+    table = _table(params32, betas)
+    pose = np.random.default_rng(11).normal(
+        scale=0.4, size=(4, 16, 3)).astype(np.float32)
+    traces = [0]
+
+    @jax.jit
+    def fused(tab, ix, p):
+        traces[0] += 1
+        return core.forward_posed_gather_fused(tab, ix, p, interpret=True)
+
+    i1 = np.array([0, 1, 2, 0], np.int32)
+    i2 = np.array([2, 2, 1, 1], np.int32)
+    o1 = fused(table, i1, pose)
+    o2 = fused(table, i2, pose)
+    new_sh = core.specialize(params32, _betas(1, seed=99)[0])
+    table2 = core.table_set_row(table, 1, new_sh)
+    o3 = fused(table2, i2, pose)
+    assert traces[0] == 1
+    for o, t, ix in ((o1, table, i1), (o2, table, i2), (o3, table2, i2)):
+        want = np.asarray(core.forward_posed_gather(t, ix, pose).verts)
+        assert np.abs(np.asarray(o) - want).max() < TOL
+
+
+# ------------------------------------------------------------- the engine
+def _prestuffed(eng, submits):
+    """Submit with the dispatcher held off, then start it: one
+    deterministic _coalesce scan (the test_serving_coalesce idiom)."""
+    orig_start = eng.start
+    eng.start = lambda: eng
+    try:
+        futs = [eng.submit(p, **kw) for p, kw in submits]
+    finally:
+        eng.start = orig_start
+    eng.start()
+    return futs
+
+
+def test_engine_fused_mixed_subject_parity_zero_recompiles(params32):
+    """The LIVE fused tier: an awkward mixed-subject coalesced batch
+    (1+2+3 rows, three subjects) and sequential singles all within TOL
+    of the per-subject posed reference at the dispatch bucket, with
+    ZERO steady recompiles after warmup — and the tier is visibly
+    'fused' in the probe-target export."""
+    rng = np.random.default_rng(13)
+    betas = _betas(3, seed=13)
+    shaped = [core.jit_specialize(params32, jnp.asarray(b))
+              for b in betas]
+    with ServingEngine(params32, max_bucket=8, max_delay_s=0.0,
+                       posed_kernel="fused") as eng:
+        keys = [eng.specialize(b) for b in betas]
+        eng.warmup_posed()
+        warm = eng.counters.compiles
+        t = eng.numerics_probe_targets()
+        assert t["posed_kernel"] == "fused"
+        assert t["gather_fused"] is True
+        assert t["gather_fused_interpret"] is True  # CPU backend
+
+        sizes = [1, 2, 3]
+        poses = [rng.normal(scale=0.4, size=(n, 16, 3)).astype(np.float32)
+                 for n in sizes]
+        futs = _prestuffed(eng, [
+            (p, {"subject": keys[i]}) for i, p in enumerate(poses)])
+        bucket = bucket_for(sum(sizes), eng.buckets)
+        for i, (p, f) in enumerate(zip(poses, futs)):
+            got = f.result(timeout=60.0)
+            want = np.asarray(core.jit_forward_posed_batched(
+                shaped[i], jnp.asarray(pad_rows(p, bucket))).verts)
+            assert np.abs(got - want[:p.shape[0]]).max() < TOL, i
+        assert eng.counters.mixed_subject_batches >= 1
+
+        for i in range(3):
+            p1 = rng.normal(scale=0.4,
+                            size=(2, 16, 3)).astype(np.float32)
+            got = eng.forward(p1, subject=keys[i])
+            want = np.asarray(core.jit_forward_posed_batched(
+                shaped[i], jnp.asarray(pad_rows(p1, 2))).verts)
+            assert np.abs(got - want).max() < TOL
+        assert eng.counters.compiles - warm == 0
+
+
+def test_engine_fused_lru_eviction_and_rebake(params32):
+    """Above max_subjects the fused tier's LRU eviction stays a data
+    operation: the evicted subject re-bakes on its next dispatch with
+    zero recompiles (table + index are runtime args on the fused
+    program too) and parity holds."""
+    rng = np.random.default_rng(17)
+    betas = _betas(3, seed=17)
+    with ServingEngine(params32, max_bucket=4, max_delay_s=0.0,
+                       max_subjects=2, posed_kernel="fused") as eng:
+        k0 = eng.specialize(betas[0])
+        k1 = eng.specialize(betas[1])
+        eng.warmup_posed()
+        warm = eng.counters.compiles
+        k2 = eng.specialize(betas[2])      # evicts LRU (betas[0])
+        assert eng.counters.specializations_evicted == 1
+        p = rng.normal(scale=0.4, size=(2, 16, 3)).astype(np.float32)
+        for k, b in ((k2, betas[2]), (k0, betas[0]), (k1, betas[1])):
+            got = eng.forward(p, subject=k)   # k0 re-bakes transparently
+            want = np.asarray(core.jit_forward_posed_batched(
+                core.jit_specialize(params32, jnp.asarray(b)),
+                jnp.asarray(pad_rows(p, 2))).verts)
+            assert np.abs(got - want).max() < TOL
+        assert eng.counters.compiles - warm == 0
+
+
+def test_engine_fused_capacity_gate_falls_back_to_xla(params32,
+                                                     monkeypatch):
+    """Above the kernel's VMEM residency budget the engine serves the
+    XLA gathered family instead — selection stays 'fused', results
+    stay BIT-identical to the posed reference (it is the XLA program),
+    and the probe export says the fused tier is inactive."""
+    monkeypatch.setattr(pallas_posed, "POSED_FUSED_MAX_CAPACITY", 4)
+    rng = np.random.default_rng(19)
+    betas = _betas(6, seed=19)   # > 4 subjects forces capacity 8 > gate
+    with ServingEngine(params32, max_bucket=4, max_delay_s=0.0,
+                       posed_kernel="fused") as eng:
+        keys = [eng.specialize(b) for b in betas]
+        eng.warmup_posed()
+        t = eng.numerics_probe_targets()
+        assert t["posed_kernel"] == "fused"
+        assert t["gather_fused"] is False    # over budget -> XLA family
+        p = rng.normal(scale=0.4, size=(2, 16, 3)).astype(np.float32)
+        got = eng.forward(p, subject=keys[5])
+        want = np.asarray(core.jit_forward_posed_batched(
+            core.jit_specialize(params32, jnp.asarray(betas[5])),
+            jnp.asarray(pad_rows(p, 2))).verts)
+        np.testing.assert_array_equal(got, want)   # f32 == (XLA family)
+        # The probe export is capacity-CONSISTENT: a stale entry (built
+        # against a pre-growth table — here simulated, since
+        # _install_subject rebuilds eagerly and the real window is a
+        # race) must be filtered out rather than handed to the
+        # sentinel, where a stale FUSED program would raise on the
+        # grown table and read as recurring probe errors.
+        with eng._exe_lock:
+            eng._gather_exes[99] = (4, lambda *a: 1 / 0)
+        t2 = eng.numerics_probe_targets()
+        assert 99 not in t2["gather"]
+        assert all(b in eng.buckets for b in t2["gather"])
+        with eng._exe_lock:
+            del eng._gather_exes[99]
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_engine_fused_failover_cpu_bit_identical(params32):
+    """A persistent primary outage under the FUSED tier fails the
+    mixed-subject batch over to the CPU full-forward program with
+    per-row betas — bit-identical to the direct CPU call (the clean
+    tier is family-independent; the kernel never weakens the
+    degradation contract)."""
+    rng = np.random.default_rng(23)
+    betas = _betas(2, seed=23)
+    poses = [rng.normal(scale=0.4, size=(n, 16, 3)).astype(np.float32)
+             for n in (1, 2)]
+    plan = chaos.ChaosPlan("error@0-")
+    br = health.CircuitBreaker(failure_threshold=1, probe=lambda: False,
+                               probe_interval_s=0.0,
+                               respect_priority_claim=False)
+    with ServingEngine(params32, max_bucket=4, max_delay_s=0.0,
+                       posed_kernel="fused",
+                       policy=_policy(plan, br)) as eng:
+        keys = [eng.specialize(b) for b in betas]
+        eng.warmup_posed()
+        eng.warmup([4])      # warm the CPU fallback tier
+        futs = _prestuffed(eng, [
+            (p, {"subject": k}) for p, k in zip(poses, keys)])
+        for p, b, f in zip(poses, betas, futs):
+            got = f.result(timeout=30.0)
+            want = np.asarray(core.jit_forward_batched(
+                params32, jnp.asarray(p),
+                jnp.asarray(np.broadcast_to(b[None],
+                                            (p.shape[0], 10)))).verts)
+            np.testing.assert_array_equal(got, want)
+    assert eng.counters.failovers >= 1
+
+
+# ------------------------------------------------------------ the sentinel
+def test_sentinel_fused_same_trace_reference_and_drift(params32):
+    """The sentinel under the fused tier: a clean probe reads 0.0 err
+    against the SAME-TRACE fused reference (an XLA reference would
+    read as permanent drift), and an injected wrong-output fault on
+    the served path is still caught as drift."""
+    from mano_hand_tpu.obs import Tracer
+    from mano_hand_tpu.obs.sentinel import NumericsSentinel
+
+    plan = chaos.ChaosPlan()
+    tr = Tracer()
+    with ServingEngine(params32, max_bucket=8, max_delay_s=0.0,
+                       posed_kernel="fused", tracer=tr,
+                       policy=_policy(plan, retries=0)) as eng:
+        eng.specialize(_betas(1, seed=29)[0])
+        eng.warmup_posed([8])
+        s = NumericsSentinel(eng, tracer=tr, interval_s=60.0)
+        r = s.probe()
+        fam = r["families"]["gather"]
+        assert fam["family"] == "gather_fused"
+        assert fam["max_abs_err"] == 0.0 and not fam["drift"]
+        plan.schedule("wrong:1.0@*")
+        r2 = s.probe()
+        assert r2["families"]["gather"]["drift"]
+        assert "gather" in r2["drifted_families"]
+        plan.clear()
+        r3 = s.probe()
+        assert not r3["families"]["gather"]["drift"]
+
+
+# ------------------------------------------------------------ the protocol
+def test_posed_kernel_bench_run_smoke(params32):
+    """config14's shared protocol at plumbing sizes: the artifact
+    carries every judged criterion field, parity/recompile criteria
+    hold on CPU, and the lm_e2e sub-leg (ROADMAP 2b) rides along."""
+    from mano_hand_tpu.serving.measure import posed_kernel_bench_run
+
+    pk = posed_kernel_bench_run(
+        params32, subjects=3, requests=8, max_rows=2, max_bucket=8,
+        trials=1, lm_batch=2, lm_steps=(2, 4), lm_iters=1,
+        log=lambda m: None)
+    assert pk["fused_vs_gather_max_abs_err"] < TOL
+    assert pk["xla_vs_gather_max_abs_err"] == 0.0
+    assert pk["steady_recompiles_fused"] == 0
+    assert pk["steady_recompiles_xla"] == 0
+    assert pk["gather_fused_active"] is True
+    assert pk["interpret"] is True and pk["platform"] == "cpu"
+    assert pk["lm_e2e_steps_per_sec"] > 0
+    acc = pk["flight_record"]["accounting"]
+    assert acc["spans_started"] == acc["spans_closed"]
+    for key in ("fused_evals_per_sec", "xla_evals_per_sec",
+                "fused_vs_xla_ratio", "slope_points", "capacity"):
+        assert key in pk, key
