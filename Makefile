@@ -22,10 +22,11 @@
 .PHONY: check check-cold test bench-cpu bench-tpu-wait mesh-scaling \
 	check-quick serve-smoke specialize-smoke chaos-smoke coalesce-smoke \
 	overload-smoke coldstart-smoke obs-smoke metrics-smoke \
-	posed-kernel-smoke analyze
+	posed-kernel-smoke stream-smoke analyze
 
 check: analyze test chaos-smoke coalesce-smoke overload-smoke \
-	coldstart-smoke obs-smoke metrics-smoke posed-kernel-smoke
+	coldstart-smoke obs-smoke metrics-smoke posed-kernel-smoke \
+	stream-smoke
 
 # tests/test_runtime.py is excluded here and covered by the chaos-smoke
 # prerequisite instead (its own pytest process + cache dir): `make
@@ -44,7 +45,8 @@ test:
 	  --ignore=tests/test_coldstart.py \
 	  --ignore=tests/test_obs.py \
 	  --ignore=tests/test_metrics.py \
-	  --ignore=tests/test_pallas_posed.py
+	  --ignore=tests/test_pallas_posed.py \
+	  --ignore=tests/test_streams.py
 
 # Seconds-scale pre-commit lane: the core-correctness modules (parity vs
 # the f64 oracle, assets/IO, golden demo, device lock, and the serving
@@ -60,7 +62,7 @@ check-quick: analyze
 # env writes, the r3 unbounded-retry pattern, wall-clock deadlines,
 # device work under _exe_lock), the engine lock-discipline checker
 # (documented order _install_lock -> _exe_lock, no cycles), the jaxpr
-# program auditor (seven programs over the five families traced on
+# program auditor (eight programs over the six families traced on
 # CPU, incl. the PR-10 fused gathered serving kernel: no f64,
 # no host callbacks, donation as designed, primitive counts vs the
 # committed analysis/baseline.json), and the fused-launch lockstep-
@@ -101,7 +103,9 @@ bench-cpu:
 # floor, so bench_report records its numbers without applying criteria —
 # and the fused gathered-kernel leg (config14: the whole fused-vs-XLA
 # engine protocol + lm_e2e sub-leg through the Pallas interpreter; a
-# config14 plumbing bug must not debut on the scarce chip).
+# config14 plumbing bug must not debut on the scarce chip), plus the
+# streaming-session drill (config15, PR 12) at plumbing size — the
+# tiny-e2e sweep of the whole open_stream/fit/coalesce/chaos protocol.
 bench-interpret:
 	python bench.py --platform cpu --big-batch 512 --chunk 128 --iters 2 \
 	  --fit-steps 10 --pallas-sweep quick --pallas-interpret --skip-fit \
@@ -112,7 +116,9 @@ bench-interpret:
 	  --overload-bursts 16 --coldstart-requests 8 --coldstart-subjects 3 \
 	  --coldstart-max-bucket 4 --coldstart-waves 2 --tracing-requests 48 \
 	  --metrics-requests 48 --posed-requests 32 --posed-subjects 6 \
-	  --posed-max-bucket 32 --posed-lm-batch 8
+	  --posed-max-bucket 32 --posed-lm-batch 8 \
+	  --stream-streams 16 --stream-frames 3 --stream-subjects 6 \
+	  --stream-workers 6 --stream-max-bucket 16
 
 # Serving-leg smoke (the bench-interpret counterpart for config7): the
 # whole serving-engine plumbing — bucket warm-up, ragged request stream,
@@ -136,7 +142,10 @@ bench-interpret:
 # serving/measure.py:metrics_overhead_run's docstring). config14 (the
 # fused gathered kernel, PR 10) runs its parity/recompile criteria here
 # too — the speed ratio is interpreter overhead on CPU and is recorded
-# unjudged (the chip leg is queued via bench-tpu-wait).
+# unjudged (the chip leg is queued via bench-tpu-wait). config15 (the
+# streaming-session drill, PR 12) runs at the FULL >= 200-stream scale
+# here — the acceptance criterion's CPU lane — while bench-interpret
+# sweeps the same protocol at plumbing size.
 serve-smoke:
 	python bench.py --platform cpu --serving-only --serving-requests 96 \
 	  --serving-max-rows 16 --serving-max-bucket 32 --init-retries 2 \
@@ -144,7 +153,8 @@ serve-smoke:
 	  --coldstart-requests 16 --coldstart-subjects 4 \
 	  --coldstart-max-bucket 4 --coldstart-waves 3 --tracing-requests 96 \
 	  --metrics-requests 160 --posed-requests 48 --posed-subjects 8 \
-	  --posed-max-bucket 32 --posed-lm-batch 8
+	  --posed-max-bucket 32 --posed-lm-batch 8 \
+	  --stream-streams 208 --stream-frames 4
 
 # Specialization-split smoke (the quick-lane half of PR 2's tooling):
 # the seconds-scale correctness story of the shape/pose split — bit-
@@ -223,6 +233,20 @@ obs-smoke:
 posed-kernel-smoke:
 	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_posed \
 	  python -m pytest tests/test_pallas_posed.py -q
+
+# Streaming-session matrix (the PR-12 tentpole): open_stream lifecycle
+# edges (evicted-subject re-bake, frames-after-close, idle expiry,
+# stop()-sweep-to-shutdown, stream-open shed), warm-start chain
+# correctness (bit-identical gathered verts, failover leaving the warm
+# start valid), the one-lock-hold load()["streams"] snapshot, the
+# metrics mapper + SLO latency burn, and the config15 drill at tiny
+# sizes. Wired into `make check` as a SEPARATE pytest process on its
+# own compile-cache dir (the CLAUDE.md rule: two pytest processes must
+# never share .jax_compile_cache/). Slow-marked, so the tier-1
+# `-m 'not slow'` lane skips it by design (the PR-8 budget precedent).
+stream-smoke:
+	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_stream \
+	  python -m pytest tests/test_streams.py -q
 
 # Metrics & SLO matrix (the PR-9 tentpole): registry instrument/
 # collector atomicity under concurrent writers, the counter-drift

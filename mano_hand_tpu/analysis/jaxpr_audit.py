@@ -23,10 +23,12 @@ resulting jaxpr is audited for
   review instead of on the chip. Intentional changes:
   ``mano analyze --update-baseline``.
 
-Program families (ISSUE 7, extended by PR 10): full forward, posed
-(pose-only fast path), gathered (PR-4 coalescing), fused one-/two-hand
-single-launch kernels, the FUSED gathered pose-only serving kernel
-(PR 10), and the CPU-failover tier.
+Program families (ISSUE 7, extended by PR 10 and PR 12): full forward,
+posed (pose-only fast path), gathered (PR-4 coalescing), fused
+one-/two-hand single-launch kernels, the FUSED gathered pose-only
+serving kernel (PR 10), the CPU-failover tier, and the stream-session
+per-frame solve (PR 12 — the frozen-shape LM tracker step every
+``open_stream`` session shares).
 """
 
 from __future__ import annotations
@@ -134,7 +136,28 @@ def build_program_specs() -> List[ProgramSpec]:
             lambda q, p, sh: core.forward_batched(q, p, sh).verts,
             (params, pose, shape), donate_argnums=(),
             expect_donated=()),
+        # serving/streams.py per-frame solve (PR 12): the frozen-shape
+        # LM tracker step — 48-col GN, joints data term — exactly as
+        # fitting/tracking.py:make_tracker builds it for a stream
+        # session (init pose + frozen betas as runtime arguments, so
+        # every session shares this one program). n_steps is tiny: the
+        # scan length changes execution, not the audited graph shape.
+        ProgramSpec(
+            "stream_fit", "stream_fit",
+            lambda q, tgt, p0, fs: _lm().fit_lm(
+                q, tgt, n_steps=2, data_term="joints",
+                init={"pose": p0}, frozen_shape=fs).pose,
+            (params, np.zeros((j, 3), np.float32),
+             np.zeros((j, 3), np.float32),
+             np.zeros((s,), np.float32)),
+            donate_argnums=(), expect_donated=()),
     ]
+
+
+def _lm():
+    from mano_hand_tpu.fitting import lm as lm_mod
+
+    return lm_mod
 
 
 def _walk_jaxpr(jaxpr) -> Tuple[Dict[str, int], List, List[str]]:

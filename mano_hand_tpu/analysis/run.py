@@ -50,9 +50,14 @@ def run_analysis(
     # refactor introduces still gets cycle-checked).
     for p in sorted((root / "mano_hand_tpu" / "obs").glob("*.py")):
         locks += check_lock_discipline(p, order=())
+    # PR 12: the stream subsystem's two locks (StreamManager registry,
+    # per-session fit serialization) are documented as never nested —
+    # the cycle/re-acquire checker keeps that true through refactors.
+    locks += check_lock_discipline(
+        root / "mano_hand_tpu" / "serving" / "streams.py", order=())
     sections.append(("lock-discipline", locks,
-                     "serving/engine.py + obs/ nesting graphs + call "
-                     "edges"))
+                     "serving/engine.py + serving/streams.py + obs/ "
+                     "nesting graphs + call edges"))
 
     step = check_lockstep(baseline.get("lockstep", {}))
     stale_note = lockstep_stale(baseline.get("lockstep", {}))
@@ -65,8 +70,9 @@ def run_analysis(
         jaxpr_findings, measured = audit_programs(baseline)
         sections.append((
             "jaxpr-audit", jaxpr_findings,
-            f"{len(measured['programs'])} programs over 5 families "
-            "(full/posed/gathered/fused/cpu_fallback) traced on CPU"))
+            f"{len(measured['programs'])} programs over 6 families "
+            "(full/posed/gathered/fused/cpu_fallback/stream_fit) "
+            "traced on CPU"))
 
     if update_baseline:
         new = dict(baseline)

@@ -1024,12 +1024,13 @@ def cmd_serve_bench(args) -> int:
         # than silently export an empty registry (the flag-guard
         # convention).
         if (args.overload or args.cold_start or args.subjects > 0
-                or args.chaos == "drill"):
+                or args.streams > 0 or args.chaos == "drill"):
             print("--metrics composes only with the default protocol "
                   "(optionally under a --chaos plan); the drill "
                   "protocols (--overload/--cold-start/--subjects/"
-                  "--chaos drill) fix their own engines and export "
-                  "nothing into a caller registry", file=sys.stderr)
+                  "--streams/--chaos drill) fix their own engines and "
+                  "export nothing into a caller registry",
+                  file=sys.stderr)
             return 2
         from mano_hand_tpu.obs import MetricsRegistry
 
@@ -1104,14 +1105,14 @@ def cmd_serve_bench(args) -> int:
         # injections, hang-composed boot), one JSON line of drill
         # metrics, judged by scripts/bench_report.py.
         if (args.chaos or args.subjects > 0 or args.overload
-                or args.deadline_s is not None):
+                or args.streams > 0 or args.deadline_s is not None):
             # The flag-guard convention (PR 4/5): the drill fixes its
             # own protocol — its own chaos hang leg, its own engines,
             # its own deadlines — refuse rather than silently not run
             # what the caller asked for.
             print("--cold-start fixes its own protocol and does not "
-                  "compose with --chaos, --subjects, --overload, or "
-                  "--deadline-s", file=sys.stderr)
+                  "compose with --chaos, --subjects, --overload, "
+                  "--streams, or --deadline-s", file=sys.stderr)
             return 2
         if not args.aot_dir:
             # Refuse the aot-dir-less invocation by name: the drill's
@@ -1127,6 +1128,34 @@ def cmd_serve_bench(args) -> int:
 
         out = cold_start_drill_run(
             params, aot_dir=args.aot_dir, seed=args.seed,
+            tracer=tracer, log=log)
+        out["backend"] = jax.default_backend()
+        export_trace(out)
+        print(json.dumps(out))
+        return 0
+
+    if args.streams > 0:
+        # The streaming-session drill (the same protocol as bench.py
+        # config15: serving/measure.py:stream_drill_run — N concurrent
+        # per-user tracking sessions, warm-started frozen-shape fits,
+        # gathered tier-0 dispatch, a mid-drill chaos plan), one JSON
+        # line of drill metrics, judged by scripts/bench_report.py.
+        if (args.chaos or args.subjects > 0 or args.overload
+                or args.cold_start or args.aot_dir
+                or args.deadline_s is not None):
+            # The flag-guard convention: the drill fixes its own
+            # protocol (its own chaos schedule, supervised policy, and
+            # per-frame deadlines) — refuse rather than silently not
+            # run what the caller asked for.
+            print("--streams fixes its own protocol and does not "
+                  "compose with --chaos, --subjects, --overload, "
+                  "--cold-start, --aot-dir, or --deadline-s",
+                  file=sys.stderr)
+            return 2
+        from mano_hand_tpu.serving.measure import stream_drill_run
+
+        out = stream_drill_run(
+            params, streams=args.streams, seed=args.seed,
             tracer=tracer, log=log)
         out["backend"] = jax.default_backend()
         export_trace(out)
@@ -1417,6 +1446,23 @@ def cmd_status(args) -> int:
             "runtime/health.py)")
     if metrics_info is not None:
         report["metrics"] = metrics_info
+    if metrics_snap is not None:
+        # Streaming sessions (PR 12): the persisted scrape carries the
+        # engine's one-lock-hold streams block (load_samples maps
+        # ServingEngine.load()["streams"] to load_streams_* gauges);
+        # surface active-stream count + per-stream backlog age here so
+        # the operator's one look answers "how many live users, and is
+        # any stream's oldest frame stuck" without re-parsing metrics.
+        m = metrics_snap.get("metrics") or {}
+        streams_block = {}
+        for short in ("active", "frames_in_flight", "backlog_age_s",
+                      "opened", "frames_submitted", "frames_resolved"):
+            entry = m.get(f"load_streams_{short}")
+            samples = (entry or {}).get("samples") or []
+            if samples:
+                streams_block[short] = samples[0][1]
+        if streams_block:
+            report["streams"] = streams_block
     print(json.dumps(report, indent=2))
     return 0
 
@@ -1810,6 +1856,16 @@ def build_parser() -> argparse.ArgumentParser:
                     help="offered-load multiple of the measured "
                          "service rate for --overload (criteria are "
                          "judged at >= 4x achieved)")
+    sb.add_argument("--streams", type=int, default=0,
+                    help="run the STREAMING-SESSION drill instead "
+                         "(serving/measure.py:stream_drill_run, shared "
+                         "with bench.py config15): this many "
+                         "concurrent per-user tracking sessions — "
+                         "warm-started frozen-shape per-frame fits, "
+                         "gathered tier-0 dispatch, a mid-drill chaos "
+                         "plan with bit-identical CPU failover — one "
+                         "JSON line judged by scripts/bench_report.py. "
+                         "0 = off")
     sb.add_argument("--trace", default="",
                     help="request-lifecycle tracing (PR 8): span every "
                          "request through an obs.Tracer and export the "

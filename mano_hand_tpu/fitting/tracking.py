@@ -54,6 +54,7 @@ def make_tracker(
     frozen_shape=None,           # [S]: pose-only tracking, betas pinned
     deadline_s: Optional[float] = None,
     retries: int = 0,
+    init_pose=None,              # [J, 3]: seed the warm start directly
     **solver_kw,
 ) -> Tuple[TrackState, Callable]:
     """Build a streaming tracker; returns ``(initial_state, track_step)``.
@@ -81,6 +82,14 @@ def make_tracker(
     the subject's betas are known (a calibration fit, an enrolled user);
     with the true betas the per-frame solves reach the same optimum as
     the free-shape solve (tests/test_specialize.py).
+
+    ``init_pose`` seeds the warm start from a KNOWN pose instead of the
+    rest pose — a resumed stream (serving/streams.py carries the last
+    converged pose across a session re-open) or any caller with a prior
+    estimate. The seed IS the warm start, so the frame-0 closed-form
+    Kabsch alignment is skipped (``TrackState.frame`` starts at 1):
+    re-seeding from the first target would throw away exactly the
+    continuity the caller is passing in.
 
     ``deadline_s``/``retries`` opt every frame's solve into SUPERVISED
     execution (``runtime.supervise.supervised_call``): a live tracker
@@ -129,12 +138,17 @@ def make_tracker(
     n_shape = params.shape_basis.shape[-1]
     if frozen_shape is not None:
         frozen_shape = jnp.asarray(frozen_shape, dtype).reshape(n_shape)
+    if init_pose is not None:
+        init_pose = jnp.asarray(init_pose, dtype).reshape(n_joints, 3)
     state0 = TrackState(
-        pose=jnp.zeros((n_joints, 3), dtype),
+        pose=(jnp.zeros((n_joints, 3), dtype) if init_pose is None
+              else init_pose),
         shape=(jnp.zeros((n_shape,), dtype) if frozen_shape is None
                else frozen_shape),
         trans=jnp.zeros((3,), dtype) if fit_trans else None,
-        frame=0,
+        # A caller-seeded pose IS the warm start: frame=1 skips the
+        # frame-0 Kabsch re-seed, which would overwrite it.
+        frame=0 if init_pose is None else 1,
     )
 
     def track_step(state: TrackState, target) -> Tuple[TrackState, object]:

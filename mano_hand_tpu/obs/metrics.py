@@ -442,6 +442,26 @@ def load_samples(load: dict) -> dict:
         out["load_backlog_age_s"] = metric(
             "gauge", load["backlog_age_s"],
             help="age of the oldest still-open request span")
+    streams = load.get("streams") or {}
+    for key, help_txt in (
+            ("active", "open stream sessions"),
+            ("frames_in_flight", "stream frames submitted, unresolved"),
+            ("backlog_age_s", "age of the oldest in-flight stream "
+                              "frame across sessions"),
+            ("opened", "stream sessions ever opened"),
+            ("frames_submitted", "stream frames ever submitted"),
+            ("frames_resolved", "stream frames resolved (any kind)")):
+        if streams.get(key) is not None:
+            out[f"load_streams_{key}"] = metric(
+                "gauge" if key in ("active", "frames_in_flight",
+                                   "backlog_age_s") else "counter",
+                streams[key], help=help_txt)
+    closed = [sample(v, {"kind": k})
+              for k, v in (streams.get("closed_by_kind") or {}).items()]
+    if closed:
+        out["load_streams_closed_by_kind"] = metric(
+            "counter", help="stream-session terminals by kind",
+            samples=closed)
     return out
 
 
@@ -492,7 +512,8 @@ def _burn(actual_good: float, target_good: float) -> float:
 
 
 def slo_report(counters_snapshot: dict,
-               objectives: Optional[dict] = None) -> dict:
+               objectives: Optional[dict] = None,
+               latency_by_tier: Optional[dict] = None) -> dict:
     """Per-tier SLO accounting from ONE counters snapshot (pass the
     same dict the serving export used — two snapshot() calls would tear
     the two views apart). Returns per tier: the observed rates, each
@@ -500,7 +521,15 @@ def slo_report(counters_snapshot: dict,
     rate <= 1.0. Requests still in flight (offered but not yet
     resolved) are excluded from the deadline-hit denominator but kept
     in goodput's offered denominator — goodput is a statement about
-    offered load, not about resolved outcomes only."""
+    offered load, not about resolved outcomes only.
+
+    ``latency_by_tier`` (PR 12 — the ``load()``/tracer quantile dict,
+    ``{tier: {"p50_ms", "p99_ms", ...}}``) adds a LATENCY objective for
+    any tier whose objectives carry ``p99_target_ms``: the burn rate is
+    observed p99 over the target (> 1.0 = frames are landing slower
+    than the stream SLO allows). Absent either side, the report is
+    byte-identical to the PR-9 shape — existing consumers see no new
+    keys they did not opt into."""
     objectives = objectives or DEFAULT_SLO_OBJECTIVES
     tiers_out: Dict[str, dict] = {}
     for tier, ledger in (counters_snapshot.get("tiers") or {}).items():
@@ -522,11 +551,19 @@ def slo_report(counters_snapshot: dict,
                      else (float("inf") if obj["shed_budget"] <= 0
                            else shed_fraction / obj["shed_budget"])),
         }
+        p99_target = obj.get("p99_target_ms")
+        lat = (latency_by_tier or {}).get(tier) or {}
+        if p99_target and lat.get("p99_ms") is not None:
+            # Latency burn: observed badness IS the quantile itself, so
+            # the rate is the direct quotient (1.0 = exactly at target).
+            burns["latency_p99"] = lat["p99_ms"] / p99_target
         tiers_out[tier] = {
             "submitted": submitted,
             "served": served,
             "shed": shed,
             "expired": expired,
+            **({"latency_p99_ms": round(float(lat["p99_ms"]), 4)}
+               if p99_target and lat.get("p99_ms") is not None else {}),
             "goodput": round(goodput, 6),
             "deadline_hit_rate": round(deadline_hit, 6),
             "shed_fraction": round(shed_fraction, 6),

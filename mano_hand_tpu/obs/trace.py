@@ -52,9 +52,13 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-#: Terminal span kinds — exactly the engine's future-resolution
-#: vocabulary (serving/engine.py:ServingError.kind plus "ok").
-TERMINAL_KINDS = ("ok", "shed", "expired", "error", "shutdown")
+#: Terminal span kinds — the engine's future-resolution vocabulary
+#: (serving/engine.py:ServingError.kind plus "ok"), extended by the
+#: stream-session lifecycle (PR 12): "closed" is a client-initiated
+#: clean close of a serving/streams.py session span; stream expiry /
+#: shed / shutdown reuse the request kinds with the same meaning.
+TERMINAL_KINDS = ("ok", "shed", "expired", "error", "shutdown",
+                  "closed")
 
 #: Default ring capacity: ~6 events/request keeps the last ~1300
 #: requests of history — plenty for an incident dump, bounded forever.
@@ -269,7 +273,13 @@ class Tracer:
         now = self._clock()
         with self._lock:
             items = {t: list(s) for t, s in self._tier_lat.items()}
-            oldest = min((r["t0"] for r in self._open.values()),
+            # REQUEST spans only: a stream-session span (PR 12) stays
+            # open for the session's whole lifetime by design, and
+            # counting it would pin backlog_age_s to "oldest open
+            # stream" — a healthy engine reading as permanently wedged.
+            # The per-frame backlog signal lives in load()["streams"].
+            oldest = min((r["t0"] for r in self._open.values()
+                          if r.get("kind") != "stream"),
                          default=None)
         out = {}
         for t, s in sorted(items.items()):

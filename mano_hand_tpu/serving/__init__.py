@@ -24,17 +24,27 @@ from mano_hand_tpu.serving.measure import (
     overload_drill_run,
     recovery_drill_run,
     serve_bench_run,
+    stream_drill_run,
+)
+from mano_hand_tpu.serving.streams import (
+    FrameResult,
+    StreamManager,
+    StreamSession,
 )
 
 __all__ = [
     "ServingEngine",
     "ServingError",
+    "FrameResult",
+    "StreamManager",
+    "StreamSession",
     "coalesce_bench_run",
     "cold_start_drill_run",
     "overload_drill_run",
     "recovery_drill_run",
     "measure_overhead",
     "serve_bench_run",
+    "stream_drill_run",
     "bucket_for",
     "bucket_sizes",
     "pad_rows",

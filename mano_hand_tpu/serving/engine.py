@@ -586,6 +586,15 @@ class ServingEngine:
         # wedged inside a C-level RPC it will never return from.
         self._live: dict = {}
         self._live_lock = threading.Lock()
+        # Streaming sessions (PR 12): the manager is built lazily on
+        # the first open_stream (it pulls the fitting stack in), so a
+        # stateless-forward engine pays nothing for the subsystem.
+        # ``_streams_stopped`` mirrors stop()/start() so a manager
+        # built AFTER a stop (or racing one — both sides synchronize
+        # on _live_lock) is born refusing registrations: the shutdown
+        # contract must hold even when no stream was ever opened.
+        self._streams = None
+        self._streams_stopped = False
 
     @property
     def tracer(self):
@@ -677,6 +686,14 @@ class ServingEngine:
             # crash so the documented stop()/start() restart actually
             # accepts work instead of re-raising the stale failure.
             self._failure = None
+            with self._live_lock:
+                # The stream manager refuses registrations after a
+                # stop() sweep; a restarted engine accepts new
+                # sessions again (PR 12).
+                self._streams_stopped = False
+                mgr = self._streams
+            if mgr is not None:
+                mgr.reopen()
             self._running = True
             self._thread = threading.Thread(
                 target=self._dispatch_loop, name="mano-serving", daemon=True)
@@ -700,6 +717,21 @@ class ServingEngine:
         unsupervised engine keeps the historical blocking join (its
         dispatch path has nothing that can wedge on CPU).
         """
+        with self._live_lock:
+            # Streaming sessions (PR 12): mark FIRST, under the same
+            # lock the lazy manager build publishes under, so an
+            # open_stream racing this stop either sees a swept manager
+            # or builds one born stopped — never a live session the
+            # one-shot sweep below missed.
+            self._streams_stopped = True
+            streams_mgr = self._streams
+        if streams_mgr is not None:
+            # Every still-open session reaches the ``shutdown``
+            # terminal (span closed exactly once) BEFORE the future
+            # sweeps below, so a session can never outlive the engine
+            # that serves its frames — in-flight frames resolve
+            # through those sweeps.
+            streams_mgr.shutdown()
         if self._thread is None:
             return
         self._running = False
@@ -956,6 +988,70 @@ class ServingEngine:
             out[b] = "aot" if self.counters.aot_loads > before else "jit"
         return out
 
+    # --------------------------------------------- streaming sessions (PR 12)
+    def _stream_manager(self):
+        """The engine's StreamManager, built on first use (race-
+        tolerant: a losing builder is discarded — the manager holds no
+        resources until sessions register). Publication happens under
+        ``_live_lock``, the same hold ``stop()``/``start()`` flip
+        ``_streams_stopped`` under, so a manager built after (or
+        racing) a stop is born refusing registrations."""
+        mgr = self._streams
+        if mgr is None:
+            from mano_hand_tpu.serving.streams import StreamManager
+
+            mgr = StreamManager(self)
+            with self._live_lock:
+                if self._streams is None:
+                    # Pre-publication: no other thread can hold the
+                    # manager lock yet, so the direct flag write is
+                    # race-free.
+                    mgr._stopped = self._streams_stopped
+                    self._streams = mgr
+                mgr = self._streams
+        return mgr
+
+    def open_stream(self, subject, *, n_steps: int = 4,
+                    data_term: str = "joints", solver: str = "lm",
+                    frame_deadline_s: Optional[float] = None,
+                    idle_timeout_s: Optional[float] = None,
+                    resume_pose=None, **tracker_kw):
+        """Open one per-user tracking session (PR 12 tentpole); returns
+        a ``serving.streams.StreamSession``.
+
+        ``subject`` is the user's betas array (baked via ``specialize``
+        — idempotent, so an unknown subject is a first bake, not an
+        error) or an existing ``specialize()`` key (an EVICTED key
+        stays valid: its betas are registered and the table row
+        re-bakes on the next dispatch). Each ``submit_frame(target)``
+        then runs a frozen-shape LM solve (the PR-2 48-col path)
+        warm-started from the last converged pose
+        (``fitting/tracking.py:make_tracker``) and serves the posed
+        verts through the gathered SubjectTable dispatch at tier 0 —
+        concurrent streams' frames coalesce into mixed-subject batches
+        with zero steady recompiles, and chaos/failover/overload
+        compose unchanged (a CPU-failover frame is bit-identical and
+        leaves the warm start untouched).
+
+        ``frame_deadline_s`` is the default per-frame TTL (fit +
+        dispatch; swept before solver time is spent);
+        ``idle_timeout_s`` expires a session nobody feeds (terminal
+        ``expired``); ``resume_pose`` seeds the warm start from a
+        carried pose (e.g. a re-opened stream) instead of the rest
+        pose. ``n_steps``/``data_term``/``solver``/``tracker_kw`` pass
+        to ``make_tracker`` with ``frozen_shape`` pinned to the
+        subject's betas. Lifecycle terminals — ``closed`` / ``expired``
+        / ``shed`` / ``shutdown`` (``stop()`` sweeps open sessions) —
+        each close the session's PR-8 span exactly once.
+        """
+        from mano_hand_tpu.serving import streams as streams_mod
+
+        return streams_mod.open_stream(
+            self, subject, n_steps=n_steps, data_term=data_term,
+            solver=solver, frame_deadline_s=frame_deadline_s,
+            idle_timeout_s=idle_timeout_s, resume_pose=resume_pose,
+            **tracker_kw)
+
     # ------------------------------------------------- admission (PR 5)
     def _quota(self, tier: int) -> int:
         """Outstanding-count threshold at which tier ``tier`` sheds.
@@ -997,6 +1093,17 @@ class ServingEngine:
             "admission": tiers,
             "backlog_peak": self.counters.backlog_peak,
         }
+        # Streaming sessions (PR 12): active-stream count + per-stream
+        # backlog age, one manager-lock hold (the torn-telemetry rule;
+        # the empty block keeps the load surface shape-stable — its
+        # keys are pinned against StreamManager.snapshot in tests).
+        mgr = self._streams
+        if mgr is not None:
+            out["streams"] = mgr.snapshot()
+        else:
+            from mano_hand_tpu.serving import streams as streams_mod
+
+            out["streams"] = streams_mod.empty_snapshot()
         if self._tracer is not None:
             # PR 8: per-tier resolve-latency quantiles + backlog age.
             # The tracer copies its samples and open-span starts in ONE

@@ -2085,3 +2085,513 @@ def posed_kernel_bench_run(
             tracer_f, os.path.join(str(trace_dir), "posed_kernel"),
             counters=eng_f.counters, reason="posed_kernel_complete")
     return results
+
+
+def stream_drill_run(
+    params,
+    *,
+    streams: int = 208,
+    frames_per_stream: int = 4,
+    subjects: Optional[int] = None,
+    workers: int = 16,
+    warm_steps: int = 4,
+    cold_steps_candidates: Sequence[int] = (8, 16, 32),
+    target_loss: float = 1e-9,
+    frame_deadline_s: float = 5.0,
+    batch_deadline_s: float = 10.0,
+    min_bucket: int = 8,
+    max_bucket: int = 64,
+    max_delay_s: float = 0.002,
+    chaos_spec: str = "error@0-",
+    calib_probes: int = 12,
+    fit_trials: int = 5,
+    seed: int = 0,
+    tracer=None,
+    log: Callable[[str], None] = None,
+) -> dict:
+    """THE streaming-session drill protocol — shared by ``bench.py``
+    config15, `mano serve-bench --streams`, and tests/test_streams.py
+    so the three artifacts cannot diverge (the recovery-drill pattern).
+
+    The scenario PR 12 exists for: hundreds of per-user tracking
+    sessions, each a stream of correlated frames. Every stream gets its
+    own synthetic subject (assets/synthetic.py betas) and a SMOOTH pose
+    track (models/anim.py:resample_poses over seeded keyframes — the
+    correlated-frames premise is the product premise), and each frame
+    runs the full session step: frozen-shape LM fit warm-started from
+    the last converged pose, then the posed verts through the gathered
+    SubjectTable dispatch at tier 0 with a per-frame deadline.
+    Concurrent streams submit from a ``workers``-wide pool, so frames
+    coalesce into mixed-subject batches exactly as production traffic
+    would.
+
+    Phases: bake every subject BEFORE warming the gathered executables
+    (growth compiles are warm-up-class work, and pre-baking means zero
+    growth-rebuilds), warm every tier (primary + gathered + the CPU
+    failover tier the chaos leg will need), open every stream, run a
+    settle round (the fit program's one compile lands there), TIMED
+    steady rounds, the warm-vs-cold calibration, then a CHAOS round
+    under ``chaos_spec`` (persistent primary fault: every frame must
+    resolve through supervised retries + CPU failover, bit-identical),
+    then close.
+
+    Returned criteria numbers (scripts/bench_report.py judges):
+
+    * ``frames_resolved_fraction`` == 1.0 with ``outcomes.error`` == 0
+      and ``outcomes.stranded`` == 0 — every frame of every stream,
+      chaos round included, resolves as ok/shed/expired, never a hang;
+    * ``warm_vs_cold_fit_ratio`` >= 1.2 (judged when
+      ``warm_loss_matched``) — the warm-started per-frame fit vs the
+      cheapest cold fit reaching the same ``target_loss``, both
+      SLOPE-TIMED (marginal per-fit cost over two in-pass repeat
+      counts, the bench.py:slope_time reasoning — fixed dispatch
+      overhead cancels);
+    * ``failover_vs_cpu_direct_max_abs_err`` == 0.0 — a chaos-round
+      frame served by CPU failover is bit-identical to a direct CPU
+      call at the same pose/betas, and the warm start it leaves behind
+      is the fit's own pose (serving faults never touch the solver);
+    * ``steady_recompiles`` == 0 — N streams share one program family;
+      the whole drill compiles nothing after warm-up;
+    * ``slo.tiers["0"]`` carries burn rates INCLUDING the frame-latency
+      p99 objective (``p99_target_ms`` = the frame deadline) computed
+      from the drill's end-to-end frame latencies;
+    * stream spans: every opened session reaches exactly one terminal
+      (``closed`` for the explicit closes, ``shutdown`` for the ones
+      ``stop()`` sweeps), and the flight record's request-span
+      accounting balances.
+
+    Everything runs on whatever backend is up; faults are injected
+    in-process, so no chip is required and none is harmed.
+    """
+    import concurrent.futures as cf
+
+    import jax
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.fitting import lm as lm_mod
+    from mano_hand_tpu.models import anim, core
+    from mano_hand_tpu.runtime.chaos import ChaosPlan
+    from mano_hand_tpu.runtime.supervise import DispatchPolicy
+    from mano_hand_tpu.serving.engine import ServingEngine, ServingError
+
+    if streams < 1:
+        raise ValueError(f"streams must be >= 1, got {streams}")
+    min_frames = 3 if chaos_spec else 2
+    if frames_per_stream < min_frames:
+        # With a chaos spec the LAST round is the chaos round, so the
+        # floor is settle + >= 1 TIMED steady round + chaos — fewer
+        # and the latency record is empty, which would fail the judged
+        # SLO latency-burn criterion on an otherwise clean run.
+        raise ValueError(
+            f"frames_per_stream must be >= {min_frames} (a settle "
+            f"round, at least one timed steady round"
+            f"{', and the chaos round' if chaos_spec else ''}), got "
+            f"{frames_per_stream}")
+    if workers < 1:
+        raise ValueError(f"workers must be >= 1, got {workers}")
+    log = _logger(log)
+    if tracer is None:
+        tracer = Tracer()
+    subjects = streams if subjects is None else max(1, int(subjects))
+    calib_probes = max(1, min(calib_probes, streams))
+    n_joints, n_shape = params.n_joints, params.n_shape
+    rng = np.random.default_rng(seed)
+    prm32 = params.astype(np.float32)
+
+    # ---- Synthetic per-user tracks (the correlated-frames premise) ----
+    betas = [rng.normal(size=(n_shape,)).astype(np.float32)
+             for _ in range(subjects)]
+    subj_of = [s % subjects for s in range(streams)]
+    # Keyframes rest -> two random poses, retimed to the frame count
+    # (anim.resample_poses): smooth, so the warm start is always near.
+    keys = np.zeros((streams, 3, n_joints, 3), np.float32)
+    keys[:, 1] = rng.normal(scale=0.2, size=(streams, n_joints, 3))
+    keys[:, 2] = keys[:, 1] + rng.normal(
+        scale=0.1, size=(streams, n_joints, 3))
+    tracks = np.stack([
+        anim.resample_poses(keys[s], frames_per_stream)
+        for s in range(streams)]).astype(np.float32)   # [S, T, J, 3]
+    flat_pose = tracks.reshape(streams * frames_per_stream, n_joints, 3)
+    flat_beta = np.stack([betas[subj_of[s]]
+                          for s in range(streams)
+                          for _ in range(frames_per_stream)])
+    gt = core.jit_forward_batched(prm32, jnp.asarray(flat_pose),
+                                  jnp.asarray(flat_beta))
+    targets = np.asarray(gt.posed_joints).reshape(
+        streams, frames_per_stream, n_joints, 3)
+
+    # ---- Engine: supervised + chaos-wrappable + CPU failover ----------
+    plan = ChaosPlan()
+    policy = DispatchPolicy(
+        deadline_s=batch_deadline_s, retries=1, backoff_s=0.01,
+        backoff_cap_s=0.02, jitter=0.0, breaker=None, chaos=plan,
+        cpu_fallback=True,
+    )
+    eng = ServingEngine(prm32, min_bucket=min_bucket,
+                        max_bucket=max_bucket, max_delay_s=max_delay_s,
+                        policy=policy, tracer=tracer)
+
+    # Bit-identity reference for the failover parity probe: the same
+    # params-as-runtime-args program family, pinned to host CPU.
+    cpu = jax.devices("cpu")[0]
+    prm_cpu = jax.device_put(prm32, cpu)
+    ref = jax.jit(lambda q, p, s: core.forward_batched(q, p, s).verts)
+
+    def cpu_direct(pose, beta):
+        return np.asarray(ref(
+            prm_cpu, jax.device_put(jnp.asarray(pose[None]), cpu),
+            jax.device_put(jnp.asarray(beta[None]), cpu)))[0]
+
+    outcomes = {"ok": 0, "shed": 0, "expired": 0, "error": 0,
+                "stranded": 0}
+    chaos_outcomes = dict(outcomes)
+    frame_lat: List[float] = []
+    round_times: List[float] = []
+    failover_err = None
+
+    pool = cf.ThreadPoolExecutor(max_workers=workers,
+                                 thread_name_prefix="stream-drill")
+    try:
+        with eng:
+            keys_subj = [eng.specialize(b) for b in betas]
+            growths = eng.counters.table_growths
+            if log:
+                log(f"streams: {subjects} subjects baked ({growths} "
+                    f"table growths), warming buckets {eng.buckets}")
+            eng.warmup()          # primary full + CPU failover tiers
+            eng.warmup_posed()    # gathered tier at final capacity
+            sessions = [
+                eng.open_stream(keys_subj[subj_of[s]],
+                                n_steps=warm_steps, data_term="joints",
+                                frame_deadline_s=frame_deadline_s)
+                for s in range(streams)]
+
+            resolve_timeout = (frame_deadline_s
+                               + batch_deadline_s * (policy.retries + 2)
+                               + 30.0)
+
+            def tally_frame(ff, tally):
+                """Classify one frame future into the outcome tally
+                (THE one classification — settle/chaos and timed
+                rounds must never diverge on what counts as resolved);
+                returns the FrameResult on ``ok``, else None."""
+                try:
+                    res = ff.result(timeout=resolve_timeout)
+                    tally["ok"] += 1
+                    return res
+                except ServingError as e:
+                    tally[e.kind if e.kind in tally else "error"] += 1
+                except Exception:  # noqa: BLE001 — a timeout IS the bug
+                    tally["stranded"] += 1
+                return None
+
+            def run_round(r, tally, deadline=True):
+                """Submit frame r of every stream from the pool; wait
+                for every frame future; tally outcomes.
+                Returns (wall seconds, [FrameResult|None per stream]).
+                ``deadline=False`` submits un-deadlined — the settle
+                round, where the fit program's one compile holds the
+                first frame wave for seconds of warm-up-class time
+                that must not be judged as frame latency."""
+                t0 = time.perf_counter()
+                outer = [pool.submit(
+                    sessions[s].submit_frame, targets[s, r],
+                    deadline_s=frame_deadline_s if deadline else None)
+                         for s in range(streams)]
+                inner = []
+                for of in outer:
+                    try:
+                        inner.append(of.result(timeout=120.0))
+                    except Exception:  # noqa: BLE001 — a refused frame
+                        inner.append(None)   # counts as stranded below
+                results_r = []
+                for ff in inner:
+                    if ff is None:
+                        tally["stranded"] += 1
+                        results_r.append(None)
+                        continue
+                    results_r.append(tally_frame(ff, tally))
+                return time.perf_counter() - t0, results_r
+
+            # Frame latency must be END-TO-END (fit + dispatch), so
+            # re-measure per frame around the whole submit+resolve in
+            # the steady rounds below; the per-future wait above only
+            # covers the dispatch tail. One honest clock: wrap the
+            # round and divide is wrong (concurrency), so each frame's
+            # latency is stamped by its own submit/resolve pair.
+            def run_round_timed(r, tally):
+                t0 = time.perf_counter()
+                boxes = []
+
+                def one(s):
+                    t_sub = time.perf_counter()
+                    ff = sessions[s].submit_frame(targets[s, r])
+                    box = []
+                    ff.add_done_callback(
+                        lambda f, b=box, t=t_sub:
+                            b.append(time.perf_counter() - t))
+                    return ff, box
+
+                outer = [pool.submit(one, s) for s in range(streams)]
+                pairs = [of.result(timeout=120.0) for of in outer]
+                for ff, box in pairs:
+                    tally_frame(ff, tally)
+                    boxes.append(box)
+                dt = time.perf_counter() - t0
+                frame_lat.extend(b[0] for b in boxes if b)
+                return dt
+
+            # Round 0: settle — the fit program's one compile and every
+            # stream's frame-0 Kabsch seed land here, outside timing
+            # and un-deadlined (compile latency is warm-up, not frame
+            # latency; a cold start that must bound it has the PR-6
+            # lattice for the serving half).
+            dt0, _ = run_round(0, outcomes, deadline=False)
+            compiles_settled = eng.counters.compiles
+            if log:
+                log(f"streams: settle round {dt0:.2f}s "
+                    f"({eng.counters.compiles} warm-up compiles); "
+                    f"{streams} streams x {frames_per_stream} frames")
+            chaos_round = frames_per_stream - 1 if chaos_spec else None
+            steady = [r for r in range(1, frames_per_stream)
+                      if r != chaos_round]
+            for r in steady:
+                round_times.append(run_round_timed(r, outcomes))
+
+            # ---- Warm-vs-cold calibration (slope-timed) --------------
+            calib = _stream_fit_calibration(
+                prm32, sessions[:calib_probes],
+                [betas[subj_of[s]] for s in range(calib_probes)],
+                [targets[s, chaos_round if chaos_round is not None
+                         else frames_per_stream - 1]
+                 for s in range(calib_probes)],
+                lm_mod, warm_steps=warm_steps,
+                cold_steps_candidates=tuple(cold_steps_candidates),
+                target_loss=target_loss, trials=fit_trials, log=log)
+
+            # ---- Chaos round: persistent primary fault ---------------
+            failovers_before = eng.counters.failovers
+            warm_start_consistent = None
+            if chaos_round is not None:
+                probe_s = 0
+                plan.schedule(chaos_spec)
+                try:
+                    _, results_c = run_round(chaos_round, chaos_outcomes)
+                finally:
+                    plan.clear()
+                res = results_c[probe_s]
+                if res is not None:
+                    # Failover parity: the frame's verts vs a direct
+                    # CPU call at the SAME (pose, betas); and the warm
+                    # start it left behind is the fit's own converged
+                    # pose — the serving fault never touched the
+                    # solver, so the stream resumes seamlessly.
+                    failover_err = float(np.abs(
+                        res.verts - cpu_direct(
+                            res.pose, betas[subj_of[probe_s]])).max())
+                    warm_start_consistent = bool(np.array_equal(
+                        sessions[probe_s].pose, res.pose))
+                for k, v in chaos_outcomes.items():
+                    outcomes[k] += v
+            failovers = eng.counters.failovers - failovers_before
+
+            steady_recompiles = (eng.counters.compiles
+                                 - compiles_settled)
+            # Close all but two sessions explicitly; stop() must sweep
+            # the stragglers to the ``shutdown`` terminal.
+            for sess in sessions[:-2]:
+                sess.close()
+            load_final = eng.load()
+            snap = eng.counters.snapshot()
+    finally:
+        pool.shutdown(wait=False)
+        plan.release.set()
+
+    # AFTER stop(): the sweep moved the straggler sessions to the
+    # ``shutdown`` terminal, so this snapshot carries the full
+    # closed-by-kind ledger the span criterion judges.
+    streams_snap = eng.load()["streams"]
+    submitted = sum(outcomes.values())
+    resolved_fraction = (1.0 - outcomes["stranded"] / submitted
+                         if submitted else 0.0)
+    lat_ms = np.asarray(frame_lat) * 1e3 if frame_lat else None
+    p50 = float(np.percentile(lat_ms, 50)) if lat_ms is not None else None
+    p99 = float(np.percentile(lat_ms, 99)) if lat_ms is not None else None
+    fps = (max(streams / t for t in round_times)
+           if round_times else None)
+    from mano_hand_tpu.obs.metrics import (
+        DEFAULT_SLO_OBJECTIVES, slo_report,
+    )
+
+    objectives = {
+        "0": {**DEFAULT_SLO_OBJECTIVES["0"],
+              "p99_target_ms": frame_deadline_s * 1e3},
+        "default": DEFAULT_SLO_OBJECTIVES["default"],
+    }
+    slo = slo_report(
+        snap, objectives,
+        latency_by_tier={"0": {"p50_ms": p50, "p99_ms": p99,
+                               "n": len(frame_lat)}}
+        if lat_ms is not None else None)
+    if log:
+        log(f"streams: {submitted} frames -> {outcomes['ok']} ok / "
+            f"{outcomes['shed']} shed / {outcomes['expired']} expired / "
+            f"{outcomes['error']} error / {outcomes['stranded']} "
+            f"stranded; {fps and f'{fps:,.0f}'} frames/s steady, p99 "
+            f"{p99 and f'{p99:.1f}'} ms, warm/cold fit ratio "
+            f"{calib.get('warm_vs_cold_fit_ratio')}, {failovers} "
+            f"failover(s), {steady_recompiles} steady recompiles")
+    return {
+        "streams": int(streams),
+        "frames_per_stream": int(frames_per_stream),
+        "subjects": int(subjects),
+        "workers": int(workers),
+        "buckets": list(eng.buckets),
+        "frame_deadline_s": frame_deadline_s,
+        "frames_submitted": int(submitted),
+        "frames_resolved_fraction": float(f"{resolved_fraction:.6g}"),
+        "outcomes": outcomes,
+        "chaos_spec": chaos_spec or None,
+        "chaos_outcomes": chaos_outcomes if chaos_spec else None,
+        "failovers": int(failovers),
+        "failover_vs_cpu_direct_max_abs_err": failover_err,
+        "warm_start_after_failover_consistent": warm_start_consistent,
+        "frames_per_sec": (None if fps is None
+                           else float(f"{fps:.5g}")),
+        "frame_p50_ms": (None if p50 is None
+                         else float(f"{p50:.4g}")),
+        "frame_p99_ms": (None if p99 is None
+                         else float(f"{p99:.4g}")),
+        **calib,
+        "steady_recompiles": int(steady_recompiles),
+        "table_growths": snap["table_growths"],
+        "mixed_subject_batches": snap["mixed_subject_batches"],
+        "coalesce_width_mean": snap["coalesce_width_mean"],
+        "dispatches": snap["dispatches"],
+        "stream_spans": {
+            "opened": streams_snap["opened"],
+            "closed_by_kind": streams_snap["closed_by_kind"],
+            "active_after_stop": streams_snap["active"],
+        },
+        "slo": slo,
+        "load_final": {k: load_final[k]
+                       for k in ("outstanding", "queued", "streams",
+                                 "backlog_age_s")
+                       if k in load_final},
+        "flight_record": flight_record(
+            tracer, eng.counters, reason="stream_drill_complete"),
+    }
+
+
+def _stream_fit_calibration(prm32, sessions, betas, next_targets,
+                            lm_mod, *, warm_steps, cold_steps_candidates,
+                            target_loss, trials, log) -> dict:
+    """The warm-start criterion's measurement (stream_drill_run):
+    warm-started frozen-shape fits at ``warm_steps`` vs the cheapest
+    COLD fit (rest-pose init) reaching the same convergence bar,
+    both slope-timed.
+
+    Loss parity first: a speed ratio between solves of different
+    quality would be fiction. ``target_loss`` is the converged-for-
+    tracking bar (mean-squared joint residual, m^2); the warm side
+    must sit under it (``warm_loss_matched``) and the cold side's step
+    count is the smallest candidate whose median loss also does.
+    Then the slope: per-fit marginal cost over two in-pass repeat
+    counts (m and 2m fits, quotient of the difference — the
+    bench.py:slope_time reasoning at the call level), interleaved
+    warm/cold per trial with min-over-trials per point (this box's
+    load drifts 5x between seconds; the measure_overhead defense).
+    """
+    import jax
+
+    probes = []
+    for sess, beta, target in zip(sessions, betas, next_targets):
+        probes.append((sess.pose, beta, target))
+
+    def warm_fit(i, n_steps=warm_steps):
+        pose, beta, target = probes[i % len(probes)]
+        return lm_mod.fit_lm(prm32, target, n_steps=n_steps,
+                             data_term="joints", init={"pose": pose},
+                             frozen_shape=beta)
+
+    def cold_fit(i, n_steps):
+        _, beta, target = probes[i % len(probes)]
+        return lm_mod.fit_lm(prm32, target, n_steps=n_steps,
+                             data_term="joints", frozen_shape=beta)
+
+    warm_losses = []
+    for i in range(len(probes)):
+        res = warm_fit(i)
+        warm_losses.append(float(jax.block_until_ready(res.final_loss)))
+    warm_median = float(np.median(warm_losses))
+    warm_ok = warm_median <= target_loss
+
+    cold_steps = None
+    cold_median = None
+    for k in sorted(cold_steps_candidates):
+        losses = []
+        for i in range(len(probes)):
+            res = cold_fit(i, k)
+            losses.append(float(jax.block_until_ready(res.final_loss)))
+        med = float(np.median(losses))
+        if med <= target_loss:
+            cold_steps, cold_median = int(k), med
+            break
+        cold_steps, cold_median = int(k), med   # keep the best-so-far
+    matched = bool(warm_ok and cold_median is not None
+                   and cold_median <= target_loss)
+
+    # Slope timing: per-fit marginal cost, warm vs cold, four points
+    # interleaved (the posed_kernel_bench_run thunk pattern).
+    m1 = len(probes)
+    m2 = 2 * m1
+
+    def run_m(fit, m, n_steps):
+        t0 = time.perf_counter()
+        last = None
+        for i in range(m):
+            last = fit(i, n_steps)
+        jax.block_until_ready(last.pose)
+        return time.perf_counter() - t0
+
+    thunks = {
+        "w1": lambda: run_m(warm_fit, m1, warm_steps),
+        "w2": lambda: run_m(warm_fit, m2, warm_steps),
+        "c1": lambda: run_m(cold_fit, m1, cold_steps),
+        "c2": lambda: run_m(cold_fit, m2, cold_steps),
+    }
+    for k in thunks:
+        thunks[k]()     # settle: every program warm before timing
+    best = {k: float("inf") for k in thunks}
+    for t in range(max(1, trials)):
+        order = sorted(thunks) if t % 2 == 0 \
+            else sorted(thunks, reverse=True)
+        for k in order:
+            best[k] = min(best[k], thunks[k]())
+    s_warm = (best["w2"] - best["w1"]) / (m2 - m1)
+    s_cold = (best["c2"] - best["c1"]) / (m2 - m1)
+    ratio = s_cold / s_warm if s_warm > 0 and s_cold > 0 else None
+    if log:
+        log(f"streams calib: warm {warm_steps} steps (median loss "
+            f"{warm_median:.2e}) vs cold {cold_steps} steps (median "
+            f"{cold_median:.2e}, bar {target_loss:.0e}, matched="
+            f"{matched}); slope {s_warm * 1e3:.2f} vs "
+            f"{s_cold * 1e3:.2f} ms/fit -> ratio "
+            f"{ratio and f'{ratio:.2f}'}x")
+    return {
+        "warm_fit_steps": int(warm_steps),
+        "cold_fit_steps": cold_steps,
+        "fit_target_loss": target_loss,
+        "warm_fit_loss_median": float(f"{warm_median:.5g}"),
+        "cold_fit_loss_median": (None if cold_median is None
+                                 else float(f"{cold_median:.5g}")),
+        "warm_loss_matched": matched,
+        "warm_fit_ms_per_frame": float(f"{s_warm * 1e3:.5g}"),
+        "cold_fit_ms_per_frame": float(f"{s_cold * 1e3:.5g}"),
+        "warm_fit_frames_per_sec": (
+            None if s_warm <= 0 else float(f"{1.0 / s_warm:.5g}")),
+        "cold_fit_frames_per_sec": (
+            None if s_cold <= 0 else float(f"{1.0 / s_cold:.5g}")),
+        "warm_vs_cold_fit_ratio": (
+            None if ratio is None else float(f"{ratio:.4g}")),
+    }

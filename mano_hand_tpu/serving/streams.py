@@ -1,0 +1,535 @@
+"""Streaming sessions: per-user hand tracking as a served workload.
+
+Eleven PRs built serving machinery for STATELESS forwards; real traffic
+(PAPER.md §0 — interactive hand tracking) is per-user streams of
+CORRELATED frames: one subject, one identity, frame t's solution a few
+millimeters from frame t-1's. This module is the product shape those
+PRs were for — ``ServingEngine.open_stream(subject)`` returns a
+session-affine handle whose per-frame step composes the whole stack:
+
+* **frozen-shape LM fitting** (the PR-2 48-col path) is the per-frame
+  solve: the subject's betas are a known constant, so every frame fits
+  pose only, WARM-STARTED from the last converged pose via
+  ``fitting/tracking.py:make_tracker`` — a handful of GN steps suffice
+  because the solution moved only as far as the hand did. All sessions
+  with the same target/step geometry share ONE compiled LM program
+  (shapes are static), so the N-th stream compiles nothing.
+* **cross-session coalescing** (PR 4): the converged pose is served
+  back through ``engine.submit(pose, subject=key)`` — the gathered
+  SubjectTable dispatch — so concurrent streams' frames merge into one
+  mixed-subject batch per bucket. N streams share one program family
+  with zero steady recompiles; the frame's verts are bit-identical to
+  the per-subject posed program.
+* **tier-0 per-frame deadlines** (PR 5): every frame carries an
+  end-to-end TTL spanning fit + dispatch. A frame already expired is
+  swept BEFORE the fit (no solver time on a result nobody reads) and
+  the remaining budget rides the engine's own deadline sweeps; an
+  expired frame resolves ``kind="expired"``, never late-but-fresh.
+* **lifecycle spans** (PR 8): each session carries a tracer span from
+  ``open`` to exactly one terminal — ``closed`` (client close),
+  ``expired`` (idle past ``idle_timeout_s``), ``shed`` (admission
+  refused the open), or ``shutdown`` (``engine.stop`` swept it) —
+  while each frame rides the engine's own request span. "Every stream
+  closes exactly once" and "every frame resolves" are judged by the
+  same flight-record accounting as every drill.
+* **SLOs** (PR 9): frames are tier-0 traffic, so the per-tier
+  burn-rate report covers them; the stream drill
+  (serving/measure.py:stream_drill_run, bench config15) adds a frame-
+  latency-p99 objective on top.
+
+Chaos, failover, and overload compose UNCHANGED: the serving half of a
+frame is an ordinary engine request, so a CPU-failover frame is
+bit-identical to a direct CPU call (the PR-3 contract), and — because
+the fit runs BEFORE dispatch and never touches the chaos-wrapped
+executables — the warm start stays valid through any serving fault.
+
+Locking: the ``StreamManager`` owns ONE lock guarding the registry and
+every session's lifecycle fields (terminal kind, in-flight frame table,
+last-activity stamp), so ``snapshot()`` — the ``ServingEngine.load()``
+streams block — is a single lock-held copy (the PR-5 torn-telemetry
+rule). Each session owns a separate ``_fit_lock`` that only serializes
+its warm-start chain (frame N+1's fit must see frame N's pose); the two
+are never nested, and neither is ever held across an engine lock —
+tracer span closes are staged outside the manager lock.
+
+Typical use::
+
+    eng = ServingEngine(params, ...)
+    with eng:
+        sess = eng.open_stream(user_betas, frame_deadline_s=0.05)
+        for target in sensor:                 # [J, 3] keypoints
+            fut = sess.submit_frame(target)   # fit + gathered dispatch
+            res = fut.result()                # FrameResult(pose, verts)
+        sess.close()
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from mano_hand_tpu.serving.engine import ServingError
+
+_UNSET = object()
+
+#: Stream terminal kinds — the session-lifecycle vocabulary (a strict
+#: superset member, "closed", joins the engine's request kinds; see
+#: obs/trace.py:TERMINAL_KINDS).
+STREAM_TERMINAL_KINDS = ("closed", "expired", "shed", "shutdown")
+
+#: The ``ServingEngine.load()["streams"]`` keys when no stream was ever
+#: opened — kept in lockstep with ``StreamManager.snapshot`` (pinned in
+#: tests/test_streams.py) so the load surface never changes shape.
+EMPTY_SNAPSHOT = {
+    "active": 0,
+    "opened": 0,
+    "frames_submitted": 0,
+    "frames_resolved": 0,
+    "frames_in_flight": 0,
+    "backlog_age_s": 0.0,
+    "closed_by_kind": {},
+    "frames_by_kind": {},
+}
+
+
+def empty_snapshot() -> dict:
+    """A FRESH empty streams block (``ServingEngine.load()`` uses
+    this, never the constant: a shallow ``dict(EMPTY_SNAPSHOT)`` would
+    alias the nested by-kind dicts, and one consumer mutating its
+    load() result would corrupt every later snapshot)."""
+    return {**EMPTY_SNAPSHOT, "closed_by_kind": {},
+            "frames_by_kind": {}}
+
+
+class FrameResult(NamedTuple):
+    """One resolved stream frame: the converged pose (the next frame's
+    warm start) and the posed verts served through the gathered
+    engine dispatch (bit-identical to the per-subject posed program)."""
+
+    pose: np.ndarray       # [J, 3] converged axis-angle pose
+    verts: np.ndarray      # [V, 3] posed verts from the engine
+    fit_loss: float        # final LM residual (frozen-shape, pose-only)
+    frame: int             # 0-based frame index within the session
+
+
+class StreamSession:
+    """Session-affine handle over one subject's frame stream.
+
+    Built by ``ServingEngine.open_stream`` — not directly. Frames are
+    serialized per session (the warm-start chain is causal); DIFFERENT
+    sessions' frames run concurrently and their serving dispatches
+    coalesce in the engine.
+    """
+
+    def __init__(self, manager: "StreamManager", stream_id: int,
+                 subject: str, betas: np.ndarray, span, state, step,
+                 frame_deadline_s: Optional[float],
+                 idle_timeout_s: Optional[float]):
+        self._mgr = manager
+        self.stream_id = stream_id
+        self.subject = subject          # the specialize() key
+        self.betas = betas              # frozen shape (the CPU-failover
+        #   tier re-derives the full forward from these — engine-owned)
+        self.span = span                # PR-8 stream-lifecycle span id
+        self.frame_deadline_s = frame_deadline_s
+        self.idle_timeout_s = idle_timeout_s
+        # Warm-start chain: guarded by _fit_lock (never nested with the
+        # manager lock — see the module docstring).
+        self._fit_lock = threading.Lock()
+        self._state = state
+        self._step = step
+        # Lifecycle fields below are guarded by the MANAGER's lock.
+        self.terminal: Optional[str] = None
+        self.last_activity = time.monotonic()
+        self.inflight: dict = {}        # frame id -> submit t (monotonic)
+        self.frames_submitted = 0
+        self.frames_by_kind: dict = {}
+
+    # ------------------------------------------------------------- frames
+    @property
+    def pose(self) -> np.ndarray:
+        """The current warm start ([J, 3]) — the last converged pose."""
+        with self._fit_lock:
+            return np.asarray(self._state.pose)
+
+    @property
+    def frame(self) -> int:
+        """Frames the tracker has consumed so far."""
+        with self._fit_lock:
+            return int(self._state.frame)
+
+    def submit_frame(self, target, *, deadline_s=_UNSET) -> Future:
+        """Fit one frame and serve its verts; returns a Future of a
+        ``FrameResult``.
+
+        The frozen-shape LM solve runs in the CALLING thread (warm-
+        started under the session's fit lock, so concurrent submitters
+        chain causally), then the converged pose is submitted through
+        the engine's gathered pose-only path at tier 0 with whatever
+        remains of the frame's deadline. Every outcome is structured:
+        ``ok`` (a FrameResult), or a ``ServingError`` of kind ``shed``
+        / ``expired`` / ``error`` / ``shutdown`` SET ON the future —
+        never raised from here, never stranded — except a frame sent
+        to a stream already at a terminal, which raises immediately
+        (kind="shed", phase="stream": the session refused admission).
+        """
+        eng = self._mgr.engine
+        if deadline_s is _UNSET:
+            deadline_s = self.frame_deadline_s
+        fid = self._mgr.admit_frame(self)   # raises if terminal
+        tr = eng.tracer
+        if tr is not None:
+            tr.event(self.span, "frame", n=fid)
+        fut: Future = Future()
+        deadline = (None if deadline_s is None
+                    else time.monotonic() + float(deadline_s))
+        loss = float("nan")
+        try:
+            with self._fit_lock:
+                if deadline is None or time.monotonic() < deadline:
+                    state, res = self._step(self._state, target)
+                    # Force the solve INSIDE the lock so the state
+                    # frame N+1 warm-starts from is frame N's converged
+                    # pose, not an in-flight device value.
+                    pose = np.asarray(res.pose)
+                    loss = float(np.asarray(res.final_loss))
+                    self._state = state
+                else:
+                    # Expired before the fit: no solver time is spent —
+                    # the warm pose rides to the engine's born-expired
+                    # path below purely so the expiry is counted and
+                    # span-closed by the one resolution machinery.
+                    pose = np.asarray(self._state.pose)
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            vfut = eng.submit(pose, subject=self.subject, priority=0,
+                              deadline_s=remaining)
+        except ServingError as e:
+            # Admission shed (or born-expired raced): structured
+            # resolution on the frame future — the caller has ONE
+            # channel for every outcome.
+            self._mgr.frame_done(self, fid, e.kind)
+            fut.set_exception(e)
+            return fut
+        except BaseException as e:  # noqa: BLE001 — never strand a frame
+            self._mgr.frame_done(self, fid, "error")
+            fut.set_exception(e)
+            return fut
+
+        def _resolve(f, pose=pose, loss=loss, fid=fid):
+            exc = f.exception()
+            if exc is None:
+                fut.set_result(FrameResult(
+                    pose=pose, verts=f.result(), fit_loss=loss,
+                    frame=fid))
+                kind = "ok"
+            else:
+                fut.set_exception(exc)
+                kind = (exc.kind if isinstance(exc, ServingError)
+                        else "error")
+            self._mgr.frame_done(self, fid, kind)
+
+        vfut.add_done_callback(_resolve)
+        return fut
+
+    def step(self, target, *, deadline_s=_UNSET) -> FrameResult:
+        """Synchronous convenience: ``submit_frame(...).result()``."""
+        return self.submit_frame(target, deadline_s=deadline_s).result()
+
+    # ---------------------------------------------------------- lifecycle
+    def close(self) -> bool:
+        """Resolve this session's span with the ``closed`` terminal;
+        returns False when it already reached a terminal (idempotent —
+        a double close is a no-op, never a double span close)."""
+        return self._mgr.close(self, "closed")
+
+    def __enter__(self) -> "StreamSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class StreamManager:
+    """Registry + lifecycle owner for an engine's stream sessions.
+
+    One lock guards everything the ``snapshot()`` reports — the
+    registry, per-session terminals, in-flight frame tables, activity
+    stamps — so ``ServingEngine.load()``'s streams block is a single
+    lock-held copy (the torn-telemetry rule). Span closes are staged
+    OUTSIDE the lock (the tracer calls nothing back, but the dispatch
+    path must never queue behind telemetry).
+    """
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._lock = threading.Lock()
+        self._active: dict = {}         # stream id -> StreamSession
+        # Sessions that reached a terminal with frames still in
+        # flight: their frames must stay visible to snapshot() until
+        # they resolve (the ledger's two views — frames_in_flight and
+        # submitted-minus-resolved — must never contradict), then the
+        # entry drops, so memory stays bounded by in-flight work.
+        self._draining: dict = {}
+        # Set by shutdown() UNDER the lock, checked by register()'s
+        # insertion hold: an open_stream racing (or following)
+        # engine.stop() must be refused, not registered into a manager
+        # whose one-shot sweep already ran — that session's span would
+        # never close. engine.start() re-opens (the documented
+        # stop()/start() restart).
+        self._stopped = False
+        # Active sessions that carry an idle_timeout_s: the sweep's
+        # fast path — with none, admit_frame's per-frame sweep is one
+        # counter read under the lock, never an O(active) scan.
+        self._idle_sessions = 0
+        self._next_id = 1
+        self.opened = 0
+        self.frames_submitted = 0
+        self.frames_resolved = 0
+        self.closed_by_kind: dict = {}
+        self.frames_by_kind: dict = {}
+
+    # ------------------------------------------------------------ opening
+    def register(self, session_factory) -> StreamSession:
+        """Allocate an id and register the session the factory builds
+        (the factory runs OUTSIDE the lock — it compiles nothing, but
+        it does build tracker closures). Raises a structured
+        ``ServingError(kind="shutdown")`` when the manager was swept
+        by ``engine.stop()`` — including a stop that lands BETWEEN the
+        two lock holds here (the caller owns closing its span)."""
+        with self._lock:
+            if self._stopped:
+                raise ServingError(
+                    "engine stopped; open_stream refused (restart the "
+                    "engine to open new streams)",
+                    phase="stream", kind="shutdown")
+            sid = self._next_id
+            self._next_id += 1
+        sess = session_factory(sid)
+        with self._lock:
+            if self._stopped:
+                raise ServingError(
+                    "engine stopped while this stream was opening; "
+                    "open_stream refused (restart the engine)",
+                    phase="stream", kind="shutdown")
+            self._active[sid] = sess
+            self.opened += 1
+            if sess.idle_timeout_s is not None:
+                self._idle_sessions += 1
+        return sess
+
+    def reopen(self) -> None:
+        """``engine.start()``'s hook: a restarted engine accepts new
+        streams again (already-swept sessions stay terminal)."""
+        with self._lock:
+            self._stopped = False
+
+    # ------------------------------------------------------------- frames
+    def admit_frame(self, sess: StreamSession) -> int:
+        """Admission for one frame: sweeps idle-expired sessions first,
+        then registers the frame in-flight. Raises a structured
+        ``ServingError(kind="shed", phase="stream")`` when the session
+        already reached a terminal — a closed stream refuses frames the
+        way a full queue refuses submits."""
+        self.sweep_idle()
+        now = time.monotonic()
+        with self._lock:
+            if sess.terminal is not None:
+                terminal = sess.terminal
+            else:
+                fid = sess.frames_submitted
+                sess.frames_submitted += 1
+                sess.inflight[fid] = now
+                sess.last_activity = now
+                self.frames_submitted += 1
+                return fid
+        raise ServingError(
+            f"stream {sess.stream_id} is {terminal}; frames after a "
+            "terminal are refused — open a new stream (the warm pose "
+            "is available as session.pose)",
+            phase="stream", kind="shed")
+
+    def frame_done(self, sess: StreamSession, fid: int,
+                   kind: str) -> None:
+        with self._lock:
+            sess.inflight.pop(fid, None)
+            sess.last_activity = time.monotonic()
+            self.frames_resolved += 1
+            sess.frames_by_kind[kind] = sess.frames_by_kind.get(kind, 0) + 1
+            self.frames_by_kind[kind] = self.frames_by_kind.get(kind, 0) + 1
+            if sess.terminal is not None and not sess.inflight:
+                self._draining.pop(sess.stream_id, None)
+
+    # ---------------------------------------------------------- lifecycle
+    def close(self, sess: StreamSession, kind: str) -> bool:
+        """Move one session to a terminal exactly once; the first
+        caller wins and closes the span, a repeat is a no-op."""
+        with self._lock:
+            if sess.terminal is not None:
+                return False
+            sess.terminal = kind
+            self._active.pop(sess.stream_id, None)
+            if sess.idle_timeout_s is not None:
+                self._idle_sessions -= 1
+            if sess.inflight:
+                self._draining[sess.stream_id] = sess
+            self.closed_by_kind[kind] = self.closed_by_kind.get(kind, 0) + 1
+        tr = self.engine.tracer
+        if tr is not None:
+            # Outside the lock: span closes are telemetry, and the
+            # frame path must never queue behind them.
+            tr.close(sess.span, kind, frames=sess.frames_submitted)
+        return True
+
+    def sweep_idle(self, now: Optional[float] = None) -> int:
+        """Expire sessions idle past their ``idle_timeout_s`` — the
+        deadline-pressure eviction: a stream nobody feeds must not pin
+        its span (or its admission slot) forever. Swept at every frame
+        admission AND every ``snapshot()`` (the ``load()``/status
+        polling path), so expiry needs frame traffic OR monitoring —
+        a fully untouched engine sweeps at its next stop(). Returns
+        the number expired this sweep."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            if self._idle_sessions == 0:
+                return 0       # fast path: nothing can expire
+            victims = [s for s in self._active.values()
+                       if s.idle_timeout_s is not None
+                       and now - s.last_activity >= s.idle_timeout_s]
+        n = 0
+        for s in victims:
+            if self.close(s, "expired"):
+                n += 1
+        return n
+
+    def shutdown(self) -> int:
+        """``engine.stop``'s sweep: every still-open session reaches
+        the ``shutdown`` terminal (span closed exactly once); in-flight
+        frames resolve through the engine's own future sweeps, and new
+        registrations are refused until ``engine.start()`` reopens."""
+        with self._lock:
+            self._stopped = True
+            open_now = list(self._active.values())
+        n = 0
+        for s in open_now:
+            if self.close(s, "shutdown"):
+                n += 1
+        return n
+
+    # ------------------------------------------------------------ reading
+    def snapshot(self) -> dict:
+        """The ``load()`` streams block: active count, frame ledger,
+        and the backlog age (the oldest in-flight frame across every
+        session), all from ONE lock hold — a snapshot racing live
+        frames is internally consistent, never a torn tuple. Also
+        sweeps idle expiry first (outside the snapshot hold), so a
+        session nobody feeds expires on the monitoring path, not just
+        at the next frame admission."""
+        self.sweep_idle()
+        now = time.monotonic()
+        with self._lock:
+            inflight = 0
+            oldest = None
+            for table in (self._active, self._draining):
+                for s in table.values():
+                    inflight += len(s.inflight)
+                    for t0 in s.inflight.values():
+                        if oldest is None or t0 < oldest:
+                            oldest = t0
+            return {
+                "active": len(self._active),
+                "opened": self.opened,
+                "frames_submitted": self.frames_submitted,
+                "frames_resolved": self.frames_resolved,
+                "frames_in_flight": inflight,
+                "backlog_age_s": (0.0 if oldest is None
+                                  else max(0.0, now - oldest)),
+                "closed_by_kind": dict(self.closed_by_kind),
+                "frames_by_kind": dict(self.frames_by_kind),
+            }
+
+
+def open_stream(engine, subject, *, n_steps: int = 4,
+                data_term: str = "joints", solver: str = "lm",
+                frame_deadline_s: Optional[float] = None,
+                idle_timeout_s: Optional[float] = None,
+                resume_pose=None, **tracker_kw) -> StreamSession:
+    """``ServingEngine.open_stream``'s implementation (see the engine
+    method's docstring for the caller-facing contract)."""
+    from mano_hand_tpu.fitting import tracking
+
+    mgr = engine._stream_manager()
+    # Resolve the subject to (key, betas). An ARRAY is the natural
+    # identity — unknown betas simply bake (specialize is idempotent),
+    # and an EVICTED subject's key stays servable because the betas
+    # registry outlives its table row (the row re-bakes at dispatch).
+    if isinstance(subject, str):
+        with engine._exe_lock:
+            betas = engine._subject_betas.get(subject)
+        if betas is None:
+            raise ValueError(
+                f"unknown subject {subject!r}; pass the betas array "
+                "(open_stream bakes it) or a specialize() key")
+        key = subject
+        engine.specialize(betas)    # refresh LRU; re-bake if evicted
+    else:
+        betas = np.ascontiguousarray(
+            np.asarray(subject, engine._dtype).reshape(engine._n_shape))
+        key = engine.specialize(betas)
+
+    tr = engine.tracer
+    span = tr.start("stream", tier=0) if tr is not None else None
+    # Stream-open admission (PR 5): under a bounded queue, a tier-0
+    # outstanding count at quota means every frame this stream submits
+    # right now would shed — refuse the OPEN with the same structured
+    # kind instead of handing back a handle that can only shed. The
+    # check is advisory (a racing submit can still fill the queue);
+    # per-frame admission stays the hard bound.
+    if engine.max_queued is not None:
+        with engine._live_lock:
+            outstanding = len(engine._live)
+        quota = engine._quota(0)
+        if outstanding >= quota:
+            if tr is not None:
+                tr.close(span, "shed")
+            raise ServingError(
+                f"stream open shed: {outstanding} outstanding >= tier-0 "
+                f"quota {quota} — the engine is over capacity; poll "
+                "load() and retry",
+                phase="stream", kind="shed")
+
+    try:
+        state, step = tracking.make_tracker(
+            engine._params, n_steps=n_steps, solver=solver,
+            data_term=data_term, frozen_shape=betas,
+            init_pose=resume_pose, **tracker_kw)
+
+        def factory(sid: int) -> StreamSession:
+            return StreamSession(
+                mgr, sid, key, betas, span, state, step,
+                frame_deadline_s=frame_deadline_s,
+                idle_timeout_s=idle_timeout_s)
+
+        sess = mgr.register(factory)
+    except ServingError as e:
+        # A stopped-manager refusal (register's shutdown race) keeps
+        # its own terminal kind on the span.
+        if tr is not None:
+            tr.close(span, e.kind)
+        raise
+    except BaseException:
+        # A tracker-build error (bad solver/tracker_kw) must not leak
+        # the just-opened span — the closed-exactly-once accounting is
+        # a judged criterion, and one leak fails every later drill on
+        # this tracer.
+        if tr is not None:
+            tr.close(span, "error")
+        raise
+    if tr is not None:
+        tr.event(span, "open", subject=key, stream=sess.stream_id)
+    return sess

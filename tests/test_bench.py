@@ -257,6 +257,14 @@ def test_bench_cpu_tiny_run_end_to_end():
         "--posed-requests", "12", "--posed-subjects", "3",
         "--posed-max-rows", "2", "--posed-max-bucket", "8",
         "--posed-lm-batch", "0",
+        # config15 (PR 12) is SKIPPED here, not shrunk: the stream
+        # drill's frozen-shape LM fit + warm-vs-cold calibration are
+        # several cold scan compiles in this test's fresh per-run
+        # bench cache (the config13 budget reasoning); its plumbing
+        # runs in `make bench-interpret` (--stream-streams 16) and its
+        # tiny e2e in `make stream-smoke`; the criteria-sized
+        # 208-stream run lives in `make serve-smoke`.
+        "--stream-streams", "0",
     )
     assert rc == 0, line
     assert line["value"] is not None and line["value"] > 0
@@ -292,6 +300,10 @@ def test_bench_cpu_tiny_run_end_to_end():
     assert pk["steady_recompiles_fused"] == 0
     assert pk["steady_recompiles_xla"] == 0
     assert "lm_e2e_steps_per_sec" not in pk
+    # config15 (PR 12) is deliberately skipped above — the streams
+    # block must be absent, not failed (bench-interpret/serve-smoke
+    # carry it).
+    assert "streams" not in d
     assert "config_errors" not in line, line.get("config_errors")
 
 

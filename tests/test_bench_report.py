@@ -884,3 +884,181 @@ def test_history_excludes_the_run_itself():
     p = _run("BENCH_r02.json", "--history", "BENCH_r02.json")
     assert p.returncode == 0, p.stdout
     assert "no usable prior rounds" in p.stdout
+
+
+def _streams_block(**over):
+    st = {
+        "streams": 208, "frames_per_stream": 4, "subjects": 208,
+        "workers": 16, "buckets": [8, 16, 32, 64],
+        "frame_deadline_s": 5.0,
+        "frames_submitted": 832, "frames_resolved_fraction": 1.0,
+        "outcomes": {"ok": 830, "shed": 0, "expired": 2, "error": 0,
+                     "stranded": 0},
+        "chaos_spec": "error@0-",
+        "chaos_outcomes": {"ok": 208, "shed": 0, "expired": 0,
+                           "error": 0, "stranded": 0},
+        "failovers": 30,
+        "failover_vs_cpu_direct_max_abs_err": 0.0,
+        "warm_start_after_failover_consistent": True,
+        "frames_per_sec": 610.0, "frame_p50_ms": 15.2,
+        "frame_p99_ms": 24.8,
+        "warm_fit_steps": 4, "cold_fit_steps": 16,
+        "fit_target_loss": 1e-9,
+        "warm_fit_loss_median": 4.9e-19,
+        "cold_fit_loss_median": 3.1e-19, "warm_loss_matched": True,
+        "warm_fit_ms_per_frame": 1.5, "cold_fit_ms_per_frame": 4.4,
+        "warm_fit_frames_per_sec": 666.0,
+        "cold_fit_frames_per_sec": 227.0,
+        "warm_vs_cold_fit_ratio": 2.93,
+        "steady_recompiles": 0, "table_growths": 5,
+        "mixed_subject_batches": 140, "coalesce_width_mean": 6.1,
+        "dispatches": 150,
+        "stream_spans": {"opened": 208,
+                         "closed_by_kind": {"closed": 206,
+                                            "shutdown": 2},
+                         "active_after_stop": 0},
+        "slo": {"schema": 1, "tiers": {"0": {
+            "submitted": 832, "served": 830, "shed": 0, "expired": 2,
+            "latency_p99_ms": 24.8, "goodput": 0.9976,
+            "deadline_hit_rate": 0.9976, "shed_fraction": 0.0,
+            "objectives": {"goodput_target": 0.99,
+                           "deadline_hit_target": 0.999,
+                           "shed_budget": 0.01,
+                           "p99_target_ms": 5000.0},
+            "burn_rates": {"goodput": 0.24, "deadline_hit": 2.4,
+                           "shed": 0.0, "latency_p99": 0.005},
+            "ok": False}}, "ok": False},
+        "flight_record": {"schema": 1, "reason": "stream_drill_complete",
+                          "accounting": {"spans_started": 1040,
+                                         "spans_closed": 1040,
+                                         "spans_open": 0,
+                                         "closed_by_kind": {},
+                                         "incidents": 30,
+                                         "events_dropped": 0}},
+    }
+    st.update(over)
+    return st
+
+
+@pytest.mark.slow
+def test_streams_metrics_block(tmp_path):
+    """The streaming-session drill (config15, PR 12): every frame
+    resolved through the mid-drill chaos plan, warm-start fit >= 1.2x
+    the loss-matched cold fit, bit-identical failover with the warm
+    start intact, zero steady recompiles, latency SLO burn reported,
+    every session span closed once — judged as a raw `serve-bench
+    --streams` artifact AND inside a serving-only envelope."""
+    st = _streams_block()
+    raw = tmp_path / "streams_raw.json"
+    raw.write_text(json.dumps(st))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    for name in ("streams_all_frames_resolved", "streams_warm_start_12x",
+                 "streams_failover_bit_identical",
+                 "streams_zero_recompiles",
+                 "streams_slo_latency_burn_reported",
+                 "streams_sessions_closed_once",
+                 "streams_spans_closed_once"):
+        assert f"[PASS] {name}" in p.stdout, (name, p.stdout)
+    assert "STREAMS CRITERIA PASS" in p.stdout
+
+    # Each criterion fails loudly on its own.
+    cases = [
+        (dict(outcomes={"ok": 830, "shed": 0, "expired": 0, "error": 0,
+                        "stranded": 2},
+              frames_resolved_fraction=0.9976),
+         "streams_all_frames_resolved"),
+        (dict(warm_vs_cold_fit_ratio=1.05), "streams_warm_start_12x"),
+        (dict(failover_vs_cpu_direct_max_abs_err=1e-6),
+         "streams_failover_bit_identical"),
+        (dict(warm_start_after_failover_consistent=False),
+         "streams_failover_bit_identical"),
+        (dict(steady_recompiles=3), "streams_zero_recompiles"),
+        (dict(stream_spans={"opened": 208,
+                            "closed_by_kind": {"closed": 206},
+                            "active_after_stop": 1}),
+         "streams_sessions_closed_once"),
+    ]
+    for over, name in cases:
+        raw.write_text(json.dumps(_streams_block(**over)))
+        p = _run(str(raw))
+        assert p.returncode == 1, (name, p.stdout)
+        assert f"[FAIL] {name}" in p.stdout, (name, p.stdout)
+
+    # A loss-UNmatched cold side records the ratio without judging it.
+    raw.write_text(json.dumps(_streams_block(
+        warm_loss_matched=False, warm_vs_cold_fit_ratio=0.9)))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    assert "ratio unjudged" in p.stdout
+    assert "streams_warm_start_12x" not in p.stdout
+
+    # A plumbing-size run records the concurrency scale without
+    # claiming it (the coalesce subjects<8 precedent).
+    raw.write_text(json.dumps(_streams_block(streams=16)))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    assert "concurrency unjudged" in p.stdout
+
+    # Inside a serving-only envelope; a crashed leg fails loudly.
+    envelope = {
+        "metric": "serving_engine_evals_per_sec", "value": 8114.4,
+        "unit": "evals/s", "vs_baseline": None, "device": "cpu:cpu",
+        "detail": {
+            "serving": {
+                "engine_evals_per_sec": 8114.4,
+                "engine_vs_direct_ratio": 1.297,
+                "warm_bucket": 32, "steady_recompiles": 0,
+                "requests": 64, "compiles": 6,
+            },
+            "streams": _streams_block(),
+        }}
+    only = tmp_path / "serve_only_streams.json"
+    only.write_text(json.dumps(envelope))
+    p = _run(str(only))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] streams_all_frames_resolved" in p.stdout
+    assert "SERVING CRITERIA PASS" in p.stdout
+    crashed = dict(envelope, config_errors={
+        "config15_streams": "RuntimeError: boom"})
+    del crashed["detail"]["streams"]
+    only.write_text(json.dumps(crashed))
+    p = _run(str(only))
+    assert p.returncode == 1
+    assert "[FAIL] streams_leg_ran" in p.stdout
+
+
+@pytest.mark.slow
+def test_history_frame_latency_regression_fails_by_name(tmp_path):
+    """The config15 satellite: `--history` picks up the streams
+    block's per-frame rate AND latency keys automatically — latency is
+    LOWER-is-better, so a fresh artifact whose frame p99 rose past
+    tolerance fails by the nested key's name, while an improved
+    (lower) latency passes."""
+    prior = {"metric": "mano_forward_evals_per_sec", "value": 10e6,
+             "device": "cpu:cpu",
+             "detail": {"streams": {"frames_per_sec": 600.0,
+                                    "frame_p50_ms": 15.0,
+                                    "frame_p99_ms": 25.0}}}
+    fresh = {"metric": "mano_forward_evals_per_sec", "value": 10e6,
+             "device": "cpu:cpu",
+             "detail": {"streams": {"frames_per_sec": 620.0,
+                                    "frame_p50_ms": 14.0,
+                                    "frame_p99_ms": 40.0}}}
+    pp, fp = tmp_path / "prior.json", tmp_path / "fresh.json"
+    pp.write_text(json.dumps(prior))
+    fp.write_text(json.dumps(fresh))
+    p = _run(str(fp), "--history", str(pp))
+    assert p.returncode == 1, p.stdout
+    # The latency regression fails BY NAME; the rate key and the
+    # improved p50 pass (inverted sense applied per key kind).
+    assert "[FAIL] streams.frame_p99_ms" in p.stdout
+    assert "lower is better" in p.stdout
+    assert "[PASS] streams.frames_per_sec" in p.stdout
+    assert "[PASS] streams.frame_p50_ms" in p.stdout
+    assert "PERF REGRESSION" in p.stdout
+    # The same artifacts inside tolerance pass.
+    p = _run(str(fp), "--history", str(pp),
+             "--history-tolerance", "0.7")
+    assert p.returncode == 0, p.stdout
+    assert "PERF NO-REGRESSION" in p.stdout

@@ -933,8 +933,14 @@ def test_serve_bench_cold_start_guard(capsys):
                      "--aot-dir", "/tmp/x", "--chaos", "drill"]) == 2
     assert cli.main(["serve-bench", "--cold-start",
                      "--aot-dir", "/tmp/x", "--deadline-s", "1.0"]) == 2
+    # PR 12: --streams is a drill too — the cold-start branch runs
+    # first in the handler, so it must refuse the combination itself
+    # rather than silently dropping the streams drill.
+    assert cli.main(["serve-bench", "--cold-start",
+                     "--aot-dir", "/tmp/x", "--streams", "8"]) == 2
     err = capsys.readouterr().err
     assert "--cold-start" in err and "--deadline-s" in err
+    assert "--streams" in err
     assert cli.main(["serve-bench", "--cold-start"]) == 2
     assert "requires --aot-dir" in capsys.readouterr().err
 

@@ -573,6 +573,14 @@ def test_load_with_tracer_quantiles_untorn(params32):
             ld = eng.load()
             assert set(("latency_by_tier", "backlog_age_s")) <= set(ld)
             assert ld["backlog_age_s"] >= 0.0
+            # PR 12: the streams block rides the same snapshot —
+            # shape-stable (streams.EMPTY_SNAPSHOT keys) even on an
+            # engine that never opened a session, internally
+            # consistent under load (its own one-lock-hold copy).
+            st = ld["streams"]
+            assert st["active"] == 0 and st["opened"] == 0
+            assert st["frames_in_flight"] == 0
+            assert st["backlog_age_s"] == 0.0
             t0 = ld["latency_by_tier"].get("0")
             if t0 is not None:
                 assert t0["p50_ms"] <= t0["p99_ms"] + 1e-9

@@ -1,0 +1,400 @@
+"""Streaming sessions (the PR-12 tentpole), CPU-verified.
+
+The session subsystem's contracts, pinned:
+
+* a frame step = frozen-shape LM fit (warm-started) + gathered tier-0
+  dispatch, with the verts BIT-identical to the per-subject posed
+  program and the warm state advancing only on a real fit;
+* lifecycle edges — open on an evicted subject re-bakes (never errors),
+  frames after a terminal are refused with a structured ServingError,
+  idle sessions expire, ``stop()`` sweeps open sessions to ``shutdown``
+  — each terminal closing the session's span exactly once;
+* chaos/failover compose unchanged: a CPU-failover frame is
+  bit-identical to a direct CPU call and the warm start it leaves is
+  the fit's own pose (pose track identical to a fault-free run);
+* ``load()["streams"]`` is a ONE-lock-hold snapshot (the PR-5/8
+  torn-telemetry rule extended), shape-stable whether or not any
+  stream was ever opened, and exported by the metrics mapper;
+* the tiny-e2e drill (serving/measure.py:stream_drill_run) resolves
+  100% of frames with zero steady recompiles.
+
+Slow-marked per the PR-8 tier-1-budget precedent: the LM fit programs
+are real compiles, so the module runs as its own `make stream-smoke`
+process (own compile-cache dir) wired into `make check`, not in the
+tier-1 `-m 'not slow'` lane.
+"""
+
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from mano_hand_tpu.models import core
+from mano_hand_tpu.obs import Tracer
+from mano_hand_tpu.serving import buckets as bucket_mod
+from mano_hand_tpu.serving import streams as streams_mod
+from mano_hand_tpu.serving.engine import ServingEngine, ServingError
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+def _betas(seed, n=10):
+    return np.random.default_rng(seed).normal(size=(n,)).astype(np.float32)
+
+
+def _track(params32, betas, frames=3, seed=2, scale=0.25):
+    """Smooth ground-truth pose track + per-frame joint targets."""
+    rng = np.random.default_rng(seed)
+    end = rng.normal(scale=scale, size=(16, 3)).astype(np.float32)
+    alphas = np.linspace(0.0, 1.0, frames, dtype=np.float32)
+    poses = alphas[:, None, None] * end[None]
+    out = core.jit_forward_batched(
+        params32, jnp.asarray(poses),
+        jnp.broadcast_to(jnp.asarray(betas), (frames, 10)))
+    return poses, np.asarray(out.posed_joints)
+
+
+def _engine(params32, tracer=None, **kw):
+    kw.setdefault("min_bucket", 1)
+    kw.setdefault("max_bucket", 4)
+    kw.setdefault("max_delay_s", 0.001)
+    return ServingEngine(params32, tracer=tracer, **kw)
+
+
+def test_stream_frames_serve_bit_identical(params32):
+    """The tentpole loop: frames fit + serve; verts match the posed
+    program bitwise; warm state advances; spans balance."""
+    tr = Tracer()
+    betas = _betas(1)
+    _, targets = _track(params32, betas, frames=3)
+    with _engine(params32, tracer=tr) as eng:
+        sess = eng.open_stream(betas, n_steps=4, data_term="joints")
+        results = [sess.step(t) for t in targets]
+        assert sess.frame == 3
+        assert [r.frame for r in results] == [0, 1, 2]
+        # Tracking converged (joints targets, frozen true betas).
+        assert results[-1].fit_loss < 1e-8
+        # The served verts ARE the gathered dispatch's — bit-identical
+        # to the per-subject posed program at the same padded size.
+        sh = core.jit_specialize(params32.device_put(),
+                                 jnp.asarray(betas))
+        b = bucket_mod.bucket_for(1, eng.buckets)
+        want = np.asarray(core.jit_forward_posed_batched(
+            sh, bucket_mod.pad_rows(results[-1].pose[None], b)).verts)[0]
+        np.testing.assert_array_equal(results[-1].verts, want)
+        # The session's warm start is the last converged pose.
+        np.testing.assert_array_equal(sess.pose, results[-1].pose)
+        assert sess.close()
+        assert not sess.close()        # idempotent, no double span close
+    acc = tr.accounting()
+    assert acc["spans_started"] == acc["spans_closed"]  # 3 frames + 1 stream
+    assert acc["spans_open"] == 0
+    assert acc["closed_by_kind"].get("closed") == 1
+    assert acc["closed_by_kind"].get("ok") == 3
+
+
+def test_open_stream_unknown_and_evicted_subject(params32):
+    """Open on unknown betas bakes; open on an EVICTED key re-bakes —
+    neither is an error. Only a never-seen KEY (no betas to re-bake
+    from) is a caller error."""
+    b1, b2, b3 = _betas(11), _betas(12), _betas(13)
+    _, targets = _track(params32, b1, frames=2)
+    with _engine(params32, max_subjects=2) as eng:
+        k1 = eng.specialize(b1)
+        eng.specialize(b2)
+        eng.specialize(b3)              # evicts k1 (LRU, capacity 2)
+        assert eng.counters.specializations_evicted >= 1
+        # Evicted key: open re-bakes instead of erroring.
+        sess = eng.open_stream(k1, n_steps=4, data_term="joints")
+        res = sess.step(targets[0])
+        assert np.isfinite(res.fit_loss)
+        # Unknown betas array: first bake, not an error.
+        sess2 = eng.open_stream(_betas(14), n_steps=4,
+                                data_term="joints")
+        assert sess2.subject != sess.subject
+        # Never-seen key: structured caller error.
+        with pytest.raises(ValueError, match="unknown subject"):
+            eng.open_stream("deadbeef00000000")
+
+
+def test_frames_after_close_refused(params32):
+    betas = _betas(21)
+    _, targets = _track(params32, betas, frames=2)
+    with _engine(params32) as eng:
+        sess = eng.open_stream(betas, n_steps=4, data_term="joints")
+        sess.step(targets[0])
+        sess.close()
+        with pytest.raises(ServingError) as ei:
+            sess.submit_frame(targets[1])
+        assert ei.value.kind == "shed"
+        assert ei.value.phase == "stream"
+        assert "closed" in str(ei.value)
+
+
+def test_idle_expiry_under_deadline_pressure(params32):
+    """A session nobody feeds expires at its idle timeout: the span
+    closes ``expired`` exactly once and later frames are refused."""
+    tr = Tracer()
+    betas = _betas(31)
+    _, targets = _track(params32, betas, frames=2)
+    with _engine(params32, tracer=tr) as eng:
+        sess = eng.open_stream(betas, n_steps=4, data_term="joints",
+                               idle_timeout_s=0.05)
+        sess.step(targets[0])
+        time.sleep(0.12)
+        # The MONITORING path sweeps too: load() alone expires the
+        # idle session — no frame traffic needed.
+        snap = eng.load()["streams"]
+        assert snap["closed_by_kind"] == {"expired": 1}
+        assert snap["active"] == 0
+        with pytest.raises(ServingError) as ei:
+            sess.submit_frame(targets[1])
+        assert ei.value.kind == "shed" and "expired" in str(ei.value)
+    assert tr.accounting()["closed_by_kind"].get("expired") == 1
+
+
+def test_stop_sweeps_open_streams_to_shutdown(params32):
+    tr = Tracer()
+    eng = _engine(params32, tracer=tr)
+    b = [_betas(41), _betas(42)]
+    _, targets = _track(params32, b[0], frames=2)
+    with eng:
+        sessions = [eng.open_stream(x, n_steps=4, data_term="joints")
+                    for x in b]
+        sessions[0].step(targets[0])
+    # Context exit == stop(): both sessions swept to ``shutdown``.
+    snap = eng.load()["streams"]
+    assert snap["active"] == 0
+    assert snap["closed_by_kind"] == {"shutdown": 2}
+    assert tr.accounting()["closed_by_kind"].get("shutdown") == 2
+    for s in sessions:
+        with pytest.raises(ServingError, match="shutdown"):
+            s.submit_frame(targets[1])
+    # A stopped engine refuses NEW streams too (an open racing the
+    # stop sweep must not register a session the sweep already
+    # missed); a restart accepts them again.
+    with pytest.raises(ServingError) as ei:
+        eng.open_stream(b[0], n_steps=4, data_term="joints")
+    assert ei.value.kind == "shutdown"
+    with eng:
+        sess3 = eng.open_stream(b[0], n_steps=4, data_term="joints")
+        sess3.step(targets[0])
+    assert tr.accounting()["spans_open"] == 0
+    # The refusal holds even when NO stream was ever opened before the
+    # stop (the manager is lazily built AFTER it — it must be born
+    # stopped, not minted fresh around the shutdown contract).
+    eng2 = _engine(params32)
+    with eng2:
+        pass
+    with pytest.raises(ServingError) as ei:
+        eng2.open_stream(b[0], n_steps=4, data_term="joints")
+    assert ei.value.kind == "shutdown"
+
+
+def test_open_stream_sheds_at_admission_pressure(params32):
+    """Under a bounded queue at capacity, opening a stream sheds with
+    the structured kind (span opened and closed ``shed`` once) instead
+    of handing back a handle that can only shed frames."""
+    tr = Tracer()
+    eng = ServingEngine(params32, max_bucket=4, max_queued=0, tracer=tr)
+    with pytest.raises(ServingError) as ei:
+        eng.open_stream(_betas(51), n_steps=4, data_term="joints")
+    assert ei.value.kind == "shed" and ei.value.phase == "stream"
+    acc = tr.accounting()
+    assert acc["closed_by_kind"].get("shed") == 1
+    assert eng.load()["streams"]["opened"] == 0
+
+
+def test_failover_frame_bit_identical_and_warm_start_valid(params32):
+    """Chaos composes unchanged: under a persistent primary fault with
+    CPU failover, every frame still resolves, verts are bit-identical
+    to a direct CPU call, and the POSE TRACK matches a fault-free
+    session exactly (the serving fault never touches the solver, so
+    the warm start stays valid)."""
+    import jax
+
+    from mano_hand_tpu.runtime.chaos import ChaosPlan
+    from mano_hand_tpu.runtime.supervise import DispatchPolicy
+
+    betas = _betas(61)
+    _, targets = _track(params32, betas, frames=3)
+
+    def run(policy):
+        eng = _engine(params32, policy=policy)
+        with eng:
+            sess = eng.open_stream(betas, n_steps=4,
+                                   data_term="joints")
+            return [sess.step(t) for t in targets]
+
+    clean = run(None)
+    plan = ChaosPlan("error@0-")
+    pol = DispatchPolicy(deadline_s=10.0, retries=1, backoff_s=0.01,
+                         backoff_cap_s=0.02, jitter=0.0, breaker=None,
+                         chaos=plan, cpu_fallback=True)
+    try:
+        faulted = run(pol)
+    finally:
+        plan.release.set()
+    cpu = jax.devices("cpu")[0]
+    prm_cpu = jax.device_put(params32, cpu)
+    ref = jax.jit(lambda q, p, s: core.forward_batched(q, p, s).verts)
+    for c, f in zip(clean, faulted):
+        # Warm-start validity: identical fits frame for frame.
+        np.testing.assert_array_equal(c.pose, f.pose)
+        # Failover bit-identity vs the direct CPU program family.
+        want = np.asarray(ref(
+            prm_cpu, jax.device_put(jnp.asarray(f.pose[None]), cpu),
+            jax.device_put(jnp.asarray(betas[None]), cpu)))[0]
+        np.testing.assert_array_equal(f.verts, want)
+
+
+def test_tracker_init_pose_seeds_warm_start(params32):
+    """``make_tracker(init_pose=...)``: the seed IS the warm start
+    (frame starts at 1, so the frame-0 Kabsch re-seed is skipped), and
+    ``open_stream(resume_pose=...)`` carries a pose across sessions."""
+    from mano_hand_tpu.fitting import make_tracker
+
+    seed_pose = np.random.default_rng(71).normal(
+        scale=0.2, size=(16, 3)).astype(np.float32)
+    state, _ = make_tracker(params32, n_steps=2, solver="lm",
+                            data_term="joints", init_pose=seed_pose)
+    np.testing.assert_allclose(np.asarray(state.pose), seed_pose,
+                               rtol=0, atol=0)
+    assert state.frame == 1
+    betas = _betas(72)
+    with _engine(params32) as eng:
+        sess = eng.open_stream(betas, n_steps=4, data_term="joints",
+                               resume_pose=seed_pose)
+        np.testing.assert_array_equal(sess.pose, seed_pose)
+        assert sess.frame == 1
+
+
+def test_load_streams_block_untorn_and_shape_stable(params32):
+    """The PR-5/8 torn-telemetry rule extended to streams: the load()
+    block is one manager-lock hold, internally consistent while frames
+    race, and SHAPE-STABLE — the streamless engine reports the same
+    keys (streams.EMPTY_SNAPSHOT is pinned against the live
+    snapshot)."""
+    import concurrent.futures as cf
+
+    empty = _engine(params32).load()["streams"]
+    assert empty == streams_mod.EMPTY_SNAPSHOT
+    betas = [_betas(81), _betas(82)]
+    _, targets = _track(params32, betas[0], frames=4)
+    with _engine(params32) as eng:
+        sessions = [eng.open_stream(b, n_steps=4, data_term="joints")
+                    for b in betas]
+        assert set(eng.load()["streams"]) == set(empty)
+        with cf.ThreadPoolExecutor(4) as pool:
+            futs = [pool.submit(sessions[i % 2].step, targets[i])
+                    for i in range(4)]
+            for _ in range(50):
+                s = eng.load()["streams"]
+                assert s["active"] == 2
+                assert s["opened"] == 2
+                assert 0 <= s["frames_in_flight"] <= 4
+                assert s["frames_resolved"] <= s["frames_submitted"]
+                assert s["backlog_age_s"] >= 0.0
+                if s["frames_in_flight"] == 0:
+                    assert s["backlog_age_s"] == 0.0
+            for f in futs:
+                f.result(timeout=60)
+        s = eng.load()["streams"]
+        assert s["frames_in_flight"] == 0
+        assert s["frames_submitted"] == s["frames_resolved"] == 4
+        assert s["frames_by_kind"] == {"ok": 4}
+
+
+def test_metrics_mapper_and_slo_latency_objective(params32):
+    """The streams block reaches the scrape surface: load_samples maps
+    it to ``load_streams_*`` gauges (Prometheus-renderable), and
+    ``slo_report`` grows the frame-latency burn rate when the tier's
+    objectives carry ``p99_target_ms``."""
+    from mano_hand_tpu.obs.metrics import (
+        DEFAULT_SLO_OBJECTIVES, load_samples, prometheus_text,
+        slo_report,
+    )
+
+    betas = _betas(91)
+    _, targets = _track(params32, betas, frames=2)
+    with _engine(params32) as eng:
+        sess = eng.open_stream(betas, n_steps=4, data_term="joints")
+        sess.step(targets[0])
+        out = load_samples(eng.load())
+    assert out["load_streams_active"]["samples"][0][1] == 1.0
+    assert out["load_streams_frames_submitted"]["samples"][0][1] == 1.0
+    assert out["load_streams_frames_in_flight"]["samples"][0][1] == 0.0
+    text = prometheus_text({"namespace": "mano", "metrics": out})
+    assert "mano_load_streams_active 1.0" in text
+    snap = eng.counters.snapshot()
+    objectives = {"0": {**DEFAULT_SLO_OBJECTIVES["0"],
+                        "p99_target_ms": 100.0},
+                  "default": DEFAULT_SLO_OBJECTIVES["default"]}
+    slo = slo_report(snap, objectives,
+                     latency_by_tier={"0": {"p99_ms": 50.0, "n": 1}})
+    t0 = slo["tiers"]["0"]
+    assert t0["burn_rates"]["latency_p99"] == 0.5
+    assert t0["latency_p99_ms"] == 50.0
+    # Without the objective, the report keeps the PR-9 shape exactly.
+    plain = slo_report(snap)
+    assert "latency_p99" not in plain["tiers"]["0"]["burn_rates"]
+    assert "latency_p99_ms" not in plain["tiers"]["0"]
+
+
+def test_stream_drill_tiny_e2e(params32):
+    """The config15 protocol at plumbing size (the bench-interpret
+    counterpart): 100% of frames resolved through the mid-drill chaos
+    plan, zero steady recompiles, every session span closed exactly
+    once, SLO latency burn reported."""
+    from mano_hand_tpu.serving.measure import stream_drill_run
+
+    out = stream_drill_run(
+        params32, streams=6, frames_per_stream=3, subjects=3,
+        workers=4, warm_steps=4, cold_steps_candidates=(8,),
+        calib_probes=3, fit_trials=1, min_bucket=4, max_bucket=8,
+        seed=5)
+    assert out["frames_resolved_fraction"] == 1.0
+    assert out["outcomes"]["error"] == 0
+    assert out["outcomes"]["stranded"] == 0
+    assert out["steady_recompiles"] == 0
+    assert out["failover_vs_cpu_direct_max_abs_err"] == 0.0
+    assert out["warm_start_after_failover_consistent"] is True
+    spans = out["stream_spans"]
+    assert spans["opened"] == 6
+    assert sum(spans["closed_by_kind"].values()) == 6
+    assert spans["active_after_stop"] == 0
+    assert "latency_p99" in out["slo"]["tiers"]["0"]["burn_rates"]
+    acc = out["flight_record"]["accounting"]
+    assert acc["spans_started"] == acc["spans_closed"]
+    assert acc["spans_open"] == 0
+
+
+def test_stream_span_never_poisons_request_backlog(params32):
+    """Review fixes pinned: (a) an open session's long-lived span must
+    NOT pin the tracer's request-backlog age (load()'s backlog_age_s
+    is a REQUEST signal; the per-frame one lives in the streams
+    block); (b) a tracker-build error closes the just-opened span
+    instead of leaking it (the closed-exactly-once criterion)."""
+    tr = Tracer()
+    betas = _betas(101)
+    with _engine(params32, tracer=tr) as eng:
+        eng.open_stream(betas, n_steps=4, data_term="joints")
+        time.sleep(0.06)
+        ld = eng.load()
+        assert ld["streams"]["active"] == 1
+        # The session span is open, but no REQUEST span is: the
+        # request-backlog age must read idle, not session-age.
+        assert ld["backlog_age_s"] < 0.05
+        with pytest.raises(ValueError, match="solver"):
+            eng.open_stream(betas, n_steps=4, data_term="joints",
+                            solver="bogus")
+    acc = tr.accounting()
+    assert acc["spans_started"] == acc["spans_closed"]
+    assert acc["spans_open"] == 0
+    assert acc["closed_by_kind"].get("error") == 1   # the failed open
