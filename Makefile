@@ -22,11 +22,11 @@
 .PHONY: check check-cold test bench-cpu bench-tpu-wait mesh-scaling \
 	check-quick serve-smoke specialize-smoke chaos-smoke coalesce-smoke \
 	overload-smoke coldstart-smoke obs-smoke metrics-smoke \
-	posed-kernel-smoke stream-smoke analyze
+	posed-kernel-smoke stream-smoke lanes-smoke examples-smoke analyze
 
 check: analyze test chaos-smoke coalesce-smoke overload-smoke \
 	coldstart-smoke obs-smoke metrics-smoke posed-kernel-smoke \
-	stream-smoke
+	stream-smoke lanes-smoke examples-smoke
 
 # tests/test_runtime.py is excluded here and covered by the chaos-smoke
 # prerequisite instead (its own pytest process + cache dir): `make
@@ -46,7 +46,9 @@ test:
 	  --ignore=tests/test_obs.py \
 	  --ignore=tests/test_metrics.py \
 	  --ignore=tests/test_pallas_posed.py \
-	  --ignore=tests/test_streams.py
+	  --ignore=tests/test_streams.py \
+	  --ignore=tests/test_lanes.py \
+	  --ignore=tests/test_examples.py
 
 # Seconds-scale pre-commit lane: the core-correctness modules (parity vs
 # the f64 oracle, assets/IO, golden demo, device lock, and the serving
@@ -118,7 +120,9 @@ bench-interpret:
 	  --metrics-requests 48 --posed-requests 32 --posed-subjects 6 \
 	  --posed-max-bucket 32 --posed-lm-batch 8 \
 	  --stream-streams 16 --stream-frames 3 --stream-subjects 6 \
-	  --stream-workers 6 --stream-max-bucket 16
+	  --stream-workers 6 --stream-max-bucket 16 \
+	  --lane-lanes 4 --lane-requests 16 --lane-subjects 3 \
+	  --lane-workers 4 --lane-max-bucket 8
 
 # Serving-leg smoke (the bench-interpret counterpart for config7): the
 # whole serving-engine plumbing — bucket warm-up, ragged request stream,
@@ -146,15 +150,25 @@ bench-interpret:
 # streaming-session drill, PR 12) runs at the FULL >= 200-stream scale
 # here — the acceptance criterion's CPU lane — while bench-interpret
 # sweeps the same protocol at plumbing size.
+# config16 (the lane-loss drill, PR 13) runs its acceptance leg here:
+# --virtual-devices 8 forces 8 virtual host devices so the 4 lanes pin
+# DISTINCT CPU devices (the ISSUE-13 "N >= 4 virtual devices" bar;
+# bench-interpret sweeps the same protocol oversubscribed on 1 device).
+# The other legs are device-count-agnostic — they dispatch to the
+# default device exactly as before (the test suite has run on this same
+# 8-virtual-device layout since round 1).
 serve-smoke:
-	python bench.py --platform cpu --serving-only --serving-requests 96 \
+	python bench.py --platform cpu --virtual-devices 8 --serving-only \
+	  --serving-requests 96 \
 	  --serving-max-rows 16 --serving-max-bucket 32 --init-retries 2 \
 	  --coalesce-subjects 8 --coalesce-requests 48 --coalesce-max-bucket 32 \
 	  --coldstart-requests 16 --coldstart-subjects 4 \
 	  --coldstart-max-bucket 4 --coldstart-waves 3 --tracing-requests 96 \
 	  --metrics-requests 160 --posed-requests 48 --posed-subjects 8 \
 	  --posed-max-bucket 32 --posed-lm-batch 8 \
-	  --stream-streams 208 --stream-frames 4
+	  --stream-streams 208 --stream-frames 4 \
+	  --lane-lanes 4 --lane-requests 96 --lane-subjects 6 \
+	  --lane-workers 8 --lane-max-bucket 16
 
 # Specialization-split smoke (the quick-lane half of PR 2's tooling):
 # the seconds-scale correctness story of the shape/pose split — bit-
@@ -247,6 +261,34 @@ posed-kernel-smoke:
 stream-smoke:
 	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_stream \
 	  python -m pytest tests/test_streams.py -q
+
+# Per-device dispatch-lane matrix (the PR-13 tentpole): placement
+# balance + bit-identity vs the single-device engine, the %LANE chaos
+# kill of exactly one lane with the sibling-failover ladder absorbing
+# it (CPU tier only when every sibling is down), recompile-free
+# failback off the backoff re-probe, SubjectTable row-broadcast +
+# growth re-adoption across lane replicas, the one-lock-hold
+# load()["lanes"] snapshot, stream warm-start bit-equality through a
+# mid-stream lane loss, and the config16 drill at tiny sizes. Runs on
+# the harness's 8-virtual-device CPU mesh (conftest.py). Wired into
+# `make check` as a SEPARATE pytest process on its own compile-cache
+# dir (the CLAUDE.md rule: two pytest processes must never share
+# .jax_compile_cache/). Slow-marked, so the tier-1 `-m 'not slow'`
+# lane skips it by design (the PR-8 budget precedent).
+lanes-smoke:
+	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_lanes \
+	  python -m pytest tests/test_lanes.py -q
+
+# Every example end-to-end (tiny sizes, CPU) — the public-surface
+# anti-rot gate. Moved out of the tier-1 lane in the PR-13 budget
+# rebalance (the 21 subprocess runs were its single biggest block,
+# ~3 min); wired into `make check` as its own pytest process + cache
+# dir per the smoke-lane pattern. The examples themselves spawn
+# subprocesses with their OWN jax processes, so the cache-dir rule
+# applies to the thin pytest wrapper only.
+examples-smoke:
+	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_examples \
+	  python -m pytest tests/test_examples.py -q
 
 # Metrics & SLO matrix (the PR-9 tentpole): registry instrument/
 # collector atomicity under concurrent writers, the counter-drift

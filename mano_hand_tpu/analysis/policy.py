@@ -36,6 +36,20 @@ debugging session (CLAUDE.md, docs/roadmap.md process notes):
     serving path for seconds. Stage device work OUTSIDE the lock (the
     ``_install_subject`` bake-and-swap pattern).
 
+``device-under-install-lock``
+    The ``_install_lock`` variant (docs/roadmap.md PR-7 "Open", landed
+    with the PR-13 multi-device lanes): installs are serialized per
+    engine, and with N lane replicas one install's device work is N
+    devices wide — a checkpoint restore, a racing ``specialize()``,
+    and every lane broadcast queue behind whatever device calls sit
+    inside the hold. The audited EXCEPTION is the engine's documented
+    bake-and-swap (``_install_subject`` stages the functional row
+    write under ``_install_lock`` precisely so it stays OUT of
+    ``_exe_lock``; the dispatcher never takes ``_install_lock``) —
+    that one site carries the pragma with its justification. New code
+    (serving/lanes.py's replica machinery in particular) keeps device
+    work outside EVERY lock.
+
 Audited sites: ``# analysis: allow(<rule>)`` on or directly above the
 flagged line.
 """
@@ -55,6 +69,7 @@ POLICY_RULES = (
     "unbounded-retry",
     "wallclock-deadline",
     "device-under-exe-lock",
+    "device-under-install-lock",
 )
 
 _DEADLINE_NAME_RE = re.compile(
@@ -138,6 +153,7 @@ class _PolicyVisitor(ast.NodeVisitor):
         self.path = path
         self.findings: List[Finding] = []
         self._exe_lock_depth = 0
+        self._install_lock_depth = 0
 
     def _emit(self, rule: str, node: ast.AST, message: str) -> None:
         self.findings.append(
@@ -161,20 +177,34 @@ class _PolicyVisitor(ast.NodeVisitor):
                     "JAX_PLATFORMS env is overridden by a site hook at "
                     "interpreter startup; select platforms via "
                     'jax.config.update("jax_platforms", ...) instead')
-        if self._exe_lock_depth > 0:
+        if self._exe_lock_depth > 0 or self._install_lock_depth > 0:
             leaf = chain.rsplit(".", 1)[-1]
             if (chain in ("jax.device_put", "jax.jit",
                           "jax.block_until_ready")
                     or leaf in ("device_put", "block_until_ready")
                     or leaf.startswith("jit_")
                     or leaf in ("lower", "compile")):
-                self._emit(
-                    "device-under-exe-lock", node,
-                    f"{chain}() lexically inside an _exe_lock hold: the "
-                    "dispatcher blocks on _exe_lock per batch, and a "
-                    "device call here can stall serving for seconds on "
-                    "the tunneled backend — stage device work outside "
-                    "the lock (engine.py:_install_subject pattern)")
+                if self._exe_lock_depth > 0:
+                    self._emit(
+                        "device-under-exe-lock", node,
+                        f"{chain}() lexically inside an _exe_lock hold: "
+                        "the dispatcher blocks on _exe_lock per batch, "
+                        "and a device call here can stall serving for "
+                        "seconds on the tunneled backend — stage device "
+                        "work outside the lock "
+                        "(engine.py:_install_subject pattern)")
+                if self._install_lock_depth > 0:
+                    self._emit(
+                        "device-under-install-lock", node,
+                        f"{chain}() lexically inside an _install_lock "
+                        "hold: installs serialize behind it, and with "
+                        "per-device lanes one install's device work is "
+                        "N replicas wide — restores, racing "
+                        "specialize(), and lane broadcasts all queue "
+                        "behind this call. Stage device work outside "
+                        "the lock; the engine's documented bake-and-"
+                        "swap is the one audited exception "
+                        "(see analysis/policy.py)")
         self.generic_visit(node)
 
     # -- platforms-env (subscript assignment) ------------------------
@@ -260,28 +290,34 @@ class _PolicyVisitor(ast.NodeVisitor):
     # context — a deferred jax call stored under the lock is the
     # engine's normal caching pattern, not a violation.
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
-        saved, self._exe_lock_depth = self._exe_lock_depth, 0
+        saved = (self._exe_lock_depth, self._install_lock_depth)
+        self._exe_lock_depth = self._install_lock_depth = 0
         self.generic_visit(node)
-        self._exe_lock_depth = saved
+        self._exe_lock_depth, self._install_lock_depth = saved
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
     def visit_Lambda(self, node: ast.Lambda) -> None:
-        saved, self._exe_lock_depth = self._exe_lock_depth, 0
+        saved = (self._exe_lock_depth, self._install_lock_depth)
+        self._exe_lock_depth = self._install_lock_depth = 0
         self.generic_visit(node)
-        self._exe_lock_depth = saved
+        self._exe_lock_depth, self._install_lock_depth = saved
 
-    # -- with self._exe_lock ------------------------------------------
+    # -- with self._exe_lock / self._install_lock ----------------------
     def visit_With(self, node: ast.With) -> None:
-        holds = any(
-            (chain := _attr_chain(item.context_expr)) is not None
-            and chain.endswith("_exe_lock")
-            for item in node.items)
-        if holds:
+        chains = [c for item in node.items
+                  if (c := _attr_chain(item.context_expr)) is not None]
+        holds_exe = any(c.endswith("_exe_lock") for c in chains)
+        holds_install = any(c.endswith("_install_lock") for c in chains)
+        if holds_exe:
             self._exe_lock_depth += 1
+        if holds_install:
+            self._install_lock_depth += 1
         self.generic_visit(node)
-        if holds:
+        if holds_exe:
             self._exe_lock_depth -= 1
+        if holds_install:
+            self._install_lock_depth -= 1
 
 
 def lint_source(source: str, path: str = "<source>") -> List[Finding]:

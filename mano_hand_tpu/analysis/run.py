@@ -55,9 +55,16 @@ def run_analysis(
     # the cycle/re-acquire checker keeps that true through refactors.
     locks += check_lock_discipline(
         root / "mano_hand_tpu" / "serving" / "streams.py", order=())
+    # PR 13: the lane subsystem's one LaneSet lock (placement +
+    # telemetry + replica swaps; device work staged outside, which the
+    # device-under-install-lock policy rule guards) — cycle/re-acquire
+    # checked like the obs/ classes.
+    locks += check_lock_discipline(
+        root / "mano_hand_tpu" / "serving" / "lanes.py", order=())
     sections.append(("lock-discipline", locks,
-                     "serving/engine.py + serving/streams.py + obs/ "
-                     "nesting graphs + call edges"))
+                     "serving/engine.py + serving/streams.py + "
+                     "serving/lanes.py + obs/ nesting graphs + call "
+                     "edges"))
 
     step = check_lockstep(baseline.get("lockstep", {}))
     stale_note = lockstep_stale(baseline.get("lockstep", {}))

@@ -462,6 +462,45 @@ def load_samples(load: dict) -> dict:
         out["load_streams_closed_by_kind"] = metric(
             "counter", help="stream-session terminals by kind",
             samples=closed)
+    # Dispatch lanes (PR 13): fleet-level gauges plus the per-lane
+    # backlog/state/ladder counters, labelled by lane index.
+    lanes = load.get("lanes") or {}
+    for key, help_txt in (
+            ("n_lanes", "configured per-device dispatch lanes"),
+            ("n_devices", "distinct devices behind the lanes"),
+            ("healthy", "lanes whose breaker is not DOWN"),
+            ("backlog_rows_total", "queued+in-flight rows fleet-wide")):
+        if lanes.get(key) is not None:
+            out[f"load_lanes_{key}"] = metric(
+                "gauge", lanes[key], help=help_txt)
+    per = lanes.get("per_lane") or []
+    if per:
+        states = {"healthy": 0, "degraded": 1, "down": 2}
+        for key, kind, help_txt in (
+                ("backlog_rows", "gauge", "queued+in-flight rows"),
+                ("inflight", "gauge", "batches executing now"),
+                ("assigned", "counter", "batches ever placed here"),
+                ("dispatched", "counter", "batches that reached a "
+                                          "device"),
+                ("served_requests", "counter", "requests resolved ok"),
+                ("failovers_out", "counter", "batches handed "
+                                             "up-ladder"),
+                ("failovers_in", "counter", "sibling batches absorbed"),
+                ("cpu_failovers", "counter", "batches that fell "
+                                             "through to CPU"),
+                ("errors", "counter", "batches resolved as "
+                                      "ServingError")):
+            out[f"load_lane_{key}"] = metric(
+                kind, help=f"per-lane {help_txt}",
+                samples=[sample(p.get(key, 0),
+                                {"lane": str(p.get("lane"))})
+                         for p in per])
+        out["load_lane_state"] = metric(
+            "gauge", help="per-lane breaker state "
+                          "(0=healthy 1=degraded 2=down)",
+            samples=[sample(states.get(p.get("state"), -1),
+                            {"lane": str(p.get("lane"))})
+                     for p in per])
     return out
 
 
@@ -539,10 +578,15 @@ def slo_report(counters_snapshot: dict,
         served = int(ledger.get("served", 0))
         shed = int(ledger.get("shed", 0))
         expired = int(ledger.get("expired", 0))
-        goodput = served / submitted if submitted else 1.0
+        # Caller-cancelled requests (PR 13) leave the offered load: the
+        # caller withdrew the work, so neither goodput nor the shed
+        # fraction should charge the engine for not serving it.
+        cancelled = int(ledger.get("cancelled", 0))
+        offered = max(0, submitted - cancelled)
+        goodput = served / offered if offered else 1.0
         decided = served + expired
         deadline_hit = served / decided if decided else 1.0
-        shed_fraction = shed / submitted if submitted else 0.0
+        shed_fraction = shed / offered if offered else 0.0
         burns = {
             "goodput": _burn(goodput, obj["goodput_target"]),
             "deadline_hit": _burn(deadline_hit,
@@ -562,6 +606,9 @@ def slo_report(counters_snapshot: dict,
             "served": served,
             "shed": shed,
             "expired": expired,
+            # Shape-stable for pre-PR-13 consumers: the key appears
+            # only once a caller actually cancelled something.
+            **({"cancelled": cancelled} if cancelled else {}),
             **({"latency_p99_ms": round(float(lat["p99_ms"]), 4)}
                if p99_target and lat.get("p99_ms") is not None else {}),
             "goodput": round(goodput, 6),

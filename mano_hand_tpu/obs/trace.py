@@ -57,8 +57,11 @@ import numpy as np
 #: stream-session lifecycle (PR 12): "closed" is a client-initiated
 #: clean close of a serving/streams.py session span; stream expiry /
 #: shed / shutdown reuse the request kinds with the same meaning.
+#: "cancelled" (PR 13) is the caller withdrawing a request via
+#: ``future.cancel()`` — the admission slot frees and the span closes
+#: before the deadline sweep would have fired.
 TERMINAL_KINDS = ("ok", "shed", "expired", "error", "shutdown",
-                  "closed")
+                  "closed", "cancelled")
 
 #: Default ring capacity: ~6 events/request keeps the last ~1300
 #: requests of history — plenty for an incident dump, bounded forever.

@@ -50,6 +50,44 @@ def make_mesh(
     return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
 
 
+def lane_devices(
+    n: Optional[int] = None,
+    platform: Optional[str] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> list:
+    """Devices to pin per-device serving dispatch LANES to (PR 13,
+    serving/lanes.py).
+
+    ``n=None`` returns every addressable device (one lane per chip —
+    the fleet default). An explicit ``n`` returns exactly ``n`` device
+    handles, OVERSUBSCRIBING round-robin when fewer physical/virtual
+    devices exist: lane correctness (placement, ladder failover,
+    telemetry) is device-count-independent, so a 4-lane engine on a
+    1-device box still exercises the whole dispatch story — only true
+    parallel placement needs distinct devices (the CPU drill forces
+    them via ``--xla_force_host_platform_device_count``, the same
+    virtual-mesh trick the test suite runs on).
+    """
+    if devices is None:
+        if platform:
+            devices = jax.devices(platform)
+        else:
+            # Reached only through ServingEngine lane construction,
+            # which is lazy by design (first warmup/dispatch, never the
+            # constructor) — the engine's callers have already proven
+            # the backend answers (tests/bench run behind the killable
+            # probe), so this is never the first backend touch.
+            devices = jax.devices()  # analysis: allow(bare-devices)
+    devices = list(devices)
+    if not devices:
+        raise RuntimeError("no devices to build serving lanes on")
+    if n is None:
+        return devices
+    if n < 1:
+        raise ValueError(f"lane count must be >= 1, got {n}")
+    return [devices[i % len(devices)] for i in range(int(n))]
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Leading-axis batch sharding over the data axis."""
     return NamedSharding(mesh, P(DATA_AXIS))

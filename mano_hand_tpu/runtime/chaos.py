@@ -11,7 +11,7 @@ on CPU bit-for-bit, run after run (tests/test_runtime.py).
 
 Plan spec grammar (``parse_plan``) — comma-separated events::
 
-    KIND[:PARAM]@SEL
+    KIND[:PARAM]@SEL[%LANE]
 
     KIND   hang      block until the plan's ``release`` event is set
                      (the unkillable-RPC stand-in; a supervised caller
@@ -42,10 +42,20 @@ Plan spec grammar (``parse_plan``) — comma-separated events::
            N-M       calls N..M inclusive (N <= M)
            N-        every call from N onward (a persistent outage)
            *         every call
+    LANE   N         (PR 13) restrict the event to callables wrapped
+                     with ``wrap(..., lane=N)`` — a per-device dispatch
+                     lane (serving/lanes.py). A lane-tagged event is
+                     indexed by that LANE'S OWN call counter, not the
+                     plan-global one, so "kill exactly lane 2 from its
+                     3rd dispatch on" stays deterministic however the
+                     other lanes interleave. Untagged events keep the
+                     historical plan-global index and hit every wrapped
+                     callable, lane or not.
 
     "error@0-1"            two transient faults, then clean
     "hang@2"               call 2 wedges
     "error@0-"             persistent outage (never self-clears)
+    "error@0-%1"           lane 1 alone goes down, siblings stay clean
     "latency:0.2@1-3"      200 ms spikes on calls 1-3
     "sat:0.02@0-"          every dispatch throttled 20 ms (saturation)
     "wrong:0.5@4"          call 4 silently returns verts + 0.5
@@ -53,9 +63,10 @@ Plan spec grammar (``parse_plan``) — comma-separated events::
     Specs are VALIDATED at parse time: unknown kinds, malformed or
     misplaced ``:PARAM`` (hang/error/fatal take none; latency/sat
     require a non-negative one), non-integer or negative selector
-    indices, and inverted ranges (``N-M`` with N > M, which can match
-    no call) all raise ``ValueError`` with the offending token — a
-    typo'd plan must fail the run, not silently inject nothing.
+    indices, inverted ranges (``N-M`` with N > M, which can match
+    no call), and malformed ``%LANE`` tags all raise ``ValueError``
+    with the offending token — a typo'd plan must fail the run, not
+    silently inject nothing.
 
 ``schedule(spec)`` swaps the event list and resets the call index, so
 one long-lived engine can be driven through a whole fault matrix
@@ -82,16 +93,19 @@ class InjectedFault(RuntimeError):
 
 
 class FaultEvent:
-    """One scheduled fault: ``kind`` over call indices [start, stop]."""
+    """One scheduled fault: ``kind`` over call indices [start, stop].
+    ``lane`` (PR 13) restricts it to one dispatch lane's callables and
+    switches the index domain to that lane's own call counter."""
 
-    __slots__ = ("kind", "start", "stop", "param")
+    __slots__ = ("kind", "start", "stop", "param", "lane")
 
     def __init__(self, kind: str, start: int, stop: Optional[int],
-                 param: float = 0.0):
+                 param: float = 0.0, lane: Optional[int] = None):
         self.kind = kind
         self.start = start
         self.stop = stop            # None = open-ended (persistent)
         self.param = param
+        self.lane = lane            # None = every wrapped callable
 
     def matches(self, idx: int) -> bool:
         return idx >= self.start and (self.stop is None or idx <= self.stop)
@@ -99,7 +113,8 @@ class FaultEvent:
     def __repr__(self) -> str:  # test/log readability
         sel = (f"{self.start}" if self.stop == self.start
                else f"{self.start}-{'' if self.stop is None else self.stop}")
-        return f"FaultEvent({self.kind}@{sel}, param={self.param})"
+        tag = "" if self.lane is None else f"%{self.lane}"
+        return f"FaultEvent({self.kind}@{sel}{tag}, param={self.param})"
 
 
 _KINDS = ("hang", "error", "fatal", "latency", "sat", "wrong")
@@ -128,6 +143,16 @@ def _parse_event(token: str) -> FaultEvent:
     head, _, sel = token.partition("@")
     if not sel:
         raise ValueError(f"chaos event {token!r} lacks '@SELECTOR'")
+    sel, pct, lane_s = sel.partition("%")
+    if pct and not lane_s:
+        raise ValueError(
+            f"chaos event {token!r}: '%' lane tag needs a lane index "
+            "(e.g. error@0-%1)")
+    lane = _parse_index(lane_s, token) if pct else None
+    if pct and not sel:
+        raise ValueError(
+            f"chaos event {token!r}: '%LANE' must follow a selector "
+            "(e.g. error@0-%1)")
     kind, colon, param_s = head.partition(":")
     if kind not in _KINDS:
         raise ValueError(f"unknown chaos kind {kind!r} (one of {_KINDS})")
@@ -151,19 +176,19 @@ def _parse_event(token: str) -> FaultEvent:
     else:
         param = 1.0 if kind == "wrong" else 0.0
     if sel == "*":
-        return FaultEvent(kind, 0, None, param)
+        return FaultEvent(kind, 0, None, param, lane)
     lo, dash, hi = sel.partition("-")
     start = _parse_index(lo, token)
     if not dash:
-        return FaultEvent(kind, start, start, param)
+        return FaultEvent(kind, start, start, param, lane)
     if not hi:
-        return FaultEvent(kind, start, None, param)
+        return FaultEvent(kind, start, None, param, lane)
     stop = _parse_index(hi, token)
     if stop < start:
         raise ValueError(
             f"chaos event {token!r}: range {start}-{stop} is inverted "
             "and would match no call")
-    return FaultEvent(kind, start, stop, param)
+    return FaultEvent(kind, start, stop, param, lane)
 
 
 class ChaosPlan:
@@ -184,6 +209,10 @@ class ChaosPlan:
         self._lock = threading.Lock()
         self._events: List[FaultEvent] = []
         self._calls = 0
+        # Per-lane call counters (PR 13): lane-tagged events index into
+        # the tagged lane's own dispatch sequence, so one lane's fault
+        # schedule is deterministic however its siblings interleave.
+        self._lane_calls: dict = {}
         self.faults_injected = 0
         self.release = threading.Event()
         if spec:
@@ -199,6 +228,7 @@ class ChaosPlan:
         with self._lock:
             self._events = events
             self._calls = 0
+            self._lane_calls = {}
         return self
 
     def clear(self) -> None:
@@ -211,18 +241,33 @@ class ChaosPlan:
         with self._lock:
             return self._calls
 
-    def _next(self) -> Tuple[int, Optional[FaultEvent]]:
+    def _next(self, lane: Optional[int] = None,
+              ) -> Tuple[int, Optional[FaultEvent]]:
         with self._lock:
             idx = self._calls
             self._calls += 1
-            ev = next((e for e in self._events if e.matches(idx)), None)
+            lidx = None
+            if lane is not None:
+                lidx = self._lane_calls.get(lane, 0)
+                self._lane_calls[lane] = lidx + 1
+            ev = next(
+                (e for e in self._events
+                 if (e.matches(idx) if e.lane is None
+                     else (e.lane == lane and e.matches(lidx)))),
+                None)
             if ev is not None:
                 self.faults_injected += 1
-            return idx, ev
+            # Report the index in the DOMAIN the event matched on: an
+            # untagged event firing on a lane call matched the
+            # plan-global counter, and the fault message / on_fault
+            # forensics must name an index that exists in the spec.
+            report = (lidx if (lane is not None and ev is not None
+                              and ev.lane is not None) else idx)
+            return report, ev
 
     # ------------------------------------------------------------- wrapping
     def wrap(self, fn: Callable, on_fault: Optional[Callable] = None,
-             ) -> Callable:
+             lane: Optional[int] = None) -> Callable:
         """Wrap ``fn`` so each invocation consults the plan first.
 
         ``on_fault`` (e.g. ``ServingCounters.count_fault``) fires once
@@ -232,6 +277,13 @@ class ChaosPlan:
         lands on the request timeline with its identity; anything else
         keeps the historical no-argument call. The arity is resolved
         ONCE at wrap time, not per dispatch.
+
+        ``lane`` (PR 13) identifies this callable as dispatch lane N's
+        (serving/lanes.py): ``%LANE``-tagged events fire only on the
+        matching lane, indexed by that lane's own call counter, while
+        untagged events keep hitting every wrapped callable on the
+        plan-global index — a plan can kill exactly one lane while its
+        siblings serve clean.
         """
         notify = None
         if on_fault is not None:
@@ -250,7 +302,7 @@ class ChaosPlan:
                       else (lambda ev, idx: on_fault()))
 
         def chaotic(*args, **kwargs):
-            idx, ev = self._next()
+            idx, ev = self._next(lane)
             if ev is None:
                 return fn(*args, **kwargs)
             if notify is not None:

@@ -58,12 +58,24 @@ class CircuitBreaker:
       CONSECUTIVE failures accumulate.
     * ``record_success()``: a primary success resets to HEALTHY.
     * ``allow_primary()``: the dispatch-time gate. True while not DOWN.
-      When DOWN it re-probes at most every ``probe_interval_s`` —
-      skipping entirely while a driver priority claim is fresh (see
-      module docstring) — and a successful probe closes the breaker
-      (HEALTHY) and returns True, restoring the primary path; the
-      still-warm executable caches make that failback recompile-free
-      (asserted in tests/test_runtime.py).
+      When DOWN it re-probes on a bounded cadence — skipping entirely
+      while a driver priority claim is fresh (see module docstring) —
+      and a successful probe closes the breaker (HEALTHY) and returns
+      True, restoring the primary path; the still-warm executable
+      caches make that failback recompile-free (asserted in
+      tests/test_runtime.py).
+
+    The re-probe cadence is OUTAGE-LENGTH-AWARE (PR 13): each
+    consecutive FAILED probe multiplies the interval by
+    ``probe_backoff`` up to ``probe_interval_cap_s`` (default
+    ``32 * probe_interval_s``), and any successful probe (or primary
+    success) resets it to ``probe_interval_s``. The tunnel's outages
+    run hours (r3: ~10 h, r4: 15+ h) — a fleet of N per-lane breakers
+    (serving/lanes.py) probing a downed backend at a CONSTANT interval
+    multiplies killable-subprocess spawns by N exactly when the box
+    should be spending itself on the surviving lanes; the exponential
+    schedule keeps the first re-probe prompt (a blip recovers fast)
+    while a long outage converges to one cheap probe per cap window.
 
     Thread-safe; the probe itself runs outside the lock (it can take
     ``probe timeout`` seconds — other dispatchers keep failing over to
@@ -75,6 +87,8 @@ class CircuitBreaker:
         failure_threshold: int = 3,
         probe: Optional[Callable[[], bool]] = None,
         probe_interval_s: float = 30.0,
+        probe_backoff: float = 2.0,
+        probe_interval_cap_s: Optional[float] = None,
         respect_priority_claim: bool = True,
         clock: Callable[[], float] = time.monotonic,
         on_transition: Optional[Callable[[str, str], None]] = None,
@@ -82,9 +96,21 @@ class CircuitBreaker:
         if failure_threshold < 1:
             raise ValueError(
                 f"failure_threshold must be >= 1, got {failure_threshold}")
+        if probe_backoff < 1.0:
+            raise ValueError(
+                f"probe_backoff must be >= 1.0 (a shrinking re-probe "
+                f"interval hammers a downed backend), got {probe_backoff}")
         self.failure_threshold = int(failure_threshold)
         self.probe = probe if probe is not None else device_probe
         self.probe_interval_s = float(probe_interval_s)
+        self.probe_backoff = float(probe_backoff)
+        self.probe_interval_cap_s = (
+            32.0 * self.probe_interval_s if probe_interval_cap_s is None
+            else float(probe_interval_cap_s))
+        if self.probe_interval_cap_s < self.probe_interval_s:
+            raise ValueError(
+                f"probe_interval_cap_s {self.probe_interval_cap_s} < "
+                f"probe_interval_s {self.probe_interval_s}")
         self.respect_priority_claim = bool(respect_priority_claim)
         self.clock = clock
         # Observability hook (PR 8): called as ``on_transition(old,
@@ -98,6 +124,7 @@ class CircuitBreaker:
         self._consecutive_failures = 0
         self._last_probe_t: Optional[float] = None
         self._probing = False
+        self._failed_probes = 0    # consecutive — drives the backoff
         self.probes = 0            # lifetime probe attempts (audit)
         self.opens = 0             # times the breaker tripped to DOWN
 
@@ -106,6 +133,43 @@ class CircuitBreaker:
     def state(self) -> str:
         with self._lock:
             return self._state
+
+    @property
+    def consecutive_failed_probes(self) -> int:
+        """Failed re-probes since the last success — the backoff
+        exponent (telemetry; the drill asserts the schedule grew)."""
+        with self._lock:
+            return self._failed_probes
+
+    def probe_due(self) -> bool:
+        """Cheap, non-probing check: would ``allow_primary()`` run a
+        re-probe right now? The lane placement path (serving/lanes.py)
+        uses this to kick a DOWN lane's re-probe onto a disposable
+        thread WITHOUT paying the probe (or even a thread spawn) on
+        the dispatch path when none is due."""
+        with self._lock:
+            if self._state != DOWN or self._probing:
+                return False
+            if self.respect_priority_claim:
+                from mano_hand_tpu.utils import devicelock
+
+                if devicelock.priority_claim_active():
+                    return False
+            return (self._last_probe_t is None
+                    or self.clock() - self._last_probe_t
+                    >= self._probe_wait_locked())
+
+    def probe_wait_s(self) -> float:
+        """The CURRENT re-probe interval: ``probe_interval_s`` grown
+        ``probe_backoff``-fold per consecutive failed probe, capped at
+        ``probe_interval_cap_s``."""
+        with self._lock:
+            return self._probe_wait_locked()
+
+    def _probe_wait_locked(self) -> float:
+        return min(self.probe_interval_cap_s,
+                   self.probe_interval_s
+                   * self.probe_backoff ** self._failed_probes)
 
     def _notify(self, old: str, new: str) -> None:
         """Fire ``on_transition`` for a state CHANGE — outside the
@@ -123,6 +187,7 @@ class CircuitBreaker:
             self._state = HEALTHY
             self._consecutive_failures = 0
             self._last_probe_t = None
+            self._failed_probes = 0
         self._notify(old, HEALTHY)
 
     def record_failure(self) -> str:
@@ -143,6 +208,7 @@ class CircuitBreaker:
         with self._lock:
             old = self._state
             self._consecutive_failures = 0
+            self._failed_probes = 0
             self._state = HEALTHY
         self._notify(old, HEALTHY)
         return HEALTHY
@@ -166,7 +232,7 @@ class CircuitBreaker:
             if (self._probing
                     or (self._last_probe_t is not None
                         and now - self._last_probe_t
-                        < self.probe_interval_s)):
+                        < self._probe_wait_locked())):
                 return False
             self._probing = True       # one prober at a time
             self._last_probe_t = now
@@ -181,6 +247,33 @@ class CircuitBreaker:
             if ok:
                 self._state = HEALTHY
                 self._consecutive_failures = 0
+                self._failed_probes = 0
+            else:
+                # One more failed re-probe: the NEXT wait doubles (up
+                # to the cap) — the outage-length-aware schedule.
+                self._failed_probes += 1
         if ok:
             self._notify(old, HEALTHY)
         return ok
+
+
+def failover_ladder(failed: int, n_lanes: int, backlog_rows,
+                    allow: Callable[[int], bool]):
+    """Sibling order for the per-lane failover LADDER (PR 13):
+    device -> least-loaded healthy sibling lane -> CPU tier.
+
+    Given the index of the lane whose primary dispatch just exhausted
+    supervision, returns its sibling lane indices in the order the
+    dispatcher should try them: every sibling ``allow`` admits (its
+    breaker not DOWN), least-backlogged first (``backlog_rows`` maps
+    lane index -> queued+in-flight rows), index as the tie-break so
+    the order is deterministic under equal load. The CPU degradation
+    tier is NOT in the list — it is the ladder's implicit last rung,
+    owned by the caller (serving/lanes.py), exactly as the PR-3
+    single-device breaker handed "device -> CPU"; this function only
+    generalizes the middle rung. An empty list means every sibling is
+    down too: go straight to CPU.
+    """
+    sibs = [i for i in range(int(n_lanes)) if i != failed and allow(i)]
+    sibs.sort(key=lambda i: (backlog_rows.get(i, 0), i))
+    return sibs

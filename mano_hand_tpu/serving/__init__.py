@@ -17,9 +17,11 @@ from mano_hand_tpu.serving.buckets import (
     subject_index_rows,
 )
 from mano_hand_tpu.serving.engine import ServingEngine, ServingError
+from mano_hand_tpu.serving.lanes import Lane, LaneSet
 from mano_hand_tpu.serving.measure import (
     coalesce_bench_run,
     cold_start_drill_run,
+    lane_drill_run,
     measure_overhead,
     overload_drill_run,
     recovery_drill_run,
@@ -36,10 +38,13 @@ __all__ = [
     "ServingEngine",
     "ServingError",
     "FrameResult",
+    "Lane",
+    "LaneSet",
     "StreamManager",
     "StreamSession",
     "coalesce_bench_run",
     "cold_start_drill_run",
+    "lane_drill_run",
     "overload_drill_run",
     "recovery_drill_run",
     "measure_overhead",

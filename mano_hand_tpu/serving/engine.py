@@ -117,6 +117,22 @@ bench.py rides under its own instance of the same class.
   costs <= 3% end-to-end, measured by bench config12's paired
   interleaved criterion — tracing must never change WHAT it measures.
 
+* **dispatches across a device fleet** (PR 13): pass ``lanes=N`` and
+  the coalesced batches fan out over N per-device dispatch lanes
+  (serving/lanes.py) — least-backlogged healthy lane wins, the
+  SubjectTable is replicated per lane with recompile-free row-write
+  broadcasts, and the PR-3 circuit breaker generalizes into a failover
+  LADDER: device -> least-loaded healthy sibling lane -> CPU tier,
+  with recompile-free failback when a lane's breaker re-probes
+  healthy (outage-length-aware exponential backoff, runtime/health.py)
+  — one bad chip degrades capacity instead of the service.
+  ``load()["lanes"]`` is the per-lane telemetry block; the lane-loss
+  chaos drill (bench config16) proves 100% of futures resolve through
+  a lane killed mid-stream. A caller can also WITHDRAW a request:
+  ``future.cancel()`` frees the admission slot and closes the span as
+  terminal kind ``cancelled`` before any deadline sweep would
+  (counted per tier).
+
 * **survives its own death** (PR 6): restart is just another fault
   class. ``bake_lattice()`` pre-bakes EVERY reachable program —
   (bucket x kind {full, gathered pose-only} x table capacity x
@@ -158,8 +174,8 @@ import collections
 import queue
 import threading
 import time
-from concurrent.futures import Future
-from typing import Optional, Sequence
+from concurrent.futures import Future, InvalidStateError
+from typing import Callable, Optional, Sequence
 
 import numpy as np
 
@@ -345,6 +361,45 @@ def build_cpu_fallback_executable(params_host, bucket: int, n_joints: int,
     return lambda p, s: jitted(params_cpu, put(p), put(s))
 
 
+class _CancellableFuture(Future):
+    """The Future ``submit`` hands out, with caller-initiated
+    cancellation wired back into the engine (PR 13).
+
+    The engine never calls ``set_running_or_notify_cancel``, so a
+    request's future stays PENDING until its terminal resolution — a
+    ``cancel()`` before that succeeds, flips the future to CANCELLED
+    (``result()`` raises ``CancelledError``), and fires the engine
+    hook EXACTLY once: the admission slot frees immediately and the
+    span closes as terminal kind ``cancelled``, before the deadline
+    sweep would have fired. A queued/parked cancelled request is
+    skipped by every dispatch boundary (never batched, never costing
+    a device row); one already in flight completes on device but its
+    result is discarded at delivery — the same late-result discipline
+    as an expired readback. ``cancel()`` after any resolution returns
+    False, exactly the stdlib contract.
+    """
+
+    def __init__(self, on_cancel: Callable[[], None]):
+        super().__init__()
+        self._on_cancel = on_cancel
+        self._cancel_notified = False
+
+    def cancel(self) -> bool:
+        if not super().cancel():
+            return False
+        hook = None
+        # Future's own condition doubles as the once-guard: stdlib
+        # cancel() returns True again on an already-cancelled future,
+        # but the engine-side bookkeeping must fire exactly once.
+        with self._condition:
+            if not self._cancel_notified:
+                self._cancel_notified = True
+                hook = self._on_cancel
+        if hook is not None:
+            hook()
+        return True
+
+
 class _Request:
     __slots__ = ("pose", "shape", "rows", "squeeze", "subject", "future",
                  "t_submit", "deadline", "tier", "span")
@@ -356,6 +411,11 @@ class _Request:
         self.rows = rows
         self.squeeze = squeeze
         self.subject = subject      # specialization digest or None (full)
+        # A plain Future until ``ServingEngine.submit`` swaps in a
+        # _CancellableFuture wired to the engine's cancel bookkeeping —
+        # a _Request cannot know its engine at construction, and a
+        # hookless cancellable future would silently drop the
+        # slot-free/span-close/counter work a cancel() must do.
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
         self.deadline = deadline    # absolute time.monotonic() or None
@@ -445,6 +505,27 @@ class ServingEngine:
         interpreter (None = auto: real TPU backends use Mosaic,
         everything else interprets — the CPU lanes/tests/bench-interpret
         path). Ignored under ``posed_kernel="xla"``.
+    lanes: per-device dispatch lanes (PR 13, serving/lanes.py). None
+        (default) keeps the single-device dispatch path unchanged —
+        zero new threads, zero new calls. An int N builds N lanes over
+        ``parallel.mesh.lane_devices`` (one per addressable device;
+        round-robin oversubscription when N exceeds the device count):
+        the dispatcher still coalesces exactly as before, then places
+        each assembled batch on the least-backlogged healthy lane;
+        the SubjectTable is replicated per lane (row writes broadcast,
+        recompile-free); and under a ``policy`` each lane carries its
+        OWN circuit breaker with the failover LADDER — device ->
+        least-loaded healthy sibling lane -> CPU tier — so one bad
+        chip degrades capacity instead of the service, and failback
+        after a re-probe is recompile-free (warm per-lane caches).
+        ``load()`` gains a one-lock-hold ``"lanes"`` block. Lane
+        executables are the same params/table-as-runtime-args program
+        families, so lane results stay bit-identical to the
+        single-device path on the same platform.
+    lane_probe: per-lane breaker probe override — called as
+        ``lane_probe(lane_index) -> bool`` (the lane-loss drill's hand
+        on each simulated tunnel). Default: the policy breaker's probe
+        (a killable-subprocess device probe).
     tracer: an ``obs.Tracer`` (PR 8). None (default) disables tracing
         entirely — zero calls on every path. With a tracer, every
         request carries a span (see the module docstring), runtime
@@ -476,6 +557,8 @@ class ServingEngine:
         tracer=None,
         posed_kernel: str = "xla",
         posed_kernel_interpret: Optional[bool] = None,
+        lanes: Optional[int] = None,
+        lane_probe: Optional[Callable[[int], bool]] = None,
     ):
         self._params = params.astype(dtype)
         self._dtype = np.dtype(dtype)
@@ -557,6 +640,13 @@ class ServingEngine:
         # ``_exe_lock`` stays valid however specialize/evict mutate the
         # live reference afterwards.
         self._table = None             # core.SubjectTable or None
+        # Monotonic install counter, bumped under _exe_lock at every
+        # table swap (PR 13): lane replicas carry the version of the
+        # engine table they derive from, so a lane worker can PROVE its
+        # replica agrees with the slots it resolved (an eviction reuses
+        # slots — serving a newer replica against older slots would be
+        # silently wrong; see lanes.py:_resolve_for_lane).
+        self._table_version = 0
         self._subject_slots: dict = {}  # betas digest -> table row
         self._subject_lru = collections.OrderedDict()  # digest -> None
         self._next_slot = 0            # first never-used row
@@ -595,6 +685,16 @@ class ServingEngine:
         # contract must hold even when no stream was ever opened.
         self._streams = None
         self._streams_stopped = False
+        # Per-device dispatch lanes (PR 13): built lazily at the first
+        # warmup/dispatch — lane construction enumerates devices, and
+        # the engine's constructor touches no backend by design.
+        if lanes is not None and lanes < 1:
+            raise ValueError(f"lanes must be >= 1, got {lanes}")
+        self._lane_count = None if lanes is None else int(lanes)
+        self._lane_probe = lane_probe
+        if lane_probe is not None and lanes is None:
+            raise ValueError("lane_probe requires lanes")
+        self._laneset = None
 
     @property
     def tracer(self):
@@ -766,6 +866,10 @@ class ServingEngine:
                 phase="shutdown")
             self._failure = err
             self._thread = None
+            if self._laneset is not None:
+                # A wedged engine gets a short lane drain: sweep_live
+                # below resolves whatever a wedged lane worker holds.
+                self._laneset.stop(timeout_s=1.0)
             self._sweep_live(err)
             self._drain_cancelled(err)
             # Parked requests were resolved by the sweep (they are
@@ -778,6 +882,12 @@ class ServingEngine:
             self._queue.put(_SENTINEL)
             return
         self._thread = None
+        if self._laneset is not None:
+            # The dispatcher is drained; let every lane finish its
+            # queued batches (sentinel-after-backlog), then poison
+            # whatever a wedged lane worker left behind. The final
+            # sweep below backstops an abandoned worker's futures.
+            self._laneset.stop(timeout_s=timeout_s)
         # A submit racing the shutdown can enqueue AFTER the dispatcher's
         # own drain; nothing will read the queue now, so sweep it again.
         self._drain_cancelled(self._failure)
@@ -918,13 +1028,31 @@ class ServingEngine:
             elif grew:
                 table = core.table_grow(table, cap)
                 self.counters.count_table_growth()
+            # The ONE audited exception to device-under-install-lock:
+            # this hold EXISTS to stage the functional row write out of
+            # _exe_lock (the dispatcher blocks there per batch, never
+            # here), and installers are the only waiters.
+            # analysis: allow(device-under-install-lock)
             table = core.jit_table_set_row(table, slot, shaped)
             with self._exe_lock:
                 self._table = table
+                self._table_version += 1
+                version = self._table_version
                 self._subject_slots[key] = slot
                 self._subject_lru[key] = None
                 stale = ([b for b, (c, _) in self._gather_exes.items()
                           if c != cap] if grew else [])
+            if self._laneset is not None:
+                # Replicate the freshly installed row into every lane's
+                # table replica (PR 13): one functional row write per
+                # lane device — data movement, never a recompile —
+                # serialized by the _install_lock this whole method
+                # already holds (installs are the table's only
+                # mutators), and stamped with the new table version so
+                # lane dispatch can prove replica/slot agreement. Still
+                # staged OUTSIDE _exe_lock, like every device op here.
+                self._laneset.broadcast_row(slot, shaped, grew=grew,
+                                            version=version)
         if restored:
             self.counters.count_restore()
         else:
@@ -986,7 +1114,40 @@ class ServingEngine:
             before = self.counters.aot_loads
             self._gather_executable(b)
             out[b] = "aot" if self.counters.aot_loads > before else "jit"
+        if self._lane_count is not None:
+            # Same reasoning as warmup(): pose-only lane traffic and
+            # sibling-ladder failovers must find every lane's gathered
+            # executables warm.
+            self._get_lanes().warm(bucket_list or self.buckets,
+                                   posed=True)
         return out
+
+    # ----------------------------------------------- dispatch lanes (PR 13)
+    @property
+    def lane_count(self) -> Optional[int]:
+        """Configured per-device dispatch lanes (None = single-device
+        dispatch, the pre-PR-13 path)."""
+        return self._lane_count
+
+    def _get_lanes(self):
+        """The engine's ``LaneSet``, built on first use (device
+        enumeration + per-lane breaker construction — never in the
+        constructor). Race-tolerant the same way ``_stream_manager``
+        is: the first publisher under ``_exe_lock`` wins, a losing
+        builder is discarded (a LaneSet holds no threads until its
+        first batch)."""
+        if self._lane_count is None:
+            return None
+        ls = self._laneset
+        if ls is None:
+            from mano_hand_tpu.serving.lanes import LaneSet
+
+            ls = LaneSet(self, self._lane_count, probe=self._lane_probe)
+            with self._exe_lock:
+                if self._laneset is None:
+                    self._laneset = ls
+                ls = self._laneset
+        return ls
 
     # --------------------------------------------- streaming sessions (PR 12)
     def _stream_manager(self):
@@ -1104,6 +1265,11 @@ class ServingEngine:
             from mano_hand_tpu.serving import streams as streams_mod
 
             out["streams"] = streams_mod.empty_snapshot()
+        # Dispatch lanes (PR 13): per-lane backlog/breaker/ladder
+        # telemetry, one LaneSet-lock hold (the torn-telemetry rule).
+        ls = self._laneset
+        if ls is not None:
+            out["lanes"] = ls.snapshot()
         if self._tracer is not None:
             # PR 8: per-tier resolve-latency quantiles + backlog age.
             # The tracer copies its samples and open-span starts in ONE
@@ -1125,12 +1291,11 @@ class ServingEngine:
         keeps chip time off results nobody will read. Counted once: the
         ``done()`` guard makes a double sweep (e.g. coalesce then a
         shutdown drain) a no-op."""
-        if not req.future.done():
-            req.future.set_exception(ServingError(
+        if self._set_exception_safe(req, ServingError(
                 f"request expired before {phase} (deadline_s elapsed "
                 f"{time.monotonic() - req.deadline:.3g}s ago); a stale "
                 "result would not be read, so none was produced",
-                phase=phase, kind="expired"))
+                phase=phase, kind="expired")):
             self.counters.count_expired(req.tier)
             if self._tracer is not None:
                 self._tracer.close(req.span, "expired", phase=phase)
@@ -1217,6 +1382,10 @@ class ServingEngine:
                     else time.monotonic() + float(deadline_s))
         req = _Request(pose, shape, n, squeeze, subject,
                        deadline=deadline, tier=tier)
+        # The future the CALLER sees carries the cancel hook from
+        # birth — one wiring mechanism, no attribute overwrite to
+        # forget (nothing has observed the placeholder future yet).
+        req.future = _CancellableFuture(lambda: self._on_cancel(req))
         tr = self._tracer
         if tr is not None:
             # The span opens HERE — after validation (a caller error is
@@ -1308,6 +1477,13 @@ class ServingEngine:
             # cold compile on top of the failure it exists to absorb.
             for b in bucket_list or self.buckets:
                 self._fallback_executable(b)
+        if self._lane_count is not None:
+            # Lane-aware engines serve full-path traffic from per-lane
+            # executables — warm all N lanes' caches here too, so
+            # steady lane traffic (and ladder failovers onto ANY
+            # sibling) compiles nothing (counted warm-up compiles).
+            self._get_lanes().warm(bucket_list or self.buckets,
+                                   posed=False)
         return out
 
     # ------------------------------------------- crash-safe restart (PR 6)
@@ -1912,6 +2088,10 @@ class ServingEngine:
         subjects = {first.subject} if posed else set()
 
         def admit(nxt, fresh=True) -> Optional[str]:
+            if self._skip_cancelled(nxt):
+                # The caller withdrew it (already counted + span-closed
+                # by the cancel hook): never batched, never parked.
+                return "cancelled"
             if self._is_expired(nxt):
                 # The pre-dispatch deadline sweep (PR 5): an expired
                 # request is resolved HERE — never batched, never
@@ -2003,6 +2183,8 @@ class ServingEngine:
                     if not self._running:
                         break
                     continue
+                if self._skip_cancelled(first):
+                    continue
                 if self._is_expired(first):
                     # Deadline sweep at the head of batch assembly: an
                     # expired request (sat queued or parked too long)
@@ -2042,10 +2224,13 @@ class ServingEngine:
         # the loop far longer), so re-check each member NOW — the last
         # instant a sweep still costs zero chip time. An all-expired
         # batch dispatches nothing at all.
-        if any(r.deadline is not None for r in reqs):
+        if any(r.deadline is not None or r.future.cancelled()
+               for r in reqs):
             now = time.monotonic()
             alive = []
             for r in reqs:
+                if self._skip_cancelled(r):
+                    continue          # withdrawn between coalesce + launch
                 if self._is_expired(r, now):
                     self._expire(r, "dispatch")
                 else:
@@ -2072,15 +2257,31 @@ class ServingEngine:
             posed = reqs[0].subject is not None  # uniform kind (_coalesce)
             shape = table = idx = None
             n_subjects = 1
+            if not posed:
+                shape = (reqs[0].shape if len(reqs) == 1 else
+                         np.concatenate([r.shape for r in reqs]))
+                shape = bucket_mod.pad_rows(shape, bucket)
+            if self._lane_count is not None:
+                # Lane-aware dispatch (PR 13): the assembled batch goes
+                # to the least-backlogged healthy lane; that lane's
+                # worker runs the supervised dispatch + failover ladder
+                # and resolves the futures (count_dispatch and the
+                # dispatched/readback span events land there). A posed
+                # batch's slots are resolved IN THE WORKER against a
+                # version-validated lane replica — resolving here and
+                # dispatching later would let an eviction reuse a slot
+                # while the batch sits in the lane's backlog. The
+                # dispatcher immediately assembles the next batch —
+                # lanes ARE the overlap, so the inflight deque stays
+                # unused in this mode.
+                self._get_lanes().submit_batch(
+                    bucket, pose, shape, posed, reqs, rows)
+                return None
             if posed:
                 table, slots = self._resolve_batch(reqs)
                 idx = bucket_mod.subject_index_rows(
                     slots, [r.rows for r in reqs], bucket)
                 n_subjects = len(set(slots))
-            else:
-                shape = (reqs[0].shape if len(reqs) == 1 else
-                         np.concatenate([r.shape for r in reqs]))
-                shape = bucket_mod.pad_rows(shape, bucket)
             if self._policy is not None:
                 # Supervised: resolved to a HOST array inside the
                 # policy's deadline/retry/failover envelope before the
@@ -2218,19 +2419,8 @@ class ServingEngine:
             self.counters.count_failover()
             if tr is not None:
                 tr.incident("failover", bucket=bucket, attempts=attempts)
-            if table is not None:
-                # Per-ROW betas for the mixed-subject batch (pad rows
-                # repeat request 0's betas, matching pad_rows/idx row 0).
-                with self._exe_lock:
-                    betas = [self._subject_betas[r.subject] for r in reqs]
-                fb_shape = bucket_mod.pad_rows(
-                    np.concatenate([
-                        np.broadcast_to(b[None], (r.rows, self._n_shape))
-                        for b, r in zip(betas, reqs)]),
-                    bucket)
-                fb_shape = np.ascontiguousarray(fb_shape)
-            else:
-                fb_shape = shape
+            fb_shape = self._fallback_shape(reqs, bucket, shape,
+                                            posed=table is not None)
             fb = self._fallback_executable(bucket)  # built un-deadlined
             try:
                 return supervise.call_with_deadline(
@@ -2250,6 +2440,24 @@ class ServingEngine:
             + " and cpu_fallback is disabled",
             attempts=attempts, cause=last)
 
+    def _fallback_shape(self, reqs, bucket: int, shape, *, posed: bool):
+        """The CPU degradation tier's shape argument — THE shared
+        reconstruction (used by ``_supervised_dispatch`` and the lane
+        ladder's last rung, serving/lanes.py, so the rule cannot
+        drift): a full-path batch reuses its padded shape as-is; a
+        pose-only batch re-materializes per-ROW betas (pad rows repeat
+        request 0's betas, matching pad_rows/idx row 0)."""
+        if not posed:
+            return shape
+        with self._exe_lock:
+            betas = [self._subject_betas[r.subject] for r in reqs]
+        fb_shape = bucket_mod.pad_rows(
+            np.concatenate([
+                np.broadcast_to(b[None], (r.rows, self._n_shape))
+                for b, r in zip(betas, reqs)]),
+            bucket)
+        return np.ascontiguousarray(fb_shape)
+
     def _resolve(self, item) -> None:
         out, reqs, bucket = item
         try:
@@ -2257,6 +2465,14 @@ class ServingEngine:
         except BaseException as e:
             self._poison(reqs, e)  # same reasoning as _launch
             raise
+        self._deliver(reqs, verts, bucket)
+
+    def _deliver(self, reqs, verts, bucket: int) -> None:
+        """Slice one completed batch back into its requests' futures —
+        the single delivery path, shared by the dispatcher's readback
+        (``_resolve``) and the per-lane workers (serving/lanes.py), so
+        the expiry-at-readback / late-result-discard / span-close
+        discipline cannot drift between the two."""
         now = time.perf_counter()
         mono = time.monotonic()
         tr = self._tracer
@@ -2276,8 +2492,9 @@ class ServingEngine:
                 # expired" — never a late result that looks fresh.
                 self._expire(r, "readback")
                 continue
-            if not r.future.done():  # a shutdown sweep can win the race
-                r.future.set_result(piece[0] if r.squeeze else piece)
+            # A shutdown sweep or a cancel() can win the race; either
+            # way the late result is discarded, never served stale.
+            if self._set_result_safe(r, piece[0] if r.squeeze else piece):
                 self.counters.count_served(r.tier)
                 if tr is not None:
                     tr.close(r.span, "ok", bucket=bucket)
@@ -2302,6 +2519,50 @@ class ServingEngine:
         with self._live_lock:
             self._live.pop(id(req), None)
 
+    # ------------------------------------------- cancellation (PR 13)
+    def _on_cancel(self, req: _Request) -> None:
+        """One caller-initiated ``future.cancel()`` (fired exactly once
+        by ``_CancellableFuture``): free the admission slot NOW — the
+        deregister drops ``outstanding`` so a bounded engine admits a
+        replacement immediately instead of after the deadline sweep —
+        count it per tier, and close the span at its new terminal
+        kind. The request object may still sit queued/parked; every
+        dispatch boundary skips a cancelled future (``_skip_cancelled``
+        / the done() guards), so it never buys a device row."""
+        self.counters.count_cancelled(req.tier)
+        if self._tracer is not None:
+            self._tracer.close(req.span, "cancelled", phase="cancel")
+        self._deregister(req)
+
+    def _skip_cancelled(self, req: _Request) -> bool:
+        """True iff ``req`` was cancelled (already counted/closed by
+        the cancel hook — the sweep just drops the stale object)."""
+        if req.future.cancelled():
+            self._deregister(req)   # idempotent belt-over-braces
+            return True
+        return False
+
+    def _set_result_safe(self, req: _Request, value) -> bool:
+        """Resolve a future to a result unless something else (a
+        cancel in the done()-check race window) got there first."""
+        if req.future.done():
+            return False
+        try:
+            req.future.set_result(value)
+            return True
+        except InvalidStateError:
+            return False
+
+    def _set_exception_safe(self, req: _Request, exc: BaseException,
+                            ) -> bool:
+        if req.future.done():
+            return False
+        try:
+            req.future.set_exception(exc)
+            return True
+        except InvalidStateError:
+            return False
+
     @staticmethod
     def _terminal_kind(exc: Optional[BaseException]) -> str:
         """The span-close kind for an exception-resolved future —
@@ -2316,16 +2577,14 @@ class ServingEngine:
             reqs, self._live = list(self._live.values()), {}
         kind = self._terminal_kind(exc)
         for r in reqs:
-            if not r.future.done():
-                r.future.set_exception(exc)
+            if self._set_exception_safe(r, exc):
                 if self._tracer is not None:
                     self._tracer.close(r.span, kind, phase="sweep")
 
     def _poison(self, reqs, exc: BaseException) -> None:
         kind = self._terminal_kind(exc)
         for r in reqs:
-            if not r.future.done():
-                r.future.set_exception(exc)
+            if self._set_exception_safe(r, exc):
                 if self._tracer is not None:
                     self._tracer.close(r.span, kind, phase="poison")
             self._deregister(r)
@@ -2339,12 +2598,11 @@ class ServingEngine:
                 return
             if req is _SENTINEL:
                 continue
-            if not req.future.done():
-                err = (exc if exc is not None else
-                       ServingError("serving engine stopped before this "
-                                    "request was dispatched",
-                                    phase="shutdown"))
-                req.future.set_exception(err)
+            err = (exc if exc is not None else
+                   ServingError("serving engine stopped before this "
+                                "request was dispatched",
+                                phase="shutdown"))
+            if self._set_exception_safe(req, err):
                 if self._tracer is not None:
                     self._tracer.close(req.span, self._terminal_kind(err),
                                        phase="drain")

@@ -1,0 +1,811 @@
+"""Per-device dispatch lanes with a sibling-failover ladder (PR 13).
+
+Everything in ``parallel/`` compiles on a multi-device mesh, but the
+serving engine dispatched to exactly ONE device — a fleet of chips was
+invisible to the layer that actually serves traffic, and one bad chip
+was a service outage instead of a capacity loss. This module makes
+dispatch mesh-aware:
+
+* **N per-device lanes** fed by the engine's existing bucket/coalesce
+  queue: the dispatcher still assembles batches exactly as before
+  (coalescing is a host-side policy — splitting it per lane would
+  fragment batches), then hands each assembled batch to the
+  least-backlogged healthy lane. Each lane owns a device handle,
+  device-pinned executable caches (the same
+  ``build_bucket_executable`` / ``build_posed_gather_executable``
+  program families as the engine — params/table as runtime arguments,
+  so per-lane results are bit-identical to the single-device path on
+  the same platform), a worker thread, and a ``CircuitBreaker``.
+* **The SubjectTable replicated per lane.** A ``specialize()`` row
+  write broadcasts to every lane replica as a functional
+  ``table_set_row`` on that lane's device — a ROW of data movement per
+  lane, never a recompile (the table stays a runtime argument). A lane
+  that has no replica yet adopts the engine's live table wholesale on
+  first use (warm-up-class work), and a capacity growth re-adopts +
+  eagerly rebuilds that lane's gathered executables, counted exactly
+  like the engine's own growth compiles.
+* **The failover LADDER** (``runtime/health.py``): the PR-3 breaker
+  generalized from "device -> CPU" to "device -> least-loaded healthy
+  sibling lane -> CPU". A lane whose supervised primary exhausts its
+  retries walks its healthy siblings in ``failover_ladder`` order (one
+  supervised attempt each, that sibling's breaker consulted and
+  updated), and only when every rung fails lands on the engine's CPU
+  degradation tier — still the bit-identical
+  params-as-runtime-args family. Failback is recompile-free by the
+  same argument as PR 3: the lane's executable caches stay warm while
+  its breaker is open, and the breaker's outage-length-aware re-probe
+  (exponential backoff, capped) closes it without a single re-trace.
+* **Per-lane chaos + telemetry.** Lane executables are chaos-wrapped
+  with their lane index, so a ``%LANE``-tagged plan event
+  (runtime/chaos.py) can kill exactly one lane while siblings serve
+  clean — the lane-loss drill (bench config16,
+  serving/measure.py:lane_drill_run). Every lane counter (backlog,
+  in-flight, assigned/dispatched, ladder hops in/out, CPU failovers)
+  mutates under ONE ``LaneSet`` lock, so ``load()["lanes"]`` is a
+  single-lock-hold snapshot (the torn-telemetry rule), and lane spans
+  ride the PR-8 tracer (a ``lane`` event per request, breaker
+  transitions and ladder hops as runtime events/incidents).
+
+Lock discipline: ``_lock`` guards placement + telemetry + the replica
+reference swaps ONLY — all device work (params/table device_put,
+executable builds, row writes) is staged OUTSIDE it, mirroring the
+engine's ``_install_subject`` bake-and-swap (lane workers block on
+``_lock`` per batch, so a device call inside it would stall every
+lane at once; the ``mano analyze`` lock checker covers this file).
+Replica broadcasts are serialized upstream by the engine's
+``_install_lock`` (``_install_subject`` is the table's only mutator),
+so ``broadcast_row`` needs no install lock of its own.
+
+Known scope bounds (documented, not accidental): lane executables have
+no AOT-lattice tier (PR-6 lattice entries deserialize onto the default
+device; a lane boot pays warm-up compiles, counted) and the gathered
+path serves the XLA family even under ``posed_kernel="fused"`` (the
+fused kernel tier stays a single-device specialization for now — the
+CPU drill and the parity criteria need the bit-identical family).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from mano_hand_tpu.obs import log as obs_log
+from mano_hand_tpu.runtime import health
+
+_SENTINEL = object()
+
+_LOG = obs_log.get_logger("serving.lanes")
+
+
+class Lane:
+    """One per-device dispatch lane: a device handle, device-pinned
+    executable caches + SubjectTable replica, a work queue, a worker
+    thread, and a circuit breaker. Telemetry fields mutate ONLY under
+    the owning ``LaneSet._lock`` (the one-lock-hold snapshot rule)."""
+
+    def __init__(self, index: int, device, breaker):
+        self.index = index
+        self.device = device
+        self.breaker = breaker
+        self.q: queue.Queue = queue.Queue()
+        self.worker: Optional[threading.Thread] = None
+        # Device-pinned state, built lazily (the engine's default-device
+        # caches are untouched — the sentinel keeps probing those).
+        self.params_dev = None
+        self.table = None            # SubjectTable replica on self.device
+        # Which engine ``_table_version`` the replica derives from: the
+        # worker dispatches only after proving (one engine-lock hold)
+        # that its resolved slots belong to EXACTLY this version —
+        # evictions reuse slots, so a replica ahead of OR behind the
+        # slots' version could silently serve the wrong subject.
+        self.table_version = -1
+        self.exes: dict = {}         # bucket -> full-path executable
+        self.gather_exes: dict = {}  # bucket -> (capacity, executable)
+        # -- telemetry (LaneSet._lock) --
+        self.backlog_batches = 0     # queued + in flight
+        self.backlog_rows = 0
+        self.inflight = 0            # batches executing right now
+        self.assigned = 0            # batches ever placed here
+        self.dispatched = 0          # batches that reached a device
+        self.served_requests = 0     # requests resolved ok by this lane
+        self.failovers_out = 0       # batches this lane handed up-ladder
+        self.failovers_in = 0        # sibling batches this lane absorbed
+        self.cpu_failovers = 0       # batches that fell through to CPU
+        self.errors = 0              # batches resolved as ServingError
+
+
+class LaneSet:
+    """The engine's lane fleet: placement, per-lane workers, replica
+    broadcast, and the failover ladder. Built lazily by
+    ``ServingEngine`` (first warmup/dispatch — the engine constructor
+    touches no backend by design)."""
+
+    def __init__(self, engine, n: int,
+                 probe: Optional[Callable[[int], bool]] = None,
+                 devices: Optional[Sequence] = None):
+        from mano_hand_tpu.parallel import mesh
+        from mano_hand_tpu.runtime.health import CircuitBreaker
+
+        if n < 1:
+            raise ValueError(f"lanes must be >= 1, got {n}")
+        self._eng = engine
+        self._lock = threading.Lock()
+        self._rr = 0    # equal-backlog tie-break cursor (placement)
+        devs = mesh.lane_devices(n, devices=devices)
+        self.n_devices = len({str(d) for d in devs})
+        pol = engine._policy
+        proto = getattr(pol, "breaker", None) if pol is not None else None
+        tracer = engine._tracer
+        self.lanes = []
+        for i, dev in enumerate(devs):
+            breaker = None
+            if pol is not None:
+                # Per-lane breakers: the policy's breaker (if any) is
+                # the TEMPLATE — thresholds/cadence copied, state NOT
+                # shared (one sick chip must not open its siblings'
+                # breakers). ``probe`` overrides the probe per lane
+                # (the drill's hand on each simulated tunnel).
+                kw = {}
+                if proto is not None:
+                    kw = dict(
+                        failure_threshold=proto.failure_threshold,
+                        probe_interval_s=proto.probe_interval_s,
+                        probe_backoff=proto.probe_backoff,
+                        probe_interval_cap_s=proto.probe_interval_cap_s,
+                        respect_priority_claim=(
+                            proto.respect_priority_claim),
+                        # CAVEAT (real multi-chip fleets): the
+                        # template's probe is typically the
+                        # backend-WIDE device_probe — with one dead
+                        # chip on a healthy backend it re-probes
+                        # green and the dead lane flaps open/closed.
+                        # Production lanes over real chips need a
+                        # per-DEVICE probe via ``lane_probe`` (the
+                        # drill's pattern); on this box the failure
+                        # domain is the whole tunnel, where the
+                        # backend-wide probe is exactly right.
+                        probe=proto.probe,
+                        # The template's clock rides along: a
+                        # deterministic-time breaker (the test/drill
+                        # pattern) must drive the lane cadences too.
+                        clock=proto.clock,
+                    )
+                if probe is not None:
+                    kw["probe"] = (lambda i=i: bool(probe(i)))
+                breaker = CircuitBreaker(**kw)
+                if tracer is not None:
+                    breaker.on_transition = (
+                        lambda old, new, i=i: tracer.runtime_event(
+                            "lane_breaker", lane=i, old=old, new=new))
+                elif proto is not None and proto.on_transition is not None:
+                    # No tracer: a caller-wired template hook still
+                    # hears every lane's transitions (lane identity via
+                    # the breaker argument closure is the caller's job;
+                    # the tracer path above carries it explicitly).
+                    breaker.on_transition = proto.on_transition
+            self.lanes.append(Lane(i, dev, breaker))
+
+    def __len__(self) -> int:
+        return len(self.lanes)
+
+    # ------------------------------------------------------------ placement
+    def submit_batch(self, bucket: int, pose, shape, posed: bool, reqs,
+                     rows: int) -> None:
+        """Place one assembled batch on the least-backlogged healthy
+        lane (breaker not DOWN; all down -> least-backlogged anyway,
+        whose worker walks the ladder straight to CPU) and wake its
+        worker. Called only by the engine's dispatcher thread."""
+        with self._lock:
+            lane = self._place_locked(rows)
+            lane.assigned += 1
+            lane.backlog_batches += 1
+            lane.backlog_rows += rows
+            if lane.worker is None or not lane.worker.is_alive():
+                lane.worker = threading.Thread(
+                    target=self._worker, args=(lane,),
+                    name=f"mano-lane-{lane.index}", daemon=True)
+                lane.worker.start()
+        for ln in self.lanes:
+            # Failback driver: placement AVOIDS a DOWN lane, so unlike
+            # the single-device engine (whose every dispatch consults
+            # allow_primary) nothing would ever re-probe it. Kick any
+            # due re-probe onto a disposable thread — probe_due() is a
+            # lock-and-compare, the probe itself (a killable
+            # subprocess, possibly seconds) never runs on the
+            # dispatcher thread, and the breaker single-flights +
+            # backs off the cadence internally.
+            if (ln.breaker is not None and ln is not lane
+                    and ln.breaker.probe_due()):
+                threading.Thread(
+                    target=ln.breaker.allow_primary,
+                    name=f"mano-lane-{ln.index}-probe",
+                    daemon=True).start()
+        tr = self._eng._tracer
+        if tr is not None:
+            for r in reqs:
+                tr.event(r.span, "lane", lane=lane.index)
+        lane.q.put((bucket, pose, shape, posed, reqs, rows))
+
+    def _place_locked(self, rows: int) -> Lane:
+        # Caller holds self._lock. Backlog = queued + in-flight rows;
+        # ties rotate round-robin — a low-rate stream (every lane idle
+        # at every placement) must still spread across the fleet, or
+        # one lane serves everything while its siblings' caches go
+        # cold and the drill's balance criterion reads as one hot
+        # lane. The rotation keeps placement deterministic.
+        cands = [ln for ln in self.lanes
+                 if ln.breaker is None or ln.breaker.state != health.DOWN]
+        if not cands:
+            cands = self.lanes
+        n = len(self.lanes)
+        lane = min(cands, key=lambda ln: (ln.backlog_rows,
+                                          (ln.index - self._rr) % n))
+        self._rr = (lane.index + 1) % n
+        return lane
+
+    # ----------------------------------------------------------- lane state
+    def _lane_params(self, lane: Lane):
+        """The lane-device-pinned params (staged outside every lock)."""
+        if lane.params_dev is None:
+            lane.params_dev = self._eng._params.device_put(
+                sharding=lane.device)
+        return lane.params_dev
+
+    def _adopt(self, lane: Lane):
+        """Re-derive the lane's replica from the engine's LIVE table
+        (whole-table device_put — warm-up-class data movement): the
+        source table and its version are read under ONE engine-lock
+        hold, and the swap is version-monotonic, so a racing broadcast
+        or adopter can never roll a replica back. Returns the lane's
+        (table, version) after the attempt."""
+        import jax
+
+        eng = self._eng
+        with eng._exe_lock:
+            src = eng._table
+            v = eng._table_version
+        if src is None:
+            raise RuntimeError(
+                "no specialized subject to replicate into lanes; call "
+                "specialize(betas) first")
+        staged = jax.device_put(src, lane.device)
+        with self._lock:
+            if lane.table is None or lane.table_version < v:
+                lane.table, lane.table_version = staged, v
+            return lane.table, lane.table_version
+
+    def _lane_table(self, lane: Lane):
+        """The lane's replica, adopted on first use — the warm-up /
+        executable-build entry point. Dispatch correctness does NOT
+        rely on this being current: the worker re-validates version +
+        slots per batch (``_resolve_for_lane``)."""
+        with self._lock:
+            tab = lane.table
+        if tab is not None:
+            return tab
+        return self._adopt(lane)[0]
+
+    def broadcast_row(self, slot: int, shaped, grew: bool,
+                      version: int) -> None:
+        """Mirror one installed subject row into every lane replica —
+        called by ``ServingEngine._install_subject`` AFTER the engine
+        table swap, still under ``_install_lock`` (the table's only
+        mutator, so broadcasts are serialized upstream and need no
+        lock of their own). ``version`` is the engine table version
+        this row write produced: a replica exactly one version behind
+        takes the row as a functional ``table_set_row`` on the lane's
+        device — data movement, never a recompile — and every other
+        state (no replica while a first adoption may be in flight
+        with a PRE-swap read, a growth, a version gap, a lost swap
+        race) re-adopts the whole live table through the monotonic
+        ``_adopt`` path, so a replica can never publish with a
+        silently missing row. Growth additionally rebuilds the lane's
+        gathered executables eagerly (warm-up-class, counted like the
+        engine's own growth compiles)."""
+        import jax
+
+        from mano_hand_tpu.models import core
+
+        for lane in self.lanes:
+            with self._lock:
+                tab, v = lane.table, lane.table_version
+            if tab is None:
+                self._adopt(lane)
+                continue
+            if v >= version and not grew:
+                # A concurrent worker-side _adopt already landed this
+                # (or a later) version — re-adopting would stage a
+                # whole-table transfer just for the monotonic guard to
+                # discard it.
+                continue
+            if grew or tab.capacity <= slot or v != version - 1:
+                self._adopt(lane)
+                if grew:
+                    self._rebuild_stale_gather(lane)
+                continue
+            new = core.jit_table_set_row(
+                tab, slot, jax.device_put(shaped, lane.device))
+            stale = False
+            with self._lock:
+                if lane.table is tab and lane.table_version == v:
+                    lane.table, lane.table_version = new, version
+                elif lane.table_version < version:
+                    # A concurrent adoption swapped a replica we did
+                    # not stage from: re-adopt monotonically instead
+                    # of publishing over it.
+                    stale = True
+            if stale:
+                self._adopt(lane)
+
+    def _rebuild_stale_gather(self, lane: Lane) -> None:
+        """Eagerly rebuild a lane's capacity-stale gathered
+        executables after a growth — a growth compile must not land
+        inside a latency-sensitive lane dispatch (the engine's
+        ``_install_subject`` rule, per lane)."""
+        with self._lock:
+            tab = lane.table
+            stale = ([] if tab is None else
+                     [b for b, (c, _) in lane.gather_exes.items()
+                      if c != tab.capacity])
+        for b in stale:
+            self._gather_executable(lane, b)
+
+    # ----------------------------------------------------------- executables
+    def _full_executable(self, lane: Lane, bucket: int):
+        from mano_hand_tpu.serving import engine as engine_mod
+
+        with self._lock:
+            exe = lane.exes.get(bucket)
+        if exe is not None:
+            return exe
+        eng = self._eng
+        built = engine_mod.build_bucket_executable(
+            self._lane_params(lane), bucket, eng._n_joints,
+            eng._n_shape, eng._dtype, donate=eng.donate)
+        eng.counters.count_compile()
+        if eng._tracer is not None:
+            eng._tracer.runtime_event("compile", family="full",
+                                      bucket=bucket, lane=lane.index)
+        pol = eng._policy
+        if pol is not None and pol.chaos is not None:
+            built = pol.chaos.wrap(built, on_fault=eng._on_chaos_fault,
+                                   lane=lane.index)
+        with self._lock:
+            exe = lane.exes.setdefault(bucket, built)
+        return exe
+
+    def _gather_executable(self, lane: Lane, bucket: int, tab=None):
+        """Returns ``(executable, table)`` — the executable serves ANY
+        table of the cache key's capacity (table + index are runtime
+        arguments), and the table the caller should dispatch is the
+        one it passed in (a version-validated replica from
+        ``_resolve_for_lane``) or, for warm-up, the lane's adopted
+        replica."""
+        from mano_hand_tpu.serving import engine as engine_mod
+
+        if tab is None:
+            tab = self._lane_table(lane)
+        cap = tab.capacity
+        with self._lock:
+            entry = lane.gather_exes.get(bucket)
+        if entry is not None and entry[0] == cap:
+            return entry[1], tab
+        eng = self._eng
+        built = engine_mod.build_posed_gather_executable(
+            tab, bucket, eng._n_joints, eng._dtype, donate=eng.donate)
+        eng.counters.count_compile()
+        if eng._tracer is not None:
+            eng._tracer.runtime_event("compile", family="gather",
+                                      bucket=bucket, capacity=cap,
+                                      lane=lane.index)
+        pol = eng._policy
+        if pol is not None and pol.chaos is not None:
+            built = pol.chaos.wrap(built, on_fault=eng._on_chaos_fault,
+                                   lane=lane.index)
+        with self._lock:
+            cur = lane.gather_exes.get(bucket)
+            if cur is not None and cur[0] == cap:
+                return cur[1], tab
+            if cur is None or cur[0] < cap:
+                lane.gather_exes[bucket] = (cap, built)
+        return built, tab
+
+    def warm(self, buckets: Sequence[int], *, posed: bool) -> None:
+        """Build every lane's executables for ``buckets`` up front —
+        warm-up is where compile latency belongs, N-lane edition."""
+        for lane in self.lanes:
+            for b in buckets:
+                if posed:
+                    self._gather_executable(lane, b)
+                else:
+                    self._full_executable(lane, b)
+
+    # -------------------------------------------------------------- dispatch
+    def _resolve_for_lane(self, lane: Lane, reqs):
+        """(replica, slots) for one posed batch, PROVEN consistent:
+        the slots come from the engine's ``_resolve_batch`` (which
+        re-bakes evicted subjects and broadcasts the rows), and the
+        replica's version is matched against the engine version the
+        slots were validated at in ONE engine-lock hold — an eviction
+        REUSES slots, so a replica ahead of the slots' version could
+        hold another subject's betas in the same row (the dispatch
+        then serves silently wrong vertices; this is the lane
+        equivalent of the engine's snapshot-pinning rule, which
+        dispatches the immutable ``_resolve_batch`` snapshot
+        directly). Install churn makes the validation race; after a
+        few retries the fallback pins a per-batch device_put of the
+        engine snapshot itself — always correct, paid as one
+        full-table transfer under eviction pressure that is already
+        re-baking every batch."""
+        import jax
+
+        eng = self._eng
+        digests = [r.subject for r in reqs]
+        for _ in range(4):
+            _, slots = eng._resolve_batch(reqs)
+            with eng._exe_lock:
+                v_eng = eng._table_version
+                still = [eng._subject_slots.get(d) for d in digests]
+            if still != slots:
+                continue          # an install/evict raced the resolve
+            with self._lock:
+                tab, v = lane.table, lane.table_version
+            if tab is not None and v == v_eng:
+                # The replica derives from exactly the engine table
+                # the slots were validated against; both sides are
+                # immutable from here (later installs only swap
+                # references), so the pair stays correct however the
+                # live table moves on.
+                return tab, slots
+            if tab is None or v < v_eng:
+                self._adopt(lane)
+            # v > v_eng (a broadcast landed mid-validation): retry —
+            # the next round reads a newer consistent pair.
+        table, slots = eng._resolve_batch(reqs)
+        return jax.device_put(table, lane.device), slots
+
+    def _worker(self, lane: Lane) -> None:
+        while True:
+            item = lane.q.get()
+            if item is _SENTINEL:
+                return
+            try:
+                self._run_batch(lane, item)
+            except BaseException as e:  # noqa: BLE001 — futures must not hang
+                # Unlike the single dispatcher (where a deterministic
+                # failure is engine-fatal), a lane is one of N: poison
+                # THIS batch, count it, keep the lane serving — its
+                # siblings and the queue behind it must not die with
+                # one bad batch.
+                self._eng._poison(item[4], e)
+                with self._lock:
+                    lane.errors += 1
+                _LOG.warning(
+                    f"lane {lane.index} batch failed "
+                    f"({type(e).__name__}: {e}); batch poisoned, "
+                    "lane worker continues")
+
+    def _posed_call(self, target: Lane, bucket: int, pose, reqs):
+        """One gathered dispatch on ``target``: version-validated
+        replica + slots, the capacity-keyed executable, and the int32
+        index built from THOSE slots (never from a resolution taken at
+        placement time — the batch may have sat in a backlog through
+        an eviction)."""
+        from mano_hand_tpu.serving import buckets as bucket_mod
+
+        tab, slots = self._resolve_for_lane(target, reqs)
+        exe, tab = self._gather_executable(target, bucket, tab)
+        idx = bucket_mod.subject_index_rows(
+            slots, [r.rows for r in reqs], bucket)
+        return exe, tab, idx
+
+    def _run_batch(self, lane: Lane, item) -> None:
+        from mano_hand_tpu.serving.engine import ServingError
+
+        bucket, pose, shape, posed, reqs, rows = item
+        eng = self._eng
+        tr = eng._tracer
+        n_subjects = (len({r.subject for r in reqs}) if posed else 1)
+        with self._lock:
+            lane.inflight += 1
+        try:
+            # Pre-dispatch sweep: the batch arrays are already
+            # assembled, so members cannot be dropped individually —
+            # but an ALL-dead batch (every member cancelled or
+            # expired while queued behind this lane's backlog) must
+            # not buy a device dispatch at all.
+            now = time.monotonic()
+            if all(r.future.cancelled() or eng._is_expired(r, now)
+                   for r in reqs):
+                for r in reqs:
+                    if not eng._skip_cancelled(r):
+                        eng._expire(r, "dispatch")
+                return
+            try:
+                if eng._policy is None:
+                    if posed:
+                        exe, tab, idx = self._posed_call(
+                            lane, bucket, pose, reqs)
+                        out = np.asarray(exe(tab, idx, pose))
+                    else:
+                        exe = self._full_executable(lane, bucket)
+                        out = np.asarray(exe(pose, shape))
+                else:
+                    out = self._ladder_dispatch(
+                        lane, bucket, pose, shape, posed, reqs)
+            except ServingError as e:
+                # Supervision + the whole ladder exhausted for THIS
+                # batch: its futures get the structured error and the
+                # lane lives on — a failed batch is traffic (the
+                # engine's _launch contract, per lane).
+                with self._lock:
+                    lane.errors += 1
+                eng._poison(reqs, e)
+                return
+            eng.counters.count_dispatch(bucket, rows,
+                                        requests=len(reqs),
+                                        subjects=n_subjects)
+            with self._lock:
+                lane.dispatched += 1
+            if tr is not None:
+                for r in reqs:
+                    tr.event(r.span, "dispatched", lane=lane.index)
+            eng._deliver(reqs, out, bucket)
+            with self._lock:
+                lane.served_requests += sum(
+                    1 for r in reqs
+                    if r.future.done() and not r.future.cancelled()
+                    and r.future.exception() is None)
+        finally:
+            with self._lock:
+                lane.inflight -= 1
+                lane.backlog_batches -= 1
+                lane.backlog_rows -= rows
+
+    def _ladder_dispatch(self, lane: Lane, bucket: int, pose, shape,
+                         posed: bool, reqs):
+        """One batch through the failover LADDER: supervised primary
+        on its placed lane, then one supervised attempt per healthy
+        sibling (least-loaded first, ``health.failover_ladder``), then
+        the engine's CPU degradation tier — every rung inside the
+        batch's own deadline budget, with the expired-members sweep
+        between rungs (a rung must not buy chip time for results
+        nobody will read). Raises ``ServingError`` when every rung is
+        exhausted; deterministic failures propagate un-retried, the
+        PR-3 contract."""
+        from mano_hand_tpu.runtime import supervise
+        from mano_hand_tpu.serving.engine import ServingError
+
+        eng = self._eng
+        pol = eng._policy
+        tr = eng._tracer
+        deadlines = [r.deadline for r in reqs]
+        give_up_by = (None if any(d is None for d in deadlines)
+                      else max(deadlines))
+
+        def attempt_on(target: Lane, retries: int):
+            # Resolution + executable fetch happen per RUNG, outside
+            # the per-attempt deadline (builds are warm-up-class, the
+            # engine rule) — and each rung's index is derived from its
+            # own validated (replica, slots) pair, never recycled from
+            # an earlier rung or the placement-time state.
+            if posed:
+                exe, tab, idx = self._posed_call(target, bucket, pose,
+                                                 reqs)
+                fn = lambda: np.asarray(exe(tab, idx, pose))  # noqa: E731
+            else:
+                exe = self._full_executable(target, bucket)
+                fn = lambda: np.asarray(exe(pose, shape))     # noqa: E731
+            br = target.breaker
+
+            def on_retry():
+                eng.counters.count_retry()
+                if tr is not None:
+                    tr.runtime_event("retry", bucket=bucket,
+                                     lane=target.index)
+
+            def on_kill():
+                eng.counters.count_deadline_kill()
+                if tr is not None:
+                    tr.incident("deadline_kill", bucket=bucket,
+                                lane=target.index)
+            return supervise.supervised_call(
+                fn,
+                deadline_s=pol.deadline_s,
+                retries=retries,
+                backoff_s=pol.backoff_s,
+                backoff_cap_s=pol.backoff_cap_s,
+                jitter=pol.jitter,
+                give_up_by=give_up_by,
+                keep_trying=(br.allow_primary if br is not None
+                             else None),
+                on_retry=on_retry,
+                on_deadline_kill=on_kill,
+                on_attempt_failure=(br.record_failure
+                                    if br is not None else None),
+                name=f"lane{target.index}-dispatch-b{bucket}",
+            )
+
+        last = None
+        attempts = 0
+        if lane.breaker is None or lane.breaker.allow_primary():
+            try:
+                out = attempt_on(lane, pol.retries)
+                if lane.breaker is not None:
+                    lane.breaker.record_success()
+                return out
+            except supervise.RetriesExhausted as e:
+                last, attempts = e.cause, e.attempts
+
+        def all_expired() -> Optional[ServingError]:
+            # The between-rungs deadline sweep (the engine's
+            # post-primary boundary, per rung): once every member has
+            # expired, no further rung may dispatch.
+            now = time.monotonic()
+            if not all(r.future.cancelled() or eng._is_expired(r, now)
+                       for r in reqs):
+                return None
+            for r in reqs:
+                if not eng._skip_cancelled(r):
+                    eng._expire(r, "failover")
+            return ServingError(
+                f"every request in the batch expired during the lane "
+                f"attempts ({attempts}); the ladder stops here — no "
+                "caller would read the result",
+                phase="failover", kind="expired",
+                attempts=attempts, cause=last)
+
+        err = all_expired()
+        if err is not None:
+            raise err
+
+        # -- middle rung: healthy siblings, least-loaded first --------
+        with self._lock:
+            backlog = {ln.index: ln.backlog_rows for ln in self.lanes}
+        order = health.failover_ladder(
+            lane.index, len(self.lanes), backlog,
+            allow=lambda i: (self.lanes[i].breaker is None
+                             or self.lanes[i].breaker.state
+                             != health.DOWN))
+        hopped = False
+        for j in order:
+            sib = self.lanes[j]
+            if sib.breaker is not None and not sib.breaker.allow_primary():
+                continue
+            if not hopped:
+                hopped = True
+                with self._lock:
+                    lane.failovers_out += 1
+            with self._lock:
+                sib.failovers_in += 1
+            if tr is not None:
+                tr.incident("lane_failover", bucket=bucket,
+                            from_lane=lane.index, to_lane=sib.index)
+            try:
+                out = attempt_on(sib, 0)   # one supervised try per rung
+                if sib.breaker is not None:
+                    sib.breaker.record_success()
+                return out
+            except supervise.RetriesExhausted as e:
+                last = e.cause
+                attempts += e.attempts
+            err = all_expired()
+            if err is not None:
+                raise err
+
+        # -- last rung: the CPU degradation tier (PR 3, unchanged) ----
+        if pol.cpu_fallback:
+            eng.counters.count_failover()
+            with self._lock:
+                lane.cpu_failovers += 1
+            if tr is not None:
+                tr.incident("failover", bucket=bucket, lane=lane.index,
+                            attempts=attempts)
+            # THE shared reconstruction (engine.py:_fallback_shape):
+            # the pad-row-betas rule must not drift between the
+            # single-device failover and the ladder's last rung.
+            fb_shape = eng._fallback_shape(reqs, bucket, shape,
+                                           posed=posed)
+            fb = eng._fallback_executable(bucket)
+            try:
+                return supervise.call_with_deadline(
+                    lambda: np.asarray(fb(pose, fb_shape)),
+                    pol.deadline_s,
+                    name=f"lane{lane.index}-fallback-b{bucket}")
+            except BaseException as e:
+                raise ServingError(
+                    f"dispatch failed on lane {lane.index}, every "
+                    f"sibling rung, AND the CPU fallback "
+                    f"({attempts} attempt(s)): {type(e).__name__}: {e}",
+                    attempts=attempts, cause=e) from e
+        raise ServingError(
+            f"dispatch failed: lane {lane.index} "
+            + ("unavailable (breaker open)" if last is None
+               else f"exhausted after {attempts} attempt(s): "
+                    f"{type(last).__name__}: {last}")
+            + ", every sibling rung failed or is down, and "
+            "cpu_fallback is disabled",
+            attempts=attempts, cause=last)
+
+    # ------------------------------------------------------------ telemetry
+    def snapshot(self) -> dict:
+        """The ``load()["lanes"]`` block: every lane's backlog,
+        breaker state, and ladder counters from ONE ``_lock`` hold
+        (the torn-telemetry rule — ``assigned_total`` is summed inside
+        the same hold, so it always equals the per-lane sum)."""
+        with self._lock:
+            per = []
+            for ln in self.lanes:
+                per.append({
+                    "lane": ln.index,
+                    "device": str(ln.device),
+                    "state": (ln.breaker.state if ln.breaker is not None
+                              else health.HEALTHY),
+                    "backlog_batches": ln.backlog_batches,
+                    "backlog_rows": ln.backlog_rows,
+                    "inflight": ln.inflight,
+                    "assigned": ln.assigned,
+                    "dispatched": ln.dispatched,
+                    "served_requests": ln.served_requests,
+                    "failovers_out": ln.failovers_out,
+                    "failovers_in": ln.failovers_in,
+                    "cpu_failovers": ln.cpu_failovers,
+                    "errors": ln.errors,
+                })
+            return {
+                "n_lanes": len(self.lanes),
+                "n_devices": self.n_devices,
+                "healthy": sum(1 for p in per
+                               if p["state"] != health.DOWN),
+                "assigned_total": sum(p["assigned"] for p in per),
+                "backlog_rows_total": sum(p["backlog_rows"]
+                                          for p in per),
+                "per_lane": per,
+            }
+
+    # ------------------------------------------------------------- lifecycle
+    def stop(self, timeout_s: Optional[float] = None) -> None:
+        """Drain + stop every lane worker; poison whatever stays
+        queued. A wedged worker (hung device RPC) is abandoned
+        (daemon) — the engine's final ``_sweep_live`` resolves its
+        batch's futures, the PR-3 shutdown contract per lane."""
+        with self._lock:
+            workers = [(ln, ln.worker) for ln in self.lanes]
+        for ln, _ in workers:
+            ln.q.put(_SENTINEL)
+        join_s = timeout_s if timeout_s is not None else 30.0
+        deadline = time.monotonic() + join_s
+        for ln, w in workers:
+            if w is not None and w.is_alive():
+                w.join(max(0.0, deadline - time.monotonic()))
+        from mano_hand_tpu.serving.engine import ServingError
+
+        for ln, w in workers:
+            while True:
+                try:
+                    item = ln.q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is _SENTINEL:
+                    continue
+                self._eng._poison(item[4], ServingError(
+                    "serving engine stopped before this batch's lane "
+                    "dispatched it", phase="shutdown"))
+                with self._lock:
+                    # The worker's finally never runs for a drained
+                    # item: release its backlog accounting here, or a
+                    # restarted engine places around phantom load
+                    # forever (and load() reports backlog on idle).
+                    ln.backlog_batches -= 1
+                    ln.backlog_rows -= item[5]
+            if w is not None and w.is_alive():
+                # The drain above may have eaten the worker's shutdown
+                # sentinel: an abandoned (wedged-RPC) worker that ever
+                # unwinds must find one and exit instead of blocking
+                # on the empty queue forever (the engine's own
+                # re-post-at-stop rule, per lane).
+                ln.q.put(_SENTINEL)

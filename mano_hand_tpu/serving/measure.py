@@ -2595,3 +2595,316 @@ def _stream_fit_calibration(prm32, sessions, betas, next_targets,
         "warm_vs_cold_fit_ratio": (
             None if ratio is None else float(f"{ratio:.4g}")),
     }
+
+
+def lane_drill_run(
+    params,
+    *,
+    lanes: int = 4,
+    requests_per_pass: int = 96,
+    subjects: int = 6,
+    workers: int = 8,
+    max_rows: int = 4,
+    max_bucket: int = 16,
+    deadline_s: float = 5.0,
+    kill_lane: int = 1,
+    lane_failover_budget: float = 0.05,
+    seed: int = 0,
+    tracer=None,
+    log: Callable[[str], None] = None,
+) -> dict:
+    """THE lane-loss chaos drill (PR 13 tentpole; bench config16).
+
+    One lane-aware ``ServingEngine`` (``lanes=N`` per-device dispatch
+    lanes over the available devices — the CPU lane forces N>=4
+    virtual host devices via bench.py ``--virtual-devices``; fewer
+    devices oversubscribe round-robin, recorded in ``n_devices``) is
+    driven by ``workers`` concurrent submitters through three passes:
+    a healthy steady pass, a LOSS pass during which a ``%LANE``-tagged
+    chaos plan kills exactly ``kill_lane`` (persistent error on that
+    lane's own call index + its breaker probe forced false) while
+    requests are in flight, and a post-failback steady pass after the
+    fault clears. The done-criteria (scripts/bench_report.py:
+    judge_lanes) read the returned numbers:
+
+    * ``futures_resolved_fraction`` == 1.0 with zero ``error`` /
+      ``stranded`` outcomes: losing one lane degraded CAPACITY, never
+      the service — every future through the loss pass resolved ok
+      via the sibling ladder;
+    * ``loss_vs_reference_max_abs_err`` == 0.0: failover results are
+      bit-identical to the single-device engine (same
+      params/table-as-runtime-args program families);
+    * ``cpu_failovers`` == 0: the ladder's SIBLING rung absorbed the
+      loss — the CPU tier (still armed) was never needed while
+      healthy siblings existed;
+    * ``steady_recompiles_pre`` == 0 AND ``steady_recompiles_post``
+      == 0: zero compiles before the loss and after failback (warm
+      per-lane caches make the ladder and the failback free);
+    * ``spans``: every request span closed exactly once, the loss
+      pass included;
+    * the killed lane's breaker re-probe schedule GREW while it was
+      down (``breaker_probe_backoff_grew`` — the PR-13 probe-backoff
+      satellite, observed in its natural habitat).
+
+    Throughput per pass is recorded; the surviving-throughput ratio is
+    judged only on a real multi-chip fleet (on this 1-core CPU box all
+    virtual lanes share one core, so the ratio carries no signal — the
+    config14 precedent). ``survivor_balance_ratio`` (max/min assigned
+    among surviving lanes during the loss pass) is the CPU-judgeable
+    stand-in: capacity loss spread evenly over the fleet.
+
+    A mid-drill ``future.cancel()`` probe rides the loss pass (the
+    PR-13 cancellation satellite): the cancelled future resolves as
+    CancelledError, is counted per tier, and frees its admission slot.
+    Faults are injected in-process; no chip is required and none is
+    harmed.
+    """
+    import concurrent.futures as cf
+    import threading
+
+    from mano_hand_tpu.runtime.chaos import ChaosPlan
+    from mano_hand_tpu.runtime.health import CircuitBreaker
+    from mano_hand_tpu.runtime.supervise import DispatchPolicy
+    from mano_hand_tpu.serving.engine import ServingEngine, ServingError
+
+    log = _logger(log)
+    if tracer is None:
+        tracer = Tracer(capacity=65536)
+    if kill_lane >= lanes:
+        raise ValueError(
+            f"kill_lane {kill_lane} out of range for {lanes} lanes")
+    n_joints, n_shape = params.n_joints, params.n_shape
+    prm32 = params.astype(np.float32)
+    rng = np.random.default_rng(seed)
+    subj_betas = [rng.normal(size=(n_shape,)).astype(np.float32)
+                  for _ in range(subjects)]
+
+    # One fixed request universe per pass, shared with the reference
+    # engine so bit-identity is comparable request-for-request.
+    def make_stream(n, pass_seed):
+        r = np.random.default_rng(pass_seed)
+        sizes = r.integers(1, max_rows + 1, size=n)
+        return [(r.normal(scale=0.4,
+                          size=(int(s), n_joints, 3)).astype(np.float32),
+                 int(r.integers(0, subjects)))
+                for s in sizes]
+
+    streams = {name: make_stream(requests_per_pass, seed + 100 + i)
+               for i, name in enumerate(("pre", "loss", "post"))}
+
+    # Reference: the SINGLE-DEVICE engine (no lanes, no policy) over
+    # the same subjects — the bit-identity bar for every lane result.
+    ref_eng = ServingEngine(prm32, max_bucket=max_bucket,
+                            max_delay_s=0.001)
+    reference = {}
+    with ref_eng:
+        ref_keys = [ref_eng.specialize(b) for b in subj_betas]
+        for name, stream in streams.items():
+            reference[name] = [
+                ref_eng.forward(p, subject=ref_keys[si])
+                for p, si in stream]
+
+    lane_ok = [True] * lanes
+    plan = ChaosPlan()
+    breaker_proto = CircuitBreaker(
+        failure_threshold=2,
+        # A tiny but NONZERO base interval: re-probes stay drill-fast,
+        # and the exponential backoff (default 2.0x, capped 32x) is
+        # observable in probe_wait_s — the PR-13 probe-backoff
+        # satellite judged in its natural habitat.
+        probe_interval_s=0.001,
+        respect_priority_claim=False)
+    policy = DispatchPolicy(
+        deadline_s=deadline_s, retries=1, backoff_s=0.005,
+        backoff_cap_s=0.01, jitter=0.0, breaker=breaker_proto,
+        chaos=plan, cpu_fallback=True)
+    eng = ServingEngine(
+        prm32, max_bucket=max_bucket, max_delay_s=0.002,
+        policy=policy, tracer=tracer, lanes=lanes,
+        lane_probe=lambda i: lane_ok[i])
+    resolve_timeout = deadline_s * (policy.retries + 2) * (lanes + 1) + 60.0
+
+    def run_pass(stream, keys, cancel_probe=False):
+        """Submit via a worker pool (concurrent in-flight streams —
+        the 'mid-stream' in mid-stream lane loss), resolve everything,
+        classify outcomes, and compare served results bitwise against
+        the reference engine."""
+        outcomes = {"ok": 0, "error": 0, "expired": 0, "stranded": 0,
+                    "cancelled": 0}
+        results = [None] * len(stream)
+        t0 = time.perf_counter()
+        lock = threading.Lock()
+        cancelled_idx = len(stream) // 2 if cancel_probe else -1
+
+        def submit_one(i):
+            p, si = stream[i]
+            fut = eng.submit(p, subject=keys[si])
+            if i == cancelled_idx:
+                fut.cancel()
+            try:
+                results[i] = fut.result(timeout=resolve_timeout)
+                k = "ok"
+            except cf.CancelledError:
+                k = "cancelled"
+            except ServingError as e:
+                k = "expired" if e.kind == "expired" else "error"
+            except Exception:   # noqa: BLE001 — a timeout IS the bug
+                k = "stranded"
+            with lock:
+                outcomes[k] += 1
+
+        with cf.ThreadPoolExecutor(max_workers=workers) as pool:
+            list(pool.map(submit_one, range(len(stream))))
+        dt = time.perf_counter() - t0
+        return outcomes, results, dt
+
+    def max_err(results, refs, skip=()):
+        worst = 0.0
+        for i, (got, want) in enumerate(zip(results, refs)):
+            if got is None:
+                if i in skip:
+                    continue
+                return None              # an unresolved result: no bar
+            worst = max(worst, float(np.abs(got - want).max()))
+        return worst
+
+    def lane_block():
+        return eng.load()["lanes"]
+
+    try:
+        with eng:
+            keys = [eng.specialize(b) for b in subj_betas]
+            buckets = [b for b in eng.buckets if b <= max_bucket]
+            eng.warmup(buckets)
+            eng.warmup_posed(buckets)
+            warm_compiles = eng.counters.compiles
+            log(f"lane drill: {lanes} lanes over "
+                f"{eng._get_lanes().n_devices} device(s), "
+                f"{warm_compiles} warm-up compiles")
+
+            # -- pass 1: healthy steady state -------------------------
+            oc_pre, res_pre, dt_pre = run_pass(streams["pre"], keys)
+            recompiles_pre = eng.counters.compiles - warm_compiles
+            err_pre = max_err(res_pre, reference["pre"])
+            assigned_before_loss = {
+                p["lane"]: p["assigned"]
+                for p in lane_block()["per_lane"]}
+
+            # -- pass 2: kill one lane MID-STREAM ---------------------
+            # The %LANE-tagged plan fires on the killed lane's own
+            # call counter (its first dispatch of this pass onward)
+            # while `workers` submitters keep frames in flight; the
+            # probe override keeps its breaker from closing until the
+            # drill clears the fault.
+            lane_ok[kill_lane] = False
+            plan.schedule(f"error@0-%{kill_lane}")
+            killed = eng._get_lanes().lanes[kill_lane]
+            oc_loss, res_loss, dt_loss = run_pass(
+                streams["loss"], keys, cancel_probe=True)
+            probes_down = killed.breaker.probes
+            backoff_grew = (killed.breaker.consecutive_failed_probes
+                            >= 1)
+            probe_wait_down_s = killed.breaker.probe_wait_s()
+            snap_loss = lane_block()
+            cancelled_i = len(streams["loss"]) // 2
+            err_loss = max_err(res_loss, reference["loss"],
+                               skip={cancelled_i})
+
+            # -- pass 3: failback ------------------------------------
+            plan.clear()
+            lane_ok[kill_lane] = True
+            # Settle: the next placements kick the killed lane's
+            # re-probe, its breaker closes, traffic returns to it.
+            oc_settle, res_settle, _ = run_pass(streams["pre"], keys)
+            compiles_settled = eng.counters.compiles
+            killed_assigned_settled = lane_block()[
+                "per_lane"][kill_lane]["assigned"]
+            oc_post, res_post, dt_post = run_pass(streams["post"], keys)
+            recompiles_post = eng.counters.compiles - compiles_settled
+            err_post = max_err(res_post, reference["post"])
+            snap_final = lane_block()
+            failback_served = (snap_final["per_lane"][kill_lane]
+                               ["assigned"] > killed_assigned_settled)
+            counters_snap = eng.counters.snapshot()
+    finally:
+        plan.release.set()
+
+    per_loss = {p["lane"]: p for p in snap_loss["per_lane"]}
+    survivors = [i for i in range(lanes) if i != kill_lane]
+    surv_assigned = [
+        per_loss[i]["assigned"] - assigned_before_loss.get(i, 0)
+        for i in survivors]
+    balance = (max(surv_assigned) / max(1, min(surv_assigned))
+               if surv_assigned else None)
+    killed_assigned_during_loss = (
+        per_loss[kill_lane]["assigned"]
+        - assigned_before_loss.get(kill_lane, 0))
+    lane_failovers = sum(p["failovers_out"]
+                         for p in snap_final["per_lane"])
+    cpu_failovers = sum(p["cpu_failovers"]
+                        for p in snap_final["per_lane"])
+    # Per-lane availability burn (the PR-9 burn-rate shape at lane
+    # granularity): fraction of a lane's batches it could not serve
+    # itself, over the failover budget.
+    lane_slo = {}
+    for p in snap_final["per_lane"]:
+        assigned = p["assigned"]
+        frac = p["failovers_out"] / assigned if assigned else 0.0
+        lane_slo[str(p["lane"])] = {
+            "assigned": assigned,
+            "failover_fraction": round(frac, 6),
+            "burn": round(frac / lane_failover_budget, 4),
+            "ok": frac <= lane_failover_budget,
+        }
+
+    n_total = 4 * requests_per_pass          # pre + loss + settle + post
+    outcomes = {k: oc_pre[k] + oc_loss[k] + oc_settle[k] + oc_post[k]
+                for k in oc_pre}
+    resolved = n_total - outcomes["stranded"]
+    acc = tracer.accounting()
+    rate = lambda oc, dt: float(   # noqa: E731
+        f"{(requests_per_pass - oc.get('stranded', 0)) / dt:.5g}")
+    return {
+        "lanes": lanes,
+        "distinct_devices": snap_final["n_devices"],
+        "kill_lane": kill_lane,
+        "requests_per_pass": requests_per_pass,
+        "workers": workers,
+        "subjects": subjects,
+        "futures_resolved_fraction": float(
+            f"{resolved / n_total:.6g}"),
+        "outcomes": outcomes,
+        "pre_vs_reference_max_abs_err": err_pre,
+        "loss_vs_reference_max_abs_err": err_loss,
+        "post_vs_reference_max_abs_err": err_post,
+        "steady_recompiles_pre": int(recompiles_pre),
+        "steady_recompiles_post": int(recompiles_post),
+        "warmup_compiles": int(warm_compiles),
+        "lane_failovers": int(lane_failovers),
+        "cpu_failovers": int(cpu_failovers),
+        "killed_lane_assigned_during_loss": int(
+            killed_assigned_during_loss),
+        "survivor_balance_ratio": (float(f"{balance:.4g}")
+                                   if balance is not None else None),
+        "throughput_pre_per_sec": rate(oc_pre, dt_pre),
+        "throughput_loss_per_sec": rate(oc_loss, dt_loss),
+        "throughput_post_per_sec": rate(oc_post, dt_post),
+        "surviving_throughput_ratio": float(
+            f"{dt_pre / dt_loss:.4g}") if dt_loss else None,
+        "breaker_probes_while_down": int(probes_down),
+        "breaker_probe_backoff_grew": bool(backoff_grew),
+        "breaker_probe_wait_down_s": float(
+            f"{probe_wait_down_s:.4g}"),
+        "failback_served": bool(failback_served),
+        "cancelled": int(counters_snap["cancelled"]),
+        "lane_slo": lane_slo,
+        "lanes_detail": snap_final,
+        "spans": {
+            "started": acc["spans_started"],
+            "closed": acc["spans_closed"],
+            "open": acc["spans_open"],
+            "closed_by_kind": acc["closed_by_kind"],
+        },
+        "flight_record": flight_record(
+            tracer, eng.counters, reason="lane_drill_complete"),
+    }

@@ -168,11 +168,17 @@ class ServingCounters:
         # per-tier ledgers are the goodput criterion's raw material.
         self.shed = 0              # submits refused at admission
         self.expired = 0           # requests expired before/at delivery
+        # Caller-initiated cancellation (PR 13): ``future.cancel()``
+        # freed the admission slot before the deadline sweep would —
+        # work the CALLER withdrew, distinct from shed (refused) and
+        # expired (timed out).
+        self.cancelled = 0
         self.backlog_peak = 0      # max outstanding requests seen at submit
         self.tier_submitted: Dict[int, int] = {}   # tier -> offered
         self.tier_served: Dict[int, int] = {}      # tier -> results delivered
         self.tier_shed: Dict[int, int] = {}        # tier -> admission sheds
         self.tier_expired: Dict[int, int] = {}     # tier -> expiries
+        self.tier_cancelled: Dict[int, int] = {}   # tier -> cancellations
         self._latencies: Dict[int, list] = {}  # bucket -> [seconds]
         self._latency_writes: Dict[int, int] = {}  # per-bucket write cursor
 
@@ -254,6 +260,16 @@ class ServingCounters:
         with self._lock:
             self.expired += 1
             self.tier_expired[tier] = self.tier_expired.get(tier, 0) + 1
+
+    def count_cancelled(self, tier: int = 0) -> None:
+        """One request whose caller called ``future.cancel()`` before a
+        result landed: the admission slot is freed immediately and the
+        span closes as terminal kind ``cancelled`` — never dispatched
+        when the sweep catches it queued, result discarded when it was
+        already in flight (serving/engine.py, PR 13)."""
+        with self._lock:
+            self.cancelled += 1
+            self.tier_cancelled[tier] = self.tier_cancelled.get(tier, 0) + 1
 
     def observe_backlog(self, outstanding: int) -> None:
         with self._lock:
@@ -398,6 +414,7 @@ class ServingCounters:
                 "deadline_kills": self.deadline_kills,
                 "shed": self.shed,
                 "expired": self.expired,
+                "cancelled": self.cancelled,
                 "backlog_peak": self.backlog_peak,
             }
             base["padding_waste"] = round(
@@ -406,13 +423,15 @@ class ServingCounters:
                 self._width_mean(self.requests_dispatched,
                                  self.dispatches), 3)
             tiers = sorted(set(self.tier_submitted) | set(self.tier_served)
-                           | set(self.tier_shed) | set(self.tier_expired))
+                           | set(self.tier_shed) | set(self.tier_expired)
+                           | set(self.tier_cancelled))
             base["tiers"] = {
                 str(t): {
                     "submitted": self.tier_submitted.get(t, 0),
                     "served": self.tier_served.get(t, 0),
                     "shed": self.tier_shed.get(t, 0),
                     "expired": self.tier_expired.get(t, 0),
+                    "cancelled": self.tier_cancelled.get(t, 0),
                 }
                 for t in tiers
             }

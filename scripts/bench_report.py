@@ -930,6 +930,93 @@ def main() -> int:
               f"{st.get('warm_fit_frames_per_sec')} fits/s")
         judge_flight_record("streams", st)
 
+    def judge_lanes(ln):
+        """Done-criteria of the lane-loss chaos drill (config16,
+        PR 13): 100% of futures resolved through one lane killed
+        mid-stream (zero errors, zero strands — losing a lane degrades
+        capacity, never the service), failover results bit-identical
+        to the single-device engine, the sibling LADDER (not the CPU
+        tier) absorbing the loss while healthy siblings exist, zero
+        steady recompiles before AND after the recompile-free
+        failback, the killed lane's re-probe backoff growing while it
+        was down, and every request span closed exactly once."""
+        frac = ln.get("futures_resolved_fraction")
+        oc = ln.get("outcomes") or {}
+        msg = (f"{frac} of 4x{ln.get('requests_per_pass')} futures "
+               f"over {ln.get('lanes')} lanes / {ln.get('distinct_devices')} "
+               f"device(s) (ok/error/expired/stranded/cancelled: "
+               f"{oc.get('ok')}/{oc.get('error')}/{oc.get('expired')}/"
+               f"{oc.get('stranded')}/{oc.get('cancelled')}; lane "
+               f"{ln.get('kill_lane')} killed mid-stream)")
+        check("lanes_all_futures_resolved",
+              frac == 1.0 and oc.get("error") == 0
+              and oc.get("stranded") == 0, msg)
+        errs = (ln.get("pre_vs_reference_max_abs_err"),
+                ln.get("loss_vs_reference_max_abs_err"),
+                ln.get("post_vs_reference_max_abs_err"))
+        check("lanes_bit_identical_to_single_device",
+              all(e == 0.0 for e in errs),
+              f"pre/loss/post vs single-device-engine max abs err "
+              f"{errs[0]}/{errs[1]}/{errs[2]} (same params/table-as-"
+              "runtime-args program families, per-lane replicas)")
+        check("lanes_sibling_ladder_absorbed_loss",
+              (ln.get("lane_failovers") or 0) >= 1
+              and ln.get("cpu_failovers") == 0,
+              f"{ln.get('lane_failovers')} ladder hop(s) onto healthy "
+              f"siblings, {ln.get('cpu_failovers')} CPU failovers "
+              "(the CPU tier stays the LAST rung — with healthy "
+              "siblings it must never fire)")
+        check("lanes_zero_steady_recompiles",
+              ln.get("steady_recompiles_pre") == 0
+              and ln.get("steady_recompiles_post") == 0
+              and ln.get("failback_served") is True,
+              f"{ln.get('steady_recompiles_pre')} recompiles pre-loss, "
+              f"{ln.get('steady_recompiles_post')} post-failback over "
+              f"{ln.get('warmup_compiles')} warm-up compiles; killed "
+              f"lane served again after failback: "
+              f"{ln.get('failback_served')}")
+        check("lanes_probe_backoff_grew",
+              ln.get("breaker_probe_backoff_grew") is True,
+              f"{ln.get('breaker_probes_while_down')} failed re-probes "
+              f"while down grew the wait to "
+              f"{ln.get('breaker_probe_wait_down_s')} s (the "
+              "outage-length-aware schedule, runtime/health.py)")
+        spans = ln.get("spans") or {}
+        check("lanes_drill_spans_closed_once",
+              spans.get("started") is not None
+              and spans.get("started") == spans.get("closed")
+              and spans.get("open") == 0,
+              f"{spans.get('closed')}/{spans.get('started')} spans "
+              f"closed (by kind {spans.get('closed_by_kind')}; "
+              f"{spans.get('open')} open)")
+        n_dev = ln.get("distinct_devices")
+        if n_dev is not None and n_dev < 2:
+            print(f"  [info] lanes (n_devices<2, placement runs "
+                  f"oversubscribed — distinct-device leg is the "
+                  f"serve-smoke artifact): {n_dev} device(s)")
+        # Throughput ratios are recorded, not judged, off-fleet: all
+        # virtual CPU lanes share this box's one core (the config14
+        # judged-on-TPU-only precedent). Balance is CPU-judgeable.
+        print(f"  [info] lanes: throughput pre/loss/post "
+              f"{ln.get('throughput_pre_per_sec')}/"
+              f"{ln.get('throughput_loss_per_sec')}/"
+              f"{ln.get('throughput_post_per_sec')} req/s, survivor "
+              f"balance {ln.get('survivor_balance_ratio')}, per-lane "
+              f"burn {[v.get('burn') for v in (ln.get('lane_slo') or {}).values()]}, "
+              f"{ln.get('cancelled')} cancelled")
+        judge_flight_record("lanes", ln)
+
+    if ("lane_failovers" in line and "metric" not in line):
+        # A raw lane_drill_run artifact (no bench.py envelope): only
+        # the config16 criteria apply. Checked BEFORE the recovery
+        # raw-artifact key, which this artifact also carries
+        # (futures_resolved_fraction).
+        judge_lanes(line)
+        bad = [n for n, ok in checks if not ok]
+        print("RESULT: " + ("LANES CRITERIA PASS" if not bad
+                            else f"failing: {', '.join(bad)}"))
+        return 0 if not bad else 1
+
     if ("frames_resolved_fraction" in line and "metric" not in line):
         # A raw `serve-bench --streams` artifact (stream_drill_run's
         # own JSON line, no bench.py envelope): only the config15
@@ -1069,6 +1156,13 @@ def main() -> int:
             check("streams_leg_ran", False,
                   f"config15_streams crashed: "
                   f"{line['config_errors']['config15_streams']}")
+        ln = detail.get("lanes")
+        if ln:
+            judge_lanes(ln)
+        elif "config16_lanes" in (line.get("config_errors") or {}):
+            check("lanes_leg_ran", False,
+                  f"config16_lanes crashed: "
+                  f"{line['config_errors']['config16_lanes']}")
         bad = [n for n, ok in checks if not ok]
         print("RESULT: " + ("SERVING CRITERIA PASS" if not bad
                             else f"failing: {', '.join(bad)}"))
@@ -1194,6 +1288,16 @@ def main() -> int:
         check("streams_leg_ran", False,
               f"config15_streams crashed: "
               f"{line['config_errors']['config15_streams']}")
+
+    lanes = detail.get("lanes")
+    if lanes:
+        # Lane-loss chaos drill (config16, PR 13) — same presence
+        # rule: judge it wherever it ran.
+        judge_lanes(lanes)
+    elif "config16_lanes" in (line.get("config_errors") or {}):
+        check("lanes_leg_ran", False,
+              f"config16_lanes crashed: "
+              f"{line['config_errors']['config16_lanes']}")
 
     spec = detail.get("specialization")
     cfg_errs = line.get("config_errors") or {}

@@ -1,0 +1,39 @@
+"""Fixture: violates `device-under-install-lock` (parsed, never run)."""
+import threading
+
+import jax
+import numpy as np
+
+
+class Engine:
+    def __init__(self):
+        self._install_lock = threading.Lock()
+        self._exe_lock = threading.Lock()
+        self._replicas = []
+
+    def bad_broadcast(self, shaped):
+        with self._install_lock:
+            for dev in self._replicas:
+                jax.device_put(shaped, dev)          # device work in hold
+            jax.block_until_ready(shaped)            # and a device wait
+
+    def fine_broadcast(self, shaped):
+        staged = [jax.device_put(shaped, dev)        # staged OUTSIDE
+                  for dev in self._replicas]
+        with self._install_lock:
+            self._replicas = staged
+
+    def fine_pragma(self, table, slot, shaped):
+        with self._install_lock:
+            # The engine's audited bake-and-swap exception.
+            # analysis: allow(device-under-install-lock)
+            return self.jit_table_set_row(table, slot, shaped)
+
+    def jit_table_set_row(self, table, slot, shaped):
+        return table
+
+    def bad_both_locks(self, x):
+        with self._install_lock:
+            with self._exe_lock:
+                # Inside BOTH holds: both rules fire on one line.
+                return jax.device_put(np.asarray(x))

@@ -111,6 +111,34 @@ def test_device_under_exe_lock_fires_and_spares_deferred():
     assert sorted(f.line for f in findings) == [15, 16]
 
 
+def test_device_under_install_lock_fires_spares_staging_and_pragma():
+    """Satellite (PR 13): the `device-under-install-lock` policy
+    variant (docs/roadmap.md PR-7 "Open") — device calls inside an
+    ``_install_lock`` hold fire; staging the device work before the
+    hold is clean; the engine's audited bake-and-swap pragma
+    silences; a line inside BOTH holds fires both rules."""
+    findings, _ = _lint_fixture("bad_device_under_install_lock.py")
+    assert _rules(findings) == ["device-under-exe-lock",
+                                "device-under-install-lock"]
+    install = sorted(f.line for f in findings
+                     if f.rule == "device-under-install-lock")
+    assert install == [17, 18, 39]
+    # The nested-both-holds line fires the exe rule too.
+    assert [f.line for f in findings
+            if f.rule == "device-under-exe-lock"] == [39]
+
+
+def test_install_lock_rule_head_is_clean_or_audited():
+    """HEAD carries exactly one audited install-lock device site: the
+    engine's documented bake-and-swap (pragma'd); serving/lanes.py —
+    the module the rule was written for — is clean with no pragma."""
+    eng = REPO_ROOT / "mano_hand_tpu" / "serving" / "engine.py"
+    lanes = REPO_ROOT / "mano_hand_tpu" / "serving" / "lanes.py"
+    assert lint_paths([eng, lanes], root=REPO_ROOT) == []
+    assert "allow(device-under-install-lock)" in eng.read_text()
+    assert "allow(device-under-install-lock)" not in lanes.read_text()
+
+
 def test_pragma_silences_on_same_and_previous_line():
     findings, src = _lint_fixture("allowed_pragma.py")
     assert findings == []
@@ -170,6 +198,14 @@ def test_nonreentrant_reacquire_is_caught():
 def test_good_lock_fixture_and_real_engine_are_clean():
     assert check_lock_discipline(FIXTURES / "good_locks.py") == []
     assert check_lock_discipline() == []   # serving/engine.py, HEAD
+
+
+def test_lanes_lock_graph_is_clean_on_head():
+    """Satellite (PR 13): the lock checker's scope covers the lane
+    subsystem — LaneSet's one lock must never grow a cycle or a
+    re-acquire through refactors (its workers block on it per batch)."""
+    lanes = REPO_ROOT / "mano_hand_tpu" / "serving" / "lanes.py"
+    assert check_lock_discipline(lanes, order=()) == []
 
 
 # ------------------------------------------------------------- lockstep

@@ -1062,3 +1062,109 @@ def test_history_frame_latency_regression_fails_by_name(tmp_path):
              "--history-tolerance", "0.7")
     assert p.returncode == 0, p.stdout
     assert "PERF NO-REGRESSION" in p.stdout
+
+
+def _lanes_block(**over):
+    ln = {
+        "lanes": 4, "distinct_devices": 4, "kill_lane": 1,
+        "requests_per_pass": 96, "workers": 8, "subjects": 6,
+        "futures_resolved_fraction": 1.0,
+        "outcomes": {"ok": 383, "error": 0, "expired": 0,
+                     "stranded": 0, "cancelled": 1},
+        "pre_vs_reference_max_abs_err": 0.0,
+        "loss_vs_reference_max_abs_err": 0.0,
+        "post_vs_reference_max_abs_err": 0.0,
+        "steady_recompiles_pre": 0, "steady_recompiles_post": 0,
+        "warmup_compiles": 55,
+        "lane_failovers": 1, "cpu_failovers": 0,
+        "killed_lane_assigned_during_loss": 1,
+        "survivor_balance_ratio": 1.2,
+        "throughput_pre_per_sec": 1533.0,
+        "throughput_loss_per_sec": 1853.6,
+        "throughput_post_per_sec": 2070.0,
+        "surviving_throughput_ratio": 1.21,
+        "breaker_probes_while_down": 4,
+        "breaker_probe_backoff_grew": True,
+        "breaker_probe_wait_down_s": 0.016,
+        "failback_served": True,
+        "cancelled": 1,
+        "lane_slo": {str(i): {"assigned": 10, "failover_fraction": 0.0,
+                              "burn": 0.0, "ok": True}
+                     for i in range(4)},
+        "spans": {"started": 384, "closed": 384, "open": 0,
+                  "closed_by_kind": {"ok": 383, "cancelled": 1}},
+        "flight_record": {"schema": 1, "reason": "lane_drill_complete",
+                          "accounting": {"spans_started": 384,
+                                         "spans_closed": 384,
+                                         "spans_open": 0,
+                                         "closed_by_kind": {},
+                                         "incidents": 1,
+                                         "events_dropped": 0}},
+    }
+    ln.update(over)
+    return ln
+
+
+@pytest.mark.slow
+def test_lanes_block(tmp_path):
+    """The lane-loss chaos drill (config16, PR 13): 100% resolved
+    through one lane killed mid-stream, bit-identical to the single-
+    device engine, the sibling ladder (not CPU) absorbing it, zero
+    steady recompiles both sides of the recompile-free failback, the
+    probe backoff growing while down, every span closed once — judged
+    as a raw lane_drill_run artifact (detected BEFORE the recovery
+    key it shares) AND inside a serving-only envelope."""
+    ln = _lanes_block()
+    raw = tmp_path / "lanes_raw.json"
+    raw.write_text(json.dumps(ln))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    for name in ("lanes_all_futures_resolved",
+                 "lanes_bit_identical_to_single_device",
+                 "lanes_sibling_ladder_absorbed_loss",
+                 "lanes_zero_steady_recompiles",
+                 "lanes_probe_backoff_grew",
+                 "lanes_drill_spans_closed_once",
+                 "lanes_spans_closed_once"):
+        assert f"[PASS] {name}" in p.stdout, (name, p.stdout)
+    assert "LANES CRITERIA PASS" in p.stdout
+    # Not misrouted into the recovery judge (shared raw key).
+    assert "RECOVERY CRITERIA" not in p.stdout
+
+    cases = [
+        (dict(outcomes={"ok": 382, "error": 1, "expired": 0,
+                        "stranded": 0, "cancelled": 1}),
+         "lanes_all_futures_resolved"),
+        (dict(loss_vs_reference_max_abs_err=1e-6),
+         "lanes_bit_identical_to_single_device"),
+        (dict(cpu_failovers=2), "lanes_sibling_ladder_absorbed_loss"),
+        (dict(lane_failovers=0), "lanes_sibling_ladder_absorbed_loss"),
+        (dict(steady_recompiles_post=2), "lanes_zero_steady_recompiles"),
+        (dict(failback_served=False), "lanes_zero_steady_recompiles"),
+        (dict(breaker_probe_backoff_grew=False),
+         "lanes_probe_backoff_grew"),
+        (dict(spans={"started": 384, "closed": 383, "open": 1,
+                     "closed_by_kind": {"ok": 383}}),
+         "lanes_drill_spans_closed_once"),
+    ]
+    for over, name in cases:
+        raw.write_text(json.dumps(_lanes_block(**over)))
+        p = _run(str(raw))
+        assert p.returncode == 1, (name, p.stdout)
+        assert f"[FAIL] {name}" in p.stdout, (name, p.stdout)
+
+    # Inside a serving-only envelope the same criteria ride along, and
+    # a crashed config16 leg fails loudly instead of vanishing.
+    env = {"metric": "serving_engine_evals_per_sec", "value": 1.0,
+           "unit": "evals/s", "device": "cpu:cpu",
+           "detail": {"lanes": _lanes_block()}}
+    ep = tmp_path / "env.json"
+    ep.write_text(json.dumps(env))
+    p = _run(str(ep))
+    assert "[PASS] lanes_all_futures_resolved" in p.stdout
+    env["detail"] = {}
+    env["config_errors"] = {"config16_lanes": "boom"}
+    ep.write_text(json.dumps(env))
+    p = _run(str(ep))
+    assert p.returncode == 1
+    assert "[FAIL] lanes_leg_ran" in p.stdout

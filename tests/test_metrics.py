@@ -214,7 +214,8 @@ def test_counter_drift_guard_every_field_exported():
     # fold into the "tiers" block, the reservoirs into
     # latency_by_bucket).
     folded = {"tier_submitted": "tiers", "tier_served": "tiers",
-              "tier_shed": "tiers", "tier_expired": "tiers"}
+              "tier_shed": "tiers", "tier_expired": "tiers",
+              "tier_cancelled": "tiers"}
     for field in public:
         assert folded.get(field, field) in snap, \
             f"ServingCounters.{field} missing from snapshot()"

@@ -277,6 +277,116 @@ def test_breaker_stands_down_for_priority_claim(tmp_path, monkeypatch):
     assert br.allow_primary() and probes
 
 
+def test_breaker_probe_backoff_grows_and_caps():
+    """PR-13 satellite: the re-probe interval grows exponentially with
+    consecutive FAILED probes (capped), and any success resets it —
+    N per-lane breakers must not hammer a 10-hour outage at a constant
+    cadence (docs/roadmap.md PR-3 "Open")."""
+    now = [0.0]
+    br = health.CircuitBreaker(
+        failure_threshold=1, probe=lambda: False,
+        probe_interval_s=1.0, probe_backoff=2.0,
+        probe_interval_cap_s=4.0,
+        respect_priority_claim=False, clock=lambda: now[0])
+    br.record_failure()
+    assert br.probe_wait_s() == 1.0
+    assert not br.allow_primary()              # probe #1 fails
+    assert br.probe_wait_s() == 2.0            # 1.0 * 2^1
+    now[0] += 1.5
+    assert not br.allow_primary() and br.probes == 1   # inside the wait
+    now[0] += 1.0                              # 2.5 s since probe #1
+    assert not br.allow_primary() and br.probes == 2
+    assert br.probe_wait_s() == 4.0            # 1.0 * 2^2
+    now[0] += 50.0
+    assert not br.allow_primary() and br.probes == 3
+    assert br.probe_wait_s() == 4.0            # capped, not 8.0
+    assert br.consecutive_failed_probes == 3
+    br.record_success()                        # reset: blips recover fast
+    assert br.probe_wait_s() == 1.0
+    with pytest.raises(ValueError, match="probe_backoff"):
+        health.CircuitBreaker(probe_backoff=0.5)
+    with pytest.raises(ValueError, match="probe_interval_cap_s"):
+        health.CircuitBreaker(probe_interval_s=10.0,
+                              probe_interval_cap_s=1.0)
+
+
+def test_breaker_probe_due_is_cheap_and_rate_limited():
+    now = [0.0]
+    br = health.CircuitBreaker(
+        failure_threshold=1, probe=lambda: False,
+        probe_interval_s=1.0, probe_backoff=2.0,
+        respect_priority_claim=False, clock=lambda: now[0])
+    assert not br.probe_due()          # HEALTHY: nothing to probe
+    br.record_failure()
+    assert br.probe_due()
+    assert not br.allow_primary()      # probe fails
+    assert not br.probe_due()          # inside the (grown) wait
+    now[0] += 2.0
+    assert br.probe_due()
+    assert br.probes == 1              # probe_due itself never probes
+
+
+def test_failover_ladder_orders_healthy_siblings_by_backlog():
+    """PR-13: device -> least-loaded healthy sibling -> CPU, as a pure
+    ordering function (runtime/health.py:failover_ladder)."""
+    allow = lambda i: i != 2                  # noqa: E731 — lane 2 down
+    order = health.failover_ladder(
+        0, 4, {1: 30, 2: 0, 3: 10}, allow=allow)
+    assert order == [3, 1]                    # healthy sibs, low backlog 1st
+    assert health.failover_ladder(1, 4, {}, allow=allow) == [0, 3]
+    # Every sibling down: empty ladder = go straight to CPU.
+    assert health.failover_ladder(0, 3, {}, allow=lambda i: False) == []
+
+
+# -------------------------------------------- per-lane chaos selectors
+def test_chaos_lane_tagged_events_hit_only_their_lane():
+    """PR-13 satellite: '%LANE' events fire on the tagged lane's OWN
+    call counter, so one lane's fault schedule is deterministic however
+    its siblings interleave; untagged events keep the plan-global
+    index over every wrapped callable."""
+    plan = chaos.ChaosPlan("error@1-%1")
+    lane0 = plan.wrap(lambda: "a", lane=0)
+    lane1 = plan.wrap(lambda: "b", lane=1)
+    assert lane1() == "b"          # lane-1 call 0: clean
+    assert lane0() == "a"          # lane 0 untouched however often
+    assert lane0() == "a"
+    with pytest.raises(chaos.InjectedFault):
+        lane1()                    # lane-1 call 1: the persistent fault
+    assert lane0() == "a"          # siblings STAY clean
+    with pytest.raises(chaos.InjectedFault):
+        lane1()
+    assert plan.faults_injected == 2
+
+
+def test_chaos_untagged_events_hit_lane_calls_on_global_index():
+    plan = chaos.ChaosPlan("error@2")      # global call index 2
+    lane0 = plan.wrap(lambda: 0, lane=0)
+    unlaned = plan.wrap(lambda: 1)
+    assert lane0() == 0                    # global 0
+    assert unlaned() == 1                  # global 1
+    with pytest.raises(chaos.InjectedFault):
+        lane0()                            # global 2 — lane or not
+    assert unlaned() == 1
+
+
+def test_chaos_lane_tag_specs_validated():
+    for bad in ("error@0-%", "error@%1", "error@0%x", "error@0%-1"):
+        with pytest.raises(ValueError):
+            chaos.parse_plan(bad)
+    ev = chaos.parse_plan("wrong:0.5@3%2")._events[0]
+    assert (ev.kind, ev.start, ev.stop, ev.param, ev.lane) == (
+        "wrong", 3, 3, 0.5, 2)
+    assert "%2" in repr(ev)
+    # schedule() resets per-lane counters along with the global index.
+    plan = chaos.ChaosPlan("error@0%1")
+    laned = plan.wrap(lambda: "x", lane=1)
+    with pytest.raises(chaos.InjectedFault):
+        laned()
+    plan.schedule("error@0%1")
+    with pytest.raises(chaos.InjectedFault):
+        laned()                    # lane counter restarted at 0
+
+
 # ------------------------------------------------ the engine chaos matrix
 def _policy(plan=None, breaker=None, **kw):
     kw.setdefault("deadline_s", None)
