@@ -591,7 +591,8 @@ def run_benchmarks(args, device_str: str) -> dict:
                                               "config13_metrics",
                                               "config14_posed_kernel",
                                               "config15_streams",
-                                              "config16_lanes"):
+                                              "config16_lanes",
+                                              "config17_precision"):
             return
         try:
             fn()
@@ -2348,6 +2349,52 @@ def run_benchmarks(args, device_str: str) -> dict:
     if args.lane_lanes > 0:
         section("config16_lanes", config16_lanes)
 
+    # -- config 17: precision-tiered serving (PR 14) ------------------------
+    # THE shared protocol (serving/measure.py:precision_bench_run): the
+    # same mixed-subject tier-0 stream through two live engines — one
+    # under a PrecisionPolicy (tier 0 -> the bf16-compute/f32-accumulate
+    # gathered family), one the f32 control — slope-timed (the config14
+    # protocol). Criteria (scripts/bench_report.py:judge_precision):
+    # bf16 max vertex error within the policy's stated envelope through
+    # the LIVE engine (mixed coalesced batches included), f32 control
+    # bit-identical (0.0), zero steady recompiles on BOTH precision
+    # families, the sentinel drill detecting an injected bf16 drift and
+    # recovering (every future resolved, spans closed once), and the
+    # speedup ratio recorded — judged >= 1.2x on a real TPU only (the
+    # config14 convention: off-chip the bf16 MXU passes are emulated,
+    # so the CPU-lane ratio measures emulation overhead; the chip leg
+    # is queued via scripts/bench_tpu_wait.sh).
+    def config17_precision():
+        from mano_hand_tpu.serving.measure import precision_bench_run
+
+        pr = precision_bench_run(
+            right,
+            subjects=args.precision_subjects,
+            requests=args.precision_requests,
+            max_rows=args.precision_max_rows,
+            max_bucket=args.precision_max_bucket,
+            posed_kernel=args.precision_posed_kernel,
+            interpret=True if args.pallas_interpret else None,
+            trace_dir=args.profile or None,
+            seed=43,
+            log=lambda m: log(f"config17 {m}"),
+        )
+        results["precision"] = pr
+        drl = pr.get("sentinel_drill") or {}
+        log(f"config17 precision: bf16 {pr['bf16_evals_per_sec']:,.0f} "
+            f"vs f32 {pr['f32_evals_per_sec']:,.0f} evals/s (slope "
+            f"ratio {pr['bf16_vs_f32_ratio']}x, platform "
+            f"{pr['platform']}), bf16 err {pr['bf16_max_abs_err']:.2e} "
+            f"vs envelope {pr['bf16_err_envelope']:.1e}, f32 control "
+            f"{pr['f32_control_max_abs_err']:.2e}, steady recompiles "
+            f"{pr['steady_recompiles_bf16']}/"
+            f"{pr['steady_recompiles_f32']}, sentinel bf16 detected="
+            f"{drl.get('bf16_family_detected')} recovered="
+            f"{drl.get('recovered')}")
+
+    if args.precision_requests > 0:
+        section("config17_precision", config17_precision)
+
     if args.serving_only:
         # Fast serving-layer artifact (`make serve-smoke`): the deferred
         # runner's serving-only skip reduces the schedule to config7
@@ -2710,6 +2757,32 @@ def main() -> int:
                     help="largest power-of-two bucket of the config16 "
                          "engine (each of N lanes warms every bucket — "
                          "keep the product small)")
+    ap.add_argument("--precision-requests", type=int, default=96,
+                    help="mixed-subject tier-0 request stream of the "
+                         "config17 precision-tier leg (PR 14: bf16 "
+                         "policy engine vs f32 control, slope-timed; "
+                         "0 skips the leg)")
+    ap.add_argument("--precision-subjects", type=int, default=8,
+                    help="distinct baked subjects in the config17 "
+                         "stream (mixed coalesced batches on both "
+                         "engines)")
+    ap.add_argument("--precision-max-rows", type=int, default=4,
+                    help="config17 request sizes are uniform in "
+                         "[1, max-rows]")
+    ap.add_argument("--precision-max-bucket", type=int, default=32,
+                    help="largest power-of-two bucket of the config17 "
+                         "engines")
+    ap.add_argument("--precision-posed-kernel", default="xla",
+                    choices=("xla", "fused"),
+                    help="gathered-kernel tier of BOTH config17 "
+                         "engines. Default xla — the family whose "
+                         "explicit bf16 casts make the CPU-lane "
+                         "envelope criterion real (the fused kernel's "
+                         "single-pass bf16 form is invisible to the "
+                         "interpreter — the documented dead-end). "
+                         "bench-interpret sweeps the fused form for "
+                         "plumbing coverage (drill + parity judge "
+                         "branch must not debut on the chip)")
     ap.add_argument("--spec-batch", type=int, default=256,
                     help="batch for the specialization leg's full-vs-"
                          "pose-only forward comparison (config8); "

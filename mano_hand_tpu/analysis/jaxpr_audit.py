@@ -23,12 +23,16 @@ resulting jaxpr is audited for
   review instead of on the chip. Intentional changes:
   ``mano analyze --update-baseline``.
 
-Program families (ISSUE 7, extended by PR 10 and PR 12): full forward,
-posed (pose-only fast path), gathered (PR-4 coalescing), fused
-one-/two-hand single-launch kernels, the FUSED gathered pose-only
-serving kernel (PR 10), the CPU-failover tier, and the stream-session
-per-frame solve (PR 12 — the frozen-shape LM tracker step every
-``open_stream`` session shares).
+Program families (ISSUE 7, extended by PR 10, PR 12, and PR 14): full
+forward, posed (pose-only fast path), gathered (PR-4 coalescing),
+fused one-/two-hand single-launch kernels, the FUSED gathered
+pose-only serving kernel (PR 10), the bf16-TIER gathered families
+(PR 14 — XLA and fused forms, with a dtype-policy assertion: bf16
+contraction operands must accumulate into f32 and the program's
+outputs stay f32; f64/complex remain banned everywhere), the
+CPU-failover tier, and the stream-session per-frame solve (PR 12 —
+the frozen-shape LM tracker step every ``open_stream`` session
+shares).
 """
 
 from __future__ import annotations
@@ -55,6 +59,9 @@ class ProgramSpec(NamedTuple):
     donate_argnums: Tuple[int, ...]   # as built for device serving
     expect_donated: Tuple[int, ...]   # flat arg indices that MUST donate
     lowerable: bool = True  # False: Pallas TPU program — jaxpr only
+    bf16: bool = False      # True: a PR-14 bf16-tier family — the
+    #   dtype-policy assertion applies (every bf16-operand dot must
+    #   accumulate f32; the program's outputs stay f32)
 
 
 def build_program_specs() -> List[ProgramSpec]:
@@ -129,6 +136,31 @@ def build_program_specs() -> List[ProgramSpec]:
                 tab, ix, p),
             (table, idx, pose), donate_argnums=(),
             expect_donated=(), lowerable=False),
+        # serving/engine.py:build_posed_gather_bf16_executable — the
+        # PR-14 bf16-TIER gathered family (XLA form): bf16 contraction
+        # operands with f32 accumulation on the pose-stage matmuls,
+        # f32 everywhere else. Donation contract identical to the XLA
+        # gathered twin (pose only; the table is read by in-flight
+        # snapshots). bf16=True arms the dtype-policy assertion.
+        ProgramSpec(
+            "gathered_bf16", "gathered",
+            lambda tab, ix, p: core.forward_posed_gather(
+                tab, ix, p, compute_dtype=jax.numpy.bfloat16).verts,
+            (table, idx, pose), donate_argnums=(2,),
+            expect_donated=(2,), bf16=True),
+        # serving/engine.py:build_posed_gather_bf16_executable(fused=
+        # True) — the fused kernel's single-pass bf16 MXU form. Jaxpr-
+        # audited only, like its fused siblings; the MXU pass count is
+        # a Mosaic lowering property invisible off-chip, so the
+        # auditable contract here is the f64/complex ban, the callback
+        # ban, and the committed primitive counts (the pass-count
+        # delta vs gathered_fused shows up there).
+        ProgramSpec(
+            "gathered_fused_bf16", "fused",
+            lambda tab, ix, p: pallas_posed.forward_posed_gather_fused(
+                tab, ix, p, compute_dtype=jax.numpy.bfloat16),
+            (table, idx, pose), donate_argnums=(),
+            expect_donated=(), lowerable=False, bf16=True),
         # serving/engine.py:build_cpu_fallback_executable — never
         # donated (CPU donation is unimplemented; the clean tier).
         ProgramSpec(
@@ -160,15 +192,19 @@ def _lm():
     return lm_mod
 
 
-def _walk_jaxpr(jaxpr) -> Tuple[Dict[str, int], List, List[str]]:
-    """Flattened (primitive histogram, all avals, callback prims) of a
-    jaxpr including every nested sub-jaxpr (pjit bodies, scans, conds,
-    pallas kernels)."""
+def _walk_jaxpr(jaxpr) -> Tuple[Dict[str, int], List, List[str], List]:
+    """Flattened (primitive histogram, all avals, callback prims,
+    dot-equation dtypes) of a jaxpr including every nested sub-jaxpr
+    (pjit bodies, scans, conds, pallas kernels). ``dots`` records each
+    ``dot_general``'s (input dtypes, output dtypes) — the raw material
+    of the PR-14 dtype-policy assertion (bf16 operands must accumulate
+    into f32, visible as bf16-in/f32-out dots)."""
     from jax.extend import core as jex_core  # jaxpr types
 
     counts: Dict[str, int] = {}
     avals: List = []
     callbacks: List[str] = []
+    dots: List = []
 
     def visit(jx) -> None:
         closed = getattr(jx, "jaxpr", None)
@@ -183,6 +219,15 @@ def _walk_jaxpr(jaxpr) -> Tuple[Dict[str, int], List, List[str]]:
             counts[name] = counts.get(name, 0) + 1
             if any(m in name for m in _CALLBACK_MARKERS):
                 callbacks.append(name)
+            if name == "dot_general":
+                dots.append((
+                    tuple(str(getattr(v.aval, "dtype", ""))
+                          for v in eqn.invars
+                          if getattr(v, "aval", None) is not None),
+                    tuple(str(getattr(v.aval, "dtype", ""))
+                          for v in eqn.outvars
+                          if getattr(v, "aval", None) is not None),
+                ))
             for v in (*eqn.invars, *eqn.outvars):
                 aval = getattr(v, "aval", None)
                 if aval is not None:
@@ -193,7 +238,7 @@ def _walk_jaxpr(jaxpr) -> Tuple[Dict[str, int], List, List[str]]:
                         visit(sub)
 
     visit(jaxpr)
-    return counts, avals, callbacks
+    return counts, avals, callbacks, dots
 
 
 def _donated_flags(fn: Callable, args: Tuple,
@@ -244,7 +289,7 @@ def audit_programs(
 
     for spec in specs:
         jaxpr = jax.make_jaxpr(spec.fn)(*spec.args)
-        counts, avals, callbacks = _walk_jaxpr(jaxpr)
+        counts, avals, callbacks, dots = _walk_jaxpr(jaxpr)
         measured["programs"][spec.name] = {
             "primitives": dict(sorted(counts.items()))}
 
@@ -265,6 +310,53 @@ def audit_programs(
                 f"{sorted(set(callbacks))} — a per-batch host sync on "
                 "the dispatch path (and a hang when the tunnel drops "
                 "mid-call)"))
+
+        if spec.bf16:
+            # The PR-14 dtype-policy assertion: a bf16-tier family's
+            # reduced-precision contractions must ACCUMULATE into f32
+            # (serving/precision.py states accumulate="f32"; a
+            # bf16-in/bf16-out dot is the single-pass-accumulation
+            # silent-collapse class the sentinel exists to catch), and
+            # the program must hand f32 vertices back to the engine.
+            bad_dots = [
+                (ins, outs) for ins, outs in dots
+                if any(d == "bfloat16" for d in ins)
+                and any(d == "bfloat16" for d in outs)
+            ]
+            if bad_dots:
+                findings.append(Finding(
+                    "jaxpr-dtype-policy", here, 0,
+                    f"program {spec.name!r}: {len(bad_dots)} "
+                    f"bf16-operand dot(s) accumulate in bf16 "
+                    f"({bad_dots[:3]}) — the committed policy is bf16 "
+                    "compute with f32 accumulation "
+                    "(preferred_element_type; serving/precision.py)"))
+            if spec.lowerable and not any(
+                    any(d == "bfloat16" for d in ins)
+                    and all(o == "float32" for o in outs)
+                    for ins, outs in dots):
+                # The XLA bf16 family must actually CONTAIN the
+                # bf16-in/f32-out dots it claims (a refactor that
+                # silently drops the casts would leave an "f32 program
+                # labelled bf16" — and a phantom speed lever). The
+                # fused family's passes live inside Mosaic, invisible
+                # here — hence lowerable-gated.
+                findings.append(Finding(
+                    "jaxpr-dtype-policy", here, 0,
+                    f"program {spec.name!r} is flagged bf16 but "
+                    "carries no bf16-operand/f32-output dot_general — "
+                    "the compute_dtype parameterization is not "
+                    "reaching the contractions"))
+            out_dtypes = sorted({
+                str(getattr(v.aval, "dtype", ""))
+                for v in jaxpr.jaxpr.outvars
+                if getattr(v, "aval", None) is not None})
+            if any(d not in ("float32", "int32") for d in out_dtypes):
+                findings.append(Finding(
+                    "jaxpr-dtype-policy", here, 0,
+                    f"program {spec.name!r} outputs {out_dtypes} — the "
+                    "serving engine delivers f32 vertices on every "
+                    "tier (callers never see bf16 arrays)"))
 
         if spec.lowerable:
             flags = _donated_flags(spec.fn, spec.args, spec.donate_argnums)

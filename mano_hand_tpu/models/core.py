@@ -110,10 +110,24 @@ def specialize(
     )
 
 
+def _check_compute_dtype(compute_dtype) -> None:
+    """bfloat16 or None, nothing else — the stated PR-14 policy. The
+    fused kernel already enforces this (ops/pallas_posed.py); the XLA
+    entries must too, or e.g. float16/float64 compute would serve
+    under bf16-documented claims with no stated envelope (and outside
+    the jaxpr audit, which traces only the committed specs)."""
+    if compute_dtype is not None and \
+            jnp.dtype(compute_dtype) != jnp.bfloat16:
+        raise ValueError(
+            f"compute_dtype must be bfloat16 (the serving bf16 tier) "
+            f"or None, got {compute_dtype}")
+
+
 def forward_posed(
     shaped: ShapedHand,
     pose: Optional[jnp.ndarray] = None,   # [J, 3] axis-angle, row 0 global
     precision=DEFAULT_PRECISION,
+    compute_dtype=None,
 ) -> ManoOutput:
     """Pose-only forward over a baked shape stage.
 
@@ -124,27 +138,41 @@ def forward_posed(
     batching structure, while skipping the per-call shape blend and
     joint regression entirely. Batch with ``jax.vmap`` over ``pose``
     (one subject, many poses) — the steady-state serving shape.
+
+    ``compute_dtype`` (PR 14, the serving bf16 tier): when set (bf16),
+    the MXU-bound contractions of the pose stage — pose-corrective
+    blend and LBS skinning — run with operands cast to that dtype and
+    f32 accumulation, while Rodrigues, FK, and every residual add stay
+    f32 and the returned vertices are f32 (~4e-4 m max vertex error vs
+    the f32 path, measured on this stack; the PrecisionPolicy envelope
+    in serving/precision.py states the budget).
     """
     n_joints = shaped.joints.shape[0]
     dtype = shaped.v_shaped.dtype
     if pose is None:
         pose = jnp.zeros((n_joints, 3), dtype=dtype)
     pose = pose.reshape(n_joints, 3).astype(dtype)
-    return forward_posed_rotmats(shaped, ops.rotation_matrix(pose), precision)
+    return forward_posed_rotmats(shaped, ops.rotation_matrix(pose),
+                                 precision, compute_dtype)
 
 
 def forward_posed_rotmats(
     shaped: ShapedHand,
     rot_mats: jnp.ndarray,   # [J, 3, 3] per-joint rotations, row 0 global
     precision=DEFAULT_PRECISION,
+    compute_dtype=None,
 ) -> ManoOutput:
     """Pose-only forward from rotation MATRICES (``forward_posed`` minus
-    Rodrigues — same input contract as ``forward_rotmats``)."""
+    Rodrigues — same input contract as ``forward_rotmats``).
+    ``compute_dtype`` as in ``forward_posed``: bf16 contraction
+    operands with f32 accumulation on the two MXU-bound stages only."""
+    _check_compute_dtype(compute_dtype)
     n_joints = shaped.joints.shape[0]
     dtype = shaped.v_shaped.dtype
     rot_mats = rot_mats.reshape(n_joints, 3, 3).astype(dtype)
     v_posed = ops.pose_blend(
-        shaped.v_shaped, shaped.pose_basis, rot_mats, precision
+        shaped.v_shaped, shaped.pose_basis, rot_mats, precision,
+        compute_dtype=compute_dtype,
     )
     world_rot, world_t = ops.forward_kinematics(
         shaped.parents, rot_mats, shaped.joints, precision
@@ -152,7 +180,8 @@ def forward_posed_rotmats(
     skin_rot, skin_t = ops.skinning_transforms(
         world_rot, world_t, shaped.joints, precision
     )
-    verts = ops.skin(shaped.lbs_weights, skin_rot, skin_t, v_posed, precision)
+    verts = ops.skin(shaped.lbs_weights, skin_rot, skin_t, v_posed,
+                     precision, compute_dtype=compute_dtype)
     return ManoOutput(
         verts=verts,
         joints=shaped.joints,
@@ -329,6 +358,7 @@ def forward_posed_gather(
     subject_idx: jnp.ndarray,  # [B] int32 row indices into the table
     pose: jnp.ndarray,         # [B, J, 3]
     precision=DEFAULT_PRECISION,
+    compute_dtype=None,
 ) -> ManoOutput:
     """Mixed-subject pose-only forward: row ``r`` runs the pose stage
     over subject ``subject_idx[r]``'s baked shape constants, gathered
@@ -346,7 +376,15 @@ def forward_posed_gather(
     program), the gathered per-row constants enter only elementwise ops
     and per-row-batched contractions, and vmapped rows are computed
     independently, so a row's bits depend only on its own inputs.
+
+    ``compute_dtype`` (PR 14): the serving bf16 tier — per-row pose
+    stages run with bf16 contraction operands and f32 accumulation
+    (see ``forward_posed``); the gather itself stays f32 data movement
+    and the returned vertices are f32. NOT bit-identical to the f32
+    family (~4e-4 m measured); judged against the PrecisionPolicy
+    envelope by the numerics sentinel, never by f32-digest equality.
     """
+    _check_compute_dtype(compute_dtype)
     n_joints = table.joints.shape[-2]
     dtype = table.v_shaped.dtype
     pose = pose.reshape(pose.shape[0], n_joints, 3).astype(dtype)
@@ -364,7 +402,7 @@ def forward_posed_gather(
             lbs_weights=table.lbs_weights,   # closed over: stays unbatched
             parents=table.parents,
         )
-        return forward_posed(sh, q, precision)
+        return forward_posed(sh, q, precision, compute_dtype)
 
     return jax.vmap(row)(v_rows, j_rows, s_rows, pose)
 
@@ -376,6 +414,7 @@ def forward_posed_gather_fused(
     precision=DEFAULT_PRECISION,
     block_b: Optional[int] = None,
     interpret: bool = False,
+    compute_dtype=None,
 ) -> jnp.ndarray:
     """Mixed-subject pose-only forward in ONE Pallas launch; verts only.
 
@@ -389,6 +428,11 @@ def forward_posed_gather_fused(
     the serving engine selects this tier with
     ``ServingEngine(posed_kernel="fused")``. Inference only (no VJP —
     solvers stay on XLA, the measured dead-end).
+
+    ``compute_dtype`` (PR 14): the serving bf16 tier — bf16 selects
+    the kernel's single-pass bf16 MXU form with f32 accumulation for
+    the pose blend and skinning dots (the one-hot gather stays the
+    exact 3-pass reconstruction; ops/pallas_posed.py).
     """
     from mano_hand_tpu.ops import pallas_posed
 
@@ -400,6 +444,7 @@ def forward_posed_gather_fused(
     return pallas_posed.forward_posed_gather_fused(
         table, subject_idx, pose, precision,
         block_b=min(bb, pose.shape[0]), interpret=interpret,
+        compute_dtype=compute_dtype,
     )
 
 

@@ -462,6 +462,24 @@ def load_samples(load: dict) -> dict:
         out["load_streams_closed_by_kind"] = metric(
             "counter", help="stream-session terminals by kind",
             samples=closed)
+    # Precision tiers (PR 14): which tier serves which precision
+    # family (0=f32, 1=bf16 per tier label) and the policy's stated
+    # vertex-error envelope — the scrape-side record an operator (or
+    # an alert) reads beside the sentinel's bf16 drift gauges.
+    prec = load.get("precision") or {}
+    tiers = [
+        sample(1.0 if dtype == "bf16" else 0.0, {"tier": t})
+        for t, dtype in sorted((prec.get("tiers") or {}).items())
+    ]
+    if tiers:
+        out["load_precision_tier_bf16"] = metric(
+            "gauge", help="per-tier precision family "
+                          "(1=bf16 pose path, 0=f32)",
+            samples=tiers)
+    if prec.get("envelope_m") is not None:
+        out["load_precision_envelope_m"] = metric(
+            "gauge", prec["envelope_m"],
+            help="stated bf16-tier max vertex error envelope (m)")
     # Dispatch lanes (PR 13): fleet-level gauges plus the per-lane
     # backlog/state/ladder counters, labelled by lane index.
     lanes = load.get("lanes") or {}

@@ -77,6 +77,17 @@ def default_goldens_path() -> Path:
     return Path(__file__).resolve().parent / "goldens.json"
 
 
+def default_bf16_goldens_path() -> Path:
+    """The committed bf16-tier goldens (PR 14), beside goldens.json.
+
+    A SEPARATE file with a different comparator: the bf16 family is a
+    reduced-precision program, so its committed record is a digest of
+    its own deterministic output PLUS its measured error against the
+    f32 truth — judged against the PrecisionPolicy ENVELOPE, never by
+    f32-digest equality (which a bf16 program can never satisfy)."""
+    return Path(__file__).resolve().parent / "goldens_bf16.json"
+
+
 def golden_inputs(n_joints: int, n_shape: int, rows: int = GOLDEN_ROWS,
                   seed: int = GOLDEN_SEED):
     """THE committed golden input: deterministic (fixed seed) pose and
@@ -120,11 +131,53 @@ def reference_digests(params, rows: int = GOLDEN_ROWS,
     return {"full": f32_digest(full), "cpu": f32_digest(cpu)}
 
 
-def commit_goldens(params, path=None, rows: int = GOLDEN_ROWS,
-                   seed: int = GOLDEN_SEED) -> dict:
-    """Write the committed-goldens file for ``params`` on the current
-    backend (merging with existing entries — one file carries every
-    (params_digest, backend) pair ever committed)."""
+def _golden_table(params, rows: int = GOLDEN_ROWS, seed: int = GOLDEN_SEED):
+    """A deterministic SubjectTable of the golden subjects: row ``i``
+    bakes golden shape row ``i`` — the committed fixed table the bf16
+    gathered references run over (identical bytes every process)."""
+    from mano_hand_tpu.models import core
+
+    _, shape = golden_inputs(params.n_joints, params.n_shape,
+                             rows=rows, seed=seed)
+    prm = params.astype(np.float32).device_put()
+    shaped = [core.jit_specialize(prm, shape[i]) for i in range(rows)]
+    return core.stack_shaped(shaped)
+
+
+def reference_digests_bf16(params, rows: int = GOLDEN_ROWS,
+                           seed: int = GOLDEN_SEED) -> dict:
+    """Clean bf16-tier golden record on the CURRENT backend at the
+    committed fixed shape: the bf16 gathered family's output digest
+    plus its measured max abs error vs the f32 gathered truth — what
+    ``commit_goldens_bf16`` persists and ``arm()`` re-derives."""
+    import jax
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+
+    pose, _ = golden_inputs(params.n_joints, params.n_shape,
+                            rows=rows, seed=seed)
+    table = _golden_table(params, rows=rows, seed=seed)
+    idx = np.arange(rows, dtype=np.int32)
+    bf = np.asarray(jax.jit(
+        lambda t, i, p: core.forward_posed_gather(
+            t, i, p, compute_dtype=jnp.bfloat16).verts)(table, idx, pose))
+    f32 = np.asarray(jax.jit(
+        lambda t, i, p: core.forward_posed_gather(t, i, p).verts)(
+            table, idx, pose))
+    return {"gather_bf16": {
+        "digest": f32_digest(bf),
+        "max_abs_err_vs_f32": float(np.abs(
+            bf.astype(np.float32) - f32.astype(np.float32)).max()),
+    }}
+
+
+def _commit_golden_file(params, path, derive, rows: int,
+                        seed: int) -> dict:
+    """Shared body of ``commit_goldens``/``commit_goldens_bf16``:
+    merge-with-existing (one file carries every (params_digest,
+    backend) pair ever committed; a damaged or schema/shape-mismatched
+    file is rewritten whole), derive the entry, write sorted JSON."""
     import jax
 
     from mano_hand_tpu.io.export_aot import params_digest
@@ -133,7 +186,6 @@ def commit_goldens(params, path=None, rows: int = GOLDEN_ROWS,
     # (engine __init__ casts to its dtype), so ``arm()``'s lookup key
     # matches regardless of the asset file's storage dtype.
     params = params.astype(np.float32)
-    path = Path(path) if path is not None else default_goldens_path()
     data = {"schema": GOLDENS_SCHEMA, "rows": rows, "seed": seed,
             "entries": {}}
     if path.exists():
@@ -146,10 +198,28 @@ def commit_goldens(params, path=None, rows: int = GOLDEN_ROWS,
         except (OSError, ValueError):
             pass   # damaged file: rewrite whole
     key = f"{params_digest(params)}:{jax.default_backend()}"
-    data["entries"][key] = reference_digests(params, rows=rows,
-                                             seed=seed)
+    data["entries"][key] = derive(params, rows=rows, seed=seed)
     path.write_text(json.dumps(data, indent=2, sort_keys=True) + "\n")
     return data
+
+
+def commit_goldens_bf16(params, path=None, rows: int = GOLDEN_ROWS,
+                        seed: int = GOLDEN_SEED) -> dict:
+    """Write the committed bf16-tier goldens for ``params`` on the
+    current backend (same merge/keying rules as ``commit_goldens``)."""
+    path = Path(path) if path is not None else default_bf16_goldens_path()
+    return _commit_golden_file(params, path, reference_digests_bf16,
+                               rows, seed)
+
+
+def commit_goldens(params, path=None, rows: int = GOLDEN_ROWS,
+                   seed: int = GOLDEN_SEED) -> dict:
+    """Write the committed-goldens file for ``params`` on the current
+    backend (merging with existing entries — one file carries every
+    (params_digest, backend) pair ever committed)."""
+    path = Path(path) if path is not None else default_goldens_path()
+    return _commit_golden_file(params, path, reference_digests,
+                               rows, seed)
 
 
 def load_goldens(path=None) -> Optional[dict]:
@@ -174,7 +244,8 @@ class NumericsSentinel:
     calls (the obs/ lock rule)."""
 
     def __init__(self, engine, tracer=None, interval_s: float = 60.0,
-                 goldens_path=None, clock=time.monotonic):
+                 goldens_path=None, bf16_goldens_path=None,
+                 clock=time.monotonic):
         if interval_s <= 0:
             raise ValueError(
                 f"interval_s must be > 0, got {interval_s}")
@@ -183,6 +254,7 @@ class NumericsSentinel:
                         else getattr(engine, "tracer", None))
         self.interval_s = float(interval_s)
         self._goldens_path = goldens_path
+        self._bf16_goldens_path = bf16_goldens_path
         self._clock = clock
         self._lock = threading.Lock()
         self._refs: Dict[str, object] = {}
@@ -192,6 +264,9 @@ class NumericsSentinel:
         self.drifts = 0
         self.probe_errors = 0
         self.golden_status = "unchecked"   # unchecked|match|mismatch|absent
+        # The bf16 tier's committed-golden anchor (PR 14); stays
+        # "unchecked" on a policy-less engine (nothing to anchor).
+        self.golden_bf16_status = "unchecked"
         self._last: Optional[dict] = None
         self._last_t: Optional[float] = None
         self._stop = threading.Event()
@@ -244,6 +319,57 @@ class NumericsSentinel:
             self._refs[key] = ref
         return ref
 
+    def _ref_gather_bf16(self, targets):
+        """The bf16 tier's same-trace reference (PR 14): the engine's
+        bf16 gather callables are either the XLA bf16-compute family
+        or the fused kernel's single-pass bf16 form — the reference
+        re-jits the SAME family (the same-trace rule), so its digest
+        pins the served path exactly while the ENVELOPE judgment runs
+        against the f32 reference."""
+        fused = bool(targets.get("gather_fused"))
+        key = "gather_bf16_fused" if fused else "gather_bf16"
+        ref = self._refs.get(key)
+        if ref is None:
+            import jax
+            import jax.numpy as jnp
+
+            from mano_hand_tpu.models import core
+
+            if fused:
+                interp = bool(targets.get("gather_fused_interpret"))
+                ref = jax.jit(
+                    lambda t, i, p: core.forward_posed_gather_fused(
+                        t, i, p, interpret=interp,
+                        compute_dtype=jnp.bfloat16))
+            else:
+                ref = jax.jit(
+                    lambda t, i, p: core.forward_posed_gather(
+                        t, i, p, compute_dtype=jnp.bfloat16).verts)
+            self._refs[key] = ref
+        return ref
+
+    def _ref_gather_truth(self):
+        """The f32 XLA gathered program — the TRUTH the bf16 tier's
+        envelope is measured against, independent of which kernel
+        family serves (fused or XLA, bf16 or f32). On a non-fused
+        engine ``_ref_gather`` already holds this exact program under
+        ``"gather"`` — alias it rather than compiling a twin (the
+        probe path should pay at most one reference compile per
+        family)."""
+        ref = self._refs.get("gather_truth")
+        if ref is None:
+            ref = self._refs.get("gather")
+            if ref is None:
+                import jax
+
+                from mano_hand_tpu.models import core
+
+                ref = jax.jit(
+                    lambda t, i, p: core.forward_posed_gather(
+                        t, i, p).verts)
+            self._refs["gather_truth"] = ref
+        return ref
+
     def _cpu_inputs(self, params_host):
         import jax
 
@@ -285,10 +411,57 @@ class NumericsSentinel:
             if self._tracer is not None:
                 self._tracer.incident("numerics_golden_mismatch",
                                       key=key)
+        out = {"golden_status": status, "key": key, "derived": got,
+               "committed": entry}
+        bf16_status = "unchecked"
+        envelope = t.get("precision_envelope")
+        if envelope is not None:
+            # The bf16-tier anchor (PR 14): the derived record must
+            # reproduce the committed DIGEST (environment determinism,
+            # same rule as the f32 goldens) AND its measured error vs
+            # the f32 truth must sit inside the policy's stated
+            # ENVELOPE — the comparator a reduced-precision family
+            # actually admits. Either failure is environment-level
+            # numerics drift, reported distinctly from a live
+            # serving-path drift.
+            got_bf16 = reference_digests_bf16(t["params"])
+            committed_bf16 = load_goldens(
+                self._bf16_goldens_path
+                if self._bf16_goldens_path is not None
+                else default_bf16_goldens_path())
+            entry_bf16 = (committed_bf16 or {}).get(
+                "entries", {}).get(key)
+            derived_err = got_bf16["gather_bf16"]["max_abs_err_vs_f32"]
+            if entry_bf16 is None:
+                bf16_status = "absent"
+            elif entry_bf16 == got_bf16:
+                # committed record == the full derived record — the
+                # {"gather_bf16": {...}} wrapper commit_goldens_bf16
+                # persists.
+                bf16_status = "match"
+            else:
+                bf16_status = "mismatch"
+            if derived_err > envelope:
+                bf16_status = "mismatch"
+            if bf16_status == "mismatch":
+                _LOG.warning(
+                    f"bf16-tier goldens for {key}: derived "
+                    f"{got_bf16['gather_bf16']} vs committed "
+                    f"{entry_bf16} at envelope {envelope} — "
+                    "environment bf16 numerics drifted; regenerate "
+                    "with `python -m mano_hand_tpu.obs.sentinel` if "
+                    "intentional")
+                if self._tracer is not None:
+                    self._tracer.incident("numerics_golden_mismatch",
+                                          key=f"{key}:bf16")
+            out.update({"golden_bf16_status": bf16_status,
+                        "derived_bf16": got_bf16["gather_bf16"],
+                        "committed_bf16": entry_bf16,
+                        "envelope_m": envelope})
         with self._lock:
             self.golden_status = status
-        return {"golden_status": status, "key": key, "derived": got,
-                "committed": entry}
+            self.golden_bf16_status = bf16_status
+        return out
 
     def _probe_family(self, exe, want_fn, *args) -> dict:
         served = np.asarray(exe(*args))
@@ -353,6 +526,40 @@ class NumericsSentinel:
                     **self._probe_family(
                         t["gather"][b],
                         self._ref_gather(t), t["table"], idx, pp))
+            if t.get("gather_bf16") and t["table"] is not None:
+                # The bf16 tier (PR 14): judged against the policy's
+                # ERROR ENVELOPE relative to the f32 XLA truth — a
+                # reduced-precision family can never satisfy f32-digest
+                # equality, so the envelope IS its drift criterion
+                # (the same-trace bf16 digest rides along as the exact
+                # comparator: a chaos/driver corruption flips both).
+                b = min(t["gather_bf16"])
+                idx = np.zeros((b,), np.int32)
+                pp = _pad_rows(pose, b)
+                served = np.asarray(t["gather_bf16"][b](
+                    t["table"], idx, pp))
+                same = np.asarray(self._ref_gather_bf16(t)(
+                    t["table"], idx, pp))
+                truth = np.asarray(self._ref_gather_truth()(
+                    t["table"], idx, pp))
+                env = t.get("precision_envelope")
+                err = float(np.abs(
+                    served.astype(np.float32)
+                    - truth.astype(np.float32)).max())
+                rec = {
+                    "bucket": b, "capacity": t["table"].capacity,
+                    "family": ("gather_fused_bf16"
+                               if t.get("gather_fused")
+                               else "gather_bf16"),
+                    "served_digest": f32_digest(served),
+                    "want_digest": f32_digest(same),
+                    "max_abs_err": err,
+                    "envelope": env,
+                }
+                rec["drift"] = bool(
+                    (env is not None and err > env)
+                    or rec["served_digest"] != rec["want_digest"])
+                families["gather_bf16"] = rec
             drifted = [f for f, rec in families.items()
                        if rec["drift"]]
             kind = "drift" if drifted else "probe"
@@ -441,6 +648,7 @@ class NumericsSentinel:
                 "drifts": self.drifts,
                 "probe_errors": self.probe_errors,
                 "golden_status": self.golden_status,
+                "golden_bf16_status": self.golden_bf16_status,
                 "armed": (self._thread is not None
                           and self._thread.is_alive()),
                 "last_probe_age_s": (None if self._last_t is None
@@ -465,6 +673,12 @@ class NumericsSentinel:
             "sentinel_golden_status": metric(
                 "gauge", golden_code,
                 help="-1 unchecked, 0 match, 1 absent, 2 mismatch"),
+            "sentinel_golden_bf16_status": metric(
+                "gauge",
+                {"unchecked": -1, "match": 0, "absent": 1,
+                 "mismatch": 2}.get(st["golden_bf16_status"], -1),
+                help="bf16-tier golden anchor: -1 unchecked, 0 match, "
+                     "1 absent, 2 mismatch (envelope-judged)"),
             "sentinel_armed": metric(
                 "gauge", 1.0 if st["armed"] else 0.0),
         }
@@ -501,6 +715,9 @@ def main(argv=None) -> int:
     data = commit_goldens(params)
     print(f"goldens committed to {default_goldens_path()}: "
           f"{sorted(data['entries'])}")
+    data16 = commit_goldens_bf16(params)
+    print(f"bf16 goldens committed to {default_bf16_goldens_path()}: "
+          f"{sorted(data16['entries'])}")
     return 0
 
 

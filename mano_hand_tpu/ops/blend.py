@@ -30,11 +30,28 @@ def pose_blend(
     pose_basis: jnp.ndarray,  # [V, 3, P]
     rot_mats: jnp.ndarray,    # [J, 3, 3] incl. root
     precision=DEFAULT_PRECISION,
+    compute_dtype=None,
 ) -> jnp.ndarray:
     """Pose-corrective offsets driven by (R - I) of the articulated joints;
-    the root/global rotation is excluded (mano_np.py:87-91)."""
+    the root/global rotation is excluded (mano_np.py:87-91).
+
+    ``compute_dtype`` (PR 14): the contraction's OPERANDS are cast to
+    this dtype (bf16 on the serving bf16 tier) with accumulation pinned
+    to f32 via ``preferred_element_type`` — the reduced-precision form
+    the PrecisionPolicy states, auditable in the jaxpr (bf16-in/f32-out
+    dots). The residual add stays in ``v_shaped``'s dtype. ``precision``
+    is ignored on this branch: XLA precision enums describe f32-operand
+    MXU decompositions, and the operands here are already bf16.
+    """
     eye = jnp.eye(3, dtype=rot_mats.dtype)
     pose_feat = (rot_mats[1:] - eye).reshape(-1)
+    if compute_dtype is not None:
+        return v_shaped + jnp.einsum(
+            "vcp,p->vc",
+            pose_basis.astype(compute_dtype),
+            pose_feat.astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        )
     return v_shaped + jnp.einsum(
         "vcp,p->vc", pose_basis, pose_feat, precision=precision
     )

@@ -24,9 +24,38 @@ def skin(
     skin_t: jnp.ndarray,     # [J, 3] skinning translations (inverse-bound)
     v_posed: jnp.ndarray,    # [V, 3] blendshaped rest-pose verts
     precision=DEFAULT_PRECISION,
+    compute_dtype=None,
 ) -> jnp.ndarray:
-    """Pose the mesh: [V, 3] skinned vertices."""
+    """Pose the mesh: [V, 3] skinned vertices.
+
+    ``compute_dtype`` (PR 14): the two weight contractions — the
+    MXU-bound work of this op — take operands cast to this dtype (bf16
+    on the serving bf16 tier) and accumulate into f32
+    (``preferred_element_type``); ``precision`` is ignored on THOSE
+    two dots (the enum describes f32-operand MXU decompositions, and
+    their operands are already bf16) but still governs the final
+    per-vertex 3x3 apply, whose operands are the f32 accumulations —
+    left at default it would itself lower to single-pass bf16 on TPU,
+    adding unbudgeted rounding outside the stated policy (review
+    finding).
+    """
     rot_flat = world_rot.reshape(world_rot.shape[0], 9)        # [J, 9]
+    if compute_dtype is not None:
+        w = weights.astype(compute_dtype)
+        blend_rot = jnp.einsum(
+            "vj,jr->vr", w, rot_flat.astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        ).reshape(-1, 3, 3)                                    # [V, 3, 3]
+        blend_t = jnp.einsum(
+            "vj,jc->vc", w, skin_t.astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return (
+            jnp.einsum("vab,vb->va", blend_rot,
+                       v_posed.astype(jnp.float32),
+                       precision=precision)
+            + blend_t
+        )
     blend_rot = jnp.einsum(
         "vj,jr->vr", weights, rot_flat, precision=precision
     ).reshape(-1, 3, 3)                                        # [V, 3, 3]

@@ -208,11 +208,19 @@ def _posed_gather_kernel(vp, levels, precision, split, *refs):
     iota = jax.lax.broadcasted_iota(jnp.int32, (tb, c), 1)
     onehot = (iota == idx).astype(jnp.float32)               # [TB, C]
     vs_rows = _gather_dot(onehot, tvs_hi, tvs_lo)            # [TB, 3*VP]
-    # Rest-joint slabs gather under the kernel's precision policy
-    # (kernel_dot's HIGH path is the same exact-for-one-hot 3-pass).
-    jx = kernel_dot(onehot, tjx, precision)                  # [TB, J]
-    jy = kernel_dot(onehot, tjy, precision)
-    jz = kernel_dot(onehot, tjz, precision)
+    # Rest-joint slabs gather at AT LEAST the exact-for-one-hot 3-pass
+    # HIGH form — a gather is data movement like the vertex planes
+    # above, never a contraction to run at reduced precision. Under
+    # the bf16 tier (precision None/DEFAULT, PR 14) a bare kernel_dot
+    # would lower to a single-pass bf16 dot and round the baked rest
+    # joints BEFORE forward kinematics, compounding along the chain —
+    # the committed policy keeps FK inputs f32 (review finding).
+    gp = precision
+    if gp is None or jax.lax.Precision(gp) == jax.lax.Precision.DEFAULT:
+        gp = jax.lax.Precision.HIGH
+    jx = kernel_dot(onehot, tjx, gp)                         # [TB, J]
+    jy = kernel_dot(onehot, tjy, gp)
+    jz = kernel_dot(onehot, tjz, gp)
 
     r_local = _rodrigues_slabs(x, y, z)
     world_r, skin_t = _fk_slabs(r_local, jx, jy, jz, levels)
@@ -258,6 +266,7 @@ def forward_posed_gather_fused(
     precision=DEFAULT_PRECISION,
     block_b: int = POSED_FUSED_BEST_BLOCK_B,
     interpret: bool = False,
+    compute_dtype=None,
 ) -> jnp.ndarray:
     """Mixed-subject pose-only vertices [B, V, 3] in ONE kernel launch.
 
@@ -271,7 +280,29 @@ def forward_posed_gather_fused(
     tests/test_pallas_posed.py and bench config14). Inference path
     only: no custom VJP (solvers stay on XLA — the measured fitting
     dead-end, docs/roadmap.md).
+
+    ``compute_dtype`` (PR 14, the serving bf16 tier): ``bfloat16``
+    maps the kernel onto its SINGLE-PASS bf16 MXU form — the pose
+    blend and skinning dots run one bf16 pass each with f32
+    accumulation (``kernel_dot``'s default branch), i.e. the hi/lo
+    split and its 2 extra MXU passes are skipped entirely; the one-hot
+    gather stays the exact 3-pass reconstruction (data movement, never
+    rounded). Outputs stay f32. NOTE the interpret lane cannot see MXU
+    rounding (``kernel_dot``'s documented limitation): off-chip this
+    tier measures within f32 noise of HIGH; the ~bf16-level error
+    (and the raw-speed win) appear on a real TPU only — exactly why
+    the serving bf16 tier is sentinel-guarded against its
+    PrecisionPolicy envelope rather than assumed.
     """
+    if compute_dtype is not None:
+        if jnp.dtype(compute_dtype) != jnp.bfloat16:
+            raise ValueError(
+                f"compute_dtype must be bfloat16 (the serving bf16 "
+                f"tier) or None, got {compute_dtype}")
+        # Single-pass bf16 MXU with f32 accumulation — the DEFAULT
+        # precision branch of kernel_dot; HIGH's 3-pass decomposition
+        # is precisely what the bf16 tier trades away.
+        precision = None
     f32 = jnp.float32
     v = table.n_verts
     j = table.n_joints

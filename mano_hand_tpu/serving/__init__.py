@@ -24,10 +24,12 @@ from mano_hand_tpu.serving.measure import (
     lane_drill_run,
     measure_overhead,
     overload_drill_run,
+    precision_bench_run,
     recovery_drill_run,
     serve_bench_run,
     stream_drill_run,
 )
+from mano_hand_tpu.serving.precision import PrecisionPolicy
 from mano_hand_tpu.serving.streams import (
     FrameResult,
     StreamManager,
@@ -46,6 +48,8 @@ __all__ = [
     "cold_start_drill_run",
     "lane_drill_run",
     "overload_drill_run",
+    "precision_bench_run",
+    "PrecisionPolicy",
     "recovery_drill_run",
     "measure_overhead",
     "serve_bench_run",

@@ -332,6 +332,46 @@ def build_posed_gather_fused_executable(table_dev, bucket: int,
     return jitted
 
 
+def build_posed_gather_bf16_executable(table_dev, bucket: int,
+                                       n_joints: int, dtype, donate: bool,
+                                       fused: bool = False,
+                                       interpret: bool = False):
+    """The per-bucket bf16-TIER gathered pose-only executable (PR 14).
+
+    Same calling convention and runtime-argument contract as
+    ``build_posed_gather_executable`` — table + int32 [B] index as
+    runtime ARGUMENTS, one compiled program per (bucket, capacity) for
+    every subject mixture, only the pose buffer donated — but the
+    program body is the bf16-compute/f32-accumulate pose stage
+    (``core.forward_posed_gather(compute_dtype=bf16)``, or the fused
+    kernel's single-pass bf16 MXU form when ``fused``). Inputs and
+    outputs stay f32 (callers never see bf16 arrays — the CPU-failover
+    rung and delivery slicing are dtype-oblivious by construction).
+    NOT bit-identical to the f32 family (~4e-4 m measured), which is
+    why this tier never loads from (or bakes into) the PR-6 AOT
+    lattice and is judged by the sentinel against its PrecisionPolicy
+    ENVELOPE, never by f32-digest equality. Eagerly warmed; the caller
+    counts the compile.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+
+    if fused:
+        fn = lambda tab, idx, p: core.forward_posed_gather_fused(  # noqa: E731
+            tab, idx, p, interpret=interpret,
+            compute_dtype=jnp.bfloat16)
+    else:
+        fn = lambda tab, idx, p: core.forward_posed_gather(  # noqa: E731
+            tab, idx, p, compute_dtype=jnp.bfloat16).verts
+    jitted = jax.jit(fn, donate_argnums=(2,) if donate else ())
+    jax.block_until_ready(jitted(
+        table_dev, np.zeros((bucket,), np.int32),
+        np.zeros((bucket, n_joints, 3), dtype)))
+    return jitted
+
+
 def build_cpu_fallback_executable(params_host, bucket: int, n_joints: int,
                                   n_shape: int, dtype):
     """The graceful-degradation executable: the SAME program family as
@@ -535,6 +575,22 @@ class ServingEngine:
         When the policy carries a ``CircuitBreaker`` without an
         ``on_transition`` hook, the engine wires breaker state changes
         onto the timeline too.
+    precision_policy: a ``serving.precision.PrecisionPolicy`` (PR 14).
+        None (default) = every tier f32, byte-for-byte the pre-PR-14
+        engine. With a policy, pose-only (subject) requests on the
+        named tiers serve a SECOND gathered program family — bf16
+        compute with f32 accumulation on the MXU-bound pose-stage
+        contractions (``core.forward_posed_gather(compute_dtype=bf16)``
+        or the fused kernel's single-pass bf16 form, per the same
+        ``posed_kernel``/capacity gate) — under the policy's stated
+        vertex-error envelope. Batches are single-precision (a
+        mixed-precision coalesce parks the odd request out, the "kind"
+        rule's sibling); full-path requests, fitting/batch tiers, the
+        CPU-failover rung, and the AOT lattice all stay f32; the bf16
+        family warms beside the f32 one (zero steady recompiles on
+        both) and is exported to the numerics sentinel, which judges
+        it against the ENVELOPE vs the f32 truth — never by f32-digest
+        equality.
     """
 
     def __init__(
@@ -559,6 +615,7 @@ class ServingEngine:
         posed_kernel_interpret: Optional[bool] = None,
         lanes: Optional[int] = None,
         lane_probe: Optional[Callable[[int], bool]] = None,
+        precision_policy=None,
     ):
         self._params = params.astype(dtype)
         self._dtype = np.dtype(dtype)
@@ -604,6 +661,15 @@ class ServingEngine:
                 f"posed_kernel must be 'xla' or 'fused', got "
                 f"{posed_kernel!r}")
         self._posed_kernel = posed_kernel
+        if precision_policy is not None:
+            from mano_hand_tpu.serving.precision import PrecisionPolicy
+
+            if not isinstance(precision_policy, PrecisionPolicy):
+                raise TypeError(
+                    f"precision_policy must be a "
+                    f"serving.precision.PrecisionPolicy, got "
+                    f"{type(precision_policy).__name__}")
+        self._precision_policy = precision_policy
         # None = resolve lazily at first build (a jax backend query —
         # the engine's constructor touches no backend by design).
         self._posed_interpret = posed_kernel_interpret
@@ -655,6 +721,11 @@ class ServingEngine:
         self._gather_exes: dict = {}   # bucket -> (capacity, executable)
         #   (subject-agnostic AND mix-agnostic: table + index are
         #   runtime args; invalidated only by a capacity growth)
+        self._gather_exes_bf16: dict = {}  # bucket -> (capacity, exe)
+        #   The bf16-TIER gathered family (PR 14): same keying and
+        #   invalidation rules as _gather_exes, populated only under a
+        #   precision_policy with bf16 tiers. Never lattice-served
+        #   (the lattice contract is f32 bit-identity).
         self._cpu_exes: dict = {}      # bucket -> CPU fallback executable
         self._exe_lock = threading.Lock()
         # Serializes _install_subject's bake-and-swap so table mutation
@@ -708,6 +779,28 @@ class ServingEngine:
         whether the fused tier actually serves also depends on the
         live table capacity — see ``_posed_fused_active``."""
         return self._posed_kernel
+
+    @property
+    def precision_policy(self):
+        """The engine's ``serving.precision.PrecisionPolicy`` (or
+        None = every tier f32, the pre-PR-14 engine exactly)."""
+        return self._precision_policy
+
+    def _req_prec(self, req: "_Request") -> str:
+        """The precision family ONE request's dispatch serves from:
+        ``"bf16"`` only for a pose-only (subject) request whose tier
+        the policy names — full-path requests, and every request on a
+        policy-less engine, are f32 (the bf16 family exists only
+        where the shape stage is pre-baked; serving/precision.py)."""
+        if self._precision_policy is None or req.subject is None:
+            return "f32"
+        return self._precision_policy.dtype_for_tier(req.tier)
+
+    def _bf16_serving(self) -> bool:
+        """Whether any tier serves the bf16 gathered family — the
+        warm-up / probe-export predicate."""
+        return (self._precision_policy is not None
+                and bool(self._precision_policy.bf16_tiers))
 
     def _resolve_posed_interpret(self) -> bool:
         """The fused tier's interpret flag, resolved once (a jax
@@ -763,6 +856,21 @@ class ServingEngine:
                 # family this round (the sentinel's live-families rule).
                 "gather": {b: exe for b, (c, exe)
                            in self._gather_exes.items() if c == cap},
+                # The bf16 tier (PR 14): same capacity-consistency rule
+                # as "gather". Judged by the sentinel against the
+                # policy's ENVELOPE vs the f32 truth, never by
+                # f32-digest equality (a reduced-precision family can
+                # never match an f32 digest).
+                "gather_bf16": {b: exe for b, (c, exe)
+                                in self._gather_exes_bf16.items()
+                                if c == cap},
+                # Exported only when some tier actually serves bf16
+                # (a policy with empty bf16_tiers builds no bf16
+                # family — the sentinel must not derive/judge bf16
+                # goldens for a program that can never serve).
+                "precision_envelope": (
+                    self._precision_policy.max_vertex_err_m
+                    if self._bf16_serving() else None),
                 "cpu": dict(self._cpu_exes),
                 "table": self._table,
                 "params": self._params,
@@ -1042,6 +1150,9 @@ class ServingEngine:
                 self._subject_lru[key] = None
                 stale = ([b for b, (c, _) in self._gather_exes.items()
                           if c != cap] if grew else [])
+                stale_bf16 = ([b for b, (c, _)
+                               in self._gather_exes_bf16.items()
+                               if c != cap] if grew else [])
             if self._laneset is not None:
                 # Replicate the freshly installed row into every lane's
                 # table replica (PR 13): one functional row write per
@@ -1059,6 +1170,11 @@ class ServingEngine:
             self.counters.count_specialize(hit=False)
         for b in stale:
             self._gather_executable(b)
+        for b in stale_bf16:
+            # The bf16 family's growth rebuild (PR 14): eager for the
+            # same reason — a growth compile must never land inside a
+            # latency-sensitive bf16 tier-0 dispatch.
+            self._gather_executable(b, prec="bf16")
         return slot
 
     def _resolve_batch(self, reqs):
@@ -1114,6 +1230,19 @@ class ServingEngine:
             before = self.counters.aot_loads
             self._gather_executable(b)
             out[b] = "aot" if self.counters.aot_loads > before else "jit"
+        if self._bf16_serving():
+            # The bf16 tier (PR 14) warms beside the f32 family — the
+            # zero-steady-recompile criterion covers BOTH precision
+            # families (a bf16 tier-0 burst must never pay a compile
+            # inside a latency-sensitive dispatch). Always "jit": the
+            # bf16 family has no lattice tier by design.
+            for b in bucket_list or self.buckets:
+                with self._exe_lock:
+                    entry = self._gather_exes_bf16.get(b)
+                    cap = (self._table.capacity
+                           if self._table is not None else None)
+                if entry is None or entry[0] != cap:
+                    self._gather_executable(b, prec="bf16")
         if self._lane_count is not None:
             # Same reasoning as warmup(): pose-only lane traffic and
             # sibling-ladder failovers must find every lane's gathered
@@ -1270,6 +1399,19 @@ class ServingEngine:
         ls = self._laneset
         if ls is not None:
             out["lanes"] = ls.snapshot()
+        # Precision tiers (PR 14): the policy is immutable, so this is
+        # pure derivation — no lock needed, and an operator (or the
+        # metrics scrape, obs/metrics.py:load_samples) can always see
+        # WHICH tier serves which precision family and under what
+        # stated envelope.
+        if self._precision_policy is not None:
+            pol = self._precision_policy
+            out["precision"] = {
+                "envelope_m": pol.max_vertex_err_m,
+                "accumulate": pol.accumulate,
+                "tiers": pol.tiers_snapshot(
+                    (0, 1, *self._tier_quotas)),
+            }
         if self._tracer is not None:
             # PR 8: per-tier resolve-latency quantiles + backlog age.
             # The tracer copies its samples and open-span starts in ONE
@@ -1856,7 +1998,8 @@ class ServingEngine:
             exe = self._exes.setdefault(bucket, loaded)
         return exe
 
-    def _gather_executable(self, bucket: int, table=None):
+    def _gather_executable(self, bucket: int, table=None,
+                           prec: str = "f32"):
         """The gathered pose-only per-bucket entry — in-memory then jit,
         no AOT tier (table and index are runtime arguments, so the
         artifact would bake nothing subject-specific; the jit compile
@@ -1871,6 +2014,14 @@ class ServingEngine:
         growth hand back a wider program whose jit then silently
         retraces on the snapshot mid-dispatch. Default (None): the live
         table (warm-up paths).
+
+        ``prec`` (PR 14) selects the precision FAMILY: ``"bf16"`` is
+        the policy tier's bf16-compute/f32-accumulate program (fused or
+        XLA per the same ``_posed_fused_active`` gate), cached in
+        ``_gather_exes_bf16`` under identical capacity keying — and
+        deliberately NEVER lattice-served (the lattice contract is f32
+        bit-identity with the live jit; a silent family swap across a
+        restart is exactly what the sentinel exists to prevent).
         """
         if table is None:
             with self._exe_lock:
@@ -1882,6 +2033,8 @@ class ServingEngine:
                 "no specialized subject to warm the pose-only path "
                 "with; call specialize(betas) first")
         cap = table.capacity
+        if prec == "bf16":
+            return self._gather_bf16_executable(bucket, table, cap)
         with self._exe_lock:
             entry = self._gather_exes.get(bucket)
         if entry is not None and entry[0] == cap:
@@ -1969,6 +2122,40 @@ class ServingEngine:
                 self._gather_exes[bucket] = (cap, exe)
         return exe
 
+    def _gather_bf16_executable(self, bucket: int, table, cap: int):
+        """The bf16-tier gathered entry (PR 14): in-memory then jit —
+        no lattice tier by design (see ``_gather_executable``). Chaos
+        wraps it exactly like every primary family, so the sentinel
+        drill can inject silent corruption into THIS tier and prove
+        detection. Publication follows the same capacity-monotonic
+        rules as the f32 cache."""
+        with self._exe_lock:
+            entry = self._gather_exes_bf16.get(bucket)
+        if entry is not None and entry[0] == cap:
+            return entry[1]
+        fused = self._posed_fused_active(cap)
+        # Resolved OUTSIDE any lock (a jax backend query).
+        interp = self._resolve_posed_interpret() if fused else False
+        exe = build_posed_gather_bf16_executable(
+            table, bucket, self._n_joints, self._dtype,
+            donate=self.donate, fused=fused, interpret=interp)
+        self.counters.count_compile()
+        if self._tracer is not None:
+            self._tracer.runtime_event(
+                "compile",
+                family="gather_fused_bf16" if fused else "gather_bf16",
+                bucket=bucket, capacity=cap)
+        if self._policy is not None and self._policy.chaos is not None:
+            exe = self._policy.chaos.wrap(
+                exe, on_fault=self._on_chaos_fault)
+        with self._exe_lock:
+            cur = self._gather_exes_bf16.get(bucket)
+            if cur is not None and cur[0] == cap:
+                return cur[1]  # racing builder won at the same capacity
+            if cur is None or cur[0] < cap:
+                self._gather_exes_bf16[bucket] = (cap, exe)
+        return exe
+
     def _fallback_executable(self, bucket: int):
         """The CPU graceful-degradation entry — in-memory then jit.
 
@@ -2050,20 +2237,27 @@ class ServingEngine:
 
     # ------------------------------------------------------------ dispatch
     def _admit(self, nxt: _Request, posed: bool, subjects: set,
-               rows: int) -> Optional[str]:
+               rows: int, prec: str = "f32") -> Optional[str]:
         """Why ``nxt`` cannot join the batch being coalesced, or None.
 
         ``"kind"``: full-path and pose-only requests cannot share a
-        program. ``"subjects"``: admitting one more DISTINCT subject
-        would exceed the table's ``max_subjects`` rows (so _resolve_batch
-        could never pin the batch). ``"overflow"``: the rows would
-        exceed the largest bucket — the one reason that also stops the
-        scan (anything later would overflow too once this batch is
-        near-full). Note what is ABSENT: a subject-equality rule —
-        different subjects coalescing is the PR-4 tentpole.
+        program. ``"precision"`` (PR 14): a batch serves ONE precision
+        family — a pose-only request whose policy tier maps to the
+        other family is parked (policy-less engines never hit this:
+        every request maps f32). ``"subjects"``: admitting one more
+        DISTINCT subject would exceed the table's ``max_subjects`` rows
+        (so _resolve_batch could never pin the batch). ``"overflow"``:
+        the rows would exceed the largest bucket — the one reason that
+        also stops the scan (anything later would overflow too once
+        this batch is near-full). Note what is ABSENT: a
+        subject-equality rule — different subjects coalescing is the
+        PR-4 tentpole.
         """
         if (nxt.subject is not None) != posed:
             return "kind"
+        if posed and self._precision_policy is not None \
+                and self._req_prec(nxt) != prec:
+            return "precision"
         if rows + nxt.rows > self.buckets[-1]:
             return "overflow"
         if (posed and nxt.subject not in subjects
@@ -2086,6 +2280,7 @@ class ServingEngine:
         reqs, rows = [first], first.rows
         posed = first.subject is not None
         subjects = {first.subject} if posed else set()
+        prec = self._req_prec(first)   # the batch's precision family
 
         def admit(nxt, fresh=True) -> Optional[str]:
             if self._skip_cancelled(nxt):
@@ -2098,7 +2293,7 @@ class ServingEngine:
                 # parked, never costing a device row.
                 self._expire(nxt, "coalesce")
                 return "expired"
-            why = self._admit(nxt, posed, subjects, rows)
+            why = self._admit(nxt, posed, subjects, rows, prec)
             if why is None:
                 reqs.append(nxt)
                 if posed:
@@ -2277,6 +2472,7 @@ class ServingEngine:
                 self._get_lanes().submit_batch(
                     bucket, pose, shape, posed, reqs, rows)
                 return None
+            prec = self._req_prec(reqs[0]) if posed else "f32"
             if posed:
                 table, slots = self._resolve_batch(reqs)
                 idx = bucket_mod.subject_index_rows(
@@ -2287,10 +2483,11 @@ class ServingEngine:
                 # policy's deadline/retry/failover envelope before the
                 # next batch launches (bounded latency over overlap).
                 out = self._supervised_dispatch(bucket, pose, shape,
-                                                reqs, table, idx)
+                                                reqs, table, idx,
+                                                prec=prec)
             elif posed:
-                out = self._gather_executable(bucket, table)(table, idx,
-                                                             pose)
+                out = self._gather_executable(bucket, table,
+                                              prec)(table, idx, pose)
             else:
                 exe = self._executable(bucket)
                 out = exe(pose, shape)  # async dispatch: pre-completion
@@ -2319,7 +2516,7 @@ class ServingEngine:
             raise
 
     def _supervised_dispatch(self, bucket: int, pose, shape,
-                             reqs, table, idx):
+                             reqs, table, idx, prec: str = "f32"):
         """One batch through the full fault-tolerance envelope:
         supervised primary attempts (deadline + classified retries with
         backoff, breaker-gated), then CPU graceful degradation, then a
@@ -2342,7 +2539,7 @@ class ServingEngine:
         pol = self._policy
         breaker = pol.breaker
         if table is not None:
-            exe = self._gather_executable(bucket, table)
+            exe = self._gather_executable(bucket, table, prec)
             primary = lambda: np.asarray(exe(table, idx, pose))  # noqa: E731
         else:
             exe = self._executable(bucket)

@@ -56,12 +56,17 @@ Replica broadcasts are serialized upstream by the engine's
 ``_install_lock`` (``_install_subject`` is the table's only mutator),
 so ``broadcast_row`` needs no install lock of its own.
 
-Known scope bounds (documented, not accidental): lane executables have
+Known scope bound (documented, not accidental): lane executables have
 no AOT-lattice tier (PR-6 lattice entries deserialize onto the default
-device; a lane boot pays warm-up compiles, counted) and the gathered
-path serves the XLA family even under ``posed_kernel="fused"`` (the
-fused kernel tier stays a single-device specialization for now — the
-CPU drill and the parity criteria need the bit-identical family).
+device; a lane boot pays warm-up compiles, counted). The PR-13 bound
+that lanes served only the XLA gathered family is CLOSED (PR 14): a
+lane's gathered cache serves the FUSED Pallas family under
+``posed_kernel="fused"`` through the engine's own capacity gate, and
+under a ``PrecisionPolicy`` each lane also carries the bf16-tier
+gathered family (same capacity keying, growth re-adoption, and chaos
+wrapping as the f32 cache) — so lane placement, the sibling ladder,
+and failback never silently change a request's kernel or precision
+family.
 """
 
 from __future__ import annotations
@@ -105,6 +110,10 @@ class Lane:
         self.table_version = -1
         self.exes: dict = {}         # bucket -> full-path executable
         self.gather_exes: dict = {}  # bucket -> (capacity, executable)
+        self.gather_exes_bf16: dict = {}  # bucket -> (capacity, exe)
+        #   The bf16-tier gathered family (PR 14), per lane — same
+        #   keying/invalidation as gather_exes; populated only under
+        #   an engine PrecisionPolicy with bf16 tiers.
         # -- telemetry (LaneSet._lock) --
         self.backlog_batches = 0     # queued + in flight
         self.backlog_rows = 0
@@ -343,16 +352,22 @@ class LaneSet:
 
     def _rebuild_stale_gather(self, lane: Lane) -> None:
         """Eagerly rebuild a lane's capacity-stale gathered
-        executables after a growth — a growth compile must not land
-        inside a latency-sensitive lane dispatch (the engine's
-        ``_install_subject`` rule, per lane)."""
+        executables (both precision families) after a growth — a
+        growth compile must not land inside a latency-sensitive lane
+        dispatch (the engine's ``_install_subject`` rule, per lane)."""
         with self._lock:
             tab = lane.table
             stale = ([] if tab is None else
                      [b for b, (c, _) in lane.gather_exes.items()
                       if c != tab.capacity])
+            stale_bf16 = ([] if tab is None else
+                          [b for b, (c, _)
+                           in lane.gather_exes_bf16.items()
+                           if c != tab.capacity])
         for b in stale:
             self._gather_executable(lane, b)
+        for b in stale_bf16:
+            self._gather_executable(lane, b, prec="bf16")
 
     # ----------------------------------------------------------- executables
     def _full_executable(self, lane: Lane, bucket: int):
@@ -378,28 +393,58 @@ class LaneSet:
             exe = lane.exes.setdefault(bucket, built)
         return exe
 
-    def _gather_executable(self, lane: Lane, bucket: int, tab=None):
+    def _gather_executable(self, lane: Lane, bucket: int, tab=None,
+                           prec: str = "f32"):
         """Returns ``(executable, table)`` — the executable serves ANY
         table of the cache key's capacity (table + index are runtime
         arguments), and the table the caller should dispatch is the
         one it passed in (a version-validated replica from
         ``_resolve_for_lane``) or, for warm-up, the lane's adopted
-        replica."""
+        replica.
+
+        Family selection (PR 14): the engine's OWN tier predicates
+        decide per lane exactly as they do on the single-device path —
+        ``_posed_fused_active`` gates the fused Pallas family under
+        ``posed_kernel="fused"`` (closing the PR-13 scope bound that
+        lanes silently served XLA), and ``prec="bf16"`` selects the
+        bf16-tier family into the lane's own bf16 cache. A lane can
+        therefore never serve a DIFFERENT kernel or precision family
+        than the engine would have — ladder hops and failback preserve
+        the request's program family by construction.
+        """
         from mano_hand_tpu.serving import engine as engine_mod
 
         if tab is None:
             tab = self._lane_table(lane)
         cap = tab.capacity
+        eng = self._eng
+        cache = (lane.gather_exes_bf16 if prec == "bf16"
+                 else lane.gather_exes)
         with self._lock:
-            entry = lane.gather_exes.get(bucket)
+            entry = cache.get(bucket)
         if entry is not None and entry[0] == cap:
             return entry[1], tab
-        eng = self._eng
-        built = engine_mod.build_posed_gather_executable(
-            tab, bucket, eng._n_joints, eng._dtype, donate=eng.donate)
+        fused = eng._posed_fused_active(cap)
+        # Resolved OUTSIDE the lock (a jax backend query).
+        interp = eng._resolve_posed_interpret() if fused else False
+        if prec == "bf16":
+            family = "gather_fused_bf16" if fused else "gather_bf16"
+            built = engine_mod.build_posed_gather_bf16_executable(
+                tab, bucket, eng._n_joints, eng._dtype,
+                donate=eng.donate, fused=fused, interpret=interp)
+        elif fused:
+            family = "gather_fused"
+            built = engine_mod.build_posed_gather_fused_executable(
+                tab, bucket, eng._n_joints, eng._dtype,
+                donate=eng.donate, interpret=interp)
+        else:
+            family = "gather"
+            built = engine_mod.build_posed_gather_executable(
+                tab, bucket, eng._n_joints, eng._dtype,
+                donate=eng.donate)
         eng.counters.count_compile()
         if eng._tracer is not None:
-            eng._tracer.runtime_event("compile", family="gather",
+            eng._tracer.runtime_event("compile", family=family,
                                       bucket=bucket, capacity=cap,
                                       lane=lane.index)
         pol = eng._policy
@@ -407,20 +452,24 @@ class LaneSet:
             built = pol.chaos.wrap(built, on_fault=eng._on_chaos_fault,
                                    lane=lane.index)
         with self._lock:
-            cur = lane.gather_exes.get(bucket)
+            cur = cache.get(bucket)
             if cur is not None and cur[0] == cap:
                 return cur[1], tab
             if cur is None or cur[0] < cap:
-                lane.gather_exes[bucket] = (cap, built)
+                cache[bucket] = (cap, built)
         return built, tab
 
     def warm(self, buckets: Sequence[int], *, posed: bool) -> None:
         """Build every lane's executables for ``buckets`` up front —
-        warm-up is where compile latency belongs, N-lane edition."""
+        warm-up is where compile latency belongs, N-lane edition
+        (both precision families when a PrecisionPolicy names bf16
+        tiers, so ladder hops never pay a bf16 compile mid-outage)."""
         for lane in self.lanes:
             for b in buckets:
                 if posed:
                     self._gather_executable(lane, b)
+                    if self._eng._bf16_serving():
+                        self._gather_executable(lane, b, prec="bf16")
                 else:
                     self._full_executable(lane, b)
 
@@ -491,14 +540,17 @@ class LaneSet:
 
     def _posed_call(self, target: Lane, bucket: int, pose, reqs):
         """One gathered dispatch on ``target``: version-validated
-        replica + slots, the capacity-keyed executable, and the int32
-        index built from THOSE slots (never from a resolution taken at
-        placement time — the batch may have sat in a backlog through
-        an eviction)."""
+        replica + slots, the capacity-keyed executable of the batch's
+        precision family (``_req_prec`` — batches are single-precision
+        by the engine's coalesce rule, so request 0 speaks for all),
+        and the int32 index built from THOSE slots (never from a
+        resolution taken at placement time — the batch may have sat in
+        a backlog through an eviction)."""
         from mano_hand_tpu.serving import buckets as bucket_mod
 
         tab, slots = self._resolve_for_lane(target, reqs)
-        exe, tab = self._gather_executable(target, bucket, tab)
+        exe, tab = self._gather_executable(
+            target, bucket, tab, prec=self._eng._req_prec(reqs[0]))
         idx = bucket_mod.subject_index_rows(
             slots, [r.rows for r in reqs], bucket)
         return exe, tab, idx

@@ -2908,3 +2908,354 @@ def lane_drill_run(
         "flight_record": flight_record(
             tracer, eng.counters, reason="lane_drill_complete"),
     }
+
+
+def precision_bench_run(
+    params,
+    *,
+    subjects: int = 8,
+    requests: int = 96,
+    min_rows: int = 1,
+    max_rows: int = 4,
+    max_bucket: int = 32,
+    max_delay_s: float = 0.002,
+    seed: int = 0,
+    trials: int = 5,
+    envelope_m: float = 2e-3,
+    posed_kernel: str = "xla",
+    interpret: Optional[bool] = None,
+    drill: bool = True,
+    trace_dir=None,
+    log: Callable[[str], None] = None,
+) -> dict:
+    """THE precision-tier benchmark protocol — bench.py config17 (PR 14).
+
+    The same mixed-subject tier-0 pose-only stream drives TWO live
+    engines: one under a ``PrecisionPolicy`` (tier 0 -> the
+    bf16-compute/f32-accumulate gathered family), one the f32 control.
+    The speed comparison is SLOPE-TIMED through the engines (t(all)
+    minus t(half), the config14 protocol: the fixed submit/coalesce
+    overhead both sides share cancels; naive timing on the tunnel
+    lies), all four timing points interleaved per trial with
+    min-over-trials per point.
+
+    Returned criteria numbers (scripts/bench_report.py:judge_precision):
+
+    * ``bf16_max_abs_err`` <= ``bf16_err_envelope`` — the bf16 tier's
+      max vertex error vs the f32 posed reference, probed through the
+      LIVE engine (sequential requests AND a concurrently-submitted
+      burst that coalesces into mixed-subject gathered batches);
+    * ``f32_control_max_abs_err`` == 0.0 — the control engine keeps
+      the PR-4 f32 bit-identity contract (a nonzero here means the
+      harness drifted, not the bf16 tier). Under
+      ``posed_kernel="fused"`` the control serves the fused Pallas
+      family, which is ~1e-5-close to the XLA posed reference by
+      design — the judge applies the config14 1e-5 parity gate there
+      instead of exact equality;
+    * ``steady_recompiles_bf16`` == ``steady_recompiles_f32`` == 0 —
+      both precision families serve every mixture from warm
+      executables (warmup_posed warms BOTH on the policy engine);
+    * ``sentinel_drill`` — a third, supervised engine composes the
+      chaos ``wrong``-output fault into its (chaos-wrapped) bf16
+      family: the sentinel's envelope judgment MUST flag
+      ``gather_bf16`` drifted (``numerics_drift`` incident + flight
+      capture), every future still resolves, and the probe recovers
+      once the fault clears — the PR-9 guarantee extended to the tier
+      whose whole safety case rests on it;
+    * ``bf16_vs_f32_ratio`` — the headline speed number, judged >= 1.2x
+      on a real TPU only (the config14 convention: off-chip the bf16
+      MXU passes are emulated/invisible, so the CPU-lane ratio is
+      recorded unjudged; the chip leg is queued via
+      scripts/bench_tpu_wait.sh).
+
+    ``drill=False`` skips the sentinel drill (the bench tiny-e2e
+    budget pattern: the drill engine's compiles are cold in a fresh
+    cache). ``trace_dir`` exports the policy engine's timeline into
+    ``<trace_dir>/precision/``.
+    """
+    import jax
+
+    from mano_hand_tpu.models import core
+    from mano_hand_tpu.serving import buckets as bucket_mod
+    from mano_hand_tpu.serving.engine import ServingEngine
+    from mano_hand_tpu.serving.precision import PrecisionPolicy
+
+    if subjects < 1:
+        raise ValueError(f"subjects must be >= 1, got {subjects}")
+    if requests < 2:
+        raise ValueError(f"requests must be >= 2, got {requests}")
+    log = _logger(log)
+    max_rows = min(max_rows, max_bucket)
+    min_rows = max(1, min(min_rows, max_rows))
+    n_joints, n_shape = params.n_joints, params.n_shape
+    rng = np.random.default_rng(seed)
+    betas = [rng.normal(size=(n_shape,)).astype(np.float32)
+             for _ in range(subjects)]
+    sizes = rng.integers(min_rows, max_rows + 1, size=requests)
+    subj_of = rng.integers(0, subjects, size=requests)
+    stream = [
+        (rng.normal(scale=0.4,
+                    size=(int(n), n_joints, 3)).astype(np.float32), int(s))
+        for n, s in zip(sizes, subj_of)
+    ]
+    m1 = max(1, requests // 2)
+    m2 = requests
+    rows_m1 = int(sizes[:m1].sum())
+    rows_m2 = int(sizes.sum())
+    d_rows = rows_m2 - rows_m1
+
+    policy = PrecisionPolicy(bf16_tiers=frozenset({0}),
+                             max_vertex_err_m=envelope_m)
+    tracer_b, tracer_c = Tracer(), Tracer()
+    eng_b = ServingEngine(params, max_bucket=max_bucket,
+                          max_delay_s=max_delay_s, tracer=tracer_b,
+                          posed_kernel=posed_kernel,
+                          posed_kernel_interpret=interpret,
+                          precision_policy=policy)
+    eng_c = ServingEngine(params, max_bucket=max_bucket,
+                          max_delay_s=max_delay_s, tracer=tracer_c,
+                          posed_kernel=posed_kernel,
+                          posed_kernel_interpret=interpret)
+
+    prm_dev = params.astype(np.float32).device_put()
+    shaped = [core.jit_specialize(prm_dev, b) for b in betas]
+    # The f32 truth: the per-subject posed program — the PR-4 gathered
+    # f32 family is bit-identical to it per row, so one reference
+    # serves the control's bit-identity AND the bf16 tier's envelope.
+    ref_exe = jax.jit(
+        lambda sh, p: core.forward_posed_batched(sh, p).verts)
+
+    def ref_one(pose, si):
+        b = bucket_mod.bucket_for(pose.shape[0], eng_b.buckets)
+        out = ref_exe(shaped[si],
+                      np.asarray(bucket_mod.pad_rows(pose, b)))
+        return np.asarray(out)[:pose.shape[0]]
+
+    def run_stream(eng, keys, m):
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, subject=keys[si], priority=0)
+                for p, si in stream[:m]]
+        for f in futs:
+            f.result()
+        return time.perf_counter() - t0
+
+    results = {}
+    with eng_b, eng_c:
+        keys_b = [eng_b.specialize(b) for b in betas]
+        keys_c = [eng_c.specialize(b) for b in betas]
+        log(f"precision: {subjects} subjects baked on both engines, "
+            f"warming buckets {eng_b.buckets} (both precision "
+            f"families on the policy side)")
+        eng_b.warmup_posed()
+        eng_c.warmup_posed()
+        for b in eng_b.buckets:   # warm the reference's buckets
+            jax.block_until_ready(ref_exe(
+                shaped[0], np.zeros((b, n_joints, 3), np.float32)))
+
+        # Envelope/parity through the LIVE engines (the CLAUDE.md
+        # in-context rule): sequential tier-0 requests AND a
+        # concurrently-submitted burst that coalesces into
+        # mixed-subject gathered batches on each side.
+        err_b = err_c = 0.0
+        probe = stream[:min(8, len(stream))]
+        for pose, si in probe:
+            err_b = max(err_b, float(np.abs(
+                eng_b.forward(pose, subject=keys_b[si], priority=0)
+                - ref_one(pose, si)).max()))
+            err_c = max(err_c, float(np.abs(
+                eng_c.forward(pose, subject=keys_c[si], priority=0)
+                - ref_one(pose, si)).max()))
+        futs_b = [eng_b.submit(p, subject=keys_b[si], priority=0)
+                  for p, si in probe]
+        futs_c = [eng_c.submit(p, subject=keys_c[si], priority=0)
+                  for p, si in probe]
+        for (pose, si), fb, fc in zip(probe, futs_b, futs_c):
+            want = ref_one(pose, si)
+            err_b = max(err_b, float(np.abs(fb.result() - want).max()))
+            err_c = max(err_c, float(np.abs(fc.result() - want).max()))
+        # A tier-1 request on the POLICY engine must serve f32 (the
+        # tier-without-policy-entry default) — probed live, so a
+        # policy-routing regression fails the control criterion here
+        # rather than silently widening the bf16 envelope.
+        t1_pose, t1_si = stream[0]
+        err_c = max(err_c, float(np.abs(
+            eng_b.forward(t1_pose, subject=keys_b[t1_si], priority=1)
+            - ref_one(t1_pose, t1_si)).max()))
+
+        run_stream(eng_b, keys_b, m2)
+        run_stream(eng_c, keys_c, m2)   # settle both sides untimed
+        compiles_b = eng_b.counters.compiles
+        compiles_c = eng_c.counters.compiles
+
+        thunks = {
+            "b1": lambda: run_stream(eng_b, keys_b, m1),
+            "b2": lambda: run_stream(eng_b, keys_b, m2),
+            "c1": lambda: run_stream(eng_c, keys_c, m1),
+            "c2": lambda: run_stream(eng_c, keys_c, m2),
+        }
+        best = {k: float("inf") for k in thunks}
+        for t in range(max(1, trials)):
+            order = sorted(thunks) if t % 2 == 0 \
+                else sorted(thunks, reverse=True)
+            for k in order:
+                best[k] = min(best[k], thunks[k]())
+        steady_b = eng_b.counters.compiles - compiles_b
+        steady_c = eng_c.counters.compiles - compiles_c
+        snap_b = eng_b.counters.snapshot()
+        targets = eng_b.numerics_probe_targets()
+        results.update({
+            "capacity": targets["table"].capacity,
+            "gather_fused_active": bool(targets["gather_fused"]),
+            "precision_tiers": eng_b.load()["precision"]["tiers"],
+        })
+
+    d_b = best["b2"] - best["b1"]
+    d_c = best["c2"] - best["c1"]
+    bf16_rate = d_rows / d_b if d_b > 0 else float("nan")
+    f32_rate = d_rows / d_c if d_c > 0 else float("nan")
+    ratio = d_c / d_b if d_b > 0 and d_c > 0 else float("nan")
+    platform = jax.default_backend()
+    log(f"precision: bf16 {bf16_rate:,.0f} vs f32 {f32_rate:,.0f} "
+        f"evals/s (slope ratio {ratio:.2f}x, platform {platform}), "
+        f"bf16 err {err_b:.2e} vs envelope {envelope_m:.1e}, f32 "
+        f"control err {err_c:.2e}, steady recompiles "
+        f"{steady_b}/{steady_c}")
+
+    # ---- the bf16 sentinel drill: injected silent corruption on the
+    # bf16 TIER must be seen by the envelope judgment (the whole
+    # safety case of serving reduced precision in production).
+    drill_out = None
+    if drill:
+        from mano_hand_tpu.obs.recorder import FlightRecorder
+        from mano_hand_tpu.obs.sentinel import NumericsSentinel
+        from mano_hand_tpu.runtime.chaos import ChaosPlan
+        from mano_hand_tpu.runtime.supervise import DispatchPolicy
+
+        plan = ChaosPlan()
+        pol = DispatchPolicy(deadline_s=20.0, retries=0, chaos=plan)
+        tr3 = Tracer()
+        eng3 = ServingEngine(params, min_bucket=8, max_bucket=8,
+                             max_delay_s=max_delay_s, policy=pol,
+                             tracer=tr3, precision_policy=policy,
+                             # The drill must corrupt the SAME family
+                             # the timed engines serve — under
+                             # posed_kernel="fused" an XLA-only drill
+                             # engine would certify detection on a
+                             # family not under test.
+                             posed_kernel=posed_kernel,
+                             posed_kernel_interpret=interpret)
+        rec3 = FlightRecorder(tr3, eng3.counters)
+        s3 = NumericsSentinel(eng3, tracer=tr3, interval_s=3600.0)
+        dkeys = [eng3.specialize(b) for b in betas[:min(3, subjects)]]
+        wave = [
+            (rng.normal(scale=0.4,
+                        size=(int(n), n_joints, 3)).astype(np.float32),
+             int(s))
+            for n, s in zip(rng.integers(1, 5, size=12),
+                            rng.integers(0, len(dkeys), size=12))
+        ]
+
+        def submit_wave():
+            import concurrent.futures as cf
+
+            futs = [eng3.submit(p, subject=dkeys[si], priority=0)
+                    for p, si in wave]
+            resolved = 0
+            for f in futs:
+                try:
+                    f.result(timeout=60.0)
+                    resolved += 1
+                except cf.TimeoutError:
+                    pass
+                except Exception:  # noqa: BLE001 — structured resolves
+                    resolved += 1
+            return resolved, len(futs)
+
+        with eng3:
+            eng3.warmup_posed()
+            golden = s3.arm()
+            ok0, n0 = submit_wave()     # clean bf16 tier-0 traffic
+            clean = s3.probe()
+            drill_compiles_warm = eng3.counters.compiles
+            # Silent corruption: every chaos-wrapped primary — the
+            # bf16 gathered family included — returns verts + 1.0
+            # from here, resolving every future "ok" with floats a
+            # whole envelope off. Only the sentinel can see it.
+            plan.schedule("wrong:1.0@0-")
+            ok1, n1 = submit_wave()
+            detected = s3.probe()
+            plan.clear()                # the fault clears
+            recovered = s3.probe()
+            drill_recompiles = (eng3.counters.compiles
+                                - drill_compiles_warm)
+        drill_acc = tr3.accounting()
+        fam = detected["families"]
+        bf16_rec = fam.get("gather_bf16") or {}
+        drill_out = {
+            "submitted": n0 + n1,
+            "futures_resolved_fraction": (ok0 + ok1) / (n0 + n1),
+            "clean_probe_drift": bool(clean["drift"]),
+            "detected": bool(detected["drift"]),
+            "bf16_family_detected": bool(bf16_rec.get("drift")),
+            "drifted_families": detected["drifted_families"],
+            "drift_max_abs_err": bf16_rec.get("max_abs_err"),
+            "envelope": bf16_rec.get("envelope"),
+            "golden_bf16_status": golden.get("golden_bf16_status"),
+            "recovered": not recovered["drift"],
+            "incidents": drill_acc["incidents"],
+            "flight_capture_reasons": [c.get("reason")
+                                       for c in rec3.captures],
+            "faults_injected": int(eng3.counters.faults_injected),
+            "steady_recompiles": int(drill_recompiles),
+            "span_accounting": drill_acc,
+        }
+        log(f"precision sentinel drill: bf16 detected="
+            f"{drill_out['bf16_family_detected']} (err "
+            f"{drill_out['drift_max_abs_err']} vs envelope "
+            f"{drill_out['envelope']}), recovered="
+            f"{drill_out['recovered']}, "
+            f"{drill_out['futures_resolved_fraction']:.0%} of "
+            f"{drill_out['submitted']} futures resolved, "
+            f"{drill_out['incidents']} incident(s), golden_bf16 "
+            f"{drill_out['golden_bf16_status']}")
+
+    results.update({
+        "subjects": int(subjects),
+        "requests": int(requests),
+        "rows": [int(sizes.min()), int(sizes.max())],
+        "buckets": list(eng_b.buckets),
+        "platform": platform,
+        "posed_kernel": posed_kernel,
+        "slope_points": {"m1": m1, "m2": m2,
+                         "rows_m1": rows_m1, "rows_m2": rows_m2},
+        "bf16_evals_per_sec": float(f"{bf16_rate:.5g}"),
+        "f32_evals_per_sec": float(f"{f32_rate:.5g}"),
+        "bf16_vs_f32_ratio": float(f"{ratio:.4g}"),
+        "bf16_max_abs_err": err_b,
+        "bf16_err_envelope": float(envelope_m),
+        "f32_control_max_abs_err": err_c,
+        "steady_recompiles_bf16": int(steady_b),
+        "steady_recompiles_f32": int(steady_c),
+        "mixed_subject_batches": snap_b["mixed_subject_batches"],
+        "coalesce_width_mean": snap_b["coalesce_width_mean"],
+        "dispatches": snap_b["dispatches"],
+        "flight_record": flight_record(
+            tracer_b, eng_b.counters, reason="precision_complete"),
+    })
+    if drill_out is not None:
+        results["sentinel_drill"] = drill_out
+    else:
+        # Self-documenting skip: judge_precision treats an ABSENT
+        # drill block as a failure unless the artifact says the skip
+        # was deliberate (the tiny-e2e budget pattern) — a drilled
+        # run that silently dropped the block must not pass.
+        results["sentinel_drill_skipped"] = True
+    if trace_dir is not None:
+        import os
+
+        from mano_hand_tpu.obs import write_trace_dir
+
+        results["trace_export"] = write_trace_dir(
+            tracer_b, os.path.join(str(trace_dir), "precision"),
+            counters=eng_b.counters, reason="precision_complete")
+    return results

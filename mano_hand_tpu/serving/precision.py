@@ -1,0 +1,115 @@
+"""Per-tier precision policy for the serving engine (PR 14).
+
+The blend matmul runs at ~45% of bf16 peak and the whole serving hot
+path was f32 (ROADMAP item 7; bench_results/r03_tpu_full1.json) — a
+bf16 posed path is the single biggest untapped raw-speed lever left
+after the PR-10 kernel fusion. It was too dangerous before: two silent
+precision collapses in this repo's history were only ever caught by
+on-chip probes. PR 9's NumericsSentinel changed the calculus — it
+probes every live program family through the engine's OWN cached
+executables in production — so a bf16 serving TIER can be continuously
+guarded rather than hoped-correct.
+
+The policy is deliberately narrow:
+
+* **Only the baked-shape/pose (gathered) path ever serves bf16.** The
+  steady-state interactive workload is ``submit(pose, subject=key)`` —
+  matmul-dominated pose blend + skinning over baked subject rows
+  (PAPER.md: shape blendshapes -> joint regression -> pose blendshapes
+  -> LBS; the shape half is baked at ``specialize`` time). Full-path
+  requests, fitting/batch tiers, the CPU-failover rung, and the PR-6
+  AOT lattice ALL stay f32: the lattice's contract is bit-identity
+  with the live f32 jit, failover is the clean reference tier every
+  parity criterion measures against, and solvers live or die on f32
+  conditioning (the measured LM dead-ends, docs/roadmap.md).
+* **bf16 means bf16 compute with f32 accumulation.** The two MXU-bound
+  contractions of the pose stage (pose-corrective blend, LBS skinning)
+  take bf16 operands and accumulate into f32
+  (``preferred_element_type`` — models/core.py ``compute_dtype``);
+  FK/Rodrigues (tiny, conditioning-sensitive) and every residual add
+  stay f32, and the served vertices are f32. Measured on this stack:
+  ~4e-4 m max vertex error vs the f32 path — well inside the stated
+  envelope below. On the fused Pallas tier the same policy selects the
+  kernel's single-pass bf16 MXU form (ops/pallas_posed.py).
+* **The envelope is part of the policy.** ``max_vertex_err_m`` is the
+  STATED per-request vertex-error budget (meters) the bf16 tier must
+  hold; the sentinel turns it into a standing guard (bf16 probes are
+  judged against this envelope relative to the f32 truth — f32-digest
+  equality is the wrong comparator for a reduced-precision family),
+  and bench config17's ``judge_precision`` gates it per round.
+
+Tiers not named in ``bf16_tiers`` default to f32 — an engine with no
+policy at all is byte-for-byte the pre-PR-14 engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import FrozenSet, Iterable
+
+#: The compute dtypes a tier can be mapped to.
+F32 = "f32"
+BF16 = "bf16"
+
+#: Default stated vertex-error budget of the bf16 tier: 2 mm in model
+#: units (meters) — 5x the ~4e-4 m measured bf16-vs-f32 error, small
+#: against fingertip dimensions (PAPER.md interactive tracking), and
+#: loose enough that it gates real drift, not float weather.
+DEFAULT_ENVELOPE_M = 2e-3
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """Which admission tiers serve the bf16 baked-shape/pose path.
+
+    Parameters
+    ----------
+    bf16_tiers: tiers whose POSE-ONLY (subject) requests are served by
+        the bf16-compute/f32-accumulate gathered family. Default:
+        tier 0 only — interactive traffic, the tier with a latency SLO
+        and a stated mm-level error budget. Full-path requests on any
+        tier stay f32 (the bf16 family exists only where the shape
+        stage is pre-baked).
+    accumulate: accumulation dtype of the bf16 contractions. Only
+        ``"f32"`` is supported — single-pass bf16 accumulation is the
+        exact silent-collapse class the sentinel exists to catch, and
+        the jaxpr auditor asserts the f32-accumulate shape of every
+        committed bf16 family (analysis/jaxpr_audit.py).
+    max_vertex_err_m: the stated per-request vertex-error envelope
+        (meters) vs the f32 path. The sentinel judges bf16 probes
+        against it; bench config17 gates it per round.
+    """
+
+    bf16_tiers: FrozenSet[int] = frozenset({0})
+    accumulate: str = F32
+    max_vertex_err_m: float = DEFAULT_ENVELOPE_M
+
+    def __post_init__(self):
+        tiers = frozenset(int(t) for t in self.bf16_tiers)
+        if any(t < 0 for t in tiers):
+            raise ValueError(
+                f"bf16_tiers must be non-negative, got {sorted(tiers)}")
+        object.__setattr__(self, "bf16_tiers", tiers)
+        if self.accumulate != F32:
+            raise ValueError(
+                f"accumulate must be {F32!r} (single-pass bf16 "
+                f"accumulation is the silent-collapse class the "
+                f"sentinel guards against), got {self.accumulate!r}")
+        if not (self.max_vertex_err_m > 0):
+            raise ValueError(
+                f"max_vertex_err_m must be > 0, got "
+                f"{self.max_vertex_err_m}")
+
+    def dtype_for_tier(self, tier: int) -> str:
+        """``"bf16"`` | ``"f32"`` for one admission tier's pose-only
+        requests — a tier without an entry defaults f32 (the
+        satellite edge: absence of policy is never a precision
+        change)."""
+        return BF16 if int(tier) in self.bf16_tiers else F32
+
+    def tiers_snapshot(self, extra_tiers: Iterable[int] = (0, 1)) -> dict:
+        """{tier: dtype} over ``bf16_tiers`` plus ``extra_tiers`` —
+        the ``load()``/metrics export shape (PR-14 satellite)."""
+        tiers = sorted(set(int(t) for t in extra_tiers)
+                       | set(self.bf16_tiers))
+        return {str(t): self.dtype_for_tier(t) for t in tiers}

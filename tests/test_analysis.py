@@ -261,15 +261,17 @@ def test_committed_lockstep_baseline_matches_head():
 def test_jaxpr_audit_clean_on_head_baseline():
     findings, measured = audit_programs(load_baseline())
     assert findings == [], [f.format() for f in findings]
-    # All six families represented by the eight audited programs (the
+    # All six families represented by the ten audited programs (the
     # PR-10 fused gathered serving kernel audits under "fused"; PR 12
-    # added the stream-session frozen-shape LM step).
+    # added the stream-session frozen-shape LM step; PR 14 the two
+    # bf16-tier gathered forms with the dtype-policy assertion).
     fams = {s.family for s in build_program_specs()}
     assert fams == {"full", "posed", "gathered", "fused",
                     "cpu_fallback", "stream_fit"}
     assert set(measured["programs"]) == {
-        "full", "posed", "gathered", "fused_one", "fused_two",
-        "gathered_fused", "cpu_fallback", "stream_fit"}
+        "full", "posed", "gathered", "gathered_bf16", "fused_one",
+        "fused_two", "gathered_fused", "gathered_fused_bf16",
+        "cpu_fallback", "stream_fit"}
 
 
 def _tiny_spec(fn, args, name="tiny", donate=(), expect=()):

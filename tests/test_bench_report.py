@@ -1168,3 +1168,204 @@ def test_lanes_block(tmp_path):
     p = _run(str(ep))
     assert p.returncode == 1
     assert "[FAIL] lanes_leg_ran" in p.stdout
+
+
+def _precision_block(**over):
+    pr = {
+        "subjects": 8, "requests": 96, "rows": [1, 4],
+        "capacity": 8, "gather_fused_active": False,
+        "platform": "cpu", "posed_kernel": "xla",
+        "precision_tiers": {"0": "bf16", "1": "f32"},
+        "slope_points": {"m1": 48, "m2": 96,
+                         "rows_m1": 118, "rows_m2": 239},
+        "bf16_evals_per_sec": 23000.0, "f32_evals_per_sec": 18000.0,
+        "bf16_vs_f32_ratio": 1.28,
+        "bf16_max_abs_err": 4.3e-4, "bf16_err_envelope": 2e-3,
+        "f32_control_max_abs_err": 0.0,
+        "steady_recompiles_bf16": 0, "steady_recompiles_f32": 0,
+        "mixed_subject_batches": 17, "coalesce_width_mean": 4.2,
+        "dispatches": 60,
+        "sentinel_drill": {
+            "submitted": 24, "futures_resolved_fraction": 1.0,
+            "clean_probe_drift": False, "detected": True,
+            "bf16_family_detected": True,
+            "drifted_families": ["gather", "gather_bf16"],
+            "drift_max_abs_err": 1.0, "envelope": 2e-3,
+            "golden_bf16_status": "match", "recovered": True,
+            "incidents": 1,
+            "flight_capture_reasons": ["numerics_drift"],
+            "faults_injected": 7, "steady_recompiles": 0,
+            "span_accounting": {"spans_started": 27,
+                                "spans_closed": 27, "spans_open": 0,
+                                "closed_by_kind": {"ok": 24,
+                                                   "probe": 2,
+                                                   "drift": 1},
+                                "incidents": 1, "events_dropped": 0},
+        },
+        "flight_record": {
+            "schema": 1, "reason": "precision_complete",
+            "accounting": {"spans_started": 81, "spans_closed": 81,
+                           "spans_open": 0, "closed_by_kind": {},
+                           "incidents": 0, "events_dropped": 0}},
+    }
+    pr.update(over)
+    return pr
+
+
+@pytest.mark.slow
+def test_precision_block(tmp_path):
+    """The precision-tier leg (config17, PR 14): bf16 error within the
+    stated envelope through the live engine, f32 control bit-identical,
+    zero steady recompiles on both precision families, the sentinel
+    detecting an injected bf16 drift, speed judged on a real chip only
+    — as a raw precision_bench_run artifact AND inside a serving-only
+    envelope."""
+    pr = _precision_block()
+    raw = tmp_path / "precision_raw.json"
+    raw.write_text(json.dumps(pr))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] precision_bf16_within_envelope" in p.stdout
+    assert "[PASS] precision_f32_control_bitwise" in p.stdout
+    assert "[PASS] precision_zero_recompiles" in p.stdout
+    assert "[PASS] precision_sentinel_detects_bf16_drift" in p.stdout
+    assert "[PASS] precision_drill_spans_closed_once" in p.stdout
+    assert "[PASS] precision_spans_closed_once" in p.stdout
+    assert "speed unjudged" in p.stdout
+    assert "precision_bf16_12x" not in p.stdout
+    assert "PRECISION CRITERIA PASS" in p.stdout
+
+    # On a real TPU the speed criterion applies — and fails below 1.2x.
+    raw.write_text(json.dumps(dict(pr, platform="tpu",
+                                   bf16_vs_f32_ratio=1.05)))
+    p = _run(str(raw))
+    assert p.returncode == 1 and "[FAIL] precision_bf16_12x" in p.stdout
+    raw.write_text(json.dumps(dict(pr, platform="tpu",
+                                   bf16_vs_f32_ratio=1.6)))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] precision_bf16_12x" in p.stdout
+
+    # Each criterion fails loudly on its own.
+    raw.write_text(json.dumps(dict(pr, bf16_max_abs_err=3e-3)))
+    p = _run(str(raw))
+    assert p.returncode == 1
+    assert "[FAIL] precision_bf16_within_envelope" in p.stdout
+    raw.write_text(json.dumps(dict(pr, f32_control_max_abs_err=1e-7)))
+    p = _run(str(raw))
+    assert p.returncode == 1
+    assert "[FAIL] precision_f32_control_bitwise" in p.stdout
+    raw.write_text(json.dumps(dict(pr, steady_recompiles_bf16=2)))
+    p = _run(str(raw))
+    assert p.returncode == 1
+    assert "[FAIL] precision_zero_recompiles" in p.stdout
+    drl = dict(_precision_block()["sentinel_drill"],
+               bf16_family_detected=False)
+    raw.write_text(json.dumps(dict(pr, sentinel_drill=drl)))
+    p = _run(str(raw))
+    assert p.returncode == 1
+    assert "[FAIL] precision_sentinel_detects_bf16_drift" in p.stdout
+
+    # drill=False artifacts carry the self-documenting skip marker —
+    # recorded, not judged; a drilled run that silently DROPPED the
+    # block (no marker) still fails loudly.
+    skipped = {k: v for k, v in pr.items() if k != "sentinel_drill"}
+    raw.write_text(json.dumps(dict(skipped, sentinel_drill_skipped=True)))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    assert "sentinel drill skipped" in p.stdout
+    assert "precision_sentinel_detects_bf16_drift" not in p.stdout
+    raw.write_text(json.dumps(skipped))
+    p = _run(str(raw))
+    assert p.returncode == 1
+    assert "[FAIL] precision_sentinel_detects_bf16_drift" in p.stdout
+
+    # Under posed_kernel="fused" the control serves the fused Pallas
+    # family (~1e-5-close to the XLA reference by design): the control
+    # bar is the config14 parity gate, never exact equality — and it
+    # still fails loudly above the gate.
+    fused = dict(pr, posed_kernel="fused",
+                 gather_fused_active=True,
+                 f32_control_max_abs_err=2.9e-6)
+    raw.write_text(json.dumps(fused))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] precision_f32_control_parity" in p.stdout
+    assert "precision_f32_control_bitwise" not in p.stdout
+    raw.write_text(json.dumps(dict(fused, f32_control_max_abs_err=5e-5)))
+    p = _run(str(raw))
+    assert p.returncode == 1
+    assert "[FAIL] precision_f32_control_parity" in p.stdout
+
+    # Inside a serving-only envelope the block rides with the serving
+    # criteria; a crashed leg fails loudly instead of vanishing.
+    only = tmp_path / "serve_only.json"
+    envelope = {
+        "metric": "serving_engine_evals_per_sec", "value": 8114.4,
+        "unit": "evals/s", "vs_baseline": None, "device": "cpu:cpu",
+        "detail": {
+            "serving": {
+                "engine_evals_per_sec": 8114.4,
+                "engine_vs_direct_ratio": 1.297,
+                "warm_bucket": 32, "steady_recompiles": 0,
+                "requests": 64, "compiles": 6, "aot_loads": 0,
+                "dispatches": 54, "padding_waste": 0.14,
+            },
+            "precision": pr,
+        }}
+    only.write_text(json.dumps(envelope))
+    p = _run(str(only))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] precision_bf16_within_envelope" in p.stdout
+    assert "SERVING CRITERIA PASS" in p.stdout
+    crashed = dict(envelope, config_errors={
+        "config17_precision": "RuntimeError: boom"})
+    del crashed["detail"]["precision"]
+    only.write_text(json.dumps(crashed))
+    p = _run(str(only))
+    assert p.returncode == 1
+    assert "[FAIL] precision_leg_ran" in p.stdout
+
+
+@pytest.mark.slow
+def test_history_error_envelope_judged_absolutely(tmp_path):
+    """The PR-14 `--history` satellite: a ``*_max_abs_err`` key with a
+    sibling stated ``*_err_envelope`` bound is judged ABSOLUTELY
+    against that bound — never as a higher-is-better rate, never as a
+    cross-round trend, and even when history holds no usable prior."""
+    fresh = {"metric": "mano_forward_evals_per_sec", "value": 10e6,
+             "device": "cpu:cpu",
+             "detail": {"precision": {"bf16_evals_per_sec": 23000.0,
+                                      "bf16_max_abs_err": 4.3e-4,
+                                      "bf16_err_envelope": 2e-3}}}
+    fp = tmp_path / "fresh.json"
+    fp.write_text(json.dumps(fresh))
+    # No usable priors at all: the envelope key is still judged (and
+    # passes), the rate keys have nothing to regress against.
+    p = _run(str(fp), "--history", str(fp))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] precision.bf16_max_abs_err" in p.stdout
+    assert "absolute bound" in p.stdout
+    # A breach fails BY NAME — with or without priors.
+    bad = dict(fresh)
+    bad["detail"] = {"precision": dict(fresh["detail"]["precision"],
+                                       bf16_max_abs_err=5e-3)}
+    bp = tmp_path / "bad.json"
+    bp.write_text(json.dumps(bad))
+    p = _run(str(bp), "--history", str(bp))
+    assert p.returncode == 1, p.stdout
+    assert "[FAIL] precision.bf16_max_abs_err" in p.stdout
+    assert "above stated envelope" in p.stdout
+    p = _run(str(bp), "--history", str(fp))
+    assert p.returncode == 1, p.stdout
+    assert "above stated envelope" in p.stdout
+    # The error key is NOT in the rate gate: a fresh error LOWER than
+    # the prior's must not read as a rate "regression".
+    better = dict(fresh)
+    better["detail"] = {"precision": dict(fresh["detail"]["precision"],
+                                          bf16_max_abs_err=1e-5)}
+    gp = tmp_path / "better.json"
+    gp.write_text(json.dumps(better))
+    p = _run(str(gp), "--history", str(fp))
+    assert p.returncode == 0, p.stdout
+    assert "[FAIL] precision.bf16_max_abs_err" not in p.stdout

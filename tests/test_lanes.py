@@ -486,3 +486,74 @@ def test_eviction_churn_under_lanes_stays_bit_identical(params32):
         # subjects (4 live subjects through 2 table rows).
         assert (eng.counters.specializations_evicted
                 > evicted_before + 4)
+
+
+def test_lanes_serve_fused_family_with_loss_parity(params32):
+    """The PR-13 scope bound CLOSED (PR 14): under
+    ``posed_kernel="fused"`` lane dispatch serves the FUSED gathered
+    family — proven by bit-equality with the single-device fused
+    engine (same trace, interpret mode) and a genuine nonzero delta
+    vs the XLA posed reference (within the 1e-5 fused parity gate) —
+    and the lane-loss bit-identity/parity contract extends to it: one
+    lane killed mid-stream, every future resolves via the sibling
+    ladder with results bit-equal to the healthy fused engine."""
+    betas = [_betas(s) for s in (1, 2, 3)]
+    poses = _poses(8, seed=5)
+    # Single-device fused engine: the bit-equality reference.
+    ref_eng = ServingEngine(params32, max_bucket=BUCKETS[-1],
+                            max_delay_s=0.001, posed_kernel="fused")
+    with ref_eng:
+        rkeys = [ref_eng.specialize(b) for b in betas]
+        fused_want = [ref_eng.forward(p, subject=rkeys[i % 3])
+                      for i, p in enumerate(poses)]
+    # XLA posed reference: the fused family's 1e-5 parity bar — and
+    # the proof the lanes did NOT silently serve the XLA family.
+    xla_eng = ServingEngine(params32, max_bucket=BUCKETS[-1],
+                            max_delay_s=0.001)
+    with xla_eng:
+        xkeys = [xla_eng.specialize(b) for b in betas]
+        xla_want = [xla_eng.forward(p, subject=xkeys[i % 3])
+                    for i, p in enumerate(poses)]
+
+    lane_ok = [True] * N_LANES
+    plan = ChaosPlan()
+    tr = Tracer()
+    eng = _lane_engine(params32, lane_ok, plan=plan, tracer=tr,
+                       posed_kernel="fused")
+    kill = 2
+    try:
+        with eng:
+            keys = [eng.specialize(b) for b in betas]
+            eng.warmup_posed(BUCKETS)
+            warm = eng.counters.compiles
+            got = [eng.forward(p, subject=keys[i % 3])
+                   for i, p in enumerate(poses)]
+            saw_fused_delta = False
+            for g, fw, xw in zip(got, fused_want, xla_want):
+                np.testing.assert_array_equal(g, fw)   # fused family
+                d = float(np.abs(g - xw).max())
+                assert d <= 1e-5                        # parity gate
+                saw_fused_delta = saw_fused_delta or d > 0.0
+            assert saw_fused_delta, \
+                "lane results == XLA family — fused tier not served"
+            # Lane loss: the parity/bit-identity contract holds
+            # THROUGH the ladder (a sibling serves the same fused
+            # family from its own replica).
+            lane_ok[kill] = False
+            plan.schedule(f"error@0-%{kill}")
+            n = len(poses)
+            got_loss = [eng.forward(p, subject=keys[(i % n) % 3])
+                        for i, p in enumerate(poses * 2)]
+            for g, want in zip(got_loss, fused_want * 2):
+                np.testing.assert_array_equal(g, want)
+            snap = eng.load()["lanes"]
+            per = {p["lane"]: p for p in snap["per_lane"]}
+            assert per[kill]["failovers_out"] >= 1
+            assert sum(p["cpu_failovers"]
+                       for p in snap["per_lane"]) == 0
+            assert eng.counters.compiles == warm   # loss compiles 0
+    finally:
+        plan.release.set()
+    acc = tr.accounting()
+    assert acc["spans_started"] == acc["spans_closed"]
+    assert acc["spans_open"] == 0

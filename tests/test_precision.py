@@ -1,0 +1,404 @@
+"""Precision-tiered serving (PR 14): the sentinel-guarded bf16 tier.
+
+The PrecisionPolicy edges (a tier without a policy entry defaults f32;
+a policy-less engine is byte-for-byte f32), the bf16 gathered family
+through the LIVE engine (envelope vs the f32 truth, f32 control
+bit-identical, zero steady recompiles on both families, mixed-tier
+bursts splitting by precision), the CPU-failover rung resolving a bf16
+request in f32 within the envelope (never a dtype crash), the sentinel
+drift drill on the bf16 family (envelope-judged, never f32-digest
+equality), the fused bf16 kernel form, per-tier precision in
+``load()``/metrics export, the jaxpr dtype-policy assertion, and the
+config17 protocol at tiny sizes.
+
+Canonical runner: `make precision-smoke` (own pytest process +
+compile-cache dir, wired into `make check`) — slow-marked, so the
+tier-1 `-m 'not slow'` lane skips it by design (the PR-8 budget
+precedent); `make test` --ignore's it for the same reason.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mano_hand_tpu.models import core
+from mano_hand_tpu.obs import Tracer
+from mano_hand_tpu.obs.sentinel import NumericsSentinel
+from mano_hand_tpu.runtime.chaos import ChaosPlan
+from mano_hand_tpu.runtime.health import CircuitBreaker
+from mano_hand_tpu.runtime.supervise import DispatchPolicy
+from mano_hand_tpu.serving.engine import ServingEngine
+from mano_hand_tpu.serving.precision import PrecisionPolicy
+
+pytestmark = pytest.mark.slow
+
+BUCKETS = [1, 2, 4]
+ENVELOPE = 2e-3
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def subjects(params32):
+    rng = np.random.default_rng(7)
+    betas = [rng.normal(size=(params32.n_shape,)).astype(np.float32)
+             for _ in range(3)]
+    poses = [rng.normal(scale=0.4, size=(2, params32.n_joints, 3))
+             .astype(np.float32) for _ in range(8)]
+    prm = params32.device_put()
+    shaped = [core.jit_specialize(prm, b) for b in betas]
+    ref = jax.jit(lambda sh, q: core.forward_posed_batched(sh, q).verts)
+
+    def ref_one(pose, si):
+        from mano_hand_tpu.serving import buckets as bm
+
+        b = bm.bucket_for(pose.shape[0], BUCKETS)
+        out = ref(shaped[si], np.asarray(bm.pad_rows(pose, b)))
+        return np.asarray(out)[:pose.shape[0]]
+
+    return {"betas": betas, "poses": poses, "ref_one": ref_one}
+
+
+def _engine(params32, prec_policy=None, **kw):
+    kw.setdefault("max_bucket", BUCKETS[-1])
+    kw.setdefault("max_delay_s", 0.001)
+    return ServingEngine(params32, precision_policy=prec_policy, **kw)
+
+
+def test_policy_validation_and_defaults(params32):
+    pol = PrecisionPolicy()
+    assert pol.dtype_for_tier(0) == "bf16"
+    # The satellite edge: a tier the policy does not name defaults f32.
+    assert pol.dtype_for_tier(1) == "f32"
+    assert pol.dtype_for_tier(7) == "f32"
+    assert pol.tiers_snapshot() == {"0": "bf16", "1": "f32"}
+    assert pol.tiers_snapshot((0, 1, 3)) == {
+        "0": "bf16", "1": "f32", "3": "f32"}
+    with pytest.raises(ValueError):
+        PrecisionPolicy(bf16_tiers=frozenset({-1}))
+    with pytest.raises(ValueError):
+        PrecisionPolicy(accumulate="bf16")
+    with pytest.raises(ValueError):
+        PrecisionPolicy(max_vertex_err_m=0.0)
+    with pytest.raises(TypeError):
+        _engine(params32, "bf16")   # a policy must be a PrecisionPolicy
+    # compute_dtype is bfloat16-or-None at the XLA entries too (the
+    # fused kernel already enforced it): float16/float64 compute must
+    # never serve under bf16-documented claims.
+    tab = core.stack_shaped(
+        [core.jit_specialize(params32.device_put(),
+                             np.zeros((params32.n_shape,), np.float32))])
+    for bad in (jnp.float16, jnp.float64):
+        with pytest.raises(ValueError):
+            core.forward_posed_gather(
+                tab, np.zeros((1,), np.int32),
+                np.zeros((1, params32.n_joints, 3), np.float32),
+                compute_dtype=bad)
+    # A policy naming NO bf16 tiers builds no bf16 family — and must
+    # not export an envelope either, or the sentinel would derive and
+    # judge bf16 goldens for a program that can never serve.
+    empty = _engine(params32, PrecisionPolicy(bf16_tiers=frozenset()))
+    with empty:
+        empty.specialize(np.zeros((params32.n_shape,), np.float32))
+        t = empty.numerics_probe_targets()
+        assert t["precision_envelope"] is None
+        assert t["gather_bf16"] == {}
+
+
+def test_tier_routing_envelope_and_zero_recompiles(params32, subjects):
+    """Tier 0 serves the bf16 family (within the envelope, genuinely
+    NOT bit-identical — a silently-f32 'bf16 tier' would be a phantom
+    lever); tier 1 on the SAME engine serves f32 bit-identically; a
+    mixed-tier burst splits by precision and the warm steady state
+    compiles nothing on either family."""
+    pol = PrecisionPolicy(max_vertex_err_m=ENVELOPE)
+    eng = _engine(params32, pol)
+    with eng:
+        keys = [eng.specialize(b) for b in subjects["betas"]]
+        eng.warmup_posed(BUCKETS)
+        warm = eng.counters.compiles
+        bf16_errs, saw_nonzero = [], False
+        for i, pose in enumerate(subjects["poses"]):
+            want = subjects["ref_one"](pose, i % 3)
+            got0 = eng.forward(pose, subject=keys[i % 3], priority=0)
+            got1 = eng.forward(pose, subject=keys[i % 3], priority=1)
+            err = float(np.abs(got0 - want).max())
+            bf16_errs.append(err)
+            saw_nonzero = saw_nonzero or err > 0.0
+            np.testing.assert_array_equal(got1, want)  # f32 tier exact
+        assert max(bf16_errs) <= ENVELOPE
+        assert saw_nonzero, "bf16 tier served f32 bits — phantom lever"
+        # Mixed-tier concurrent burst: precision-split batches, every
+        # future resolved per its own tier's family.
+        futs = [(i, eng.submit(subjects["poses"][i % 8],
+                               subject=keys[i % 3], priority=i % 2))
+                for i in range(16)]
+        for i, f in futs:
+            want = subjects["ref_one"](subjects["poses"][i % 8], i % 3)
+            got = f.result(timeout=60.0)
+            if i % 2 == 1:
+                np.testing.assert_array_equal(got, want)
+            else:
+                assert float(np.abs(got - want).max()) <= ENVELOPE
+        assert eng.counters.compiles == warm  # zero steady recompiles
+        t = eng.numerics_probe_targets()
+        assert set(t["gather"]) == set(t["gather_bf16"]) == set(BUCKETS)
+        assert t["precision_envelope"] == ENVELOPE
+
+
+def test_policyless_engine_is_pure_f32(params32, subjects):
+    """No policy = the pre-PR-14 engine: tier 0 serves f32
+    bit-identically and exports no bf16 family or precision block."""
+    eng = _engine(params32)
+    with eng:
+        keys = [eng.specialize(b) for b in subjects["betas"]]
+        eng.warmup_posed(BUCKETS)
+        for i, pose in enumerate(subjects["poses"][:4]):
+            got = eng.forward(pose, subject=keys[i % 3], priority=0)
+            np.testing.assert_array_equal(
+                got, subjects["ref_one"](pose, i % 3))
+        t = eng.numerics_probe_targets()
+        assert t["gather_bf16"] == {}
+        assert t["precision_envelope"] is None
+        assert "precision" not in eng.load()
+
+
+def test_bf16_request_through_cpu_failover(params32, subjects):
+    """A bf16 tier-0 request whose primary dispatch is persistently
+    down resolves through the CPU rung — the f32 full-path family,
+    re-run from raw betas — WITHIN the envelope (exactly: the rung is
+    f32 truth) and never crashes on a dtype mismatch."""
+    plan = ChaosPlan()
+    pol = DispatchPolicy(
+        deadline_s=10.0, retries=0, backoff_s=0.005,
+        backoff_cap_s=0.01, jitter=0.0,
+        breaker=CircuitBreaker(failure_threshold=1,
+                               probe_interval_s=60.0,
+                               respect_priority_claim=False,
+                               probe=lambda: False),
+        chaos=plan, cpu_fallback=True)
+    eng = _engine(params32, PrecisionPolicy(max_vertex_err_m=ENVELOPE),
+                  policy=pol)
+    with eng:
+        keys = [eng.specialize(b) for b in subjects["betas"]]
+        eng.warmup(BUCKETS)
+        eng.warmup_posed(BUCKETS)
+        plan.schedule("error@0-")   # every primary call fails forever
+        fails = eng.counters.failovers
+        pose = subjects["poses"][0]
+        got = eng.forward(pose, subject=keys[0], priority=0)
+        assert eng.counters.failovers > fails
+        want = subjects["ref_one"](pose, 0)
+        # The rung serves f32 FULL-path results: ~1e-8 from the posed
+        # reference (the full forward re-runs the shape stage, so the
+        # comparison is float-rounding-level, not bit-identical — the
+        # test_lanes CPU-rung precedent), far inside the envelope.
+        err = float(np.abs(got - want).max())
+        assert err <= 1e-6, err
+        assert err <= ENVELOPE
+
+
+def test_sentinel_bf16_drift_drill(params32, subjects):
+    """The whole safety case: silent corruption on the bf16 family —
+    a fault no retry/breaker/deadline sees — is caught by the
+    sentinel's ENVELOPE judgment (not f32-digest equality), raises the
+    ``numerics_drift`` incident, and recovers when the fault clears."""
+    plan = ChaosPlan()
+    pol = DispatchPolicy(deadline_s=10.0, retries=0, chaos=plan)
+    tr = Tracer()
+    eng = _engine(params32, PrecisionPolicy(max_vertex_err_m=ENVELOPE),
+                  policy=pol, tracer=tr)
+    s = NumericsSentinel(eng, tracer=tr, interval_s=3600.0)
+    with eng:
+        keys = [eng.specialize(b) for b in subjects["betas"]]
+        eng.warmup_posed(BUCKETS)
+        golden = s.arm()
+        assert golden["golden_bf16_status"] in ("match", "absent")
+        assert golden["envelope_m"] == ENVELOPE
+        clean = s.probe()
+        assert not clean["drift"]
+        rec = clean["families"]["gather_bf16"]
+        assert rec["envelope"] == ENVELOPE
+        assert 0.0 < rec["max_abs_err"] <= ENVELOPE
+        # An in-envelope reduced-precision tier is NOT drift: the bf16
+        # digest differs from any f32 digest by construction, which is
+        # exactly why the envelope is the comparator.
+        plan.schedule("wrong:1.0@0-")
+        detected = s.probe()
+        assert detected["families"]["gather_bf16"]["drift"]
+        assert "gather_bf16" in detected["drifted_families"]
+        assert detected["families"]["gather_bf16"]["max_abs_err"] \
+            > ENVELOPE
+        plan.clear()
+        recovered = s.probe()
+        assert not recovered["families"]["gather_bf16"]["drift"]
+        assert s.status()["golden_bf16_status"] in ("match", "absent")
+        assert eng.forward(subjects["poses"][0], subject=keys[0],
+                           priority=0) is not None
+    acc = tr.accounting()
+    assert acc["spans_open"] == 0
+    assert acc["incidents"] >= 1
+
+
+def test_fused_bf16_family(params32, subjects):
+    """Under ``posed_kernel="fused"`` the bf16 tier serves the fused
+    kernel's single-pass bf16 form — same program as the direct
+    ``forward_posed_gather_fused(compute_dtype=bf16)`` call (exact),
+    within the envelope of the f32 truth, zero steady recompiles."""
+    pol = PrecisionPolicy(max_vertex_err_m=ENVELOPE)
+    eng = _engine(params32, pol, posed_kernel="fused")
+    with eng:
+        keys = [eng.specialize(b) for b in subjects["betas"]]
+        eng.warmup_posed(BUCKETS)
+        warm = eng.counters.compiles
+        t = eng.numerics_probe_targets()
+        assert t["gather_fused"]
+        pose = subjects["poses"][1]
+        got = eng.forward(pose, subject=keys[1], priority=0)
+        assert eng.counters.compiles == warm
+        assert float(np.abs(got - subjects["ref_one"](pose, 1)).max()) \
+            <= ENVELOPE
+        # Same-trace exactness against the direct fused bf16 program
+        # at the matched padded size (row 1 of the dispatched bucket).
+        from mano_hand_tpu.serving import buckets as bm
+
+        b = bm.bucket_for(pose.shape[0], BUCKETS)
+        with eng._exe_lock:
+            table = eng._table
+            slot = eng._subject_slots[keys[1]]
+        direct = np.asarray(jax.jit(
+            lambda tab, i, p: core.forward_posed_gather_fused(
+                tab, i, p, interpret=True,
+                compute_dtype=jnp.bfloat16))(
+                    table, np.full((b,), slot, np.int32),
+                    np.asarray(bm.pad_rows(pose, b))))[:pose.shape[0]]
+        np.testing.assert_array_equal(got, direct)
+
+
+def test_precision_in_load_and_metrics(params32, subjects):
+    """The per-tier precision snapshot rides ``load()`` and the
+    metrics export (the PR-14 observability satellite)."""
+    from mano_hand_tpu.obs.metrics import engine_registry, load_samples
+
+    pol = PrecisionPolicy(max_vertex_err_m=ENVELOPE)
+    tr = Tracer()
+    eng = _engine(params32, pol, tracer=tr, max_queued=64,
+                  tier_quotas={2: 8})
+    s = NumericsSentinel(eng, tracer=tr, interval_s=3600.0)
+    with eng:
+        eng.specialize(subjects["betas"][0])
+        eng.warmup_posed(BUCKETS)
+        load = eng.load()
+        assert load["precision"] == {
+            "envelope_m": ENVELOPE, "accumulate": "f32",
+            "tiers": {"0": "bf16", "1": "f32", "2": "f32"}}
+        samples = load_samples(load)
+        tier_samples = samples["load_precision_tier_bf16"]["samples"]
+        assert {(labels["tier"], value)
+                for labels, value in tier_samples} == {
+                    ("0", 1.0), ("1", 0.0), ("2", 0.0)}
+        assert samples["load_precision_envelope_m"]["samples"] == [
+            [None, ENVELOPE]]
+        reg = engine_registry(eng, tracer=tr, sentinel=s)
+        s.arm()
+        snap = reg.snapshot()
+        assert snap.get("errors") is None, snap.get("errors")
+        golden = snap["metrics"]["sentinel_golden_bf16_status"]
+        assert golden["samples"][0][1] in (0, 1)   # match | absent
+        assert "load_precision_tier_bf16" in snap["metrics"]
+        assert "load_precision_envelope_m" in snap["metrics"]
+
+
+def test_lane_engine_serves_bf16_family(params32, subjects):
+    """Lanes (PR 13) carry the bf16 family per lane: a lane-mode
+    engine under a policy serves tier-0 bf16 within the envelope and
+    tier-1 f32 bit-identically, with zero steady recompiles after a
+    both-family warm-up."""
+    pol = PrecisionPolicy(max_vertex_err_m=ENVELOPE)
+    eng = _engine(params32, pol, lanes=2)
+    with eng:
+        keys = [eng.specialize(b) for b in subjects["betas"]]
+        eng.warmup_posed(BUCKETS)
+        warm = eng.counters.compiles
+        saw_nonzero = False
+        for i, pose in enumerate(subjects["poses"][:6]):
+            want = subjects["ref_one"](pose, i % 3)
+            got0 = eng.forward(pose, subject=keys[i % 3], priority=0)
+            got1 = eng.forward(pose, subject=keys[i % 3], priority=1)
+            err = float(np.abs(got0 - want).max())
+            assert err <= ENVELOPE
+            saw_nonzero = saw_nonzero or err > 0.0
+            np.testing.assert_array_equal(got1, want)
+        assert saw_nonzero
+        assert eng.counters.compiles == warm
+
+
+def test_jaxpr_dtype_policy_assertion(params32):
+    """The analysis satellite: a bf16-flagged program whose dots
+    accumulate in bf16 — or that carries no bf16 dots at all — raises
+    ``jaxpr-dtype-policy``; the committed families audit clean."""
+    from mano_hand_tpu.analysis.jaxpr_audit import (
+        ProgramSpec, audit_programs, build_program_specs,
+    )
+
+    specs = [s for s in build_program_specs() if s.bf16]
+    assert {s.name for s in specs} == {"gathered_bf16",
+                                       "gathered_fused_bf16"}
+    findings, measured = audit_programs(None, specs=specs)
+    assert not [f for f in findings if f.rule == "jaxpr-dtype-policy"], \
+        [str(f) for f in findings]
+    # A single-pass-accumulation program (bf16-in/bf16-out dots) is
+    # exactly the silent-collapse class the assertion bans.
+    bad = ProgramSpec(
+        "bad_bf16", "gathered",
+        lambda a, b: jnp.dot(a.astype(jnp.bfloat16),
+                             b.astype(jnp.bfloat16)),
+        (np.ones((8, 8), np.float32), np.ones((8, 8), np.float32)),
+        donate_argnums=(), expect_donated=(), bf16=True)
+    findings, _ = audit_programs(None, specs=[bad])
+    rules = [f.rule for f in findings]
+    assert "jaxpr-dtype-policy" in rules
+    # An f32 program mislabelled bf16 (the dropped-cast refactor) is
+    # caught by the must-contain-bf16-dots half.
+    phantom = ProgramSpec(
+        "phantom_bf16", "gathered",
+        lambda a, b: jnp.dot(a, b, precision=jax.lax.Precision.HIGHEST),
+        (np.ones((8, 8), np.float32), np.ones((8, 8), np.float32)),
+        donate_argnums=(), expect_donated=(), bf16=True)
+    findings, _ = audit_programs(None, specs=[phantom])
+    assert any(f.rule == "jaxpr-dtype-policy" and "no bf16" in f.message
+               for f in findings)
+
+
+def test_precision_bench_tiny_e2e(params32):
+    """The config17 protocol end-to-end at plumbing size: envelope
+    met, f32 control exact, zero steady recompiles, the sentinel
+    drill detecting + recovering, spans closed once."""
+    from mano_hand_tpu.serving.measure import precision_bench_run
+
+    pr = precision_bench_run(params32, subjects=3, requests=12,
+                             max_rows=2, max_bucket=4, trials=2,
+                             envelope_m=ENVELOPE)
+    assert pr["bf16_max_abs_err"] <= pr["bf16_err_envelope"]
+    assert pr["f32_control_max_abs_err"] == 0.0
+    assert pr["steady_recompiles_bf16"] == 0
+    assert pr["steady_recompiles_f32"] == 0
+    assert pr["precision_tiers"] == {"0": "bf16", "1": "f32"}
+    drl = pr["sentinel_drill"]
+    assert drl["bf16_family_detected"] and drl["recovered"]
+    assert drl["futures_resolved_fraction"] == 1.0
+    assert drl["clean_probe_drift"] is False
+    assert "numerics_drift" in drl["flight_capture_reasons"]
+    acc = drl["span_accounting"]
+    assert acc["spans_started"] == acc["spans_closed"]
+    assert acc["spans_open"] == 0
+    fr = pr["flight_record"]["accounting"]
+    assert fr["spans_started"] == fr["spans_closed"]
+    assert fr["spans_open"] == 0
