@@ -19,15 +19,16 @@
 # boundaries, which keeps the live count bounded and the suite green —
 # do not remove it. Also avoid two concurrent pytest processes on the
 # shared cache dir.
-.PHONY: check check-cold test bench-cpu bench-tpu-wait mesh-scaling \
+.PHONY: check check-cold test bench-cpu bench-tpu-wait bench-tpu-queue \
+	mesh-scaling \
 	check-quick serve-smoke specialize-smoke chaos-smoke coalesce-smoke \
 	overload-smoke coldstart-smoke obs-smoke metrics-smoke \
 	posed-kernel-smoke stream-smoke lanes-smoke precision-smoke \
-	examples-smoke analyze
+	edge-smoke examples-smoke analyze
 
 check: analyze test chaos-smoke coalesce-smoke overload-smoke \
 	coldstart-smoke obs-smoke metrics-smoke posed-kernel-smoke \
-	stream-smoke lanes-smoke precision-smoke examples-smoke
+	stream-smoke lanes-smoke precision-smoke edge-smoke examples-smoke
 
 # tests/test_runtime.py is excluded here and covered by the chaos-smoke
 # prerequisite instead (its own pytest process + cache dir): `make
@@ -50,6 +51,7 @@ test:
 	  --ignore=tests/test_streams.py \
 	  --ignore=tests/test_lanes.py \
 	  --ignore=tests/test_precision.py \
+	  --ignore=tests/test_edge.py \
 	  --ignore=tests/test_examples.py
 
 # Seconds-scale pre-commit lane: the core-correctness modules (parity vs
@@ -133,7 +135,8 @@ bench-interpret:
 	  --lane-lanes 4 --lane-requests 16 --lane-subjects 3 \
 	  --lane-workers 4 --lane-max-bucket 8 \
 	  --precision-requests 32 --precision-subjects 6 \
-	  --precision-max-bucket 16 --precision-posed-kernel fused
+	  --precision-max-bucket 16 --precision-posed-kernel fused \
+	  --edge-bursts 6 --edge-workers 8 --edge-streams 2 --edge-frames 2
 
 # Serving-leg smoke (the bench-interpret counterpart for config7): the
 # whole serving-engine plumbing — bucket warm-up, ragged request stream,
@@ -169,7 +172,12 @@ bench-interpret:
 # criteria here — envelope, f32 control, recompiles, and the bf16
 # sentinel drill are CPU-defined; the speedup ratio is recorded
 # unjudged off-chip (the config14 convention; chip leg via
-# bench-tpu-wait). The other legs are device-count-agnostic — they
+# bench-tpu-wait).
+# config18 (the loopback edge drill, PR 15) runs its acceptance leg
+# here: the PR-5 overload numbers through real sockets, stream parity,
+# disconnect-cancel, and the drain drill — every criterion CPU-defined
+# (bench-interpret sweeps the same protocol at plumbing size).
+# The other legs are device-count-agnostic — they
 # dispatch to the default device exactly as before (the test suite has
 # run on this same 8-virtual-device layout since round 1).
 serve-smoke:
@@ -185,7 +193,9 @@ serve-smoke:
 	  --lane-lanes 4 --lane-requests 96 --lane-subjects 6 \
 	  --lane-workers 8 --lane-max-bucket 16 \
 	  --precision-requests 96 --precision-subjects 8 \
-	  --precision-max-bucket 32
+	  --precision-max-bucket 32 \
+	  --edge-bursts 24 --edge-workers 24 --edge-streams 3 \
+	  --edge-frames 3
 
 # Specialization-split smoke (the quick-lane half of PR 2's tooling):
 # the seconds-scale correctness story of the shape/pose split — bit-
@@ -313,6 +323,26 @@ precision-smoke:
 	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_precision \
 	  python -m pytest tests/test_precision.py -q
 
+# Network-edge matrix (the PR-15 tentpole): the wire protocol's
+# byte-level codec (lossless arrays), one-shot forward/posed requests
+# bit-identical through a real loopback socket with QoS headers, the
+# PR-5 shed mapped to 429 + Retry-After with zero dispatches, deadline
+# -> 504, /healthz + /metrics served through the socket, 5xx bodies
+# carrying flight records, the PR-12 stream upgrade protocol with
+# frames bit-identical to in-process submit_frame, client disconnect
+# -> the PR-13 cancellation terminal (+ the caller-driven in-process
+# half that path never had) + session close, in-process AND real-
+# SIGTERM-subprocess drain drills, and the config18 drill at plumbing
+# size. Wired into `make check` as a SEPARATE pytest process on its
+# own compile-cache dir (the CLAUDE.md rule: two pytest processes must
+# never share .jax_compile_cache/ — and the SIGTERM subprocess worker
+# gets its OWN tmp cache dir inside the test for the same reason).
+# Slow-marked, so the tier-1 `-m 'not slow'` lane skips it by design
+# (the PR-8 budget precedent).
+edge-smoke:
+	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_edge \
+	  python -m pytest tests/test_edge.py -q
+
 # Every example end-to-end (tiny sizes, CPU) — the public-surface
 # anti-rot gate. Moved out of the tier-1 lane in the PR-13 budget
 # rebalance (the 21 subprocess runs were its single biggest block,
@@ -344,3 +374,24 @@ OUT ?= bench_tpu
 DEADLINE ?= 10800
 bench-tpu-wait:
 	bash scripts/bench_tpu_wait.sh $(OUT) $(DEADLINE)
+
+# Queue the still-open ON-CHIP payloads so the first tunnel-up hour
+# needs zero thinking (docs/roadmap.md PR-10/PR-14 "Open"): a default
+# bench run carries BOTH pending ratio legs — config14 (fused gathered
+# kernel + lm_e2e, judged >= 1.2x on real TPU only) and config17 (the
+# bf16-tier speedup, same convention) — inside the done-criteria-first
+# priority block, so even a minutes-long window salvages them. This
+# target just runs the builder wrapper the CLAUDE.md way: nohup'd,
+# flock-guarded, yielding to the driver's priority claim mid-attempt,
+# self-expiring at QUEUE_DEADLINE (default 12 h). Afterwards:
+#   python scripts/bench_report.py bench_tpu_queue.out   # verdict
+#   python scripts/trace_report.py bench_tpu_queue.trace # stage split
+QUEUE_OUT ?= bench_tpu_queue
+QUEUE_DEADLINE ?= 43200
+bench-tpu-queue:
+	@mkdir -p bench_results
+	nohup bash scripts/bench_tpu_wait.sh $(QUEUE_OUT) $(QUEUE_DEADLINE) \
+	  > $(QUEUE_OUT).nohup.log 2>&1 &
+	@echo "queued: scripts/bench_tpu_wait.sh $(QUEUE_OUT)" \
+	  "$(QUEUE_DEADLINE)s (nohup, flock-guarded, driver-yielding);" \
+	  "tail -f $(QUEUE_OUT).log for attempts"

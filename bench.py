@@ -592,7 +592,8 @@ def run_benchmarks(args, device_str: str) -> dict:
                                               "config14_posed_kernel",
                                               "config15_streams",
                                               "config16_lanes",
-                                              "config17_precision"):
+                                              "config17_precision",
+                                              "config18_edge"):
             return
         try:
             fn()
@@ -2395,6 +2396,54 @@ def run_benchmarks(args, device_str: str) -> dict:
     if args.precision_requests > 0:
         section("config17_precision", config17_precision)
 
+    # -- config 18: loopback edge drill (PR 15) -----------------------------
+    # THE network-edge protocol (serving/measure.py:edge_drill_run): a
+    # live edge.EdgeServer over the saturated engine, driven through
+    # real loopback sockets — the PR-5 overload acceptance numbers
+    # reproduced THROUGH the wire (every request an HTTP terminal
+    # within budget, tier-0 goodput >= 95% at >= 3x achieved
+    # saturation, shed decisions still O(µs) engine-side with every
+    # one mapped to 429 + Retry-After, zero steady recompiles), plus
+    # the wire-only legs: stream frames bit-identical to in-process
+    # submit_frame, client disconnect -> future.cancel() (terminal
+    # kind "cancelled") + session close, SIGTERM-path drain with
+    # requests in flight, and /healthz + /metrics scraped through the
+    # socket. Criteria (scripts/bench_report.py:judge_edge) are all
+    # CPU-defined: saturation is throttled in-process and the sockets
+    # are loopback — no chip required, none harmed.
+    def config18_edge():
+        from mano_hand_tpu.serving.measure import edge_drill_run
+
+        ed = edge_drill_run(
+            right,
+            saturation=args.edge_saturation,
+            bursts=args.edge_bursts,
+            workers=args.edge_workers,
+            streams=args.edge_streams,
+            frames_per_stream=args.edge_frames,
+            max_bucket=args.edge_max_bucket,
+            seed=47,
+            log=lambda m: log(f"config18 {m}"),
+        )
+        results["edge"] = ed
+        oc = ed["outcomes"]
+        acc = ed["span_accounting"]
+        log(f"config18 edge: {ed['submitted']} wire requests at "
+            f"{ed['saturation_achieved']}x achieved -> "
+            f"{ed['wire_resolved_within_budget_fraction']:.0%} in "
+            f"budget ({oc['ok']} ok / {oc['shed']} shed / "
+            f"{oc['expired']} expired / {oc['unresolved']} "
+            f"unresolved), tier-0 goodput {ed['tier0_goodput']}, "
+            f"stream parity err "
+            f"{ed['stream']['wire_vs_inprocess_max_abs_err']}, "
+            f"disconnect cancelled {ed['disconnect']['cancelled_total']}"
+            f", drain {ed['drain']['drain_wall_s']}s, "
+            f"{ed['steady_recompiles']} steady recompiles, spans "
+            f"{acc['spans_closed']}/{acc['spans_started']}")
+
+    if args.edge_bursts > 0:
+        section("config18_edge", config18_edge)
+
     if args.serving_only:
         # Fast serving-layer artifact (`make serve-smoke`): the deferred
         # runner's serving-only skip reduces the schedule to config7
@@ -2783,6 +2832,35 @@ def main() -> int:
                          "bench-interpret sweeps the fused form for "
                          "plumbing coverage (drill + parity judge "
                          "branch must not debut on the chip)")
+    ap.add_argument("--edge-bursts", type=int, default=24,
+                    help="arrival bursts of the loopback edge drill "
+                         "(config18, PR 15: the PR-5 overload criteria "
+                         "through real sockets + the stream/disconnect/"
+                         "drain wire legs; saturation is throttled "
+                         "in-process, sockets are loopback — no chip "
+                         "involved; 0 skips the leg)")
+    ap.add_argument("--edge-workers", type=int, default=24,
+                    help="wire-client worker pool of config18 (one "
+                         "persistent connection each; must exceed the "
+                         "drill's max_queued or overload can never "
+                         "materialize through the blocking clients)")
+    ap.add_argument("--edge-streams", type=int, default=3,
+                    help="config18 stream-parity sessions (frames "
+                         "through the upgrade protocol, judged "
+                         "bit-identical to in-process submit_frame)")
+    ap.add_argument("--edge-frames", type=int, default=3,
+                    help="frames per config18 stream (>= 2: settle + "
+                         "parity)")
+    ap.add_argument("--edge-max-bucket", type=int, default=8,
+                    help="largest power-of-two bucket of the config18 "
+                         "engines")
+    ap.add_argument("--edge-saturation", type=float, default=5.0,
+                    help="offered-load multiple of the socket-"
+                         "calibrated service rate in config18 (the "
+                         "goodput criterion is judged at >= 3x "
+                         "achieved; the wire's blocking clients "
+                         "compress bursts, so the target carries "
+                         "headroom over the floor)")
     ap.add_argument("--spec-batch", type=int, default=256,
                     help="batch for the specialization leg's full-vs-"
                          "pose-only forward comparison (config8); "

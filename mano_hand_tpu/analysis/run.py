@@ -61,10 +61,17 @@ def run_analysis(
     # checked like the obs/ classes.
     locks += check_lock_discipline(
         root / "mano_hand_tpu" / "serving" / "lanes.py", order=())
+    # PR 15: the network edge — the server's connection/drain state and
+    # the stream frame-future's cancel-forwarding lock (streams.py's
+    # _FrameFuture is covered by the streams pass above; edge/ holds
+    # no engine locks, and the policy linter scans it via the package
+    # rglob like every other subsystem).
+    for p in sorted((root / "mano_hand_tpu" / "edge").glob("*.py")):
+        locks += check_lock_discipline(p, order=())
     sections.append(("lock-discipline", locks,
                      "serving/engine.py + serving/streams.py + "
-                     "serving/lanes.py + obs/ nesting graphs + call "
-                     "edges"))
+                     "serving/lanes.py + edge/ + obs/ nesting graphs "
+                     "+ call edges"))
 
     step = check_lockstep(baseline.get("lockstep", {}))
     stale_note = lockstep_stale(baseline.get("lockstep", {}))

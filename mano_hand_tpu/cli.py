@@ -1313,6 +1313,101 @@ def cmd_serve_bench(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """`mano serve` — the network edge (PR 15): one worker process
+    exposing a ``ServingEngine`` over the edge wire protocol
+    (edge/server.py): ``POST /v1/forward`` (+ ``/v1/specialize``) with
+    QoS headers, the PR-12 stream upgrade, 429 + Retry-After
+    backpressure, ``/metrics`` (PR-9 Prometheus text) and ``/healthz``,
+    flight-record-bearing 5xx bodies, and graceful drain on
+    SIGTERM/SIGINT via the engine's ``stop(timeout_s=)`` sweep.
+
+    Multi-worker coexistence: by default (``--device-lock auto``) a
+    worker on a device backend takes the SHARED device lock
+    (``utils.devicelock.DeviceLock(role="server")``) — N workers
+    coexist, a driver bench's priority claim makes new workers stand
+    down (rc 2), and a CPU-pinned worker takes no lock at all (the
+    bench-interpret precedent: never preempt a real builder pipeline
+    from a harness that cannot touch the chip).
+
+    stdout carries exactly two JSON lines: a ready line at bind time
+    (host/port/pid — the SIGTERM drill and orchestrators parse it)
+    and a final drain report at exit; logs go to stderr.
+    """
+    import contextlib
+    import os
+    import signal
+    import threading
+
+    from mano_hand_tpu.edge import EdgeServer
+    from mano_hand_tpu.obs import Tracer
+    from mano_hand_tpu.obs.metrics import engine_registry
+    from mano_hand_tpu.obs.recorder import FlightRecorder
+    from mano_hand_tpu.serving.engine import ServingEngine
+    from mano_hand_tpu.utils.devicelock import DeviceBusy, DeviceLock
+
+    params = _load_params(args.asset, args.side).astype(np.float32)
+    tracer = Tracer()
+    tier_quotas = ({1: args.tier1_quota}
+                   if args.max_queued and args.tier1_quota else None)
+    eng = ServingEngine(
+        params,
+        max_bucket=args.max_bucket,
+        max_delay_s=args.max_delay_ms / 1e3,
+        aot_dir=args.aot_dir or None,
+        max_queued=args.max_queued or None,
+        tier_quotas=tier_quotas,
+        lanes=args.lanes or None,
+        posed_kernel=args.posed_kernel,
+        tracer=tracer,
+    )
+    recorder = FlightRecorder(tracer, eng.counters,
+                              out_dir=args.flight_dir or None)
+    registry = engine_registry(eng, tracer=tracer)
+
+    lock_mode = args.device_lock
+    if lock_mode == "auto":
+        lock_mode = "off" if args.platform == "cpu" else "server"
+    lock_ctx = (DeviceLock("server", log=lambda m: print(
+        m, file=sys.stderr)) if lock_mode == "server"
+        else contextlib.nullcontext())
+
+    stop_evt = threading.Event()
+
+    def _on_signal(signum, frame):
+        print(f"signal {signum}: draining", file=sys.stderr)
+        stop_evt.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    try:
+        with lock_ctx:
+            eng.start()
+            if not args.no_warmup:
+                eng.warmup()
+            srv = EdgeServer(
+                eng, host=args.host, port=args.port, registry=registry,
+                drain_timeout_s=args.drain_timeout_s,
+                log=lambda m: print(m, file=sys.stderr)).start()
+            print(json.dumps({
+                "edge": {"host": srv.host, "port": srv.port,
+                         "pid": os.getpid(),
+                         "device_lock": lock_mode}}), flush=True)
+            # Interruptible wait: the signal handler runs on this main
+            # thread between wait windows (a bare Event.wait can sit
+            # in one C-level acquire).
+            while not stop_evt.wait(0.5):
+                pass
+            report = srv.drain(timeout_s=args.drain_timeout_s)
+            report["incident_captures"] = len(recorder.captures)
+            print(json.dumps({"edge_exit": report}), flush=True)
+    except DeviceBusy as e:
+        print(f"device busy: {e}", file=sys.stderr)
+        return 2
+    return 0
+
+
 def cmd_trace_report(args) -> int:
     """`mano trace-report` — the CLI spelling of
     scripts/trace_report.py (PR 8): one merged host+device timeline
@@ -1426,6 +1521,44 @@ def cmd_status(args) -> int:
             degraded = True
         probes[plat] = entry
 
+    server_block = None
+    if args.server:
+        # PR 15: probe a live edge worker. Bounded (EdgeClient's
+        # socket timeout covers connect and every read) and degrading
+        # (any failure is a fact in the report, not a crash): status
+        # is a report, not a gate — rc stays 0 either way.
+        from urllib.parse import urlparse
+
+        from mano_hand_tpu.edge import EdgeClient
+
+        spec = (args.server if "//" in args.server
+                else f"http://{args.server}")
+        u = urlparse(spec)
+        server_block = {"url": args.server, "ok": False}
+        cli = EdgeClient(u.hostname or "127.0.0.1", u.port or 8077,
+                         timeout_s=args.server_timeout)
+        try:
+            h = cli.healthz()
+            server_block["ok"] = bool(h.get("ok"))
+            server_block["healthz"] = {
+                k: h.get(k) for k in ("status", "degraded",
+                                      "uptime_s", "breaker", "lanes")}
+            server_block["engine"] = h.get("engine")
+            server_block["streams"] = h.get("streams")
+            try:
+                text = cli.metrics_text()
+                server_block["metrics"] = {
+                    "lines": len(text.splitlines()),
+                    "has_serving": "mano_serving_" in text,
+                }
+            except Exception as e:  # noqa: BLE001 — degrade per leg
+                server_block["metrics"] = {
+                    "error": f"{type(e).__name__}: {e}"}
+        except Exception as e:  # noqa: BLE001 — down/hung server
+            server_block["error"] = f"{type(e).__name__}: {e}"
+        finally:
+            cli.close()
+
     gpath = default_goldens_path()
     goldens = load_goldens(gpath)
     report = {
@@ -1444,6 +1577,8 @@ def cmd_status(args) -> int:
             "device probe failed/hung — host-only report (the tunnel "
             "is probably down; serving degrades to the CPU tier, see "
             "runtime/health.py)")
+    if server_block is not None:
+        report["server"] = server_block
     if metrics_info is not None:
         report["metrics"] = metrics_info
     if metrics_snap is not None:
@@ -1888,6 +2023,58 @@ def build_parser() -> argparse.ArgumentParser:
     sb.add_argument("--seed", type=int, default=0)
     sb.set_defaults(fn=cmd_serve_bench)
 
+    sv = sub.add_parser(
+        "serve",
+        help="serve the engine over the edge wire protocol (PR 15): "
+             "forward/stream endpoints with QoS headers, 429 "
+             "backpressure, /metrics + /healthz, SIGTERM drain")
+    sv.add_argument("--host", default="127.0.0.1",
+                    help="bind address (default loopback — fronting a "
+                         "real network is the proxy's job)")
+    sv.add_argument("--port", type=int, default=8077,
+                    help="bind port (0 = ephemeral; the bound port is "
+                         "in the stdout ready line)")
+    sv.add_argument("--asset", default="synthetic")
+    sv.add_argument("--side", default=None,
+                    choices=[None, "left", "right", "neutral"])
+    sv.add_argument("--max-bucket", type=int, default=64)
+    sv.add_argument("--max-delay-ms", type=float, default=2.0,
+                    help="coalesce window (the latency/throughput "
+                         "knob)")
+    sv.add_argument("--max-queued", type=int, default=256,
+                    help="bounded admission (PR 5): outstanding cap; "
+                         "0 = unbounded (429s never fire)")
+    sv.add_argument("--tier1-quota", type=int, default=0,
+                    help="tier-1 admission quota (0 = the PR-5 "
+                         "default: half of max-queued)")
+    sv.add_argument("--lanes", type=int, default=0,
+                    help="per-device dispatch lanes (PR 13; 0 = "
+                         "single-device dispatch)")
+    sv.add_argument("--posed-kernel", default="xla",
+                    choices=["xla", "fused"],
+                    help="gathered pose-only program family (PR 10)")
+    sv.add_argument("--aot-dir", default="",
+                    help="executable lattice dir (PR 6) for zero-"
+                         "compile boot")
+    sv.add_argument("--no-warmup", action="store_true",
+                    help="skip the boot-time bucket warmup (compiles "
+                         "then land in the first requests)")
+    sv.add_argument("--drain-timeout-s", type=float, default=15.0,
+                    help="SIGTERM drain budget: in-flight requests "
+                         "resolve, the engine stop() sweep runs, the "
+                         "process exits inside this window")
+    sv.add_argument("--flight-dir", default="",
+                    help="persist flight-recorder incident captures "
+                         "here (default: in-memory only)")
+    sv.add_argument("--device-lock", default="auto",
+                    choices=["auto", "server", "off"],
+                    help="multi-worker coexistence: 'server' takes "
+                         "the SHARED device lock (N workers coexist; "
+                         "a driver bench claim -> rc 2); 'auto' = "
+                         "server on device backends, off when "
+                         "--platform cpu pins the host")
+    sv.set_defaults(fn=cmd_serve)
+
     tr = sub.add_parser(
         "trace-report",
         help="summarize an XLA --profile capture and/or an engine span "
@@ -1919,6 +2106,15 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--probe-timeout", type=float, default=20.0,
                     help="per-platform probe deadline in seconds; a "
                          "hung probe is SIGKILLed at the deadline")
+    st.add_argument("--server", default="",
+                    help="probe a running edge worker (PR 15): hit "
+                         "its /healthz + /metrics with a bounded "
+                         "timeout and fold the answer into the "
+                         "report; a down/hung server degrades the "
+                         "block (rc stays 0, never hangs — the "
+                         "tunnel-probe contract)")
+    st.add_argument("--server-timeout", type=float, default=3.0,
+                    help="per-read bound on the --server probe")
     st.add_argument("--metrics-dir", default="",
                     help="read the metrics.json a `serve-bench "
                          "--metrics DIR` run persisted and include it "

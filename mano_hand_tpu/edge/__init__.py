@@ -1,0 +1,27 @@
+"""The network edge (PR 15): ``ServingEngine`` behind a wire protocol.
+
+* ``protocol`` — the shared byte-level conventions (lossless array
+  encoding, QoS headers, kind -> status mapping, Retry-After policy,
+  the stream-upgrade NDJSON vocabulary);
+* ``server.EdgeServer`` — the thin asyncio HTTP front-end process
+  (`mano serve` is its CLI);
+* ``client.EdgeClient`` / ``client.EdgeStreamClient`` — the bounded
+  stdlib client the config18 drill, tests, and `mano status --server`
+  share.
+"""
+
+from mano_hand_tpu.edge.client import (  # noqa: F401
+    EdgeClient,
+    EdgeError,
+    EdgeStreamClient,
+    FrameReply,
+)
+from mano_hand_tpu.edge.server import EdgeServer  # noqa: F401
+
+__all__ = [
+    "EdgeClient",
+    "EdgeError",
+    "EdgeServer",
+    "EdgeStreamClient",
+    "FrameReply",
+]

@@ -309,14 +309,35 @@ def export_metrics_dir(snapshot: dict, out_dir, slo: Optional[dict]
     return out
 
 
+def _prom_name(name: str) -> str:
+    """Exposition-safe metric/label NAME: once requests arrive over
+    the wire (PR 15), bucket/kind/subject strings are user-influenced
+    and may reach a label key or a reloaded snapshot's metric name —
+    anything outside ``[a-zA-Z_][a-zA-Z0-9_]*`` is folded to ``_`` so
+    the text format stays parseable (values are escaped, names cannot
+    be)."""
+    name = re.sub(r"[^a-zA-Z0-9_]", "_", str(name))
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _prom_escape(value: str) -> str:
+    """Exposition-format label-value escaping: ``\\`` first (so the
+    escapes below cannot be double-escaped), then ``"`` and newlines;
+    a bare CR is folded into the newline escape — the format is
+    line-delimited and an unescaped CR would tear a sample line in
+    CRLF-aware parsers."""
+    value = str(value).replace("\\", "\\\\").replace('"', '\\"')
+    value = value.replace("\r\n", "\n").replace("\r", "\n")
+    return value.replace("\n", "\\n")
+
+
 def _prom_labels(labels: Optional[dict]) -> str:
     if not labels:
         return ""
-    parts = []
-    for k, v in sorted(labels.items()):
-        val = str(v).replace("\\", "\\\\").replace('"', '\\"')
-        val = val.replace("\n", "\\n")
-        parts.append(f'{k}="{val}"')
+    parts = [f'{_prom_name(k)}="{_prom_escape(v)}"'
+             for k, v in sorted(labels.items())]
     return "{" + ",".join(parts) + "}"
 
 
@@ -325,7 +346,7 @@ def prometheus_text(snapshot: dict) -> str:
     ``serve-bench --metrics DIR`` run persisted) as Prometheus text
     exposition. Pure function of the snapshot, so `mano status` can
     serve the text form without the process that owned the registry."""
-    ns = snapshot.get("namespace", "mano")
+    ns = _prom_name(snapshot.get("namespace", "mano"))
     lines: List[str] = []
     # "quantile" summaries render as untyped gauges per-quantile —
     # prometheus's native summary type requires _sum/_count pairs this
@@ -334,9 +355,14 @@ def prometheus_text(snapshot: dict) -> str:
                 "quantile": "gauge"}
     for name in sorted(snapshot.get("metrics", {})):
         m = snapshot["metrics"][name]
-        full = f"{ns}_{name}"
+        # Re-sanitize here, not just at registration: this renderer
+        # also serves snapshots RE-LOADED from disk (`mano status
+        # --prom`) whose names never passed _check_name.
+        full = f"{ns}_{_prom_name(name)}"
         if m.get("help"):
-            esc = str(m["help"]).replace("\\", "\\\\").replace("\n", " ")
+            esc = str(m["help"]).replace("\\", "\\\\")
+            esc = esc.replace("\r\n", " ").replace("\r", " ")
+            esc = esc.replace("\n", " ")
             lines.append(f"# HELP {full} {esc}")
         lines.append(f"# TYPE {full} {type_map.get(m.get('type'), 'gauge')}")
         for labels, value in m.get("samples", []):

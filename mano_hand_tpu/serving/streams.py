@@ -67,7 +67,7 @@ from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import NamedTuple, Optional
 
 import numpy as np
@@ -103,6 +103,46 @@ def empty_snapshot() -> dict:
     load() result would corrupt every later snapshot)."""
     return {**EMPTY_SNAPSHOT, "closed_by_kind": {},
             "frames_by_kind": {}}
+
+
+class _FrameFuture(Future):
+    """The Future ``submit_frame`` hands out, with caller cancellation
+    FORWARDED to the engine-level request future (PR 13's
+    ``_CancellableFuture``): a network edge whose client disconnects
+    mid-frame calls ``cancel()`` here, and the engine's cancel
+    bookkeeping fires — admission slot freed, request span closed as
+    terminal kind ``cancelled``, the dispatch boundary skips the work.
+    Without the forwarding, cancelling the frame future would strand
+    the underlying engine request until its deadline sweep.
+
+    ``_attach`` is called once the serving dispatch exists; a cancel
+    landing BEFORE that (the fit is still running in the submitter's
+    thread) is honored at attach time — the engine request is
+    cancelled the instant it is created.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._vfut: Optional[Future] = None
+        self._vlock = threading.Lock()
+
+    def _attach(self, vfut: Future) -> None:
+        with self._vlock:
+            self._vfut = vfut
+            cancelled = self.cancelled()
+        if cancelled:
+            vfut.cancel()
+
+    def cancel(self) -> bool:
+        if not super().cancel():
+            return False
+        with self._vlock:
+            vfut = self._vfut
+        if vfut is not None:
+            # Outside _vlock: the engine-side hook does counter/span
+            # work that must never run under a streams-layer lock.
+            vfut.cancel()
+        return True
 
 
 class FrameResult(NamedTuple):
@@ -176,6 +216,9 @@ class StreamSession:
         never raised from here, never stranded — except a frame sent
         to a stream already at a terminal, which raises immediately
         (kind="shed", phase="stream": the session refused admission).
+        Cancelling the returned future forwards to the engine request
+        (PR 13 — the network edge's client-disconnect path): the
+        frame resolves CANCELLED and the ledger records the terminal.
         """
         eng = self._mgr.engine
         if deadline_s is _UNSET:
@@ -184,7 +227,7 @@ class StreamSession:
         tr = eng.tracer
         if tr is not None:
             tr.event(self.span, "frame", n=fid)
-        fut: Future = Future()
+        fut: Future = _FrameFuture()
         deadline = (None if deadline_s is None
                     else time.monotonic() + float(deadline_s))
         loss = float("nan")
@@ -221,18 +264,35 @@ class StreamSession:
             return fut
 
         def _resolve(f, pose=pose, loss=loss, fid=fid):
+            if f.cancelled():
+                # PR-13 caller cancellation (forwarded by _FrameFuture
+                # or aimed at the engine future directly): the engine
+                # already freed the slot and closed the request span as
+                # ``cancelled``; mirror the terminal on the frame
+                # future + session ledger.
+                fut.cancel()
+                self._mgr.frame_done(self, fid, "cancelled")
+                return
             exc = f.exception()
-            if exc is None:
-                fut.set_result(FrameResult(
-                    pose=pose, verts=f.result(), fit_loss=loss,
-                    frame=fid))
-                kind = "ok"
-            else:
-                fut.set_exception(exc)
-                kind = (exc.kind if isinstance(exc, ServingError)
-                        else "error")
+            try:
+                if exc is None:
+                    fut.set_result(FrameResult(
+                        pose=pose, verts=f.result(), fit_loss=loss,
+                        frame=fid))
+                    kind = "ok"
+                else:
+                    fut.set_exception(exc)
+                    kind = (exc.kind if isinstance(exc, ServingError)
+                            else "error")
+            except InvalidStateError:
+                # The frame future was cancelled in the gap between
+                # the cancelled() check and resolution: the result is
+                # discarded (the late-result discipline) and the frame
+                # records the caller's terminal.
+                kind = "cancelled"
             self._mgr.frame_done(self, fid, kind)
 
+        fut._attach(vfut)
         vfut.add_done_callback(_resolve)
         return fut
 

@@ -64,15 +64,23 @@ class DeviceLock:
     """``with DeviceLock(role, ...):`` around any device-touching bench.
 
     role="driver": writes the priority claim, waits up to ``wait_s`` for
-    the flock (refreshing the claim so builders keep standing down),
-    then proceeds with or without it.
+    the EXCLUSIVE flock (refreshing the claim so builders keep standing
+    down), then proceeds with or without it.
     role="builder": raises DeviceBusy if a fresh driver claim exists or
     the flock is held — never waits, never blocks a driver.
+    role="server" (PR 15 — edge-worker coexistence): takes a SHARED
+    flock, so N `mano serve` workers coexist on the device while any
+    bench's exclusive lock still excludes them all. Like a builder it
+    never waits and stands down for a fresh driver claim or a running
+    exclusive bench; unlike a builder it does not conflict with its
+    sibling servers. A driver arriving while servers hold shared locks
+    rides its existing advisory wait (workers are expected to drain on
+    the operator's SIGTERM well inside that window).
     """
 
     def __init__(self, role: str = "driver", wait_s: float = 1200.0,
                  log=lambda m: None):
-        if role not in ("driver", "builder"):
+        if role not in ("driver", "builder", "server"):
             raise ValueError(f"unknown role {role!r}")
         self.role = role
         self.wait_s = wait_s
@@ -90,12 +98,30 @@ class DeviceLock:
 
     def __enter__(self) -> "DeviceLock":
         os.makedirs(_LOCK_DIR, exist_ok=True)
-        if self.role == "builder" and priority_claim_active():
+        if self.role in ("builder", "server") and priority_claim_active():
             raise DeviceBusy(
                 f"driver priority claim at {CLAIM_PATH} is fresh "
-                f"(age {_claim_age_s():.0f}s) — builder stands down")
+                f"(age {_claim_age_s():.0f}s) — {self.role} stands down")
         if self.role == "driver":
             self._write_claim()
+        if self.role == "server":
+            # Shared mode: open append (never clobber an exclusive
+            # holder's info line) and LOCK_SH so sibling servers
+            # coexist; an exclusive bench lock refuses us.
+            self._fd = open(LOCK_PATH, "a")
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_SH | fcntl.LOCK_NB)
+            except OSError as e:
+                self._fd.close()
+                self._fd = None
+                if e.errno not in (errno.EAGAIN, errno.EACCES):
+                    raise
+                raise DeviceBusy(
+                    "device lock held exclusively by a bench — server "
+                    "worker stands down") from None
+            self._locked = True
+            self.log("device lock acquired (server, shared)")
+            return self
         self._fd = open(LOCK_PATH, "w")
         # Monotonic deadline arithmetic: an NTP step or suspend/resume
         # during the (up to 20-minute) wait must not make the driver

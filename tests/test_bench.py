@@ -284,6 +284,16 @@ def test_bench_cpu_tiny_run_end_to_end():
         # e2e in `make precision-smoke`, and the criteria-sized run
         # in `make serve-smoke`.
         "--precision-requests", "0",
+        # config18 (PR 15) is SKIPPED here too, not shrunk: the edge
+        # drill stands up four engines (probe, saturated, disconnect,
+        # plus in-process stream references) and its stream-parity leg
+        # pays the frozen-shape tracker's cold scan compiles against
+        # this test's fresh per-run bench cache (the config13/15/16/17
+        # budget reasoning, again). Its plumbing runs in `make
+        # bench-interpret` (--edge-bursts 6), its e2e in `make
+        # edge-smoke`, and the criteria-sized drill in `make
+        # serve-smoke`.
+        "--edge-bursts", "0",
     )
     assert rc == 0, line
     assert line["value"] is not None and line["value"] > 0
@@ -326,6 +336,9 @@ def test_bench_cpu_tiny_run_end_to_end():
     # config17 (PR 14) likewise: skipped by flag, so the precision
     # block must be absent, not failed.
     assert "precision" not in d
+    # config18 (PR 15) likewise: skipped by flag (edge-smoke /
+    # bench-interpret / serve-smoke carry it).
+    assert "edge" not in d
     assert "config_errors" not in line, line.get("config_errors")
 
 

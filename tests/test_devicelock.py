@@ -127,3 +127,41 @@ def test_exit_preserves_foreign_claim():
     assert os.path.exists(devicelock.CLAIM_PATH), \
         "exit removed a claim it does not own"
     os.remove(devicelock.CLAIM_PATH)
+
+
+def test_server_role_shared_coexistence():
+    # PR 15: N edge workers coexist on the shared lock...
+    with DeviceLock("server") as a:
+        assert a._locked
+        with DeviceLock("server") as b:
+            assert b._locked
+            # ...while a bench's exclusive lock is refused while any
+            # worker holds its shared one (builder never waits).
+            with pytest.raises(DeviceBusy):
+                DeviceLock("builder").__enter__()
+
+
+def test_server_stands_down_on_fresh_driver_claim():
+    with open(devicelock.CLAIM_PATH, "w") as f:
+        f.write("{}")
+    with pytest.raises(DeviceBusy, match="server stands down"):
+        DeviceLock("server").__enter__()
+
+
+def test_server_refused_while_exclusive_bench_runs():
+    holder = DeviceLock("driver", wait_s=5.0)
+    holder.__enter__()
+    os.remove(devicelock.CLAIM_PATH)  # claimless exclusive holder
+    try:
+        with pytest.raises(DeviceBusy, match="held exclusively"):
+            DeviceLock("server").__enter__()
+    finally:
+        holder.__exit__()
+
+
+def test_server_exit_releases_shared_lock():
+    with DeviceLock("server"):
+        pass
+    # The exclusive path must be clean again after all servers exit.
+    with DeviceLock("driver", wait_s=5.0) as lk:
+        assert lk._locked

@@ -1,0 +1,487 @@
+"""The network edge (PR 15): ServingEngine behind the wire protocol.
+
+The serialization boundary under test is real — every assertion here
+crosses a loopback socket into a live ``edge.EdgeServer`` — and the
+bars are the in-process ones: wire results BIT-identical to ``submit``
+/ ``submit_frame``, the PR-5 shed mapped to 429 + per-tier Retry-After
+in O(µs) engine-side, deadlines to 504, a client disconnect landing
+the PR-13 cancellation terminal (this module is the caller-driven e2e
+exerciser that path never had) and closing the stream session, SIGTERM
+drain resolving in-flight work while refusing new connections, and the
+PR-9 scrape surfaces served through the socket.
+
+Canonical runner: `make edge-smoke` (own pytest process +
+compile-cache dir, wired into `make check`) — slow-marked, so the
+tier-1 `-m 'not slow'` lane skips it by design (the PR-8 budget
+precedent); `make test` --ignore's it for the same reason.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mano_hand_tpu.edge import (
+    EdgeClient,
+    EdgeError,
+    EdgeServer,
+    protocol as proto,
+)
+from mano_hand_tpu.models import core
+from mano_hand_tpu.obs import Tracer
+from mano_hand_tpu.runtime.chaos import ChaosPlan
+from mano_hand_tpu.runtime.supervise import DispatchPolicy
+from mano_hand_tpu.serving.engine import ServingEngine, ServingError
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+@pytest.fixture()
+def served(params32):
+    """A started engine + edge server + client, drained at teardown."""
+    tracer = Tracer()
+    eng = ServingEngine(params32, max_bucket=4, max_delay_s=0.001,
+                        max_queued=16, tracer=tracer)
+    eng.start()
+    srv = EdgeServer(eng, port=0).start()
+    cli = EdgeClient("127.0.0.1", srv.port, timeout_s=120.0)
+    yield eng, srv, cli, tracer
+    cli.close()
+    srv.drain(timeout_s=10.0)
+    acc = tracer.accounting()
+    # The cross-cutting PR-8 criterion: nothing any test did over the
+    # wire may leak a span.
+    assert acc["spans_started"] == acc["spans_closed"]
+    assert acc["spans_open"] == 0
+
+
+def _pose(rows=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(scale=0.4, size=(rows, 16, 3)).astype(np.float32)
+
+
+def _betas(seed=1):
+    return np.random.default_rng(seed).normal(size=(10,)).astype(
+        np.float32)
+
+
+def _target(params32, betas, seed=2):
+    pose = np.random.default_rng(seed).normal(
+        scale=0.2, size=(16, 3)).astype(np.float32)
+    out = core.jit_forward(params32.device_put(), jnp.asarray(pose),
+                           jnp.asarray(betas))
+    return np.asarray(out.posed_joints)
+
+
+# ------------------------------------------------------------- protocol
+def test_array_codec_lossless_roundtrip():
+    rng = np.random.default_rng(0)
+    for arr in (rng.normal(size=(3, 16, 3)).astype(np.float32),
+                rng.normal(size=(10,)).astype(np.float32),
+                np.float32(rng.normal(size=(2, 2)) * 1e-30),
+                np.arange(6, dtype=np.int64).reshape(2, 3)):
+        dec = proto.decode_array(proto.encode_array(arr))
+        assert dec.dtype == arr.dtype
+        assert np.array_equal(dec, arr)     # bitwise, not allclose
+
+
+def test_array_codec_rejects_malformed():
+    with pytest.raises(ValueError):
+        proto.decode_array({"b64": "!!!", "shape": [1], "dtype": "f4"})
+    ok = proto.encode_array(np.zeros(4, np.float32))
+    with pytest.raises(ValueError, match="size mismatch"):
+        proto.decode_array({**ok, "shape": [5]})
+    with pytest.raises(ValueError):
+        proto.decode_array("not a dict")
+
+
+def test_retry_after_policy_tiers():
+    # Tier 0 retries soonest; lower tiers wait longer; a hard-shedding
+    # tier gets the extra second over a merely busy one.
+    assert proto.retry_after_s(0) == 1
+    assert proto.retry_after_s(2) == 3
+    load = {"admission": {"1": "shed"}}
+    assert proto.retry_after_s(1, load) == proto.retry_after_s(1) + 1
+
+
+# ------------------------------------------------------------- one-shots
+def test_forward_bitwise_and_qos_headers(served):
+    eng, _srv, cli, _tr = served
+    pose = _pose(rows=2)
+    wire = cli.forward(pose, priority=0, deadline_s=30.0)
+    inproc = eng.forward(pose)
+    assert np.array_equal(wire, inproc)     # bit-identical through wire
+    # Squeeze semantics survive serialization: [J,3] -> [V,3].
+    single = cli.forward(pose[0])
+    assert single.shape == (778, 3)
+    assert np.array_equal(single, wire[0])
+
+
+def test_posed_subject_path_over_wire(served):
+    eng, _srv, cli, _tr = served
+    betas = _betas()
+    key = cli.specialize(betas)
+    assert key == eng.specialize(betas)     # same digest either side
+    pose = _pose(rows=1, seed=3)
+    assert np.array_equal(cli.forward(pose, subject=key),
+                          eng.forward(pose, subject=key))
+
+
+def test_caller_errors_map_400(served):
+    _eng, srv, cli, _tr = served
+    with pytest.raises(EdgeError) as ei:
+        cli.forward(np.zeros((2, 7, 3), np.float32))   # bad joint count
+    assert ei.value.status == 400
+    with pytest.raises(EdgeError) as ei:
+        cli.forward(_pose(1), subject="no-such-subject")
+    assert ei.value.status == 400
+    # Unknown route: structured 404, the connection stays usable.
+    status, _h, _b = cli._request("GET", "/nope")
+    assert status == 404
+    assert cli.healthz()["ok"]
+
+
+def test_shed_maps_429_with_retry_after_and_no_dispatch(params32):
+    tracer = Tracer()
+    probe = ServingEngine(params32, max_bucket=4, max_queued=0,
+                          tracer=tracer)
+    srv = EdgeServer(probe, port=0).start()
+    cli = EdgeClient("127.0.0.1", srv.port, timeout_s=30.0)
+    for tier in (0, 1, 3):
+        with pytest.raises(EdgeError) as ei:
+            cli.forward(_pose(1), priority=tier, deadline_s=1.0)
+        assert ei.value.status == 429
+        assert ei.value.kind == "shed"
+        assert ei.value.retry_after_s >= 1
+    # The PR-5 contract through the socket: the decision was pure
+    # admission bookkeeping — no dispatcher, no device, no params.
+    assert probe.counters.dispatches == 0
+    assert probe._thread is None
+    assert probe._params_dev is None
+    cli.close()
+    srv.drain(timeout_s=5.0)
+
+
+def test_expired_deadline_maps_504(served):
+    _eng, _srv, cli, _tr = served
+    with pytest.raises(EdgeError) as ei:
+        cli.forward(_pose(1), deadline_s=0.0)   # born expired
+    assert ei.value.status == 504
+    assert ei.value.kind == "expired"
+
+
+def test_healthz_and_metrics_through_socket(served):
+    eng, _srv, cli, _tr = served
+    eng.forward(_pose(1))                   # some traffic to report
+    h = cli.healthz()
+    assert h["ok"] and h["status"] == "serving"
+    assert h["engine"]["max_queued"] == 16
+    text = cli.metrics_text()
+    assert "# TYPE mano_serving_dispatches counter" in text
+    assert "mano_slo_burn_rate" in text
+    assert "mano_load_outstanding" in text
+
+
+def test_5xx_carries_flight_record(served):
+    eng, srv, cli, _tr = served
+    # Kill the dispatcher out from under the edge: submits now raise
+    # RuntimeError -> 503 with the PR-8 capture attached.
+    eng.stop()
+    eng._failure = ServingError("induced for the 5xx test",
+                                phase="dispatch")
+    with pytest.raises(EdgeError) as ei:
+        cli.forward(_pose(1))
+    assert ei.value.status == 503
+    assert ei.value.flight is not None
+    assert ei.value.flight["accounting"]["spans_started"] >= 0
+    eng._failure = None
+    eng.start()                             # restore for teardown
+
+
+# --------------------------------------------------------------- streams
+def test_stream_frames_bitwise_vs_inprocess(served, params32):
+    eng, _srv, cli, _tr = served
+    betas = _betas(seed=11)
+    target = _target(params32, betas, seed=12)
+    with cli.open_stream(betas=betas) as ws:
+        wire = [ws.frame(target) for _ in range(3)]
+    sess = eng.open_stream(betas)
+    for i in range(3):
+        ref = sess.step(target)
+        assert np.array_equal(wire[i].verts, ref.verts)
+        assert np.array_equal(wire[i].pose, ref.pose)
+        assert wire[i].frame == ref.frame
+    sess.close()
+
+
+def test_stream_open_by_key_and_close_event(served):
+    eng, _srv, cli, _tr = served
+    key = eng.specialize(_betas(seed=21))
+    ws = cli.open_stream(subject=key)
+    assert ws.subject == key
+    reply = ws.close()
+    assert reply == {"event": "closed", "frames": 0}
+    snap = eng.load()["streams"]
+    assert snap["closed_by_kind"].get("closed", 0) >= 1
+
+
+def test_stream_frame_errors_keep_stream_open(served, params32):
+    _eng, _srv, cli, _tr = served
+    betas = _betas(seed=31)
+    target = _target(params32, betas, seed=32)
+    with cli.open_stream(betas=betas) as ws:
+        with pytest.raises(EdgeError):      # born-expired frame
+            ws.frame(target, deadline_s=0.0)
+        ok = ws.frame(target)               # the stream survived it
+        assert ok.frame == 1
+
+
+# ------------------------------------------------- disconnect -> cancel
+@pytest.fixture()
+def slow_served(params32):
+    """A deterministically slow engine (every dispatch ~0.35s) behind
+    an edge — the in-flight window the disconnect tests race into."""
+    tracer = Tracer()
+    plan = ChaosPlan("sat:0.35@0-")
+    policy = DispatchPolicy(
+        deadline_s=3.0, retries=0, backoff_s=0.0, backoff_cap_s=0.0,
+        jitter=0.0, breaker=None, chaos=plan, cpu_fallback=False)
+    eng = ServingEngine(params32, max_bucket=2, max_delay_s=0.001,
+                        policy=policy, tracer=tracer)
+    eng.start()
+    eng.warmup([1, 2])
+    srv = EdgeServer(eng, port=0).start()
+    yield eng, srv, tracer
+    srv.drain(timeout_s=10.0)
+    acc = tracer.accounting()
+    assert acc["spans_started"] == acc["spans_closed"]
+    assert acc["spans_open"] == 0
+
+
+def test_frame_future_cancel_forwards_to_engine(params32, slow_served):
+    # The PR-13 path driven by a CALLER, no socket involved: the
+    # satellite's in-process half. submit_frame's future forwards
+    # cancel to the engine request (streams._FrameFuture).
+    eng, _srv, _tr = slow_served
+    sess = eng.open_stream(_betas(seed=41))
+    target = _target(params32, _betas(seed=41), seed=42)
+    sess.step(target)                       # settle (compile + warm)
+    base = eng.counters.cancelled
+    fut = sess.submit_frame(target)
+    time.sleep(0.1)                         # inside the 0.35s window
+    assert fut.cancel()
+    deadline = time.monotonic() + 5.0
+    while eng.counters.cancelled <= base and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert eng.counters.cancelled == base + 1
+    assert fut.cancelled()
+    snap = eng.load()["streams"]
+    assert snap["frames_by_kind"].get("cancelled", 0) >= 1
+    assert sess.close()
+
+
+def test_oneshot_disconnect_cancels_future(slow_served):
+    eng, srv, tracer = slow_served
+    base = eng.counters.cancelled
+    body = proto.dumps({"pose": proto.encode_array(_pose(1))})
+    conn = socket.create_connection(("127.0.0.1", srv.port),
+                                    timeout=10.0)
+    conn.sendall((f"POST /v1/forward HTTP/1.1\r\nHost: t\r\n"
+                  f"Content-Type: application/json\r\n"
+                  f"Content-Length: {len(body)}\r\n\r\n"
+                  ).encode() + body)
+    time.sleep(0.1)                         # request is in flight now
+    conn.close()                            # the client vanishes
+    deadline = time.monotonic() + 5.0
+    while eng.counters.cancelled <= base and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert eng.counters.cancelled == base + 1
+    acc = tracer.accounting()
+    assert acc["closed_by_kind"].get("cancelled", 0) >= 1
+
+
+def test_stream_disconnect_cancels_frame_and_closes_session(
+        params32, slow_served):
+    eng, srv, _tr = slow_served
+    betas = _betas(seed=51)
+    target = _target(params32, betas, seed=52)
+    cli = EdgeClient("127.0.0.1", srv.port, timeout_s=60.0)
+    ws = cli.open_stream(betas=betas)
+    ws.frame(target)                        # settle
+    base = eng.counters.cancelled
+    aborter = threading.Timer(0.1, ws.abort)
+    aborter.start()
+    with pytest.raises((EdgeError, OSError, ValueError)):
+        ws.frame(target)                    # dies mid-dispatch
+    aborter.join()
+    deadline = time.monotonic() + 5.0
+    while eng.counters.cancelled <= base and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert eng.counters.cancelled == base + 1
+    snap = eng.load()["streams"]
+    assert snap["frames_by_kind"].get("cancelled", 0) >= 1
+    assert snap["closed_by_kind"].get("closed", 0) >= 1
+    assert snap["active"] == 0              # the session did not linger
+    cli.close()
+
+
+# ----------------------------------------------------------------- drain
+def test_drain_resolves_inflight_refuses_new(params32):
+    tracer = Tracer()
+    plan = ChaosPlan("sat:0.2@0-")
+    policy = DispatchPolicy(
+        deadline_s=3.0, retries=0, backoff_s=0.0, backoff_cap_s=0.0,
+        jitter=0.0, breaker=None, chaos=plan, cpu_fallback=False)
+    eng = ServingEngine(params32, max_bucket=2, max_delay_s=0.001,
+                        policy=policy, tracer=tracer)
+    eng.start()
+    eng.warmup([1, 2])
+    srv = EdgeServer(eng, port=0).start()
+    results = []
+
+    def one_request():
+        cli = EdgeClient("127.0.0.1", srv.port, timeout_s=30.0)
+        try:
+            cli.forward(_pose(1), deadline_s=10.0)
+            results.append("ok")
+        except Exception as e:  # noqa: BLE001
+            results.append(type(e).__name__)
+        finally:
+            cli.close()
+
+    threads = [threading.Thread(target=one_request) for _ in range(3)]
+    for t in threads:
+        t.start()
+    # All three must be IN (the ~0.2s sat window holds them) before
+    # the drain flips, or a late arrival is legitimately 503'd and
+    # the all-ok assertion below would be racing the wrong thing.
+    deadline = time.monotonic() + 2.0
+    while srv._active_requests < 3 and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert srv._active_requests == 3
+    report = srv.drain(timeout_s=10.0)
+    for t in threads:
+        t.join(timeout=10.0)
+    assert report["drained"] and report["within_timeout"]
+    assert results == ["ok", "ok", "ok"]    # in-flight work resolved
+    with pytest.raises(OSError):            # new connections refused
+        socket.create_connection(("127.0.0.1", srv.port), timeout=2.0)
+    assert eng._thread is None              # the stop() sweep ran
+    # Idempotent: a second drain reports, never re-runs.
+    assert srv.drain(timeout_s=1.0).get("already")
+
+
+def test_drain_with_idle_stream_connection_is_fast(params32):
+    """An idle upgraded stream connection (client parked, no frame in
+    flight) owes the drain nothing: it must be swept, not waited out —
+    the drain completes far inside its window."""
+    tracer = Tracer()
+    eng = ServingEngine(params32, max_bucket=2, max_delay_s=0.001,
+                        tracer=tracer)
+    eng.start()
+    srv = EdgeServer(eng, port=0).start()
+    cli = EdgeClient("127.0.0.1", srv.port, timeout_s=30.0)
+    ws = cli.open_stream(betas=_betas(seed=61))   # open, then idle
+    t0 = time.monotonic()
+    report = srv.drain(timeout_s=10.0)
+    wall = time.monotonic() - t0
+    assert report["drained"] and report["within_timeout"]
+    assert wall < 5.0                       # swept, not timed out
+    # The engine's stop() sweep closed the idle session (shutdown
+    # terminal), so the span accounting still balances.
+    acc = tracer.accounting()
+    assert acc["spans_started"] == acc["spans_closed"]
+    assert acc["spans_open"] == 0
+    ws.abort()
+    cli.close()
+
+
+def test_sigterm_drains_subprocess_cleanly(tmp_path):
+    """The acceptance drill's process-level half: a real `mano serve`
+    worker, a real SIGTERM, a clean exit inside the drain budget."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        TF_CPP_MIN_LOG_LEVEL="3",
+        # Own cache dir (the CLAUDE.md rule — the worker is a separate
+        # jax process beside this pytest one) and an isolated device
+        # lock so the worker never contends with a real pipeline.
+        MANO_TEST_CACHE_DIR=str(tmp_path / "cache"),
+        MANO_DEVICE_LOCK_DIR=str(tmp_path / "lock"),
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "mano_hand_tpu.cli", "--platform", "cpu",
+         "serve", "--port", "0", "--max-bucket", "2", "--max-queued",
+         "8", "--drain-timeout-s", "10"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        text=True)
+    try:
+        ready = json.loads(proc.stdout.readline())
+        port = ready["edge"]["port"]
+        cli = EdgeClient("127.0.0.1", port, timeout_s=120.0)
+        assert cli.healthz()["ok"]
+        v = cli.forward(_pose(1), deadline_s=60.0)
+        assert v.shape == (1, 778, 3)
+        cli.close()
+        t0 = time.monotonic()
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30.0)
+        wall = time.monotonic() - t0
+        assert rc == 0
+        assert wall < 15.0                  # inside the drain budget
+        exit_line = json.loads(proc.stdout.readline())
+        assert exit_line["edge_exit"]["drained"]
+        # The flight recorder stayed quiet: a drain is a lifecycle,
+        # not an incident.
+        assert exit_line["edge_exit"]["incident_captures"] == 0
+        with pytest.raises(OSError):
+            socket.create_connection(("127.0.0.1", port), timeout=2.0)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+# ------------------------------------------------------------ the drill
+def test_edge_drill_small_e2e(params32):
+    """config18 end-to-end at plumbing size: the drill's own criteria
+    fields all populated and internally consistent (the acceptance
+    - sized run is `make serve-smoke` -> bench_report:judge_edge)."""
+    from mano_hand_tpu.serving.measure import edge_drill_run
+
+    out = edge_drill_run(params32, bursts=6, workers=8, streams=2,
+                         frames_per_stream=2, shed_probe_requests=8,
+                         seed=3)
+    assert out["wire_resolved_within_budget_fraction"] == 1.0
+    assert out["outcomes"]["error"] == 0
+    assert out["outcomes"]["unresolved"] == 0
+    assert out["steady_recompiles"] == 0
+    probe = out["shed_probe"]
+    assert probe["dispatches"] == 0
+    assert probe["wire_429"] == probe["sheds"]
+    assert probe["wire_retry_after_present"]
+    assert out["stream"]["wire_vs_inprocess_max_abs_err"] == 0.0
+    assert out["stream"]["frames_ok"] == out["stream"]["frames_expected"]
+    assert out["disconnect"]["cancelled_total"] >= 2
+    assert out["drain"]["inflight_all_ok"]
+    assert out["drain"]["new_connection_refused"]
+    assert out["drain"]["recorder_quiet_during_drain"]
+    acc = out["span_accounting"]
+    assert acc["spans_started"] == acc["spans_closed"]
+    assert acc["spans_open"] == 0
+    json.dumps(out)                         # one-line-artifact safe

@@ -44,6 +44,7 @@ from mano_hand_tpu.obs import (
     prometheus_text,
     slo_report,
 )
+from mano_hand_tpu.obs import metrics as metrics_mod
 from mano_hand_tpu.obs.metrics import (
     load_samples,
     metric,
@@ -479,3 +480,64 @@ def test_metrics_overhead_run_small_e2e(params32, tmp_path):
     snap = json.loads((tmp_path / "mx" / "metrics.json").read_text())
     assert snap["schema"] == 1
     assert json.loads((tmp_path / "mx" / "slo.json").read_text())["ok"]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text-export escaping (PR 15 hardening): once requests
+# arrive over the wire, bucket/kind/subject strings are user-influenced
+# — label VALUES must escape `\`, `"`, and newlines exactly per the
+# exposition format, and label/metric NAMES (which the format cannot
+# escape) must be folded to the safe charset.
+def test_prometheus_label_value_escaping_pinned():
+    snap = {
+        "namespace": "mano",
+        "metrics": {
+            "evil": metrics_mod.metric(
+                "counter",
+                samples=[metrics_mod.sample(
+                    1.0, {"kind": 'a\\b"c\nd\re'})]),
+        },
+    }
+    text = metrics_mod.prometheus_text(snap)
+    [line] = [ln for ln in text.splitlines()
+              if ln.startswith("mano_evil{")]
+    # Backslash doubled, quote escaped, LF -> \n, bare CR folded into
+    # the newline escape: one physical line, reversible per the spec.
+    assert line == 'mano_evil{kind="a\\\\b\\"c\\nd\\ne"} 1.0'
+    assert len(text.splitlines()) == len(
+        [ln for ln in text.splitlines()])  # no torn lines
+
+
+def test_prometheus_name_sanitization_for_reloaded_snapshots():
+    # prometheus_text also renders snapshots RE-LOADED from disk
+    # (`mano status --prom`) whose names never passed _check_name.
+    snap = {
+        "namespace": "mano",
+        "metrics": {
+            'bad name\n{}': metrics_mod.metric(
+                "gauge",
+                samples=[metrics_mod.sample(
+                    2.0, {'bad key"': "v"})]),
+        },
+    }
+    text = metrics_mod.prometheus_text(snap)
+    assert 'mano_bad_name___{bad_key_="v"} 2.0' in text
+    # Nothing un-sanitized leaked into a name position.
+    for ln in text.splitlines():
+        name = ln.split("{")[0].split(" ")[-1] if ln.startswith("#") \
+            else ln.split("{")[0].split(" ")[0]
+        assert "\n" not in name and '"' not in name
+
+
+def test_prometheus_help_newline_and_cr_folded():
+    snap = {
+        "namespace": "mano",
+        "metrics": {
+            "m": metrics_mod.metric(
+                "counter", 1.0, help="line1\r\nline2\rline3\nline4"),
+        },
+    }
+    text = metrics_mod.prometheus_text(snap)
+    [help_line] = [ln for ln in text.splitlines()
+                   if ln.startswith("# HELP mano_m ")]
+    assert help_line == "# HELP mano_m line1 line2 line3 line4"
