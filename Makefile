@@ -24,11 +24,12 @@
 	check-quick serve-smoke specialize-smoke chaos-smoke coalesce-smoke \
 	overload-smoke coldstart-smoke obs-smoke metrics-smoke \
 	posed-kernel-smoke stream-smoke lanes-smoke precision-smoke \
-	edge-smoke examples-smoke analyze
+	edge-smoke subject-store-smoke examples-smoke analyze
 
 check: analyze test chaos-smoke coalesce-smoke overload-smoke \
 	coldstart-smoke obs-smoke metrics-smoke posed-kernel-smoke \
-	stream-smoke lanes-smoke precision-smoke edge-smoke examples-smoke
+	stream-smoke lanes-smoke precision-smoke edge-smoke \
+	subject-store-smoke examples-smoke
 
 # tests/test_runtime.py is excluded here and covered by the chaos-smoke
 # prerequisite instead (its own pytest process + cache dir): `make
@@ -52,6 +53,7 @@ test:
 	  --ignore=tests/test_lanes.py \
 	  --ignore=tests/test_precision.py \
 	  --ignore=tests/test_edge.py \
+	  --ignore=tests/test_subject_store.py \
 	  --ignore=tests/test_examples.py
 
 # Seconds-scale pre-commit lane: the core-correctness modules (parity vs
@@ -136,7 +138,8 @@ bench-interpret:
 	  --lane-workers 4 --lane-max-bucket 8 \
 	  --precision-requests 32 --precision-subjects 6 \
 	  --precision-max-bucket 16 --precision-posed-kernel fused \
-	  --edge-bursts 6 --edge-workers 8 --edge-streams 2 --edge-frames 2
+	  --edge-bursts 6 --edge-workers 8 --edge-streams 2 --edge-frames 2 \
+	  --subject-store-subjects 300 --subject-store-requests 12
 
 # Serving-leg smoke (the bench-interpret counterpart for config7): the
 # whole serving-engine plumbing — bucket warm-up, ragged request stream,
@@ -176,6 +179,11 @@ bench-interpret:
 # config18 (the loopback edge drill, PR 15) runs its acceptance leg
 # here: the PR-5 overload numbers through real sockets, stream parity,
 # disconnect-cancel, and the drain drill — every criterion CPU-defined
+# (bench-interpret sweeps the same protocol at plumbing size).
+# config19 (the tiered subject-store drill, PR 16) runs its acceptance
+# leg here at the DEFAULT size (100k registered subjects — defaults
+# are policy, the driver passes no flags): tiers, paging, and sharded
+# routing are host/disk machinery, every criterion CPU-defined
 # (bench-interpret sweeps the same protocol at plumbing size).
 # The other legs are device-count-agnostic — they
 # dispatch to the default device exactly as before (the test suite has
@@ -342,6 +350,24 @@ precision-smoke:
 edge-smoke:
 	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_edge \
 	  python -m pytest tests/test_edge.py -q
+
+# Tiered subject store (the PR-16 tentpole): warm demote→promote
+# roundtrips bit-identical, warm overflow paging to cold and promoting
+# back THROUGH warm (inclusive tiers), a damaged cold page degrading to
+# a counted re-bake (never an error), page adoption across processes,
+# cross-shard batches through a 2-lane sharded fleet bit-identical to
+# the single-device engine, eviction under a live stream re-baking
+# transparently, the one-lock-hold load()["subject_store"] block,
+# betas-only registration density, and the config19 drill protocol at
+# plumbing size. Wired into `make check` as a SEPARATE pytest process
+# on its own compile-cache dir (the CLAUDE.md rule: two pytest
+# processes must never share .jax_compile_cache/). Slow-marked, so the
+# tier-1 `-m 'not slow'` lane skips it by design (the PR-8 budget
+# precedent); the pure-logic tests carry `quick` too and ride
+# `make check-quick`.
+subject-store-smoke:
+	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_subject_store \
+	  python -m pytest tests/test_subject_store.py -q
 
 # Every example end-to-end (tiny sizes, CPU) — the public-surface
 # anti-rot gate. Moved out of the tier-1 lane in the PR-13 budget

@@ -593,7 +593,8 @@ def run_benchmarks(args, device_str: str) -> dict:
                                               "config15_streams",
                                               "config16_lanes",
                                               "config17_precision",
-                                              "config18_edge"):
+                                              "config18_edge",
+                                              "config19_subject_store"):
             return
         try:
             fn()
@@ -2444,6 +2445,46 @@ def run_benchmarks(args, device_str: str) -> dict:
     if args.edge_bursts > 0:
         section("config18_edge", config18_edge)
 
+    # -- config 19: tiered subject store drill (PR 16) ----------------------
+    # THE memory-hierarchy protocol (serving/measure.py:
+    # subject_store_drill_run): O(100k) registered subjects paged
+    # through the device/host/disk hierarchy under Zipf traffic, a
+    # capacity-sharded lane fleet judged against its replicated twin on
+    # interleaved paired slices. Criteria (scripts/bench_report.py:
+    # judge_subject_store) are all CPU-defined: every leg bit-identical
+    # to a single-device reference, warm-promotion p99 inside the
+    # coalesce window, zero steady recompiles across the capacity
+    # ladder (hot-only -> warm-spill -> cold-spill -> cold-revisit),
+    # a damaged cold page counted + re-baked (never an error), and
+    # per-lane device rows strictly below the replicated baseline.
+    # Throughput ratio is [info] off-chip — registration density and
+    # row accounting are the point, not CPU wall-clock.
+    def config19_subject_store():
+        from mano_hand_tpu.serving.measure import subject_store_drill_run
+
+        sd = subject_store_drill_run(
+            right,
+            subjects=args.subject_store_subjects,
+            requests_per_leg=args.subject_store_requests,
+            seed=53,
+            log=lambda m: log(f"config19 {m}"),
+        )
+        results["subject_store"] = sd
+        oc = sd["outcomes"]
+        log(f"config19 subject store: {sd['subjects_registered']} "
+            f"subjects through {sd['lanes']} shards, "
+            f"{sd['requests_total']} requests ({oc['ok']} ok / "
+            f"{oc['error']} error / {oc['stranded']} stranded), "
+            f"hot-tier hit rate {sd['hot_tier_hit_rate']}, "
+            f"promotion p99 {sd['promotion_stall_ms']['p99_ms']:.3g}ms, "
+            f"device rows {sd['per_lane_device_rows_sharded']} vs "
+            f"{sd['per_lane_device_rows_replicated']} replicated, "
+            f"{sd['steady_recompiles']} steady recompiles, "
+            f"damage counted {sd['damage_probe'].get('damage_counted')}")
+
+    if args.subject_store_requests > 0:
+        section("config19_subject_store", config19_subject_store)
+
     if args.serving_only:
         # Fast serving-layer artifact (`make serve-smoke`): the deferred
         # runner's serving-only skip reduces the schedule to config7
@@ -2861,6 +2902,18 @@ def main() -> int:
                          "achieved; the wire's blocking clients "
                          "compress bursts, so the target carries "
                          "headroom over the floor)")
+    ap.add_argument("--subject-store-subjects", type=int,
+                    default=100_000,
+                    help="registered-subject universe of the tiered "
+                         "subject-store drill (config19, PR 16; "
+                         "betas-only registration keeps O(100k) at "
+                         "~40B/subject — density is the criterion, "
+                         "not wall-clock)")
+    ap.add_argument("--subject-store-requests", type=int, default=120,
+                    help="requests per capacity-ladder leg of "
+                         "config19 (hot-only / warm-spill / "
+                         "cold-spill, paired sharded-vs-replicated "
+                         "slices; 0 skips the leg)")
     ap.add_argument("--spec-batch", type=int, default=256,
                     help="batch for the specialization leg's full-vs-"
                          "pose-only forward comparison (config8); "

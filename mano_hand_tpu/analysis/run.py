@@ -68,10 +68,18 @@ def run_analysis(
     # rglob like every other subsystem).
     for p in sorted((root / "mano_hand_tpu" / "edge").glob("*.py")):
         locks += check_lock_discipline(p, order=())
+    # PR 16: the subject store's one LEAF lock (warm LRU + promotion
+    # registry + cold index; transfers and page IO staged outside, the
+    # documented contract in its module docstring) — cycle/re-acquire
+    # checked like the obs/ classes, and the policy linter's
+    # device-work/wallclock rules scan it via the package rglob.
+    locks += check_lock_discipline(
+        root / "mano_hand_tpu" / "serving" / "subject_store.py",
+        order=())
     sections.append(("lock-discipline", locks,
                      "serving/engine.py + serving/streams.py + "
-                     "serving/lanes.py + edge/ + obs/ nesting graphs "
-                     "+ call edges"))
+                     "serving/lanes.py + serving/subject_store.py + "
+                     "edge/ + obs/ nesting graphs + call edges"))
 
     step = check_lockstep(baseline.get("lockstep", {}))
     stale_note = lockstep_stale(baseline.get("lockstep", {}))

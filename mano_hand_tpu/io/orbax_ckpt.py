@@ -195,6 +195,61 @@ def load_state(path: PathLike):
     return meta, arrays
 
 
+# --------------------------------------------------------------------------
+# Row pages (PR 16): the cold tier of the tiered subject store. One
+# directory per subject digest holding that subject's baked table row —
+# a (meta, arrays) state pair, so the crash-safety (meta lands LAST) and
+# backend-portability of save_state carry over unchanged. Pages are
+# content-verified at load: the meta records a sha256 per array, and the
+# "shape" array is the digest preimage itself, so the STORE re-derives
+# the digest from the bytes — a damaged page is detected, not served.
+
+_ROW_PAGE_PREFIX = "row-"
+
+
+def row_page_path(digest: str, root: PathLike) -> Path:
+    return Path(root).absolute() / f"{_ROW_PAGE_PREFIX}{digest}"
+
+
+def save_row_page(digest: str, arrays: dict, root: PathLike,
+                  *, backend: Optional[str] = None) -> Path:
+    """Write one subject's baked row as a verifiable cold page."""
+    import hashlib
+
+    arrays = {k: np.asarray(v) for k, v in arrays.items()}
+    meta = {
+        "kind": "subject_row_page",
+        "digest": digest,
+        "row_sha256": {
+            k: hashlib.sha256(np.ascontiguousarray(v).tobytes()).hexdigest()
+            for k, v in arrays.items()},
+    }
+    return save_state(meta, arrays, row_page_path(digest, root),
+                      backend=backend)
+
+
+def load_row_page(digest: str, root: PathLike):
+    """Restore one cold page as ``(meta, arrays)``. Raises on a missing
+    or unreadable page; CONTENT verification against ``meta["row_sha256"]``
+    is the caller's job (serving/subject_store.py does it, so damage
+    degrades to a counted re-bake there, never an exception here)."""
+    return load_state(row_page_path(digest, root))
+
+
+def list_row_pages(root: PathLike) -> list:
+    """Digests with a COMPLETE page under ``root`` (meta file present —
+    the same completeness test load_state applies)."""
+    root = Path(root).absolute()
+    if not root.is_dir():
+        return []
+    out = []
+    for p in root.iterdir():
+        if (p.is_dir() and p.name.startswith(_ROW_PAGE_PREFIX)
+                and (p / _STATE_META).exists()):
+            out.append(p.name[len(_ROW_PAGE_PREFIX):])
+    return sorted(out)
+
+
 def load(path: PathLike, target: Optional[Any] = None) -> dict:
     """Restore a checkpoint as a dict of numpy arrays.
 

@@ -417,6 +417,21 @@ def serving_samples(snap: dict) -> dict:
                 out["serving_latency_samples"] = metric(
                     "gauge", help="per-bucket latency sample count",
                     samples=counts)
+        elif key == "subject_store_promotion_ms" and isinstance(val, dict):
+            # The tiered-store promotion-stall summary (PR 16): one
+            # {p50_ms, p99_ms, n} dict — the quantiles export as
+            # gauges, the sample count as its own gauge (the
+            # latency_by_bucket convention without the bucket label).
+            out["serving_subject_store_promotion_p50_ms"] = metric(
+                "gauge", val.get("p50_ms", 0.0),
+                help="subject-store promotion stall p50 (install-path "
+                     "wait for a warm/cold row to be device-ready)")
+            out["serving_subject_store_promotion_p99_ms"] = metric(
+                "gauge", val.get("p99_ms", 0.0),
+                help="subject-store promotion stall p99")
+            out["serving_subject_store_promotion_samples"] = metric(
+                "gauge", val.get("n", 0),
+                help="promotion stall sample count")
         elif isinstance(val, bool) or not isinstance(val, (int, float)):
             unexported += 1
         else:
@@ -521,6 +536,10 @@ def load_samples(load: dict) -> dict:
     if per:
         states = {"healthy": 0, "degraded": 1, "down": 2}
         for key, kind, help_txt in (
+                ("table_capacity", "gauge", "allocated device table "
+                                            "rows"),
+                ("resident_rows", "gauge", "device rows actually "
+                                           "holding a subject"),
                 ("backlog_rows", "gauge", "queued+in-flight rows"),
                 ("inflight", "gauge", "batches executing now"),
                 ("assigned", "counter", "batches ever placed here"),
@@ -545,6 +564,19 @@ def load_samples(load: dict) -> dict:
             samples=[sample(states.get(p.get("state"), -1),
                             {"lane": str(p.get("lane"))})
                      for p in per])
+    # Tiered subject store (PR 16): warm/cold occupancy — the hit/miss
+    # COUNTERS ride the generic serving_samples mapper; these are the
+    # set-point gauges only load() knows.
+    store = load.get("subject_store") or {}
+    for key, help_txt in (
+            ("warm_rows", "host-RAM warm-tier rows resident"),
+            ("warm_capacity", "warm-tier LRU bound"),
+            ("promotions_pending", "async host->device promotions "
+                                   "in flight"),
+            ("cold_pages", "cold-tier row pages on disk")):
+        if store.get(key) is not None:
+            out[f"load_subject_store_{key}"] = metric(
+                "gauge", store[key], help=help_txt)
     return out
 
 

@@ -616,6 +616,7 @@ class ServingEngine:
         lanes: Optional[int] = None,
         lane_probe: Optional[Callable[[int], bool]] = None,
         precision_policy=None,
+        subject_store=None,
     ):
         self._params = params.astype(dtype)
         self._dtype = np.dtype(dtype)
@@ -766,6 +767,23 @@ class ServingEngine:
         if lane_probe is not None and lanes is None:
             raise ValueError("lane_probe requires lanes")
         self._laneset = None
+        # Tiered subject store (PR 16): warm/cold tiers + the shard map
+        # under the device table. Bound here to this engine's counters
+        # (and lane count, when sharded — shards ARE the per-lane
+        # tables); the store touches no backend at construction.
+        if subject_store is not None:
+            from mano_hand_tpu.serving.subject_store import SubjectStore
+
+            if not isinstance(subject_store, SubjectStore):
+                raise TypeError(
+                    f"subject_store must be a serving.subject_store."
+                    f"SubjectStore, got {type(subject_store).__name__}")
+            if subject_store.config.sharded and self._lane_count is None:
+                raise ValueError(
+                    "a sharded subject_store requires lanes (the shards "
+                    "are the per-lane tables; pass lanes=N)")
+            subject_store.bind(self.counters, n_shards=self._lane_count)
+        self._subject_store = subject_store
 
     @property
     def tracer(self):
@@ -785,6 +803,35 @@ class ServingEngine:
         """The engine's ``serving.precision.PrecisionPolicy`` (or
         None = every tier f32, the pre-PR-14 engine exactly)."""
         return self._precision_policy
+
+    @property
+    def subject_store(self):
+        """The engine's tiered ``serving.subject_store.SubjectStore``
+        (or None = device-table-only, the pre-PR-16 engine exactly)."""
+        return self._subject_store
+
+    def _shard_of(self, digest: Optional[str]) -> Optional[int]:
+        """The owning LANE of one subject digest under a sharded store
+        (None on an unsharded/storeless engine) — content-based, so
+        placement is stable across restarts and registration order."""
+        store = self._subject_store
+        if store is None or digest is None:
+            return None
+        return store.shard_for(digest)
+
+    def _prefetch_subject(self, digest: Optional[str]) -> None:
+        """Kick an async warm→device promotion the instant a subject is
+        KNOWN to be dispatching soon (coalesce-admit here;
+        streams.open_stream calls the same hook): the transfer overlaps
+        the coalesce window instead of stalling inside the install.
+        Hot or unknown digests are a dict-lookup no-op."""
+        store = self._subject_store
+        if store is None or digest is None:
+            return
+        with self._exe_lock:
+            hot = digest in self._subject_slots
+        if not hot:
+            store.prefetch(digest)
 
     def _req_prec(self, req: "_Request") -> str:
         """The precision family ONE request's dispatch serves from:
@@ -1050,6 +1097,35 @@ class ServingEngine:
         self._install_subject(key, shape)
         return key
 
+    def register_subjects(self, betas_batch) -> list:
+        """Register MANY subjects' betas WITHOUT baking a single table
+        row — the O(100k) on-ramp of the tiered store (PR 16): raw
+        betas cost ~40 bytes/subject (never evicted, exactly like the
+        CPU-fallback registry above), while a baked row costs ~10 KB of
+        device memory — bulk-baking the registry would defeat the
+        tiers. A registered subject is immediately submittable
+        (``submit(pose, subject=key)``); its row bakes — or promotes
+        from a warm/cold tier — on first dispatch via the existing
+        ``_resolve_batch`` re-bake path. Returns the subject keys, in
+        input order (duplicates collapse to the same key).
+        """
+        import hashlib
+
+        betas_batch = np.ascontiguousarray(
+            np.asarray(betas_batch, self._dtype).reshape(
+                -1, self._n_shape))
+        keys = []
+        rows = {}
+        for b in betas_batch:
+            b = np.ascontiguousarray(b)
+            key = hashlib.sha256(b.tobytes()).hexdigest()[:16]
+            keys.append(key)
+            rows[key] = b
+        with self._exe_lock:
+            for key, b in rows.items():
+                self._subject_betas.setdefault(key, b)
+        return keys
+
     def _install_subject(self, key: str, betas: np.ndarray,
                          protected=(), shaped=None) -> int:
         """Bake ``betas`` and write them into a table row; returns the
@@ -1085,11 +1161,34 @@ class ServingEngine:
         if self._params_dev is None:
             self._params_dev = self._params.device_put()
         restored = shaped is not None
-        if not restored:
+        store = self._subject_store
+        tier = None
+        if not restored and store is not None:
+            # Tiered resolution (PR 16): a warm/cold row promotes
+            # (device_put of persisted bytes — bit-identical, like the
+            # checkpoint-restore path below) instead of re-baking; a
+            # miss is COUNTED and falls through to the bake. Runs
+            # before the install lock: the promotion stall must never
+            # serialize other installers.
+            fetched = store.fetch_row(key)
+            if fetched is not None:
+                handles, tier = fetched
+                shaped = core.ShapedHand(
+                    v_shaped=handles["v_shaped"],
+                    joints=handles["joints"],
+                    shape=handles["shape"],
+                    pose_basis=self._params.pose_basis,
+                    lbs_weights=self._params.lbs_weights,
+                    parents=self._params.parents,
+                )
+            else:
+                self.counters.count_store_miss()
+        if shaped is None:
             shaped = core.jit_specialize(self._params_dev, betas)
         with self._install_lock:
             grew = False
             evicted = None
+            victim_table = None
             with self._exe_lock:
                 if key in self._subject_slots:     # racing writer won
                     self._subject_lru.move_to_end(key)
@@ -1123,6 +1222,11 @@ class ServingEngine:
                     del self._subject_lru[victim]
                     self.counters.count_evict()
                     evicted = victim
+                    # The victim's baked row still lives in THIS table
+                    # snapshot (functional updates never mutate it);
+                    # keep the reference so the demotion below can copy
+                    # the row host-side after every lock is released.
+                    victim_table = table
             if evicted is not None and self._tracer is not None:
                 # Staged outside _exe_lock like the device work below:
                 # the dispatch path must never queue behind telemetry.
@@ -1163,11 +1267,24 @@ class ServingEngine:
                 # lane dispatch can prove replica/slot agreement. Still
                 # staged OUTSIDE _exe_lock, like every device op here.
                 self._laneset.broadcast_row(slot, shaped, grew=grew,
-                                            version=version)
+                                            version=version, digest=key)
+        if evicted is not None and store is not None:
+            # Demotion (PR 16): capture the evicted row into the warm
+            # tier from the pre-swap snapshot — outside BOTH locks (the
+            # D2H copy happens in the store; the dispatch path and
+            # other installers never wait on it). Recompile-free by
+            # construction: demotion touches no compiled program.
+            row = core.table_row(victim_table, slot)
+            store.demote(evicted, {"v_shaped": row.v_shaped,
+                                   "joints": row.joints,
+                                   "shape": row.shape})
         if restored:
             self.counters.count_restore()
-        else:
+        elif tier is None:
             self.counters.count_specialize(hit=False)
+        # (A warm/cold-tier install counted its hit + promotion stall in
+        # the store: the shape stage did NOT re-run, so counting it as a
+        # specialization would overstate the bakes.)
         for b in stale:
             self._gather_executable(b)
         for b in stale_bf16:
@@ -1184,10 +1301,20 @@ class ServingEngine:
         dispatched program sees a consistent table; a concurrent
         specialize/evict only ever swaps the LIVE reference."""
         digests = {r.subject for r in reqs}
+        counted_hot = self._subject_store is None
         for _ in range(len(digests) + 2):
             with self._exe_lock:
                 missing = [k for k in digests
                            if k not in self._subject_slots]
+                if not counted_hot:
+                    # Hot-tier hits (PR 16): batch digests already
+                    # table-resident at first resolution — counted once
+                    # per batch (the same under-lock counter pattern as
+                    # count_evict above).
+                    counted_hot = True
+                    if len(digests) > len(missing):
+                        self.counters.count_store_hot(
+                            len(digests) - len(missing))
                 if not missing:
                     table = self._table
                     slots = {k: self._subject_slots[k] for k in digests}
@@ -1399,6 +1526,10 @@ class ServingEngine:
         ls = self._laneset
         if ls is not None:
             out["lanes"] = ls.snapshot()
+        # Tiered subject store (PR 16): tier occupancy + in-flight
+        # promotions, one store-lock hold (the torn-telemetry rule).
+        if self._subject_store is not None:
+            out["subject_store"] = self._subject_store.snapshot()
         # Precision tiers (PR 14): the policy is immutable, so this is
         # pure derivation — no lock needed, and an operator (or the
         # metrics scrape, obs/metrics.py:load_samples) can always see
@@ -2237,14 +2368,18 @@ class ServingEngine:
 
     # ------------------------------------------------------------ dispatch
     def _admit(self, nxt: _Request, posed: bool, subjects: set,
-               rows: int, prec: str = "f32") -> Optional[str]:
+               rows: int, prec: str = "f32",
+               shard: Optional[int] = None) -> Optional[str]:
         """Why ``nxt`` cannot join the batch being coalesced, or None.
 
         ``"kind"``: full-path and pose-only requests cannot share a
         program. ``"precision"`` (PR 14): a batch serves ONE precision
         family — a pose-only request whose policy tier maps to the
         other family is parked (policy-less engines never hit this:
-        every request maps f32). ``"subjects"``: admitting one more
+        every request maps f32). ``"shard"`` (PR 16): under a sharded
+        subject store a batch serves from ONE lane's shard table, so a
+        request whose subject another lane owns is parked — the
+        cross-shard batch split. ``"subjects"``: admitting one more
         DISTINCT subject would exceed the table's ``max_subjects`` rows
         (so _resolve_batch could never pin the batch). ``"overflow"``:
         the rows would exceed the largest bucket — the one reason that
@@ -2258,6 +2393,14 @@ class ServingEngine:
         if posed and self._precision_policy is not None \
                 and self._req_prec(nxt) != prec:
             return "precision"
+        if posed and shard is not None \
+                and self._shard_of(nxt.subject) != shard:
+            # Sharded store (PR 16): a batch dispatches to ONE lane's
+            # shard table, so cross-shard batches split here — the
+            # parked request leads a later batch bound for ITS lane.
+            # Checked before overflow: a cross-shard request keeps the
+            # scan going (its rows were never joining this batch).
+            return "shard"
         if rows + nxt.rows > self.buckets[-1]:
             return "overflow"
         if (posed and nxt.subject not in subjects
@@ -2281,6 +2424,11 @@ class ServingEngine:
         posed = first.subject is not None
         subjects = {first.subject} if posed else set()
         prec = self._req_prec(first)   # the batch's precision family
+        shard = self._shard_of(first.subject) if posed else None
+        if posed:
+            # Prefetch at the coalesce boundary (PR 16): the async
+            # promotion overlaps the max_delay_s window below.
+            self._prefetch_subject(first.subject)
 
         def admit(nxt, fresh=True) -> Optional[str]:
             if self._skip_cancelled(nxt):
@@ -2293,11 +2441,12 @@ class ServingEngine:
                 # parked, never costing a device row.
                 self._expire(nxt, "coalesce")
                 return "expired"
-            why = self._admit(nxt, posed, subjects, rows, prec)
+            why = self._admit(nxt, posed, subjects, rows, prec, shard)
             if why is None:
                 reqs.append(nxt)
                 if posed:
                     subjects.add(nxt.subject)
+                    self._prefetch_subject(nxt.subject)
                 if self._tracer is not None:
                     self._tracer.event(nxt.span, "coalesce")
                 return None
@@ -2470,7 +2619,12 @@ class ServingEngine:
                 # lanes ARE the overlap, so the inflight deque stays
                 # unused in this mode.
                 self._get_lanes().submit_batch(
-                    bucket, pose, shape, posed, reqs, rows)
+                    bucket, pose, shape, posed, reqs, rows,
+                    # Sharded store (PR 16): every request in a posed
+                    # batch shares one shard (the _admit "shard" split),
+                    # so the batch routes to its owner lane.
+                    shard=(self._shard_of(reqs[0].subject)
+                           if posed else None))
                 return None
             prec = self._req_prec(reqs[0]) if posed else "f32"
             if posed:

@@ -24,6 +24,21 @@ dispatch mesh-aware:
   first use (warm-up-class work), and a capacity growth re-adopts +
   eagerly rebuilds that lane's gathered executables, counted exactly
   like the engine's own growth compiles.
+* **Or SHARDED, not replicated (PR 16).** Under a sharded
+  ``serving.subject_store.SubjectStore`` the N lanes hold N DISJOINT
+  shard tables instead of N full replicas: ``shard_of(digest, N)``
+  (content-based) names each subject's owner lane, the engine's
+  ``_admit`` splits cross-shard batches at coalesce, and
+  ``submit_batch`` pins each posed batch to its owner lane while that
+  lane is healthy. A shard table is digest-keyed — its slot map and
+  table reference swap together under ONE ``_lock`` hold (epoch-
+  guarded against racing adopters), so a captured (table, slots) pair
+  is immutably consistent without the replicated path's engine-version
+  proof. Ladder hops and an owner-lane outage fall back to a per-batch
+  ``device_put`` of the engine snapshot — always correct, paid only
+  off the happy path. The win: per-lane device-resident rows drop from
+  ``max_subjects`` to ~``max_subjects / N`` (the capacity ladder's
+  fleet multiplier; bench config19).
 * **The failover LADDER** (``runtime/health.py``): the PR-3 breaker
   generalized from "device -> CPU" to "device -> least-loaded healthy
   sibling lane -> CPU". A lane whose supervised primary exhausts its
@@ -71,6 +86,7 @@ family.
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 import time
@@ -114,6 +130,15 @@ class Lane:
         #   The bf16-tier gathered family (PR 14), per lane — same
         #   keying/invalidation as gather_exes; populated only under
         #   an engine PrecisionPolicy with bf16 tiers.
+        # -- sharded mode (PR 16): lane.table is a shard-LOCAL table --
+        # digest-keyed: shard_slots maps subject digest -> local slot,
+        # and it swaps together with ``table`` (one _lock hold, epoch-
+        # guarded), so a captured (table, slots) pair is consistent by
+        # construction — shard tables need no engine-version proof.
+        self.shard_slots: dict = {}          # digest -> local slot
+        self.shard_lru = collections.OrderedDict()  # digest -> None
+        self.shard_next_slot = 0             # first never-used local row
+        self.shard_epoch = 0                 # bumped at every shard swap
         # -- telemetry (LaneSet._lock) --
         self.backlog_batches = 0     # queued + in flight
         self.backlog_rows = 0
@@ -144,6 +169,12 @@ class LaneSet:
         self._eng = engine
         self._lock = threading.Lock()
         self._rr = 0    # equal-backlog tie-break cursor (placement)
+        # Sharded mode (PR 16): disjoint per-lane shard tables instead
+        # of full replicas — decided once at construction from the
+        # engine's store (the store's shard map was bound to this lane
+        # count in the engine constructor).
+        store = getattr(engine, "_subject_store", None)
+        self._sharded = bool(store is not None and store.sharded)
         devs = mesh.lane_devices(n, devices=devices)
         self.n_devices = len({str(d) for d in devs})
         pol = engine._policy
@@ -203,13 +234,17 @@ class LaneSet:
 
     # ------------------------------------------------------------ placement
     def submit_batch(self, bucket: int, pose, shape, posed: bool, reqs,
-                     rows: int) -> None:
+                     rows: int, shard: Optional[int] = None) -> None:
         """Place one assembled batch on the least-backlogged healthy
         lane (breaker not DOWN; all down -> least-backlogged anyway,
         whose worker walks the ladder straight to CPU) and wake its
-        worker. Called only by the engine's dispatcher thread."""
+        worker. ``shard`` (PR 16, sharded store only): the batch's
+        owner lane — placement pins there while it is healthy, else
+        degrades to normal placement (the worker then serves via the
+        engine-snapshot fallback, always correct). Called only by the
+        engine's dispatcher thread."""
         with self._lock:
-            lane = self._place_locked(rows)
+            lane = self._place_locked(rows, shard)
             lane.assigned += 1
             lane.backlog_batches += 1
             lane.backlog_rows += rows
@@ -239,8 +274,16 @@ class LaneSet:
                 tr.event(r.span, "lane", lane=lane.index)
         lane.q.put((bucket, pose, shape, posed, reqs, rows))
 
-    def _place_locked(self, rows: int) -> Lane:
-        # Caller holds self._lock. Backlog = queued + in-flight rows;
+    def _place_locked(self, rows: int, shard: Optional[int] = None) -> Lane:
+        # Caller holds self._lock. Sharded routing first (PR 16): the
+        # subject→lane map IS the placement for a posed batch — only an
+        # owner-lane outage falls through to load-based placement (and
+        # the engine-snapshot dispatch fallback keeps that correct).
+        if shard is not None:
+            owner = self.lanes[shard]
+            if owner.breaker is None or owner.breaker.state != health.DOWN:
+                return owner
+        # Backlog = queued + in-flight rows;
         # ties rotate round-robin — a low-rate stream (every lane idle
         # at every placement) must still spread across the fleet, or
         # one lane serves everything while its siblings' caches go
@@ -274,6 +317,8 @@ class LaneSet:
         import jax
 
         eng = self._eng
+        if self._sharded:
+            return self._adopt_shard(lane)
         with eng._exe_lock:
             src = eng._table
             v = eng._table_version
@@ -287,6 +332,121 @@ class LaneSet:
                 lane.table, lane.table_version = staged, v
             return lane.table, lane.table_version
 
+    # ------------------------------------------------- shard tables (PR 16)
+    def _shard_capacity_max(self) -> int:
+        """The per-lane row budget under sharding: an even split of the
+        engine's ``max_subjects`` (ceiling) — the per-lane footprint
+        the replicated design multiplied by N collapses to ~1/N."""
+        n = len(self.lanes)
+        return max(1, -(-self._eng.max_subjects // n))
+
+    def _adopt_shard(self, lane: Lane):
+        """(Re)derive ``lane``'s shard-LOCAL table from the engine's
+        live state: the rows this lane OWNS (``shard_of``), most
+        recently used first, up to the per-lane budget. The sharded
+        counterpart of ``_adopt`` — warm-up-class data movement, and
+        the first-use path for a lane that has never seen a broadcast.
+        Returns the lane's (table, version) after the attempt."""
+        from mano_hand_tpu.models import core
+        from mano_hand_tpu.serving.subject_store import shard_of
+
+        eng = self._eng
+        n = len(self.lanes)
+        with eng._exe_lock:
+            src = eng._table
+            v = eng._table_version
+            owned = [d for d in eng._subject_lru
+                     if shard_of(d, n) == lane.index]
+            eslots = {d: eng._subject_slots[d] for d in owned}
+        if src is None:
+            raise RuntimeError(
+                "no specialized subject to shard into lanes; call "
+                "specialize(betas) first")
+        owned = owned[-self._shard_capacity_max():]   # LRU keeps the tail
+        rows = {d: core.table_row(src, eslots[d]) for d in owned}
+        for _ in range(4):
+            if self._install_shard_rows(lane, rows, version=v):
+                break
+            # A racing swap (broadcast / sibling adopter) bumped the
+            # epoch mid-stage; retry from the fresh state — on
+            # exhaustion dispatch still serves via the engine-snapshot
+            # fallback, so giving up here is safe.
+        with self._lock:
+            return lane.table, lane.table_version
+
+    def _install_shard_rows(self, lane: Lane, rows: dict,
+                            version: Optional[int] = None) -> bool:
+        """Stage ``rows`` (digest -> ShapedHand) into ``lane``'s shard
+        table and swap (table + slot map + LRU together, one ``_lock``
+        hold, epoch-guarded).
+
+        Capacity policy: the shard table is allocated at the FULL
+        per-lane budget (``ceil(max_subjects / N)``) on first build and
+        never resized — the budget is exactly the advertised sharded
+        footprint (still ~1/N of a replica), and a fixed capacity
+        keeps the gathered executables' input shapes stable, so
+        steady-state dispatch is structurally recompile-free (the
+        engine's pre-grow-at-warmup reasoning, applied per lane).
+        Slots fill never-used rows first, then local-LRU eviction
+        reuses a slot INSIDE the staged table only — captured
+        (table, slots) pairs from earlier holds stay consistent.
+        Returns False on an epoch race (nothing swapped) or when
+        ``rows`` exceeds the per-lane budget (the caller's dispatch
+        falls back to the engine snapshot)."""
+        import jax
+
+        from mano_hand_tpu.models import core
+
+        cap = self._shard_capacity_max()
+        if len(rows) > cap:
+            return False
+        with self._lock:
+            tab = lane.table
+            slots = dict(lane.shard_slots)
+            lru = list(lane.shard_lru)
+            nxt = lane.shard_next_slot
+            epoch = lane.shard_epoch
+        assign = {}
+        for d in rows:
+            if d in slots:
+                assign[d] = slots[d]
+            elif nxt < cap:
+                assign[d] = nxt
+                slots[d] = nxt
+                nxt += 1
+            else:
+                victim = next((k for k in lru if k not in rows), None)
+                if victim is None:       # rows wider than the budget
+                    return False
+                s = slots.pop(victim)
+                lru.remove(victim)
+                assign[d] = s
+                slots[d] = s
+            if d in lru:
+                lru.remove(d)
+            lru.append(d)
+        # Device work on the STAGED table, outside _lock (the lane
+        # workers block there per batch — the _install_subject rule).
+        if tab is None:
+            tab = core.subject_table(self._lane_params(lane), cap)
+        for d, shaped in rows.items():
+            tab = core.jit_table_set_row(
+                tab, assign[d], jax.device_put(shaped, lane.device))
+        with self._lock:
+            if lane.shard_epoch != epoch:
+                return False             # a concurrent swap won; retry
+            lane.table = tab
+            lane.shard_slots = slots
+            lane.shard_lru = collections.OrderedDict(
+                (k, None) for k in lru)
+            lane.shard_next_slot = nxt
+            lane.shard_epoch = epoch + 1
+            if version is not None:
+                # Telemetry only in sharded mode: consistency is the
+                # digest-keyed atomic swap, never a version proof.
+                lane.table_version = version
+        return True
+
     def _lane_table(self, lane: Lane):
         """The lane's replica, adopted on first use — the warm-up /
         executable-build entry point. Dispatch correctness does NOT
@@ -299,7 +459,7 @@ class LaneSet:
         return self._adopt(lane)[0]
 
     def broadcast_row(self, slot: int, shaped, grew: bool,
-                      version: int) -> None:
+                      version: int, digest: Optional[str] = None) -> None:
         """Mirror one installed subject row into every lane replica —
         called by ``ServingEngine._install_subject`` AFTER the engine
         table swap, still under ``_install_lock`` (the table's only
@@ -314,11 +474,31 @@ class LaneSet:
         ``_adopt`` path, so a replica can never publish with a
         silently missing row. Growth additionally rebuilds the lane's
         gathered executables eagerly (warm-up-class, counted like the
-        engine's own growth compiles)."""
+        engine's own growth compiles).
+
+        Sharded mode (PR 16): the row lands on its OWNER lane only
+        (``shard_of(digest, N)``) through the epoch-guarded shard
+        install — one row of data movement total instead of one per
+        lane, which is the broadcast-bandwidth half of the sharding
+        win."""
         import jax
 
         from mano_hand_tpu.models import core
 
+        if self._sharded:
+            if digest is None:
+                return       # kind-only engines never take this path
+            from mano_hand_tpu.serving.subject_store import shard_of
+
+            owner = self.lanes[shard_of(digest, len(self.lanes))]
+            for _ in range(4):
+                if self._install_shard_rows(owner, {digest: shaped},
+                                            version=version):
+                    return
+            # Epoch races kept winning (adopter churn): the row is
+            # still served correctly via the engine-snapshot dispatch
+            # fallback; the next owner-lane resolve pulls it in.
+            return
         for lane in self.lanes:
             with self._lock:
                 tab, v = lane.table, lane.table_version
@@ -357,13 +537,21 @@ class LaneSet:
         dispatch (the engine's ``_install_subject`` rule, per lane)."""
         with self._lock:
             tab = lane.table
-            stale = ([] if tab is None else
-                     [b for b, (c, _) in lane.gather_exes.items()
-                      if c != tab.capacity])
-            stale_bf16 = ([] if tab is None else
-                          [b for b, (c, _)
-                           in lane.gather_exes_bf16.items()
-                           if c != tab.capacity])
+            cap = None if tab is None else tab.capacity
+
+            def _stale(cache):
+                if cap is None:
+                    return []
+                if self._sharded:
+                    # (bucket, capacity) keys: a bucket is stale when
+                    # it has entries but none at the new capacity.
+                    buckets = {b for (b, _c) in cache}
+                    fresh = {b for (b, c) in cache if c == cap}
+                    return sorted(buckets - fresh)
+                return [b for b, (c, _) in cache.items() if c != cap]
+
+            stale = _stale(lane.gather_exes)
+            stale_bf16 = _stale(lane.gather_exes_bf16)
         for b in stale:
             self._gather_executable(lane, b)
         for b in stale_bf16:
@@ -420,8 +608,14 @@ class LaneSet:
         eng = self._eng
         cache = (lane.gather_exes_bf16 if prec == "bf16"
                  else lane.gather_exes)
+        # Sharded lanes key by (bucket, capacity): the engine-snapshot
+        # dispatch fallback runs ENGINE-capacity tables through the same
+        # cache, and the replicated larger-capacity-wins policy would
+        # let one fallback evict the shard-capacity entry — turning
+        # every later owner-lane dispatch into a steady recompile.
+        key = (bucket, cap) if self._sharded else bucket
         with self._lock:
-            entry = cache.get(bucket)
+            entry = cache.get(key)
         if entry is not None and entry[0] == cap:
             return entry[1], tab
         fused = eng._posed_fused_active(cap)
@@ -452,24 +646,44 @@ class LaneSet:
             built = pol.chaos.wrap(built, on_fault=eng._on_chaos_fault,
                                    lane=lane.index)
         with self._lock:
-            cur = cache.get(bucket)
+            cur = cache.get(key)
             if cur is not None and cur[0] == cap:
                 return cur[1], tab
-            if cur is None or cur[0] < cap:
-                cache[bucket] = (cap, built)
+            if self._sharded or cur is None or cur[0] < cap:
+                cache[key] = (cap, built)
         return built, tab
 
     def warm(self, buckets: Sequence[int], *, posed: bool) -> None:
         """Build every lane's executables for ``buckets`` up front —
         warm-up is where compile latency belongs, N-lane edition
         (both precision families when a PrecisionPolicy names bf16
-        tiers, so ladder hops never pay a bf16 compile mid-outage)."""
+        tiers, so ladder hops never pay a bf16 compile mid-outage).
+        Sharded lanes additionally pre-build the ENGINE-capacity
+        family each bucket — the engine-snapshot dispatch fallback
+        (raced install, foreign-shard ladder hop) must cost a table
+        transfer, never a mid-traffic compile; the staged full table
+        is dropped right after the build, so nothing engine-sized
+        stays resident."""
+        import jax
+
+        fallback_tab = None
+        if posed and self._sharded:
+            with self._eng._exe_lock:
+                src = self._eng._table
+            fallback_tab = src
         for lane in self.lanes:
+            staged = (None if fallback_tab is None
+                      else jax.device_put(fallback_tab, lane.device))
             for b in buckets:
                 if posed:
                     self._gather_executable(lane, b)
+                    if staged is not None:
+                        self._gather_executable(lane, b, staged)
                     if self._eng._bf16_serving():
                         self._gather_executable(lane, b, prec="bf16")
+                        if staged is not None:
+                            self._gather_executable(lane, b, staged,
+                                                    prec="bf16")
                 else:
                     self._full_executable(lane, b)
 
@@ -493,6 +707,8 @@ class LaneSet:
         import jax
 
         eng = self._eng
+        if self._sharded:
+            return self._resolve_sharded(lane, reqs)
         digests = [r.subject for r in reqs]
         for _ in range(4):
             _, slots = eng._resolve_batch(reqs)
@@ -514,6 +730,64 @@ class LaneSet:
                 self._adopt(lane)
             # v > v_eng (a broadcast landed mid-validation): retry —
             # the next round reads a newer consistent pair.
+        table, slots = eng._resolve_batch(reqs)
+        return jax.device_put(table, lane.device), slots
+
+    def _resolve_sharded(self, lane: Lane, reqs):
+        """(shard table, local slots) for one posed batch on its OWNER
+        lane. Consistency needs no version proof here: the slot map and
+        table swap together (epoch-guarded, one ``_lock`` hold), and a
+        digest-keyed row is content-correct whatever the engine's live
+        table did since — the worst case of serving an engine-evicted
+        subject from its shard row is still bit-exact, because the row
+        IS that subject's bake. Missing rows are pulled through the
+        engine's ``_resolve_batch`` (which re-bakes evictions and
+        counts them) into the shard table, then the read retries once;
+        a foreign-shard batch (ladder hop / owner-down placement) or a
+        lost install race dispatches a per-batch device_put of the
+        engine snapshot — always correct, paid only off the happy
+        path."""
+        import jax
+
+        from mano_hand_tpu.models import core
+        from mano_hand_tpu.serving.subject_store import shard_of
+
+        eng = self._eng
+        digests = [r.subject for r in reqs]
+        n = len(self.lanes)
+
+        def read_local():
+            """One-lock-hold (table, slots) read; None unless every
+            digest is locally resident."""
+            with self._lock:
+                tab = lane.table
+                if tab is None:
+                    return None
+                slots = [lane.shard_slots.get(d) for d in digests]
+                if any(s is None for s in slots):
+                    return None
+                for d in digests:
+                    lane.shard_lru.move_to_end(d)
+                return tab, slots
+
+        if all(shard_of(d, n) == lane.index for d in digests):
+            for attempt in range(2):
+                got = read_local()
+                if got is not None:
+                    # The shard fast path never reaches
+                    # _resolve_batch, so the hot-tier hit is counted
+                    # HERE (outside the lock) or the drill's hit rate
+                    # undercounts every locally-served batch.
+                    eng.counters.count_store_hot(len(set(digests)))
+                    return got
+                if attempt:
+                    break
+                src, eslots = eng._resolve_batch(reqs)
+                rows = {d: core.table_row(src, s)
+                        for d, s in zip(digests, eslots)}
+                for _ in range(4):
+                    if self._install_shard_rows(lane, rows):
+                        break
         table, slots = eng._resolve_batch(reqs)
         return jax.device_put(table, lane.device), slots
 
@@ -797,6 +1071,16 @@ class LaneSet:
                     "device": str(ln.device),
                     "state": (ln.breaker.state if ln.breaker is not None
                               else health.HEALTHY),
+                    # Allocated device rows / rows actually resident —
+                    # the sharded-vs-replicated memory headline (a
+                    # replica's residency IS its capacity; a shard
+                    # table holds only its slot-mapped digests).
+                    "table_capacity": (ln.table.capacity
+                                       if ln.table is not None else 0),
+                    "resident_rows": (len(ln.shard_slots)
+                                      if self._sharded else
+                                      (ln.table.capacity
+                                       if ln.table is not None else 0)),
                     "backlog_batches": ln.backlog_batches,
                     "backlog_rows": ln.backlog_rows,
                     "inflight": ln.inflight,
@@ -811,6 +1095,7 @@ class LaneSet:
             return {
                 "n_lanes": len(self.lanes),
                 "n_devices": self.n_devices,
+                "sharded": self._sharded,
                 "healthy": sum(1 for p in per
                                if p["state"] != health.DOWN),
                 "assigned_total": sum(p["assigned"] for p in per),

@@ -3818,3 +3818,414 @@ def edge_drill_run(
         "flight_record": flight_record(
             tracer, eng.counters, reason="edge_drill_complete"),
     }
+
+
+def subject_store_drill_run(
+    params,
+    *,
+    subjects: int = 100_000,
+    requests_per_leg: int = 120,
+    lanes: int = 2,
+    max_subjects: int = 32,
+    warm_capacity: int = 64,
+    max_rows: int = 2,
+    max_bucket: int = 8,
+    zipf_a: float = 1.2,
+    max_delay_s: float = 0.003,
+    workers: int = 8,
+    pair_slice: int = 20,
+    seed: int = 0,
+    cold_dir: Optional[str] = None,
+    backend: Optional[str] = None,
+    tracer=None,
+    log: Callable[[str], None] = None,
+) -> dict:
+    """THE tiered-subject-store capacity drill (PR 16 tentpole; bench
+    config19).
+
+    ``subjects`` synthetic identities (default 100k) are REGISTERED —
+    betas only, ~40 bytes each, never bulk-baked — on two lane-fleet
+    engines driven through the capacity ladder under Zipf traffic:
+
+    * **hot_only** — working set <= ``max_subjects``: every request
+      resolves from the device table (the warmup pre-fills it to full
+      capacity, so the leg is recompile- and promotion-free);
+    * **warm_spill** — working set > hot but <= hot + warm: evictions
+      demote rows to host RAM and later dispatches PROMOTE them back
+      (async ``device_put`` started at coalesce admit), never
+      re-running the shape stage;
+    * **cold_spill** — Zipf over the whole universe: warm-LRU overflow
+      pages rows to disk (orbax row pages) and deep-tail requests page
+      them back (or re-bake on a true miss — counted, never an error).
+      A DAMAGE PROBE then corrupts one cold page in place and requests
+      that subject: the load must degrade to a counted re-bake
+      (``subject_store_cold_damage``) with a bit-correct result.
+
+    The INTERLEAVED PAIRED protocol (the slope-time discipline applied
+    to A/B serving): each leg's request stream is cut into slices run
+    alternately on the SHARDED engine (N lanes holding N disjoint
+    shard tables through the store) and a REPLICATED twin (same lanes,
+    no store) — same requests, same load, so the throughput ratio and
+    the per-lane device-rows comparison are paired, not sequential.
+    ``scripts/bench_report.py:judge_subject_store`` reads: hot-tier
+    hit rate, promotion-stall p99 inside the coalesce window, ZERO
+    steady recompiles across the whole ladder, per-lane device rows
+    strictly below the replicated baseline, every future resolved
+    (misses counted, never errored), spans closed exactly once. All
+    CPU-lane-provable; no chip required.
+    """
+    import concurrent.futures as cf
+    import tempfile
+    import threading
+
+    import jax
+
+    from mano_hand_tpu.serving.engine import ServingEngine, ServingError
+    from mano_hand_tpu.serving.subject_store import (SubjectStore,
+                                                     SubjectStoreConfig)
+
+    log = _logger(log)
+    if tracer is None:
+        tracer = Tracer(capacity=65536)
+    n_joints, n_shape = params.n_joints, params.n_shape
+    prm32 = params.astype(np.float32)
+    rng = np.random.default_rng(seed)
+    universe = rng.normal(size=(subjects, n_shape)).astype(np.float32)
+
+    tmp = None
+    if cold_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="mano_subject_store_")
+        cold_dir = tmp.name
+
+    # The ladder's working sets (universe index ranges / samplers).
+    hot_n = max_subjects
+    warm_n = min(subjects, max_subjects + max(8, warm_capacity // 2))
+
+    def make_stream(n, leg_universe, pass_seed):
+        r = np.random.default_rng(pass_seed)
+        idx = (r.zipf(zipf_a, size=n).astype(np.int64) - 1) % leg_universe
+        sizes = r.integers(1, max_rows + 1, size=n)
+        return [(r.normal(scale=0.4,
+                          size=(int(s), n_joints, 3)).astype(np.float32),
+                 int(i))
+                for s, i in zip(sizes, idx)]
+
+    legs = ("hot_only", "warm_spill", "cold_spill")
+    streams = {
+        "hot_only": make_stream(requests_per_leg, hot_n, seed + 101),
+        "warm_spill": make_stream(requests_per_leg, warm_n, seed + 102),
+        "cold_spill": make_stream(requests_per_leg, subjects, seed + 103),
+    }
+
+    # Reference pass FIRST: the single-device engine, subjects baked on
+    # demand — the bit-identity bar for every tiered/sharded result.
+    reference = {}
+    ref_eng = ServingEngine(prm32, max_bucket=max_bucket,
+                            max_delay_s=0.001)
+    with ref_eng:
+        ref_keys = {}
+
+        def ref_forward(pose, si):
+            if si not in ref_keys:
+                ref_keys[si] = ref_eng.specialize(universe[si])
+            return ref_eng.forward(pose, subject=ref_keys[si])
+
+        for name in legs:
+            reference[name] = [ref_forward(p, si)
+                               for p, si in streams[name]]
+
+    store = SubjectStore(SubjectStoreConfig(
+        warm_capacity=warm_capacity, cold_dir=cold_dir,
+        sharded=True, backend=backend))
+    eng_s = ServingEngine(
+        prm32, max_bucket=max_bucket, max_subjects=max_subjects,
+        max_delay_s=max_delay_s, lanes=lanes, tracer=tracer,
+        subject_store=store)
+    eng_r = ServingEngine(
+        prm32, max_bucket=max_bucket, max_subjects=max_subjects,
+        max_delay_s=max_delay_s, lanes=lanes)
+    resolve_timeout = 120.0
+
+    def run_slice(eng, keys, stream, outcomes, results, base):
+        lock = threading.Lock()
+
+        def submit_one(j):
+            p, si = stream[j]
+            fut = eng.submit(p, subject=keys[si])
+            try:
+                results[base + j] = fut.result(timeout=resolve_timeout)
+                k = "ok"
+            except ServingError as e:
+                k = "expired" if e.kind == "expired" else "error"
+            except Exception:   # noqa: BLE001 — a timeout IS the bug
+                k = "stranded"
+            with lock:
+                outcomes[k] += 1
+
+        t0 = time.perf_counter()
+        with cf.ThreadPoolExecutor(max_workers=workers) as pool:
+            list(pool.map(submit_one, range(len(stream))))
+        return time.perf_counter() - t0
+
+    def max_err(results, refs):
+        worst = 0.0
+        for got, want in zip(results, refs):
+            if got is None:
+                return None          # an unresolved result: no bar
+            worst = max(worst, float(np.abs(got - want).max()))
+        return worst
+
+    leg_out = {}
+    damage = {}
+    try:
+        with eng_s, eng_r:
+            keys_s = eng_s.register_subjects(universe)
+            keys_r = eng_r.register_subjects(universe)
+            assert keys_s == keys_r     # content-addressed, same bytes
+            # Pre-fill the hot tier to FULL capacity, then warm: the
+            # table (and every shard table) reaches its final shape
+            # before any executable builds, so the whole ladder runs
+            # with zero steady recompiles — growth is a warmup event.
+            for i in range(hot_n):
+                eng_s.specialize(universe[i])
+                eng_r.specialize(universe[i])
+            buckets = [b for b in eng_s.buckets if b <= max_bucket]
+            for e in (eng_s, eng_r):
+                e.warmup(buckets)
+                e.warmup_posed(buckets)
+            warm_compiles_s = eng_s.counters.compiles
+            warm_compiles_r = eng_r.counters.compiles
+            log(f"subject-store drill: {subjects} registered subjects, "
+                f"hot={max_subjects} warm={warm_capacity} "
+                f"lanes={lanes} sharded vs replicated, "
+                f"{warm_compiles_s} warm-up compiles (sharded)")
+
+            dt_s_total = dt_r_total = 0.0
+            oc_s = {"ok": 0, "error": 0, "expired": 0, "stranded": 0}
+            oc_r = dict(oc_s)
+            for name in legs:
+                stream = streams[name]
+                res_s = [None] * len(stream)
+                res_r = [None] * len(stream)
+                dt_s = dt_r = 0.0
+                store_before = eng_s.counters.snapshot()
+                for base in range(0, len(stream), pair_slice):
+                    sl = stream[base:base + pair_slice]
+                    dt_s += run_slice(eng_s, keys_s, sl, oc_s,
+                                      res_s, base)
+                    dt_r += run_slice(eng_r, keys_r, sl, oc_r,
+                                      res_r, base)
+                dt_s_total += dt_s
+                dt_r_total += dt_r
+                after = eng_s.counters.snapshot()
+                leg_out[name] = {
+                    "requests": len(stream),
+                    "distinct_subjects": len({si for _, si in stream}),
+                    "sharded_vs_reference_max_abs_err": max_err(
+                        res_s, reference[name]),
+                    "replicated_vs_reference_max_abs_err": max_err(
+                        res_r, reference[name]),
+                    "throughput_sharded_per_sec": float(
+                        f"{len(stream) / dt_s:.5g}") if dt_s else None,
+                    "throughput_replicated_per_sec": float(
+                        f"{len(stream) / dt_r:.5g}") if dt_r else None,
+                    "store_deltas": {
+                        k: after[k] - store_before[k]
+                        for k in ("subject_store_hot_hits",
+                                  "subject_store_warm_hits",
+                                  "subject_store_cold_hits",
+                                  "subject_store_misses",
+                                  "subject_store_prefetches",
+                                  "subject_store_demotions_warm",
+                                  "subject_store_demotions_cold")},
+                }
+                log(f"  leg {name}: "
+                    f"{leg_out[name]['distinct_subjects']} subjects, "
+                    f"err_s={leg_out[name]['sharded_vs_reference_max_abs_err']}")
+
+            # -- cold-revisit mini-leg: force organic cold hits -------
+            # A small universe can resolve every paired leg out of
+            # hot+warm (the inclusive tiers keep recently-paged rows
+            # warm), leaving the cold READ path untested by real
+            # traffic.  Pull a handful of evicted-everywhere digests
+            # back through the live engine so the cold tier serves
+            # organic requests, with bit-parity against a fresh
+            # reference engine.
+            from mano_hand_tpu.io import orbax_ckpt
+
+            with eng_s._exe_lock:
+                hot_now = set(eng_s._subject_slots)
+            warm_now = set(store.warm_digests())
+            cold_only = [d for d in store.cold_digests()
+                         if d not in hot_now and d not in warm_now]
+            revisit = cold_only[:max(1, requests_per_leg // 4)]
+            # A stopped engine never restarts: parity for the revisit
+            # leg and the damage probe comes from ONE fresh
+            # single-device engine.
+            ref2 = ServingEngine(prm32, max_bucket=max_bucket,
+                                 max_delay_s=0.001)
+            with ref2:
+                if revisit:
+                    rv_before = eng_s.counters.snapshot()
+                    pose_rv = rng.normal(
+                        scale=0.4,
+                        size=(1, n_joints, 3)).astype(np.float32)
+                    rv_err = 0.0
+                    t0_rv = time.perf_counter()
+                    for d in revisit:
+                        got = eng_s.submit(
+                            pose_rv,
+                            subject=d).result(timeout=resolve_timeout)
+                        oc_s["ok"] += 1
+                        want = ref2.forward(
+                            pose_rv, subject=ref2.specialize(
+                                universe[keys_s.index(d)]))
+                        rv_err = max(rv_err, float(
+                            np.abs(np.asarray(got) - want).max()))
+                    dt_rv = time.perf_counter() - t0_rv
+                    rv_after = eng_s.counters.snapshot()
+                    leg_out["cold_revisit"] = {
+                        "requests": len(revisit),
+                        "distinct_subjects": len(revisit),
+                        "sharded_vs_reference_max_abs_err": rv_err,
+                        "throughput_sharded_per_sec": float(
+                            f"{len(revisit) / dt_rv:.5g}")
+                        if dt_rv else None,
+                        "store_deltas": {
+                            k: rv_after[k] - rv_before[k]
+                            for k in (
+                                "subject_store_hot_hits",
+                                "subject_store_warm_hits",
+                                "subject_store_cold_hits",
+                                "subject_store_misses",
+                                "subject_store_prefetches",
+                                "subject_store_demotions_warm",
+                                "subject_store_demotions_cold")},
+                    }
+                    log(f"  leg cold_revisit: {len(revisit)} subjects, "
+                        f"err_s={rv_err}, cold_hits="
+                        f"{leg_out['cold_revisit']['store_deltas']['subject_store_cold_hits']}")
+
+                # -- damage probe: corrupt one cold page IN PLACE -----
+                # The victim comes from the NON-revisited cold
+                # remainder: revisited digests were just promoted back
+                # to hot/warm and would be served without touching
+                # their (corrupted) page.
+                with eng_s._exe_lock:
+                    hot_now = set(eng_s._subject_slots)
+                warm_now = set(store.warm_digests())
+                rv_set = set(revisit)
+                victims = [d for d in store.cold_digests()
+                           if d not in hot_now and d not in warm_now
+                           and d not in rv_set]
+                if victims:
+                    vd = victims[0]
+                    vi = keys_s.index(vd)
+                    meta, arrays = orbax_ckpt.load_row_page(vd, cold_dir)
+                    # A self-CONSISTENT page for the WRONG subject: the
+                    # per-array hashes verify, the digest preimage does
+                    # not — exactly the silent-corruption case the
+                    # content check exists for.
+                    arrays["shape"] = np.asarray(arrays["shape"]) + 1.0
+                    orbax_ckpt.save_row_page(vd, arrays, cold_dir,
+                                             backend=backend)
+                    dmg_before = eng_s.counters.snapshot()[
+                        "subject_store_cold_damage"]
+                    pose = rng.normal(
+                        scale=0.4,
+                        size=(1, n_joints, 3)).astype(np.float32)
+                    want = ref2.forward(
+                        pose, subject=ref2.specialize(universe[vi]))
+                    got = eng_s.submit(
+                        pose, subject=vd).result(timeout=resolve_timeout)
+                    oc_s["ok"] += 1
+                    dmg_after = eng_s.counters.snapshot()[
+                        "subject_store_cold_damage"]
+                    damage = {
+                        "injected": True,
+                        "damage_counted": int(dmg_after - dmg_before),
+                        "request_max_abs_err": float(
+                            np.abs(np.asarray(got) - want).max()),
+                    }
+                else:
+                    damage = {"injected": False}
+
+            steady_recompiles_s = (eng_s.counters.compiles
+                                   - warm_compiles_s)
+            steady_recompiles_r = (eng_r.counters.compiles
+                                   - warm_compiles_r)
+            counters_snap = eng_s.counters.snapshot()
+            load_s = eng_s.load()
+            load_r = eng_r.load()
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+    lookups = sum(counters_snap[k] for k in (
+        "subject_store_hot_hits", "subject_store_warm_hits",
+        "subject_store_cold_hits", "subject_store_misses"))
+    hot_rate = (counters_snap["subject_store_hot_hits"] / lookups
+                if lookups else None)
+    prom = counters_snap["subject_store_promotion_ms"]
+    per_s = load_s["lanes"]["per_lane"]
+    per_r = load_r["lanes"]["per_lane"]
+    rows_s = [p["table_capacity"] for p in per_s]
+    rows_r = [p["table_capacity"] for p in per_r]
+    n_paired = len(legs) * requests_per_leg
+    n_total = n_paired + len(revisit) + (
+        1 if damage.get("injected") else 0)
+    resolved = n_total - oc_s["stranded"]
+    acc = tracer.accounting()
+    return {
+        "subjects_registered": int(subjects),
+        "lanes": int(lanes),
+        "hot_capacity": int(max_subjects),
+        "warm_capacity": int(warm_capacity),
+        "zipf_a": float(zipf_a),
+        "coalesce_window_ms": float(max_delay_s * 1e3),
+        "requests_total": int(n_total),
+        "futures_resolved_fraction": float(f"{resolved / n_total:.6g}"),
+        "outcomes": oc_s,
+        "outcomes_replicated": oc_r,
+        "legs": leg_out,
+        "damage_probe": damage,
+        "hot_tier_hit_rate": (None if hot_rate is None
+                              else float(f"{hot_rate:.6g}")),
+        "store_counters": {
+            k: counters_snap[k] for k in (
+                "subject_store_hot_hits", "subject_store_warm_hits",
+                "subject_store_cold_hits", "subject_store_misses",
+                "subject_store_prefetches",
+                "subject_store_promotions",
+                "subject_store_demotions_warm",
+                "subject_store_demotions_cold",
+                "subject_store_cold_damage")},
+        "promotion_stall_ms": prom,
+        "promotion_p99_within_window": bool(
+            prom["n"] == 0 or prom["p99_ms"] <= max_delay_s * 1e3),
+        "steady_recompiles": int(steady_recompiles_s),
+        "steady_recompiles_replicated": int(steady_recompiles_r),
+        "per_lane_device_rows_sharded": rows_s,
+        "per_lane_device_rows_replicated": rows_r,
+        "device_rows_ratio": (
+            float(f"{max(rows_s) / max(rows_r):.4g}")
+            if rows_r and max(rows_r) else None),
+        "throughput_sharded_per_sec": float(
+            f"{n_paired / dt_s_total:.5g}") if dt_s_total else None,
+        "throughput_replicated_per_sec": float(
+            f"{n_paired / dt_r_total:.5g}") if dt_r_total else None,
+        "paired_throughput_ratio": (
+            float(f"{dt_r_total / dt_s_total:.4g}")
+            if dt_s_total and dt_r_total else None),
+        "subject_store": load_s["subject_store"],
+        "lanes_sharded": bool(load_s["lanes"].get("sharded")),
+        "platform": jax.default_backend(),
+        "spans": {
+            "started": acc["spans_started"],
+            "closed": acc["spans_closed"],
+            "open": acc["spans_open"],
+            "closed_by_kind": acc["closed_by_kind"],
+        },
+        "flight_record": flight_record(
+            tracer, eng_s.counters, reason="subject_store_drill_complete"),
+    }

@@ -536,10 +536,17 @@ def open_stream(engine, subject, *, n_steps: int = 4,
                 f"unknown subject {subject!r}; pass the betas array "
                 "(open_stream bakes it) or a specialize() key")
         key = subject
+        # Tiered store (PR 16): start the async host->device promotion
+        # BEFORE the (re-)bake below — an evicted-but-warm subject's
+        # row transfer overlaps the open instead of stalling it.
+        engine._prefetch_subject(key)
         engine.specialize(betas)    # refresh LRU; re-bake if evicted
     else:
         betas = np.ascontiguousarray(
             np.asarray(subject, engine._dtype).reshape(engine._n_shape))
+        from mano_hand_tpu.serving.subject_store import subject_digest
+
+        engine._prefetch_subject(subject_digest(betas))
         key = engine.specialize(betas)
 
     tr = engine.tracer

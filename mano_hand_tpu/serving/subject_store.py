@@ -1,0 +1,344 @@
+"""Tiered subject store: page O(100k) subjects through device/host/disk.
+
+The engine's device-resident ``SubjectTable`` (PR 4) is the HOT tier and
+stays the single source of truth for what a dispatch gathers from; this
+module adds the two tiers underneath it plus the shard map that turns
+PR-13's per-lane replicas into disjoint shards:
+
+* **warm** — evicted rows land as host ``numpy`` copies in a bounded
+  LRU (``warm_capacity``); a later dispatch PROMOTES the row back with
+  ``jax.device_put`` instead of re-running the shape stage.  Promotion
+  is started asynchronously at coalesce-admit / ``open_stream`` time
+  (``prefetch``), so the transfer hides inside the coalesce window and
+  the install path only pays the residual ``block_until_ready`` stall —
+  which is exactly what ``subject_store_promotion_ms`` measures.
+* **cold** — warm-LRU overflow pages rows to disk through
+  ``io/orbax_ckpt.py`` row pages (one directory per subject digest,
+  content-hashed).  A damaged page NEVER errors a request: the load
+  degrades to a counted re-bake (``subject_store_cold_damage``), the
+  PR-6 damage contract applied to paging.
+* **shards** — ``shard_of(digest, n)`` is the pure content-based
+  subject→lane placement used when ``sharded=True``: lane *k* keeps
+  rows only for shard *k* in a shard-local table (lanes.py), so N lanes
+  hold N DISJOINT slices instead of N full replicas — the per-lane
+  device footprint drops by ~N at equal subject count.
+
+Locking: the store's ``_lock`` is LEAF-LEVEL — it is acquired with no
+engine lock held by the store itself, never acquires any other lock
+inside, and no device work runs under it (transfers are staged outside,
+like every device op on the engine's install path).  Counters live on
+the engine's ``ServingCounters`` (bound at attach), which has its own
+leaf lock.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+# The baked row's arrays, exactly the checkpoint schema of
+# engine.checkpoint_subjects: "shape" IS the digest preimage (the
+# dtype-normalized betas specialize hashed), so a cold page is
+# self-verifying without a sidecar.
+ROW_KEYS = ("v_shaped", "joints", "shape")
+
+
+def subject_digest(betas: np.ndarray) -> str:
+    """The engine's subject key for a NORMALIZED betas array (must stay
+    in lockstep with ``ServingEngine.specialize``'s hashing)."""
+    return hashlib.sha256(
+        np.ascontiguousarray(betas).tobytes()).hexdigest()[:16]
+
+
+def shard_of(digest: str, n_shards: int) -> int:
+    """Content-based subject→shard placement: stable across restarts,
+    independent of registration order, uniform over sha256 prefixes."""
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    return int(digest[:8], 16) % n_shards
+
+
+@dataclass
+class SubjectStoreConfig:
+    """Tier sizing for one :class:`SubjectStore`.
+
+    ``warm_capacity``: max rows held as host copies (LRU beyond it
+    pages to ``cold_dir`` when set, else the row is dropped and the
+    next access re-bakes).  ``cold_dir``: row-page directory (None =
+    no cold tier).  ``sharded``: lanes hold disjoint shard tables
+    instead of full replicas.  ``backend``: cold-page serialization
+    override, forwarded to ``io.orbax_ckpt`` ("orbax" | "pickle" |
+    None = auto)."""
+
+    warm_capacity: int = 1024
+    cold_dir: Optional[str] = None
+    sharded: bool = False
+    backend: Optional[str] = None
+
+    def __post_init__(self):
+        if self.warm_capacity < 1:
+            raise ValueError(
+                f"warm_capacity must be >= 1, got {self.warm_capacity}")
+
+
+class SubjectStore:
+    """The warm/cold tiers + shard map under one serving engine.
+
+    One store binds to ONE engine (``ServingEngine(subject_store=...)``
+    calls :meth:`bind`); all mutation happens on engine threads
+    (dispatcher / installers / stream opens), under the store's own
+    leaf lock.
+    """
+
+    def __init__(self, config: Optional[SubjectStoreConfig] = None, **kw):
+        self.config = config if config is not None else SubjectStoreConfig(
+            **kw)
+        self._lock = threading.Lock()
+        self._warm: "OrderedDict[str, dict]" = OrderedDict()
+        # digest -> (handles dict, t_started) for an in-flight async
+        # promotion; consumed (popped) by fetch_row on the install path.
+        self._promotions: dict = {}
+        self._cold_index: set = set()
+        self._counters = None
+        self._n_shards: Optional[int] = None
+        if self.config.cold_dir is not None:
+            # Adopt pages a previous process left behind: paging is a
+            # persistence layer, not per-process scratch.
+            from mano_hand_tpu.io import orbax_ckpt
+
+            self._cold_index.update(
+                orbax_ckpt.list_row_pages(self.config.cold_dir))
+
+    # ------------------------------------------------------------- attach
+    def bind(self, counters, n_shards: Optional[int] = None) -> None:
+        """Attach to an engine's counters (and lane count when sharded).
+        Binding twice to different engines is a wiring bug."""
+        with self._lock:
+            if self._counters is not None and self._counters is not counters:
+                raise RuntimeError(
+                    "SubjectStore is already bound to another engine")
+            self._counters = counters
+            if n_shards is not None:
+                self._n_shards = int(n_shards)
+
+    @property
+    def sharded(self) -> bool:
+        return self.config.sharded
+
+    @property
+    def n_shards(self) -> Optional[int]:
+        return self._n_shards
+
+    def shard_for(self, digest: str) -> Optional[int]:
+        """The owning shard of one subject digest, or None when the
+        store is unsharded / not yet bound to a lane count."""
+        n = self._n_shards
+        if not self.config.sharded or not n:
+            return None
+        return shard_of(digest, n)
+
+    # ------------------------------------------------------------ prefetch
+    def prefetch(self, digest: str) -> bool:
+        """Start an ASYNC host→device promotion for a warm row; returns
+        whether a transfer was started.  Called at coalesce-admit and
+        ``open_stream`` — the points where a dispatch is known to be
+        coming — so the copy overlaps the coalesce window.  A digest
+        that is hot, cold-only, or unknown is a cheap no-op (the install
+        path handles those tiers itself)."""
+        with self._lock:
+            if digest in self._promotions:
+                return False
+            row = self._warm.get(digest)
+        if row is None:
+            return False
+        import jax
+
+        # Device work OUTSIDE the lock; jax.device_put returns with the
+        # transfer in flight — that asynchrony IS the prefetch.
+        handles = {k: jax.device_put(v) for k, v in row.items()}
+        with self._lock:
+            # A racing prefetch of the same digest put the same bytes;
+            # last writer wins harmlessly.
+            self._promotions[digest] = (handles, time.perf_counter())
+        if self._counters is not None:
+            self._counters.count_store_prefetch()
+        return True
+
+    # --------------------------------------------------------------- fetch
+    def fetch_row(self, digest: str):
+        """Resolve one digest from the warm or cold tier for an install;
+        returns ``(row_arrays, tier)`` with the arrays device-resident
+        and ready, or None on a miss (caller re-bakes, counting the
+        miss).  The measured stall — everything this call waited on —
+        lands in the promotion-latency reservoir; a prefetched row's
+        stall is only the residual ``block_until_ready``, which is the
+        whole point."""
+        import jax
+
+        t0 = time.perf_counter()
+        with self._lock:
+            prom = self._promotions.pop(digest, None)
+            row = self._warm.get(digest)
+            if row is not None:
+                self._warm.move_to_end(digest)
+        if prom is not None:
+            handles, _t_started = prom
+            jax.block_until_ready(list(handles.values()))
+            self._record(t0, "warm")
+            return handles, "warm"
+        if row is not None:
+            # Warm hit without a prefetch: the stall is the full
+            # synchronous transfer — honestly measured as such.
+            handles = {k: jax.device_put(v) for k, v in row.items()}
+            jax.block_until_ready(list(handles.values()))
+            self._record(t0, "warm")
+            return handles, "warm"
+        row = self._load_cold(digest)
+        if row is None:
+            return None
+        victims = []
+        with self._lock:
+            # Cold rows promote THROUGH warm (inclusive tiers): the next
+            # eviction of this subject demotes for free.
+            self._warm[digest] = row
+            self._warm.move_to_end(digest)
+            while len(self._warm) > self.config.warm_capacity:
+                victims.append(self._warm.popitem(last=False))
+        self._page_out(victims)
+        handles = {k: jax.device_put(v) for k, v in row.items()}
+        jax.block_until_ready(list(handles.values()))
+        self._record(t0, "cold")
+        return handles, "cold"
+
+    def _record(self, t0: float, tier: str) -> None:
+        c = self._counters
+        if c is None:
+            return
+        if tier == "warm":
+            # The promotion-latency quantile measures the WARM
+            # host->device stall only — the thing prefetch exists to
+            # hide inside the coalesce window (the drill's p99
+            # criterion). Cold paging is disk-bound by design and
+            # observable through its own hit counter; folding it in
+            # would drown the signal the quantile judges.
+            c.record_promotion_stall(time.perf_counter() - t0)
+            c.count_store_warm()
+        else:
+            c.count_store_cold()
+        c.count_store_promotion()
+
+    # -------------------------------------------------------------- demote
+    def demote(self, digest: str, row) -> None:
+        """Insert one evicted subject's row into the warm tier.  The
+        caller passes the row's arrays (device or host); the D2H copy
+        happens HERE, outside every lock — callers must not hold engine
+        locks (the engine calls this after releasing ``_install_lock``).
+        Warm overflow pages the LRU victim to the cold tier."""
+        host = {k: np.asarray(row[k]) for k in ROW_KEYS}
+        victims = []
+        with self._lock:
+            self._warm[digest] = host
+            self._warm.move_to_end(digest)
+            while len(self._warm) > self.config.warm_capacity:
+                victims.append(self._warm.popitem(last=False))
+            self._promotions.pop(digest, None)
+        if self._counters is not None:
+            self._counters.count_store_demotion_warm()
+        self._page_out(victims)
+
+    # ------------------------------------------------------------ cold tier
+    def _page_out(self, victims) -> None:
+        for digest, row in victims:
+            if self.config.cold_dir is None:
+                continue    # no cold tier: the row is gone; next
+                # access is a counted miss → re-bake.
+            with self._lock:
+                present = digest in self._cold_index
+            if present:
+                # Content-addressed: a verified page for this digest
+                # IS this row — re-writing identical bytes buys
+                # nothing (rows promoted THROUGH warm cycle often).
+                continue
+            from mano_hand_tpu.io import orbax_ckpt
+
+            orbax_ckpt.save_row_page(digest, row, self.config.cold_dir,
+                                     backend=self.config.backend)
+            with self._lock:
+                self._cold_index.add(digest)
+            if self._counters is not None:
+                self._counters.count_store_demotion_cold()
+
+    def _load_cold(self, digest: str):
+        """Load + verify one cold page; None on miss OR damage (damage
+        is counted and degrades to a re-bake, never an error)."""
+        if self.config.cold_dir is None:
+            return None
+        with self._lock:
+            known = digest in self._cold_index
+        if not known:
+            return None
+        from mano_hand_tpu.io import orbax_ckpt
+
+        try:
+            meta, arrays = orbax_ckpt.load_row_page(
+                digest, self.config.cold_dir)
+            row = {k: np.asarray(arrays[k]) for k in ROW_KEYS}
+            # Self-verification: "shape" is the digest preimage, and
+            # every array must match the hash recorded at save time.
+            if subject_digest(row["shape"]) != digest:
+                raise ValueError("betas digest mismatch")
+            want = meta.get("row_sha256") or {}
+            for k in ROW_KEYS:
+                got = hashlib.sha256(
+                    np.ascontiguousarray(row[k]).tobytes()).hexdigest()
+                if want.get(k) != got:
+                    raise ValueError(f"row hash mismatch on {k!r}")
+        except Exception:
+            # Drop the damaged page from the index so one bad file
+            # costs one re-bake, not one per access.
+            with self._lock:
+                self._cold_index.discard(digest)
+            if self._counters is not None:
+                self._counters.count_store_cold_damage()
+            return None
+        return row
+
+    def cold_page_path(self, digest: str) -> Optional[Path]:
+        """Where one digest's cold page lives (for drills/tests that
+        inject damage); None when no cold tier is configured."""
+        if self.config.cold_dir is None:
+            return None
+        from mano_hand_tpu.io import orbax_ckpt
+
+        return orbax_ckpt.row_page_path(digest, self.config.cold_dir)
+
+    def cold_digests(self) -> list:
+        with self._lock:
+            return sorted(self._cold_index)
+
+    def warm_digests(self) -> list:
+        with self._lock:
+            return list(self._warm)
+
+    # ------------------------------------------------------------ snapshot
+    def snapshot(self) -> dict:
+        """One-lock-hold tier occupancy (the torn-telemetry rule): every
+        field read under a single hold of the store lock."""
+        with self._lock:
+            return {
+                "warm_rows": len(self._warm),
+                "warm_capacity": self.config.warm_capacity,
+                "promotions_pending": len(self._promotions),
+                "cold_pages": len(self._cold_index),
+                "cold_dir": (None if self.config.cold_dir is None
+                             else str(self.config.cold_dir)),
+                "sharded": self.config.sharded,
+                "shards": self._n_shards,
+            }

@@ -174,6 +174,26 @@ class ServingCounters:
         # expired (timed out).
         self.cancelled = 0
         self.backlog_peak = 0      # max outstanding requests seen at submit
+        # Tiered subject store (PR 16): per-tier resolutions — hot (a
+        # batch's digest already table-resident), warm (host-RAM row
+        # promoted), cold (disk page promoted), miss (no tier held the
+        # row; a full re-bake ran) — plus the movement counters and the
+        # damage counter (a cold page that failed verification and
+        # DEGRADED to a counted re-bake, the PR-6 contract applied to
+        # paging). The promotion-stall reservoir measures what the
+        # install path actually waited on a promotion — near-zero when
+        # the prefetch hid the transfer inside the coalesce window.
+        self.subject_store_hot_hits = 0
+        self.subject_store_warm_hits = 0
+        self.subject_store_cold_hits = 0
+        self.subject_store_misses = 0
+        self.subject_store_prefetches = 0
+        self.subject_store_promotions = 0
+        self.subject_store_demotions_warm = 0
+        self.subject_store_demotions_cold = 0
+        self.subject_store_cold_damage = 0
+        self._promotion_stalls: list = []   # seconds; bounded ring
+        self._promotion_writes = 0
         self.tier_submitted: Dict[int, int] = {}   # tier -> offered
         self.tier_served: Dict[int, int] = {}      # tier -> results delivered
         self.tier_shed: Dict[int, int] = {}        # tier -> admission sheds
@@ -311,6 +331,67 @@ class ServingCounters:
         with self._lock:
             self.table_growths += n
 
+    # -- tiered subject store (PR 16) ------------------------------------
+    def count_store_hot(self, n: int = 1) -> None:
+        """N batch digests resolved straight from the device table."""
+        with self._lock:
+            self.subject_store_hot_hits += n
+
+    def count_store_warm(self, n: int = 1) -> None:
+        """One install served from a host-RAM warm row (no re-bake)."""
+        with self._lock:
+            self.subject_store_warm_hits += n
+
+    def count_store_cold(self, n: int = 1) -> None:
+        """One install served from a verified cold page (no re-bake)."""
+        with self._lock:
+            self.subject_store_cold_hits += n
+
+    def count_store_miss(self, n: int = 1) -> None:
+        """One install NO tier could serve: the shape stage re-ran.
+        Counted, never errored — a miss is a latency event by design."""
+        with self._lock:
+            self.subject_store_misses += n
+
+    def count_store_prefetch(self, n: int = 1) -> None:
+        """One async host→device promotion started ahead of dispatch
+        (coalesce-admit / open_stream)."""
+        with self._lock:
+            self.subject_store_prefetches += n
+
+    def count_store_promotion(self, n: int = 1) -> None:
+        """One row made device-resident from a lower tier."""
+        with self._lock:
+            self.subject_store_promotions += n
+
+    def count_store_demotion_warm(self, n: int = 1) -> None:
+        """One evicted row captured into the warm tier."""
+        with self._lock:
+            self.subject_store_demotions_warm += n
+
+    def count_store_demotion_cold(self, n: int = 1) -> None:
+        """One warm-LRU victim paged to the cold tier."""
+        with self._lock:
+            self.subject_store_demotions_cold += n
+
+    def count_store_cold_damage(self, n: int = 1) -> None:
+        """One cold page that failed verification (missing, unreadable,
+        or content/digest mismatch) and degraded to a counted re-bake."""
+        with self._lock:
+            self.subject_store_cold_damage += n
+
+    def record_promotion_stall(self, seconds: float) -> None:
+        """What one install actually WAITED on a tier promotion (the
+        residual after any prefetch overlap) — same bounded-ring policy
+        as the request-latency reservoir."""
+        with self._lock:
+            if len(self._promotion_stalls) >= _LATENCY_RESERVOIR:
+                self._promotion_stalls[
+                    self._promotion_writes % _LATENCY_RESERVOIR] = seconds
+            else:
+                self._promotion_stalls.append(seconds)
+            self._promotion_writes += 1
+
     def observe_queue_depth(self, depth: int) -> None:
         with self._lock:
             if depth > self.queue_depth_peak:
@@ -416,6 +497,17 @@ class ServingCounters:
                 "expired": self.expired,
                 "cancelled": self.cancelled,
                 "backlog_peak": self.backlog_peak,
+                "subject_store_hot_hits": self.subject_store_hot_hits,
+                "subject_store_warm_hits": self.subject_store_warm_hits,
+                "subject_store_cold_hits": self.subject_store_cold_hits,
+                "subject_store_misses": self.subject_store_misses,
+                "subject_store_prefetches": self.subject_store_prefetches,
+                "subject_store_promotions": self.subject_store_promotions,
+                "subject_store_demotions_warm":
+                    self.subject_store_demotions_warm,
+                "subject_store_demotions_cold":
+                    self.subject_store_demotions_cold,
+                "subject_store_cold_damage": self.subject_store_cold_damage,
             }
             base["padding_waste"] = round(
                 self._waste_ratio(self.rows_live, self.rows_padded), 4)
@@ -436,7 +528,10 @@ class ServingCounters:
                 for t in tiers
             }
             items = {b: list(s) for b, s in self._latencies.items()}
+            stalls = list(self._promotion_stalls)
         # Percentile math alone happens outside the lock (pure reads of
         # the copied sample lists; submitters never wait on numpy).
         base["latency_by_bucket"] = self._quantiles(items)
+        base["subject_store_promotion_ms"] = self._quantiles(
+            {0: stalls}).get(0, {"p50_ms": 0.0, "p99_ms": 0.0, "n": 0})
         return base

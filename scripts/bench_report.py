@@ -1287,6 +1287,125 @@ def main() -> int:
               f"over the drill, load mid-drill "
               f"{(ed.get('load_mid_drill') or {}).get('admission')}")
 
+    def judge_subject_store(sd):
+        """Done-criteria of the tiered subject-store drill (config19,
+        PR 16): O(100k) registered subjects paged through the
+        device/host/disk hierarchy — every capacity-ladder leg (and
+        the cold-revisit leg) bit-identical to a single-device
+        reference on BOTH the sharded fleet and its replicated twin,
+        every future resolved with zero errors/strands, tier lookups
+        mostly served from device residency under Zipf, warm-promotion
+        p99 inside the coalesce window, zero steady recompiles on
+        either engine across the whole ladder, a damaged cold page
+        COUNTED and re-baked (bit-correct result, never an error),
+        per-lane device rows strictly below the replicated baseline,
+        and every span closed exactly once. All CPU-defined: the
+        tiers, the paging, and the sharded routing are host/disk
+        machinery — no chip required. The paired throughput ratio is
+        [info] off-chip (CPU wall-clock carries no signal for a
+        device-memory optimisation — the config14 precedent)."""
+        oc = sd.get("outcomes") or {}
+        oc_r = sd.get("outcomes_replicated") or {}
+        frac = sd.get("futures_resolved_fraction")
+        check("subject_store_all_resolved",
+              frac == 1.0 and oc.get("error") == 0
+              and oc.get("stranded") == 0 and oc_r.get("error") == 0
+              and oc_r.get("stranded") == 0,
+              f"fraction {frac} of {sd.get('requests_total')} requests "
+              f"resolved (sharded ok/error/expired/stranded: "
+              f"{oc.get('ok')}/{oc.get('error')}/{oc.get('expired')}/"
+              f"{oc.get('stranded')}; replicated: {oc_r.get('ok')}/"
+              f"{oc_r.get('error')}/{oc_r.get('expired')}/"
+              f"{oc_r.get('stranded')})")
+        legs = sd.get("legs") or {}
+        errs = {}
+        for name, leg in legs.items():
+            for k in ("sharded_vs_reference_max_abs_err",
+                      "replicated_vs_reference_max_abs_err"):
+                if k in leg:
+                    errs[f"{name}.{k.split('_vs_')[0]}"] = leg[k]
+        check("subject_store_bit_identical",
+              len(legs) >= 3 and errs
+              and all(v == 0.0 for v in errs.values()),
+              f"{len(legs)} legs vs the single-device reference: "
+              f"{errs} (bit-identity bar: 0.0 on every leg, both "
+              "engines)")
+        rate = sd.get("hot_tier_hit_rate")
+        check("subject_store_hot_tier_serves",
+              rate is not None and rate >= 0.5,
+              f"hot-tier hit rate {rate} under Zipf "
+              f"a={sd.get('zipf_a')} (store counters "
+              f"{sd.get('store_counters')}) — the working set must be "
+              "served mostly from device residency, not paged per "
+              "request")
+        cold = (sd.get("store_counters") or {}).get(
+            "subject_store_cold_hits")
+        check("subject_store_cold_tier_serves",
+              cold is not None and cold >= 1,
+              f"{cold} cold-tier hits — the disk tier must serve "
+              "organic traffic (cold-revisit leg), not exist only on "
+              "paper")
+        prom = sd.get("promotion_stall_ms") or {}
+        check("subject_store_promotion_in_window",
+              bool(sd.get("promotion_p99_within_window")),
+              f"warm-promotion stall p50/p99 {prom.get('p50_ms')}/"
+              f"{prom.get('p99_ms')} ms over {prom.get('n')} "
+              f"promotions vs the {sd.get('coalesce_window_ms')} ms "
+              "coalesce window (cold paging is disk-bound by design "
+              "and tracked by its own counter, not this quantile)")
+        check("subject_store_zero_steady_recompiles",
+              sd.get("steady_recompiles") == 0
+              and sd.get("steady_recompiles_replicated") == 0,
+              f"sharded {sd.get('steady_recompiles')} / replicated "
+              f"{sd.get('steady_recompiles_replicated')} steady "
+              "recompiles across the capacity ladder (fixed shard "
+              "budgets keep gathered-executable shapes stable)")
+        dmg = sd.get("damage_probe") or {}
+        check("subject_store_damage_counted",
+              dmg.get("injected") and (dmg.get("damage_counted") or 0) >= 1
+              and dmg.get("request_max_abs_err") == 0.0,
+              f"damaged cold page: injected={dmg.get('injected')}, "
+              f"counted={dmg.get('damage_counted')}, request err "
+              f"{dmg.get('request_max_abs_err')} (degrade to a counted "
+              "re-bake with a bit-correct result — never an error)")
+        rows_s = sd.get("per_lane_device_rows_sharded") or []
+        rows_r = sd.get("per_lane_device_rows_replicated") or []
+        check("subject_store_device_rows_below_replicated",
+              bool(rows_s) and bool(rows_r)
+              and max(rows_s) < min(rows_r),
+              f"per-lane device table rows {rows_s} sharded vs "
+              f"{rows_r} replicated (ratio "
+              f"{sd.get('device_rows_ratio')}) — every shard must "
+              "hold strictly fewer rows than the replicated baseline")
+        # Span accounting (started == closed, zero open) rides in
+        # judge_flight_record — it owns the spans_closed_once check.
+        judge_flight_record("subject_store", sd)
+        ratio = sd.get("paired_throughput_ratio")
+        msg = (f"paired throughput ratio {ratio} (sharded "
+               f"{sd.get('throughput_sharded_per_sec')} vs replicated "
+               f"{sd.get('throughput_replicated_per_sec')} req/s over "
+               f"{sd.get('subjects_registered')} registered subjects, "
+               f"platform {sd.get('platform')})")
+        if sd.get("platform") in ("tpu", "axon"):
+            check("subject_store_paired_throughput",
+                  ratio is not None and ratio >= 0.9,
+                  msg + " — sharding must not tax steady-state "
+                  "dispatch on-chip")
+        else:
+            print(f"  [info] subject_store (off-chip, ratio "
+                  f"unjudged): {msg}")
+
+    if ("hot_tier_hit_rate" in line and "metric" not in line):
+        # A raw subject_store_drill_run artifact (no bench.py
+        # envelope): only the config19 criteria apply — checked BEFORE
+        # the recovery raw key, which this artifact also carries
+        # (futures_resolved_fraction), same pattern as the lane drill.
+        judge_subject_store(line)
+        bad = [n for n, ok in checks if not ok]
+        print("RESULT: " + ("SUBJECT-STORE CRITERIA PASS" if not bad
+                            else f"failing: {', '.join(bad)}"))
+        return 0 if not bad else 1
+
     if ("wire_resolved_within_budget_fraction" in line
             and "metric" not in line):
         # A raw edge_drill_run artifact (no bench.py envelope): only
@@ -1479,6 +1598,14 @@ def main() -> int:
             check("edge_leg_ran", False,
                   f"config18_edge crashed: "
                   f"{line['config_errors']['config18_edge']}")
+        sd = detail.get("subject_store")
+        if sd:
+            judge_subject_store(sd)
+        elif "config19_subject_store" in (line.get("config_errors")
+                                          or {}):
+            check("subject_store_leg_ran", False,
+                  f"config19_subject_store crashed: "
+                  f"{line['config_errors']['config19_subject_store']}")
         bad = [n for n, ok in checks if not ok]
         print("RESULT: " + ("SERVING CRITERIA PASS" if not bad
                             else f"failing: {', '.join(bad)}"))
@@ -1638,6 +1765,18 @@ def main() -> int:
         check("edge_leg_ran", False,
               f"config18_edge crashed: "
               f"{line['config_errors']['config18_edge']}")
+
+    sds = detail.get("subject_store")
+    if sds:
+        # Tiered subject-store drill (config19, PR 16) — same presence
+        # rule: judge it wherever it ran (tiers, paging and sharded
+        # routing are host/disk machinery; the throughput ratio
+        # self-gates on platform).
+        judge_subject_store(sds)
+    elif "config19_subject_store" in (line.get("config_errors") or {}):
+        check("subject_store_leg_ran", False,
+              f"config19_subject_store crashed: "
+              f"{line['config_errors']['config19_subject_store']}")
 
     spec = detail.get("specialization")
     cfg_errs = line.get("config_errors") or {}

@@ -1,0 +1,28 @@
+"""Fixture: a subject-store-shaped class whose eviction path calls its
+page-out helper WITH the leaf lock still held — the helper re-acquires
+the same non-reentrant Lock: a guaranteed self-deadlock.  The real
+``serving/subject_store.py`` releases ``_lock`` before ``_page_out``
+(its leaf-lock contract); this fixture proves the checker would catch
+the refactor that breaks it.  Parsed, never imported."""
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._warm = {}
+        self._cold_index = set()
+
+    def bad_demote(self, digest, row):
+        with self._lock:
+            self._warm[digest] = row
+            self._page_out(digest)    # callee re-takes _lock: deadlock
+
+    def _page_out(self, digest):
+        with self._lock:
+            self._cold_index.add(digest)
+
+    def fine_demote(self, digest, row):
+        with self._lock:
+            self._warm[digest] = row
+        self._page_out(digest)        # staged AFTER the hold: clean

@@ -195,6 +195,25 @@ def test_nonreentrant_reacquire_is_caught():
     assert any("re-acquisition" in f.message for f in findings)
 
 
+def test_store_leaf_lock_reacquire_is_caught():
+    """Satellite (PR 16): the store-shaped hazard — an eviction path
+    calling the page-out helper with the leaf lock still held — fires
+    the re-acquire rule; staging the call after the hold is clean."""
+    findings = check_lock_discipline(
+        FIXTURES / "bad_store_lock_reacquire.py")
+    assert any("re-acquisition" in f.message for f in findings)
+
+
+def test_subject_store_lock_graph_is_clean_on_head():
+    """Satellite (PR 16): the lock checker's scope covers the subject
+    store — its one LEAF lock (warm LRU + promotion registry + cold
+    index) must never grow a cycle or a re-acquire through refactors
+    (demote/fetch run on engine install threads)."""
+    path = (Path(__file__).resolve().parents[1] / "mano_hand_tpu"
+            / "serving" / "subject_store.py")
+    assert check_lock_discipline(path, order=()) == []
+
+
 def test_good_lock_fixture_and_real_engine_are_clean():
     assert check_lock_discipline(FIXTURES / "good_locks.py") == []
     assert check_lock_discipline() == []   # serving/engine.py, HEAD

@@ -294,6 +294,16 @@ def test_bench_cpu_tiny_run_end_to_end():
         # edge-smoke`, and the criteria-sized drill in `make
         # serve-smoke`.
         "--edge-bursts", "0",
+        # config19 (PR 16) is SKIPPED here too, not shrunk: the
+        # subject-store drill stands up THREE engines (reference,
+        # sharded fleet, replicated fleet) plus two post-leg reference
+        # engines, all cold compiles in this test's fresh per-run
+        # bench cache (the config13/15/16/17/18 budget reasoning).
+        # Its plumbing runs in `make bench-interpret`
+        # (--subject-store-requests 12), its tiny e2e in `make
+        # subject-store-smoke`, and the acceptance-sized 100k-subject
+        # drill in `make serve-smoke`.
+        "--subject-store-requests", "0",
     )
     assert rc == 0, line
     assert line["value"] is not None and line["value"] > 0
@@ -339,6 +349,9 @@ def test_bench_cpu_tiny_run_end_to_end():
     # config18 (PR 15) likewise: skipped by flag (edge-smoke /
     # bench-interpret / serve-smoke carry it).
     assert "edge" not in d
+    # config19 (PR 16) likewise: skipped by flag (subject-store-smoke /
+    # bench-interpret / serve-smoke carry it).
+    assert "subject_store" not in d
     assert "config_errors" not in line, line.get("config_errors")
 
 

@@ -1328,6 +1328,164 @@ def test_precision_block(tmp_path):
 
 
 @pytest.mark.slow
+def _subject_store_block(**over):
+    """A passing raw config19 (subject_store_drill_run) artifact;
+    override keys to break specific criteria."""
+    leg = {"requests": 120, "distinct_subjects": 32,
+           "sharded_vs_reference_max_abs_err": 0.0,
+           "replicated_vs_reference_max_abs_err": 0.0,
+           "throughput_sharded_per_sec": 400.0,
+           "throughput_replicated_per_sec": 410.0,
+           "store_deltas": {"subject_store_hot_hits": 140,
+                            "subject_store_warm_hits": 4,
+                            "subject_store_cold_hits": 0,
+                            "subject_store_misses": 6,
+                            "subject_store_prefetches": 4,
+                            "subject_store_demotions_warm": 10,
+                            "subject_store_demotions_cold": 2}}
+    art = {
+        "subjects_registered": 100000, "lanes": 2, "hot_capacity": 32,
+        "warm_capacity": 64, "zipf_a": 1.2, "coalesce_window_ms": 3.0,
+        "requests_total": 391, "futures_resolved_fraction": 1.0,
+        "outcomes": {"ok": 391, "error": 0, "expired": 0,
+                     "stranded": 0},
+        "outcomes_replicated": {"ok": 360, "error": 0, "expired": 0,
+                                "stranded": 0},
+        "legs": {"hot_only": dict(leg), "warm_spill": dict(leg),
+                 "cold_spill": dict(leg),
+                 "cold_revisit": {
+                     "requests": 30, "distinct_subjects": 30,
+                     "sharded_vs_reference_max_abs_err": 0.0,
+                     "throughput_sharded_per_sec": 5.0,
+                     "store_deltas": dict(
+                         leg["store_deltas"],
+                         subject_store_cold_hits=30)}},
+        "damage_probe": {"injected": True, "damage_counted": 1,
+                         "request_max_abs_err": 0.0},
+        "hot_tier_hit_rate": 0.78,
+        "store_counters": {
+            "subject_store_hot_hits": 430,
+            "subject_store_warm_hits": 12,
+            "subject_store_cold_hits": 31,
+            "subject_store_misses": 78,
+            "subject_store_prefetches": 16,
+            "subject_store_promotions": 43,
+            "subject_store_demotions_warm": 90,
+            "subject_store_demotions_cold": 40,
+            "subject_store_cold_damage": 1},
+        "promotion_stall_ms": {"p50_ms": 0.04, "p99_ms": 0.3, "n": 12},
+        "promotion_p99_within_window": True,
+        "steady_recompiles": 0, "steady_recompiles_replicated": 0,
+        "per_lane_device_rows_sharded": [16, 16],
+        "per_lane_device_rows_replicated": [32, 32],
+        "device_rows_ratio": 0.5,
+        "throughput_sharded_per_sec": 400.0,
+        "throughput_replicated_per_sec": 410.0,
+        "paired_throughput_ratio": 0.98,
+        "subject_store": {"warm_rows": 64, "warm_capacity": 64,
+                          "promotions_pending": 0, "cold_pages": 200,
+                          "cold_dir": "/tmp/x", "sharded": True,
+                          "shards": 2},
+        "lanes_sharded": True, "platform": "cpu",
+        "spans": {"started": 391, "closed": 391, "open": 0,
+                  "closed_by_kind": {"ok": 391}},
+        "flight_record": {
+            "schema": 1, "reason": "subject_store_drill_complete",
+            "accounting": {"spans_started": 391, "spans_closed": 391,
+                           "spans_open": 0, "spans_double_closed": 0,
+                           "closed_by_kind": {"ok": 391},
+                           "events_dropped": 0, "incidents": 0}},
+    }
+    art.update(over)
+    return art
+
+
+@pytest.mark.slow
+def test_subject_store_block(tmp_path):
+    """The config19 judge (PR 16): a raw subject-store artifact passes
+    whole, each criterion fails alone, the throughput ratio is [info]
+    off-chip and judged on-chip, and the block judges inside a
+    serving-only envelope too (incl. the crashed-leg fallback)."""
+    sd = _subject_store_block()
+    raw = tmp_path / "sd_raw.json"
+    raw.write_text(json.dumps(sd))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    for name in ("subject_store_all_resolved",
+                 "subject_store_bit_identical",
+                 "subject_store_hot_tier_serves",
+                 "subject_store_cold_tier_serves",
+                 "subject_store_promotion_in_window",
+                 "subject_store_zero_steady_recompiles",
+                 "subject_store_damage_counted",
+                 "subject_store_device_rows_below_replicated",
+                 "subject_store_spans_closed_once"):
+        assert f"[PASS] {name}" in p.stdout, (name, p.stdout)
+    assert "SUBJECT-STORE CRITERIA PASS" in p.stdout
+    assert "ratio unjudged" in p.stdout     # CPU: [info], no check
+    # Not misrouted into the recovery judge (shared raw key).
+    assert "RECOVERY CRITERIA" not in p.stdout
+
+    cases = [
+        (dict(outcomes={"ok": 390, "error": 1, "expired": 0,
+                        "stranded": 0}),
+         "subject_store_all_resolved"),
+        (dict(legs=dict(sd["legs"], hot_only=dict(
+            sd["legs"]["hot_only"],
+            sharded_vs_reference_max_abs_err=1e-6))),
+         "subject_store_bit_identical"),
+        (dict(hot_tier_hit_rate=0.3), "subject_store_hot_tier_serves"),
+        (dict(store_counters=dict(sd["store_counters"],
+                                  subject_store_cold_hits=0)),
+         "subject_store_cold_tier_serves"),
+        (dict(promotion_p99_within_window=False),
+         "subject_store_promotion_in_window"),
+        (dict(steady_recompiles=2),
+         "subject_store_zero_steady_recompiles"),
+        (dict(damage_probe={"injected": True, "damage_counted": 0,
+                            "request_max_abs_err": 0.0}),
+         "subject_store_damage_counted"),
+        (dict(per_lane_device_rows_sharded=[32, 16]),
+         "subject_store_device_rows_below_replicated"),
+    ]
+    for over, name in cases:
+        raw.write_text(json.dumps(_subject_store_block(**over)))
+        p = _run(str(raw))
+        assert p.returncode == 1, (name, p.stdout)
+        assert f"[FAIL] {name}" in p.stdout, (name, p.stdout)
+
+    # On-chip the paired ratio becomes a real criterion.
+    raw.write_text(json.dumps(_subject_store_block(
+        platform="tpu", paired_throughput_ratio=0.7)))
+    p = _run(str(raw))
+    assert p.returncode == 1
+    assert "[FAIL] subject_store_paired_throughput" in p.stdout
+    raw.write_text(json.dumps(_subject_store_block(platform="tpu")))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] subject_store_paired_throughput" in p.stdout
+
+    # Inside a serving-only envelope; a crashed config19 leg must fail
+    # loudly, not vanish.
+    env = {"metric": "serving_engine_evals_per_sec", "value": 1.0,
+           "unit": "evals/s", "device": "cpu",
+           "detail": {"serving": {"engine_vs_direct_ratio": 1.0,
+                                  "steady_recompiles": 0},
+                      "subject_store": _subject_store_block()}}
+    art = tmp_path / "serving_only.json"
+    art.write_text(json.dumps(env))
+    p = _run(str(art))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] subject_store_all_resolved" in p.stdout
+    del env["detail"]["subject_store"]
+    env["config_errors"] = {"config19_subject_store":
+                            "RuntimeError: boom"}
+    art.write_text(json.dumps(env))
+    p = _run(str(art))
+    assert p.returncode == 1
+    assert "[FAIL] subject_store_leg_ran" in p.stdout
+
+
 def test_history_error_envelope_judged_absolutely(tmp_path):
     """The PR-14 `--history` satellite: a ``*_max_abs_err`` key with a
     sibling stated ``*_err_envelope`` bound is judged ABSOLUTELY

@@ -310,11 +310,12 @@ def test_load_lanes_snapshot_untorn_and_shape_stable(params32, reference):
         t.join(10)
         snap = eng.load()["lanes"]
     assert torn == []
-    assert set(snap) == {"n_lanes", "n_devices", "healthy",
+    assert set(snap) == {"n_lanes", "n_devices", "sharded", "healthy",
                          "assigned_total", "backlog_rows_total",
                          "per_lane"}
     assert set(snap["per_lane"][0]) == {
-        "lane", "device", "state", "backlog_batches", "backlog_rows",
+        "lane", "device", "state", "table_capacity", "resident_rows",
+        "backlog_batches", "backlog_rows",
         "inflight", "assigned", "dispatched", "served_requests",
         "failovers_out", "failovers_in", "cpu_failovers", "errors"}
 

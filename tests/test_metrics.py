@@ -228,6 +228,8 @@ def test_counter_drift_guard_every_field_exported():
             assert "serving_tier_submitted" in out
         elif key == "latency_by_bucket":
             assert "serving_latency_p50_ms" in out
+        elif key == "subject_store_promotion_ms":
+            assert "serving_subject_store_promotion_p50_ms" in out
         else:
             assert f"serving_{key}" in out, \
                 f"snapshot key {key} missing from the metrics export"

@@ -1,0 +1,357 @@
+"""Tiered subject store (PR 16): device/host/disk paging + shard map.
+
+The memory-hierarchy story, CPU-verified: warm demote→promote
+roundtrips are bit-identical; warm overflow pages to cold and promotes
+back THROUGH warm (inclusive tiers); a damaged cold page degrades to a
+counted re-bake, never an error; a sharded lane fleet serving
+cross-shard batches stays bit-identical to the single-device engine;
+an evicted subject under a live stream re-bakes transparently;
+``load()["subject_store"]`` is a one-lock-hold block; and the config19
+drill protocol passes end-to-end at tiny sizes.
+
+Canonical runner: `make subject-store-smoke` (own pytest process +
+compile-cache dir, wired into `make check`) — slow-marked, so the
+tier-1 `-m 'not slow'` lane skips it by design (the PR-8 budget
+precedent); `make test` --ignore's it for the same reason.  The
+pure-logic tests carry the `quick` mark too and ride the pre-commit
+`make check-quick` lane.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from mano_hand_tpu.serving.engine import ServingEngine
+from mano_hand_tpu.serving.subject_store import (ROW_KEYS, SubjectStore,
+                                                 SubjectStoreConfig,
+                                                 shard_of, subject_digest)
+from mano_hand_tpu.utils.profiling import ServingCounters
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+def _betas(seed, n=10):
+    return np.random.default_rng(seed).normal(size=(n,)).astype(np.float32)
+
+
+def _row(seed, n_verts=8, n_joints=4, n_shape=10):
+    rng = np.random.default_rng(seed)
+    shape = rng.normal(size=(n_shape,)).astype(np.float32)
+    return subject_digest(shape), {
+        "v_shaped": rng.normal(size=(n_verts, 3)).astype(np.float32),
+        "joints": rng.normal(size=(n_joints, 3)).astype(np.float32),
+        "shape": shape,
+    }
+
+
+# ---------------------------------------------------------------- pure logic
+@pytest.mark.quick
+def test_shard_of_stable_in_range():
+    d = subject_digest(_betas(0))
+    assert shard_of(d, 4) == shard_of(d, 4)     # deterministic
+    for n in (1, 2, 3, 8):
+        assert 0 <= shard_of(d, n) < n
+    # Uniform enough that 64 digests don't all land on one shard.
+    hits = {shard_of(subject_digest(_betas(s)), 4) for s in range(64)}
+    assert hits == {0, 1, 2, 3}
+    with pytest.raises(ValueError):
+        shard_of(d, 0)
+
+
+@pytest.mark.quick
+def test_config_validation():
+    with pytest.raises(ValueError):
+        SubjectStoreConfig(warm_capacity=0)
+    st = SubjectStore(warm_capacity=4)
+    assert not st.sharded
+    assert st.shard_for(subject_digest(_betas(1))) is None
+
+
+@pytest.mark.quick
+def test_digest_is_content_addressed():
+    a, b = _betas(0), _betas(0)
+    assert subject_digest(a) == subject_digest(b)
+    assert subject_digest(a) != subject_digest(_betas(1))
+
+
+# ------------------------------------------------------------- store tiers
+def test_warm_demote_promote_roundtrip():
+    st = SubjectStore(warm_capacity=4)
+    c = ServingCounters()
+    st.bind(c)
+    digest, row = _row(0)
+    st.demote(digest, row)
+    assert st.warm_digests() == [digest]
+    # Prefetch starts the async host->device copy; fetch consumes it.
+    assert st.prefetch(digest)
+    got = st.fetch_row(digest)
+    assert got is not None
+    handles, tier = got
+    assert tier == "warm"
+    for k in ROW_KEYS:
+        np.testing.assert_array_equal(np.asarray(handles[k]), row[k])
+    snap = c.snapshot()
+    assert snap["subject_store_warm_hits"] == 1
+    assert snap["subject_store_prefetches"] == 1
+    assert snap["subject_store_promotions"] == 1
+    assert snap["subject_store_promotion_ms"]["n"] == 1
+    # A row stays warm after promotion (inclusive tiers).
+    assert st.warm_digests() == [digest]
+    # Unknown digest: a plain miss, no exception.
+    assert st.fetch_row("0" * 16) is None
+
+
+def test_cold_roundtrip_inclusive_promotion(tmp_path):
+    st = SubjectStore(warm_capacity=1, cold_dir=str(tmp_path),
+                      backend="pickle")
+    c = ServingCounters()
+    st.bind(c)
+    d0, r0 = _row(0)
+    d1, r1 = _row(1)
+    st.demote(d0, r0)
+    st.demote(d1, r1)           # warm_capacity=1: d0 pages to cold
+    assert st.warm_digests() == [d1]
+    assert st.cold_digests() == [d0]
+    assert st.cold_page_path(d0).exists()
+    handles, tier = st.fetch_row(d0)
+    assert tier == "cold"
+    for k in ROW_KEYS:
+        np.testing.assert_array_equal(np.asarray(handles[k]), r0[k])
+    # Cold promotes THROUGH warm: d0 is now the warm resident (d1 was
+    # paged out to make room) and the page remains on disk.
+    assert st.warm_digests() == [d0]
+    assert set(st.cold_digests()) == {d0, d1}
+    snap = c.snapshot()
+    assert snap["subject_store_cold_hits"] == 1
+    assert snap["subject_store_demotions_cold"] == 2
+    # Evicting d0 again does NOT rewrite its page (content-addressed):
+    # the cold-demotion counter stays put.
+    st.demote(*_row(2))
+    assert c.snapshot()["subject_store_demotions_cold"] == 2
+
+
+def test_damaged_cold_page_counted_rebake(tmp_path):
+    from mano_hand_tpu.io import orbax_ckpt
+
+    st = SubjectStore(warm_capacity=1, cold_dir=str(tmp_path),
+                      backend="pickle")
+    c = ServingCounters()
+    st.bind(c)
+    d0, r0 = _row(0)
+    st.demote(d0, r0)
+    st.demote(*_row(1))         # evict d0 to cold
+    assert d0 in st.cold_digests()
+    # A self-CONSISTENT page for the WRONG subject: per-array hashes
+    # verify, the digest preimage does not.
+    meta, arrays = orbax_ckpt.load_row_page(d0, str(tmp_path))
+    arrays["shape"] = np.asarray(arrays["shape"]) + 1.0
+    orbax_ckpt.save_row_page(d0, arrays, str(tmp_path), backend="pickle")
+    assert st.fetch_row(d0) is None     # degrade, never raise
+    assert c.snapshot()["subject_store_cold_damage"] == 1
+    # One bad file costs ONE re-bake: the page left the index, so the
+    # next access is a clean (uncounted-damage) miss.
+    assert d0 not in st.cold_digests()
+    assert st.fetch_row(d0) is None
+    assert c.snapshot()["subject_store_cold_damage"] == 1
+
+
+def test_store_adopts_existing_pages(tmp_path):
+    d0, r0 = _row(0)
+    first = SubjectStore(warm_capacity=1, cold_dir=str(tmp_path),
+                         backend="pickle")
+    first.bind(ServingCounters())
+    first.demote(d0, r0)
+    first.demote(*_row(1))
+    assert d0 in first.cold_digests()
+    # A new process's store adopts the pages a predecessor left.
+    second = SubjectStore(warm_capacity=1, cold_dir=str(tmp_path),
+                          backend="pickle")
+    second.bind(ServingCounters())
+    assert d0 in second.cold_digests()
+    handles, tier = second.fetch_row(d0)
+    assert tier == "cold"
+    np.testing.assert_array_equal(np.asarray(handles["shape"]),
+                                  r0["shape"])
+
+
+def test_bind_twice_to_different_engines_raises():
+    st = SubjectStore(warm_capacity=2)
+    a, b = ServingCounters(), ServingCounters()
+    st.bind(a)
+    st.bind(a)                  # idempotent rebind: fine
+    with pytest.raises(RuntimeError):
+        st.bind(b)
+
+
+# ---------------------------------------------------------- engine surgery
+def test_cross_shard_batch_split_parity(params32, tmp_path):
+    """Mixed-shard traffic through a 2-lane sharded fleet stays
+    bit-identical to the single-device engine."""
+    rng = np.random.default_rng(7)
+    betas = [rng.normal(size=(params32.n_shape,)).astype(np.float32)
+             for _ in range(6)]
+    poses = [rng.normal(scale=0.4,
+                        size=(2, params32.n_joints, 3)).astype(np.float32)
+             for _ in range(12)]
+    want = []
+    with ServingEngine(params32, max_bucket=4,
+                       max_delay_s=0.001) as ref:
+        ref_keys = [ref.specialize(b) for b in betas]
+        for i, p in enumerate(poses):
+            want.append(ref.forward(p, subject=ref_keys[i % len(betas)]))
+    store = SubjectStore(SubjectStoreConfig(
+        warm_capacity=8, cold_dir=str(tmp_path), sharded=True,
+        backend="pickle"))
+    with ServingEngine(params32, max_bucket=4, max_delay_s=0.005,
+                       lanes=2, subject_store=store) as eng:
+        keys = [eng.specialize(b) for b in betas]
+        # Both shards are populated (content-based placement over 6
+        # digests), so coalesced windows mix owners and must split.
+        shards = {store.shard_for(k) for k in keys}
+        assert shards == {0, 1}
+        futs = [eng.submit(p, subject=keys[i % len(betas)])
+                for i, p in enumerate(poses)]
+        got = [f.result(timeout=60) for f in futs]
+        assert eng.load()["lanes"]["sharded"]
+    worst = max(float(np.abs(g - w).max()) for g, w in zip(got, want))
+    assert worst == 0.0
+
+
+def test_eviction_under_stream_rebakes(params32):
+    """A stream whose subject is evicted from the hot tier mid-session
+    keeps producing bit-identical frames (store/warm re-bake)."""
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+
+    rng = np.random.default_rng(11)
+    betas0 = rng.normal(size=(params32.n_shape,)).astype(np.float32)
+    # A STATIC target track (same joints every frame): the warm-started
+    # fit re-converges to the same pose, so a re-baked frame must be
+    # bit-identical to the first.
+    pose_gt = np.zeros((1, params32.n_joints, 3), np.float32)
+    target = np.asarray(core.jit_forward_batched(
+        params32, jnp.asarray(pose_gt),
+        jnp.asarray(betas0)[None]).posed_joints)[0]
+    pose_frame = rng.normal(
+        scale=0.4, size=(1, params32.n_joints, 3)).astype(np.float32)
+    # Reference: the same two-frame warm-start chain with NO eviction.
+    with ServingEngine(params32, max_bucket=2, max_delay_s=0.001,
+                       max_subjects=8) as ref:
+        with ref.open_stream(betas0, n_steps=4,
+                             data_term="joints") as sess:
+            want = [sess.submit_frame(target).result(timeout=60)
+                    for _ in range(2)]
+    store = SubjectStore(warm_capacity=8)
+    with ServingEngine(params32, max_bucket=2, max_delay_s=0.001,
+                       max_subjects=2, subject_store=store) as eng:
+        with eng.open_stream(betas0, n_steps=4,
+                             data_term="joints") as sess:
+            first = sess.submit_frame(target).result(timeout=60)
+            # Evict betas0's row: the 2-slot table takes 2 fresh
+            # subjects, demoting the stream's row to the warm tier.
+            for s in range(2):
+                b = rng.normal(size=(params32.n_shape,)).astype(
+                    np.float32)
+                eng.forward(pose_frame, subject=eng.specialize(b))
+            again = sess.submit_frame(target).result(timeout=60)
+        c = eng.counters.snapshot()
+    for got, ref_fr in ((first, want[0]), (again, want[1])):
+        np.testing.assert_array_equal(np.asarray(got.verts),
+                                      np.asarray(ref_fr.verts))
+        np.testing.assert_array_equal(np.asarray(got.pose),
+                                      np.asarray(ref_fr.pose))
+    assert c["subject_store_demotions_warm"] >= 1
+
+
+def test_load_subject_store_untorn(params32):
+    """``load()["subject_store"]`` is present, complete, and internally
+    consistent while demotions churn on another thread."""
+    import threading
+
+    store = SubjectStore(warm_capacity=4)
+    with ServingEngine(params32, max_bucket=2, max_delay_s=0.001,
+                       max_subjects=2, subject_store=store) as eng:
+        assert eng.subject_store is store
+        stop = threading.Event()
+
+        def churn():
+            rng = np.random.default_rng(23)
+            while not stop.is_set():
+                d, r = _row(int(rng.integers(0, 1 << 30)),
+                            n_shape=params32.n_shape)
+                store.demote(d, r)
+
+        t = threading.Thread(target=churn)
+        t.start()
+        try:
+            for _ in range(50):
+                blk = eng.load()["subject_store"]
+                assert set(blk) == {"warm_rows", "warm_capacity",
+                                    "promotions_pending", "cold_pages",
+                                    "cold_dir", "sharded", "shards"}
+                assert 0 <= blk["warm_rows"] <= blk["warm_capacity"]
+                assert blk["sharded"] is False
+        finally:
+            stop.set()
+            t.join()
+    # No store configured -> no block (absence is the signal).
+    with ServingEngine(params32, max_bucket=2,
+                       max_delay_s=0.001) as bare:
+        assert "subject_store" not in bare.load()
+
+
+def test_register_subjects_density(params32):
+    """Betas-only registration: O(N) keys servable on demand without
+    baking N device rows up front."""
+    rng = np.random.default_rng(3)
+    universe = rng.normal(size=(512, params32.n_shape)).astype(np.float32)
+    with ServingEngine(params32, max_bucket=2, max_delay_s=0.001,
+                       max_subjects=4) as eng:
+        keys = eng.register_subjects(universe)
+        assert len(keys) == 512
+        assert keys == eng.register_subjects(universe)  # idempotent
+        pose = rng.normal(scale=0.4,
+                          size=(1, params32.n_joints, 3)).astype(
+                              np.float32)
+        got = eng.submit(pose, subject=keys[200]).result(timeout=60)
+        want = eng.forward(pose, subject=eng.specialize(universe[200]))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_tiny_drill_e2e(params32, tmp_path):
+    """The config19 protocol end-to-end at plumbing size — the same
+    artifact shape scripts/bench_report.py:judge_subject_store judges."""
+    from mano_hand_tpu.serving.measure import subject_store_drill_run
+
+    out = subject_store_drill_run(
+        params32, subjects=300, requests_per_leg=16, lanes=2,
+        max_subjects=8, warm_capacity=12, max_rows=2, max_bucket=4,
+        pair_slice=8, workers=4, seed=0, cold_dir=str(tmp_path),
+        backend="pickle")
+    assert out["futures_resolved_fraction"] == 1.0
+    assert out["outcomes"]["error"] == 0
+    assert out["outcomes"]["stranded"] == 0
+    for leg in out["legs"].values():
+        assert leg["sharded_vs_reference_max_abs_err"] == 0.0
+        if "replicated_vs_reference_max_abs_err" in leg:
+            assert leg["replicated_vs_reference_max_abs_err"] == 0.0
+    assert out["steady_recompiles"] == 0
+    assert out["steady_recompiles_replicated"] == 0
+    assert out["promotion_p99_within_window"]
+    assert out["damage_probe"]["injected"]
+    assert out["damage_probe"]["damage_counted"] >= 1
+    assert out["damage_probe"]["request_max_abs_err"] == 0.0
+    assert out["store_counters"]["subject_store_cold_hits"] >= 1
+    rows_s = out["per_lane_device_rows_sharded"]
+    rows_r = out["per_lane_device_rows_replicated"]
+    assert max(rows_s) < min(rows_r)
+    sp = out["spans"]
+    assert sp["started"] == sp["closed"] and sp["open"] == 0
+    assert out["lanes_sharded"] and out["subject_store"]["sharded"]
