@@ -24,25 +24,29 @@
 	check-quick serve-smoke specialize-smoke chaos-smoke coalesce-smoke \
 	overload-smoke coldstart-smoke obs-smoke metrics-smoke \
 	posed-kernel-smoke stream-smoke lanes-smoke precision-smoke \
-	edge-smoke subject-store-smoke examples-smoke analyze
+	edge-smoke subject-store-smoke bench-smoke examples-smoke analyze
 
 check: analyze test chaos-smoke coalesce-smoke overload-smoke \
 	coldstart-smoke obs-smoke metrics-smoke posed-kernel-smoke \
 	stream-smoke lanes-smoke precision-smoke edge-smoke \
-	subject-store-smoke examples-smoke
+	subject-store-smoke bench-smoke examples-smoke
 
 # tests/test_runtime.py is excluded here and covered by the chaos-smoke
 # prerequisite instead (its own pytest process + cache dir): `make
 # check` would otherwise pay the real-time deadline/backoff/hang sleeps
 # of the chaos matrix twice. tests/test_serving_coalesce.py is likewise
 # covered by coalesce-smoke, tests/test_overload.py by overload-smoke,
-# and tests/test_coldstart.py by coldstart-smoke (same pattern, their
+# tests/test_coldstart.py by coldstart-smoke, and tests/test_bench.py
+# by bench-smoke (PR 17 — its watchdog/SIGTERM stall sleeps and bench
+# subprocesses are the next-largest real-time sink; same pattern, their
 # own cache dirs). A bare `pytest tests/` (e.g. the tier-1 verify
-# command) still collects all — test_coldstart is `slow`-marked, so the
-# tier-1 `-m 'not slow'` lane skips it by design.
+# command) still collects all — test_coldstart and test_bench are
+# `slow`-marked, so the tier-1 `-m 'not slow'` lane skips them by
+# design.
 test:
 	TF_CPP_MIN_LOG_LEVEL=3 python -m pytest tests/ -q \
 	  --ignore=tests/test_runtime.py \
+	  --ignore=tests/test_bench.py \
 	  --ignore=tests/test_serving_coalesce.py \
 	  --ignore=tests/test_overload.py \
 	  --ignore=tests/test_coldstart.py \
@@ -139,7 +143,9 @@ bench-interpret:
 	  --precision-requests 32 --precision-subjects 6 \
 	  --precision-max-bucket 16 --precision-posed-kernel fused \
 	  --edge-bursts 6 --edge-workers 8 --edge-streams 2 --edge-frames 2 \
-	  --subject-store-subjects 300 --subject-store-requests 12
+	  --subject-store-subjects 300 --subject-store-requests 12 \
+	  --pipeline-requests 24 --pipeline-calibrate 12 \
+	  --pipeline-trials 1 --pipeline-max-bucket 8
 
 # Serving-leg smoke (the bench-interpret counterpart for config7): the
 # whole serving-engine plumbing — bucket warm-up, ragged request stream,
@@ -184,6 +190,11 @@ bench-interpret:
 # leg here at the DEFAULT size (100k registered subjects — defaults
 # are policy, the driver passes no flags): tiers, paging, and sharded
 # routing are host/disk machinery, every criterion CPU-defined
+# (bench-interpret sweeps the same protocol at plumbing size).
+# config20 (the pipelined-dispatch drill, PR 17) runs its acceptance
+# leg here at the DEFAULT size too: the serial-vs-pipelined capacity,
+# queue-wait, bit-identity, and span-accounting criteria are all
+# CPU-defined — the injected sat round-trip stands in for the tunnel
 # (bench-interpret sweeps the same protocol at plumbing size).
 # The other legs are device-count-agnostic — they
 # dispatch to the default device exactly as before (the test suite has
@@ -379,6 +390,18 @@ subject-store-smoke:
 examples-smoke:
 	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_examples \
 	  python -m pytest tests/test_examples.py -q
+
+# Bench-harness contract matrix (the round-1 one-JSON-line guarantee:
+# error paths, SIGTERM salvage, watchdog stall/emit-by bounds, the tiny
+# CPU end-to-end run). Moved out of `make test` in PR 17 (the tier-1
+# budget rebalance, test_runtime/test_coldstart precedent): its
+# deliberate real-time stalls and bench subprocesses ride in their own
+# pytest process here. Each bench subprocess already isolates its own
+# device-lock and bench-cache dirs (tests/test_bench.py header); the
+# cache dir below only serves the in-process quick cases.
+bench-smoke:
+	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_bench \
+	  python -m pytest tests/test_bench.py -q
 
 # Metrics & SLO matrix (the PR-9 tentpole): registry instrument/
 # collector atomicity under concurrent writers, the counter-drift

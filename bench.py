@@ -594,7 +594,8 @@ def run_benchmarks(args, device_str: str) -> dict:
                                               "config16_lanes",
                                               "config17_precision",
                                               "config18_edge",
-                                              "config19_subject_store"):
+                                              "config19_subject_store",
+                                              "config20_dispatch_pipeline"):
             return
         try:
             fn()
@@ -2485,6 +2486,52 @@ def run_benchmarks(args, device_str: str) -> dict:
     if args.subject_store_requests > 0:
         section("config19_subject_store", config19_subject_store)
 
+    # -- config 20: pipelined dispatch drill (PR 17) ------------------------
+    # THE dispatch-pipeline protocol (serving/measure.py:
+    # dispatch_pipeline_drill_run): a pipelined engine (bounded
+    # completion stage, overlapped in-flight dispatches, strict FIFO
+    # delivery) judged against its depth-1 serial twin on interleaved
+    # legs over the same request streams — drain (saturated capacity),
+    # paced steady (queue wait at matched saturated load, plus a
+    # mid-leg cancel probe), and chaos (faults landing on in-flight
+    # batches). Criteria (scripts/bench_report.py:
+    # judge_dispatch_pipeline) are CPU-defined: every leg bit-identical
+    # to an unbatched reference AND across the two engines, queue p50
+    # cut >= 1.5x, drain throughput >= 1.2x, zero steady recompiles on
+    # both sides, every future resolved, every span closed exactly
+    # once (chaos leg included), and the serial side's telemetry kept
+    # byte-for-byte serial in shape (no pipeline stage rows).
+    def config20_dispatch_pipeline():
+        from mano_hand_tpu.serving.measure import (
+            dispatch_pipeline_drill_run,
+        )
+
+        pd = dispatch_pipeline_drill_run(
+            right,
+            requests_steady=args.pipeline_requests,
+            calibrate_requests=args.pipeline_calibrate,
+            trials=args.pipeline_trials,
+            inflight_depth=args.pipeline_depth,
+            max_bucket=args.pipeline_max_bucket,
+            device_rtt_s=args.pipeline_rtt,
+            seed=0,
+            log=lambda m: log(f"config20 {m}"),
+        )
+        results["dispatch_pipeline"] = pd
+        log(f"config20 dispatch pipeline: queue p50 "
+            f"{pd['serial_queue_p50_ms']} -> "
+            f"{pd['pipelined_queue_p50_ms']}ms "
+            f"({pd['queue_p50_speedup']}x), throughput "
+            f"{pd['serial_throughput_per_sec']} -> "
+            f"{pd['pipelined_throughput_per_sec']}/s "
+            f"({pd['throughput_speedup']}x), bit-identical "
+            f"{pd['cross_engine_bit_identical']}, futures resolved "
+            f"{pd['futures_resolved_fraction']}, inflight peak "
+            f"{pd['pipelined_pipeline_inflight_peak']}")
+
+    if args.pipeline_requests > 0:
+        section("config20_dispatch_pipeline", config20_dispatch_pipeline)
+
     if args.serving_only:
         # Fast serving-layer artifact (`make serve-smoke`): the deferred
         # runner's serving-only skip reduces the schedule to config7
@@ -2914,6 +2961,29 @@ def main() -> int:
                          "config19 (hot-only / warm-spill / "
                          "cold-spill, paired sharded-vs-replicated "
                          "slices; 0 skips the leg)")
+    ap.add_argument("--pipeline-requests", type=int, default=240,
+                    help="steady-leg requests per trial of the "
+                         "pipelined-dispatch drill (config20, PR 17; "
+                         "paced at 0.9x the pipelined engine's "
+                         "measured capacity; 0 skips the config)")
+    ap.add_argument("--pipeline-calibrate", type=int, default=128,
+                    help="requests per drain (capacity-calibration) "
+                         "leg of config20 — the upfront-backlog legs "
+                         "whose min-time sets each engine's measured "
+                         "capacity and the steady leg's pace")
+    ap.add_argument("--pipeline-trials", type=int, default=5,
+                    help="interleaved serial/pipelined repeats of each "
+                         "config20 leg (min-time capacities, pooled "
+                         "queue-wait percentiles)")
+    ap.add_argument("--pipeline-depth", type=int, default=2,
+                    help="in-flight depth of config20's pipelined "
+                         "engine (its serial twin is always depth 1)")
+    ap.add_argument("--pipeline-max-bucket", type=int, default=16,
+                    help="bucket ceiling of both config20 engines")
+    ap.add_argument("--pipeline-rtt", type=float, default=0.0015,
+                    help="config20's injected per-dispatch device "
+                         "round-trip (chaos sat model, the documented "
+                         "slow-device stand-in for the TPU tunnel)")
     ap.add_argument("--spec-batch", type=int, default=256,
                     help="batch for the specialization leg's full-vs-"
                          "pose-only forward comparison (config8); "

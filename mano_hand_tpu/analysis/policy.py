@@ -50,6 +50,18 @@ debugging session (CLAUDE.md, docs/roadmap.md process notes):
     (serving/lanes.py's replica machinery in particular) keeps device
     work outside EVERY lock.
 
+``device-under-completion-lock``
+    The PR-17 completion stage's ``_completion_lock`` (a Condition) is
+    the handoff between the dispatcher and the completion worker: the
+    dispatcher blocks on it for backpressure at every staged launch,
+    and ``drain()``/``stop()`` wait on it for the in-flight horizon.
+    It is a LEAF lock by design — nothing is ever taken under it and
+    no device work happens inside a hold (the worker pops the item,
+    RELEASES the lock, then dispatches/reads back). A device call
+    inside the hold would wedge the dispatcher behind a tunneled RPC
+    exactly like the ``_exe_lock`` case, except worse: ``stop()``
+    waits on the same Condition, so shutdown wedges too.
+
 Audited sites: ``# analysis: allow(<rule>)`` on or directly above the
 flagged line.
 """
@@ -70,6 +82,7 @@ POLICY_RULES = (
     "wallclock-deadline",
     "device-under-exe-lock",
     "device-under-install-lock",
+    "device-under-completion-lock",
 )
 
 _DEADLINE_NAME_RE = re.compile(
@@ -154,6 +167,7 @@ class _PolicyVisitor(ast.NodeVisitor):
         self.findings: List[Finding] = []
         self._exe_lock_depth = 0
         self._install_lock_depth = 0
+        self._completion_lock_depth = 0
 
     def _emit(self, rule: str, node: ast.AST, message: str) -> None:
         self.findings.append(
@@ -177,7 +191,8 @@ class _PolicyVisitor(ast.NodeVisitor):
                     "JAX_PLATFORMS env is overridden by a site hook at "
                     "interpreter startup; select platforms via "
                     'jax.config.update("jax_platforms", ...) instead')
-        if self._exe_lock_depth > 0 or self._install_lock_depth > 0:
+        if (self._exe_lock_depth > 0 or self._install_lock_depth > 0
+                or self._completion_lock_depth > 0):
             leaf = chain.rsplit(".", 1)[-1]
             if (chain in ("jax.device_put", "jax.jit",
                           "jax.block_until_ready")
@@ -205,6 +220,17 @@ class _PolicyVisitor(ast.NodeVisitor):
                         "the lock; the engine's documented bake-and-"
                         "swap is the one audited exception "
                         "(see analysis/policy.py)")
+                if self._completion_lock_depth > 0:
+                    self._emit(
+                        "device-under-completion-lock", node,
+                        f"{chain}() lexically inside a _completion_lock "
+                        "hold: the dispatcher backpressures on this "
+                        "Condition every staged launch and stop()/"
+                        "drain() wait on it, so a device call here "
+                        "wedges serving AND shutdown behind a tunneled "
+                        "RPC — the completion lock is a leaf: pop the "
+                        "item, release, then dispatch (engine.py "
+                        "_CompletionStage._worker pattern)")
         self.generic_visit(node)
 
     # -- platforms-env (subscript assignment) ------------------------
@@ -290,18 +316,24 @@ class _PolicyVisitor(ast.NodeVisitor):
     # context — a deferred jax call stored under the lock is the
     # engine's normal caching pattern, not a violation.
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
-        saved = (self._exe_lock_depth, self._install_lock_depth)
+        saved = (self._exe_lock_depth, self._install_lock_depth,
+                 self._completion_lock_depth)
         self._exe_lock_depth = self._install_lock_depth = 0
+        self._completion_lock_depth = 0
         self.generic_visit(node)
-        self._exe_lock_depth, self._install_lock_depth = saved
+        (self._exe_lock_depth, self._install_lock_depth,
+         self._completion_lock_depth) = saved
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
     def visit_Lambda(self, node: ast.Lambda) -> None:
-        saved = (self._exe_lock_depth, self._install_lock_depth)
+        saved = (self._exe_lock_depth, self._install_lock_depth,
+                 self._completion_lock_depth)
         self._exe_lock_depth = self._install_lock_depth = 0
+        self._completion_lock_depth = 0
         self.generic_visit(node)
-        self._exe_lock_depth, self._install_lock_depth = saved
+        (self._exe_lock_depth, self._install_lock_depth,
+         self._completion_lock_depth) = saved
 
     # -- with self._exe_lock / self._install_lock ----------------------
     def visit_With(self, node: ast.With) -> None:
@@ -309,15 +341,21 @@ class _PolicyVisitor(ast.NodeVisitor):
                   if (c := _attr_chain(item.context_expr)) is not None]
         holds_exe = any(c.endswith("_exe_lock") for c in chains)
         holds_install = any(c.endswith("_install_lock") for c in chains)
+        holds_completion = any(c.endswith("_completion_lock")
+                               for c in chains)
         if holds_exe:
             self._exe_lock_depth += 1
         if holds_install:
             self._install_lock_depth += 1
+        if holds_completion:
+            self._completion_lock_depth += 1
         self.generic_visit(node)
         if holds_exe:
             self._exe_lock_depth -= 1
         if holds_install:
             self._install_lock_depth -= 1
+        if holds_completion:
+            self._completion_lock_depth -= 1
 
 
 def lint_source(source: str, path: str = "<source>") -> List[Finding]:

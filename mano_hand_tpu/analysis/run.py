@@ -44,6 +44,12 @@ def run_analysis(
                      f"{len(default_policy_paths(root))} files linted"))
 
     locks = check_lock_discipline()
+    # PR 17: the dispatch pipeline's completion stage lives in
+    # serving/engine.py, so the default pass above already covers its
+    # _completion_lock Condition (cycle/re-acquire) — and the policy
+    # linter's new device-under-completion-lock rule enforces that it
+    # stays a LEAF: the worker pops under the lock, releases, then
+    # dispatches; nothing (engine locks included) is taken inside it.
     # PR 8: the obs/ tracer and flight recorder hold their own locks on
     # the dispatch path — same cycle/re-acquire rules, no documented
     # order (each class owns exactly one lock; any nesting edge a

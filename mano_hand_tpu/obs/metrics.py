@@ -67,6 +67,9 @@ _NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 _SERVING_GAUGE_KEYS = frozenset({
     "queue_depth_peak", "backlog_peak", "padding_waste",
     "coalesce_width_mean",
+    # Dispatch-pipeline occupancy high-water (PR 17): how much of
+    # ``inflight_depth`` the completion stage actually used.
+    "pipeline_inflight_peak",
 })
 
 

@@ -339,6 +339,17 @@ class Tracer:
         wait); ``readback`` = readback -> resolve (host-side slice +
         future delivery). The four stages partition submit->resolve
         exactly.
+
+        Pipelined spans (PR 17, ``inflight_depth > 1``) carry one
+        extra OPTIONAL event, ``staged`` — the moment the dispatcher
+        handed the assembled batch to the completion stage. When
+        present, ``dispatch`` narrows to launch -> staged (assembly +
+        executable fetch only) and a fifth stage ``pipeline`` =
+        staged -> dispatched (completion-stage queue wait: how long
+        the batch sat behind earlier in-flight batches) joins the
+        partition. Serial spans (depth 1) never emit ``staged``, so
+        their rows — and the whole report — are byte-identical to the
+        pre-pipeline engine's.
         """
         at = {}
         meta = {}
@@ -354,7 +365,7 @@ class Tracer:
         needed = ("submit", "launch", "dispatched", "readback", "resolve")
         if any(k not in at for k in needed):
             return None
-        return {
+        st = {
             "bucket": meta.get("bucket"),
             "tier": meta.get("tier", 0),
             "kind": meta.get("kind"),
@@ -364,6 +375,11 @@ class Tracer:
             "readback_s": at["resolve"] - at["readback"],
             "total_s": at["resolve"] - at["submit"],
         }
+        if "staged" in at:
+            st["dispatch_s"] = at["staged"] - at["launch"]
+            st["pipeline_s"] = at["dispatched"] - at["staged"]
+            st["_staged_at"] = at["staged"]
+        return st
 
     def stage_breakdown(self, spans: Optional[List[dict]] = None) -> dict:
         """Queue-wait vs device vs readback per (bucket, tier) over the
@@ -382,8 +398,11 @@ class Tracer:
             cell = rows.setdefault(
                 key, {"queue_s": [], "dispatch_s": [], "device_s": [],
                       "readback_s": [], "total_s": []})
-            for k in cell:
-                cell[k].append(st[k])
+            for k, v in st.items():
+                # "pipeline_s" rides only on pipelined spans (PR 17):
+                # rows that never saw one keep the four-stage shape.
+                if k.endswith("_s"):
+                    cell.setdefault(k, []).append(v)
         out = {}
         for key, cell in sorted(rows.items()):
             out[key] = {"n": len(cell["total_s"])}
@@ -442,11 +461,15 @@ class Tracer:
             ev.append({"ph": "X", "pid": pid, "tid": tid, "name": label,
                        "ts": t0 * 1e6, "dur": st["total_s"] * 1e6,
                        "args": {"terminal": span["closed_kind"]}})
-            for stage, start, dur in (
-                    ("queue", at["submit"], st["queue_s"]),
-                    ("dispatch", at["launch"], st["dispatch_s"]),
-                    ("device", at["dispatched"], st["device_s"]),
-                    ("readback", at["readback"], st["readback_s"])):
+            slices = [
+                ("queue", at["submit"], st["queue_s"]),
+                ("dispatch", at["launch"], st["dispatch_s"]),
+                ("device", at["dispatched"], st["device_s"]),
+                ("readback", at["readback"], st["readback_s"])]
+            if "pipeline_s" in st:
+                slices.insert(
+                    2, ("pipeline", st["_staged_at"], st["pipeline_s"]))
+            for stage, start, dur in slices:
                 ev.append({"ph": "X", "pid": pid, "tid": tid,
                            "name": f"stage/{stage}",
                            "ts": start * 1e6, "dur": dur * 1e6})

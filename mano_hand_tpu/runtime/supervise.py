@@ -143,6 +143,28 @@ def backoff_delay(attempt: int, base_s: float, cap_s: float,
     return max(0.0, delay)
 
 
+def batch_give_up_by(deadlines) -> Optional[float]:
+    """The end-to-end supervision bound for one coalesced batch: the
+    LATEST member deadline, or None when any member is deadline-less
+    (one unbounded consumer keeps the whole batch's budget unbounded).
+
+    THE shared reconstruction for ``give_up_by`` — used by the engine's
+    dispatch path (serving/engine.py), the lane ladder
+    (serving/lanes.py), and the pipelined completion stage (PR 17), so
+    the rule cannot drift between them. The deadlines are absolute
+    ``time.monotonic`` timestamps, which is what makes the bound
+    survive the launch/completion split: a batch that sat queued in the
+    completion stage has ALREADY spent that wait against the same
+    absolute budget — ``supervised_call`` clips each attempt to
+    ``give_up_by - clock()`` at attempt START, so no re-arming or
+    budget hand-off is needed across the stage boundary.
+    """
+    deadlines = list(deadlines)
+    if not deadlines or any(d is None for d in deadlines):
+        return None
+    return max(deadlines)
+
+
 def supervised_call(
     fn: Callable,
     *,

@@ -15,10 +15,19 @@ under-fills the device. This engine:
   process re-loads a warm bucket's serialized StableHLO instead of
   re-tracing it (the XLA backend compile of the artifact is further
   absorbed by jax's persistent compilation cache when enabled);
-* **overlaps host and device** with double-buffered async dispatch: JAX
-  dispatch is async, so the dispatcher keeps ``inflight_depth`` batches
-  in flight and assembles batch N+1 while the device runs batch N,
-  blocking only on the oldest readback;
+* **overlaps host and device** with a pipelined dispatch path (PR 17):
+  at ``inflight_depth > 1`` the dispatcher hands each launched batch to
+  a bounded FIFO COMPLETION STAGE (``_CompletionStage``: dispatch/
+  readback, deadline re-check, future resolution, span close on its own
+  worker) and assembles batch N+1 while batch N executes — supervised
+  and unsupervised alike; batch inputs are written into pre-allocated
+  staging slabs at coalesce-admit time so launch stops re-stacking
+  arrays on the critical path; and the coalesce window adapts (shrinks
+  as backlog rises) so waiting for stragglers only pays when the device
+  would otherwise idle. ``inflight_depth=1`` is the serial
+  assemble->launch->block->resolve cycle, byte-for-byte in telemetry
+  shape — the drill's baseline (serving/measure.py:
+  dispatch_pipeline_drill_run proves pipelined results bit-identical);
 * **donates** the steady-state input buffers (``donate_argnums`` on the
   per-bucket jit) so XLA may reuse them for outputs — meaningful on
   device backends; auto-disabled on CPU, where donation is unimplemented
@@ -463,6 +472,279 @@ class _Request:
         self.span = None            # obs.Tracer span id (PR 8) or None
 
 
+class _Staging:
+    """One pre-allocated batch-assembly slab pair (PR 17).
+
+    ``pose``/``shape`` are max-bucket-row arrays the coalesce loop
+    writes INCREMENTALLY as each request is admitted, so the launch
+    path hands the executable a contiguous ``slab[:bucket]`` view
+    instead of re-stacking every member array on the critical path.
+    ``finish`` fills the pad region by broadcasting row 0 — the exact
+    ``buckets.pad_rows`` rule ("pad rows replay live traffic's
+    regime"), so staged batches stay bit-identical to the legacy
+    concatenate+pad assembly. A slab is owned by its batch until the
+    dispatch has consumed it (under the completion stage: until
+    readback), then returns to the engine's pool.
+    """
+
+    __slots__ = ("pose", "shape", "rows", "full")
+
+    def __init__(self, pose, shape):
+        self.pose = pose            # [max_bucket, J, 3] engine dtype
+        self.shape = shape          # [max_bucket, S] engine dtype
+        self.rows = 0               # write cursor (== batch rows)
+        self.full = False           # full path: shape rows staged too
+
+    def append(self, req: _Request) -> None:
+        n = req.rows
+        self.pose[self.rows:self.rows + n] = req.pose
+        if self.full:
+            self.shape[self.rows:self.rows + n] = req.shape
+        self.rows += n
+
+    def finish(self, bucket: int):
+        """Pad to ``bucket`` (repeat row 0, the pad_rows contract) and
+        return the batch's ``(pose, shape)`` views — ``shape`` is None
+        on the pose-only path."""
+        if self.rows < bucket:
+            self.pose[self.rows:bucket] = self.pose[:1]
+            if self.full:
+                self.shape[self.rows:bucket] = self.shape[:1]
+        return (self.pose[:bucket],
+                self.shape[:bucket] if self.full else None)
+
+
+class _CompletionStage:
+    """The bounded completion stage of the dispatch pipeline (PR 17):
+    a pool of ``depth`` daemon workers that finish launched batches —
+    dispatch-or-readback, deadline re-check, future resolution, span
+    close — while the dispatcher assembles the next batch.
+
+    ``depth`` bounds launched-but-unresolved batches: ``submit`` blocks
+    once ``depth`` batches are in flight, which is the pipeline's
+    backpressure — and because the pool holds one worker per in-flight
+    slot, up to ``depth`` device round-trips overlap each other (the
+    actual pipelining win on the tunnel: concurrent outstanding RPCs
+    hide each other's RTT; a single worker was tried first and
+    serialized them — docs/roadmap.md PR-17 dead-ends). Resolution
+    order is still STRICT FIFO: every batch takes a launch-order
+    sequence number at submit and ``_finish_in_order`` holds its
+    completed result at a reorder barrier until every predecessor has
+    resolved, so delivery order matches launch order exactly as the
+    serial loop's did (and per-lane FIFO in lane mode is untouched:
+    lanes bypass this stage entirely — each lane worker is already its
+    own completion stage).
+
+    Failure contract (mirrors ``_launch``): a ``ServingError`` poisons
+    ONLY its batch and the stage keeps completing (a failed batch is
+    traffic); any other ``BaseException`` is engine-fatal — the
+    failing worker poisons its batch plus everything still queued,
+    records the failure, and retires; ``submit``/``drain`` re-raise it
+    on the DISPATCHER thread so the normal crash path (poison parked,
+    drain cancelled, ``_failure``) owns the shutdown. Workers holding
+    a completed batch at the reorder barrier when a peer fails still
+    resolve their own batch (its predecessors were poisoned by the
+    failing worker, so FIFO over resolved batches holds).
+
+    ``_completion_lock`` is a Condition and the stage's ONE lock — a
+    LEAF in the engine's lock order (nothing else is ever taken under
+    it), held only around deque/sequence bookkeeping. Device work (the
+    dispatch closure, ``np.asarray`` readback) and future resolution
+    run OUTSIDE it: the ``device-under-completion-lock`` analysis rule
+    (mano_hand_tpu/analysis/policy.py) pins that, the same way the
+    ``_exe_lock``/``_install_lock`` rules pin the executable caches.
+    """
+
+    def __init__(self, eng: "ServingEngine", depth: int):
+        self._eng = eng
+        self.depth = int(depth)
+        self._completion_lock = threading.Condition()
+        self._items: collections.deque = collections.deque()
+        self._inflight = 0          # submitted, not yet delivered
+        self._next_seq = 0          # launch order, assigned at submit
+        self._deliver_seq = 0       # next seq allowed to resolve
+        self._closed = False
+        self._failure: Optional[BaseException] = None
+        self._threads = [
+            threading.Thread(
+                target=self._worker,
+                name=f"mano-serving-completion-{i}", daemon=True)
+            for i in range(max(1, self.depth))]
+        for t in self._threads:
+            t.start()
+
+    def inflight(self) -> int:
+        with self._completion_lock:
+            return self._inflight
+
+    def submit(self, fn, reqs, rows: int, bucket: int, n_subjects: int,
+               staging) -> int:
+        """Hand one launched batch to the stage; blocks at ``depth``
+        (backpressure). Returns the post-enqueue in-flight count.
+        Re-raises a worker engine-fatal failure on the caller (the
+        dispatcher), whose crash handler owns it; the caller's batch
+        is NOT enqueued then (its ``_launch`` except poisons it)."""
+        with self._completion_lock:
+            while (self._failure is None and not self._closed
+                   and self._inflight >= self.depth):
+                self._completion_lock.wait()
+            if self._failure is not None:
+                raise self._failure
+            if self._closed:
+                raise ServingError(
+                    "completion stage closed during submit (engine "
+                    "stopping)", phase="shutdown")
+            seq = self._next_seq
+            self._next_seq += 1
+            self._inflight += 1
+            self._items.append((seq, fn, reqs, rows, bucket,
+                                n_subjects, staging))
+            self._completion_lock.notify_all()
+            return self._inflight
+
+    def drain(self) -> None:
+        """Block until every submitted batch has resolved (the
+        dispatcher's clean-exit barrier). Re-raises a worker
+        engine-fatal failure; returns immediately once closed (the
+        stop() wedged path abandoned us — ``_sweep_live`` resolves
+        whatever the stuck workers still hold)."""
+        with self._completion_lock:
+            while (self._failure is None and not self._closed
+                   and self._inflight > 0):
+                self._completion_lock.wait()
+            if self._failure is not None:
+                raise self._failure
+
+    def close(self, exc: Optional[BaseException] = None) -> None:
+        """Retire the pool (idempotent). Queued never-dispatched
+        batches are poisoned — with ``exc`` on a dispatcher crash,
+        else with the shutdown ServingError — so no future strands
+        however the stage ends. A worker wedged INSIDE a batch stays
+        abandoned (daemon; only kill -9 clears a hung device RPC), and
+        that batch's futures — plus any batch parked behind it at the
+        reorder barrier — fall to stop()'s ``_sweep_live``."""
+        with self._completion_lock:
+            self._closed = True
+            leftovers = list(self._items)
+            self._items.clear()
+            self._completion_lock.notify_all()
+        err = exc if exc is not None else ServingError(
+            "serving engine stopped before this launched batch "
+            "completed", phase="shutdown")
+        for it in leftovers:
+            self._eng._poison(it[2], err)
+            self._eng._staging_release(it[6])
+
+    # Worker side ------------------------------------------------------
+    def _worker(self) -> None:
+        eng = self._eng
+        item = None
+        try:
+            while True:
+                with self._completion_lock:
+                    while (not self._items and not self._closed
+                           and self._failure is None):
+                        self._completion_lock.wait()
+                    if self._failure is not None or not self._items:
+                        return      # failed, or closed + drained
+                    item = self._items.popleft()
+                seq, fn, reqs, rows, bucket, n_subjects, staging = item
+                try:
+                    outcome = self._run_call(fn, reqs, rows, bucket,
+                                             n_subjects)
+                finally:
+                    eng._staging_release(staging)
+                self._finish_in_order(seq, outcome, reqs, bucket)
+                item = None
+        except BaseException as e:  # noqa: BLE001 — engine-fatal class
+            # The _launch contract, stage-shaped: poison the batch
+            # whose resolution failed AND everything still queued
+            # (nothing will ever run it), record the failure for the
+            # dispatcher to re-raise, and retire.
+            if item is not None:
+                eng._poison(item[2], e)
+            with self._completion_lock:
+                if self._failure is None:
+                    self._failure = e
+                leftovers = list(self._items)
+                self._items.clear()
+                self._completion_lock.notify_all()
+            for it in leftovers:
+                eng._poison(it[2], e)
+                eng._staging_release(it[6])
+
+    def _run_call(self, fn, reqs, rows: int, bucket: int,
+                  n_subjects: int):
+        """The parallel phase: everything about finishing ONE batch
+        that does not touch another batch's state — deadline re-check,
+        the dispatch closure, the blocking readback. Runs concurrently
+        across workers; returns an outcome tag for the in-order
+        delivery phase."""
+        eng = self._eng
+        tr = eng._tracer
+        # Deadline re-check across the launch/completion split (PR 5
+        # composed with PR 17): the batch waited in the stage queue
+        # AFTER its launch-boundary sweep, so re-check NOW — the last
+        # instant a sweep still costs zero device time. Only a WHOLLY
+        # expired/cancelled batch skips its dispatch (the staged slab
+        # cannot drop single rows without re-assembly); a live member
+        # keeps the batch, and stragglers expire individually at
+        # readback (_deliver). give_up_by needs no re-arming here: it
+        # is an absolute monotonic bound (supervise.batch_give_up_by),
+        # so stage queue time already counted against it.
+        if any(r.deadline is not None or r.future.cancelled()
+               for r in reqs):
+            now = time.monotonic()
+            if all(r.future.cancelled() or eng._is_expired(r, now)
+                   for r in reqs):
+                return ("presweep", None)
+        try:
+            out = fn()   # supervised: host array; unsupervised: async
+        except ServingError as e:
+            # Supervision exhausted for THIS batch — same contract as
+            # the serial path: its futures get the structured error
+            # and the stage keeps completing (a failed batch is
+            # traffic, not an engine invariant breach).
+            return ("poison", e)
+        eng.counters.count_dispatch(bucket, rows, requests=len(reqs),
+                                    subjects=n_subjects)
+        if tr is not None:
+            for r in reqs:
+                tr.event(r.span, "dispatched")
+        verts = np.asarray(out)  # blocks until the device batch is done
+        return ("ok", verts)
+
+    def _finish_in_order(self, seq: int, outcome, reqs,
+                         bucket: int) -> None:
+        """The serial phase: hold this completed batch at the reorder
+        barrier until every earlier launch has resolved, then resolve
+        its futures / close its spans. This is what keeps resolution
+        strictly FIFO while the ``_run_call`` phases overlap."""
+        eng = self._eng
+        with self._completion_lock:
+            while self._deliver_seq != seq and self._failure is None:
+                self._completion_lock.wait()
+        # Resolution runs OUTSIDE the lock (leaf contract). On the
+        # failure path the predecessors were poisoned by the failing
+        # worker before it set _failure, so resolving this batch now
+        # still observes FIFO over resolved batches.
+        kind, payload = outcome
+        if kind == "presweep":
+            for r in reqs:
+                if not eng._skip_cancelled(r):
+                    eng._expire(r, "dispatch")
+            eng.counters.count_pipeline_presweep()
+        elif kind == "poison":
+            eng._poison(reqs, payload)
+        else:
+            eng.counters.count_pipeline_completion()
+            eng._deliver(reqs, payload, bucket)
+        with self._completion_lock:
+            self._deliver_seq = seq + 1
+            self._inflight -= 1
+            self._completion_lock.notify_all()
+
+
 class ServingEngine:
     """Micro-batching forward server over one parameter set.
 
@@ -473,6 +755,13 @@ class ServingEngine:
         than ``max_bucket`` are rejected at ``submit`` (chunk upstream).
     max_delay_s: how long the dispatcher waits to coalesce more requests
         once it holds at least one (the latency/throughput knob).
+    adaptive_coalesce: shrink the coalesce window as backlog depth and
+        head-of-line age rise (PR 17, ``_coalesce_window``): with a
+        backlog that can already fill a batch the wait buys nothing and
+        only adds latency, so it collapses toward zero; sparse traffic
+        still gets the full ``max_delay_s``. False pins the legacy
+        fixed window. Never changes WHICH requests may share a batch —
+        results are bit-identical either way.
     aot_dir: directory of persistent AOT artifacts. When it holds a
         baked executable LATTICE (``bake_lattice()``; PR 6) every
         reachable program — full, gathered pose-only per capacity, CPU
@@ -485,8 +774,17 @@ class ServingEngine:
         re-tracing. None = in-memory cache only.
     donate: donate pose/shape buffers to XLA (None = auto: on for
         device backends, off on CPU where donation is unimplemented).
-    inflight_depth: dispatched-but-unread batches to keep in flight
-        (2 = classic double buffering).
+    inflight_depth: the dispatch pipeline's in-flight depth (PR 17):
+        how many launched-but-unresolved batches the bounded completion
+        stage may hold, and therefore how many device round-trips may
+        overlap each other (2 = classic double buffering, the default —
+        batch N+1 assembles and dispatches while batch N executes; the
+        dispatcher blocks on stage backpressure past the depth;
+        resolution stays strict launch-order FIFO via the stage's
+        reorder barrier). 1 disables the stage entirely and keeps the
+        serial assemble->launch->block->resolve cycle, byte-for-byte in
+        telemetry shape — the pipelined-vs-serial drill's baseline.
+        Ignored in lane mode (lanes ARE the overlap).
     counters: a shared ServingCounters (e.g. process-global); default a
         private one, exposed as ``self.counters``.
     max_subjects: capacity ceiling of the device-resident subject table.
@@ -500,11 +798,14 @@ class ServingEngine:
     policy: a ``runtime.DispatchPolicy`` enabling supervised dispatch
         (per-batch deadline, classified retries with backoff, circuit-
         breaker-gated CPU failover, optional chaos injection). None
-        (default) keeps the unsupervised fast path: zero threads, zero
-        overhead per dispatch — right for directly-attached devices.
-        Supervision trades the double-buffered device overlap for a
-        bounded-latency guarantee: each supervised batch is resolved to
-        a host array inside its own deadline before the next launches.
+        (default) keeps the unsupervised fast path: zero supervision
+        threads, zero overhead per dispatch — right for directly-
+        attached devices. Each supervised batch still resolves to a
+        host array inside its own deadline envelope; since PR 17 that
+        envelope runs ON the completion stage at ``inflight_depth > 1``,
+        so supervision no longer forfeits the host/device overlap —
+        batch N+1 assembles while batch N's supervised call runs
+        (depth 1 restores the strictly serial pre-PR-17 behavior).
     max_queued: bounded admission (PR 5). None (default) keeps the
         historical unbounded queue; an int caps OUTSTANDING requests
         (submitted, not yet resolved — queued, parked, and in flight),
@@ -600,6 +901,7 @@ class ServingEngine:
         min_bucket: int = 1,
         max_bucket: int = 1024,
         max_delay_s: float = 0.002,
+        adaptive_coalesce: bool = True,
         aot_dir=None,
         donate: Optional[bool] = None,
         inflight_depth: int = 2,
@@ -622,6 +924,7 @@ class ServingEngine:
         self._dtype = np.dtype(dtype)
         self.buckets = bucket_mod.bucket_sizes(min_bucket, max_bucket)
         self.max_delay_s = float(max_delay_s)
+        self.adaptive_coalesce = bool(adaptive_coalesce)
         self.aot_dir = aot_dir
         if inflight_depth < 1:
             raise ValueError(
@@ -740,6 +1043,21 @@ class ServingEngine:
         # so a parked request can never starve behind the live queue.
         # Owned by the dispatcher thread; the crash handler sweeps it.
         self._pending: collections.deque = collections.deque()
+        # The pipelined completion stage (PR 17): built by the
+        # dispatcher loop at entry when ``inflight_depth > 1`` on the
+        # single-device path (lanes ARE the overlap in lane mode, and
+        # depth 1 keeps the serial assemble->launch->block->resolve
+        # cycle byte-for-byte). stop()'s wedged branch reads it to
+        # abandon a stuck stage.
+        self._completion = None
+        # Staged-assembly slab pool (PR 17): pre-allocated max-bucket
+        # pose/shape slabs, written incrementally at coalesce-admit
+        # time so _launch stops re-stacking request arrays on the
+        # critical path. Recycled when the owning batch fully resolves
+        # (a slab is live from assembly until its dispatch consumed
+        # it, which under the completion stage is after readback).
+        self._slab_pool: collections.deque = collections.deque()
+        self._slab_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._running = False
         self._failure: Optional[BaseException] = None
@@ -1021,6 +1339,17 @@ class ServingEngine:
                 phase="shutdown")
             self._failure = err
             self._thread = None
+            stage = self._completion
+            if stage is not None:
+                # A batch wedged IN the completion stage (hung device
+                # RPC on the stage worker) wedges the dispatcher behind
+                # it via backpressure: close the stage so queued
+                # batches poison, any blocked submit/drain wakes, and
+                # sweep_live below resolves whatever the stuck worker
+                # itself still holds. Both threads stay abandoned
+                # (daemons) — the kill -9 rule.
+                stage.close(err)
+                self._completion = None
             if self._laneset is not None:
                 # A wedged engine gets a short lane drain: sweep_live
                 # below resolves whatever a wedged lane worker holds.
@@ -2410,7 +2739,8 @@ class ServingEngine:
 
     def _coalesce(self, first: _Request):
         """Gather more pending requests behind ``first`` until the largest
-        bucket fills or ``max_delay_s`` elapses. Returns (requests, rows).
+        bucket fills or the coalesce window elapses. Returns
+        (requests, rows, staging).
 
         Same-path requests coalesce regardless of subject (the gathered
         dispatch takes a per-row subject index); a request that cannot
@@ -2419,12 +2749,23 @@ class ServingEngine:
         — is parked on ``_pending``, which leads the next batches, so
         head-of-line blocking is bounded to one batch instead of
         starving behind the live queue.
+
+        Staged assembly (PR 17): each admitted request's pose (and
+        shape, full path) rows are copied into a pre-allocated slab AT
+        ADMIT TIME — the copy overlaps the coalesce wait below instead
+        of re-stacking every member on the launch critical path. The
+        window itself is adaptive (``_coalesce_window``): it shrinks as
+        backlog age/depth rise, down to zero once a full batch is
+        already waiting — waiting for stragglers only pays when the
+        device would otherwise idle.
         """
         reqs, rows = [first], first.rows
         posed = first.subject is not None
         subjects = {first.subject} if posed else set()
         prec = self._req_prec(first)   # the batch's precision family
         shard = self._shard_of(first.subject) if posed else None
+        staging = self._staging_acquire(posed)
+        staging.append(first)
         if posed:
             # Prefetch at the coalesce boundary (PR 16): the async
             # promotion overlaps the max_delay_s window below.
@@ -2444,6 +2785,7 @@ class ServingEngine:
             why = self._admit(nxt, posed, subjects, rows, prec, shard)
             if why is None:
                 reqs.append(nxt)
+                staging.append(nxt)
                 if posed:
                     subjects.add(nxt.subject)
                     self._prefetch_subject(nxt.subject)
@@ -2469,7 +2811,7 @@ class ServingEngine:
             nxt = self._pending.popleft()
             if admit(nxt, fresh=False) is None:
                 rows += nxt.rows
-        deadline = time.perf_counter() + self.max_delay_s
+        deadline = time.perf_counter() + self._coalesce_window(first)
         while rows < self.buckets[-1]:
             timeout = deadline - time.perf_counter()
             try:
@@ -2489,40 +2831,96 @@ class ServingEngine:
                 # keeps scanning instead — later same-path requests can
                 # still fill this batch.
                 break
-        return reqs, rows
+        return reqs, rows, staging
+
+    def _coalesce_window(self, first: _Request) -> float:
+        """How long ``_coalesce`` may wait for stragglers THIS batch.
+
+        The adaptive coalesce window (PR 17), fed by the same signals
+        ``load()`` exports (queue depth + backlog age): the base
+        ``max_delay_s`` is the latency/throughput knob when traffic is
+        sparse, but once a backlog exists the wait stops buying
+        anything — the batch will fill from the queue instantly — and
+        only adds head-of-line latency. So the window (a) collapses to
+        zero when the waiting backlog could already fill the largest
+        bucket, (b) scales down linearly with backlog depth below
+        that, and (c) decays as the head request's age climbs to MANY
+        multiples of the base window (backlog age rising = the
+        dispatcher is congested, stop buying latency) — but a head
+        that is merely one dispatch-cycle old does NOT shrink it:
+        under paced load the head is always about one cycle old, and
+        charging that age collapses every batch to whatever already
+        sits queued, thinning batches until per-batch dispatch
+        overhead dominates (measured: 3x throughput LOSS —
+        docs/roadmap.md PR-17 dead-ends). ``adaptive_coalesce=False``
+        pins the legacy fixed window.
+
+        Depth-1 serial-equivalence note: the window only shapes how
+        long assembly WAITS for not-yet-arrived requests — never which
+        requests may join a batch — so results stay bit-identical at
+        every depth; see the "Dispatch pipeline" README section for
+        the depth-1 contract this rides beside.
+        """
+        base = self.max_delay_s
+        if not self.adaptive_coalesce or base <= 0.0:
+            return base
+        backlog = self._queue.qsize() + len(self._pending)
+        cap = self.buckets[-1]
+        if backlog + 1 >= cap:
+            return 0.0
+        age = time.perf_counter() - first.t_submit
+        pressure = max(backlog / cap, min(1.0, age / (8.0 * base)))
+        return base * (1.0 - pressure)
 
     def _pop_parked(self) -> _Request:
-        """Take the highest-priority (lowest-tier) parked request,
-        earliest-parked among ties. Parked requests already lead the
-        next batches (the anti-starvation rule); under priority classes
-        the lead goes to tier 0 FIRST, so a parked interactive request
-        can never starve behind parked batch work either."""
+        """Take the highest-priority parked request: lowest tier first,
+        then EARLIEST DEADLINE within the tier (EDF — the PR-5 Open
+        item, closed by PR 17), deadline-less requests after deadlined
+        ones, earliest-parked among remaining ties. Parked requests
+        already lead the next batches (the anti-starvation rule); under
+        priority classes the lead goes to tier 0 FIRST, so a parked
+        interactive request can never starve behind parked batch work —
+        and within a tier the request closest to expiry now leads, so a
+        deep parked backlog sheds the fewest deadlines."""
         best = 0
         for i in range(1, len(self._pending)):
-            if self._pending[i].tier < self._pending[best].tier:
+            a, b = self._pending[i], self._pending[best]
+            if a.tier != b.tier:
+                if a.tier < b.tier:
+                    best = i
+            elif (a.deadline is not None
+                    and (b.deadline is None or a.deadline < b.deadline)):
                 best = i
         req = self._pending[best]
         del self._pending[best]
         return req
 
     def _dispatch_loop(self) -> None:
-        inflight: collections.deque = collections.deque()
+        # The pipelined dispatch path (PR 17): at depth > 1 on the
+        # single-device path, launched batches hand off to a bounded
+        # completion stage (readback, deadline re-check, future
+        # resolution, span close on a worker pool with FIFO delivery)
+        # so batch N+1 assembles and dispatches while batch N executes
+        # — the dispatcher only ever blocks on the queue or on stage
+        # backpressure. Depth 1 keeps
+        # the serial assemble->launch->block->resolve cycle on this one
+        # thread, byte-for-byte in telemetry shape (no stage, no
+        # "staged" stamps, no pipeline events). Lane mode bypasses both
+        # (lanes ARE the overlap; each lane worker is its own FIFO
+        # completion stage).
+        stage = None
+        if self.inflight_depth > 1 and self._lane_count is None:
+            stage = _CompletionStage(self, self.inflight_depth)
+            self._completion = stage
+            if self._tracer is not None:
+                self._tracer.runtime_event(
+                    "pipeline", depth=self.inflight_depth)
         try:
             while True:
                 if self._pending:
                     first = self._pop_parked()
                 else:
-                    try:
-                        # With work in flight, never WAIT on the queue:
-                        # an empty instant means nothing to assemble, so
-                        # the right move is retiring the oldest batch
-                        # (which blocks on the device — new requests
-                        # accumulate behind it meanwhile).
-                        first = (self._queue.get_nowait() if inflight
-                                 else self._queue.get())
-                    except queue.Empty:
-                        self._resolve(inflight.popleft())
-                        continue
+                    first = self._queue.get()
                 if first is _SENTINEL:
                     if not self._running:
                         break
@@ -2537,24 +2935,31 @@ class ServingEngine:
                     continue
                 self.counters.observe_queue_depth(
                     self._queue.qsize() + len(self._pending) + 1)
-                reqs, rows = self._coalesce(first)
-                item = self._launch(reqs, rows)
-                if item is not None:  # None: batch resolved to an error
-                    inflight.append(item)
-                # Double buffering: block on the OLDEST batch only once
-                # the pipeline is full — assembly of the next batch then
-                # overlaps the device executing this one.
-                while len(inflight) >= self.inflight_depth + 1:
-                    self._resolve(inflight.popleft())
-            while inflight:
-                self._resolve(inflight.popleft())
+                reqs, rows, staging = self._coalesce(first)
+                item = self._launch(reqs, rows, staging)
+                if item is not None:
+                    # Depth-1 serial cycle (or an unsupervised async
+                    # handle): retire it before assembling the next
+                    # batch. Pipelined/lane launches return None — the
+                    # stage (or a lane worker) owns the resolution.
+                    self._resolve(item)
+            if stage is not None:
+                # Clean exit: every launched batch resolves before the
+                # queue drains below (re-raises a stage engine-fatal
+                # failure here, into the crash handler).
+                stage.drain()
+                stage.close()
+                self._completion = None
             self._drain_cancelled()
         except BaseException as e:  # noqa: BLE001 — futures must not hang
             self._failure = e
-            for item in inflight:
-                self._poison(item[1], e)
+            if stage is not None:
+                # Queued never-dispatched stage batches are poisoned;
+                # the worker retires (idempotent if IT failed first).
+                stage.close(e)
+                self._completion = None
             if self._pending:
-                # Requests parked by _coalesce are in neither inflight
+                # Requests parked by _coalesce are in neither the stage
                 # nor the queue — their futures must not hang (the PR-3
                 # poison guarantee extended to the _pending deque).
                 self._poison(list(self._pending), e)
@@ -2562,12 +2967,38 @@ class ServingEngine:
             self._drain_cancelled(e)
             raise
 
-    def _launch(self, reqs, rows):
+    def _staging_acquire(self, posed: bool) -> _Staging:
+        """One assembly slab pair from the pool (allocate on a dry
+        pool — the pool only ever holds recycled slabs). The pool is
+        shared with the completion worker (it recycles from its own
+        thread), hence the lock."""
+        with self._slab_lock:
+            st = self._slab_pool.pop() if self._slab_pool else None
+        if st is None:
+            cap = self.buckets[-1]
+            st = _Staging(
+                np.empty((cap, self._n_joints, 3), self._dtype),
+                np.empty((cap, self._n_shape), self._dtype))
+        st.rows = 0
+        st.full = not posed
+        return st
+
+    def _staging_release(self, st: Optional[_Staging]) -> None:
+        """Recycle a batch's slab once its dispatch has consumed it
+        (bounded pool: depth in-flight + one assembling + slack; an
+        overflow slab is simply dropped to the allocator)."""
+        if st is None:
+            return
+        with self._slab_lock:
+            if len(self._slab_pool) < self.inflight_depth + 2:
+                self._slab_pool.append(st)
+
+    def _launch(self, reqs, rows, staging: Optional[_Staging] = None):
         # Final deadline sweep at the launch boundary: coalescing can
-        # hold a batch for max_delay_s (and a predecessor batch can hold
-        # the loop far longer), so re-check each member NOW — the last
-        # instant a sweep still costs zero chip time. An all-expired
-        # batch dispatches nothing at all.
+        # hold a batch for the coalesce window (and a predecessor batch
+        # can hold the loop far longer), so re-check each member NOW —
+        # the last instant a sweep still costs zero chip time. An
+        # all-expired batch dispatches nothing at all.
         if any(r.deadline is not None or r.future.cancelled()
                for r in reqs):
             now = time.monotonic()
@@ -2580,8 +3011,14 @@ class ServingEngine:
                 else:
                     alive.append(r)
             if not alive:
+                self._staging_release(staging)
                 return None
             if len(alive) != len(reqs):
+                # The staged slab has holes where swept members sat —
+                # this (rare: a mid-coalesce expiry/cancel) batch falls
+                # back to the legacy re-stack below.
+                self._staging_release(staging)
+                staging = None
                 reqs = alive
                 rows = sum(r.rows for r in reqs)
         try:
@@ -2593,19 +3030,35 @@ class ServingEngine:
                 # itself land between "launch" and "dispatched".
                 for r in reqs:
                     tr.event(r.span, "launch", bucket=bucket)
-            if len(reqs) == 1:
-                pose = reqs[0].pose
-            else:
-                pose = np.concatenate([r.pose for r in reqs])
-            pose = bucket_mod.pad_rows(pose, bucket)
             posed = reqs[0].subject is not None  # uniform kind (_coalesce)
-            shape = table = idx = None
+            if staging is not None:
+                # Staged assembly (PR 17): the rows were copied at
+                # admit time; what remains is the pad fill — identical
+                # bytes to pad_rows (repeat row 0).
+                pose, shape = staging.finish(bucket)
+            else:
+                if len(reqs) == 1:
+                    pose = reqs[0].pose
+                else:
+                    pose = np.concatenate([r.pose for r in reqs])
+                pose = bucket_mod.pad_rows(pose, bucket)
+                shape = None
+                if not posed:
+                    shape = (reqs[0].shape if len(reqs) == 1 else
+                             np.concatenate([r.shape for r in reqs]))
+                    shape = bucket_mod.pad_rows(shape, bucket)
+            table = idx = None
             n_subjects = 1
-            if not posed:
-                shape = (reqs[0].shape if len(reqs) == 1 else
-                         np.concatenate([r.shape for r in reqs]))
-                shape = bucket_mod.pad_rows(shape, bucket)
             if self._lane_count is not None:
+                if staging is not None:
+                    # A lane batch outlives the dispatcher's recycling
+                    # horizon (it queues on the lane), so it takes a
+                    # compact copy and the slab returns to the pool
+                    # right away.
+                    pose = np.array(pose)
+                    shape = None if shape is None else np.array(shape)
+                    self._staging_release(staging)
+                    staging = None
                 # Lane-aware dispatch (PR 13): the assembled batch goes
                 # to the least-backlogged healthy lane; that lane's
                 # worker runs the supervised dispatch + failover ladder
@@ -2628,14 +3081,58 @@ class ServingEngine:
                 return None
             prec = self._req_prec(reqs[0]) if posed else "f32"
             if posed:
+                # Resolved HERE (not in the completion worker): the
+                # (table, slots) pair is a functional SNAPSHOT taken
+                # under _exe_lock, so it stays self-consistent however
+                # specialize/evict mutate the live table while the
+                # batch waits in the stage — unlike a lane replica,
+                # which is why lanes resolve in their workers instead.
                 table, slots = self._resolve_batch(reqs)
                 idx = bucket_mod.subject_index_rows(
                     slots, [r.rows for r in reqs], bucket)
                 n_subjects = len(set(slots))
+            stage = self._completion
+            if stage is not None:
+                # Pipelined dispatch (PR 17): hand the assembled batch
+                # to the completion stage and assemble the next one
+                # immediately — the dispatch itself, the readback, and
+                # the future resolution all run on the stage worker,
+                # in strict launch (FIFO) order. The closure captures
+                # the functional table snapshot; executables for the
+                # unsupervised paths are fetched HERE so a warm-up
+                # compile stays on the dispatcher (the stage worker
+                # never builds programs, it only runs them).
+                if self._policy is not None:
+                    def fn(pose=pose, shape=shape, reqs=reqs,
+                           table=table, idx=idx, bucket=bucket,
+                           prec=prec):
+                        return self._supervised_dispatch(
+                            bucket, pose, shape, reqs, table, idx,
+                            prec=prec)
+                elif posed:
+                    exe = self._gather_executable(bucket, table, prec)
+                    def fn(exe=exe, table=table, idx=idx, pose=pose):  # noqa: E306
+                        return exe(table, idx, pose)
+                else:
+                    exe = self._executable(bucket)
+                    def fn(exe=exe, pose=pose, shape=shape):  # noqa: E306
+                        return exe(pose, shape)
+                if tr is not None:
+                    # Stamped BEFORE submit: a submit that blocks on
+                    # stage backpressure is itself stage wait. The
+                    # inflight field counts this batch in.
+                    depth_now = stage.inflight() + 1
+                    for r in reqs:
+                        tr.event(r.span, "staged", inflight=depth_now)
+                n = stage.submit(fn, reqs, rows, bucket, n_subjects,
+                                 staging)
+                self.counters.observe_pipeline_inflight(n)
+                return None
             if self._policy is not None:
-                # Supervised: resolved to a HOST array inside the
-                # policy's deadline/retry/failover envelope before the
-                # next batch launches (bounded latency over overlap).
+                # Supervised serial (depth 1): resolved to a HOST array
+                # inside the policy's deadline/retry/failover envelope
+                # before the next batch launches (bounded latency over
+                # overlap).
                 out = self._supervised_dispatch(bucket, pose, shape,
                                                 reqs, table, idx,
                                                 prec=prec)
@@ -2654,19 +3151,21 @@ class ServingEngine:
                 # either way this is where the batch left the engine.
                 for r in reqs:
                     tr.event(r.span, "dispatched")
-            return out, reqs, bucket
+            return out, reqs, bucket, staging
         except ServingError as e:
             # Supervision exhausted for THIS batch: its futures get the
             # structured error and the dispatcher lives on — a failed
             # batch is traffic, not an engine invariant breach. (The
             # fault may clear; later submits must still be servable.)
             self._poison(reqs, e)
+            self._staging_release(staging)
             return None
         except BaseException as e:
             # This batch's requests live only in our locals — the outer
             # crash handler cannot see them, so a caller blocked on one
             # of these futures would otherwise hang forever.
             self._poison(reqs, e)
+            self._staging_release(staging)
             raise
 
     def _supervised_dispatch(self, bucket: int, pose, shape,
@@ -2703,9 +3202,12 @@ class ServingEngine:
         # every request in the batch has expired — a retry or failover
         # past the LATEST member deadline produces a result nobody will
         # read. Any member without a deadline keeps the budget unbounded.
-        deadlines = [r.deadline for r in reqs]
-        give_up_by = (None if any(d is None for d in deadlines)
-                      else max(deadlines))
+        # The bound is computed when THIS call starts (on the completion
+        # worker when pipelined), from absolute monotonic deadlines —
+        # so time a batch spent queued in the completion stage has
+        # already been charged against it (supervise.batch_give_up_by).
+        give_up_by = supervise.batch_give_up_by(
+            r.deadline for r in reqs)
         tr = self._tracer
         if tr is None:
             on_retry = self.counters.count_retry
@@ -2810,12 +3312,16 @@ class ServingEngine:
         return np.ascontiguousarray(fb_shape)
 
     def _resolve(self, item) -> None:
-        out, reqs, bucket = item
+        out, reqs, bucket, staging = item
         try:
             verts = np.asarray(out)  # blocks until the device batch is done
         except BaseException as e:
             self._poison(reqs, e)  # same reasoning as _launch
             raise
+        finally:
+            # The dispatch (and any readback above) has consumed the
+            # staged slab either way — recycle it.
+            self._staging_release(staging)
         self._deliver(reqs, verts, bucket)
 
     def _deliver(self, reqs, verts, bucket: int) -> None:

@@ -909,9 +909,7 @@ class LaneSet:
         eng = self._eng
         pol = eng._policy
         tr = eng._tracer
-        deadlines = [r.deadline for r in reqs]
-        give_up_by = (None if any(d is None for d in deadlines)
-                      else max(deadlines))
+        give_up_by = supervise.batch_give_up_by(r.deadline for r in reqs)
 
         def attempt_on(target: Lane, retries: int):
             # Resolution + executable fetch happen per RUNG, outside

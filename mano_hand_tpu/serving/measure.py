@@ -2910,6 +2910,386 @@ def lane_drill_run(
     }
 
 
+def dispatch_pipeline_drill_run(
+    params,
+    *,
+    requests_steady: int = 240,
+    requests_chaos: int = 48,
+    calibrate_requests: int = 128,
+    trials: int = 5,
+    subjects: int = 6,
+    max_rows: int = 2,
+    max_bucket: int = 16,
+    deadline_s: float = 6.0,
+    inflight_depth: int = 2,
+    device_rtt_s: float = 0.0015,
+    max_delay_s: float = 0.002,
+    pace_factor: float = 0.9,
+    seed: int = 0,
+    log: Callable[[str], None] = None,
+) -> dict:
+    """THE paired pipelined-vs-serial dispatch drill (PR 17 tentpole;
+    bench config20, judged by scripts/bench_report.py:
+    judge_dispatch_pipeline).
+
+    Two supervised single-device engines over the SAME params, subjects,
+    and deterministic request streams, differing only in the dispatch
+    pipeline: ``serial`` is today's baseline (``inflight_depth=1``,
+    fixed coalesce window — the depth-1 serial-equivalence contract),
+    ``pipelined`` runs the PR-17 path (``inflight_depth`` deep
+    completion stage + adaptive window). The timed legs run
+    ``trials`` times each, interleaved per trial with ALTERNATING
+    side order on the same stream, and rates come from each side's
+    FASTEST trial (the module-preamble noise defenses: a load spike
+    on this busy 1-core box costs both sides, and min-time reads the
+    least-loaded window) while queue-wait percentiles pool every
+    trial's spans:
+
+    * **drain** — ``calibrate_requests`` submitted upfront (fully
+      saturated backlog, no arrival pacing): the serial drain rate is
+      the measured serial CAPACITY, and the pipelined drain alongside
+      is the raw capacity-ratio record;
+    * **steady** — ``requests_steady`` arriving open-loop at
+      ``pace_factor`` x the serial capacity, the matched SATURATED
+      load of the acceptance criteria: the serial engine cannot keep
+      up by construction, so its backlog (and queue wait) grows at a
+      rate the pipelined engine's host/device overlap must beat. Queue
+      p50/p99 per engine come from each tracer's steady-leg spans
+      (the same submit->launch stage `mano trace-report` prints); the
+      full per-bucket stage table rides in the artifact as evidence.
+      A mid-leg ``future.cancel()`` probe (same index both engines)
+      exercises the cancellation path through the completion stage;
+    * **chaos** — transient ``error@`` faults land on ALREADY-LAUNCHED
+      batches (on the pipelined engine the supervised envelope runs on
+      the completion worker, so the fault fires in-flight by
+      construction), retries absorb them, and every span still closes
+      exactly once.
+
+    Every leg's results are compared BITWISE against a plain
+    single-device reference engine and across the two engines
+    (``cross_engine_bit_identical``): pipelining reorders WORK, never
+    results. The device-side ``sat:{device_rtt_s}@*`` throttle on BOTH
+    engines is the chaos module's documented slow-device model — it
+    stands in for the tunnel's dispatch RTT (docs/roadmap.md PR-8: 70
+    ms sync on the real chip), the genuinely off-host time whose
+    overlap is the point of the PR. Faults are injected in-process; no
+    chip is required and none is harmed.
+    """
+    import concurrent.futures as cf
+
+    from mano_hand_tpu.runtime.chaos import ChaosPlan
+    from mano_hand_tpu.runtime.supervise import DispatchPolicy
+    from mano_hand_tpu.serving.engine import ServingEngine, ServingError
+
+    log = _logger(log)
+    n_joints, n_shape = params.n_joints, params.n_shape
+    prm32 = params.astype(np.float32)
+    rng = np.random.default_rng(seed)
+    subj_betas = [rng.normal(size=(n_shape,)).astype(np.float32)
+                  for _ in range(subjects)]
+
+    def make_stream(n, pass_seed):
+        r = np.random.default_rng(pass_seed)
+        sizes = r.integers(1, max_rows + 1, size=n)
+        return [(r.normal(scale=0.4,
+                          size=(int(s), n_joints, 3)).astype(np.float32),
+                 int(r.integers(0, subjects)))
+                for s in sizes]
+
+    streams = {
+        "drain": make_stream(calibrate_requests, seed + 300),
+        "steady": make_stream(requests_steady, seed + 301),
+        "chaos": make_stream(requests_chaos, seed + 302),
+    }
+
+    # Bit-identity bar: the plain single-device engine, same subjects.
+    ref_eng = ServingEngine(prm32, max_bucket=max_bucket,
+                            max_delay_s=0.001)
+    reference = {}
+    with ref_eng:
+        ref_keys = [ref_eng.specialize(b) for b in subj_betas]
+        for name, stream in streams.items():
+            reference[name] = [
+                ref_eng.forward(p, subject=ref_keys[si])
+                for p, si in stream]
+
+    sat_spec = (f"sat:{device_rtt_s}@*" if device_rtt_s > 0 else "")
+
+    def build(depth, adaptive):
+        plan = ChaosPlan()
+        policy = DispatchPolicy(
+            deadline_s=deadline_s, retries=1, backoff_s=0.005,
+            backoff_cap_s=0.01, jitter=0.0, chaos=plan,
+            cpu_fallback=True)
+        tracer = Tracer(capacity=65536)
+        eng = ServingEngine(
+            prm32, max_bucket=max_bucket, max_delay_s=max_delay_s,
+            adaptive_coalesce=adaptive, inflight_depth=depth,
+            policy=policy, tracer=tracer)
+        return {"eng": eng, "plan": plan, "tracer": tracer}
+
+    sides = {"serial": build(1, False),
+             "pipelined": build(int(inflight_depth), True)}
+    resolve_timeout = deadline_s * 3 + 60.0
+
+    def queue_seconds(side, n0):
+        tr = side["tracer"]
+        spans = tr.spans()[n0:]
+        out = []
+        for sp in spans:
+            st = tr._span_stages(sp)
+            if st is not None:
+                out.append(st["queue_s"])
+        return out, spans
+
+    def run_leg(side, stream, keys, *, rate=None, cancel_idx=-1):
+        """Submit one leg (open-loop paced at ``rate``/s, or all
+        upfront when None), resolve everything, classify outcomes."""
+        eng = sides[side]["eng"]
+        outcomes = {"ok": 0, "error": 0, "expired": 0, "stranded": 0,
+                    "cancelled": 0}
+        results = [None] * len(stream)
+        futs = [None] * len(stream)
+        t0 = time.perf_counter()
+        for i, (p, si) in enumerate(stream):
+            if rate is not None:
+                wait = t0 + i / rate - time.perf_counter()
+                if wait > 0:
+                    time.sleep(wait)
+            futs[i] = eng.submit(p, subject=keys[si])
+            if i == cancel_idx:
+                futs[i].cancel()
+        for i, f in enumerate(futs):
+            try:
+                results[i] = f.result(timeout=resolve_timeout)
+                k = "ok"
+            except cf.CancelledError:
+                k = "cancelled"
+            except ServingError as e:
+                k = "expired" if e.kind == "expired" else "error"
+            except Exception:   # noqa: BLE001 — a timeout IS the bug
+                k = "stranded"
+            outcomes[k] += 1
+        return outcomes, results, time.perf_counter() - t0
+
+    def max_err(results, refs, skip=()):
+        worst = 0.0
+        for i, (got, want) in enumerate(zip(results, refs)):
+            if got is None:
+                if i in skip:
+                    continue
+                return None              # an unresolved result: no bar
+            worst = max(worst, float(np.abs(got - want).max()))
+        return worst
+
+    pct = lambda xs, q: float(   # noqa: E731
+        f"{np.percentile(np.asarray(xs), q) * 1e3:.4g}") if xs else None
+    g4 = lambda x: float(f"{x:.4g}")     # noqa: E731
+
+    legs = {"serial": {}, "pipelined": {}}
+    cancel_idx = len(streams["steady"]) // 2
+    try:
+        for name, side in sides.items():
+            eng = side["eng"]
+            eng.__enter__()
+            side["keys"] = [eng.specialize(b) for b in subj_betas]
+            buckets = [b for b in eng.buckets if b <= max_bucket]
+            eng.warmup(buckets)
+            eng.warmup_posed(buckets)
+            side["warm_compiles"] = eng.counters.compiles
+            if sat_spec:
+                side["plan"].schedule(sat_spec)
+
+        def order(t):
+            return (("serial", "pipelined") if t % 2 == 0
+                    else ("pipelined", "serial"))
+
+        def werr(a, b):
+            return None if a is None or b is None else max(a, b)
+
+        def merge(leg, name, oc, err, res, dt):
+            st = legs[name].setdefault(leg, {
+                "outcomes": {k: 0 for k in oc}, "dts": [], "err": 0.0})
+            for k, v in oc.items():
+                st["outcomes"][k] += v
+            st["dts"].append(dt)
+            st["err"] = werr(st["err"], err)
+            st["results"] = res
+
+        # -- leg 1: drain (saturated-backlog capacity) ----------------
+        for t in range(trials):
+            for name in order(t):
+                oc, res, dt = run_leg(name, streams["drain"],
+                                      sides[name]["keys"])
+                merge("drain", name, oc,
+                      max_err(res, reference["drain"]), res, dt)
+        serial_rate = calibrate_requests / min(
+            legs["serial"]["drain"]["dts"])
+        pipelined_rate = calibrate_requests / min(
+            legs["pipelined"]["drain"]["dts"])
+        # Pace the steady leg at pace_factor (default 0.9) of the
+        # PIPELINED capacity: when the pipeline genuinely buys
+        # headroom, that rate sits decisively above the serial
+        # engine's plateau — its backlog grows for the whole leg —
+        # while the pipelined engine keeps 10% slack and serves at
+        # the arrival rate. The queue-wait gap is the pipeline's
+        # capacity headroom made visible. A broken pipeline
+        # (capacity <= serial) pulls the pace under BOTH plateaus
+        # and the queue ratio honestly collapses to ~1. (A
+        # geometric-mean pace was tried first: it lands within
+        # calibration noise of the serial plateau, so whether the
+        # serial side overloads at all flips run to run.)
+        paced_rate = pace_factor * pipelined_rate
+        log(f"dispatch pipeline drill: capacities serial "
+            f"{serial_rate:.1f} / pipelined {pipelined_rate:.1f} "
+            f"req/s, pacing steady leg at {paced_rate:.1f} req/s")
+
+        # -- leg 2: steady (matched saturated open-loop load) ---------
+        for name, side in sides.items():
+            side["steady_n0"] = len(side["tracer"].spans())
+            side["compiles_before_steady"] = side["eng"].counters.compiles
+        for t in range(trials):
+            for name in order(t):
+                oc, res, dt = run_leg(
+                    name, streams["steady"], sides[name]["keys"],
+                    rate=paced_rate, cancel_idx=cancel_idx)
+                merge("steady", name, oc,
+                      max_err(res, reference["steady"],
+                              skip={cancel_idx}), res, dt)
+        for name, side in sides.items():
+            qs, spans = queue_seconds(side, side["steady_n0"])
+            legs[name]["steady"].update({
+                "queue_s": qs,
+                "stage_table": side["tracer"].stage_breakdown(spans),
+                "recompiles": (side["eng"].counters.compiles
+                               - side["compiles_before_steady"]),
+            })
+
+        # -- leg 3: chaos (transient faults on in-flight batches) -----
+        for name, side in sides.items():
+            c0 = {k: getattr(side["eng"].counters, k)
+                  for k in ("retries", "faults_injected", "failovers")}
+            side["plan"].schedule(
+                "error@1,error@4" + ("," + sat_spec if sat_spec else ""))
+            oc, res, dt = run_leg(name, streams["chaos"], side["keys"])
+            side["plan"].clear()
+            merge("chaos", name, oc,
+                  max_err(res, reference["chaos"]), res, dt)
+            legs[name]["chaos"].update(
+                {k: getattr(side["eng"].counters, k) - c0[k]
+                 for k in c0})
+    finally:
+        for side in sides.values():
+            side["plan"].release.set()
+            side["eng"].__exit__(None, None, None)
+
+    # Cross-engine bit identity, leg by leg (the cancel probe's index
+    # is skipped on steady — both engines cancelled the same request).
+    cross = True
+    for leg_name in ("drain", "steady", "chaos"):
+        for i, (a, b) in enumerate(zip(
+                legs["serial"][leg_name]["results"],
+                legs["pipelined"][leg_name]["results"])):
+            if leg_name == "steady" and i == cancel_idx:
+                continue
+            if a is None or b is None or not np.array_equal(a, b):
+                cross = False
+
+    n_legs_total = (trials * (calibrate_requests + requests_steady)
+                    + requests_chaos)
+    out = {
+        "requests_steady": requests_steady,
+        "requests_chaos": requests_chaos,
+        "calibrate_requests": calibrate_requests,
+        "trials": trials,
+        "subjects": subjects,
+        "max_bucket": max_bucket,
+        "pipeline_depth": int(inflight_depth),
+        "device_rtt_s": device_rtt_s,
+        "pace_factor": pace_factor,
+        "serial_capacity_per_sec": g4(serial_rate),
+        "pipelined_capacity_per_sec": g4(pipelined_rate),
+        "paced_rate_per_sec": g4(paced_rate),
+    }
+    for name in ("serial", "pipelined"):
+        side, lg = sides[name], legs[name]
+        qs = lg["steady"]["queue_s"]
+        acc = side["tracer"].accounting()
+        resolved = n_legs_total - sum(
+            lg[leg]["outcomes"]["stranded"]
+            for leg in ("drain", "steady", "chaos"))
+        outcomes = {k: sum(lg[leg]["outcomes"][k]
+                           for leg in ("drain", "steady", "chaos"))
+                    for k in lg["steady"]["outcomes"]}
+        csnap = side["eng"].counters.snapshot()
+        out[f"{name}_queue_p50_ms"] = pct(qs, 50)
+        out[f"{name}_queue_p99_ms"] = pct(qs, 99)
+        out.update({
+            # End-to-end throughput at matched saturated load: the
+            # drain leg (full backlog, no arrival pacing) is the
+            # capacity comparison; the paced rate is the steady leg's
+            # (arrival-bound for whichever side keeps up).
+            f"{name}_throughput_per_sec": g4(
+                calibrate_requests / min(lg["drain"]["dts"])),
+            f"{name}_drain_leg_seconds": [
+                g4(dt) for dt in lg["drain"]["dts"]],
+            f"{name}_paced_throughput_per_sec": g4(
+                requests_steady / min(lg["steady"]["dts"])),
+            f"{name}_steady_recompiles": int(lg["steady"]["recompiles"]),
+            f"{name}_warmup_compiles": int(side["warm_compiles"]),
+            f"{name}_futures_resolved_fraction": float(
+                f"{resolved / n_legs_total:.6g}"),
+            f"{name}_outcomes": outcomes,
+            f"{name}_drain_vs_reference_max_abs_err":
+                lg["drain"]["err"],
+            f"{name}_steady_vs_reference_max_abs_err":
+                lg["steady"]["err"],
+            f"{name}_chaos_vs_reference_max_abs_err":
+                lg["chaos"]["err"],
+            f"{name}_chaos_retries": int(lg["chaos"]["retries"]),
+            f"{name}_chaos_faults_injected": int(
+                lg["chaos"]["faults_injected"]),
+            f"{name}_stage_table": lg["steady"]["stage_table"],
+            f"{name}_spans": {
+                "started": acc["spans_started"],
+                "closed": acc["spans_closed"],
+                "open": acc["spans_open"],
+                "closed_by_kind": acc["closed_by_kind"],
+            },
+            f"{name}_pipeline_inflight_peak": int(
+                csnap["pipeline_inflight_peak"]),
+            f"{name}_pipeline_completions": int(
+                csnap["pipeline_completions"]),
+        })
+    out["queue_p50_speedup"] = (
+        g4(out["serial_queue_p50_ms"] / out["pipelined_queue_p50_ms"])
+        if out["serial_queue_p50_ms"] and out["pipelined_queue_p50_ms"]
+        else None)
+    out["throughput_speedup"] = g4(
+        out["pipelined_throughput_per_sec"]
+        / out["serial_throughput_per_sec"])
+    out["cross_engine_bit_identical"] = bool(cross)
+    frac = (out["serial_futures_resolved_fraction"]
+            + out["pipelined_futures_resolved_fraction"]) / 2
+    out["futures_resolved_fraction"] = float(f"{frac:.6g}")
+    # The depth-1 serial-equivalence contract, observed: a serial span
+    # never carries the optional "staged" stage, a pipelined one does.
+    def _has_pipeline_stage(table):
+        return any("pipeline_p50_ms" in cell
+                   for cell in table["by_bucket_tier"].values())
+    out["serial_telemetry_serial_shape"] = (
+        not _has_pipeline_stage(out["serial_stage_table"]))
+    out["pipelined_overlap_observed"] = _has_pipeline_stage(
+        out["pipelined_stage_table"])
+    out["serial_flight_record"] = flight_record(
+        sides["serial"]["tracer"], sides["serial"]["eng"].counters,
+        reason="dispatch_pipeline_serial_leg")
+    out["flight_record"] = flight_record(
+        sides["pipelined"]["tracer"], sides["pipelined"]["eng"].counters,
+        reason="dispatch_pipeline_drill_complete")
+    return out
+
+
 def precision_bench_run(
     params,
     *,

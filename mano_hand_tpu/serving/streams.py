@@ -42,6 +42,12 @@ frame is an ordinary engine request, so a CPU-failover frame is
 bit-identical to a direct CPU call (the PR-3 contract), and — because
 the fit runs BEFORE dispatch and never touches the chaos-wrapped
 executables — the warm start stays valid through any serving fault.
+The PR-17 dispatch pipeline composes the same way: at
+``inflight_depth > 1`` a frame's future may resolve on the engine's
+completion-stage thread rather than the dispatcher, which is invisible
+here because frames are ordinary requests and the manager's single
+lock is thread-agnostic — per-frame FIFO within a session still holds
+(the stage completes strictly in launch order).
 
 Locking: the ``StreamManager`` owns ONE lock guarding the registry and
 every session's lifecycle fields (terminal kind, in-flight frame table,

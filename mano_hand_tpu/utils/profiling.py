@@ -174,6 +174,15 @@ class ServingCounters:
         # expired (timed out).
         self.cancelled = 0
         self.backlog_peak = 0      # max outstanding requests seen at submit
+        # Pipelined dispatch (PR 17): completions counts batches the
+        # bounded completion stage resolved (0 = serial depth-1 or
+        # lane mode), the peak is the stage's in-flight high-water
+        # (launched-but-unresolved batches; bounded by
+        # ``inflight_depth``), and presweeps counts batches the stage's
+        # deadline re-check expired WHOLE without buying device time.
+        self.pipeline_completions = 0
+        self.pipeline_inflight_peak = 0
+        self.pipeline_presweeps = 0
         # Tiered subject store (PR 16): per-tier resolutions — hot (a
         # batch's digest already table-resident), warm (host-RAM row
         # promoted), cold (disk page promoted), miss (no tier held the
@@ -295,6 +304,29 @@ class ServingCounters:
         with self._lock:
             if outstanding > self.backlog_peak:
                 self.backlog_peak = outstanding
+
+    # -- pipelined dispatch (PR 17) --------------------------------------
+    def count_pipeline_completion(self, n: int = 1) -> None:
+        """One launched batch resolved by the completion stage (its
+        readback/deliver ran on the stage worker, overlapped with the
+        dispatcher's next assembly)."""
+        with self._lock:
+            self.pipeline_completions += n
+
+    def observe_pipeline_inflight(self, inflight: int) -> None:
+        """Stage occupancy at a submit (queued + resolving), this batch
+        included — the high-water says how much of ``inflight_depth``
+        the traffic actually used."""
+        with self._lock:
+            if inflight > self.pipeline_inflight_peak:
+                self.pipeline_inflight_peak = inflight
+
+    def count_pipeline_presweep(self, n: int = 1) -> None:
+        """One batch the stage's deadline re-check expired WHOLE before
+        its dispatch — stage queue time ate the last deadline, and no
+        device time was spent on a result nobody would read."""
+        with self._lock:
+            self.pipeline_presweeps += n
 
     def count_dispatch(self, bucket: int, live_rows: int,
                        requests: int = 1, subjects: int = 1) -> None:
@@ -497,6 +529,9 @@ class ServingCounters:
                 "expired": self.expired,
                 "cancelled": self.cancelled,
                 "backlog_peak": self.backlog_peak,
+                "pipeline_completions": self.pipeline_completions,
+                "pipeline_inflight_peak": self.pipeline_inflight_peak,
+                "pipeline_presweeps": self.pipeline_presweeps,
                 "subject_store_hot_hits": self.subject_store_hot_hits,
                 "subject_store_warm_hits": self.subject_store_warm_hits,
                 "subject_store_cold_hits": self.subject_store_cold_hits,

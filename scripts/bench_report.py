@@ -1395,6 +1395,130 @@ def main() -> int:
             print(f"  [info] subject_store (off-chip, ratio "
                   f"unjudged): {msg}")
 
+    def judge_dispatch_pipeline(dp):
+        """Done-criteria of the pipelined-dispatch drill (config20,
+        PR 17): at matched saturated load the pipelined engine's queue
+        p50 beats serial by >= 1.5x and its drain throughput by >=
+        1.2x, every leg bit-identical to the plain reference AND
+        bit-identical across the two engines (pipelining reorders
+        work, never results), zero steady recompiles on BOTH engines,
+        every future resolved, every span closed exactly once on both
+        sides (the chaos leg's in-flight faults included), the chaos
+        faults absorbed by retries, the depth-1 serial engine's
+        telemetry free of pipeline stages (the serial-equivalence
+        contract, observed) and the pipelined engine's overlap
+        actually recorded. All CPU-defined: the device round-trip is
+        the chaos module's documented slow-device throttle, so the
+        host/device overlap being bought is real on every backend."""
+        q50x = dp.get("queue_p50_speedup")
+        check("dispatch_pipeline_queue_p50_15x",
+              q50x is not None and q50x >= 1.5,
+              f"queue p50 {dp.get('serial_queue_p50_ms')} ms serial vs "
+              f"{dp.get('pipelined_queue_p50_ms')} ms pipelined "
+              f"({q50x}x, bar 1.5x; p99 "
+              f"{dp.get('serial_queue_p99_ms')} vs "
+              f"{dp.get('pipelined_queue_p99_ms')} ms) at matched "
+              f"saturated load {dp.get('paced_rate_per_sec')} req/s "
+              f"({dp.get('pace_factor')} x pipelined capacity)")
+        thrx = dp.get("throughput_speedup")
+        check("dispatch_pipeline_throughput_12x",
+              thrx is not None and thrx >= 1.2,
+              f"drain capacity {dp.get('serial_throughput_per_sec')} "
+              f"serial vs {dp.get('pipelined_throughput_per_sec')} "
+              f"pipelined req/s ({thrx}x, bar 1.2x) over "
+              f"{dp.get('trials')} interleaved trials of "
+              f"{dp.get('calibrate_requests')} requests, depth "
+              f"{dp.get('pipeline_depth')}, device rtt "
+              f"{dp.get('device_rtt_s')}s")
+        errs = {k: dp.get(k) for k in (
+            f"{s}_{leg}_vs_reference_max_abs_err"
+            for s in ("serial", "pipelined")
+            for leg in ("drain", "steady", "chaos"))}
+        check("dispatch_pipeline_bit_identical",
+              all(v == 0.0 for v in errs.values())
+              and dp.get("cross_engine_bit_identical") is True,
+              f"max abs err vs the plain reference {errs}, cross-engine "
+              f"bit-identical {dp.get('cross_engine_bit_identical')} "
+              "(bar: 0.0 every leg, both engines, and byte-equal "
+              "results across them)")
+        check("dispatch_pipeline_zero_steady_recompiles",
+              dp.get("serial_steady_recompiles") == 0
+              and dp.get("pipelined_steady_recompiles") == 0,
+              f"serial {dp.get('serial_steady_recompiles')} / pipelined "
+              f"{dp.get('pipelined_steady_recompiles')} steady "
+              "recompiles (staging slabs and the completion stage must "
+              "not perturb compiled shapes)")
+        frac = dp.get("futures_resolved_fraction")
+        oc_s = dp.get("serial_outcomes") or {}
+        oc_p = dp.get("pipelined_outcomes") or {}
+        check("dispatch_pipeline_all_resolved",
+              frac == 1.0 and oc_s.get("stranded") == 0
+              and oc_p.get("stranded") == 0,
+              f"fraction {frac} resolved (serial "
+              f"ok/err/expired/cancelled/stranded: {oc_s.get('ok')}/"
+              f"{oc_s.get('error')}/{oc_s.get('expired')}/"
+              f"{oc_s.get('cancelled')}/{oc_s.get('stranded')}; "
+              f"pipelined: {oc_p.get('ok')}/{oc_p.get('error')}/"
+              f"{oc_p.get('expired')}/{oc_p.get('cancelled')}/"
+              f"{oc_p.get('stranded')})")
+        check("dispatch_pipeline_chaos_absorbed",
+              (dp.get("pipelined_chaos_retries") or 0) >= 1
+              and (dp.get("pipelined_chaos_faults_injected") or 0) >= 1,
+              f"chaos leg: {dp.get('pipelined_chaos_faults_injected')} "
+              f"faults injected on in-flight batches, "
+              f"{dp.get('pipelined_chaos_retries')} retries absorbed "
+              f"them (serial side: "
+              f"{dp.get('serial_chaos_faults_injected')}/"
+              f"{dp.get('serial_chaos_retries')})")
+        check("dispatch_pipeline_depth1_serial_shape",
+              dp.get("serial_telemetry_serial_shape") is True,
+              "depth-1 engine's steady spans carry no pipeline stage "
+              "(the serial-equivalence contract: depth 1 IS the old "
+              "serial cycle, telemetry shape included) — observed "
+              f"{dp.get('serial_telemetry_serial_shape')}")
+        check("dispatch_pipeline_overlap_observed",
+              dp.get("pipelined_overlap_observed") is True
+              and (dp.get("pipelined_pipeline_inflight_peak") or 0) >= 2,
+              f"pipelined spans record the staged->dispatch overlap "
+              f"({dp.get('pipelined_overlap_observed')}), in-flight "
+              f"peak {dp.get('pipelined_pipeline_inflight_peak')} "
+              f"(depth {dp.get('pipeline_depth')}), "
+              f"{dp.get('pipelined_pipeline_completions')} batches "
+              "through the completion stage")
+        # Span accounting for BOTH engines: judge_flight_record owns
+        # the started==closed/zero-open check; the serial side's
+        # record rides under its own key, so wrap it.
+        judge_flight_record("dispatch_pipeline", dp)
+        judge_flight_record(
+            "dispatch_pipeline_serial",
+            {"flight_record": dp.get("serial_flight_record")})
+
+        def p50(cell, stage):
+            x = cell.get(f"{stage}_p50_ms")
+            return "?" if x is None else f"{x:.2f}"
+
+        for side in ("serial", "pipelined"):
+            tbl = dp.get(f"{side}_stage_table") or {}
+            cells = tbl.get("by_bucket_tier") or {}
+            brief = {k: (f"q{p50(v, 'queue')}/s{p50(v, 'pipeline')}/"
+                         f"d{p50(v, 'device')}/r{p50(v, 'readback')}"
+                         " ms p50")
+                     for k, v in cells.items()}
+            print(f"  [info] dispatch_pipeline: {side} steady-leg "
+                  f"stage table over {tbl.get('complete_spans')} "
+                  f"complete spans — {brief}")
+
+    if "queue_p50_speedup" in line and "metric" not in line:
+        # A raw dispatch_pipeline_drill_run artifact (no bench.py
+        # envelope): only the config20 criteria apply — checked BEFORE
+        # the recovery raw key, which this artifact also carries
+        # (futures_resolved_fraction), same pattern as the lane drill.
+        judge_dispatch_pipeline(line)
+        bad = [n for n, ok in checks if not ok]
+        print("RESULT: " + ("DISPATCH-PIPELINE CRITERIA PASS" if not bad
+                            else f"failing: {', '.join(bad)}"))
+        return 0 if not bad else 1
+
     if ("hot_tier_hit_rate" in line and "metric" not in line):
         # A raw subject_store_drill_run artifact (no bench.py
         # envelope): only the config19 criteria apply — checked BEFORE
@@ -1606,6 +1730,14 @@ def main() -> int:
             check("subject_store_leg_ran", False,
                   f"config19_subject_store crashed: "
                   f"{line['config_errors']['config19_subject_store']}")
+        dp = detail.get("dispatch_pipeline")
+        if dp:
+            judge_dispatch_pipeline(dp)
+        elif "config20_dispatch_pipeline" in (line.get("config_errors")
+                                              or {}):
+            check("dispatch_pipeline_leg_ran", False,
+                  f"config20_dispatch_pipeline crashed: "
+                  f"{line['config_errors']['config20_dispatch_pipeline']}")
         bad = [n for n, ok in checks if not ok]
         print("RESULT: " + ("SERVING CRITERIA PASS" if not bad
                             else f"failing: {', '.join(bad)}"))
@@ -1777,6 +1909,19 @@ def main() -> int:
         check("subject_store_leg_ran", False,
               f"config19_subject_store crashed: "
               f"{line['config_errors']['config19_subject_store']}")
+
+    dpl = detail.get("dispatch_pipeline")
+    if dpl:
+        # Pipelined-dispatch drill (config20, PR 17) — same presence
+        # rule: judge it wherever it ran (the device round-trip is the
+        # chaos module's slow-device throttle, so the overlap criteria
+        # are CPU-defined and hold on every backend).
+        judge_dispatch_pipeline(dpl)
+    elif "config20_dispatch_pipeline" in (line.get("config_errors")
+                                          or {}):
+        check("dispatch_pipeline_leg_ran", False,
+              f"config20_dispatch_pipeline crashed: "
+              f"{line['config_errors']['config20_dispatch_pipeline']}")
 
     spec = detail.get("specialization")
     cfg_errs = line.get("config_errors") or {}

@@ -1,0 +1,29 @@
+"""Fixture: violates `device-under-completion-lock` (parsed, never run)."""
+import threading
+
+import jax
+import numpy as np
+
+
+class Stage:
+    def __init__(self):
+        self._completion_lock = threading.Condition()
+        self._items = []
+
+    def bad_worker(self, batch):
+        with self._completion_lock:
+            item = self._items.pop()
+            out = jax.device_put(batch)              # device work in hold
+            jax.block_until_ready(out)               # and a device wait
+        return np.asarray(out), item
+
+    def fine_worker(self, fn):
+        with self._completion_lock:
+            item = self._items.pop()                 # bookkeeping only
+        out = fn()                                   # dispatch OUTSIDE
+        return np.asarray(out), item                 # readback OUTSIDE
+
+    def fine_pragma(self, shaped):
+        with self._completion_lock:
+            # analysis: allow(device-under-completion-lock)
+            return jax.device_put(shaped)

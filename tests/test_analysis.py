@@ -128,6 +128,29 @@ def test_device_under_install_lock_fires_spares_staging_and_pragma():
             if f.rule == "device-under-exe-lock"] == [39]
 
 
+def test_device_under_completion_lock_fires_spares_leaf_use():
+    """Satellite (PR 17): the `device-under-completion-lock` policy
+    variant — device calls inside a ``_completion_lock`` hold fire
+    (the dispatcher backpressures and stop()/drain() wait on that
+    Condition, so a tunneled RPC here wedges serving AND shutdown);
+    the stage's real pattern (pop under the lock, dispatch/readback
+    OUTSIDE) is clean; a pragma'd site silences."""
+    findings, _ = _lint_fixture("bad_device_under_completion_lock.py")
+    assert _rules(findings) == ["device-under-completion-lock"]
+    assert sorted(f.line for f in findings) == [16, 17]
+
+
+def test_completion_lock_rule_head_is_clean():
+    """HEAD's engine (the module the rule was written for) carries NO
+    device work under `_completion_lock` and needs no pragma — the
+    leaf-lock contract the _CompletionStage docstring states, pinned
+    by the linter."""
+    eng = REPO_ROOT / "mano_hand_tpu" / "serving" / "engine.py"
+    assert [f for f in lint_paths([eng], root=REPO_ROOT)
+            if f.rule == "device-under-completion-lock"] == []
+    assert "allow(device-under-completion-lock)" not in eng.read_text()
+
+
 def test_install_lock_rule_head_is_clean_or_audited():
     """HEAD carries exactly one audited install-lock device site: the
     engine's documented bake-and-swap (pragma'd); serving/lanes.py —

@@ -1486,6 +1486,201 @@ def test_subject_store_block(tmp_path):
     assert "[FAIL] subject_store_leg_ran" in p.stdout
 
 
+def _dispatch_pipeline_block(**over):
+    def fr(reason):
+        return {"schema": 1, "reason": reason,
+                "accounting": {"spans_started": 240, "spans_closed": 240,
+                               "spans_open": 0, "spans_double_closed": 0,
+                               "closed_by_kind": {"ok": 238,
+                                                  "cancelled": 2},
+                               "events_dropped": 0, "incidents": 0}}
+
+    def table(pipelined):
+        cell = {"n": 60, "queue_p50_ms": 2.7, "queue_p99_ms": 6.8,
+                "device_p50_ms": 0.1, "readback_p50_ms": 0.01}
+        if pipelined:
+            cell = dict(cell, pipeline_p50_ms=3.6, queue_p50_ms=1.5,
+                        queue_p99_ms=3.2)
+        return {"complete_spans": 118, "by_bucket_tier":
+                {"b16/tier0": dict(cell)}}
+
+    art = {
+        "requests_steady": 240, "requests_chaos": 48,
+        "calibrate_requests": 128, "trials": 5, "subjects": 6,
+        "max_bucket": 16, "pipeline_depth": 2, "device_rtt_s": 0.0015,
+        "pace_factor": 0.9,
+        "serial_capacity_per_sec": 2800.0,
+        "pipelined_capacity_per_sec": 4900.0,
+        "paced_rate_per_sec": 4410.0,
+        "serial_queue_p50_ms": 12.0, "serial_queue_p99_ms": 30.0,
+        "pipelined_queue_p50_ms": 1.5, "pipelined_queue_p99_ms": 4.0,
+        "serial_throughput_per_sec": 2800.0,
+        "pipelined_throughput_per_sec": 4900.0,
+        "serial_paced_throughput_per_sec": 2800.0,
+        "pipelined_paced_throughput_per_sec": 4400.0,
+        "serial_steady_recompiles": 0, "pipelined_steady_recompiles": 0,
+        "serial_warmup_compiles": 12, "pipelined_warmup_compiles": 12,
+        "serial_futures_resolved_fraction": 1.0,
+        "pipelined_futures_resolved_fraction": 1.0,
+        "futures_resolved_fraction": 1.0,
+        "serial_outcomes": {"ok": 1878, "error": 0, "expired": 0,
+                            "stranded": 0, "cancelled": 10},
+        "pipelined_outcomes": {"ok": 1878, "error": 0, "expired": 0,
+                               "stranded": 0, "cancelled": 10},
+        "serial_drain_vs_reference_max_abs_err": 0.0,
+        "serial_steady_vs_reference_max_abs_err": 0.0,
+        "serial_chaos_vs_reference_max_abs_err": 0.0,
+        "pipelined_drain_vs_reference_max_abs_err": 0.0,
+        "pipelined_steady_vs_reference_max_abs_err": 0.0,
+        "pipelined_chaos_vs_reference_max_abs_err": 0.0,
+        "serial_chaos_retries": 2, "serial_chaos_faults_injected": 4,
+        "pipelined_chaos_retries": 2,
+        "pipelined_chaos_faults_injected": 4,
+        "queue_p50_speedup": 8.0, "throughput_speedup": 1.75,
+        "cross_engine_bit_identical": True,
+        "serial_telemetry_serial_shape": True,
+        "pipelined_overlap_observed": True,
+        "serial_pipeline_inflight_peak": 1,
+        "pipelined_pipeline_inflight_peak": 2,
+        "serial_pipeline_completions": 0,
+        "pipelined_pipeline_completions": 120,
+        "serial_stage_table": table(False),
+        "pipelined_stage_table": table(True),
+        "serial_spans": {"started": 240, "closed": 240, "open": 0,
+                         "closed_by_kind": {"ok": 238, "cancelled": 2}},
+        "pipelined_spans": {"started": 240, "closed": 240, "open": 0,
+                            "closed_by_kind": {"ok": 238,
+                                               "cancelled": 2}},
+        "serial_flight_record": fr("dispatch_pipeline_serial_leg"),
+        "flight_record": fr("dispatch_pipeline_drill_complete"),
+    }
+    art.update(over)
+    return art
+
+
+@pytest.mark.slow
+def test_dispatch_pipeline_block(tmp_path):
+    """The config20 judge (PR 17): a raw dispatch-pipeline artifact
+    passes whole, each criterion fails alone (both engines' flight
+    records included), the stage table prints as evidence, and the
+    block judges inside a serving-only envelope too (incl. the
+    crashed-leg fallback)."""
+    dp = _dispatch_pipeline_block()
+    raw = tmp_path / "dp_raw.json"
+    raw.write_text(json.dumps(dp))
+    p = _run(str(raw))
+    assert p.returncode == 0, p.stdout
+    for name in ("dispatch_pipeline_queue_p50_15x",
+                 "dispatch_pipeline_throughput_12x",
+                 "dispatch_pipeline_bit_identical",
+                 "dispatch_pipeline_zero_steady_recompiles",
+                 "dispatch_pipeline_all_resolved",
+                 "dispatch_pipeline_chaos_absorbed",
+                 "dispatch_pipeline_depth1_serial_shape",
+                 "dispatch_pipeline_overlap_observed",
+                 "dispatch_pipeline_spans_closed_once",
+                 "dispatch_pipeline_serial_spans_closed_once"):
+        assert f"[PASS] {name}" in p.stdout, (name, p.stdout)
+    assert "DISPATCH-PIPELINE CRITERIA PASS" in p.stdout
+    # The per-bucket stage table rides as evidence, both sides.
+    assert "serial steady-leg stage table" in p.stdout
+    assert "pipelined steady-leg stage table" in p.stdout
+    # Not misrouted into the recovery judge (shared raw key).
+    assert "RECOVERY CRITERIA" not in p.stdout
+
+    bad_fr = _dispatch_pipeline_block()
+    bad_fr["serial_flight_record"]["accounting"]["spans_open"] = 1
+    cases = [
+        (dict(queue_p50_speedup=1.2), "dispatch_pipeline_queue_p50_15x"),
+        (dict(throughput_speedup=1.1),
+         "dispatch_pipeline_throughput_12x"),
+        (dict(pipelined_chaos_vs_reference_max_abs_err=1e-6),
+         "dispatch_pipeline_bit_identical"),
+        (dict(cross_engine_bit_identical=False),
+         "dispatch_pipeline_bit_identical"),
+        (dict(pipelined_steady_recompiles=3),
+         "dispatch_pipeline_zero_steady_recompiles"),
+        (dict(futures_resolved_fraction=0.99),
+         "dispatch_pipeline_all_resolved"),
+        (dict(pipelined_outcomes=dict(dp["pipelined_outcomes"],
+                                      stranded=1)),
+         "dispatch_pipeline_all_resolved"),
+        (dict(pipelined_chaos_retries=0),
+         "dispatch_pipeline_chaos_absorbed"),
+        (dict(serial_telemetry_serial_shape=False),
+         "dispatch_pipeline_depth1_serial_shape"),
+        (dict(pipelined_overlap_observed=False),
+         "dispatch_pipeline_overlap_observed"),
+        (dict(pipelined_pipeline_inflight_peak=1),
+         "dispatch_pipeline_overlap_observed"),
+        (bad_fr, "dispatch_pipeline_serial_spans_closed_once"),
+    ]
+    for over, name in cases:
+        raw.write_text(json.dumps(
+            over if "flight_record" in over
+            else _dispatch_pipeline_block(**over)))
+        p = _run(str(raw))
+        assert p.returncode == 1, (name, p.stdout)
+        assert f"[FAIL] {name}" in p.stdout, (name, p.stdout)
+
+    # Inside a serving-only envelope; a crashed config20 leg must fail
+    # loudly, not vanish.
+    env = {"metric": "serving_engine_evals_per_sec", "value": 1.0,
+           "unit": "evals/s", "device": "cpu",
+           "detail": {"serving": {"engine_vs_direct_ratio": 1.0,
+                                  "steady_recompiles": 0},
+                      "dispatch_pipeline": _dispatch_pipeline_block()}}
+    art = tmp_path / "serving_only.json"
+    art.write_text(json.dumps(env))
+    p = _run(str(art))
+    assert p.returncode == 0, p.stdout
+    assert "[PASS] dispatch_pipeline_queue_p50_15x" in p.stdout
+    del env["detail"]["dispatch_pipeline"]
+    env["config_errors"] = {"config20_dispatch_pipeline":
+                            "RuntimeError: boom"}
+    art.write_text(json.dumps(env))
+    p = _run(str(art))
+    assert p.returncode == 1
+    assert "[FAIL] dispatch_pipeline_leg_ran" in p.stdout
+
+
+def test_history_queue_latency_regression_fails_by_name(tmp_path):
+    """The PR-17 `--history` satellite: the dispatch-pipeline block's
+    ``*_queue_p50_ms``/``*_queue_p99_ms`` keys are picked up
+    automatically as LOWER-is-better — a fresh artifact whose
+    pipelined queue p50 rose past tolerance fails by the nested key's
+    name, while a lower (improved) quantile passes."""
+    def env(p50, p99):
+        return {"metric": "mano_forward_evals_per_sec", "value": 10e6,
+                "device": "cpu:cpu",
+                "detail": {"dispatch_pipeline": {
+                    "pipelined_throughput_per_sec": 4900.0,
+                    "serial_queue_p50_ms": 12.0,
+                    "serial_queue_p99_ms": 30.0,
+                    "pipelined_queue_p50_ms": p50,
+                    "pipelined_queue_p99_ms": p99}}}
+    pp, fp = tmp_path / "prior.json", tmp_path / "fresh.json"
+    pp.write_text(json.dumps(env(1.5, 4.0)))
+    fp.write_text(json.dumps(env(3.5, 3.0)))
+    p = _run(str(fp), "--history", str(pp))
+    assert p.returncode == 1, p.stdout
+    # The risen p50 fails BY NAME with the inverted sense; the
+    # improved p99 and the unchanged serial keys pass.
+    assert ("[FAIL] dispatch_pipeline.pipelined_queue_p50_ms"
+            in p.stdout)
+    assert "lower is better" in p.stdout
+    assert ("[PASS] dispatch_pipeline.pipelined_queue_p99_ms"
+            in p.stdout)
+    assert ("[PASS] dispatch_pipeline.serial_queue_p50_ms"
+            in p.stdout)
+    assert "PERF REGRESSION" in p.stdout
+    # The same artifacts inside tolerance pass.
+    p = _run(str(fp), "--history", str(pp),
+             "--history-tolerance", "1.5")
+    assert p.returncode == 0, p.stdout
+    assert "PERF NO-REGRESSION" in p.stdout
+
+
 def test_history_error_envelope_judged_absolutely(tmp_path):
     """The PR-14 `--history` satellite: a ``*_max_abs_err`` key with a
     sibling stated ``*_err_envelope`` bound is judged ABSOLUTELY

@@ -291,7 +291,13 @@ def test_deadline_expires_while_parked(params32):
     pol = DispatchPolicy(deadline_s=None, retries=0, jitter=0.0,
                          chaos=chaos.ChaosPlan("sat:0.15@0"),
                          cpu_fallback=False)
-    eng = ServingEngine(params32, max_bucket=4, policy=pol)
+    # depth 1: at the default pipeline depth the parked request would
+    # overlap the slow predecessor and dispatch in time (the PR-17
+    # feature) — the park sweep under test is the serial-cycle path;
+    # the pipelined equivalent (stage-queue presweep) is covered in
+    # tests/test_pipeline.py.
+    eng = ServingEngine(params32, max_bucket=4, policy=pol,
+                        inflight_depth=1)
     eng.warmup()
     with _held(eng):
         first = eng.submit(_pose(3, seed=1))

@@ -1,0 +1,322 @@
+"""Pipelined dispatch (the PR-17 tentpole), CPU-verified.
+
+The completion-stage invariants that let batch N+1 assemble and launch
+while batch N executes, without changing a single observable result:
+
+* every chaos fault class landing on an IN-FLIGHT batch (the supervised
+  envelope runs on a completion worker) resolves through the same
+  ladder as the serial path — errors retried, hangs deadline-killed and
+  failed over, wrong output passed through silently (detection is the
+  sentinel's job, tests/test_metrics.py) — with no stranded futures and
+  every span closed exactly once;
+* ``stop(timeout_s=...)`` sweeps batches wedged INSIDE the stage (hung
+  device RPC on a worker) and batches parked behind its backpressure;
+* the PR-5 deadline sweeps compose with the stage: a batch whose whole
+  membership expires while queued BETWEEN launch and its completion
+  worker is presweeped — resolved expired without costing a dispatch;
+* results are bit-identical at every depth (the staged-slab assembly
+  reproduces the legacy concatenate+pad bytes), and depth 1 IS the old
+  serial cycle — no stage, no "staged" stamps, no pipeline telemetry
+  (the serial-equivalence contract, README "Dispatch pipeline");
+* the EDF parked-queue order and the adaptive coalesce window (the two
+  PR-17 scheduling satellites) follow their stated formulas.
+
+All faults are injected in-process (runtime/chaos.py); no chip needed.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from mano_hand_tpu.obs.trace import Tracer
+from mano_hand_tpu.runtime import chaos
+from mano_hand_tpu.runtime.supervise import DispatchPolicy
+from mano_hand_tpu.serving.engine import (
+    ServingEngine,
+    ServingError,
+    _Request,
+)
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+def _pose(n=1, seed=0):
+    return np.random.default_rng(seed).normal(
+        scale=0.4, size=(n, 16, 3)).astype(np.float32)
+
+
+class _held:
+    """Hold the dispatcher off (the prestuffed trick from
+    tests/test_overload.py) so queue/stage composition is
+    deterministic, then release it on exit."""
+
+    def __init__(self, eng):
+        self.eng = eng
+
+    def __enter__(self):
+        self.eng.start = lambda: self.eng
+        return self.eng
+
+    def __exit__(self, *exc):
+        del self.eng.start          # restore the class method
+        self.eng.start()
+
+
+def _supervised(plan, *, deadline_s=30.0, retries=0, cpu_fallback=False):
+    return DispatchPolicy(deadline_s=deadline_s, retries=retries,
+                          backoff_s=0.0, backoff_cap_s=0.0, jitter=0.0,
+                          chaos=plan, cpu_fallback=cpu_fallback)
+
+
+# ----------------------------------- chaos composition through the stage
+def test_error_on_inflight_batch_is_retried(params32):
+    """A transient ``error`` fault fires on the completion worker (the
+    batch is in flight by construction at depth 2) and the supervised
+    retry absorbs it: the future resolves ok, the retry and fault are
+    counted, and the span still closes exactly once."""
+    plan = chaos.ChaosPlan()
+    tr = Tracer()
+    eng = ServingEngine(params32, max_bucket=4, max_delay_s=0.0,
+                        inflight_depth=2, tracer=tr,
+                        policy=_supervised(plan, retries=1))
+    with eng:
+        eng.warmup()
+        clean = eng.submit(_pose(2)).result(timeout=30)
+        plan.schedule("error@0")
+        out = eng.submit(_pose(2)).result(timeout=30)
+    np.testing.assert_array_equal(out, clean)   # retry, not a re-roll
+    assert eng.counters.faults_injected == 1
+    assert eng.counters.retries == 1
+    acc = tr.accounting()
+    assert acc["spans_started"] == acc["spans_closed"]
+    assert acc["spans_open"] == 0
+
+
+def test_hang_on_inflight_batch_fails_over(params32):
+    """A ``hang`` fault wedges the in-flight batch's primary attempt on
+    the completion worker: the deadline watchdog kills it and the CPU
+    failover serves the batch — counted, resolved, span closed."""
+    plan = chaos.ChaosPlan()
+    tr = Tracer()
+    eng = ServingEngine(params32, max_bucket=4, max_delay_s=0.0,
+                        inflight_depth=2, tracer=tr,
+                        policy=_supervised(plan, deadline_s=0.3,
+                                           cpu_fallback=True))
+    try:
+        with eng:
+            eng.warmup()
+            plan.schedule("hang@0")
+            out = eng.submit(_pose(2)).result(timeout=30)
+    finally:
+        plan.release.set()        # let the abandoned hang thread exit
+    assert out.shape == (2, 778, 3)
+    assert np.isfinite(out).all()
+    assert eng.counters.failovers == 1
+    assert eng.counters.deadline_kills == 1
+    acc = tr.accounting()
+    assert acc["spans_started"] == acc["spans_closed"]
+    assert acc["spans_open"] == 0
+
+
+def test_wrong_output_on_inflight_batch_passes_through(params32):
+    """A silent ``wrong`` fault on the in-flight batch resolves
+    "successfully" with skewed floats — the pipeline must not mask OR
+    detect it (detection is the numerics sentinel's job, PR 9) and the
+    span accounting must not notice anything happened."""
+    plan = chaos.ChaosPlan()
+    tr = Tracer()
+    eng = ServingEngine(params32, max_bucket=4, max_delay_s=0.0,
+                        inflight_depth=2, tracer=tr,
+                        policy=_supervised(plan))
+    with eng:
+        eng.warmup()
+        clean = eng.submit(_pose(2)).result(timeout=30)
+        plan.schedule("wrong:1.0@0")
+        skewed = eng.submit(_pose(2)).result(timeout=30)
+    assert np.max(np.abs(skewed - clean)) == pytest.approx(1.0, rel=1e-4)
+    assert eng.counters.faults_injected == 1
+    acc = tr.accounting()
+    assert acc["spans_started"] == acc["spans_closed"]
+    assert acc["spans_open"] == 0
+
+
+def test_stop_timeout_sweeps_batches_wedged_in_stage(params32):
+    """``stop(timeout_s=...)`` on an engine whose completion workers
+    are wedged inside hung device RPCs: the wedged batches AND the
+    batch parked behind the stage's backpressure all resolve with the
+    structured shutdown error — no caller blocks forever, no future
+    strands (the kill -9 rule leaves the threads abandoned)."""
+    plan = chaos.ChaosPlan()
+    eng = ServingEngine(params32, max_bucket=2, max_delay_s=0.0,
+                        inflight_depth=2,
+                        policy=_supervised(plan, deadline_s=None))
+    try:
+        with _held(eng):
+            plan.schedule("hang@0-")
+            # Three 2-row batches at max_bucket=2: two wedge the two
+            # completion workers, the third wedges the dispatcher in
+            # the stage's backpressure wait.
+            futs = [eng.submit(_pose(2, seed=i)) for i in range(3)]
+        time.sleep(0.3)           # let both workers enter the hang
+        eng.stop(timeout_s=0.5)
+        for f in futs:
+            with pytest.raises(ServingError) as ei:
+                f.result(timeout=30)
+            assert ei.value.phase == "shutdown"
+    finally:
+        plan.release.set()
+
+
+def test_stage_queue_presweep_skips_wholly_expired_batch(params32):
+    """The PR-5 deadline sweeps compose with the stage: a batch whose
+    every member expires while it waits BETWEEN launch and a free
+    completion worker is presweeped — resolved expired, counted, and
+    never costs a dispatch (the last zero-device-time boundary)."""
+    plan = chaos.ChaosPlan()
+    eng = ServingEngine(params32, max_bucket=2, max_delay_s=0.0,
+                        inflight_depth=2,
+                        policy=_supervised(plan, deadline_s=30.0))
+    with eng:
+        eng.warmup()
+        plan.schedule("sat:0.5@*")
+        with _held(eng):
+            # Batches 1+2 occupy both workers for ~0.5 s; batch 3's
+            # 0.35 s deadline lapses while it waits for a stage slot
+            # (it outlives every PRE-launch sweep by construction).
+            f1 = eng.submit(_pose(2, seed=1))
+            f2 = eng.submit(_pose(2, seed=2))
+            f3 = eng.submit(_pose(2, seed=3), deadline_s=0.35)
+        assert f1.result(timeout=30).shape == (2, 778, 3)
+        assert f2.result(timeout=30).shape == (2, 778, 3)
+        with pytest.raises(ServingError) as ei:
+            f3.result(timeout=30)
+    assert ei.value.kind == "expired"
+    snap = eng.counters.snapshot()
+    assert snap["pipeline_presweeps"] == 1
+    assert eng.counters.expired == 1
+    assert eng.counters.dispatches == 2      # the swept batch cost none
+
+
+# --------------------------------------------- bit-identity across depths
+def test_results_bit_identical_across_depths(params32):
+    """The tentpole's correctness bar in miniature: staged-slab
+    assembly + pipelined resolution reorder WORK, never results — the
+    same ragged request set resolves byte-for-byte equal at depth 1
+    (legacy serial cycle) and depth 3 (stage + adaptive window)."""
+    rng = np.random.default_rng(7)
+    poses = [_pose(int(rng.integers(1, 4)), seed=100 + i)
+             for i in range(12)]
+    outs = {}
+    for depth, adaptive in ((1, False), (3, True)):
+        eng = ServingEngine(params32, max_bucket=8, max_delay_s=0.002,
+                            adaptive_coalesce=adaptive,
+                            inflight_depth=depth)
+        with eng:
+            eng.warmup()
+            futs = [eng.submit(p) for p in poses]
+            outs[depth] = [f.result(timeout=30) for f in futs]
+    for a, b in zip(outs[1], outs[3]):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(a, b)
+
+
+# ------------------------------------- depth-1 serial telemetry contract
+def test_depth1_telemetry_has_no_pipeline_shape(params32):
+    """The serial-equivalence contract, observed end-to-end: a depth-1
+    engine's spans never carry the optional "staged" stamp and its
+    pipeline counters stay zero, while a depth-2 engine records both —
+    so depth 1 is byte-for-byte the old serial telemetry, not a
+    pipeline with an empty stage."""
+    stamps = {}
+    for depth in (1, 2):
+        tr = Tracer()
+        eng = ServingEngine(params32, max_bucket=4, max_delay_s=0.0,
+                            inflight_depth=depth, tracer=tr)
+        with eng:
+            eng.warmup()
+            for i in range(6):
+                eng.submit(_pose(2, seed=i)).result(timeout=30)
+        names = {ev[1] for sp in tr.spans() for ev in sp["events"]}
+        snap = eng.counters.snapshot()
+        stamps[depth] = (names, snap["pipeline_completions"],
+                         snap["pipeline_inflight_peak"])
+    names1, completions1, peak1 = stamps[1]
+    assert "staged" not in names1
+    assert completions1 == 0 and peak1 == 0
+    names2, completions2, peak2 = stamps[2]
+    assert "staged" in names2
+    assert completions2 == 6 and peak2 >= 1
+
+
+# ------------------------------------------------- EDF parked-queue order
+@pytest.mark.quick
+def test_pop_parked_is_tier_then_edf(params32):
+    """``_pop_parked``: lowest tier first; within a tier EARLIEST
+    DEADLINE first (EDF — the PR-5 Open item), deadline-less requests
+    after deadlined ones, FIFO among remaining ties."""
+    eng = ServingEngine(params32, max_bucket=4)
+
+    def req(tag, tier, deadline):
+        r = _Request(_pose(), None, 1, True, tier=tier,
+                     deadline=deadline)
+        r.subject = tag              # unused slot, handy label
+        return r
+
+    now = time.monotonic()
+    eng._pending = [
+        req("t1-late", 1, now + 9.0),
+        req("t0-none-a", 0, None),
+        req("t0-late", 0, now + 5.0),
+        req("t1-soon", 1, now + 1.0),
+        req("t0-soon", 0, now + 2.0),
+        req("t0-none-b", 0, None),
+    ]
+    order = [eng._pop_parked().subject for _ in range(6)]
+    assert order == ["t0-soon", "t0-late", "t0-none-a", "t0-none-b",
+                     "t1-soon", "t1-late"]
+
+
+# ------------------------------------------------ adaptive coalesce window
+@pytest.mark.quick
+def test_coalesce_window_pressure_formula(params32):
+    """``_coalesce_window``: full base window when sparse; collapses to
+    zero once the backlog could fill the largest bucket; scales down
+    linearly with backlog below that; decays with head age only at
+    MANY multiples of the base (a one-cycle-old head barely charges —
+    the measured 3x-loss dead-end, docs/roadmap.md PR-17); and
+    ``adaptive_coalesce=False`` pins the legacy fixed window."""
+    base = 0.004
+    eng = ServingEngine(params32, max_bucket=8, max_delay_s=base,
+                        adaptive_coalesce=True)
+    cap = eng.buckets[-1]
+    assert cap == 8
+
+    def head(age=0.0):
+        r = _Request(_pose(), None, 1, True)
+        r.t_submit = time.perf_counter() - age
+        return r
+
+    # Sparse: the full latency/throughput knob.
+    assert eng._coalesce_window(head()) == pytest.approx(base, rel=0.05)
+    # Backlog scales the window down linearly below the collapse point.
+    eng._pending = [object()] * 4
+    assert eng._coalesce_window(head()) == pytest.approx(
+        base * (1 - 4 / cap), rel=0.05)
+    # A backlog that already fills the largest bucket: wait buys nothing.
+    eng._pending = [object()] * (cap - 1)
+    assert eng._coalesce_window(head()) == 0.0
+    eng._pending = []
+    # A one-dispatch-cycle-old head charges only age/(8*base).
+    assert eng._coalesce_window(head(age=base)) == pytest.approx(
+        base * (1 - 1 / 8), rel=0.05)
+    # A congested head (age >= 8x base) collapses the window.
+    assert eng._coalesce_window(head(age=8 * base)) == 0.0
+    # The legacy pin: fixed window regardless of pressure.
+    fixed = ServingEngine(params32, max_bucket=8, max_delay_s=base,
+                          adaptive_coalesce=False)
+    fixed._pending = [object()] * (cap + 4)
+    assert fixed._coalesce_window(head(age=8 * base)) == base
