@@ -24,12 +24,13 @@
 	check-quick serve-smoke specialize-smoke chaos-smoke coalesce-smoke \
 	overload-smoke coldstart-smoke obs-smoke metrics-smoke \
 	posed-kernel-smoke stream-smoke lanes-smoke precision-smoke \
-	edge-smoke subject-store-smoke bench-smoke examples-smoke analyze
+	edge-smoke subject-store-smoke bench-smoke examples-smoke \
+	fleet-smoke analyze
 
 check: analyze test chaos-smoke coalesce-smoke overload-smoke \
 	coldstart-smoke obs-smoke metrics-smoke posed-kernel-smoke \
 	stream-smoke lanes-smoke precision-smoke edge-smoke \
-	subject-store-smoke bench-smoke examples-smoke
+	subject-store-smoke fleet-smoke bench-smoke examples-smoke
 
 # tests/test_runtime.py is excluded here and covered by the chaos-smoke
 # prerequisite instead (its own pytest process + cache dir): `make
@@ -58,6 +59,7 @@ test:
 	  --ignore=tests/test_precision.py \
 	  --ignore=tests/test_edge.py \
 	  --ignore=tests/test_subject_store.py \
+	  --ignore=tests/test_fleet.py \
 	  --ignore=tests/test_examples.py
 
 # Seconds-scale pre-commit lane: the core-correctness modules (parity vs
@@ -145,7 +147,10 @@ bench-interpret:
 	  --edge-bursts 6 --edge-workers 8 --edge-streams 2 --edge-frames 2 \
 	  --subject-store-subjects 300 --subject-store-requests 12 \
 	  --pipeline-requests 24 --pipeline-calibrate 12 \
-	  --pipeline-trials 1 --pipeline-max-bucket 8
+	  --pipeline-trials 1 --pipeline-max-bucket 8 \
+	  --fleet-streams 6 --fleet-frames 3 --fleet-stream-workers 4 \
+	  --fleet-tracks 3 --fleet-max-bucket 4 --fleet-max-subjects 16 \
+	  --fleet-drain-budget 20
 
 # Serving-leg smoke (the bench-interpret counterpart for config7): the
 # whole serving-engine plumbing — bucket warm-up, ragged request stream,
@@ -379,6 +384,26 @@ edge-smoke:
 subject-store-smoke:
 	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_subject_store \
 	  python -m pytest tests/test_subject_store.py -q
+
+# Fleet front tier (the PR-18 tentpole): the edge proxy's health-aware
+# routing over real `mano serve` worker processes — backend dead at
+# connect vs dead mid-response (idempotent re-route only for requests
+# that never dispatched; a failed-after-send forward is 502, never
+# silently retried), 429/Retry-After passing through untouched, live
+# stream migration with a frame IN FLIGHT when the backend dies (the
+# resend-on-dead-backend exception + warm-start bit-equality), the
+# rolling-deploy drain, proxied /healthz aggregation + `mano status
+# --server` against the proxy, the warm-capacity runtime resize, and
+# the config21 drill protocol at plumbing size. Wired into `make
+# check` as a SEPARATE pytest process on its own compile-cache dir
+# (the CLAUDE.md rule: two pytest processes must never share
+# .jax_compile_cache/ — and every worker SUBPROCESS gets its own tmp
+# cache dir inside the tests for the same reason). Slow-marked, so
+# the tier-1 `-m 'not slow'` lane skips it by design (the PR-8 budget
+# precedent).
+fleet-smoke:
+	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_fleet \
+	  python -m pytest tests/test_fleet.py -q
 
 # Every example end-to-end (tiny sizes, CPU) — the public-surface
 # anti-rot gate. Moved out of the tier-1 lane in the PR-13 budget

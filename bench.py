@@ -595,7 +595,8 @@ def run_benchmarks(args, device_str: str) -> dict:
                                               "config17_precision",
                                               "config18_edge",
                                               "config19_subject_store",
-                                              "config20_dispatch_pipeline"):
+                                              "config20_dispatch_pipeline",
+                                              "config21_fleet"):
             return
         try:
             fn()
@@ -2532,6 +2533,58 @@ def run_benchmarks(args, device_str: str) -> dict:
     if args.pipeline_requests > 0:
         section("config20_dispatch_pipeline", config20_dispatch_pipeline)
 
+    # -- config 21: fleet chaos drill (PR 18) -------------------------------
+    # THE rolling-deploy protocol (serving/measure.py:fleet_drill_run):
+    # N real `mano serve` worker PROCESSES cold-booting from a per-lane
+    # executable lattice, fronted by the edge proxy (health-aware
+    # routing + live stream migration), with one worker SIGKILLed
+    # mid-frame-wave and a second drained under the surviving live
+    # streams. Criteria (scripts/bench_report.py:judge_fleet) are all
+    # CPU-defined — workers pin `--platform cpu` and the sockets are
+    # loopback, no chip involved: per-worker cold boot with ZERO jit
+    # compiles at lanes=N (aot_loads > 0), 100% of frames reaching an
+    # HTTP terminal through the chaos, migrated warm starts bit-equal
+    # (pose chains identical fleet-wide AND vs the in-process
+    # reference), drain inside its budget, zero steady recompiles
+    # fleet-wide (exit-line counters minus post-warm baselines), and
+    # every span closed exactly once across process boundaries (the
+    # exit-line accounting of every worker that reported).
+    def config21_fleet():
+        from mano_hand_tpu.serving.measure import fleet_drill_run
+
+        fd = fleet_drill_run(
+            right,
+            workers=args.fleet_workers,
+            lanes=args.fleet_lanes,
+            streams=args.fleet_streams,
+            frames_per_stream=args.fleet_frames,
+            stream_workers=args.fleet_stream_workers,
+            unique_tracks=args.fleet_tracks,
+            max_bucket=args.fleet_max_bucket,
+            max_subjects=args.fleet_max_subjects,
+            drain_budget_s=args.fleet_drain_budget,
+            seed=59,
+            log=lambda m: log(f"config21 {m}"),
+        )
+        results["fleet"] = fd
+        oc = fd["outcomes"]
+        log(f"config21 fleet: {fd['workers']} workers x "
+            f"{fd['lanes']} lanes, cold boot zero-compile "
+            f"{fd['cold_boot_zero_compiles']}, {fd['streams']} streams"
+            f" x {fd['frames_per_stream']} frames -> "
+            f"{fd['terminal_fraction']:.0%} terminal ({oc['ok']} ok / "
+            f"{oc['http_error']} http / {oc['exception']} exc), "
+            f"kill {fd['kill']['victim']} migrated "
+            f"{fd['proxy']['migrated_frames']} in-flight, drain "
+            f"{fd['drain']['wall_s']}s/{fd['drain']['budget_s']}s, "
+            f"pose parity intra {fd['intra_fleet_pose_max_abs_err']} / "
+            f"ref {fd['wire_vs_inprocess_pose_max_abs_err']}, "
+            f"{fd['steady_recompiles_total']} steady recompiles, "
+            f"spans once {fd['spans_closed_exactly_once']}")
+
+    if args.fleet_streams > 0:
+        section("config21_fleet", config21_fleet)
+
     if args.serving_only:
         # Fast serving-layer artifact (`make serve-smoke`): the deferred
         # runner's serving-only skip reduces the schedule to config7
@@ -2984,6 +3037,48 @@ def main() -> int:
                     help="config20's injected per-dispatch device "
                          "round-trip (chaos sat model, the documented "
                          "slow-device stand-in for the TPU tunnel)")
+    ap.add_argument("--fleet-streams", type=int, default=208,
+                    help="live streams of the fleet chaos drill "
+                         "(config21, PR 18: 3 `mano serve` worker "
+                         "processes behind the edge proxy, one "
+                         "SIGKILLed mid-wave + one drained under "
+                         "load; workers pin --platform cpu and "
+                         "sockets are loopback — no chip involved; "
+                         "0 skips the config, and the tiny-e2e bench "
+                         "tests pass 0 to keep subprocess fan-out "
+                         "out of that lane)")
+    ap.add_argument("--fleet-workers", type=int, default=3,
+                    help="config21 worker processes (>= 3: kill one, "
+                         "drain one, serve on the rest)")
+    ap.add_argument("--fleet-lanes", type=int, default=2,
+                    help="dispatch lanes per config21 worker (each "
+                         "worker gets xla_force_host_platform_device_"
+                         "count=N virtual CPU devices; the per-lane "
+                         "lattice must boot every lane with zero "
+                         "re-traces)")
+    ap.add_argument("--fleet-frames", type=int, default=4,
+                    help="frames per config21 stream (>= 3: settle "
+                         "wave + kill wave + drain tail)")
+    ap.add_argument("--fleet-stream-workers", type=int, default=16,
+                    help="client thread pool stepping config21's "
+                         "streams (one persistent connection per "
+                         "stream, one in-flight frame per stream)")
+    ap.add_argument("--fleet-tracks", type=int, default=8,
+                    help="distinct animation tracks of config21 "
+                         "(streams sharing a track must stay "
+                         "BIT-equal fleet-wide — the migration "
+                         "warm-start judgment)")
+    ap.add_argument("--fleet-max-bucket", type=int, default=8,
+                    help="bucket ceiling of config21's workers and "
+                         "reference engine")
+    ap.add_argument("--fleet-max-subjects", type=int, default=32,
+                    help="subject capacity of config21's workers "
+                         "(keeps the sharded per-lane tables small; "
+                         "the per-lane lattice bakes the shard "
+                         "capacity)")
+    ap.add_argument("--fleet-drain-budget", type=float, default=10.0,
+                    help="seconds the config21 rolling-deploy drain "
+                         "must finish within (judged)")
     ap.add_argument("--spec-batch", type=int, default=256,
                     help="batch for the specialization leg's full-vs-"
                          "pose-only forward comparison (config8); "

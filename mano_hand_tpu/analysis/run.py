@@ -71,7 +71,12 @@ def run_analysis(
     # the stream frame-future's cancel-forwarding lock (streams.py's
     # _FrameFuture is covered by the streams pass above; edge/ holds
     # no engine locks, and the policy linter scans it via the package
-    # rglob like every other subsystem).
+    # rglob like every other subsystem). PR 18 grows this glob's scope
+    # to the fleet front tier: edge/proxy.py (loop-thread counters +
+    # drain coordination) and edge/fleet.py (worker supervision) —
+    # tests/test_analysis.py pins both by name, with a seeded
+    # drain/route lock-cycle fixture proving the rule fires on
+    # proxy-shaped code.
     for p in sorted((root / "mano_hand_tpu" / "edge").glob("*.py")):
         locks += check_lock_discipline(p, order=())
     # PR 16: the subject store's one LEAF lock (warm LRU + promotion

@@ -1350,6 +1350,19 @@ def cmd_serve(args) -> int:
     tracer = Tracer()
     tier_quotas = ({1: args.tier1_quota}
                    if args.max_queued and args.tier1_quota else None)
+    # --store-warm-capacity N (PR 18) opts the worker into the PR-16
+    # tiered store with a host-RAM warm tier of N rows (sharded when
+    # the worker runs lanes — the shards ARE the per-lane tables).
+    store = None
+    if getattr(args, "store_warm_capacity", 0):
+        from mano_hand_tpu.serving.subject_store import (
+            SubjectStore,
+            SubjectStoreConfig,
+        )
+
+        store = SubjectStore(SubjectStoreConfig(
+            warm_capacity=int(args.store_warm_capacity),
+            sharded=bool(args.lanes)))
     eng = ServingEngine(
         params,
         max_bucket=args.max_bucket,
@@ -1360,6 +1373,8 @@ def cmd_serve(args) -> int:
         lanes=args.lanes or None,
         posed_kernel=args.posed_kernel,
         tracer=tracer,
+        subject_store=store,
+        max_subjects=args.max_subjects,
     )
     recorder = FlightRecorder(tracer, eng.counters,
                               out_dir=args.flight_dir or None)
@@ -1401,6 +1416,15 @@ def cmd_serve(args) -> int:
                 pass
             report = srv.drain(timeout_s=args.drain_timeout_s)
             report["incident_captures"] = len(recorder.captures)
+            # Cross-process telemetry (PR 18): the fleet drill judges
+            # span-once and zero-steady-recompiles ACROSS workers, so
+            # each worker's exit line carries its own tracer accounting
+            # and compile counters for the supervisor to aggregate.
+            report["accounting"] = tracer.accounting()
+            snap = eng.counters.snapshot()
+            report["counters"] = {
+                k: snap[k] for k in
+                ("compiles", "aot_loads", "aot_load_failures")}
             print(json.dumps({"edge_exit": report}), flush=True)
     except DeviceBusy as e:
         print(f"device busy: {e}", file=sys.stderr)
@@ -1545,6 +1569,21 @@ def cmd_status(args) -> int:
                                       "uptime_s", "breaker", "lanes")}
             server_block["engine"] = h.get("engine")
             server_block["streams"] = h.get("streams")
+            if h.get("role") == "proxy":
+                # PR 18: the probed server is a fleet front tier. Its
+                # /healthz already did the bounded per-backend fan-out
+                # (a wedged worker is a per-entry error after its own
+                # probe deadline, never a hang), so the aggregate is
+                # one more dict to surface, per-worker health/breaker
+                # state included.
+                server_block["role"] = "proxy"
+                server_block["backends"] = {
+                    name: {k: b.get(k) for k in
+                           ("ok", "status", "degraded", "breaker",
+                            "draining_via_proxy", "outstanding",
+                            "streams", "error")}
+                    for name, b in (h.get("backends") or {}).items()}
+                server_block["counters"] = h.get("counters")
             try:
                 text = cli.metrics_text()
                 server_block["metrics"] = {
@@ -2044,6 +2083,10 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--max-queued", type=int, default=256,
                     help="bounded admission (PR 5): outstanding cap; "
                          "0 = unbounded (429s never fire)")
+    sv.add_argument("--max-subjects", type=int, default=4096,
+                    help="specialized-subject table ceiling (PR 4); "
+                         "under --lanes it also sizes the per-lane "
+                         "shard tables (ceil(max-subjects / lanes))")
     sv.add_argument("--tier1-quota", type=int, default=0,
                     help="tier-1 admission quota (0 = the PR-5 "
                          "default: half of max-queued)")
@@ -2056,6 +2099,10 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--aot-dir", default="",
                     help="executable lattice dir (PR 6) for zero-"
                          "compile boot")
+    sv.add_argument("--store-warm-capacity", type=int, default=0,
+                    help="tiered subject store (PR 16): host-RAM warm "
+                         "tier of N rows (sharded under --lanes); "
+                         "0 = device-table only")
     sv.add_argument("--no-warmup", action="store_true",
                     help="skip the boot-time bucket warmup (compiles "
                          "then land in the first requests)")
@@ -2107,12 +2154,14 @@ def build_parser() -> argparse.ArgumentParser:
                     help="per-platform probe deadline in seconds; a "
                          "hung probe is SIGKILLed at the deadline")
     st.add_argument("--server", default="",
-                    help="probe a running edge worker (PR 15): hit "
-                         "its /healthz + /metrics with a bounded "
-                         "timeout and fold the answer into the "
-                         "report; a down/hung server degrades the "
-                         "block (rc stays 0, never hangs — the "
-                         "tunnel-probe contract)")
+                    help="probe a running edge worker (PR 15) or "
+                         "fleet proxy (PR 18): hit its /healthz + "
+                         "/metrics with a bounded timeout and fold "
+                         "the answer into the report — a proxy "
+                         "answers with the per-backend aggregate; a "
+                         "down/hung server degrades the block (rc "
+                         "stays 0, never hangs — the tunnel-probe "
+                         "contract)")
     st.add_argument("--server-timeout", type=float, default=3.0,
                     help="per-read bound on the --server probe")
     st.add_argument("--metrics-dir", default="",

@@ -7,7 +7,11 @@
   (`mano serve` is its CLI);
 * ``client.EdgeClient`` / ``client.EdgeStreamClient`` — the bounded
   stdlib client the config18 drill, tests, and `mano status --server`
-  share.
+  share;
+* ``proxy.EdgeProxy`` — the fleet front tier (PR 18): health-aware
+  routing over N workers with live stream migration;
+* ``fleet.Fleet`` / ``fleet.WorkerProc`` — kill -9-capable worker
+  process supervision (the chaos drill's substrate).
 """
 
 from mano_hand_tpu.edge.client import (  # noqa: F401
@@ -16,12 +20,19 @@ from mano_hand_tpu.edge.client import (  # noqa: F401
     EdgeStreamClient,
     FrameReply,
 )
+from mano_hand_tpu.edge.fleet import Fleet, WorkerProc, WorkerSpec  # noqa: F401
+from mano_hand_tpu.edge.proxy import Backend, EdgeProxy  # noqa: F401
 from mano_hand_tpu.edge.server import EdgeServer  # noqa: F401
 
 __all__ = [
+    "Backend",
     "EdgeClient",
     "EdgeError",
+    "EdgeProxy",
     "EdgeServer",
     "EdgeStreamClient",
+    "Fleet",
     "FrameReply",
+    "WorkerProc",
+    "WorkerSpec",
 ]

@@ -189,15 +189,17 @@ class EdgeClient:
     def open_stream(self, *, subject: Optional[str] = None,
                     betas=None, frame_deadline_s: Optional[float] = None,
                     idle_timeout_s: Optional[float] = None,
-                    **open_kw) -> "EdgeStreamClient":
+                    resume_pose=None, **open_kw) -> "EdgeStreamClient":
         """Open a PR-12 stream over a DEDICATED upgraded connection
         (the session is connection-affine; this client's one-shot
-        connection stays usable beside it)."""
+        connection stays usable beside it). ``resume_pose`` warm-starts
+        the tracker — the PR-18 migration handoff over the wire."""
         return EdgeStreamClient(
             self.host, self.port, timeout_s=self.timeout_s,
             subject=subject, betas=betas,
             frame_deadline_s=frame_deadline_s,
-            idle_timeout_s=idle_timeout_s, **open_kw)
+            idle_timeout_s=idle_timeout_s, resume_pose=resume_pose,
+            **open_kw)
 
 
 class EdgeStreamClient:
@@ -210,7 +212,8 @@ class EdgeStreamClient:
     def __init__(self, host: str, port: int, *, timeout_s: float = 30.0,
                  subject: Optional[str] = None, betas=None,
                  frame_deadline_s: Optional[float] = None,
-                 idle_timeout_s: Optional[float] = None, **open_kw):
+                 idle_timeout_s: Optional[float] = None,
+                 resume_pose=None, **open_kw):
         if (subject is None) == (betas is None):
             raise ValueError("pass exactly one of subject= / betas=")
         self._sock = socket.create_connection((host, port),
@@ -240,6 +243,8 @@ class EdgeStreamClient:
                 msg["frame_deadline_s"] = frame_deadline_s
             if idle_timeout_s is not None:
                 msg["idle_timeout_s"] = idle_timeout_s
+            if resume_pose is not None:
+                msg["resume_pose"] = proto.encode_array(resume_pose)
             msg.update(open_kw)
             reply = self._roundtrip(msg)
             if "error" in reply:

@@ -1,0 +1,336 @@
+"""Worker-process supervision for the fleet front tier (PR 18).
+
+edge/proxy.py routes over backends it is HANDED; this module is the
+half that makes those backends: spawn N ``mano serve`` worker
+processes, parse each one's stdout ready line for its ephemeral port,
+and keep every wait BOUNDED with a SIGKILL backstop — the r3-incident
+rule (CLAUDE.md): anything long-running needs a kill -9-capable
+supervisor, never a signal handler it hopes gets delivered. SIGTERM is
+the polite path (the worker's documented drain), but a worker wedged
+in a C-level call cannot run a Python handler, so ``terminate()``
+always escalates to SIGKILL at its deadline.
+
+The stdout contract is cmd_serve's: exactly two JSON lines — a ready
+line ``{"edge": {host, port, pid, ...}}`` at bind time and an exit
+line ``{"edge_exit": {...}}`` after the drain (PR 18 extends the exit
+line with the worker's span accounting + compile counters, the
+cross-process halves of the fleet drill's span-once and zero-recompile
+judgments). A reader thread drains the pipe continuously — a worker
+must never block on a full stdout pipe — and stderr goes to a per-
+worker log file (or devnull) for the same reason.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from mano_hand_tpu.edge.proxy import Backend, EdgeProxy
+
+
+class WorkerSpec:
+    """The knobs one ``mano serve`` worker boots with. ``extra`` is
+    passed through verbatim (flags this module need not know)."""
+
+    def __init__(self, *, asset: str = "synthetic",
+                 side: Optional[str] = None,
+                 platform: str = "", lanes: int = 0,
+                 max_bucket: int = 64, max_delay_ms: float = 2.0,
+                 max_queued: int = 256, max_subjects: int = 0,
+                 aot_dir: str = "",
+                 store_warm_capacity: int = 0,
+                 no_warmup: bool = False,
+                 drain_timeout_s: float = 15.0,
+                 device_lock: str = "auto",
+                 extra: Sequence[str] = (),
+                 extra_env: Optional[Dict[str, str]] = None):
+        self.asset = asset
+        self.side = side
+        self.platform = platform
+        self.lanes = int(lanes)
+        self.max_bucket = int(max_bucket)
+        self.max_delay_ms = float(max_delay_ms)
+        self.max_queued = int(max_queued)
+        self.max_subjects = int(max_subjects)
+        self.aot_dir = aot_dir
+        self.store_warm_capacity = int(store_warm_capacity)
+        self.no_warmup = bool(no_warmup)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.device_lock = device_lock
+        self.extra = tuple(extra)
+        self.extra_env = dict(extra_env or {})
+
+    def argv(self) -> List[str]:
+        cmd = [sys.executable, "-m", "mano_hand_tpu.cli"]
+        if self.platform:
+            cmd += ["--platform", self.platform]
+        cmd += ["serve", "--host", "127.0.0.1", "--port", "0",
+                "--asset", self.asset,
+                "--max-bucket", str(self.max_bucket),
+                "--max-delay-ms", repr(self.max_delay_ms),
+                "--max-queued", str(self.max_queued),
+                "--drain-timeout-s", repr(self.drain_timeout_s),
+                "--device-lock", self.device_lock]
+        if self.side:
+            cmd += ["--side", self.side]
+        if self.lanes:
+            cmd += ["--lanes", str(self.lanes)]
+        if self.max_subjects:
+            cmd += ["--max-subjects", str(self.max_subjects)]
+        if self.aot_dir:
+            cmd += ["--aot-dir", self.aot_dir]
+        if self.store_warm_capacity:
+            cmd += ["--store-warm-capacity",
+                    str(self.store_warm_capacity)]
+        if self.no_warmup:
+            cmd += ["--no-warmup"]
+        cmd += list(self.extra)
+        return cmd
+
+
+class WorkerProc:
+    """One supervised ``mano serve`` process.
+
+    ``start()`` spawns it; ``wait_ready()`` blocks (bounded, SIGKILL
+    on timeout) until the stdout ready line names the bound port;
+    ``terminate()`` is SIGTERM + bounded wait + SIGKILL backstop;
+    ``kill()`` is the chaos drill's instant SIGKILL. ``exit_report``
+    holds the parsed ``edge_exit`` line once the process printed one
+    (a SIGKILLed worker never does — by construction)."""
+
+    def __init__(self, name: str, spec: WorkerSpec, *,
+                 env: Optional[Dict[str, str]] = None,
+                 stderr_path: Optional[str] = None,
+                 log: Optional[Callable[[str], None]] = None):
+        self.name = name
+        self.spec = spec
+        self._env = env
+        self._stderr_path = stderr_path
+        self._log = log or (lambda m: None)
+        self._proc: Optional[subprocess.Popen] = None
+        self._reader: Optional[threading.Thread] = None
+        self._stderr_f = None
+        self._ready = threading.Event()
+        self._exited = threading.Event()
+        self.ready_info: Optional[dict] = None
+        self.exit_report: Optional[dict] = None
+        self.stdout_lines: List[str] = []
+        self.returncode: Optional[int] = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "WorkerProc":
+        if self._proc is not None:
+            return self
+        env = dict(os.environ)
+        if self._env:
+            env.update(self._env)
+        # Per-spec env wins over the fleet-wide env: the drill uses it
+        # to give each worker its OWN compile-cache dir — N processes
+        # sharing one jax_compilation_cache_dir is the XLA executable-
+        # deserialization crash class (CLAUDE.md), and workers inherit
+        # MANO_TEST_CACHE_DIR from a pytest parent unless overridden.
+        if self.spec.extra_env:
+            env.update(self.spec.extra_env)
+        if self._stderr_path:
+            self._stderr_f = open(self._stderr_path, "ab")
+            stderr = self._stderr_f
+        else:
+            stderr = subprocess.DEVNULL
+        self._proc = subprocess.Popen(
+            self.spec.argv(), stdout=subprocess.PIPE, stderr=stderr,
+            env=env, start_new_session=True)
+        self._reader = threading.Thread(
+            target=self._drain_stdout, name=f"stdout-{self.name}",
+            daemon=True)
+        self._reader.start()
+        return self
+
+    def _drain_stdout(self) -> None:
+        proc = self._proc
+        try:
+            for raw in proc.stdout:
+                line = raw.decode("utf-8", "replace").rstrip("\n")
+                self.stdout_lines.append(line)
+                try:
+                    d = json.loads(line)
+                except ValueError:
+                    continue
+                if "edge" in d:
+                    self.ready_info = d["edge"]
+                    self._ready.set()
+                elif "edge_exit" in d:
+                    self.exit_report = d["edge_exit"]
+        except (OSError, ValueError):
+            pass
+        finally:
+            self._exited.set()
+            self._ready.set()           # never strand a ready waiter
+
+    @property
+    def pid(self) -> Optional[int]:
+        return None if self._proc is None else self._proc.pid
+
+    @property
+    def port(self) -> Optional[int]:
+        return (None if self.ready_info is None
+                else int(self.ready_info["port"]))
+
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def wait_ready(self, timeout_s: float = 120.0) -> "WorkerProc":
+        """Block until the ready line lands; a worker that failed (or
+        wedged) before binding is SIGKILLed and reported — a boot must
+        never hang the fleet."""
+        if not self._ready.wait(timeout=timeout_s):
+            self.kill()
+            raise RuntimeError(
+                f"worker {self.name} did not become ready within "
+                f"{timeout_s}s")
+        if self.ready_info is None:
+            rc = self._proc.poll() if self._proc else None
+            self.kill()
+            raise RuntimeError(
+                f"worker {self.name} exited (rc={rc}) before its "
+                f"ready line; stdout: {self.stdout_lines[-3:]}")
+        return self
+
+    def kill(self) -> None:
+        """The chaos path: SIGKILL now. The process gets no drain, no
+        exit line, and its tracer dies with it (the drill's span
+        accounting excludes it by construction)."""
+        if self._proc is None:
+            return
+        try:
+            self._proc.kill()
+        except OSError:
+            pass
+        self._finish(join_timeout_s=10.0)
+
+    def terminate(self, timeout_s: float = 30.0) -> Optional[dict]:
+        """The polite path: SIGTERM (the worker drains and prints its
+        exit line), bounded by ``timeout_s`` with a SIGKILL backstop —
+        SIGTERM needs the worker's main thread, and a worker wedged in
+        a C-level call never runs the handler (CLAUDE.md). Returns the
+        parsed exit report (None if the backstop fired first)."""
+        if self._proc is None:
+            return None
+        if self._proc.poll() is None:
+            try:
+                self._proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.monotonic() + timeout_s
+        try:
+            self._proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            self._log(f"worker {self.name}: SIGTERM deadline hit — "
+                      f"SIGKILL backstop")
+            try:
+                self._proc.kill()
+            except OSError:
+                pass
+        self._finish(join_timeout_s=max(1.0,
+                                        deadline - time.monotonic()))
+        return self.exit_report
+
+    def _finish(self, join_timeout_s: float) -> None:
+        try:
+            self._proc.wait(timeout=join_timeout_s)
+        except subprocess.TimeoutExpired:
+            pass
+        self.returncode = self._proc.poll()
+        if self._reader is not None:
+            self._reader.join(timeout=join_timeout_s)
+        if self._stderr_f is not None:
+            try:
+                self._stderr_f.close()
+            except OSError:
+                pass
+            self._stderr_f = None
+
+
+class Fleet:
+    """N workers + one proxy, as a unit: the rolling-deploy substrate.
+
+    ``start()`` boots every worker (bounded), waits for all ready
+    lines, then fronts them with an ``EdgeProxy``. ``kill_worker``
+    (chaos) and ``drain_worker`` (deploy: proxy-side stream migration,
+    then SIGTERM) are the two removal paths the config21 drill
+    exercises; ``stop()`` tears the whole thing down and returns every
+    worker's exit report."""
+
+    def __init__(self, specs: Sequence[WorkerSpec], *,
+                 env: Optional[Dict[str, str]] = None,
+                 stderr_dir: Optional[str] = None,
+                 proxy_kwargs: Optional[dict] = None,
+                 log: Optional[Callable[[str], None]] = None):
+        self._log = log or (lambda m: None)
+        self.workers: Dict[str, WorkerProc] = {}
+        for i, spec in enumerate(specs):
+            name = f"w{i}"
+            stderr_path = (os.path.join(stderr_dir, f"{name}.stderr")
+                           if stderr_dir else None)
+            self.workers[name] = WorkerProc(
+                name, spec, env=env, stderr_path=stderr_path,
+                log=self._log)
+        self._proxy_kwargs = dict(proxy_kwargs or {})
+        self.proxy: Optional[EdgeProxy] = None
+        self.exit_reports: Dict[str, Optional[dict]] = {}
+
+    def start(self, ready_timeout_s: float = 180.0) -> "Fleet":
+        t0 = time.monotonic()
+        for w in self.workers.values():
+            w.start()
+        for w in self.workers.values():
+            left = max(1.0, ready_timeout_s - (time.monotonic() - t0))
+            try:
+                w.wait_ready(timeout_s=left)
+            except RuntimeError:
+                self.stop(timeout_s=10.0)
+                raise
+        backends = [Backend(name, "127.0.0.1", w.port)
+                    for name, w in self.workers.items()]
+        self.proxy = EdgeProxy(backends, log=self._log,
+                               **self._proxy_kwargs).start()
+        return self
+
+    def kill_worker(self, name: str) -> None:
+        """Chaos: SIGKILL one worker. The proxy discovers the death
+        through its breaker / mid-frame failover — nothing is told in
+        advance, which is the point of the drill."""
+        self.workers[name].kill()
+        self.exit_reports[name] = None
+
+    def drain_worker(self, name: str, *,
+                     migrate_timeout_s: float = 10.0,
+                     term_timeout_s: float = 30.0) -> dict:
+        """Rolling deploy: migrate the worker's proxied streams to
+        siblings (bounded), then SIGTERM it so its own drain closes
+        any remaining local state and prints the exit line."""
+        if self.proxy is None:
+            raise RuntimeError("fleet is not started")
+        report = self.proxy.drain_backend(
+            name, timeout_s=migrate_timeout_s)
+        self.exit_reports[name] = self.workers[name].terminate(
+            timeout_s=term_timeout_s)
+        return report
+
+    def stop(self, timeout_s: float = 30.0) -> Dict[str, Optional[dict]]:
+        if self.proxy is not None:
+            try:
+                self.proxy.drain(timeout_s=min(10.0, timeout_s))
+            except Exception:  # noqa: BLE001 — teardown must finish
+                pass
+        for name, w in self.workers.items():
+            if name not in self.exit_reports or (
+                    self.exit_reports[name] is None and w.alive()):
+                self.exit_reports[name] = w.terminate(
+                    timeout_s=timeout_s)
+        return dict(self.exit_reports)

@@ -59,13 +59,19 @@ KIND_STATUS = {
     "expired": 504,     # the request's own deadline elapsed unserved
     "shutdown": 503,    # the engine is stopping/stopped
     "error": 500,       # dispatch failure — flight record attached
+    "upstream": 502,    # the PROXY (PR 18) lost a backend mid-response
+    #   after the request hit its wire — re-sending is not safe (the
+    #   worker may have admitted the work), so the client decides.
+    #   A backend down AT CONNECT never surfaces this: the proxy
+    #   re-routes to a sibling (nothing was dispatched — idempotent).
 }
 
 _REASONS = {
     200: "OK", 400: "Bad Request", 404: "Not Found",
     405: "Method Not Allowed", 408: "Request Timeout",
     413: "Payload Too Large", 429: "Too Many Requests",
-    500: "Internal Server Error", 503: "Service Unavailable",
+    500: "Internal Server Error", 502: "Bad Gateway",
+    503: "Service Unavailable",
     504: "Gateway Timeout", 101: "Switching Protocols",
 }
 

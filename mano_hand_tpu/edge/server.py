@@ -118,6 +118,82 @@ class _Request:
         self.body = body
 
 
+async def write_response(writer, status: int, body,
+                         *, content_type: str = "application/json",
+                         extra_headers: Optional[dict] = None,
+                         close: bool = False) -> None:
+    """Serialize one HTTP/1.1 response (shared by EdgeServer and the
+    PR-18 proxy — one implementation owns the bytes)."""
+    payload = (body if isinstance(body, (bytes, bytearray))
+               else proto.dumps(body))
+    head = [f"HTTP/1.1 {status} {proto.reason(status)}",
+            f"Content-Type: {content_type}",
+            f"Content-Length: {len(payload)}"]
+    for k, v in (extra_headers or {}).items():
+        head.append(f"{k}: {v}")
+    if close:
+        head.append("Connection: close")
+    writer.write(("\r\n".join(head) + "\r\n\r\n").encode("latin-1")
+                 + bytes(payload))
+    await writer.drain()
+
+
+async def read_request(rd: _Pushback, writer, *,
+                       max_body_bytes: int = MAX_BODY_BYTES,
+                       draining: bool = False) -> Optional[_Request]:
+    """Parse one HTTP/1.1 request off an upgraded-capable connection;
+    answers the malformed cases itself (400/413) and returns None when
+    the connection is done (shared by EdgeServer and the proxy)."""
+    try:
+        line = await rd.readline()
+    except (ValueError, asyncio.LimitOverrunError):
+        await write_response(writer, 400, proto.error_body(
+            "bad_request", "request line too long"), close=draining)
+        return None
+    if not line:
+        return None                 # clean EOF between requests
+    try:
+        method, path, _version = line.decode(
+            "latin-1").strip().split(" ", 2)
+    except ValueError:
+        await write_response(writer, 400, proto.error_body(
+            "bad_request", "malformed request line"), close=draining)
+        return None
+    headers = {}
+    while True:
+        h = await rd.readline()
+        if h in (b"\r\n", b"\n"):
+            break
+        if not h:
+            return None             # EOF mid-headers: client gone
+        if len(headers) > 128:
+            await write_response(writer, 400, proto.error_body(
+                "bad_request", "too many headers"), close=draining)
+            return None
+        name, _, value = h.decode("latin-1").partition(":")
+        headers[name.strip().lower()] = value.strip()
+    body = b""
+    if headers.get("transfer-encoding"):
+        await write_response(writer, 400, proto.error_body(
+            "bad_request", "chunked bodies are not supported"),
+            close=draining)
+        return None
+    clen = headers.get("content-length")
+    if clen:
+        try:
+            n = int(clen)
+        except ValueError:
+            n = -1
+        if n < 0 or n > max_body_bytes:
+            await write_response(writer, 413, proto.error_body(
+                "bad_request",
+                f"body of {clen} bytes exceeds the "
+                f"{max_body_bytes}-byte bound"), close=draining)
+            return None
+        body = await rd.readexactly(n)
+    return _Request(method, path, headers, body)
+
+
 class EdgeServer:
     """Asyncio HTTP front-end over one ``ServingEngine``.
 
@@ -285,70 +361,18 @@ class EdgeServer:
 
     async def _read_request(self, rd: _Pushback,
                             writer) -> Optional[_Request]:
-        try:
-            line = await rd.readline()
-        except (ValueError, asyncio.LimitOverrunError):
-            await self._respond(writer, 400, proto.error_body(
-                "bad_request", "request line too long"))
-            return None
-        if not line:
-            return None                 # clean EOF between requests
-        try:
-            method, path, _version = line.decode(
-                "latin-1").strip().split(" ", 2)
-        except ValueError:
-            await self._respond(writer, 400, proto.error_body(
-                "bad_request", "malformed request line"))
-            return None
-        headers = {}
-        while True:
-            h = await rd.readline()
-            if h in (b"\r\n", b"\n"):
-                break
-            if not h:
-                return None             # EOF mid-headers: client gone
-            if len(headers) > 128:
-                await self._respond(writer, 400, proto.error_body(
-                    "bad_request", "too many headers"))
-                return None
-            name, _, value = h.decode("latin-1").partition(":")
-            headers[name.strip().lower()] = value.strip()
-        body = b""
-        if headers.get("transfer-encoding"):
-            await self._respond(writer, 400, proto.error_body(
-                "bad_request", "chunked bodies are not supported"))
-            return None
-        clen = headers.get("content-length")
-        if clen:
-            try:
-                n = int(clen)
-            except ValueError:
-                n = -1
-            if n < 0 or n > self.max_body_bytes:
-                await self._respond(writer, 413, proto.error_body(
-                    "bad_request",
-                    f"body of {clen} bytes exceeds the "
-                    f"{self.max_body_bytes}-byte bound"))
-                return None
-            body = await rd.readexactly(n)
-        return _Request(method, path, headers, body)
+        return await read_request(
+            rd, writer, max_body_bytes=self.max_body_bytes,
+            draining=self._draining)
 
     async def _respond(self, writer, status: int, body,
                        *, content_type: str = "application/json",
                        extra_headers: Optional[dict] = None,
                        close: bool = False) -> None:
-        payload = (body if isinstance(body, (bytes, bytearray))
-                   else proto.dumps(body))
-        head = [f"HTTP/1.1 {status} {proto.reason(status)}",
-                f"Content-Type: {content_type}",
-                f"Content-Length: {len(payload)}"]
-        for k, v in (extra_headers or {}).items():
-            head.append(f"{k}: {v}")
-        if close or self._draining:
-            head.append("Connection: close")
-        writer.write(("\r\n".join(head) + "\r\n\r\n").encode("latin-1")
-                     + bytes(payload))
-        await writer.drain()
+        await write_response(
+            writer, status, body, content_type=content_type,
+            extra_headers=extra_headers,
+            close=close or self._draining)
 
     # ------------------------------------------------------------- routing
     async def _dispatch(self, req: _Request, rd: _Pushback,
@@ -680,6 +704,14 @@ class EdgeServer:
                         kw = {k: msg[k] for k in
                               ("n_steps", "data_term", "solver")
                               if k in msg}
+                        if msg.get("resume_pose") is not None:
+                            # PR-18 migration handoff: a proxy re-opens
+                            # a drained worker's session on a sibling
+                            # warm-started at the last confirmed pose
+                            # (PR-12 portability — deterministic fits
+                            # make the continuation bit-equal).
+                            kw["resume_pose"] = proto.decode_array(
+                                msg["resume_pose"])
                         sess = await loop.run_in_executor(
                             None, lambda: eng.open_stream(
                                 subject,

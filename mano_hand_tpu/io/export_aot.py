@@ -452,14 +452,23 @@ class ExecutableLattice:
     boot or serve silently-wrong results (the checksum covers the whole
     file; the header digest re-checks provenance after the checksum).
     Deserialized programs are cached, so a warm entry is a dict hit.
+
+    Thread-safe (PR 18): N lanes boot concurrently against ONE lattice
+    object. The read/deserialize work runs OUTSIDE the lock (it is the
+    slow part); publication is first-wins, so two threads racing the
+    same key both get the same jitted wrapper back and the loser's
+    unwarmed duplicate is discarded before it costs a compile (jit is
+    lazy).
     """
 
     def __init__(self, directory, manifest: dict, on_failure=None):
+        import threading
         from pathlib import Path
 
         self.dir = Path(directory)
         self.manifest = manifest
         self._on_failure = on_failure
+        self._lock = threading.Lock()
         self._cache: dict = {}
         self._bad: set = set()
 
@@ -475,7 +484,8 @@ class ExecutableLattice:
     def _fail(self, key: str, reason: str):
         import warnings
 
-        self._bad.add(key)
+        with self._lock:
+            self._bad.add(key)
         if self._on_failure is not None:
             self._on_failure(key, reason)
         warnings.warn(
@@ -489,10 +499,11 @@ class ExecutableLattice:
         entry baked for other platforms is a counted degrade, not a
         call-time crash in the middle of boot."""
         key = self.key_of(kind, bucket, capacity)
-        if key in self._cache:
-            return self._cache[key]
-        if key in self._bad:
-            return None
+        with self._lock:
+            if key in self._cache:
+                return self._cache[key]
+            if key in self._bad:
+                return None
         ent = self.manifest.get("entries", {}).get(key)
         if ent is None:
             return None        # never baked: a plain miss, not a failure
@@ -527,8 +538,8 @@ class ExecutableLattice:
         except Exception as e:  # noqa: BLE001 — degrade, never crash boot
             return self._fail(key, f"deserialize failed "
                                    f"({type(e).__name__}: {e})")
-        self._cache[key] = call
-        return call
+        with self._lock:
+            return self._cache.setdefault(key, call)
 
 
 def load_lattice(aot_dir, params_or_digest, *, on_failure=None):

@@ -919,6 +919,7 @@ class ServingEngine:
         lane_probe: Optional[Callable[[int], bool]] = None,
         precision_policy=None,
         subject_store=None,
+        store_warm_capacity: Optional[int] = None,
     ):
         self._params = params.astype(dtype)
         self._dtype = np.dtype(dtype)
@@ -1101,6 +1102,17 @@ class ServingEngine:
                     "a sharded subject_store requires lanes (the shards "
                     "are the per-lane tables; pass lanes=N)")
             subject_store.bind(self.counters, n_shards=self._lane_count)
+        if store_warm_capacity is not None:
+            # Warm-tier budget override (PR 18): applied through the
+            # runtime resize AFTER bind, so a shrink against a pre-
+            # populated (restored/shared) store evicts LRU-first with
+            # counted evictions — same path `mano serve
+            # --store-warm-capacity` rides.
+            if subject_store is None:
+                raise ValueError(
+                    "store_warm_capacity requires subject_store (it "
+                    "retargets the warm tier's row budget)")
+            subject_store.resize_warm(int(store_warm_capacity))
         self._subject_store = subject_store
 
     @property
@@ -2159,11 +2171,27 @@ class ServingEngine:
         if include_cpu_fallback is None:
             include_cpu_fallback = bool(
                 self._policy is not None and self._policy.cpu_fallback)
+        if capacities is None:
+            caps = self._lattice_capacities()
+            # Per-lane tier (PR 18): sharded lanes dispatch against
+            # shard-LOCAL tables of a FIXED capacity — the even split
+            # of max_subjects over N lanes (lanes.py:_shard_capacity_
+            # max) — which is generally NOT on the doubling ladder.
+            # Bake it too, or every lane's gathered program misses the
+            # lattice and the per-worker cold boot pays N compiles.
+            store = getattr(self, "_subject_store", None)
+            if (self._lane_count and store is not None
+                    and getattr(store, "sharded", False)):
+                shard_cap = max(
+                    1, -(-self.max_subjects // self._lane_count))
+                if shard_cap not in caps:
+                    caps.append(shard_cap)
+        else:
+            caps = list(capacities)
         manifest = bake_lattice(
             self._params, self.aot_dir,
             buckets=self.buckets,
-            capacities=(self._lattice_capacities() if capacities is None
-                        else list(capacities)),
+            capacities=caps,
             platforms=tuple(platforms) if platforms else ("cpu", "tpu"),
             cpu_fallback=include_cpu_fallback,
             log=log,

@@ -71,9 +71,19 @@ Replica broadcasts are serialized upstream by the engine's
 ``_install_lock`` (``_install_subject`` is the table's only mutator),
 so ``broadcast_row`` needs no install lock of its own.
 
-Known scope bound (documented, not accidental): lane executables have
-no AOT-lattice tier (PR-6 lattice entries deserialize onto the default
-device; a lane boot pays warm-up compiles, counted). The PR-13 bound
+The PR-13 scope bound that lane executables had no AOT-lattice tier is
+CLOSED (PR 18): ``_full_executable`` and ``_gather_executable`` try the
+PR-6 lattice FIRST, exactly like the engine's single-device builders —
+the per-lane twist is that the deserialized program's runtime arguments
+(the ``params_leaves`` / ``table_leaves``) are COMMITTED to the lane's
+device, so jax's committed-argument placement pins the backend compile
+and every later dispatch to that lane (no default-device detour), and
+the eager warm uses host-side zeros exactly as dispatch passes host
+batches. A lane boot from a baked lattice therefore reports 0 jit
+compiles at lanes=N (``aot_loads`` counts the revivals) — the fleet
+drill's per-worker cold-boot criterion. The bf16 and fused families
+stay deliberately OUT of the lattice tier (the PR-6 exclusion: the
+lattice contract is f32 bit-identity with live jit). The PR-13 bound
 that lanes served only the XLA gathered family is CLOSED (PR 14): a
 lane's gathered cache serves the FUSED Pallas family under
 ``posed_kernel="fused"`` through the engine's own capacity gate, and
@@ -117,6 +127,7 @@ class Lane:
         # Device-pinned state, built lazily (the engine's default-device
         # caches are untouched — the sentinel keeps probing those).
         self.params_dev = None
+        self.lat_leaves = None       # lane-device params_leaves (PR 18)
         self.table = None            # SubjectTable replica on self.device
         # Which engine ``_table_version`` the replica derives from: the
         # worker dispatches only after proving (one engine-lock hold)
@@ -306,6 +317,18 @@ class LaneSet:
             lane.params_dev = self._eng._params.device_put(
                 sharding=lane.device)
         return lane.params_dev
+
+    def _lane_lat_leaves(self, lane: Lane):
+        """The lane-device-committed ``params_leaves`` a lattice-loaded
+        full program takes as runtime arguments (PR 18): committed
+        leaves pin the deserialized program's backend compile — and
+        every dispatch — to THIS lane's device (staged outside every
+        lock, cached on the lane like ``params_dev``)."""
+        if lane.lat_leaves is None:
+            from mano_hand_tpu.io.export_aot import params_leaves
+
+            lane.lat_leaves = params_leaves(self._lane_params(lane))
+        return lane.lat_leaves
 
     def _adopt(self, lane: Lane):
         """Re-derive the lane's replica from the engine's LIVE table
@@ -566,13 +589,58 @@ class LaneSet:
         if exe is not None:
             return exe
         eng = self._eng
-        built = engine_mod.build_bucket_executable(
-            self._lane_params(lane), bucket, eng._n_joints,
-            eng._n_shape, eng._dtype, donate=eng.donate)
-        eng.counters.count_compile()
-        if eng._tracer is not None:
-            eng._tracer.runtime_event("compile", family="full",
-                                      bucket=bucket, lane=lane.index)
+        built = None
+        lat = eng._get_lattice()
+        if lat is not None:
+            # Per-lane lattice tier (PR 18): the SAME PR-6 entry the
+            # single-device path loads, with its runtime params
+            # arguments committed to this lane's device — placement
+            # follows the committed leaves, so the backend compile
+            # lands on the lane, not the default device. Warmed with
+            # host zeros exactly as dispatch passes host batches (a
+            # committed-zeros warm would populate a DIFFERENT jit
+            # cache entry and pay a second backend compile mid-
+            # dispatch). Damage degrades to the counted jit build.
+            import jax
+
+            call = lat.get("full", bucket,
+                           platform=jax.default_backend())
+            if call is not None:
+                try:
+                    leaves = self._lane_lat_leaves(lane)
+                    loaded = (lambda p, s, _c=call, _l=leaves:
+                              _c(_l, p, s))
+                    jax.block_until_ready(loaded(
+                        np.zeros((bucket, eng._n_joints, 3),
+                                 eng._dtype),
+                        np.zeros((bucket, eng._n_shape), eng._dtype)))
+                    eng.counters.count_aot_load()
+                    if eng._tracer is not None:
+                        eng._tracer.runtime_event(
+                            "lattice_load", family="full",
+                            bucket=bucket, lane=lane.index)
+                    built = loaded
+                except Exception as e:  # noqa: BLE001 — degrade
+                    eng.counters.count_aot_load_failure()
+                    _LOG.warning(
+                        f"lane {lane.index}: lattice full/b{bucket} "
+                        f"entry failed at execution "
+                        f"({type(e).__name__}: {e}); recompiling "
+                        f"(counted)")
+                    if eng._tracer is not None:
+                        eng._tracer.runtime_event(
+                            "lattice_load_failed", family="full",
+                            bucket=bucket, lane=lane.index)
+                    built = None
+        if built is None:
+            built = engine_mod.build_bucket_executable(
+                self._lane_params(lane), bucket, eng._n_joints,
+                eng._n_shape, eng._dtype, donate=eng.donate)
+            eng.counters.count_compile()
+            if eng._tracer is not None:
+                eng._tracer.runtime_event("compile", family="full",
+                                          bucket=bucket,
+                                          lane=lane.index)
         pol = eng._policy
         if pol is not None and pol.chaos is not None:
             built = pol.chaos.wrap(built, on_fault=eng._on_chaos_fault,
@@ -621,26 +689,74 @@ class LaneSet:
         fused = eng._posed_fused_active(cap)
         # Resolved OUTSIDE the lock (a jax backend query).
         interp = eng._resolve_posed_interpret() if fused else False
-        if prec == "bf16":
-            family = "gather_fused_bf16" if fused else "gather_bf16"
-            built = engine_mod.build_posed_gather_bf16_executable(
-                tab, bucket, eng._n_joints, eng._dtype,
-                donate=eng.donate, fused=fused, interpret=interp)
-        elif fused:
-            family = "gather_fused"
-            built = engine_mod.build_posed_gather_fused_executable(
-                tab, bucket, eng._n_joints, eng._dtype,
-                donate=eng.donate, interpret=interp)
-        else:
-            family = "gather"
-            built = engine_mod.build_posed_gather_executable(
-                tab, bucket, eng._n_joints, eng._dtype,
-                donate=eng.donate)
-        eng.counters.count_compile()
-        if eng._tracer is not None:
-            eng._tracer.runtime_event("compile", family=family,
-                                      bucket=bucket, capacity=cap,
-                                      lane=lane.index)
+        built = None
+        if prec != "bf16" and not fused:
+            # Per-lane lattice tier (PR 18), f32/XLA family only (the
+            # PR-6 exclusion: bf16 and fused never enter the lattice —
+            # its contract is f32 bit-identity with live jit). The
+            # entry's table argument is this lane's replica, already
+            # committed to the lane device, so placement and the
+            # backend compile pin to the lane; requires the shard
+            # capacity among the baked capacities (bake_lattice adds
+            # it when the engine's lanes shard — engine.py).
+            lat = eng._get_lattice()
+            if lat is not None:
+                import jax
+
+                call = lat.get("gather", bucket, cap,
+                               platform=jax.default_backend())
+                if call is not None:
+                    try:
+                        from mano_hand_tpu.io.export_aot import (
+                            table_leaves,
+                        )
+
+                        built = (lambda t, idx, p, _c=call:
+                                 _c(table_leaves(t), idx, p))
+                        jax.block_until_ready(built(
+                            tab, np.zeros((bucket,), np.int32),
+                            np.zeros((bucket, eng._n_joints, 3),
+                                     eng._dtype)))
+                        eng.counters.count_aot_load()
+                        if eng._tracer is not None:
+                            eng._tracer.runtime_event(
+                                "lattice_load", family="gather",
+                                bucket=bucket, capacity=cap,
+                                lane=lane.index)
+                    except Exception as e:  # noqa: BLE001 — degrade
+                        eng.counters.count_aot_load_failure()
+                        _LOG.warning(
+                            f"lane {lane.index}: lattice gather/"
+                            f"b{bucket}/c{cap} entry failed at "
+                            f"execution ({type(e).__name__}: {e}); "
+                            f"recompiling (counted)")
+                        if eng._tracer is not None:
+                            eng._tracer.runtime_event(
+                                "lattice_load_failed", family="gather",
+                                bucket=bucket, capacity=cap,
+                                lane=lane.index)
+                        built = None
+        if built is None:
+            if prec == "bf16":
+                family = "gather_fused_bf16" if fused else "gather_bf16"
+                built = engine_mod.build_posed_gather_bf16_executable(
+                    tab, bucket, eng._n_joints, eng._dtype,
+                    donate=eng.donate, fused=fused, interpret=interp)
+            elif fused:
+                family = "gather_fused"
+                built = engine_mod.build_posed_gather_fused_executable(
+                    tab, bucket, eng._n_joints, eng._dtype,
+                    donate=eng.donate, interpret=interp)
+            else:
+                family = "gather"
+                built = engine_mod.build_posed_gather_executable(
+                    tab, bucket, eng._n_joints, eng._dtype,
+                    donate=eng.donate)
+            eng.counters.count_compile()
+            if eng._tracer is not None:
+                eng._tracer.runtime_event("compile", family=family,
+                                          bucket=bucket, capacity=cap,
+                                          lane=lane.index)
         pol = eng._policy
         if pol is not None and pol.chaos is not None:
             built = pol.chaos.wrap(built, on_fault=eng._on_chaos_fault,

@@ -253,6 +253,33 @@ class SubjectStore:
             self._counters.count_store_demotion_warm()
         self._page_out(victims)
 
+    # -------------------------------------------------------------- resize
+    def resize_warm(self, new_capacity: int) -> dict:
+        """Retarget the warm tier's row budget at RUNTIME (PR 18).
+
+        One lock hold flips the capacity and stages out the LRU-first
+        victims a shrink strands; paging (disk work) runs after release,
+        exactly like ``demote``'s overflow path. Evictions are COUNTED
+        (``subject_store_resize_evictions``), never an error — a paged
+        victim re-enters through the cold tier, an unpaged one re-bakes
+        on next use, both existing degradation contracts. A grow evicts
+        nothing; rows refill on demand."""
+        new_capacity = int(new_capacity)
+        if new_capacity < 1:
+            raise ValueError(
+                f"warm_capacity must be >= 1, got {new_capacity}")
+        victims = []
+        with self._lock:
+            old = self.config.warm_capacity
+            self.config.warm_capacity = new_capacity
+            while len(self._warm) > new_capacity:
+                victims.append(self._warm.popitem(last=False))
+        if victims and self._counters is not None:
+            self._counters.count_store_resize_eviction(len(victims))
+        self._page_out(victims)
+        return {"warm_capacity": new_capacity, "previous": old,
+                "evicted": len(victims)}
+
     # ------------------------------------------------------------ cold tier
     def _page_out(self, victims) -> None:
         for digest, row in victims:

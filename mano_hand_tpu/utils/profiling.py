@@ -201,6 +201,7 @@ class ServingCounters:
         self.subject_store_demotions_warm = 0
         self.subject_store_demotions_cold = 0
         self.subject_store_cold_damage = 0
+        self.subject_store_resize_evictions = 0
         self._promotion_stalls: list = []   # seconds; bounded ring
         self._promotion_writes = 0
         self.tier_submitted: Dict[int, int] = {}   # tier -> offered
@@ -412,6 +413,13 @@ class ServingCounters:
         with self._lock:
             self.subject_store_cold_damage += n
 
+    def count_store_resize_eviction(self, n: int = 1) -> None:
+        """One warm row evicted (LRU-first) by a RUNTIME warm-capacity
+        shrink (``SubjectStore.resize_warm``, PR 18) — counted, never
+        an error; a paged victim re-enters through the cold tier."""
+        with self._lock:
+            self.subject_store_resize_evictions += n
+
     def record_promotion_stall(self, seconds: float) -> None:
         """What one install actually WAITED on a tier promotion (the
         residual after any prefetch overlap) — same bounded-ring policy
@@ -543,6 +551,8 @@ class ServingCounters:
                 "subject_store_demotions_cold":
                     self.subject_store_demotions_cold,
                 "subject_store_cold_damage": self.subject_store_cold_damage,
+                "subject_store_resize_evictions":
+                    self.subject_store_resize_evictions,
             }
             base["padding_waste"] = round(
                 self._waste_ratio(self.rows_live, self.rows_padded), 4)

@@ -1508,6 +1508,122 @@ def main() -> int:
                   f"stage table over {tbl.get('complete_spans')} "
                   f"complete spans — {brief}")
 
+    def judge_fleet(fd):
+        """Done-criteria of the fleet chaos drill (config21, PR 18):
+        every worker process cold-boots from the per-lane lattice with
+        ZERO jit compiles at lanes=N (aot_loads > 0, no load
+        failures); with one of the workers SIGKILLed mid-frame-wave
+        and a second drained under the remaining live streams, 100% of
+        frames still reach an HTTP terminal; migrated warm starts are
+        bit-equal (pose chains identical fleet-wide across migration —
+        and identical to the in-process reference when it ran on cpu;
+        verts carry the f32 anchor tolerance because WHICH bucket
+        executable serves a coalesced batch varies run to run — that
+        jitter exists on one worker with no chaos, see the drill's
+        parity note); the rolling-deploy drain migrates every hosted
+        stream inside its budget; zero steady recompiles fleet-wide
+        (exit-line counters minus post-warm baselines); and every span
+        closes exactly once across process boundaries (the exit-line
+        accounting of every worker that reported — the SIGKILLed one
+        is excluded by construction, it never prints an exit line).
+        All CPU-defined: workers pin --platform cpu, sockets are
+        loopback."""
+        cb = fd.get("cold_boot") or {}
+        check("fleet_cold_boot_zero_compiles",
+              fd.get("cold_boot_zero_compiles") is True,
+              f"per-worker cold boot at lanes={fd.get('lanes')} from "
+              f"{fd.get('lattice_entries')} lattice entries: "
+              + ", ".join(
+                  f"{n} {c.get('compiles')}c/{c.get('aot_loads')}a"
+                  f"/{c.get('aot_load_failures')}f"
+                  for n, c in sorted(cb.items()))
+              + " (bar: 0 compiles, > 0 aot loads, 0 failures, every "
+                "worker)")
+        oc = fd.get("outcomes") or {}
+        frames = fd.get("frames_expected")
+        check("fleet_all_frames_terminal",
+              fd.get("terminal_fraction") == 1.0
+              and oc.get("exception") == 0
+              and not fd.get("close_errors"),
+              f"{oc.get('ok')} ok + {oc.get('http_error')} http error "
+              f"of {frames} frames ({fd.get('terminal_fraction')}), "
+              f"{oc.get('exception')} non-terminal exceptions, "
+              f"{fd.get('closes_ok')}/{fd.get('streams')} clean "
+              f"closes, through a SIGKILL of "
+              f"{(fd.get('kill') or {}).get('victim')} (hosting "
+              f"{(fd.get('kill') or {}).get('streams_hosted')} "
+              f"streams, mid-wave "
+              f"{(fd.get('kill') or {}).get('fired_mid_wave')}) and a "
+              f"drain of {(fd.get('drain') or {}).get('victim')}")
+        ref_cpu = fd.get("reference_platform") == "cpu"
+        pose_ref = fd.get("wire_vs_inprocess_pose_max_abs_err")
+        check("fleet_warm_starts_bit_equal",
+              fd.get("intra_fleet_pose_max_abs_err") == 0.0
+              and (not ref_cpu or pose_ref == 0.0)
+              and (fd.get("wire_vs_inprocess_max_abs_err") or 0) <= 1e-6
+              and fd.get("frames_compared") == fd.get("frame_numbering_ok")
+              and (fd.get("frames_compared") or 0) > 0,
+              f"pose max abs err {fd.get('intra_fleet_pose_max_abs_err')} "
+              f"intra-fleet over {fd.get('frames_compared')} frames "
+              f"({fd.get('unique_tracks')} shared tracks, migrated "
+              f"streams included), {pose_ref} vs the in-process "
+              f"reference (on {fd.get('reference_platform')}"
+              f"{'' if ref_cpu else ' — recorded unjudged off-cpu'}), "
+              f"verts anchor {fd.get('wire_vs_inprocess_max_abs_err')} "
+              f"(bar 1e-6), frame numbering preserved "
+              f"{fd.get('frame_numbering_ok')}/{fd.get('frames_compared')}")
+        dr = fd.get("drain") or {}
+        check("fleet_drain_within_budget",
+              dr.get("clean") is True
+              and dr.get("wall_s") is not None
+              and dr.get("wall_s") <= dr.get("budget_s", 0)
+              and dr.get("streams_migrated") == dr.get("streams_hosted"),
+              f"drained {dr.get('victim')} in {dr.get('wall_s')}s "
+              f"(budget {dr.get('budget_s')}s, clean {dr.get('clean')})"
+              f", {dr.get('streams_migrated')}/{dr.get('streams_hosted')}"
+              f" hosted streams migrated to siblings (proxy total: "
+              f"{(fd.get('proxy') or {}).get('migrations')} migrations,"
+              f" {(fd.get('proxy') or {}).get('migrated_frames')} "
+              f"in-flight frames re-sent)")
+        sb = fd.get("steady_recompiles_by_worker") or {}
+        check("fleet_zero_steady_recompiles",
+              fd.get("steady_recompiles_total") == 0
+              and fd.get("aot_load_failures_total") == 0
+              and any(v is not None for v in sb.values()),
+              f"steady recompiles by worker {sb} (exit-line counters "
+              f"minus post-warm baselines; the SIGKILLed worker is "
+              f"null by construction), {fd.get('aot_load_failures_total')}"
+              f" lattice load failures")
+        spans = fd.get("spans_by_worker") or {}
+        reported = [n for n, v in spans.items() if v is not None]
+        check("fleet_spans_closed_once",
+              fd.get("spans_closed_exactly_once") is True
+              and len(reported) == (fd.get("workers") or 0) - 1,
+              f"exit-line span accounting {spans} (bar: started == "
+              f"closed, 0 open, 0 double-closed on each of the "
+              f"{len(reported)} reporting workers; exactly the "
+              f"SIGKILLed one missing)")
+        px = fd.get("proxy") or {}
+        print(f"  [info] fleet: {fd.get('workers')} workers x "
+              f"{fd.get('lanes')} lanes booted in "
+              f"{fd.get('boot_wall_s')}s (lattice bake "
+              f"{fd.get('bake_wall_s')}s), {fd.get('streams')} streams "
+              f"x {fd.get('frames_per_stream')} frames, kill wave "
+              f"resolved in {(fd.get('kill') or {}).get('wave_wall_s')}"
+              f"s, proxy relayed {px.get('frames_relayed')} frames "
+              f"({px.get('reroutes')} reroutes, "
+              f"{px.get('upstream_failures')} upstream failures)")
+
+    if "fleet_drill_schema" in line and "metric" not in line:
+        # A raw fleet_drill_run artifact (no bench.py envelope): only
+        # the config21 criteria apply — checked BEFORE the other raw
+        # keys, same pattern as the other drill artifacts.
+        judge_fleet(line)
+        bad = [n for n, ok in checks if not ok]
+        print("RESULT: " + ("FLEET CRITERIA PASS" if not bad
+                            else f"failing: {', '.join(bad)}"))
+        return 0 if not bad else 1
+
     if "queue_p50_speedup" in line and "metric" not in line:
         # A raw dispatch_pipeline_drill_run artifact (no bench.py
         # envelope): only the config20 criteria apply — checked BEFORE
@@ -1738,6 +1854,13 @@ def main() -> int:
             check("dispatch_pipeline_leg_ran", False,
                   f"config20_dispatch_pipeline crashed: "
                   f"{line['config_errors']['config20_dispatch_pipeline']}")
+        fd = detail.get("fleet")
+        if fd:
+            judge_fleet(fd)
+        elif "config21_fleet" in (line.get("config_errors") or {}):
+            check("fleet_leg_ran", False,
+                  f"config21_fleet crashed: "
+                  f"{line['config_errors']['config21_fleet']}")
         bad = [n for n, ok in checks if not ok]
         print("RESULT: " + ("SERVING CRITERIA PASS" if not bad
                             else f"failing: {', '.join(bad)}"))
@@ -1922,6 +2045,18 @@ def main() -> int:
         check("dispatch_pipeline_leg_ran", False,
               f"config20_dispatch_pipeline crashed: "
               f"{line['config_errors']['config20_dispatch_pipeline']}")
+
+    fdl = detail.get("fleet")
+    if fdl:
+        # Fleet chaos drill (config21, PR 18) — same presence rule:
+        # judge it wherever it ran (workers always pin --platform cpu;
+        # the in-process pose anchor self-gates on the parent backend
+        # inside judge_fleet).
+        judge_fleet(fdl)
+    elif "config21_fleet" in (line.get("config_errors") or {}):
+        check("fleet_leg_ran", False,
+              f"config21_fleet crashed: "
+              f"{line['config_errors']['config21_fleet']}")
 
     spec = detail.get("specialization")
     cfg_errs = line.get("config_errors") or {}

@@ -1,0 +1,43 @@
+"""Seeded lock-discipline FAILURE fixture (PR 18): the proxy/fleet-
+shaped hazard — a drain path and a routing path that nest the same two
+locks in OPPOSITE orders through innocent-looking helper calls. Each
+method's own nesting is one level deep and looks fine in isolation;
+only the intra-class call graph (drain -> _pick takes the route lock
+under the drain lock, route -> _note_drain takes the drain lock under
+the route lock) closes the cycle two threads deadlock on."""
+
+import threading
+
+
+class FleetProxy:
+    def __init__(self):
+        self._route_lock = threading.Lock()
+        self._drain_lock = threading.Lock()
+        self._backends = {}
+        self._draining = set()
+
+    def _pick(self):
+        with self._route_lock:
+            for name in sorted(self._backends):
+                if name not in self._draining:
+                    return name
+        return None
+
+    def _note_drain(self, name):
+        with self._drain_lock:
+            self._draining.add(name)
+
+    def drain_backend(self, name):
+        # BAD: calls the routing helper with the drain lock held — the
+        # edge _drain_lock -> _route_lock.
+        with self._drain_lock:
+            self._draining.add(name)
+            return self._pick()
+
+    def route(self, name):
+        # BAD: marks the backend draining with the route lock held —
+        # the opposite edge _route_lock -> _drain_lock.
+        with self._route_lock:
+            if name not in self._backends:
+                self._note_drain(name)
+            return self._backends.get(name)

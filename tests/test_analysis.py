@@ -237,6 +237,30 @@ def test_subject_store_lock_graph_is_clean_on_head():
     assert check_lock_discipline(path, order=()) == []
 
 
+def test_seeded_proxy_drain_route_cycle_is_caught():
+    """Satellite (PR 18): the proxy/fleet-shaped hazard — a drain path
+    and a routing path nesting the same two locks in opposite orders
+    through helper calls (each method clean in isolation; the
+    intra-class call graph closes the cycle) — fires the cycle rule."""
+    findings = check_lock_discipline(
+        FIXTURES / "bad_proxy_lock_cycle.py", order=())
+    assert findings, "the seeded proxy cycle fixture must fail"
+    assert any("cycle" in f.message for f in findings)
+    assert any("_route_lock" in f.message and "_drain_lock" in f.message
+               for f in findings)
+
+
+def test_edge_proxy_fleet_lock_graphs_are_clean_on_head():
+    """Satellite (PR 18): the lock checker's scope covers the fleet
+    front tier — edge/proxy.py (loop-thread state + drain coordination)
+    and edge/fleet.py (worker supervision) must never grow a cycle or
+    a re-acquire through refactors; `mano analyze` scans them via the
+    edge/ glob, this pins the two PR-18 files by name."""
+    edge = REPO_ROOT / "mano_hand_tpu" / "edge"
+    assert check_lock_discipline(edge / "proxy.py", order=()) == []
+    assert check_lock_discipline(edge / "fleet.py", order=()) == []
+
+
 def test_good_lock_fixture_and_real_engine_are_clean():
     assert check_lock_discipline(FIXTURES / "good_locks.py") == []
     assert check_lock_discipline() == []   # serving/engine.py, HEAD

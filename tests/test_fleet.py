@@ -1,0 +1,559 @@
+"""The fleet front tier (PR 18): proxy routing, failover semantics,
+live stream migration, and the chaos-drill protocol.
+
+Every routing/failover assertion here crosses TWO loopback sockets
+(client -> EdgeProxy -> backend). Deterministic backend failure modes
+come from raw threaded socket stubs — a stub can die at EXACTLY the
+byte the test needs (before the reply, mid-stream, with a canned 429)
+— while real ``EdgeServer``s provide the healthy siblings, so the
+failover target is always the genuine wire path. The semantic bars:
+
+* dead at CONNECT -> silent idempotent re-route (counted, invisible);
+* dead AFTER dispatch -> 502 ``upstream`` to the client, NEVER retried
+  (a fully-received body WILL be dispatched — retrying double-submits);
+* 429 + Retry-After relayed verbatim (PR-5 backpressure end to end);
+* the migration race: a frame IN FLIGHT when the backend dies is
+  re-sent on a sibling and the client sees one continuous stream;
+* ``drain_backend`` (rolling deploy) hands live streams to siblings
+  warm-started via ``resume_pose`` — bit-equal poses, continuous frame
+  numbering, spans balanced on the drained worker;
+* the proxied /healthz aggregate + ``mano status --server`` over it;
+* ``SubjectStore.resize_warm`` (the serve-time warm-capacity knob);
+* the config21 drill protocol itself at plumbing size (3 real worker
+  processes — the one test here that pays for subprocess boots).
+
+Canonical runner: `make fleet-smoke` (own pytest process +
+compile-cache dir, wired into `make check`) — slow-marked, so the
+tier-1 `-m 'not slow'` lane skips it by design; `make test`
+--ignore's it for the same reason. Worker SUBPROCESSES never share
+this process's compile cache: ``fleet_drill_run`` gives each worker
+its own ``MANO_TEST_CACHE_DIR`` (the XLA executable-deserialization
+crash class is two processes on one cache dir — CLAUDE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mano_hand_tpu.edge import (
+    Backend,
+    EdgeClient,
+    EdgeError,
+    EdgeProxy,
+    EdgeServer,
+    protocol as proto,
+)
+from mano_hand_tpu.obs import Tracer
+from mano_hand_tpu.serving.engine import ServingEngine
+from mano_hand_tpu.serving.subject_store import (
+    SubjectStore,
+    SubjectStoreConfig,
+    subject_digest,
+)
+from mano_hand_tpu.utils.profiling import ServingCounters
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+def _betas(seed=1):
+    return np.random.default_rng(seed).normal(size=(10,)).astype(
+        np.float32)
+
+
+def _target(params32, betas, seed=2):
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.models import core
+
+    pose = np.random.default_rng(seed).normal(
+        scale=0.2, size=(16, 3)).astype(np.float32)
+    out = core.jit_forward(params32.device_put(), jnp.asarray(pose),
+                           jnp.asarray(betas))
+    return np.asarray(out.posed_joints)
+
+
+def _free_port() -> int:
+    """A port that was just bound and released: connecting to it is
+    (near-certainly) refused — the dead-at-connect backend."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# --------------------------------------------------------------- stubs
+class _StubBackend:
+    """A raw threaded TCP server that fails exactly where told.
+
+    ``mode``:
+      * ``"die_after_request"`` — read the full HTTP request, then
+        close without one reply byte (dead AFTER dispatch);
+      * ``"shed_429"`` — read the request, answer a canned 429 with
+        ``Retry-After: 7`` and a structured shed body;
+      * ``"stream_die_first_frame"`` — speak the stream upgrade + open
+        handshake, then close the socket the moment the first frame
+        line arrives (the migration race: that frame is IN FLIGHT).
+    """
+
+    def __init__(self, mode: str):
+        self.mode = mode
+        self.connections = 0
+        self.requests = 0
+        self.frames_seen = 0
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            self.connections += 1
+            threading.Thread(target=self._serve_one, args=(conn,),
+                             daemon=True).start()
+
+    def _read_http_request(self, rf) -> bool:
+        """Consume one request head + Content-Length body; False on a
+        closed socket."""
+        length = 0
+        line = rf.readline()
+        if not line:
+            return False
+        while True:
+            h = rf.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = h.decode("latin-1").partition(":")
+            if name.strip().lower() == "content-length":
+                length = int(value.strip())
+        if length:
+            rf.read(length)
+        self.requests += 1
+        return True
+
+    def _serve_one(self, conn: socket.socket):
+        conn.settimeout(30.0)
+        rf = conn.makefile("rb")
+        try:
+            if self.mode == "die_after_request":
+                if self._read_http_request(rf):
+                    pass                # fall through: close, no reply
+            elif self.mode == "shed_429":
+                if self._read_http_request(rf):
+                    body = proto.dumps(proto.error_body(
+                        "shed", "stub shed", phase="admission"))
+                    conn.sendall(
+                        (f"HTTP/1.1 429 Too Many Requests\r\n"
+                         f"Content-Type: application/json\r\n"
+                         f"Retry-After: 7\r\n"
+                         f"Content-Length: {len(body)}\r\n"
+                         f"Connection: close\r\n\r\n").encode("latin-1")
+                        + body)
+            elif self.mode == "stream_die_first_frame":
+                if not self._read_http_request(rf):
+                    return
+                conn.sendall(
+                    (f"HTTP/1.1 101 Switching Protocols\r\n"
+                     f"Upgrade: {proto.STREAM_UPGRADE}\r\n"
+                     f"Connection: Upgrade\r\n\r\n").encode("latin-1"))
+                open_line = rf.readline()       # the {"op": "open"}
+                if not open_line:
+                    return
+                conn.sendall(proto.dumps(
+                    {"event": "open", "stream_id": "stub-0"}) + b"\n")
+                frame_line = rf.readline()      # first frame: die NOW,
+                if frame_line:                  # reply never sent
+                    self.frames_seen += 1
+        except OSError:
+            pass
+        finally:
+            for closer in (rf.close, conn.close):
+                try:
+                    closer()
+                except OSError:
+                    pass
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+# ------------------------------------------------------------ fixtures
+def _engine(params32, tracer):
+    eng = ServingEngine(params32, max_bucket=4, max_delay_s=0.001,
+                        max_queued=32, tracer=tracer)
+    eng.start()
+    return eng
+
+
+@pytest.fixture()
+def live_backend(params32):
+    """One real engine + edge server (the healthy failover target)."""
+    tracer = Tracer()
+    eng = _engine(params32, tracer)
+    srv = EdgeServer(eng, port=0).start()
+    yield eng, srv, tracer
+    srv.drain(timeout_s=10.0)
+    acc = tracer.accounting()
+    assert acc["spans_started"] == acc["spans_closed"]
+    assert acc["spans_open"] == 0
+
+
+@pytest.fixture()
+def live_pair(params32):
+    """Two real backends — the drain test needs a genuine sibling on
+    BOTH sides of the migration."""
+    tracers = [Tracer(), Tracer()]
+    engs = [_engine(params32, t) for t in tracers]
+    srvs = [EdgeServer(e, port=0).start() for e in engs]
+    yield engs, srvs, tracers
+    for srv in srvs:
+        srv.drain(timeout_s=10.0)
+    for t in tracers:
+        acc = t.accounting()
+        assert acc["spans_started"] == acc["spans_closed"]
+        assert acc["spans_open"] == 0
+
+
+def _proxy_over(*backends) -> EdgeProxy:
+    return EdgeProxy(list(backends), upstream_timeout_s=120.0).start()
+
+
+# ----------------------------------------------- one-shot failover
+def test_backend_dead_at_connect_reroutes_silently(live_backend,
+                                                   params32):
+    """A backend that refuses the CONNECT was never dispatched: the
+    proxy re-routes the same request to a sibling and the client never
+    learns — the idempotent retry is counted, not surfaced."""
+    eng, srv, _tr = live_backend
+    # Stub names sort before the live worker: _pick's deterministic
+    # name tie-break routes the first attempt AT the dead backend.
+    px = _proxy_over(Backend("a_dead", "127.0.0.1", _free_port()),
+                     Backend("b_live", "127.0.0.1", srv.port))
+    cli = EdgeClient("127.0.0.1", px.port, timeout_s=120.0)
+    try:
+        betas = _betas(seed=3)
+        pose = np.random.default_rng(4).normal(
+            scale=0.3, size=(16, 3)).astype(np.float32)
+        via_proxy = cli.forward(pose, shape=betas)
+        direct = EdgeClient("127.0.0.1", srv.port, timeout_s=120.0)
+        try:
+            via_worker = direct.forward(pose, shape=betas)
+        finally:
+            direct.close()
+        assert np.array_equal(via_proxy, via_worker)    # bitwise
+        assert px.reroutes >= 1
+        assert px.upstream_failures == 0
+    finally:
+        cli.close()
+        px.drain(timeout_s=10.0)
+
+
+def test_backend_dead_after_dispatch_maps_502_no_retry(live_backend,
+                                                       params32):
+    """Once the connect succeeded, the body may have been dispatched:
+    the failure surfaces as 502 ``upstream`` and is NEVER re-routed —
+    a silent retry here would double-submit."""
+    _eng, srv, _tr = live_backend
+    stub = _StubBackend("die_after_request")
+    px = _proxy_over(Backend("a_stub", "127.0.0.1", stub.port),
+                     Backend("b_live", "127.0.0.1", srv.port))
+    cli = EdgeClient("127.0.0.1", px.port, timeout_s=120.0)
+    try:
+        pose = np.random.default_rng(5).normal(
+            scale=0.3, size=(16, 3)).astype(np.float32)
+        with pytest.raises(EdgeError) as ei:
+            cli.forward(pose, shape=_betas(seed=6))
+        assert ei.value.status == 502
+        assert ei.value.kind == "upstream"
+        assert px.upstream_failures == 1
+        assert px.reroutes == 0         # dispatched -> not idempotent
+        assert stub.requests == 1       # exactly one delivery attempt
+    finally:
+        cli.close()
+        px.drain(timeout_s=10.0)
+        stub.stop()
+
+
+def test_429_retry_after_passthrough(live_backend):
+    """A worker's PR-5 shed crosses the proxy verbatim: status, kind,
+    and the Retry-After header all reach the client untouched."""
+    _eng, srv, _tr = live_backend
+    stub = _StubBackend("shed_429")
+    px = _proxy_over(Backend("a_stub", "127.0.0.1", stub.port),
+                     Backend("b_live", "127.0.0.1", srv.port))
+    cli = EdgeClient("127.0.0.1", px.port, timeout_s=120.0)
+    try:
+        pose = np.random.default_rng(7).normal(
+            scale=0.3, size=(16, 3)).astype(np.float32)
+        with pytest.raises(EdgeError) as ei:
+            cli.forward(pose, shape=_betas(seed=8))
+        assert ei.value.status == 429
+        assert ei.value.kind == "shed"
+        assert ei.value.retry_after_s == 7
+        # A structured backend ANSWER is not a failure: no counter
+        # moved, the breaker stayed closed.
+        assert px.upstream_failures == 0
+        assert px.reroutes == 0
+    finally:
+        cli.close()
+        px.drain(timeout_s=10.0)
+        stub.stop()
+
+
+# ------------------------------------------------------ stream failover
+def test_stream_migration_race_frame_in_flight(live_backend, params32):
+    """The backend dies with a frame IN FLIGHT (sent, reply pending).
+    The reply never reached the client, so re-sending the frame on a
+    sibling is NOT a double submit — the client must see one
+    continuous, correct stream and never learn."""
+    eng, srv, _tr = live_backend
+    stub = _StubBackend("stream_die_first_frame")
+    px = _proxy_over(Backend("a_stub", "127.0.0.1", stub.port),
+                     Backend("b_live", "127.0.0.1", srv.port))
+    cli = EdgeClient("127.0.0.1", px.port, timeout_s=120.0)
+    try:
+        betas = _betas(seed=11)
+        target = _target(params32, betas, seed=12)
+        with cli.open_stream(betas=betas) as ws:
+            wire = [ws.frame(target) for _ in range(3)]
+        assert stub.frames_seen == 1        # the in-flight casualty
+        assert px.migrations == 1
+        assert px.migrated_frames == 1
+        sess = eng.open_stream(betas)
+        try:
+            for i in range(3):
+                ref = sess.step(target)
+                assert wire[i].frame == i == ref.frame  # continuous
+                assert np.array_equal(wire[i].pose, ref.pose)
+                np.testing.assert_allclose(wire[i].verts, ref.verts,
+                                           atol=1e-6, rtol=0)
+        finally:
+            sess.close()
+    finally:
+        cli.close()
+        px.drain(timeout_s=10.0)
+        stub.stop()
+
+
+def test_drain_backend_migrates_live_stream_warm(live_pair, params32):
+    """Rolling deploy: ``drain_backend`` proactively hands a parked
+    live stream to a sibling, warm-started at the last confirmed pose
+    (``resume_pose``). The client's next frames continue the SAME pose
+    chain with continuous numbering; the drained worker's spans
+    balance (the polite close closed its session exactly once)."""
+    engs, srvs, tracers = live_pair
+    px = _proxy_over(Backend("a_live", "127.0.0.1", srvs[0].port),
+                     Backend("b_live", "127.0.0.1", srvs[1].port))
+    cli = EdgeClient("127.0.0.1", px.port, timeout_s=120.0)
+    ws = None
+    try:
+        betas = _betas(seed=21)
+        target = _target(params32, betas, seed=22)
+        ws = cli.open_stream(betas=betas)       # lands on a_live
+        first = ws.frame(target)
+        assert first.frame == 0
+        assert len(px.backends()["a_live"].streams) == 1
+        report = px.drain_backend("a_live", timeout_s=30.0)
+        assert report["clean"] is True
+        assert report["streams_migrated"] == 1
+        # The drain returns the moment the old worker holds no proxied
+        # work (it is then safe to SIGTERM); the sibling re-open
+        # completes moments later — bounded wait, not a sleep.
+        deadline = time.monotonic() + 10.0
+        while px.migrations < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert px.migrations == 1
+        assert len(px.backends()["b_live"].streams) == 1
+        rest = [ws.frame(target) for _ in range(2)]
+        wire = [first] + rest
+        # The uninterrupted in-process session is the reference: the
+        # resume_pose warm start must reproduce its POSE chain exactly
+        # (pose IS the migrated fit state; verts get the f32 anchor
+        # tolerance — see fleet_drill_run's parity note).
+        sess = engs[0].open_stream(betas)
+        try:
+            for i in range(3):
+                ref = sess.step(target)
+                assert wire[i].frame == i == ref.frame
+                assert np.array_equal(wire[i].pose, ref.pose)
+                np.testing.assert_allclose(wire[i].verts, ref.verts,
+                                           atol=1e-6, rtol=0)
+        finally:
+            sess.close()
+        ws.close()
+        ws = None
+        # The drained worker closed its half of the handoff span-once.
+        acc = tracers[0].accounting()
+        assert acc["spans_started"] == acc["spans_closed"]
+        assert acc["spans_open"] == 0
+        assert acc["spans_double_closed"] == 0
+    finally:
+        if ws is not None:
+            ws.abort()
+        cli.close()
+        px.drain(timeout_s=10.0)
+
+
+# ------------------------------------------------- healthz + status CLI
+def test_proxy_healthz_aggregate_and_status_cli(live_pair, tmp_path):
+    """The proxied /healthz carries the per-backend aggregate, and
+    ``mano status --server`` pointed at a PROXY surfaces it (rc 0,
+    bounded) — the operator's one look at fleet health."""
+    _engs, srvs, _trs = live_pair
+    px = _proxy_over(Backend("a_live", "127.0.0.1", srvs[0].port),
+                     Backend("b_live", "127.0.0.1", srvs[1].port))
+    cli = EdgeClient("127.0.0.1", px.port, timeout_s=120.0)
+    try:
+        h = cli.healthz()
+        assert h["ok"] is True
+        assert h["role"] == "proxy"
+        assert set(h["backends"]) == {"a_live", "b_live"}
+        for b in h["backends"].values():
+            assert b["ok"] is True
+            assert b["breaker"] == "healthy"
+        env = dict(os.environ)
+        env["TF_CPP_MIN_LOG_LEVEL"] = "3"
+        # Its own cache dir: the subprocess must never share this
+        # pytest process's compile cache (CLAUDE.md crash class).
+        env["MANO_TEST_CACHE_DIR"] = str(tmp_path / "jax_cache_status")
+        res = subprocess.run(
+            [sys.executable, "-m", "mano_hand_tpu.cli", "status",
+             "--platforms", "cpu", "--server", f"127.0.0.1:{px.port}",
+             "--server-timeout", "30.0"],
+            capture_output=True, text=True, timeout=300, env=env)
+        assert res.returncode == 0, res.stderr[-2000:]
+        report = json.loads(res.stdout)
+        blk = report["server"]
+        assert blk["ok"] is True
+        assert blk["role"] == "proxy"
+        assert set(blk["backends"]) == {"a_live", "b_live"}
+        assert blk["counters"]["requests_proxied"] >= 1
+    finally:
+        cli.close()
+        px.drain(timeout_s=10.0)
+
+
+# -------------------------------------------------- warm-capacity knob
+def _store_row(betas):
+    return {"v_shaped": np.zeros((4, 3), np.float32),
+            "joints": np.zeros((2, 3), np.float32),
+            "shape": betas}
+
+
+def test_resize_warm_shrink_evicts_lru_first_counted():
+    store = SubjectStore(SubjectStoreConfig(warm_capacity=8))
+    counters = ServingCounters()
+    store.bind(counters)
+    digests = []
+    for i in range(5):
+        betas = _betas(seed=100 + i)
+        d = subject_digest(betas)
+        digests.append(d)
+        store.demote(d, _store_row(betas))
+    # Touch 0 and 1: they become MRU; 2..4 are now the LRU victims.
+    assert store.fetch_row(digests[0]) is not None
+    assert store.fetch_row(digests[1]) is not None
+    store.demote(digests[0], _store_row(_betas(seed=100)))
+    store.demote(digests[1], _store_row(_betas(seed=101)))
+    report = store.resize_warm(2)
+    assert report == {"warm_capacity": 2, "previous": 8, "evicted": 3}
+    assert counters.subject_store_resize_evictions == 3
+    assert set(store.warm_digests()) == {digests[0], digests[1]}
+    # No cold tier configured: the victims are gone, and re-entry is
+    # the documented degradation (a counted miss -> re-bake upstream).
+    assert store.fetch_row(digests[2]) is None
+
+
+def test_resize_warm_grow_evicts_nothing():
+    store = SubjectStore(SubjectStoreConfig(warm_capacity=2))
+    store.bind(ServingCounters())
+    for i in range(2):
+        betas = _betas(seed=200 + i)
+        store.demote(subject_digest(betas), _store_row(betas))
+    report = store.resize_warm(64)
+    assert report["evicted"] == 0
+    assert len(store.warm_digests()) == 2
+
+
+def test_resize_warm_rejects_nonpositive():
+    store = SubjectStore(SubjectStoreConfig(warm_capacity=4))
+    with pytest.raises(ValueError, match="warm_capacity"):
+        store.resize_warm(0)
+
+
+def test_engine_store_warm_capacity_kwarg(params32):
+    """The engine kwarg rides the same runtime-resize path the serve
+    flag does — a shrink against a pre-populated store evicts
+    LRU-first, counted."""
+    store = SubjectStore(SubjectStoreConfig(warm_capacity=16))
+    for i in range(6):
+        betas = _betas(seed=300 + i)
+        store.demote(subject_digest(betas), _store_row(betas))
+    eng = ServingEngine(params32, max_bucket=4, subject_store=store,
+                        store_warm_capacity=4)
+    assert store.config.warm_capacity == 4
+    assert len(store.warm_digests()) == 4
+    assert eng.counters.subject_store_resize_evictions == 2
+
+
+def test_engine_store_warm_capacity_requires_store(params32):
+    with pytest.raises(ValueError, match="store_warm_capacity"):
+        ServingEngine(params32, max_bucket=4, store_warm_capacity=8)
+
+
+# -------------------------------------------------- the drill protocol
+def test_fleet_drill_protocol_plumbing(params):
+    """config21's protocol end to end at plumbing size: 3 REAL worker
+    processes cold-booting from the baked per-lane lattice, a SIGKILL
+    mid-wave, a drain under live streams — every judged invariant must
+    already hold here, far from the scarce chip."""
+    from mano_hand_tpu.serving.measure import fleet_drill_run
+
+    fd = fleet_drill_run(
+        params, workers=3, lanes=2, streams=4, frames_per_stream=3,
+        stream_workers=4, unique_tracks=2, max_bucket=4,
+        max_subjects=16, store_warm_capacity=8, drain_budget_s=30.0,
+        ready_timeout_s=420.0)
+    assert fd["fleet_drill_schema"] == 1
+    assert fd["cold_boot_zero_compiles"] is True
+    assert fd["terminal_fraction"] == 1.0
+    assert fd["outcomes"]["exception"] == 0
+    assert fd["closes_ok"] == 4
+    assert fd["frames_compared"] == fd["frame_numbering_ok"] > 0
+    assert fd["intra_fleet_pose_max_abs_err"] == 0.0
+    assert fd["wire_vs_inprocess_pose_max_abs_err"] == 0.0
+    assert fd["intra_fleet_max_abs_err"] <= 1e-6
+    assert fd["wire_vs_inprocess_max_abs_err"] <= 1e-6
+    assert fd["steady_recompiles_total"] == 0
+    assert fd["aot_load_failures_total"] == 0
+    assert fd["spans_closed_exactly_once"] is True
+    assert fd["drain"]["clean"] is True
+    assert fd["drain"]["streams_migrated"] == fd["drain"][
+        "streams_hosted"]
