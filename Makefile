@@ -25,12 +25,13 @@
 	overload-smoke coldstart-smoke obs-smoke metrics-smoke \
 	posed-kernel-smoke stream-smoke lanes-smoke precision-smoke \
 	edge-smoke subject-store-smoke bench-smoke examples-smoke \
-	fleet-smoke analyze
+	fleet-smoke control-smoke analyze
 
 check: analyze test chaos-smoke coalesce-smoke overload-smoke \
 	coldstart-smoke obs-smoke metrics-smoke posed-kernel-smoke \
 	stream-smoke lanes-smoke precision-smoke edge-smoke \
-	subject-store-smoke fleet-smoke bench-smoke examples-smoke
+	subject-store-smoke fleet-smoke control-smoke bench-smoke \
+	examples-smoke
 
 # tests/test_runtime.py is excluded here and covered by the chaos-smoke
 # prerequisite instead (its own pytest process + cache dir): `make
@@ -60,6 +61,7 @@ test:
 	  --ignore=tests/test_edge.py \
 	  --ignore=tests/test_subject_store.py \
 	  --ignore=tests/test_fleet.py \
+	  --ignore=tests/test_control.py \
 	  --ignore=tests/test_examples.py
 
 # Seconds-scale pre-commit lane: the core-correctness modules (parity vs
@@ -150,7 +152,10 @@ bench-interpret:
 	  --pipeline-trials 1 --pipeline-max-bucket 8 \
 	  --fleet-streams 6 --fleet-frames 3 --fleet-stream-workers 4 \
 	  --fleet-tracks 3 --fleet-max-bucket 4 --fleet-max-subjects 16 \
-	  --fleet-drain-budget 20
+	  --fleet-drain-budget 20 \
+	  --control-pairs 1 --control-trace-s 0.8 --control-workers 8 \
+	  --control-max-bucket 4 --control-max-queued 8 \
+	  --control-tier1-quota 2
 
 # Serving-leg smoke (the bench-interpret counterpart for config7): the
 # whole serving-engine plumbing — bucket warm-up, ragged request stream,
@@ -404,6 +409,22 @@ subject-store-smoke:
 fleet-smoke:
 	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_fleet \
 	  python -m pytest tests/test_fleet.py -q
+
+# Closed-loop control (the PR-19 tentpole): the adaptive controller's
+# actuation bounds (hysteresis, rate limit, saturation), the engine's
+# live setters + torn-snapshot atomicity of load()["control"], the
+# crash contract (revert to static defaults, never wedge admission),
+# the traffic generator's byte-identical determinism, the edge
+# retry_after_source plumbing, and the config22 drill protocol at
+# plumbing size. Wired into `make check` as a SEPARATE pytest process
+# on its own compile-cache dir (the CLAUDE.md rule: two pytest
+# processes must never share .jax_compile_cache/). Slow-marked legs
+# skip the tier-1 `-m 'not slow'` lane by design (the PR-8 budget
+# precedent); the pure-logic tests carry `quick` and ride
+# `make check-quick`.
+control-smoke:
+	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_control \
+	  python -m pytest tests/test_control.py -q
 
 # Every example end-to-end (tiny sizes, CPU) — the public-surface
 # anti-rot gate. Moved out of the tier-1 lane in the PR-13 budget

@@ -596,7 +596,8 @@ def run_benchmarks(args, device_str: str) -> dict:
                                               "config18_edge",
                                               "config19_subject_store",
                                               "config20_dispatch_pipeline",
-                                              "config21_fleet"):
+                                              "config21_fleet",
+                                              "config22_control"):
             return
         try:
             fn()
@@ -2585,6 +2586,54 @@ def run_benchmarks(args, device_str: str) -> dict:
     if args.fleet_streams > 0:
         section("config21_fleet", config21_fleet)
 
+    # -- config 22: closed-loop control drill (PR 19) -----------------------
+    # THE adaptive-control protocol (serving/measure.py:
+    # control_drill_run): the serving.control.Controller versus its own
+    # static defaults on ONE seeded flash-crowd trace
+    # (serving/traffic.py), replayed through a live edge.EdgeServer on
+    # interleaved paired legs, plus a controller-crash leg mid-crowd.
+    # Criteria (scripts/bench_report.py:judge_control) are all
+    # CPU-defined — saturation is a chaos throttle and the sockets are
+    # loopback, no chip involved: controlled tier-0 goodput >= the
+    # static baseline on the pooled pairs AND controlled tier-1 served
+    # STRICTLY greater (same arrivals — the digest in the artifact is
+    # the determinism receipt), zero steady recompiles every leg,
+    # every actuation evented (runtime-event count == the counter
+    # ledger), spans closed exactly once per leg, and the crash leg
+    # reverted to static defaults with 100% of requests reaching an
+    # HTTP terminal (a dead controller degrades to today's behavior,
+    # never wedges admission).
+    def config22_control():
+        from mano_hand_tpu.serving.measure import control_drill_run
+
+        cd = control_drill_run(
+            right,
+            trace_duration_s=args.control_trace_s,
+            pairs=args.control_pairs,
+            workers=args.control_workers,
+            max_bucket=args.control_max_bucket,
+            max_queued=args.control_max_queued,
+            tier1_quota=args.control_tier1_quota,
+            seed=61,
+            log=lambda m: log(f"config22 {m}"),
+        )
+        results["control"] = cd
+        cl = cd["crash_leg"]
+        log(f"config22 control: {cd['pairs']} pairs on "
+            f"{cd['trace']['stats']['arrivals']} arrivals, tier-0 "
+            f"goodput {cd['controlled_tier0_goodput']} vs static "
+            f"{cd['static_tier0_goodput']}, tier-1 served "
+            f"{cd['controlled_tier1_served']} vs "
+            f"{cd['static_tier1_served']}, {cd['actuations_total']} "
+            f"actuations evented={cd['actuations_evented']}, "
+            f"{cd['steady_recompiles_total']} steady recompiles, "
+            f"{cd['unresolved_total']} unresolved, crash reverted="
+            f"{cl['reverted_to_static']}, spans once "
+            f"{cd['spans_closed_exactly_once']}")
+
+    if args.control_pairs > 0:
+        section("config22_control", config22_control)
+
     if args.serving_only:
         # Fast serving-layer artifact (`make serve-smoke`): the deferred
         # runner's serving-only skip reduces the schedule to config7
@@ -3079,6 +3128,36 @@ def main() -> int:
     ap.add_argument("--fleet-drain-budget", type=float, default=10.0,
                     help="seconds the config21 rolling-deploy drain "
                          "must finish within (judged)")
+    ap.add_argument("--control-pairs", type=int, default=2,
+                    help="(static, controlled) leg pairs of the "
+                         "closed-loop control drill (config22, PR 19: "
+                         "the adaptive controller vs its own static "
+                         "defaults on one seeded flash-crowd trace "
+                         "through a live loopback edge, plus a "
+                         "controller-crash leg; 0 skips the config, "
+                         "and the tiny-e2e bench tests pass 0 to keep "
+                         "the seconds-long paced replays out of that "
+                         "lane)")
+    ap.add_argument("--control-trace-s", type=float, default=2.5,
+                    help="seconds of the config22 flash-crowd trace "
+                         "(every leg replays the same seeded "
+                         "arrivals, paced to their offsets)")
+    ap.add_argument("--control-workers", type=int, default=24,
+                    help="wire-client worker pool of config22 (one "
+                         "persistent connection each; must exceed "
+                         "max-queued or overload never materializes "
+                         "through blocking clients)")
+    ap.add_argument("--control-max-bucket", type=int, default=8,
+                    help="bucket ceiling of config22's engines")
+    ap.add_argument("--control-max-queued", type=int, default=16,
+                    help="admission bound of config22's engines (the "
+                         "static default the controller steers "
+                         "around and the crash leg must revert to)")
+    ap.add_argument("--control-tier1-quota", type=int, default=4,
+                    help="static tier-1 quota of config22 (the "
+                         "baseline the controller must beat on "
+                         "tier-1 served without losing tier-0 "
+                         "goodput)")
     ap.add_argument("--spec-batch", type=int, default=256,
                     help="batch for the specialization leg's full-vs-"
                          "pose-only forward comparison (config8); "

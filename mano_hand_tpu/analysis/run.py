@@ -87,9 +87,23 @@ def run_analysis(
     locks += check_lock_discipline(
         root / "mano_hand_tpu" / "serving" / "subject_store.py",
         order=())
+    # PR 19: the closed-loop controller's one LEAF lock (actuation
+    # ledger + snapshot values share ONE hold; engine setters run
+    # OUTSIDE it — the actuate-vs-load() cycle the seeded fixture in
+    # tests/fixtures/analysis/ deadlocks on) and the traffic
+    # generator (no locks by design; pinned here so a refactor that
+    # grows one gets cycle-checked from day one). The policy linter's
+    # wallclock-deadline rule scans both via the package rglob — the
+    # controller's cadence/rate-limit arithmetic is exactly the
+    # monotonic-only territory that rule exists for.
+    locks += check_lock_discipline(
+        root / "mano_hand_tpu" / "serving" / "control.py", order=())
+    locks += check_lock_discipline(
+        root / "mano_hand_tpu" / "serving" / "traffic.py", order=())
     sections.append(("lock-discipline", locks,
                      "serving/engine.py + serving/streams.py + "
                      "serving/lanes.py + serving/subject_store.py + "
+                     "serving/control.py + serving/traffic.py + "
                      "edge/ + obs/ nesting graphs + call edges"))
 
     step = check_lockstep(baseline.get("lockstep", {}))

@@ -1379,6 +1379,14 @@ def cmd_serve(args) -> int:
     recorder = FlightRecorder(tracer, eng.counters,
                               out_dir=args.flight_dir or None)
     registry = engine_registry(eng, tracer=tracer)
+    # --control (PR 19): attach the closed-loop controller; its
+    # retry_after_for also becomes the edge's 429 Retry-After source.
+    ctl = None
+    if getattr(args, "control", False):
+        from mano_hand_tpu.serving.control import Controller
+
+        ctl = Controller(eng, log=lambda m: print(
+            f"control: {m}", file=sys.stderr))
 
     lock_mode = args.device_lock
     if lock_mode == "auto":
@@ -1401,9 +1409,37 @@ def cmd_serve(args) -> int:
             eng.start()
             if not args.no_warmup:
                 eng.warmup()
+            # --warm-streams (PR 19, the PR-18 scale-up remainder):
+            # exercise ONE synthetic stream — specialize, fit a frame,
+            # close — BEFORE the ready line, so a scale-up worker's
+            # first real stream frame pays zero compiles. The
+            # fit-stage programs are deliberately NOT in the AOT
+            # lattice (per-stream LM, shapes frozen at open — the
+            # PR-18 dead-end), so a live warm pass is the only way to
+            # pre-pay them. Best-effort: a failure logs and boots the
+            # worker cold rather than not at all.
+            if getattr(args, "warm_streams", False):
+                try:
+                    sess = eng.open_stream(
+                        np.zeros((params.n_shape,), np.float32))
+                    try:
+                        sess.submit_frame(
+                            np.zeros((params.n_joints, 3), np.float32)
+                        ).result(timeout=300)
+                    finally:
+                        sess.close()
+                    print("warm-streams: stream-fit family warm",
+                          file=sys.stderr)
+                except Exception as e:  # noqa: BLE001 — cold > dead
+                    print(f"warm-streams failed (worker boots cold): "
+                          f"{type(e).__name__}: {e}", file=sys.stderr)
+            if ctl is not None:
+                ctl.start()
             srv = EdgeServer(
                 eng, host=args.host, port=args.port, registry=registry,
                 drain_timeout_s=args.drain_timeout_s,
+                retry_after_source=(None if ctl is None
+                                    else ctl.retry_after_for),
                 log=lambda m: print(m, file=sys.stderr)).start()
             print(json.dumps({
                 "edge": {"host": srv.host, "port": srv.port,
@@ -1414,6 +1450,8 @@ def cmd_serve(args) -> int:
             # in one C-level acquire).
             while not stop_evt.wait(0.5):
                 pass
+            if ctl is not None:
+                ctl.stop()
             report = srv.drain(timeout_s=args.drain_timeout_s)
             report["incident_captures"] = len(recorder.captures)
             # Cross-process telemetry (PR 18): the fleet drill judges
@@ -2106,6 +2144,17 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--no-warmup", action="store_true",
                     help="skip the boot-time bucket warmup (compiles "
                          "then land in the first requests)")
+    sv.add_argument("--warm-streams", action="store_true",
+                    help="exercise one synthetic stream fit before "
+                         "the ready line (PR 19): a scale-up worker's "
+                         "first real frame pays zero compiles (the "
+                         "fit-stage programs are not in the AOT "
+                         "lattice)")
+    sv.add_argument("--control", action="store_true",
+                    help="attach the closed-loop controller (PR 19): "
+                         "live quota/coalesce/Retry-After actuation "
+                         "off burn rates; crash degrades to the "
+                         "static flags above")
     sv.add_argument("--drain-timeout-s", type=float, default=15.0,
                     help="SIGTERM drain budget: in-flight requests "
                          "resolve, the engine stop() sweep runs, the "

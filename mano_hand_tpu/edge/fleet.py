@@ -46,6 +46,7 @@ class WorkerSpec:
                  aot_dir: str = "",
                  store_warm_capacity: int = 0,
                  no_warmup: bool = False,
+                 warm_streams: bool = False,
                  drain_timeout_s: float = 15.0,
                  device_lock: str = "auto",
                  extra: Sequence[str] = (),
@@ -61,6 +62,7 @@ class WorkerSpec:
         self.aot_dir = aot_dir
         self.store_warm_capacity = int(store_warm_capacity)
         self.no_warmup = bool(no_warmup)
+        self.warm_streams = bool(warm_streams)
         self.drain_timeout_s = float(drain_timeout_s)
         self.device_lock = device_lock
         self.extra = tuple(extra)
@@ -90,6 +92,8 @@ class WorkerSpec:
                     str(self.store_warm_capacity)]
         if self.no_warmup:
             cmd += ["--no-warmup"]
+        if self.warm_streams:
+            cmd += ["--warm-streams"]
         cmd += list(self.extra)
         return cmd
 
@@ -272,6 +276,8 @@ class Fleet:
                  proxy_kwargs: Optional[dict] = None,
                  log: Optional[Callable[[str], None]] = None):
         self._log = log or (lambda m: None)
+        self._env = env
+        self._stderr_dir = stderr_dir
         self.workers: Dict[str, WorkerProc] = {}
         for i, spec in enumerate(specs):
             name = f"w{i}"
@@ -300,6 +306,49 @@ class Fleet:
         self.proxy = EdgeProxy(backends, log=self._log,
                                **self._proxy_kwargs).start()
         return self
+
+    def add_worker(self, spec: WorkerSpec, *,
+                   ready_timeout_s: float = 180.0,
+                   stderr_dir: Optional[str] = None,
+                   env: Optional[Dict[str, str]] = None) -> str:
+        """Scale-up: boot one NEW worker and route to it only once it
+        is genuinely warm (the PR-18 "cold stream starts on scale-up
+        workers" remainder).
+
+        The ordering is the contract: the worker boots, runs its full
+        warmup — including, with ``spec.warm_streams``, the in-process
+        stream-fit warm pass (cmd_serve's ``--warm-streams``: the
+        fit-stage programs are NOT in the AOT lattice, per the PR-18
+        dead-end, so the worker exercises one synthetic stream before
+        printing its ready line) — and ONLY THEN is handed to
+        ``proxy.add_backend``, which replays every known specialize
+        before traffic can land. A fresh worker's first real frame
+        pays zero compiles; test_fleet.py pins it via /metrics.
+
+        Returns the new worker's name (``w<N>``, continuing the boot
+        numbering)."""
+        if self.proxy is None:
+            raise RuntimeError("fleet is not started")
+        env = self._env if env is None else env
+        stderr_dir = (self._stderr_dir if stderr_dir is None
+                      else stderr_dir)
+        i = len(self.workers)
+        while f"w{i}" in self.workers:
+            i += 1
+        name = f"w{i}"
+        stderr_path = (os.path.join(stderr_dir, f"{name}.stderr")
+                       if stderr_dir else None)
+        w = WorkerProc(name, spec, env=env, stderr_path=stderr_path,
+                       log=self._log)
+        self.workers[name] = w
+        try:
+            w.start().wait_ready(timeout_s=ready_timeout_s)
+        except RuntimeError:
+            del self.workers[name]
+            raise
+        self.proxy.add_backend(Backend(name, "127.0.0.1", w.port))
+        self._log(f"fleet: added worker {name} on port {w.port}")
+        return name
 
     def kill_worker(self, name: str) -> None:
         """Chaos: SIGKILL one worker. The proxy discovers the death

@@ -148,6 +148,7 @@ class EdgeProxy:
                  probe_timeout_s: float = 2.0,
                  upstream_timeout_s: float = 300.0,
                  max_body_bytes: int = MAX_BODY_BYTES,
+                 retry_after_source: Optional[Callable] = None,
                  log: Optional[Callable[[str], None]] = None):
         self._backends: Dict[str, Backend] = {}
         for i, be in enumerate(backends):
@@ -163,6 +164,14 @@ class EdgeProxy:
         self.probe_timeout_s = float(probe_timeout_s)
         self.upstream_timeout_s = float(upstream_timeout_s)
         self.max_body_bytes = int(max_body_bytes)
+        # Closed-loop control (PR 19): optional ``(tier, load) ->
+        # Optional[int]`` for PROXY-originated 503s (no routable
+        # backend / draining). Worker-originated 429s keep relaying
+        # the worker's own Retry-After verbatim — the worker's
+        # controller owns that value; this source only covers
+        # responses the proxy itself synthesizes (load is None there —
+        # the proxy has no engine). None -> no header, today's wire.
+        self._retry_after_source = retry_after_source
         self._log = log or (lambda m: None)
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._server: Optional[asyncio.AbstractServer] = None
@@ -400,11 +409,30 @@ class EdgeProxy:
             except Exception:  # noqa: BLE001
                 pass
 
+    def _unavailable_headers(self, req: _Request) -> Optional[dict]:
+        """Retry-After for a PROXY-originated 503, from the attached
+        controller source (PR 19). The request's priority header is
+        the tier; the proxy has no engine, so load is None. Any
+        source failure or None opinion -> no header (today's wire)."""
+        if self._retry_after_source is None:
+            return None
+        try:
+            tier = int(req.headers.get(proto.PRIORITY_HEADER, 0))
+        except (TypeError, ValueError):
+            tier = 0
+        try:
+            retry_s = self._retry_after_source(tier, None)
+        except Exception:  # noqa: BLE001 — advisory header only
+            return None
+        return None if retry_s is None else {
+            "Retry-After": int(retry_s)}
+
     async def _dispatch(self, req: _Request, rd: _Pushback,
                         writer) -> bool:
         if self._draining:
             await write_response(writer, 503, proto.error_body(
                 "shutdown", "proxy is draining; connection closing"),
+                extra_headers=self._unavailable_headers(req),
                 close=True)
             return False
         route = (req.method, req.path.split("?", 1)[0])
@@ -511,7 +539,8 @@ class EdgeProxy:
             if be is None:
                 await write_response(writer, 503, proto.error_body(
                     "shutdown", "no routable backend in the fleet",
-                    phase="proxy"))
+                    phase="proxy"),
+                    extra_headers=self._unavailable_headers(req))
                 return True
             tried.add(be.name)
             be.outstanding += 1
@@ -561,7 +590,8 @@ class EdgeProxy:
         if not targets:
             await write_response(writer, 503, proto.error_body(
                 "shutdown", "no routable backend in the fleet",
-                phase="proxy"))
+                phase="proxy"),
+                extra_headers=self._unavailable_headers(req))
             return True
         results = await asyncio.gather(*(one(be) for be in targets))
         winner = None

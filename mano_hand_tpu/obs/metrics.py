@@ -580,6 +580,37 @@ def load_samples(load: dict) -> dict:
         if store.get(key) is not None:
             out[f"load_subject_store_{key}"] = metric(
                 "gauge", store[key], help=help_txt)
+    # Closed-loop control (PR 19): controller liveness + the actuated
+    # set points — an operator reads THESE beside the burn-rate gauges
+    # to see what the controller decided and whether it is alive. The
+    # tick/actuation/revert counters ride serving_samples; these are
+    # the states and values only the control block knows.
+    ctl = load.get("control") or {}
+    for key, help_txt in (
+            ("attached", "a controller is attached (0/1)"),
+            ("running", "controller tick thread alive (0/1)"),
+            ("crashed", "controller crashed; engine reverted to "
+                        "static defaults (0/1)"),
+            ("version", "controller actuation version (torn-snapshot "
+                        "anchor)")):
+        if ctl.get(key) is not None:
+            out[f"load_control_{key}"] = metric(
+                "gauge", int(ctl[key]), help=help_txt)
+    values = ctl.get("values") or {}
+    for key, help_txt in (
+            ("coalesce_base_s", "actuated coalesce window base"),
+            ("max_queued", "actuated bounded-admission cap"),
+            ("bucket_bias", "actuated bucket-ladder selection bias")):
+        if values.get(key) is not None:
+            out[f"load_control_{key}"] = metric(
+                "gauge", values[key], help=help_txt)
+    retry = [sample(v, {"tier": t})
+             for t, v in sorted((values.get("retry_after_s")
+                                 or {}).items())]
+    if retry:
+        out["load_control_retry_after_s"] = metric(
+            "gauge", help="actuated per-tier Retry-After (seconds)",
+            samples=retry)
     return out
 
 

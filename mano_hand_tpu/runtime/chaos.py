@@ -42,6 +42,21 @@ Plan spec grammar (``parse_plan``) — comma-separated events::
            N-M       calls N..M inclusive (N <= M)
            N-        every call from N onward (a persistent outage)
            *         every call
+           T1s-T2s   (PR 19) TIME window: every call whose arrival
+                     falls in [T1, T2) seconds after ``schedule()``
+                     (epoch = the monotonic clock at schedule time;
+                     fractional seconds fine). Call-index selectors
+                     describe the device's own dispatch sequence; a
+                     time window describes the OUTSIDE world — "the
+                     tunnel browns out 2 s into the drill, for 1 s" —
+                     which is what an arrival-correlated fault burst
+                     under a traffic trace (serving/traffic.py) needs:
+                     the fault window lands at a trace offset no
+                     matter how many dispatches the controller's
+                     batching happened to produce first. Both ends
+                     must carry the ``s`` suffix (mixed domains are a
+                     typo), T1 < T2 strictly (an instant matches no
+                     interval), and ``T1s-`` is the open-ended form.
     LANE   N         (PR 13) restrict the event to callables wrapped
                      with ``wrap(..., lane=N)`` — a per-device dispatch
                      lane (serving/lanes.py). A lane-tagged event is
@@ -59,6 +74,8 @@ Plan spec grammar (``parse_plan``) — comma-separated events::
     "latency:0.2@1-3"      200 ms spikes on calls 1-3
     "sat:0.02@0-"          every dispatch throttled 20 ms (saturation)
     "wrong:0.5@4"          call 4 silently returns verts + 0.5
+    "error@2s-3s"          every call arriving 2-3 s into the plan
+    "sat:0.05@1.5s-%0"     lane 0 throttled from 1.5 s onward
 
     Specs are VALIDATED at parse time: unknown kinds, malformed or
     misplaced ``:PARAM`` (hang/error/fatal take none; latency/sat
@@ -93,26 +110,44 @@ class InjectedFault(RuntimeError):
 
 
 class FaultEvent:
-    """One scheduled fault: ``kind`` over call indices [start, stop].
-    ``lane`` (PR 13) restricts it to one dispatch lane's callables and
-    switches the index domain to that lane's own call counter."""
+    """One scheduled fault: ``kind`` over call indices [start, stop] —
+    or, when ``t_start`` is set (PR 19), over the TIME window
+    [t_start, t_stop) seconds after ``schedule()``. ``lane`` (PR 13)
+    restricts it to one dispatch lane's callables; for index-domain
+    events it also switches the index domain to that lane's own call
+    counter (a time window is already interleave-independent, so the
+    lane tag is purely a filter there)."""
 
-    __slots__ = ("kind", "start", "stop", "param", "lane")
+    __slots__ = ("kind", "start", "stop", "param", "lane",
+                 "t_start", "t_stop")
 
     def __init__(self, kind: str, start: int, stop: Optional[int],
-                 param: float = 0.0, lane: Optional[int] = None):
+                 param: float = 0.0, lane: Optional[int] = None,
+                 t_start: Optional[float] = None,
+                 t_stop: Optional[float] = None):
         self.kind = kind
         self.start = start
         self.stop = stop            # None = open-ended (persistent)
         self.param = param
         self.lane = lane            # None = every wrapped callable
+        self.t_start = t_start      # None = call-index domain
+        self.t_stop = t_stop        # None = open-ended window
 
     def matches(self, idx: int) -> bool:
         return idx >= self.start and (self.stop is None or idx <= self.stop)
 
+    def matches_time(self, elapsed_s: float) -> bool:
+        return (self.t_start is not None and elapsed_s >= self.t_start
+                and (self.t_stop is None or elapsed_s < self.t_stop))
+
     def __repr__(self) -> str:  # test/log readability
-        sel = (f"{self.start}" if self.stop == self.start
-               else f"{self.start}-{'' if self.stop is None else self.stop}")
+        if self.t_start is not None:
+            stop = "" if self.t_stop is None else f"{self.t_stop}s"
+            sel = f"{self.t_start}s-{stop}"
+        elif self.stop == self.start:
+            sel = f"{self.start}"
+        else:
+            sel = f"{self.start}-{'' if self.stop is None else self.stop}"
         tag = "" if self.lane is None else f"%{self.lane}"
         return f"FaultEvent({self.kind}@{sel}{tag}, param={self.param})"
 
@@ -137,6 +172,19 @@ def _parse_index(text: str, token: str) -> int:
             f"chaos event {token!r}: selector index {idx} is negative "
             "(call indices are 0-based)")
     return idx
+
+
+def _parse_seconds(text: str, token: str) -> float:
+    try:
+        t = float(text)
+    except ValueError:
+        raise ValueError(
+            f"chaos event {token!r}: time bound {text!r}s is not a "
+            "number of seconds") from None
+    if t < 0:
+        raise ValueError(
+            f"chaos event {token!r}: time bound {t}s is negative")
+    return t
 
 
 def _parse_event(token: str) -> FaultEvent:
@@ -178,6 +226,32 @@ def _parse_event(token: str) -> FaultEvent:
     if sel == "*":
         return FaultEvent(kind, 0, None, param, lane)
     lo, dash, hi = sel.partition("-")
+    # Time-window domain (PR 19): 's'-suffixed bounds. Both ends must
+    # agree — "2s-5" (or "2-5s") is a typo that would otherwise parse
+    # as a huge call index, silently injecting at the wrong place.
+    time_lo, time_hi = lo.endswith("s"), hi.endswith("s")
+    if time_lo or time_hi:
+        if not dash:
+            raise ValueError(
+                f"chaos event {token!r}: a time selector needs a "
+                "window, not an instant (T1s-T2s or T1s-; a bare "
+                f"{sel!r} can match no call)")
+        if not time_lo or (hi and not time_hi):
+            raise ValueError(
+                f"chaos event {token!r}: mixed selector domains — "
+                "both window ends must carry the 's' suffix "
+                "(e.g. 2s-3s), or neither (call indices)")
+        t0 = _parse_seconds(lo[:-1], token)
+        if not hi:
+            return FaultEvent(kind, 0, None, param, lane,
+                              t_start=t0, t_stop=None)
+        t1 = _parse_seconds(hi[:-1], token)
+        if t1 <= t0:
+            raise ValueError(
+                f"chaos event {token!r}: time window {t0}s-{t1}s is "
+                "empty (need T1 < T2)")
+        return FaultEvent(kind, 0, None, param, lane,
+                          t_start=t0, t_stop=t1)
     start = _parse_index(lo, token)
     if not dash:
         return FaultEvent(kind, start, start, param, lane)
@@ -209,6 +283,11 @@ class ChaosPlan:
         self._lock = threading.Lock()
         self._events: List[FaultEvent] = []
         self._calls = 0
+        # Time-window epoch (PR 19): 's'-suffixed selectors measure
+        # elapsed seconds from the most recent schedule() (monotonic —
+        # never wall clock), so a plan scheduled at a trace's t=0
+        # pins its fault windows to trace offsets.
+        self._epoch = time.monotonic()
         # Per-lane call counters (PR 13): lane-tagged events index into
         # the tagged lane's own dispatch sequence, so one lane's fault
         # schedule is deterministic however its siblings interleave.
@@ -229,6 +308,7 @@ class ChaosPlan:
             self._events = events
             self._calls = 0
             self._lane_calls = {}
+            self._epoch = time.monotonic()
         return self
 
     def clear(self) -> None:
@@ -250,11 +330,16 @@ class ChaosPlan:
             if lane is not None:
                 lidx = self._lane_calls.get(lane, 0)
                 self._lane_calls[lane] = lidx + 1
-            ev = next(
-                (e for e in self._events
-                 if (e.matches(idx) if e.lane is None
-                     else (e.lane == lane and e.matches(lidx)))),
-                None)
+            elapsed = time.monotonic() - self._epoch
+
+            def fires(e: FaultEvent) -> bool:
+                if e.lane is not None and e.lane != lane:
+                    return False
+                if e.t_start is not None:
+                    return e.matches_time(elapsed)
+                return e.matches(idx if e.lane is None else lidx)
+
+            ev = next((e for e in self._events if fires(e)), None)
             if ev is not None:
                 self.faults_injected += 1
             # Report the index in the DOMAIN the event matched on: an

@@ -961,6 +961,14 @@ class ServingEngine:
             raise ValueError(
                 f"busy_fraction must be in (0, 1], got {busy_fraction}")
         self.busy_fraction = float(busy_fraction)
+        # Closed-loop control (PR 19): the bucket-ladder selection bias
+        # (0 = the classic smallest-fitting rung; N rounds N rungs up,
+        # trading pad waste for fewer distinct executables exercised —
+        # see set_bucket_bias) and the attached controller's snapshot
+        # source (None = no controller; load()["control"] stays a
+        # shape-stable empty block, exactly like streams).
+        self.bucket_bias = 0
+        self._control_source = None
         if posed_kernel not in ("xla", "fused"):
             raise ValueError(
                 f"posed_kernel must be 'xla' or 'fused', got "
@@ -1820,6 +1828,92 @@ class ServingEngine:
             q = self.max_queued if tier <= 0 else self.max_queued // 2
         return min(q, self.max_queued)
 
+    # --------------------------------------- live control surface (PR 19)
+    def attach_control(self, source) -> None:
+        """Attach a controller's snapshot source: a zero-arg callable
+        returning the ``load()["control"]`` block, built in ONE
+        controller-lock hold (the torn-telemetry rule — the same
+        discipline every other load() sub-block follows). Detach with
+        ``detach_control``; a failing source degrades the block to the
+        empty shape, never a load() crash."""
+        self._control_source = source
+
+    def detach_control(self) -> None:
+        self._control_source = None
+
+    def set_coalesce_base(self, max_delay_s: float) -> dict:
+        """Live-retune the coalesce window BASE (serving/control.py's
+        batching actuator). The adaptive formula (``_coalesce_window``)
+        reads the attribute per batch, so the new base takes effect at
+        the next assembly — no lock is needed for a single float swap,
+        and the window stays bounded by the same pressure collapse.
+        Returns ``{"before", "after"}`` for the actuation event."""
+        v = float(max_delay_s)
+        if not 0.0 <= v <= 1.0:
+            raise ValueError(
+                f"max_delay_s must be in [0, 1] seconds, got {v}")
+        before = self.max_delay_s
+        self.max_delay_s = v
+        return {"before": before, "after": v}
+
+    def set_admission(self, *, max_queued: Optional[int] = None,
+                      tier_quotas: Optional[dict] = None) -> dict:
+        """Live-retune bounded admission (the PR-19 quota actuator):
+        swap ``max_queued`` and/or ``tier_quotas`` in ONE ``_live_lock``
+        hold — the same lock ``submit`` decides admission under, so a
+        concurrent submitter sees either the old pair or the new pair,
+        never a torn mix (the torn-telemetry rule applied to a WRITE).
+
+        Boundedness itself is a construction-time choice: an engine
+        built unbounded (``max_queued=None``) keeps its lock-free
+        admission fast path, and this setter refuses to retrofit a
+        bound (or remove one) at runtime. Validation mirrors the
+        constructor. Returns ``{"before", "after"}`` dicts."""
+        if self.max_queued is None:
+            raise ValueError(
+                "set_admission requires an engine built with bounded "
+                "admission (max_queued=N); boundedness is a "
+                "construction-time choice")
+        if max_queued is not None and int(max_queued) < 0:
+            raise ValueError(
+                f"max_queued must be >= 0 (0 sheds everything), got "
+                f"{max_queued}")
+        for t, q in (tier_quotas or {}).items():
+            if t < 0 or q < 0:
+                raise ValueError(
+                    f"tier_quotas entries must be non-negative, got "
+                    f"{{{t}: {q}}}")
+        with self._live_lock:
+            before = {"max_queued": self.max_queued,
+                      "tier_quotas": dict(self._tier_quotas)}
+            if max_queued is not None:
+                self.max_queued = int(max_queued)
+            if tier_quotas is not None:
+                self._tier_quotas = {int(t): int(q)
+                                     for t, q in tier_quotas.items()}
+            after = {"max_queued": self.max_queued,
+                     "tier_quotas": dict(self._tier_quotas)}
+        return {"before": before, "after": after}
+
+    def set_bucket_bias(self, bias: int) -> dict:
+        """Live-retune the bucket-ladder selection bias (the PR-19
+        ladder actuator): ``bias`` rungs are added to the
+        smallest-fitting bucket at ``_launch`` (capped at the largest).
+        0 is today's policy exactly. A positive bias pads more rows per
+        dispatch but narrows the set of executables steady traffic
+        exercises to the ladder's top rungs — steadier batch shapes
+        (and a smaller live-executable working set) at a bounded pad
+        cost, the lever the controller pulls when latency-quantile
+        spread, not throughput, is the burning objective."""
+        b = int(bias)
+        if not 0 <= b < len(self.buckets):
+            raise ValueError(
+                f"bucket_bias must be in [0, {len(self.buckets) - 1}], "
+                f"got {b}")
+        before = self.bucket_bias
+        self.bucket_bias = b
+        return {"before": before, "after": b}
+
     def load(self) -> dict:
         """The backpressure signal: a point-in-time load snapshot
         callers can poll BEFORE submitting (soft "try later"), instead
@@ -1830,24 +1924,30 @@ class ServingEngine:
         instant would raise ``ServingError(kind="shed")``). With
         admission unbounded (``max_queued=None``) every tier is "ok"
         and only the observability numbers carry signal."""
+        # Admission state derives inside the SAME _live_lock hold that
+        # reads the outstanding count (and that set_admission swaps the
+        # quota pair under, PR 19) — the per-tier states, the cap, and
+        # the count always describe one instant, even against a live
+        # controller retune (the torn-telemetry rule).
         with self._live_lock:
             outstanding = len(self._live)
+            max_queued = self.max_queued
+            tiers = {}
+            if max_queued is not None:
+                for t in sorted({0, 1} | set(self._tier_quotas)):
+                    q = self._quota(t)
+                    if outstanding >= q:
+                        state = "shed"
+                    elif outstanding >= self.busy_fraction * q:
+                        state = "busy"
+                    else:
+                        state = "ok"
+                    tiers[str(t)] = state
         queued = self._queue.qsize() + len(self._pending)
-        tiers = {}
-        if self.max_queued is not None:
-            for t in sorted({0, 1} | set(self._tier_quotas)):
-                q = self._quota(t)
-                if outstanding >= q:
-                    state = "shed"
-                elif outstanding >= self.busy_fraction * q:
-                    state = "busy"
-                else:
-                    state = "ok"
-                tiers[str(t)] = state
         out = {
             "outstanding": outstanding,
             "queued": queued,
-            "max_queued": self.max_queued,
+            "max_queued": max_queued,
             "admission": tiers,
             "backlog_peak": self.counters.backlog_peak,
         }
@@ -1871,6 +1971,24 @@ class ServingEngine:
         # promotions, one store-lock hold (the torn-telemetry rule).
         if self._subject_store is not None:
             out["subject_store"] = self._subject_store.snapshot()
+        # Closed-loop control (PR 19): the attached controller's state
+        # (actuated values, decision counters, crash flag), one
+        # controller-lock hold (the torn-telemetry rule). The empty
+        # block keeps the load surface shape-stable — its keys are
+        # pinned against Controller.snapshot in tests — and a FAILING
+        # source degrades to it too: telemetry must never crash load().
+        src = self._control_source
+        if src is not None:
+            try:
+                out["control"] = src()
+            except Exception:  # noqa: BLE001 — degrade, never crash
+                from mano_hand_tpu.serving import control as control_mod
+
+                out["control"] = control_mod.empty_snapshot()
+        else:
+            from mano_hand_tpu.serving import control as control_mod
+
+            out["control"] = control_mod.empty_snapshot()
         # Precision tiers (PR 14): the policy is immutable, so this is
         # pure derivation — no lock needed, and an operator (or the
         # metrics scrape, obs/metrics.py:load_samples) can always see
@@ -2018,8 +2136,12 @@ class ServingEngine:
             # hold): concurrent submitters cannot both squeeze past the
             # same last slot, so the bound is a bound, not a hint. The
             # whole decision is dict bookkeeping — O(µs), no device.
-            quota = self._quota(tier)
+            # The quota READ rides inside the same hold (PR 19): a live
+            # set_admission swaps max_queued + tier_quotas under this
+            # lock, so a submit sees one coherent pair, never a torn
+            # mix of old cap and new quota.
             with self._live_lock:
+                quota = self._quota(tier)
                 outstanding = len(self._live)
                 admitted = outstanding < quota
                 if admitted:
@@ -3051,6 +3173,16 @@ class ServingEngine:
                 rows = sum(r.rows for r in reqs)
         try:
             bucket = bucket_mod.bucket_for(rows, self.buckets)
+            bias = self.bucket_bias
+            if bias:
+                # Ladder bias (PR 19): round ``bias`` rungs past the
+                # smallest fit, capped at the top — pad waste bought
+                # deliberately for steadier batch shapes (the values
+                # stay policy-exact: pads are repeats of row 0, masked
+                # out at delivery like every padded dispatch).
+                i = self.buckets.index(bucket)
+                bucket = self.buckets[min(len(self.buckets) - 1,
+                                          i + bias)]
             tr = self._tracer
             if tr is not None:
                 # The launch boundary: queue/coalesce wait ends here;

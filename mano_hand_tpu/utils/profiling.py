@@ -202,6 +202,15 @@ class ServingCounters:
         self.subject_store_demotions_cold = 0
         self.subject_store_cold_damage = 0
         self.subject_store_resize_evictions = 0
+        # Closed-loop control (PR 19): the controller's own health as
+        # counters — ticks (decision sweeps run), actuations (knobs
+        # actually moved; each one is also a traced runtime event with
+        # before/after), reverts (crash/stop restorations to the
+        # static defaults — nonzero in production means a controller
+        # died and the engine degraded to hand-tuned behavior).
+        self.control_ticks = 0
+        self.control_actuations = 0
+        self.control_reverts = 0
         self._promotion_stalls: list = []   # seconds; bounded ring
         self._promotion_writes = 0
         self.tier_submitted: Dict[int, int] = {}   # tier -> offered
@@ -420,6 +429,25 @@ class ServingCounters:
         with self._lock:
             self.subject_store_resize_evictions += n
 
+    def count_control_tick(self, n: int = 1) -> None:
+        """One controller decision sweep (serving/control.py) — ran,
+        whether or not anything moved."""
+        with self._lock:
+            self.control_ticks += n
+
+    def count_control_actuation(self, n: int = 1) -> None:
+        """One knob the controller actually moved (quota, coalesce
+        base, bucket bias, Retry-After, warm capacity); the traced
+        ``control`` runtime event carries the before/after."""
+        with self._lock:
+            self.control_actuations += n
+
+    def count_control_revert(self, n: int = 1) -> None:
+        """One restoration to the static defaults (controller crash or
+        reverting stop) — the degrade-to-hand-tuned event."""
+        with self._lock:
+            self.control_reverts += n
+
     def record_promotion_stall(self, seconds: float) -> None:
         """What one install actually WAITED on a tier promotion (the
         residual after any prefetch overlap) — same bounded-ring policy
@@ -553,6 +581,9 @@ class ServingCounters:
                 "subject_store_cold_damage": self.subject_store_cold_damage,
                 "subject_store_resize_evictions":
                     self.subject_store_resize_evictions,
+                "control_ticks": self.control_ticks,
+                "control_actuations": self.control_actuations,
+                "control_reverts": self.control_reverts,
             }
             base["padding_waste"] = round(
                 self._waste_ratio(self.rows_live, self.rows_padded), 4)

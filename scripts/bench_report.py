@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 
 
@@ -84,11 +85,20 @@ def _numeric_rates(line: dict) -> dict:
     for k, val in (line.get("detail") or {}).items():
         if "bound" in k:
             continue   # derived roofline ceilings, not measurements
-        if isinstance(val, (int, float)) and "per_sec" in k:
+        # *_goodput fractions (PR 19) ride the same gate: bounded
+        # [0, 1], higher-is-better by construction, and a tier-0
+        # goodput regression is exactly the trend the control drill
+        # exists to catch across rounds.
+        def want(key, v):
+            return (isinstance(v, (int, float))
+                    and not isinstance(v, bool)
+                    and ("per_sec" in key or key.endswith("_goodput")))
+
+        if want(k, val):
             out[k] = float(val)
         elif isinstance(val, dict):
             for k2, v2 in val.items():
-                if isinstance(v2, (int, float)) and "per_sec" in k2:
+                if want(k2, v2):
                     out[f"{k}.{k2}"] = float(v2)
     return out
 
@@ -156,6 +166,64 @@ def _numeric_latencies(line: dict) -> dict:
                 if want(k2, v2):
                     out[f"{k}.{k2}"] = float(v2)
     return out
+
+
+def capacity_model(rate_per_chip: float, *, users_m: float = 1.0,
+                   user_hz: float = 1.0) -> dict:
+    """The "N chips for X M users" estimate (PR 19, ROADMAP item 5).
+
+    Pure arithmetic over a MEASURED per-chip service rate (requests or
+    evals per second, whichever the artifact carries): a population of
+    ``users_m`` million users each issuing ``user_hz`` requests/s
+    demands ``users_m * 1e6 * user_hz`` req/s; chips is that demand
+    over the per-chip rate, ceiling'd (capacity is provisioned in
+    whole chips), never below 1. No headroom factor is baked in — the
+    caller picks the rate (a chaos-throttled drill floor is already
+    conservative; a clean engine rate is a peak) and the printout
+    names the source so the estimate is never mistaken for the other
+    kind."""
+    if not (isinstance(rate_per_chip, (int, float))
+            and rate_per_chip > 0):
+        raise ValueError(
+            f"rate_per_chip must be > 0, got {rate_per_chip!r}")
+    if users_m < 0:
+        raise ValueError(f"users_m must be >= 0, got {users_m}")
+    if user_hz <= 0:
+        raise ValueError(f"user_hz must be > 0, got {user_hz}")
+    demand = users_m * 1e6 * user_hz
+    return {
+        "rate_per_chip_per_sec": float(rate_per_chip),
+        "users_m": float(users_m),
+        "user_hz": float(user_hz),
+        "demand_per_sec": float(demand),
+        "chips": max(1, math.ceil(demand / rate_per_chip)),
+        "users_per_chip": float(rate_per_chip / user_hz),
+    }
+
+
+def service_rate_source(line: dict):
+    """Extract the best measured per-chip service rate from any
+    serving-era artifact: (rate, source_name), or (None, None).
+    Preference order: the serving envelope's engine rate (clean engine
+    throughput on the artifact's device), then a headline evals/s
+    metric, then the control drill's socket-calibrated wire rate (a
+    chaos-throttled FLOOR — the drill throttles the device on purpose,
+    so estimates from it are conservative by construction)."""
+    detail = line.get("detail") or {}
+    srv = detail.get("serving") or {}
+    r = srv.get("engine_evals_per_sec")
+    if isinstance(r, (int, float)) and r > 0:
+        return float(r), "serving.engine_evals_per_sec"
+    v = line.get("value")
+    if (isinstance(v, (int, float)) and v > 0
+            and "evals_per_sec" in str(line.get("metric") or "")):
+        return float(v), str(line["metric"])
+    ctl = detail.get("control") or (
+        line if "control_drill_schema" in line else {})
+    r = ctl.get("service_rate_per_sec")
+    if isinstance(r, (int, float)) and r > 0:
+        return float(r), "control.service_rate_per_sec (throttled floor)"
+    return None, None
 
 
 def history_verdict(run_path: str, history_paths, tolerance: float,
@@ -329,6 +397,15 @@ def main() -> int:
              "values: BENCH_r*.json in the current directory); "
              "null/outage rounds are tolerated, cross-device priors "
              "excluded; exit 1 iff a judged config regressed")
+    ap.add_argument(
+        "--capacity-users-m", type=float, default=1.0,
+        help="millions of users for the capacity-model printout "
+             "(PR 19: chips = ceil(users * user-hz / measured "
+             "per-chip rate))")
+    ap.add_argument(
+        "--capacity-user-hz", type=float, default=1.0,
+        help="requests/s each modeled user sustains (the demand side "
+             "of the capacity model)")
     ap.add_argument(
         "--history-tolerance", type=float, default=0.15,
         help="regression threshold: fail a config below "
@@ -1614,6 +1691,115 @@ def main() -> int:
               f"({px.get('reroutes')} reroutes, "
               f"{px.get('upstream_failures')} upstream failures)")
 
+    def print_capacity(src_line):
+        rate, source = service_rate_source(src_line)
+        if rate is None:
+            return
+        cm = capacity_model(rate, users_m=args.capacity_users_m,
+                            user_hz=args.capacity_user_hz)
+        print(f"  [info] capacity: {cm['chips']} chip(s) for "
+              f"{cm['users_m']:g} M users at {cm['user_hz']:g} req/s "
+              f"each ({cm['demand_per_sec']:,.0f} req/s demand over "
+              f"{cm['rate_per_chip_per_sec']:,.0f}/s per chip = "
+              f"{cm['users_per_chip']:,.0f} users/chip; rate source: "
+              f"{source})")
+
+    def judge_control(cd):
+        """Done-criteria of the closed-loop control drill (config22,
+        PR 19): on the SAME seeded flash-crowd arrivals (the sha256
+        digest is the determinism receipt), the controller holds
+        pooled tier-0 goodput >= the static baseline while serving
+        STRICTLY more tier-1 work; every leg resolves every request to
+        an HTTP terminal with zero steady recompiles; every actuation
+        is a traced runtime event (event count == the counter ledger,
+        per controlled leg — the before/after audit trail is not
+        optional); spans close exactly once per leg; and the
+        controller-crash leg reverts every actuator to the static
+        defaults mid-crowd and still terminates 100% of requests — a
+        dead controller degrades to today's behavior, never wedges
+        admission. Goodput here IS the registry's burn-rate math: the
+        drill records each leg's slo_report off the same exit
+        counters the controller steered by. All CPU-defined:
+        saturation is a chaos throttle, the sockets are loopback."""
+        tr = cd.get("trace") or {}
+        check("control_tier0_goodput_held",
+              cd.get("controlled_tier0_goodput") is not None
+              and cd.get("controlled_tier0_goodput")
+              >= cd.get("static_tier0_goodput", 2.0),
+              f"controlled {cd.get('controlled_tier0_goodput')} vs "
+              f"static {cd.get('static_tier0_goodput')} pooled over "
+              f"{cd.get('pairs')} interleaved pairs (same "
+              f"{tr.get('stats', {}).get('arrivals')} arrivals, trace "
+              f"{tr.get('kind')} seed={tr.get('seed')} digest "
+              f"{str(tr.get('sha256'))[:12]}...)")
+        check("control_tier1_served_strictly_more",
+              (cd.get("controlled_tier1_served") or 0)
+              > (cd.get("static_tier1_served") or 0),
+              f"controlled {cd.get('controlled_tier1_served')} vs "
+              f"static {cd.get('static_tier1_served')} tier-1 "
+              f"requests served "
+              f"({cd.get('controlled_tier1_served_per_sec')}/s vs "
+              f"{cd.get('static_tier1_served_per_sec')}/s)")
+        legs = (cd.get("legs") or []) + [cd.get("crash_leg") or {}]
+        check("control_all_terminal",
+              cd.get("unresolved_total") == 0
+              and all(l.get("drained") is True for l in legs),
+              f"{cd.get('unresolved_total')} unresolved across "
+              f"{len(legs)} legs, drained "
+              f"{[l.get('drained') for l in legs]}")
+        check("control_zero_steady_recompiles",
+              cd.get("steady_recompiles_total") == 0,
+              f"{cd.get('steady_recompiles_total')} steady recompiles "
+              f"across every leg (per leg: "
+              f"{[l.get('steady_recompiles') for l in legs]})")
+        check("control_actuations_evented",
+              (cd.get("actuations_total") or 0) > 0
+              and cd.get("actuations_evented") is True,
+              f"{cd.get('actuations_total')} actuations, runtime-event"
+              f" count == counter ledger on every controlled leg: "
+              f"{cd.get('actuations_evented')} (bar: > 0 actuations, "
+              f"each one evented with before/after)")
+        cl = cd.get("crash_leg") or {}
+        clc = cl.get("control") or {}
+        check("control_crash_degrades_to_static",
+              cl.get("crash_injected") is True
+              and clc.get("crashed") is True
+              and (clc.get("reverts") or 0) >= 1
+              and cl.get("reverted_to_static") is True
+              and cl.get("unresolved") == 0
+              and (cl.get("control_revert_events") or 0) >= 1,
+              f"crash injected mid-crowd: crashed={clc.get('crashed')}"
+              f", reverts={clc.get('reverts')} "
+              f"({cl.get('control_revert_events')} evented), engine "
+              f"back at static defaults={cl.get('reverted_to_static')}"
+              f", {cl.get('unresolved')} unresolved after the crash")
+        check("control_spans_closed_once",
+              cd.get("spans_closed_exactly_once") is True,
+              f"per-leg accounting balanced on all {len(legs)} legs: "
+              f"{cd.get('spans_closed_exactly_once')}")
+        ctrl_legs = [l for l in (cd.get("legs") or [])
+                     if l.get("controlled")]
+        if ctrl_legs:
+            burns = ctrl_legs[-1].get("slo_burn_rates") or {}
+            ra = ctrl_legs[-1].get("retry_after_seen") or {}
+            print(f"  [info] control: registry burn rates (last "
+                  f"controlled leg) {burns}; tier-1 Retry-After "
+                  f"steered through {ra.get('1')} (static formula "
+                  f"emits one constant); service rate "
+                  f"{cd.get('service_rate_per_sec')}/s under the "
+                  f"chaos throttle")
+
+    if "control_drill_schema" in line and "metric" not in line:
+        # A raw control_drill_run artifact (no bench.py envelope):
+        # only the config22 criteria apply — checked BEFORE the other
+        # raw keys, same pattern as the other drill artifacts.
+        judge_control(line)
+        print_capacity(line)
+        bad = [n for n, ok in checks if not ok]
+        print("RESULT: " + ("CONTROL CRITERIA PASS" if not bad
+                            else f"failing: {', '.join(bad)}"))
+        return 0 if not bad else 1
+
     if "fleet_drill_schema" in line and "metric" not in line:
         # A raw fleet_drill_run artifact (no bench.py envelope): only
         # the config21 criteria apply — checked BEFORE the other raw
@@ -1861,6 +2047,14 @@ def main() -> int:
             check("fleet_leg_ran", False,
                   f"config21_fleet crashed: "
                   f"{line['config_errors']['config21_fleet']}")
+        cd = detail.get("control")
+        if cd:
+            judge_control(cd)
+        elif "config22_control" in (line.get("config_errors") or {}):
+            check("control_leg_ran", False,
+                  f"config22_control crashed: "
+                  f"{line['config_errors']['config22_control']}")
+        print_capacity(line)
         bad = [n for n, ok in checks if not ok]
         print("RESULT: " + ("SERVING CRITERIA PASS" if not bad
                             else f"failing: {', '.join(bad)}"))
@@ -2057,6 +2251,19 @@ def main() -> int:
         check("fleet_leg_ran", False,
               f"config21_fleet crashed: "
               f"{line['config_errors']['config21_fleet']}")
+
+    cdl = detail.get("control")
+    if cdl:
+        # Closed-loop control drill (config22, PR 19) — same presence
+        # rule: judge it wherever it ran (saturation is a chaos
+        # throttle, sockets are loopback, so the paired-leg criteria
+        # are CPU-defined and hold on every backend).
+        judge_control(cdl)
+    elif "config22_control" in (line.get("config_errors") or {}):
+        check("control_leg_ran", False,
+              f"config22_control crashed: "
+              f"{line['config_errors']['config22_control']}")
+    print_capacity(line)
 
     spec = detail.get("specialization")
     cfg_errs = line.get("config_errors") or {}

@@ -1,0 +1,45 @@
+"""Seeded lock-discipline FAILURE fixture (PR 19): the controller-
+shaped hazard — an actuation path that calls the engine's live setter
+with the controller lock held, while the engine's telemetry path calls
+the controller's snapshot with the engine lock held. Each method's own
+nesting is one level deep and looks fine in isolation; only the call
+graph (actuate -> set_admission takes the engine lock under the
+controller lock, load -> snapshot takes the controller lock under the
+engine lock) closes the cycle two threads deadlock on — the exact
+reason the real Controller runs setters OUTSIDE its lock and the real
+engine reads the control source with no engine lock held."""
+
+import threading
+
+
+class ControlledEngine:
+    def __init__(self):
+        self._ctl_lock = threading.Lock()
+        self._live_lock = threading.Lock()
+        self._max_queued = 16
+        self._history = []
+
+    def set_admission(self, max_queued):
+        with self._live_lock:
+            before = self._max_queued
+            self._max_queued = max_queued
+            return {"before": before, "after": max_queued}
+
+    def snapshot(self):
+        with self._ctl_lock:
+            return {"actuations": len(self._history)}
+
+    def actuate(self, max_queued, reason):
+        # BAD: runs the engine setter with the controller lock held —
+        # the edge _ctl_lock -> _live_lock.
+        with self._ctl_lock:
+            change = self.set_admission(max_queued)
+            self._history.append((reason, change))
+            return change
+
+    def load(self):
+        # BAD: reads the controller snapshot with the engine lock held
+        # — the opposite edge _live_lock -> _ctl_lock.
+        with self._live_lock:
+            return {"max_queued": self._max_queued,
+                    "control": self.snapshot()}

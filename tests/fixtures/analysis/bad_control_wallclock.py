@@ -1,0 +1,18 @@
+"""Seeded wallclock-deadline FAILURE fixture (PR 19): controller-
+shaped cadence and rate-limit arithmetic built on time.time(). An NTP
+step or DST shift mid-run would stall or burst the control loop —
+serving-path deadlines are time.monotonic() territory (the policy
+linter's wallclock-deadline rule). Both assigns below must fire: the
+tick deadline and the annotated actuation rate-limit expiry."""
+
+import time
+
+
+def next_tick(cadence_s):
+    tick_deadline = time.time() + cadence_s
+    return tick_deadline
+
+
+def may_actuate(last_at, min_interval_s):
+    actuation_expires: float = time.time() + min_interval_s
+    return last_at >= actuation_expires

@@ -261,6 +261,44 @@ def test_edge_proxy_fleet_lock_graphs_are_clean_on_head():
     assert check_lock_discipline(edge / "fleet.py", order=()) == []
 
 
+def test_seeded_control_actuate_load_cycle_is_caught():
+    """Satellite (PR 19): the controller-shaped hazard — an actuation
+    path running the engine setter with the controller lock held,
+    against a telemetry path reading the controller snapshot with the
+    engine lock held (each method clean in isolation; the call graph
+    closes the cycle) — fires the cycle rule. This is the exact
+    deadlock the real Controller avoids by running setters OUTSIDE its
+    lock and having engine.load() read the control source lock-free."""
+    findings = check_lock_discipline(
+        FIXTURES / "bad_control_actuate_cycle.py", order=())
+    assert findings, "the seeded control cycle fixture must fail"
+    assert any("cycle" in f.message for f in findings)
+    assert any("_ctl_lock" in f.message and "_live_lock" in f.message
+               for f in findings)
+
+
+def test_seeded_control_wallclock_fixture_fires():
+    """Satellite (PR 19): controller-shaped cadence/rate-limit math on
+    time.time() fires wallclock-deadline on BOTH assign shapes (plain
+    and annotated) — the control loop is serving-path code and its
+    deadline arithmetic is monotonic-only territory."""
+    findings, _ = _lint_fixture("bad_control_wallclock.py")
+    assert _rules(findings) == ["wallclock-deadline"]
+    assert len(findings) == 2
+
+
+def test_control_traffic_lock_graphs_are_clean_on_head():
+    """Satellite (PR 19): the lock checker's scope covers the
+    closed-loop controller (one LEAF lock: actuation ledger + snapshot
+    values share one hold, engine setters run outside it) and the
+    traffic generator (no locks by design); `mano analyze` pins both
+    by path, this pins them by name so a scope regression fails here
+    before it fails in review."""
+    serving = REPO_ROOT / "mano_hand_tpu" / "serving"
+    assert check_lock_discipline(serving / "control.py", order=()) == []
+    assert check_lock_discipline(serving / "traffic.py", order=()) == []
+
+
 def test_good_lock_fixture_and_real_engine_are_clean():
     assert check_lock_discipline(FIXTURES / "good_locks.py") == []
     assert check_lock_discipline() == []   # serving/engine.py, HEAD

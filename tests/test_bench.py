@@ -336,6 +336,13 @@ def test_bench_cpu_tiny_run_end_to_end():
         # plumbing runs in `make bench-interpret` (--fleet-streams 6)
         # and the drill protocol e2e in `make fleet-smoke`.
         "--fleet-streams", "0",
+        # config22 (PR 19) is SKIPPED here too, not shrunk: the control
+        # drill replays a seconds-long paced flash-crowd trace across
+        # five fresh engine+edge legs — real wall-clock even at
+        # plumbing size. Its plumbing runs in `make bench-interpret`
+        # (--control-pairs 1) and the drill protocol e2e in `make
+        # control-smoke`.
+        "--control-pairs", "0",
     )
     assert rc == 0, line
     assert line["value"] is not None and line["value"] > 0
@@ -390,6 +397,9 @@ def test_bench_cpu_tiny_run_end_to_end():
     # config21 (PR 18) likewise: skipped by flag (bench-interpret /
     # fleet-smoke carry it).
     assert "fleet" not in d
+    # config22 (PR 19) likewise: skipped by flag (bench-interpret /
+    # control-smoke carry it).
+    assert "control" not in d
     assert "config_errors" not in line, line.get("config_errors")
 
 

@@ -1722,3 +1722,178 @@ def test_history_error_envelope_judged_absolutely(tmp_path):
     p = _run(str(gp), "--history", str(fp))
     assert p.returncode == 0, p.stdout
     assert "[FAIL] precision.bf16_max_abs_err" not in p.stdout
+
+def _load_bench_report():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_report", ROOT / "scripts" / "bench_report.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_capacity_model_math():
+    """The PR-19 "N chips for X M users" estimate is pure, auditable
+    arithmetic: demand = users * rate-per-user, chips = ceil(demand /
+    measured per-chip rate), floored at one whole chip."""
+    br = _load_bench_report()
+    cm = br.capacity_model(5000.0, users_m=1.0, user_hz=1.0)
+    assert cm["demand_per_sec"] == 1e6
+    assert cm["chips"] == 200                  # exact division
+    assert cm["users_per_chip"] == 5000.0
+    # Ceiling, not rounding: 1e6 / 5001 = 199.96 -> 200 stays, but
+    # 1e6 / 4999 = 200.04 -> 201.
+    assert br.capacity_model(4999.0)["chips"] == 201
+    # Whole-chip floor: a tiny population still needs one chip.
+    assert br.capacity_model(5000.0, users_m=0.0)["chips"] == 1
+    assert br.capacity_model(5000.0, users_m=1e-6)["chips"] == 1
+    # user_hz scales demand and divides users-per-chip.
+    cm = br.capacity_model(5000.0, users_m=1.0, user_hz=0.1)
+    assert cm["chips"] == 20 and cm["users_per_chip"] == 50000.0
+    for bad in (0.0, -5.0, None, "fast"):
+        with pytest.raises(ValueError):
+            br.capacity_model(bad)
+    with pytest.raises(ValueError):
+        br.capacity_model(5000.0, users_m=-1.0)
+    with pytest.raises(ValueError):
+        br.capacity_model(5000.0, user_hz=0.0)
+
+
+def test_service_rate_source_preference():
+    """Rate-source order: clean engine envelope rate > headline
+    evals/s metric > the control drill's chaos-throttled wire floor
+    (labeled as such) > nothing."""
+    br = _load_bench_report()
+    full = {"metric": "mano_forward_evals_per_sec", "value": 1e6,
+            "detail": {"serving": {"engine_evals_per_sec": 2e5},
+                       "control": {"service_rate_per_sec": 300.0}}}
+    assert br.service_rate_source(full) == (
+        2e5, "serving.engine_evals_per_sec")
+    del full["detail"]["serving"]
+    assert br.service_rate_source(full) == (
+        1e6, "mano_forward_evals_per_sec")
+    full["value"] = None
+    rate, src = br.service_rate_source(full)
+    assert rate == 300.0 and "throttled floor" in src
+    raw = {"control_drill_schema": 1, "service_rate_per_sec": 250.0}
+    rate, src = br.service_rate_source(raw)
+    assert rate == 250.0 and "throttled floor" in src
+    assert br.service_rate_source({"value": None, "detail": {}}) \
+        == (None, None)
+
+
+def _control_block():
+    """A minimal PASSING control_drill_run artifact (config22 shape,
+    PR 19) — the same keys the real drill emits, at toy values."""
+    leg = {"name": "controlled_0", "controlled": True, "drained": True,
+           "steady_recompiles": 0, "unresolved": 0,
+           "slo_burn_rates": {"0": {"goodput": 0.4}},
+           "retry_after_seen": {"0": [1], "1": [2, 4, 8]}}
+    sleg = dict(leg, name="static_0", controlled=False,
+                retry_after_seen={"1": [3]})
+    crash = dict(leg, name="crash", crash_injected=True,
+                 reverted_to_static=True, control_revert_events=1,
+                 control={"crashed": True, "reverts": 1, "ticks": 6,
+                          "actuations": 5})
+    return {
+        "control_drill_schema": 1, "pairs": 1,
+        "trace": {"kind": "flash_crowd", "seed": 7, "sha256": "ab" * 32,
+                  "stats": {"arrivals": 120}},
+        "service_rate_per_sec": 320.0,
+        "legs": [sleg, leg], "crash_leg": crash,
+        "static_tier0_goodput": 0.95, "controlled_tier0_goodput": 0.97,
+        "static_tier1_served": 40, "controlled_tier1_served": 70,
+        "static_tier1_served_per_sec": 50.0,
+        "controlled_tier1_served_per_sec": 87.5,
+        "steady_recompiles_total": 0, "unresolved_total": 0,
+        "actuations_total": 17, "actuations_evented": True,
+        "spans_closed_exactly_once": True,
+    }
+
+
+def test_control_block_raw_and_each_criterion_fails(tmp_path):
+    """A raw control_drill_run artifact gets the config22 verdict and
+    the capacity estimate; breaking any single criterion fails BY
+    NAME (the judge must not collapse distinct failures)."""
+    good = _control_block()
+    gp = tmp_path / "control.json"
+    gp.write_text(json.dumps(good))
+    p = _run(str(gp))
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "RESULT: CONTROL CRITERIA PASS" in p.stdout
+    for name in ("control_tier0_goodput_held",
+                 "control_tier1_served_strictly_more",
+                 "control_all_terminal",
+                 "control_zero_steady_recompiles",
+                 "control_actuations_evented",
+                 "control_crash_degrades_to_static",
+                 "control_spans_closed_once"):
+        assert f"[PASS] {name}" in p.stdout
+    assert "[info] capacity:" in p.stdout
+    assert "throttled floor" in p.stdout    # rate source named
+    assert "[info] control:" in p.stdout    # burn-rate/Retry-After line
+
+    breakers = {
+        "control_tier0_goodput_held": {"controlled_tier0_goodput": 0.5},
+        "control_tier1_served_strictly_more":
+            {"controlled_tier1_served": 40},
+        "control_all_terminal": {"unresolved_total": 3},
+        "control_zero_steady_recompiles": {"steady_recompiles_total": 2},
+        "control_actuations_evented": {"actuations_evented": False},
+        "control_crash_degrades_to_static":
+            {"crash_leg": dict(_control_block()["crash_leg"],
+                               reverted_to_static=False)},
+        "control_spans_closed_once": {"spans_closed_exactly_once": False},
+    }
+    for name, patch in breakers.items():
+        bad = dict(_control_block(), **patch)
+        bp = tmp_path / "bad.json"
+        bp.write_text(json.dumps(bad))
+        p = _run(str(bp))
+        assert p.returncode == 1, name
+        assert f"[FAIL] {name}" in p.stdout, name
+
+
+def test_control_block_in_full_bench_and_capacity_flags(tmp_path):
+    """A full-bench artifact carrying detail.control is judged on the
+    same config22 criteria, and the capacity flags re-shape the
+    estimate (the clean engine rate is preferred over the drill's
+    throttled floor when the envelope carries one)."""
+    line = {"metric": "mano_forward_evals_per_sec", "value": 2.1e7,
+            "unit": "evals/s", "vs_baseline": 420.0,
+            "max_err_vs_numpy": 3e-6, "device": "cpu:cpu",
+            "detail": {"control": _control_block(),
+                       "serving": {"engine_evals_per_sec": 1e6}}}
+    fp = tmp_path / "full.json"
+    fp.write_text(json.dumps(line))
+    p = _run(str(fp), "--capacity-users-m", "10",
+             "--capacity-user-hz", "0.5")
+    assert "[PASS] control_tier0_goodput_held" in p.stdout
+    assert "[PASS] control_crash_degrades_to_static" in p.stdout
+    assert "10 M users" in p.stdout
+    assert "serving.engine_evals_per_sec" in p.stdout
+    # demand 10e6*0.5 = 5e6 over 1e6/s/chip = 5 chips.
+    assert "5 chip(s)" in p.stdout
+
+
+@pytest.mark.slow
+def test_history_picks_up_control_goodput_keys(tmp_path):
+    """`--history` (PR-19 satellite): the drill's goodput fractions
+    and served-tier-1 rates ride the existing cross-round gate — a
+    regression in either fails by its nested name."""
+    mk = lambda g, s: {  # noqa: E731 — two-literal helper
+        "metric": "mano_forward_evals_per_sec", "value": 1e6,
+        "device": "cpu:cpu",
+        "detail": {"control": {"controlled_tier0_goodput": g,
+                               "controlled_tier1_served_per_sec": s}}}
+    pp, fp = tmp_path / "prior.json", tmp_path / "fresh.json"
+    pp.write_text(json.dumps(mk(0.97, 80.0)))
+    fp.write_text(json.dumps(mk(0.55, 81.0)))   # goodput regressed
+    p = _run(str(fp), "--history", str(pp))
+    assert p.returncode == 1, p.stdout
+    assert "[FAIL] control.controlled_tier0_goodput" in p.stdout
+    assert "[PASS] control.controlled_tier1_served_per_sec" in p.stdout
+    fp.write_text(json.dumps(mk(0.98, 85.0)))   # both improved
+    p = _run(str(fp), "--history", str(pp))
+    assert p.returncode == 0, p.stdout
+    assert "PERF NO-REGRESSION" in p.stdout

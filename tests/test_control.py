@@ -1,0 +1,472 @@
+"""Closed-loop control (the PR-19 tentpole), CPU-verified.
+
+The adaptive controller is only shippable if it provably cannot make
+things worse, so the contract pinned here is mostly about restraint:
+
+* actuation bounds — hysteresis deadbands (no decision flaps on a
+  hovering signal), per-actuator rate limits, bounded steps, hard
+  floors/ceilings re-validated by the engine's own live setters;
+* crash = static defaults — a controller failure reverts every
+  actuator to the values captured at start() and the engine keeps
+  admitting/serving on them (never-wedge), with ``retry_after_for``
+  falling back to the static wire formula;
+* torn-snapshot atomicity — ``load()["control"]`` is ONE lock hold:
+  ``version == actuations`` and every history entry's version is
+  consistent with the counters beside it, under a concurrent hammer;
+* traffic determinism — the drill's arrivals are replayable: same
+  seed, byte-identical ``serialize()`` output;
+* the config22 drill protocol at plumbing size (the acceptance-sized
+  run is `make bench-interpret` / bench.py config22 ->
+  bench_report:judge_control).
+
+Quick (the pre-commit `-m quick` lane runs this module) AND slow (the
+tier-1 `-m 'not slow'` lane skips it): its canonical runner is `make
+control-smoke` — own pytest process + compile-cache dir, wired into
+`make check` (the overload/edge/fleet smoke-lane precedent).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mano_hand_tpu.serving import traffic
+from mano_hand_tpu.serving.control import (
+    ControlConfig,
+    Controller,
+    empty_snapshot,
+)
+from mano_hand_tpu.serving.engine import ServingEngine, ServingError
+
+pytestmark = [pytest.mark.quick, pytest.mark.slow]
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+def _pose(n=1, seed=0):
+    return np.random.default_rng(seed).normal(
+        scale=0.4, size=(n, 16, 3)).astype(np.float32)
+
+
+def _slo(burn0):
+    return {"tiers": {"0": {"burn_rates": {"goodput": burn0}}}}
+
+
+def _sig(burn0=0.0, backlog_s=0.0, counters=None):
+    """A synthetic signals dict: tick(signals=...) drives the decision
+    logic deterministically without a live engine under load."""
+    return {"load": {"backlog_age_s": backlog_s}, "slo": _slo(burn0),
+            "counters": counters or {}}
+
+
+def _controller(eng, **cfg_kw):
+    """A started-then-halted controller: start() captures the static
+    -default anchor and attaches the snapshot source, stop() joins the
+    tick thread so the tests own every tick() call."""
+    cfg_kw.setdefault("cadence_s", 60.0)   # thread never self-ticks
+    cfg_kw.setdefault("min_actuation_interval_s", 0.0)
+    ctl = Controller(eng, config=ControlConfig(**cfg_kw))
+    ctl.start()
+    ctl.stop()
+    return ctl
+
+
+# ------------------------------------------------------------- config
+def test_control_config_validates():
+    for bad in (dict(cadence_s=0.0), dict(hysteresis=1.0),
+                dict(hysteresis=0.0), dict(min_actuation_interval_s=-1),
+                dict(max_step_fraction=0.0), dict(max_step_fraction=1.5),
+                dict(tier0_burn_low=2.0, tier0_burn_high=1.0),
+                dict(backlog_age_low_s=0.5, backlog_age_high_s=0.25),
+                dict(coalesce_min_s=0.1, coalesce_max_s=0.05),
+                dict(tier1_quota_min_fraction=0.9,
+                     tier1_quota_max_fraction=0.5),
+                dict(retry_after_max_s=0), dict(bucket_bias_max=-1),
+                dict(batch_fill_low=1.5), dict(warm_grow_ticks=0)):
+        with pytest.raises(ValueError):
+            ControlConfig(**bad)
+    cfg = ControlConfig(hysteresis=0.4, tier0_burn_high=2.0)
+    assert cfg.tier0_burn_low == pytest.approx(0.8)   # one-knob deadband
+
+
+# -------------------------------------------------- engine live setters
+def test_live_setters_validate_and_report_before_after(params32):
+    eng = ServingEngine(params32, max_bucket=4, max_queued=8,
+                        tier_quotas={1: 2}, max_delay_s=0.004)
+    d = eng.set_coalesce_base(0.002)
+    assert (d["before"], d["after"]) == (0.004, 0.002)
+    assert eng.max_delay_s == 0.002
+    with pytest.raises(ValueError):
+        eng.set_coalesce_base(-0.001)
+    with pytest.raises(ValueError):
+        eng.set_coalesce_base(5.0)          # > the 1 s sanity ceiling
+
+    d = eng.set_admission(max_queued=4, tier_quotas={1: 3})
+    assert d["before"]["max_queued"] == 8
+    assert d["after"] == {"max_queued": 4, "tier_quotas": {1: 3}}
+    with pytest.raises(ValueError):
+        eng.set_admission(max_queued=-1)
+
+    d = eng.set_bucket_bias(1)
+    assert (d["before"], d["after"]) == (0, 1)
+    with pytest.raises(ValueError):
+        eng.set_bucket_bias(len(eng.buckets))   # off the ladder
+    with pytest.raises(ValueError):
+        eng.set_bucket_bias(-1)
+
+
+def test_set_admission_rejected_on_unbounded_engine(params32):
+    """An engine built without admission control has no quota ledger
+    to steer — the setter must refuse rather than invent one."""
+    eng = ServingEngine(params32, max_bucket=4)
+    assert eng.max_queued is None
+    with pytest.raises(ValueError):
+        eng.set_admission(max_queued=8)
+
+
+def test_live_quota_change_takes_effect_at_submit(params32):
+    """The setter is LIVE admission policy: the same tier-1 submit
+    that sheds under quota 0 is admitted right after a grow, no
+    restart, dispatcher never started (the PR-5 O(µs) shed path)."""
+    eng = ServingEngine(params32, max_bucket=4, max_queued=8,
+                        tier_quotas={1: 0})
+    with pytest.raises(ServingError) as e:
+        eng.submit(_pose(), priority=1)
+    assert e.value.kind == "shed"
+    eng.set_admission(tier_quotas={1: 8})
+    fut = eng.submit(_pose(), priority=1)   # admitted live
+    assert fut is not None
+    assert eng.counters.dispatches == 0     # decision, not device work
+
+
+# ---------------------------------------------------- decision bounds
+def test_hysteresis_deadband_holds(params32):
+    """Between the low and high watermarks the controller applies
+    NOTHING — a signal hovering at one threshold cannot flap a knob."""
+    eng = ServingEngine(params32, max_bucket=4, max_queued=8,
+                        tier_quotas={1: 2})
+    ctl = _controller(eng, tier0_burn_high=1.0, hysteresis=0.5)
+    mid = 0.75                              # inside (0.5, 1.0)
+    for _ in range(5):
+        assert ctl.tick(_sig(burn0=mid)) == []
+    assert eng._tier_quotas == {1: 2}
+    assert ctl.snapshot()["actuations"] == 0
+
+
+def test_quota_grows_cold_shrinks_hot_within_bounds(params32):
+    eng = ServingEngine(params32, max_bucket=4, max_queued=16,
+                        tier_quotas={1: 4})
+    ctl = _controller(eng, max_step_fraction=0.25,
+                      tier1_quota_min_fraction=0.25,
+                      tier1_quota_max_fraction=0.75)
+    def quota_events(sig):
+        return [x for x in ctl.tick(sig) if x["actuator"] == "tier1_quota"]
+
+    # Cold: grow by at most max_step_fraction * max_queued per tick,
+    # saturating at the max fraction (0.75 * 16 = 12).
+    a = quota_events(_sig(burn0=0.0))
+    assert len(a) == 1
+    assert eng._tier_quotas[1] == 8         # 4 + 0.25*16
+    quota_events(_sig(burn0=0.0))
+    assert eng._tier_quotas[1] == 12
+    assert quota_events(_sig(burn0=0.0)) == []   # saturated: no event
+    # Hot: walk back down, floored at the min fraction (0.25*16 = 4).
+    quota_events(_sig(burn0=2.0))
+    assert eng._tier_quotas[1] == 8
+    quota_events(_sig(burn0=2.0))
+    assert eng._tier_quotas[1] == 4
+    assert quota_events(_sig(burn0=2.0)) == []   # floored: no event
+    # Every actuation carried before/after and was version-stamped.
+    hist = [h for h in ctl.snapshot()["history"]
+            if h["actuator"] == "tier1_quota"]
+    assert len(hist) == 4
+    assert all(h["before"] != h["after"] for h in hist)
+
+
+def test_rate_limit_blocks_immediate_reactuation(params32):
+    eng = ServingEngine(params32, max_bucket=4, max_queued=16,
+                        tier_quotas={1: 4})
+    ctl = _controller(eng, min_actuation_interval_s=30.0)
+    assert len(ctl.tick(_sig(burn0=0.0))) >= 1
+    q = eng._tier_quotas[1]
+    for _ in range(3):                      # inside the interval:
+        assert ctl.tick(_sig(burn0=0.0)) == []   # held, not re-stepped
+    assert eng._tier_quotas[1] == q
+
+
+def test_coalesce_shrinks_under_backlog_and_restores(params32):
+    eng = ServingEngine(params32, max_bucket=4, max_queued=8,
+                        max_delay_s=0.004)
+    ctl = _controller(eng, backlog_age_high_s=0.1,
+                      max_step_fraction=0.5)
+
+    def coalesce_events(sig):
+        return [x for x in ctl.tick(sig) if x["actuator"] == "coalesce"]
+
+    a = coalesce_events(_sig(backlog_s=0.5))
+    assert len(a) == 1
+    assert eng.max_delay_s == pytest.approx(0.002)
+    # Backlog drained: walk back toward the start() default, never
+    # past it.
+    coalesce_events(_sig(backlog_s=0.0))
+    coalesce_events(_sig(backlog_s=0.0))
+    assert eng.max_delay_s == pytest.approx(0.004)
+    assert coalesce_events(_sig(backlog_s=0.0)) == []   # at the default
+
+
+def test_retry_after_steering_and_fallback(params32):
+    eng = ServingEngine(params32, max_bucket=4, max_queued=8,
+                        tier_quotas={1: 2})
+    ctl = _controller(eng, retry_after_max_s=8)
+    assert ctl.retry_after_for(1) is None   # no opinion yet: static
+    ctl.tick(_sig(burn0=2.0))               # hot: back off harder
+    first = ctl.retry_after_for(1)
+    assert first is not None and first >= 2
+    for _ in range(4):
+        ctl.tick(_sig(burn0=2.0))
+    assert ctl.retry_after_for(1) == 8      # capped at the max
+    assert ctl.retry_after_for(0) == 1      # tier 0 never punished
+    for _ in range(8):
+        ctl.tick(_sig(burn0=0.0))
+    assert ctl.retry_after_for(1) == 1      # cold: halved home
+
+
+def test_warm_capacity_steering_grows_and_shrinks(params32):
+    """The PR-16 remainder: `SubjectStore.resize_warm` driven by the
+    counted warm-miss telemetry — grow under sustained miss pressure
+    (bounded by warm_capacity_max), shrink back toward the start()
+    default after enough idle ticks, never below it."""
+    from mano_hand_tpu.serving.subject_store import SubjectStore
+
+    store = SubjectStore(warm_capacity=8)
+    eng = ServingEngine(params32, max_bucket=4, max_queued=8,
+                        subject_store=store)
+    ctl = _controller(eng, warm_miss_grow_per_tick=4, warm_grow_ticks=2,
+                      warm_idle_shrink_ticks=3, max_step_fraction=0.5,
+                      warm_capacity_max=32)
+    mid = 0.75                  # inside the burn deadband: only warm
+
+    def warm_events(misses):
+        return [x for x in ctl.tick(_sig(
+            burn0=mid, counters={"subject_store_misses": misses}))
+            if x["actuator"] == "warm_capacity"]
+
+    assert warm_events(0) == []         # first sample: baseline only
+    assert warm_events(10) == []        # pressure tick 1 of 2
+    a = warm_events(20)                 # tick 2: grow 8 -> 13
+    assert len(a) == 1
+    assert store.config.warm_capacity == 13
+    assert (a[0]["before"], a[0]["after"]) == (8, 13)
+    # Growth is capped at warm_capacity_max.
+    for m in (30, 40, 50, 60, 70, 80):
+        warm_events(m)
+    assert store.config.warm_capacity == 32
+    assert warm_events(90) == [] or store.config.warm_capacity == 32
+    # Idle (no new misses): shrink after warm_idle_shrink_ticks,
+    # floored at the start() default.
+    for _ in range(20):
+        warm_events(90)
+    assert store.config.warm_capacity == 8
+    assert all(h["after"] >= 8 for h in ctl.snapshot()["history"]
+               if h["actuator"] == "warm_capacity")
+
+
+# ------------------------------------------------------ crash contract
+def test_crash_reverts_to_static_defaults_and_never_wedges(params32):
+    eng = ServingEngine(params32, max_bucket=4, max_queued=8,
+                        tier_quotas={1: 2}, max_delay_s=0.004)
+    ctl = _controller(eng)
+    ctl.tick(_sig(burn0=0.0, backlog_s=0.5))    # steer off the statics
+    assert (eng._tier_quotas[1], eng.max_delay_s) != (2, 0.004)
+
+    ctl._crash(RuntimeError("injected"))
+    # Every actuator back at its start() anchor.
+    assert eng._tier_quotas == {1: 2}
+    assert eng.max_delay_s == 0.004
+    assert eng.max_queued == 8
+    assert eng.bucket_bias == 0
+    snap = ctl.snapshot()
+    assert snap["crashed"] and not snap["running"]
+    assert snap["reverts"] == 1
+    # A crashed controller never actuates again...
+    assert ctl.tick(_sig(burn0=0.0)) == []
+    assert ctl.retry_after_for(1) is None   # ...and the wire falls
+    # ...back to the static formula, while admission keeps working:
+    assert eng.submit(_pose(), priority=0) is not None
+    with pytest.raises(ServingError):       # quota 2 enforced again
+        for i in range(4):
+            eng.submit(_pose(seed=i), priority=1)
+
+
+def test_crash_revert_is_counted_and_evented(params32):
+    from mano_hand_tpu.obs import Tracer
+
+    tr = Tracer()
+    eng = ServingEngine(params32, max_bucket=4, max_queued=8,
+                        tier_quotas={1: 2}, tracer=tr)
+    ctl = _controller(eng)
+    ctl.tick(_sig(burn0=0.0))
+    ctl._crash(RuntimeError("injected"))
+    snap = eng.counters.snapshot()
+    assert snap["control_actuations"] >= 1
+    assert snap["control_reverts"] == 1
+    events = [e for e in tr.snapshot()["events"]]
+    names = [e[2] for e in events]
+    assert names.count("control") == snap["control_actuations"]
+    assert "control_revert" in names
+    assert any(e[2].startswith("incident:control_crash") for e in events)
+    # The revert event reports how many actuators were restored.
+    rev = next(e for e in events if e[2] == "control_revert")
+    assert rev[3]["reason"] == "crash" and rev[3]["restored"] >= 3
+
+
+def test_crashed_run_loop_reverts_via_thread(params32):
+    """The thread-path crash: a tick that raises inside _run lands in
+    _crash, reverts, and the loop never respins."""
+    eng = ServingEngine(params32, max_bucket=4, max_queued=8,
+                        tier_quotas={1: 2})
+    ctl = Controller(eng, config=ControlConfig(
+        cadence_s=0.01, min_actuation_interval_s=0.0))
+    boom = RuntimeError("tick poisoned")
+
+    def poisoned(signals=None):
+        raise boom
+
+    ctl.tick = poisoned
+    ctl.start()
+    t0 = time.monotonic()
+    while not ctl.snapshot()["crashed"]:
+        assert time.monotonic() - t0 < 10.0
+        time.sleep(0.005)
+    ctl.stop()
+    snap = ctl.snapshot()
+    assert snap["crashed"] and snap["reverts"] == 1
+    assert eng._tier_quotas == {1: 2}       # statics restored
+
+
+# ---------------------------------------------------- torn-snapshot
+def test_load_control_block_is_never_torn(params32):
+    """The one-lock-hold rule, adversarially: a reader hammering
+    ``load()["control"]`` while ticks actuate must never observe
+    version != actuations, a history entry newer than the version
+    beside it, or a missing key (the empty_snapshot shape contract)."""
+    eng = ServingEngine(params32, max_bucket=4, max_queued=16,
+                        tier_quotas={1: 4})
+    ctl = _controller(eng, max_step_fraction=0.1)
+    keys = set(empty_snapshot())
+    bad = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            c = eng.load()["control"]
+            if set(c) != keys:
+                bad.append(("keys", sorted(set(c) ^ keys)))
+            if c["version"] != c["actuations"]:
+                bad.append(("version", c["version"], c["actuations"]))
+            if c["history"] and c["history"][-1]["version"] > c["version"]:
+                bad.append(("history", c["history"][-1]["version"],
+                            c["version"]))
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    # Alternate hot/cold so every tick actuates (interval 0, step 10%).
+    for i in range(200):
+        ctl.tick(_sig(burn0=0.0 if i % 2 else 2.0))
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert not bad, bad[:5]
+    assert ctl.snapshot()["actuations"] >= 100
+
+
+def test_engine_without_controller_serves_empty_snapshot(params32):
+    eng = ServingEngine(params32, max_bucket=4, max_queued=8)
+    c = eng.load()["control"]
+    assert c == empty_snapshot()
+    assert c["attached"] is False
+    # Detach restores the empty block; a crashing source degrades to
+    # it too instead of tearing load().
+    ctl = _controller(eng)
+    assert eng.load()["control"]["attached"] is True
+    eng.attach_control(lambda: 1 / 0)
+    assert eng.load()["control"] == empty_snapshot()
+    eng.detach_control()
+    assert eng.load()["control"] == empty_snapshot()
+    del ctl
+
+
+# ---------------------------------------------------------- traffic
+def test_traffic_same_seed_is_byte_identical():
+    kw = dict(seed=11, duration_s=3.0, base_hz=40.0, peak_hz=400.0,
+              tier0_fraction=0.3)
+    for kind in traffic.TRACE_KINDS:
+        a = traffic.serialize(traffic.make_trace(kind, **kw))
+        b = traffic.serialize(traffic.make_trace(kind, **kw))
+        assert a == b, kind                 # the replayability contract
+        assert a != traffic.serialize(traffic.make_trace(
+            kind, **{**kw, "seed": 12}))
+
+
+def test_traffic_traces_are_valid_and_shaped():
+    tr = traffic.make_trace("flash_crowd", seed=7, duration_s=2.0,
+                            base_hz=40.0, peak_hz=400.0,
+                            tier0_fraction=0.25, crowd_at_fraction=0.4)
+    ts = [t for t, _ in tr]
+    assert ts == sorted(ts)
+    assert all(0.0 <= t < 2.0 for t in ts)
+    assert {tier for _, tier in tr} <= {0, 1}
+    st = traffic.trace_stats(tr)
+    assert st["arrivals"] == len(tr) == st["tier0"] + st["tier1"]
+    # The crowd is real: peak rate well above the base rate.
+    assert st["peak_rate_hz"] > 3 * 40.0
+    # Tier split tracks the requested fraction (binomial, wide margin).
+    assert 0.1 < st["tier0"] / st["arrivals"] < 0.45
+
+
+def test_traffic_specs_validated():
+    for bad in (dict(kind="tsunami"), dict(duration_s=0.0),
+                dict(base_hz=0.0), dict(base_hz=500.0),
+                dict(tier0_fraction=1.5)):
+        kw = dict(kind="diurnal", seed=0, duration_s=1.0, base_hz=10.0,
+                  peak_hz=100.0, tier0_fraction=0.5)
+        kw.update(bad)
+        kind = kw.pop("kind")
+        with pytest.raises(ValueError):
+            traffic.make_trace(kind, **kw)
+
+
+# ------------------------------------------------------------ the drill
+def test_control_drill_small_e2e(params32):
+    """config22 end-to-end at plumbing size: the drill's own criteria
+    fields all populated and internally consistent (the acceptance
+    -sized run is `make bench-interpret` -> bench_report:
+    judge_control)."""
+    from mano_hand_tpu.serving.measure import control_drill_run
+
+    out = control_drill_run(
+        params32, trace_duration_s=0.7, workers=8, pairs=1,
+        max_bucket=4, max_queued=8, tier1_quota=2,
+        sat_latency_s=0.01, cadence_s=0.03, seed=5)
+    assert out["control_drill_schema"] == 1
+    assert out["unresolved_total"] == 0
+    assert out["steady_recompiles_total"] == 0
+    assert out["actuations_total"] > 0
+    assert out["actuations_evented"] is True
+    assert out["spans_closed_exactly_once"] is True
+    assert len(out["trace"]["sha256"]) == 64   # determinism receipt
+    cl = out["crash_leg"]
+    assert cl["crash_injected"] and cl["control"]["crashed"]
+    assert cl["reverted_to_static"] is True
+    assert cl["unresolved"] == 0
+    # Paired-leg data present for judge_control (the PASS/FAIL verdict
+    # itself belongs to the acceptance-sized artifact, not plumbing).
+    assert out["static_tier1_served"] >= 0
+    assert out["controlled_tier1_served"] >= 0
+    json.dumps(out)                         # one-line-artifact safe

@@ -557,3 +557,67 @@ def test_fleet_drill_protocol_plumbing(params):
     assert fd["drain"]["clean"] is True
     assert fd["drain"]["streams_migrated"] == fd["drain"][
         "streams_hosted"]
+
+
+# ------------------------------------------------------- scale-up path
+def test_add_worker_warm_streams_first_frame_zero_compiles(tmp_path):
+    """Scale-up (PR 19, the PR-18 remainder): ``Fleet.add_worker``
+    boots a NEW worker, and with ``warm_streams`` its first real
+    stream frame pays ZERO compiles — the in-process stream-fit warm
+    pass ran before the ready line, so the proxy is handed a worker
+    that is warm, not merely alive. The baseline worker (booted
+    WITHOUT the knob) proves the contrast: its first stream compiles
+    the fit-stage programs, which are deliberately not in the AOT
+    lattice (the PR-18 dead-end)."""
+    from mano_hand_tpu.edge.fleet import Fleet, WorkerSpec
+    from mano_hand_tpu.serving.measure import _prom_value
+
+    def spec(i, **kw):
+        # Per-worker compile-cache dirs: worker subprocesses inherit
+        # the pytest lane's env (CLAUDE.md: never two processes on
+        # one cache dir).
+        return WorkerSpec(
+            platform="cpu", max_bucket=4, max_delay_ms=1.0,
+            max_subjects=16,
+            extra_env={"MANO_TEST_CACHE_DIR":
+                       str(tmp_path / f"jax_cache_w{i}")}, **kw)
+
+    def scrape(port):
+        cli = EdgeClient("127.0.0.1", port, timeout_s=30.0)
+        try:
+            text = cli.metrics_text()
+        finally:
+            cli.close()
+        return int(_prom_value(text, "mano_serving_compiles") or 0)
+
+    def first_stream_compiles(port):
+        before = scrape(port)
+        cli = EdgeClient("127.0.0.1", port, timeout_s=120.0)
+        try:
+            with cli.open_stream(betas=np.zeros(10, np.float32),
+                                 frame_deadline_s=120.0) as ws:
+                out = ws.frame(np.random.default_rng(3).normal(
+                    scale=0.05, size=(16, 3)).astype(np.float32))
+            assert out.frame == 0
+        finally:
+            cli.close()
+        return scrape(port) - before
+
+    fleet = Fleet([spec(0)], stderr_dir=str(tmp_path))
+    fleet.start(ready_timeout_s=420.0)
+    try:
+        name = fleet.add_worker(spec(1, warm_streams=True),
+                                ready_timeout_s=420.0)
+        assert name == "w1"
+        # Routed only after ready: the proxy holds both backends.
+        assert set(fleet.proxy.backends()) == {"w0", "w1"}
+        # The new worker's first stream frame: zero compiles.
+        assert first_stream_compiles(fleet.workers["w1"].port) == 0
+        # The cold-booted baseline pays the fit-stage compiles on ITS
+        # first stream — the knob is what made the difference.
+        assert first_stream_compiles(fleet.workers["w0"].port) > 0
+    finally:
+        reports = fleet.stop()
+    # Both workers drained politely (exit line present).
+    assert set(reports) == {"w0", "w1"}
+    assert all(r is not None for r in reports.values())

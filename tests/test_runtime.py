@@ -387,6 +387,68 @@ def test_chaos_lane_tag_specs_validated():
         laned()                    # lane counter restarted at 0
 
 
+# ------------------------------------------- time-windowed selectors
+def test_chaos_time_window_fires_by_elapsed_time():
+    """PR-19 satellite: 'KIND[:P]@T1s-T2s' fires on seconds elapsed
+    since schedule(), not on call indices — the selector the drill's
+    trace-aligned fault windows need. Half-open [T1, T2): a call at
+    the stop bound is clean."""
+    plan = chaos.ChaosPlan("error@0.05s-0.15s")
+    f = plan.wrap(lambda: "ok")
+    assert f() == "ok"                     # before the window opens
+    time.sleep(0.07)
+    with pytest.raises(chaos.InjectedFault):
+        f()                                # inside [0.05, 0.15)
+    time.sleep(0.12)
+    assert f() == "ok"                     # past the stop bound
+    assert plan.faults_injected == 1
+
+
+def test_chaos_time_open_window_and_lane_filter_compose():
+    """An open-ended '@T1s-' stays latched once elapsed passes T1, and
+    a '%LANE' tag on a time event is a pure filter: siblings stay
+    clean on the same clock."""
+    plan = chaos.ChaosPlan("error@0s-%1")
+    lane0 = plan.wrap(lambda: "a", lane=0)
+    lane1 = plan.wrap(lambda: "b", lane=1)
+    assert lane0() == "a"
+    with pytest.raises(chaos.InjectedFault):
+        lane1()
+    assert lane0() == "a"
+    with pytest.raises(chaos.InjectedFault):
+        lane1()
+
+
+def test_chaos_time_epoch_resets_on_schedule():
+    """schedule() re-anchors the elapsed-time epoch, so a re-armed
+    plan's windows realign to the new trace start."""
+    plan = chaos.ChaosPlan("error@0.2s-")
+    f = plan.wrap(lambda: "x")
+    assert f() == "x"                      # 0.2 s not yet elapsed
+    time.sleep(0.25)
+    with pytest.raises(chaos.InjectedFault):
+        f()
+    plan.schedule("error@0.2s-")           # fresh epoch: window closed
+    assert f() == "x"
+
+
+def test_chaos_time_window_specs_validated():
+    """Parse-time validation (the PR-5 chaos-grammar rule): mixed
+    index/time domains, bare time instants, empty windows, and
+    malformed seconds all fail construction."""
+    for bad in ("error@2s", "error@1s-3", "error@1-3s", "error@3s-1s",
+                "error@2s-2s", "error@-1s-2s", "error@xs-2s",
+                "error@1s-ys"):
+        with pytest.raises(ValueError):
+            chaos.parse_plan(bad)
+    ev = chaos.parse_plan("sat:0.05@1.5s-2.5s%0")._events[0]
+    assert (ev.kind, ev.t_start, ev.t_stop, ev.param, ev.lane) == (
+        "sat", 1.5, 2.5, 0.05, 0)
+    assert "1.5s-2.5s" in repr(ev) and "%0" in repr(ev)
+    open_ev = chaos.parse_plan("error@2s-")._events[0]
+    assert (open_ev.t_start, open_ev.t_stop) == (2.0, None)
+
+
 # ------------------------------------------------ the engine chaos matrix
 def _policy(plan=None, breaker=None, **kw):
     kw.setdefault("deadline_s", None)
