@@ -25,13 +25,13 @@
 	overload-smoke coldstart-smoke obs-smoke metrics-smoke \
 	posed-kernel-smoke stream-smoke lanes-smoke precision-smoke \
 	edge-smoke subject-store-smoke bench-smoke examples-smoke \
-	fleet-smoke control-smoke analyze
+	fleet-smoke control-smoke selfheal-smoke analyze
 
 check: analyze test chaos-smoke coalesce-smoke overload-smoke \
 	coldstart-smoke obs-smoke metrics-smoke posed-kernel-smoke \
 	stream-smoke lanes-smoke precision-smoke edge-smoke \
-	subject-store-smoke fleet-smoke control-smoke bench-smoke \
-	examples-smoke
+	subject-store-smoke fleet-smoke control-smoke selfheal-smoke \
+	bench-smoke examples-smoke
 
 # tests/test_runtime.py is excluded here and covered by the chaos-smoke
 # prerequisite instead (its own pytest process + cache dir): `make
@@ -155,7 +155,10 @@ bench-interpret:
 	  --fleet-drain-budget 20 \
 	  --control-pairs 1 --control-trace-s 0.8 --control-workers 8 \
 	  --control-max-bucket 4 --control-max-queued 8 \
-	  --control-tier1-quota 2
+	  --control-tier1-quota 2 \
+	  --selfheal-streams 4 --selfheal-frames 6 \
+	  --selfheal-stream-workers 4 --selfheal-tracks 2 \
+	  --selfheal-max-bucket 4 --selfheal-max-subjects 8
 
 # Serving-leg smoke (the bench-interpret counterpart for config7): the
 # whole serving-engine plumbing — bucket warm-up, ragged request stream,
@@ -409,6 +412,25 @@ subject-store-smoke:
 fleet-smoke:
 	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_fleet \
 	  python -m pytest tests/test_fleet.py -q
+
+# Self-healing fleet (the PR-20 tentpole): the FleetSupervisor's
+# death-detection channels (exit line + consecutive /healthz breaker
+# failures) and budgeted restart (degraded-with-incident when the
+# storm exhausts it — never flapping, the r3 lesson), the
+# active/standby ProxyPair flock takeover with a frame in flight,
+# client reconnect-and-resume (ResilientStream), the shard-rebalance
+# bit-identity vs a reference engine (the PR-16 remainder), the
+# torn-read load()["fleet"] snapshot hammer, the ChaosCampaign
+# schedule grammar/determinism, and the config23 drill protocol at
+# plumbing size. Wired into `make check` as a SEPARATE pytest process
+# on its own compile-cache dir (the CLAUDE.md rule: two pytest
+# processes must never share .jax_compile_cache/ — and every worker
+# SUBPROCESS gets its own tmp cache dir inside the tests for the same
+# reason). Slow-marked legs skip the tier-1 `-m 'not slow'` lane by
+# design; the pure-logic supervisor/campaign tests carry `quick`.
+selfheal-smoke:
+	TF_CPP_MIN_LOG_LEVEL=3 MANO_TEST_CACHE_DIR=/tmp/jax_cache_selfheal \
+	  python -m pytest tests/test_selfheal.py -q
 
 # Closed-loop control (the PR-19 tentpole): the adaptive controller's
 # actuation bounds (hysteresis, rate limit, saturation), the engine's

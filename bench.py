@@ -597,7 +597,8 @@ def run_benchmarks(args, device_str: str) -> dict:
                                               "config19_subject_store",
                                               "config20_dispatch_pipeline",
                                               "config21_fleet",
-                                              "config22_control"):
+                                              "config22_control",
+                                              "config23_selfheal"):
             return
         try:
             fn()
@@ -2634,6 +2635,63 @@ def run_benchmarks(args, device_str: str) -> dict:
     if args.control_pairs > 0:
         section("config22_control", config22_control)
 
+    # -- config 23: self-healing chaos campaign (PR 20) ---------------------
+    # THE recovery protocol (serving/measure.py:selfheal_drill_run): a
+    # seeded cross-process ChaosCampaign (worker SIGKILL, ACTIVE-proxy
+    # SIGKILL, SIGSTOP partition) against a FleetSupervisor-watched
+    # fleet behind an active/standby proxy pair, plus the restart-storm
+    # leg (budget exhausted -> degraded-with-incident, never flapping)
+    # and the in-process leg closing the PR-16 remainder (shard
+    # rebalance onto surviving lanes + damaged-cold-page re-bake).
+    # Criteria (scripts/bench_report.py:judge_selfheal) are all
+    # CPU-defined — workers pin `--platform cpu`, chaos is seeded
+    # signals on loopback processes, no chip involved: every death
+    # auto-healed with ZERO human invocations (replacements boot from
+    # the per-lane lattice with zero jit compiles), 100% of frames
+    # reaching an HTTP terminal with continuous numbering and bit-equal
+    # poses through the takeover, MTTR p99 inside budget, zero steady
+    # recompiles post-heal (live /metrics deltas), spans closed exactly
+    # once across process boundaries, storm leg degraded-with-incident,
+    # rebalanced shard bit-identical with zero recompiles, damaged page
+    # detected and re-baked bit-exactly.
+    def config23_selfheal():
+        from mano_hand_tpu.serving.measure import selfheal_drill_run
+
+        sd = selfheal_drill_run(
+            right,
+            workers=args.selfheal_workers,
+            lanes=args.selfheal_lanes,
+            streams=args.selfheal_streams,
+            frames_per_stream=args.selfheal_frames,
+            stream_workers=args.selfheal_stream_workers,
+            unique_tracks=args.selfheal_tracks,
+            max_bucket=args.selfheal_max_bucket,
+            max_subjects=args.selfheal_max_subjects,
+            mttr_budget_ms=args.selfheal_mttr_budget_ms,
+            seed=67,
+            log=lambda m: log(f"config23 {m}"),
+        )
+        results["selfheal"] = sd
+        oc = sd["outcomes"]
+        log(f"config23 selfheal: {sd['workers']} workers x "
+            f"{sd['lanes']} lanes, lattice boot {sd['lattice_boot_ok']}"
+            f", {sd['streams']} streams x {sd['frames_per_stream']} "
+            f"frames -> {sd['terminal_fraction']:.0%} terminal "
+            f"({oc['ok']} ok / {oc['http_error']} http / "
+            f"{oc['exception']} exc), {sd['supervisor_restarts']} "
+            f"heals for {sd['expected_heals']} deaths (MTTR p99 "
+            f"{sd['heal_p99_mttr_ms']} ms), takeover "
+            f"{sd['takeover_walls_ms']} ms, pose parity "
+            f"{sd['pose_max_abs_err']}, {sd['steady_recompiles_total']}"
+            f" steady recompiles, storm incidents "
+            f"{sd['storm']['incidents'] if sd.get('storm') else None}, "
+            f"rebalance err {sd['rebalance']['max_abs_err']}, damage "
+            f"re-bake err {sd['damage']['request_max_abs_err']}, "
+            f"spans once {sd['spans_closed_exactly_once']}")
+
+    if args.selfheal_streams > 0:
+        section("config23_selfheal", config23_selfheal)
+
     if args.serving_only:
         # Fast serving-layer artifact (`make serve-smoke`): the deferred
         # runner's serving-only skip reduces the schedule to config7
@@ -3158,6 +3216,51 @@ def main() -> int:
                          "baseline the controller must beat on "
                          "tier-1 served without losing tier-0 "
                          "goodput)")
+    ap.add_argument("--selfheal-streams", type=int, default=12,
+                    help="live streams of the self-healing chaos "
+                         "campaign (config23, PR 20: a supervised "
+                         "fleet behind an active/standby proxy pair "
+                         "under a seeded kill/takeover/partition "
+                         "campaign, plus the restart-storm and "
+                         "in-process rebalance/damage legs; workers "
+                         "pin --platform cpu and sockets are loopback "
+                         "— no chip involved; 0 skips the config, and "
+                         "the tiny-e2e bench tests pass 0 to keep "
+                         "subprocess fan-out out of that lane)")
+    ap.add_argument("--selfheal-workers", type=int, default=3,
+                    help="config23 worker processes (>= 3: one "
+                         "SIGKILLed, one SIGSTOPped, at least one "
+                         "always serving)")
+    ap.add_argument("--selfheal-lanes", type=int, default=2,
+                    help="dispatch lanes per config23 worker (healed "
+                         "replacements must boot every lane from the "
+                         "per-lane lattice with zero jit compiles)")
+    ap.add_argument("--selfheal-frames", type=int, default=7,
+                    help="frames per config23 stream (>= 6: settle "
+                         "wave + chaos waves + post-heal settle + "
+                         "judged steady wave)")
+    ap.add_argument("--selfheal-stream-workers", type=int, default=8,
+                    help="client thread pool stepping config23's "
+                         "resilient streams (one persistent "
+                         "connection per stream; reconnect-and-resume "
+                         "on transport death)")
+    ap.add_argument("--selfheal-tracks", type=int, default=4,
+                    help="distinct animation tracks of config23 "
+                         "(every frame must stay BIT-equal to the "
+                         "in-process reference across heals and the "
+                         "proxy takeover)")
+    ap.add_argument("--selfheal-max-bucket", type=int, default=8,
+                    help="bucket ceiling of config23's workers and "
+                         "reference engine")
+    ap.add_argument("--selfheal-max-subjects", type=int, default=32,
+                    help="subject capacity of config23's workers (the "
+                         "per-lane lattice bakes the shard capacity)")
+    ap.add_argument("--selfheal-mttr-budget-ms", type=float,
+                    default=300000.0,
+                    help="per-heal detect-to-ready budget judged at "
+                         "p99 (config23; generous — a heal is a full "
+                         "worker boot on a 1-core box, and the bar is "
+                         "'bounded and honest', not 'fast')")
     ap.add_argument("--spec-batch", type=int, default=256,
                     help="batch for the specialization leg's full-vs-"
                          "pose-only forward comparison (config8); "

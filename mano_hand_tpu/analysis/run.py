@@ -100,10 +100,22 @@ def run_analysis(
         root / "mano_hand_tpu" / "serving" / "control.py", order=())
     locks += check_lock_discipline(
         root / "mano_hand_tpu" / "serving" / "traffic.py", order=())
+    # PR 20: the self-healing tier. edge/fleet.py rides the edge/ glob
+    # above and now holds TWO more graphs — the FleetSupervisor's
+    # ledger lock (a LEAF: heals rewire the proxy OUTSIDE it; load()'s
+    # one-hold snapshot is the torn-read contract the seeded
+    # heal-vs-healthz cycle fixture deadlocks on) and the ProxyPair's
+    # process bookkeeping. runtime/chaos.py (campaign schedule lock,
+    # fault injection on the monotonic clock) is scanned here by name —
+    # chaos code that deadlocks or reads time.time() would corrupt the
+    # very drills that certify the healing paths.
+    locks += check_lock_discipline(
+        root / "mano_hand_tpu" / "runtime" / "chaos.py", order=())
     sections.append(("lock-discipline", locks,
                      "serving/engine.py + serving/streams.py + "
                      "serving/lanes.py + serving/subject_store.py + "
                      "serving/control.py + serving/traffic.py + "
+                     "runtime/chaos.py + "
                      "edge/ + obs/ nesting graphs + call edges"))
 
     step = check_lockstep(baseline.get("lockstep", {}))

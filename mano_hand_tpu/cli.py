@@ -1418,6 +1418,7 @@ def cmd_serve(args) -> int:
             # PR-18 dead-end), so a live warm pass is the only way to
             # pre-pay them. Best-effort: a failure logs and boots the
             # worker cold rather than not at all.
+            warmed = False
             if getattr(args, "warm_streams", False):
                 try:
                     sess = eng.open_stream(
@@ -1428,6 +1429,7 @@ def cmd_serve(args) -> int:
                         ).result(timeout=300)
                     finally:
                         sess.close()
+                    warmed = True
                     print("warm-streams: stream-fit family warm",
                           file=sys.stderr)
                 except Exception as e:  # noqa: BLE001 — cold > dead
@@ -1440,6 +1442,11 @@ def cmd_serve(args) -> int:
                 drain_timeout_s=args.drain_timeout_s,
                 retry_after_source=(None if ctl is None
                                     else ctl.retry_after_for),
+                # The healthz warm fact (PR 20): a definitive bool — a
+                # worker that skipped (or failed) the warm pass says
+                # False, and the proxy keeps NEW stream opens off it
+                # while a warm sibling is routable.
+                warm_streams=warmed,
                 log=lambda m: print(m, file=sys.stderr)).start()
             print(json.dumps({
                 "edge": {"host": srv.host, "port": srv.port,
@@ -1468,6 +1475,153 @@ def cmd_serve(args) -> int:
         print(f"device busy: {e}", file=sys.stderr)
         return 2
     return 0
+
+
+def cmd_proxy(args) -> int:
+    """`mano proxy` — ONE member of the active/standby proxy pair
+    (PR 20): the proxy tier's single point of failure, made killable.
+
+    Arbitration is the DeviceLock pattern at the socket level: both
+    members run this command against the same ``--lock`` file; exactly
+    one wins the EXCLUSIVE flock, binds the service ``--port``, and
+    serves. The loser parks in a bounded-step, SIGTERM-interruptible
+    ``LOCK_NB`` poll (never a C-level ``LOCK_EX`` wait — signal
+    handlers need the main thread, the CLAUDE.md rule). When the
+    active dies — SIGKILL included — the kernel releases its flock and
+    the standby takes over: it reads+increments the takeover
+    generation stored IN the lock file (under the flock), waits
+    (bounded) for the corpse's port to free, rebuilds per-backend
+    routing state from the workers' own ``/healthz``
+    (``EdgeProxy.resync_backends``), and serves. Live streams are not
+    lost: clients reconnect through ``edge.client.ResilientStream``,
+    which re-opens with ``resume_pose`` (the PR-18 last-confirmed-pose
+    protocol) against the new active.
+
+    stdout contract (edge/fleet.py's ``ProxyPair`` parses it):
+    a ready line at spawn ``{"proxy": {pid, port, role: "standby"}}``
+    BEFORE the (possibly unbounded) park; on activation
+    ``{"proxy_event": {event: "active", takeovers: N, port}}``; on
+    SIGTERM a final ``{"proxy_exit": {...}}``. Logs go to stderr.
+    """
+    import errno
+    import fcntl
+    import os
+    import signal
+    import socket
+    import threading
+    import time as _time
+
+    from mano_hand_tpu.edge.proxy import Backend, EdgeProxy
+
+    backends = []
+    for spec in args.backend:
+        name, _, hp = spec.partition("=")
+        host, _, port = hp.rpartition(":")
+        if not name or not host or not port.isdigit():
+            print(f"--backend wants NAME=HOST:PORT, got {spec!r}",
+                  file=sys.stderr)
+            return 2
+        backends.append(Backend(name, host, int(port)))
+    if not backends:
+        print("proxy needs at least one --backend NAME=HOST:PORT",
+              file=sys.stderr)
+        return 2
+
+    stop_evt = threading.Event()
+
+    def _on_signal(signum, frame):
+        print(f"signal {signum}: proxy stopping", file=sys.stderr)
+        stop_evt.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    # Ready line FIRST: the pair supervisor needs the pid before the
+    # park, which lasts as long as the active lives.
+    print(json.dumps({"proxy": {"pid": os.getpid(),
+                                "port": int(args.port),
+                                "role": "standby"}}), flush=True)
+
+    fd = open(args.lock, "a+")
+    try:
+        while not stop_evt.is_set():
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError as e:
+                if e.errno not in (errno.EAGAIN, errno.EACCES):
+                    raise
+                stop_evt.wait(0.05)
+        if stop_evt.is_set():
+            print(json.dumps({"proxy_exit": {
+                "role": "standby", "served": False}}), flush=True)
+            return 0
+
+        # The takeover generation lives IN the lock file, mutated only
+        # under the flock we now hold: generation 0 is the first-boot
+        # active, N the Nth takeover winner.
+        fd.seek(0)
+        try:
+            gen = int(json.loads(fd.read() or "{}").get(
+                "takeovers", -1)) + 1
+        except ValueError:
+            gen = 0
+        fd.seek(0)
+        fd.truncate(0)
+        fd.write(json.dumps({"takeovers": gen, "pid": os.getpid()}))
+        fd.flush()
+
+        # A SIGKILLed predecessor's listener closes with its process
+        # (the same teardown that released the flock), but give the
+        # kernel a bounded beat rather than crash-looping on EADDRINUSE.
+        bind_deadline = _time.monotonic() + 10.0
+        while not stop_evt.is_set():
+            probe = socket.socket()
+            probe.setsockopt(socket.SOL_SOCKET,
+                             socket.SO_REUSEADDR, 1)
+            try:
+                probe.bind((args.host, int(args.port)))
+                break
+            except OSError as e:
+                if _time.monotonic() > bind_deadline:
+                    print(f"service port {args.port} never freed: {e}",
+                          file=sys.stderr)
+                    print(json.dumps({"proxy_exit": {
+                        "role": "active", "takeovers": gen,
+                        "error": f"bind: {e}"}}), flush=True)
+                    return 1
+                stop_evt.wait(0.05)
+            finally:
+                probe.close()
+        if stop_evt.is_set():
+            print(json.dumps({"proxy_exit": {
+                "role": "standby", "served": False}}), flush=True)
+            return 0
+
+        proxy = EdgeProxy(
+            backends, host=args.host, port=int(args.port),
+            drain_timeout_s=args.drain_timeout_s,
+            upstream_timeout_s=args.upstream_timeout_s,
+            role="active", takeovers=gen,
+            log=lambda m: print(m, file=sys.stderr))
+        # Routing rebuild BEFORE the first proxied byte: a takeover
+        # winner must not start with an empty breaker ledger aimed at
+        # a dead worker. Bounded (concurrent, per-backend timeout).
+        resynced = proxy.resync_backends(timeout_s=5.0)
+        proxy.start()
+        print(json.dumps({"proxy_event": {
+            "event": "active", "takeovers": gen, "port": proxy.port,
+            "backends_up": sum(1 for ok in resynced.values() if ok),
+            "backends": len(resynced)}}), flush=True)
+        while not stop_evt.wait(0.2):
+            pass
+        report = proxy.drain(timeout_s=args.drain_timeout_s)
+        print(json.dumps({"proxy_exit": {
+            "role": "active", "takeovers": gen, "drain": report,
+            "counters": proxy._counter_dict()}}), flush=True)
+        return 0
+    finally:
+        fd.close()                      # releases the flock if held
 
 
 def cmd_trace_report(args) -> int:
@@ -1615,11 +1769,19 @@ def cmd_status(args) -> int:
                 # one more dict to surface, per-worker health/breaker
                 # state included.
                 server_block["role"] = "proxy"
+                # PR 20: active/standby pair facts. A mid-takeover
+                # probe (nobody bound to the service port yet) lands
+                # in the except arm below as an error fact — the
+                # command still never hangs (socket timeout) and rc
+                # stays 0; the next scrape sees the new active's
+                # incremented takeover generation.
+                server_block["proxy_role"] = h.get("proxy_role")
+                server_block["takeovers"] = h.get("takeovers")
                 server_block["backends"] = {
                     name: {k: b.get(k) for k in
                            ("ok", "status", "degraded", "breaker",
                             "draining_via_proxy", "outstanding",
-                            "streams", "error")}
+                            "streams", "stream_warm", "error")}
                     for name, b in (h.get("backends") or {}).items()}
                 server_block["counters"] = h.get("counters")
             try:
@@ -2170,6 +2332,30 @@ def build_parser() -> argparse.ArgumentParser:
                          "server on device backends, off when "
                          "--platform cpu pins the host")
     sv.set_defaults(fn=cmd_serve)
+
+    px = sub.add_parser(
+        "proxy",
+        help="one member of the active/standby fleet-proxy pair "
+             "(PR 20): parks on an exclusive flock; the winner binds "
+             "the service port, resyncs backend health from worker "
+             "/healthz, and serves — a SIGKILLed active's kernel-"
+             "released lock activates the standby with an incremented "
+             "takeover generation")
+    px.add_argument("--port", type=int, required=True,
+                    help="the pair's stable service port (clients and "
+                         "ResilientStream reconnect here across "
+                         "takeovers)")
+    px.add_argument("--host", default="127.0.0.1")
+    px.add_argument("--lock", required=True,
+                    help="flock arbitration file; also carries the "
+                         "takeover generation (mutated only under the "
+                         "flock)")
+    px.add_argument("--backend", action="append", default=[],
+                    metavar="NAME=HOST:PORT",
+                    help="one worker address (repeatable)")
+    px.add_argument("--drain-timeout-s", type=float, default=10.0)
+    px.add_argument("--upstream-timeout-s", type=float, default=300.0)
+    px.set_defaults(fn=cmd_proxy)
 
     tr = sub.add_parser(
         "trace-report",

@@ -11,7 +11,12 @@
 * ``proxy.EdgeProxy`` — the fleet front tier (PR 18): health-aware
   routing over N workers with live stream migration;
 * ``fleet.Fleet`` / ``fleet.WorkerProc`` — kill -9-capable worker
-  process supervision (the chaos drill's substrate).
+  process supervision (the chaos drill's substrate);
+* ``fleet.FleetSupervisor`` / ``fleet.ProxyPair`` — the self-healing
+  tier (PR 20): auto-restart of dead workers inside a budget, and the
+  active/standby proxy pair behind flock takeover;
+* ``client.ResilientStream`` — client-side reconnect-and-resume, so a
+  SIGKILLed proxy loses no stream.
 """
 
 from mano_hand_tpu.edge.client import (  # noqa: F401
@@ -19,8 +24,17 @@ from mano_hand_tpu.edge.client import (  # noqa: F401
     EdgeError,
     EdgeStreamClient,
     FrameReply,
+    ResilientStream,
 )
-from mano_hand_tpu.edge.fleet import Fleet, WorkerProc, WorkerSpec  # noqa: F401
+from mano_hand_tpu.edge.fleet import (  # noqa: F401
+    Fleet,
+    FleetSupervisor,
+    ProxyPair,
+    ProxyProc,
+    ProxySpec,
+    WorkerProc,
+    WorkerSpec,
+)
 from mano_hand_tpu.edge.proxy import Backend, EdgeProxy  # noqa: F401
 from mano_hand_tpu.edge.server import EdgeServer  # noqa: F401
 
@@ -32,7 +46,12 @@ __all__ = [
     "EdgeServer",
     "EdgeStreamClient",
     "Fleet",
+    "FleetSupervisor",
     "FrameReply",
+    "ProxyPair",
+    "ProxyProc",
+    "ProxySpec",
+    "ResilientStream",
     "WorkerProc",
     "WorkerSpec",
 ]

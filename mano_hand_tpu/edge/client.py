@@ -316,3 +316,147 @@ class EdgeStreamClient:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+class ResilientStream:
+    """Reconnect-and-resume stream client (PR 20): the PR-18
+    last-confirmed-pose protocol applied at the CLIENT edge.
+
+    The proxy's ``_StreamRelay`` survives a WORKER death for the
+    client; nothing survives the death of the proxy itself — the
+    socket dies and the relay's state dies with it. This wrapper keeps
+    that state (the original open identity, the last CONFIRMED pose,
+    the confirmed-frame count) on the client side and, when the
+    transport dies mid-op, reconnects to the SAME host:port (the
+    pair's stable service port — the flock winner binds it), re-opens
+    with ``resume_pose=<last confirmed pose>``, and re-sends the
+    in-flight frame. Re-sending is safe for exactly the relay's
+    reason: the lost reply never reached us (one reply line per op,
+    strictly ordered), and a deterministic fit warm-started from the
+    same confirmed pose re-derives the SAME result. Frame numbers stay
+    continuous: the resumed session counts from 0 again and every
+    reply gets the confirmed-count offset added.
+
+    Reconnects are BOUNDED (attempt cap + deadline + doubling
+    backoff) and classified: a transport death retries, a structured
+    server refusal (shed/expired/bad request) raises immediately —
+    the stream is alive and the refusal is the caller's business.
+    """
+
+    def __init__(self, host: str, port: int, *,
+                 timeout_s: float = 30.0,
+                 subject: Optional[str] = None, betas=None,
+                 max_reconnects: int = 8,
+                 reconnect_backoff_s: float = 0.1,
+                 reconnect_timeout_s: float = 30.0,
+                 **open_kw):
+        self.host = host
+        self.port = int(port)
+        self.timeout_s = float(timeout_s)
+        self._subject = subject
+        self._betas = betas
+        self._open_kw = dict(open_kw)
+        self.max_reconnects = int(max_reconnects)
+        self.reconnect_backoff_s = float(reconnect_backoff_s)
+        self.reconnect_timeout_s = float(reconnect_timeout_s)
+        self.reconnects = 0             # successful session re-opens
+        self._last_pose: Optional[np.ndarray] = None
+        self._frames_confirmed = 0
+        self._offset = 0
+        self._stream = self._dial(resume=False)
+
+    # ----------------------------------------------------------- plumbing
+    def _dial(self, *, resume: bool) -> EdgeStreamClient:
+        kw = dict(self._open_kw)
+        if resume and self._last_pose is not None:
+            kw["resume_pose"] = self._last_pose
+        return EdgeStreamClient(
+            self.host, self.port, timeout_s=self.timeout_s,
+            subject=self._subject, betas=self._betas, **kw)
+
+    def _reconnect(self, cause: BaseException) -> None:
+        """Bounded re-dial of the SAME address with resume state; on
+        exhaustion raises an ``EdgeError`` that names both the
+        original death and the last reconnect failure."""
+        try:
+            self._stream.abort()
+        except Exception:  # noqa: BLE001 — already dead
+            pass
+        import time
+
+        deadline = time.monotonic() + self.reconnect_timeout_s
+        delay = self.reconnect_backoff_s
+        attempt = 0
+        last: BaseException = cause
+        while True:
+            attempt += 1
+            try:
+                self._stream = self._dial(resume=True)
+                break
+            except (EdgeError, OSError, ConnectionError,
+                    ValueError) as e:
+                last = e
+                if (attempt >= self.max_reconnects
+                        or time.monotonic() >= deadline):
+                    raise EdgeError(0, message=(
+                        f"stream lost ({type(cause).__name__}: {cause})"
+                        f" and reconnect exhausted after {attempt} "
+                        f"attempt(s): {type(last).__name__}: {last}"
+                    )) from cause
+                time.sleep(min(delay,
+                               max(0.0, deadline - time.monotonic())))
+                delay *= 2.0
+        self._offset = self._frames_confirmed
+        self.reconnects += 1
+
+    # ------------------------------------------------------------- surface
+    @property
+    def stream_id(self):
+        return self._stream.stream_id
+
+    @property
+    def subject(self):
+        return self._stream.subject
+
+    def frame(self, target, *,
+              deadline_s: Optional[float] = None) -> FrameReply:
+        msg = {"op": "frame", "target": proto.encode_array(target)}
+        if deadline_s is not None:
+            msg["deadline_s"] = deadline_s
+        while True:
+            try:
+                # _roundtrip raises ONLY on transport death (closed
+                # socket / timeout / torn line); structured refusals
+                # come back as a reply dict and are never retried.
+                reply = self._stream._roundtrip(msg)
+                break
+            except (EdgeError, OSError, ConnectionError,
+                    ValueError) as e:
+                self._reconnect(e)      # raises when exhausted
+        if "error" in reply:
+            raise EdgeError(0, reply,
+                            message=f"frame failed: {reply['error']}")
+        out = FrameReply(
+            pose=proto.decode_array(reply["pose"]),
+            verts=proto.decode_array(reply["verts"]),
+            fit_loss=float(reply["fit_loss"]),
+            frame=int(reply["frame"]) + self._offset,
+        )
+        self._last_pose = out.pose
+        self._frames_confirmed = out.frame + 1
+        return out
+
+    def close(self) -> Optional[dict]:
+        reply = self._stream.close()
+        if isinstance(reply, dict) and "frames" in reply:
+            reply["frames"] = int(reply["frames"]) + self._offset
+        return reply
+
+    def abort(self) -> None:
+        self._stream.abort()
+
+    def __enter__(self) -> "ResilientStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -111,6 +111,17 @@ class Backend:
         self.draining = False
         self.outstanding = 0            # one-shot requests in flight
         self.streams: set = set()       # live _StreamRelay objects
+        # The worker's own /healthz ``warm_streams`` fact (PR 20).
+        # Tri-state: None = unknown (old worker, or no probe yet) stays
+        # ELIGIBLE for stream opens — exactly the pre-PR-20 fleet;
+        # False = the worker said it booted cold, so NEW streams prefer
+        # a warm sibling (the cold-stream-start guard).
+        self.stream_warm: Optional[bool] = None
+        # Proxy-assigned registration stamp: 0 for the boot-time fleet,
+        # monotone-increasing for scale-up joins (``add_backend``).
+        # Only consulted as a stream-open tie-break, so the boot-time
+        # fleet's routing order is byte-identical to before.
+        self.boot_seq = 0
 
     def _healthz_probe(self) -> bool:
         """Bounded liveness GET (runs on a disposable thread, never the
@@ -121,6 +132,8 @@ class Backend:
             with EdgeClient(self.host, self.port,
                             timeout_s=self.probe_timeout_s) as cli:
                 h = cli.healthz()
+            if "warm_streams" in h:
+                self.stream_warm = bool(h["warm_streams"])
             return h.get("status") == "serving"
         except (EdgeError, OSError, ValueError):
             return False
@@ -148,15 +161,25 @@ class EdgeProxy:
                  probe_timeout_s: float = 2.0,
                  upstream_timeout_s: float = 300.0,
                  max_body_bytes: int = MAX_BODY_BYTES,
+                 role: str = "active",
+                 takeovers: int = 0,
                  retry_after_source: Optional[Callable] = None,
                  log: Optional[Callable[[str], None]] = None):
         self._backends: Dict[str, Backend] = {}
+        self._boot_seq = 0              # bumped by add_backend only
         for i, be in enumerate(backends):
             if not isinstance(be, Backend):
                 host_i, port_i = be
                 be = Backend(f"w{i}", host_i, port_i,
                              probe_timeout_s=probe_timeout_s)
             self._backends[be.name] = be
+        # Active/standby (PR 20): ``role`` is what this process IS
+        # right now ("active" serves; "standby" is parked on the flock
+        # in cli.cmd_proxy and never reaches start()). ``takeovers`` is
+        # the lock file's takeover generation at activation — 0 for a
+        # first-boot active, N for the Nth flock winner.
+        self.role = str(role)
+        self.takeovers = int(takeovers)
         self.host = host
         self.port = int(port)
         self.drain_timeout_s = float(drain_timeout_s)
@@ -292,6 +315,21 @@ class EdgeProxy:
         breaker — subject traffic re-routes around it)."""
         from mano_hand_tpu.edge.client import EdgeClient, EdgeError
 
+        self._boot_seq += 1
+        be.boot_seq = self._boot_seq
+        # Cold-stream-start guard (PR 20): learn the worker's
+        # ``warm_streams`` fact BEFORE it becomes routable, so a cold
+        # scale-up worker cannot win a stream open purely by being the
+        # idlest process in the fleet. Best-effort and bounded: an
+        # unreadable fact leaves the tri-state at None (eligible).
+        try:
+            with EdgeClient(be.host, be.port,
+                            timeout_s=be.probe_timeout_s) as cli:
+                h = cli.healthz()
+            if "warm_streams" in h:
+                be.stream_warm = bool(h["warm_streams"])
+        except (EdgeError, OSError, ValueError):
+            pass
         self._backends[be.name] = be
         deadline = time.monotonic() + float(replay_timeout_s)
         for body in list(self._specialized.values()):
@@ -309,6 +347,55 @@ class EdgeProxy:
 
     def remove_backend(self, name: str) -> None:
         self._backends.pop(name, None)
+
+    def resync_backends(self, timeout_s: float = 10.0) -> dict:
+        """Rebuild per-backend routing state from the workers' own
+        ``/healthz`` — the standby-takeover path (PR 20): a freshly
+        active proxy must not inherit an empty breaker ledger that
+        routes the first post-takeover frames at a corpse. Bounded
+        CONCURRENT sweep on disposable threads (no event loop needed —
+        callable BEFORE ``start()``, which is exactly when cmd_proxy
+        runs it). A live worker is recorded healthy (plus its
+        ``warm_streams`` fact); a dead one is driven to DOWN through
+        the breaker's own public failure path, so the breaker's
+        re-probe ladder owns its recovery exactly as if the failures
+        had been observed in traffic. Returns ``{name: ok}``."""
+        results: Dict[str, bool] = {}
+
+        def sweep(be: Backend) -> None:
+            from mano_hand_tpu.edge.client import EdgeClient, EdgeError
+
+            try:
+                with EdgeClient(be.host, be.port,
+                                timeout_s=min(float(timeout_s),
+                                              be.probe_timeout_s)) as c:
+                    h = c.healthz()
+                ok = h.get("status") == "serving"
+            except (EdgeError, OSError, ValueError):
+                ok, h = False, {}
+            if ok:
+                if "warm_streams" in h:
+                    be.stream_warm = bool(h["warm_streams"])
+                be.breaker.record_success()
+            else:
+                # Classified and bounded: feed consecutive failures
+                # until the threshold trips — never a raw state poke,
+                # so the transition callback/ledger stay truthful.
+                for _ in range(64):
+                    if be.breaker.record_failure() == DOWN:
+                        break
+            results[be.name] = ok
+
+        threads = [threading.Thread(target=sweep, args=(be,),
+                                    name=f"resync-{be.name}",
+                                    daemon=True)
+                   for be in list(self._backends.values())]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + float(timeout_s)
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        return results
 
     def drain_backend(self, name: str,
                       timeout_s: float = 10.0) -> dict:
@@ -364,6 +451,34 @@ class EdgeProxy:
             return None
         cands.sort(key=lambda b: (
             0 if b.breaker.state == HEALTHY else 1, b.load(), b.name))
+        return cands[0]
+
+    def _pick_stream(self, exclude=()) -> Optional[Backend]:
+        """Stream-open placement (PR 20): like ``_pick`` but a worker
+        that told us it booted COLD (``stream_warm is False``) must not
+        win a new open while a warm (or unknown — pre-fact) sibling is
+        routable, or the client's first frames pay that worker's jit
+        wall. Unknown (None) stays eligible — exactly the pre-PR-20
+        fleet. Among survivors the sort adds ``-boot_seq`` before the
+        name tie-break: the boot-time fleet all carries seq 0 (order
+        unchanged), and a WARM scale-up join is preferred at equal
+        load — new capacity takes new sessions. Falls back to the
+        plain pick when only cold workers remain: availability beats
+        warmth."""
+        cands = []
+        for be in self._backends.values():
+            if be.breaker.probe_due():
+                threading.Thread(target=be.breaker.allow_primary,
+                                 name=f"probe-{be.name}",
+                                 daemon=True).start()
+            if (be.routable() and be.name not in exclude
+                    and be.stream_warm is not False):
+                cands.append(be)
+        if not cands:
+            return self._pick(exclude)
+        cands.sort(key=lambda b: (
+            0 if b.breaker.state == HEALTHY else 1, b.load(),
+            -b.boot_seq, b.name))
         return cands[0]
 
     async def _connect(self, be: Backend):
@@ -648,6 +763,8 @@ class EdgeProxy:
             *(probe_one(be) for be in list(self._backends.values())))
         backends = {}
         for be, h in results:
+            if "warm_streams" in h:     # refresh the PR-20 warm fact
+                be.stream_warm = bool(h["warm_streams"])
             backends[be.name] = {
                 "ok": bool(h.get("ok", False)),
                 "status": h.get("status"),
@@ -657,12 +774,15 @@ class EdgeProxy:
                 "draining_via_proxy": be.draining,
                 "outstanding": be.outstanding,
                 "streams": len(be.streams),
+                "stream_warm": be.stream_warm,
             }
         routable = sum(1 for be, _h in results if be.routable())
         ok = not self._draining and routable > 0
         body = {
             "ok": ok,
             "role": "proxy",
+            "proxy_role": self.role,
+            "takeovers": self.takeovers,
             "status": "draining" if self._draining else "proxying",
             "degraded": 0 < routable < len(backends),
             "uptime_s": round(time.monotonic() - self._t0, 3),
@@ -692,6 +812,11 @@ class EdgeProxy:
         for k, v in self._counter_dict().items():
             lines.append(f"# TYPE mano_proxy_{k} counter")
             lines.append(f"mano_proxy_{k} {v}")
+        lines.append("# TYPE mano_proxy_takeovers counter")
+        lines.append(f"mano_proxy_takeovers {self.takeovers}")
+        lines.append("# TYPE mano_proxy_active gauge")
+        lines.append(
+            f"mano_proxy_active {1 if self.role == 'active' else 0}")
         for be in self._backends.values():
             lab = f'{{backend="{be.name}"}}'
             lines.append(
@@ -699,6 +824,9 @@ class EdgeProxy:
             lines.append(
                 f"mano_proxy_backend_routable{lab} "
                 f"{1 if be.routable() else 0}")
+            lines.append(
+                f"mano_proxy_backend_stream_warm{lab} "
+                f"{-1 if be.stream_warm is None else int(be.stream_warm)}")
         await write_response(
             writer, 200, ("\n".join(lines) + "\n").encode("utf-8"),
             content_type="text/plain; version=0.0.4")
@@ -811,7 +939,7 @@ class _StreamRelay:
         self.open_msg = msg
         tried = set()
         while True:
-            be = self.proxy._pick(exclude=tried)
+            be = self.proxy._pick_stream(exclude=tried)
             if be is None:
                 self.open_msg = None
                 await self._send_client(proto.error_body(
@@ -867,7 +995,7 @@ class _StreamRelay:
             self.backend = self.b_rd = self.b_w = None
         tried = {old.name} if old is not None else set()
         while True:
-            be = self.proxy._pick(exclude=tried)
+            be = self.proxy._pick_stream(exclude=tried)
             if be is None:
                 return False
             tried.add(be.name)

@@ -212,8 +212,15 @@ class EdgeServer:
                  *, registry=None, drain_timeout_s: float = 10.0,
                  max_body_bytes: int = MAX_BODY_BYTES,
                  retry_after_source: Optional[Callable] = None,
+                 warm_streams: Optional[bool] = None,
                  log: Optional[Callable[[str], None]] = None):
         self._engine = engine
+        # PR 20: did this worker pre-warm its stream path before
+        # declaring ready (cmd_serve --warm-streams)? Tri-state fact
+        # surfaced on /healthz so the proxy can keep NEW stream opens
+        # off a cold scale-up worker. None = the owner never said
+        # (embedded/test servers) and the key is omitted from healthz.
+        self._warm_streams = warm_streams
         self.host = host
         self.port = int(port)           # rewritten to the bound port
         self._registry = registry
@@ -485,6 +492,8 @@ class EdgeServer:
             }),
             "breaker": None if breaker is None else breaker.state,
         }
+        if self._warm_streams is not None:
+            body["warm_streams"] = bool(self._warm_streams)
         await self._respond(writer, 200 if ok else 503, body)
         return True
 

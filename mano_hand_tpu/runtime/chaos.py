@@ -422,3 +422,197 @@ class ChaosPlan:
 def parse_plan(spec: str) -> ChaosPlan:
     """``spec`` (grammar above) -> a fresh ChaosPlan."""
     return ChaosPlan(spec)
+
+
+# --------------------------------------------------------------------------
+# Process-level campaign (PR 20): the same spec-string discipline, one
+# level up. A ChaosPlan wraps CALLABLES (a device dispatch dies); a
+# ChaosCampaign schedules WHOLE-PROCESS events (a worker dies, the
+# proxy dies, a backend partitions, a cold page is damaged) against a
+# live fleet — the faults the self-healing tier exists to absorb.
+# --------------------------------------------------------------------------
+
+_CAMPAIGN_KINDS = ("kill_worker", "kill_proxy", "partition", "damage_page")
+# partition REQUIRES ':SECONDS' (how long the victim stays unreachable
+# before the campaign lifts it); the kill/damage kinds are instants and
+# take none — same typo-hardening stance as the call-level grammar.
+_CAMPAIGN_PARAM_REQUIRED = ("partition",)
+
+
+class CampaignEvent:
+    """One scheduled process-level fault: ``kind`` fired ``at_s``
+    seconds after ``ChaosCampaign.start()``."""
+
+    __slots__ = ("kind", "at_s", "param")
+
+    def __init__(self, kind: str, at_s: float, param: float = 0.0):
+        self.kind = kind
+        self.at_s = at_s
+        self.param = param
+
+    def __repr__(self) -> str:
+        p = f":{self.param}" if self.param else ""
+        return f"CampaignEvent({self.kind}{p}@{self.at_s}s)"
+
+
+def parse_campaign(spec: str) -> List[CampaignEvent]:
+    """Campaign spec -> time-ordered events. Grammar (the call-level
+    spec's shape, with the selector REQUIRED to be a time instant —
+    process events live on the wall, not on a dispatch counter)::
+
+        KIND[:PARAM]@Ts[, ...]
+
+        kill_worker@2s              SIGKILL one seeded-picked worker
+        kill_proxy@4s               SIGKILL the active proxy
+        partition:1.5@6s            one backend unreachable for 1.5 s
+        damage_page@8s              corrupt one cold row page
+
+    Validated at parse time like ``parse_plan``: unknown kinds,
+    call-index selectors (no ``s`` suffix), windows (``T1s-T2s`` — a
+    process kill is an instant), missing/forbidden ``:PARAM``, and
+    negative times all raise ValueError with the offending token.
+    Ties fire in spec order (stable sort)."""
+    events = []
+    for token in (t.strip() for t in spec.split(",") if t.strip()):
+        head, _, sel = token.partition("@")
+        if not sel:
+            raise ValueError(f"campaign event {token!r} lacks '@Ts'")
+        if "-" in sel:
+            raise ValueError(
+                f"campaign event {token!r}: campaign selectors are "
+                "instants (KIND@Ts), not windows")
+        if not sel.endswith("s"):
+            raise ValueError(
+                f"campaign event {token!r}: selector {sel!r} must be "
+                "a time instant with the 's' suffix (e.g. @2s) — "
+                "process events live on the wall clock, not a call "
+                "index")
+        at_s = _parse_seconds(sel[:-1], token)
+        kind, colon, param_s = head.partition(":")
+        if kind not in _CAMPAIGN_KINDS:
+            raise ValueError(
+                f"unknown campaign kind {kind!r} (one of "
+                f"{_CAMPAIGN_KINDS})")
+        if kind in _CAMPAIGN_PARAM_REQUIRED and not param_s:
+            raise ValueError(
+                f"{kind} events need ':SECONDS' (e.g. {kind}:1.5@2s)")
+        if colon and kind not in _CAMPAIGN_PARAM_REQUIRED:
+            raise ValueError(
+                f"campaign event {token!r}: {kind} takes no ':PARAM' "
+                f"(only {_CAMPAIGN_PARAM_REQUIRED} do)")
+        if param_s:
+            param = _parse_seconds(param_s, token)
+        else:
+            param = 0.0
+        events.append(CampaignEvent(kind, at_s, param))
+    events.sort(key=lambda e: e.at_s)
+    return events
+
+
+class ChaosCampaign:
+    """A deterministic seeded schedule of process-level faults driven
+    against a live fleet.
+
+    The DRILL registers one handler per kind (``on``) — the campaign
+    owns WHEN and (via :meth:`pick`) WHICH, the handler owns HOW (it
+    holds the fleet/proxy/store references; the campaign imports
+    nothing above runtime/). Handlers run on the campaign's driver
+    thread, exceptions are captured into the audit trail rather than
+    killing the campaign mid-drill (a chaos harness that dies on its
+    own fault is useless), and every firing lands in ``events_fired``
+    with its measured offset — the drill's schedule-vs-actual
+    forensics.
+
+    Determinism: victim selection draws from ONE ``numpy`` Generator
+    seeded at construction, consumed in event order on the single
+    driver thread, over the SORTED candidate list the handler passes
+    to :meth:`pick` — same seed + same alive-sets = same victims,
+    run after run (the ChaosPlan philosophy at process scope)."""
+
+    def __init__(self, spec: str, seed: int = 0, log=None):
+        self.events = parse_campaign(spec)
+        self._rng = np.random.default_rng(seed)
+        self.seed = int(seed)
+        self._handlers: dict = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._log = log
+        self.events_fired: List[dict] = []
+
+    # ------------------------------------------------------------- wiring
+    def on(self, kind: str, handler: Callable) -> "ChaosCampaign":
+        """Register ``handler(event) -> json-able result`` for one
+        kind; chainable. The result (e.g. the victim's name) lands in
+        the audit trail."""
+        if kind not in _CAMPAIGN_KINDS:
+            raise ValueError(
+                f"unknown campaign kind {kind!r} (one of "
+                f"{_CAMPAIGN_KINDS})")
+        self._handlers[kind] = handler
+        return self
+
+    def pick(self, candidates):
+        """Seeded choice over ``sorted(candidates)`` — handlers call
+        this at FIRE time so the victim set reflects who is actually
+        alive (a worker healed since the last kill is back in the
+        pool). None when the pool is empty."""
+        cands = sorted(candidates)
+        if not cands:
+            return None
+        return cands[int(self._rng.integers(len(cands)))]
+
+    # ------------------------------------------------------------- driving
+    def start(self) -> "ChaosCampaign":
+        """Drive the schedule on a daemon thread (the drill's streams
+        keep flowing while faults land). Every scheduled kind must
+        have a handler — a campaign that silently skips events would
+        read as 'survived' without being tested."""
+        missing = sorted({e.kind for e in self.events}
+                         - set(self._handlers))
+        if missing:
+            raise RuntimeError(
+                f"campaign kinds with no handler: {missing}")
+        if self._thread is not None:
+            raise RuntimeError("campaign already started")
+        self._thread = threading.Thread(
+            target=self._drive, name="mano-chaos-campaign", daemon=True)
+        self._thread.start()
+        return self
+
+    def _drive(self) -> None:
+        epoch = time.monotonic()
+        for ev in self.events:
+            delay = ev.at_s - (time.monotonic() - epoch)
+            if delay > 0 and self._stop.wait(delay):
+                return
+            if self._stop.is_set():
+                return
+            entry = {"kind": ev.kind, "at_s": ev.at_s,
+                     "param": ev.param,
+                     "fired_s": round(time.monotonic() - epoch, 3)}
+            try:
+                entry["result"] = self._handlers[ev.kind](ev)
+            except Exception as e:  # noqa: BLE001 — audit, don't die
+                entry["error"] = f"{type(e).__name__}: {e}"
+            with self._lock:
+                self.events_fired.append(entry)
+            if self._log is not None:
+                self._log(f"[campaign] {entry}")
+
+    def join(self, timeout_s: float = 60.0) -> bool:
+        """Wait for the schedule to finish; False on timeout."""
+        t = self._thread
+        if t is None:
+            return True
+        t.join(timeout_s)
+        return not t.is_alive()
+
+    def stop(self) -> None:
+        """Abandon the remaining schedule (drill teardown)."""
+        self._stop.set()
+
+    def fired(self) -> List[dict]:
+        """A snapshot of the audit trail (one lock hold)."""
+        with self._lock:
+            return [dict(e) for e in self.events_fired]

@@ -186,6 +186,10 @@ class LaneSet:
         # count in the engine constructor).
         store = getattr(engine, "_subject_store", None)
         self._sharded = bool(store is not None and store.sharded)
+        # Shard-rebalance kick guard (PR 20): shards whose adoption
+        # thread has been spawned (under ``_lock``) — one rebalance per
+        # dead shard, never a spawn storm from a hot dispatcher loop.
+        self._rebalance_kicked: set = set()
         devs = mesh.lane_devices(n, devices=devices)
         self.n_devices = len({str(d) for d in devs})
         pol = engine._policy
@@ -294,6 +298,18 @@ class LaneSet:
             owner = self.lanes[shard]
             if owner.breaker is None or owner.breaker.state != health.DOWN:
                 return owner
+            if self._sharded and shard not in self._rebalance_kicked:
+                # Owner lane DOWN (PR 20): adopt its shard onto the
+                # survivors OFF-thread — the adoption stages device
+                # work, which must never run on the dispatcher. Spawn
+                # is once per shard (guarded here under ``_lock``);
+                # a failed attempt re-arms so a later placement can
+                # retry once the race clears.
+                self._rebalance_kicked.add(shard)
+                threading.Thread(
+                    target=self._rebalance_kick, args=(shard,),
+                    name=f"mano-shard-rebalance-{shard}",
+                    daemon=True).start()
         # Backlog = queued + in-flight rows;
         # ties rotate round-robin — a low-rate stream (every lane idle
         # at every placement) must still spread across the fleet, or
@@ -356,6 +372,23 @@ class LaneSet:
             return lane.table, lane.table_version
 
     # ------------------------------------------------- shard tables (PR 16)
+    def _effective_shard(self, digest: str) -> int:
+        """The digest's EFFECTIVE owner lane: the store's shard map —
+        which applies any PR-20 rebalance overlay, so after a lane
+        loss every ownership consumer here (adopt, broadcast, the
+        sharded-resolve fast path) agrees with the engine's ``_admit``
+        grouping and the dispatcher's shard tags — falling back to the
+        pure content placement when no store is bound (tests build
+        LaneSets bare)."""
+        store = getattr(self._eng, "_subject_store", None)
+        if store is not None:
+            s = store.shard_for(digest)
+            if s is not None:
+                return s
+        from mano_hand_tpu.serving.subject_store import shard_of
+
+        return shard_of(digest, len(self.lanes))
+
     def _shard_capacity_max(self) -> int:
         """The per-lane row budget under sharding: an even split of the
         engine's ``max_subjects`` (ceiling) — the per-lane footprint
@@ -371,15 +404,13 @@ class LaneSet:
         the first-use path for a lane that has never seen a broadcast.
         Returns the lane's (table, version) after the attempt."""
         from mano_hand_tpu.models import core
-        from mano_hand_tpu.serving.subject_store import shard_of
 
         eng = self._eng
-        n = len(self.lanes)
         with eng._exe_lock:
             src = eng._table
             v = eng._table_version
             owned = [d for d in eng._subject_lru
-                     if shard_of(d, n) == lane.index]
+                     if self._effective_shard(d) == lane.index]
             eslots = {d: eng._subject_slots[d] for d in owned}
         if src is None:
             raise RuntimeError(
@@ -470,6 +501,110 @@ class LaneSet:
                 lane.table_version = version
         return True
 
+    # ---------------------------------------------- shard rebalance (PR 20)
+    def _rebalance_kick(self, dead: int) -> None:
+        """The ``_place_locked`` auto-trigger body (disposable daemon
+        thread): run the adoption; on failure RE-ARM the kick guard so
+        a later placement retries once the race clears."""
+        ok = False
+        try:
+            ok = self.rebalance_shard(dead)
+        except Exception as e:  # noqa: BLE001 — dispatcher must survive
+            _LOG.warning(
+                f"shard {dead} rebalance failed "
+                f"({type(e).__name__}: {e}); will retry on next "
+                "owner-down placement")
+        if not ok:
+            with self._lock:
+                self._rebalance_kicked.discard(dead)
+
+    def rebalance_shard(self, dead: int) -> bool:
+        """Adopt a dead lane's shard onto the survivors (PR 20 — the
+        PR-16 'no shard-rebalance on lane loss' remainder).
+
+        Two steps, in an order that makes the window safe: (1) install
+        the store's reassignment OVERLAY (``SubjectStore.
+        reassign_shard`` — the dead shard's digests spread across the
+        survivors by a second content hash), which INSTANTLY re-routes
+        the whole pipeline (``_admit`` grouping, dispatcher shard tags,
+        placement, the sharded-resolve fast path) because every one of
+        those consults ``shard_for``; (2) proactively install the dead
+        shard's ENGINE-HOT rows into their adopter lanes' shard tables
+        (``core.table_row`` off the live engine table, the
+        epoch-guarded ``_install_shard_rows`` swap — 0 recompiles by
+        construction, the ``(bucket, capacity)`` keying is untouched).
+        Anything not engine-hot re-enters lazily: the adopter's first
+        miss pulls it through ``eng._resolve_batch`` — i.e. the subject
+        store's warm/cold tiers — exactly the existing PR-16 path.
+
+        Idempotent (the store overlay is the arbiter); counted on
+        ``ServingCounters.count_shard_rebalance``. Returns whether THIS
+        call installed the overlay. Failback: ``SubjectStore.
+        restore_shard`` drops the overlay once the lane returns; its
+        own rows re-enter through the same lazy path."""
+        from mano_hand_tpu.models import core
+        from mano_hand_tpu.serving.subject_store import shard_of
+
+        eng = self._eng
+        store = getattr(eng, "_subject_store", None)
+        if not self._sharded or store is None:
+            return False
+        n = len(self.lanes)
+        if not 0 <= dead < n:
+            raise ValueError(f"shard {dead} out of range [0, {n})")
+        with self._lock:
+            survivors = [ln.index for ln in self.lanes
+                         if ln.index != dead
+                         and (ln.breaker is None
+                              or ln.breaker.state != health.DOWN)]
+        if not survivors:
+            return False     # whole fleet down; the ladder/CPU tier
+            # is already serving — nothing to adopt onto.
+        try:
+            if not store.reassign_shard(dead, survivors):
+                return False             # someone already adopted it
+        except ValueError as e:
+            # A survivor raced DOWN / was itself reassigned between
+            # the pick and the install; no overlay landed — safe.
+            _LOG.warning(f"shard {dead} reassignment rejected: {e}")
+            return False
+        # Proactive adoption of the ENGINE-HOT rows (everything else
+        # flows in lazily via the warm tier): source under one engine
+        # lock hold, stage + install outside it (the _adopt_shard
+        # pattern). Raw shard_of here — the overlay is live, so
+        # _effective_shard already names the adopters, but the rows to
+        # MOVE are the ones whose content placement was the dead shard.
+        with eng._exe_lock:
+            src = eng._table
+            owned = [d for d in eng._subject_lru
+                     if shard_of(d, n) == dead]
+            eslots = {d: eng._subject_slots[d] for d in owned}
+        moved = 0
+        if src is not None and owned:
+            by_owner: dict = {}
+            for d in owned:
+                by_owner.setdefault(self._effective_shard(d),
+                                    []).append(d)
+            cap = self._shard_capacity_max()
+            for idx, ds in sorted(by_owner.items()):
+                rows = {d: core.table_row(src, eslots[d])
+                        for d in ds[-cap:]}    # LRU keeps the tail
+                for _ in range(4):
+                    if self._install_shard_rows(self.lanes[idx], rows):
+                        moved += len(rows)
+                        break
+                    # Epoch race (adopter churn): retry; on exhaustion
+                    # the rows re-enter lazily — still correct.
+        eng.counters.count_shard_rebalance(rows=moved)
+        tr = eng._tracer
+        if tr is not None:
+            tr.runtime_event("shard_rebalance", shard=dead,
+                             survivors=list(survivors), rows=moved)
+        _LOG.warning(
+            f"shard {dead} rebalanced onto lanes {survivors} "
+            f"({moved} hot row(s) adopted eagerly)")
+        return True
+
     def _lane_table(self, lane: Lane):
         """The lane's replica, adopted on first use — the warm-up /
         executable-build entry point. Dispatch correctness does NOT
@@ -511,9 +646,7 @@ class LaneSet:
         if self._sharded:
             if digest is None:
                 return       # kind-only engines never take this path
-            from mano_hand_tpu.serving.subject_store import shard_of
-
-            owner = self.lanes[shard_of(digest, len(self.lanes))]
+            owner = self.lanes[self._effective_shard(digest)]
             for _ in range(4):
                 if self._install_shard_rows(owner, {digest: shaped},
                                             version=version):
@@ -866,11 +999,9 @@ class LaneSet:
         import jax
 
         from mano_hand_tpu.models import core
-        from mano_hand_tpu.serving.subject_store import shard_of
 
         eng = self._eng
         digests = [r.subject for r in reqs]
-        n = len(self.lanes)
 
         def read_local():
             """One-lock-hold (table, slots) read; None unless every
@@ -886,7 +1017,11 @@ class LaneSet:
                     lane.shard_lru.move_to_end(d)
                 return tab, slots
 
-        if all(shard_of(d, n) == lane.index for d in digests):
+        # EFFECTIVE ownership (PR 20): after a rebalance the adopter
+        # lane owns the dead shard's digests — its fast path must
+        # accept them, or every adopted subject pays the snapshot
+        # fallback forever.
+        if all(self._effective_shard(d) == lane.index for d in digests):
             for attempt in range(2):
                 got = read_local()
                 if got is not None:

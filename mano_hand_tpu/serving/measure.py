@@ -5081,6 +5081,783 @@ def fleet_drill_run(
     }
 
 
+def selfheal_drill_run(
+    params,
+    *,
+    workers: int = 3,
+    lanes: int = 2,
+    streams: int = 12,
+    frames_per_stream: int = 7,
+    stream_workers: int = 8,
+    unique_tracks: int = 4,
+    max_bucket: int = 8,
+    max_subjects: int = 32,
+    store_warm_capacity: int = 16,
+    campaign: str = "kill_worker@0.2s, kill_proxy@1.5s, partition:25@3s",
+    store_campaign: str = "damage_page@0s",
+    mttr_budget_ms: float = 300000.0,
+    restart_budget: int = 6,
+    budget_window_s: float = 900.0,
+    probe_interval_s: float = 0.25,
+    probe_timeout_s: float = 2.0,
+    failure_threshold: int = 3,
+    heal_timeout_s: float = 300.0,
+    ready_timeout_s: float = 420.0,
+    frame_deadline_s: float = 120.0,
+    client_timeout_s: float = 60.0,
+    storm_leg: bool = True,
+    work_dir=None,
+    seed: int = 0,
+    log: Callable[[str], None] = None,
+) -> dict:
+    """THE self-healing chaos campaign (config23, PR 20): every PR-20
+    recovery tier drilled end to end, with ZERO human invocations —
+    detection and repair belong to the supervisor/standby/overlay, the
+    drill only schedules faults and measures. Shared by ``bench.py``
+    config23 and tests/test_selfheal.py (one protocol, the artifacts
+    cannot diverge).
+
+    **Leg A — process campaign.** The full PR-20 fleet: ``workers``
+    fixed-port ``mano serve`` processes (``--warm-streams``, per-lane
+    AOT lattice, one compile-cache dir EACH) supervised by a
+    ``FleetSupervisor``; an active/standby ``mano proxy``
+    :class:`~mano_hand_tpu.edge.fleet.ProxyPair` behind one
+    flock-arbitered service port; ``streams``
+    :class:`~mano_hand_tpu.edge.client.ResilientStream` clients. A
+    seeded :class:`~mano_hand_tpu.runtime.chaos.ChaosCampaign`
+    (``KIND[:PARAM]@Ts`` grammar) then fires ``kill_worker`` (SIGKILL
+    a worker — the supervisor's exit-line channel), ``kill_proxy``
+    (SIGKILL the ACTIVE proxy — flock takeover, clients
+    reconnect-and-resume), and ``partition`` (SIGSTOP a worker: the
+    process lives, ``/healthz`` stops — the supervisor's breaker
+    channel; a SIGCONT backstop fires at ``:PARAM`` seconds in case
+    the supervisor is the thing that broke). Judgment inputs: every
+    frame reaches an HTTP terminal with CONTINUOUS numbering, pose
+    chains stay bit-equal to the in-process reference (healed workers
+    and resumed streams included), heals == scheduled deaths with the
+    post-heal steady wave compiling NOTHING, per-heal MTTR within
+    ``mttr_budget_ms``, spans closed exactly once on every worker that
+    reported an exit line.
+
+    **Leg C — restart storm** (rides the same fleet, after the steady
+    check): a fresh supervisor with ``restart_budget=1`` takes one
+    kill (heals) and then a second (budget exhausted) — the drill
+    passes only if the second death DEGRADES (worker abandoned,
+    incident recorded, surviving workers still serve a fresh stream)
+    instead of flapping.
+
+    **Leg B — in-process store/lane tier.** A sharded ``lanes``-lane
+    engine over a warm+cold ``SubjectStore``: force one lane's breaker
+    DOWN — the next dead-shard placement AUTO-kicks the PR-20 shard
+    rebalance (store overlay + engine-hot row adoption), after which
+    the dead lane's subjects serve bit-identical with 0 recompiles
+    (the ``(bucket, capacity)`` keying is untouched). Then a second
+    seeded campaign fires ``damage_page`` against one COLD row page:
+    the next access is a COUNTED re-bake (never an error) and the
+    result stays bit-identical.
+
+    All CPU-defined: workers pin ``--platform cpu``, sockets are
+    loopback — no chip required, none harmed.
+    """
+    import os
+    import shutil
+    import signal as signal_mod
+    import socket
+    import tempfile
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+    import jax.numpy as jnp
+
+    from mano_hand_tpu.edge import (
+        EdgeClient,
+        EdgeError,
+        Fleet,
+        FleetSupervisor,
+        ProxyPair,
+        ProxySpec,
+        ResilientStream,
+        WorkerSpec,
+    )
+    from mano_hand_tpu.models import anim, core
+    from mano_hand_tpu.runtime import health as health_mod
+    from mano_hand_tpu.runtime.chaos import ChaosCampaign
+    from mano_hand_tpu.runtime.health import CircuitBreaker
+    from mano_hand_tpu.runtime.supervise import DispatchPolicy
+    from mano_hand_tpu.serving.engine import ServingEngine
+    from mano_hand_tpu.serving.subject_store import (
+        SubjectStore,
+        SubjectStoreConfig,
+    )
+
+    if workers < 3:
+        raise ValueError(f"workers must be >= 3 (kill one, partition "
+                         f"one, serve on the rest), got {workers}")
+    if frames_per_stream < 6:
+        raise ValueError(
+            f"frames_per_stream must be >= 6 (settle + >=2 chaos + "
+            f"post-heal settle + steady waves), got {frames_per_stream}")
+    # Parse up front: a bad campaign spec must fail before any process
+    # boots. The process leg takes exactly the three process kinds.
+    proc_campaign = ChaosCampaign(campaign, seed=seed)
+    bad = sorted({e.kind for e in proc_campaign.events}
+                 - {"kill_worker", "kill_proxy", "partition"})
+    if bad:
+        raise ValueError(f"process campaign kinds {bad} not drillable "
+                         "here (damage_page is the store campaign's)")
+    expected_heals = sum(1 for e in proc_campaign.events
+                         if e.kind in ("kill_worker", "partition"))
+    expected_takeovers = sum(1 for e in proc_campaign.events
+                             if e.kind == "kill_proxy")
+    if restart_budget < expected_heals + 2:
+        raise ValueError(
+            f"restart_budget {restart_budget} cannot absorb "
+            f"{expected_heals} scheduled deaths plus boot-failure "
+            "retries")
+    log = _logger(log)
+    host = "127.0.0.1"
+    n_joints, n_shape = params.n_joints, params.n_shape
+    rng = np.random.default_rng(seed)
+    prm32 = params.astype(np.float32)
+    tracks = min(max(1, unique_tracks), streams)
+
+    own_work_dir = work_dir is None
+    if own_work_dir:
+        work_dir = tempfile.mkdtemp(prefix="mano_selfheal_drill_")
+    aot_dir = os.path.join(work_dir, "aot")
+    log_dir = os.path.join(work_dir, "logs")
+    os.makedirs(aot_dir, exist_ok=True)
+    os.makedirs(log_dir, exist_ok=True)
+
+    def free_ports(n: int) -> list:
+        # Bind all n simultaneously so the kernel guarantees they are
+        # distinct, then release: the just-released ports are free to
+        # re-bind (the fixed-port heal contract needs them STABLE, so
+        # they are chosen once, here).
+        socks = [socket.socket() for _ in range(n)]
+        try:
+            for s in socks:
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind((host, 0))
+            return [s.getsockname()[1] for s in socks]
+        finally:
+            for s in socks:
+                s.close()
+
+    ports = free_ports(workers + 1)
+    service_port = ports[-1]
+    worker_ports = {f"w{i}": ports[i] for i in range(workers)}
+
+    # ---- Phase 1: bake the per-lane lattice ---------------------------
+    t_bake0 = time.monotonic()
+    bake_eng = ServingEngine(
+        prm32, max_bucket=max_bucket, aot_dir=aot_dir, lanes=lanes,
+        max_subjects=max_subjects,
+        subject_store=SubjectStore(SubjectStoreConfig(
+            warm_capacity=store_warm_capacity, sharded=True)))
+    manifest = bake_eng.bake_lattice(platforms=("cpu",),
+                                     include_cpu_fallback=False)
+    bake_wall = time.monotonic() - t_bake0
+    log(f"selfheal: baked {len(manifest['entries'])} lattice entries "
+        f"in {bake_wall:.1f}s")
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " "
+                 f"--xla_force_host_platform_device_count={lanes}").strip()
+    # FIXED ports + --warm-streams: the replacement a heal boots binds
+    # the dead worker's own port after a full warm pass (fit-stage
+    # programs are not in the lattice), so it re-enters the standby
+    # pair's STATIC routing with zero wiring calls and zero steady
+    # compiles. One compile-cache dir per worker (CLAUDE.md: never two
+    # processes on one cache dir).
+    specs = [WorkerSpec(platform="cpu", lanes=lanes,
+                        max_bucket=max_bucket,
+                        max_delay_ms=1.0, max_subjects=max_subjects,
+                        aot_dir=aot_dir,
+                        store_warm_capacity=store_warm_capacity,
+                        warm_streams=True,
+                        drain_timeout_s=15.0,
+                        port=worker_ports[f"w{i}"],
+                        extra_env={"MANO_TEST_CACHE_DIR": os.path.join(
+                            work_dir, f"jax_cache_w{i}")})
+             for i in range(workers)]
+    fleet = Fleet(specs, env={"XLA_FLAGS": flags},
+                  stderr_dir=log_dir, external_proxy=True,
+                  log=lambda m: log(f"selfheal: {m}"))
+    pair = ProxyPair(
+        ProxySpec(port=service_port,
+                  lock_path=os.path.join(work_dir, "proxy.lock"),
+                  backends=[(n, host, p)
+                            for n, p in worker_ports.items()],
+                  drain_timeout_s=10.0,
+                  upstream_timeout_s=client_timeout_s * 4),
+        stderr_dir=log_dir, log=lambda m: log(f"selfheal: {m}"))
+    sup = FleetSupervisor(
+        fleet, poll_interval_s=0.05,
+        probe_interval_s=probe_interval_s,
+        probe_timeout_s=probe_timeout_s,
+        failure_threshold=failure_threshold,
+        restart_budget=restart_budget,
+        budget_window_s=budget_window_s,
+        ready_timeout_s=ready_timeout_s,
+        log=lambda m: log(f"selfheal: {m}"))
+    sup2 = None
+
+    def scrape(name: str):
+        cli = EdgeClient(host, worker_ports[name], timeout_s=30.0)
+        try:
+            text = cli.metrics_text()
+        except Exception:  # noqa: BLE001 — a dead worker scrapes None
+            return None
+        finally:
+            cli.close()
+        return {k: int(_prom_value(text, f"mano_serving_{k}") or 0)
+                for k in ("compiles", "aot_loads", "aot_load_failures")}
+
+    t_boot0 = time.monotonic()
+    fleet.start(ready_timeout_s=ready_timeout_s)
+    try:
+        pair.start(timeout_s=60.0)
+        boot_wall = time.monotonic() - t_boot0
+        log(f"selfheal: {workers} fixed-port workers + proxy pair up "
+            f"in {boot_wall:.1f}s (service :{service_port})")
+
+        boot_counters = {name: scrape(name) for name in fleet.workers}
+        lattice_boot_ok = all(
+            c is not None and c["aot_loads"] > 0
+            and c["aot_load_failures"] == 0
+            for c in boot_counters.values())
+
+        # ---- Reference tracks (deterministic fits) -------------------
+        betas = [rng.normal(size=(n_shape,)).astype(np.float32)
+                 for _ in range(tracks)]
+        keys = np.zeros((tracks, 3, n_joints, 3), np.float32)
+        keys[:, 1] = rng.normal(scale=0.2, size=(tracks, n_joints, 3))
+        keys[:, 2] = keys[:, 1] + rng.normal(
+            scale=0.1, size=(tracks, n_joints, 3))
+        track_poses = np.stack([
+            anim.resample_poses(keys[t], frames_per_stream)
+            for t in range(tracks)]).astype(np.float32)
+        flat_pose = track_poses.reshape(
+            tracks * frames_per_stream, n_joints, 3)
+        flat_beta = np.stack([betas[t] for t in range(tracks)
+                              for _ in range(frames_per_stream)])
+        gt = core.jit_forward_batched(prm32.device_put(),
+                                      jnp.asarray(flat_pose),
+                                      jnp.asarray(flat_beta))
+        targets = np.asarray(gt.posed_joints).reshape(
+            tracks, frames_per_stream, n_joints, 3)
+
+        ref_eng = ServingEngine(prm32, max_bucket=max_bucket,
+                                max_delay_s=0.001,
+                                max_subjects=max_subjects)
+        ref_eng.start()
+        ref_frames = []
+        for t in range(tracks):
+            sess = ref_eng.open_stream(betas[t])
+            ref_frames.append([sess.step(targets[t, f])
+                               for f in range(frames_per_stream)])
+            sess.close()
+        ref_eng.stop()
+
+        # ---- Streams: reconnect-and-resume clients -------------------
+        stream_clis = [
+            ResilientStream(host, service_port,
+                            timeout_s=client_timeout_s,
+                            betas=betas[s % tracks],
+                            max_reconnects=12,
+                            reconnect_backoff_s=0.1,
+                            reconnect_timeout_s=60.0,
+                            frame_deadline_s=frame_deadline_s)
+            for s in range(streams)]
+        log(f"selfheal: {streams} resilient streams open through the "
+            f"pair ({tracks} distinct tracks)")
+
+        outcomes = {"ok": 0, "http_error": 0, "exception": 0}
+        got = [[None] * frames_per_stream for _ in range(streams)]
+        rec_lock = threading.Lock()
+
+        def step(s: int, f: int):
+            try:
+                fr = stream_clis[s].frame(targets[s % tracks, f])
+                with rec_lock:
+                    outcomes["ok"] += 1
+                    got[s][f] = fr
+            except EdgeError as e:
+                with rec_lock:
+                    outcomes["http_error"] += 1
+                    got[s][f] = ("http", e.status, e.kind)
+            except Exception as e:  # noqa: BLE001 — NOT a terminal
+                with rec_lock:
+                    outcomes["exception"] += 1
+                    got[s][f] = ("exc", type(e).__name__, str(e)[:120])
+
+        pool = ThreadPoolExecutor(max_workers=stream_workers)
+
+        def wave(f: int):
+            list(pool.map(lambda s: step(s, f), range(streams)))
+
+        wave(0)                                         # settle
+        baseline = {name: scrape(name) for name in fleet.workers}
+        sup.start()
+
+        # ---- Leg A: the campaign fires under live waves --------------
+        takeover_walls = []
+
+        def on_kill_worker(ev):
+            alive = [n for n, w in fleet.workers.items() if w.alive()]
+            victim = proc_campaign.pick(alive)
+            if victim is None:
+                raise RuntimeError("no live worker to kill")
+            fleet.kill_worker(victim)
+            return victim
+
+        def on_kill_proxy(ev):
+            t0 = time.monotonic()
+            victim = pair.kill_active()
+            pair.wait_active(timeout_s=60.0)
+            dt_ms = (time.monotonic() - t0) * 1e3
+            takeover_walls.append(round(dt_ms, 1))
+            return {"victim": victim,
+                    "takeover_ms": round(dt_ms, 1)}
+
+        def on_partition(ev):
+            alive = [n for n, w in fleet.workers.items() if w.alive()]
+            victim = proc_campaign.pick(alive)
+            if victim is None:
+                raise RuntimeError("no live worker to partition")
+            pid = fleet.workers[victim].pid
+            os.kill(pid, signal_mod.SIGSTOP)
+
+            def backstop():
+                # Only matters if the supervisor ITSELF failed: its
+                # heal SIGKILLs the stopped remains long before this.
+                try:
+                    os.kill(pid, signal_mod.SIGCONT)
+                except OSError:
+                    pass
+
+            t = threading.Timer(ev.param, backstop)
+            t.daemon = True
+            t.start()
+            return {"victim": victim, "stopped_pid": pid,
+                    "sigcont_backstop_s": ev.param}
+
+        proc_campaign.on("kill_worker", on_kill_worker)
+        proc_campaign.on("kill_proxy", on_kill_proxy)
+        proc_campaign.on("partition", on_partition)
+        proc_campaign.start()
+
+        t_chaos0 = time.monotonic()
+        for f in range(1, frames_per_stream - 2):        # chaos waves
+            wave(f)
+        last_event_s = (proc_campaign.events[-1].at_s
+                        if proc_campaign.events else 0.0)
+        campaign_done = proc_campaign.join(
+            timeout_s=last_event_s + 120.0)
+
+        # Wait until the supervisor healed every scheduled death
+        # (bounded — a heal that never lands is the drill's failure,
+        # not its hang).
+        t_heal0 = time.monotonic()
+        heal_deadline = t_heal0 + heal_timeout_s
+        while time.monotonic() < heal_deadline:
+            if sup.load()["fleet"]["restarts"] >= expected_heals:
+                break
+            time.sleep(0.1)
+        heal_wait_wall = time.monotonic() - t_heal0
+        chaos_wall = time.monotonic() - t_chaos0
+
+        wave(frames_per_stream - 2)                      # post-heal settle
+        baseline2 = {name: scrape(name) for name in fleet.workers}
+        wave(frames_per_stream - 1)                      # steady
+        final_counters = {name: scrape(name) for name in fleet.workers}
+        pool.shutdown(wait=True)
+
+        # Post-heal steady recompiles: scraped live over the fixed
+        # ports (exit lines would miss the healed workers' baselines).
+        steady_by_worker = {}
+        for name in fleet.workers:
+            b2, fc = baseline2.get(name), final_counters.get(name)
+            steady_by_worker[name] = (
+                None if b2 is None or fc is None
+                else fc["compiles"] - b2["compiles"])
+        steady_total = sum(v for v in steady_by_worker.values()
+                           if v is not None)
+
+        closes_ok = 0
+        close_errors = []
+        reconnects_total = 0
+        for s in range(streams):
+            reconnects_total += stream_clis[s].reconnects
+            try:
+                stream_clis[s].close()
+                closes_ok += 1
+            except Exception as e:  # noqa: BLE001
+                close_errors.append(f"{type(e).__name__}: {e}"[:120])
+
+        sup_ledger = sup.load()["fleet"]
+        sup.stop()
+
+        # ---- Leg C: restart storm -> degraded + incident -------------
+        storm = None
+        if storm_leg:
+            sup2 = FleetSupervisor(
+                fleet, poll_interval_s=0.05,
+                probe_interval_s=probe_interval_s,
+                probe_timeout_s=probe_timeout_s,
+                failure_threshold=failure_threshold,
+                restart_budget=1, budget_window_s=3600.0,
+                ready_timeout_s=ready_timeout_s,
+                log=lambda m: log(f"selfheal-storm: {m}"))
+            sup2.start()
+            victim = sorted(n for n, w in fleet.workers.items()
+                            if w.alive())[0]
+            fleet.kill_worker(victim)
+            d1 = time.monotonic() + heal_timeout_s
+            while (time.monotonic() < d1
+                   and sup2.load()["fleet"]["restarts"] < 1):
+                time.sleep(0.1)
+            fleet.kill_worker(victim)            # budget now exhausted
+            d2 = time.monotonic() + 60.0
+            while time.monotonic() < d2:
+                led = sup2.load()["fleet"]
+                if led["incidents"] >= 1 and victim in led["abandoned"]:
+                    break
+                time.sleep(0.1)
+            storm_ledger = sup2.load()["fleet"]
+            sup2.stop()
+            sup2 = None
+            # Degraded-but-serving: a FRESH stream through the pair
+            # must still produce bit-exact frames off the survivors.
+            deg_err = None
+            deg_frames = 0
+            try:
+                rs = ResilientStream(host, service_port,
+                                     timeout_s=client_timeout_s,
+                                     betas=betas[0], max_reconnects=12,
+                                     reconnect_timeout_s=60.0,
+                                     frame_deadline_s=frame_deadline_s)
+                try:
+                    deg_err = 0.0
+                    for f in range(2):
+                        fr = rs.frame(targets[0, f])
+                        deg_err = max(deg_err, float(np.max(np.abs(
+                            fr.pose - ref_frames[0][f].pose))))
+                        deg_frames += 1
+                finally:
+                    rs.abort()
+            except Exception as e:  # noqa: BLE001 — recorded, judged
+                close_errors.append(
+                    f"storm-degraded: {type(e).__name__}: {e}"[:120])
+            storm = {
+                "victim": victim,
+                "restarts": storm_ledger["restarts"],
+                "deaths_detected": storm_ledger["deaths_detected"],
+                "incidents": storm_ledger["incidents"],
+                "incident_log": storm_ledger["incident_log"],
+                "abandoned": storm_ledger["abandoned"],
+                "budget_left": storm_ledger["budget"]["left"],
+                "degraded_frames_ok": deg_frames,
+                "degraded_pose_max_abs_err": deg_err,
+                "degraded_without_flap": bool(
+                    storm_ledger["restarts"] == 1
+                    and storm_ledger["incidents"] == 1
+                    and victim in storm_ledger["abandoned"]),
+            }
+            log(f"selfheal: storm leg — {storm['restarts']} heal, "
+                f"{storm['incidents']} incident, abandoned "
+                f"{storm['abandoned']}, degraded serve err={deg_err}")
+
+        # Takeover facts from the surviving active proxy itself.
+        proxy_health = None
+        try:
+            hcli = EdgeClient(host, service_port, timeout_s=10.0)
+            h = hcli.healthz()
+            hcli.close()
+            proxy_health = {"proxy_role": h.get("proxy_role"),
+                            "takeovers": h.get("takeovers")}
+        except Exception as e:  # noqa: BLE001 — recorded, judged
+            close_errors.append(
+                f"proxy-healthz: {type(e).__name__}: {e}"[:120])
+
+        proxy_reports = pair.stop(timeout_s=30.0)
+        reports = fleet.stop(timeout_s=60.0)
+    finally:
+        try:
+            if sup2 is not None:
+                sup2.stop()
+            sup.stop()
+        except Exception:  # noqa: BLE001 — teardown must finish
+            pass
+        try:
+            proc_campaign.stop()
+        except Exception:  # noqa: BLE001 — teardown must finish
+            pass
+        try:
+            pair.stop(timeout_s=10.0)
+        except Exception:  # noqa: BLE001 — teardown must finish
+            pass
+        try:
+            fleet.stop(timeout_s=30.0)
+        except Exception:  # noqa: BLE001 — teardown must finish
+            pass
+
+    # ---- Leg A parity + spans (same bars as the fleet drill) ---------
+    frames_expected = streams * frames_per_stream
+    pose_err = 0.0
+    verts_err = 0.0
+    numbering_ok = 0
+    compared = 0
+    for s in range(streams):
+        for f in range(frames_per_stream):
+            fr = got[s][f]
+            if not hasattr(fr, "verts"):
+                continue
+            compared += 1
+            ref = ref_frames[s % tracks][f]
+            pose_err = max(pose_err, float(
+                np.max(np.abs(fr.pose - ref.pose))))
+            verts_err = max(verts_err, float(
+                np.max(np.abs(fr.verts - ref.verts))))
+            if fr.frame == f:
+                numbering_ok += 1
+
+    spans_by_worker = {}
+    for name, rep in reports.items():
+        if rep is None:
+            spans_by_worker[name] = None
+            continue
+        acc = rep.get("accounting") or {}
+        spans_by_worker[name] = {
+            "started": acc.get("spans_started"),
+            "closed": acc.get("spans_closed"),
+            "open": acc.get("spans_open"),
+            "double_closed": acc.get("spans_double_closed"),
+        }
+    spans_balanced = all(
+        v is None or (v["started"] == v["closed"] and v["open"] == 0
+                      and not v["double_closed"])
+        for v in spans_by_worker.values())
+
+    mttr_ms = list(sup_ledger["mttr_ms"])
+    mttr_p99 = (float(np.percentile(mttr_ms, 99)) if mttr_ms else None)
+
+    # ---- Leg B: shard rebalance + cold-page damage (in-process) ------
+    log("selfheal: leg B — in-process shard rebalance + damage_page")
+    n_b = 6
+    betas_b = [rng.normal(size=(n_shape,)).astype(np.float32)
+               for _ in range(n_b)]
+    poses_b = [rng.normal(scale=0.4,
+                          size=(2, n_joints, 3)).astype(np.float32)
+               for _ in range(n_b)]
+    with ServingEngine(prm32, max_bucket=max_bucket,
+                       max_delay_s=0.001) as ref_b:
+        keys_r = [ref_b.specialize(b) for b in betas_b]
+        want_b = [ref_b.forward(poses_b[i], subject=keys_r[i])
+                  for i in range(n_b)]
+
+    cold_dir = os.path.join(work_dir, "cold")
+    store_b = SubjectStore(SubjectStoreConfig(
+        warm_capacity=2, cold_dir=cold_dir, sharded=True,
+        backend="pickle"))
+    lane_ok = [True] * lanes
+    policy_b = DispatchPolicy(
+        deadline_s=30.0, retries=1, backoff_s=0.005,
+        backoff_cap_s=0.01, jitter=0.0,
+        breaker=CircuitBreaker(failure_threshold=2,
+                               probe_interval_s=0.001,
+                               respect_priority_claim=False),
+        cpu_fallback=True)
+    rebalance = {}
+    damage = {}
+    store_campaign_fired = []
+    with ServingEngine(prm32, max_bucket=max_bucket, max_delay_s=0.002,
+                       policy=policy_b, lanes=lanes,
+                       lane_probe=lambda i: lane_ok[i],
+                       max_subjects=4,
+                       subject_store=store_b) as eng_b:
+        keys_b = [eng_b.specialize(b) for b in betas_b]
+        pre_err = 0.0
+        for i in range(n_b):                     # warm every program
+            got_b = eng_b.forward(poses_b[i], subject=keys_b[i])
+            pre_err = max(pre_err, float(
+                np.abs(got_b - want_b[i]).max()))
+        shards_pop = sorted({store_b.shard_for(k) for k in keys_b})
+        dead = store_b.shard_for(keys_b[0])
+        owned = [i for i in range(n_b)
+                 if store_b.shard_for(keys_b[i]) == dead]
+        base_b = eng_b.counters.snapshot()
+        # Lane loss: probe pinned false + breaker driven DOWN through
+        # its public API (the tests' idiom — never a raw state poke).
+        lane_ok[dead] = False
+        lane_set = eng_b._get_lanes()
+        br = lane_set.lanes[dead].breaker
+        for _ in range(64):
+            if br is None or br.record_failure() == health_mod.DOWN:
+                break
+        # The next dead-shard placement AUTO-kicks the rebalance; the
+        # drill never calls it (0 human invocations).
+        trigger = eng_b.forward(poses_b[owned[0]],
+                                subject=keys_b[owned[0]])
+        reb_deadline = time.monotonic() + 60.0
+        while (eng_b.counters.snapshot()["shard_rebalances"] < 1
+               and time.monotonic() < reb_deadline):
+            time.sleep(0.02)
+        reb_err = float(np.abs(trigger - want_b[owned[0]]).max())
+        for i in owned:                          # adopted-shard serving
+            got_b = eng_b.forward(poses_b[i], subject=keys_b[i])
+            reb_err = max(reb_err, float(
+                np.abs(got_b - want_b[i]).max()))
+        after_b = eng_b.counters.snapshot()
+        rebalance = {
+            "dead_shard": int(dead),
+            "shards_populated": shards_pop,
+            "owned_subjects": len(owned),
+            "pre_loss_max_abs_err": pre_err,
+            "shard_rebalances": int(after_b["shard_rebalances"]),
+            "rebalance_rows": int(after_b["shard_rebalance_rows"]),
+            "steady_recompiles": int(after_b["compiles"]
+                                     - base_b["compiles"]),
+            "max_abs_err": reb_err,
+            "reassigned": store_b.snapshot().get("reassigned_shards"),
+        }
+        log(f"selfheal: rebalanced shard {dead} "
+            f"({rebalance['shard_rebalances']} rebalance, "
+            f"{rebalance['rebalance_rows']} rows adopted, "
+            f"{rebalance['steady_recompiles']} recompiles, "
+            f"err={reb_err})")
+
+        # -- damage_page: seeded store campaign vs the cold tier ------
+        camp2 = ChaosCampaign(store_campaign, seed=seed + 1,
+                              log=lambda m: log(f"selfheal: {m}"))
+        dmg_digest = {}
+
+        def on_damage(ev):
+            from mano_hand_tpu.io import orbax_ckpt
+
+            victim_d = camp2.pick(store_b.cold_digests())
+            if victim_d is None:
+                raise RuntimeError("no cold page to damage")
+            # The test idiom (tests/test_subject_store.py): a page
+            # whose per-array hashes verify but whose digest preimage
+            # does not — self-consistent, for the WRONG subject.
+            meta, arrays = orbax_ckpt.load_row_page(victim_d, cold_dir)
+            arrays["shape"] = np.asarray(arrays["shape"]) + 1.0
+            orbax_ckpt.save_row_page(victim_d, arrays, cold_dir,
+                                     backend="pickle")
+            dmg_digest["digest"] = victim_d
+            return victim_d
+
+        camp2.on("damage_page", on_damage).start()
+        camp2.join(timeout_s=30.0)
+        store_campaign_fired = list(camp2.events_fired)
+        dig = dmg_digest.get("digest")
+        req_err = None
+        dmg_counted = 0
+        if dig is not None and dig in keys_b:
+            # Push the damaged digest out of the hot table AND the
+            # 2-row warm tier so the verification request must read
+            # the (damaged) cold page.
+            for i in range(n_b):
+                if keys_b[i] != dig:
+                    eng_b.forward(poses_b[i], subject=keys_b[i])
+            dmg_base = eng_b.counters.snapshot()[
+                "subject_store_cold_damage"]
+            i = keys_b.index(dig)
+            got_b = eng_b.forward(poses_b[i], subject=keys_b[i])
+            req_err = float(np.abs(got_b - want_b[i]).max())
+            dmg_counted = (eng_b.counters.snapshot()[
+                "subject_store_cold_damage"] - dmg_base)
+        damage = {
+            "injected": dig is not None,
+            "digest": (dig or "")[:12],
+            "damage_counted": int(dmg_counted),
+            "request_max_abs_err": req_err,
+        }
+        log(f"selfheal: damage_page — counted {dmg_counted} re-bake, "
+            f"err={req_err}")
+
+    if own_work_dir:
+        shutil.rmtree(work_dir, ignore_errors=True)
+
+    terminals = outcomes["ok"] + outcomes["http_error"]
+    return {
+        "selfheal_drill_schema": 1,
+        # Workers are ALWAYS cpu subprocesses; the in-process
+        # references ride the parent's backend — the judge applies the
+        # exact-zero pose anchors only when this is "cpu".
+        "reference_platform": jax.default_backend(),
+        "workers": int(workers),
+        "lanes": int(lanes),
+        "streams": int(streams),
+        "frames_per_stream": int(frames_per_stream),
+        "unique_tracks": int(tracks),
+        "max_bucket": int(max_bucket),
+        "max_subjects": int(max_subjects),
+        "campaign": campaign,
+        "store_campaign": store_campaign,
+        "campaign_done": bool(campaign_done),
+        "campaign_fired": proc_campaign.events_fired,
+        "store_campaign_fired": store_campaign_fired,
+        "lattice_entries": len(manifest["entries"]),
+        "bake_wall_s": float(f"{bake_wall:.4g}"),
+        "boot_wall_s": float(f"{boot_wall:.4g}"),
+        "boot_counters": boot_counters,
+        "lattice_boot_ok": bool(lattice_boot_ok),
+        "chaos_wall_s": float(f"{chaos_wall:.4g}"),
+        "frames_expected": int(frames_expected),
+        "outcomes": outcomes,
+        "terminal_fraction": float(
+            f"{terminals / frames_expected:.6g}") if frames_expected
+            else None,
+        "frames_compared": int(compared),
+        "frame_numbering_ok": int(numbering_ok),
+        "pose_max_abs_err": pose_err,
+        "verts_max_abs_err": verts_err,
+        "closes_ok": int(closes_ok),
+        "close_errors": close_errors[:8],
+        "reconnects_total": int(reconnects_total),
+        "takeovers_expected": int(expected_takeovers),
+        "takeover_walls_ms": takeover_walls,
+        "proxy_health": proxy_health,
+        "proxy_exit_reports": {
+            name: (None if rep is None else
+                   {k: rep.get(k) for k in ("role", "takeovers")})
+            for name, rep in proxy_reports.items()},
+        "expected_heals": int(expected_heals),
+        "heal_wait_wall_s": float(f"{heal_wait_wall:.4g}"),
+        "supervisor": sup_ledger,
+        "supervisor_restarts": int(sup_ledger["restarts"]),
+        "all_deaths_auto_healed": bool(
+            sup_ledger["restarts"] >= expected_heals
+            and not sup_ledger["abandoned"]),
+        "heal_mttr_ms": mttr_ms,
+        "heal_p99_mttr_ms": (None if mttr_p99 is None
+                             else float(f"{mttr_p99:.5g}")),
+        "heal_max_mttr_ms": (max(mttr_ms) if mttr_ms else None),
+        "mttr_budget_ms": float(mttr_budget_ms),
+        "mttr_within_budget": bool(
+            mttr_ms and max(mttr_ms) <= mttr_budget_ms),
+        "steady_recompiles_by_worker": steady_by_worker,
+        "steady_recompiles_total": int(steady_total),
+        "spans_by_worker": spans_by_worker,
+        "spans_closed_exactly_once": bool(spans_balanced),
+        "storm": storm,
+        "storm_restarts": (None if storm is None
+                           else int(storm["restarts"])),
+        "rebalance": rebalance,
+        "damage": damage,
+        "worker_exit_reports": {
+            name: (None if rep is None else {
+                k: rep.get(k) for k in
+                ("drained", "incident_captures")})
+            for name, rep in reports.items()},
+    }
+
+
 def control_drill_run(
     params,
     *,

@@ -108,6 +108,14 @@ class SubjectStore:
         self._cold_index: set = set()
         self._counters = None
         self._n_shards: Optional[int] = None
+        # Shard-rebalance overlay (PR 20): dead shard -> tuple of
+        # surviving shard indices adopting its subjects.  ``shard_for``
+        # remaps through it, so the ENTIRE pipeline (admit grouping,
+        # dispatcher shard tags, lane placement, sharded resolve) agrees
+        # on the new owner the instant the overlay lands — no per-call
+        # coordination.  Values are immutable tuples swapped whole;
+        # readers take no lock (the hot-path placement lookup).
+        self._reassigned: dict = {}
         if self.config.cold_dir is not None:
             # Adopt pages a previous process left behind: paging is a
             # persistence layer, not per-process scratch.
@@ -137,12 +145,52 @@ class SubjectStore:
         return self._n_shards
 
     def shard_for(self, digest: str) -> Optional[int]:
-        """The owning shard of one subject digest, or None when the
-        store is unsharded / not yet bound to a lane count."""
+        """The EFFECTIVE owning shard of one subject digest, or None
+        when the store is unsharded / not yet bound to a lane count.
+        A shard reassigned on lane loss (:meth:`reassign_shard`) maps
+        its subjects onto the survivors by a second content hash, so
+        the dead shard's load spreads deterministically instead of
+        piling onto one adopter."""
         n = self._n_shards
         if not self.config.sharded or not n:
             return None
-        return shard_of(digest, n)
+        s = shard_of(digest, n)
+        survivors = self._reassigned.get(s)
+        if survivors is None:
+            return s
+        return survivors[int(digest[:8], 16) % len(survivors)]
+
+    def reassign_shard(self, dead: int, survivors) -> bool:
+        """Route a dead shard's subjects onto ``survivors`` (PR 20 lane
+        loss).  Idempotent: a shard already reassigned is left alone
+        (False).  Survivors must be live shard indices — in range, not
+        the dead shard, and not themselves reassigned; a reassignment
+        chain would make ownership depend on overlay-install order."""
+        n = self._n_shards
+        if not self.config.sharded or not n:
+            raise RuntimeError("reassign_shard on an unsharded store")
+        if not 0 <= dead < n:
+            raise ValueError(f"dead shard {dead} out of range [0, {n})")
+        surv = tuple(sorted(set(int(s) for s in survivors)))
+        if not surv:
+            raise ValueError("reassign_shard needs >= 1 survivor")
+        with self._lock:
+            if dead in self._reassigned:
+                return False
+            for s in surv:
+                if not 0 <= s < n or s == dead or s in self._reassigned:
+                    raise ValueError(
+                        f"survivor shard {s} is not live (range [0, {n}), "
+                        f"dead={dead}, reassigned="
+                        f"{sorted(self._reassigned)})")
+            self._reassigned[dead] = surv
+        return True
+
+    def restore_shard(self, dead: int) -> bool:
+        """Undo :meth:`reassign_shard` once the lane is back (the
+        failback mirror); returns whether an overlay was removed."""
+        with self._lock:
+            return self._reassigned.pop(dead, None) is not None
 
     # ------------------------------------------------------------ prefetch
     def prefetch(self, digest: str) -> bool:
@@ -368,4 +416,7 @@ class SubjectStore:
                              else str(self.config.cold_dir)),
                 "sharded": self.config.sharded,
                 "shards": self._n_shards,
+                "reassigned_shards": {
+                    str(d): list(s)
+                    for d, s in sorted(self._reassigned.items())},
             }

@@ -211,6 +211,15 @@ class ServingCounters:
         self.control_ticks = 0
         self.control_actuations = 0
         self.control_reverts = 0
+        # Shard rebalance on lane loss (PR 20): one ``rebalances`` event
+        # per dead shard adopted by the survivors (idempotent — a second
+        # trigger for the same shard is a no-op and not counted);
+        # ``rows`` counts the hot rows the adopters pulled through the
+        # warm tier at adoption time.  Steady recompiles stay 0 by
+        # construction ((bucket, cap) keying unchanged), so these two
+        # are the whole audit trail.
+        self.shard_rebalances = 0
+        self.shard_rebalance_rows = 0
         self._promotion_stalls: list = []   # seconds; bounded ring
         self._promotion_writes = 0
         self.tier_submitted: Dict[int, int] = {}   # tier -> offered
@@ -448,6 +457,15 @@ class ServingCounters:
         with self._lock:
             self.control_reverts += n
 
+    def count_shard_rebalance(self, rows: int = 0) -> None:
+        """One dead shard's subjects adopted by the surviving lanes
+        (PR 20): ``rows`` is how many engine-hot rows were proactively
+        installed into the adopters at adoption time; everything else
+        re-enters lazily through the warm tier on first dispatch."""
+        with self._lock:
+            self.shard_rebalances += 1
+            self.shard_rebalance_rows += int(rows)
+
     def record_promotion_stall(self, seconds: float) -> None:
         """What one install actually WAITED on a tier promotion (the
         residual after any prefetch overlap) — same bounded-ring policy
@@ -584,6 +602,8 @@ class ServingCounters:
                 "control_ticks": self.control_ticks,
                 "control_actuations": self.control_actuations,
                 "control_reverts": self.control_reverts,
+                "shard_rebalances": self.shard_rebalances,
+                "shard_rebalance_rows": self.shard_rebalance_rows,
             }
             base["padding_waste"] = round(
                 self._waste_ratio(self.rows_live, self.rows_padded), 4)

@@ -1,0 +1,45 @@
+"""Seeded lock-discipline FAILURE fixture (PR 20): the supervisor-
+shaped hazard — a heal path that rewires the proxy with the ledger
+lock held, against a status path that reads the supervisor ledger
+with the proxy's route lock held. Each method's own nesting is one
+level deep and looks fine in isolation; only the intra-class call
+graph (heal -> _rewire takes the route lock under the ledger lock,
+healthz -> _ledger_view takes the ledger lock under the route lock)
+closes the cycle two threads deadlock on. The real FleetSupervisor
+avoids exactly this by doing ALL proxy rewiring outside its ledger
+lock and giving ``load()`` its one-hold snapshot nothing else nests
+into."""
+
+import threading
+
+
+class HealingSupervisor:
+    def __init__(self):
+        self._ledger_lock = threading.Lock()
+        self._route_lock = threading.Lock()
+        self._backends = {}
+        self.restarts = 0
+
+    def _rewire(self, name, port):
+        with self._route_lock:
+            self._backends[name] = port
+
+    def _ledger_view(self):
+        with self._ledger_lock:
+            return {"restarts": self.restarts}
+
+    def heal(self, name, port):
+        # BAD: rewires the proxy with the ledger lock held — the edge
+        # _ledger_lock -> _route_lock.
+        with self._ledger_lock:
+            self.restarts += 1
+            self._rewire(name, port)
+            return self.restarts
+
+    def healthz(self):
+        # BAD: snapshots the ledger with the route lock held — the
+        # opposite edge _route_lock -> _ledger_lock.
+        with self._route_lock:
+            body = {"backends": dict(self._backends)}
+            body.update(self._ledger_view())
+            return body

@@ -299,6 +299,38 @@ def test_control_traffic_lock_graphs_are_clean_on_head():
     assert check_lock_discipline(serving / "traffic.py", order=()) == []
 
 
+def test_seeded_supervisor_heal_cycle_is_caught():
+    """Satellite (PR 20): the supervisor-shaped hazard — a heal path
+    rewiring the proxy with the ledger lock held, against a status
+    path reading the ledger with the route lock held (each method
+    clean in isolation; the call graph closes the cycle) — fires the
+    cycle rule. This is the exact deadlock the real FleetSupervisor
+    avoids by doing ALL proxy rewiring outside its ledger lock and
+    keeping ``load()`` a one-hold leaf snapshot."""
+    findings = check_lock_discipline(
+        FIXTURES / "bad_supervisor_heal_cycle.py", order=())
+    assert findings, "the seeded supervisor cycle fixture must fail"
+    assert any("cycle" in f.message for f in findings)
+    assert any("_ledger_lock" in f.message and "_route_lock" in f.message
+               for f in findings)
+
+
+def test_selfheal_lock_graphs_are_clean_on_head():
+    """Satellite (PR 20): the lock checker's scope covers the
+    self-healing tier — edge/fleet.py now holds the supervisor's
+    ledger lock and the ProxyPair's process bookkeeping, and
+    runtime/chaos.py the campaign's schedule lock; `mano analyze`
+    scans fleet.py via the edge/ glob and chaos.py via the runtime
+    pass, this pins both by name so a scope regression fails here
+    before it fails in review."""
+    assert check_lock_discipline(
+        REPO_ROOT / "mano_hand_tpu" / "edge" / "fleet.py",
+        order=()) == []
+    assert check_lock_discipline(
+        REPO_ROOT / "mano_hand_tpu" / "runtime" / "chaos.py",
+        order=()) == []
+
+
 def test_good_lock_fixture_and_real_engine_are_clean():
     assert check_lock_discipline(FIXTURES / "good_locks.py") == []
     assert check_lock_discipline() == []   # serving/engine.py, HEAD

@@ -343,6 +343,14 @@ def test_bench_cpu_tiny_run_end_to_end():
         # (--control-pairs 1) and the drill protocol e2e in `make
         # control-smoke`.
         "--control-pairs", "0",
+        # config23 (PR 20) is SKIPPED here too, not shrunk: the
+        # self-healing drill boots a supervised three-worker fleet
+        # plus an active/standby proxy PAIR and runs a seeded
+        # kill/takeover/partition campaign whose heal waits are real
+        # wall-clock seconds (the config21/22 budget reasoning). Its
+        # plumbing runs in `make bench-interpret` (--selfheal-streams
+        # 4) and the drill protocol e2e in `make selfheal-smoke`.
+        "--selfheal-streams", "0",
     )
     assert rc == 0, line
     assert line["value"] is not None and line["value"] > 0
@@ -400,6 +408,9 @@ def test_bench_cpu_tiny_run_end_to_end():
     # config22 (PR 19) likewise: skipped by flag (bench-interpret /
     # control-smoke carry it).
     assert "control" not in d
+    # config23 (PR 20) likewise: skipped by flag (bench-interpret /
+    # selfheal-smoke carry it).
+    assert "selfheal" not in d
     assert "config_errors" not in line, line.get("config_errors")
 
 

@@ -421,6 +421,73 @@ def test_drain_backend_migrates_live_stream_warm(live_pair, params32):
         px.drain(timeout_s=10.0)
 
 
+def test_stream_open_prefers_warm_scale_up_worker(live_pair, params32):
+    """Cold-stream-start guard (PR 20 satellite): a scale-up worker
+    that advertises ``warm_streams: true`` on its OWN /healthz wins
+    new stream opens over a boot-fleet sibling that said it booted
+    cold — the client's first frames never pay a cold worker's jit
+    wall. The proxy learns the fact from the worker (add_backend
+    probe + healthz aggregate), never from the test poking state."""
+    engs, _srvs, _trs = live_pair
+    srv_cold = EdgeServer(engs[0], port=0, warm_streams=False).start()
+    srv_warm = EdgeServer(engs[1], port=0, warm_streams=True).start()
+    px = _proxy_over(Backend("a_cold", "127.0.0.1", srv_cold.port))
+    cli = EdgeClient("127.0.0.1", px.port, timeout_s=120.0)
+    try:
+        # The scale-up join: add_backend's boot probe reads the
+        # worker's warm fact and stamps the freshest boot_seq.
+        px.add_backend(Backend("b_warm", "127.0.0.1", srv_warm.port))
+        cli.healthz()                   # aggregate refresh of a_cold
+        bes = px.backends()
+        assert bes["a_cold"].stream_warm is False
+        assert bes["b_warm"].stream_warm is True
+        assert bes["b_warm"].boot_seq > bes["a_cold"].boot_seq
+        betas = _betas(seed=41)
+        target = _target(params32, betas, seed=42)
+        ws = cli.open_stream(betas=betas)
+        try:
+            # The open landed on the WARM scale-up worker, not the
+            # boot-fleet cold one.
+            assert len(px.backends()["b_warm"].streams) == 1
+            assert len(px.backends()["a_cold"].streams) == 0
+            fr = ws.frame(target)
+            assert fr.frame == 0
+        finally:
+            ws.close()
+    finally:
+        cli.close()
+        px.drain(timeout_s=10.0)
+        srv_cold.drain(timeout_s=10.0)
+        srv_warm.drain(timeout_s=10.0)
+
+
+def test_stream_open_all_cold_falls_back_to_plain_pick(live_pair,
+                                                       params32):
+    """Availability beats warmth: when EVERY routable worker booted
+    cold, ``_pick_stream`` falls back to the plain pick — the open
+    succeeds on a cold worker rather than refusing service."""
+    engs, _srvs, _trs = live_pair
+    fronts = [EdgeServer(engs[i], port=0, warm_streams=False).start()
+              for i in range(2)]
+    px = _proxy_over(Backend("c_cold", "127.0.0.1", fronts[0].port),
+                     Backend("d_cold", "127.0.0.1", fronts[1].port))
+    cli = EdgeClient("127.0.0.1", px.port, timeout_s=120.0)
+    try:
+        cli.healthz()                   # both facts refreshed: False
+        bes = px.backends()
+        assert all(bes[n].stream_warm is False for n in bes)
+        betas = _betas(seed=51)
+        target = _target(params32, betas, seed=52)
+        with cli.open_stream(betas=betas) as ws:
+            fr = ws.frame(target)
+        assert fr.frame == 0            # served, cold or not
+    finally:
+        cli.close()
+        px.drain(timeout_s=10.0)
+        for f in fronts:
+            f.drain(timeout_s=10.0)
+
+
 # ------------------------------------------------- healthz + status CLI
 def test_proxy_healthz_aggregate_and_status_cli(live_pair, tmp_path):
     """The proxied /healthz carries the per-backend aggregate, and

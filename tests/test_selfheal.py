@@ -1,0 +1,619 @@
+"""Self-healing fleet (the PR-20 tentpole), CPU-verified.
+
+The recovery tier is only shippable if every repair is bounded,
+classified, and provably loses nothing, so the contract pinned here is
+mostly about restraint under chaos:
+
+* supervisor restart-storm budget — a worker that keeps dying consumes
+  the sliding restart budget and then DEGRADES (abandoned + incident,
+  fleet serves with fewer workers); flapping is structurally
+  impossible because every boot attempt draws budget (never the r3
+  bare-retry loop);
+* torn-snapshot atomicity — ``load()["fleet"]`` is ONE lock hold:
+  ``restarts == len(heals) == len(mttr_ms)`` and ``incidents ==
+  len(incident_log)`` in every snapshot, under a concurrent hammer
+  while heals are landing;
+* active/standby takeover — SIGKILL the ACTIVE proxy with frames in
+  flight: the standby wins the kernel-released flock, binds the SAME
+  port, and every client stream resumes with continuous numbering and
+  bit-equal poses (the PR-18 last-confirmed-pose protocol driven by
+  ``ResilientStream``);
+* shard rebalance (the PR-16 remainder) — a dead lane's shard is
+  auto-adopted by survivors and serves BIT-identical to the reference
+  engine with zero recompiles (the ``(bucket, cap)`` keying never saw
+  the shard id);
+* ChaosCampaign — the ``KIND[:PARAM]@Ts`` grammar validates at parse
+  time, victim selection is seeded-deterministic, and a handler
+  exception is audited, never fatal;
+* the config23 drill protocol at plumbing size (the acceptance-sized
+  run is `make bench-interpret` / bench.py config23 ->
+  bench_report:judge_selfheal).
+
+Canonical runner: `make selfheal-smoke` — own pytest process +
+compile-cache dir, wired into `make check` (the fleet/control
+smoke-lane precedent). Slow-marked module; the pure-logic
+supervisor/campaign tests carry `quick` and ride `make check-quick`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mano_hand_tpu.runtime import health
+from mano_hand_tpu.runtime.chaos import ChaosCampaign, parse_campaign
+from mano_hand_tpu.runtime.health import CircuitBreaker
+from mano_hand_tpu.runtime.supervise import DispatchPolicy
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def params32(params):
+    return params.astype(np.float32)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ------------------------------------------------------ campaign grammar
+@pytest.mark.quick
+def test_campaign_parse_orders_and_validates():
+    evs = parse_campaign(
+        "kill_proxy@4s, kill_worker@2s, partition:1.5@6s, damage_page@0s")
+    assert [(e.kind, e.at_s, e.param) for e in evs] == [
+        ("damage_page", 0.0, 0.0), ("kill_worker", 2.0, 0.0),
+        ("kill_proxy", 4.0, 0.0), ("partition", 6.0, 1.5)]
+
+
+@pytest.mark.quick
+@pytest.mark.parametrize("bad, match", [
+    ("kill_worker", "lacks '@Ts'"),
+    ("kill_worker@2s-4s", "instants"),
+    ("kill_worker@2", "'s' suffix"),
+    ("reboot_rack@2s", "unknown campaign kind"),
+    ("partition@2s", ":SECONDS"),
+    ("kill_worker:1.5@2s", "takes no ':PARAM'"),
+])
+def test_campaign_parse_rejects(bad, match):
+    with pytest.raises(ValueError, match=match):
+        parse_campaign(bad)
+
+
+@pytest.mark.quick
+def test_campaign_seeded_victims_deterministic():
+    """Same seed + same alive-sets = same victims, run after run —
+    and ``pick`` sorts, so the caller's iteration order is irrelevant
+    (the drill passes live dict views)."""
+    pools = [{"w2", "w0", "w1"}, {"w0", "w1"}, {"w1", "w2", "w0"}]
+
+    def victims(seed):
+        camp = ChaosCampaign("kill_worker@0s", seed=seed)
+        return [camp.pick(p) for p in pools]
+
+    assert victims(7) == victims(7)
+    assert victims(7) == [
+        ChaosCampaign("kill_worker@0s", seed=7).pick(sorted(p))
+        for p in pools]
+
+
+@pytest.mark.quick
+def test_campaign_requires_handlers_and_audits_exceptions():
+    camp = ChaosCampaign("kill_worker@0s, kill_proxy@0s", seed=0)
+    with pytest.raises(RuntimeError, match="no handler"):
+        camp.start()
+    camp.on("kill_worker", lambda ev: "w1")
+    camp.on("kill_proxy", lambda ev: (_ for _ in ()).throw(
+        RuntimeError("proxy already gone")))
+    camp.start()
+    assert camp.join(timeout_s=30.0)
+    fired = camp.fired()
+    assert [e["kind"] for e in fired] == ["kill_worker", "kill_proxy"]
+    assert fired[0]["result"] == "w1"
+    # The handler exception is AUDITED, not fatal: the campaign
+    # finished the schedule and recorded the failure.
+    assert "proxy already gone" in fired[1]["error"]
+    assert "result" not in fired[1]
+
+
+# -------------------------------------------- supervisor (fake fleet)
+class _FakeWorker:
+    """Duck-typed WorkerProc: exactly the surface the supervisor
+    touches (alive/exit_report/port/spec/kill)."""
+
+    def __init__(self, name, *, alive=True, port=None, spec=None):
+        self.name = name
+        self._alive = alive
+        self.port = port
+        self.spec = spec
+        self.exit_report = None
+        self.pid = 4242
+        self.kills = 0
+
+    def alive(self):
+        return self._alive
+
+    def kill(self):
+        self.kills += 1
+        self._alive = False
+
+
+class _FakeBoot:
+    """Stands in for ``WorkerProc`` on the heal path (monkeypatched
+    into edge.fleet): 'boots' instantly, then behaves per the class
+    attrs — ``alive_after_boot=False`` models a dead-on-arrival
+    flapper, ``lifetime_s`` a replacement that serves for a while and
+    then dies (exit channel), and an alive boot with a dead ``port``
+    a wedged one (probe channel)."""
+
+    alive_after_boot = True
+    lifetime_s = None
+
+    def __init__(self, name, spec, *, env=None, stderr_path=None,
+                 log=None):
+        self.name = name
+        self.spec = spec
+        self.port = getattr(spec, "port", None)
+        self.pid = 31337
+        self.exit_report = None
+        self._alive = True
+        self._death_at = None
+        self.kills = 0
+
+    def start(self):
+        return self
+
+    def wait_ready(self, timeout_s=0.0):
+        if not type(self).alive_after_boot:
+            self._alive = False
+        elif type(self).lifetime_s is not None:
+            self._death_at = time.monotonic() + type(self).lifetime_s
+        return self
+
+    def alive(self):
+        if self._death_at is not None \
+                and time.monotonic() >= self._death_at:
+            self._alive = False
+        return self._alive
+
+    def kill(self):
+        self.kills += 1
+        self._alive = False
+
+
+class _FakeFleet:
+    proxy = None
+    _stderr_dir = None
+    _env = None
+
+    def __init__(self, workers):
+        self.workers = dict(workers)
+
+
+def _supervisor(fleet, **kw):
+    from mano_hand_tpu.edge.fleet import FleetSupervisor
+
+    kw.setdefault("poll_interval_s", 0.001)
+    kw.setdefault("probe_interval_s", 0.002)
+    kw.setdefault("probe_timeout_s", 0.2)
+    kw.setdefault("failure_threshold", 2)
+    kw.setdefault("ready_timeout_s", 1.0)
+    kw.setdefault("spec_factory", lambda name, spec: spec)
+    return FleetSupervisor(fleet, **kw)
+
+
+@pytest.mark.quick
+def test_restart_storm_budget_degrades_with_incident(monkeypatch):
+    """THE storm contract: a flapping worker (every replacement dead
+    on arrival) consumes the budget and is then ABANDONED — one
+    incident, degraded fleet, and NO further restart attempts (the
+    sweep skips abandoned workers; flap-spin is structurally
+    impossible)."""
+    from mano_hand_tpu.edge import fleet as fleet_mod
+
+    monkeypatch.setattr(fleet_mod, "WorkerProc", _FakeBoot)
+    _FakeBoot.alive_after_boot = False           # dead-on-arrival
+    fleet = _FakeFleet({"w0": _FakeWorker("w0", alive=False)})
+    sup = _supervisor(fleet, restart_budget=1, budget_window_s=3600.0)
+    sup.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            f = sup.load()["fleet"]
+            if f["incidents"] >= 1:
+                break
+            time.sleep(0.005)
+        f = sup.load()["fleet"]
+        assert f["restarts"] == 1                # the one budgeted boot
+        assert f["deaths_detected"] == 2         # original + the DOA
+        assert f["incidents"] == 1
+        assert f["abandoned"] == ["w0"]
+        assert "budget exhausted" in f["incident_log"][0]["incident"]
+        assert f["budget"]["left"] == 0
+        # No spin: the abandoned worker is never retried.
+        time.sleep(0.1)
+        f2 = sup.load()["fleet"]
+        assert f2["deaths_detected"] == 2
+        assert f2["restarts"] == 1
+        assert f2["incidents"] == 1
+    finally:
+        sup.stop()
+        _FakeBoot.alive_after_boot = True
+
+
+@pytest.mark.quick
+def test_budget_window_slides_not_cumulative(monkeypatch):
+    """The budget is per sliding window, not per lifetime: deaths
+    SPACED WIDER than the window keep healing forever — consumption
+    expires with the window, so the suppressor only bites while the
+    storm is actually denser than the budget. Replacements here serve
+    for several window-lengths and then die (exit channel; the probe
+    channel is disarmed by a huge threshold), so every death finds a
+    freshly pruned budget."""
+    from mano_hand_tpu.edge import fleet as fleet_mod
+
+    monkeypatch.setattr(fleet_mod, "WorkerProc", _FakeBoot)
+    monkeypatch.setattr(_FakeBoot, "lifetime_s", 0.2)
+    fleet = _FakeFleet({"w0": _FakeWorker("w0", alive=False)})
+    sup = _supervisor(fleet, restart_budget=1, budget_window_s=0.05,
+                      failure_threshold=10_000)
+    sup.start()
+    try:
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if sup.load()["fleet"]["restarts"] >= 3:
+                break
+            time.sleep(0.01)
+        f = sup.load()["fleet"]
+        assert f["restarts"] >= 3
+        assert f["abandoned"] == []
+        assert f["incidents"] == 0
+    finally:
+        sup.stop()
+
+
+@pytest.mark.quick
+def test_supervisor_load_torn_read_hammer(monkeypatch):
+    """``load()["fleet"]`` is one lock hold: while the supervisor is
+    landing a continuous stream of heals (alive replacements whose
+    probes fail — no socket behind the port — so every heal is
+    followed by a probe-channel death), concurrent readers must NEVER
+    see a count out of step with the list beside it."""
+    from mano_hand_tpu.edge import fleet as fleet_mod
+
+    monkeypatch.setattr(fleet_mod, "WorkerProc", _FakeBoot)
+    _FakeBoot.alive_after_boot = True
+    dead_port = _free_port()                     # refused instantly
+    spec = type("S", (), {"port": dead_port})()
+    fleet = _FakeFleet(
+        {"w0": _FakeWorker("w0", alive=False, port=dead_port,
+                           spec=spec)})
+    sup = _supervisor(fleet, restart_budget=10_000,
+                      budget_window_s=3600.0,
+                      spec_factory=lambda name, s: spec)
+    torn = []
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            f = sup.load()["fleet"]
+            if not (f["restarts"] == len(f["heals"]) == len(f["mttr_ms"])
+                    and f["incidents"] == len(f["incident_log"])
+                    and f["deaths_detected"]
+                    >= f["restarts"] + f["incidents"]):
+                torn.append(f)
+                return
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(4)]
+    sup.start()
+    try:
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 10.0
+        while (sup.load()["fleet"]["restarts"] < 5
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        assert torn == []
+        f = sup.load()["fleet"]
+        assert f["restarts"] >= 5                # the hammer saw churn
+        assert f["heals"][0]["worker"] == "w0"
+        assert all(h["mttr_ms"] >= 0.0 for h in f["heals"])
+    finally:
+        stop.set()
+        sup.stop()
+
+
+@pytest.mark.quick
+def test_supervisor_rejects_zero_budget():
+    from mano_hand_tpu.edge.fleet import FleetSupervisor
+
+    with pytest.raises(ValueError, match="restart_budget"):
+        FleetSupervisor(_FakeFleet({}), restart_budget=0)
+
+
+# --------------------------------------- active/standby proxy takeover
+def test_proxy_pair_takeover_frames_in_flight(params32, tmp_path):
+    """SIGKILL the ACTIVE proxy mid-stream: the standby wins the
+    kernel-released flock, binds the SAME service port, and the
+    stream resumes via the PR-18 last-confirmed-pose protocol —
+    continuous frame numbering, poses BIT-equal to the in-process
+    reference, zero frames lost. Frames 3..5 are sent INTO the
+    takeover window (the old proxy is already a corpse), so the
+    transport death and bounded reconnect are exercised
+    deterministically, not by racing the scheduler; the racy
+    genuinely-in-flight variant runs at scale in the config23 drill
+    (kill_proxy under 24 concurrently stepping streams)."""
+    from mano_hand_tpu.edge import (
+        EdgeClient,
+        EdgeServer,
+        ProxyPair,
+        ProxySpec,
+        ResilientStream,
+    )
+    from mano_hand_tpu.serving.engine import ServingEngine
+
+    frames = 6
+    rng = np.random.default_rng(23)
+    betas = rng.normal(size=(params32.n_shape,)).astype(np.float32)
+    targets = rng.normal(
+        scale=0.1, size=(frames, params32.n_joints, 3)).astype(
+        np.float32)
+
+    eng = ServingEngine(params32, max_bucket=4, max_delay_s=0.001)
+    eng.start()
+    srv = EdgeServer(eng, port=0).start()
+    # The reference: the same warm-started fit chain, in process.
+    ref_eng = ServingEngine(params32, max_bucket=4, max_delay_s=0.001)
+    ref_eng.start()
+    sess = ref_eng.open_stream(betas)
+    want = [sess.step(targets[f]) for f in range(frames)]
+    sess.close()
+    ref_eng.stop()
+
+    spec = ProxySpec(
+        port=_free_port(), lock_path=str(tmp_path / "proxy.lock"),
+        backends=[("w0", "127.0.0.1", srv.port)],
+        upstream_timeout_s=120.0)
+    # Proxy subprocesses never share this pytest process's compile
+    # cache (CLAUDE.md crash class) — cmd_proxy is jax-free, but the
+    # env pin keeps that true even if an import sneaks in.
+    env = {"MANO_TEST_CACHE_DIR": str(tmp_path / "jax_cache_proxy")}
+    pair = ProxyPair(spec, env=env, stderr_dir=str(tmp_path))
+    rs = None
+    try:
+        pair.start(timeout_s=120.0)
+        first = pair.active().name
+        rs = ResilientStream("127.0.0.1", pair.port, timeout_s=60.0,
+                             betas=betas, max_reconnects=8,
+                             reconnect_backoff_s=0.1,
+                             reconnect_timeout_s=60.0,
+                             frame_deadline_s=120.0)
+        got = [rs.frame(targets[f]) for f in range(3)]
+        victim = pair.kill_active()
+        assert victim == first
+        # The next frame meets a dead socket: ResilientStream must
+        # re-dial the SAME service port until the standby's takeover
+        # bind wins, then resume from the last confirmed pose.
+        for f in range(3, frames):
+            got.append(rs.frame(targets[f]))
+        survivor = pair.wait_active(timeout_s=60.0)
+        assert survivor.name != victim
+        # No frame lost, numbering continuous across the takeover.
+        assert [fr.frame for fr in got] == list(range(frames))
+        assert rs.reconnects >= 1
+        for fr, w in zip(got, want):
+            np.testing.assert_array_equal(fr.pose, w.pose)
+        # The surviving proxy tells the takeover story on /healthz.
+        with EdgeClient("127.0.0.1", pair.port, timeout_s=30.0) as cli:
+            h = cli.healthz()
+        assert h["proxy_role"] == "active"
+        assert h["takeovers"] == 1
+        rs.close()
+        rs = None
+        reports = pair.stop(timeout_s=30.0)
+        # SIGKILLed active: no exit line by construction; survivor
+        # drains politely and reports its takeover.
+        assert reports[victim] is None
+        assert reports[survivor.name]["takeovers"] == 1
+    finally:
+        if rs is not None:
+            rs.abort()
+        pair.stop(timeout_s=10.0)
+        srv.drain(timeout_s=10.0)
+        eng.stop()
+
+
+def test_status_cli_degrades_against_mid_takeover_proxy(tmp_path):
+    """``mano status --server`` pointed at a proxy pair whose ACTIVE
+    was just SIGKILLed: whatever instant the probe lands in — service
+    port still unbound, or the standby already active — the command
+    returns rc 0 within its bounded timeout (a down/hung server
+    degrades the block, never the exit code), and once the takeover
+    settles the block names the role and the takeover count. The
+    pair's one backend is dead on purpose: a DEGRADED aggregate
+    (ok=false) must still carry the proxy story."""
+    from mano_hand_tpu.edge import ProxyPair, ProxySpec
+
+    spec = ProxySpec(
+        port=_free_port(), lock_path=str(tmp_path / "proxy.lock"),
+        backends=[("w0", "127.0.0.1", _free_port())])
+    env = dict(os.environ)
+    env["TF_CPP_MIN_LOG_LEVEL"] = "3"
+    # Its own cache dir: the subprocess must never share this pytest
+    # process's compile cache (CLAUDE.md crash class).
+    env["MANO_TEST_CACHE_DIR"] = str(tmp_path / "jax_cache_status")
+
+    def status():
+        return subprocess.run(
+            [sys.executable, "-m", "mano_hand_tpu.cli", "status",
+             "--platforms", "cpu", "--server",
+             f"127.0.0.1:{spec.port}", "--server-timeout", "10.0"],
+            capture_output=True, text=True, timeout=300, env=env)
+
+    pair = ProxyPair(spec, env={"MANO_TEST_CACHE_DIR":
+                                str(tmp_path / "jax_cache_proxy")},
+                     stderr_dir=str(tmp_path))
+    try:
+        pair.start(timeout_s=60.0)
+        pair.kill_active()
+        # Mid-takeover probe: rc 0 and a well-formed block, hang-free,
+        # regardless of which side of the flock race it lands on.
+        res = status()
+        assert res.returncode == 0, res.stderr[-2000:]
+        blk = json.loads(res.stdout)["server"]
+        assert ("error" in blk) or (blk.get("proxy_role")
+                                    in ("active", "standby"))
+        # Settled: the survivor tells the takeover story.
+        pair.wait_active(timeout_s=60.0)
+        res = status()
+        assert res.returncode == 0, res.stderr[-2000:]
+        blk = json.loads(res.stdout)["server"]
+        assert blk["role"] == "proxy"
+        assert blk["proxy_role"] == "active"
+        assert blk["takeovers"] == 1
+        assert blk["ok"] is False          # the dead backend degrades
+        assert blk["backends"]["w0"]["ok"] is False
+    finally:
+        pair.stop(timeout_s=10.0)
+
+
+# ------------------------------------- shard rebalance (PR-16 remainder)
+def test_shard_rebalance_bit_identity_zero_recompiles(params32,
+                                                      tmp_path):
+    """Lane loss with a SHARDED store: the dead lane's shard is
+    auto-adopted (the placement path kicks the rebalance — the test
+    never calls it), its subjects keep serving BIT-identical to the
+    single-device reference engine, and the whole loss+adopt cycle
+    compiles NOTHING (the ``(bucket, cap)`` keying never saw the
+    shard id)."""
+    from mano_hand_tpu.serving.engine import ServingEngine
+    from mano_hand_tpu.serving.subject_store import (
+        SubjectStore,
+        SubjectStoreConfig,
+    )
+
+    lanes = 2
+    rng = np.random.default_rng(31)
+    betas = [rng.normal(size=(params32.n_shape,)).astype(np.float32)
+             for _ in range(6)]
+    poses = [rng.normal(scale=0.4,
+                        size=(2, params32.n_joints, 3)).astype(
+                 np.float32) for _ in range(6)]
+    with ServingEngine(params32, max_bucket=4, max_delay_s=0.001) as ref:
+        ref_keys = [ref.specialize(b) for b in betas]
+        want = [ref.forward(poses[i], subject=ref_keys[i])
+                for i in range(6)]
+
+    store = SubjectStore(SubjectStoreConfig(
+        warm_capacity=4, cold_dir=str(tmp_path / "cold"), sharded=True,
+        backend="pickle"))
+    lane_ok = [True] * lanes
+    policy = DispatchPolicy(
+        deadline_s=30.0, retries=1, backoff_s=0.005, backoff_cap_s=0.01,
+        jitter=0.0,
+        breaker=CircuitBreaker(failure_threshold=2,
+                               probe_interval_s=0.001,
+                               respect_priority_claim=False),
+        cpu_fallback=True)
+    with ServingEngine(params32, max_bucket=4, max_delay_s=0.002,
+                       policy=policy, lanes=lanes,
+                       lane_probe=lambda i: lane_ok[i],
+                       max_subjects=8, subject_store=store) as eng:
+        keys = [eng.specialize(b) for b in betas]
+        for i in range(6):                       # warm every program
+            np.testing.assert_array_equal(
+                eng.forward(poses[i], subject=keys[i]), want[i])
+        dead = store.shard_for(keys[0])
+        owned = [i for i in range(6)
+                 if store.shard_for(keys[i]) == dead]
+        assert owned                             # the dead shard is real
+        base = eng.counters.snapshot()
+        # Lane loss through the public API: probe pinned false, the
+        # breaker driven DOWN by recorded failures (never a raw poke).
+        lane_ok[dead] = False
+        br = eng._get_lanes().lanes[dead].breaker
+        for _ in range(64):
+            if br is None or br.record_failure() == health.DOWN:
+                break
+        # The next dead-shard placement AUTO-kicks the rebalance.
+        got0 = eng.forward(poses[owned[0]], subject=keys[owned[0]])
+        np.testing.assert_array_equal(got0, want[owned[0]])
+        deadline = time.monotonic() + 60.0
+        while (eng.counters.snapshot()["shard_rebalances"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        for i in owned:                          # adopted-shard serving
+            np.testing.assert_array_equal(
+                eng.forward(poses[i], subject=keys[i]), want[i])
+        after = eng.counters.snapshot()
+        assert after["shard_rebalances"] == 1    # counted exactly once
+        assert after["compiles"] == base["compiles"]   # zero recompiles
+        reassigned = store.snapshot()["reassigned_shards"]
+        assert str(dead) in {str(k) for k in reassigned}
+        # Epoch guard: a second dead-shard request does not re-kick.
+        eng.forward(poses[owned[0]], subject=keys[owned[0]])
+        assert eng.counters.snapshot()["shard_rebalances"] == 1
+
+
+# ---------------------------------------------------- the drill protocol
+def test_selfheal_drill_protocol_plumbing(params, tmp_path):
+    """config23's protocol end to end at plumbing size: 3 REAL worker
+    processes under a supervisor, an active/standby proxy pair, a
+    seeded kill/takeover/partition campaign, the storm leg, and the
+    in-process rebalance/damage legs — every judged invariant must
+    already hold here, far from the scarce chip."""
+    from mano_hand_tpu.serving.measure import selfheal_drill_run
+
+    sd = selfheal_drill_run(
+        params, workers=3, lanes=2, streams=4, frames_per_stream=6,
+        stream_workers=4, unique_tracks=2, max_bucket=4,
+        max_subjects=8, store_warm_capacity=4,
+        work_dir=str(tmp_path), ready_timeout_s=420.0)
+    assert sd["selfheal_drill_schema"] == 1
+    assert sd["lattice_boot_ok"] is True
+    assert sd["campaign_done"] is True
+    assert sd["terminal_fraction"] == 1.0
+    assert sd["outcomes"]["exception"] == 0
+    assert sd["closes_ok"] == 4
+    assert sd["frames_compared"] == sd["frame_numbering_ok"] > 0
+    assert sd["pose_max_abs_err"] == 0.0
+    assert sd["verts_max_abs_err"] <= 1e-6
+    assert sd["all_deaths_auto_healed"] is True
+    assert sd["supervisor_restarts"] == sd["expected_heals"] == 2
+    assert sd["supervisor"]["abandoned"] == []
+    assert sd["mttr_within_budget"] is True
+    assert sd["proxy_health"]["takeovers"] == 1
+    assert len(sd["takeover_walls_ms"]) == 1
+    assert sd["steady_recompiles_total"] == 0
+    assert sd["spans_closed_exactly_once"] is True
+    st = sd["storm"]
+    assert st["incidents"] == 1
+    assert st["abandoned"] == [st["victim"]]
+    assert st["degraded_without_flap"] is True
+    assert st["degraded_pose_max_abs_err"] == 0.0
+    rb = sd["rebalance"]
+    assert rb["shard_rebalances"] == 1
+    assert rb["steady_recompiles"] == 0
+    assert rb["max_abs_err"] == 0.0
+    dm = sd["damage"]
+    assert dm["injected"] is True
+    assert dm["damage_counted"] >= 1
+    assert dm["request_max_abs_err"] == 0.0
